@@ -1,0 +1,37 @@
+"""deeplearning4j_tpu — a TPU-native deep learning framework.
+
+A ground-up rebuild of the capabilities of the Eclipse Deeplearning4j stack
+(reference: holgerbrandl/deeplearning4j) designed for TPU hardware:
+
+- whole-graph XLA compilation via ``jax.jit`` instead of per-op JNI dispatch
+  (reference: op-by-op ``NativeOpExecutioner#exec`` -> libnd4j ``execCustomOp2``)
+- parallelism as sharding (``jax.sharding.Mesh`` + ``shard_map`` + collectives)
+  instead of thread-per-device replicas (reference: ``ParallelWrapper``)
+- the configuration DSL (builder -> JSON round trip) is the durable API-parity
+  surface (reference: ``NeuralNetConfiguration.Builder`` ->
+  ``MultiLayerConfiguration``); the execution engine underneath is XLA.
+
+Package map (mirrors the reference's layer map, SURVEY.md section 1):
+
+- ``conf``      — config DSL: layers, vertices, updaters, losses, schedules
+                  (reference: ``deeplearning4j-nn/.../nn/conf/``)
+- ``nn``        — model runtimes: ``MultiLayerNetwork``, ``ComputationGraph``
+                  (reference: ``.../nn/multilayer/``, ``.../nn/graph/``)
+- ``ops``       — op library + Pallas kernels (reference: libnd4j declarable ops)
+- ``autodiff``  — SameDiff-equivalent symbolic graph API
+                  (reference: ``nd4j/.../autodiff/samediff/``)
+- ``datasets``  — ``DataSet``/iterators (reference: ``org.nd4j.linalg.dataset``)
+- ``datavec``   — ETL: record readers, transforms
+                  (reference: ``datavec/``)
+- ``eval``      — ``Evaluation``/``ROC``/``RegressionEvaluation``
+                  (reference: ``org.nd4j.evaluation``)
+- ``optimize``  — solver loop, listeners, early stopping
+                  (reference: ``org.deeplearning4j.optimize``)
+- ``parallel``  — mesh/topology, ParallelWrapper-equivalent, compressed grads
+                  (reference: ``deeplearning4j-scaleout``)
+- ``zoo``       — model zoo (reference: ``deeplearning4j-zoo``)
+- ``util``      — ModelSerializer, checkpointing
+                  (reference: ``.../util/ModelSerializer``)
+"""
+
+__version__ = "0.1.0"

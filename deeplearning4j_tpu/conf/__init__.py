@@ -1,0 +1,11 @@
+"""Configuration DSL (reference: ``deeplearning4j-nn/.../nn/conf/`` +
+``org.nd4j.linalg.learning.config`` + ``org.nd4j.linalg.lossfunctions``).
+
+Configs are plain dataclasses that serialize to JSON with full round-trip
+fidelity (see :mod:`deeplearning4j_tpu.serde`); they are *data*, the durable
+API-parity surface. Execution lowers them to jitted XLA programs.
+"""
+
+from deeplearning4j_tpu.conf.activations import Activation
+from deeplearning4j_tpu.conf.inputs import InputType
+from deeplearning4j_tpu.conf.weights import WeightInit

@@ -1,0 +1,81 @@
+"""Activation functions.
+
+Reference: ``org.nd4j.linalg.activations.Activation`` enum + per-activation
+``IActivation`` impls (``nd4j/.../linalg/activations/impl/``). There each
+activation carries its own backprop; here they are plain jax functions and
+``jax.grad`` differentiates them — XLA fuses them into adjacent matmuls, so
+unlike the reference there is no per-activation kernel dispatch.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import serde
+
+
+@serde.register_enum
+class Activation(enum.Enum):
+    """Mirrors the reference's ``Activation`` enum values."""
+
+    IDENTITY = "identity"
+    SIGMOID = "sigmoid"
+    TANH = "tanh"
+    RELU = "relu"
+    RELU6 = "relu6"
+    LEAKYRELU = "leakyrelu"
+    ELU = "elu"
+    SELU = "selu"
+    GELU = "gelu"
+    SOFTMAX = "softmax"
+    SOFTPLUS = "softplus"
+    SOFTSIGN = "softsign"
+    SWISH = "swish"
+    MISH = "mish"
+    HARDSIGMOID = "hardsigmoid"
+    HARDTANH = "hardtanh"
+    CUBE = "cube"
+    RATIONALTANH = "rationaltanh"
+    RECTIFIEDTANH = "rectifiedtanh"
+    THRESHOLDEDRELU = "thresholdedrelu"
+
+    def apply(self, x):
+        return _FNS[self](x)
+
+
+def _rationaltanh(x):
+    # Reference ActivationRationalTanh: 1.7159 * tanh_approx(2x/3) where
+    # tanh_approx(y) = sign(y) * (1 - 1/(1+|y|+y^2+1.41645*y^4))
+    y = 2.0 * x / 3.0
+    a = jnp.abs(y)
+    approx = jnp.sign(y) * (1.0 - 1.0 / (1.0 + a + y * y + 1.41645 * (y ** 4)))
+    return 1.7159 * approx
+
+
+_FNS = {
+    Activation.IDENTITY: lambda x: x,
+    Activation.SIGMOID: jax.nn.sigmoid,
+    Activation.TANH: jnp.tanh,
+    Activation.RELU: jax.nn.relu,
+    Activation.RELU6: jax.nn.relu6,
+    Activation.LEAKYRELU: lambda x: jax.nn.leaky_relu(x, 0.01),
+    Activation.ELU: jax.nn.elu,
+    Activation.SELU: jax.nn.selu,
+    Activation.GELU: jax.nn.gelu,
+    Activation.SOFTMAX: lambda x: jax.nn.softmax(x, axis=-1),
+    Activation.SOFTPLUS: jax.nn.softplus,
+    Activation.SOFTSIGN: jax.nn.soft_sign,
+    Activation.SWISH: jax.nn.swish,
+    Activation.MISH: jax.nn.mish,
+    # Reference ActivationHardSigmoid: clip(0.2*x + 0.5, 0, 1) — NOT jax's
+    # relu6-based hard_sigmoid (slope 1/6).
+    Activation.HARDSIGMOID: lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
+    Activation.HARDTANH: jax.nn.hard_tanh,
+    Activation.CUBE: lambda x: x ** 3,
+    Activation.RATIONALTANH: _rationaltanh,
+    Activation.RECTIFIEDTANH: lambda x: jax.nn.relu(jnp.tanh(x)),
+    Activation.THRESHOLDEDRELU: lambda x: jnp.where(x > 1.0, x, 0.0),
+}

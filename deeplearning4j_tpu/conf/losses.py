@@ -1,0 +1,321 @@
+"""Loss functions.
+
+Reference: ``org.nd4j.linalg.lossfunctions.impl.*`` (LossMSE, LossMAE,
+LossL1/L2, LossMAPE, LossMSLE, LossMCXENT, LossSparseMCXENT, LossBinaryXENT,
+LossNegativeLogLikelihood, LossHinge, LossSquaredHinge, LossCosineProximity,
+LossPoisson, LossKLD, LossFMeasure, LossWasserstein) and the
+``ILossFunction`` contract (computeScore / computeGradient, per-example mask,
+optional per-output weights).
+
+Differences by design: the reference hand-writes ``computeGradient`` (dL/dz)
+per loss; here losses are differentiable jax code and the gradient is
+``jax.grad`` through the fused (activation + loss) expression — which also
+gives the numerically-stable softmax/sigmoid cross-entropy forms that the
+reference special-cases inside LossMCXENT/LossBinaryXENT.
+
+Contract: ``score(labels, pre_output, activation, mask) -> scalar`` (mean over
+examples; mask is per-example or per-timestep-broadcastable, matching the
+reference's masking semantics in §5.7 of SURVEY.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import serde
+from deeplearning4j_tpu.conf.activations import Activation
+
+
+def _apply_weights(per_out, weights):
+    if weights is not None:
+        per_out = per_out * jnp.asarray(weights, per_out.dtype)
+    return per_out
+
+
+def _reduce(per_pos, mask):
+    """Mean over (masked) positions. ``per_pos``: [batch] or [batch, time] —
+    matches the reference's reshape-to-[batch*time] masked averaging in RNN
+    output layers (SURVEY.md §5.7)."""
+    if mask is not None:
+        mask = jnp.asarray(mask, per_pos.dtype)
+        if mask.ndim > per_pos.ndim:  # e.g. [batch, 1] column mask vs [batch]
+            mask = mask.reshape(per_pos.shape)
+        while mask.ndim < per_pos.ndim:
+            mask = mask[..., None]
+        mask = jnp.broadcast_to(mask, per_pos.shape)
+        total = jnp.sum(per_pos * mask)
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+        return total / denom
+    return jnp.mean(per_pos)
+
+
+@dataclasses.dataclass
+class ILossFunction:
+    """Base loss contract. ``weights``: optional per-output weighting
+    (reference: constructor arg on most losses)."""
+
+    def score(self, labels, pre_output, activation: Activation, mask=None):
+        raise NotImplementedError
+
+    def output(self, pre_output, activation: Activation):
+        return activation.apply(pre_output)
+
+    def _per_example(self, per_out):
+        """Sum per-output losses over the feature axis only, keeping any time
+        axis so per-timestep masks apply position-wise."""
+        return jnp.sum(per_out, axis=-1) if per_out.ndim >= 2 else per_out
+
+
+@serde.register
+@dataclasses.dataclass
+class LossMSE(ILossFunction):
+    """Mean squared error, averaged over output size (reference LossMSE =
+    LossL2 / nOut)."""
+
+    weights: Optional[Sequence[float]] = None
+
+    def score(self, labels, pre_output, activation, mask=None):
+        out = activation.apply(pre_output)
+        per_out = _apply_weights((out - labels) ** 2, self.weights)
+        n_out = labels.shape[-1]
+        return _reduce(self._per_example(per_out) / n_out, mask)
+
+
+@serde.register
+@dataclasses.dataclass
+class LossL2(ILossFunction):
+    """Sum of squared errors per example (no /nOut)."""
+
+    weights: Optional[Sequence[float]] = None
+
+    def score(self, labels, pre_output, activation, mask=None):
+        out = activation.apply(pre_output)
+        per_out = _apply_weights((out - labels) ** 2, self.weights)
+        return _reduce(self._per_example(per_out), mask)
+
+
+@serde.register
+@dataclasses.dataclass
+class LossMAE(ILossFunction):
+    weights: Optional[Sequence[float]] = None
+
+    def score(self, labels, pre_output, activation, mask=None):
+        out = activation.apply(pre_output)
+        per_out = _apply_weights(jnp.abs(out - labels), self.weights)
+        n_out = labels.shape[-1]
+        return _reduce(self._per_example(per_out) / n_out, mask)
+
+
+@serde.register
+@dataclasses.dataclass
+class LossL1(ILossFunction):
+    weights: Optional[Sequence[float]] = None
+
+    def score(self, labels, pre_output, activation, mask=None):
+        out = activation.apply(pre_output)
+        per_out = _apply_weights(jnp.abs(out - labels), self.weights)
+        return _reduce(self._per_example(per_out), mask)
+
+
+@serde.register
+@dataclasses.dataclass
+class LossMAPE(ILossFunction):
+    weights: Optional[Sequence[float]] = None
+
+    def score(self, labels, pre_output, activation, mask=None):
+        out = activation.apply(pre_output)
+        per_out = 100.0 * jnp.abs(out - labels) / (jnp.abs(labels) + 1e-8)
+        per_out = _apply_weights(per_out, self.weights)
+        n_out = labels.shape[-1]
+        return _reduce(self._per_example(per_out) / n_out, mask)
+
+
+@serde.register
+@dataclasses.dataclass
+class LossMSLE(ILossFunction):
+    weights: Optional[Sequence[float]] = None
+
+    def score(self, labels, pre_output, activation, mask=None):
+        out = activation.apply(pre_output)
+        per_out = (jnp.log1p(labels) - jnp.log1p(out)) ** 2
+        per_out = _apply_weights(per_out, self.weights)
+        n_out = labels.shape[-1]
+        return _reduce(self._per_example(per_out) / n_out, mask)
+
+
+@serde.register
+@dataclasses.dataclass
+class LossMCXENT(ILossFunction):
+    """Multi-class cross entropy. With SOFTMAX activation uses the fused
+    log-softmax form (reference LossMCXENT special-cases softmax too).
+    ``soft_label_clipping`` mirrors the reference's clipEps."""
+
+    weights: Optional[Sequence[float]] = None
+    clip_eps: float = 1e-10
+
+    def score(self, labels, pre_output, activation, mask=None):
+        if activation is Activation.SOFTMAX:
+            logp = jax.nn.log_softmax(pre_output, axis=-1)
+        else:
+            out = jnp.clip(activation.apply(pre_output), self.clip_eps, 1.0)
+            logp = jnp.log(out)
+        per_out = _apply_weights(-labels * logp, self.weights)
+        return _reduce(self._per_example(per_out), mask)
+
+
+@serde.register
+@dataclasses.dataclass
+class LossSparseMCXENT(LossMCXENT):
+    """Labels are integer class indices, not one-hot (reference
+    LossSparseMCXENT)."""
+
+    def score(self, labels, pre_output, activation, mask=None):
+        labels = jnp.asarray(labels)
+        if labels.ndim == pre_output.ndim:  # [batch, 1] -> [batch]
+            labels = labels.squeeze(-1)
+        oh = jax.nn.one_hot(labels.astype(jnp.int32), pre_output.shape[-1],
+                            dtype=pre_output.dtype)
+        return super().score(oh, pre_output, activation, mask)
+
+
+@serde.register
+@dataclasses.dataclass
+class LossBinaryXENT(ILossFunction):
+    """Binary cross entropy; stable fused form under SIGMOID (reference
+    LossBinaryXENT with its sigmoid special case)."""
+
+    weights: Optional[Sequence[float]] = None
+    clip_eps: float = 1e-7
+
+    def score(self, labels, pre_output, activation, mask=None):
+        if activation is Activation.SIGMOID:
+            # log(sigmoid(z)) = -softplus(-z); log(1-sigmoid(z)) = -softplus(z)
+            per_out = (
+                labels * jax.nn.softplus(-pre_output)
+                + (1.0 - labels) * jax.nn.softplus(pre_output)
+            )
+        else:
+            out = jnp.clip(activation.apply(pre_output), self.clip_eps,
+                           1.0 - self.clip_eps)
+            per_out = -(labels * jnp.log(out) + (1.0 - labels) * jnp.log1p(-out))
+        per_out = _apply_weights(per_out, self.weights)
+        return _reduce(self._per_example(per_out), mask)
+
+
+@serde.register
+@dataclasses.dataclass
+class LossNegativeLogLikelihood(LossMCXENT):
+    """Identical scoring to MCXENT in the reference (alias when labels are
+    one-hot probabilities)."""
+
+
+@serde.register
+@dataclasses.dataclass
+class LossHinge(ILossFunction):
+    def score(self, labels, pre_output, activation, mask=None):
+        out = activation.apply(pre_output)
+        per_out = jnp.maximum(0.0, 1.0 - labels * out)
+        return _reduce(self._per_example(per_out), mask)
+
+
+@serde.register
+@dataclasses.dataclass
+class LossSquaredHinge(ILossFunction):
+    def score(self, labels, pre_output, activation, mask=None):
+        out = activation.apply(pre_output)
+        per_out = jnp.maximum(0.0, 1.0 - labels * out) ** 2
+        return _reduce(self._per_example(per_out), mask)
+
+
+@serde.register
+@dataclasses.dataclass
+class LossCosineProximity(ILossFunction):
+    def score(self, labels, pre_output, activation, mask=None):
+        out = activation.apply(pre_output)
+        dot = jnp.sum(labels * out, axis=-1)
+        norm = jnp.linalg.norm(labels, axis=-1) * jnp.linalg.norm(out, axis=-1)
+        return _reduce(-dot / (norm + 1e-8), mask)
+
+
+@serde.register
+@dataclasses.dataclass
+class LossPoisson(ILossFunction):
+    def score(self, labels, pre_output, activation, mask=None):
+        out = activation.apply(pre_output)
+        per_out = out - labels * jnp.log(out + 1e-8)
+        return _reduce(self._per_example(per_out), mask)
+
+
+@serde.register
+@dataclasses.dataclass
+class LossKLD(ILossFunction):
+    def score(self, labels, pre_output, activation, mask=None):
+        out = activation.apply(pre_output)
+        safe_labels = jnp.clip(labels, 1e-8, 1.0)
+        per_out = labels * (jnp.log(safe_labels) - jnp.log(out + 1e-8))
+        return _reduce(self._per_example(per_out), mask)
+
+
+@serde.register
+@dataclasses.dataclass
+class LossWasserstein(ILossFunction):
+    def score(self, labels, pre_output, activation, mask=None):
+        out = activation.apply(pre_output)
+        return _reduce(self._per_example(labels * out), mask)
+
+
+@serde.register
+@dataclasses.dataclass
+class LossFMeasure(ILossFunction):
+    """Differentiable (soft) F-beta for binary problems (reference
+    LossFMeasure: computed over the whole batch, not per-example)."""
+
+    beta: float = 1.0
+
+    def score(self, labels, pre_output, activation, mask=None):
+        out = activation.apply(pre_output)
+        if out.shape[-1] == 2:  # two-column softmax form: positive prob col 1
+            out = out[..., 1]
+            labels = labels[..., 1]
+        else:
+            out = out.squeeze(-1) if out.ndim > 1 and out.shape[-1] == 1 else out
+            labels = (
+                labels.squeeze(-1)
+                if labels.ndim > 1 and labels.shape[-1] == 1
+                else labels
+            )
+        if mask is not None:
+            m = jnp.asarray(mask, out.dtype).reshape(out.shape)
+            out, labels = out * m, labels * m
+        b2 = self.beta ** 2
+        tp = jnp.sum(labels * out)
+        fp = jnp.sum((1.0 - labels) * out)
+        fn = jnp.sum(labels * (1.0 - out))
+        num = (1.0 + b2) * tp
+        return 1.0 - num / (num + b2 * fn + fp + 1e-8)
+
+
+# name -> default instance, mirroring reference LossFunctions.LossFunction enum
+LOSS_FUNCTIONS = {
+    "MSE": LossMSE,
+    "L2": LossL2,
+    "MAE": LossMAE,
+    "L1": LossL1,
+    "MAPE": LossMAPE,
+    "MSLE": LossMSLE,
+    "MCXENT": LossMCXENT,
+    "SPARSE_MCXENT": LossSparseMCXENT,
+    "XENT": LossBinaryXENT,
+    "NEGATIVELOGLIKELIHOOD": LossNegativeLogLikelihood,
+    "HINGE": LossHinge,
+    "SQUARED_HINGE": LossSquaredHinge,
+    "COSINE_PROXIMITY": LossCosineProximity,
+    "POISSON": LossPoisson,
+    "KL_DIVERGENCE": LossKLD,
+    "WASSERSTEIN": LossWasserstein,
+    "FMEASURE": LossFMeasure,
+}

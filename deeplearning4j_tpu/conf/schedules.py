@@ -1,0 +1,202 @@
+"""Learning-rate / momentum schedules.
+
+Reference: ``org.nd4j.linalg.schedule.ISchedule`` + impls (StepSchedule,
+ExponentialSchedule, InverseSchedule, PolySchedule, SigmoidSchedule,
+MapSchedule, CycleSchedule, FixedSchedule, RampSchedule). Schedules are pure
+``value(iteration, epoch)`` functions of traced integers so they can live
+inside a jitted train step (no Python branching on the step counter).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import serde
+
+
+@serde.register_enum
+class ScheduleType(enum.Enum):
+    """Reference: ``org.nd4j.linalg.schedule.ScheduleType``."""
+
+    ITERATION = "iteration"
+    EPOCH = "epoch"
+
+
+@dataclasses.dataclass
+class ISchedule:
+    """Base schedule contract: ``value_at(iteration, epoch) -> scalar``."""
+
+    def value_at(self, iteration, epoch):
+        raise NotImplementedError
+
+    def _t(self, iteration, epoch):
+        st = getattr(self, "schedule_type", ScheduleType.ITERATION)
+        t = epoch if st is ScheduleType.EPOCH else iteration
+        return jnp.asarray(t, jnp.float32)
+
+
+@serde.register
+@dataclasses.dataclass
+class FixedSchedule(ISchedule):
+    value: float = 0.001
+
+    def value_at(self, iteration, epoch):
+        return jnp.asarray(self.value, jnp.float32)
+
+
+@serde.register
+@dataclasses.dataclass
+class StepSchedule(ISchedule):
+    """value * decayRate^floor(t/step)."""
+
+    schedule_type: ScheduleType = ScheduleType.ITERATION
+    initial_value: float = 0.001
+    decay_rate: float = 0.5
+    step: float = 1000.0
+
+    def value_at(self, iteration, epoch):
+        t = self._t(iteration, epoch)
+        return self.initial_value * self.decay_rate ** jnp.floor(t / self.step)
+
+
+@serde.register
+@dataclasses.dataclass
+class ExponentialSchedule(ISchedule):
+    """value * gamma^t."""
+
+    schedule_type: ScheduleType = ScheduleType.ITERATION
+    initial_value: float = 0.001
+    gamma: float = 0.99
+
+    def value_at(self, iteration, epoch):
+        return self.initial_value * self.gamma ** self._t(iteration, epoch)
+
+
+@serde.register
+@dataclasses.dataclass
+class InverseSchedule(ISchedule):
+    """value / (1 + gamma*t)^power."""
+
+    schedule_type: ScheduleType = ScheduleType.ITERATION
+    initial_value: float = 0.001
+    gamma: float = 0.01
+    power: float = 1.0
+
+    def value_at(self, iteration, epoch):
+        t = self._t(iteration, epoch)
+        return self.initial_value / (1.0 + self.gamma * t) ** self.power
+
+
+@serde.register
+@dataclasses.dataclass
+class PolySchedule(ISchedule):
+    """value * (1 - t/maxIter)^power, clamped at 0 past maxIter."""
+
+    schedule_type: ScheduleType = ScheduleType.ITERATION
+    initial_value: float = 0.001
+    power: float = 2.0
+    max_iter: int = 10000
+
+    def value_at(self, iteration, epoch):
+        t = self._t(iteration, epoch)
+        frac = jnp.clip(1.0 - t / float(self.max_iter), 0.0, 1.0)
+        return self.initial_value * frac ** self.power
+
+
+@serde.register
+@dataclasses.dataclass
+class SigmoidSchedule(ISchedule):
+    """Caffe-style sigmoid LR policy (reference ``SigmoidSchedule``):
+    ``value = initialValue / (1 + exp(-gamma * (t - stepSize)))``.
+    Negative gamma gives the usual smooth step-DOWN centered at stepSize
+    (half of initialValue exactly at t == stepSize)."""
+
+    schedule_type: ScheduleType = ScheduleType.ITERATION
+    initial_value: float = 0.001
+    gamma: float = -0.1
+    step_size: int = 1000
+
+    def value_at(self, iteration, epoch):
+        t = self._t(iteration, epoch)
+        return self.initial_value / (1.0 + jnp.exp(-self.gamma * (t - self.step_size)))
+
+
+@serde.register
+@dataclasses.dataclass
+class MapSchedule(ISchedule):
+    """Piecewise-constant: explicit {t: value} map; holds last value.
+
+    Reference: ``MapSchedule`` (values must include t=0).
+    """
+
+    schedule_type: ScheduleType = ScheduleType.ITERATION
+    values: dict = dataclasses.field(default_factory=lambda: {"0": 0.001})
+
+    def __post_init__(self):
+        # Normalize int keys (natural form, matching the reference's
+        # Map<Integer,Double>) to strings so JSON round-trip is identity.
+        self.values = {str(k): float(v) for k, v in self.values.items()}
+
+    def value_at(self, iteration, epoch):
+        t = self._t(iteration, epoch)
+        pts = sorted((int(k), float(v)) for k, v in self.values.items())
+        out = jnp.asarray(pts[0][1], jnp.float32)
+        for start, val in pts[1:]:
+            out = jnp.where(t >= start, val, out)
+        return out
+
+
+@serde.register
+@dataclasses.dataclass
+class CycleSchedule(ISchedule):
+    """1cycle policy (reference ``CycleSchedule``): linear ramp up to
+    initialValue*cycleLengthMult... simplified: warm up from initial/div to
+    peak over half the cycle, anneal back, then decay tail."""
+
+    schedule_type: ScheduleType = ScheduleType.ITERATION
+    initial_value: float = 0.001
+    div_factor: float = 25.0
+    cycle_length: int = 1000
+    annealing_length: int = 100
+    annealing_decay: float = 0.1
+
+    def value_at(self, iteration, epoch):
+        t = self._t(iteration, epoch)
+        lo = self.initial_value / self.div_factor
+        half = (self.cycle_length - self.annealing_length) / 2.0
+        up = lo + (self.initial_value - lo) * (t / jnp.maximum(half, 1.0))
+        down = self.initial_value - (self.initial_value - lo) * (
+            (t - half) / jnp.maximum(half, 1.0)
+        )
+        anneal_t = t - (self.cycle_length - self.annealing_length)
+        anneal = lo * (
+            self.annealing_decay
+            + (1.0 - self.annealing_decay)
+            * (1.0 - anneal_t / jnp.maximum(float(self.annealing_length), 1.0))
+        )
+        v = jnp.where(t < half, up, down)
+        v = jnp.where(t >= self.cycle_length - self.annealing_length, anneal, v)
+        return jnp.maximum(v, 0.0)
+
+
+@serde.register
+@dataclasses.dataclass
+class WarmupSchedule(ISchedule):
+    """Linear warmup then hand-off to an inner schedule (shifted by warmup).
+
+    No direct reference equivalent (reference RampSchedule is similar);
+    included because every Transformer config needs it.
+    """
+
+    warmup_steps: int = 100
+    inner: ISchedule = dataclasses.field(default_factory=FixedSchedule)
+
+    def value_at(self, iteration, epoch):
+        t = jnp.asarray(iteration, jnp.float32)
+        peak = self.inner.value_at(0, 0)
+        ramp = peak * (t + 1.0) / float(max(self.warmup_steps, 1))
+        after = self.inner.value_at(iteration - self.warmup_steps, epoch)
+        return jnp.where(t < self.warmup_steps, ramp, after)

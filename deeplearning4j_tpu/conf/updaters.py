@@ -1,0 +1,270 @@
+"""Gradient updaters (optimizers).
+
+Reference: ``org.nd4j.linalg.learning.config.*`` (Sgd, Adam, AdamW, AMSGrad,
+AdaMax, Nadam, Nesterovs, AdaGrad, AdaDelta, RmsProp, NoOp) and the matching
+``GradientUpdater#applyUpdater`` impls in ``org.nd4j.linalg.learning``.
+
+Semantics follow the reference: ``applyUpdater`` transforms the raw gradient
+into the *update* tensor and the solver then does ``params -= update``. Here
+each updater is a pure per-leaf transform ``update_leaf(g, state, lr, t)``
+mapped over the params pytree inside the jitted train step; state is a pytree
+mirroring params (the reference keeps it as one flat vector — the flatten
+order spec in :mod:`deeplearning4j_tpu.util.params` reproduces that layout for
+serializer parity).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import serde
+from deeplearning4j_tpu.conf.schedules import ISchedule
+
+
+@dataclasses.dataclass
+class IUpdater:
+    """Base updater contract (reference: ``IUpdater`` interface)."""
+
+    def init_state(self, param):
+        """Return this updater's state pytree for one parameter tensor."""
+        return {}
+
+    def update_leaf(self, g, state, lr, t):
+        """(gradient, state, lr scalar, iteration) -> (update, new_state)."""
+        raise NotImplementedError
+
+    # state-size accounting, reference IUpdater#stateSize
+    def state_size(self, n_params: int) -> int:
+        return 0
+
+    def current_lr(self, iteration, epoch):
+        sched: Optional[ISchedule] = getattr(self, "lr_schedule", None)
+        if sched is not None:
+            return sched.value_at(iteration, epoch)
+        return jnp.asarray(getattr(self, "learning_rate", 0.0), jnp.float32)
+
+
+@serde.register
+@dataclasses.dataclass
+class Sgd(IUpdater):
+    learning_rate: float = 0.1
+    lr_schedule: Optional[ISchedule] = None
+
+    def update_leaf(self, g, state, lr, t):
+        return lr * g, state
+
+
+@serde.register
+@dataclasses.dataclass
+class NoOp(IUpdater):
+    """Gradient passed through untouched (used by tests / frozen layers)."""
+
+    def update_leaf(self, g, state, lr, t):
+        return g, state
+
+    def current_lr(self, iteration, epoch):
+        return jnp.asarray(1.0, jnp.float32)
+
+
+@serde.register
+@dataclasses.dataclass
+class Adam(IUpdater):
+    learning_rate: float = 0.001
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+    lr_schedule: Optional[ISchedule] = None
+
+    def init_state(self, param):
+        return {"m": jnp.zeros_like(param), "v": jnp.zeros_like(param)}
+
+    def state_size(self, n):
+        return 2 * n
+
+    def update_leaf(self, g, state, lr, t):
+        m = self.beta1 * state["m"] + (1.0 - self.beta1) * g
+        v = self.beta2 * state["v"] + (1.0 - self.beta2) * g * g
+        tt = t + 1.0
+        alpha = lr * jnp.sqrt(1.0 - self.beta2 ** tt) / (1.0 - self.beta1 ** tt)
+        return alpha * m / (jnp.sqrt(v) + self.epsilon), {"m": m, "v": v}
+
+
+@serde.register
+@dataclasses.dataclass
+class AdamW(Adam):
+    """Adam with decoupled weight decay (update includes wd*param term at
+    apply time via the solver's regularization hook, matching reference
+    ``org.nd4j.linalg.learning.config.AdamW`` / ``WeightDecay``)."""
+
+    weight_decay: float = 0.01
+
+
+@serde.register
+@dataclasses.dataclass
+class AMSGrad(IUpdater):
+    learning_rate: float = 0.001
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+    lr_schedule: Optional[ISchedule] = None
+
+    def init_state(self, param):
+        z = jnp.zeros_like(param)
+        return {"m": z, "v": z, "vhat": z}
+
+    def state_size(self, n):
+        return 3 * n
+
+    def update_leaf(self, g, state, lr, t):
+        m = self.beta1 * state["m"] + (1.0 - self.beta1) * g
+        v = self.beta2 * state["v"] + (1.0 - self.beta2) * g * g
+        vhat = jnp.maximum(state["vhat"], v)
+        tt = t + 1.0
+        alpha = lr * jnp.sqrt(1.0 - self.beta2 ** tt) / (1.0 - self.beta1 ** tt)
+        return (
+            alpha * m / (jnp.sqrt(vhat) + self.epsilon),
+            {"m": m, "v": v, "vhat": vhat},
+        )
+
+
+@serde.register
+@dataclasses.dataclass
+class AdaMax(IUpdater):
+    learning_rate: float = 0.002
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+    lr_schedule: Optional[ISchedule] = None
+
+    def init_state(self, param):
+        return {"m": jnp.zeros_like(param), "u": jnp.zeros_like(param)}
+
+    def state_size(self, n):
+        return 2 * n
+
+    def update_leaf(self, g, state, lr, t):
+        m = self.beta1 * state["m"] + (1.0 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * state["u"], jnp.abs(g))
+        tt = t + 1.0
+        alpha = lr / (1.0 - self.beta1 ** tt)
+        return alpha * m / (u + self.epsilon), {"m": m, "u": u}
+
+
+@serde.register
+@dataclasses.dataclass
+class Nadam(IUpdater):
+    learning_rate: float = 0.001
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+    lr_schedule: Optional[ISchedule] = None
+
+    def init_state(self, param):
+        return {"m": jnp.zeros_like(param), "v": jnp.zeros_like(param)}
+
+    def state_size(self, n):
+        return 2 * n
+
+    def update_leaf(self, g, state, lr, t):
+        m = self.beta1 * state["m"] + (1.0 - self.beta1) * g
+        v = self.beta2 * state["v"] + (1.0 - self.beta2) * g * g
+        tt = t + 1.0
+        mhat = m / (1.0 - self.beta1 ** (tt + 1.0))
+        ghat = g / (1.0 - self.beta1 ** tt)
+        vhat = v / (1.0 - self.beta2 ** tt)
+        mbar = self.beta1 * mhat + (1.0 - self.beta1) * ghat
+        return lr * mbar / (jnp.sqrt(vhat) + self.epsilon), {"m": m, "v": v}
+
+
+@serde.register
+@dataclasses.dataclass
+class Nesterovs(IUpdater):
+    learning_rate: float = 0.1
+    momentum: float = 0.9
+    lr_schedule: Optional[ISchedule] = None
+    momentum_schedule: Optional[ISchedule] = None
+
+    def init_state(self, param):
+        return {"v": jnp.zeros_like(param)}
+
+    def state_size(self, n):
+        return n
+
+    def current_momentum(self, iteration, epoch):
+        if self.momentum_schedule is not None:
+            return self.momentum_schedule.value_at(iteration, epoch)
+        return jnp.asarray(self.momentum, jnp.float32)
+
+    def update_leaf(self, g, state, lr, t):
+        # Reference NesterovsUpdater: vPrev = v; v = mu*v - lr*g;
+        # update = -(-mu*vPrev + (1+mu)*v); solver then does params -= update.
+        mu = self.current_momentum(t, 0)
+        v_prev = state["v"]
+        v = mu * v_prev - lr * g
+        update = -(-mu * v_prev + (1.0 + mu) * v)
+        return update, {"v": v}
+
+
+@serde.register
+@dataclasses.dataclass
+class AdaGrad(IUpdater):
+    learning_rate: float = 0.01
+    epsilon: float = 1e-6
+    lr_schedule: Optional[ISchedule] = None
+
+    def init_state(self, param):
+        return {"h": jnp.zeros_like(param)}
+
+    def state_size(self, n):
+        return n
+
+    def update_leaf(self, g, state, lr, t):
+        h = state["h"] + g * g
+        return lr * g / (jnp.sqrt(h) + self.epsilon), {"h": h}
+
+
+@serde.register
+@dataclasses.dataclass
+class AdaDelta(IUpdater):
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def init_state(self, param):
+        return {"msg": jnp.zeros_like(param), "msdx": jnp.zeros_like(param)}
+
+    def state_size(self, n):
+        return 2 * n
+
+    def update_leaf(self, g, state, lr, t):
+        msg = self.rho * state["msg"] + (1.0 - self.rho) * g * g
+        dx = (
+            jnp.sqrt(state["msdx"] + self.epsilon)
+            / jnp.sqrt(msg + self.epsilon)
+        ) * g
+        msdx = self.rho * state["msdx"] + (1.0 - self.rho) * dx * dx
+        return dx, {"msg": msg, "msdx": msdx}
+
+    def current_lr(self, iteration, epoch):
+        return jnp.asarray(1.0, jnp.float32)
+
+
+@serde.register
+@dataclasses.dataclass
+class RmsProp(IUpdater):
+    learning_rate: float = 0.001
+    rms_decay: float = 0.95
+    epsilon: float = 1e-8
+    lr_schedule: Optional[ISchedule] = None
+
+    def init_state(self, param):
+        return {"g2": jnp.zeros_like(param)}
+
+    def state_size(self, n):
+        return n
+
+    def update_leaf(self, g, state, lr, t):
+        g2 = self.rms_decay * state["g2"] + (1.0 - self.rms_decay) * g * g
+        return lr * g / (jnp.sqrt(g2) + self.epsilon), {"g2": g2}

@@ -1,0 +1,135 @@
+"""Weight initialization schemes.
+
+Reference: ``org.deeplearning4j.nn.weights.WeightInit`` enum +
+``WeightInitUtil`` (fan-in/fan-out based scaling), plus ``Distribution``
+configs (``org.deeplearning4j.nn.conf.distribution``). Initializers are pure
+functions of a jax PRNG key — counter-based and reproducible across device
+counts, unlike the reference's stateful global RNG.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import serde
+
+
+@serde.register
+@dataclasses.dataclass
+class Distribution:
+    """Reference: ``org.deeplearning4j.nn.conf.distribution.Distribution``.
+
+    kind: "normal" (mean/std), "uniform" (lower/upper), "truncated_normal",
+    "constant" (value), "orthogonal" (gain).
+    """
+
+    kind: str = "normal"
+    mean: float = 0.0
+    std: float = 1.0
+    lower: float = -1.0
+    upper: float = 1.0
+    value: float = 0.0
+    gain: float = 1.0
+
+    def sample(self, key, shape, dtype=jnp.float32):
+        if self.kind == "normal":
+            return self.mean + self.std * jax.random.normal(key, shape, dtype)
+        if self.kind == "truncated_normal":
+            return self.mean + self.std * jax.random.truncated_normal(
+                key, -2.0, 2.0, shape, dtype
+            )
+        if self.kind == "uniform":
+            return jax.random.uniform(
+                key, shape, dtype, minval=self.lower, maxval=self.upper
+            )
+        if self.kind == "constant":
+            return jnp.full(shape, self.value, dtype)
+        if self.kind == "orthogonal":
+            return self.gain * jax.nn.initializers.orthogonal()(key, shape, dtype)
+        raise ValueError(f"unknown distribution kind: {self.kind}")
+
+
+@serde.register_enum
+class WeightInit(enum.Enum):
+    """Mirrors the reference's ``WeightInit`` enum (WeightInitUtil scalings)."""
+
+    ZERO = "zero"
+    ONES = "ones"
+    CONSTANT = "constant"
+    NORMAL = "normal"               # N(0, 1/sqrt(fanIn))
+    UNIFORM = "uniform"             # U(-a, a), a = 1/sqrt(fanIn)
+    XAVIER = "xavier"               # N(0, 2/(fanIn+fanOut))
+    XAVIER_UNIFORM = "xavier_uniform"
+    XAVIER_FAN_IN = "xavier_fan_in"
+    RELU = "relu"                   # He: N(0, 2/fanIn)
+    RELU_UNIFORM = "relu_uniform"
+    LECUN_NORMAL = "lecun_normal"
+    LECUN_UNIFORM = "lecun_uniform"
+    SIGMOID_UNIFORM = "sigmoid_uniform"
+    VAR_SCALING_NORMAL_FAN_IN = "vs_normal_fan_in"
+    VAR_SCALING_NORMAL_FAN_OUT = "vs_normal_fan_out"
+    VAR_SCALING_NORMAL_FAN_AVG = "vs_normal_fan_avg"
+    VAR_SCALING_UNIFORM_FAN_IN = "vs_uniform_fan_in"
+    VAR_SCALING_UNIFORM_FAN_OUT = "vs_uniform_fan_out"
+    VAR_SCALING_UNIFORM_FAN_AVG = "vs_uniform_fan_avg"
+    IDENTITY = "identity"
+    DISTRIBUTION = "distribution"
+
+    def init(self, key, shape, fan_in, fan_out, dtype=jnp.float32,
+             distribution: Distribution | None = None):
+        """Sample a weight tensor. fan_in/fan_out follow WeightInitUtil."""
+        w = self
+        normal = lambda std: std * jax.random.normal(key, shape, dtype)
+        uniform = lambda a: jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+        if w is WeightInit.ZERO:
+            return jnp.zeros(shape, dtype)
+        if w is WeightInit.ONES:
+            return jnp.ones(shape, dtype)
+        if w is WeightInit.CONSTANT:
+            dist = distribution or Distribution(kind="constant", value=0.0)
+            return dist.sample(key, shape, dtype)
+        if w is WeightInit.NORMAL:
+            return normal(1.0 / jnp.sqrt(fan_in))
+        if w is WeightInit.UNIFORM:
+            return uniform(1.0 / jnp.sqrt(fan_in))
+        if w is WeightInit.XAVIER:
+            return normal(jnp.sqrt(2.0 / (fan_in + fan_out)))
+        if w is WeightInit.XAVIER_UNIFORM:
+            return uniform(jnp.sqrt(6.0 / (fan_in + fan_out)))
+        if w is WeightInit.XAVIER_FAN_IN:
+            return normal(jnp.sqrt(1.0 / fan_in))
+        if w is WeightInit.RELU:
+            return normal(jnp.sqrt(2.0 / fan_in))
+        if w is WeightInit.RELU_UNIFORM:
+            return uniform(jnp.sqrt(6.0 / fan_in))
+        if w is WeightInit.LECUN_NORMAL:
+            return normal(jnp.sqrt(1.0 / fan_in))
+        if w is WeightInit.LECUN_UNIFORM:
+            return uniform(jnp.sqrt(3.0 / fan_in))
+        if w is WeightInit.SIGMOID_UNIFORM:
+            return uniform(4.0 * jnp.sqrt(6.0 / (fan_in + fan_out)))
+        if w is WeightInit.VAR_SCALING_NORMAL_FAN_IN:
+            return normal(jnp.sqrt(1.0 / fan_in))
+        if w is WeightInit.VAR_SCALING_NORMAL_FAN_OUT:
+            return normal(jnp.sqrt(1.0 / fan_out))
+        if w is WeightInit.VAR_SCALING_NORMAL_FAN_AVG:
+            return normal(jnp.sqrt(2.0 / (fan_in + fan_out)))
+        if w is WeightInit.VAR_SCALING_UNIFORM_FAN_IN:
+            return uniform(jnp.sqrt(3.0 / fan_in))
+        if w is WeightInit.VAR_SCALING_UNIFORM_FAN_OUT:
+            return uniform(jnp.sqrt(3.0 / fan_out))
+        if w is WeightInit.VAR_SCALING_UNIFORM_FAN_AVG:
+            return uniform(jnp.sqrt(6.0 / (fan_in + fan_out)))
+        if w is WeightInit.IDENTITY:
+            if len(shape) != 2 or shape[0] != shape[1]:
+                raise ValueError("IDENTITY init requires a square 2d shape")
+            return jnp.eye(shape[0], dtype=dtype)
+        if w is WeightInit.DISTRIBUTION:
+            if distribution is None:
+                raise ValueError("WeightInit.DISTRIBUTION requires a Distribution")
+            return distribution.sample(key, shape, dtype)
+        raise ValueError(f"unhandled WeightInit: {w}")

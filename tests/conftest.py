@@ -1,0 +1,44 @@
+"""Test config: force CPU jax with 8 virtual devices so multi-chip sharding
+logic is exercised without TPU hardware (the driver separately dry-runs the
+multi-chip path; bench.py runs on the real chip).
+
+Mirrors the reference's backend-parametrized test strategy (SURVEY.md §4):
+the CPU platform is the correctness oracle; TPU runs the same suite with
+tolerance tiers.
+
+IMPORTANT environment quirk: this machine's ``sitecustomize.py`` registers
+the experimental ``axon`` TPU PJRT plugin in EVERY interpreter (and the env
+pins ``JAX_PLATFORMS=axon``), importing jax at interpreter boot — before this
+conftest runs. Setting env vars here is therefore too late; we must update
+the already-imported jax config and deregister the axon backend factory, or
+every test run contends for (and can hang on) the single real TPU tunnel.
+"""
+
+import os
+
+# For any subprocesses tests may spawn.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402  (already imported by sitecustomize anyway)
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:  # pragma: no cover - jax internals may move
+    pass
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(12345)

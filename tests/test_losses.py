@@ -1,0 +1,141 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.conf.activations import Activation
+from deeplearning4j_tpu.conf.losses import (
+    LossBinaryXENT,
+    LossCosineProximity,
+    LossFMeasure,
+    LossHinge,
+    LossKLD,
+    LossL1,
+    LossL2,
+    LossMAE,
+    LossMCXENT,
+    LossMSE,
+    LossMSLE,
+    LossPoisson,
+    LossSparseMCXENT,
+    LossSquaredHinge,
+)
+
+
+def test_mse_matches_numpy(rng):
+    labels = rng.normal(size=(4, 3)).astype(np.float32)
+    pre = rng.normal(size=(4, 3)).astype(np.float32)
+    got = float(LossMSE().score(jnp.asarray(labels), jnp.asarray(pre),
+                                Activation.IDENTITY))
+    want = np.mean(np.sum((pre - labels) ** 2, axis=1) / 3)
+    assert np.isclose(got, want, rtol=1e-5)
+
+
+def test_l2_is_mse_times_nout(rng):
+    labels = rng.normal(size=(4, 5)).astype(np.float32)
+    pre = rng.normal(size=(4, 5)).astype(np.float32)
+    mse = float(LossMSE().score(jnp.asarray(labels), jnp.asarray(pre), Activation.IDENTITY))
+    l2 = float(LossL2().score(jnp.asarray(labels), jnp.asarray(pre), Activation.IDENTITY))
+    assert np.isclose(l2, mse * 5, rtol=1e-5)
+
+
+def test_mcxent_softmax_matches_manual(rng):
+    logits = rng.normal(size=(6, 4)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, size=6)]
+    got = float(LossMCXENT().score(jnp.asarray(y), jnp.asarray(logits), Activation.SOFTMAX))
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.mean(-np.sum(y * np.log(p), axis=-1))
+    assert np.isclose(got, want, rtol=1e-4)
+
+
+def test_sparse_mcxent_equals_dense(rng):
+    logits = rng.normal(size=(6, 4)).astype(np.float32)
+    idx = rng.integers(0, 4, size=6)
+    y = np.eye(4, dtype=np.float32)[idx]
+    dense = float(LossMCXENT().score(jnp.asarray(y), jnp.asarray(logits), Activation.SOFTMAX))
+    sparse = float(
+        LossSparseMCXENT().score(jnp.asarray(idx), jnp.asarray(logits), Activation.SOFTMAX)
+    )
+    assert np.isclose(dense, sparse, rtol=1e-6)
+
+
+def test_binary_xent_stable_at_extreme_logits():
+    pre = jnp.asarray([[40.0], [-40.0]])
+    y = jnp.asarray([[1.0], [0.0]])
+    val = float(LossBinaryXENT().score(y, pre, Activation.SIGMOID))
+    assert np.isfinite(val) and val < 1e-10
+    # gradient also finite
+    g = jax.grad(lambda z: LossBinaryXENT().score(y, z, Activation.SIGMOID))(pre)
+    assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_masking_excludes_examples(rng):
+    labels = rng.normal(size=(4, 3)).astype(np.float32)
+    pre = rng.normal(size=(4, 3)).astype(np.float32)
+    mask = np.array([1.0, 1.0, 0.0, 0.0], np.float32)
+    got = float(
+        LossMSE().score(jnp.asarray(labels), jnp.asarray(pre), Activation.IDENTITY,
+                        mask=jnp.asarray(mask))
+    )
+    want = np.mean(np.sum((pre[:2] - labels[:2]) ** 2, axis=1) / 3)
+    assert np.isclose(got, want, rtol=1e-5)
+
+
+def test_time_series_masking(rng):
+    # [batch, time, features] with per-timestep mask
+    labels = rng.normal(size=(2, 5, 3)).astype(np.float32)
+    pre = rng.normal(size=(2, 5, 3)).astype(np.float32)
+    mask = np.zeros((2, 5), np.float32)
+    mask[0, :3] = 1.0
+    mask[1, :1] = 1.0
+    got = float(
+        LossMSE().score(jnp.asarray(labels), jnp.asarray(pre), Activation.IDENTITY,
+                        mask=jnp.asarray(mask))
+    )
+    per = np.sum((pre - labels) ** 2, axis=2) / 3
+    want = np.sum(per * mask) / mask.sum()
+    assert np.isclose(got, want, rtol=1e-5)
+
+
+def test_weighted_loss(rng):
+    labels = rng.normal(size=(4, 2)).astype(np.float32)
+    pre = rng.normal(size=(4, 2)).astype(np.float32)
+    w = (2.0, 0.5)
+    got = float(
+        LossMSE(weights=w).score(jnp.asarray(labels), jnp.asarray(pre), Activation.IDENTITY)
+    )
+    want = np.mean(np.sum((pre - labels) ** 2 * np.asarray(w), axis=1) / 2)
+    assert np.isclose(got, want, rtol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "loss,act",
+    [
+        (LossMAE(), Activation.IDENTITY),
+        (LossL1(), Activation.IDENTITY),
+        (LossMSLE(), Activation.RELU),
+        (LossHinge(), Activation.IDENTITY),
+        (LossSquaredHinge(), Activation.IDENTITY),
+        (LossCosineProximity(), Activation.IDENTITY),
+        (LossPoisson(), Activation.SOFTPLUS),
+        (LossKLD(), Activation.SOFTMAX),
+        (LossFMeasure(), Activation.SIGMOID),
+    ],
+)
+def test_all_losses_finite_and_differentiable(loss, act, rng):
+    pre = jnp.asarray(rng.normal(size=(3, 4)).astype(np.float32))
+    labels = jnp.asarray(np.abs(rng.normal(size=(3, 4))).astype(np.float32))
+    if act is Activation.SOFTMAX:
+        labels = labels / labels.sum(-1, keepdims=True)
+    if loss.__class__ in (LossHinge, LossSquaredHinge):
+        # symmetric ±1 labels so negative-label handling is exercised
+        labels = jnp.asarray(
+            np.where(rng.normal(size=(3, 4)) > 0, 1.0, -1.0).astype(np.float32)
+        )
+    if isinstance(loss, LossFMeasure):
+        labels = (labels > 0.5).astype(jnp.float32)
+    val = loss.score(labels, pre, act)
+    assert np.isfinite(float(val))
+    g = jax.grad(lambda z: loss.score(labels, z, act))(pre)
+    assert np.all(np.isfinite(np.asarray(g)))

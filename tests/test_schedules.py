@@ -1,0 +1,96 @@
+import numpy as np
+
+from deeplearning4j_tpu.conf.schedules import (
+    ExponentialSchedule,
+    FixedSchedule,
+    InverseSchedule,
+    MapSchedule,
+    PolySchedule,
+    ScheduleType,
+    SigmoidSchedule,
+    StepSchedule,
+    WarmupSchedule,
+)
+
+
+def v(s, it, ep=0):
+    return float(s.value_at(it, ep))
+
+
+def test_fixed():
+    assert v(FixedSchedule(0.01), 0) == np.float32(0.01)
+    assert v(FixedSchedule(0.01), 9999) == np.float32(0.01)
+
+
+def test_step():
+    s = StepSchedule(ScheduleType.ITERATION, 0.1, 0.5, 10)
+    assert np.isclose(v(s, 0), 0.1)
+    assert np.isclose(v(s, 9), 0.1)
+    assert np.isclose(v(s, 10), 0.05)
+    assert np.isclose(v(s, 25), 0.025)
+
+
+def test_step_epoch_type():
+    s = StepSchedule(ScheduleType.EPOCH, 0.1, 0.1, 1)
+    assert np.isclose(v(s, 12345, ep=0), 0.1)
+    assert np.isclose(v(s, 12345, ep=2), 0.001)
+
+
+def test_exponential():
+    s = ExponentialSchedule(ScheduleType.ITERATION, 0.2, 0.9)
+    assert np.isclose(v(s, 0), 0.2)
+    assert np.isclose(v(s, 3), 0.2 * 0.9 ** 3, rtol=1e-5)
+
+
+def test_inverse():
+    s = InverseSchedule(ScheduleType.ITERATION, 0.5, 0.1, 2.0)
+    assert np.isclose(v(s, 0), 0.5)
+    assert np.isclose(v(s, 10), 0.5 / (1 + 1.0) ** 2)
+
+
+def test_poly():
+    s = PolySchedule(ScheduleType.ITERATION, 0.3, 1.0, 100)
+    assert np.isclose(v(s, 0), 0.3)
+    assert np.isclose(v(s, 50), 0.15)
+    assert np.isclose(v(s, 100), 0.0)
+    assert np.isclose(v(s, 150), 0.0)  # clamped past max_iter
+
+
+def test_sigmoid_monotone_decreasing():
+    # Caffe convention: negative gamma = smooth step-down.
+    s = SigmoidSchedule(ScheduleType.ITERATION, 0.1, -0.05, 100)
+    vals = [v(s, t) for t in range(0, 300, 25)]
+    assert all(a >= b for a, b in zip(vals, vals[1:]))
+    assert np.isclose(v(s, 100), 0.05, rtol=1e-4)  # half value at step_size
+
+
+def test_map():
+    s = MapSchedule(ScheduleType.ITERATION, {"0": 0.1, "10": 0.01, "20": 0.001})
+    assert np.isclose(v(s, 5), 0.1)
+    assert np.isclose(v(s, 10), 0.01)
+    assert np.isclose(v(s, 19), 0.01)
+    assert np.isclose(v(s, 1000), 0.001)
+
+
+def test_warmup():
+    s = WarmupSchedule(warmup_steps=10, inner=FixedSchedule(0.1))
+    assert v(s, 0) < v(s, 5) < v(s, 9)
+    assert np.isclose(v(s, 10), 0.1)
+    assert np.isclose(v(s, 500), 0.1)
+
+
+def test_map_int_keys_roundtrip():
+    from deeplearning4j_tpu import serde
+
+    s = MapSchedule(ScheduleType.ITERATION, {0: 0.1, 100: 0.01})
+    assert serde.from_json(serde.to_json(s)) == s
+    assert np.isclose(v(s, 100), 0.01)
+
+
+def test_jit_compatible():
+    import jax
+
+    s = StepSchedule(ScheduleType.ITERATION, 0.1, 0.5, 10)
+    f = jax.jit(lambda t: s.value_at(t, 0))
+    assert np.isclose(float(f(0)), 0.1)
+    assert np.isclose(float(f(10)), 0.05)

@@ -1,0 +1,125 @@
+"""Config JSON round-trip tests (reference: heavily-tested Jackson round
+trips of MultiLayerConfiguration / updater / loss configs, SURVEY.md §5.6)."""
+
+from deeplearning4j_tpu import serde
+from deeplearning4j_tpu.conf.activations import Activation
+from deeplearning4j_tpu.conf.inputs import InputType
+from deeplearning4j_tpu.conf.losses import LossBinaryXENT, LossMCXENT, LossMSE
+from deeplearning4j_tpu.conf.regularization import (
+    L1Regularization,
+    L2Regularization,
+    WeightDecay,
+)
+from deeplearning4j_tpu.conf.schedules import (
+    CycleSchedule,
+    ExponentialSchedule,
+    FixedSchedule,
+    InverseSchedule,
+    MapSchedule,
+    PolySchedule,
+    ScheduleType,
+    SigmoidSchedule,
+    StepSchedule,
+    WarmupSchedule,
+)
+from deeplearning4j_tpu.conf.updaters import (
+    AMSGrad,
+    AdaDelta,
+    AdaGrad,
+    AdaMax,
+    Adam,
+    AdamW,
+    Nadam,
+    Nesterovs,
+    NoOp,
+    RmsProp,
+    Sgd,
+)
+from deeplearning4j_tpu.conf.weights import Distribution, WeightInit
+
+
+def roundtrip(obj):
+    restored = serde.from_json(serde.to_json(obj))
+    assert restored == obj, f"{obj} != {restored}"
+    return restored
+
+
+def test_updater_roundtrip():
+    for u in [
+        Sgd(learning_rate=0.05),
+        Adam(learning_rate=3e-4, beta1=0.85),
+        AdamW(weight_decay=0.02),
+        AMSGrad(),
+        AdaMax(),
+        Nadam(),
+        Nesterovs(momentum=0.95),
+        AdaGrad(),
+        AdaDelta(rho=0.9),
+        RmsProp(rms_decay=0.9),
+        NoOp(),
+        Adam(lr_schedule=StepSchedule(initial_value=0.01, step=500)),
+    ]:
+        roundtrip(u)
+
+
+def test_schedule_roundtrip():
+    for s in [
+        FixedSchedule(0.01),
+        StepSchedule(ScheduleType.EPOCH, 0.1, 0.5, 10),
+        ExponentialSchedule(gamma=0.97),
+        InverseSchedule(power=0.75),
+        PolySchedule(max_iter=5000),
+        SigmoidSchedule(step_size=300),
+        MapSchedule(values={"0": 0.1, "100": 0.01}),
+        CycleSchedule(cycle_length=2000),
+        WarmupSchedule(warmup_steps=50, inner=ExponentialSchedule()),
+    ]:
+        roundtrip(s)
+
+
+def test_loss_and_misc_roundtrip():
+    roundtrip(LossMSE(weights=(0.5, 1.0, 2.0)))
+    roundtrip(LossMCXENT())
+    roundtrip(LossBinaryXENT(clip_eps=1e-6))
+    roundtrip(L1Regularization(l1=1e-4))
+    roundtrip(L2Regularization(l2=5e-4))
+    roundtrip(WeightDecay(coeff=0.01, apply_lr=False))
+    roundtrip(Distribution(kind="uniform", lower=-0.1, upper=0.1))
+    roundtrip(InputType.convolutional(28, 28, 1))
+    roundtrip(InputType.recurrent(128, 50))
+
+
+def test_enum_roundtrip():
+    assert serde.from_json(serde.to_json(Activation.SOFTMAX)) is Activation.SOFTMAX
+    assert serde.from_json(serde.to_json(WeightInit.XAVIER)) is WeightInit.XAVIER
+
+
+def test_unknown_field_rejected():
+    import pytest
+
+    bad = '{"@type": "Sgd", "learning_rate": 0.1, "bogus": 1}'
+    with pytest.raises(ValueError):
+        serde.from_json(bad)
+
+
+def test_unregistered_subclass_rejected():
+    import dataclasses
+
+    import pytest
+
+    @dataclasses.dataclass
+    class SneakySgd(Sgd):  # NOT @serde.register-ed
+        extra: float = 1.0
+
+    with pytest.raises(TypeError):
+        serde.to_json(SneakySgd())
+
+
+def test_hardsigmoid_matches_reference_form():
+    import jax.numpy as jnp
+    import numpy as np
+
+    x = jnp.asarray([-3.0, -2.5, 0.0, 1.0, 2.5, 3.0])
+    got = np.asarray(Activation.HARDSIGMOID.apply(x))
+    want = np.clip(0.2 * np.asarray(x) + 0.5, 0.0, 1.0)
+    np.testing.assert_allclose(got, want, rtol=1e-6)

@@ -1,0 +1,129 @@
+"""Updater numerics vs hand-computed reference steps (reference oracle:
+``org.nd4j.linalg.learning`` updater tests compute expected arrays in-test)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.conf.updaters import (
+    AMSGrad,
+    AdaDelta,
+    AdaGrad,
+    AdaMax,
+    Adam,
+    Nadam,
+    Nesterovs,
+    NoOp,
+    RmsProp,
+    Sgd,
+)
+
+
+def run_steps(updater, grads, lr=0.1, steps=3):
+    p = jnp.zeros_like(jnp.asarray(grads[0]))
+    state = updater.init_state(p)
+    outs = []
+    for t in range(steps):
+        g = jnp.asarray(grads[t % len(grads)])
+        upd, state = updater.update_leaf(g, state, lr, float(t))
+        p = p - upd
+        outs.append(np.asarray(p))
+    return outs
+
+
+def test_sgd():
+    g = np.array([1.0, -2.0, 0.5], np.float32)
+    outs = run_steps(Sgd(), [g], lr=0.1, steps=2)
+    np.testing.assert_allclose(outs[0], -0.1 * g, rtol=1e-6)
+    np.testing.assert_allclose(outs[1], -0.2 * g, rtol=1e-6)
+
+
+def test_noop_passthrough():
+    g = np.array([1.0, 2.0], np.float32)
+    upd, _ = NoOp().update_leaf(jnp.asarray(g), {}, 0.5, 0.0)
+    np.testing.assert_allclose(np.asarray(upd), g)
+
+
+def test_adam_first_step_is_lr_sized():
+    # After one step from zero state, Adam's update ≈ lr * sign(g).
+    g = np.array([0.3, -0.7], np.float32)
+    adam = Adam(epsilon=1e-12)
+    upd, _ = adam.update_leaf(jnp.asarray(g), adam.init_state(jnp.zeros(2)), 0.01, 0.0)
+    np.testing.assert_allclose(np.asarray(upd), 0.01 * np.sign(g), rtol=1e-4)
+
+
+def test_adam_matches_manual_two_steps():
+    g1 = np.array([0.5], np.float64)
+    g2 = np.array([-0.25], np.float64)
+    b1, b2, eps, lr = 0.9, 0.999, 1e-8, 0.05
+    m = (1 - b1) * g1
+    v = (1 - b2) * g1 * g1
+    a1 = lr * np.sqrt(1 - b2) / (1 - b1)
+    exp1 = a1 * m / (np.sqrt(v) + eps)
+    m2 = b1 * m + (1 - b1) * g2
+    v2 = b2 * v + (1 - b2) * g2 * g2
+    a2 = lr * np.sqrt(1 - b2 ** 2) / (1 - b1 ** 2)
+    exp2 = a2 * m2 / (np.sqrt(v2) + eps)
+
+    adam = Adam()
+    st = adam.init_state(jnp.zeros(1))
+    u1, st = adam.update_leaf(jnp.asarray(g1, jnp.float32), st, lr, 0.0)
+    u2, st = adam.update_leaf(jnp.asarray(g2, jnp.float32), st, lr, 1.0)
+    np.testing.assert_allclose(np.asarray(u1), exp1, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(u2), exp2, rtol=1e-5)
+
+
+def test_adagrad_accumulates():
+    g = np.array([2.0], np.float32)
+    ada = AdaGrad(epsilon=1e-12)
+    st = ada.init_state(jnp.zeros(1))
+    u1, st = ada.update_leaf(jnp.asarray(g), st, 0.1, 0.0)
+    u2, st = ada.update_leaf(jnp.asarray(g), st, 0.1, 1.0)
+    np.testing.assert_allclose(np.asarray(u1), [0.1], rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(u2), [0.1 / np.sqrt(2.0)], rtol=1e-5)
+
+
+def test_nesterovs_momentum_accelerates():
+    g = np.array([1.0], np.float32)
+    nes = Nesterovs(momentum=0.9)
+    outs = run_steps(nes, [g], lr=0.1, steps=3)
+    # displacement must exceed plain SGD's due to momentum
+    sgd_outs = run_steps(Sgd(), [g], lr=0.1, steps=3)
+    assert outs[2][0] < sgd_outs[2][0] < 0
+
+
+def test_nesterovs_momentum_schedule_is_used():
+    from deeplearning4j_tpu.conf.schedules import MapSchedule, ScheduleType
+
+    g = jnp.asarray(np.array([1.0], np.float32))
+    # schedule drops momentum to 0 => update must equal plain SGD's lr*g
+    nes = Nesterovs(momentum=0.9,
+                    momentum_schedule=MapSchedule(ScheduleType.ITERATION, {0: 0.0}))
+    upd, _ = nes.update_leaf(g, nes.init_state(jnp.zeros(1)), 0.1, 0.0)
+    np.testing.assert_allclose(np.asarray(upd), [0.1], rtol=1e-6)
+
+
+def test_rmsprop_scale_invariance():
+    big = np.array([100.0], np.float32)
+    small = np.array([0.01], np.float32)
+    rms = RmsProp(epsilon=1e-12)
+    ub, _ = rms.update_leaf(jnp.asarray(big), rms.init_state(jnp.zeros(1)), 0.01, 0.0)
+    us, _ = rms.update_leaf(jnp.asarray(small), rms.init_state(jnp.zeros(1)), 0.01, 0.0)
+    np.testing.assert_allclose(np.asarray(ub), np.asarray(us), rtol=1e-4)
+
+
+@pytest.mark.parametrize(
+    "updater", [Adam(), AMSGrad(), AdaMax(), Nadam(), AdaDelta(), RmsProp(), AdaGrad()]
+)
+def test_updates_finite_and_descend(updater):
+    # quadratic bowl: f(p) = 0.5*||p - target||^2
+    target = jnp.asarray(np.array([1.0, -2.0, 3.0], np.float32))
+    p = jnp.zeros(3)
+    state = updater.init_state(p)
+    loss0 = float(jnp.sum((p - target) ** 2))
+    for t in range(200):
+        g = p - target
+        upd, state = updater.update_leaf(g, state, 0.05, float(t))
+        p = p - upd
+        assert np.all(np.isfinite(np.asarray(p)))
+    assert float(jnp.sum((p - target) ** 2)) < loss0 * 0.5
