@@ -1,0 +1,77 @@
+"""Benchmark harness — runs on the real TPU chip (default env platform).
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Current flagship benchmark: LeNet/MNIST training throughput (BASELINE
+config #1). The reference ships no published numbers (BASELINE.md), so the
+first measured value defines the baseline; vs_baseline is measured/baseline
+once BENCH_BASELINE.json exists (written on first run), else 1.0.
+
+Protocol (BASELINE.md): median of >=3 timed runs, first (compile) step
+excluded, fixed batch size, per-chip numbers.
+"""
+
+import json
+import statistics
+import time
+from pathlib import Path
+
+import numpy as np
+
+BATCH = 256
+STEPS_PER_RUN = 30
+RUNS = 4
+BASELINE_FILE = Path(__file__).parent / "BENCH_BASELINE.json"
+
+
+def main():
+    import jax
+
+    from deeplearning4j_tpu.conf.updaters import Adam
+    from deeplearning4j_tpu.datasets.mnist import synthesize
+    from deeplearning4j_tpu.zoo.models import LeNet
+
+    devices = jax.devices()
+    net = LeNet(updater=Adam(learning_rate=1e-3)).init()
+
+    features, labels = synthesize(BATCH, seed=42)
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    ds = DataSet(features, labels)
+
+    # warmup: first step compiles
+    net.fit_batch(ds)
+    _ = net.score_value  # sync
+
+    run_rates = []
+    for _ in range(RUNS):
+        t0 = time.perf_counter()
+        for _ in range(STEPS_PER_RUN):
+            net.fit_batch(ds)
+        # fit_batch converts loss to float -> device sync included
+        dt = time.perf_counter() - t0
+        run_rates.append(STEPS_PER_RUN * BATCH / dt)
+
+    images_per_sec = statistics.median(run_rates)
+
+    if BASELINE_FILE.exists():
+        base = json.loads(BASELINE_FILE.read_text()).get("images_per_sec")
+    else:
+        base = images_per_sec
+        BASELINE_FILE.write_text(json.dumps({
+            "images_per_sec": images_per_sec,
+            "config": "LeNet/MNIST train, batch=256",
+            "device": str(devices[0]),
+        }))
+    vs = images_per_sec / base if base else 1.0
+
+    print(json.dumps({
+        "metric": "lenet_mnist_train_images_per_sec_per_chip",
+        "value": round(images_per_sec, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,518 @@
+"""Convolutional / pooling / normalization layer configs.
+
+Reference confs: ``ConvolutionLayer``, ``SubsamplingLayer``,
+``BatchNormalization``, ``GlobalPoolingLayer``, ``Upsampling2D``,
+``ZeroPaddingLayer``, ``Cropping2D``, ``SeparableConvolution2D``,
+``Deconvolution2D``, ``LocalResponseNormalization``, ``SpaceToDepthLayer``
+(``org.deeplearning4j.nn.conf.layers``), runtime in
+``org.deeplearning4j.nn.layers.convolution`` / ``.normalization``.
+
+All convs run in NHWC / HWIO (TPU-native tiling for the MXU); the reference's
+cuDNN platform-helper role is filled by XLA's fused conv emitters.
+``ConvolutionMode`` semantics (Strict / Truncate / Same) follow the reference
+exactly (``org.deeplearning4j.nn.conf.ConvolutionMode``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu import serde
+from deeplearning4j_tpu.conf import inputs as it
+from deeplearning4j_tpu.conf.activations import Activation
+from deeplearning4j_tpu.conf.layers import BaseLayer, Layer, _as_ff_size
+
+_DIMNUMS = ("NHWC", "HWIO", "NHWC")
+
+
+@serde.register_enum
+class ConvolutionMode(enum.Enum):
+    STRICT = "strict"
+    TRUNCATE = "truncate"
+    SAME = "same"
+
+
+@serde.register_enum
+class PoolingType(enum.Enum):
+    MAX = "max"
+    AVG = "avg"
+    SUM = "sum"
+    PNORM = "pnorm"
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return int(v[0]), int(v[1])
+    return int(v), int(v)
+
+
+def _out_size(size, k, s, p, mode: ConvolutionMode, dilation=1):
+    eff_k = k + (k - 1) * (dilation - 1)
+    if mode is ConvolutionMode.SAME:
+        return -(-size // s)  # ceil
+    out = (size + 2 * p - eff_k) // s + 1
+    if mode is ConvolutionMode.STRICT and (size + 2 * p - eff_k) % s != 0:
+        raise ValueError(
+            f"ConvolutionMode.STRICT: (size={size} + 2*pad={p} - kernel={eff_k})"
+            f" not divisible by stride={s} (reference throws DL4JException here;"
+            f" use TRUNCATE or SAME)"
+        )
+    return out
+
+
+def _conv_padding(mode: ConvolutionMode, padding):
+    if mode is ConvolutionMode.SAME:
+        return "SAME"
+    ph, pw = _pair(padding)
+    return [(ph, ph), (pw, pw)]
+
+
+@serde.register
+@dataclasses.dataclass
+class ConvolutionLayer(BaseLayer):
+    """2D convolution (reference ``ConvolutionLayer``). Weights HWIO:
+    [kh, kw, in_c, out_c]; fan_in = kh*kw*in_c (reference WeightInitUtil
+    convention for conv)."""
+
+    n_out: int = 0
+    kernel_size: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    dilation: Tuple[int, int] = (1, 1)
+    convolution_mode: ConvolutionMode = ConvolutionMode.TRUNCATE
+    has_bias: bool = True
+
+    def output_type(self, input_type):
+        assert isinstance(input_type, it.Convolutional), (
+            f"{type(self).__name__} needs CNN input, got {input_type}"
+        )
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        dh, dw = _pair(self.dilation)
+        return it.Convolutional(
+            height=_out_size(input_type.height, kh, sh, ph, self.convolution_mode, dh),
+            width=_out_size(input_type.width, kw, sw, pw, self.convolution_mode, dw),
+            channels=self.n_out,
+        )
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        kh, kw = _pair(self.kernel_size)
+        in_c = input_type.channels
+        fan_in = kh * kw * in_c
+        fan_out = kh * kw * self.n_out
+        w = self.weight_init.init(key, (kh, kw, in_c, self.n_out), fan_in,
+                                  fan_out, dtype, self.distribution)
+        params = {"W": w}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return params
+
+    def param_order(self):
+        return ["W", "b"] if self.has_bias else ["W"]
+
+    def forward(self, params, state, x, train=False, rng=None):
+        x = self._dropout_input(x, train, rng)
+        y = lax.conv_general_dilated(
+            x, params["W"],
+            window_strides=_pair(self.stride),
+            padding=_conv_padding(self.convolution_mode, self.padding),
+            rhs_dilation=_pair(self.dilation),
+            dimension_numbers=_DIMNUMS,
+        )
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation.apply(y), state
+
+
+@serde.register
+@dataclasses.dataclass
+class Convolution1DLayer(ConvolutionLayer):
+    """Reference ``Convolution1DLayer``: conv over [batch, time, features]
+    (reference uses [b, f, t]; we keep time-major-last-features NWC)."""
+
+    kernel: int = 3
+    stride1d: int = 1
+    padding1d: int = 0
+
+    def output_type(self, input_type):
+        assert isinstance(input_type, it.Recurrent)
+        t = input_type.timesteps
+        if t > 0:
+            t = _out_size(t, self.kernel, self.stride1d, self.padding1d,
+                          self.convolution_mode)
+        return it.Recurrent(size=self.n_out, timesteps=t)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        in_c = input_type.size
+        fan_in = self.kernel * in_c
+        fan_out = self.kernel * self.n_out
+        w = self.weight_init.init(key, (self.kernel, in_c, self.n_out), fan_in,
+                                  fan_out, dtype, self.distribution)
+        params = {"W": w}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return params
+
+    def forward(self, params, state, x, train=False, rng=None):
+        x = self._dropout_input(x, train, rng)
+        if self.convolution_mode is ConvolutionMode.SAME:
+            pad = "SAME"
+        else:
+            pad = [(self.padding1d, self.padding1d)]
+        y = lax.conv_general_dilated(
+            x, params["W"], window_strides=(self.stride1d,), padding=pad,
+            dimension_numbers=("NWC", "WIO", "NWC"),
+        )
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation.apply(y), state
+
+
+@serde.register
+@dataclasses.dataclass
+class SeparableConvolution2D(ConvolutionLayer):
+    """Reference ``SeparableConvolution2D``: depthwise (depth_multiplier) +
+    pointwise 1x1. Params: dW [kh, kw, in_c, depth_mult] stored HWIO-grouped,
+    pW [1, 1, in_c*mult, n_out], b."""
+
+    depth_multiplier: int = 1
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        kh, kw = _pair(self.kernel_size)
+        in_c = input_type.channels
+        k1, k2 = jax.random.split(key)
+        dw = self.weight_init.init(
+            k1, (kh, kw, 1, in_c * self.depth_multiplier), kh * kw * in_c,
+            kh * kw * in_c * self.depth_multiplier, dtype, self.distribution)
+        pw = self.weight_init.init(
+            k2, (1, 1, in_c * self.depth_multiplier, self.n_out),
+            in_c * self.depth_multiplier, self.n_out, dtype, self.distribution)
+        params = {"dW": dw, "pW": pw}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return params
+
+    def param_order(self):
+        return ["dW", "pW", "b"] if self.has_bias else ["dW", "pW"]
+
+    def forward(self, params, state, x, train=False, rng=None):
+        x = self._dropout_input(x, train, rng)
+        in_c = x.shape[-1]
+        y = lax.conv_general_dilated(
+            x, params["dW"],
+            window_strides=_pair(self.stride),
+            padding=_conv_padding(self.convolution_mode, self.padding),
+            rhs_dilation=_pair(self.dilation),
+            dimension_numbers=_DIMNUMS,
+            feature_group_count=in_c,
+        )
+        y = lax.conv_general_dilated(
+            y, params["pW"], window_strides=(1, 1), padding="VALID",
+            dimension_numbers=_DIMNUMS,
+        )
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation.apply(y), state
+
+
+@serde.register
+@dataclasses.dataclass
+class Deconvolution2D(ConvolutionLayer):
+    """Reference ``Deconvolution2D`` (transposed conv). Implemented as a
+    direct conv over the stride-dilated input with a spatially-flipped
+    kernel so TRUNCATE output is exactly ``s*(i-1) + k - 2p`` (the
+    reference's formula); ``lax.conv_transpose``'s integer-padding
+    convention differs, so it is not used here."""
+
+    def output_type(self, input_type):
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        if self.convolution_mode is ConvolutionMode.SAME:
+            h = input_type.height * sh
+            w = input_type.width * sw
+        else:
+            h = sh * (input_type.height - 1) + kh - 2 * ph
+            w = sw * (input_type.width - 1) + kw - 2 * pw
+        return it.Convolutional(height=h, width=w, channels=self.n_out)
+
+    def forward(self, params, state, x, train=False, rng=None):
+        x = self._dropout_input(x, train, rng)
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        if self.convolution_mode is ConvolutionMode.SAME:
+            # target out = i*s: dilated size d = (i-1)*s+1, need
+            # pad_total = i*s - d + (k-1) = s + k - 2 per spatial dim
+            pt_h, pt_w = sh + kh - 2, sw + kw - 2
+            pad = [(pt_h // 2, pt_h - pt_h // 2),
+                   (pt_w // 2, pt_w - pt_w // 2)]
+        else:
+            ph, pw = _pair(self.padding)
+            pad = [(kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)]
+        y = lax.conv_general_dilated(
+            x, jnp.flip(params["W"], (0, 1)),
+            window_strides=(1, 1),
+            padding=pad,
+            lhs_dilation=(sh, sw),
+            dimension_numbers=_DIMNUMS,
+        )
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation.apply(y), state
+
+
+@serde.register
+@dataclasses.dataclass
+class SubsamplingLayer(Layer):
+    """Pooling (reference ``SubsamplingLayer``; runtime
+    ``org.deeplearning4j.nn.layers.convolution.subsampling``)."""
+
+    pooling_type: PoolingType = PoolingType.MAX
+    kernel_size: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (2, 2)
+    padding: Tuple[int, int] = (0, 0)
+    convolution_mode: ConvolutionMode = ConvolutionMode.TRUNCATE
+    pnorm: int = 2
+
+    def output_type(self, input_type):
+        assert isinstance(input_type, it.Convolutional)
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        return it.Convolutional(
+            height=_out_size(input_type.height, kh, sh, ph, self.convolution_mode),
+            width=_out_size(input_type.width, kw, sw, pw, self.convolution_mode),
+            channels=input_type.channels,
+        )
+
+    def forward(self, params, state, x, train=False, rng=None):
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        window = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        if self.convolution_mode is ConvolutionMode.SAME:
+            pad = "SAME"
+        else:
+            ph, pw = _pair(self.padding)
+            pad = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+        if self.pooling_type is PoolingType.MAX:
+            y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pad)
+        elif self.pooling_type is PoolingType.SUM:
+            y = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+        elif self.pooling_type is PoolingType.AVG:
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+            ones = jnp.ones_like(x)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pad)
+            y = s / cnt
+        elif self.pooling_type is PoolingType.PNORM:
+            p = float(self.pnorm)
+            s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window,
+                                  strides, pad)
+            y = s ** (1.0 / p)
+        else:
+            raise ValueError(f"unknown pooling type {self.pooling_type}")
+        return y, state
+
+
+@serde.register
+@dataclasses.dataclass
+class BatchNormalization(BaseLayer):
+    """Reference ``BatchNormalization`` conf + runtime
+    (``org.deeplearning4j.nn.layers.normalization.BatchNormalization``).
+    Params gamma/beta; running mean/var live in mutable state (the reference
+    stores them as non-trained 'params'; the flat-vector spec appends them
+    after gamma/beta for serializer parity). ``decay`` matches the reference
+    (running = decay*running + (1-decay)*batch)."""
+
+    decay: float = 0.9
+    eps: float = 1e-5
+    lock_gamma_beta: bool = False
+    use_batch_mean_in_eval: bool = False  # reference's isMinibatch inverse
+
+    def output_type(self, input_type):
+        return input_type
+
+    def _n_features(self, input_type):
+        if isinstance(input_type, it.Convolutional):
+            return input_type.channels
+        return _as_ff_size(input_type)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        n = self._n_features(input_type)
+        if self.lock_gamma_beta:
+            return {}
+        return {"gamma": jnp.ones((n,), dtype), "beta": jnp.zeros((n,), dtype)}
+
+    def init_state(self, input_type, dtype=jnp.float32):
+        n = self._n_features(input_type)
+        return {"mean": jnp.zeros((n,), dtype), "var": jnp.ones((n,), dtype)}
+
+    def param_order(self):
+        return [] if self.lock_gamma_beta else ["gamma", "beta"]
+
+    def regularized_param_keys(self):
+        return []
+
+    def forward(self, params, state, x, train=False, rng=None):
+        axes = tuple(range(x.ndim - 1))  # all but channel/feature axis
+        if train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1 - self.decay) * var,
+            }
+        elif self.use_batch_mean_in_eval:
+            # reference isMinibatch=false: batch statistics at inference
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_state = state
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        xhat = (x - mean) * lax.rsqrt(var + self.eps)
+        if not self.lock_gamma_beta:
+            xhat = xhat * params["gamma"] + params["beta"]
+        return self.activation.apply(xhat), new_state
+
+
+@serde.register
+@dataclasses.dataclass
+class LocalResponseNormalization(Layer):
+    """Reference ``LocalResponseNormalization`` (AlexNet-era LRN):
+    y = x / (k + alpha*sum_window(x^2))^beta over adjacent channels."""
+
+    k: float = 2.0
+    n: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def forward(self, params, state, x, train=False, rng=None):
+        half = self.n // 2
+        sq = x * x
+        # sum over a window of `n` adjacent channels (last axis)
+        window = (1, 1, 1, self.n)
+        pad = ((0, 0), (0, 0), (0, 0), (half, self.n - 1 - half))
+        s = lax.reduce_window(sq, 0.0, lax.add, window, (1, 1, 1, 1), pad)
+        return x / (self.k + self.alpha * s) ** self.beta, state
+
+
+@serde.register
+@dataclasses.dataclass
+class GlobalPoolingLayer(Layer):
+    """Reference ``GlobalPoolingLayer``: CNN [b,h,w,c] -> [b,c] or RNN
+    [b,t,f] -> [b,f], with mask support for RNN (masked positions excluded,
+    matching the reference's masked pooling)."""
+
+    pooling_type: PoolingType = PoolingType.MAX
+
+    def output_type(self, input_type):
+        if isinstance(input_type, it.Convolutional):
+            return it.FeedForward(size=input_type.channels)
+        if isinstance(input_type, it.Recurrent):
+            return it.FeedForward(size=input_type.size)
+        return input_type
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None):
+        axes = tuple(range(1, x.ndim - 1))
+        if mask is not None and x.ndim == 3:
+            m = mask[..., None].astype(x.dtype)
+            if self.pooling_type is PoolingType.MAX:
+                y = jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=1)
+            elif self.pooling_type is PoolingType.SUM:
+                y = jnp.sum(x * m, axis=1)
+            elif self.pooling_type is PoolingType.AVG:
+                y = jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+            else:
+                p = 2.0
+                y = jnp.sum(jnp.abs(x * m) ** p, axis=1) ** (1 / p)
+            return y, state
+        if self.pooling_type is PoolingType.MAX:
+            return jnp.max(x, axis=axes), state
+        if self.pooling_type is PoolingType.SUM:
+            return jnp.sum(x, axis=axes), state
+        if self.pooling_type is PoolingType.AVG:
+            return jnp.mean(x, axis=axes), state
+        p = 2.0
+        return jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1 / p), state
+
+
+@serde.register
+@dataclasses.dataclass
+class Upsampling2D(Layer):
+    """Reference ``Upsampling2D``: nearest-neighbour repeat."""
+
+    size: Tuple[int, int] = (2, 2)
+
+    def output_type(self, input_type):
+        sh, sw = _pair(self.size)
+        return it.Convolutional(input_type.height * sh, input_type.width * sw,
+                                input_type.channels)
+
+    def forward(self, params, state, x, train=False, rng=None):
+        sh, sw = _pair(self.size)
+        y = jnp.repeat(jnp.repeat(x, sh, axis=1), sw, axis=2)
+        return y, state
+
+
+@serde.register
+@dataclasses.dataclass
+class ZeroPaddingLayer(Layer):
+    """Reference ``ZeroPaddingLayer``: pad [(top,bottom),(left,right)]."""
+
+    padding: Tuple[int, int, int, int] = (1, 1, 1, 1)  # t, b, l, r
+
+    def output_type(self, input_type):
+        t, b, l, r = self.padding
+        return it.Convolutional(input_type.height + t + b,
+                                input_type.width + l + r, input_type.channels)
+
+    def forward(self, params, state, x, train=False, rng=None):
+        t, b, l, r = self.padding
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state
+
+
+@serde.register
+@dataclasses.dataclass
+class Cropping2D(Layer):
+    """Reference ``Cropping2D``."""
+
+    cropping: Tuple[int, int, int, int] = (0, 0, 0, 0)  # t, b, l, r
+
+    def output_type(self, input_type):
+        t, b, l, r = self.cropping
+        return it.Convolutional(input_type.height - t - b,
+                                input_type.width - l - r, input_type.channels)
+
+    def forward(self, params, state, x, train=False, rng=None):
+        t, b, l, r = self.cropping
+        h, w = x.shape[1], x.shape[2]
+        return x[:, t:h - b, l:w - r, :], state
+
+
+@serde.register
+@dataclasses.dataclass
+class SpaceToDepthLayer(Layer):
+    """Reference ``SpaceToDepthLayer`` (used by YOLO2's reorg): block
+    rearrange [b, h, w, c] -> [b, h/bs, w/bs, c*bs*bs]."""
+
+    block_size: int = 2
+
+    def output_type(self, input_type):
+        bs = self.block_size
+        return it.Convolutional(input_type.height // bs, input_type.width // bs,
+                                input_type.channels * bs * bs)
+
+    def forward(self, params, state, x, train=False, rng=None):
+        b, h, w, c = x.shape
+        bs = self.block_size
+        y = x.reshape(b, h // bs, bs, w // bs, bs, c)
+        y = y.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // bs, w // bs, bs * bs * c)
+        return y, state

@@ -1,0 +1,240 @@
+"""MultiLayerConfiguration + the NeuralNetConfiguration builder DSL.
+
+Reference: ``org.deeplearning4j.nn.conf.NeuralNetConfiguration.Builder``
+(global hyperparam defaults) -> ``.list()`` (``ListBuilder``) ->
+``MultiLayerConfiguration`` (JSON-serializable config tree;
+``#toJson``/``#fromJson`` round-trip). ``setInputType`` drives nIn inference
+and auto-inserts preprocessors, exactly as the reference's
+``MultiLayerConfiguration.Builder#inputType`` does.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import List, Optional, Tuple
+
+from deeplearning4j_tpu import serde
+from deeplearning4j_tpu.conf import inputs as it
+from deeplearning4j_tpu.conf.layers import (
+    BaseLayer,
+    CnnToFeedForwardPreProcessor,
+    DenseLayer,
+    Layer,
+)
+from deeplearning4j_tpu.conf.regularization import (
+    L1Regularization,
+    L2Regularization,
+    Regularization,
+)
+from deeplearning4j_tpu.conf.updaters import IUpdater, Sgd
+from deeplearning4j_tpu.conf.weights import WeightInit
+
+
+@serde.register_enum
+class BackpropType(enum.Enum):
+    """Reference: ``org.deeplearning4j.nn.conf.BackpropType``."""
+
+    STANDARD = "standard"
+    TRUNCATED_BPTT = "tbptt"
+
+
+@serde.register
+@dataclasses.dataclass
+class MultiLayerConfiguration:
+    """The serializable model definition (reference
+    ``MultiLayerConfiguration``)."""
+
+    layers: Tuple[Layer, ...] = ()
+    input_type: Optional[object] = None
+    seed: int = 12345
+    updater: IUpdater = dataclasses.field(default_factory=Sgd)
+    backprop_type: BackpropType = BackpropType.STANDARD
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
+    dtype: str = "float32"
+
+    def to_json(self) -> str:
+        return serde.to_json(self)
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        obj = serde.from_json(s)
+        if not isinstance(obj, MultiLayerConfiguration):
+            raise TypeError(f"JSON is a {type(obj).__name__}, "
+                            "not MultiLayerConfiguration")
+        return obj
+
+    def input_types(self) -> List[object]:
+        """Per-layer input InputType list (shape inference pass)."""
+        if self.input_type is None:
+            raise ValueError(
+                "MultiLayerConfiguration requires input_type for shape "
+                "inference (reference: setInputType / explicit nIn)"
+            )
+        types = []
+        cur = self.input_type
+        for layer in self.layers:
+            types.append(cur)
+            cur = layer.output_type(cur)
+        return types
+
+    def output_types(self) -> List[object]:
+        types = self.input_types()
+        return types[1:] + [self.layers[-1].output_type(types[-1])]
+
+
+class NeuralNetConfiguration:
+    """Namespace for the builder (reference ``NeuralNetConfiguration``)."""
+
+    @staticmethod
+    def builder() -> "Builder":
+        return Builder()
+
+
+class Builder:
+    """Global-defaults builder (reference ``NeuralNetConfiguration.Builder``).
+    Fluent setters mirror the reference's names (snake_cased)."""
+
+    def __init__(self):
+        self._seed = 12345
+        self._updater: IUpdater = Sgd()
+        self._weight_init: Optional[WeightInit] = None
+        self._activation = None
+        self._regularization: List[Regularization] = []
+        self._dropout: Optional[float] = None
+        self._dtype = "float32"
+
+    def seed(self, s: int) -> "Builder":
+        self._seed = int(s)
+        return self
+
+    def updater(self, u: IUpdater) -> "Builder":
+        self._updater = u
+        return self
+
+    def weight_init(self, w: WeightInit) -> "Builder":
+        self._weight_init = w
+        return self
+
+    def activation(self, a) -> "Builder":
+        self._activation = a
+        return self
+
+    def l2(self, v: float) -> "Builder":
+        self._regularization.append(L2Regularization(l2=v))
+        return self
+
+    def l1(self, v: float) -> "Builder":
+        self._regularization.append(L1Regularization(l1=v))
+        return self
+
+    def dropout(self, retain_prob: float) -> "Builder":
+        self._dropout = retain_prob
+        return self
+
+    def dtype(self, dt: str) -> "Builder":
+        self._dtype = dt
+        return self
+
+    def list(self) -> "ListBuilder":
+        return ListBuilder(self)
+
+
+class ListBuilder:
+    """Reference ``NeuralNetConfiguration.ListBuilder``."""
+
+    def __init__(self, base: Builder):
+        self._base = base
+        self._layers: List[Layer] = []
+        self._input_type = None
+        self._backprop_type = BackpropType.STANDARD
+        self._tbptt_fwd = 20
+        self._tbptt_back = 20
+
+    def layer(self, conf: Layer) -> "ListBuilder":
+        self._layers.append(conf)
+        return self
+
+    def set_input_type(self, input_type) -> "ListBuilder":
+        self._input_type = input_type
+        return self
+
+    def backprop_type(self, bp: BackpropType, fwd: int = 20,
+                      back: int = 20) -> "ListBuilder":
+        self._backprop_type = bp
+        self._tbptt_fwd = fwd
+        self._tbptt_back = back
+        return self
+
+    def build(self) -> MultiLayerConfiguration:
+        layers = [self._apply_defaults(l) for l in self._layers]
+        layers = _insert_preprocessors(layers, self._input_type)
+        for i, l in enumerate(layers):
+            if l.name is None:
+                l.name = f"layer{i}"
+        return MultiLayerConfiguration(
+            layers=tuple(layers),
+            input_type=self._input_type,
+            seed=self._base._seed,
+            updater=self._base._updater,
+            backprop_type=self._backprop_type,
+            tbptt_fwd_length=self._tbptt_fwd,
+            tbptt_back_length=self._tbptt_back,
+            dtype=self._base._dtype,
+        )
+
+    def _apply_defaults(self, layer: Layer) -> Layer:
+        """Fill builder-level defaults into layer fields still at their
+        dataclass defaults (reference: global conf inherited unless the layer
+        overrides). Always returns a copy so build() never mutates the
+        caller's layer objects (name assignment happens on the copies)."""
+        if not isinstance(layer, BaseLayer):
+            return dataclasses.replace(layer)
+        layer = dataclasses.replace(layer)
+        cls_defaults = {f.name: f.default for f in dataclasses.fields(layer)
+                        if f.default is not dataclasses.MISSING}
+        b = self._base
+        if b._weight_init is not None and layer.weight_init == cls_defaults.get(
+                "weight_init"):
+            layer.weight_init = b._weight_init
+        if b._activation is not None and layer.activation == cls_defaults.get(
+                "activation"):
+            layer.activation = b._activation
+        if b._regularization and not layer.regularization:
+            layer.regularization = tuple(b._regularization)
+        if b._dropout is not None and layer.dropout == 0.0:
+            layer.dropout = b._dropout
+        return layer
+
+
+def _insert_preprocessors(layers: List[Layer], input_type) -> List[Layer]:
+    """Auto-insert CNN->FF flatten preprocessors where layer input kinds
+    mismatch (reference: ``InputType#getPreProcessorForInputType`` logic in
+    setInputType)."""
+    if input_type is None:
+        return layers
+    out: List[Layer] = []
+    cur = input_type
+    for layer in layers:
+        if isinstance(cur, it.Convolutional) and isinstance(layer, DenseLayer):
+            pre = CnnToFeedForwardPreProcessor(
+                height=cur.height, width=cur.width, channels=cur.channels)
+            out.append(pre)
+            cur = pre.output_type(cur)
+        if isinstance(cur, it.ConvolutionalFlat):
+            # reference treats flat CNN input as FF into dense, CNN into conv
+            from deeplearning4j_tpu.conf.layers import FeedForwardToCnnPreProcessor
+            from deeplearning4j_tpu.conf.layers_cnn import ConvolutionLayer as _Conv
+            from deeplearning4j_tpu.conf.layers_cnn import SubsamplingLayer as _Pool
+
+            if isinstance(layer, (_Conv, _Pool)):
+                pre = FeedForwardToCnnPreProcessor(
+                    height=cur.height, width=cur.width, channels=cur.channels)
+                out.append(pre)
+                cur = pre.output_type(cur)
+            else:
+                cur = it.FeedForward(size=cur.arity())
+        out.append(layer)
+        cur = layer.output_type(cur)
+    return out

@@ -32,7 +32,7 @@ class IUpdater:
         """Return this updater's state pytree for one parameter tensor."""
         return {}
 
-    def update_leaf(self, g, state, lr, t):
+    def update_leaf(self, g, state, lr, t, epoch=0.0, param=None):
         """(gradient, state, lr scalar, iteration) -> (update, new_state)."""
         raise NotImplementedError
 
@@ -53,7 +53,7 @@ class Sgd(IUpdater):
     learning_rate: float = 0.1
     lr_schedule: Optional[ISchedule] = None
 
-    def update_leaf(self, g, state, lr, t):
+    def update_leaf(self, g, state, lr, t, epoch=0.0, param=None):
         return lr * g, state
 
 
@@ -62,7 +62,7 @@ class Sgd(IUpdater):
 class NoOp(IUpdater):
     """Gradient passed through untouched (used by tests / frozen layers)."""
 
-    def update_leaf(self, g, state, lr, t):
+    def update_leaf(self, g, state, lr, t, epoch=0.0, param=None):
         return g, state
 
     def current_lr(self, iteration, epoch):
@@ -84,7 +84,7 @@ class Adam(IUpdater):
     def state_size(self, n):
         return 2 * n
 
-    def update_leaf(self, g, state, lr, t):
+    def update_leaf(self, g, state, lr, t, epoch=0.0, param=None):
         m = self.beta1 * state["m"] + (1.0 - self.beta1) * g
         v = self.beta2 * state["v"] + (1.0 - self.beta2) * g * g
         tt = t + 1.0
@@ -95,11 +95,18 @@ class Adam(IUpdater):
 @serde.register
 @dataclasses.dataclass
 class AdamW(Adam):
-    """Adam with decoupled weight decay (update includes wd*param term at
-    apply time via the solver's regularization hook, matching reference
-    ``org.nd4j.linalg.learning.config.AdamW`` / ``WeightDecay``)."""
+    """Adam with decoupled weight decay (reference
+    ``org.nd4j.linalg.learning.config.AdamW``): the Adam update plus
+    ``weight_decay * lr * param`` added to the update tensor (decoupled —
+    not fed through the moment estimates)."""
 
     weight_decay: float = 0.01
+
+    def update_leaf(self, g, state, lr, t, epoch=0.0, param=None):
+        upd, new_state = super().update_leaf(g, state, lr, t, epoch, param)
+        if param is not None and self.weight_decay:
+            upd = upd + self.weight_decay * lr * param
+        return upd, new_state
 
 
 @serde.register
@@ -118,7 +125,7 @@ class AMSGrad(IUpdater):
     def state_size(self, n):
         return 3 * n
 
-    def update_leaf(self, g, state, lr, t):
+    def update_leaf(self, g, state, lr, t, epoch=0.0, param=None):
         m = self.beta1 * state["m"] + (1.0 - self.beta1) * g
         v = self.beta2 * state["v"] + (1.0 - self.beta2) * g * g
         vhat = jnp.maximum(state["vhat"], v)
@@ -145,7 +152,7 @@ class AdaMax(IUpdater):
     def state_size(self, n):
         return 2 * n
 
-    def update_leaf(self, g, state, lr, t):
+    def update_leaf(self, g, state, lr, t, epoch=0.0, param=None):
         m = self.beta1 * state["m"] + (1.0 - self.beta1) * g
         u = jnp.maximum(self.beta2 * state["u"], jnp.abs(g))
         tt = t + 1.0
@@ -168,7 +175,7 @@ class Nadam(IUpdater):
     def state_size(self, n):
         return 2 * n
 
-    def update_leaf(self, g, state, lr, t):
+    def update_leaf(self, g, state, lr, t, epoch=0.0, param=None):
         m = self.beta1 * state["m"] + (1.0 - self.beta1) * g
         v = self.beta2 * state["v"] + (1.0 - self.beta2) * g * g
         tt = t + 1.0
@@ -198,10 +205,10 @@ class Nesterovs(IUpdater):
             return self.momentum_schedule.value_at(iteration, epoch)
         return jnp.asarray(self.momentum, jnp.float32)
 
-    def update_leaf(self, g, state, lr, t):
+    def update_leaf(self, g, state, lr, t, epoch=0.0, param=None):
         # Reference NesterovsUpdater: vPrev = v; v = mu*v - lr*g;
         # update = -(-mu*vPrev + (1+mu)*v); solver then does params -= update.
-        mu = self.current_momentum(t, 0)
+        mu = self.current_momentum(t, epoch)
         v_prev = state["v"]
         v = mu * v_prev - lr * g
         update = -(-mu * v_prev + (1.0 + mu) * v)
@@ -221,7 +228,7 @@ class AdaGrad(IUpdater):
     def state_size(self, n):
         return n
 
-    def update_leaf(self, g, state, lr, t):
+    def update_leaf(self, g, state, lr, t, epoch=0.0, param=None):
         h = state["h"] + g * g
         return lr * g / (jnp.sqrt(h) + self.epsilon), {"h": h}
 
@@ -238,7 +245,7 @@ class AdaDelta(IUpdater):
     def state_size(self, n):
         return 2 * n
 
-    def update_leaf(self, g, state, lr, t):
+    def update_leaf(self, g, state, lr, t, epoch=0.0, param=None):
         msg = self.rho * state["msg"] + (1.0 - self.rho) * g * g
         dx = (
             jnp.sqrt(state["msdx"] + self.epsilon)
@@ -265,6 +272,6 @@ class RmsProp(IUpdater):
     def state_size(self, n):
         return n
 
-    def update_leaf(self, g, state, lr, t):
+    def update_leaf(self, g, state, lr, t, epoch=0.0, param=None):
         g2 = self.rms_decay * state["g2"] + (1.0 - self.rms_decay) * g * g
         return lr * g / (jnp.sqrt(g2) + self.epsilon), {"g2": g2}

@@ -1,0 +1,6 @@
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets.iterators import (
+    ArrayDataSetIterator,
+    DataSetIterator,
+    ListDataSetIterator,
+)

@@ -1,0 +1,78 @@
+"""DataSet containers.
+
+Reference: ``org.nd4j.linalg.dataset.DataSet`` (features + labels +
+featuresMask + labelsMask) and ``MultiDataSet`` (lists of each). Arrays here
+are host numpy until they cross into the jitted step — device transfer is the
+iterator/prefetcher's job, not the container's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class DataSet:
+    features: np.ndarray
+    labels: np.ndarray
+    features_mask: Optional[np.ndarray] = None
+    labels_mask: Optional[np.ndarray] = None
+
+    def num_examples(self) -> int:
+        return int(np.asarray(self.features).shape[0])
+
+    def split_test_and_train(self, n_train: int):
+        a = DataSet(self.features[:n_train], self.labels[:n_train],
+                    _slice(self.features_mask, None, n_train),
+                    _slice(self.labels_mask, None, n_train))
+        b = DataSet(self.features[n_train:], self.labels[n_train:],
+                    _slice(self.features_mask, n_train, None),
+                    _slice(self.labels_mask, n_train, None))
+        return a, b
+
+    def shuffle(self, seed: int | None = None):
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(self.num_examples())
+        self.features = np.asarray(self.features)[perm]
+        self.labels = np.asarray(self.labels)[perm]
+        if self.features_mask is not None:
+            self.features_mask = np.asarray(self.features_mask)[perm]
+        if self.labels_mask is not None:
+            self.labels_mask = np.asarray(self.labels_mask)[perm]
+        return self
+
+    @staticmethod
+    def merge(datasets: Sequence["DataSet"]) -> "DataSet":
+        return DataSet(
+            np.concatenate([np.asarray(d.features) for d in datasets]),
+            np.concatenate([np.asarray(d.labels) for d in datasets]),
+            _cat([d.features_mask for d in datasets]),
+            _cat([d.labels_mask for d in datasets]),
+        )
+
+
+def _slice(arr, a, b):
+    return None if arr is None else np.asarray(arr)[a:b]
+
+
+def _cat(arrs):
+    if any(a is None for a in arrs):
+        return None
+    return np.concatenate([np.asarray(a) for a in arrs])
+
+
+@dataclasses.dataclass
+class MultiDataSet:
+    """Reference ``org.nd4j.linalg.dataset.MultiDataSet``: multi-input /
+    multi-output sample container for ComputationGraph training."""
+
+    features: Sequence[np.ndarray]
+    labels: Sequence[np.ndarray]
+    features_masks: Optional[Sequence[Optional[np.ndarray]]] = None
+    labels_masks: Optional[Sequence[Optional[np.ndarray]]] = None
+
+    def num_examples(self) -> int:
+        return int(np.asarray(self.features[0]).shape[0])

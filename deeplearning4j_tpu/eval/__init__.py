@@ -1,0 +1,6 @@
+from deeplearning4j_tpu.eval.evaluation import (
+    Evaluation,
+    EvaluationBinary,
+    RegressionEvaluation,
+    ROC,
+)

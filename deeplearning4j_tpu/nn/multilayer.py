@@ -1,0 +1,318 @@
+"""MultiLayerNetwork — sequential model runtime.
+
+Reference: ``org.deeplearning4j.nn.multilayer.MultiLayerNetwork`` (~4k LoC):
+``fit`` / ``output`` / ``score`` / ``evaluate``, flat params vector,
+listeners, updater application via ``MultiLayerUpdater``.
+
+TPU-native inversion (SURVEY.md §3.1): the reference's hot loop —
+per-layer ``activate``/``backpropGradient`` calls each crossing JNI per op —
+becomes ONE ``jax.jit``-compiled XLA program:
+``train_step(params, state, opt_state, batch) -> (params', state',
+opt_state', loss)``. Forward, backward (``jax.grad``), gradient
+normalization, regularization and updater all fuse into a single
+device executable; the Python loop only feeds batches.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.conf.multilayer import MultiLayerConfiguration
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import (
+    ArrayDataSetIterator,
+    DataSetIterator,
+    ListDataSetIterator,
+)
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.optimize import solver
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+from deeplearning4j_tpu.util import params as params_util
+
+
+def _as_iterator(data, labels=None, batch_size: Optional[int] = None):
+    if isinstance(data, DataSetIterator):
+        return data
+    if isinstance(data, DataSet):
+        return ListDataSetIterator([data])
+    if labels is not None:
+        return ArrayDataSetIterator(data, labels,
+                                    batch_size or np.asarray(data).shape[0],
+                                    drop_last=False)
+    raise TypeError(f"cannot build DataSetIterator from {type(data)}")
+
+
+class MultiLayerNetwork:
+    """Sequential network (reference ``MultiLayerNetwork``)."""
+
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.params: Optional[Dict[str, dict]] = None
+        self.state: Dict[str, dict] = {}
+        self.opt_state: Dict[str, dict] = {}
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners: List[TrainingListener] = []
+        self.last_batch_size: Optional[int] = None
+        self.score_value: float = float("nan")
+        self._train_step = None
+        self._output_fn = None
+        self._score_fn = None
+        self._dtype = jnp.dtype(conf.dtype)
+        self._base_key = jax.random.PRNGKey(conf.seed)
+
+    # --- lifecycle ---------------------------------------------------------
+    def init(self) -> "MultiLayerNetwork":
+        """Initialize params/state/updater-state (reference ``#init``)."""
+        key = self._base_key
+        types = self.conf.input_types()
+        self.params, self.state, self.opt_state = {}, {}, {}
+        for i, (layer, itype) in enumerate(zip(self.conf.layers, types)):
+            p = layer.init(jax.random.fold_in(key, i), itype, self._dtype)
+            if p:
+                self.params[str(i)] = p
+            s = layer.init_state(itype, self._dtype)
+            if s:
+                self.state[str(i)] = s
+        for k, lp in self.params.items():
+            upd = self._updater_for(int(k))
+            self.opt_state[k] = {pk: upd.init_state(pv) for pk, pv in lp.items()}
+        return self
+
+    def set_listeners(self, *listeners: TrainingListener):
+        self.listeners = list(listeners)
+        return self
+
+    def _updater_for(self, layer_idx: int):
+        layer = self.conf.layers[layer_idx]
+        return getattr(layer, "updater", None) or self.conf.updater
+
+    # --- functional core ---------------------------------------------------
+    def _forward(self, params, state, x, train: bool, rng, upto: int = None):
+        """Pure forward pass over layers [0, upto). Returns (x, new_state)."""
+        n = len(self.conf.layers) if upto is None else upto
+        new_state = {}
+        for i in range(n):
+            layer = self.conf.layers[i]
+            p = params.get(str(i), {})
+            s = state.get(str(i), {})
+            lrng = jax.random.fold_in(rng, i) if rng is not None else None
+            x, s2 = layer.forward(p, s, x, train=train, rng=lrng)
+            if str(i) in state:
+                new_state[str(i)] = s2
+        return x, new_state
+
+    def _output_layer(self):
+        last = self.conf.layers[-1]
+        if not hasattr(last, "score"):
+            raise TypeError(
+                f"last layer {type(last).__name__} is not an output layer "
+                "(reference: fit() requires an IOutputLayer)")
+        return last
+
+    def _loss(self, params, state, features, labels, lmask, rng, train=True):
+        out_layer = self._output_layer()
+        last = len(self.conf.layers) - 1
+        x, new_state = self._forward(params, state, features, train=train,
+                                     rng=rng, upto=last)
+        loss = out_layer.score(params.get(str(last), {}), x, labels, lmask)
+        loss = loss + solver.regularization_score(self.conf.layers, params)
+        return loss, new_state
+
+    def train_step_fn(self):
+        """The raw (unjitted) pure train step — exposed so parallel wrappers
+        can jit it under a Mesh with explicit shardings (stage-7 path)."""
+        layers = self.conf.layers
+
+        def step(params, state, opt_state, features, labels, lmask, it, ep, rng):
+            def loss_fn(p):
+                return self._loss(p, state, features, labels, lmask, rng)
+
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_opt = {}, {}
+            for k in params:
+                layer = layers[int(k)]
+                upd = self._updater_for(int(k))
+                lr = upd.current_lr(it, ep)
+                g = solver.normalize_layer_gradients(layer, grads[k])
+                new_params[k], new_opt[k] = solver.apply_updater_to_layer(
+                    layer, upd, params[k], g, opt_state[k], lr, it, ep)
+            return new_params, new_state, new_opt, loss
+
+        return step
+
+    def _build_train_step(self):
+        return jax.jit(self.train_step_fn(), donate_argnums=(0, 1, 2))
+
+    def _build_output_fn(self):
+        def out(params, state, x):
+            y, _ = self._forward(params, state, x, train=False, rng=None)
+            return y
+
+        return jax.jit(out)
+
+    def _build_score_fn(self):
+        def score(params, state, features, labels, lmask):
+            # eval mode: BN uses running stats, dropout off — matches the
+            # reference's score() running feed-forward in inference mode
+            loss, _ = self._loss(params, state, features, labels, lmask,
+                                 rng=None, train=False)
+            return loss
+
+        return jax.jit(score)
+
+    # --- training ----------------------------------------------------------
+    def fit(self, data, labels=None, epochs: int = 1,
+            batch_size: Optional[int] = None):
+        """Train (reference ``MultiLayerNetwork#fit`` overloads: iterator,
+        DataSet, or (features, labels) arrays)."""
+        if self.params is None:
+            self.init()
+        iterator = _as_iterator(data, labels, batch_size)
+        for _ in range(epochs):
+            for lst in self.listeners:
+                lst.on_epoch_start(self, self.epoch)
+            for ds in iterator:
+                self.fit_batch(ds)
+            iterator.reset()
+            for lst in self.listeners:
+                lst.on_epoch_end(self, self.epoch)
+            self.epoch += 1
+        return self
+
+    def fit_batch(self, ds: DataSet) -> float:
+        """One optimization step on one minibatch."""
+        if self.params is None:
+            self.init()
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        features = jnp.asarray(np.asarray(ds.features), self._dtype)
+        labels = jnp.asarray(np.asarray(ds.labels), self._dtype)
+        if ds.labels_mask is not None:
+            lmask = jnp.asarray(np.asarray(ds.labels_mask), self._dtype)
+        else:
+            lmask = jnp.ones((features.shape[0],), self._dtype)
+        rng = jax.random.fold_in(self._base_key, self.iteration + 1_000_003)
+        it = jnp.asarray(float(self.iteration), jnp.float32)
+        ep = jnp.asarray(float(self.epoch), jnp.float32)
+        self.params, self.state, self.opt_state, loss = self._train_step(
+            self.params, self.state, self.opt_state, features, labels, lmask,
+            it, ep, rng)
+        self.last_batch_size = int(features.shape[0])
+        self.score_value = float(loss)
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, self.epoch,
+                               self.score_value)
+        self.iteration += 1
+        return self.score_value
+
+    # --- inference / scoring ----------------------------------------------
+    def output(self, x, batch_size: Optional[int] = None):
+        """Forward pass, eval mode (reference ``#output``)."""
+        if self.params is None:
+            self.init()
+        if self._output_fn is None:
+            self._output_fn = self._build_output_fn()
+        x = jnp.asarray(np.asarray(x), self._dtype)
+        return self._output_fn(self.params, self.state, x)
+
+    def score(self, ds: DataSet = None) -> float:
+        """Loss on a DataSet without updating (reference ``#score``), or the
+        last training score when called with no args."""
+        if ds is None:
+            return self.score_value
+        if self._score_fn is None:
+            self._score_fn = self._build_score_fn()
+        features = jnp.asarray(np.asarray(ds.features), self._dtype)
+        labels = jnp.asarray(np.asarray(ds.labels), self._dtype)
+        lmask = (jnp.asarray(np.asarray(ds.labels_mask), self._dtype)
+                 if ds.labels_mask is not None
+                 else jnp.ones((features.shape[0],), self._dtype))
+        return float(self._score_fn(self.params, self.state, features, labels,
+                                    lmask))
+
+    def evaluate(self, iterator, evaluation: Optional[Evaluation] = None):
+        """Reference ``#evaluate(DataSetIterator)`` -> Evaluation."""
+        ev = evaluation if evaluation is not None else Evaluation()
+        iterator = _as_iterator(iterator)
+        for ds in iterator:
+            out = self.output(ds.features)
+            ev.eval(ds.labels, np.asarray(out), mask=ds.labels_mask)
+        iterator.reset()
+        return ev
+
+    # --- gradients (for gradient checks / ParallelWrapper) -----------------
+    def compute_gradient_and_score(self, ds: DataSet):
+        """(grads pytree, score) without updating params — the hook the
+        gradient-check oracle and the gradient-sharing trainer use
+        (reference ``#computeGradientAndScore``)."""
+        if self.params is None:
+            self.init()
+        features = jnp.asarray(np.asarray(ds.features), self._dtype)
+        labels = jnp.asarray(np.asarray(ds.labels), self._dtype)
+        lmask = (jnp.asarray(np.asarray(ds.labels_mask), self._dtype)
+                 if ds.labels_mask is not None
+                 else jnp.ones((features.shape[0],), self._dtype))
+
+        def loss_fn(p):
+            return self._loss(p, self.state, features, labels, lmask, rng=None)
+
+        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(self.params)
+        return grads, float(loss)
+
+    # --- params vector (serializer parity) ---------------------------------
+    def params_flat(self) -> np.ndarray:
+        """The ONE contiguous params vector (reference ``#params()``)."""
+        return params_util.flatten_params(self.conf, self.params)
+
+    def set_params_flat(self, flat: np.ndarray):
+        self.params = params_util.unflatten_params(self.conf, flat, self.params)
+        return self
+
+    def num_params(self) -> int:
+        return int(self.params_flat().size)
+
+    def clone(self) -> "MultiLayerNetwork":
+        """Config + params copy (reference ``#clone``)."""
+        other = MultiLayerNetwork(self.conf)
+        if self.params is not None:
+            other.init()
+            other.params = jax.tree_util.tree_map(lambda a: a, self.params)
+            other.state = jax.tree_util.tree_map(lambda a: a, self.state)
+            other.opt_state = jax.tree_util.tree_map(lambda a: a, self.opt_state)
+        return other
+
+    def summary(self) -> str:
+        """Layer table (reference ``#summary``)."""
+        types = self.conf.input_types()
+        lines = ["=" * 70,
+                 f"{'idx':<4} {'layer':<30} {'output':<20} {'params':>10}",
+                 "-" * 70]
+        total = 0
+        for i, (layer, itype) in enumerate(zip(self.conf.layers, types)):
+            out_t = layer.output_type(itype)
+            n = 0
+            if self.params and str(i) in self.params:
+                n = sum(int(np.prod(p.shape)) for p in self.params[str(i)].values())
+            total += n
+            lines.append(f"{i:<4} {type(layer).__name__:<30} "
+                         f"{_fmt_type(out_t):<20} {n:>10,}")
+        lines += ["-" * 70, f"Total params: {total:,}", "=" * 70]
+        return "\n".join(lines)
+
+
+def _fmt_type(t) -> str:
+    from deeplearning4j_tpu.conf import inputs as it
+
+    if isinstance(t, it.Convolutional):
+        return f"[{t.height},{t.width},{t.channels}]"
+    if isinstance(t, it.Recurrent):
+        return f"[t={t.timesteps},{t.size}]"
+    if isinstance(t, (it.FeedForward,)):
+        return f"[{t.size}]"
+    return str(t)

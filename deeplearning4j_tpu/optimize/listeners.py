@@ -1,0 +1,144 @@
+"""Training listeners.
+
+Reference: ``org.deeplearning4j.optimize.api.TrainingListener`` SPI and impls
+in ``org.deeplearning4j.optimize.listeners`` (`ScoreIterationListener`,
+`PerformanceListener`, `EvaluativeListener`, `TimeIterationListener`,
+`CollectScoresListener`, `CheckpointListener`).
+
+Per SURVEY.md §5.1/§5.5 the listener SPI survives the rebuild; it is fed
+step-level numbers (per-op timing is meaningless under XLA fusion).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Callable, List, Optional
+
+
+class TrainingListener:
+    """SPI (reference ``TrainingListener``)."""
+
+    def iteration_done(self, model, iteration: int, epoch: int,
+                       score: float) -> None:
+        pass
+
+    def on_epoch_start(self, model, epoch: int) -> None:
+        pass
+
+    def on_epoch_end(self, model, epoch: int) -> None:
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """Print score every N iterations (reference ``ScoreIterationListener``)."""
+
+    def __init__(self, print_iterations: int = 10, stream=None):
+        self.print_iterations = max(1, print_iterations)
+        self.stream = stream or sys.stdout
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if iteration % self.print_iterations == 0:
+            print(f"Score at iteration {iteration} is {score}", file=self.stream)
+
+
+class PerformanceListener(TrainingListener):
+    """Examples/sec + iterations/sec (reference ``PerformanceListener``)."""
+
+    def __init__(self, frequency: int = 10, report_batch: bool = True,
+                 stream=None):
+        self.frequency = max(1, frequency)
+        self.report_batch = report_batch
+        self.stream = stream or sys.stdout
+        self._last_time = None
+        self._last_iter = None
+        self.last_examples_per_sec: Optional[float] = None
+
+    def iteration_done(self, model, iteration, epoch, score):
+        now = time.perf_counter()
+        if self._last_time is not None and iteration % self.frequency == 0:
+            iters = iteration - self._last_iter
+            dt = now - self._last_time
+            if dt > 0 and iters > 0:
+                ips = iters / dt
+                batch = getattr(model, "last_batch_size", None)
+                msg = f"iterations/sec: {ips:.2f}"
+                if batch and self.report_batch:
+                    self.last_examples_per_sec = ips * batch
+                    msg += f", examples/sec: {self.last_examples_per_sec:.2f}"
+                print(msg, file=self.stream)
+            self._last_time = now
+            self._last_iter = iteration
+        elif self._last_time is None:
+            self._last_time = now
+            self._last_iter = iteration
+
+
+class CollectScoresListener(TrainingListener):
+    """Accumulate (iteration, score) pairs (reference
+    ``CollectScoresListener``)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.iterations: List[int] = []
+        self.scores: List[float] = []
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if iteration % self.frequency == 0:
+            self.iterations.append(iteration)
+            self.scores.append(float(score))
+
+
+class TimeIterationListener(TrainingListener):
+    """ETA printout (reference ``TimeIterationListener``)."""
+
+    def __init__(self, total_iterations: int, frequency: int = 50, stream=None):
+        self.total = total_iterations
+        self.frequency = max(1, frequency)
+        self.stream = stream or sys.stdout
+        self._start = None
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if self._start is None:
+            self._start = time.perf_counter()
+            return
+        if iteration % self.frequency == 0 and iteration > 0:
+            elapsed = time.perf_counter() - self._start
+            rate = elapsed / iteration
+            remaining = (self.total - iteration) * rate
+            print(f"Remaining time estimate: {remaining:.1f}s "
+                  f"(iteration {iteration}/{self.total})", file=self.stream)
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodic evaluation during fit (reference ``EvaluativeListener``)."""
+
+    def __init__(self, iterator, frequency: int = 1,
+                 unit: str = "epoch",
+                 evaluation_factory: Optional[Callable] = None,
+                 stream=None):
+        self.iterator = iterator
+        self.frequency = max(1, frequency)
+        self.unit = unit
+        self.evaluation_factory = evaluation_factory
+        self.stream = stream or sys.stdout
+        self.last_evaluation = None
+
+    def _run(self, model):
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+        factory = self.evaluation_factory or Evaluation
+        self.last_evaluation = model.evaluate(self.iterator,
+                                              evaluation=factory())
+        acc = getattr(self.last_evaluation, "accuracy", None)
+        if callable(acc):
+            print(f"[EvaluativeListener] accuracy: {acc():.4f}",
+                  file=self.stream)
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if self.unit == "iteration" and iteration % self.frequency == 0:
+            self._run(model)
+
+    def on_epoch_end(self, model, epoch):
+        if self.unit == "epoch" and (epoch + 1) % self.frequency == 0:
+            self._run(model)

@@ -1,0 +1,88 @@
+"""Gradient post-processing + updater application — the solver step.
+
+Reference: ``org.deeplearning4j.optimize.solvers.BaseOptimizer`` (gradient
+normalization/clipping per layer conf) + ``org.deeplearning4j.nn.updater``
+(``MultiLayerUpdater``/``UpdaterBlock`` grouping layers over the flat params
+view, applying regularization then the layer's updater).
+
+All pure functions composed inside the jitted train step — where the
+reference's ``StochasticGradientDescent#optimize`` crosses JNI per update op,
+this entire pipeline is one fused XLA program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.conf.layers import BaseLayer, GradientNormalization
+
+
+def normalize_layer_gradients(layer_conf, grads: dict) -> dict:
+    """Apply the layer's GradientNormalization (reference
+    ``BaseOptimizer#postProcessGradient``)."""
+    if not isinstance(layer_conf, BaseLayer) or not grads:
+        return grads
+    gn = layer_conf.gradient_normalization
+    thr = layer_conf.gradient_normalization_threshold
+    if gn is GradientNormalization.NONE:
+        return grads
+    if gn is GradientNormalization.RENORMALIZE_L2_PER_PARAM_TYPE:
+        return {k: g / (jnp.linalg.norm(g) + 1e-12) for k, g in grads.items()}
+    if gn is GradientNormalization.RENORMALIZE_L2_PER_LAYER:
+        norm = jnp.sqrt(sum(jnp.sum(g * g) for g in grads.values()) + 1e-24)
+        return {k: g / norm for k, g in grads.items()}
+    if gn is GradientNormalization.CLIP_ELEMENTWISE_ABSOLUTE_VALUE:
+        return {k: jnp.clip(g, -thr, thr) for k, g in grads.items()}
+    if gn is GradientNormalization.CLIP_L2_PER_LAYER:
+        norm = jnp.sqrt(sum(jnp.sum(g * g) for g in grads.values()) + 1e-24)
+        scale = jnp.minimum(1.0, thr / norm)
+        return {k: g * scale for k, g in grads.items()}
+    if gn is GradientNormalization.CLIP_L2_PER_PARAM_TYPE:
+        out = {}
+        for k, g in grads.items():
+            norm = jnp.linalg.norm(g) + 1e-12
+            out[k] = g * jnp.minimum(1.0, thr / norm)
+        return out
+    raise ValueError(f"unhandled GradientNormalization {gn}")
+
+
+def apply_updater_to_layer(layer_conf, updater, params: dict, grads: dict,
+                           opt_state: dict, lr, t, epoch=0.0):
+    """Regularization (before/after updater) + updater transform for ONE
+    layer. Returns (new_params, new_opt_state).
+
+    Reference flow (``UpdaterBlock#update``): L1/L2 added to gradient ->
+    ``GradientUpdater#applyUpdater`` -> WeightDecay added to update ->
+    ``params -= update``.
+    """
+    reg_w = tuple(getattr(layer_conf, "regularization", ()) or ())
+    reg_b = tuple(getattr(layer_conf, "regularization_bias", ()) or ())
+    reg_keys = set(layer_conf.regularized_param_keys())
+    new_params, new_opt = {}, {}
+    for k, p in params.items():
+        g = grads[k]
+        regs = reg_w if k in reg_keys else reg_b
+        for r in regs:
+            g = r.apply_before_updater(g, p, lr)
+        upd, new_opt[k] = updater.update_leaf(g, opt_state[k], lr, t,
+                                              epoch=epoch, param=p)
+        for r in regs:
+            upd = r.apply_after_updater(upd, p, lr)
+        new_params[k] = p - upd
+    return new_params, new_opt
+
+
+def regularization_score(layers, params: dict):
+    """Total regularization penalty added to the reported score (reference:
+    ``BaseLayer#calcRegularizationScore``)."""
+    total = 0.0
+    for idx_str, layer_params in params.items():
+        conf = layers[int(idx_str)]
+        reg_keys = set(conf.regularized_param_keys())
+        for k, p in layer_params.items():
+            regs = (getattr(conf, "regularization", ()) if k in reg_keys
+                    else getattr(conf, "regularization_bias", ()))
+            for r in regs or ():
+                total = total + r.score_term(p)
+    return total

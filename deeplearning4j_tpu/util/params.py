@@ -1,0 +1,101 @@
+"""Flat-parameter-vector convention.
+
+Reference: the ONE contiguous params vector of ``MultiLayerNetwork`` /
+``ComputationGraph`` (``#params()``), with per-layer views — the layout
+contract that ModelSerializer's ``coefficients.bin`` depends on.
+
+Here params live as a pytree ``{"0": {"W":…, "b":…}, "1": …}`` (layer index
+keys as strings); the flatten order spec is: layers in ascending index order,
+within a layer the conf's ``param_order()`` (e.g. W then b), each raveled in
+C order. Updater state flattens the same way with the updater's state keys
+sorted alphabetically per param.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def layer_keys(params: Dict[str, dict]) -> List[str]:
+    return sorted(params.keys(), key=lambda k: int(k))
+
+
+def flatten_params(conf, params: Dict[str, dict]) -> np.ndarray:
+    """params pytree -> single 1-D numpy vector in the canonical order."""
+    chunks = []
+    for k in layer_keys(params):
+        layer = conf.layers[int(k)]
+        for name in layer.param_order():
+            if name in params[k]:
+                chunks.append(np.asarray(params[k][name]).ravel())
+    if not chunks:
+        return np.zeros((0,), np.float32)
+    return np.concatenate(chunks)
+
+
+def unflatten_params(conf, flat: np.ndarray, like: Dict[str, dict]) -> Dict[str, dict]:
+    """1-D vector -> params pytree with shapes/dtypes taken from ``like``."""
+    flat = np.asarray(flat)
+    expected = sum(
+        int(np.prod(like[k][name].shape))
+        for k in layer_keys(like)
+        for name in conf.layers[int(k)].param_order() if name in like[k])
+    if flat.size != expected:
+        raise ValueError(
+            f"flat params vector has {flat.size} values but the model "
+            f"expects {expected} (reference: setParams length check)")
+    out: Dict[str, dict] = {}
+    pos = 0
+    for k in layer_keys(like):
+        layer = conf.layers[int(k)]
+        out[k] = dict(like[k])
+        for name in layer.param_order():
+            if name in like[k]:
+                ref = like[k][name]
+                n = int(np.prod(ref.shape)) if ref.ndim else 1
+                out[k][name] = jnp.asarray(
+                    flat[pos:pos + n].reshape(ref.shape), dtype=ref.dtype)
+                pos += n
+    if pos != flat.size:
+        raise ValueError(f"flat vector length {flat.size} != params size {pos}")
+    return out
+
+
+def num_params(conf, params: Dict[str, dict]) -> int:
+    return int(flatten_params(conf, params).size)
+
+
+def flatten_state_like(nested) -> np.ndarray:
+    """Flatten updater state {layer: {param: {statekey: arr}}} in canonical
+    order (layers ascending, param insertion order, state keys sorted)."""
+    chunks = []
+    for k in sorted(nested.keys(), key=lambda k: int(k)):
+        for pname in nested[k]:
+            st = nested[k][pname]
+            for sk in sorted(st.keys()):
+                chunks.append(np.asarray(st[sk]).ravel())
+    if not chunks:
+        return np.zeros((0,), np.float32)
+    return np.concatenate(chunks)
+
+
+def unflatten_state_like(flat: np.ndarray, like) -> dict:
+    flat = np.asarray(flat)
+    out = {}
+    pos = 0
+    for k in sorted(like.keys(), key=lambda k: int(k)):
+        out[k] = {}
+        for pname in like[k]:
+            out[k][pname] = {}
+            for sk in sorted(like[k][pname].keys()):
+                ref = like[k][pname][sk]
+                n = int(np.prod(ref.shape)) if ref.ndim else 1
+                out[k][pname][sk] = jnp.asarray(
+                    flat[pos:pos + n].reshape(ref.shape), dtype=ref.dtype)
+                pos += n
+    if pos != flat.size:
+        raise ValueError(f"flat state length {flat.size} != expected {pos}")
+    return out

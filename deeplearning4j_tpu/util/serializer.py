@@ -1,0 +1,117 @@
+"""ModelSerializer — checkpoint save/restore.
+
+Reference: ``org.deeplearning4j.util.ModelSerializer``: a zip archive of
+``configuration.json`` + ``coefficients.bin`` (flat params vector) +
+``updaterState.bin`` (flat updater state) + optional normalizer.
+
+Format here (same spirit, numpy container): zip with
+- ``configuration.json`` — full config DSL JSON (round-trippable)
+- ``coefficients.npy`` — flat params vector (canonical order,
+  :mod:`deeplearning4j_tpu.util.params`)
+- ``updaterState.npy`` — flat updater state (if saved)
+- ``state.npz`` — layer runtime state (BN running stats), keyed
+  ``<layer>/<name>``
+- ``metadata.json`` — iteration/epoch counters, format version
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import serde
+from deeplearning4j_tpu.util import params as params_util
+
+FORMAT_VERSION = 1
+
+
+def write_model(net, path, save_updater: bool = True) -> None:
+    """Reference ``ModelSerializer#writeModel(net, file, saveUpdater)``."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("configuration.json", net.conf.to_json())
+        z.writestr("coefficients.npy", _npy_bytes(net.params_flat()))
+        if save_updater and net.opt_state:
+            z.writestr("updaterState.npy",
+                       _npy_bytes(params_util.flatten_state_like(net.opt_state)))
+        if net.state:
+            buf = io.BytesIO()
+            flat = {f"{k}/{name}": np.asarray(v)
+                    for k, d in net.state.items() for name, v in d.items()}
+            np.savez(buf, **flat)
+            z.writestr("state.npz", buf.getvalue())
+        z.writestr("metadata.json", json.dumps({
+            "format_version": FORMAT_VERSION,
+            "iteration": net.iteration,
+            "epoch": net.epoch,
+            "model_class": type(net).__name__,
+        }))
+
+
+def restore_multi_layer_network(path, load_updater: bool = True):
+    """Reference ``ModelSerializer#restoreMultiLayerNetwork`` — exact
+    resume: params + updater state + counters."""
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    with zipfile.ZipFile(Path(path), "r") as z:
+        conf = serde.from_json(z.read("configuration.json").decode())
+        net = MultiLayerNetwork(conf).init()
+        flat = _read_npy(z, "coefficients.npy")
+        net.set_params_flat(flat)
+        if load_updater and "updaterState.npy" in z.namelist():
+            sflat = _read_npy(z, "updaterState.npy")
+            net.opt_state = params_util.unflatten_state_like(sflat, net.opt_state)
+        if "state.npz" in z.namelist():
+            with z.open("state.npz") as f:
+                data = np.load(io.BytesIO(f.read()))
+                for key in data.files:
+                    layer, name = key.split("/", 1)
+                    net.state[layer][name] = jnp.asarray(data[key])
+        meta = json.loads(z.read("metadata.json").decode())
+        net.iteration = meta.get("iteration", 0)
+        net.epoch = meta.get("epoch", 0)
+    return net
+
+
+def restore_computation_graph(path, load_updater: bool = True):
+    """Reference ``ModelSerializer#restoreComputationGraph``."""
+    try:
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+    except ImportError as e:  # pragma: no cover
+        raise NotImplementedError(
+            "ComputationGraph runtime not available yet") from e
+
+    with zipfile.ZipFile(Path(path), "r") as z:
+        conf = serde.from_json(z.read("configuration.json").decode())
+        net = ComputationGraph(conf).init()
+        net.set_params_flat(_read_npy(z, "coefficients.npy"))
+        if load_updater and "updaterState.npy" in z.namelist():
+            sflat = _read_npy(z, "updaterState.npy")
+            net.opt_state = params_util.unflatten_state_like(sflat, net.opt_state)
+        if "state.npz" in z.namelist():
+            with z.open("state.npz") as f:
+                data = np.load(io.BytesIO(f.read()))
+                for key in data.files:
+                    layer, name = key.split("/", 1)
+                    net.state[layer][name] = jnp.asarray(data[key])
+        meta = json.loads(z.read("metadata.json").decode())
+        net.iteration = meta.get("iteration", 0)
+        net.epoch = meta.get("epoch", 0)
+    return net
+
+
+def _npy_bytes(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(arr))
+    return buf.getvalue()
+
+
+def _read_npy(z: zipfile.ZipFile, name: str) -> np.ndarray:
+    with z.open(name) as f:
+        return np.load(io.BytesIO(f.read()))

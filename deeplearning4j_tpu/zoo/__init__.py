@@ -1,0 +1,1 @@
+from deeplearning4j_tpu.zoo.models import LeNet, SimpleCNN

@@ -1,0 +1,120 @@
+"""Model zoo — sequential models.
+
+Reference: ``org.deeplearning4j.zoo.model.*`` (``ZooModel`` SPI: ``init()``
+builds a config; pretrained download is a no-op here — zero-egress env, the
+checksum-verified download machinery lives in ``zoo.pretrained``).
+ComputationGraph-based zoo models (ResNet50, VGG16, …) are in
+:mod:`deeplearning4j_tpu.zoo.graphs`.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.conf import Activation, InputType, WeightInit
+from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.conf.layers_cnn import (
+    BatchNormalization,
+    ConvolutionLayer,
+    ConvolutionMode,
+    PoolingType,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.conf.losses import LossMCXENT
+from deeplearning4j_tpu.conf.multilayer import (
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.conf.updaters import Adam, IUpdater, Nesterovs
+
+
+class ZooModel:
+    """SPI base (reference ``org.deeplearning4j.zoo.ZooModel``)."""
+
+    def init(self):
+        """Build the (un-initialized) network object."""
+        raise NotImplementedError
+
+    def conf(self):
+        raise NotImplementedError
+
+
+class LeNet(ZooModel):
+    """Reference ``org.deeplearning4j.zoo.model.LeNet`` topology:
+    conv5x5(20) -> maxpool2 -> conv5x5(50) -> maxpool2 -> dense(500, relu)
+    -> softmax output. Input 28x28xC (MNIST default)."""
+
+    def __init__(self, num_classes: int = 10, height: int = 28,
+                 width: int = 28, channels: int = 1, seed: int = 123,
+                 updater: IUpdater | None = None):
+        self.num_classes = num_classes
+        self.height, self.width, self.channels = height, width, channels
+        self.seed = seed
+        self.updater = updater or Adam(learning_rate=1e-3)
+
+    def conf(self) -> MultiLayerConfiguration:
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed)
+                .updater(self.updater)
+                .weight_init(WeightInit.XAVIER)
+                .list()
+                .layer(ConvolutionLayer(
+                    n_out=20, kernel_size=(5, 5), stride=(1, 1),
+                    convolution_mode=ConvolutionMode.SAME,
+                    activation=Activation.IDENTITY))
+                .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                        kernel_size=(2, 2), stride=(2, 2)))
+                .layer(ConvolutionLayer(
+                    n_out=50, kernel_size=(5, 5), stride=(1, 1),
+                    convolution_mode=ConvolutionMode.SAME,
+                    activation=Activation.IDENTITY))
+                .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                        kernel_size=(2, 2), stride=(2, 2)))
+                .layer(DenseLayer(n_out=500, activation=Activation.RELU))
+                .layer(OutputLayer(n_out=self.num_classes,
+                                   activation=Activation.SOFTMAX,
+                                   loss_fn=LossMCXENT()))
+                .set_input_type(InputType.convolutional(
+                    self.height, self.width, self.channels))
+                .build())
+
+    def init(self):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        return MultiLayerNetwork(self.conf()).init()
+
+
+class SimpleCNN(ZooModel):
+    """Reference ``org.deeplearning4j.zoo.model.SimpleCNN``: small
+    conv/bn stack for 48x48x3-style inputs."""
+
+    def __init__(self, num_classes: int = 10, height: int = 48,
+                 width: int = 48, channels: int = 3, seed: int = 123):
+        self.num_classes = num_classes
+        self.height, self.width, self.channels = height, width, channels
+        self.seed = seed
+
+    def conf(self) -> MultiLayerConfiguration:
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed)
+             .updater(Nesterovs(learning_rate=0.01, momentum=0.9))
+             .weight_init(WeightInit.RELU)
+             .list())
+        for n_out, pool in [(16, False), (32, True), (64, True)]:
+            b.layer(ConvolutionLayer(n_out=n_out, kernel_size=(3, 3),
+                                     convolution_mode=ConvolutionMode.SAME,
+                                     activation=Activation.IDENTITY))
+            b.layer(BatchNormalization(activation=Activation.RELU))
+            if pool:
+                b.layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                         kernel_size=(2, 2), stride=(2, 2)))
+        b.layer(DenseLayer(n_out=128, activation=Activation.RELU))
+        b.layer(OutputLayer(n_out=self.num_classes,
+                            activation=Activation.SOFTMAX,
+                            loss_fn=LossMCXENT()))
+        b.set_input_type(InputType.convolutional(self.height, self.width,
+                                                 self.channels))
+        return b.build()
+
+    def init(self):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        return MultiLayerNetwork(self.conf()).init()

@@ -1,0 +1,75 @@
+import numpy as np
+
+from deeplearning4j_tpu.eval.evaluation import (
+    Evaluation,
+    EvaluationBinary,
+    RegressionEvaluation,
+    ROC,
+)
+
+
+def test_evaluation_confusion_and_metrics():
+    ev = Evaluation()
+    labels = np.eye(3)[[0, 0, 1, 1, 2, 2]]
+    preds = np.eye(3)[[0, 1, 1, 1, 2, 0]]
+    ev.eval(labels, preds)
+    assert ev.confusion.tolist() == [[1, 1, 0], [0, 2, 0], [1, 0, 1]]
+    assert np.isclose(ev.accuracy(), 4 / 6)
+    # class 1: precision 2/3, recall 1.0
+    assert np.isclose(ev.precision(1), 2 / 3)
+    assert np.isclose(ev.recall(1), 1.0)
+    f1 = ev.f1(1)
+    assert np.isclose(f1, 2 * (2 / 3) / (2 / 3 + 1))
+    assert "Accuracy" in ev.stats()
+
+
+def test_evaluation_batched_equals_single():
+    rng = np.random.default_rng(0)
+    labels = np.eye(4)[rng.integers(0, 4, 100)]
+    preds = rng.random((100, 4))
+    ev1 = Evaluation().eval(labels, preds)
+    ev2 = Evaluation()
+    ev2.eval(labels[:50], preds[:50])
+    ev2.eval(labels[50:], preds[50:])
+    assert (ev1.confusion == ev2.confusion).all()
+
+
+def test_evaluation_mask():
+    ev = Evaluation(num_classes=2)
+    labels = np.eye(2)[[0, 1, 1]]
+    preds = np.eye(2)[[0, 0, 0]]
+    ev.eval(labels, preds, mask=np.array([1.0, 1.0, 0.0]))
+    assert ev.confusion.sum() == 2
+    assert np.isclose(ev.accuracy(), 0.5)
+
+
+def test_roc_auc_perfect_and_random():
+    roc = ROC()
+    labels = np.array([0, 0, 1, 1])
+    scores = np.array([0.1, 0.2, 0.8, 0.9])
+    roc.eval(labels, scores)
+    assert np.isclose(roc.calculate_auc(), 1.0)
+    roc2 = ROC()
+    roc2.eval(np.array([0, 1, 0, 1]), np.array([0.5, 0.5, 0.5, 0.5]))
+    assert np.isclose(roc2.calculate_auc(), 0.5)
+    assert 0.0 < roc.calculate_auprc() <= 1.0
+
+
+def test_regression_evaluation():
+    re = RegressionEvaluation()
+    labels = np.array([[1.0], [2.0], [3.0]])
+    preds = np.array([[1.5], [2.0], [2.5]])
+    re.eval(labels, preds)
+    assert np.isclose(re.mean_squared_error(0), (0.25 + 0 + 0.25) / 3)
+    assert np.isclose(re.mean_absolute_error(0), 1 / 3)
+    assert re.r_squared(0) > 0.5
+    assert re.pearson_correlation(0) > 0.9
+
+
+def test_evaluation_binary():
+    eb = EvaluationBinary()
+    labels = np.array([[1, 0], [1, 1], [0, 0], [0, 1]], np.float32)
+    preds = np.array([[0.9, 0.1], [0.8, 0.4], [0.3, 0.2], [0.1, 0.9]], np.float32)
+    eb.eval(labels, preds)
+    assert np.isclose(eb.accuracy(0), 1.0)
+    assert np.isclose(eb.recall(1), 0.5)

@@ -1,0 +1,219 @@
+"""Layer shape inference + forward correctness (reference oracle:
+layer tests in deeplearning4j-nn src/test, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.conf import inputs as it
+from deeplearning4j_tpu.conf.activations import Activation
+from deeplearning4j_tpu.conf.layers import (
+    ActivationLayer,
+    DenseLayer,
+    DropoutLayer,
+    EmbeddingLayer,
+    EmbeddingSequenceLayer,
+    OutputLayer,
+)
+from deeplearning4j_tpu.conf.layers_cnn import (
+    BatchNormalization,
+    ConvolutionLayer,
+    ConvolutionMode,
+    Cropping2D,
+    Deconvolution2D,
+    GlobalPoolingLayer,
+    LocalResponseNormalization,
+    PoolingType,
+    SeparableConvolution2D,
+    SpaceToDepthLayer,
+    SubsamplingLayer,
+    Upsampling2D,
+    ZeroPaddingLayer,
+)
+
+KEY = jax.random.PRNGKey(0)
+
+
+def run_layer(layer, input_type, x, train=False):
+    params = layer.init(KEY, input_type)
+    state = layer.init_state(input_type)
+    y, _ = layer.forward(params, state, jnp.asarray(x), train=train,
+                         rng=jax.random.PRNGKey(1))
+    return np.asarray(y)
+
+
+def test_dense_shapes_and_values():
+    layer = DenseLayer(n_out=3, activation=Activation.IDENTITY)
+    t = it.InputType.feed_forward(4)
+    params = layer.init(KEY, t)
+    assert params["W"].shape == (4, 3) and params["b"].shape == (3,)
+    x = np.ones((2, 4), np.float32)
+    y, _ = layer.forward(params, {}, jnp.asarray(x))
+    want = x @ np.asarray(params["W"]) + np.asarray(params["b"])
+    np.testing.assert_allclose(np.asarray(y), want, rtol=1e-5)
+    assert layer.output_type(t) == it.InputType.feed_forward(3)
+
+
+def test_conv_same_truncate_strict_output_sizes():
+    t = it.InputType.convolutional(28, 28, 1)
+    same = ConvolutionLayer(n_out=8, kernel_size=(3, 3), stride=(2, 2),
+                            convolution_mode=ConvolutionMode.SAME)
+    assert same.output_type(t) == it.InputType.convolutional(14, 14, 8)
+    trunc = ConvolutionLayer(n_out=8, kernel_size=(5, 5), stride=(2, 2),
+                             convolution_mode=ConvolutionMode.TRUNCATE)
+    assert trunc.output_type(t) == it.InputType.convolutional(12, 12, 8)
+    strict = ConvolutionLayer(n_out=8, kernel_size=(5, 5), stride=(2, 2),
+                              convolution_mode=ConvolutionMode.STRICT)
+    with pytest.raises(ValueError):
+        strict.output_type(t)  # (28-5) % 2 != 0
+
+
+def test_conv_forward_matches_manual():
+    t = it.InputType.convolutional(5, 5, 2)
+    layer = ConvolutionLayer(n_out=3, kernel_size=(3, 3), stride=(1, 1),
+                             activation=Activation.IDENTITY)
+    params = layer.init(KEY, t)
+    x = np.random.default_rng(0).normal(size=(1, 5, 5, 2)).astype(np.float32)
+    y, _ = layer.forward(params, {}, jnp.asarray(x))
+    assert y.shape == (1, 3, 3, 3)
+    # manual: output position (0,0), channel 0
+    W = np.asarray(params["W"])
+    b = np.asarray(params["b"])
+    want00 = (x[0, :3, :3, :] * W[:, :, :, 0]).sum() + b[0]
+    np.testing.assert_allclose(np.asarray(y)[0, 0, 0, 0], want00, rtol=1e-4)
+
+
+def test_pooling_max_avg():
+    t = it.InputType.convolutional(4, 4, 1)
+    x = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+    mx = run_layer(SubsamplingLayer(pooling_type=PoolingType.MAX), t, x)
+    np.testing.assert_allclose(mx[0, :, :, 0], [[5, 7], [13, 15]])
+    av = run_layer(SubsamplingLayer(pooling_type=PoolingType.AVG), t, x)
+    np.testing.assert_allclose(av[0, :, :, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_batchnorm_train_and_eval():
+    t = it.InputType.feed_forward(3)
+    bn = BatchNormalization(decay=0.5)
+    params = bn.init(KEY, t)
+    state = bn.init_state(t)
+    x = np.random.default_rng(0).normal(3.0, 2.0, size=(64, 3)).astype(np.float32)
+    y, new_state = bn.forward(params, state, jnp.asarray(x), train=True)
+    # normalized output: ~zero mean, ~unit var
+    np.testing.assert_allclose(np.asarray(y).mean(0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(y).std(0), 1.0, atol=1e-2)
+    # running stats moved toward batch stats
+    assert np.all(np.asarray(new_state["mean"]) != 0.0)
+    # eval mode uses running stats, state unchanged
+    y2, s2 = bn.forward(params, new_state, jnp.asarray(x), train=False)
+    assert s2 is new_state
+
+
+def test_global_pooling_cnn_and_rnn_mask():
+    t = it.InputType.convolutional(4, 4, 3)
+    x = np.random.default_rng(0).normal(size=(2, 4, 4, 3)).astype(np.float32)
+    y = run_layer(GlobalPoolingLayer(pooling_type=PoolingType.AVG), t, x)
+    np.testing.assert_allclose(y, x.mean((1, 2)), rtol=1e-5)
+    # masked RNN pooling
+    gp = GlobalPoolingLayer(pooling_type=PoolingType.AVG)
+    seq = np.ones((1, 4, 2), np.float32)
+    seq[0, 2:] = 100.0  # should be excluded by mask
+    mask = jnp.asarray([[1.0, 1.0, 0.0, 0.0]])
+    y2, _ = gp.forward({}, {}, jnp.asarray(seq), mask=mask)
+    np.testing.assert_allclose(np.asarray(y2), [[1.0, 1.0]], rtol=1e-5)
+
+
+def test_dropout_train_vs_eval():
+    layer = DropoutLayer(dropout=0.5)
+    x = np.ones((1000,), np.float32)
+    y_eval = run_layer(layer, it.InputType.feed_forward(1000), x, train=False)
+    np.testing.assert_allclose(y_eval, x)
+    y_train = run_layer(layer, it.InputType.feed_forward(1000), x, train=True)
+    kept = (y_train != 0).mean()
+    assert 0.4 < kept < 0.6
+    # inverted dropout: kept values scaled by 1/p
+    np.testing.assert_allclose(y_train[y_train != 0], 2.0, rtol=1e-5)
+
+
+def test_embedding():
+    layer = EmbeddingLayer(n_in=10, n_out=4)
+    params = layer.init(KEY, it.InputType.feed_forward(1))
+    idx = np.array([[1], [7]], np.int32)
+    y, _ = layer.forward(params, {}, jnp.asarray(idx))
+    np.testing.assert_allclose(np.asarray(y)[0], np.asarray(params["W"])[1])
+    seq = EmbeddingSequenceLayer(n_in=10, n_out=4)
+    sp = seq.init(KEY, it.InputType.recurrent(1, 5))
+    ys, _ = seq.forward(sp, {}, jnp.asarray(np.zeros((2, 5), np.int32)))
+    assert ys.shape == (2, 5, 4)
+
+
+def test_spatial_reshaping_layers():
+    t = it.InputType.convolutional(4, 4, 2)
+    x = np.random.default_rng(0).normal(size=(1, 4, 4, 2)).astype(np.float32)
+    up = run_layer(Upsampling2D(size=(2, 2)), t, x)
+    assert up.shape == (1, 8, 8, 2)
+    np.testing.assert_allclose(up[0, :2, :2, 0], x[0, 0, 0, 0])
+    zp = run_layer(ZeroPaddingLayer(padding=(1, 2, 3, 4)), t, x)
+    assert zp.shape == (1, 7, 11, 2)
+    cr = run_layer(Cropping2D(cropping=(1, 1, 1, 1)), t, x)
+    assert cr.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(cr[0], x[0, 1:3, 1:3])
+    sd = run_layer(SpaceToDepthLayer(block_size=2), t, x)
+    assert sd.shape == (1, 2, 2, 8)
+
+
+def test_separable_and_deconv_shapes():
+    t = it.InputType.convolutional(8, 8, 3)
+    x = np.random.default_rng(0).normal(size=(2, 8, 8, 3)).astype(np.float32)
+    sep = SeparableConvolution2D(n_out=6, kernel_size=(3, 3),
+                                 convolution_mode=ConvolutionMode.SAME)
+    y = run_layer(sep, t, x)
+    assert y.shape == (2, 8, 8, 6)
+    dec = Deconvolution2D(n_out=4, kernel_size=(2, 2), stride=(2, 2),
+                          convolution_mode=ConvolutionMode.SAME)
+    y2 = run_layer(dec, t, x)
+    assert y2.shape == (2, 16, 16, 4)
+    assert dec.output_type(t) == it.InputType.convolutional(16, 16, 4)
+
+
+def test_lrn_shape_preserved():
+    t = it.InputType.convolutional(4, 4, 8)
+    x = np.random.default_rng(0).normal(size=(1, 4, 4, 8)).astype(np.float32)
+    y = run_layer(LocalResponseNormalization(), t, x)
+    assert y.shape == x.shape
+    assert np.all(np.abs(y) <= np.abs(x) + 1e-6)  # normalization shrinks
+
+
+def test_activation_layer():
+    y = run_layer(ActivationLayer(activation=Activation.RELU),
+                  it.InputType.feed_forward(3),
+                  np.array([[-1.0, 0.0, 2.0]], np.float32))
+    np.testing.assert_allclose(y, [[0.0, 0.0, 2.0]])
+
+
+def test_deconv_truncate_shape_matches_declared():
+    t = it.InputType.convolutional(8, 8, 3)
+    dec = Deconvolution2D(n_out=6, kernel_size=(3, 3), stride=(1, 1),
+                          padding=(0, 0),
+                          convolution_mode=ConvolutionMode.TRUNCATE)
+    declared = dec.output_type(t)
+    x = np.zeros((1, 8, 8, 3), np.float32)
+    y = run_layer(dec, t, x)
+    assert y.shape == (1, declared.height, declared.width, 6) == (1, 10, 10, 6)
+    dec2 = Deconvolution2D(n_out=2, kernel_size=(4, 4), stride=(2, 2),
+                           padding=(1, 1),
+                           convolution_mode=ConvolutionMode.TRUNCATE)
+    d2 = dec2.output_type(t)
+    y2 = run_layer(dec2, t, x)
+    assert y2.shape == (1, d2.height, d2.width, 2) == (1, 16, 16, 2)
+
+
+def test_batchnorm_use_batch_mean_in_eval():
+    t = it.InputType.feed_forward(2)
+    bn = BatchNormalization(use_batch_mean_in_eval=True)
+    params = bn.init(KEY, t)
+    state = bn.init_state(t)  # running stats untouched (mean 0, var 1)
+    x = np.random.default_rng(0).normal(5.0, 3.0, (32, 2)).astype(np.float32)
+    y, _ = bn.forward(params, state, jnp.asarray(x), train=False)
+    np.testing.assert_allclose(np.asarray(y).mean(0), 0.0, atol=1e-4)

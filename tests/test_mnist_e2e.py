@@ -1,0 +1,30 @@
+"""BASELINE config #1 e2e: LeNet on MNIST (synthetic fallback), CPU oracle.
+Reference: MultiLayerNetwork LeNet on MNIST (dl4j-examples)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.conf.updaters import Adam
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.zoo.models import LeNet
+
+
+def test_lenet_mnist_learns():
+    train = MnistDataSetIterator(batch=64, train=True, num_examples=2048)
+    test = MnistDataSetIterator(batch=256, train=False, num_examples=512,
+                                shuffle=False)
+    net = LeNet(updater=Adam(learning_rate=2e-3)).init()
+    net.fit(train, epochs=4)
+    ev = net.evaluate(test)
+    assert ev.accuracy() > 0.85, ev.stats()
+
+
+def test_mnist_iterator_shapes():
+    it = MnistDataSetIterator(batch=32, train=True, num_examples=64)
+    ds = next(iter(it))
+    assert ds.features.shape == (32, 28, 28, 1)
+    assert ds.labels.shape == (32, 10)
+    assert 0.0 <= ds.features.min() and ds.features.max() <= 1.0
+    # deterministic synthesis
+    it2 = MnistDataSetIterator(batch=32, train=True, num_examples=64)
+    ds2 = next(iter(it2))
+    np.testing.assert_array_equal(ds.features, ds2.features)

@@ -1,0 +1,212 @@
+"""MultiLayerNetwork end-to-end: builder DSL, fit/output/evaluate,
+serialization round-trip (reference oracle: deeplearning4j-core tests +
+MultiLayerTest, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.conf import Activation, InputType, WeightInit
+from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.conf.layers_cnn import (
+    BatchNormalization,
+    ConvolutionLayer,
+    ConvolutionMode,
+    PoolingType,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.conf.losses import LossMCXENT, LossMSE
+from deeplearning4j_tpu.conf.multilayer import (
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.conf.updaters import Adam, Sgd
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.datasets.mnist import IrisDataSetIterator
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners import (
+    CollectScoresListener,
+    ScoreIterationListener,
+)
+from deeplearning4j_tpu.util import serializer
+
+
+def iris_conf(seed=12345):
+    return (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(Adam(learning_rate=0.02))
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(DenseLayer(n_out=16, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+
+
+def test_config_json_roundtrip():
+    conf = iris_conf()
+    js = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(js)
+    assert conf2 == conf
+
+
+def test_network_init_and_summary():
+    net = MultiLayerNetwork(iris_conf()).init()
+    assert net.num_params() == (4 * 16 + 16) + (16 * 3 + 3)
+    s = net.summary()
+    assert "DenseLayer" in s and "Total params" in s
+
+
+def test_fit_iris_converges_and_evaluates():
+    it = IrisDataSetIterator(batch=150)
+    net = MultiLayerNetwork(iris_conf()).init()
+    scores = CollectScoresListener()
+    net.set_listeners(scores)
+    net.fit(it, epochs=150)
+    assert scores.scores[-1] < scores.scores[0] * 0.5
+    ev = net.evaluate(it)
+    assert ev.accuracy() > 0.9, ev.stats()
+
+
+def test_fit_arrays_api_and_score():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    w_true = rng.normal(size=(4, 2)).astype(np.float32)
+    y = x @ w_true
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1)
+            .updater(Sgd(learning_rate=0.1))
+            .list()
+            .layer(OutputLayer(n_out=2, activation=Activation.IDENTITY,
+                               loss_fn=LossMSE()))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    ds = DataSet(x, y)
+    s0 = net.score(ds)
+    net.fit(x, y, epochs=200)
+    assert net.score(ds) < s0 * 0.01
+
+
+def test_flat_params_roundtrip():
+    net = MultiLayerNetwork(iris_conf()).init()
+    flat = net.params_flat()
+    out_before = np.asarray(net.output(np.ones((1, 4), np.float32)))
+    flat2 = flat * 0.0
+    net.set_params_flat(flat2)
+    assert np.allclose(net.params_flat(), 0.0)
+    net.set_params_flat(flat)
+    out_after = np.asarray(net.output(np.ones((1, 4), np.float32)))
+    np.testing.assert_allclose(out_before, out_after, rtol=1e-6)
+
+
+def test_model_serializer_roundtrip(tmp_path):
+    it = IrisDataSetIterator(batch=150)
+    net = MultiLayerNetwork(iris_conf()).init()
+    net.fit(it, epochs=5)
+    path = tmp_path / "model.zip"
+    serializer.write_model(net, path)
+    net2 = serializer.restore_multi_layer_network(path)
+    assert net2.conf == net.conf
+    assert net2.iteration == net.iteration
+    x = np.ones((2, 4), np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(net2.output(x)), rtol=1e-6)
+    # exact resume: continue training both, scores match
+    ds = next(iter(it))
+    s1 = net.fit_batch(ds)
+    s2 = net2.fit_batch(ds)
+    assert np.isclose(s1, s2, rtol=1e-5)
+
+
+def test_cnn_pipeline_with_preprocessor_and_bn():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .updater(Adam(learning_rate=0.01))
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                    convolution_mode=ConvolutionMode.SAME,
+                                    activation=Activation.RELU))
+            .layer(BatchNormalization())
+            .layer(SubsamplingLayer(pooling_type=PoolingType.MAX))
+            .layer(DenseLayer(n_out=8, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build())
+    # preprocessor auto-inserted between pool and dense
+    names = [type(l).__name__ for l in conf.layers]
+    assert "CnnToFeedForwardPreProcessor" in names
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).normal(size=(4, 8, 8, 1)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]
+    net.fit(x, y, epochs=3)
+    out = np.asarray(net.output(x))
+    assert out.shape == (4, 2)
+    np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)  # softmax
+
+
+def test_listeners_fire():
+    import io
+
+    buf = io.StringIO()
+    it = IrisDataSetIterator(batch=150)
+    net = MultiLayerNetwork(iris_conf()).init()
+    net.set_listeners(ScoreIterationListener(1, stream=buf))
+    net.fit(it, epochs=2)
+    assert "Score at iteration" in buf.getvalue()
+
+
+def test_per_layer_updater_override():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3)
+            .updater(Sgd(learning_rate=0.0))  # global: frozen
+            .list()
+            .layer(DenseLayer(n_out=4, updater=Sgd(learning_rate=0.5)))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.feed_forward(3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    # locate dense and output layer indices in built conf
+    w0_before = np.asarray(net.params["0"]["W"]).copy()
+    w_out_key = str(len(conf.layers) - 1)
+    w1_before = np.asarray(net.params[w_out_key]["W"]).copy()
+    x = np.random.default_rng(0).normal(size=(8, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[[0, 1] * 4]
+    net.fit(x, y, epochs=1)
+    assert not np.allclose(np.asarray(net.params["0"]["W"]), w0_before)
+    np.testing.assert_allclose(np.asarray(net.params[w_out_key]["W"]),
+                               w1_before)  # global lr=0 -> unchanged
+
+
+def test_builder_does_not_mutate_caller_layers():
+    shared = SubsamplingLayer()
+    dense = DenseLayer(n_out=4)
+    for _ in range(2):
+        (NeuralNetConfiguration.builder().list()
+         .layer(ConvolutionLayer(n_out=2, kernel_size=(3, 3),
+                                 convolution_mode=ConvolutionMode.SAME))
+         .layer(shared)
+         .layer(dense)
+         .layer(OutputLayer(n_out=2))
+         .set_input_type(InputType.convolutional(8, 8, 1))
+         .build())
+    assert shared.name is None and dense.name is None
+
+
+def test_score_uses_eval_mode_batchnorm():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(5)
+            .list()
+            .layer(BatchNormalization())
+            .layer(OutputLayer(n_out=2, loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(1).normal(7.0, 0.1, (4, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]
+    # untrained running stats are mean=0/var=1; eval-mode score must differ
+    # hugely from a train-mode (batch-normalized) score on shifted data
+    s_eval = net.score(DataSet(x, y))
+    grads, s_train_mode = net.compute_gradient_and_score(DataSet(x, y))
+    assert abs(s_eval - s_train_mode) > 0.1

@@ -127,3 +127,27 @@ def test_updates_finite_and_descend(updater):
         p = p - upd
         assert np.all(np.isfinite(np.asarray(p)))
     assert float(jnp.sum((p - target) ** 2)) < loss0 * 0.5
+
+
+def test_adamw_decays_weights():
+    from deeplearning4j_tpu.conf.updaters import AdamW
+
+    w = jnp.asarray([10.0])
+    g = jnp.asarray([0.0])  # zero gradient: only decay acts
+    u = AdamW(weight_decay=0.1)
+    upd, _ = u.update_leaf(g, u.init_state(w), 0.5, 0.0, param=w)
+    np.testing.assert_allclose(np.asarray(upd), [0.5], rtol=1e-6)  # wd*lr*w
+
+
+def test_nesterovs_epoch_momentum_schedule():
+    from deeplearning4j_tpu.conf.schedules import MapSchedule, ScheduleType
+
+    nes = Nesterovs(momentum=0.9, momentum_schedule=MapSchedule(
+        ScheduleType.EPOCH, {0: 0.0, 5: 0.9}))
+    g = jnp.asarray([1.0])
+    # epoch 0: mu=0 -> plain sgd
+    upd, _ = nes.update_leaf(g, nes.init_state(jnp.zeros(1)), 0.1, 0.0, epoch=0.0)
+    np.testing.assert_allclose(np.asarray(upd), [0.1], rtol=1e-6)
+    # epoch 5: mu=0.9 -> first-step update (1+mu)*lr*g
+    upd2, _ = nes.update_leaf(g, nes.init_state(jnp.zeros(1)), 0.1, 0.0, epoch=5.0)
+    np.testing.assert_allclose(np.asarray(upd2), [0.19], rtol=1e-5)
