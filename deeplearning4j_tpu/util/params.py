@@ -36,16 +36,20 @@ def flatten_params(conf, params: Dict[str, dict]) -> np.ndarray:
     return np.concatenate(chunks)
 
 
-def unflatten_params(conf, flat: np.ndarray, like: Dict[str, dict]) -> Dict[str, dict]:
-    """1-D vector -> params pytree with shapes/dtypes taken from ``like``."""
-    flat = np.asarray(flat)
+def unflatten_params(conf, flat, like: Dict[str, dict]) -> Dict[str, dict]:
+    """1-D vector -> params pytree with shapes/dtypes taken from ``like``.
+    jit-traceable (used inside the gradient-check loss-of-flat-vector fn)."""
+    flat = jnp.asarray(flat)
+    if flat.ndim != 1:
+        raise ValueError(
+            f"flat params vector must be 1-D, got shape {flat.shape}")
     expected = sum(
         int(np.prod(like[k][name].shape))
         for k in layer_keys(like)
         for name in conf.layers[int(k)].param_order() if name in like[k])
-    if flat.size != expected:
+    if flat.shape[0] != expected:
         raise ValueError(
-            f"flat params vector has {flat.size} values but the model "
+            f"flat params vector has {flat.shape[0]} values but the model "
             f"expects {expected} (reference: setParams length check)")
     out: Dict[str, dict] = {}
     pos = 0
@@ -56,11 +60,9 @@ def unflatten_params(conf, flat: np.ndarray, like: Dict[str, dict]) -> Dict[str,
             if name in like[k]:
                 ref = like[k][name]
                 n = int(np.prod(ref.shape)) if ref.ndim else 1
-                out[k][name] = jnp.asarray(
-                    flat[pos:pos + n].reshape(ref.shape), dtype=ref.dtype)
+                out[k][name] = (
+                    flat[pos:pos + n].reshape(ref.shape).astype(ref.dtype))
                 pos += n
-    if pos != flat.size:
-        raise ValueError(f"flat vector length {flat.size} != params size {pos}")
     return out
 
 

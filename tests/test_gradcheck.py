@@ -1,0 +1,156 @@
+"""Gradient checks — per-layer matrix in f64 (reference:
+``org.deeplearning4j.gradientcheck.*`` test suites, the main correctness
+oracle per SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.conf import Activation, InputType, WeightInit
+from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.conf.layers_cnn import (
+    BatchNormalization,
+    ConvolutionLayer,
+    ConvolutionMode,
+    Deconvolution2D,
+    GlobalPoolingLayer,
+    LocalResponseNormalization,
+    PoolingType,
+    SeparableConvolution2D,
+    SubsamplingLayer,
+    Upsampling2D,
+)
+from deeplearning4j_tpu.conf.losses import (
+    LossBinaryXENT,
+    LossHinge,
+    LossMAE,
+    LossMCXENT,
+    LossMSE,
+)
+from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+from deeplearning4j_tpu.conf.regularization import (
+    L1Regularization,
+    L2Regularization,
+)
+from deeplearning4j_tpu.conf.updaters import NoOp
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.util.gradcheck import (
+    check_layer_input_gradient,
+    gradient_check,
+)
+
+RNG = np.random.default_rng(42)
+
+
+def dense_conf(activation, loss, out_act, n_in=4, n_hidden=5, n_out=3,
+               regularization=()):
+    return (NeuralNetConfiguration.builder()
+            .seed(12345)
+            .updater(NoOp())
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(DenseLayer(n_out=n_hidden, activation=activation,
+                              regularization=tuple(regularization)))
+            .layer(OutputLayer(n_out=n_out, activation=out_act, loss_fn=loss))
+            .set_input_type(InputType.feed_forward(n_in))
+            .build())
+
+
+def random_ds(n_in=4, n_out=3, batch=6, onehot=True):
+    x = RNG.normal(size=(batch, n_in)).astype(np.float64)
+    if onehot:
+        y = np.eye(n_out)[RNG.integers(0, n_out, batch)]
+    else:
+        y = RNG.normal(size=(batch, n_out))
+    return DataSet(x, y)
+
+
+@pytest.mark.parametrize("act", [
+    Activation.TANH, Activation.RELU, Activation.SIGMOID, Activation.ELU,
+    Activation.SOFTPLUS, Activation.GELU, Activation.SWISH, Activation.CUBE,
+    Activation.HARDSIGMOID, Activation.LEAKYRELU,
+])
+def test_dense_mcxent_gradients(act):
+    conf = dense_conf(act, LossMCXENT(), Activation.SOFTMAX)
+    res = gradient_check(conf, random_ds())
+    assert res.passed, f"{act}: {res.n_failed}/{res.n_checked} failed, " \
+                       f"max_rel={res.max_rel_error:.2e}, {res.failures[:3]}"
+
+
+@pytest.mark.parametrize("loss,out_act,onehot", [
+    (LossMSE(), Activation.IDENTITY, False),
+    (LossMAE(), Activation.IDENTITY, False),
+    (LossMCXENT(), Activation.SOFTMAX, True),
+    (LossBinaryXENT(), Activation.SIGMOID, True),
+    (LossHinge(), Activation.TANH, False),
+])
+def test_loss_gradients(loss, out_act, onehot):
+    conf = dense_conf(Activation.TANH, loss, out_act)
+    res = gradient_check(conf, random_ds(onehot=onehot))
+    assert res.passed, f"{loss}: max_rel={res.max_rel_error:.2e} " \
+                       f"{res.failures[:3]}"
+
+
+def test_regularized_gradients():
+    conf = dense_conf(Activation.TANH, LossMCXENT(), Activation.SOFTMAX,
+                      regularization=[L2Regularization(l2=0.01),
+                                      L1Regularization(l1=0.005)])
+    # L1/L2 affect updater-side gradient, and score_term adds to the loss:
+    # the loss gradient check covers the score_term path
+    res = gradient_check(conf, random_ds())
+    assert res.passed, res.failures[:3]
+
+
+def test_cnn_gradients():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12345)
+            .updater(NoOp())
+            .list()
+            .layer(ConvolutionLayer(n_out=3, kernel_size=(2, 2),
+                                    activation=Activation.TANH,
+                                    convolution_mode=ConvolutionMode.SAME))
+            .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                    kernel_size=(2, 2), stride=(2, 2)))
+            .layer(OutputLayer(n_out=2, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.convolutional(4, 4, 2))
+            .build())
+    x = RNG.normal(size=(3, 4, 4, 2))
+    y = np.eye(2)[RNG.integers(0, 2, 3)]
+    res = gradient_check(conf, DataSet(x, y))
+    assert res.passed, f"max_rel={res.max_rel_error:.2e} {res.failures[:3]}"
+
+
+def test_batchnorm_gradients():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12345)
+            .updater(NoOp())
+            .list()
+            .layer(DenseLayer(n_out=5, activation=Activation.IDENTITY))
+            .layer(BatchNormalization())
+            .layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    res = gradient_check(conf, random_ds())
+    assert res.passed, f"max_rel={res.max_rel_error:.2e} {res.failures[:3]}"
+
+
+@pytest.mark.parametrize("layer,shape", [
+    (SubsamplingLayer(pooling_type=PoolingType.AVG, kernel_size=(2, 2),
+                      stride=(2, 2)), (2, 4, 4, 3)),
+    (SubsamplingLayer(pooling_type=PoolingType.PNORM, kernel_size=(2, 2),
+                      stride=(2, 2)), (2, 4, 4, 3)),
+    (GlobalPoolingLayer(pooling_type=PoolingType.AVG), (2, 4, 4, 3)),
+    (Upsampling2D(size=(2, 2)), (2, 3, 3, 2)),
+    (LocalResponseNormalization(), (2, 3, 3, 4)),
+    (SeparableConvolution2D(n_out=3, kernel_size=(2, 2),
+                            convolution_mode=ConvolutionMode.SAME),
+     (2, 4, 4, 2)),
+    (Deconvolution2D(n_out=2, kernel_size=(2, 2), stride=(2, 2),
+                     convolution_mode=ConvolutionMode.SAME), (2, 3, 3, 2)),
+])
+def test_layer_input_gradients(layer, shape):
+    t = InputType.convolutional(shape[1], shape[2], shape[3])
+    x = RNG.normal(size=shape)
+    res = check_layer_input_gradient(layer, t, x)
+    assert res.passed, f"max_rel={res.max_rel_error:.2e} {res.failures[:3]}"
