@@ -516,3 +516,29 @@ class SpaceToDepthLayer(Layer):
         y = x.reshape(b, h // bs, bs, w // bs, bs, c)
         y = y.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // bs, w // bs, bs * bs * c)
         return y, state
+
+
+@serde.register
+@dataclasses.dataclass
+class CnnLossLayer(Layer):
+    """Reference ``CnnLossLayer``: per-position loss over NHWC activation
+    maps (used by UNet/segmentation heads) — no params; activation + loss
+    applied elementwise over [b, h, w, c]."""
+
+    activation: Activation = Activation.IDENTITY
+    loss_fn: "object" = None
+
+    def __post_init__(self):
+        if self.loss_fn is None:
+            from deeplearning4j_tpu.conf.losses import LossMCXENT
+
+            self.loss_fn = LossMCXENT()
+
+    def forward(self, params, state, x, train=False, rng=None):
+        return self.activation.apply(x), state
+
+    def score(self, params, x, labels, mask=None):
+        return self.loss_fn.score(labels, x, self.activation, mask)
+
+    def regularized_param_keys(self):
+        return []

@@ -140,6 +140,12 @@ class Builder:
     def list(self) -> "ListBuilder":
         return ListBuilder(self)
 
+    def graph_builder(self):
+        """Reference ``NeuralNetConfiguration.Builder#graphBuilder``."""
+        from deeplearning4j_tpu.conf.graph import GraphBuilder
+
+        return GraphBuilder(self)
+
 
 class ListBuilder:
     """Reference ``NeuralNetConfiguration.ListBuilder``."""
@@ -185,16 +191,20 @@ class ListBuilder:
         )
 
     def _apply_defaults(self, layer: Layer) -> Layer:
+        return ListBuilder._apply_defaults_static(self._base, layer)
+
+    @staticmethod
+    def _apply_defaults_static(b: Builder, layer: Layer) -> Layer:
         """Fill builder-level defaults into layer fields still at their
         dataclass defaults (reference: global conf inherited unless the layer
         overrides). Always returns a copy so build() never mutates the
-        caller's layer objects (name assignment happens on the copies)."""
+        caller's layer objects (name assignment happens on the copies).
+        Shared with the ComputationGraph ``GraphBuilder``."""
         if not isinstance(layer, BaseLayer):
             return dataclasses.replace(layer)
         layer = dataclasses.replace(layer)
         cls_defaults = {f.name: f.default for f in dataclasses.fields(layer)
                         if f.default is not dataclasses.MISSING}
-        b = self._base
         if b._weight_init is not None and layer.weight_init == cls_defaults.get(
                 "weight_init"):
             layer.weight_init = b._weight_init

@@ -1,0 +1,350 @@
+"""ComputationGraph — DAG model runtime.
+
+Reference: ``org.deeplearning4j.nn.graph.ComputationGraph`` (~5k LoC):
+multi-input/multi-output DAG of GraphVertex, cached topological order,
+``fit``/``output``/``score``/``evaluate``, flattened params.
+
+TPU-native inversion (SURVEY.md §3.2): the reference's hot loop — walk the
+topo order calling ``GraphVertex#doForward`` then reverse for ``doBackward``,
+each vertex issuing per-op JNI calls — becomes ONE jitted XLA program; the
+topo walk happens once at trace time and XLA fuses across vertex boundaries.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.conf.graph import ComputationGraphConfiguration
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.optimize import solver
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+from deeplearning4j_tpu.util import params as params_util
+
+
+def _as_multi(ds) -> MultiDataSet:
+    """DataSet -> single-input/single-output MultiDataSet (reference
+    ``ComputationGraph#fit(DataSet)`` convenience overload)."""
+    if isinstance(ds, MultiDataSet):
+        return ds
+    return MultiDataSet(
+        features=[ds.features], labels=[ds.labels],
+        features_masks=[ds.features_mask] if ds.features_mask is not None else None,
+        labels_masks=[ds.labels_mask] if ds.labels_mask is not None else None)
+
+
+class ComputationGraph:
+    """DAG network (reference ``ComputationGraph``)."""
+
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.params: Optional[Dict[str, dict]] = None
+        self.state: Dict[str, dict] = {}
+        self.opt_state: Dict[str, dict] = {}
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners: List[TrainingListener] = []
+        self.score_value: float = float("nan")
+        self._train_step = None
+        self._output_fn = None
+        self._score_fn = None
+        self._dtype = jnp.dtype(conf.dtype)
+        self._base_key = jax.random.PRNGKey(conf.seed)
+        self._topo = conf.topo_order()
+        self._vmap = conf.vertex_map()
+
+    # --- lifecycle ---------------------------------------------------------
+    def init(self) -> "ComputationGraph":
+        key = self._base_key
+        types = self.conf.vertex_output_types()
+        self.params, self.state, self.opt_state = {}, {}, {}
+        for i, name in enumerate(self._topo):
+            spec = self._vmap[name]
+            in_types = [self._input_type_of(src, types) for src in spec.inputs]
+            p = spec.vertex.init(jax.random.fold_in(key, i), in_types,
+                                 self._dtype)
+            if p:
+                self.params[name] = p
+            s = spec.vertex.init_state(in_types, self._dtype)
+            if s:
+                self.state[name] = s
+        for k, vp in self.params.items():
+            upd = self._updater_for(k)
+            self.opt_state[k] = {pk: upd.init_state(pv) for pk, pv in vp.items()}
+        return self
+
+    def _input_type_of(self, src: str, types: Dict[str, object]):
+        return types[src]
+
+    def set_listeners(self, *listeners: TrainingListener):
+        self.listeners = list(listeners)
+        return self
+
+    def _updater_for(self, name: str):
+        v = self._vmap[name].vertex
+        layer = getattr(v, "layer", None)
+        return (getattr(layer, "updater", None) if layer is not None else None) \
+            or self.conf.updater
+
+    # --- functional core ---------------------------------------------------
+    def _forward(self, params, state, inputs: Sequence, train: bool, rng,
+                 skip=frozenset()):
+        """Pure DAG forward. ``inputs`` aligned with conf.network_inputs.
+        Returns (activations dict incl. every vertex, new_state). ``skip``:
+        vertex names left unevaluated (the loss path skips output vertices —
+        their fused activation+loss is computed by score())."""
+        acts: Dict[str, object] = dict(zip(self.conf.network_inputs, inputs))
+        new_state = {}
+        for i, name in enumerate(self._topo):
+            if name in skip:
+                continue
+            spec = self._vmap[name]
+            xs = [acts[src] for src in spec.inputs]
+            p = params.get(name, {})
+            s = state.get(name, {})
+            vrng = jax.random.fold_in(rng, i) if rng is not None else None
+            y, s2 = spec.vertex.forward(p, s, xs, train=train, rng=vrng)
+            acts[name] = y
+            if name in state:
+                new_state[name] = s2
+        return acts, new_state
+
+    def _output_specs(self):
+        specs = self.conf.output_vertices()
+        for s in specs:
+            if not (hasattr(s.vertex, "score") and getattr(s.vertex, "is_output",
+                                                           lambda: False)()):
+                raise TypeError(
+                    f"output vertex {s.name!r} is not an output layer "
+                    "(reference: outputs must be IOutputLayer vertices)")
+        return specs
+
+    def _loss(self, params, state, features: Sequence, labels: Sequence,
+              lmasks: Sequence, rng, train=True):
+        out_specs = self._output_specs()
+        acts, new_state = self._forward(params, state, features, train, rng,
+                                        skip={s.name for s in out_specs})
+        loss = 0.0
+        for i, spec in enumerate(out_specs):
+            x = acts[spec.inputs[0]]
+            loss = loss + spec.vertex.score(params.get(spec.name, {}), x,
+                                            labels[i], lmasks[i])
+        loss = loss + self._regularization_score(params)
+        return loss, new_state
+
+    def _regularization_score(self, params):
+        total = 0.0
+        for name, vparams in params.items():
+            v = self._vmap[name].vertex
+            conf = getattr(v, "layer", None) or v
+            reg_keys = set(v.regularized_param_keys())
+            for k, p in vparams.items():
+                regs = (getattr(conf, "regularization", ()) if k in reg_keys
+                        else getattr(conf, "regularization_bias", ()))
+                for r in regs or ():
+                    total = total + r.score_term(p)
+        return total
+
+    def train_step_fn(self):
+        """Raw (unjitted) pure train step for parallel wrappers (stage-7)."""
+
+        def step(params, state, opt_state, features, labels, lmasks, it, ep,
+                 rng):
+            def loss_fn(p):
+                return self._loss(p, state, features, labels, lmasks, rng)
+
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_opt = {}, {}
+            for k in params:
+                v = self._vmap[k].vertex
+                layer_conf = getattr(v, "layer", None) or v
+                upd = self._updater_for(k)
+                lr = upd.current_lr(it, ep)
+                g = solver.normalize_layer_gradients(layer_conf, grads[k])
+                new_params[k], new_opt[k] = solver.apply_updater_to_layer(
+                    layer_conf, upd, params[k], g, opt_state[k], lr, it, ep)
+            return new_params, new_state, new_opt, loss
+
+        return step
+
+    # --- training ----------------------------------------------------------
+    def fit(self, data, labels=None, epochs: int = 1):
+        """Train (reference ``ComputationGraph#fit`` overloads:
+        MultiDataSetIterator / DataSetIterator / (MultiData)Set /
+        (features, labels) arrays)."""
+        if self.params is None:
+            self.init()
+        if isinstance(data, (DataSet, MultiDataSet)):
+            batches = [data]
+            reset = lambda: None  # noqa: E731
+        elif isinstance(data, DataSetIterator) or hasattr(data, "reset"):
+            batches = data
+            reset = data.reset
+        elif labels is not None:
+            f = data if isinstance(data, (list, tuple)) else [data]
+            l = labels if isinstance(labels, (list, tuple)) else [labels]
+            batches = [MultiDataSet(features=list(f), labels=list(l))]
+            reset = lambda: None  # noqa: E731
+        else:
+            raise TypeError(f"cannot fit from {type(data)}")
+        for _ in range(epochs):
+            for lst in self.listeners:
+                lst.on_epoch_start(self, self.epoch)
+            for ds in batches:
+                self.fit_batch(ds)
+            reset()
+            for lst in self.listeners:
+                lst.on_epoch_end(self, self.epoch)
+            self.epoch += 1
+        return self
+
+    def _prep_batch(self, ds):
+        mds = _as_multi(ds)
+        features = tuple(jnp.asarray(np.asarray(f), self._dtype)
+                         for f in mds.features)
+        labels = tuple(jnp.asarray(np.asarray(l), self._dtype)
+                       for l in mds.labels)
+        n_out = len(labels)
+        if mds.labels_masks is not None:
+            lmasks = tuple(
+                jnp.asarray(np.asarray(m), self._dtype) if m is not None
+                else jnp.ones((labels[i].shape[0],), self._dtype)
+                for i, m in enumerate(mds.labels_masks))
+        else:
+            lmasks = tuple(jnp.ones((labels[i].shape[0],), self._dtype)
+                           for i in range(n_out))
+        return features, labels, lmasks
+
+    def fit_batch(self, ds) -> float:
+        if self.params is None:
+            self.init()
+        if self._train_step is None:
+            self._train_step = jax.jit(self.train_step_fn(),
+                                       donate_argnums=(0, 1, 2))
+        features, labels, lmasks = self._prep_batch(ds)
+        rng = jax.random.fold_in(self._base_key, self.iteration + 1_000_003)
+        it = jnp.asarray(float(self.iteration), jnp.float32)
+        ep = jnp.asarray(float(self.epoch), jnp.float32)
+        self.params, self.state, self.opt_state, loss = self._train_step(
+            self.params, self.state, self.opt_state, features, labels, lmasks,
+            it, ep, rng)
+        self.score_value = float(loss)
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, self.epoch,
+                               self.score_value)
+        self.iteration += 1
+        return self.score_value
+
+    # --- inference / scoring ----------------------------------------------
+    def output(self, *inputs):
+        """Forward pass, eval mode (reference ``#output(INDArray...)``).
+        Returns a list aligned with conf.network_outputs (single array if
+        one output)."""
+        if self.params is None:
+            self.init()
+        if self._output_fn is None:
+            def out(params, state, xs):
+                acts, _ = self._forward(params, state, xs, train=False,
+                                        rng=None)
+                return tuple(acts[n] for n in self.conf.network_outputs)
+
+            self._output_fn = jax.jit(out)
+        xs = tuple(jnp.asarray(np.asarray(x), self._dtype) for x in inputs)
+        outs = self._output_fn(self.params, self.state, xs)
+        return outs[0] if len(outs) == 1 else list(outs)
+
+    def score(self, ds=None) -> float:
+        if ds is None:
+            return self.score_value
+        if self.params is None:
+            self.init()
+        if self._score_fn is None:
+            def score(params, state, features, labels, lmasks):
+                loss, _ = self._loss(params, state, features, labels, lmasks,
+                                     rng=None, train=False)
+                return loss
+
+            self._score_fn = jax.jit(score)
+        features, labels, lmasks = self._prep_batch(ds)
+        return float(self._score_fn(self.params, self.state, features, labels,
+                                    lmasks))
+
+    def evaluate(self, iterator, evaluation: Optional[Evaluation] = None):
+        """Reference ``#evaluate(DataSetIterator)`` — first output vertex."""
+        ev = evaluation if evaluation is not None else Evaluation()
+        for ds in iterator:
+            mds = _as_multi(ds)
+            out = self.output(*mds.features)
+            if isinstance(out, list):
+                out = out[0]
+            mask = (mds.labels_masks[0]
+                    if mds.labels_masks is not None else None)
+            ev.eval(mds.labels[0], np.asarray(out), mask=mask)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        return ev
+
+    def compute_gradient_and_score(self, ds):
+        """(grads pytree, score) without updating (reference
+        ``#computeGradientAndScore``)."""
+        if self.params is None:
+            self.init()
+        features, labels, lmasks = self._prep_batch(ds)
+
+        def loss_fn(p):
+            return self._loss(p, self.state, features, labels, lmasks,
+                              rng=None)
+
+        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            self.params)
+        return grads, float(loss)
+
+    # --- params vector (serializer parity) ---------------------------------
+    def params_flat(self) -> np.ndarray:
+        return params_util.flatten_params(self.conf, self.params)
+
+    def set_params_flat(self, flat: np.ndarray):
+        self.params = params_util.unflatten_params(self.conf, flat,
+                                                   self.params)
+        return self
+
+    def num_params(self) -> int:
+        return int(self.params_flat().size)
+
+    def clone(self) -> "ComputationGraph":
+        other = ComputationGraph(self.conf)
+        if self.params is not None:
+            other.init()
+            # true copies: the train step donates its input buffers, so
+            # shared references would be invalidated by the next fit
+            other.params = jax.tree_util.tree_map(jnp.copy, self.params)
+            other.state = jax.tree_util.tree_map(jnp.copy, self.state)
+            other.opt_state = jax.tree_util.tree_map(jnp.copy, self.opt_state)
+        return other
+
+    def summary(self) -> str:
+        types = self.conf.vertex_output_types()
+        lines = ["=" * 78,
+                 f"{'vertex':<24} {'type':<24} {'inputs':<18} {'params':>9}",
+                 "-" * 78]
+        total = 0
+        for name in self._topo:
+            spec = self._vmap[name]
+            n = 0
+            if self.params and name in self.params:
+                n = sum(int(np.prod(p.shape))
+                        for p in self.params[name].values())
+            total += n
+            vname = type(spec.vertex).__name__
+            if hasattr(spec.vertex, "layer") and spec.vertex.layer is not None:
+                vname = type(spec.vertex.layer).__name__
+            lines.append(f"{name:<24} {vname:<24} "
+                         f"{','.join(spec.inputs):<18} {n:>9,}")
+        lines += ["-" * 78, f"Total params: {total:,}", "=" * 78]
+        return "\n".join(lines)

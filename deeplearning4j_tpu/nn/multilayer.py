@@ -226,6 +226,8 @@ class MultiLayerNetwork:
         last training score when called with no args."""
         if ds is None:
             return self.score_value
+        if self.params is None:
+            self.init()
         if self._score_fn is None:
             self._score_fn = self._build_score_fn()
         features = jnp.asarray(np.asarray(ds.features), self._dtype)
@@ -282,9 +284,11 @@ class MultiLayerNetwork:
         other = MultiLayerNetwork(self.conf)
         if self.params is not None:
             other.init()
-            other.params = jax.tree_util.tree_map(lambda a: a, self.params)
-            other.state = jax.tree_util.tree_map(lambda a: a, self.state)
-            other.opt_state = jax.tree_util.tree_map(lambda a: a, self.opt_state)
+            # true copies: the train step donates its input buffers, so
+            # shared references would be invalidated by the next fit
+            other.params = jax.tree_util.tree_map(jnp.copy, self.params)
+            other.state = jax.tree_util.tree_map(jnp.copy, self.state)
+            other.opt_state = jax.tree_util.tree_map(jnp.copy, self.opt_state)
         return other
 
     def summary(self) -> str:

@@ -67,6 +67,40 @@ def _central_diff_check(f_jit, flat0: np.ndarray, analytic: np.ndarray,
     )
 
 
+def _check_net_params_gradient(conf64, net, loss_args, epsilon,
+                               max_rel_error, abs_error_threshold, n_samples,
+                               seed) -> GradCheckResult:
+    """Shared scaffolding for the MultiLayerNetwork / ComputationGraph
+    checks: flatten params, jit loss-of-flat-vector, analytic ``jax.grad``,
+    optional parameter subsampling, central-difference compare."""
+    import jax
+    import jax.numpy as jnp
+
+    like = net.params
+
+    def loss_from_flat(flat):
+        p = params_util.unflatten_params(conf64, flat, like)
+        loss, _ = net._loss(p, net.state, *loss_args, rng=None, train=True)
+        return loss
+
+    flat0 = np.asarray(params_util.flatten_params(conf64, net.params))
+    loss_jit = jax.jit(loss_from_flat)
+    analytic = np.asarray(
+        jax.jit(jax.grad(loss_from_flat))(jnp.asarray(flat0)))
+
+    n = flat0.size
+    if n_samples is not None and n_samples < n:
+        rng = np.random.default_rng(seed)
+        idx = np.sort(rng.choice(n, size=n_samples, replace=False))
+    else:
+        idx = np.arange(n)
+
+    return _central_diff_check(loss_jit, flat0, analytic, idx,
+                               reshape=lambda v: v, epsilon=epsilon,
+                               max_rel_error=max_rel_error,
+                               abs_error_threshold=abs_error_threshold)
+
+
 def gradient_check(conf, ds, epsilon: float = 1e-6,
                    max_rel_error: float = 1e-5,
                    abs_error_threshold: float = 1e-9,
@@ -80,12 +114,12 @@ def gradient_check(conf, ds, epsilon: float = 1e-6,
     import jax
 
     with jax.enable_x64(True):
+        import jax.numpy as jnp
+
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
         conf64 = dataclasses.replace(conf, dtype="float64")
         net = MultiLayerNetwork(conf64).init()
-
-        import jax.numpy as jnp
 
         features = jnp.asarray(np.asarray(ds.features), jnp.float64)
         labels = jnp.asarray(np.asarray(ds.labels), jnp.float64)
@@ -93,30 +127,9 @@ def gradient_check(conf, ds, epsilon: float = 1e-6,
                  if ds.labels_mask is not None
                  else jnp.ones((features.shape[0],), jnp.float64))
 
-        like = net.params
-
-        def loss_from_flat(flat):
-            p = params_util.unflatten_params(conf64, flat, like)
-            loss, _ = net._loss(p, net.state, features, labels, lmask,
-                                rng=None, train=True)
-            return loss
-
-        flat0 = np.asarray(params_util.flatten_params(conf64, net.params))
-        loss_jit = jax.jit(loss_from_flat)
-        analytic = np.asarray(
-            jax.jit(jax.grad(loss_from_flat))(jnp.asarray(flat0)))
-
-        n = flat0.size
-        if n_samples is not None and n_samples < n:
-            rng = np.random.default_rng(seed)
-            idx = np.sort(rng.choice(n, size=n_samples, replace=False))
-        else:
-            idx = np.arange(n)
-
-        return _central_diff_check(loss_jit, flat0, analytic, idx,
-                                   reshape=lambda v: v, epsilon=epsilon,
-                                   max_rel_error=max_rel_error,
-                                   abs_error_threshold=abs_error_threshold)
+        return _check_net_params_gradient(
+            conf64, net, (features, labels, lmask), epsilon, max_rel_error,
+            abs_error_threshold, n_samples, seed)
 
 
 def check_layer_input_gradient(layer, input_type, x, epsilon: float = 1e-6,
@@ -148,3 +161,39 @@ def check_layer_input_gradient(layer, input_type, x, epsilon: float = 1e-6,
             reshape=lambda v: v.reshape(x_np.shape), epsilon=epsilon,
             max_rel_error=max_rel_error,
             abs_error_threshold=abs_error_threshold)
+
+
+def gradient_check_graph(conf, mds, epsilon: float = 1e-6,
+                         max_rel_error: float = 1e-5,
+                         abs_error_threshold: float = 1e-9,
+                         n_samples: Optional[int] = None,
+                         seed: int = 0) -> GradCheckResult:
+    """Gradient check for a ComputationGraphConfiguration against central
+    differences (reference ``GradientCheckUtil#checkGradients(GraphConfig)``
+    overload; same f64 protocol as :func:`gradient_check`)."""
+    import jax
+
+    with jax.enable_x64(True):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.graph import ComputationGraph, _as_multi
+
+        conf64 = dataclasses.replace(conf, dtype="float64")
+        net = ComputationGraph(conf64).init()
+        mds = _as_multi(mds)
+        features = tuple(jnp.asarray(np.asarray(f), jnp.float64)
+                         for f in mds.features)
+        labels = tuple(jnp.asarray(np.asarray(l), jnp.float64)
+                       for l in mds.labels)
+        if mds.labels_masks is not None:
+            lmasks = tuple(
+                jnp.asarray(np.asarray(m), jnp.float64) if m is not None
+                else jnp.ones((labels[i].shape[0],), jnp.float64)
+                for i, m in enumerate(mds.labels_masks))
+        else:
+            lmasks = tuple(jnp.ones((l.shape[0],), jnp.float64)
+                           for l in labels)
+
+        return _check_net_params_gradient(
+            conf64, net, (features, labels, lmasks), epsilon, max_rel_error,
+            abs_error_threshold, n_samples, seed)

@@ -19,15 +19,36 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _key_sort(k: str):
+    """Deterministic order for layer/vertex keys: numeric keys (MultiLayer
+    layer indices) ascending first, then names lexicographically
+    (ComputationGraph vertices outside conf order never hit this branch)."""
+    return (0, int(k), "") if k.isdigit() else (1, 0, k)
+
+
 def layer_keys(params: Dict[str, dict]) -> List[str]:
-    return sorted(params.keys(), key=lambda k: int(k))
+    return sorted(params.keys(), key=_key_sort)
+
+
+def _conf_keys(conf, params: Dict[str, dict]) -> List[str]:
+    """Canonical flatten order: the conf's own key order when it provides one
+    (ComputationGraph topological order), else ascending layer index."""
+    if hasattr(conf, "ordered_param_keys"):
+        return [k for k in conf.ordered_param_keys() if k in params]
+    return layer_keys(params)
+
+
+def _conf_layer(conf, key: str):
+    if hasattr(conf, "layer_for_key"):
+        return conf.layer_for_key(key)
+    return conf.layers[int(key)]
 
 
 def flatten_params(conf, params: Dict[str, dict]) -> np.ndarray:
     """params pytree -> single 1-D numpy vector in the canonical order."""
     chunks = []
-    for k in layer_keys(params):
-        layer = conf.layers[int(k)]
+    for k in _conf_keys(conf, params):
+        layer = _conf_layer(conf, k)
         for name in layer.param_order():
             if name in params[k]:
                 chunks.append(np.asarray(params[k][name]).ravel())
@@ -45,16 +66,16 @@ def unflatten_params(conf, flat, like: Dict[str, dict]) -> Dict[str, dict]:
             f"flat params vector must be 1-D, got shape {flat.shape}")
     expected = sum(
         int(np.prod(like[k][name].shape))
-        for k in layer_keys(like)
-        for name in conf.layers[int(k)].param_order() if name in like[k])
+        for k in _conf_keys(conf, like)
+        for name in _conf_layer(conf, k).param_order() if name in like[k])
     if flat.shape[0] != expected:
         raise ValueError(
             f"flat params vector has {flat.shape[0]} values but the model "
             f"expects {expected} (reference: setParams length check)")
     out: Dict[str, dict] = {}
     pos = 0
-    for k in layer_keys(like):
-        layer = conf.layers[int(k)]
+    for k in _conf_keys(conf, like):
+        layer = _conf_layer(conf, k)
         out[k] = dict(like[k])
         for name in layer.param_order():
             if name in like[k]:
@@ -74,7 +95,7 @@ def flatten_state_like(nested) -> np.ndarray:
     """Flatten updater state {layer: {param: {statekey: arr}}} in canonical
     order (layers ascending, param insertion order, state keys sorted)."""
     chunks = []
-    for k in sorted(nested.keys(), key=lambda k: int(k)):
+    for k in sorted(nested.keys(), key=_key_sort):
         for pname in nested[k]:
             st = nested[k][pname]
             for sk in sorted(st.keys()):
@@ -88,7 +109,7 @@ def unflatten_state_like(flat: np.ndarray, like) -> dict:
     flat = np.asarray(flat)
     out = {}
     pos = 0
-    for k in sorted(like.keys(), key=lambda k: int(k)):
+    for k in sorted(like.keys(), key=_key_sort):
         out[k] = {}
         for pname in like[k]:
             out[k][pname] = {}

@@ -1,1 +1,9 @@
-from deeplearning4j_tpu.zoo.models import LeNet, SimpleCNN
+from deeplearning4j_tpu.zoo.graphs import (
+    VGG16,
+    VGG19,
+    Darknet19,
+    ResNet50,
+    SqueezeNet,
+    UNet,
+)
+from deeplearning4j_tpu.zoo.models import LeNet, SimpleCNN, ZooModel

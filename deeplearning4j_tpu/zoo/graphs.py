@@ -1,0 +1,321 @@
+"""Model zoo — ComputationGraph models.
+
+Reference: ``org.deeplearning4j.zoo.model.{VGG16,VGG19,ResNet50,SqueezeNet,
+Darknet19,UNet}`` — each ``init()`` builds a ComputationGraphConfiguration;
+topologies follow the reference's graph builders (conv/bn orderings, residual
+wiring via ``ElementWiseVertex(Add)``, fire-module concat via
+``MergeVertex``). Layouts are NHWC (TPU-native) instead of the reference's
+NCHW; shapes/channel counts match.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from deeplearning4j_tpu.conf import Activation, InputType, WeightInit
+from deeplearning4j_tpu.conf.graph import (
+    ComputationGraphConfiguration,
+    ElementWiseOp,
+    ElementWiseVertex,
+    MergeVertex,
+)
+from deeplearning4j_tpu.conf.layers import ActivationLayer, DenseLayer, OutputLayer
+from deeplearning4j_tpu.conf.layers_cnn import (
+    BatchNormalization,
+    CnnLossLayer,
+    ConvolutionLayer,
+    ConvolutionMode,
+    GlobalPoolingLayer,
+    PoolingType,
+    SubsamplingLayer,
+    Upsampling2D,
+)
+from deeplearning4j_tpu.conf.losses import LossBinaryXENT, LossMCXENT
+from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+from deeplearning4j_tpu.conf.updaters import Adam, IUpdater, Nesterovs
+from deeplearning4j_tpu.zoo.models import ZooModel
+
+
+def _conv(n_out, k, s=(1, 1), act=Activation.RELU, mode=ConvolutionMode.SAME,
+          bias=True):
+    return ConvolutionLayer(n_out=n_out, kernel_size=k, stride=s,
+                            activation=act, convolution_mode=mode,
+                            has_bias=bias)
+
+
+def _maxpool(k=(2, 2), s=(2, 2), mode=ConvolutionMode.TRUNCATE):
+    return SubsamplingLayer(pooling_type=PoolingType.MAX, kernel_size=k,
+                            stride=s, convolution_mode=mode)
+
+
+class GraphZooModel(ZooModel):
+    def init(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        return ComputationGraph(self.conf()).init()
+
+
+class VGG16(GraphZooModel):
+    """Reference ``org.deeplearning4j.zoo.model.VGG16``: 13 conv3x3 SAME +
+    5 maxpools + FC 4096/4096/classes."""
+
+    BLOCKS: Tuple[Tuple[int, int], ...] = (
+        (64, 2), (128, 2), (256, 3), (512, 3), (512, 3))
+
+    def __init__(self, num_classes: int = 1000, height: int = 224,
+                 width: int = 224, channels: int = 3, seed: int = 123,
+                 updater: IUpdater | None = None):
+        self.num_classes = num_classes
+        self.height, self.width, self.channels = height, width, channels
+        self.seed = seed
+        self.updater = updater or Nesterovs(learning_rate=0.01, momentum=0.9)
+
+    def conf(self) -> ComputationGraphConfiguration:
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed).updater(self.updater)
+             .weight_init(WeightInit.XAVIER)
+             .graph_builder()
+             .add_inputs("input")
+             .set_input_types(InputType.convolutional(
+                 self.height, self.width, self.channels)))
+        prev = "input"
+        for bi, (ch, reps) in enumerate(self.BLOCKS):
+            for ri in range(reps):
+                name = f"conv{bi + 1}_{ri + 1}"
+                g.add_layer(name, _conv(ch, (3, 3)), prev)
+                prev = name
+            g.add_layer(f"pool{bi + 1}", _maxpool(), prev)
+            prev = f"pool{bi + 1}"
+        g.add_layer("fc1", DenseLayer(n_out=4096, activation=Activation.RELU),
+                    prev)
+        g.add_layer("fc2", DenseLayer(n_out=4096, activation=Activation.RELU),
+                    "fc1")
+        g.add_layer("output", OutputLayer(n_out=self.num_classes,
+                                          activation=Activation.SOFTMAX,
+                                          loss_fn=LossMCXENT()), "fc2")
+        g.set_outputs("output")
+        return g.build()
+
+
+class VGG19(VGG16):
+    """Reference ``VGG19``: VGG16 with 4-deep conv blocks 3..5."""
+
+    BLOCKS = ((64, 2), (128, 2), (256, 4), (512, 4), (512, 4))
+
+
+class ResNet50(GraphZooModel):
+    """Reference ``org.deeplearning4j.zoo.model.ResNet50``: conv7x7/2 + BN +
+    maxpool3x3/2, 4 stages of bottleneck blocks [3,4,6,3] with channel
+    triples (64,64,256)x, residual add via ``ElementWiseVertex(Add)``,
+    global avg pool + softmax."""
+
+    def __init__(self, num_classes: int = 1000, height: int = 224,
+                 width: int = 224, channels: int = 3, seed: int = 123,
+                 updater: IUpdater | None = None):
+        self.num_classes = num_classes
+        self.height, self.width, self.channels = height, width, channels
+        self.seed = seed
+        self.updater = updater or Adam(learning_rate=1e-3)
+
+    def _conv_bn(self, g, name, n_out, k, s, inp, act=True):
+        g.add_layer(f"{name}_conv",
+                    _conv(n_out, k, s, Activation.IDENTITY, bias=False), inp)
+        g.add_layer(f"{name}_bn", BatchNormalization(
+            activation=Activation.RELU if act else Activation.IDENTITY),
+            f"{name}_conv")
+        return f"{name}_bn"
+
+    def _bottleneck(self, g, name, inp, filters, stride, project):
+        f1, f2, f3 = filters
+        x = self._conv_bn(g, f"{name}_a", f1, (1, 1), stride, inp)
+        x = self._conv_bn(g, f"{name}_b", f2, (3, 3), (1, 1), x)
+        x = self._conv_bn(g, f"{name}_c", f3, (1, 1), (1, 1), x, act=False)
+        if project:
+            sc = self._conv_bn(g, f"{name}_sc", f3, (1, 1), stride, inp,
+                               act=False)
+        else:
+            sc = inp
+        g.add_vertex(f"{name}_add", ElementWiseVertex(op=ElementWiseOp.ADD),
+                     x, sc)
+        g.add_layer(f"{name}_relu", ActivationLayer(activation=Activation.RELU),
+                    f"{name}_add")
+        return f"{name}_relu"
+
+    def conf(self) -> ComputationGraphConfiguration:
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed).updater(self.updater)
+             .weight_init(WeightInit.RELU)
+             .graph_builder()
+             .add_inputs("input")
+             .set_input_types(InputType.convolutional(
+                 self.height, self.width, self.channels)))
+        x = self._conv_bn(g, "stem", 64, (7, 7), (2, 2), "input")
+        g.add_layer("stem_pool", _maxpool((3, 3), (2, 2),
+                                          ConvolutionMode.SAME), x)
+        x = "stem_pool"
+        stages = ((64, 64, 256, 3), (128, 128, 512, 4),
+                  (256, 256, 1024, 6), (512, 512, 2048, 3))
+        for si, (f1, f2, f3, reps) in enumerate(stages):
+            for ri in range(reps):
+                stride = (1, 1) if (si == 0 or ri > 0) else (2, 2)
+                x = self._bottleneck(g, f"res{si + 2}{chr(97 + ri)}", x,
+                                     (f1, f2, f3), stride, project=(ri == 0))
+        g.add_layer("avgpool",
+                    GlobalPoolingLayer(pooling_type=PoolingType.AVG), x)
+        g.add_layer("output", OutputLayer(n_out=self.num_classes,
+                                          activation=Activation.SOFTMAX,
+                                          loss_fn=LossMCXENT()), "avgpool")
+        g.set_outputs("output")
+        return g.build()
+
+
+class SqueezeNet(GraphZooModel):
+    """Reference ``SqueezeNet`` (v1.1): conv3x3/2 + fire modules with
+    squeeze(1x1) -> expand(1x1 || 3x3) -> MergeVertex concat, conv1x1 head +
+    global avg pool."""
+
+    def __init__(self, num_classes: int = 1000, height: int = 224,
+                 width: int = 224, channels: int = 3, seed: int = 123,
+                 updater: IUpdater | None = None):
+        self.num_classes = num_classes
+        self.height, self.width, self.channels = height, width, channels
+        self.seed = seed
+        self.updater = updater or Adam(learning_rate=1e-3)
+
+    def _fire(self, g, name, inp, squeeze, expand):
+        g.add_layer(f"{name}_sq", _conv(squeeze, (1, 1)), inp)
+        g.add_layer(f"{name}_e1", _conv(expand, (1, 1)), f"{name}_sq")
+        g.add_layer(f"{name}_e3", _conv(expand, (3, 3)), f"{name}_sq")
+        g.add_vertex(f"{name}_cat", MergeVertex(), f"{name}_e1", f"{name}_e3")
+        return f"{name}_cat"
+
+    def conf(self) -> ComputationGraphConfiguration:
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed).updater(self.updater)
+             .weight_init(WeightInit.RELU)
+             .graph_builder()
+             .add_inputs("input")
+             .set_input_types(InputType.convolutional(
+                 self.height, self.width, self.channels)))
+        g.add_layer("conv1", _conv(64, (3, 3), (2, 2)), "input")
+        g.add_layer("pool1", _maxpool((3, 3), (2, 2)), "conv1")
+        x = self._fire(g, "fire2", "pool1", 16, 64)
+        x = self._fire(g, "fire3", x, 16, 64)
+        g.add_layer("pool3", _maxpool((3, 3), (2, 2)), x)
+        x = self._fire(g, "fire4", "pool3", 32, 128)
+        x = self._fire(g, "fire5", x, 32, 128)
+        g.add_layer("pool5", _maxpool((3, 3), (2, 2)), x)
+        x = self._fire(g, "fire6", "pool5", 48, 192)
+        x = self._fire(g, "fire7", x, 48, 192)
+        x = self._fire(g, "fire8", x, 64, 256)
+        x = self._fire(g, "fire9", x, 64, 256)
+        g.add_layer("conv10", _conv(self.num_classes, (1, 1)), x)
+        g.add_layer("avgpool",
+                    GlobalPoolingLayer(pooling_type=PoolingType.AVG), "conv10")
+        g.add_layer("output", OutputLayer(
+            n_out=self.num_classes, has_bias=False,
+            activation=Activation.SOFTMAX, loss_fn=LossMCXENT()), "avgpool")
+        g.set_outputs("output")
+        return g.build()
+
+
+class Darknet19(GraphZooModel):
+    """Reference ``Darknet19`` (YOLO9000 backbone): 19 convs (3x3/1x1
+    alternation) + BN + LeakyReLU, 5 maxpools, conv1x1 head + global
+    avg pool."""
+
+    def __init__(self, num_classes: int = 1000, height: int = 224,
+                 width: int = 224, channels: int = 3, seed: int = 123,
+                 updater: IUpdater | None = None):
+        self.num_classes = num_classes
+        self.height, self.width, self.channels = height, width, channels
+        self.seed = seed
+        self.updater = updater or Adam(learning_rate=1e-3)
+
+    def _conv_bn_leaky(self, g, i, n_out, k, inp):
+        name = f"conv{i}"
+        g.add_layer(name, _conv(n_out, k, (1, 1), Activation.IDENTITY,
+                                bias=False), inp)
+        g.add_layer(f"{name}_bn",
+                    BatchNormalization(activation=Activation.LEAKYRELU), name)
+        return f"{name}_bn"
+
+    def conf(self) -> ComputationGraphConfiguration:
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed).updater(self.updater)
+             .weight_init(WeightInit.RELU)
+             .graph_builder()
+             .add_inputs("input")
+             .set_input_types(InputType.convolutional(
+                 self.height, self.width, self.channels)))
+        spec = [(32, 3), "M", (64, 3), "M", (128, 3), (64, 1), (128, 3), "M",
+                (256, 3), (128, 1), (256, 3), "M",
+                (512, 3), (256, 1), (512, 3), (256, 1), (512, 3), "M",
+                (1024, 3), (512, 1), (1024, 3), (512, 1), (1024, 3)]
+        x, ci, pi = "input", 0, 0
+        for s in spec:
+            if s == "M":
+                pi += 1
+                g.add_layer(f"pool{pi}", _maxpool(), x)
+                x = f"pool{pi}"
+            else:
+                ci += 1
+                n_out, k = s
+                x = self._conv_bn_leaky(g, ci, n_out, (k, k), x)
+        g.add_layer("head", _conv(self.num_classes, (1, 1),
+                                  act=Activation.IDENTITY), x)
+        g.add_layer("avgpool",
+                    GlobalPoolingLayer(pooling_type=PoolingType.AVG), "head")
+        g.add_layer("output", OutputLayer(
+            n_out=self.num_classes, has_bias=False,
+            activation=Activation.SOFTMAX, loss_fn=LossMCXENT()), "avgpool")
+        g.set_outputs("output")
+        return g.build()
+
+
+class UNet(GraphZooModel):
+    """Reference ``UNet``: 4-down/4-up encoder-decoder, skip connections via
+    ``MergeVertex``, nearest-neighbour ``Upsampling2D`` + conv on the way up,
+    sigmoid ``CnnLossLayer`` head (binary segmentation)."""
+
+    def __init__(self, height: int = 128, width: int = 128, channels: int = 1,
+                 base: int = 64, seed: int = 123,
+                 updater: IUpdater | None = None):
+        self.height, self.width, self.channels = height, width, channels
+        self.base = base
+        self.seed = seed
+        self.updater = updater or Adam(learning_rate=1e-4)
+
+    def _double_conv(self, g, name, n_out, inp):
+        g.add_layer(f"{name}_1", _conv(n_out, (3, 3)), inp)
+        g.add_layer(f"{name}_2", _conv(n_out, (3, 3)), f"{name}_1")
+        return f"{name}_2"
+
+    def conf(self) -> ComputationGraphConfiguration:
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed).updater(self.updater)
+             .weight_init(WeightInit.RELU)
+             .graph_builder()
+             .add_inputs("input")
+             .set_input_types(InputType.convolutional(
+                 self.height, self.width, self.channels)))
+        b = self.base
+        skips = []
+        x = "input"
+        for i, ch in enumerate([b, b * 2, b * 4, b * 8]):
+            x = self._double_conv(g, f"down{i + 1}", ch, x)
+            skips.append(x)
+            g.add_layer(f"dpool{i + 1}", _maxpool(), x)
+            x = f"dpool{i + 1}"
+        x = self._double_conv(g, "bottom", b * 16, x)
+        for i, ch in enumerate([b * 8, b * 4, b * 2, b]):
+            g.add_layer(f"up{i + 1}_us", Upsampling2D(size=(2, 2)), x)
+            g.add_layer(f"up{i + 1}_conv", _conv(ch, (2, 2)), f"up{i + 1}_us")
+            g.add_vertex(f"up{i + 1}_cat", MergeVertex(),
+                         skips[3 - i], f"up{i + 1}_conv")
+            x = self._double_conv(g, f"up{i + 1}", ch, f"up{i + 1}_cat")
+        g.add_layer("head", _conv(1, (1, 1), act=Activation.IDENTITY), x)
+        g.add_layer("output", CnnLossLayer(activation=Activation.SIGMOID,
+                                           loss_fn=LossBinaryXENT()), "head")
+        g.set_outputs("output")
+        return g.build()

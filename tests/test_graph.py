@@ -1,0 +1,388 @@
+"""ComputationGraph: vertices, topo sort, shape inference, training,
+serialization, gradient checks (reference oracle: ComputationGraph tests +
+GradientCheckTestsComputationGraph, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.conf import Activation, InputType, WeightInit
+from deeplearning4j_tpu.conf.graph import (
+    ComputationGraphConfiguration,
+    ElementWiseOp,
+    ElementWiseVertex,
+    L2NormalizeVertex,
+    LayerVertex,
+    MergeVertex,
+    PreprocessorVertex,
+    ReshapeVertex,
+    ScaleVertex,
+    ShiftVertex,
+    StackVertex,
+    SubsetVertex,
+    UnstackVertex,
+    VertexSpec,
+)
+from deeplearning4j_tpu.conf.layers import ActivationLayer, DenseLayer, OutputLayer
+from deeplearning4j_tpu.conf.layers_cnn import (
+    BatchNormalization,
+    ConvolutionLayer,
+    ConvolutionMode,
+    PoolingType,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.conf.losses import LossMCXENT, LossMSE
+from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+from deeplearning4j_tpu.conf.updaters import Adam, Sgd
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.util import serializer
+from deeplearning4j_tpu.util.gradcheck import gradient_check_graph
+
+
+def simple_graph_conf(seed=12345, updater=None):
+    """input -> dense -> (dense_a, dense_b) -> add -> output (residual-ish)."""
+    return (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(updater or Adam(learning_rate=0.02))
+            .weight_init(WeightInit.XAVIER)
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(4))
+            .add_layer("h", DenseLayer(n_out=8, activation=Activation.TANH),
+                       "in")
+            .add_layer("a", DenseLayer(n_out=8, activation=Activation.RELU),
+                       "h")
+            .add_vertex("res", ElementWiseVertex(op=ElementWiseOp.ADD),
+                        "a", "h")
+            .add_layer("out", OutputLayer(n_out=3,
+                                          activation=Activation.SOFTMAX,
+                                          loss_fn=LossMCXENT()), "res")
+            .set_outputs("out")
+            .build())
+
+
+def iris_like(n=60, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.zeros((n, 3), np.float32)
+    cls = (x[:, 0] + 2 * x[:, 1] > 0).astype(int) + (x[:, 2] > 0.5)
+    y[np.arange(n), cls] = 1.0
+    return DataSet(x, y)
+
+
+# --- vertex semantics vs numpy ---------------------------------------------
+
+class TestVertexOps:
+    def _run(self, vertex, *inputs):
+        import jax.numpy as jnp
+
+        y, _ = vertex.forward({}, {}, [jnp.asarray(x) for x in inputs],
+                              train=False, rng=None)
+        return np.asarray(y)
+
+    def test_merge_concat_last_axis(self, rng):
+        a, b = rng.normal(size=(2, 3)), rng.normal(size=(2, 5))
+        np.testing.assert_allclose(self._run(MergeVertex(), a, b),
+                                   np.concatenate([a, b], axis=-1))
+
+    def test_elementwise_ops(self, rng):
+        a, b = rng.normal(size=(4, 3)), rng.normal(size=(4, 3))
+        np.testing.assert_allclose(
+            self._run(ElementWiseVertex(op=ElementWiseOp.ADD), a, b), a + b,
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            self._run(ElementWiseVertex(op=ElementWiseOp.SUBTRACT), a, b),
+            a - b, rtol=1e-6)
+        np.testing.assert_allclose(
+            self._run(ElementWiseVertex(op=ElementWiseOp.PRODUCT), a, b),
+            a * b, rtol=1e-6)
+        np.testing.assert_allclose(
+            self._run(ElementWiseVertex(op=ElementWiseOp.AVERAGE), a, b),
+            (a + b) / 2, rtol=1e-6)
+        np.testing.assert_allclose(
+            self._run(ElementWiseVertex(op=ElementWiseOp.MAX), a, b),
+            np.maximum(a, b), rtol=1e-6)
+
+    def test_subset_inclusive(self, rng):
+        a = rng.normal(size=(2, 10))
+        np.testing.assert_allclose(
+            self._run(SubsetVertex(from_idx=2, to_idx=5), a), a[:, 2:6])
+
+    def test_scale_shift(self, rng):
+        a = rng.normal(size=(2, 3))
+        np.testing.assert_allclose(self._run(ScaleVertex(scale_factor=2.5), a),
+                                   2.5 * a, rtol=1e-6)
+        np.testing.assert_allclose(self._run(ShiftVertex(shift_factor=1.5), a),
+                                   a + 1.5, rtol=1e-6)
+
+    def test_l2_normalize(self, rng):
+        a = rng.normal(size=(3, 5)).astype(np.float32)
+        got = self._run(L2NormalizeVertex(), a)
+        want = a / np.linalg.norm(a, axis=1, keepdims=True)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_stack_unstack_roundtrip(self, rng):
+        a, b = rng.normal(size=(2, 3)), rng.normal(size=(2, 3))
+        stacked = self._run(StackVertex(), a, b)
+        assert stacked.shape == (4, 3)
+        np.testing.assert_allclose(
+            self._run(UnstackVertex(from_idx=1, stack_size=2), stacked), b)
+
+    def test_reshape(self, rng):
+        a = rng.normal(size=(2, 6))
+        got = self._run(ReshapeVertex(new_shape=(-1, 2, 3)), a)
+        np.testing.assert_allclose(got, a.reshape(-1, 2, 3))
+
+
+# --- config structure -------------------------------------------------------
+
+class TestGraphConfig:
+    def test_topo_order_out_of_declaration_order(self):
+        # declare downstream vertex before its input
+        conf = ComputationGraphConfiguration(
+            network_inputs=("in",),
+            network_outputs=("out",),
+            vertices=(
+                VertexSpec("out", LayerVertex(layer=OutputLayer(
+                    n_out=2, loss_fn=LossMSE(),
+                    activation=Activation.IDENTITY)), ("b",)),
+                VertexSpec("b", LayerVertex(layer=DenseLayer(n_out=3)), ("a",)),
+                VertexSpec("a", LayerVertex(layer=DenseLayer(n_out=3)), ("in",)),
+            ),
+            input_types=(InputType.feed_forward(4),),
+        )
+        assert conf.topo_order() == ["a", "b", "out"]
+
+    def test_cycle_detection(self):
+        conf = ComputationGraphConfiguration(
+            network_inputs=("in",),
+            network_outputs=("a",),
+            vertices=(
+                VertexSpec("a", ElementWiseVertex(), ("in", "b")),
+                VertexSpec("b", LayerVertex(layer=DenseLayer(n_out=3)), ("a",)),
+            ),
+            input_types=(InputType.feed_forward(3),),
+        )
+        with pytest.raises(ValueError, match="cycle"):
+            conf.topo_order()
+
+    def test_unknown_input_raises(self):
+        conf = ComputationGraphConfiguration(
+            network_inputs=("in",),
+            network_outputs=("a",),
+            vertices=(VertexSpec("a", LayerVertex(layer=DenseLayer(n_out=3)),
+                                 ("nope",)),),
+            input_types=(InputType.feed_forward(3),),
+        )
+        with pytest.raises(ValueError, match="unknown input"):
+            conf.topo_order()
+
+    def test_json_roundtrip(self):
+        conf = simple_graph_conf()
+        s = conf.to_json()
+        back = ComputationGraphConfiguration.from_json(s)
+        assert back == conf
+
+    def test_shape_inference_through_merge(self):
+        g = (NeuralNetConfiguration.builder()
+             .graph_builder()
+             .add_inputs("in1", "in2")
+             .set_input_types(InputType.feed_forward(3),
+                              InputType.feed_forward(5))
+             .add_layer("d1", DenseLayer(n_out=4), "in1")
+             .add_layer("d2", DenseLayer(n_out=6), "in2")
+             .add_vertex("m", MergeVertex(), "d1", "d2")
+             .add_layer("out", OutputLayer(n_out=2, loss_fn=LossMSE(),
+                                           activation=Activation.IDENTITY),
+                        "m")
+             .set_outputs("out")
+             .build())
+        types = g.vertex_output_types()
+        assert types["m"].size == 10
+
+    def test_cnn_to_dense_preprocessor_auto_inserted(self):
+        g = (NeuralNetConfiguration.builder()
+             .graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.convolutional(8, 8, 3))
+             .add_layer("conv", ConvolutionLayer(
+                 n_out=4, kernel_size=(3, 3),
+                 convolution_mode=ConvolutionMode.SAME), "in")
+             .add_layer("dense", DenseLayer(n_out=10), "conv")
+             .add_layer("out", OutputLayer(n_out=2, loss_fn=LossMSE(),
+                                           activation=Activation.IDENTITY),
+                        "dense")
+             .set_outputs("out")
+             .build())
+        lv = g.vertex_map()["dense"].vertex
+        assert lv.preprocessor is not None
+        net = ComputationGraph(g).init()
+        out = net.output(np.random.default_rng(0).normal(size=(2, 8, 8, 3)))
+        assert np.asarray(out).shape == (2, 2)
+
+
+# --- runtime ----------------------------------------------------------------
+
+class TestGraphTraining:
+    def test_fit_reduces_loss(self):
+        net = ComputationGraph(simple_graph_conf()).init()
+        ds = iris_like()
+        first = net.fit_batch(ds)
+        for _ in range(60):
+            last = net.fit_batch(ds)
+        assert last < first * 0.5
+
+    def test_output_shape_and_softmax(self):
+        net = ComputationGraph(simple_graph_conf()).init()
+        out = np.asarray(net.output(iris_like(8).features))
+        assert out.shape == (8, 3)
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+
+    def test_multi_input_multi_output(self):
+        g = (NeuralNetConfiguration.builder()
+             .seed(7).updater(Sgd(learning_rate=0.1))
+             .graph_builder()
+             .add_inputs("in1", "in2")
+             .set_input_types(InputType.feed_forward(3),
+                              InputType.feed_forward(2))
+             .add_layer("d1", DenseLayer(n_out=8, activation=Activation.TANH),
+                        "in1")
+             .add_layer("d2", DenseLayer(n_out=8, activation=Activation.TANH),
+                        "in2")
+             .add_vertex("m", MergeVertex(), "d1", "d2")
+             .add_layer("out1", OutputLayer(n_out=2,
+                                            activation=Activation.SOFTMAX,
+                                            loss_fn=LossMCXENT()), "m")
+             .add_layer("out2", OutputLayer(n_out=1,
+                                            activation=Activation.IDENTITY,
+                                            loss_fn=LossMSE()), "m")
+             .set_outputs("out1", "out2")
+             .build())
+        net = ComputationGraph(g).init()
+        rng = np.random.default_rng(1)
+        n = 32
+        mds = MultiDataSet(
+            features=[rng.normal(size=(n, 3)).astype(np.float32),
+                      rng.normal(size=(n, 2)).astype(np.float32)],
+            labels=[np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)],
+                    rng.normal(size=(n, 1)).astype(np.float32)])
+        first = net.fit_batch(mds)
+        for _ in range(40):
+            last = net.fit_batch(mds)
+        assert last < first
+        outs = net.output(*mds.features)
+        assert isinstance(outs, list) and len(outs) == 2
+        assert np.asarray(outs[0]).shape == (n, 2)
+        assert np.asarray(outs[1]).shape == (n, 1)
+
+    def test_fit_dataset_iterator_and_evaluate(self):
+        from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+
+        ds = iris_like(n=90)
+        it = ArrayDataSetIterator(ds.features, ds.labels, 30)
+        net = ComputationGraph(simple_graph_conf()).init()
+        net.fit(it, epochs=30)
+        ev = net.evaluate(it)
+        assert ev.accuracy() > 0.8
+
+    def test_clone_independent(self):
+        net = ComputationGraph(simple_graph_conf()).init()
+        other = net.clone()
+        net.fit_batch(iris_like())
+        assert not np.allclose(net.params_flat(), other.params_flat())
+
+    def test_summary_smoke(self):
+        net = ComputationGraph(simple_graph_conf()).init()
+        s = net.summary()
+        assert "Total params" in s and "res" in s
+
+    def test_non_output_vertex_as_output_raises(self):
+        g = (NeuralNetConfiguration.builder()
+             .graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.feed_forward(3))
+             .add_layer("d", DenseLayer(n_out=4), "in")
+             .set_outputs("d")
+             .build())
+        net = ComputationGraph(g).init()
+        with pytest.raises(TypeError, match="not an output layer"):
+            net.fit_batch(iris_like())
+
+
+# --- serialization ----------------------------------------------------------
+
+class TestGraphSerializer:
+    def test_roundtrip_exact_resume(self, tmp_path):
+        net = ComputationGraph(simple_graph_conf()).init()
+        ds = iris_like()
+        for _ in range(5):
+            net.fit_batch(ds)
+        p = tmp_path / "graph.zip"
+        serializer.write_model(net, p)
+        back = serializer.restore_computation_graph(p)
+        np.testing.assert_allclose(back.params_flat(), net.params_flat(),
+                                   rtol=1e-6)
+        assert back.iteration == net.iteration
+        # continued training must match exactly (same updater state)
+        a = net.fit_batch(ds)
+        b = back.fit_batch(ds)
+        assert a == pytest.approx(b, rel=1e-5)
+
+
+# --- gradient checks --------------------------------------------------------
+
+class TestGraphGradients:
+    def test_residual_graph_gradients(self):
+        conf = simple_graph_conf(updater=Sgd(learning_rate=0.1))
+        res = gradient_check_graph(conf, iris_like(n=8), n_samples=60)
+        assert res.passed, res.failures
+
+    def test_merge_subset_graph_gradients(self):
+        g = (NeuralNetConfiguration.builder()
+             .seed(3).updater(Sgd(learning_rate=0.1))
+             .graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.feed_forward(6))
+             .add_vertex("s1", SubsetVertex(from_idx=0, to_idx=2), "in")
+             .add_vertex("s2", SubsetVertex(from_idx=3, to_idx=5), "in")
+             .add_layer("d1", DenseLayer(n_out=5, activation=Activation.TANH),
+                        "s1")
+             .add_layer("d2", DenseLayer(n_out=5,
+                                         activation=Activation.SIGMOID), "s2")
+             .add_vertex("m", MergeVertex(), "d1", "d2")
+             .add_vertex("n", L2NormalizeVertex(), "m")
+             .add_layer("out", OutputLayer(n_out=2, loss_fn=LossMSE(),
+                                           activation=Activation.IDENTITY),
+                        "n")
+             .set_outputs("out")
+             .build())
+        rng = np.random.default_rng(5)
+        ds = DataSet(rng.normal(size=(6, 6)).astype(np.float32),
+                     rng.normal(size=(6, 2)).astype(np.float32))
+        res = gradient_check_graph(g, ds, n_samples=60)
+        assert res.passed, res.failures
+
+    def test_cnn_graph_gradients(self):
+        g = (NeuralNetConfiguration.builder()
+             .seed(4).updater(Sgd(learning_rate=0.1))
+             .graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.convolutional(6, 6, 2))
+             .add_layer("c1", ConvolutionLayer(
+                 n_out=3, kernel_size=(3, 3),
+                 convolution_mode=ConvolutionMode.SAME,
+                 activation=Activation.TANH), "in")
+             .add_layer("bn", BatchNormalization(), "c1")
+             .add_layer("p", SubsamplingLayer(pooling_type=PoolingType.AVG,
+                                              kernel_size=(2, 2),
+                                              stride=(2, 2)), "bn")
+             .add_layer("out", OutputLayer(n_out=2, loss_fn=LossMSE(),
+                                           activation=Activation.IDENTITY),
+                        "p")
+             .set_outputs("out")
+             .build())
+        rng = np.random.default_rng(6)
+        ds = DataSet(rng.normal(size=(4, 6, 6, 2)).astype(np.float32),
+                     rng.normal(size=(4, 2)).astype(np.float32))
+        res = gradient_check_graph(g, ds, n_samples=60)
+        assert res.passed, res.failures

@@ -1,0 +1,95 @@
+"""Model zoo: topology construction, parameter-count parity, forward shapes
+(reference oracle: dl4j-zoo model smoke tests, SURVEY.md §4 integration
+tier). Small spatial sizes keep the CPU oracle fast; channel structure is
+the full reference topology."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.zoo.graphs import (
+    VGG16,
+    VGG19,
+    Darknet19,
+    ResNet50,
+    SqueezeNet,
+    UNet,
+)
+from deeplearning4j_tpu.zoo.models import LeNet, SimpleCNN
+
+
+def _forward(model, h, w, c, batch=2):
+    net = model.init()
+    x = np.random.default_rng(0).normal(size=(batch, h, w, c)).astype(
+        np.float32)
+    return net, np.asarray(net.output(x))
+
+
+class TestSequentialZoo:
+    def test_lenet_shapes(self):
+        net, out = _forward(LeNet(num_classes=10), 28, 28, 1)
+        assert out.shape == (2, 10)
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+
+    def test_simplecnn_shapes(self):
+        net, out = _forward(SimpleCNN(num_classes=5, height=32, width=32,
+                                      channels=3), 32, 32, 3)
+        assert out.shape == (2, 5)
+
+
+class TestGraphZoo:
+    def test_vgg16_small(self):
+        net, out = _forward(VGG16(num_classes=10, height=64, width=64), 64,
+                            64, 3)
+        assert out.shape == (2, 10)
+        # 13 conv layers + 3 dense
+        convs = [n for n in net.conf.topo_order() if n.startswith("conv")]
+        assert len(convs) == 13
+
+    def test_vgg19_has_16_convs(self):
+        conf = VGG19(num_classes=10, height=64, width=64).conf()
+        convs = [n for n in conf.topo_order() if n.startswith("conv")]
+        assert len(convs) == 16
+
+    def test_resnet50_param_count_parity(self):
+        # Reference ResNet50 (ImageNet, 1000 classes): 25,557,032 trainable
+        # params (conv weights w/o bias, BN gamma/beta, final FC w/ bias).
+        net = ResNet50(num_classes=1000).init()
+        assert net.num_params() == 25_557_032
+
+    def test_resnet50_small_forward_and_train(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        model = ResNet50(num_classes=7, height=64, width=64)
+        net, out = _forward(model, 64, 64, 3)
+        assert out.shape == (2, 7)
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+        # one train step runs and produces a finite loss
+        y = np.eye(7, dtype=np.float32)[[0, 3]]
+        x = np.random.default_rng(1).normal(size=(2, 64, 64, 3)).astype(
+            np.float32)
+        loss = net.fit_batch(DataSet(x, y))
+        assert np.isfinite(loss)
+
+    def test_squeezenet_small(self):
+        net, out = _forward(SqueezeNet(num_classes=10, height=96, width=96),
+                            96, 96, 3)
+        assert out.shape == (2, 10)
+        fires = {n.rsplit("_", 1)[0] for n in net.conf.topo_order()
+                 if n.startswith("fire")}
+        assert len(fires) == 8
+
+    def test_darknet19_has_19_convs(self):
+        net, out = _forward(Darknet19(num_classes=10, height=64, width=64),
+                            64, 64, 3)
+        assert out.shape == (2, 10)
+        # 18 bn convs + the 1x1 classification head = 19 convolutions
+        convs = [n for n in net.conf.topo_order()
+                 if (n.startswith("conv") and not n.endswith("_bn"))
+                 or n == "head"]
+        assert len(convs) == 19
+
+    def test_unet_output_is_input_resolution_mask(self):
+        net, out = _forward(UNet(height=32, width=32, channels=1, base=8),
+                            32, 32, 1)
+        assert out.shape == (2, 32, 32, 1)
+        assert (out >= 0).all() and (out <= 1).all()  # sigmoid head
