@@ -1,0 +1,116 @@
+"""Async prefetching iterator.
+
+Reference: ``org.nd4j.linalg.dataset.api.iterator.AsyncDataSetIterator`` —
+a background thread pulls from the wrapped iterator into a bounded queue so
+ETL overlaps training (the reference wraps every ``fit`` iterator in one,
+SURVEY.md §3.1). TPU version: the worker can additionally ``device_put``
+batches so the host→HBM transfer also overlaps the running step
+(double-buffering); the training loop then consumes device-resident arrays.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+
+_SENTINEL = object()
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Bounded-queue prefetch wrapper (reference ``AsyncDataSetIterator``,
+    default queue size 8 there; same default here)."""
+
+    def __init__(self, wrapped: DataSetIterator, queue_size: int = 8,
+                 device_put: bool = False, device=None):
+        self.wrapped = wrapped
+        self.queue_size = max(1, int(queue_size))
+        self.device_put = device_put
+        self.device = device
+        self._thread: Optional[threading.Thread] = None
+        self._queue: Optional[queue.Queue] = None
+        self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
+
+    def batch_size(self):
+        return self.wrapped.batch_size()
+
+    def total_examples(self):
+        return self.wrapped.total_examples()
+
+    def _producer(self):
+        try:
+            for ds in self.wrapped:
+                if self._stop.is_set():
+                    return
+                if self.device_put:
+                    ds = self._to_device(ds)
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(ds, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # surfaced on the consumer side
+            self._error = e
+        finally:
+            while not self._stop.is_set():
+                try:
+                    self._queue.put(_SENTINEL, timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
+
+    def _to_device(self, ds: DataSet) -> DataSet:
+        import jax
+
+        put = (lambda a: jax.device_put(a, self.device)) if self.device \
+            else jax.device_put
+        return DataSet(
+            put(np.asarray(ds.features)), put(np.asarray(ds.labels)),
+            None if ds.features_mask is None else put(np.asarray(ds.features_mask)),
+            None if ds.labels_mask is None else put(np.asarray(ds.labels_mask)))
+
+    def __iter__(self):
+        self._shutdown()
+        self._stop.clear()
+        self._error = None
+        self._queue = queue.Queue(self.queue_size)
+        self._thread = threading.Thread(target=self._producer, daemon=True,
+                                        name="AsyncDataSetIterator")
+        self._thread.start()
+        try:
+            while True:
+                item = self._queue.get()
+                if item is _SENTINEL:
+                    break
+                yield item
+            self._thread.join(timeout=5)
+            if self._error is not None:
+                raise self._error
+        finally:
+            # consumer may abandon the generator early (break / exception in
+            # the training loop): stop the producer rather than leaking the
+            # thread and its queued (possibly device-resident) batches
+            self._shutdown()
+
+    def _shutdown(self):
+        if self._thread is not None and self._thread.is_alive():
+            self._stop.set()
+            self._thread.join(timeout=5)
+        self._thread = None
+
+    def reset(self):
+        self._shutdown()
+        self.wrapped.reset()
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass

@@ -1,0 +1,42 @@
+"""DataVec-equivalent ETL pipeline (reference ``datavec/`` modules).
+
+Record readers, input splits, schema-driven transform processes, image
+loading/augmentation and the RecordReader→DataSetIterator bridge — the
+TPU-side difference is that everything stays host-side numpy until the
+prefetcher hands batches to the jitted step (SURVEY.md §2.2 DataVec rows).
+"""
+
+from deeplearning4j_tpu.datavec.writables import (
+    Writable, IntWritable, LongWritable, FloatWritable, DoubleWritable,
+    Text, BooleanWritable, NDArrayWritable, NullWritable,
+)
+from deeplearning4j_tpu.datavec.split import (
+    InputSplit, FileSplit, CollectionInputSplit, NumberedFileInputSplit,
+    StringSplit,
+)
+from deeplearning4j_tpu.datavec.records import (
+    RecordReader, SequenceRecordReader, CSVRecordReader, LineRecordReader,
+    CollectionRecordReader, CollectionSequenceRecordReader,
+    CSVSequenceRecordReader, RegexLineRecordReader, JsonRecordReader,
+    TransformProcessRecordReader,
+)
+from deeplearning4j_tpu.datavec.schema import Schema, ColumnType
+from deeplearning4j_tpu.datavec.transform import TransformProcess
+from deeplearning4j_tpu.datavec.bridge import (
+    RecordReaderDataSetIterator, SequenceRecordReaderDataSetIterator,
+)
+
+__all__ = [
+    "Writable", "IntWritable", "LongWritable", "FloatWritable",
+    "DoubleWritable", "Text", "BooleanWritable", "NDArrayWritable",
+    "NullWritable",
+    "InputSplit", "FileSplit", "CollectionInputSplit",
+    "NumberedFileInputSplit", "StringSplit",
+    "RecordReader", "SequenceRecordReader", "CSVRecordReader",
+    "LineRecordReader", "CollectionRecordReader",
+    "CollectionSequenceRecordReader", "CSVSequenceRecordReader",
+    "RegexLineRecordReader", "JsonRecordReader",
+    "TransformProcessRecordReader",
+    "Schema", "ColumnType", "TransformProcess",
+    "RecordReaderDataSetIterator", "SequenceRecordReaderDataSetIterator",
+]

@@ -1,0 +1,197 @@
+"""RecordReader ↔ DataSet bridge.
+
+Reference: ``deeplearning4j-core/.../datasets/datavec/`` —
+``RecordReaderDataSetIterator`` (records → feature matrix + one-hot labels)
+and ``SequenceRecordReaderDataSetIterator`` (sequence records → [N,C,T]
+tensors with per-timestep masks for variable-length sequences, ALIGN_END or
+ALIGN_START padding alignment).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+from deeplearning4j_tpu.datavec.records import RecordReader, SequenceRecordReader
+from deeplearning4j_tpu.datavec.writables import numeric_of, value_of
+
+
+def _one_hot(idx: int, n: int) -> np.ndarray:
+    v = np.zeros(n, np.float32)
+    v[int(idx)] = 1.0
+    return v
+
+
+class RecordReaderDataSetIterator(DataSetIterator):
+    """Reference ``RecordReaderDataSetIterator``: batches records into
+    (features, one-hot labels). ``label_index`` selects the label cell
+    (or a [from,to] range for regression via ``regression=True``);
+    NDArray-valued cells (image reader) are flattened into the feature
+    tensor, preserving their shape when they are the only feature."""
+
+    def __init__(self, reader: RecordReader, batch_size: int,
+                 label_index: Optional[int] = None,
+                 num_possible_labels: Optional[int] = None,
+                 regression: bool = False,
+                 label_index_to: Optional[int] = None):
+        self.reader = reader
+        self.batch = int(batch_size)
+        self.label_index = label_index
+        self.label_index_to = label_index_to
+        self.num_labels = num_possible_labels
+        self.regression = regression
+        self._preprocessor = None
+
+    def set_preprocessor(self, pre):
+        """Reference ``#setPreProcessor`` (DataNormalization etc.)."""
+        self._preprocessor = pre
+        return self
+
+    def batch_size(self):
+        return self.batch
+
+    def reset(self):
+        self.reader.reset()
+
+    def _split_record(self, rec: List):
+        cells = list(rec)
+        if self.label_index is None:
+            return cells, None
+        li = self.label_index
+        lt = self.label_index_to if self.label_index_to is not None else li
+        label_cells = cells[li:lt + 1]
+        feat_cells = cells[:li] + cells[lt + 1:]
+        return feat_cells, label_cells
+
+    def _features_of(self, cells: List) -> np.ndarray:
+        vals = [value_of(c) for c in cells]
+        if len(vals) == 1 and isinstance(vals[0], np.ndarray):
+            return vals[0].astype(np.float32)
+        parts = []
+        for v in vals:
+            if isinstance(v, np.ndarray):
+                parts.append(v.astype(np.float32).ravel())
+            else:
+                parts.append(np.asarray([numeric_of(v)], np.float32))
+        return np.concatenate(parts)
+
+    def _labels_of(self, cells: Optional[List]) -> Optional[np.ndarray]:
+        if cells is None:
+            return None
+        if self.regression:
+            return np.asarray([numeric_of(c) for c in cells], np.float32)
+        if len(cells) != 1:
+            raise ValueError("classification expects exactly one label cell")
+        if self.num_labels is None:
+            raise ValueError("num_possible_labels required for classification")
+        return _one_hot(int(numeric_of(cells[0])), self.num_labels)
+
+    def __iter__(self):
+        feats, labs = [], []
+        for rec in self.reader:
+            f_cells, l_cells = self._split_record(rec)
+            feats.append(self._features_of(f_cells))
+            lab = self._labels_of(l_cells)
+            if lab is not None:
+                labs.append(lab)
+            if len(feats) == self.batch:
+                yield self._emit(feats, labs)
+                feats, labs = [], []
+        if feats:
+            yield self._emit(feats, labs)
+
+    def _emit(self, feats, labs):
+        f = np.stack(feats)
+        l = np.stack(labs) if labs else np.zeros((f.shape[0], 0), np.float32)
+        ds = DataSet(f, l)
+        if self._preprocessor is not None:
+            self._preprocessor.transform(ds)
+        return ds
+
+
+@enum.unique
+class AlignmentMode(enum.Enum):
+    """Reference ``SequenceRecordReaderDataSetIterator.AlignmentMode``."""
+    ALIGN_START = "ALIGN_START"
+    ALIGN_END = "ALIGN_END"
+    EQUAL_LENGTH = "EQUAL_LENGTH"
+
+
+class SequenceRecordReaderDataSetIterator(DataSetIterator):
+    """Reference ``SequenceRecordReaderDataSetIterator`` (single-reader
+    mode): each sequence supplies features and a label per timestep;
+    variable lengths are padded to the batch max with 0s and a per-timestep
+    mask, aligned start or end — the exact masking contract the RNN layers
+    and losses consume (SURVEY.md §5.7)."""
+
+    def __init__(self, reader: SequenceRecordReader, batch_size: int,
+                 label_index: int, num_possible_labels: Optional[int] = None,
+                 regression: bool = False,
+                 alignment: AlignmentMode = AlignmentMode.ALIGN_START,
+                 channels_first: bool = False):
+        self.reader = reader
+        self.batch = int(batch_size)
+        self.label_index = label_index
+        self.num_labels = num_possible_labels
+        self.regression = regression
+        self.alignment = alignment
+        # False (default): framework-native [batch, time, features];
+        # True: the reference's [batch, features, time] layout.
+        self.channels_first = channels_first
+
+    def batch_size(self):
+        return self.batch
+
+    def reset(self):
+        self.reader.reset()
+
+    def _seq_arrays(self, seq):
+        """sequence → (features [T,F], labels [T,L])."""
+        f_rows, l_rows = [], []
+        for rec in seq:
+            cells = list(rec)
+            lab = cells.pop(self.label_index)
+            f_rows.append([numeric_of(c) for c in cells])
+            if self.regression:
+                l_rows.append([numeric_of(lab)])
+            else:
+                l_rows.append(_one_hot(int(numeric_of(lab)), self.num_labels))
+        return (np.asarray(f_rows, np.float32), np.asarray(l_rows, np.float32))
+
+    def __iter__(self):
+        bucket = []
+        for seq in self.reader:
+            bucket.append(self._seq_arrays(seq))
+            if len(bucket) == self.batch:
+                yield self._emit(bucket)
+                bucket = []
+        if bucket:
+            yield self._emit(bucket)
+
+    def _emit(self, bucket):
+        max_t = max(f.shape[0] for f, _ in bucket)
+        n = len(bucket)
+        nf = bucket[0][0].shape[1]
+        nl = bucket[0][1].shape[1]
+        feats = np.zeros((n, max_t, nf), np.float32)
+        labs = np.zeros((n, max_t, nl), np.float32)
+        mask = np.zeros((n, max_t), np.float32)
+        for i, (f, l) in enumerate(bucket):
+            t = f.shape[0]
+            if self.alignment is AlignmentMode.ALIGN_END:
+                sl = slice(max_t - t, max_t)
+            else:
+                if self.alignment is AlignmentMode.EQUAL_LENGTH and t != max_t:
+                    raise ValueError("EQUAL_LENGTH but sequence lengths differ")
+                sl = slice(0, t)
+            feats[i, sl, :] = f
+            labs[i, sl, :] = l
+            mask[i, sl] = 1.0
+        if self.channels_first:
+            feats = np.transpose(feats, (0, 2, 1))
+            labs = np.transpose(labs, (0, 2, 1))
+        return DataSet(feats, labs, features_mask=mask, labels_mask=mask)

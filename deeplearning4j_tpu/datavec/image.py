@@ -1,0 +1,276 @@
+"""Image loading + augmentation.
+
+Reference: ``datavec-data-image`` — ``NativeImageLoader`` (OpenCV decode →
+NCHW INDArray), ``ImageRecordReader`` (label inferred from parent dir via
+``ParentPathLabelGenerator``), and ``org.datavec.image.transform.*``
+augmentations (crop/flip/rotate/warp/color, composed by
+``PipelineImageTransform``). Decode here uses PIL+numpy on the host; the
+augmented batch crosses to device once, via the dataset bridge/prefetcher.
+
+Layout is HWC float32 (the framework's TPU-native channels-last convention
+— the reference defaults to channels-first; pass ``channels_first=True`` to
+the loader/reader for that layout). Pixel values stay in [0,255]; scaling
+is the normalizer's job, as in the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datavec.records import RecordReader
+from deeplearning4j_tpu.datavec.split import InputSplit
+
+
+def _per_channel(img: np.ndarray, fn) -> np.ndarray:
+    """Apply a 2D→2D float op per channel of an HWC image."""
+    return np.stack([fn(img[:, :, c]) for c in range(img.shape[2])], axis=-1)
+
+
+class ImageLoader:
+    """Decode + resize + to-HWC (reference ``NativeImageLoader#asMatrix``)."""
+
+    def __init__(self, height: int, width: int, channels: int = 3,
+                 channels_first: bool = False):
+        self.height = int(height)
+        self.width = int(width)
+        self.channels = int(channels)
+        self.channels_first = channels_first
+
+    def _finish(self, arr: np.ndarray) -> np.ndarray:
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if self.channels_first:
+            arr = np.transpose(arr, (2, 0, 1))
+        return np.ascontiguousarray(arr)
+
+    def as_matrix(self, path) -> np.ndarray:
+        """file → float32 [H,W,C] (or [C,H,W] if channels_first)."""
+        from PIL import Image
+
+        with Image.open(path) as im:
+            im = im.convert("L" if self.channels == 1 else "RGB")
+            im = im.resize((self.width, self.height), Image.BILINEAR)
+            arr = np.asarray(im, dtype=np.float32)
+        return self._finish(arr)
+
+    def from_array(self, arr: np.ndarray) -> np.ndarray:
+        """HWC / HW / CHW array → float32 resized, target layout."""
+        from PIL import Image
+
+        arr = np.asarray(arr)
+        if arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[-1] not in (1, 3):
+            arr = np.transpose(arr, (1, 2, 0))  # CHW -> HWC
+        im = Image.fromarray(arr.astype(np.uint8).squeeze())
+        im = im.convert("L" if self.channels == 1 else "RGB")
+        im = im.resize((self.width, self.height), Image.BILINEAR)
+        return self._finish(np.asarray(im, dtype=np.float32))
+
+
+# --------------------------------------------------------------------------
+# augmentation transforms (reference org.datavec.image.transform.*)
+# --------------------------------------------------------------------------
+class ImageTransform:
+    """HWC float image → HWC float image; randomness drawn from ``rng`` when
+    the transform is stochastic (reference ``ImageTransform#transform``)."""
+
+    def apply(self, img: np.ndarray, rng: random.Random) -> np.ndarray:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class FlipImageTransform(ImageTransform):
+    """Reference ``FlipImageTransform``: mode 0=vertical (flip about the
+    x-axis), 1=horizontal, -1=both; None = random choice per image."""
+    mode: Optional[int] = 1
+
+    def apply(self, img, rng):
+        mode = self.mode if self.mode is not None else rng.choice([-1, 0, 1])
+        if mode in (1, -1):
+            img = img[:, ::-1, :]
+        if mode in (0, -1):
+            img = img[::-1, :, :]
+        return np.ascontiguousarray(img)
+
+
+@dataclasses.dataclass
+class RandomCropTransform(ImageTransform):
+    """Reference ``RandomCropTransform``: random crop to (height,width)."""
+    height: int
+    width: int
+
+    def apply(self, img, rng):
+        h, w = img.shape[:2]
+        if h < self.height or w < self.width:
+            raise ValueError(f"crop {self.height}x{self.width} > image {h}x{w}")
+        top = rng.randint(0, h - self.height)
+        left = rng.randint(0, w - self.width)
+        return img[top:top + self.height, left:left + self.width, :]
+
+
+@dataclasses.dataclass
+class CropImageTransform(ImageTransform):
+    """Reference ``CropImageTransform``: deterministic border crop."""
+    crop_top: int = 0
+    crop_left: int = 0
+    crop_bottom: int = 0
+    crop_right: int = 0
+
+    def apply(self, img, rng):
+        h, w = img.shape[:2]
+        return img[self.crop_top:h - self.crop_bottom,
+                   self.crop_left:w - self.crop_right, :]
+
+
+@dataclasses.dataclass
+class RotateImageTransform(ImageTransform):
+    """Reference ``RotateImageTransform``: rotate by angle±delta degrees
+    about the center, same output size."""
+    angle: float = 0.0
+    delta: float = 0.0
+
+    def apply(self, img, rng):
+        from PIL import Image
+
+        ang = self.angle + (rng.uniform(-self.delta, self.delta)
+                            if self.delta else 0.0)
+        return _per_channel(img, lambda c: np.asarray(
+            Image.fromarray(c).rotate(ang, resample=Image.BILINEAR),
+            dtype=np.float32))
+
+
+@dataclasses.dataclass
+class ResizeImageTransform(ImageTransform):
+    """Reference ``ResizeImageTransform``."""
+    height: int
+    width: int
+
+    def apply(self, img, rng):
+        from PIL import Image
+
+        return _per_channel(img, lambda c: np.asarray(
+            Image.fromarray(c).resize((self.width, self.height),
+                                      Image.BILINEAR), dtype=np.float32))
+
+
+@dataclasses.dataclass
+class ScaleImageTransform(ImageTransform):
+    """Reference ``ScaleImageTransform``: random scale by up to ±delta
+    pixels in each dimension."""
+    delta: float
+
+    def apply(self, img, rng):
+        from PIL import Image
+
+        h, w = img.shape[:2]
+        nh = max(1, int(round(h + rng.uniform(-self.delta, self.delta))))
+        nw = max(1, int(round(w + rng.uniform(-self.delta, self.delta))))
+        return _per_channel(img, lambda c: np.asarray(
+            Image.fromarray(c).resize((nw, nh), Image.BILINEAR),
+            dtype=np.float32))
+
+
+@dataclasses.dataclass
+class EqualizeHistTransform(ImageTransform):
+    """Reference ``EqualizeHistTransform``: per-channel histogram
+    equalization."""
+
+    def apply(self, img, rng):
+        def eq(c):
+            flat = c.astype(np.uint8).ravel()
+            hist = np.bincount(flat, minlength=256).astype(np.float64)
+            cdf = hist.cumsum()
+            nz = cdf[cdf > 0]
+            if nz.size == 0:
+                return c
+            cdf_min = nz[0]
+            denom = max(cdf[-1] - cdf_min, 1)
+            lut = np.round((cdf - cdf_min) / denom * 255.0).clip(0, 255)
+            return lut[flat].reshape(c.shape).astype(np.float32)
+
+        return _per_channel(img, eq)
+
+
+class PipelineImageTransform(ImageTransform):
+    """Reference ``PipelineImageTransform``: sequence of (transform, prob)
+    pairs, each applied with its probability."""
+
+    def __init__(self, transforms: Sequence, shuffle: bool = False):
+        # accepts ImageTransform or (ImageTransform, prob)
+        self.steps: List[Tuple[ImageTransform, float]] = []
+        for t in transforms:
+            if isinstance(t, tuple):
+                self.steps.append((t[0], float(t[1])))
+            else:
+                self.steps.append((t, 1.0))
+        self.shuffle = shuffle
+
+    def apply(self, img, rng):
+        steps = list(self.steps)
+        if self.shuffle:
+            rng.shuffle(steps)
+        for t, p in steps:
+            if p >= 1.0 or rng.random() < p:
+                img = t.apply(img, rng)
+        return img
+
+
+# --------------------------------------------------------------------------
+# readers
+# --------------------------------------------------------------------------
+class ParentPathLabelGenerator:
+    """Label = parent directory name (reference
+    ``ParentPathLabelGenerator``)."""
+
+    def label_for(self, path: str) -> str:
+        return Path(path).parent.name
+
+
+class ImageRecordReader(RecordReader):
+    """Reference ``ImageRecordReader``: record = [image ndarray, label
+    index]. Labels discovered from parent dirs (sorted, as the reference
+    does) or omitted when no label generator is set. Augmentation runs on
+    the HWC image; ``channels_first`` transposes at the end."""
+
+    def __init__(self, height: int, width: int, channels: int = 3,
+                 label_generator: Optional[ParentPathLabelGenerator] = None,
+                 image_transform: Optional[ImageTransform] = None,
+                 channels_first: bool = False, seed: int = 12345):
+        self.loader = ImageLoader(height, width, channels)
+        self.label_gen = label_generator
+        self.transform = image_transform
+        self.channels_first = channels_first
+        self._labels: Optional[List[str]] = None
+        self._split: Optional[InputSplit] = None
+        self._rng = random.Random(seed)
+        self._seed = seed
+
+    def initialize(self, split: InputSplit):
+        self._split = split
+        if self.label_gen is not None:
+            found = {self.label_gen.label_for(p) for p in split.locations()}
+            self._labels = sorted(found)
+        return self
+
+    def labels(self):
+        return self._labels
+
+    def reset(self):
+        self._rng = random.Random(self._seed)
+
+    def __iter__(self):
+        for loc in self._split.locations():
+            img = self.loader.as_matrix(loc)
+            if self.transform is not None:
+                img = self.transform.apply(img, self._rng)
+            if self.channels_first:
+                img = np.transpose(img, (2, 0, 1))
+            if self.label_gen is not None:
+                label = self._labels.index(self.label_gen.label_for(loc))
+                yield [img, label]
+            else:
+                yield [img]

@@ -1,0 +1,224 @@
+"""Record readers.
+
+Reference: ``org.datavec.api.records.reader.impl.*`` — ``CSVRecordReader``,
+``LineRecordReader``, ``CSVSequenceRecordReader``, ``RegexLineRecordReader``,
+Jackson JSON readers, ``CollectionRecordReader`` and the transform-applying
+wrapper ``TransformProcessRecordReader``. A record is a list of cells; a
+sequence record is a list of records (one per timestep).
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+import re
+from pathlib import Path
+from typing import Iterator, List, Optional, Sequence
+
+from deeplearning4j_tpu.datavec.split import InputSplit, StringSplit
+
+
+class RecordReader:
+    """One record per ``next()`` (reference ``RecordReader``). Iterating
+    yields records (lists of cell values)."""
+
+    def initialize(self, split: InputSplit) -> "RecordReader":
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[List]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+    def labels(self) -> Optional[List[str]]:
+        """Known label set, when the reader derives labels (image readers)."""
+        return None
+
+
+class SequenceRecordReader(RecordReader):
+    """One SEQUENCE (list of timestep records) per ``next()`` (reference
+    ``SequenceRecordReader``)."""
+
+
+def _read_text(location: str) -> str:
+    p = Path(location)
+    if p.exists():
+        return p.read_text()
+    return location  # StringSplit hands the data itself as the location
+
+
+class LineRecordReader(RecordReader):
+    """Each line is a single-cell record (reference ``LineRecordReader``)."""
+
+    def __init__(self):
+        self._split: Optional[InputSplit] = None
+
+    def initialize(self, split: InputSplit):
+        self._split = split
+        return self
+
+    def __iter__(self):
+        for loc in self._split.locations():
+            for line in _read_text(loc).splitlines():
+                yield [line]
+
+
+class CSVRecordReader(RecordReader):
+    """CSV rows as records (reference ``CSVRecordReader``): skip-N-lines,
+    custom delimiter/quote. Cells stay strings; numeric coercion happens in
+    the transform process / dataset bridge, as in the reference."""
+
+    def __init__(self, skip_num_lines: int = 0, delimiter: str = ",",
+                 quote: str = '"'):
+        self.skip = int(skip_num_lines)
+        self.delimiter = delimiter
+        self.quote = quote
+        self._split: Optional[InputSplit] = None
+
+    def initialize(self, split: InputSplit):
+        self._split = split
+        return self
+
+    def __iter__(self):
+        for loc in self._split.locations():
+            text = _read_text(loc)
+            reader = csv.reader(io.StringIO(text), delimiter=self.delimiter,
+                                quotechar=self.quote)
+            for i, row in enumerate(reader):
+                if i < self.skip or not row:
+                    continue
+                yield list(row)
+
+
+class CSVSequenceRecordReader(SequenceRecordReader):
+    """One CSV file per sequence (reference ``CSVSequenceRecordReader``,
+    usually fed by ``NumberedFileInputSplit``)."""
+
+    def __init__(self, skip_num_lines: int = 0, delimiter: str = ","):
+        self.skip = int(skip_num_lines)
+        self.delimiter = delimiter
+        self._split: Optional[InputSplit] = None
+
+    def initialize(self, split: InputSplit):
+        self._split = split
+        return self
+
+    def __iter__(self):
+        for loc in self._split.locations():
+            text = _read_text(loc)
+            reader = csv.reader(io.StringIO(text), delimiter=self.delimiter)
+            seq = [list(row) for i, row in enumerate(reader)
+                   if i >= self.skip and row]
+            yield seq
+
+
+class RegexLineRecordReader(RecordReader):
+    """Line → capture groups as cells (reference ``RegexLineRecordReader``)."""
+
+    def __init__(self, regex: str, skip_num_lines: int = 0):
+        self.pattern = re.compile(regex)
+        self.skip = int(skip_num_lines)
+        self._split: Optional[InputSplit] = None
+
+    def initialize(self, split: InputSplit):
+        self._split = split
+        return self
+
+    def __iter__(self):
+        for loc in self._split.locations():
+            for i, line in enumerate(_read_text(loc).splitlines()):
+                if i < self.skip:
+                    continue
+                m = self.pattern.match(line)
+                if m is None:
+                    raise ValueError(
+                        f"line {i} does not match {self.pattern.pattern!r}: "
+                        f"{line!r}")
+                yield list(m.groups())
+
+
+class JsonRecordReader(RecordReader):
+    """JSON objects → records with a fixed field order (reference: Jackson
+    ``JacksonRecordReader`` with a ``FieldSelection``). Accepts a file of
+    either one JSON object, a JSON array, or JSON-lines."""
+
+    def __init__(self, field_selection: Sequence[str]):
+        self.fields = list(field_selection)
+        self._split: Optional[InputSplit] = None
+
+    def initialize(self, split: InputSplit):
+        self._split = split
+        return self
+
+    def _objects(self, text: str):
+        text = text.strip()
+        if not text:
+            return
+        if text.startswith("["):
+            yield from json.loads(text)
+            return
+        for line in text.splitlines():
+            line = line.strip()
+            if line:
+                yield json.loads(line)
+
+    def __iter__(self):
+        for loc in self._split.locations():
+            for obj in self._objects(_read_text(loc)):
+                yield [obj.get(f) for f in self.fields]
+
+
+class CollectionRecordReader(RecordReader):
+    """Records from an in-memory collection (reference
+    ``CollectionRecordReader``)."""
+
+    def __init__(self, records: Sequence[Sequence]):
+        self._records = [list(r) for r in records]
+
+    def initialize(self, split: InputSplit = None):
+        return self
+
+    def __iter__(self):
+        return iter([list(r) for r in self._records])
+
+
+class CollectionSequenceRecordReader(SequenceRecordReader):
+    """Sequences from an in-memory collection (reference
+    ``CollectionSequenceRecordReader``)."""
+
+    def __init__(self, sequences: Sequence[Sequence[Sequence]]):
+        self._seqs = [[list(r) for r in s] for s in sequences]
+
+    def initialize(self, split: InputSplit = None):
+        return self
+
+    def __iter__(self):
+        return iter([[list(r) for r in s] for s in self._seqs])
+
+
+class TransformProcessRecordReader(RecordReader):
+    """Wraps a reader, applying a TransformProcess per record (reference
+    ``TransformProcessRecordReader``). Records removed by filters are
+    skipped."""
+
+    def __init__(self, reader: RecordReader, transform_process):
+        self.reader = reader
+        self.tp = transform_process
+
+    def initialize(self, split: InputSplit):
+        self.reader.initialize(split)
+        return self
+
+    def labels(self):
+        return self.reader.labels()
+
+    def reset(self):
+        self.reader.reset()
+
+    def __iter__(self):
+        for rec in self.reader:
+            out = self.tp.execute_record(rec)
+            if out is not None:
+                yield out

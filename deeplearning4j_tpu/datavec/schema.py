@@ -1,0 +1,139 @@
+"""Schema — typed column description of a record stream.
+
+Reference: ``org.datavec.api.transform.schema.Schema`` + ``ColumnType``:
+a TransformProcess starts from a schema and every transform step produces
+a new schema, so column names/types are statically known after each step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import List, Optional, Sequence
+
+from deeplearning4j_tpu import serde
+
+
+@serde.register_enum
+class ColumnType(enum.Enum):
+    Integer = "Integer"
+    Long = "Long"
+    Double = "Double"
+    Float = "Float"
+    Categorical = "Categorical"
+    String = "String"
+    Boolean = "Boolean"
+    Time = "Time"
+    NDArray = "NDArray"
+
+
+@serde.register
+@dataclasses.dataclass
+class ColumnMetadata:
+    name: str
+    column_type: ColumnType
+    state_names: Optional[List[str]] = None  # categorical values
+
+    def is_numeric(self) -> bool:
+        return self.column_type in (ColumnType.Integer, ColumnType.Long,
+                                    ColumnType.Double, ColumnType.Float,
+                                    ColumnType.Time, ColumnType.Boolean)
+
+
+@serde.register
+@dataclasses.dataclass
+class Schema:
+    """Ordered, named, typed columns (reference ``Schema`` + its Builder)."""
+
+    columns: List[ColumnMetadata] = dataclasses.field(default_factory=list)
+
+    # --- builder API (reference Schema.Builder#addColumn*) ------------------
+    @staticmethod
+    def builder() -> "SchemaBuilder":
+        return SchemaBuilder()
+
+    # --- queries ------------------------------------------------------------
+    def names(self) -> List[str]:
+        return [c.name for c in self.columns]
+
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def index_of(self, name: str) -> int:
+        for i, c in enumerate(self.columns):
+            if c.name == name:
+                return i
+        raise KeyError(f"no column {name!r}; have {self.names()}")
+
+    def column(self, name: str) -> ColumnMetadata:
+        return self.columns[self.index_of(name)]
+
+    def has_column(self, name: str) -> bool:
+        return any(c.name == name for c in self.columns)
+
+    # --- functional updates (each transform derives a new schema) -----------
+    def with_columns(self, columns: Sequence[ColumnMetadata]) -> "Schema":
+        return Schema(list(columns))
+
+    def to_json(self) -> str:
+        return serde.to_json(self)
+
+    @staticmethod
+    def from_json(s: str) -> "Schema":
+        return serde.from_json(s)
+
+
+class SchemaBuilder:
+    def __init__(self):
+        self._cols: List[ColumnMetadata] = []
+
+    def add_column_integer(self, *names: str):
+        for n in names:
+            self._cols.append(ColumnMetadata(n, ColumnType.Integer))
+        return self
+
+    def add_column_long(self, *names: str):
+        for n in names:
+            self._cols.append(ColumnMetadata(n, ColumnType.Long))
+        return self
+
+    def add_column_double(self, *names: str):
+        for n in names:
+            self._cols.append(ColumnMetadata(n, ColumnType.Double))
+        return self
+
+    def add_column_float(self, *names: str):
+        for n in names:
+            self._cols.append(ColumnMetadata(n, ColumnType.Float))
+        return self
+
+    def add_column_string(self, *names: str):
+        for n in names:
+            self._cols.append(ColumnMetadata(n, ColumnType.String))
+        return self
+
+    def add_column_categorical(self, name: str, state_names: Sequence[str]):
+        self._cols.append(ColumnMetadata(name, ColumnType.Categorical,
+                                         list(state_names)))
+        return self
+
+    def add_column_boolean(self, *names: str):
+        for n in names:
+            self._cols.append(ColumnMetadata(n, ColumnType.Boolean))
+        return self
+
+    def add_column_time(self, *names: str):
+        for n in names:
+            self._cols.append(ColumnMetadata(n, ColumnType.Time))
+        return self
+
+    def add_column_ndarray(self, *names: str):
+        for n in names:
+            self._cols.append(ColumnMetadata(n, ColumnType.NDArray))
+        return self
+
+    def build(self) -> Schema:
+        names = [c.name for c in self._cols]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate column names: {names}")
+        return Schema(list(self._cols))

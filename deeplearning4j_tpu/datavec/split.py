@@ -1,0 +1,85 @@
+"""Input splits — where records come from.
+
+Reference: ``org.datavec.api.split.*`` (FileSplit, CollectionInputSplit,
+NumberedFileInputSplit, StringSplit): enumerate URIs/locations for record
+readers, with optional extension filtering, recursion and shuffling.
+"""
+
+from __future__ import annotations
+
+import random
+import re
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+
+class InputSplit:
+    """Enumerable source locations (reference ``InputSplit``)."""
+
+    def locations(self) -> List[str]:
+        raise NotImplementedError
+
+    def length(self) -> int:
+        return len(self.locations())
+
+
+class FileSplit(InputSplit):
+    """Files under a root dir (reference ``FileSplit``): recursive walk,
+    optional allowed-extension filter, optional seeded shuffle."""
+
+    def __init__(self, root, allowed_extensions: Optional[Sequence[str]] = None,
+                 recursive: bool = True, seed: Optional[int] = None):
+        self.root = Path(root)
+        self.allowed = (None if allowed_extensions is None else
+                        {e.lower().lstrip(".") for e in allowed_extensions})
+        self.recursive = recursive
+        self.seed = seed
+
+    def locations(self) -> List[str]:
+        if self.root.is_file():
+            return [str(self.root)]
+        pat = "**/*" if self.recursive else "*"
+        files = [p for p in sorted(self.root.glob(pat)) if p.is_file()]
+        if self.allowed is not None:
+            files = [p for p in files
+                     if p.suffix.lower().lstrip(".") in self.allowed]
+        out = [str(p) for p in files]
+        if self.seed is not None:
+            random.Random(self.seed).shuffle(out)
+        return out
+
+
+class CollectionInputSplit(InputSplit):
+    """A fixed list of locations (reference ``CollectionInputSplit``)."""
+
+    def __init__(self, locations: Sequence[str]):
+        self._locations = [str(u) for u in locations]
+
+    def locations(self) -> List[str]:
+        return list(self._locations)
+
+
+class NumberedFileInputSplit(InputSplit):
+    """Pattern like ``file_%d.csv`` over an index range (reference
+    ``NumberedFileInputSplit``), used heavily for per-sequence CSV files."""
+
+    def __init__(self, base_string: str, min_idx: int, max_idx: int):
+        if not re.search(r"%(0\d+)?d", base_string):
+            raise ValueError(f"pattern must contain %d: {base_string!r}")
+        self.base_string = base_string
+        self.min_idx = int(min_idx)
+        self.max_idx = int(max_idx)
+
+    def locations(self) -> List[str]:
+        return [self.base_string % i
+                for i in range(self.min_idx, self.max_idx + 1)]
+
+
+class StringSplit(InputSplit):
+    """A single in-memory string 'location' (reference ``StringSplit``)."""
+
+    def __init__(self, data: str):
+        self.data = data
+
+    def locations(self) -> List[str]:
+        return [self.data]

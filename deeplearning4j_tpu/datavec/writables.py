@@ -1,0 +1,140 @@
+"""Writable value types.
+
+Reference: ``org.datavec.api.writable.*`` — typed record cell values
+(IntWritable, DoubleWritable, Text, NDArrayWritable, …) flowing between
+record readers and transforms. Here they are thin wrappers over Python
+scalars/ndarrays; readers may also emit raw Python values, and
+``as_writable``/``value_of`` normalize at the boundaries.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Writable:
+    """Base record cell (reference ``org.datavec.api.writable.Writable``)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+    def to_double(self) -> float:
+        return float(self.value)
+
+    def to_int(self) -> int:
+        return int(self.value)
+
+    def to_string(self) -> str:
+        return str(self.value)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.value!r})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and _eq(self.value, other.value)
+
+    def __hash__(self):
+        return hash((type(self).__name__, _hashable(self.value)))
+
+
+def _eq(a, b):
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        return np.array_equal(np.asarray(a), np.asarray(b))
+    return a == b
+
+
+def _hashable(v):
+    if isinstance(v, np.ndarray):
+        return v.tobytes()
+    return v
+
+
+class IntWritable(Writable):
+    def __init__(self, value):
+        super().__init__(int(value))
+
+
+class LongWritable(Writable):
+    def __init__(self, value):
+        super().__init__(int(value))
+
+
+class FloatWritable(Writable):
+    def __init__(self, value):
+        super().__init__(float(value))
+
+
+class DoubleWritable(Writable):
+    def __init__(self, value):
+        super().__init__(float(value))
+
+
+class BooleanWritable(Writable):
+    def __init__(self, value):
+        super().__init__(bool(value))
+
+    def to_double(self):
+        return 1.0 if self.value else 0.0
+
+
+class Text(Writable):
+    def __init__(self, value):
+        super().__init__(str(value))
+
+    def to_double(self):
+        return float(self.value)
+
+
+class NullWritable(Writable):
+    def __init__(self):
+        super().__init__(None)
+
+    def to_double(self):
+        raise ValueError("NullWritable has no numeric value")
+
+
+class NDArrayWritable(Writable):
+    """Whole-tensor cell (reference ``NDArrayWritable`` wrapping INDArray)."""
+
+    def __init__(self, value):
+        super().__init__(np.asarray(value))
+
+    def to_double(self):
+        if self.value.size != 1:
+            raise ValueError("NDArrayWritable with size != 1 has no scalar value")
+        return float(self.value.reshape(())[()])
+
+
+def as_writable(v) -> Writable:
+    """Wrap a raw Python/numpy value in the matching Writable."""
+    if isinstance(v, Writable):
+        return v
+    if v is None:
+        return NullWritable()
+    if isinstance(v, bool):
+        return BooleanWritable(v)
+    if isinstance(v, (int, np.integer)):
+        return IntWritable(v)
+    if isinstance(v, (float, np.floating)):
+        return DoubleWritable(v)
+    if isinstance(v, str):
+        return Text(v)
+    if isinstance(v, np.ndarray):
+        return NDArrayWritable(v)
+    raise TypeError(f"no Writable for {type(v).__name__}")
+
+
+def value_of(v):
+    """Unwrap a Writable (or pass through a raw value)."""
+    return v.value if isinstance(v, Writable) else v
+
+
+def numeric_of(v) -> float:
+    """Cell → float (used when assembling feature matrices)."""
+    if isinstance(v, Writable):
+        return v.to_double()
+    if isinstance(v, str):
+        return float(v)
+    return float(v)

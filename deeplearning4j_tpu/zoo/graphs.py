@@ -19,7 +19,8 @@ from deeplearning4j_tpu.conf.graph import (
     ElementWiseVertex,
     MergeVertex,
 )
-from deeplearning4j_tpu.conf.layers import ActivationLayer, DenseLayer, OutputLayer
+from deeplearning4j_tpu.conf.layers import (ActivationLayer, DenseLayer,
+    LossLayer, OutputLayer)
 from deeplearning4j_tpu.conf.layers_cnn import (
     BatchNormalization,
     CnnLossLayer,
@@ -212,8 +213,9 @@ class SqueezeNet(GraphZooModel):
         g.add_layer("conv10", _conv(self.num_classes, (1, 1)), x)
         g.add_layer("avgpool",
                     GlobalPoolingLayer(pooling_type=PoolingType.AVG), "conv10")
-        g.add_layer("output", OutputLayer(
-            n_out=self.num_classes, has_bias=False,
+        # avgpool already yields num_classes features: a parameter-free
+        # LossLayer head, matching the reference topology (no extra dense)
+        g.add_layer("output", LossLayer(
             activation=Activation.SOFTMAX, loss_fn=LossMCXENT()), "avgpool")
         g.set_outputs("output")
         return g.build()
@@ -266,8 +268,9 @@ class Darknet19(GraphZooModel):
                                   act=Activation.IDENTITY), x)
         g.add_layer("avgpool",
                     GlobalPoolingLayer(pooling_type=PoolingType.AVG), "head")
-        g.add_layer("output", OutputLayer(
-            n_out=self.num_classes, has_bias=False,
+        # avgpool already yields num_classes features: a parameter-free
+        # LossLayer head, matching the reference topology (no extra dense)
+        g.add_layer("output", LossLayer(
             activation=Activation.SOFTMAX, loss_fn=LossMCXENT()), "avgpool")
         g.set_outputs("output")
         return g.build()

@@ -1,0 +1,235 @@
+"""DataVec-equivalent pipeline tests (reference test model: datavec-api
+reader/transform tests + dl4j-core RecordReaderDataSetIterator tests)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datavec import (
+    CSVRecordReader, CSVSequenceRecordReader, CollectionRecordReader,
+    CollectionSequenceRecordReader, FileSplit, CollectionInputSplit,
+    JsonRecordReader, LineRecordReader, NumberedFileInputSplit,
+    RecordReaderDataSetIterator, RegexLineRecordReader, Schema,
+    SequenceRecordReaderDataSetIterator, StringSplit, TransformProcess,
+    TransformProcessRecordReader,
+)
+from deeplearning4j_tpu.datavec.bridge import AlignmentMode
+from deeplearning4j_tpu.datavec.transform import (
+    CategoricalToInteger, ConditionOp, MathOp, MinMaxNormalize,
+    StandardizeNormalize,
+)
+
+
+# --------------------------------------------------------------------------
+# splits
+# --------------------------------------------------------------------------
+def test_file_split_filters_and_recurses(tmp_path):
+    (tmp_path / "a").mkdir()
+    (tmp_path / "a" / "x.csv").write_text("1,2\n")
+    (tmp_path / "a" / "y.txt").write_text("no")
+    (tmp_path / "z.csv").write_text("3,4\n")
+    locs = FileSplit(tmp_path, allowed_extensions=["csv"]).locations()
+    assert [l.split("/")[-1] for l in locs] == ["x.csv", "z.csv"]
+
+
+def test_numbered_file_split():
+    s = NumberedFileInputSplit("seq_%d.csv", 0, 2)
+    assert s.locations() == ["seq_0.csv", "seq_1.csv", "seq_2.csv"]
+    with pytest.raises(ValueError):
+        NumberedFileInputSplit("nopattern.csv", 0, 1)
+
+
+# --------------------------------------------------------------------------
+# readers
+# --------------------------------------------------------------------------
+def test_csv_record_reader(tmp_path):
+    f = tmp_path / "data.csv"
+    f.write_text("h1,h2\n1,2\n3,4\n")
+    rr = CSVRecordReader(skip_num_lines=1).initialize(FileSplit(f))
+    assert list(rr) == [["1", "2"], ["3", "4"]]
+
+
+def test_csv_reader_string_split():
+    rr = CSVRecordReader().initialize(StringSplit("5,6\n7,8"))
+    assert list(rr) == [["5", "6"], ["7", "8"]]
+
+
+def test_line_and_regex_readers(tmp_path):
+    f = tmp_path / "log.txt"
+    f.write_text("INFO 100\nWARN 200\n")
+    assert list(LineRecordReader().initialize(FileSplit(f))) == [
+        ["INFO 100"], ["WARN 200"]]
+    rr = RegexLineRecordReader(r"(\w+) (\d+)").initialize(FileSplit(f))
+    assert list(rr) == [["INFO", "100"], ["WARN", "200"]]
+
+
+def test_json_record_reader(tmp_path):
+    f = tmp_path / "data.jsonl"
+    f.write_text('{"a": 1, "b": "x"}\n{"a": 2, "b": "y"}\n')
+    rr = JsonRecordReader(["b", "a"]).initialize(FileSplit(f))
+    assert list(rr) == [["x", 1], ["y", 2]]
+
+
+def test_csv_sequence_reader(tmp_path):
+    for i in range(2):
+        (tmp_path / f"seq_{i}.csv").write_text(f"{i},0\n{i},1\n")
+    rr = CSVSequenceRecordReader().initialize(
+        NumberedFileInputSplit(str(tmp_path / "seq_%d.csv"), 0, 1))
+    seqs = list(rr)
+    assert seqs[0] == [["0", "0"], ["0", "1"]]
+    assert seqs[1] == [["1", "0"], ["1", "1"]]
+
+
+# --------------------------------------------------------------------------
+# schema + transform process
+# --------------------------------------------------------------------------
+def _schema():
+    return (Schema.builder()
+            .add_column_string("name")
+            .add_column_categorical("color", ["red", "green", "blue"])
+            .add_column_double("value")
+            .build())
+
+
+def test_schema_json_roundtrip():
+    s = _schema()
+    s2 = Schema.from_json(s.to_json())
+    assert s2 == s
+    assert s2.index_of("value") == 2
+
+
+def test_transform_process_chain_and_roundtrip():
+    tp = (TransformProcess.builder(_schema())
+          .remove_columns("name")
+          .categorical_to_integer("color")
+          .math_op("value", MathOp.Multiply, 2.0)
+          .filter_condition("value", ConditionOp.GreaterThan, 10.0)
+          .build())
+    out = tp.execute([["a", "red", "3.0"], ["b", "blue", "7.0"]])
+    # 3*2=6 kept, 7*2=14 filtered (condition true => removed)
+    assert out == [[0, 6.0]]
+    tp2 = TransformProcess.from_json(tp.to_json())
+    assert tp2.execute([["a", "green", "4.0"]]) == [[1, 8.0]]
+    final = tp.final_schema()
+    assert final.names() == ["color", "value"]
+
+
+def test_one_hot_and_normalize():
+    tp = (TransformProcess.builder(_schema())
+          .remove_columns("name")
+          .categorical_to_one_hot("color")
+          .normalize(MinMaxNormalize("value", 0.0, 10.0))
+          .build())
+    out = tp.execute_record(["x", "green", 5.0])
+    assert out == [0, 1, 0, 0.5]
+    assert tp.final_schema().names() == [
+        "color[red]", "color[green]", "color[blue]", "value"]
+
+
+def test_fit_normalizers():
+    schema = Schema.builder().add_column_double("v").build()
+    records = [[1.0], [2.0], [3.0]]
+    (norm,) = TransformProcess.fit_normalizers(schema, records, ["v"],
+                                               kind="standardize")
+    assert isinstance(norm, StandardizeNormalize)
+    assert norm.mean == pytest.approx(2.0)
+    out = [norm.map_record(schema, r)[0] for r in records]
+    assert np.mean(out) == pytest.approx(0.0)
+
+
+def test_transform_process_record_reader():
+    tp = (TransformProcess.builder(_schema())
+          .remove_columns("name")
+          .categorical_to_integer("color")
+          .build())
+    rr = CollectionRecordReader([["a", "red", 1.0], ["b", "blue", 2.0]])
+    wrapped = TransformProcessRecordReader(rr, tp)
+    wrapped.initialize(None)
+    assert list(wrapped) == [[0, 1.0], [2, 2.0]]
+
+
+# --------------------------------------------------------------------------
+# dataset bridge
+# --------------------------------------------------------------------------
+def test_rr_dataset_iterator_classification():
+    rr = CollectionRecordReader([[0.1, 0.2, 0], [0.3, 0.4, 1],
+                                 [0.5, 0.6, 2]])
+    it = RecordReaderDataSetIterator(rr, batch_size=2, label_index=2,
+                                     num_possible_labels=3)
+    batches = list(it)
+    assert batches[0].features.shape == (2, 2)
+    assert batches[0].labels.shape == (2, 3)
+    np.testing.assert_allclose(batches[0].labels[1], [0, 1, 0])
+    assert batches[1].features.shape == (1, 2)
+
+
+def test_rr_dataset_iterator_regression_range():
+    rr = CollectionRecordReader([[1.0, 2.0, 3.0, 4.0]])
+    it = RecordReaderDataSetIterator(rr, batch_size=1, label_index=2,
+                                     label_index_to=3, regression=True)
+    (ds,) = list(it)
+    np.testing.assert_allclose(ds.features, [[1.0, 2.0]])
+    np.testing.assert_allclose(ds.labels, [[3.0, 4.0]])
+
+
+def test_sequence_iterator_masking_align_start_end():
+    seqs = [
+        [[0.0, 1.0, 0], [0.1, 1.1, 1]],                    # len 2
+        [[0.2, 1.2, 1], [0.3, 1.3, 0], [0.4, 1.4, 1]],     # len 3
+    ]
+    rr = CollectionSequenceRecordReader(seqs)
+    it = SequenceRecordReaderDataSetIterator(
+        rr, batch_size=2, label_index=2, num_possible_labels=2)
+    (ds,) = list(it)
+    assert ds.features.shape == (2, 3, 2)   # [batch, time, feat]
+    assert ds.labels.shape == (2, 3, 2)
+    np.testing.assert_allclose(ds.labels_mask, [[1, 1, 0], [1, 1, 1]])
+    np.testing.assert_allclose(ds.features[0, 1], [0.1, 1.1])
+    # ALIGN_END pads at the front
+    rr2 = CollectionSequenceRecordReader(seqs)
+    it2 = SequenceRecordReaderDataSetIterator(
+        rr2, batch_size=2, label_index=2, num_possible_labels=2,
+        alignment=AlignmentMode.ALIGN_END)
+    (ds2,) = list(it2)
+    np.testing.assert_allclose(ds2.labels_mask, [[0, 1, 1], [1, 1, 1]])
+    np.testing.assert_allclose(ds2.features[0, 1], [0.0, 1.0])
+
+
+def test_sequence_iterator_channels_first():
+    seqs = [[[0.0, 1.0, 0], [0.1, 1.1, 1]]]
+    rr = CollectionSequenceRecordReader(seqs)
+    it = SequenceRecordReaderDataSetIterator(
+        rr, batch_size=1, label_index=2, num_possible_labels=2,
+        channels_first=True)
+    (ds,) = list(it)
+    assert ds.features.shape == (1, 2, 2)
+    np.testing.assert_allclose(ds.features[0, :, 1], [0.1, 1.1])
+
+
+def test_condition_equal_coerces_csv_strings():
+    # CSV cells are strings; Equal/InSet must match numeric condition values
+    schema = Schema.builder().add_column_integer("age").build()
+    tp = (TransformProcess.builder(schema)
+          .filter_condition("age", ConditionOp.Equal, 30)
+          .build())
+    assert tp.execute([["30"], ["31"]]) == [["31"]]
+    tp2 = (TransformProcess.builder(schema)
+           .filter_condition("age", ConditionOp.InSet, [30, 40])
+           .build())
+    assert tp2.execute([["30"], ["35"], ["40"]]) == [["35"]]
+
+
+def test_transform_reader_reset_delegates():
+    class CountingReader(CollectionRecordReader):
+        def __init__(self):
+            super().__init__([[1.0]])
+            self.resets = 0
+
+        def reset(self):
+            self.resets += 1
+
+    inner = CountingReader()
+    tp = TransformProcess.builder(
+        Schema.builder().add_column_double("v").build()).build()
+    wrapped = TransformProcessRecordReader(inner, tp)
+    wrapped.reset()
+    assert inner.resets == 1

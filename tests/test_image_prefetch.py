@@ -1,0 +1,163 @@
+"""Image reader, augmentation and async prefetch tests (reference model:
+datavec-data-image tests + AsyncDataSetIterator tests)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.datasets.normalizers import (
+    ImagePreProcessingScaler, NormalizerMinMaxScaler, NormalizerStandardize,
+    normalizer_from_state,
+)
+from deeplearning4j_tpu.datasets.prefetch import AsyncDataSetIterator
+from deeplearning4j_tpu.datavec import (
+    FileSplit, RecordReaderDataSetIterator,
+)
+from deeplearning4j_tpu.datavec.image import (
+    CropImageTransform, FlipImageTransform, ImageLoader, ImageRecordReader,
+    ParentPathLabelGenerator, PipelineImageTransform, RandomCropTransform,
+    ResizeImageTransform,
+)
+
+
+def _write_png(path, color, size=(8, 8)):
+    from PIL import Image
+
+    arr = np.zeros((size[0], size[1], 3), np.uint8)
+    arr[..., :] = color
+    Image.fromarray(arr).save(path)
+
+
+@pytest.fixture
+def image_dir(tmp_path):
+    for label, color in [("cats", (255, 0, 0)), ("dogs", (0, 0, 255))]:
+        d = tmp_path / label
+        d.mkdir()
+        for i in range(3):
+            _write_png(d / f"{i}.png", color)
+    return tmp_path
+
+
+def test_image_loader_hwc_and_chw(image_dir):
+    p = next((image_dir / "cats").glob("*.png"))
+    img = ImageLoader(4, 6, 3).as_matrix(p)
+    assert img.shape == (4, 6, 3)
+    assert img[0, 0, 0] == 255.0
+    chw = ImageLoader(4, 6, 3, channels_first=True).as_matrix(p)
+    assert chw.shape == (3, 4, 6)
+    gray = ImageLoader(4, 4, 1).as_matrix(p)
+    assert gray.shape == (4, 4, 1)
+
+
+def test_image_record_reader_labels_sorted(image_dir):
+    rr = ImageRecordReader(8, 8, 3,
+                           label_generator=ParentPathLabelGenerator())
+    rr.initialize(FileSplit(image_dir, allowed_extensions=["png"]))
+    assert rr.labels() == ["cats", "dogs"]
+    recs = list(rr)
+    assert len(recs) == 6
+    labels = sorted(r[1] for r in recs)
+    assert labels == [0, 0, 0, 1, 1, 1]
+    assert recs[0][0].shape == (8, 8, 3)
+
+
+def test_image_pipeline_to_dataset(image_dir):
+    rr = ImageRecordReader(8, 8, 3,
+                           label_generator=ParentPathLabelGenerator())
+    rr.initialize(FileSplit(image_dir, allowed_extensions=["png"]))
+    it = RecordReaderDataSetIterator(rr, batch_size=4, label_index=1,
+                                     num_possible_labels=2)
+    it.set_preprocessor(ImagePreProcessingScaler())
+    batches = list(it)
+    assert batches[0].features.shape == (4, 8, 8, 3)
+    assert batches[0].features.max() <= 1.0
+    assert batches[0].labels.shape == (4, 2)
+
+
+def test_transforms():
+    import random
+
+    rng = random.Random(0)
+    img = np.arange(4 * 4 * 1, dtype=np.float32).reshape(4, 4, 1)
+    flipped = FlipImageTransform(mode=1).apply(img, rng)
+    np.testing.assert_allclose(flipped[0, :, 0], img[0, ::-1, 0])
+    cropped = CropImageTransform(1, 1, 1, 1).apply(img, rng)
+    assert cropped.shape == (2, 2, 1)
+    rcrop = RandomCropTransform(2, 2).apply(img, rng)
+    assert rcrop.shape == (2, 2, 1)
+    resized = ResizeImageTransform(8, 8).apply(img, rng)
+    assert resized.shape == (8, 8, 1)
+    pipe = PipelineImageTransform([(FlipImageTransform(mode=1), 1.0),
+                                   ResizeImageTransform(2, 2)])
+    assert pipe.apply(img, rng).shape == (2, 2, 1)
+
+
+def test_normalizer_standardize_roundtrip():
+    feats = np.random.default_rng(0).normal(5.0, 3.0, (100, 4)).astype(np.float32)
+    it = ArrayDataSetIterator(feats, np.zeros((100, 1)), batch=25)
+    norm = NormalizerStandardize().fit(it)
+    ds = DataSet(feats.copy(), np.zeros((100, 1)))
+    norm.transform(ds)
+    assert abs(ds.features.mean()) < 1e-4
+    assert abs(ds.features.std() - 1.0) < 1e-2
+    norm.revert(ds)
+    np.testing.assert_allclose(ds.features, feats, atol=1e-3)
+    # state round-trip (serializer hook)
+    norm2 = normalizer_from_state(norm.state_dict())
+    ds2 = norm2.transform(DataSet(feats.copy(), np.zeros((100, 1))))
+    assert abs(ds2.features.mean()) < 1e-4
+
+
+def test_normalizer_minmax():
+    feats = np.array([[0.0, 10.0], [5.0, 20.0], [10.0, 30.0]], np.float32)
+    it = ArrayDataSetIterator(feats, np.zeros((3, 1)), batch=3,
+                              drop_last=False)
+    norm = NormalizerMinMaxScaler().fit(it)
+    ds = norm.transform(DataSet(feats.copy(), np.zeros((3, 1))))
+    np.testing.assert_allclose(ds.features.min(0), [0, 0])
+    np.testing.assert_allclose(ds.features.max(0), [1, 1])
+
+
+def test_async_iterator_matches_sync_and_resets():
+    feats = np.arange(40, dtype=np.float32).reshape(20, 2)
+    labels = np.zeros((20, 1), np.float32)
+    base = ArrayDataSetIterator(feats, labels, batch=4)
+    sync = [ds.features.copy() for ds in base]
+    base.reset()
+    async_it = AsyncDataSetIterator(ArrayDataSetIterator(feats, labels, batch=4),
+                                    queue_size=2)
+    got = [np.asarray(ds.features) for ds in async_it]
+    assert len(got) == len(sync)
+    for a, b in zip(got, sync):
+        np.testing.assert_allclose(a, b)
+    # second epoch works after implicit re-iteration
+    got2 = [np.asarray(ds.features) for ds in async_it]
+    assert len(got2) == len(sync)
+
+
+def test_async_iterator_propagates_errors():
+    class Boom(ArrayDataSetIterator):
+        def __iter__(self):
+            yield DataSet(np.zeros((2, 2)), np.zeros((2, 1)))
+            raise RuntimeError("ETL failure")
+
+    it = AsyncDataSetIterator(Boom(np.zeros((4, 2)), np.zeros((4, 1)), batch=2))
+    with pytest.raises(RuntimeError, match="ETL failure"):
+        list(it)
+
+
+def test_async_iterator_early_break_stops_producer():
+    import threading
+
+    feats = np.arange(200, dtype=np.float32).reshape(100, 2)
+    it = AsyncDataSetIterator(
+        ArrayDataSetIterator(feats, np.zeros((100, 1)), batch=2),
+        queue_size=2)
+    for i, ds in enumerate(it):
+        if i == 1:
+            break
+    # generator close must have stopped the producer thread
+    alive = [t for t in threading.enumerate()
+             if t.name == "AsyncDataSetIterator" and t.is_alive()]
+    assert not alive
