@@ -9,3 +9,10 @@ API-parity surface. Execution lowers them to jitted XLA programs.
 from deeplearning4j_tpu.conf.activations import Activation
 from deeplearning4j_tpu.conf.inputs import InputType
 from deeplearning4j_tpu.conf.weights import WeightInit
+
+# import layer/loss/updater modules for their serde tag registrations, so
+# from_json works regardless of which entry point the user imported first
+from deeplearning4j_tpu.conf import (  # noqa: E402,F401
+    layers, layers_cnn, layers_rnn, losses, regularization, schedules,
+    updaters,
+)

@@ -1,0 +1,481 @@
+"""Recurrent layers.
+
+Reference: ``org.deeplearning4j.nn.conf.layers.{SimpleRnn, LSTM, GravesLSTM,
+Bidirectional, LastTimeStep, RnnOutputLayer, RnnLossLayer}`` +
+``org.deeplearning4j.nn.layers.recurrent.*`` (``LSTMHelpers`` fused cell,
+``MaskZeroLayer``) and the masking/tBPTT semantics of SURVEY.md §5.7.
+
+TPU-native design: the whole sequence runs as ONE ``lax.scan`` inside the
+jitted program (the reference loops timesteps in Java, issuing per-step JNI
+ops). Data layout is ``[batch, time, features]`` (reference: [batch,
+features, time]; the dataset bridge transposes at the boundary). Per-timestep
+masks [batch, time] gate both the carried state (masked steps pass state
+through unchanged) and the emitted output (zeroed), which reproduces the
+reference's masked-RNN behavior for variable-length batches.
+
+Gate order in the packed LSTM weights is **IFOG** (input, forget, output,
+cell-gate) along the last axis; the reference packs gates in its own fixed
+order inside ``LSTMParamInitializer`` — any fixed order is equivalent, ours
+is documented here and locked by the serializer round-trip tests.
+
+Carry/state contract (tBPTT + streaming inference): layers with recurrence
+set ``has_carry = True`` and implement ``zero_carry`` /
+``forward_with_carry``; plain ``forward`` starts from the zero carry. The
+network threads carries across tBPTT segments and ``rnn_time_step`` calls
+(reference: ``rnnTimeStep`` / ``rnnSetPreviousState`` state maps).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import serde
+from deeplearning4j_tpu.conf import inputs as it
+from deeplearning4j_tpu.conf.activations import Activation
+from deeplearning4j_tpu.conf.layers import BaseLayer, DenseLayer, Layer
+from deeplearning4j_tpu.conf.losses import ILossFunction, LossMCXENT
+
+
+def _rnn_in_size(input_type) -> int:
+    if isinstance(input_type, it.Recurrent):
+        return input_type.size
+    if isinstance(input_type, it.FeedForward):
+        return input_type.size
+    raise ValueError(f"recurrent layer needs Recurrent input, got {input_type}")
+
+
+def _mask_bt1(mask, x):
+    """[batch, time] mask -> [batch, time, 1] float (or ones)."""
+    if mask is None:
+        return jnp.ones(x.shape[:2] + (1,), x.dtype)
+    return jnp.asarray(mask, x.dtype)[:, :, None]
+
+
+def reverse_sequence(x, mask=None):
+    """Reverse the VALID portion of each sequence, keeping padding in place
+    (reference ``ReverseTimeSeriesVertex`` used by ``Bidirectional``).
+    Assumes ALIGN_START masks (valid steps first), the bridge's default."""
+    T = x.shape[1]
+    t = jnp.arange(T)
+    if mask is None:
+        return x[:, ::-1, :]
+    lengths = jnp.sum(jnp.asarray(mask, jnp.int32), axis=1)  # [batch]
+    src = jnp.where(t[None, :] < lengths[:, None],
+                    lengths[:, None] - 1 - t[None, :], t[None, :])
+    return jnp.take_along_axis(x, src[:, :, None], axis=1)
+
+
+@dataclasses.dataclass
+class BaseRecurrentLayer(BaseLayer):
+    """Common recurrent conf (reference ``BaseRecurrentLayer``)."""
+
+    n_out: int = 0
+    activation: Activation = Activation.TANH
+
+    uses_mask = True
+    has_carry = True
+
+    def output_type(self, input_type):
+        ts = input_type.timesteps if isinstance(input_type, it.Recurrent) else -1
+        return it.Recurrent(size=self.n_out, timesteps=ts)
+
+    def zero_carry(self, batch: int, dtype=jnp.float32) -> dict:
+        raise NotImplementedError
+
+    def forward_with_carry(self, params, carry, x, mask=None, train=False,
+                           rng=None):
+        raise NotImplementedError
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None):
+        carry = self.zero_carry(x.shape[0], x.dtype)
+        y, _ = self.forward_with_carry(params, carry, x, mask=mask,
+                                       train=train, rng=rng)
+        return y, state
+
+
+@serde.register
+@dataclasses.dataclass
+class SimpleRnn(BaseRecurrentLayer):
+    """Vanilla RNN: h_t = act(x_t·W + h_{t-1}·RW + b) (reference
+    ``SimpleRnn``). W: [nIn, nOut], RW: [nOut, nOut], b: [nOut]."""
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        n_in = _rnn_in_size(input_type)
+        k1, k2 = jax.random.split(key)
+        return {
+            "W": self.weight_init.init(k1, (n_in, self.n_out), n_in,
+                                       self.n_out, dtype, self.distribution),
+            "RW": self.weight_init.init(k2, (self.n_out, self.n_out),
+                                        self.n_out, self.n_out, dtype,
+                                        self.distribution),
+            "b": jnp.full((self.n_out,), self.bias_init, dtype),
+        }
+
+    def param_order(self):
+        return ["W", "RW", "b"]
+
+    def regularized_param_keys(self):
+        # recurrent weights are weights for L1/L2 purposes (the reference
+        # regularizes input and recurrent matrices alike, biases excluded)
+        return ["W", "RW"]
+
+    def zero_carry(self, batch, dtype=jnp.float32):
+        return {"h": jnp.zeros((batch, self.n_out), dtype)}
+
+    def forward_with_carry(self, params, carry, x, mask=None, train=False,
+                           rng=None):
+        x = self._dropout_input(x, train, rng)
+        m = _mask_bt1(mask, x)
+        # hoist the input projection out of the scan: one big [B*T] matmul
+        # on the MXU instead of T small ones
+        xw = jnp.einsum("btf,fh->bth", x, params["W"]) + params["b"]
+
+        def step(h, inp):
+            xw_t, m_t = inp
+            h_new = self.activation.apply(xw_t + h @ params["RW"])
+            h = m_t * h_new + (1.0 - m_t) * h
+            return h, m_t * h_new
+
+        h0 = carry["h"]
+        h_final, ys = jax.lax.scan(
+            step, h0, (jnp.swapaxes(xw, 0, 1), jnp.swapaxes(m, 0, 1)))
+        return jnp.swapaxes(ys, 0, 1), {"h": h_final}
+
+
+@serde.register
+@dataclasses.dataclass
+class LSTM(BaseRecurrentLayer):
+    """LSTM without peepholes (reference ``LSTM`` conf /
+    ``LSTMHelpers#activateHelper``). Packed weights, IFOG gate order:
+    W: [nIn, 4*nOut], RW: [nOut, 4*nOut], b: [4*nOut]; forget-gate bias
+    initialized to ``forget_gate_bias_init`` (reference
+    ``forgetGateBiasInit``, default 1.0)."""
+
+    forget_gate_bias_init: float = 1.0
+    gate_activation: Activation = Activation.SIGMOID
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        n_in = _rnn_in_size(input_type)
+        h = self.n_out
+        k1, k2 = jax.random.split(key)
+        b = jnp.full((4 * h,), self.bias_init, dtype)
+        b = b.at[h:2 * h].set(self.forget_gate_bias_init)
+        return {
+            "W": self.weight_init.init(k1, (n_in, 4 * h), n_in, h, dtype,
+                                       self.distribution),
+            "RW": self.weight_init.init(k2, (h, 4 * h), h, h, dtype,
+                                        self.distribution),
+            "b": b,
+        }
+
+    def param_order(self):
+        return ["W", "RW", "b"]
+
+    def regularized_param_keys(self):
+        return ["W", "RW"]
+
+    def zero_carry(self, batch, dtype=jnp.float32):
+        return {"h": jnp.zeros((batch, self.n_out), dtype),
+                "c": jnp.zeros((batch, self.n_out), dtype)}
+
+    def _gates(self, z, c_prev, params):
+        h = self.n_out
+        i = self.gate_activation.apply(z[:, 0 * h:1 * h])
+        f = self.gate_activation.apply(z[:, 1 * h:2 * h])
+        o = self.gate_activation.apply(z[:, 2 * h:3 * h])
+        g = self.activation.apply(z[:, 3 * h:4 * h])
+        return i, f, o, g
+
+    def forward_with_carry(self, params, carry, x, mask=None, train=False,
+                           rng=None):
+        x = self._dropout_input(x, train, rng)
+        m = _mask_bt1(mask, x)
+        xw = jnp.einsum("btf,fg->btg", x, params["W"]) + params["b"]
+
+        def step(hc, inp):
+            h_prev, c_prev = hc
+            xw_t, m_t = inp
+            z = xw_t + h_prev @ params["RW"]
+            i, f, o, g = self._gates(z, c_prev, params)
+            c_new = f * c_prev + i * g
+            h_new = o * self.activation.apply(c_new)
+            c = m_t * c_new + (1.0 - m_t) * c_prev
+            h = m_t * h_new + (1.0 - m_t) * h_prev
+            return (h, c), m_t * h_new
+
+        (h_f, c_f), ys = jax.lax.scan(
+            step, (carry["h"], carry["c"]),
+            (jnp.swapaxes(xw, 0, 1), jnp.swapaxes(m, 0, 1)))
+        return jnp.swapaxes(ys, 0, 1), {"h": h_f, "c": c_f}
+
+
+@serde.register
+@dataclasses.dataclass
+class GravesLSTM(LSTM):
+    """LSTM with peephole connections (reference ``GravesLSTM``, Graves
+    2013): input/forget gates peek at c_{t-1}, output gate at c_t. Peephole
+    weights are separate vectors pI/pF/pO [nOut] (the reference packs them
+    into extra recurrent-weight columns; separate keys are equivalent and
+    serializer-locked)."""
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        params = super().init(key, input_type, dtype)
+        params["pI"] = jnp.zeros((self.n_out,), dtype)
+        params["pF"] = jnp.zeros((self.n_out,), dtype)
+        params["pO"] = jnp.zeros((self.n_out,), dtype)
+        return params
+
+    def param_order(self):
+        return ["W", "RW", "b", "pI", "pF", "pO"]
+
+    def regularized_param_keys(self):
+        # the reference packs peepholes into the recurrent weight matrix, so
+        # they are regularized as weights there; mirror that
+        return ["W", "RW", "pI", "pF", "pO"]
+
+    def forward_with_carry(self, params, carry, x, mask=None, train=False,
+                           rng=None):
+        x = self._dropout_input(x, train, rng)
+        m = _mask_bt1(mask, x)
+        h_units = self.n_out
+        xw = jnp.einsum("btf,fg->btg", x, params["W"]) + params["b"]
+
+        def step(hc, inp):
+            h_prev, c_prev = hc
+            xw_t, m_t = inp
+            z = xw_t + h_prev @ params["RW"]
+            i = self.gate_activation.apply(
+                z[:, 0:h_units] + params["pI"] * c_prev)
+            f = self.gate_activation.apply(
+                z[:, h_units:2 * h_units] + params["pF"] * c_prev)
+            g = self.activation.apply(z[:, 3 * h_units:4 * h_units])
+            c_new = f * c_prev + i * g
+            o = self.gate_activation.apply(
+                z[:, 2 * h_units:3 * h_units] + params["pO"] * c_new)
+            h_new = o * self.activation.apply(c_new)
+            c = m_t * c_new + (1.0 - m_t) * c_prev
+            h = m_t * h_new + (1.0 - m_t) * h_prev
+            return (h, c), m_t * h_new
+
+        (h_f, c_f), ys = jax.lax.scan(
+            step, (carry["h"], carry["c"]),
+            (jnp.swapaxes(xw, 0, 1), jnp.swapaxes(m, 0, 1)))
+        return jnp.swapaxes(ys, 0, 1), {"h": h_f, "c": c_f}
+
+
+@serde.register_enum
+class BidirectionalMode(enum.Enum):
+    """Reference ``Bidirectional.Mode``."""
+
+    ADD = "ADD"
+    MUL = "MUL"
+    AVERAGE = "AVERAGE"
+    CONCAT = "CONCAT"
+
+
+@serde.register
+@dataclasses.dataclass
+class Bidirectional(Layer):
+    """Wraps a recurrent layer, running it forward and (mask-aware)
+    time-reversed, combining per mode (reference ``Bidirectional`` wrapper).
+    Param keys take the reference's ``f``/``b`` prefixes (fW, bW, …) so the
+    flat-params convention stays a flat dict per layer."""
+
+    layer: Optional[BaseRecurrentLayer] = None
+    mode: BidirectionalMode = BidirectionalMode.CONCAT
+
+    uses_mask = True
+    # streaming inference is undefined for the backward pass; the reference
+    # Bidirectional also cannot rnnTimeStep
+    has_carry = False
+
+    def output_type(self, input_type):
+        out = self.layer.output_type(input_type)
+        if self.mode is BidirectionalMode.CONCAT:
+            return it.Recurrent(size=2 * out.size, timesteps=out.timesteps)
+        return out
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        kf, kb = jax.random.split(key)
+        fwd = self.layer.init(kf, input_type, dtype)
+        bwd = self.layer.init(kb, input_type, dtype)
+        out = {f"f{k}": v for k, v in fwd.items()}
+        out.update({f"b{k}": v for k, v in bwd.items()})
+        return out
+
+    def param_order(self):
+        inner = self.layer.param_order()
+        return [f"f{k}" for k in inner] + [f"b{k}" for k in inner]
+
+    def regularized_param_keys(self):
+        return [f"f{k}" for k in self.layer.regularized_param_keys()] + \
+               [f"b{k}" for k in self.layer.regularized_param_keys()]
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None):
+        fwd_p = {k[1:]: v for k, v in params.items() if k.startswith("f")}
+        bwd_p = {k[1:]: v for k, v in params.items() if k.startswith("b")}
+        rf, rb = (jax.random.split(rng) if rng is not None else (None, None))
+        carry = self.layer.zero_carry(x.shape[0], x.dtype)
+        y_f, _ = self.layer.forward_with_carry(fwd_p, carry, x, mask=mask,
+                                               train=train, rng=rf)
+        x_rev = reverse_sequence(x, mask)
+        y_b, _ = self.layer.forward_with_carry(bwd_p, carry, x_rev, mask=mask,
+                                               train=train, rng=rb)
+        y_b = reverse_sequence(y_b, mask)
+        if self.mode is BidirectionalMode.ADD:
+            return y_f + y_b, state
+        if self.mode is BidirectionalMode.MUL:
+            return y_f * y_b, state
+        if self.mode is BidirectionalMode.AVERAGE:
+            return 0.5 * (y_f + y_b), state
+        return jnp.concatenate([y_f, y_b], axis=-1), state
+
+
+def _last_valid_index(mask, total_t):
+    """Index of the LAST nonzero mask step per sample — correct for both
+    ALIGN_START and ALIGN_END padding (argmax over the reversed mask finds
+    the last 1; all-masked rows degrade to index total_t-1)."""
+    rev = jnp.asarray(mask)[:, ::-1]
+    return total_t - 1 - jnp.argmax(rev, axis=1).astype(jnp.int32)
+
+
+@dataclasses.dataclass
+class _RecurrentWrapper(Layer):
+    """Shared delegation for wrappers around a recurrent layer: params,
+    state, regularization and the carry protocol all forward to the wrapped
+    layer, so tBPTT / rnn_time_step thread state straight through."""
+
+    layer: Optional[Layer] = None
+
+    uses_mask = True
+
+    def __post_init__(self):
+        self.has_carry = getattr(self.layer, "has_carry", False)
+
+    def output_type(self, input_type):
+        return self.layer.output_type(input_type)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        return self.layer.init(key, input_type, dtype)
+
+    def init_state(self, input_type, dtype=jnp.float32):
+        return self.layer.init_state(input_type, dtype)
+
+    def param_order(self):
+        return self.layer.param_order()
+
+    def regularized_param_keys(self):
+        return self.layer.regularized_param_keys()
+
+    def zero_carry(self, batch, dtype=jnp.float32):
+        return self.layer.zero_carry(batch, dtype)
+
+    def _run_inner(self, params, carry, x, mask, train, rng):
+        """Run the wrapped layer, with carry when it has one. Returns
+        (y, carry_out or None)."""
+        kw = {"mask": mask} if getattr(self.layer, "uses_mask", False) else {}
+        if self.has_carry:
+            if carry is None:
+                carry = self.layer.zero_carry(x.shape[0], x.dtype)
+            return self.layer.forward_with_carry(params, carry, x,
+                                                 train=train, rng=rng, **kw)
+        y, _ = self.layer.forward(params, {}, x, train=train, rng=rng, **kw)
+        return y, None
+
+
+@serde.register
+@dataclasses.dataclass
+class LastTimeStep(_RecurrentWrapper):
+    """Wraps a recurrent layer, emitting only the LAST VALID timestep's
+    output as [batch, nOut] (reference ``LastTimeStep`` wrapper). Handles
+    both ALIGN_START and ALIGN_END masks."""
+
+    def output_type(self, input_type):
+        out = self.layer.output_type(input_type)
+        return it.FeedForward(size=out.size)
+
+    def _select_last(self, y, mask):
+        if mask is None:
+            return y[:, -1, :]
+        idx = _last_valid_index(mask, y.shape[1])
+        return jnp.take_along_axis(y, idx[:, None, None], axis=1)[:, 0, :]
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None):
+        y, _ = self._run_inner(params, None, x, mask, train, rng)
+        return self._select_last(y, mask), state
+
+    def forward_with_carry(self, params, carry, x, mask=None, train=False,
+                           rng=None):
+        y, carry_out = self._run_inner(params, carry, x, mask, train, rng)
+        return self._select_last(y, mask), carry_out
+
+
+@serde.register
+@dataclasses.dataclass
+class MaskZeroLayer(_RecurrentWrapper):
+    """Zeroes activations at masked timesteps / at a sentinel input value
+    (reference ``MaskZeroLayer``: wraps a layer, zeroing where the input
+    equals ``mask_value``)."""
+
+    mask_value: float = 0.0
+
+    def _step_mask(self, x, mask):
+        # a step is masked out iff ALL features equal the sentinel value
+        # (the reference's all-zeros convention)
+        step_mask = jnp.any(x != self.mask_value, axis=-1).astype(x.dtype)
+        if mask is not None:
+            step_mask = step_mask * jnp.asarray(mask, x.dtype)
+        return step_mask
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None):
+        step_mask = self._step_mask(x, mask)
+        y, _ = self._run_inner(params, None, x, step_mask, train, rng)
+        return y * step_mask[:, :, None], state
+
+    def forward_with_carry(self, params, carry, x, mask=None, train=False,
+                           rng=None):
+        step_mask = self._step_mask(x, mask)
+        y, carry_out = self._run_inner(params, carry, x, step_mask, train, rng)
+        return y * step_mask[:, :, None], carry_out
+
+
+@serde.register
+@dataclasses.dataclass
+class RnnOutputLayer(DenseLayer):
+    """Time-distributed dense + per-timestep loss (reference
+    ``RnnOutputLayer``): [batch, time, nIn] -> [batch, time, nOut]; score
+    averages over VALID timesteps via the labels mask."""
+
+    loss_fn: ILossFunction = dataclasses.field(default_factory=LossMCXENT)
+    activation: Activation = Activation.SOFTMAX
+
+    def output_type(self, input_type):
+        ts = input_type.timesteps if isinstance(input_type, it.Recurrent) else -1
+        return it.Recurrent(size=self.n_out, timesteps=ts)
+
+    def score(self, params, x, labels, mask=None):
+        z = self.pre_output(params, x)
+        return self.loss_fn.score(labels, z, self.activation, mask)
+
+
+@serde.register
+@dataclasses.dataclass
+class RnnLossLayer(Layer):
+    """Parameter-free per-timestep loss head (reference ``RnnLossLayer``)."""
+
+    loss_fn: ILossFunction = dataclasses.field(default_factory=LossMCXENT)
+    activation: Activation = Activation.SOFTMAX
+
+    def forward(self, params, state, x, train=False, rng=None):
+        return self.activation.apply(x), state
+
+    def score(self, params, x, labels, mask=None):
+        return self.loss_fn.score(labels, x, self.activation, mask)
+
+    def regularized_param_keys(self):
+        return []

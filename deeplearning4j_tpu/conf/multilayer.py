@@ -174,6 +174,10 @@ class ListBuilder:
         return self
 
     def build(self) -> MultiLayerConfiguration:
+        if self._input_type is None:
+            raise ValueError(
+                "set_input_type(...) is required: layers infer nIn from the "
+                "InputType chain (reference: setInputType / explicit nIn)")
         layers = [self._apply_defaults(l) for l in self._layers]
         layers = _insert_preprocessors(layers, self._input_type)
         for i, l in enumerate(layers):

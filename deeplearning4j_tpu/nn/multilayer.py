@@ -60,8 +60,11 @@ class MultiLayerNetwork:
         self.last_batch_size: Optional[int] = None
         self.score_value: float = float("nan")
         self._train_step = None
+        self._tbptt_step = None
         self._output_fn = None
         self._score_fn = None
+        self._rnn_step_fn = None
+        self._rnn_carries = None
         self._dtype = jnp.dtype(conf.dtype)
         self._base_key = jax.random.PRNGKey(conf.seed)
 
@@ -92,19 +95,35 @@ class MultiLayerNetwork:
         return getattr(layer, "updater", None) or self.conf.updater
 
     # --- functional core ---------------------------------------------------
-    def _forward(self, params, state, x, train: bool, rng, upto: int = None):
-        """Pure forward pass over layers [0, upto). Returns (x, new_state)."""
+    def _forward(self, params, state, x, train: bool, rng, fmask=None,
+                 upto: int = None, carries=None):
+        """Pure forward pass over layers [0, upto). Returns (x, new_state,
+        new_carries). ``fmask``: per-timestep features mask [batch, time],
+        given only to mask-consuming layers (RNNs, wrappers). ``carries``:
+        {layer_idx: carry} recurrent state threaded across tBPTT segments /
+        ``rnn_time_step`` calls; None = start every RNN from zeros."""
         n = len(self.conf.layers) if upto is None else upto
-        new_state = {}
+        new_state, new_carries = {}, {}
         for i in range(n):
             layer = self.conf.layers[i]
             p = params.get(str(i), {})
             s = state.get(str(i), {})
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
-            x, s2 = layer.forward(p, s, x, train=train, rng=lrng)
-            if str(i) in state:
-                new_state[str(i)] = s2
-        return x, new_state
+            kw = {"mask": fmask} if getattr(layer, "uses_mask", False) else {}
+            if carries is not None and getattr(layer, "has_carry", False):
+                c = carries.get(str(i))
+                if c is None:
+                    c = layer.zero_carry(x.shape[0], x.dtype)
+                x, c2 = layer.forward_with_carry(p, c, x, train=train,
+                                                 rng=lrng, **kw)
+                new_carries[str(i)] = c2
+                if str(i) in state:
+                    new_state[str(i)] = s
+            else:
+                x, s2 = layer.forward(p, s, x, train=train, rng=lrng, **kw)
+                if str(i) in state:
+                    new_state[str(i)] = s2
+        return x, new_state, new_carries
 
     def _output_layer(self):
         last = self.conf.layers[-1]
@@ -114,25 +133,29 @@ class MultiLayerNetwork:
                 "(reference: fit() requires an IOutputLayer)")
         return last
 
-    def _loss(self, params, state, features, labels, lmask, rng, train=True):
+    def _loss(self, params, state, features, labels, fmask, lmask, rng,
+              train=True, carries=None):
         out_layer = self._output_layer()
         last = len(self.conf.layers) - 1
-        x, new_state = self._forward(params, state, features, train=train,
-                                     rng=rng, upto=last)
+        x, new_state, new_carries = self._forward(
+            params, state, features, train=train, rng=rng, fmask=fmask,
+            upto=last, carries=carries)
         loss = out_layer.score(params.get(str(last), {}), x, labels, lmask)
         loss = loss + solver.regularization_score(self.conf.layers, params)
-        return loss, new_state
+        return loss, (new_state, new_carries)
 
     def train_step_fn(self):
         """The raw (unjitted) pure train step — exposed so parallel wrappers
         can jit it under a Mesh with explicit shardings (stage-7 path)."""
         layers = self.conf.layers
 
-        def step(params, state, opt_state, features, labels, lmask, it, ep, rng):
+        def step(params, state, opt_state, features, labels, fmask, lmask,
+                 it, ep, rng, carries=None):
             def loss_fn(p):
-                return self._loss(p, state, features, labels, lmask, rng)
+                return self._loss(p, state, features, labels, fmask, lmask,
+                                  rng, carries=carries)
 
-            (loss, new_state), grads = jax.value_and_grad(
+            (loss, (new_state, new_carries)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
             new_params, new_opt = {}, {}
             for k in params:
@@ -142,26 +165,45 @@ class MultiLayerNetwork:
                 g = solver.normalize_layer_gradients(layer, grads[k])
                 new_params[k], new_opt[k] = solver.apply_updater_to_layer(
                     layer, upd, params[k], g, opt_state[k], lr, it, ep)
-            return new_params, new_state, new_opt, loss
+            if carries is None:
+                return new_params, new_state, new_opt, loss
+            # tBPTT: the next segment resumes from this segment's final RNN
+            # state, detached (gradients do not flow across segments —
+            # reference BackpropType.TruncatedBPTT semantics)
+            new_carries = jax.lax.stop_gradient(new_carries)
+            return new_params, new_state, new_opt, loss, new_carries
 
         return step
 
     def _build_train_step(self):
         return jax.jit(self.train_step_fn(), donate_argnums=(0, 1, 2))
 
+    def _build_tbptt_step(self):
+        return jax.jit(self.train_step_fn(), donate_argnums=(0, 1, 2, 10))
+
     def _build_output_fn(self):
-        def out(params, state, x):
-            y, _ = self._forward(params, state, x, train=False, rng=None)
+        def out(params, state, x, fmask):
+            y, _, _ = self._forward(params, state, x, train=False, rng=None,
+                                    fmask=fmask)
             return y
 
         return jax.jit(out)
 
+    def _build_rnn_step_fn(self):
+        def out(params, state, carries, x, fmask):
+            y, _, new_carries = self._forward(
+                params, state, x, train=False, rng=None, fmask=fmask,
+                carries=carries)
+            return y, new_carries
+
+        return jax.jit(out)
+
     def _build_score_fn(self):
-        def score(params, state, features, labels, lmask):
+        def score(params, state, features, labels, fmask, lmask):
             # eval mode: BN uses running stats, dropout off — matches the
             # reference's score() running feed-forward in inference mode
-            loss, _ = self._loss(params, state, features, labels, lmask,
-                                 rng=None, train=False)
+            loss, _ = self._loss(params, state, features, labels, fmask,
+                                 lmask, rng=None, train=False)
             return loss
 
         return jax.jit(score)
@@ -185,24 +227,36 @@ class MultiLayerNetwork:
             self.epoch += 1
         return self
 
-    def fit_batch(self, ds: DataSet) -> float:
-        """One optimization step on one minibatch."""
-        if self.params is None:
-            self.init()
-        if self._train_step is None:
-            self._train_step = self._build_train_step()
+    def _batch_arrays(self, ds: DataSet):
         features = jnp.asarray(np.asarray(ds.features), self._dtype)
         labels = jnp.asarray(np.asarray(ds.labels), self._dtype)
+        fmask = (jnp.asarray(np.asarray(ds.features_mask), self._dtype)
+                 if ds.features_mask is not None else None)
         if ds.labels_mask is not None:
             lmask = jnp.asarray(np.asarray(ds.labels_mask), self._dtype)
         else:
             lmask = jnp.ones((features.shape[0],), self._dtype)
+        return features, labels, fmask, lmask
+
+    def fit_batch(self, ds: DataSet) -> float:
+        """One optimization step on one minibatch (tBPTT: one step per
+        segment, reference ``MultiLayerNetwork#doTruncatedBPTT``)."""
+        if self.params is None:
+            self.init()
+        features, labels, fmask, lmask = self._batch_arrays(ds)
+        from deeplearning4j_tpu.conf.multilayer import BackpropType
+
+        if (self.conf.backprop_type is BackpropType.TRUNCATED_BPTT
+                and features.ndim == 3):
+            return self._fit_tbptt(features, labels, fmask, lmask)
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
         rng = jax.random.fold_in(self._base_key, self.iteration + 1_000_003)
         it = jnp.asarray(float(self.iteration), jnp.float32)
         ep = jnp.asarray(float(self.epoch), jnp.float32)
         self.params, self.state, self.opt_state, loss = self._train_step(
-            self.params, self.state, self.opt_state, features, labels, lmask,
-            it, ep, rng)
+            self.params, self.state, self.opt_state, features, labels, fmask,
+            lmask, it, ep, rng)
         self.last_batch_size = int(features.shape[0])
         self.score_value = float(loss)
         for lst in self.listeners:
@@ -211,15 +265,109 @@ class MultiLayerNetwork:
         self.iteration += 1
         return self.score_value
 
+    def _fit_tbptt(self, features, labels, fmask, lmask) -> float:
+        """Truncated BPTT: slice the time axis into segments of
+        ``tbptt_fwd_length``, one parameter update per segment, RNN state
+        carried (detached) between segments. The tail segment is zero-padded
+        with a 0 mask so every segment has the same (compiled-once) shape."""
+        if labels.ndim != 3:
+            raise ValueError(
+                "truncated BPTT needs per-timestep labels [batch, time, "
+                f"nOut], got shape {tuple(labels.shape)} (reference tBPTT "
+                "operates on sequence labels; use STANDARD backprop for "
+                "sequence-level classification heads)")
+        if self._tbptt_step is None:
+            self._tbptt_step = self._build_tbptt_step()
+        seg = int(self.conf.tbptt_fwd_length)
+        n, total_t = features.shape[0], features.shape[1]
+        if fmask is None:
+            fmask = jnp.ones((n, total_t), self._dtype)
+        if lmask.ndim == 1:  # per-example -> per-timestep
+            lmask = lmask[:, None] * jnp.ones((n, total_t), self._dtype)
+        carries = {str(i): layer.zero_carry(n, self._dtype)
+                   for i, layer in enumerate(self.conf.layers)
+                   if getattr(layer, "has_carry", False)}
+        losses = []
+        for start in range(0, total_t, seg):
+            f_seg = _pad_time(features[:, start:start + seg], seg)
+            l_seg = _pad_time(labels[:, start:start + seg], seg)
+            fm_seg = _pad_time(fmask[:, start:start + seg], seg)
+            lm_seg = _pad_time(lmask[:, start:start + seg], seg)
+            rng = jax.random.fold_in(self._base_key,
+                                     self.iteration + 1_000_003)
+            it = jnp.asarray(float(self.iteration), jnp.float32)
+            ep = jnp.asarray(float(self.epoch), jnp.float32)
+            (self.params, self.state, self.opt_state, loss,
+             carries) = self._tbptt_step(
+                self.params, self.state, self.opt_state, f_seg, l_seg,
+                fm_seg, lm_seg, it, ep, rng, carries)
+            losses.append(float(loss))
+            self.iteration += 1
+        self.last_batch_size = int(n)
+        self.score_value = float(np.mean(losses))
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, self.epoch,
+                               self.score_value)
+        return self.score_value
+
+    # --- stateful RNN inference (reference rnnTimeStep API) -----------------
+    def rnn_time_step(self, x, fmask=None):
+        """Streaming inference: feed a segment [batch, t, f], get outputs
+        with RNN state persisted across calls (reference
+        ``MultiLayerNetwork#rnnTimeStep``)."""
+        if self.params is None:
+            self.init()
+        for layer in self.conf.layers:
+            if type(layer).__name__ == "Bidirectional":
+                raise RuntimeError(
+                    "rnn_time_step is unsupported for Bidirectional layers: "
+                    "the backward pass needs the full sequence (reference "
+                    "throws UnsupportedOperationException here)")
+        if self._rnn_step_fn is None:
+            self._rnn_step_fn = self._build_rnn_step_fn()
+        x = jnp.asarray(np.asarray(x), self._dtype)
+        if x.ndim == 2:  # single timestep [batch, f]
+            x = x[:, None, :]
+        n = x.shape[0]
+        if self._rnn_carries is None:
+            self._rnn_carries = {
+                str(i): layer.zero_carry(n, self._dtype)
+                for i, layer in enumerate(self.conf.layers)
+                if getattr(layer, "has_carry", False)}
+        fmask = (None if fmask is None
+                 else jnp.asarray(np.asarray(fmask), self._dtype))
+        y, self._rnn_carries = self._rnn_step_fn(
+            self.params, self.state, self._rnn_carries, x, fmask)
+        return y
+
+    def rnn_clear_previous_state(self):
+        """Reference ``#rnnClearPreviousState``."""
+        self._rnn_carries = None
+
+    def rnn_get_previous_state(self, layer_idx: int):
+        """Reference ``#rnnGetPreviousState(layer)``."""
+        if self._rnn_carries is None:
+            return None
+        return self._rnn_carries.get(str(layer_idx))
+
+    def rnn_set_previous_state(self, layer_idx: int, state: dict):
+        """Reference ``#rnnSetPreviousState(layer, state)``."""
+        if self._rnn_carries is None:
+            self._rnn_carries = {}
+        self._rnn_carries[str(layer_idx)] = {
+            k: jnp.asarray(v, self._dtype) for k, v in state.items()}
+
     # --- inference / scoring ----------------------------------------------
-    def output(self, x, batch_size: Optional[int] = None):
+    def output(self, x, batch_size: Optional[int] = None, fmask=None):
         """Forward pass, eval mode (reference ``#output``)."""
         if self.params is None:
             self.init()
         if self._output_fn is None:
             self._output_fn = self._build_output_fn()
         x = jnp.asarray(np.asarray(x), self._dtype)
-        return self._output_fn(self.params, self.state, x)
+        fmask = (None if fmask is None
+                 else jnp.asarray(np.asarray(fmask), self._dtype))
+        return self._output_fn(self.params, self.state, x, fmask)
 
     def score(self, ds: DataSet = None) -> float:
         """Loss on a DataSet without updating (reference ``#score``), or the
@@ -230,20 +378,16 @@ class MultiLayerNetwork:
             self.init()
         if self._score_fn is None:
             self._score_fn = self._build_score_fn()
-        features = jnp.asarray(np.asarray(ds.features), self._dtype)
-        labels = jnp.asarray(np.asarray(ds.labels), self._dtype)
-        lmask = (jnp.asarray(np.asarray(ds.labels_mask), self._dtype)
-                 if ds.labels_mask is not None
-                 else jnp.ones((features.shape[0],), self._dtype))
+        features, labels, fmask, lmask = self._batch_arrays(ds)
         return float(self._score_fn(self.params, self.state, features, labels,
-                                    lmask))
+                                    fmask, lmask))
 
     def evaluate(self, iterator, evaluation: Optional[Evaluation] = None):
         """Reference ``#evaluate(DataSetIterator)`` -> Evaluation."""
         ev = evaluation if evaluation is not None else Evaluation()
         iterator = _as_iterator(iterator)
         for ds in iterator:
-            out = self.output(ds.features)
+            out = self.output(ds.features, fmask=ds.features_mask)
             ev.eval(ds.labels, np.asarray(out), mask=ds.labels_mask)
         iterator.reset()
         return ev
@@ -255,14 +399,11 @@ class MultiLayerNetwork:
         (reference ``#computeGradientAndScore``)."""
         if self.params is None:
             self.init()
-        features = jnp.asarray(np.asarray(ds.features), self._dtype)
-        labels = jnp.asarray(np.asarray(ds.labels), self._dtype)
-        lmask = (jnp.asarray(np.asarray(ds.labels_mask), self._dtype)
-                 if ds.labels_mask is not None
-                 else jnp.ones((features.shape[0],), self._dtype))
+        features, labels, fmask, lmask = self._batch_arrays(ds)
 
         def loss_fn(p):
-            return self._loss(p, self.state, features, labels, lmask, rng=None)
+            return self._loss(p, self.state, features, labels, fmask, lmask,
+                              rng=None)
 
         (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(self.params)
         return grads, float(loss)
@@ -308,6 +449,15 @@ class MultiLayerNetwork:
                          f"{_fmt_type(out_t):<20} {n:>10,}")
         lines += ["-" * 70, f"Total params: {total:,}", "=" * 70]
         return "\n".join(lines)
+
+
+def _pad_time(arr, seg: int):
+    """Zero-pad [batch, t, ...] (or [batch, t]) to t == seg on axis 1."""
+    t = arr.shape[1]
+    if t == seg:
+        return arr
+    width = [(0, 0), (0, seg - t)] + [(0, 0)] * (arr.ndim - 2)
+    return jnp.pad(arr, width)
 
 
 def _fmt_type(t) -> str:

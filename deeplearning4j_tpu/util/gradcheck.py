@@ -123,13 +123,15 @@ def gradient_check(conf, ds, epsilon: float = 1e-6,
 
         features = jnp.asarray(np.asarray(ds.features), jnp.float64)
         labels = jnp.asarray(np.asarray(ds.labels), jnp.float64)
+        fmask = (jnp.asarray(np.asarray(ds.features_mask), jnp.float64)
+                 if ds.features_mask is not None else None)
         lmask = (jnp.asarray(np.asarray(ds.labels_mask), jnp.float64)
                  if ds.labels_mask is not None
                  else jnp.ones((features.shape[0],), jnp.float64))
 
         return _check_net_params_gradient(
-            conf64, net, (features, labels, lmask), epsilon, max_rel_error,
-            abs_error_threshold, n_samples, seed)
+            conf64, net, (features, labels, fmask, lmask), epsilon,
+            max_rel_error, abs_error_threshold, n_samples, seed)
 
 
 def check_layer_input_gradient(layer, input_type, x, epsilon: float = 1e-6,

@@ -1,0 +1,316 @@
+"""Recurrent layer tests: shapes, masking, gradients vs central differences,
+tBPTT segmentation and streaming inference (reference test model:
+``LSTMGradientCheckTests``, ``GravesLSTMTest``, ``MultiLayerTest`` tBPTT and
+``rnnTimeStep`` tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.conf.activations import Activation
+from deeplearning4j_tpu.conf.inputs import InputType
+from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.conf.layers_rnn import (
+    Bidirectional, BidirectionalMode, GravesLSTM, LSTM, LastTimeStep,
+    MaskZeroLayer, RnnLossLayer, RnnOutputLayer, SimpleRnn, reverse_sequence,
+)
+from deeplearning4j_tpu.conf.multilayer import (
+    BackpropType, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.conf.updaters import Adam, Sgd
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.util.gradcheck import gradient_check
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _seq_conf(cell, n_in=3, n_out=4, classes=2, tbptt=None, bid=None):
+    b = (NeuralNetConfiguration.builder()
+         .seed(12345)
+         .updater(Adam(5e-3))
+         .list())
+    layer = cell(n_out=n_out)
+    if bid is not None:
+        layer = Bidirectional(layer=layer, mode=bid)
+    b.layer(layer)
+    b.layer(RnnOutputLayer(n_out=classes))
+    b.set_input_type(InputType.recurrent(n_in, timesteps=5))
+    if tbptt:
+        b.backprop_type(BackpropType.TRUNCATED_BPTT, tbptt, tbptt)
+    return b.build()
+
+
+def _seq_data(n=4, t=5, f=3, classes=2, masked=True, seed=0):
+    rng = np.random.default_rng(seed)
+    feats = rng.normal(size=(n, t, f)).astype(np.float32)
+    labels = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, (n, t))]
+    if not masked:
+        return DataSet(feats, labels)
+    mask = np.ones((n, t), np.float32)
+    mask[0, 3:] = 0.0  # first sample has length 3
+    feats[0, 3:] = 0.0
+    return DataSet(feats, labels, features_mask=mask, labels_mask=mask)
+
+
+# --------------------------------------------------------------------------
+# forward shapes + masking semantics
+# --------------------------------------------------------------------------
+@pytest.mark.parametrize("cell", [SimpleRnn, LSTM, GravesLSTM])
+def test_rnn_forward_shapes(cell):
+    layer = cell(n_out=6)
+    t = InputType.recurrent(3, timesteps=5)
+    assert layer.output_type(t) == InputType.recurrent(6, timesteps=5)
+    params = layer.init(KEY, t)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 5, 3)),
+                    jnp.float32)
+    y, _ = layer.forward(params, {}, x)
+    assert y.shape == (2, 5, 6)
+
+
+@pytest.mark.parametrize("cell", [SimpleRnn, LSTM, GravesLSTM])
+def test_rnn_mask_freezes_state_and_zeroes_output(cell):
+    layer = cell(n_out=4)
+    t = InputType.recurrent(2, timesteps=6)
+    params = layer.init(KEY, t)
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(1, 6, 2)).astype(np.float32)
+    mask = np.array([[1, 1, 1, 0, 0, 0]], np.float32)
+    y_masked, _ = layer.forward(params, {}, jnp.asarray(x),
+                                mask=jnp.asarray(mask))
+    # outputs at masked steps are exactly zero
+    np.testing.assert_allclose(np.asarray(y_masked[0, 3:]), 0.0)
+    # valid prefix identical to running the 3-step sequence alone
+    y_short, _ = layer.forward(params, {}, jnp.asarray(x[:, :3]))
+    np.testing.assert_allclose(np.asarray(y_masked[0, :3]),
+                               np.asarray(y_short[0]), rtol=1e-5, atol=1e-6)
+
+
+def test_reverse_sequence_mask_aware():
+    x = jnp.asarray(np.arange(8, dtype=np.float32).reshape(1, 4, 2))
+    mask = jnp.asarray([[1, 1, 1, 0]], jnp.float32)
+    r = np.asarray(reverse_sequence(x, mask))
+    # valid steps 0,1,2 reversed; padding step 3 untouched
+    np.testing.assert_allclose(r[0, 0], [4, 5])
+    np.testing.assert_allclose(r[0, 2], [0, 1])
+    np.testing.assert_allclose(r[0, 3], [6, 7])
+
+
+@pytest.mark.parametrize("mode,expected_size", [
+    (BidirectionalMode.CONCAT, 8), (BidirectionalMode.ADD, 4),
+    (BidirectionalMode.AVERAGE, 4), (BidirectionalMode.MUL, 4)])
+def test_bidirectional_modes(mode, expected_size):
+    layer = Bidirectional(layer=LSTM(n_out=4), mode=mode)
+    t = InputType.recurrent(3, timesteps=5)
+    assert layer.output_type(t).size == expected_size
+    params = layer.init(KEY, t)
+    assert set(params) == {f"{d}{k}" for d in "fb" for k in ("W", "RW", "b")}
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 5, 3)),
+                    jnp.float32)
+    y, _ = layer.forward(params, {}, x)
+    assert y.shape == (2, 5, expected_size)
+
+
+def test_last_time_step_mask_aware():
+    inner = SimpleRnn(n_out=4)
+    layer = LastTimeStep(layer=inner)
+    t = InputType.recurrent(2, timesteps=5)
+    params = layer.init(KEY, t)
+    assert layer.output_type(t) == InputType.feed_forward(4)
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(2, 5, 2)).astype(np.float32)
+    mask = np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.float32)
+    y, _ = layer.forward(params, {}, jnp.asarray(x), mask=jnp.asarray(mask))
+    full, _ = inner.forward(params, {}, jnp.asarray(x),
+                            mask=jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(y[0]), np.asarray(full[0, 2]))
+    np.testing.assert_allclose(np.asarray(y[1]), np.asarray(full[1, 4]))
+
+
+def test_mask_zero_layer_derives_mask_from_sentinel():
+    layer = MaskZeroLayer(layer=SimpleRnn(n_out=3), mask_value=0.0)
+    t = InputType.recurrent(2, timesteps=4)
+    params = layer.init(KEY, t)
+    x = np.ones((1, 4, 2), np.float32)
+    x[0, 2:] = 0.0  # all-zero steps => masked
+    y, _ = layer.forward(params, {}, jnp.asarray(x))
+    assert not np.allclose(np.asarray(y[0, :2]), 0.0)
+    np.testing.assert_allclose(np.asarray(y[0, 2:]), 0.0)
+
+
+# --------------------------------------------------------------------------
+# gradient checks (the reference's core oracle)
+# --------------------------------------------------------------------------
+@pytest.mark.parametrize("cell", [SimpleRnn, LSTM, GravesLSTM])
+def test_rnn_gradients(cell):
+    conf = _seq_conf(cell)
+    res = gradient_check(conf, _seq_data(), n_samples=60)
+    assert res.n_failed == 0, res.failures
+
+
+def test_bidirectional_gradients():
+    conf = _seq_conf(LSTM, bid=BidirectionalMode.CONCAT)
+    res = gradient_check(conf, _seq_data(), n_samples=60)
+    assert res.n_failed == 0, res.failures
+
+
+def test_last_time_step_gradients():
+    b = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1)).list()
+         .layer(LastTimeStep(layer=LSTM(n_out=3)))
+         .layer(OutputLayer(n_out=2))
+         .set_input_type(InputType.recurrent(2, timesteps=4)))
+    conf = b.build()
+    rng = np.random.default_rng(3)
+    feats = rng.normal(size=(3, 4, 2)).astype(np.float32)
+    labels = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 3)]
+    mask = np.ones((3, 4), np.float32)
+    mask[1, 2:] = 0.0
+    ds = DataSet(feats, labels, features_mask=mask)
+    res = gradient_check(conf, ds, n_samples=40)
+    assert res.n_failed == 0, res.failures
+
+
+# --------------------------------------------------------------------------
+# training: standard BPTT, tBPTT, streaming
+# --------------------------------------------------------------------------
+def test_lstm_learns_sequence_task():
+    # predict whether the cumulative sum of inputs so far is positive
+    rng = np.random.default_rng(0)
+    feats = rng.normal(size=(64, 8, 1)).astype(np.float32)
+    cum = np.cumsum(feats[:, :, 0], axis=1)
+    labels = np.stack([(cum <= 0), (cum > 0)], axis=-1).astype(np.float32)
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(2e-2))
+            .list()
+            .layer(LSTM(n_out=8))
+            .layer(RnnOutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(1, timesteps=8))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    ds = DataSet(feats, labels)
+    first = net.fit_batch(ds)
+    for _ in range(150):
+        last = net.fit_batch(ds)
+    assert last < first * 0.5, (first, last)
+    out = np.asarray(net.output(feats))
+    acc = np.mean(out.argmax(-1) == labels.argmax(-1))
+    assert acc > 0.9, acc
+
+
+def test_tbptt_segments_and_learns():
+    rng = np.random.default_rng(1)
+    feats = rng.normal(size=(8, 10, 2)).astype(np.float32)
+    labels = np.eye(2, dtype=np.float32)[
+        (feats.sum(-1) > 0).astype(int)]
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2))
+            .list()
+            .layer(LSTM(n_out=4))
+            .layer(RnnOutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(2, timesteps=10))
+            .backprop_type(BackpropType.TRUNCATED_BPTT, 4, 4)
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    ds = DataSet(feats, labels)
+    net.fit_batch(ds)
+    # 10 steps in segments of 4 -> 3 parameter updates per batch
+    assert net.iteration == 3
+    first = net.score_value
+    for _ in range(60):
+        net.fit_batch(ds)
+    assert net.score_value < first
+
+
+def test_rnn_time_step_streaming_matches_full_forward():
+    conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-3))
+            .list()
+            .layer(LSTM(n_out=4))
+            .layer(SimpleRnn(n_out=3))
+            .layer(RnnOutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(2, timesteps=6))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(2, 6, 2)).astype(np.float32)
+    full = np.asarray(net.output(x))
+    # stream in chunks of 2 timesteps
+    net.rnn_clear_previous_state()
+    parts = [np.asarray(net.rnn_time_step(x[:, i:i + 2])) for i in (0, 2, 4)]
+    streamed = np.concatenate(parts, axis=1)
+    np.testing.assert_allclose(streamed, full, rtol=1e-5, atol=1e-6)
+    # state inspection / reset round-trip
+    st = net.rnn_get_previous_state(0)
+    assert set(st) == {"h", "c"}
+    net.rnn_clear_previous_state()
+    assert net.rnn_get_previous_state(0) is None
+    # single-step [batch, f] input works
+    y1 = net.rnn_time_step(x[:, 0])
+    assert np.asarray(y1).shape == (2, 1, 2)
+
+
+def test_rnn_conf_json_roundtrip():
+    conf = _seq_conf(GravesLSTM, bid=BidirectionalMode.ADD)
+    from deeplearning4j_tpu.conf.multilayer import MultiLayerConfiguration
+
+    conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+    assert conf2 == conf
+    net = MultiLayerNetwork(conf2).init()
+    y = net.output(np.zeros((1, 5, 3), np.float32))
+    assert np.asarray(y).shape == (1, 5, 2)
+
+
+def test_last_time_step_align_end_mask():
+    inner = SimpleRnn(n_out=3)
+    layer = LastTimeStep(layer=inner)
+    t = InputType.recurrent(2, timesteps=4)
+    params = layer.init(KEY, t)
+    x = np.random.default_rng(5).normal(size=(1, 4, 2)).astype(np.float32)
+    mask = np.array([[0, 0, 1, 1]], np.float32)  # ALIGN_END, length 2
+    y, _ = layer.forward(params, {}, jnp.asarray(x), mask=jnp.asarray(mask))
+    full, _ = inner.forward(params, {}, jnp.asarray(x),
+                            mask=jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(y[0]), np.asarray(full[0, 3]))
+    assert not np.allclose(np.asarray(y[0]), 0.0)
+
+
+def test_mask_zero_layer_carries_state_in_streaming():
+    conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-3))
+            .list()
+            .layer(MaskZeroLayer(layer=LSTM(n_out=4)))
+            .layer(RnnOutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(2, timesteps=6))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(6).normal(size=(2, 6, 2)).astype(np.float32)
+    full = np.asarray(net.output(x))
+    net.rnn_clear_previous_state()
+    parts = [np.asarray(net.rnn_time_step(x[:, i:i + 3])) for i in (0, 3)]
+    np.testing.assert_allclose(np.concatenate(parts, axis=1), full,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_rnn_recurrent_weights_are_regularized():
+    from deeplearning4j_tpu.conf.regularization import L2Regularization as L2
+    from deeplearning4j_tpu.optimize.solver import regularization_score
+
+    layer = LSTM(n_out=3, regularization=(L2(0.1),))
+    t = InputType.recurrent(2, timesteps=4)
+    params = {"0": layer.init(KEY, t)}
+    score = regularization_score([layer], params)
+    w_rw = 0.5 * 0.1 * float(jnp.sum(params["0"]["W"] ** 2)
+                             + jnp.sum(params["0"]["RW"] ** 2))
+    assert float(score) == pytest.approx(w_rw, rel=1e-5)
+
+
+def test_tbptt_rejects_sequence_level_labels():
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-3))
+            .list()
+            .layer(LastTimeStep(layer=LSTM(n_out=3)))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(2, timesteps=6))
+            .backprop_type(BackpropType.TRUNCATED_BPTT, 3, 3)
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    feats = np.zeros((2, 6, 2), np.float32)
+    labels = np.eye(2, dtype=np.float32)[[0, 1]]
+    with pytest.raises(ValueError, match="per-timestep labels"):
+        net.fit_batch(DataSet(feats, labels))
