@@ -1,0 +1,10 @@
+"""SameDiff-equivalent: symbolic define-by-graph autodiff lowered to
+whole-graph XLA programs (reference ``org.nd4j.autodiff.samediff``)."""
+
+from deeplearning4j_tpu.samediff.core import (OP_REGISTRY, SDVariable,
+                                              SameDiff, VariableType,
+                                              register_op)
+from deeplearning4j_tpu.samediff.training import History, TrainingConfig
+
+__all__ = ["SameDiff", "SDVariable", "VariableType", "TrainingConfig",
+           "History", "OP_REGISTRY", "register_op"]

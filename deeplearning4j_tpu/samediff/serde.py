@@ -1,0 +1,123 @@
+"""SameDiff persistence (reference: FlatBuffers save/load via
+``SameDiff#asFlatBuffers/save/load`` + ``FlatBuffersMapper`` — SURVEY.md
+§2.2 "SameDiff serialization").
+
+Format here: one ``.sdz`` zip = ``graph.json`` (variables + op nodes with
+registry names and JSON attrs) + ``arrays.npz`` (VARIABLE/CONSTANT values)
++ optional ``updater_state.npz``. The op registry is the schema — loading
+re-links each node to its pure-jax impl by name, so a loaded graph compiles
+to the identical XLA program. Graphs containing control-flow callables
+(``cond``/``while_loop``/``scan``) carry non-serializable closures and are
+rejected with a clear error, matching the spirit of the reference's
+unsupported-op FlatBuffers failures.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+
+import jax.numpy as jnp
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def save(sd, path, save_updater_state: bool = True) -> None:
+    for op in sd.ops.values():
+        if op.fn_attrs:
+            raise ValueError(
+                f"op {op.name!r} ({op.op_name}) holds python callables "
+                "(control flow); such graphs are not serializable")
+    graph = {
+        "format_version": FORMAT_VERSION,
+        "variables": [
+            {"name": v.name, "var_type": v.var_type,
+             "shape": list(v.shape) if v.shape is not None else None,
+             "dtype": v.dtype, "producer": v.producer,
+             "output_index": v.output_index}
+            for v in sd.variables.values()
+        ],
+        "ops": [
+            {"name": o.name, "op_name": o.op_name,
+             "inputs": list(o.inputs), "outputs": list(o.outputs),
+             "attrs": _jsonable_attrs(o.attrs)}
+            for o in sd.ops.values()
+        ],
+        "loss_variables": list(sd.loss_variables),
+        "iteration_count": sd._iteration_count,
+        "epoch_count": sd._epoch_count,
+    }
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("graph.json", json.dumps(graph, indent=1))
+        z.writestr("arrays.npz", _npz_bytes(
+            {k: np.asarray(v) for k, v in sd.arrays.items()}))
+        if save_updater_state and sd._updater_state is not None:
+            flat = {}
+            for var, st in sd._updater_state.items():
+                for k, v in (st or {}).items():
+                    flat[f"{var}//{k}"] = np.asarray(v)
+            z.writestr("updater_state.npz", _npz_bytes(flat))
+
+
+def load(path):
+    from deeplearning4j_tpu.samediff.core import (OpNode, SameDiff, VarMeta)
+
+    with zipfile.ZipFile(path, "r") as z:
+        graph = json.loads(z.read("graph.json"))
+        arrays = dict(np.load(io.BytesIO(z.read("arrays.npz"))))
+        updater_state = None
+        if "updater_state.npz" in z.namelist():
+            flat = dict(np.load(io.BytesIO(z.read("updater_state.npz"))))
+            updater_state = {}
+            for key, v in flat.items():
+                var, k = key.rsplit("//", 1)
+                updater_state.setdefault(var, {})[k] = jnp.asarray(v)
+
+    sd = SameDiff()
+    for v in graph["variables"]:
+        sd.variables[v["name"]] = VarMeta(
+            v["name"], v["var_type"],
+            tuple(v["shape"]) if v["shape"] is not None else None,
+            v["dtype"], v.get("producer"), v.get("output_index", 0))
+    for o in graph["ops"]:
+        sd.ops[o["name"]] = OpNode(
+            o["name"], o["op_name"], tuple(o["inputs"]),
+            tuple(o["outputs"]), _restore_attrs(o["attrs"]))
+    sd.arrays = {k: jnp.asarray(v) for k, v in arrays.items()}
+    sd.loss_variables = list(graph.get("loss_variables", []))
+    sd._iteration_count = graph.get("iteration_count", 0)
+    sd._epoch_count = graph.get("epoch_count", 0)
+    sd._updater_state = updater_state
+    return sd
+
+
+def _jsonable_attrs(attrs: dict) -> dict:
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, tuple):
+            out[k] = {"__tuple__": [_jsonable_attrs({"v": x})["v"]
+                                    for x in v]}
+        elif isinstance(v, (str, int, float, bool, type(None), dict, list)):
+            out[k] = v
+        else:
+            raise TypeError(f"attr {k}={v!r} not JSON-serializable")
+    return out
+
+
+def _restore_attrs(attrs: dict) -> dict:
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, dict) and "__tuple__" in v:
+            out[k] = tuple(
+                _restore_attrs({"v": x})["v"] for x in v["__tuple__"])
+        else:
+            out[k] = v
+    return out
+
+
+def _npz_bytes(arrs: dict) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **arrs)
+    return buf.getvalue()

@@ -1,0 +1,186 @@
+"""SameDiff training (reference ``TrainingConfig`` + ``TrainingSession`` —
+SURVEY.md §3.3).
+
+Where the reference's ``TrainingSession#trainingIteration`` executes the
+graph op-by-op then applies regularization + ``GradientUpdater`` per
+variable (one JNI crossing each), here one jitted ``train_step`` fuses
+forward + ``jax.grad`` backward + regularization + updater into a single
+XLA program, compiled once and reused across batches/epochs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.conf.updaters import IUpdater, Sgd
+
+
+@dataclasses.dataclass
+class TrainingConfig:
+    """Reference ``org.nd4j.autodiff.samediff.TrainingConfig``."""
+    updater: IUpdater = dataclasses.field(default_factory=Sgd)
+    data_set_feature_mapping: tp.Sequence[str] = ()
+    data_set_label_mapping: tp.Sequence[str] = ()
+    data_set_feature_mask_mapping: tp.Sequence[str] = ()
+    data_set_label_mask_mapping: tp.Sequence[str] = ()
+    loss_variables: tp.Sequence[str] = ()
+    regularization: tp.Sequence = ()  # conf.regularization.* instances
+    minimize: bool = True
+
+    class Builder:
+        def __init__(self):
+            self._cfg = TrainingConfig()
+
+        def updater(self, u):
+            self._cfg.updater = u
+            return self
+
+        def data_set_feature_mapping(self, *names):
+            self._cfg.data_set_feature_mapping = list(names)
+            return self
+
+        def data_set_label_mapping(self, *names):
+            self._cfg.data_set_label_mapping = list(names)
+            return self
+
+        def data_set_feature_mask_mapping(self, *names):
+            self._cfg.data_set_feature_mask_mapping = list(names)
+            return self
+
+        def data_set_label_mask_mapping(self, *names):
+            self._cfg.data_set_label_mask_mapping = list(names)
+            return self
+
+        def loss_variables(self, *names):
+            self._cfg.loss_variables = [
+                n if isinstance(n, str) else n.name for n in names]
+            return self
+
+        def regularization(self, *regs):
+            self._cfg.regularization = list(regs)
+            return self
+
+        def minimize(self, m=True):
+            self._cfg.minimize = m
+            return self
+
+        def build(self):
+            return self._cfg
+
+    @staticmethod
+    def builder() -> "TrainingConfig.Builder":
+        return TrainingConfig.Builder()
+
+
+class History:
+    """Reference ``org.nd4j.autodiff.listeners.records.History`` (thin)."""
+
+    def __init__(self):
+        self.loss_curve: list[float] = []
+
+    def append(self, loss: float):
+        self.loss_curve.append(float(loss))
+
+
+def make_train_step(sd, cfg: TrainingConfig):
+    """Build the pure jitted step:
+    (trainables, opt_state, t, placeholders) -> (trainables', opt_state',
+    loss). Regularization mirrors the reference's apply-before/after-updater
+    split (``Regularization.ApplyStep``)."""
+    loss_names = tuple(cfg.loss_variables or sd.loss_variables)
+    if not loss_names:
+        raise ValueError("TrainingConfig has no loss variables and none "
+                         "were marked on the graph")
+    trainable_names = tuple(sd.trainable_variables())
+    fn = sd.make_function(loss_names)
+    updater = cfg.updater
+    regs = tuple(cfg.regularization)
+    sign = 1.0 if cfg.minimize else -1.0
+
+    def loss_fn(trainables, frozen, placeholders):
+        merged = dict(frozen)
+        merged.update(trainables)
+        outs = fn(merged, placeholders)
+        return sign * sum(jnp.sum(v) for v in outs.values())
+
+    def train_step(trainables, frozen, opt_state, t, placeholders):
+        loss, grads = jax.value_and_grad(loss_fn)(trainables, frozen,
+                                                  placeholders)
+        lr = updater.current_lr(t, 0)
+        new_params, new_state = {}, {}
+        for n in trainable_names:
+            g, p = grads[n], trainables[n]
+            for r in regs:
+                g = r.apply_before_updater(g, p, lr)
+            upd, new_state[n] = updater.update_leaf(g, opt_state[n], lr, t,
+                                                    param=p)
+            for r in regs:
+                upd = r.apply_after_updater(upd, p, lr)
+            new_params[n] = p - upd
+        return new_params, new_state, loss
+
+    return jax.jit(train_step), trainable_names, loss_names
+
+
+def fit(sd, iterator=None, epochs: int = 1, features=None, labels=None):
+    """Reference ``SameDiff#fit(DataSetIterator, epochs)``. Also accepts
+    raw (features, labels) arrays for single-dataset fitting."""
+    cfg = sd.training_config
+    if cfg is None:
+        raise ValueError("call set_training_config() first")
+    step, trainable_names, _ = make_train_step(sd, cfg)
+
+    trainables = {n: sd.arrays[n] for n in trainable_names}
+    frozen = {k: v for k, v in sd.arrays.items()
+              if k not in set(trainable_names)}
+    if sd._updater_state is None:
+        sd._updater_state = {n: cfg.updater.init_state(trainables[n])
+                             for n in trainable_names}
+    opt_state = sd._updater_state
+    history = History()
+
+    def batches():
+        if iterator is not None:
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            for ds in iterator:
+                yield ds
+        else:
+            from deeplearning4j_tpu.datasets.dataset import DataSet
+            yield DataSet(features, labels)
+
+    for _ in range(epochs):
+        for ds in batches():
+            ph = {}
+            feats = ds.features if isinstance(ds.features, (list, tuple)) \
+                else [ds.features]
+            labs = ds.labels if isinstance(ds.labels, (list, tuple)) \
+                else [ds.labels]
+            for name, arr in zip(cfg.data_set_feature_mapping, feats):
+                ph[name] = jnp.asarray(arr)
+            for name, arr in zip(cfg.data_set_label_mapping, labs):
+                ph[name] = jnp.asarray(arr)
+            if cfg.data_set_feature_mask_mapping and \
+                    getattr(ds, "features_mask", None) is not None:
+                ph[cfg.data_set_feature_mask_mapping[0]] = jnp.asarray(
+                    ds.features_mask)
+            if cfg.data_set_label_mask_mapping and \
+                    getattr(ds, "labels_mask", None) is not None:
+                ph[cfg.data_set_label_mask_mapping[0]] = jnp.asarray(
+                    ds.labels_mask)
+            trainables, opt_state, loss = step(
+                trainables, frozen, opt_state, sd._iteration_count, ph)
+            sd._iteration_count += 1
+            history.append(loss)
+            for lst in sd._listeners:
+                if hasattr(lst, "iteration_done"):
+                    lst.iteration_done(sd, sd._iteration_count, float(loss))
+        sd._epoch_count += 1
+
+    sd.arrays.update(trainables)
+    sd._updater_state = opt_state
+    return history

@@ -1,0 +1,364 @@
+"""SameDiff-equivalent tests.
+
+Mirrors the reference's nd4j-tests op validation + SameDiff gradient checks
+(SURVEY.md §4 "Op-level validation"): forward values vs numpy, gradients vs
+central differences, training convergence, serde round-trip, and the
+BASELINE config #3 models (LSTM + small Transformer).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.conf.updaters import Adam, Sgd
+from deeplearning4j_tpu.samediff import (SameDiff, TrainingConfig,
+                                         VariableType)
+
+
+def test_basic_arithmetic_and_eval(rng):
+    sd = SameDiff.create()
+    a = sd.var("a", value=rng.normal(size=(3, 4)).astype(np.float32))
+    b = sd.var("b", value=rng.normal(size=(3, 4)).astype(np.float32))
+    c = (a + b) * 2.0 - a / (sd.math.abs(b) + 1.0)
+    out = c.eval()
+    av, bv = np.asarray(a.get_arr()), np.asarray(b.get_arr())
+    expect = (av + bv) * 2.0 - av / (np.abs(bv) + 1.0)
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_placeholder_and_matmul(rng):
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 4))
+    w = sd.var("w", value=rng.normal(size=(4, 3)).astype(np.float32))
+    y = sd.math.mmul(x, w)
+    xv = rng.normal(size=(5, 4)).astype(np.float32)
+    out = sd.output({"x": xv}, y)[y.name]
+    np.testing.assert_allclose(out, xv @ np.asarray(w.get_arr()), rtol=1e-4)
+
+
+def test_reductions_and_argmax(rng):
+    sd = SameDiff.create()
+    xv = rng.normal(size=(4, 6)).astype(np.float32)
+    x = sd.constant(xv, name="x")
+    s = sd.math.sum(x, dims=1)
+    m = sd.math.mean(x)
+    am = sd.math.argmax(x, dim=1)
+    outs = sd.output({}, s, m, am)
+    np.testing.assert_allclose(outs[s.name], xv.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(outs[m.name], xv.mean(), rtol=1e-5)
+    np.testing.assert_array_equal(outs[am.name], xv.argmax(1))
+
+
+def test_variable_types_and_rename(rng):
+    sd = SameDiff.create()
+    v = sd.var("w", shape=(2, 2))
+    c = sd.constant(np.eye(2, dtype=np.float32), name="c")
+    p = sd.placeholder("x", shape=(2, 2))
+    assert v.var_type == VariableType.VARIABLE
+    assert c.var_type == VariableType.CONSTANT
+    assert p.var_type == VariableType.PLACEHOLDER
+    y = v + c
+    assert y.var_type == VariableType.ARRAY
+    y.rename("sum_out")
+    out = sd.output({"x": np.zeros((2, 2), np.float32)}, "sum_out")
+    assert out["sum_out"].shape == (2, 2)
+
+
+def test_calculate_gradients_vs_numeric(rng):
+    sd = SameDiff.create()
+    w = sd.var("w", value=rng.normal(size=(3, 2)).astype(np.float64))
+    x = sd.constant(rng.normal(size=(4, 3)).astype(np.float64), name="x")
+    y = sd.math.mmul(x, w)
+    loss = sd.math.sum(sd.math.square(sd.math.tanh(y)))
+    sd.set_loss_variables(loss)
+    grads = sd.calculate_gradients({}, "w")
+
+    wv = np.asarray(w.get_arr(), dtype=np.float64)
+    xv = np.asarray(x.get_arr(), dtype=np.float64)
+
+    def f(wm):
+        return np.sum(np.tanh(xv @ wm) ** 2)
+
+    eps = 1e-5
+    num = np.zeros_like(wv)
+    for i in range(wv.shape[0]):
+        for j in range(wv.shape[1]):
+            wp, wm_ = wv.copy(), wv.copy()
+            wp[i, j] += eps
+            wm_[i, j] -= eps
+            num[i, j] = (f(wp) - f(wm_)) / (2 * eps)
+    np.testing.assert_allclose(np.asarray(grads["w"]), num, rtol=1e-3,
+                               atol=1e-5)
+
+
+def test_fit_linear_regression(rng):
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 3))
+    labels = sd.placeholder("labels", shape=(None, 1))
+    w = sd.var("w", value=np.zeros((3, 1), np.float32))
+    b = sd.var("b", value=np.zeros((1,), np.float32))
+    pred = sd.math.mmul(x, w) + b
+    sd.loss.meanSquaredError(labels, pred, name="loss")
+
+    true_w = np.array([[1.5], [-2.0], [0.5]], np.float32)
+    xv = rng.normal(size=(256, 3)).astype(np.float32)
+    yv = xv @ true_w + 0.3
+
+    cfg = (TrainingConfig.builder()
+           .updater(Adam(learning_rate=0.1))
+           .data_set_feature_mapping("x")
+           .data_set_label_mapping("labels")
+           .build())
+    sd.set_training_config(cfg)
+    hist = None
+    for _ in range(60):
+        hist = sd.fit(features=xv, labels=yv)
+    assert hist.loss_curve[-1] < 1e-2
+    np.testing.assert_allclose(np.asarray(w.get_arr()), true_w, atol=0.05)
+    np.testing.assert_allclose(np.asarray(b.get_arr()), [0.3], atol=0.05)
+
+
+def test_mlp_classification_convergence(rng):
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 2))
+    labels = sd.placeholder("labels", shape=(None, 2))
+    w0 = sd.var("w0", shape=(2, 16), key=None)
+    b0 = sd.var("b0", value=np.zeros((16,), np.float32))
+    w1 = sd.var("w1", shape=(16, 2))
+    b1 = sd.var("b1", value=np.zeros((2,), np.float32))
+    h = sd.nn.relu(sd.nn.linear(x, w0, b0))
+    logits = sd.nn.linear(h, w1, b1)
+    sd.loss.softmaxCrossEntropy(labels, logits, name="loss")
+
+    n = 256
+    xv = rng.normal(size=(n, 2)).astype(np.float32)
+    cls = (xv[:, 0] * xv[:, 1] > 0).astype(int)  # XOR-ish quadrant task
+    yv = np.eye(2, dtype=np.float32)[cls]
+
+    sd.set_training_config(TrainingConfig.builder()
+                           .updater(Adam(learning_rate=0.05))
+                           .data_set_feature_mapping("x")
+                           .data_set_label_mapping("labels")
+                           .build())
+    for _ in range(150):
+        sd.fit(features=xv, labels=yv)
+    probs = sd.output({"x": xv}, logits)[logits.name]
+    acc = (probs.argmax(1) == cls).mean()
+    assert acc > 0.9
+
+
+def test_control_flow_cond_and_while():
+    sd = SameDiff.create()
+    x = sd.constant(np.float32(3.0), name="x")
+    pred = sd.math.gt(x, 0.0)
+    out = sd.cond(pred, lambda v: v * 2.0, lambda v: v - 1.0, [x])
+    assert float(out.eval()) == 6.0
+
+    sd2 = SameDiff.create()
+    i = sd2.constant(np.float32(0.0), name="i")
+    acc = sd2.constant(np.float32(1.0), name="acc")
+    outs = sd2.while_loop(
+        lambda i_, a_: i_ < 5.0,
+        lambda i_, a_: (i_ + 1.0, a_ * 2.0),
+        [i, acc])
+    vals = sd2.output({}, *outs)
+    assert float(vals[outs[1].name]) == 32.0
+
+
+def test_scan_cumulative():
+    sd = SameDiff.create()
+    xs = sd.constant(np.arange(1, 6, dtype=np.float32), name="xs")
+    init = sd.constant(np.float32(0.0), name="init")
+
+    def body(carry, xt):
+        s = carry + xt
+        return s, s
+
+    final, ys = sd.scan(body, init, xs)
+    outs = sd.output({}, final, ys)
+    assert float(outs[final.name]) == 15.0
+    np.testing.assert_allclose(outs[ys.name], np.cumsum(np.arange(1, 6)))
+
+
+def test_lstm_layer_shapes_and_grad(rng):
+    """BASELINE config #3a: SameDiff LSTM."""
+    T, B, I, H = 7, 4, 5, 8
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(T, B, I))
+    w = sd.var("w", value=(0.1 * rng.normal(size=(I, 4 * H))).astype(
+        np.float32))
+    r = sd.var("r", value=(0.1 * rng.normal(size=(H, 4 * H))).astype(
+        np.float32))
+    b = sd.var("b", value=np.zeros((4 * H,), np.float32))
+    h0 = sd.constant(np.zeros((B, H), np.float32), name="h0")
+    c0 = sd.constant(np.zeros((B, H), np.float32), name="c0")
+    ys, h_f, c_f = sd.rnn.lstmLayer(x, w, r, b, h0, c0)
+    loss = sd.math.sum(sd.math.square(ys))
+    sd.set_loss_variables(loss)
+
+    xv = rng.normal(size=(T, B, I)).astype(np.float32)
+    outs = sd.output({"x": xv}, ys, h_f, c_f)
+    assert outs[ys.name].shape == (T, B, H)
+    assert outs[h_f.name].shape == (B, H)
+    np.testing.assert_allclose(outs[ys.name][-1], outs[h_f.name], rtol=1e-5)
+
+    grads = sd.calculate_gradients({"x": xv}, "w", "r", "b")
+    assert grads["w"].shape == (I, 4 * H)
+    assert float(np.abs(np.asarray(grads["w"])).sum()) > 0
+
+
+def test_small_transformer_block(rng):
+    """BASELINE config #3b: small Transformer encoder block via
+    multiHeadDotProductAttention + layerNorm + FFN, trained a few steps."""
+    B, T, E, HEADS = 4, 6, 16, 4
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(B, T, E))
+    labels = sd.placeholder("labels", shape=(B, E))
+
+    def pvar(name, shape):
+        return sd.var(name, value=(0.1 * rng.normal(size=shape)).astype(
+            np.float32))
+
+    wq, wk, wv = pvar("wq", (E, E)), pvar("wk", (E, E)), pvar("wv", (E, E))
+    wo = pvar("wo", (E, E))
+    att = sd.nn.multiHeadDotProductAttention(x, x, x, wq, wk, wv, wo,
+                                             num_heads=HEADS)
+    g1 = sd.var("g1", value=np.ones((E,), np.float32))
+    bt1 = sd.var("bt1", value=np.zeros((E,), np.float32))
+    norm1 = sd.nn.layerNorm(att + x, g1, bt1)
+    w1, b1 = pvar("w1", (E, 4 * E)), sd.var(
+        "b1", value=np.zeros((4 * E,), np.float32))
+    w2, b2 = pvar("w2", (4 * E, E)), sd.var(
+        "b2", value=np.zeros((E,), np.float32))
+    ffn = sd.nn.linear(sd.nn.gelu(sd.nn.linear(norm1, w1, b1)), w2, b2)
+    g2 = sd.var("g2", value=np.ones((E,), np.float32))
+    bt2 = sd.var("bt2", value=np.zeros((E,), np.float32))
+    enc = sd.nn.layerNorm(ffn + norm1, g2, bt2)
+    pooled = sd.math.mean(enc, dims=1)
+    sd.loss.meanSquaredError(labels, pooled, name="loss")
+
+    xv = rng.normal(size=(B, T, E)).astype(np.float32)
+    yv = rng.normal(size=(B, E)).astype(np.float32)
+    sd.set_training_config(TrainingConfig.builder()
+                           .updater(Adam(learning_rate=0.01))
+                           .data_set_feature_mapping("x")
+                           .data_set_label_mapping("labels")
+                           .build())
+    losses = []
+    for _ in range(30):
+        h = sd.fit(features=xv, labels=yv)
+        losses.append(h.loss_curve[-1])
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_attention_masking(rng):
+    B, T, E = 2, 5, 8
+    sd = SameDiff.create()
+    q = sd.placeholder("q", shape=(B, T, E))
+    mask = sd.placeholder("mask", shape=(B, T))
+    out = sd.nn.dotProductAttention(q, q, q, mask=mask)
+    qv = rng.normal(size=(B, T, E)).astype(np.float32)
+    mv = np.ones((B, T), np.float32)
+    mv[:, -2:] = 0  # last two kv positions masked out
+    o = sd.output({"q": qv, "mask": mv}, out)[out.name]
+    # masked result must differ from unmasked and contain no NaN
+    o_full = sd.output({"q": qv, "mask": np.ones((B, T), np.float32)},
+                       out)[out.name]
+    assert np.isfinite(o).all()
+    assert np.abs(o - o_full).max() > 1e-6
+
+
+def test_serde_roundtrip(tmp_path, rng):
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 4))
+    w = sd.var("w", value=rng.normal(size=(4, 3)).astype(np.float32))
+    b = sd.var("b", value=np.zeros((3,), np.float32))
+    logits = sd.nn.linear(x, w, b).rename("logits")
+    labels = sd.placeholder("labels", shape=(None, 3))
+    sd.loss.softmaxCrossEntropy(labels, logits, name="loss")
+
+    xv = rng.normal(size=(8, 4)).astype(np.float32)
+    yv = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    sd.set_training_config(TrainingConfig.builder()
+                           .updater(Adam(learning_rate=0.01))
+                           .data_set_feature_mapping("x")
+                           .data_set_label_mapping("labels")
+                           .build())
+    sd.fit(features=xv, labels=yv)
+    before = sd.output({"x": xv}, "logits")["logits"]
+
+    path = tmp_path / "model.sdz"
+    sd.save(str(path))
+    sd2 = SameDiff.load(str(path))
+    after = sd2.output({"x": xv}, "logits")["logits"]
+    np.testing.assert_allclose(before, after, rtol=1e-6)
+    # updater state survives -> continued training matches
+    sd2.set_training_config(TrainingConfig.builder()
+                            .updater(Adam(learning_rate=0.01))
+                            .data_set_feature_mapping("x")
+                            .data_set_label_mapping("labels")
+                            .build())
+    sd2.fit(features=xv, labels=yv)
+
+
+def test_serde_rejects_control_flow(tmp_path):
+    sd = SameDiff.create()
+    x = sd.constant(np.float32(1.0), name="x")
+    sd.cond(sd.math.gt(x, 0.0), lambda v: v, lambda v: -v, [x])
+    with pytest.raises(ValueError, match="control flow"):
+        sd.save(str(tmp_path / "bad.sdz"))
+
+
+def test_shape_ops_and_indexing(rng):
+    sd = SameDiff.create()
+    xv = rng.normal(size=(2, 3, 4)).astype(np.float32)
+    x = sd.constant(xv, name="x")
+    r = sd.reshape(x, (6, 4))
+    p = sd.permute(x, (2, 0, 1))
+    sl = x[:, 1, :]
+    outs = sd.output({}, r, p, sl)
+    np.testing.assert_allclose(outs[r.name], xv.reshape(6, 4))
+    np.testing.assert_allclose(outs[p.name], xv.transpose(2, 0, 1))
+    np.testing.assert_allclose(outs[sl.name], xv[:, 1, :])
+
+
+def test_gather_onehot_concat(rng):
+    sd = SameDiff.create()
+    emb = sd.var("emb", value=rng.normal(size=(10, 4)).astype(np.float32))
+    idx = sd.constant(np.array([1, 3, 5], np.int32), name="idx")
+    g = sd.gather(emb, idx, axis=0)
+    oh = sd.one_hot(idx, 10)
+    cat = sd.concat(1, g, g)
+    outs = sd.output({}, g, oh, cat)
+    np.testing.assert_allclose(outs[g.name],
+                               np.asarray(emb.get_arr())[[1, 3, 5]])
+    assert outs[oh.name].shape == (3, 10)
+    assert outs[cat.name].shape == (3, 8)
+
+
+def test_losses_match_numpy(rng):
+    sd = SameDiff.create()
+    logits_v = rng.normal(size=(6, 4)).astype(np.float32)
+    labels_v = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 6)]
+    logits = sd.constant(logits_v, name="logits")
+    labels = sd.constant(labels_v, name="labels")
+    ce = sd.loss.softmaxCrossEntropy(labels, logits)
+    out = float(ce.eval())
+    lp = logits_v - logits_v.max(1, keepdims=True)
+    lp = lp - np.log(np.exp(lp).sum(1, keepdims=True))
+    expect = float((-labels_v * lp).sum(1).mean())
+    assert abs(out - expect) < 1e-5
+
+
+def test_sgd_minimize_false(rng):
+    """minimize=False climbs the objective."""
+    sd = SameDiff.create()
+    w = sd.var("w", value=np.float32([0.1]))
+    obj = sd.math.neg(sd.math.square(w)).rename("obj")  # max at w=0... climb
+    sd.set_loss_variables(obj)
+    sd.set_training_config(TrainingConfig.builder()
+                           .updater(Sgd(learning_rate=0.1))
+                           .minimize(False).build())
+    for _ in range(5):
+        sd.fit(features=np.zeros((1, 1), np.float32),
+               labels=np.zeros((1, 1), np.float32))
+    assert abs(float(np.asarray(w.get_arr()))) < 0.1  # moved toward 0
