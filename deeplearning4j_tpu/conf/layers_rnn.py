@@ -57,16 +57,20 @@ def _mask_bt1(mask, x):
 
 
 def reverse_sequence(x, mask=None):
-    """Reverse the VALID portion of each sequence, keeping padding in place
-    (reference ``ReverseTimeSeriesVertex`` used by ``Bidirectional``).
-    Assumes ALIGN_START masks (valid steps first), the bridge's default."""
+    """Reverse the VALID portion of each sequence in place, keeping padding
+    where it is (reference ``ReverseTimeSeriesVertex`` used by
+    ``Bidirectional``). Handles both ALIGN_START and ALIGN_END masks: the
+    contiguous valid segment [first..last] is mirrored within its own slots.
+    """
     T = x.shape[1]
-    t = jnp.arange(T)
+    t = jnp.arange(T)[None, :]
     if mask is None:
         return x[:, ::-1, :]
-    lengths = jnp.sum(jnp.asarray(mask, jnp.int32), axis=1)  # [batch]
-    src = jnp.where(t[None, :] < lengths[:, None],
-                    lengths[:, None] - 1 - t[None, :], t[None, :])
+    m = jnp.asarray(mask, jnp.int32)
+    first = jnp.argmax(m, axis=1).astype(jnp.int32)[:, None]
+    last = (T - 1 - jnp.argmax(m[:, ::-1], axis=1).astype(jnp.int32))[:, None]
+    inside = (t >= first) & (t <= last)
+    src = jnp.where(inside, first + last - t, t)
     return jnp.take_along_axis(x, src[:, :, None], axis=1)
 
 
@@ -183,30 +187,36 @@ class LSTM(BaseRecurrentLayer):
         return {"h": jnp.zeros((batch, self.n_out), dtype),
                 "c": jnp.zeros((batch, self.n_out), dtype)}
 
-    def _gates(self, z, c_prev, params):
-        h = self.n_out
-        i = self.gate_activation.apply(z[:, 0 * h:1 * h])
-        f = self.gate_activation.apply(z[:, 1 * h:2 * h])
-        o = self.gate_activation.apply(z[:, 2 * h:3 * h])
-        g = self.activation.apply(z[:, 3 * h:4 * h])
-        return i, f, o, g
-
     def forward_with_carry(self, params, carry, x, mask=None, train=False,
                            rng=None):
+        """Shared LSTM scan. Peepholes (GravesLSTM) are the optional
+        pI/pF/pO params: i/f gates peek at c_{t-1}, o gate at c_t."""
         x = self._dropout_input(x, train, rng)
         m = _mask_bt1(mask, x)
+        h = self.n_out
         xw = jnp.einsum("btf,fg->btg", x, params["W"]) + params["b"]
+        pI, pF, pO = (params.get("pI"), params.get("pF"), params.get("pO"))
 
         def step(hc, inp):
             h_prev, c_prev = hc
             xw_t, m_t = inp
             z = xw_t + h_prev @ params["RW"]
-            i, f, o, g = self._gates(z, c_prev, params)
+            zi, zf, zo = z[:, :h], z[:, h:2 * h], z[:, 2 * h:3 * h]
+            if pI is not None:
+                zi = zi + pI * c_prev
+            if pF is not None:
+                zf = zf + pF * c_prev
+            i = self.gate_activation.apply(zi)
+            f = self.gate_activation.apply(zf)
+            g = self.activation.apply(z[:, 3 * h:4 * h])
             c_new = f * c_prev + i * g
+            if pO is not None:
+                zo = zo + pO * c_new
+            o = self.gate_activation.apply(zo)
             h_new = o * self.activation.apply(c_new)
             c = m_t * c_new + (1.0 - m_t) * c_prev
-            h = m_t * h_new + (1.0 - m_t) * h_prev
-            return (h, c), m_t * h_new
+            h_t = m_t * h_new + (1.0 - m_t) * h_prev
+            return (h_t, c), m_t * h_new
 
         (h_f, c_f), ys = jax.lax.scan(
             step, (carry["h"], carry["c"]),
@@ -237,35 +247,8 @@ class GravesLSTM(LSTM):
         # the reference packs peepholes into the recurrent weight matrix, so
         # they are regularized as weights there; mirror that
         return ["W", "RW", "pI", "pF", "pO"]
-
-    def forward_with_carry(self, params, carry, x, mask=None, train=False,
-                           rng=None):
-        x = self._dropout_input(x, train, rng)
-        m = _mask_bt1(mask, x)
-        h_units = self.n_out
-        xw = jnp.einsum("btf,fg->btg", x, params["W"]) + params["b"]
-
-        def step(hc, inp):
-            h_prev, c_prev = hc
-            xw_t, m_t = inp
-            z = xw_t + h_prev @ params["RW"]
-            i = self.gate_activation.apply(
-                z[:, 0:h_units] + params["pI"] * c_prev)
-            f = self.gate_activation.apply(
-                z[:, h_units:2 * h_units] + params["pF"] * c_prev)
-            g = self.activation.apply(z[:, 3 * h_units:4 * h_units])
-            c_new = f * c_prev + i * g
-            o = self.gate_activation.apply(
-                z[:, 2 * h_units:3 * h_units] + params["pO"] * c_new)
-            h_new = o * self.activation.apply(c_new)
-            c = m_t * c_new + (1.0 - m_t) * c_prev
-            h = m_t * h_new + (1.0 - m_t) * h_prev
-            return (h, c), m_t * h_new
-
-        (h_f, c_f), ys = jax.lax.scan(
-            step, (carry["h"], carry["c"]),
-            (jnp.swapaxes(xw, 0, 1), jnp.swapaxes(m, 0, 1)))
-        return jnp.swapaxes(ys, 0, 1), {"h": h_f, "c": c_f}
+    # forward_with_carry inherited: LSTM's scan applies the pI/pF/pO
+    # peephole terms whenever those params are present
 
 
 @serde.register_enum
@@ -293,6 +276,29 @@ class Bidirectional(Layer):
     # streaming inference is undefined for the backward pass; the reference
     # Bidirectional also cannot rnnTimeStep
     has_carry = False
+
+    # the solver reads training hyperparams off the top-level layer conf;
+    # wrappers carry none of their own, so everything delegates to the
+    # wrapped layer (reference: Bidirectional extends the wrapped conf)
+    @property
+    def regularization(self):
+        return getattr(self.layer, "regularization", ())
+
+    @property
+    def regularization_bias(self):
+        return getattr(self.layer, "regularization_bias", ())
+
+    @property
+    def updater(self):
+        return getattr(self.layer, "updater", None)
+
+    @property
+    def gradient_normalization(self):
+        return getattr(self.layer, "gradient_normalization", None)
+
+    @property
+    def gradient_normalization_threshold(self):
+        return getattr(self.layer, "gradient_normalization_threshold", 1.0)
 
     def output_type(self, input_type):
         out = self.layer.output_type(input_type)
@@ -356,6 +362,26 @@ class _RecurrentWrapper(Layer):
 
     def __post_init__(self):
         self.has_carry = getattr(self.layer, "has_carry", False)
+
+    @property
+    def regularization(self):
+        return getattr(self.layer, "regularization", ())
+
+    @property
+    def regularization_bias(self):
+        return getattr(self.layer, "regularization_bias", ())
+
+    @property
+    def updater(self):
+        return getattr(self.layer, "updater", None)
+
+    @property
+    def gradient_normalization(self):
+        return getattr(self.layer, "gradient_normalization", None)
+
+    @property
+    def gradient_normalization_threshold(self):
+        return getattr(self.layer, "gradient_normalization_threshold", 1.0)
 
     def output_type(self, input_type):
         return self.layer.output_type(input_type)

@@ -205,7 +205,15 @@ class ListBuilder:
         caller's layer objects (name assignment happens on the copies).
         Shared with the ComputationGraph ``GraphBuilder``."""
         if not isinstance(layer, BaseLayer):
-            return dataclasses.replace(layer)
+            layer = dataclasses.replace(layer)
+            # wrapper layers (Bidirectional, LastTimeStep, MaskZeroLayer):
+            # builder defaults must reach the wrapped layer too
+            inner = getattr(layer, "layer", None)
+            if isinstance(inner, Layer):
+                layer.layer = ListBuilder._apply_defaults_static(b, inner)
+                if hasattr(layer, "__post_init__"):
+                    layer.__post_init__()
+            return layer
         layer = dataclasses.replace(layer)
         cls_defaults = {f.name: f.default for f in dataclasses.fields(layer)
                         if f.default is not dataclasses.MISSING}

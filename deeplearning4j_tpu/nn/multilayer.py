@@ -279,6 +279,8 @@ class MultiLayerNetwork:
         if self._tbptt_step is None:
             self._tbptt_step = self._build_tbptt_step()
         seg = int(self.conf.tbptt_fwd_length)
+        back = int(self.conf.tbptt_back_length or seg)
+        back = min(back, seg)
         n, total_t = features.shape[0], features.shape[1]
         if fmask is None:
             fmask = jnp.ones((n, total_t), self._dtype)
@@ -287,12 +289,26 @@ class MultiLayerNetwork:
         carries = {str(i): layer.zero_carry(n, self._dtype)
                    for i, layer in enumerate(self.conf.layers)
                    if getattr(layer, "has_carry", False)}
+        if back < seg and self._rnn_step_fn is None:
+            self._rnn_step_fn = self._build_rnn_step_fn()
         losses = []
         for start in range(0, total_t, seg):
             f_seg = _pad_time(features[:, start:start + seg], seg)
             l_seg = _pad_time(labels[:, start:start + seg], seg)
             fm_seg = _pad_time(fmask[:, start:start + seg], seg)
             lm_seg = _pad_time(lmask[:, start:start + seg], seg)
+            if back < seg:
+                # tbptt_back_length < fwd: the first seg-back steps only
+                # advance RNN state (no gradient flows through them —
+                # reference truncates the backward pass at backLength)
+                cut = seg - back
+                _, carries = self._rnn_step_fn(
+                    self.params, self.state, carries,
+                    f_seg[:, :cut], fm_seg[:, :cut])
+                f_seg = _pad_time(f_seg[:, cut:], seg)
+                l_seg = _pad_time(l_seg[:, cut:], seg)
+                fm_seg = _pad_time(fm_seg[:, cut:], seg)
+                lm_seg = _pad_time(lm_seg[:, cut:], seg)
             rng = jax.random.fold_in(self._base_key,
                                      self.iteration + 1_000_003)
             it = jnp.asarray(float(self.iteration), jnp.float32)
@@ -317,12 +333,19 @@ class MultiLayerNetwork:
         ``MultiLayerNetwork#rnnTimeStep``)."""
         if self.params is None:
             self.init()
-        for layer in self.conf.layers:
+        def contains_bidirectional(layer):
             if type(layer).__name__ == "Bidirectional":
+                return True
+            inner = getattr(layer, "layer", None)
+            return inner is not None and contains_bidirectional(inner)
+
+        for layer in self.conf.layers:
+            if contains_bidirectional(layer):
                 raise RuntimeError(
-                    "rnn_time_step is unsupported for Bidirectional layers: "
-                    "the backward pass needs the full sequence (reference "
-                    "throws UnsupportedOperationException here)")
+                    "rnn_time_step is unsupported for Bidirectional layers "
+                    "(including wrapped ones): the backward pass needs the "
+                    "full sequence (reference throws "
+                    "UnsupportedOperationException here)")
         if self._rnn_step_fn is None:
             self._rnn_step_fn = self._build_rnn_step_fn()
         x = jnp.asarray(np.asarray(x), self._dtype)

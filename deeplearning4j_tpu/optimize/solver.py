@@ -15,17 +15,17 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.conf.layers import BaseLayer, GradientNormalization
+from deeplearning4j_tpu.conf.layers import GradientNormalization
 
 
 def normalize_layer_gradients(layer_conf, grads: dict) -> dict:
     """Apply the layer's GradientNormalization (reference
     ``BaseOptimizer#postProcessGradient``)."""
-    if not isinstance(layer_conf, BaseLayer) or not grads:
-        return grads
-    gn = layer_conf.gradient_normalization
-    thr = layer_conf.gradient_normalization_threshold
-    if gn is GradientNormalization.NONE:
+    # duck-typed (not isinstance BaseLayer): wrapper layers delegate these
+    # attrs to their wrapped layer
+    gn = getattr(layer_conf, "gradient_normalization", None)
+    thr = getattr(layer_conf, "gradient_normalization_threshold", 1.0)
+    if not grads or gn is None or gn is GradientNormalization.NONE:
         return grads
     if gn is GradientNormalization.RENORMALIZE_L2_PER_PARAM_TYPE:
         return {k: g / (jnp.linalg.norm(g) + 1e-12) for k, g in grads.items()}

@@ -1,0 +1,140 @@
+"""Device mesh / topology abstraction — the distributed backbone.
+
+Reference equivalents (SURVEY.md §2.4, §5.8): the entire Aeron UDP transport
+(`nd4j-aeron` ``AeronNDArrayPublisher``/``NDArrayMessage`` chunking), the
+``VoidParameterServer`` mesh, and ``AffinityManager`` device pinning. On TPU
+all of that collapses into XLA collectives compiled into the program: this
+module only names the axes, builds the ``jax.sharding.Mesh``, and hands out
+``NamedSharding``s; ``psum``/``all_gather``/``ppermute`` ride ICI within a
+slice and DCN across slices, inserted by the compiler.
+
+Axis convention (the full menu; unused axes just have size 1):
+``data`` (DP replicas), ``model`` (TP shards), ``pipeline`` (PP stages),
+``sequence`` (SP/ring-attention shards), ``expert`` (EP/MoE shards).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import typing as tp
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+PIPELINE_AXIS = "pipeline"
+SEQUENCE_AXIS = "sequence"
+EXPERT_AXIS = "expert"
+
+ALL_AXES = (DATA_AXIS, MODEL_AXIS, PIPELINE_AXIS, SEQUENCE_AXIS, EXPERT_AXIS)
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Declarative mesh shape. Unspecified axes default to 1; ``data=-1``
+    (the default) absorbs all remaining devices, so the same config scales
+    from 1 chip to a pod unchanged."""
+
+    data: int = -1
+    model: int = 1
+    pipeline: int = 1
+    sequence: int = 1
+    expert: int = 1
+    devices: tp.Optional[tp.Sequence] = None  # default: jax.devices()
+
+    def build(self) -> Mesh:
+        devices = list(self.devices if self.devices is not None
+                       else jax.devices())
+        n = len(devices)
+        fixed = self.model * self.pipeline * self.sequence * self.expert
+        data = self.data
+        if data == -1:
+            if n % fixed != 0:
+                raise ValueError(
+                    f"{n} devices not divisible by model*pipeline*sequence*"
+                    f"expert={fixed}")
+            data = n // fixed
+        total = data * fixed
+        if total > n:
+            raise ValueError(f"mesh needs {total} devices, have {n}")
+        shape = (data, self.model, self.pipeline, self.sequence, self.expert)
+        arr = np.array(devices[:total]).reshape(shape)
+        return Mesh(arr, ALL_AXES)
+
+
+def single_host_mesh(n_devices: int | None = None, **axes) -> Mesh:
+    """Convenience: mesh over the first n local devices (default: all)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return MeshConfig(devices=devices, **axes).build()
+
+
+def data_parallel_spec(mesh: Mesh) -> NamedSharding:
+    """Batch sharded over 'data', everything else replicated — the
+    ParallelWrapper layout."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_spec(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Place a host batch so its leading dim is split over the 'data' axis
+    (the role of ParallelWrapper's splitter + per-worker MagicQueues)."""
+    sharding = data_parallel_spec(mesh)
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), batch)
+
+
+def replicate(mesh: Mesh, tree):
+    """Replicate params/opt-state across the mesh (the reference copies
+    replica params to each device via AffinityManager)."""
+    sharding = replicated_spec(mesh)
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), tree)
+
+
+def initialize_distributed(coordinator_address: str | None = None,
+                           num_processes: int | None = None,
+                           process_id: int | None = None) -> None:
+    """Multi-host bootstrap (reference: Spark master/worker setup + Aeron
+    ``VoidParameterServer`` join — SURVEY.md §3.5). One call per host;
+    afterwards ``jax.devices()`` spans the whole pod and the same Mesh code
+    scales across hosts, collectives riding ICI intra-slice / DCN inter-
+    slice. No-op when every argument is None and env vars configure it."""
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
+def device_count(mesh: Mesh, axis: str = DATA_AXIS) -> int:
+    return mesh.shape[axis]
+
+
+def pad_batch_to_multiple(features, labels, multiple: int):
+    """Pad the leading (batch) dim to a multiple of the data-axis size so
+    even ragged final batches shard; returns (features, labels, weights)
+    where weights zero out the padded rows' loss contribution."""
+    n = features.shape[0]
+    target = math.ceil(n / multiple) * multiple
+    pad = target - n
+    w = np.ones((target,), np.float32)
+    if pad:
+        w[n:] = 0.0
+        features = np.concatenate(
+            [features, np.zeros((pad,) + features.shape[1:],
+                                features.dtype)])
+        labels = np.concatenate(
+            [labels, np.zeros((pad,) + labels.shape[1:], labels.dtype)])
+    return features, labels, w

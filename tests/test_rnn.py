@@ -314,3 +314,108 @@ def test_tbptt_rejects_sequence_level_labels():
     labels = np.eye(2, dtype=np.float32)[[0, 1]]
     with pytest.raises(ValueError, match="per-timestep labels"):
         net.fit_batch(DataSet(feats, labels))
+
+
+# --------------------------------------------------------------------------
+# regression tests for review findings (wrapper delegation, ALIGN_END,
+# builder defaults through wrappers, tbptt back length)
+# --------------------------------------------------------------------------
+def test_wrapper_layers_delegate_training_hyperparams():
+    """Regularization/updater/gradient-norm set on a wrapped layer must be
+    visible through the wrapper (the solver reads them off the top conf)."""
+    from deeplearning4j_tpu.conf.layers import GradientNormalization
+    from deeplearning4j_tpu.conf.regularization import L2Regularization
+    from deeplearning4j_tpu.optimize import solver
+
+    inner = LSTM(n_out=3, regularization=(L2Regularization(0.1),),
+                 updater=Sgd(0.5),
+                 gradient_normalization=GradientNormalization.CLIP_L2_PER_LAYER,
+                 gradient_normalization_threshold=0.5)
+    for wrapper in (LastTimeStep(layer=inner),
+                    Bidirectional(layer=inner)):
+        assert wrapper.regularization == inner.regularization
+        assert wrapper.updater is inner.updater
+        assert (wrapper.gradient_normalization
+                is GradientNormalization.CLIP_L2_PER_LAYER)
+        g = {"W": jnp.ones((4, 12))}
+        clipped = solver.normalize_layer_gradients(wrapper, g)
+        norm = float(jnp.sqrt(jnp.sum(clipped["W"] ** 2)))
+        assert norm <= 0.5 + 1e-5
+
+
+def test_reverse_sequence_align_end():
+    """ALIGN_END masks: valid segment reversed in place, padding intact."""
+    from deeplearning4j_tpu.conf.layers_rnn import reverse_sequence
+
+    x = np.arange(8, dtype=np.float32).reshape(1, 4, 2)
+    mask = np.array([[0.0, 0.0, 1.0, 1.0]])  # valid steps at t=2,3
+    out = np.asarray(reverse_sequence(jnp.asarray(x), jnp.asarray(mask)))
+    np.testing.assert_allclose(out[0, 2], x[0, 3])
+    np.testing.assert_allclose(out[0, 3], x[0, 2])
+    np.testing.assert_allclose(out[0, :2], x[0, :2])  # padding untouched
+
+
+def test_builder_defaults_reach_wrapped_layer():
+    from deeplearning4j_tpu.conf.regularization import L2Regularization
+    from deeplearning4j_tpu.conf.weights import WeightInit
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1)
+            .weight_init(WeightInit.UNIFORM)
+            .l2(0.01)
+            .list()
+            .layer(Bidirectional(layer=LSTM(n_out=4)))
+            .layer(RnnOutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(3, timesteps=5))
+            .build())
+    inner = conf.layers[0].layer
+    assert inner.weight_init == WeightInit.UNIFORM
+    assert any(isinstance(r, L2Regularization) for r in inner.regularization)
+
+
+def test_rnn_time_step_rejects_wrapped_bidirectional():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1)
+            .list()
+            .layer(LastTimeStep(layer=Bidirectional(layer=LSTM(n_out=4))))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(3, timesteps=5))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    with pytest.raises(RuntimeError, match="Bidirectional"):
+        net.rnn_time_step(np.zeros((2, 5, 3), np.float32))
+
+
+def test_tbptt_back_length_shorter_than_fwd():
+    """fwd=4, back=2: runs, learns, and the prefix steps carry state."""
+    b = (NeuralNetConfiguration.builder()
+         .seed(12345)
+         .updater(Adam(5e-3))
+         .list()
+         .layer(LSTM(n_out=4))
+         .layer(RnnOutputLayer(n_out=2)))
+    b.set_input_type(InputType.recurrent(3, timesteps=8))
+    b.backprop_type(BackpropType.TRUNCATED_BPTT, 4, 2)
+    conf = b.build()
+    assert conf.tbptt_back_length == 2
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    feats = rng.normal(size=(4, 8, 3)).astype(np.float32)
+    labels = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (4, 8))]
+    loss = net.fit_batch(DataSet(feats, labels))
+    assert np.isfinite(loss)
+
+
+def test_graves_lstm_peepholes_change_output():
+    """GravesLSTM inherits LSTM's scan; nonzero peepholes must alter it."""
+    layer = GravesLSTM(n_out=3)
+    itype = InputType.recurrent(2, timesteps=4)
+    p = layer.init(KEY, itype)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 4, 2)),
+                    jnp.float32)
+    carry = layer.zero_carry(2)
+    y0, _ = layer.forward_with_carry(p, carry, x)
+    p2 = dict(p)
+    p2["pO"] = jnp.ones_like(p["pO"])
+    y1, _ = layer.forward_with_carry(p2, carry, x)
+    assert float(jnp.abs(y1 - y0).max()) > 1e-6
