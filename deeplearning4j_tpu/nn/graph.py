@@ -173,6 +173,39 @@ class ComputationGraph:
 
         return step
 
+    def grad_fn(self):
+        """Backward only, updater NOT applied: (params, state, features,
+        labels, lmasks, rng) -> (loss, new_state, grads). ParallelWrapper's
+        gradient-exchange hook point (SURVEY.md §3.4)."""
+
+        def gfn(params, state, features, labels, lmasks, rng):
+            def loss_fn(p):
+                return self._loss(p, state, features, labels, lmasks, rng)
+
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            return loss, new_state, grads
+
+        return gfn
+
+    def apply_updates_fn(self):
+        """Updater half: (params, opt_state, grads, it, ep) ->
+        (new_params, new_opt_state)."""
+
+        def afn(params, opt_state, grads, it, ep):
+            new_params, new_opt = {}, {}
+            for k in params:
+                v = self._vmap[k].vertex
+                layer_conf = getattr(v, "layer", None) or v
+                upd = self._updater_for(k)
+                lr = upd.current_lr(it, ep)
+                g = solver.normalize_layer_gradients(layer_conf, grads[k])
+                new_params[k], new_opt[k] = solver.apply_updater_to_layer(
+                    layer_conf, upd, params[k], g, opt_state[k], lr, it, ep)
+            return new_params, new_opt
+
+        return afn
+
     # --- training ----------------------------------------------------------
     def fit(self, data, labels=None, epochs: int = 1):
         """Train (reference ``ComputationGraph#fit`` overloads:
@@ -255,7 +288,11 @@ class ComputationGraph:
                 return tuple(acts[n] for n in self.conf.network_outputs)
 
             self._output_fn = jax.jit(out)
-        xs = tuple(jnp.asarray(np.asarray(x), self._dtype) for x in inputs)
+        # keep jax.Arrays as-is (preserves committed shardings, e.g. from
+        # ParallelInference); only host data goes through numpy
+        xs = tuple(
+            x.astype(self._dtype) if isinstance(x, jax.Array)
+            else jnp.asarray(np.asarray(x), self._dtype) for x in inputs)
         outs = self._output_fn(self.params, self.state, xs)
         return outs[0] if len(outs) == 1 else list(outs)
 

@@ -175,6 +175,42 @@ class MultiLayerNetwork:
 
         return step
 
+    def grad_fn(self):
+        """Backward only, updater NOT applied: (params, state, features,
+        labels, fmask, lmask, rng) -> (loss, new_state, grads). The split
+        point where ParallelWrapper interposes gradient exchange (reference
+        ``EncodingHandler#encodeUpdates`` hook, SURVEY.md §3.4)."""
+
+        def gfn(params, state, features, labels, fmask, lmask, rng):
+            def loss_fn(p):
+                return self._loss(p, state, features, labels, fmask, lmask,
+                                  rng)
+
+            (loss, (new_state, _)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            return loss, new_state, grads
+
+        return gfn
+
+    def apply_updates_fn(self):
+        """Updater half of the step: (params, opt_state, grads, it, ep) ->
+        (new_params, new_opt_state). Gradient normalization + regularization
+        + per-layer updater (reference ``MultiLayerUpdater#update``)."""
+        layers = self.conf.layers
+
+        def afn(params, opt_state, grads, it, ep):
+            new_params, new_opt = {}, {}
+            for k in params:
+                layer = layers[int(k)]
+                upd = self._updater_for(int(k))
+                lr = upd.current_lr(it, ep)
+                g = solver.normalize_layer_gradients(layer, grads[k])
+                new_params[k], new_opt[k] = solver.apply_updater_to_layer(
+                    layer, upd, params[k], g, opt_state[k], lr, it, ep)
+            return new_params, new_opt
+
+        return afn
+
     def _build_train_step(self):
         return jax.jit(self.train_step_fn(), donate_argnums=(0, 1, 2))
 
@@ -387,9 +423,15 @@ class MultiLayerNetwork:
             self.init()
         if self._output_fn is None:
             self._output_fn = self._build_output_fn()
-        x = jnp.asarray(np.asarray(x), self._dtype)
-        fmask = (None if fmask is None
-                 else jnp.asarray(np.asarray(fmask), self._dtype))
+        # keep jax.Arrays as-is (preserves any committed sharding, e.g.
+        # ParallelInference's P('data') placement); only host data goes
+        # through numpy
+        x = (x.astype(self._dtype) if isinstance(x, jax.Array)
+             else jnp.asarray(np.asarray(x), self._dtype))
+        if fmask is not None:
+            fmask = (fmask.astype(self._dtype)
+                     if isinstance(fmask, jax.Array)
+                     else jnp.asarray(np.asarray(fmask), self._dtype))
         return self._output_fn(self.params, self.state, x, fmask)
 
     def score(self, ds: DataSet = None) -> float:

@@ -1,0 +1,30 @@
+"""Parallelism: mesh/topology, data-parallel training, sharded inference,
+compressed gradient exchange (reference ``deeplearning4j-scaleout`` +
+``nd4j-parameter-server-parent`` — SURVEY.md §2.3, §2.4, §3.4)."""
+
+from deeplearning4j_tpu.parallel.compression import (  # noqa: F401
+    AdaptiveThresholdAlgorithm,
+    ThresholdAlgorithm,
+    bitmap_encode,
+    threshold_decode,
+    threshold_encode,
+)
+from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401
+from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    PIPELINE_AXIS,
+    SEQUENCE_AXIS,
+    MeshConfig,
+    data_parallel_spec,
+    initialize_distributed,
+    replicate,
+    replicated_spec,
+    shard_batch,
+    single_host_mesh,
+)
+from deeplearning4j_tpu.parallel.wrapper import (  # noqa: F401
+    ParallelWrapper,
+    TrainingMode,
+)

@@ -103,7 +103,3 @@ def encode_tree(grads, residuals, tau):
             jax.tree_util.tree_unflatten(treedef, new_res), sparsity)
 
 
-def zeros_like_tree(tree):
-    import jax
-
-    return jax.tree_util.tree_map(jnp.zeros_like, tree)

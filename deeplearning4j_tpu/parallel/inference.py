@@ -1,0 +1,84 @@
+"""ParallelInference — multi-device batched serving.
+
+Reference: ``org.deeplearning4j.parallelism.ParallelInference`` (SURVEY.md
+§3.6): caller threads enqueue requests, an ``ObservablesProvider`` batches
+them, per-device ``InferenceWorker`` replicas run batched forwards, results
+are demuxed.
+
+TPU-native inversion: there are no worker threads or queues — a request
+batch is padded to a multiple of the mesh's data axis and executed by the
+model's (already jitted) forward with inputs sharded ``P('data')``; XLA
+splits the batch across devices. ``INPLACE``-style replica semantics are
+inherent (params replicated, read-only).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.parallel import mesh as mesh_mod
+
+
+class ParallelInference:
+    """Sharded batch inference over all (or ``workers``) local devices.
+
+    Usage::
+
+        pi = ParallelInference(net, workers=8, batch_limit=256)
+        y = pi.output(x)          # any leading batch size, incl. ragged
+    """
+
+    def __init__(self, model, workers: Optional[int] = None,
+                 batch_limit: int = 0, mesh=None):
+        if model.params is None:
+            model.init()
+        self.model = model
+        self.mesh = mesh if mesh is not None else mesh_mod.single_host_mesh(
+            n_devices=workers)
+        self.workers = self.mesh.shape[mesh_mod.DATA_AXIS]
+        # max examples per device program launch (reference batchLimit);
+        # 0 = whole request in one launch
+        self.batch_limit = int(batch_limit)
+        # replicate params once up front (reference: replicas share params
+        # via INPLACE model distribution)
+        model.params = mesh_mod.replicate(self.mesh, model.params)
+        if model.state:
+            model.state = mesh_mod.replicate(self.mesh, model.state)
+
+    def _run(self, xs):
+        """One sharded program launch over a tuple of input arrays."""
+        n = xs[0].shape[0]
+        target = math.ceil(n / self.workers) * self.workers
+        spec = mesh_mod.data_parallel_spec(self.mesh)
+        placed = []
+        for a in xs:
+            if target != n:
+                a = np.concatenate(
+                    [a, np.zeros((target - n,) + a.shape[1:], a.dtype)])
+            placed.append(jax.device_put(jnp.asarray(a), spec))
+        ys = self.model.output(*placed)
+        if isinstance(ys, (list, tuple)):
+            return [np.asarray(y)[:n] for y in ys]
+        return np.asarray(ys)[:n]
+
+    def output(self, x, *more_inputs):
+        """Forward a request batch (reference ``ParallelInference#output``).
+        For multi-input ComputationGraphs pass all inputs positionally."""
+        xs = tuple(np.asarray(a) for a in (x,) + more_inputs)
+        n = xs[0].shape[0]
+        if not self.batch_limit or n <= self.batch_limit:
+            result = self._run(xs)
+        else:
+            chunks = [self._run(tuple(a[i:i + self.batch_limit] for a in xs))
+                      for i in range(0, n, self.batch_limit)]
+            if isinstance(chunks[0], list):
+                result = [np.concatenate([c[j] for c in chunks])
+                          for j in range(len(chunks[0]))]
+            else:
+                result = np.concatenate(chunks)
+        return result

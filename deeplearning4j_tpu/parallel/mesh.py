@@ -16,7 +16,6 @@ Axis convention (the full menu; unused axes just have size 1):
 from __future__ import annotations
 
 import dataclasses
-import math
 import typing as tp
 
 import jax
@@ -122,19 +121,3 @@ def device_count(mesh: Mesh, axis: str = DATA_AXIS) -> int:
     return mesh.shape[axis]
 
 
-def pad_batch_to_multiple(features, labels, multiple: int):
-    """Pad the leading (batch) dim to a multiple of the data-axis size so
-    even ragged final batches shard; returns (features, labels, weights)
-    where weights zero out the padded rows' loss contribution."""
-    n = features.shape[0]
-    target = math.ceil(n / multiple) * multiple
-    pad = target - n
-    w = np.ones((target,), np.float32)
-    if pad:
-        w[n:] = 0.0
-        features = np.concatenate(
-            [features, np.zeros((pad,) + features.shape[1:],
-                                features.dtype)])
-        labels = np.concatenate(
-            [labels, np.zeros((pad,) + labels.shape[1:], labels.dtype)])
-    return features, labels, w

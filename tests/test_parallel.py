@@ -1,0 +1,205 @@
+"""ParallelWrapper / ParallelInference over an 8-virtual-device CPU mesh
+(reference oracle: deeplearning4j-scaleout-parallelwrapper tests run N
+workers on CPU threads — SURVEY.md §4 'Multi-device w/o real cluster')."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.conf import Activation, InputType, WeightInit
+from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.conf.losses import LossMCXENT
+from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+from deeplearning4j_tpu.conf.updaters import Adam, Sgd
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import (
+    AdaptiveThresholdAlgorithm,
+    ParallelInference,
+    ParallelWrapper,
+    ThresholdAlgorithm,
+    TrainingMode,
+    single_host_mesh,
+)
+
+
+def _data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return x, y
+
+
+def _conf(updater=None, seed=12345):
+    return (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(updater or Adam(learning_rate=0.05))
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(DenseLayer(n_out=16, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+
+
+def test_mesh_has_8_devices():
+    mesh = single_host_mesh()
+    assert mesh.shape["data"] == 8
+
+
+def test_shared_gradients_exact_matches_single_device():
+    """Exact (uncompressed) gradient sharing == single-device training on
+    the same global batch: the all-reduced mean gradient is the full-batch
+    gradient (the reference's lossless-accumulator limit)."""
+    x, y = _data(64)
+    serial = MultiLayerNetwork(_conf()).init()
+    par = MultiLayerNetwork(_conf()).init()
+
+    pw = ParallelWrapper(par, training_mode=TrainingMode.SHARED_GRADIENTS)
+    it = ArrayDataSetIterator(x, y, batch=64)
+    for _ in range(3):
+        serial.fit_batch(DataSet(x, y))
+    pw.fit(it, epochs=3)
+
+    for k in serial.params:
+        for pk in serial.params[k]:
+            np.testing.assert_allclose(
+                np.asarray(serial.params[k][pk]),
+                np.asarray(par.params[k][pk]), atol=2e-5,
+                err_msg=f"layer {k} param {pk}")
+
+
+def test_shared_gradients_ragged_batch():
+    """Batch not divisible by 8: padded rows must not change the math."""
+    x, y = _data(64)
+    serial = MultiLayerNetwork(_conf()).init()
+    par = MultiLayerNetwork(_conf()).init()
+    pw = ParallelWrapper(par)
+    # 61 rows -> padded to 64 with zero label-mask
+    serial.fit_batch(DataSet(x[:61], y[:61]))
+    pw.fit(ArrayDataSetIterator(x[:61], y[:61], batch=61), epochs=1)
+    for k in serial.params:
+        for pk in serial.params[k]:
+            np.testing.assert_allclose(
+                np.asarray(serial.params[k][pk]),
+                np.asarray(par.params[k][pk]), atol=2e-5)
+
+
+def test_averaging_freq1_sgd_matches_full_batch():
+    """With plain SGD and averaging every iteration, averaged replica params
+    equal a single full-batch step: mean_i(p - lr*g_i) = p - lr*mean(g_i).
+    (Reference AVERAGING mode semantics.)"""
+    x, y = _data(64)
+    serial = MultiLayerNetwork(_conf(Sgd(learning_rate=0.1))).init()
+    par = MultiLayerNetwork(_conf(Sgd(learning_rate=0.1))).init()
+    pw = ParallelWrapper(par, training_mode=TrainingMode.AVERAGING,
+                         averaging_frequency=1)
+    serial.fit_batch(DataSet(x, y))
+    pw.fit(ArrayDataSetIterator(x, y, batch=64), epochs=1)
+    for k in serial.params:
+        for pk in serial.params[k]:
+            np.testing.assert_allclose(
+                np.asarray(serial.params[k][pk]),
+                np.asarray(par.params[k][pk]), atol=2e-5)
+
+
+def test_averaging_periodic_converges():
+    x, y = _data(256, seed=1)
+    net2 = MultiLayerNetwork(_conf()).init()
+    pw2 = ParallelWrapper(net2, training_mode=TrainingMode.AVERAGING,
+                          averaging_frequency=3)
+    scores = []
+    orig = pw2._fit_batch
+
+    def spy(ds):
+        orig(ds)
+        scores.append(pw2.score_value)
+
+    pw2._fit_batch = spy
+    pw2.fit(ArrayDataSetIterator(x, y, batch=64), epochs=8)
+    assert scores[-1] < scores[0]
+    assert np.isfinite(scores[-1])
+
+
+def test_threshold_shared_gradients_converges():
+    """Compressed mode: residual-corrected ±tau exchange still trains."""
+    x, y = _data(256, seed=2)
+    # sign-magnitude exchange: per-step movement is bounded by
+    # workers*tau*lr, so pick tau/lr in the regime the reference tunes for
+    net = MultiLayerNetwork(_conf(Sgd(learning_rate=0.5))).init()
+    pw = ParallelWrapper(
+        net, training_mode=TrainingMode.SHARED_GRADIENTS,
+        threshold_algorithm=ThresholdAlgorithm(threshold=1e-2))
+    scores = []
+    orig = pw._fit_batch
+
+    def spy(ds):
+        orig(ds)
+        scores.append(pw.score_value)
+
+    pw._fit_batch = spy
+    pw.fit(ArrayDataSetIterator(x, y, batch=64), epochs=10)
+    assert scores[-1] < scores[0]
+
+
+def test_adaptive_threshold_updates_tau():
+    x, y = _data(64, seed=3)
+    net = MultiLayerNetwork(_conf()).init()
+    algo = AdaptiveThresholdAlgorithm(threshold=1e-2)
+    pw = ParallelWrapper(net, threshold_algorithm=algo)
+    pw.fit(ArrayDataSetIterator(x, y, batch=64), epochs=3)
+    assert pw._tau > 0
+    assert np.isfinite(pw._tau)
+
+
+def test_parallel_inference_matches_serial():
+    x, y = _data(13, seed=4)  # ragged on purpose
+    net = MultiLayerNetwork(_conf()).init()
+    expected = np.asarray(net.output(x))
+    pi = ParallelInference(net)
+    got = pi.output(x)
+    np.testing.assert_allclose(got, expected, atol=1e-6)
+    assert got.shape == (13, 3)
+
+
+def test_parallel_inference_batch_limit():
+    x, _ = _data(40, seed=5)
+    net = MultiLayerNetwork(_conf()).init()
+    expected = np.asarray(net.output(x))
+    pi = ParallelInference(net, batch_limit=16)
+    got = pi.output(x)
+    np.testing.assert_allclose(got, expected, atol=1e-6)
+
+
+def test_graph_parallel_wrapper():
+    """ComputationGraph under the wrapper (exact mode)."""
+    from deeplearning4j_tpu.conf.graph import ComputationGraphConfiguration
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    x, y = _data(64, seed=6)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12345)
+            .updater(Adam(learning_rate=0.05))
+            .weight_init(WeightInit.XAVIER)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_out=16, activation=Activation.TANH),
+                       "in")
+            .add_layer("out", OutputLayer(n_out=3,
+                                          activation=Activation.SOFTMAX,
+                                          loss_fn=LossMCXENT()), "d")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    serial = ComputationGraph(conf).init()
+    par = ComputationGraph(
+        ComputationGraphConfiguration.from_json(conf.to_json())).init()
+    serial.fit_batch(DataSet(x, y))
+    pw = ParallelWrapper(par)
+    pw.fit(DataSet(x, y), epochs=1)
+    for k in serial.params:
+        for pk in serial.params[k]:
+            np.testing.assert_allclose(
+                np.asarray(serial.params[k][pk]),
+                np.asarray(par.params[k][pk]), atol=2e-5)
