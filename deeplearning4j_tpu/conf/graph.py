@@ -116,6 +116,93 @@ class LayerVertex(GraphVertex):
         return hasattr(self.layer, "score")
 
 
+@serde.register
+@dataclasses.dataclass
+class AttentionVertex(GraphVertex):
+    """Multi-head dot-product attention vertex (reference
+    ``org.deeplearning4j.nn.conf.graph.AttentionVertex`` over
+    ``sd.nn.multiHeadDotProductAttention``). Inputs: ``[queries, keys,
+    values]`` or ``[queries, keys, values, key_mask]`` — all sequences
+    ``[batch, time, size]``, mask ``[batch, time_k]``. Projections
+    ``Wq/Wk/Wv: [nIn*, nHeads*headSize]``, ``Wo: [nHeads*headSize, nOut]``.
+    The attention core dispatches to the Pallas flash kernel on TPU
+    (:mod:`deeplearning4j_tpu.ops`)."""
+
+    n_out: int = 0
+    n_heads: int = 1
+    head_size: int = 0
+    project_input: bool = True
+    weight_init: "WeightInit" = None  # set in __post_init__
+    attention_impl: str = "auto"
+
+    def __post_init__(self):
+        from deeplearning4j_tpu.conf.weights import WeightInit
+        if self.weight_init is None:
+            self.weight_init = WeightInit.XAVIER
+
+    def _head_size(self, nq):
+        return self.head_size or (self.n_out // self.n_heads)
+
+    def output_type(self, input_types):
+        tq = input_types[0]
+        ts = tq.timesteps if isinstance(tq, it.Recurrent) else -1
+        # unprojected attention emits a weighted sum of the VALUES, so the
+        # output feature size is the values' size, not the queries'
+        n = self.n_out if self.project_input else input_types[2].size
+        return it.Recurrent(size=n, timesteps=ts)
+
+    def init(self, key, input_types, dtype=jnp.float32):
+        if not self.project_input:
+            if self.n_heads != 1:
+                raise ValueError("project_input=False requires n_heads == 1")
+            return {}
+        nq, nk, nv = (t.size for t in input_types[:3])
+        hs = self._head_size(nq)
+        e = self.n_heads * hs
+        import jax as _jax
+        ks = _jax.random.split(key, 4)
+        wi = self.weight_init
+        return {
+            "Wq": wi.init(ks[0], (nq, e), nq, e, dtype),
+            "Wk": wi.init(ks[1], (nk, e), nk, e, dtype),
+            "Wv": wi.init(ks[2], (nv, e), nv, e, dtype),
+            "Wo": wi.init(ks[3], (e, self.n_out), e, self.n_out, dtype),
+            "bq": jnp.zeros((e,), dtype), "bk": jnp.zeros((e,), dtype),
+            "bv": jnp.zeros((e,), dtype), "bo": jnp.zeros((self.n_out,), dtype),
+        }
+
+    def param_order(self):
+        if not self.project_input:
+            return []
+        return ["Wq", "bq", "Wk", "bk", "Wv", "bv", "Wo", "bo"]
+
+    def regularized_param_keys(self):
+        return ["Wq", "Wk", "Wv", "Wo"] if self.project_input else []
+
+    def forward(self, params, state, inputs, train=False, rng=None):
+        from deeplearning4j_tpu.conf.layers_attention import (
+            _split_heads, _merge_heads)
+        from deeplearning4j_tpu.ops import dot_product_attention
+        q_in, k_in, v_in = inputs[0], inputs[1], inputs[2]
+        mask = inputs[3] if len(inputs) > 3 else None
+        if mask is not None and mask.ndim == 3:
+            mask = mask[:, :, 0]
+        if self.project_input:
+            q = q_in @ params["Wq"] + params["bq"]
+            k = k_in @ params["Wk"] + params["bk"]
+            v = v_in @ params["Wv"] + params["bv"]
+        else:
+            q, k, v = q_in, k_in, v_in
+        o = dot_product_attention(
+            _split_heads(q, self.n_heads), _split_heads(k, self.n_heads),
+            _split_heads(v, self.n_heads), key_mask=mask,
+            impl=self.attention_impl)
+        y = _merge_heads(o)
+        if self.project_input:
+            y = y @ params["Wo"] + params["bo"]
+        return y, state
+
+
 @serde.register_enum
 class ElementWiseOp(enum.Enum):
     """Reference ``ElementWiseVertex.Op``."""

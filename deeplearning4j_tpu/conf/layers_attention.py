@@ -1,0 +1,305 @@
+"""Attention layers.
+
+Reference: ``org.deeplearning4j.nn.conf.layers.{SelfAttentionLayer,
+LearnedSelfAttentionLayer, RecurrentAttentionLayer}`` and
+``org.deeplearning4j.nn.conf.graph.AttentionVertex`` — all built on
+``sd.nn.multiHeadDotProductAttention`` (the reference materializes the full
+attention matrix per head). TPU-native design: the projections are single
+large matmuls on the MXU and the softmax·V core goes through
+:func:`deeplearning4j_tpu.ops.dot_product_attention`, which dispatches to the
+Pallas flash kernel on TPU for long sequences (O(T) memory) — the reference
+has no such kernel.
+
+Weight layout (locked by serializer round-trip tests): ``Wq/Wk/Wv:
+[nIn, nHeads*headSize]``, ``Wo: [nHeads*headSize, nOut]``, biases per
+projection. With ``project_input=False`` the layer requires ``nHeads == 1``
+and applies attention directly (no params), as the reference does.
+
+Sequence data layout is ``[batch, time, features]`` (see layers_rnn.py);
+``key_mask`` is the per-timestep features mask ``[batch, time]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import serde
+from deeplearning4j_tpu.conf import inputs as it
+from deeplearning4j_tpu.conf.activations import Activation
+from deeplearning4j_tpu.conf.layers import BaseLayer
+from deeplearning4j_tpu.ops import dot_product_attention
+
+
+def _split_heads(x, nheads):
+    b, t, e = x.shape
+    return jnp.transpose(x.reshape(b, t, nheads, e // nheads), (0, 2, 1, 3))
+
+
+def _merge_heads(x):
+    b, h, t, d = x.shape
+    return jnp.transpose(x, (0, 2, 1, 3)).reshape(b, t, h * d)
+
+
+def _mha(params, q_in, kv_in, nheads, key_mask, causal=False, impl="auto"):
+    """Projected multi-head attention over [B, T, E] inputs."""
+    q = q_in @ params["Wq"] + params["bq"]
+    k = kv_in @ params["Wk"] + params["bk"]
+    v = kv_in @ params["Wv"] + params["bv"]
+    o = dot_product_attention(_split_heads(q, nheads), _split_heads(k, nheads),
+                              _split_heads(v, nheads), key_mask=key_mask,
+                              causal=causal, impl=impl)
+    return _merge_heads(o) @ params["Wo"] + params["bo"]
+
+
+def _rnn_size(input_type) -> int:
+    if isinstance(input_type, it.Recurrent):
+        return input_type.size
+    raise ValueError(f"attention layer needs Recurrent input, got {input_type}")
+
+
+@serde.register
+@dataclasses.dataclass
+class SelfAttentionLayer(BaseLayer):
+    """Self-attention over the sequence (reference ``SelfAttentionLayer``)."""
+
+    n_out: int = 0
+    n_heads: int = 1
+    head_size: int = 0  # 0 → nOut // nHeads
+    project_input: bool = True
+    causal: bool = False  # TPU extension (reference is always bidirectional)
+    attention_impl: str = "auto"  # auto|flash|blockwise|reference
+
+    uses_mask = True
+
+    def _head_size(self, n_in):
+        if not self.project_input:
+            return n_in
+        return self.head_size or (self.n_out // self.n_heads)
+
+    def output_type(self, input_type):
+        ts = input_type.timesteps if isinstance(input_type, it.Recurrent) else -1
+        n = self.n_out if self.project_input else _rnn_size_static(input_type)
+        return it.Recurrent(size=n, timesteps=ts)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        if not self.project_input:
+            if self.n_heads != 1:
+                raise ValueError("project_input=False requires n_heads == 1 "
+                                 "(reference SelfAttentionLayer semantics)")
+            return {}
+        n_in = _rnn_size(input_type)
+        hs = self._head_size(n_in)
+        e = self.n_heads * hs
+        ks = jax.random.split(key, 4)
+        wi = self.weight_init
+        return {
+            "Wq": wi.init(ks[0], (n_in, e), n_in, e, dtype, self.distribution),
+            "Wk": wi.init(ks[1], (n_in, e), n_in, e, dtype, self.distribution),
+            "Wv": wi.init(ks[2], (n_in, e), n_in, e, dtype, self.distribution),
+            "Wo": wi.init(ks[3], (e, self.n_out), e, self.n_out, dtype,
+                          self.distribution),
+            "bq": jnp.zeros((e,), dtype), "bk": jnp.zeros((e,), dtype),
+            "bv": jnp.zeros((e,), dtype),
+            "bo": jnp.full((self.n_out,), self.bias_init, dtype),
+        }
+
+    def param_order(self):
+        if not self.project_input:
+            return []
+        return ["Wq", "bq", "Wk", "bk", "Wv", "bv", "Wo", "bo"]
+
+    def regularized_param_keys(self):
+        return ["Wq", "Wk", "Wv", "Wo"]
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None):
+        x = self._dropout_input(x, train, rng)
+        if not self.project_input:
+            q = _split_heads(x, 1)
+            o = dot_product_attention(q, q, q, key_mask=mask,
+                                      causal=self.causal,
+                                      impl=self.attention_impl)
+            y = _merge_heads(o)
+        else:
+            y = _mha(params, x, x, self.n_heads, mask, self.causal,
+                     self.attention_impl)
+        y = self.activation.apply(y)
+        if mask is not None:  # masked-out steps emit zeros, as the reference
+            y = y * jnp.asarray(mask, y.dtype)[:, :, None]
+        return y, state
+
+
+def _rnn_size_static(input_type):
+    return input_type.size if isinstance(input_type, it.Recurrent) else 0
+
+
+@serde.register
+@dataclasses.dataclass
+class LearnedSelfAttentionLayer(BaseLayer):
+    """Attention with ``n_queries`` LEARNED query vectors (reference
+    ``LearnedSelfAttentionLayer``) — output is a fixed-length
+    ``[batch, n_queries, n_out]`` sequence regardless of input length, so it
+    doubles as a sequence-pooling layer. Param ``Q: [n_queries,
+    n_heads*head_size]`` holds the queries directly in projected space."""
+
+    n_out: int = 0
+    n_heads: int = 1
+    head_size: int = 0
+    n_queries: int = 1
+    project_input: bool = True
+    attention_impl: str = "auto"
+
+    uses_mask = True
+
+    def _dims(self, n_in):
+        hs = self.head_size or ((self.n_out if self.project_input else n_in)
+                                // self.n_heads)
+        return hs, self.n_heads * hs
+
+    def output_type(self, input_type):
+        n = self.n_out if self.project_input else _rnn_size_static(input_type)
+        return it.Recurrent(size=n, timesteps=self.n_queries)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        n_in = _rnn_size(input_type)
+        hs, e = self._dims(n_in)
+        ks = jax.random.split(key, 4)
+        wi = self.weight_init
+        p = {"Q": wi.init(ks[3], (self.n_queries, e), e, e, dtype,
+                          self.distribution)}
+        if not self.project_input:
+            if self.n_heads != 1:
+                raise ValueError("project_input=False requires n_heads == 1")
+            return p
+        p.update({
+            "Wk": wi.init(ks[0], (n_in, e), n_in, e, dtype, self.distribution),
+            "Wv": wi.init(ks[1], (n_in, e), n_in, e, dtype, self.distribution),
+            "Wo": wi.init(ks[2], (e, self.n_out), e, self.n_out, dtype,
+                          self.distribution),
+            "bk": jnp.zeros((e,), dtype), "bv": jnp.zeros((e,), dtype),
+            "bo": jnp.full((self.n_out,), self.bias_init, dtype),
+        })
+        return p
+
+    def param_order(self):
+        if not self.project_input:
+            return ["Q"]
+        return ["Q", "Wk", "bk", "Wv", "bv", "Wo", "bo"]
+
+    def regularized_param_keys(self):
+        return ["Q", "Wk", "Wv", "Wo"] if self.project_input else ["Q"]
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None):
+        x = self._dropout_input(x, train, rng)
+        b = x.shape[0]
+        q = jnp.broadcast_to(params["Q"][None], (b,) + params["Q"].shape)
+        if self.project_input:
+            k = x @ params["Wk"] + params["bk"]
+            v = x @ params["Wv"] + params["bv"]
+        else:
+            k = v = x
+        o = dot_product_attention(
+            _split_heads(q, self.n_heads), _split_heads(k, self.n_heads),
+            _split_heads(v, self.n_heads), key_mask=mask,
+            impl=self.attention_impl)
+        y = _merge_heads(o)
+        if self.project_input:
+            y = y @ params["Wo"] + params["bo"]
+        return self.activation.apply(y), state
+
+
+@serde.register
+@dataclasses.dataclass
+class RecurrentAttentionLayer(BaseLayer):
+    """Recurrent cell with attention over the full input sequence at every
+    timestep, query = previous hidden state (reference
+    ``RecurrentAttentionLayer``):
+
+        ctx_t = MHA(q = h_{t-1}·Wq, K = x·Wk, V = x·Wv)
+        h_t   = act(x_t·W + h_{t-1}·RW + ctx_t·Wc + b)
+
+    Keys/values are projected ONCE outside the scan (one big MXU matmul);
+    only the per-step query projection and the [1, T] attention row run
+    inside ``lax.scan``."""
+
+    n_out: int = 0
+    n_heads: int = 1
+    head_size: int = 0
+    activation: Activation = Activation.TANH
+
+    uses_mask = True
+    has_carry = True
+
+    def _dims(self):
+        hs = self.head_size or (self.n_out // self.n_heads)
+        return hs, self.n_heads * hs
+
+    def output_type(self, input_type):
+        ts = input_type.timesteps if isinstance(input_type, it.Recurrent) else -1
+        return it.Recurrent(size=self.n_out, timesteps=ts)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        n_in = _rnn_size(input_type)
+        hs, e = self._dims()
+        ks = jax.random.split(key, 6)
+        wi = self.weight_init
+        return {
+            "W": wi.init(ks[0], (n_in, self.n_out), n_in, self.n_out, dtype,
+                         self.distribution),
+            "RW": wi.init(ks[1], (self.n_out, self.n_out), self.n_out,
+                          self.n_out, dtype, self.distribution),
+            "Wq": wi.init(ks[2], (self.n_out, e), self.n_out, e, dtype,
+                          self.distribution),
+            "Wk": wi.init(ks[3], (n_in, e), n_in, e, dtype, self.distribution),
+            "Wv": wi.init(ks[4], (n_in, e), n_in, e, dtype, self.distribution),
+            "Wc": wi.init(ks[5], (e, self.n_out), e, self.n_out, dtype,
+                          self.distribution),
+            "b": jnp.full((self.n_out,), self.bias_init, dtype),
+        }
+
+    def param_order(self):
+        return ["W", "RW", "Wq", "Wk", "Wv", "Wc", "b"]
+
+    def regularized_param_keys(self):
+        return ["W", "RW", "Wq", "Wk", "Wv", "Wc"]
+
+    def zero_carry(self, batch, dtype=jnp.float32):
+        return {"h": jnp.zeros((batch, self.n_out), dtype)}
+
+    def forward_with_carry(self, params, carry, x, mask=None, train=False,
+                           rng=None):
+        x = self._dropout_input(x, train, rng)
+        b, t, _ = x.shape
+        hs, e = self._dims()
+        nh = self.n_heads
+        k = (x @ params["Wk"]).reshape(b, t, nh, hs)
+        v = (x @ params["Wv"]).reshape(b, t, nh, hs)
+        m = jnp.ones((b, t), x.dtype) if mask is None \
+            else jnp.asarray(mask, x.dtype)
+        xw = jnp.einsum("btf,fh->bth", x, params["W"]) + params["b"]
+        scale = 1.0 / jnp.sqrt(jnp.asarray(hs, x.dtype))
+
+        def step(h, inp):
+            xw_t, m_t = inp  # [b, nOut], [b]
+            q = (h @ params["Wq"]).reshape(b, nh, hs)
+            s = jnp.einsum("bnd,btnd->bnt", q, k) * scale
+            s = jnp.where(m[:, None, :] > 0, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("bnt,btnd->bnd", p, v).reshape(b, e)
+            h_new = self.activation.apply(
+                xw_t + h @ params["RW"] + ctx @ params["Wc"])
+            h = m_t[:, None] * h_new + (1.0 - m_t[:, None]) * h
+            return h, m_t[:, None] * h_new
+
+        h_final, ys = jax.lax.scan(
+            step, carry["h"], (jnp.swapaxes(xw, 0, 1), jnp.swapaxes(m, 0, 1)))
+        return jnp.swapaxes(ys, 0, 1), {"h": h_final}
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None):
+        carry = self.zero_carry(x.shape[0], x.dtype)
+        y, _ = self.forward_with_carry(params, carry, x, mask=mask,
+                                       train=train, rng=rng)
+        return y, state

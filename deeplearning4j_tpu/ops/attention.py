@@ -1,0 +1,450 @@
+"""Scaled dot-product attention: reference, blockwise (XLA), and Pallas flash.
+
+Reference counterparts: ``sd.nn.multiHeadDotProductAttention`` /
+``org.nd4j.linalg.api.ops.impl.transforms.custom.MultiHeadDotProductAttention``
+and the attention layers in ``org.deeplearning4j.nn.conf.layers.{SelfAttentionLayer,
+LearnedSelfAttentionLayer}`` — the reference materializes the full [Tq, Tk]
+attention matrix per head on-device. TPU-native design: three tiers sharing
+one semantics,
+
+- ``reference_attention``: plain jnp, full materialization (oracle for tests).
+- ``blockwise_attention``: online-softmax ``lax.scan`` over key blocks —
+  O(T) memory at the XLA level, differentiable, runs on any backend. This is
+  FlashAttention's math without a hand kernel; used as the CPU path and as the
+  local compute inside ring attention (ops/ring.py).
+- ``flash_attention``: Pallas TPU kernel (fwd + custom-VJP bwd), blocks
+  streamed HBM→VMEM by the pipeline, f32 accumulators in VMEM scratch,
+  log-sum-exp saved for the backward. Grid iterates key blocks in the
+  innermost (sequential) dimension so scratch persists across them.
+
+All take ``q, k, v: [batch, heads, time, head_dim]``, optional
+``key_mask: [batch, time_k]`` (1.0 = valid, 0.0 = padding) and ``causal``.
+``dot_product_attention`` dispatches by backend/size.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on some CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+NEG_INF = -1e30
+
+
+def _scale(q, scale):
+    return (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
+
+
+# ---------------------------------------------------------------------------
+# Tier 0: reference (oracle)
+# ---------------------------------------------------------------------------
+
+def reference_attention(q, k, v, key_mask=None, causal=False, scale=None):
+    """Full-materialization attention; the test oracle."""
+    sm = _scale(q, scale)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm
+    if key_mask is not None:
+        s = jnp.where(key_mask[:, None, None, :] > 0, s, NEG_INF)
+    if causal:
+        tq, tk = q.shape[2], k.shape[2]
+        mask = jnp.arange(tk)[None, :] <= jnp.arange(tq)[:, None] + (tk - tq)
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+# ---------------------------------------------------------------------------
+# Tier 1: blockwise online-softmax (pure XLA, any backend)
+# ---------------------------------------------------------------------------
+
+def blockwise_attention(q, k, v, key_mask=None, causal=False, scale=None,
+                        block_k: int = 128):
+    """Online-softmax over key blocks via ``lax.scan`` — never materializes
+    the [Tq, Tk] matrix. Differentiable (scan has a transpose rule);
+    ``jax.checkpoint`` on the block body keeps backward memory O(T)."""
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    sm = _scale(q, scale)
+    bk = min(block_k, tk)
+    nk = -(-tk // bk)
+    pad = nk * bk - tk
+
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    km = jnp.ones((b, tk), q.dtype) if key_mask is None \
+        else jnp.asarray(key_mask, q.dtype)
+    km = jnp.pad(km, ((0, 0), (0, pad)))
+
+    # [nk, b, h, bk, d] blocks scanned over axis 0
+    kb = jnp.moveaxis(kp.reshape(b, h, nk, bk, d), 2, 0)
+    vb = jnp.moveaxis(vp.reshape(b, h, nk, bk, d), 2, 0)
+    mb = jnp.moveaxis(km.reshape(b, nk, bk), 1, 0)
+
+    q32 = q.astype(jnp.float32)
+    qpos = jnp.arange(tq)[:, None] + (tk - tq)  # global query positions
+
+    @jax.checkpoint
+    def body(carry, blk):
+        acc, m, l = carry
+        kblk, vblk, mblk, j = blk
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, kblk.astype(jnp.float32)) * sm
+        s = jnp.where(mblk[:, None, None, :] > 0, s, NEG_INF)
+        if causal:
+            kpos = j * bk + jnp.arange(bk)[None, :]
+            s = jnp.where((kpos <= qpos)[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vblk.astype(jnp.float32))
+        return (acc, m_new, l), None
+
+    acc0 = jnp.zeros((b, h, tq, d), jnp.float32)
+    m0 = jnp.full((b, h, tq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, tq), jnp.float32)
+    (acc, _, l), _ = jax.lax.scan(
+        body, (acc0, m0, l0), (kb, vb, mb, jnp.arange(nk)))
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Tier 2: Pallas flash kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, sm, causal, block_q, block_k, nk,
+                tq, tk):
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    i = pl.program_id(1)
+    # causal: key block strictly above the diagonal contributes nothing
+    run = True if not causal else (j * block_k <= (i + 1) * block_q - 1 + (tk - tq))
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm
+        km = km_ref[0, :, 0].astype(jnp.float32)
+        s = jnp.where(km[None, :] > 0, s, NEG_INF)
+        if causal:
+            qpos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + (tk - tq)
+            kpos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        m_prev = m_ref[...]  # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    @pl.when(j == nk - 1)
+    def _final():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[...] + jnp.log(l)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, delta_ref,
+               dq_out, dq_acc, *, sm, causal, block_q, block_k, nk, tq, tk):
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    i = pl.program_id(1)
+    run = True if not causal else (j * block_k <= (i + 1) * block_q - 1 + (tk - tq))
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm
+        km = km_ref[0, :, 0].astype(jnp.float32)
+        s = jnp.where(km[None, :] > 0, s, NEG_INF)
+        if causal:
+            qpos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + (tk - tq)
+            kpos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0])
+        do = do_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm
+
+    @pl.when(j == nk - 1)
+    def _final():
+        dq_out[0] = dq_acc[...].astype(dq_out.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, delta_ref,
+                dk_out, dv_out, dk_acc, dv_acc, *, sm, causal, block_q,
+                block_k, nq, tq, tk):
+    i = pl.program_id(2)  # query block index (innermost)
+    j = pl.program_id(1)  # key block index
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    run = True if not causal else (j * block_k <= (i + 1) * block_q - 1 + (tk - tq))
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm
+        km = km_ref[0, :, 0].astype(jnp.float32)
+        s = jnp.where(km[None, :] > 0, s, NEG_INF)
+        if causal:
+            qpos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + (tk - tq)
+            kpos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0])  # [bq, bk]
+        do = do_ref[0].astype(jnp.float32)
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm
+
+    @pl.when(i == nq - 1)
+    def _final():
+        dk_out[0] = dk_acc[...].astype(dk_out.dtype)
+        dv_out[0] = dv_acc[...].astype(dv_out.dtype)
+
+
+def _pad_t(x, blk):
+    t = x.shape[2]
+    pad = (-t) % blk
+    return (jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0))), t + pad) \
+        if pad else (x, t)
+
+
+def _flash_fwd_impl(q, k, v, km, causal, scale, block_q, block_k, interpret):
+    b, h, tq0, d = q.shape
+    tk0 = k.shape[2]
+    sm = _scale(q, scale)
+    bq = min(block_q, max(tq0, 8))
+    bk = min(block_k, max(tk0, 8))
+    q, tq = _pad_t(q, bq)
+    k, tk = _pad_t(k, bk)
+    v, _ = _pad_t(v, bk)
+    km = jnp.pad(jnp.asarray(km, q.dtype), ((0, 0), (0, tk - tk0)))
+
+    bh = b * h
+    qf = q.reshape(bh, tq, d)
+    kf = k.reshape(bh, tk, d)
+    vf = v.reshape(bh, tk, d)
+    kmf = jnp.broadcast_to(km[:, None, :], (b, h, tk)).reshape(bh, tk, 1)
+    nq, nk = tq // bq, tk // bk
+
+    kern = functools.partial(_fwd_kernel, sm=sm, causal=causal, block_q=bq,
+                             block_k=bk, nk=nk, tq=tq0, tk=tk0)
+    scratch = [pltpu.VMEM((bq, d), jnp.float32),
+               pltpu.VMEM((bq, 1), jnp.float32),
+               pltpu.VMEM((bq, 1), jnp.float32)]
+
+    out, lse = pl.pallas_call(
+        kern,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, bk, 1), lambda b_, i, j: (b_, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b_, i, j: (b_, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
+        ],
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(qf, kf, vf, kmf)
+    out = out.reshape(b, h, tq, d)[:, :, :tq0]
+    lse = lse.reshape(b, h, tq)[:, :, :tq0]
+    return out, lse
+
+
+def _flash_bwd_impl(q, k, v, km, out, lse, g, causal, scale, block_q,
+                    block_k, interpret):
+    b, h, tq0, d = q.shape
+    tk0 = k.shape[2]
+    sm = _scale(q, scale)
+    bq = min(block_q, max(tq0, 8))
+    bk = min(block_k, max(tk0, 8))
+    qp, tq = _pad_t(q, bq)
+    kp, tk = _pad_t(k, bk)
+    vp, _ = _pad_t(v, bk)
+    gp, _ = _pad_t(g, bq)
+    op, _ = _pad_t(out, bq)
+    kmf0 = jnp.pad(jnp.asarray(km, q.dtype), ((0, 0), (0, tk - tk0)))
+
+    delta = jnp.sum(gp.astype(jnp.float32) * op.astype(jnp.float32), axis=-1)
+    # padded query rows: lse = -inf would make exp() explode; clamp them
+    lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, tq - tq0)),
+                   constant_values=jnp.inf)
+
+    bh = b * h
+    qf, kf, vf = (x.reshape(bh, -1, d) for x in (qp, kp, vp))
+    gf = gp.reshape(bh, tq, d)
+    kmf = jnp.broadcast_to(kmf0[:, None, :], (b, h, tk)).reshape(bh, tk, 1)
+    lsef = lsep.reshape(bh, tq, 1)
+    deltaf = delta.reshape(bh, tq, 1)
+    nq, nk = tq // bq, tk // bk
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, sm=sm, causal=causal, block_q=bq,
+                          block_k=bk, nk=nk, tq=tq0, tk=tk0),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, bk, 1), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b_, i, j: (b_, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, kmf, gf, lsef, deltaf)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, sm=sm, causal=causal, block_q=bq,
+                          block_k=bk, nq=nq, tq=tq0, tk=tk0),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b_, j, i: (b_, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b_, j, i: (b_, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b_, j, i: (b_, j, 0)),
+            pl.BlockSpec((1, bk, 1), lambda b_, j, i: (b_, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b_, j, i: (b_, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b_, j, i: (b_, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b_, j, i: (b_, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b_, j, i: (b_, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b_, j, i: (b_, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, kmf, gf, lsef, deltaf)
+
+    dq = dq.reshape(b, h, tq, d)[:, :, :tq0]
+    dk = dk.reshape(b, h, tk, d)[:, :, :tk0]
+    dv = dv.reshape(b, h, tk, d)[:, :, :tk0]
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, km, causal, scale, block_q, block_k, interpret):
+    out, _ = _flash_fwd_impl(q, k, v, km, causal, scale, block_q, block_k,
+                             interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, km, causal, scale, block_q, block_k, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, km, causal, scale, block_q, block_k,
+                               interpret)
+    return out, (q, k, v, km, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, km, out, lse = res
+    dq, dk, dv = _flash_bwd_impl(q, k, v, km, out, lse, g, causal, scale,
+                                 block_q, block_k, interpret)
+    return dq, dk, dv, jnp.zeros_like(km)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, key_mask=None, causal=False, scale=None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """FlashAttention as a Pallas TPU kernel with a custom-VJP backward.
+
+    ``interpret=None`` auto-enables the Pallas interpreter off-TPU so the
+    same kernel code is exercised in CPU CI (SURVEY.md §4 backend-parity
+    oracle)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if key_mask is None:
+        key_mask = jnp.ones((q.shape[0], k.shape[2]), q.dtype)
+    return _flash(q, k, v, jnp.asarray(key_mask, q.dtype), causal, scale,
+                  block_q, block_k, interpret)
+
+
+# ---------------------------------------------------------------------------
+# Dispatcher
+# ---------------------------------------------------------------------------
+
+def dot_product_attention(q, k, v, key_mask=None, causal=False, scale=None,
+                          impl: str = "auto"):
+    """Pick the right tier: Pallas flash on TPU for long sequences,
+    blockwise XLA otherwise, full materialization for tiny ones."""
+    if impl == "auto":
+        if jax.default_backend() == "tpu" and q.shape[2] >= 256:
+            impl = "flash"
+        elif q.shape[2] <= 512:
+            impl = "reference"
+        else:
+            impl = "blockwise"
+    if impl == "flash":
+        return flash_attention(q, k, v, key_mask, causal, scale)
+    if impl == "blockwise":
+        return blockwise_attention(q, k, v, key_mask, causal, scale)
+    return reference_attention(q, k, v, key_mask, causal, scale)

@@ -55,12 +55,8 @@ class ParallelInference:
         n = xs[0].shape[0]
         target = math.ceil(n / self.workers) * self.workers
         spec = mesh_mod.data_parallel_spec(self.mesh)
-        placed = []
-        for a in xs:
-            if target != n:
-                a = np.concatenate(
-                    [a, np.zeros((target - n,) + a.shape[1:], a.dtype)])
-            placed.append(jax.device_put(jnp.asarray(a), spec))
+        placed = [jax.device_put(a, spec)
+                  for a in mesh_mod.pad_leading(list(xs), target)]
         ys = self.model.output(*placed)
         if isinstance(ys, (list, tuple)):
             return [np.asarray(y)[:n] for y in ys]

@@ -99,6 +99,45 @@ def replicate(mesh: Mesh, tree):
         lambda x: jax.device_put(x, sharding), tree)
 
 
+def pad_leading(tree, target: int):
+    """Zero-pad every leaf's leading (batch) dim to ``target`` rows. Padded
+    rows carry a zero label-mask so they contribute nothing to loss/grads
+    (the role of the reference splitter handling ragged final batches)."""
+    import jax.numpy as jnp
+
+    def pad(x):
+        x = jnp.asarray(x)
+        n = x.shape[0]
+        if n == target:
+            return x
+        return jnp.concatenate(
+            [x, jnp.zeros((target - n,) + x.shape[1:], x.dtype)])
+
+    return jax.tree_util.tree_map(pad, tree)
+
+
+def shard_valid_counts(rows: int, workers: int) -> np.ndarray:
+    """Valid (non-padded) row count per shard after ``pad_leading`` to
+    ``ceil(rows/workers)*workers`` and an even split: shard i holds rows
+    [i*s, (i+1)*s)."""
+    s = -(-rows // workers)
+    return np.clip(rows - np.arange(workers) * s, 0, s).astype(np.float32)
+
+
+try:  # jax >= 0.4.35
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs)
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map_old(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs)
+
+
 def initialize_distributed(coordinator_address: str | None = None,
                            num_processes: int | None = None,
                            process_id: int | None = None) -> None:

@@ -50,19 +50,7 @@ from deeplearning4j_tpu.parallel.compression import (
     encode_tree,
 )
 
-try:  # jax >= 0.4.35
-    from jax import shard_map as _shard_map
-
-    def shard_map(f, mesh, in_specs, out_specs):
-        return _shard_map(f, mesh=mesh, in_specs=in_specs,
-                          out_specs=out_specs)
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map as _shard_map_old
-
-    def shard_map(f, mesh, in_specs, out_specs):
-        return _shard_map_old(f, mesh=mesh, in_specs=in_specs,
-                              out_specs=out_specs)
-
+shard_map = mesh_mod.shard_map
 DATA = mesh_mod.DATA_AXIS
 
 
@@ -76,21 +64,6 @@ class TrainingMode(enum.Enum):
 
 def _tree_map(f, *trees):
     return jax.tree_util.tree_map(f, *trees)
-
-
-def _pad_leading(tree, target: int):
-    """Zero-pad every leaf's leading (batch) dim to ``target`` rows. Padded
-    rows carry a zero label-mask so they contribute nothing to loss/grads
-    (the role of the reference splitter handling ragged final batches)."""
-
-    def pad(x):
-        n = x.shape[0]
-        if n == target:
-            return x
-        return jnp.concatenate(
-            [x, jnp.zeros((target - n,) + x.shape[1:], x.dtype)])
-
-    return _tree_map(pad, tree)
 
 
 def _stack(tree, n: int):
@@ -212,10 +185,20 @@ class ParallelWrapper:
         gfn = self.model.grad_fn()
         afn = self.model.apply_updates_fn()
 
-        def step(params, state, opt, residual, batch, it, ep, rng, tau):
+        def step(params, state, opt, residual, batch, it, ep, rng, tau,
+                 cvec):
             idx = jax.lax.axis_index(DATA)
             rng = jax.random.fold_in(rng, idx)
             loss, new_state, grads = gfn(params, state, *batch, rng)
+            # ragged batches: gfn normalizes by the LOCAL shard's valid
+            # rows; reweight so the summed exchange equals the global
+            # per-example average (and all-padding shards contribute 0,
+            # including their regularization grads)
+            c = cvec[0]
+            n = jax.lax.psum(1.0, DATA)
+            ctot = jnp.maximum(jax.lax.psum(c, DATA), 1.0)
+            w = c * n / ctot
+            grads = _tree_map(lambda g: g * w, grads)
             res = _tree_map(lambda r: r[0], residual)
             # encode(grad + residual) -> ±tau flips; remainder stays local
             enc, new_res, sparsity = encode_tree(grads, res, tau)
@@ -223,8 +206,9 @@ class ParallelWrapper:
             # all workers' encoded messages (its own + peers')
             shared = _tree_map(lambda e: jax.lax.psum(e, DATA), enc)
             new_params, new_opt = afn(params, opt, shared, it, ep)
-            loss = jax.lax.pmean(loss, DATA)
-            new_state = _tree_map(lambda s: jax.lax.pmean(s, DATA), new_state)
+            loss = jax.lax.psum(loss * c, DATA) / ctot
+            new_state = _tree_map(
+                lambda s: jax.lax.psum(s * (c / ctot), DATA), new_state)
             # sparsity feedback for AdaptiveThresholdAlgorithm (host-side)
             sparsity = jax.lax.pmean(sparsity, DATA)
             return (new_params, new_state, new_opt,
@@ -232,26 +216,35 @@ class ParallelWrapper:
 
         sharded = shard_map(
             step, self.mesh,
-            in_specs=(P(), P(), P(), P(DATA), P(DATA), P(), P(), P(), P()),
+            in_specs=(P(), P(), P(), P(DATA), P(DATA), P(), P(), P(), P(),
+                      P(DATA)),
             out_specs=(P(), P(), P(), P(DATA), P(), P()))
         return jax.jit(sharded, donate_argnums=(0, 1, 2, 3))
 
     def _build_averaging_step(self):
         raw = self.model.train_step_fn()
 
-        def step(params, state, opt, batch, it, ep, rng):
+        def step(params, state, opt, batch, it, ep, rng, cvec):
             idx = jax.lax.axis_index(DATA)
             rng = jax.random.fold_in(rng, idx)
             p = _tree_map(lambda x: x[0], params)
             s = _tree_map(lambda x: x[0], state)
             o = _tree_map(lambda x: x[0], opt)
             new_p, new_s, new_o, loss = raw(p, s, o, *batch, it, ep, rng)
+            # an all-padding replica (final ragged batch smaller than the
+            # worker count) must not move: regularization/momentum would
+            # otherwise update it and later be averaged into real replicas
+            ok = cvec[0] > 0
+            new_p = _tree_map(lambda a, b: jnp.where(ok, a, b), new_p, p)
+            new_s = _tree_map(lambda a, b: jnp.where(ok, a, b), new_s, s)
+            new_o = _tree_map(lambda a, b: jnp.where(ok, a, b), new_o, o)
             return (_tree_map(lambda x: x[None], (new_p, new_s, new_o))
                     + (loss[None],))
 
         sharded = shard_map(
             step, self.mesh,
-            in_specs=(P(DATA), P(DATA), P(DATA), P(DATA), P(), P(), P()),
+            in_specs=(P(DATA), P(DATA), P(DATA), P(DATA), P(), P(), P(),
+                      P(DATA)),
             out_specs=(P(DATA), P(DATA), P(DATA), P(DATA)))
         return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
@@ -289,6 +282,10 @@ class ParallelWrapper:
                     ListDataSetIterator,
                 )
                 iterator = ListDataSetIterator([data])
+            if self.prefetch_buffer > 0 and not isinstance(
+                    iterator, AsyncDataSetIterator):
+                iterator = AsyncDataSetIterator(
+                    iterator, queue_size=self.prefetch_buffer)
         else:
             iterator = _as_iterator(data, labels)
             if self.prefetch_buffer > 0 and not isinstance(
@@ -315,15 +312,19 @@ class ParallelWrapper:
         batch = self._prep(ds)
         rows = self._batch_rows(batch)
         target = math.ceil(rows / self.workers) * self.workers
-        batch = self._data_sharded(_pad_leading(batch, target))
+        batch = self._data_sharded(mesh_mod.pad_leading(batch, target))
+        counts = mesh_mod.shard_valid_counts(rows, self.workers)
+        cvec = self._data_sharded(jnp.asarray(counts))
         rng = jax.random.fold_in(m._base_key, m.iteration + 1_000_003)
         it = jnp.asarray(float(m.iteration), jnp.float32)
         ep = jnp.asarray(float(m.epoch), jnp.float32)
 
         if self.training_mode is TrainingMode.AVERAGING:
             (self._params, self._state, self._opt, losses) = self._step(
-                self._params, self._state, self._opt, batch, it, ep, rng)
-            self.score_value = float(jnp.mean(losses))
+                self._params, self._state, self._opt, batch, it, ep, rng,
+                cvec)
+            self.score_value = float(
+                np.sum(np.asarray(losses) * counts) / max(counts.sum(), 1.0))
             if (m.iteration + 1) % self.averaging_frequency == 0:
                 self._params, self._state, self._opt = self._avg(
                     self._params, self._state, self._opt)
@@ -331,7 +332,8 @@ class ParallelWrapper:
             tau = jnp.asarray(self._tau, jnp.float32)
             (self._params, self._state, self._opt, self._residual, loss,
              sparsity) = self._step(self._params, self._state, self._opt,
-                                    self._residual, batch, it, ep, rng, tau)
+                                    self._residual, batch, it, ep, rng, tau,
+                                    cvec)
             self.score_value = float(loss)
             self._tau = float(self.threshold_algorithm.update(
                 self._tau, float(sparsity)))

@@ -1,0 +1,125 @@
+"""Attention ops: flash (Pallas, interpret mode on CPU), blockwise, ring.
+
+Oracle = full-materialization reference_attention, per the reference's
+gradient-check-everything test strategy (SURVEY.md §4)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.ops.attention import (
+    reference_attention, blockwise_attention, flash_attention,
+    dot_product_attention)
+from deeplearning4j_tpu.ops.ring import ring_attention
+
+
+def _qkv(rng, b=2, h=3, t=96, d=32):
+    q = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    km = jnp.asarray(rng.random((b, t)) > 0.2, jnp.float32)
+    # ensure no fully-masked row
+    km = km.at[:, 0].set(1.0)
+    return q, k, v, km
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_blockwise_matches_reference(rng, causal):
+    q, k, v, km = _qkv(rng)
+    ref = reference_attention(q, k, v, km, causal)
+    blk = blockwise_attention(q, k, v, km, causal, block_k=32)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(blk),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference(rng, causal):
+    q, k, v, km = _qkv(rng)
+    ref = reference_attention(q, k, v, km, causal)
+    fl = flash_attention(q, k, v, km, causal, block_q=32, block_k=32)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(fl),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_unpadded_time(rng):
+    # T not a multiple of the block size exercises the padding path
+    q, k, v, km = _qkv(rng, t=80)
+    ref = reference_attention(q, k, v, km, False)
+    fl = flash_attention(q, k, v, km, False, block_q=32, block_k=32)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(fl),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_gradients_match(rng):
+    q, k, v, km = _qkv(rng, t=64)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, km, True) ** 2)
+
+    def loss_fl(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, km, True, block_q=32, block_k=32) ** 2)
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_fl, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_blockwise_gradients_match(rng):
+    q, k, v, km = _qkv(rng, t=64)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, km, True) ** 2)
+
+    def loss_blk(q, k, v):
+        return jnp.sum(
+            blockwise_attention(q, k, v, km, True, block_k=16) ** 2)
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gb = jax.grad(loss_blk, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gb):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+@pytest.fixture
+def seq_mesh():
+    devs = np.array(jax.devices()[:8])
+    return Mesh(devs, ("sequence",))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_reference(rng, seq_mesh, causal):
+    q, k, v, km = _qkv(rng, t=64)
+    ref = reference_attention(q, k, v, km, causal)
+    r = ring_attention(q, k, v, seq_mesh, km, causal=causal)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(r),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_gradients_match(rng, seq_mesh):
+    q, k, v, km = _qkv(rng, t=64)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, km, True) ** 2)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, seq_mesh, km, causal=True) ** 2)
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gg = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gg):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_dispatcher_runs(rng):
+    q, k, v, km = _qkv(rng, t=32)
+    out = dot_product_attention(q, k, v, km, causal=True)
+    ref = reference_attention(q, k, v, km, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)

@@ -1,0 +1,141 @@
+"""Attention layer confs: shapes, masking, serde round-trip, gradient checks
+(reference: ``AttentionLayerTest`` gradient checks in
+``deeplearning4j-core/.../gradientcheck/``)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu import serde
+from deeplearning4j_tpu.conf import InputType, WeightInit
+from deeplearning4j_tpu.conf.graph import AttentionVertex
+from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.conf.layers_attention import (
+    LearnedSelfAttentionLayer, RecurrentAttentionLayer, SelfAttentionLayer)
+from deeplearning4j_tpu.conf.layers_rnn import RnnOutputLayer
+from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+from deeplearning4j_tpu.conf.updaters import NoOp
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.util.gradcheck import gradient_check
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _seq_data(n=4, t=5, f=3, classes=2, masked=True, seed=0, label_t=None):
+    rng = np.random.default_rng(seed)
+    feats = rng.normal(size=(n, t, f)).astype(np.float32)
+    lt = label_t or t
+    labels = np.eye(classes, dtype=np.float32)[
+        rng.integers(0, classes, (n, lt))]
+    if not masked:
+        return DataSet(feats, labels)
+    mask = np.ones((n, t), np.float32)
+    mask[0, 3:] = 0.0
+    feats[0, 3:] = 0.0
+    lmask = mask if lt == t else np.ones((n, lt), np.float32)
+    return DataSet(feats, labels, features_mask=mask, labels_mask=lmask)
+
+
+def test_self_attention_shapes_and_mask():
+    layer = SelfAttentionLayer(n_out=8, n_heads=2)
+    t = InputType.recurrent(3, timesteps=5)
+    assert layer.output_type(t) == InputType.recurrent(8, timesteps=5)
+    params = layer.init(KEY, t)
+    assert params["Wq"].shape == (3, 8) and params["Wo"].shape == (8, 8)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 5, 3)),
+                    jnp.float32)
+    mask = jnp.asarray([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], jnp.float32)
+    y, _ = layer.forward(params, {}, x, mask=mask)
+    assert y.shape == (2, 5, 8)
+    # masked-out timesteps emit zeros
+    np.testing.assert_allclose(np.asarray(y[0, 3:]), 0.0)
+    # masked keys don't affect valid outputs: change masked input, same out
+    x2 = x.at[0, 3:].set(99.0)
+    y2, _ = layer.forward(params, {}, x2, mask=mask)
+    np.testing.assert_allclose(np.asarray(y[0, :3]), np.asarray(y2[0, :3]),
+                               atol=1e-6)
+
+
+def test_learned_self_attention_fixed_output_length():
+    layer = LearnedSelfAttentionLayer(n_out=8, n_heads=2, n_queries=4)
+    t = InputType.recurrent(3, timesteps=7)
+    assert layer.output_type(t) == InputType.recurrent(8, timesteps=4)
+    params = layer.init(KEY, t)
+    assert params["Q"].shape == (4, 8)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 7, 3)),
+                    jnp.float32)
+    y, _ = layer.forward(params, {}, x)
+    assert y.shape == (2, 4, 8)
+
+
+def test_recurrent_attention_shapes():
+    layer = RecurrentAttentionLayer(n_out=6, n_heads=2)
+    t = InputType.recurrent(3, timesteps=5)
+    assert layer.output_type(t) == InputType.recurrent(6, timesteps=5)
+    params = layer.init(KEY, t)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 5, 3)),
+                    jnp.float32)
+    y, _ = layer.forward(params, {}, x)
+    assert y.shape == (2, 5, 6)
+
+
+@pytest.mark.parametrize("layer_fn", [
+    lambda: SelfAttentionLayer(n_out=4, n_heads=2,
+                               attention_impl="reference"),
+    lambda: SelfAttentionLayer(n_out=4, n_heads=1, project_input=True,
+                               causal=True, attention_impl="reference"),
+    lambda: RecurrentAttentionLayer(n_out=4, n_heads=2),
+])
+def test_attention_gradients(layer_fn):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12345)
+            .updater(NoOp())
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(layer_fn())
+            .layer(RnnOutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(3, timesteps=5))
+            .build())
+    res = gradient_check(conf, _seq_data(), n_samples=60)
+    assert res.passed, res.summary()
+
+
+def test_learned_attention_gradients():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12345)
+            .updater(NoOp())
+            .list()
+            .layer(LearnedSelfAttentionLayer(n_out=4, n_heads=2, n_queries=3,
+                                             attention_impl="reference"))
+            .layer(RnnOutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(3, timesteps=5))
+            .build())
+    res = gradient_check(conf, _seq_data(label_t=3), n_samples=60)
+    assert res.passed, res.summary()
+
+
+def test_serde_round_trip():
+    for layer in (SelfAttentionLayer(n_out=8, n_heads=2, head_size=4),
+                  LearnedSelfAttentionLayer(n_out=8, n_queries=5),
+                  RecurrentAttentionLayer(n_out=6, n_heads=3)):
+        js = serde.to_json(layer)
+        back = serde.from_json(js)
+        assert back == layer
+
+
+def test_attention_vertex_forward_and_mask():
+    v = AttentionVertex(n_out=8, n_heads=2)
+    tq = InputType.recurrent(3, timesteps=4)
+    tk = InputType.recurrent(5, timesteps=6)
+    assert v.output_type([tq, tk, tk]) == InputType.recurrent(8, timesteps=4)
+    params = v.init(KEY, [tq, tk, tk])
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(2, 4, 3)), jnp.float32)
+    kv = jnp.asarray(rng.normal(size=(2, 6, 5)), jnp.float32)
+    mask = jnp.ones((2, 6), jnp.float32).at[0, 4:].set(0.0)
+    y, _ = v.forward(params, {}, [q, kv, kv, mask])
+    assert y.shape == (2, 4, 8)
+    kv2 = kv.at[0, 4:].set(7.0)
+    y2, _ = v.forward(params, {}, [q, kv2, kv2, mask])
+    np.testing.assert_allclose(np.asarray(y[0]), np.asarray(y2[0]), atol=1e-6)
