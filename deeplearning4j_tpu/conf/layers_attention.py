@@ -173,6 +173,11 @@ class LearnedSelfAttentionLayer(BaseLayer):
         if not self.project_input:
             if self.n_heads != 1:
                 raise ValueError("project_input=False requires n_heads == 1")
+            if self.head_size and self.head_size != n_in:
+                raise ValueError(
+                    f"project_input=False: learned queries attend directly "
+                    f"over the {n_in}-wide input, so head_size must be "
+                    f"{n_in} (or 0 for automatic), got {self.head_size}")
             return p
         p.update({
             "Wk": wi.init(ks[0], (n_in, e), n_in, e, dtype, self.distribution),

@@ -92,11 +92,13 @@ class ArrayDataSetIterator(DataSetIterator):
             rng = np.random.default_rng(self.seed + self._epoch)
             rng.shuffle(idx)
         stop = n - (n % self.batch) if (self.drop_last and not self.pad_last) else n
+        from deeplearning4j_tpu import native as _native
+        take = _native.gather_rows if self.shuffle else (lambda a, i: a[i])
         for start in range(0, stop, self.batch):
             sel = idx[start:start + self.batch]
-            fm = None if self.features_mask is None else self.features_mask[sel]
-            lm = None if self.labels_mask is None else self.labels_mask[sel]
-            f, l = self.features[sel], self.labels[sel]
+            fm = None if self.features_mask is None else take(self.features_mask, sel)
+            lm = None if self.labels_mask is None else take(self.labels_mask, sel)
+            f, l = take(self.features, sel), take(self.labels, sel)
             if self.pad_last and len(sel) < self.batch:
                 pad = self.batch - len(sel)
                 f = _pad0(f, pad)

@@ -65,7 +65,8 @@ def _load_real(train: bool):
     lab = _find(("train" if train else "t10k") + "-labels-idx1-ubyte")
     if img is None or lab is None:
         return None
-    images = _read_idx(img).astype(np.float32) / 255.0
+    from deeplearning4j_tpu import native as _native
+    images = _native.u8_to_f32(_read_idx(img))
     labels = _read_idx(lab)
     features = images[..., None]  # NHWC
     onehot = np.eye(10, dtype=np.float32)[labels]

@@ -1,0 +1,234 @@
+// Native host-side runtime kernels for the TPU framework.
+//
+// Reference parity: libnd4j's host-side roles that do NOT belong on the TPU —
+// threshold/bitmap gradient codecs (libnd4j encodeThreshold/encodeBitmap,
+// used by EncodedGradientsAccumulator for compressed gradient messaging),
+// DataVec's native ETL (CSV parsing; NativeImageLoader's decode-to-tensor
+// role), and batch staging (AffinityManager/MagicQueue feeding replicas).
+// On-device work is XLA/Pallas; this library keeps the HOST data path off
+// the Python interpreter: OpenMP loops over raw buffers, called via ctypes.
+//
+// ABI: plain C, int64 sizes, caller-allocated buffers (no allocation across
+// the boundary except what the caller owns via numpy).
+
+#include <charconv>
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <cmath>
+#include <vector>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Threshold codec (reference: libnd4j TypesConversion/encoder kernels used by
+// nd4j "encodeThreshold" op). Encoding: signed 1-based indices, +(i+1) means
+// g[i] >= tau (flip +tau), -(i+1) means g[i] <= -tau. Residual handling is
+// the caller's job (EncodingHandler semantics).
+// ---------------------------------------------------------------------------
+
+int64_t dl4j_encode_threshold(const float* g, int64_t n, float tau,
+                              int32_t* out, int64_t cap) {
+  int64_t cnt = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    float v = g[i];
+    if (v >= tau) {
+      if (cnt < cap) out[cnt] = (int32_t)(i + 1);
+      ++cnt;
+    } else if (v <= -tau) {
+      if (cnt < cap) out[cnt] = -(int32_t)(i + 1);
+      ++cnt;
+    }
+  }
+  return cnt;  // may exceed cap: caller re-allocates and retries
+}
+
+void dl4j_decode_threshold(const int32_t* enc, int64_t cnt, float tau,
+                           float* out) {
+  // out is accumulated into (+=), matching the accumulator's "apply the sum
+  // of everyone's messages" semantics
+  // duplicate indices are legal (a concatenation of several workers'
+  // messages), so the accumulation must be atomic
+#pragma omp parallel for if (cnt > (1 << 16))
+  for (int64_t i = 0; i < cnt; ++i) {
+    int32_t e = enc[i];
+    if (e > 0) {
+#pragma omp atomic
+      out[e - 1] += tau;
+    } else if (e < 0) {
+#pragma omp atomic
+      out[-e - 1] -= tau;
+    }
+  }
+}
+
+// Bitmap codec: 2 bits per element (00 none, 01 +tau, 10 -tau), packed into
+// uint64 words (reference "encodeBitmap" auto-chosen when >~1/16 dense).
+// Returns number of non-zero flips.
+int64_t dl4j_encode_bitmap(const float* g, int64_t n, float tau,
+                           uint64_t* words) {
+  int64_t nwords = (n + 31) / 32;
+  int64_t nnz = 0;
+#pragma omp parallel for reduction(+ : nnz) if (nwords > (1 << 14))
+  for (int64_t w = 0; w < nwords; ++w) {
+    uint64_t bits = 0;
+    int64_t base = w * 32;
+    int64_t end = (base + 32 < n) ? base + 32 : n;
+    for (int64_t i = base; i < end; ++i) {
+      float v = g[i];
+      if (v >= tau) {
+        bits |= (uint64_t)1 << ((i - base) * 2);
+        ++nnz;
+      } else if (v <= -tau) {
+        bits |= (uint64_t)2 << ((i - base) * 2);
+        ++nnz;
+      }
+    }
+    words[w] = bits;
+  }
+  return nnz;
+}
+
+void dl4j_decode_bitmap(const uint64_t* words, int64_t n, float tau,
+                        float* out) {
+  int64_t nwords = (n + 31) / 32;
+#pragma omp parallel for if (nwords > (1 << 14))
+  for (int64_t w = 0; w < nwords; ++w) {
+    uint64_t bits = words[w];
+    if (!bits) continue;
+    int64_t base = w * 32;
+    int64_t end = (base + 32 < n) ? base + 32 : n;
+    for (int64_t i = base; i < end; ++i) {
+      uint64_t s = (bits >> ((i - base) * 2)) & 3;
+      if (s == 1)
+        out[i] += tau;
+      else if (s == 2)
+        out[i] -= tau;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Numeric CSV (reference: DataVec CSVRecordReader's hot path; Java splits
+// strings per cell — here one pass indexes lines, OpenMP parses rows).
+// ---------------------------------------------------------------------------
+
+static void index_lines(const char* buf, int64_t len,
+                        std::vector<int64_t>& starts,
+                        std::vector<int64_t>& ends) {
+  int64_t i = 0;
+  while (i < len) {
+    int64_t s = i;
+    while (i < len && buf[i] != '\n') ++i;
+    int64_t e = i;
+    if (e > s && buf[e - 1] == '\r') --e;
+    if (e > s) {  // skip empty lines, as the Python reader does
+      starts.push_back(s);
+      ends.push_back(e);
+    }
+    ++i;
+  }
+}
+
+int64_t dl4j_csv_dims(const char* buf, int64_t len, char delim, int64_t skip,
+                      int64_t* rows, int64_t* cols) {
+  std::vector<int64_t> starts, ends;
+  index_lines(buf, len, starts, ends);
+  int64_t nrows = (int64_t)starts.size() - skip;
+  if (nrows < 0) nrows = 0;
+  *rows = nrows;
+  if (nrows == 0) {
+    *cols = 0;
+    return 0;
+  }
+  int64_t c = 1;
+  for (int64_t i = starts[skip]; i < ends[skip]; ++i)
+    if (buf[i] == delim) ++c;
+  *cols = c;
+  return 0;
+}
+
+static inline bool parse_cell(const char* cell, const char* cell_end,
+                              float* v) {
+  // trim ASCII whitespace on both sides (Python float() semantics), then a
+  // BOUNDED locale-free parse that must consume the whole cell
+  while (cell < cell_end && (*cell == ' ' || *cell == '\t')) ++cell;
+  while (cell_end > cell &&
+         (cell_end[-1] == ' ' || cell_end[-1] == '\t'))
+    --cell_end;
+  if (cell == cell_end) return false;
+  // std::from_chars rejects a leading '+'; Python accepts it
+  if (*cell == '+') ++cell;
+  auto res = std::from_chars(cell, cell_end, *v);
+  return res.ec == std::errc() && res.ptr == cell_end;
+}
+
+// returns number of parse errors (0 = clean); a row with a cell count
+// different from `cols` counts as an error (the Python fallback raises)
+int64_t dl4j_parse_csv(const char* buf, int64_t len, char delim, int64_t skip,
+                       float* out, int64_t rows, int64_t cols) {
+  std::vector<int64_t> starts, ends;
+  index_lines(buf, len, starts, ends);
+  int64_t avail = (int64_t)starts.size() - skip;
+  int64_t n = avail < rows ? avail : rows;
+  int64_t errors = 0;
+#pragma omp parallel for reduction(+ : errors) if (n > 256)
+  for (int64_t r = 0; r < n; ++r) {
+    const char* p = buf + starts[r + skip];
+    const char* lineend = buf + ends[r + skip];
+    float* dst = out + r * cols;
+    for (int64_t c = 0; c < cols; ++c) {
+      if (p > lineend) {  // row ran out of cells
+        ++errors;
+        dst[c] = 0.0f;
+        continue;
+      }
+      const char* cell_end = p;
+      while (cell_end < lineend && *cell_end != delim) ++cell_end;
+      float v = 0.0f;
+      if (!parse_cell(p, cell_end, &v)) {
+        ++errors;
+        v = 0.0f;
+      }
+      dst[c] = v;
+      p = cell_end + 1;  // past the delimiter (or past lineend = row done)
+    }
+    if (p <= lineend) ++errors;  // extra cells beyond `cols`
+  }
+  return errors;
+}
+
+// ---------------------------------------------------------------------------
+// Pixel/ubyte conversion (reference: NativeImageLoader's decode+normalize
+// into a float tensor) and batch staging gather (reference: MagicQueue
+// assembling per-worker minibatches).
+// ---------------------------------------------------------------------------
+
+void dl4j_u8_to_f32(const uint8_t* src, int64_t n, float scale, float shift,
+                    float* dst) {
+#pragma omp parallel for if (n > (1 << 18))
+  for (int64_t i = 0; i < n; ++i) dst[i] = (float)src[i] * scale + shift;
+}
+
+void dl4j_gather_rows(const char* src, const int64_t* idx, int64_t nidx,
+                      int64_t row_bytes, char* dst) {
+#pragma omp parallel for if (nidx * row_bytes > (1 << 20))
+  for (int64_t i = 0; i < nidx; ++i)
+    memcpy(dst + i * row_bytes, src + idx[i] * row_bytes, (size_t)row_bytes);
+}
+
+int dl4j_native_version() { return 1; }
+
+int dl4j_native_threads() {
+#if defined(_OPENMP)
+  return omp_get_max_threads();
+#else
+  return 1;
+#endif
+}
+
+}  // extern "C"

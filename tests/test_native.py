@@ -1,0 +1,131 @@
+"""Native host-runtime library: codec/CSV/gather parity between the C++
+OpenMP path and the numpy fallbacks (reference: libnd4j encodeThreshold /
+encodeBitmap kernels + DataVec native ETL, SURVEY.md §2.1)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native
+
+
+@pytest.fixture
+def grads(rng):
+    return rng.normal(size=50_000).astype(np.float32)
+
+
+def _expected_flips(g, tau):
+    return np.where(g >= tau, tau,
+                    np.where(g <= -tau, -tau, 0)).astype(np.float32)
+
+
+def test_native_builds():
+    assert native.available(), "native library failed to build/load"
+    assert native.get_lib().dl4j_native_version() == 1
+
+
+def test_threshold_roundtrip(grads):
+    tau = 1.0
+    enc = native.encode_threshold(grads, tau)
+    dec = native.decode_threshold(enc, tau, grads.size)
+    np.testing.assert_allclose(dec, _expected_flips(grads, tau))
+    # decode accumulates
+    dec2 = native.decode_threshold(enc, tau, grads.size, out=dec)
+    np.testing.assert_allclose(dec2, 2 * _expected_flips(grads, tau))
+
+
+def test_bitmap_roundtrip(grads):
+    tau = 0.5
+    words, nnz = native.encode_bitmap(grads, tau)
+    assert nnz == int(np.sum(np.abs(grads) >= tau))
+    dec = native.decode_bitmap(words, tau, grads.size)
+    np.testing.assert_allclose(dec, _expected_flips(grads, tau))
+
+
+def test_fallback_matches_native(monkeypatch, grads):
+    tau = 1.0
+    enc_n = native.encode_threshold(grads, tau)
+    words_n, nnz_n = native.encode_bitmap(grads[:2000], tau)
+    monkeypatch.setattr(native, "get_lib", lambda: None)
+    enc_p = native.encode_threshold(grads, tau)
+    np.testing.assert_array_equal(np.sort(enc_n), np.sort(enc_p))
+    words_p, nnz_p = native.encode_bitmap(grads[:2000], tau)
+    assert nnz_p == nnz_n
+    np.testing.assert_array_equal(words_n, words_p)
+    dec_p = native.decode_threshold(enc_p, tau, grads.size)
+    np.testing.assert_allclose(dec_p, _expected_flips(grads, tau))
+
+
+def test_parse_numeric_csv():
+    m = native.parse_numeric_csv("# header\n1.5,2,3\n4,5.25,-6e2\n",
+                                 skip_lines=1)
+    np.testing.assert_allclose(
+        m, np.asarray([[1.5, 2, 3], [4, 5.25, -600]], np.float32))
+    with pytest.raises(ValueError):
+        native.parse_numeric_csv(b"1,abc,3\n")
+
+
+def test_parse_csv_matches_python_fallback(monkeypatch, rng):
+    data = rng.normal(size=(200, 7)).astype(np.float32)
+    text = "\n".join(",".join(f"{v:.6g}" for v in row) for row in data)
+    m_native = native.parse_numeric_csv(text)
+    monkeypatch.setattr(native, "get_lib", lambda: None)
+    m_py = native.parse_numeric_csv(text)
+    np.testing.assert_allclose(m_native, m_py, rtol=1e-6)
+    np.testing.assert_allclose(m_native, data, rtol=1e-4)
+
+
+def test_read_numeric_csv_from_file(tmp_path, rng):
+    from deeplearning4j_tpu.datavec.records import read_numeric_csv
+    from deeplearning4j_tpu.datavec.split import FileSplit
+
+    data = rng.normal(size=(50, 4)).astype(np.float32)
+    f = tmp_path / "data.csv"
+    f.write_text("\n".join(",".join(f"{v:.6g}" for v in r) for r in data))
+    m = read_numeric_csv(str(f))
+    np.testing.assert_allclose(m, data, rtol=1e-4)
+    m2 = read_numeric_csv(FileSplit(str(tmp_path), allowed_extensions=[".csv"]))
+    np.testing.assert_allclose(m2, data, rtol=1e-4)
+
+
+def test_u8_and_gather(rng):
+    u = rng.integers(0, 256, size=(3, 28, 28), dtype=np.uint8)
+    f = native.u8_to_f32(u)
+    np.testing.assert_allclose(f, u.astype(np.float32) / 255.0)
+    src = rng.normal(size=(100, 5, 2)).astype(np.float32)
+    idx = rng.permutation(100)[:32]
+    np.testing.assert_array_equal(native.gather_rows(src, idx), src[idx])
+
+
+def test_iterator_uses_native_gather(rng):
+    from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+
+    feats = rng.normal(size=(64, 3)).astype(np.float32)
+    labels = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 64)]
+    it = ArrayDataSetIterator(feats, labels, batch=16, shuffle=True, seed=7)
+    seen = np.concatenate([ds.features for ds in it])
+    np.testing.assert_allclose(np.sort(seen.ravel()),
+                               np.sort(feats.ravel()))
+
+
+def test_csv_whitespace_cell_is_error_not_row_steal():
+    # a whitespace-only cell must error, not steal the next row's value
+    with pytest.raises(ValueError):
+        native.parse_numeric_csv("1, \n2,3\n")
+    # but padded numeric cells parse fine
+    m = native.parse_numeric_csv("1 , 2\n 3,4\n")
+    np.testing.assert_allclose(m, [[1, 2], [3, 4]])
+
+
+def test_csv_ragged_rows_are_errors():
+    with pytest.raises(ValueError):
+        native.parse_numeric_csv("1,2\n3,4,5\n")
+    with pytest.raises(ValueError):
+        native.parse_numeric_csv("1,2,3\n4,5\n")
+
+
+def test_decode_threshold_duplicate_indices():
+    # concatenated multi-worker messages contain repeats; every flip counts
+    enc = np.asarray([1, 1, 1, -2, -2, 3] * 30000, np.int32)
+    out = native.decode_threshold(enc, 0.5, 4)
+    np.testing.assert_allclose(
+        out, [0.5 * 3 * 30000, -0.5 * 2 * 30000, 0.5 * 30000, 0.0])
