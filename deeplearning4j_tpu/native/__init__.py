@@ -130,6 +130,12 @@ def decode_threshold(enc: np.ndarray, tau: float, n: int,
     if out is None:
         out = np.zeros(n, np.float32)
     enc = np.ascontiguousarray(enc, np.int32)
+    if enc.size:
+        amax = int(np.abs(enc).max())
+        if amax > n or (enc == 0).any():
+            raise ValueError(
+                f"corrupt threshold message: index magnitude {amax} outside "
+                f"[1, {n}] (truncated or mis-framed payload?)")
     lib = get_lib()
     if lib is None:
         idx = np.abs(enc) - 1
@@ -167,6 +173,9 @@ def decode_bitmap(words: np.ndarray, tau: float, n: int,
     if out is None:
         out = np.zeros(n, np.float32)
     words = np.ascontiguousarray(words, np.uint64)
+    if n > words.size * 32:
+        raise ValueError(f"bitmap of {words.size} words covers "
+                         f"{words.size * 32} elements < n={n}")
     lib = get_lib()
     if lib is None:
         for i in range(n):
@@ -195,6 +204,8 @@ def parse_numeric_csv(text: bytes | str, delimiter: str = ",",
     if lib is None:
         rows = [r.split(delimiter) for r in text.decode().splitlines()
                 if r.strip()][skip_lines:]
+        if not rows:
+            return np.zeros((0, 0), np.float32)
         return np.asarray([[float(c) for c in r] for r in rows], np.float32)
     rows = ctypes.c_int64()
     cols = ctypes.c_int64()
@@ -231,6 +242,13 @@ def gather_rows(src: np.ndarray, indices: np.ndarray) -> np.ndarray:
     paying a full-array copy per batch."""
     src = np.asarray(src)
     idx = np.ascontiguousarray(indices, np.int64)
+    n = src.shape[0] if src.ndim else 0
+    # numpy fancy-index semantics for BOTH paths: negatives wrap, OOB raises
+    if idx.size and ((idx < -n).any() or (idx >= n).any()):
+        bad = idx[(idx < -n) | (idx >= n)][0]
+        raise IndexError(f"index {bad} out of bounds for axis 0 with "
+                         f"size {n}")
+    idx = np.where(idx < 0, idx + n, idx)
     lib = get_lib()
     if (lib is None or src.ndim == 0
             or not src.flags["C_CONTIGUOUS"]):

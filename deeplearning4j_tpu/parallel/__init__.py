@@ -9,6 +9,14 @@ from deeplearning4j_tpu.parallel.compression import (  # noqa: F401
     threshold_decode,
     threshold_encode,
 )
+from deeplearning4j_tpu.parallel.cluster import (  # noqa: F401
+    ParameterAveragingTrainingMaster,
+    SharedTrainingMaster,
+    SparkComputationGraph,
+    SparkDl4jMultiLayer,
+    TrainingMaster,
+    global_batch,
+)
 from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401
 from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS,

@@ -1,0 +1,143 @@
+"""Cluster (multi-host) training — the Spark/parameter-server equivalent.
+
+Reference: ``dl4j-spark``'s two TrainingMasters (SURVEY.md §2.2, §3.5) —
+``ParameterAveragingTrainingMaster`` (sync param averaging every N batches
+via Spark aggregation) and ``SharedTrainingMaster`` (threshold-encoded
+gradients over the Aeron ``VoidParameterServer`` while Spark only
+schedules) — plus the ``SparkDl4jMultiLayer``/``SparkComputationGraph``
+facades.
+
+TPU-native design: there is no Spark and no parameter server. Hosts join one
+``jax.distributed`` job (→ :func:`deeplearning4j_tpu.parallel.mesh.
+initialize_distributed`); the global mesh spans every chip on every host;
+the SAME sharded train steps used by :class:`ParallelWrapper` run on all
+hosts (SPMD), with XLA routing the gradient collectives over ICI within a
+slice and DCN between hosts. "Aggregation" is therefore a compiled
+``psum``/average — the masters only carry the reference's configuration
+surface (averaging frequency, threshold algorithm, worker batch sizes) and
+the per-host data-partition plumbing (each process contributes its local
+batches; :func:`jax.make_array_from_process_local_data` assembles the
+global sharded batch — the role of Spark's RDD partitioning).
+
+Fault tolerance follows the reference's actual story (SURVEY.md §5.3): no
+elasticity; a lost host fails the step cleanly and training resumes from the
+last checkpoint (``CheckpointListener`` / ``ModelSerializer``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.parallel import mesh as mesh_mod
+from deeplearning4j_tpu.parallel.compression import (
+    AdaptiveThresholdAlgorithm,
+    ThresholdAlgorithm,
+)
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper, TrainingMode
+
+
+class TrainingMaster:
+    """Configuration strategy for cluster fitting (reference
+    ``org.deeplearning4j.spark.api.TrainingMaster``)."""
+
+    def build_wrapper(self, model, mesh) -> ParallelWrapper:
+        raise NotImplementedError
+
+
+class ParameterAveragingTrainingMaster(TrainingMaster):
+    """Sync parameter averaging every ``averaging_frequency`` iterations
+    (reference ``ParameterAveragingTrainingMaster.Builder``). The reference
+    averages through Spark's aggregate; here replicas live on the mesh and
+    the average is one compiled cross-replica mean."""
+
+    def __init__(self, averaging_frequency: int = 5,
+                 batch_size_per_worker: int = 32,
+                 average_updaters: bool = True,
+                 prefetch_num_batches: int = 2):
+        self.averaging_frequency = int(averaging_frequency)
+        self.batch_size_per_worker = int(batch_size_per_worker)
+        self.average_updaters = bool(average_updaters)
+        self.prefetch_num_batches = int(prefetch_num_batches)
+
+    def build_wrapper(self, model, mesh):
+        return ParallelWrapper(
+            model, training_mode=TrainingMode.AVERAGING,
+            averaging_frequency=self.averaging_frequency,
+            average_updaters=self.average_updaters,
+            prefetch_buffer=self.prefetch_num_batches, mesh=mesh)
+
+
+class SharedTrainingMaster(TrainingMaster):
+    """Per-iteration gradient sharing (reference ``SharedTrainingMaster``:
+    threshold-encoded gradient messages over Aeron; here the exchange is a
+    compiled all-reduce). ``threshold=0`` selects EXACT dense all-reduce —
+    the recommended TPU default; a nonzero threshold reproduces the
+    reference's compressed semantics (±tau flips + local residuals)."""
+
+    def __init__(self, threshold: float = 0.0,
+                 threshold_algorithm: Optional[ThresholdAlgorithm] = None,
+                 batch_size_per_worker: int = 32,
+                 prefetch_num_batches: int = 2):
+        if threshold and threshold_algorithm is None:
+            threshold_algorithm = AdaptiveThresholdAlgorithm(threshold)
+        self.threshold_algorithm = threshold_algorithm
+        self.batch_size_per_worker = int(batch_size_per_worker)
+        self.prefetch_num_batches = int(prefetch_num_batches)
+
+    def build_wrapper(self, model, mesh):
+        return ParallelWrapper(
+            model, training_mode=TrainingMode.SHARED_GRADIENTS,
+            threshold_algorithm=self.threshold_algorithm,
+            prefetch_buffer=self.prefetch_num_batches, mesh=mesh)
+
+
+class SparkDl4jMultiLayer:
+    """Cluster facade (reference ``SparkDl4jMultiLayer``). The ``sc``
+    argument exists for API parity and is unused — host membership comes
+    from ``jax.distributed`` (start each process with
+    ``mesh.initialize_distributed(...)`` before constructing this)."""
+
+    def __init__(self, sc, network, training_master: TrainingMaster,
+                 mesh=None):
+        del sc  # parity only: no Spark context in the TPU design
+        self.network = network
+        self.training_master = training_master
+        self.mesh = mesh if mesh is not None else mesh_mod.MeshConfig().build()
+        self._wrapper = training_master.build_wrapper(network, self.mesh)
+
+    def fit(self, data, epochs: int = 1):
+        """``data``: a DataSetIterator over THIS host's partition (the
+        reference's RDD partition). Single-process: the whole dataset."""
+        return self._wrapper.fit(data, epochs=epochs)
+
+    def evaluate(self, iterator):
+        return self.network.evaluate(iterator)
+
+    def get_network(self):
+        return self.network
+
+    @property
+    def score(self):
+        return self._wrapper.score_value
+
+
+class SparkComputationGraph(SparkDl4jMultiLayer):
+    """Reference ``SparkComputationGraph`` — same machinery over a
+    ComputationGraph."""
+
+
+def global_batch(mesh, batch):
+    """Assemble a globally-sharded batch from per-process local arrays
+    (reference: Spark partitions feeding SharedTrainingWorkers; here
+    ``jax.make_array_from_process_local_data`` over the data axis)."""
+    sharding = mesh_mod.data_parallel_spec(mesh)
+    if jax.process_count() == 1:
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(jax.numpy.asarray(x), sharding), batch)
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(
+            sharding, np.asarray(x)), batch)

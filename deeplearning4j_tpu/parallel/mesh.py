@@ -85,8 +85,16 @@ def replicated_spec(mesh: Mesh) -> NamedSharding:
 
 def shard_batch(mesh: Mesh, batch):
     """Place a host batch so its leading dim is split over the 'data' axis
-    (the role of ParallelWrapper's splitter + per-worker MagicQueues)."""
+    (the role of ParallelWrapper's splitter + per-worker MagicQueues).
+
+    Multi-process (``jax.distributed``): ``batch`` holds THIS process's
+    local partition (the reference's RDD partition per Spark executor); the
+    global array is assembled from every process's contribution."""
     sharding = data_parallel_spec(mesh)
+    if jax.process_count() > 1:
+        return jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)), batch)
     return jax.tree_util.tree_map(
         lambda x: jax.device_put(x, sharding), batch)
 

@@ -118,6 +118,13 @@ class ParallelWrapper:
                 "ParallelWrapper does not segment truncated-BPTT batches; "
                 "train tBPTT models with net.fit() or use STANDARD backprop "
                 "under the wrapper")
+        procs = jax.process_count()
+        if self.workers % procs != 0 or self.workers < procs:
+            raise ValueError(
+                f"data axis size {self.workers} must be a positive multiple "
+                f"of the process count {procs} (each host owns "
+                f"data_axis/process_count shards)")
+        self.local_workers = self.workers // procs
         self.training_mode = training_mode
         self.averaging_frequency = int(averaging_frequency)
         self.average_updaters = bool(average_updaters)
@@ -130,6 +137,7 @@ class ParallelWrapper:
         self._tau = None
         self._step = None
         self._avg = None
+        self._collect = None
 
     # --- model-type adapters -----------------------------------------------
     def _prep(self, ds):
@@ -153,18 +161,26 @@ class ParallelWrapper:
         are config-keyed, so repeated fit() calls reuse the jit cache)."""
         m = self.model
         if self.training_mode is TrainingMode.AVERAGING:
-            stacked = _stack((m.params, m.state, m.opt_state), self.workers)
+            # multi-process: each process contributes its LOCAL replicas;
+            # shard_batch assembles the [workers]-leading global tree
+            stacked = _stack((m.params, m.state, m.opt_state),
+                             self.local_workers)
             stacked = self._data_sharded(stacked)
             self._params, self._state, self._opt = stacked
             if self._step is None:
                 self._step = self._build_averaging_step()
                 self._avg = self._build_average_fn()
+            if self._collect is None:
+                self._collect = jax.jit(
+                    _mean_leading,
+                    out_shardings=mesh_mod.replicated_spec(self.mesh))
         elif self.threshold_algorithm is not None:
             self._params = self._replicated(m.params)
             self._state = self._replicated(m.state)
             self._opt = self._replicated(m.opt_state)
             self._residual = self._data_sharded(
-                _stack(_tree_map(jnp.zeros_like, m.params), self.workers))
+                _stack(_tree_map(jnp.zeros_like, m.params),
+                       self.local_workers))
             if self._tau is None:
                 self._tau = float(self.threshold_algorithm.threshold)
             if self._step is None:
@@ -238,14 +254,17 @@ class ParallelWrapper:
             new_p = _tree_map(lambda a, b: jnp.where(ok, a, b), new_p, p)
             new_s = _tree_map(lambda a, b: jnp.where(ok, a, b), new_s, s)
             new_o = _tree_map(lambda a, b: jnp.where(ok, a, b), new_o, o)
+            c = cvec[0]
+            loss = (jax.lax.psum(loss * c, DATA)
+                    / jnp.maximum(jax.lax.psum(c, DATA), 1.0))
             return (_tree_map(lambda x: x[None], (new_p, new_s, new_o))
-                    + (loss[None],))
+                    + (loss,))
 
         sharded = shard_map(
             step, self.mesh,
             in_specs=(P(DATA), P(DATA), P(DATA), P(DATA), P(), P(), P(),
                       P(DATA)),
-            out_specs=(P(DATA), P(DATA), P(DATA), P(DATA)))
+            out_specs=(P(DATA), P(DATA), P(DATA), P()))
         return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
     def _build_average_fn(self):
@@ -311,20 +330,21 @@ class ParallelWrapper:
         m = self.model
         batch = self._prep(ds)
         rows = self._batch_rows(batch)
-        target = math.ceil(rows / self.workers) * self.workers
+        # multi-process: this batch is the LOCAL partition; pad/split over
+        # the local worker count, then assemble the global sharded batch
+        target = math.ceil(rows / self.local_workers) * self.local_workers
         batch = self._data_sharded(mesh_mod.pad_leading(batch, target))
-        counts = mesh_mod.shard_valid_counts(rows, self.workers)
+        counts = mesh_mod.shard_valid_counts(rows, self.local_workers)
         cvec = self._data_sharded(jnp.asarray(counts))
         rng = jax.random.fold_in(m._base_key, m.iteration + 1_000_003)
         it = jnp.asarray(float(m.iteration), jnp.float32)
         ep = jnp.asarray(float(m.epoch), jnp.float32)
 
         if self.training_mode is TrainingMode.AVERAGING:
-            (self._params, self._state, self._opt, losses) = self._step(
+            (self._params, self._state, self._opt, loss) = self._step(
                 self._params, self._state, self._opt, batch, it, ep, rng,
                 cvec)
-            self.score_value = float(
-                np.sum(np.asarray(losses) * counts) / max(counts.sum(), 1.0))
+            self.score_value = float(loss)
             if (m.iteration + 1) % self.averaging_frequency == 0:
                 self._params, self._state, self._opt = self._avg(
                     self._params, self._state, self._opt)
@@ -355,9 +375,9 @@ class ParallelWrapper:
             return
         m = self.model
         if self.training_mode is TrainingMode.AVERAGING:
-            m.params = jax.device_get(_mean_leading(self._params))
-            m.state = jax.device_get(_mean_leading(self._state))
-            m.opt_state = jax.device_get(_mean_leading(self._opt))
+            m.params = jax.device_get(self._collect(self._params))
+            m.state = jax.device_get(self._collect(self._state))
+            m.opt_state = jax.device_get(self._collect(self._opt))
         else:
             m.params = jax.device_get(self._params)
             m.state = jax.device_get(self._state)

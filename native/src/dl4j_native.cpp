@@ -126,7 +126,10 @@ static void index_lines(const char* buf, int64_t len,
     while (i < len && buf[i] != '\n') ++i;
     int64_t e = i;
     if (e > s && buf[e - 1] == '\r') --e;
-    if (e > s) {  // skip empty lines, as the Python reader does
+    bool blank = true;  // skip empty/whitespace-only lines, matching the
+    for (int64_t j = s; j < e; ++j)   // Python fallback's `if r.strip()`
+      if (buf[j] != ' ' && buf[j] != '\t') { blank = false; break; }
+    if (!blank) {
       starts.push_back(s);
       ends.push_back(e);
     }

@@ -129,3 +129,30 @@ def test_decode_threshold_duplicate_indices():
     out = native.decode_threshold(enc, 0.5, 4)
     np.testing.assert_allclose(
         out, [0.5 * 3 * 30000, -0.5 * 2 * 30000, 0.5 * 30000, 0.0])
+
+
+def test_decode_bounds_validation():
+    with pytest.raises(ValueError):
+        native.decode_threshold(np.asarray([10_000_000], np.int32), 0.5, 4)
+    with pytest.raises(ValueError):
+        native.decode_threshold(np.asarray([0], np.int32), 0.5, 4)
+    with pytest.raises(ValueError):
+        native.decode_bitmap(np.zeros(1, np.uint64), 0.5, 1000)
+
+
+def test_gather_numpy_semantics(rng):
+    src = rng.normal(size=(4, 3)).astype(np.float32)
+    np.testing.assert_array_equal(
+        native.gather_rows(src, np.asarray([-1, 0])), src[[-1, 0]])
+    with pytest.raises(IndexError):
+        native.gather_rows(src, np.asarray([4]))
+    with pytest.raises(IndexError):
+        native.gather_rows(src, np.asarray([-5]))
+
+
+def test_csv_whitespace_line_parity(monkeypatch):
+    m_native = native.parse_numeric_csv("1,2\n \n3,4\n")
+    monkeypatch.setattr(native, "get_lib", lambda: None)
+    m_py = native.parse_numeric_csv("1,2\n \n3,4\n")
+    np.testing.assert_array_equal(m_native, m_py)
+    assert native.parse_numeric_csv("").shape == (0, 0)
