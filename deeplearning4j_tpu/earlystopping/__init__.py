@@ -1,0 +1,303 @@
+"""Early stopping.
+
+Reference: ``org.deeplearning4j.earlystopping`` — ``EarlyStoppingConfiguration``
+(epoch/iteration termination conditions + score calculator + model saver),
+``EarlyStoppingTrainer#fit`` returning an ``EarlyStoppingResult`` with the
+best model, and savers (``LocalFileModelSaver``, ``InMemoryModelSaver``).
+"""
+
+from __future__ import annotations
+
+import copy
+import enum
+import os
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.util import serializer
+
+
+# ---------------------------------------------------------------------------
+# Termination conditions
+# ---------------------------------------------------------------------------
+
+class EpochTerminationCondition:
+    """Checked after each epoch (reference interface of the same name)."""
+
+    def terminate(self, epoch: int, score: float) -> bool:
+        raise NotImplementedError
+
+
+class MaxEpochsTerminationCondition(EpochTerminationCondition):
+    def __init__(self, max_epochs: int):
+        self.max_epochs = int(max_epochs)
+
+    def terminate(self, epoch, score):
+        return epoch + 1 >= self.max_epochs
+
+
+class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
+    """Stop after ``max_epochs_without_improvement`` non-improving epochs
+    (improvement = score drop greater than ``min_improvement``)."""
+
+    def __init__(self, max_epochs_without_improvement: int,
+                 min_improvement: float = 0.0):
+        self.patience = int(max_epochs_without_improvement)
+        self.min_improvement = float(min_improvement)
+        self._best = float("inf")
+        self._bad = 0
+
+    def terminate(self, epoch, score):
+        if score < self._best - self.min_improvement:
+            self._best = score
+            self._bad = 0
+            return False
+        self._bad += 1
+        return self._bad > self.patience
+
+
+class BestScoreEpochTerminationCondition(EpochTerminationCondition):
+    """Stop once the score is at/below a target (reference class)."""
+
+    def __init__(self, best_expected_score: float):
+        self.target = float(best_expected_score)
+
+    def terminate(self, epoch, score):
+        return score <= self.target
+
+
+class IterationTerminationCondition:
+    """Checked after each iteration (minibatch)."""
+
+    def terminate(self, score: float) -> bool:
+        raise NotImplementedError
+
+
+class MaxTimeIterationTerminationCondition(IterationTerminationCondition):
+    def __init__(self, max_seconds: float):
+        self.max_seconds = float(max_seconds)
+        self._start = None
+
+    def terminate(self, score):
+        if self._start is None:
+            self._start = time.monotonic()
+        return time.monotonic() - self._start > self.max_seconds
+
+
+class MaxScoreIterationTerminationCondition(IterationTerminationCondition):
+    """Abort on exploding loss."""
+
+    def __init__(self, max_score: float):
+        self.max_score = float(max_score)
+
+    def terminate(self, score):
+        return score > self.max_score
+
+
+class InvalidScoreIterationTerminationCondition(IterationTerminationCondition):
+    def terminate(self, score):
+        return not np.isfinite(score)
+
+
+# ---------------------------------------------------------------------------
+# Score calculators
+# ---------------------------------------------------------------------------
+
+class ScoreCalculator:
+    def calculate_score(self, model) -> float:
+        raise NotImplementedError
+
+
+class DataSetLossCalculator(ScoreCalculator):
+    """Average loss over a validation iterator (reference class)."""
+
+    def __init__(self, iterator, average: bool = True):
+        self.iterator = iterator
+        self.average = average
+
+    def calculate_score(self, model):
+        total, n = 0.0, 0
+        for ds in self.iterator:
+            total += float(model.score(ds)) * ds.num_examples()
+            n += ds.num_examples()
+        self.iterator.reset()
+        if n == 0:
+            return float("nan")
+        return total / n if self.average else total
+
+
+class ClassificationScoreCalculator(ScoreCalculator):
+    """NEGATIVE accuracy/F1 so that lower = better, matching the trainer's
+    minimization convention (reference ``ClassificationScoreCalculator``)."""
+
+    def __init__(self, iterator, metric: str = "accuracy"):
+        self.iterator = iterator
+        self.metric = metric
+
+    def calculate_score(self, model):
+        ev = model.evaluate(self.iterator)
+        self.iterator.reset()
+        return -float(getattr(ev, self.metric)())
+
+
+# ---------------------------------------------------------------------------
+# Model savers
+# ---------------------------------------------------------------------------
+
+class ModelSaver:
+    def save_best_model(self, model, score: float) -> None:
+        raise NotImplementedError
+
+    def get_best_model(self):
+        raise NotImplementedError
+
+
+class InMemoryModelSaver(ModelSaver):
+    def __init__(self):
+        self._best = None
+
+    def save_best_model(self, model, score):
+        import jax
+
+        host = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda x: np.asarray(x), t)
+        self._best = (copy.deepcopy(model.conf), host(model.params),
+                      host(model.state))
+
+    def get_best_model(self):
+        if self._best is None:
+            return None
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf, params, state = self._best
+        net = MultiLayerNetwork(conf)
+        net.init()
+        net.params = copy.deepcopy(params)
+        net.state = copy.deepcopy(state)
+        return net
+
+
+class LocalFileModelSaver(ModelSaver):
+    def __init__(self, directory: str):
+        self.directory = str(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._path = os.path.join(self.directory, "bestModel.zip")
+
+    def save_best_model(self, model, score):
+        serializer.write_model(model, self._path, save_updater=True)
+
+    def get_best_model(self):
+        if not os.path.exists(self._path):
+            return None
+        return serializer.restore_multi_layer_network(self._path)
+
+
+# ---------------------------------------------------------------------------
+# Configuration / trainer / result
+# ---------------------------------------------------------------------------
+
+class EarlyStoppingConfiguration:
+    """Reference ``EarlyStoppingConfiguration.Builder`` (kwargs replace the
+    builder chain)."""
+
+    def __init__(self,
+                 epoch_termination_conditions: Optional[List] = None,
+                 iteration_termination_conditions: Optional[List] = None,
+                 score_calculator: Optional[ScoreCalculator] = None,
+                 model_saver: Optional[ModelSaver] = None,
+                 evaluate_every_n_epochs: int = 1,
+                 save_last_model: bool = False):
+        self.epoch_conditions = list(epoch_termination_conditions or [])
+        self.iteration_conditions = list(
+            iteration_termination_conditions or [])
+        self.score_calculator = score_calculator
+        self.model_saver = model_saver or InMemoryModelSaver()
+        self.evaluate_every_n_epochs = int(evaluate_every_n_epochs)
+        self.save_last_model = save_last_model
+
+
+class TerminationReason(enum.Enum):
+    EPOCH = "EpochTerminationCondition"
+    ITERATION = "IterationTerminationCondition"
+    ERROR = "Error"
+
+
+class EarlyStoppingResult:
+    """Reference ``EarlyStoppingResult``."""
+
+    def __init__(self, termination_reason, termination_details,
+                 score_vs_epoch, best_model_epoch, best_model_score,
+                 total_epochs, best_model):
+        self.termination_reason = termination_reason
+        self.termination_details = termination_details
+        self.score_vs_epoch = score_vs_epoch
+        self.best_model_epoch = best_model_epoch
+        self.best_model_score = best_model_score
+        self.total_epochs = total_epochs
+        self.best_model = best_model
+
+    def get_best_model(self):
+        return self.best_model
+
+
+class EarlyStoppingTrainer:
+    """Reference ``EarlyStoppingTrainer`` over a MultiLayerNetwork (the
+    graph variant works identically through duck typing)."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, net, train_iter):
+        self.config = config
+        self.net = net
+        self.train_iter = train_iter
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        if self.net.params is None:
+            self.net.init()
+        best_score, best_epoch = float("inf"), -1
+        scores = {}
+        epoch = 0
+        reason, details = TerminationReason.EPOCH, "max epochs"
+        stop = False
+        while not stop:
+            for ds in self.train_iter:
+                score = self.net.fit_batch(ds)
+                for cond in cfg.iteration_conditions:
+                    if cond.terminate(score):
+                        details = f"{type(cond).__name__} at score {score}"
+                        reason = TerminationReason.ITERATION
+                        stop = True
+                        break
+                if stop:
+                    break
+            self.train_iter.reset()
+            if stop:
+                break
+
+            if epoch % cfg.evaluate_every_n_epochs == 0:
+                if cfg.score_calculator is not None:
+                    score = cfg.score_calculator.calculate_score(self.net)
+                else:
+                    score = self.net.score_value
+                scores[epoch] = score
+                if score < best_score:
+                    best_score, best_epoch = score, epoch
+                    cfg.model_saver.save_best_model(self.net, score)
+
+            for cond in cfg.epoch_conditions:
+                if cond.terminate(epoch, scores.get(epoch, best_score)):
+                    details = type(cond).__name__
+                    reason = TerminationReason.EPOCH
+                    stop = True
+                    break
+            epoch += 1
+
+        best = cfg.model_saver.get_best_model()
+        if best is None:
+            best = self.net
+        return EarlyStoppingResult(
+            termination_reason=reason, termination_details=details,
+            score_vs_epoch=scores, best_model_epoch=best_epoch,
+            best_model_score=best_score, total_epochs=epoch,
+            best_model=best)

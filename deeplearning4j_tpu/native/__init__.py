@@ -132,10 +132,15 @@ def decode_threshold(enc: np.ndarray, tau: float, n: int,
     enc = np.ascontiguousarray(enc, np.int32)
     if enc.size:
         amax = int(np.abs(enc).max())
-        if amax > n or (enc == 0).any():
+        if amax > n:
             raise ValueError(
                 f"corrupt threshold message: index magnitude {amax} outside "
                 f"[1, {n}] (truncated or mis-framed payload?)")
+        nzero = int((enc == 0).sum())
+        if nzero:
+            raise ValueError(
+                f"corrupt threshold message: {nzero} zero entries "
+                f"(indices are signed and 1-based; 0 is not a valid code)")
     lib = get_lib()
     if lib is None:
         idx = np.abs(enc) - 1

@@ -1,0 +1,287 @@
+"""Transfer learning.
+
+Reference: ``org.deeplearning4j.nn.transferlearning`` —
+``TransferLearning.Builder`` (freeze via ``FrozenLayer``, replace/remove/add
+layers, ``FineTuneConfiguration`` overriding hyperparams) and
+``TransferLearningHelper`` (featurize through the frozen front, train only
+the unfrozen tail).
+
+TPU-native notes: freezing is ``jax.lax.stop_gradient`` on the wrapped
+layer's params inside the compiled program (gradients to the INPUT still
+flow, exactly like the reference's epsilon pass-through) plus a ``NoOp``
+updater and no regularization — so a frozen layer's params are bit-identical
+after any amount of training. The helper's ``featurize`` runs the frozen
+front ONCE per dataset (one jitted forward), the tail trains as its own
+smaller compiled program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import serde
+from deeplearning4j_tpu.conf.layers import Layer
+from deeplearning4j_tpu.conf.layers_rnn import _RecurrentWrapper
+from deeplearning4j_tpu.conf.multilayer import (
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.conf.updaters import IUpdater, NoOp
+from deeplearning4j_tpu.conf.weights import WeightInit
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+@serde.register
+@dataclasses.dataclass
+class FrozenLayer(_RecurrentWrapper):
+    """Freeze wrapper (reference ``org.deeplearning4j.nn.layers.FrozenLayer``
+    via ``conf.layers.misc.FrozenLayer``): delegates everything to the
+    wrapped layer but stops gradients at its params, uses a NoOp updater and
+    drops regularization (weight decay must not move frozen params)."""
+
+    @property
+    def updater(self):
+        return NoOp()
+
+    @property
+    def regularization(self):
+        return ()
+
+    @property
+    def regularization_bias(self):
+        return ()
+
+    def _frozen(self, params):
+        return jax.tree_util.tree_map(jax.lax.stop_gradient, params)
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None):
+        # train=False inside: frozen layers run in inference mode (the
+        # reference keeps e.g. dropout/BN of frozen layers fixed); state
+        # (e.g. BN running stats) is read but never updated
+        kw = {"mask": mask} if getattr(self.layer, "uses_mask", False) else {}
+        y, _ = self.layer.forward(self._frozen(params), state, x,
+                                  train=False, rng=rng, **kw)
+        return y, state
+
+    def forward_with_carry(self, params, carry, x, mask=None, train=False,
+                           rng=None):
+        return self._run_inner(self._frozen(params), carry, x, mask, False,
+                               rng)
+
+
+@dataclasses.dataclass
+class FineTuneConfiguration:
+    """Hyperparam overrides applied to every NON-frozen layer (reference
+    ``FineTuneConfiguration.Builder``). ``None`` = keep the layer's value."""
+
+    updater: Optional[IUpdater] = None
+    seed: Optional[int] = None
+    weight_init: Optional[WeightInit] = None
+    dropout: Optional[float] = None
+
+
+class TransferLearning:
+    """Namespace matching the reference API: ``TransferLearning.Builder``."""
+
+    class Builder:
+        def __init__(self, net):
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+            if not isinstance(net, MultiLayerNetwork):
+                raise TypeError("TransferLearning.Builder takes a "
+                                "MultiLayerNetwork")
+            if net.params is None:
+                net.init()
+            self._net = net
+            # (layer, old_index, reinit) — old_index None = newly added
+            self._items: List[list] = [
+                [l, i, False] for i, l in enumerate(net.conf.layers)]
+            self._ftc: Optional[FineTuneConfiguration] = None
+            self._frozen_upto = -1
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._ftc = ftc
+            return self
+
+        def set_feature_extractor(self, layer_idx: int):
+            """Freeze layers [0..layer_idx] (reference semantics: the named
+            layer and everything before it become the frozen featurizer)."""
+            self._frozen_upto = int(layer_idx)
+            return self
+
+        def n_out_replace(self, layer_idx: int, n_out: int,
+                          weight_init: Optional[WeightInit] = None):
+            """Change layer ``layer_idx``'s width; its params and the next
+            parameterized layer's params are re-initialized (reference
+            ``nOutReplace``)."""
+            item = self._items[layer_idx]
+            layer = dataclasses.replace(item[0], n_out=int(n_out))
+            if weight_init is not None:
+                layer = dataclasses.replace(layer, weight_init=weight_init)
+            item[0] = layer
+            item[2] = True
+            for nxt in self._items[layer_idx + 1:]:
+                if nxt[0].param_order():
+                    nxt[2] = True
+                    break
+            return self
+
+        def remove_output_layer(self):
+            self._items.pop()
+            return self
+
+        def remove_layers_from_output(self, n: int):
+            for _ in range(int(n)):
+                self._items.pop()
+            return self
+
+        def add_layer(self, layer: Layer):
+            self._items.append([layer, None, False])
+            return self
+
+        # -- build -----------------------------------------------------------
+        def _apply_ftc(self, layer: Layer) -> Layer:
+            if self._ftc is None:
+                return layer
+            kw = {}
+            for f in ("updater", "weight_init", "dropout"):
+                v = getattr(self._ftc, f)
+                if v is not None and hasattr(layer, f):
+                    kw[f] = v
+            return dataclasses.replace(layer, **kw) if kw else layer
+
+        def build(self):
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+            old_conf = self._net.conf
+            layers: List[Layer] = []
+            copy_map: List[Tuple[int, Optional[int]]] = []  # new->old idx
+            for new_idx, (layer, old_idx, reinit) in enumerate(self._items):
+                if old_idx is not None and old_idx <= self._frozen_upto:
+                    layer = FrozenLayer(layer=layer)
+                else:
+                    layer = self._apply_ftc(layer)
+                layers.append(layer)
+                copy_map.append(
+                    (new_idx, old_idx if not reinit else None))
+
+            b = (NeuralNetConfiguration.builder()
+                 .seed(self._ftc.seed if self._ftc and self._ftc.seed
+                       is not None else old_conf.seed)
+                 .updater(old_conf.updater)
+                 .list())
+            for l in layers:
+                b.layer(l)
+            b.set_input_type(old_conf.input_type)
+            b.backprop_type(old_conf.backprop_type,
+                            old_conf.tbptt_fwd_length,
+                            old_conf.tbptt_back_length)
+            conf = b.build()
+
+            new_net = MultiLayerNetwork(conf)
+            new_net.init()
+            # copy retained params (the builder re-ran preprocessor
+            # insertion, so map by parameterized-layer ORDER, not index)
+            old_p_idx = [i for i, l in enumerate(old_conf.layers)
+                         if l.param_order()]
+            for new_idx, old_idx in copy_map:
+                if old_idx is None:
+                    continue
+                src = self._net.params.get(str(old_idx))
+                if not src:
+                    continue
+                # locate the same layer in the rebuilt conf: preprocessors
+                # only ever get INSERTED, so parameterized layers keep their
+                # relative order
+                tgt_idx = _find_nth_param_layer(
+                    conf.layers, old_p_idx.index(old_idx))
+                new_net.params[str(tgt_idx)] = {
+                    k: jnp.asarray(v) for k, v in src.items()}
+            return new_net
+
+
+def _find_nth_param_layer(layers, n: int) -> int:
+    seen = 0
+    for i, l in enumerate(layers):
+        if l.param_order():
+            if seen == n:
+                return i
+            seen += 1
+    raise IndexError(f"no {n}-th parameterized layer")
+
+
+def _output_type_at(conf: MultiLayerConfiguration, layer_idx: int):
+    return conf.output_types()[layer_idx]
+
+
+class TransferLearningHelper:
+    """Featurize-once training (reference ``TransferLearningHelper``): split
+    the net at the frozen boundary, run the frozen front once per dataset,
+    train only the tail."""
+
+    def __init__(self, net, frozen_till: Optional[int] = None):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        self._net = net
+        layers = net.conf.layers
+        if frozen_till is None:
+            frozen_till = max(
+                (i for i, l in enumerate(layers) if isinstance(l, FrozenLayer)),
+                default=-1)
+        self._split = int(frozen_till) + 1
+        if self._split <= 0:
+            raise ValueError("no frozen layers: use net.fit directly")
+
+        # tail sub-network sharing the original params
+        tail_input = _output_type_at(net.conf, self._split - 1)
+        b = (NeuralNetConfiguration.builder()
+             .seed(net.conf.seed)
+             .updater(net.conf.updater)
+             .list())
+        for l in layers[self._split:]:
+            b.layer(l)
+        b.set_input_type(tail_input)
+        self._tail = MultiLayerNetwork(b.build())
+        self._tail.init()
+        self._sync_to_tail()
+
+    def _sync_to_tail(self):
+        for j in range(len(self._tail.conf.layers)):
+            src = self._net.params.get(str(self._split + j))
+            if src:
+                self._tail.params[str(j)] = src
+
+    def _sync_from_tail(self):
+        for j in range(len(self._tail.conf.layers)):
+            src = self._tail.params.get(str(j))
+            if src:
+                self._net.params[str(self._split + j)] = src
+
+    def featurize(self, ds: DataSet) -> DataSet:
+        """Forward through the frozen front (reference ``featurize``)."""
+        x = jnp.asarray(np.asarray(ds.features))
+        fmask = None if ds.features_mask is None else jnp.asarray(
+            np.asarray(ds.features_mask))
+        out, _, _ = self._net._forward(self._net.params, self._net.state, x,
+                                       train=False, rng=None, fmask=fmask,
+                                       upto=self._split)
+        return DataSet(np.asarray(out), ds.labels, ds.features_mask,
+                       ds.labels_mask)
+
+    def fit_featurized(self, ds: DataSet):
+        """Train the tail on featurized data (reference
+        ``fitFeaturized``)."""
+        self._tail.fit_batch(ds)
+        self._sync_from_tail()
+        return self
+
+    def unfrozen_mln(self):
+        return self._tail
+
+    def output_from_featurized(self, features):
+        return self._tail.output(features)

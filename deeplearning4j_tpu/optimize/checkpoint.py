@@ -1,0 +1,149 @@
+"""Checkpointing.
+
+Reference: ``org.deeplearning4j.optimize.listeners.CheckpointListener`` —
+periodic model zips (every N iterations / epochs / minutes) with retention
+(keep-last-N / keep-every-N), plus static load helpers; checkpoint format is
+``ModelSerializer``'s zip (config + params + updater state), so resume is
+exact (SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import time
+from typing import List, Optional
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+from deeplearning4j_tpu.util import serializer
+
+
+class Checkpoint:
+    """One row of checkpoint.csv metadata (reference ``Checkpoint``)."""
+
+    def __init__(self, number: int, timestamp: float, iteration: int,
+                 epoch: int, filename: str):
+        self.number = int(number)
+        self.timestamp = float(timestamp)
+        self.iteration = int(iteration)
+        self.epoch = int(epoch)
+        self.filename = filename
+
+
+class CheckpointListener(TrainingListener):
+    """Save-every-N listener with retention (reference
+    ``CheckpointListener.Builder``)::
+
+        CheckpointListener(dir, save_every_n_epochs=1, keep_last=3)
+        CheckpointListener(dir, save_every_n_iterations=500, keep_mod=5)
+
+    ``keep_last``: only the newest N zips survive; ``keep_mod``: every
+    ``keep_mod``-th checkpoint is additionally kept forever (reference
+    ``keepLastAndEvery``). Default keeps everything.
+    """
+
+    def __init__(self, directory: str,
+                 save_every_n_epochs: Optional[int] = None,
+                 save_every_n_iterations: Optional[int] = None,
+                 save_every_n_seconds: Optional[float] = None,
+                 keep_last: Optional[int] = None,
+                 keep_mod: Optional[int] = None,
+                 delete_existing: bool = False):
+        if not any((save_every_n_epochs, save_every_n_iterations,
+                    save_every_n_seconds)):
+            raise ValueError("configure at least one save frequency")
+        self.directory = str(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._csv = os.path.join(self.directory, "checkpoint.csv")
+        if delete_existing:
+            for c in self.list_checkpoints():
+                p = os.path.join(self.directory, c.filename)
+                if os.path.exists(p):
+                    os.remove(p)
+            if os.path.exists(self._csv):
+                os.remove(self._csv)
+        self.every_epochs = save_every_n_epochs
+        self.every_iters = save_every_n_iterations
+        self.every_seconds = save_every_n_seconds
+        self.keep_last = keep_last
+        self.keep_mod = keep_mod
+        self._last_save_time = time.monotonic()
+        self._count = len(self.list_checkpoints())
+
+    # --- listener hooks -----------------------------------------------------
+    def iteration_done(self, model, iteration, epoch, score):
+        if self.every_iters and (iteration + 1) % self.every_iters == 0:
+            self._save(model, iteration, epoch)
+        elif (self.every_seconds
+              and time.monotonic() - self._last_save_time
+              >= self.every_seconds):
+            self._save(model, iteration, epoch)
+
+    def on_epoch_end(self, model, epoch):
+        if self.every_epochs and (epoch + 1) % self.every_epochs == 0:
+            self._save(model, getattr(model, "iteration", -1), epoch)
+
+    # --- mechanics ----------------------------------------------------------
+    def _save(self, model, iteration, epoch):
+        num = self._count
+        self._count += 1
+        fname = f"checkpoint_{num}_iter_{iteration}_epoch_{epoch}.zip"
+        serializer.write_model(model, os.path.join(self.directory, fname))
+        new_row = Checkpoint(num, time.time(), iteration, epoch, fname)
+        rows = self.list_checkpoints() + [new_row]
+        with open(self._csv, "w", newline="") as f:
+            w = csv.writer(f)
+            for c in rows:
+                w.writerow([c.number, c.timestamp, c.iteration, c.epoch,
+                            c.filename])
+        self._last_save_time = time.monotonic()
+        self._apply_retention(rows)
+
+    def _apply_retention(self, rows: List[Checkpoint]):
+        if self.keep_last is None:
+            return
+        keep = {c.number for c in rows[-self.keep_last:]}
+        if self.keep_mod:
+            keep |= {c.number for c in rows if c.number % self.keep_mod == 0}
+        for c in rows:
+            if c.number not in keep:
+                p = os.path.join(self.directory, c.filename)
+                if os.path.exists(p):
+                    os.remove(p)
+
+    # --- static API (reference's static helpers) ----------------------------
+    def list_checkpoints(self) -> List[Checkpoint]:
+        if not os.path.exists(self._csv):
+            return []
+        out = []
+        with open(self._csv, newline="") as f:
+            for row in csv.reader(f):
+                if row:
+                    out.append(Checkpoint(*row))
+        # drop rows whose zip was retention-deleted
+        return [c for c in out if os.path.exists(
+            os.path.join(self.directory, c.filename))]
+
+    def last_checkpoint(self) -> Optional[Checkpoint]:
+        cps = self.list_checkpoints()
+        return cps[-1] if cps else None
+
+    def load_checkpoint(self, number: Optional[int] = None):
+        """Restore a MultiLayerNetwork from checkpoint ``number`` (default:
+        latest)."""
+        cps = self.list_checkpoints()
+        if not cps:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        cp = cps[-1] if number is None else next(
+            c for c in cps if c.number == number)
+        return serializer.restore_multi_layer_network(
+            os.path.join(self.directory, cp.filename))
+
+    def load_checkpoint_graph(self, number: Optional[int] = None):
+        cps = self.list_checkpoints()
+        if not cps:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        cp = cps[-1] if number is None else next(
+            c for c in cps if c.number == number)
+        return serializer.restore_computation_graph(
+            os.path.join(self.directory, cp.filename))

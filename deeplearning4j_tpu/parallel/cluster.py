@@ -109,9 +109,32 @@ class SparkDl4jMultiLayer:
         self.mesh = mesh if mesh is not None else mesh_mod.MeshConfig().build()
         self._wrapper = training_master.build_wrapper(network, self.mesh)
 
-    def fit(self, data, epochs: int = 1):
+    def fit(self, data, labels=None, epochs: int = 1):
         """``data``: a DataSetIterator over THIS host's partition (the
-        reference's RDD partition). Single-process: the whole dataset."""
+        reference's RDD partition), or raw feature/label arrays —
+        arrays are batched to ``batch_size_per_worker * data_axis_size``
+        rows, the reference's effective global batch.
+
+        MULTI-HOST CONTRACT (reference: Spark repartitions to equal-size
+        partitions before training): every host must run the SAME number
+        of equally-shaped batches per epoch — SPMD collectives mean a host
+        with an extra or odd-sized batch hangs the job. Keep partitions
+        equal-sized and iterators drop_last (the default)."""
+        if labels is not None or not hasattr(data, "reset"):
+            from deeplearning4j_tpu.datasets.iterators import (
+                ArrayDataSetIterator,
+            )
+
+            bs = getattr(self.training_master, "batch_size_per_worker", 32)
+            procs = jax.process_count()
+            local_rows = (self._wrapper.workers // procs) * bs
+            if labels is None:
+                features, labels_arr = data
+            else:
+                features, labels_arr = data, labels
+            data = ArrayDataSetIterator(np.asarray(features),
+                                        np.asarray(labels_arr),
+                                        batch=local_rows)
         return self._wrapper.fit(data, epochs=epochs)
 
     def evaluate(self, iterator):
@@ -132,12 +155,7 @@ class SparkComputationGraph(SparkDl4jMultiLayer):
 
 def global_batch(mesh, batch):
     """Assemble a globally-sharded batch from per-process local arrays
-    (reference: Spark partitions feeding SharedTrainingWorkers; here
-    ``jax.make_array_from_process_local_data`` over the data axis)."""
-    sharding = mesh_mod.data_parallel_spec(mesh)
-    if jax.process_count() == 1:
-        return jax.tree_util.tree_map(
-            lambda x: jax.device_put(jax.numpy.asarray(x), sharding), batch)
-    return jax.tree_util.tree_map(
-        lambda x: jax.make_array_from_process_local_data(
-            sharding, np.asarray(x)), batch)
+    (reference: Spark partitions feeding SharedTrainingWorkers). Alias of
+    :func:`deeplearning4j_tpu.parallel.mesh.shard_batch`, kept under the
+    cluster-API name."""
+    return mesh_mod.shard_batch(mesh, batch)

@@ -138,6 +138,7 @@ class ParallelWrapper:
         self._step = None
         self._avg = None
         self._collect = None
+        self._mp_target = None
 
     # --- model-type adapters -----------------------------------------------
     def _prep(self, ds):
@@ -333,6 +334,20 @@ class ParallelWrapper:
         # multi-process: this batch is the LOCAL partition; pad/split over
         # the local worker count, then assemble the global sharded batch
         target = math.ceil(rows / self.local_workers) * self.local_workers
+        if jax.process_count() > 1:
+            # SPMD: every host must present identically-shaped local
+            # batches. Lock the shape to the first batch's padded size and
+            # pad tails up to it (unequal partitions beyond that are a
+            # documented contract violation -> clear error, not a hang).
+            if self._mp_target is None:
+                self._mp_target = target
+            if target > self._mp_target:
+                raise ValueError(
+                    f"multi-host batch of {rows} rows exceeds the "
+                    f"established per-host batch of {self._mp_target}; "
+                    f"all hosts must feed equal-size batches (repartition "
+                    f"your data as Spark does in the reference)")
+            target = self._mp_target
         batch = self._data_sharded(mesh_mod.pad_leading(batch, target))
         counts = mesh_mod.shard_valid_counts(rows, self.local_workers)
         cvec = self._data_sharded(jnp.asarray(counts))

@@ -1,0 +1,19 @@
+"""Training UI — stats collection, storage, and a static dashboard.
+
+Reference: ``deeplearning4j-ui-parent`` — ``StatsListener`` feeding a
+``StatsStorage`` (in-memory or file) consumed by the ``UIServer`` web app
+(SURVEY.md §5.5). TPU-native equivalent: the listener computes the same
+signature diagnostics (score, per-layer param/update mean magnitudes and
+their RATIO — DL4J's signature training health metric), storage is
+in-memory or JSONL on disk, and ``UIServer.render`` emits a self-contained
+static HTML dashboard (inline SVG charts, zero server/JS deps) instead of a
+Play/Vertx web server.
+"""
+
+from deeplearning4j_tpu.ui.stats import (  # noqa: F401
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    StatsListener,
+    StatsStorage,
+)
+from deeplearning4j_tpu.ui.server import UIServer  # noqa: F401

@@ -1,0 +1,137 @@
+"""Static dashboard renderer (reference ``UIServer`` web app, SURVEY.md
+§5.5) — emits one self-contained HTML file with inline SVG charts: score vs
+iteration, update:param log-ratio per layer, param mean magnitudes, and
+iteration timing. No server, no JS dependencies; re-render to refresh."""
+
+from __future__ import annotations
+
+import html
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.ui.stats import StatsStorage
+
+_W, _H, _PAD = 640, 220, 40
+_COLORS = ("#1f77b4", "#d62728", "#2ca02c", "#9467bd", "#ff7f0e",
+           "#8c564b", "#e377c2", "#7f7f7f")
+
+
+def _polyline(xs: Sequence[float], ys: Sequence[float],
+              xr: Tuple[float, float], yr: Tuple[float, float],
+              color: str) -> str:
+    if not xs:
+        return ""
+    x0, x1 = xr
+    y0, y1 = yr
+    sx = (_W - 2 * _PAD) / max(x1 - x0, 1e-12)
+    sy = (_H - 2 * _PAD) / max(y1 - y0, 1e-12)
+    pts = " ".join(
+        f"{_PAD + (x - x0) * sx:.1f},{_H - _PAD - (y - y0) * sy:.1f}"
+        for x, y in zip(xs, ys))
+    return (f'<polyline fill="none" stroke="{color}" stroke-width="1.5" '
+            f'points="{pts}"/>')
+
+
+def _chart(title: str, series: Dict[str, Tuple[List[float], List[float]]],
+           y_label: str = "") -> str:
+    allx = [x for xs, _ in series.values() for x in xs]
+    ally = [y for _, ys in series.values() for y in ys]
+    if not allx:
+        return ""
+    xr = (min(allx), max(allx) or 1.0)
+    ylo, yhi = min(ally), max(ally)
+    if ylo == yhi:
+        ylo, yhi = ylo - 1.0, yhi + 1.0
+    yr = (ylo, yhi)
+    lines, legend = [], []
+    for i, (name, (xs, ys)) in enumerate(sorted(series.items())):
+        c = _COLORS[i % len(_COLORS)]
+        lines.append(_polyline(xs, ys, xr, yr, c))
+        legend.append(f'<tspan fill="{c}">&#9632; {html.escape(name)} '
+                      f'</tspan>')
+    axis = (f'<line x1="{_PAD}" y1="{_H - _PAD}" x2="{_W - _PAD}" '
+            f'y2="{_H - _PAD}" stroke="#999"/>'
+            f'<line x1="{_PAD}" y1="{_PAD}" x2="{_PAD}" y2="{_H - _PAD}" '
+            f'stroke="#999"/>'
+            f'<text x="{_PAD}" y="{_H - 8}" font-size="10" fill="#666">'
+            f'{xr[0]:.0f}</text>'
+            f'<text x="{_W - _PAD}" y="{_H - 8}" font-size="10" '
+            f'fill="#666" text-anchor="end">{xr[1]:.0f}</text>'
+            f'<text x="{_PAD - 4}" y="{_H - _PAD}" font-size="10" '
+            f'fill="#666" text-anchor="end">{yr[0]:.3g}</text>'
+            f'<text x="{_PAD - 4}" y="{_PAD + 4}" font-size="10" '
+            f'fill="#666" text-anchor="end">{yr[1]:.3g}</text>')
+    return (f'<div class="chart"><h3>{html.escape(title)} '
+            f'<small>{html.escape(y_label)}</small></h3>'
+            f'<svg width="{_W}" height="{_H}">{axis}{"".join(lines)}'
+            f'<text x="{_PAD}" y="14" font-size="11">{"".join(legend)}'
+            f'</text></svg></div>')
+
+
+class UIServer:
+    """Reference ``UIServer#getInstance().attach(storage)`` — here a
+    renderer over the same storage."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self):
+        self._storages: List[StatsStorage] = []
+
+    @classmethod
+    def get_instance(cls) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer()
+        return cls._instance
+
+    def attach(self, storage: StatsStorage):
+        if storage not in self._storages:
+            self._storages.append(storage)
+        return self
+
+    def detach(self, storage: StatsStorage):
+        if storage in self._storages:
+            self._storages.remove(storage)
+        return self
+
+    def render(self, path: str) -> str:
+        """Write the dashboard HTML; returns the path."""
+        records = [r for st in self._storages for r in st.records()]
+        records.sort(key=lambda r: (r.get("session", ""),
+                                    r.get("iteration", 0)))
+        score = {}
+        ratio = {}
+        pmag = {}
+        timing = {}
+        for r in records:
+            it = r.get("iteration", 0)
+            sess = r.get("session", "s")
+            score.setdefault(sess, ([], []))
+            score[sess][0].append(it)
+            score[sess][1].append(r.get("score", float("nan")))
+            if "iter_seconds" in r:
+                timing.setdefault(sess, ([], []))
+                timing[sess][0].append(it)
+                timing[sess][1].append(r["iter_seconds"])
+            for layer, v in r.get("update_param_ratio_log10", {}).items():
+                ratio.setdefault(f"layer {layer}", ([], []))
+                ratio[f"layer {layer}"][0].append(it)
+                ratio[f"layer {layer}"][1].append(v)
+            for layer, v in r.get("param_mean_mag", {}).items():
+                pmag.setdefault(f"layer {layer}", ([], []))
+                pmag[f"layer {layer}"][0].append(it)
+                pmag[f"layer {layer}"][1].append(v)
+        body = "".join([
+            _chart("Model score vs iteration", score),
+            _chart("log10 update:param ratio", ratio,
+                   "(healthy ≈ -3)"),
+            _chart("Parameter mean magnitude", pmag),
+            _chart("Iteration time", timing, "seconds"),
+        ]) or "<p>No stats collected yet.</p>"
+        doc = ("<!doctype html><html><head><meta charset='utf-8'>"
+               "<title>deeplearning4j_tpu training</title><style>"
+               "body{font-family:sans-serif;margin:24px;background:#fafafa}"
+               ".chart{background:#fff;border:1px solid #ddd;margin:12px 0;"
+               "padding:8px}h3{margin:4px 0}</style></head><body>"
+               f"<h1>Training dashboard</h1>{body}</body></html>")
+        with open(path, "w") as f:
+            f.write(doc)
+        return path

@@ -1,0 +1,131 @@
+"""Stats collection (reference ``org.deeplearning4j.ui.stats.StatsListener``
++ ``StatsStorage``)."""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+
+class StatsStorage:
+    """Storage contract (reference ``StatsStorage``): ordered records per
+    session."""
+
+    def put(self, record: dict) -> None:
+        raise NotImplementedError
+
+    def records(self) -> List[dict]:
+        raise NotImplementedError
+
+
+class InMemoryStatsStorage(StatsStorage):
+    """Reference class of the same name."""
+
+    def __init__(self):
+        self._records: List[dict] = []
+
+    def put(self, record):
+        self._records.append(record)
+
+    def records(self):
+        return list(self._records)
+
+
+class FileStatsStorage(StatsStorage):
+    """JSONL-on-disk storage (reference ``FileStatsStorage`` uses MapDB;
+    JSONL keeps it greppable and append-only)."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._records: List[dict] = []
+        try:
+            with open(self.path) as f:
+                for line in f:
+                    if line.strip():
+                        self._records.append(json.loads(line))
+        except FileNotFoundError:
+            pass
+
+    def put(self, record):
+        self._records.append(record)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+    def records(self):
+        return list(self._records)
+
+
+def _mean_magnitude(tree) -> Dict[str, float]:
+    out = {}
+    for layer_idx, params in (tree or {}).items():
+        if not isinstance(params, dict) or not params:
+            continue
+        total, count = 0.0, 0
+        for v in params.values():
+            a = np.asarray(v)
+            total += float(np.abs(a).sum())
+            count += a.size
+        if count:
+            out[str(layer_idx)] = total / count
+    return out
+
+
+class StatsListener(TrainingListener):
+    """Computes DL4J's dashboard stats each ``frequency`` iterations:
+    score, examples/sec, per-layer parameter mean magnitude, UPDATE mean
+    magnitude (params delta since the previous collection), and the
+    log10(update/param) ratio — the reference's signature learning-rate
+    diagnostic (healthy ≈ -3)."""
+
+    def __init__(self, storage: StatsStorage, frequency: int = 1,
+                 session_id: Optional[str] = None):
+        self.storage = storage
+        self.frequency = max(1, int(frequency))
+        self.session_id = session_id or f"session_{int(time.time())}"
+        self._prev_params = None
+        self._last_time = None
+
+    def _host_params(self, model):
+        import jax
+
+        return jax.tree_util.tree_map(lambda x: np.asarray(x), model.params)
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if iteration % self.frequency:
+            return
+        now = time.monotonic()
+        params = self._host_params(model)
+        rec = {
+            "session": self.session_id,
+            "iteration": int(iteration),
+            "epoch": int(epoch),
+            "score": float(score),
+            "timestamp": time.time(),
+            "param_mean_mag": _mean_magnitude(params),
+        }
+        if self._last_time is not None:
+            rec["iter_seconds"] = now - self._last_time
+        if self._prev_params is not None:
+            updates = {}
+            for k, lp in params.items():
+                prev = self._prev_params.get(k)
+                if isinstance(lp, dict) and prev:
+                    updates[k] = {pk: np.asarray(pv) - prev[pk]
+                                  for pk, pv in lp.items()}
+            upd_mag = _mean_magnitude(updates)
+            rec["update_mean_mag"] = upd_mag
+            ratios = {}
+            for k, u in upd_mag.items():
+                p = rec["param_mean_mag"].get(k, 0.0)
+                if p > 0 and u > 0:
+                    ratios[k] = math.log10(u / p)
+            rec["update_param_ratio_log10"] = ratios
+        self._prev_params = params
+        self._last_time = now
+        self.storage.put(rec)

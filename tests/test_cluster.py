@@ -142,3 +142,13 @@ def test_two_process_distributed_matches_single(tmp_path):
     flat = np.concatenate([np.asarray(l).ravel()
                            for l in _jax.tree_util.tree_leaves(net.params)])
     np.testing.assert_allclose(p0, flat, rtol=5e-5, atol=1e-6)
+
+
+def test_fit_raw_arrays_uses_batch_size_per_worker():
+    net = MultiLayerNetwork(_conf())
+    net.init()
+    x, y = _data(64)
+    sn = SparkDl4jMultiLayer(None, net,
+                             SharedTrainingMaster(batch_size_per_worker=4))
+    sn.fit(x, y)  # 8 workers * 4 rows -> 2 batches of 32
+    assert net.iteration == 2

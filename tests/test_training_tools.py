@@ -1,0 +1,288 @@
+"""Transfer learning, early stopping, checkpointing, stats/UI (reference:
+``TransferLearningMLNTest``, ``TestEarlyStopping``, CheckpointListener
+tests, StatsListener tests)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.conf import Activation, InputType, WeightInit
+from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.conf.losses import LossMCXENT
+from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+from deeplearning4j_tpu.conf.updaters import Adam, Sgd
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    InvalidScoreIterationTerminationCondition,
+    LocalFileModelSaver,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+    TerminationReason,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.transferlearning import (
+    FineTuneConfiguration,
+    FrozenLayer,
+    TransferLearning,
+    TransferLearningHelper,
+)
+from deeplearning4j_tpu.optimize.checkpoint import CheckpointListener
+from deeplearning4j_tpu.ui import (
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    StatsListener,
+    UIServer,
+)
+
+
+def _conf(n_in=4, classes=3, updater=None):
+    return (NeuralNetConfiguration.builder()
+            .seed(12345)
+            .updater(updater or Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation=Activation.TANH))
+            .layer(DenseLayer(n_out=6, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=classes, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(n_in))
+            .build())
+
+
+def _data(n=48, n_in=4, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, n_in)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n)]
+    return DataSet(x, y)
+
+
+def _flat(net, idx):
+    return np.concatenate([np.asarray(v).ravel()
+                           for v in sorted(net.params[str(idx)].items())
+                           for v in [v[1]]])
+
+
+# --------------------------------------------------------------------------
+# transfer learning
+# --------------------------------------------------------------------------
+
+def test_frozen_layers_do_not_move():
+    base = MultiLayerNetwork(_conf())
+    base.init()
+    ds = _data()
+    base.fit_batch(ds)
+
+    t_net = (TransferLearning.Builder(base)
+             .fine_tune_configuration(FineTuneConfiguration(updater=Sgd(0.1)))
+             .set_feature_extractor(1)  # freeze layers 0..1
+             .build())
+    assert isinstance(t_net.conf.layers[0], FrozenLayer)
+    assert isinstance(t_net.conf.layers[1], FrozenLayer)
+    frozen0 = _flat(t_net, 0).copy()
+    frozen1 = _flat(t_net, 1).copy()
+    head_before = _flat(t_net, 2).copy()
+    for _ in range(5):
+        t_net.fit_batch(ds)
+    np.testing.assert_array_equal(_flat(t_net, 0), frozen0)
+    np.testing.assert_array_equal(_flat(t_net, 1), frozen1)
+    assert not np.allclose(_flat(t_net, 2), head_before)
+
+
+def test_transfer_replace_output():
+    base = MultiLayerNetwork(_conf(classes=3))
+    base.init()
+    w0 = _flat(base, 0).copy()
+
+    t_net = (TransferLearning.Builder(base)
+             .set_feature_extractor(0)
+             .remove_output_layer()
+             .add_layer(OutputLayer(n_out=5, activation=Activation.SOFTMAX,
+                                    loss_fn=LossMCXENT(),
+                                    updater=Sgd(0.1)))
+             .build())
+    # retained layer params copied over
+    np.testing.assert_array_equal(_flat(t_net, 0), w0)
+    ds = _data(classes=5)
+    s0 = t_net.fit_batch(ds)
+    for _ in range(10):
+        s1 = t_net.fit_batch(ds)
+    assert s1 < s0
+    out = t_net.output(ds.features)
+    assert out.shape == (48, 5)
+
+
+def test_n_out_replace_reinits_next_layer():
+    base = MultiLayerNetwork(_conf())
+    base.init()
+    t_net = (TransferLearning.Builder(base)
+             .n_out_replace(1, 12, WeightInit.XAVIER)
+             .build())
+    assert t_net.params["1"]["W"].shape == (8, 12)
+    assert t_net.params["2"]["W"].shape == (12, 3)
+    # layer 0 untouched
+    np.testing.assert_array_equal(_flat(t_net, 0), _flat(base, 0))
+
+
+def test_transfer_learning_helper_featurize():
+    base = MultiLayerNetwork(_conf())
+    base.init()
+    t_net = (TransferLearning.Builder(base)
+             .set_feature_extractor(0)
+             .build())
+    helper = TransferLearningHelper(t_net)
+    ds = _data()
+    feat = helper.featurize(ds)
+    assert feat.features.shape == (48, 8)
+    s0 = None
+    for _ in range(5):
+        helper.fit_featurized(feat)
+        s0 = s0 or helper.unfrozen_mln().score_value
+    assert helper.unfrozen_mln().score_value <= s0
+    # tail training propagated back to the full net
+    full_out = t_net.output(ds.features)
+    tail_out = helper.output_from_featurized(feat.features)
+    np.testing.assert_allclose(np.asarray(full_out), np.asarray(tail_out),
+                               atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# early stopping
+# --------------------------------------------------------------------------
+
+def test_early_stopping_max_epochs(tmp_path):
+    net = MultiLayerNetwork(_conf())
+    ds = _data()
+    it = ArrayDataSetIterator(ds.features, ds.labels, batch=16)
+    cfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(4)],
+        score_calculator=DataSetLossCalculator(
+            ArrayDataSetIterator(ds.features, ds.labels, batch=16)),
+        model_saver=LocalFileModelSaver(str(tmp_path)))
+    result = EarlyStoppingTrainer(cfg, net, it).fit()
+    assert result.termination_reason is TerminationReason.EPOCH
+    assert result.total_epochs == 4
+    assert result.best_model_epoch >= 0
+    best = result.get_best_model()
+    assert best is not None
+    assert os.path.exists(tmp_path / "bestModel.zip")
+    # best model scores what the result claims (fresh calculator)
+    calc = DataSetLossCalculator(
+        ArrayDataSetIterator(ds.features, ds.labels, batch=16))
+    assert abs(calc.calculate_score(best) - result.best_model_score) < 1e-4
+
+
+def test_early_stopping_patience():
+    net = MultiLayerNetwork(_conf(updater=Sgd(0.0)))  # lr=0: never improves
+    ds = _data()
+    it = ArrayDataSetIterator(ds.features, ds.labels, batch=16)
+    cfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[
+            ScoreImprovementEpochTerminationCondition(2),
+            MaxEpochsTerminationCondition(50)],
+        score_calculator=DataSetLossCalculator(
+            ArrayDataSetIterator(ds.features, ds.labels, batch=16)))
+    result = EarlyStoppingTrainer(cfg, net, it).fit()
+    assert result.total_epochs < 50
+    assert "ScoreImprovement" in result.termination_details
+
+
+def test_early_stopping_iteration_condition():
+    net = MultiLayerNetwork(_conf())
+    ds = _data()
+    it = ArrayDataSetIterator(ds.features, ds.labels, batch=16)
+    cfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(100)],
+        iteration_termination_conditions=[
+            MaxScoreIterationTerminationCondition(1e-9),
+            InvalidScoreIterationTerminationCondition()])
+    result = EarlyStoppingTrainer(cfg, net, it).fit()
+    assert result.termination_reason is TerminationReason.ITERATION
+
+
+# --------------------------------------------------------------------------
+# checkpoint listener
+# --------------------------------------------------------------------------
+
+def test_checkpoint_listener_epochs_and_retention(tmp_path):
+    net = MultiLayerNetwork(_conf())
+    net.init()
+    cl = CheckpointListener(str(tmp_path), save_every_n_epochs=1,
+                            keep_last=2)
+    net.set_listeners(cl)
+    ds = _data()
+    net.fit(ArrayDataSetIterator(ds.features, ds.labels, batch=16),
+            epochs=5)
+    cps = cl.list_checkpoints()
+    assert len(cps) == 2  # retention kept only the last 2
+    assert cps[-1].epoch == 4
+    restored = cl.load_checkpoint()
+    np.testing.assert_allclose(restored.params_flat(), net.params_flat(),
+                               rtol=1e-6)
+    # resume continues training (exact resume incl. updater state)
+    restored.fit_batch(ds)
+
+
+def test_checkpoint_listener_iterations(tmp_path):
+    net = MultiLayerNetwork(_conf())
+    net.init()
+    cl = CheckpointListener(str(tmp_path), save_every_n_iterations=2)
+    net.set_listeners(cl)
+    ds = _data()
+    for _ in range(6):
+        net.fit_batch(ds)
+    assert len(cl.list_checkpoints()) == 3
+
+
+# --------------------------------------------------------------------------
+# stats + UI
+# --------------------------------------------------------------------------
+
+def test_stats_listener_and_dashboard(tmp_path):
+    net = MultiLayerNetwork(_conf())
+    net.init()
+    storage = InMemoryStatsStorage()
+    net.set_listeners(StatsListener(storage, frequency=1))
+    ds = _data()
+    for _ in range(4):
+        net.fit_batch(ds)
+    recs = storage.records()
+    assert len(recs) == 4
+    assert "param_mean_mag" in recs[0]
+    assert "update_param_ratio_log10" in recs[1]
+    assert recs[1]["update_param_ratio_log10"]  # nonempty after an update
+    html_path = UIServer.get_instance().attach(storage).render(
+        str(tmp_path / "dash.html"))
+    text = open(html_path).read()
+    assert "Model score" in text and "<svg" in text
+    UIServer.get_instance().detach(storage)
+
+
+def test_file_stats_storage_roundtrip(tmp_path):
+    p = str(tmp_path / "stats.jsonl")
+    st = FileStatsStorage(p)
+    st.put({"session": "s", "iteration": 0, "score": 1.0})
+    st.put({"session": "s", "iteration": 1, "score": 0.5})
+    st2 = FileStatsStorage(p)
+    assert len(st2.records()) == 2
+    assert st2.records()[1]["score"] == 0.5
+
+
+def test_transfer_net_serializes(tmp_path):
+    from deeplearning4j_tpu.util import serializer
+
+    base = MultiLayerNetwork(_conf())
+    base.init()
+    t_net = (TransferLearning.Builder(base)
+             .set_feature_extractor(0)
+             .build())
+    p = str(tmp_path / "transfer.zip")
+    serializer.write_model(t_net, p)
+    restored = serializer.restore_multi_layer_network(p)
+    assert isinstance(restored.conf.layers[0], FrozenLayer)
+    np.testing.assert_allclose(restored.params_flat(), t_net.params_flat(),
+                               rtol=1e-6)
