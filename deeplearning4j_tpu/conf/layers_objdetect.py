@@ -1,0 +1,252 @@
+"""Object detection: YOLOv2 output layer + detection utilities.
+
+Reference: ``org.deeplearning4j.nn.conf.layers.objdetect.Yolo2OutputLayer``
+(conf) / ``org.deeplearning4j.nn.layers.objdetect.Yolo2OutputLayer`` (loss),
+``YoloUtils`` (activation + NMS), ``DetectedObject``.
+
+Layouts (NHWC, TPU-native; the reference is NCHW with the channel packing
+first):
+
+- network activations INTO this layer: ``[b, H, W, nBoxes*(5+C)]`` — per
+  anchor box: tx, ty, tw, th, to followed by C class logits.
+- labels: ``[b, H, W, 4+C]`` — per grid cell: x1, y1, x2, y2 of the ground
+  truth box IN GRID UNITS (cell size = 1) for the cell containing the box
+  center, then the one-hot class; all-zero for cells without objects
+  (reference label format, transposed).
+
+Loss = YOLOv2 (reference ``Yolo2OutputLayer#computeBackpropGradientAndScore``):
+position (sigmoid-center + sqrt-size, weight ``lambda_coord``), confidence
+(predicted IOU for the responsible anchor, ``lambda_no_obj`` elsewhere),
+class probabilities (L2 on softmax by default, as the reference's default
+``LossL2``). The responsible anchor per labeled cell is the prior with best
+shape-IOU against the truth box, as in the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import serde
+from deeplearning4j_tpu.conf import inputs as it
+from deeplearning4j_tpu.conf.layers import Layer
+
+
+@serde.register
+@dataclasses.dataclass
+class Yolo2OutputLayer(Layer):
+    """YOLOv2 loss head. ``boxes``: anchor priors ``((w, h), ...)`` in grid
+    units (reference ``boundingBoxePriors``)."""
+
+    boxes: Tuple[Tuple[float, float], ...] = ()
+    lambda_coord: float = 5.0
+    lambda_no_obj: float = 0.5
+
+    def __post_init__(self):
+        if not self.boxes:
+            raise ValueError("Yolo2OutputLayer needs anchor box priors")
+        self.boxes = tuple(tuple(float(v) for v in b) for b in self.boxes)
+
+    # -- shapes --------------------------------------------------------------
+    @property
+    def n_boxes(self) -> int:
+        return len(self.boxes)
+
+    def _classes(self, channels: int) -> int:
+        per = channels // self.n_boxes
+        c = per - 5
+        if per * self.n_boxes != channels or c < 1:
+            raise ValueError(
+                f"input depth {channels} != nBoxes({self.n_boxes}) * "
+                f"(5 + C) for a positive class count C")
+        return c
+
+    def output_type(self, input_type):
+        return input_type
+
+    # -- activation transform (reference YoloUtils.activate) -----------------
+    def _split(self, x):
+        b, h, w, ch = x.shape
+        c = self._classes(ch)
+        x = x.reshape(b, h, w, self.n_boxes, 5 + c)
+        txy = x[..., 0:2]
+        twh = x[..., 2:4]
+        to = x[..., 4]
+        logits = x[..., 5:]
+        return txy, twh, to, logits
+
+    def _decode(self, x):
+        """-> (center_xy [b,h,w,nb,2] grid units, wh [b,h,w,nb,2],
+        confidence [b,h,w,nb], class_probs [b,h,w,nb,C])."""
+        bsz, h, w, _ = x.shape
+        txy, twh, to, logits = self._split(x)
+        cy = jnp.arange(h, dtype=x.dtype)[None, :, None, None]
+        cx = jnp.arange(w, dtype=x.dtype)[None, None, :, None]
+        sig = jax.nn.sigmoid(txy)
+        center = jnp.stack([sig[..., 0] + cx, sig[..., 1] + cy], axis=-1)
+        priors = jnp.asarray(self.boxes, x.dtype)  # [nb, 2]
+        wh = priors[None, None, None] * jnp.exp(twh)
+        conf = jax.nn.sigmoid(to)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return center, wh, conf, probs
+
+    def forward(self, params, state, x, train=False, rng=None):
+        """Inference output: activated grid ``[b,h,w,nb,(5+C)]`` flattened
+        back to ``[b,h,w,nb*(5+C)]`` — x,y as ABSOLUTE grid coords, w,h in
+        grid units, sigmoid confidence, softmax classes (reference
+        ``YoloUtils.activate``)."""
+        center, wh, conf, probs = self._decode(x)
+        out = jnp.concatenate(
+            [center, wh, conf[..., None], probs], axis=-1)
+        b, h, w = out.shape[:3]
+        return out.reshape(b, h, w, -1), state
+
+    # -- loss ----------------------------------------------------------------
+    def score(self, params, x, labels, mask=None):
+        bsz, h, w, ch = x.shape
+        c = self._classes(ch)
+        labels = jnp.asarray(labels, x.dtype)
+        truth_xy1 = labels[..., 0:2]  # [b,h,w,2] grid units
+        truth_xy2 = labels[..., 2:4]
+        truth_cls = labels[..., 4:]
+        obj = (jnp.sum(labels[..., 0:4] != 0.0, axis=-1) > 0).astype(x.dtype)
+
+        truth_wh = truth_xy2 - truth_xy1
+        truth_center = 0.5 * (truth_xy1 + truth_xy2)
+
+        # responsible anchor: best shape-IOU prior vs truth wh
+        priors = jnp.asarray(self.boxes, x.dtype)  # [nb,2]
+        inter = (jnp.minimum(truth_wh[..., None, 0], priors[None, None, None, :, 0])
+                 * jnp.minimum(truth_wh[..., None, 1], priors[None, None, None, :, 1]))
+        union = (truth_wh[..., 0] * truth_wh[..., 1])[..., None] \
+            + priors[:, 0] * priors[:, 1] - inter
+        shape_iou = inter / jnp.maximum(union, 1e-9)
+        resp = jax.nn.one_hot(jnp.argmax(shape_iou, axis=-1), self.n_boxes,
+                              dtype=x.dtype)          # [b,h,w,nb]
+        resp = resp * obj[..., None]
+
+        center, wh, conf, probs = self._decode(x)
+
+        # position: squared error on centers + sqrt sizes (lambda_coord)
+        d_center = jnp.sum((center - truth_center[..., None, :]) ** 2, -1)
+        d_size = jnp.sum((jnp.sqrt(jnp.maximum(wh, 1e-9))
+                          - jnp.sqrt(jnp.maximum(truth_wh, 1e-9))[..., None, :]
+                          ) ** 2, -1)
+        # per-example sums so the labels mask (padded rows in ragged
+        # batches) can zero out whole examples
+        pos_loss = self.lambda_coord * jnp.sum(
+            resp * (d_center + d_size), axis=(1, 2, 3))
+
+        # confidence: responsible -> (conf - IOU(pred, truth))^2,
+        # everything else -> lambda_no_obj * conf^2
+        p_xy1 = center - 0.5 * wh
+        p_xy2 = center + 0.5 * wh
+        ixy1 = jnp.maximum(p_xy1, truth_xy1[..., None, :])
+        ixy2 = jnp.minimum(p_xy2, truth_xy2[..., None, :])
+        iwh = jnp.maximum(ixy2 - ixy1, 0.0)
+        inter_a = iwh[..., 0] * iwh[..., 1]
+        area_p = jnp.maximum(wh[..., 0] * wh[..., 1], 0.0)
+        area_t = (truth_wh[..., 0] * truth_wh[..., 1])[..., None]
+        iou = inter_a / jnp.maximum(area_p + area_t - inter_a, 1e-9)
+        iou = jax.lax.stop_gradient(iou)  # target, as in the reference
+        conf_loss = (jnp.sum(resp * (conf - iou) ** 2, axis=(1, 2, 3))
+                     + self.lambda_no_obj
+                     * jnp.sum((1.0 - resp) * conf ** 2, axis=(1, 2, 3)))
+
+        # class: L2 on softmax for labeled cells (reference default LossL2)
+        cls_loss = jnp.sum(
+            obj[..., None] * jnp.sum(
+                (probs - truth_cls[..., None, :]) ** 2, -1),
+            axis=(1, 2, 3))
+
+        per_example = pos_loss + conf_loss + cls_loss  # [b]
+        if mask is not None:
+            m = jnp.asarray(mask, x.dtype).reshape(bsz, -1)[:, 0]
+            return jnp.sum(per_example * m) / jnp.maximum(jnp.sum(m), 1.0)
+        return jnp.mean(per_example)
+
+
+@dataclasses.dataclass
+class DetectedObject:
+    """Reference ``org.deeplearning4j.nn.layers.objdetect.DetectedObject``.
+    Coordinates in GRID units; use ``top_left``/``bottom_right`` and scale
+    by (image_size / grid_size) for pixels."""
+
+    example: int
+    center_x: float
+    center_y: float
+    width: float
+    height: float
+    predicted_class: int
+    confidence: float
+    class_probs: np.ndarray = None
+
+    @property
+    def top_left(self):
+        return (self.center_x - self.width / 2,
+                self.center_y - self.height / 2)
+
+    @property
+    def bottom_right(self):
+        return (self.center_x + self.width / 2,
+                self.center_y + self.height / 2)
+
+
+def get_predicted_objects(layer: Yolo2OutputLayer, activated,
+                          threshold: float = 0.5) -> List[DetectedObject]:
+    """Detections from the activated grid produced by ``layer.forward``
+    (reference ``YoloUtils.getPredictedObjects``): keep anchors whose
+    confidence * max class prob exceeds ``threshold``."""
+    a = np.asarray(activated)
+    b, h, w, ch = a.shape
+    nb = layer.n_boxes
+    per = ch // nb
+    a = a.reshape(b, h, w, nb, per)
+    centers, whs, confs, probs = (a[..., 0:2], a[..., 2:4], a[..., 4],
+                                  a[..., 5:])
+    out: List[DetectedObject] = []
+    score = confs * probs.max(axis=-1)
+    for ex, yy, xx, bb in zip(*np.nonzero(score > threshold)):
+        out.append(DetectedObject(
+            example=int(ex),
+            center_x=float(centers[ex, yy, xx, bb, 0]),
+            center_y=float(centers[ex, yy, xx, bb, 1]),
+            width=float(whs[ex, yy, xx, bb, 0]),
+            height=float(whs[ex, yy, xx, bb, 1]),
+            predicted_class=int(probs[ex, yy, xx, bb].argmax()),
+            confidence=float(score[ex, yy, xx, bb]),
+            class_probs=probs[ex, yy, xx, bb].copy()))
+    return out
+
+
+def iou(a: DetectedObject, b: DetectedObject) -> float:
+    """Box IOU (reference ``DetectedObject``/``YoloUtils`` IOU)."""
+    ax1, ay1 = a.top_left
+    ax2, ay2 = a.bottom_right
+    bx1, by1 = b.top_left
+    bx2, by2 = b.bottom_right
+    iw = max(0.0, min(ax2, bx2) - max(ax1, bx1))
+    ih = max(0.0, min(ay2, by2) - max(ay1, by1))
+    inter = iw * ih
+    union = (ax2 - ax1) * (ay2 - ay1) + (bx2 - bx1) * (by2 - by1) - inter
+    return inter / union if union > 0 else 0.0
+
+
+def nms(objects: List[DetectedObject], iou_threshold: float = 0.45
+        ) -> List[DetectedObject]:
+    """Per-class non-max suppression (reference ``YoloUtils.nms``)."""
+    keep: List[DetectedObject] = []
+    by_class = {}
+    for o in objects:
+        by_class.setdefault((o.example, o.predicted_class), []).append(o)
+    for group in by_class.values():
+        group = sorted(group, key=lambda o: -o.confidence)
+        while group:
+            best = group.pop(0)
+            keep.append(best)
+            group = [o for o in group if iou(best, o) < iou_threshold]
+    return sorted(keep, key=lambda o: (o.example, -o.confidence))

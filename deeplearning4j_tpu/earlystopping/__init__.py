@@ -26,6 +26,10 @@ from deeplearning4j_tpu.util import serializer
 class EpochTerminationCondition:
     """Checked after each epoch (reference interface of the same name)."""
 
+    def initialize(self) -> None:
+        """Reset state at the start of each fit (reference
+        ``EpochTerminationCondition#initialize``)."""
+
     def terminate(self, epoch: int, score: float) -> bool:
         raise NotImplementedError
 
@@ -46,6 +50,10 @@ class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
                  min_improvement: float = 0.0):
         self.patience = int(max_epochs_without_improvement)
         self.min_improvement = float(min_improvement)
+        self._best = float("inf")
+        self._bad = 0
+
+    def initialize(self):
         self._best = float("inf")
         self._bad = 0
 
@@ -71,6 +79,9 @@ class BestScoreEpochTerminationCondition(EpochTerminationCondition):
 class IterationTerminationCondition:
     """Checked after each iteration (minibatch)."""
 
+    def initialize(self) -> None:
+        """Reset state at the start of each fit."""
+
     def terminate(self, score: float) -> bool:
         raise NotImplementedError
 
@@ -78,6 +89,9 @@ class IterationTerminationCondition:
 class MaxTimeIterationTerminationCondition(IterationTerminationCondition):
     def __init__(self, max_seconds: float):
         self.max_seconds = float(max_seconds)
+        self._start = None
+
+    def initialize(self):
         self._start = None
 
     def terminate(self, score):
@@ -169,10 +183,15 @@ class InMemoryModelSaver(ModelSaver):
     def get_best_model(self):
         if self._best is None:
             return None
-        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-
         conf, params, state = self._best
-        net = MultiLayerNetwork(conf)
+        if type(conf).__name__ == "ComputationGraphConfiguration":
+            from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+            net = ComputationGraph(conf)
+        else:
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+            net = MultiLayerNetwork(conf)
         net.init()
         net.params = copy.deepcopy(params)
         net.state = copy.deepcopy(state)
@@ -191,7 +210,7 @@ class LocalFileModelSaver(ModelSaver):
     def get_best_model(self):
         if not os.path.exists(self._path):
             return None
-        return serializer.restore_multi_layer_network(self._path)
+        return serializer.restore_model(self._path)
 
 
 # ---------------------------------------------------------------------------
@@ -253,6 +272,13 @@ class EarlyStoppingTrainer:
 
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
+        if not cfg.epoch_conditions:
+            raise ValueError(
+                "EarlyStoppingConfiguration needs at least one epoch "
+                "termination condition (e.g. MaxEpochsTerminationCondition) "
+                "or fit() would never return")
+        for cond in cfg.epoch_conditions + cfg.iteration_conditions:
+            cond.initialize()
         if self.net.params is None:
             self.net.init()
         best_score, best_epoch = float("inf"), -1
@@ -285,7 +311,13 @@ class EarlyStoppingTrainer:
                     best_score, best_epoch = score, epoch
                     cfg.model_saver.save_best_model(self.net, score)
 
+            evaluated = epoch in scores
             for cond in cfg.epoch_conditions:
+                # score-driven conditions only fire on epochs that actually
+                # evaluated; MaxEpochs fires regardless (reference behavior)
+                if not evaluated and not isinstance(
+                        cond, MaxEpochsTerminationCondition):
+                    continue
                 if cond.terminate(epoch, scores.get(epoch, best_score)):
                     details = type(cond).__name__
                     reason = TerminationReason.EPOCH

@@ -68,7 +68,8 @@ class CheckpointListener(TrainingListener):
         self.keep_last = keep_last
         self.keep_mod = keep_mod
         self._last_save_time = time.monotonic()
-        self._count = len(self.list_checkpoints())
+        rows = self._read_rows()
+        self._count = (max(c.number for c in rows) + 1) if rows else 0
 
     # --- listener hooks -----------------------------------------------------
     def iteration_done(self, model, iteration, epoch, score):
@@ -90,7 +91,7 @@ class CheckpointListener(TrainingListener):
         fname = f"checkpoint_{num}_iter_{iteration}_epoch_{epoch}.zip"
         serializer.write_model(model, os.path.join(self.directory, fname))
         new_row = Checkpoint(num, time.time(), iteration, epoch, fname)
-        rows = self.list_checkpoints() + [new_row]
+        rows = self._read_rows() + [new_row]
         with open(self._csv, "w", newline="") as f:
             w = csv.writer(f)
             for c in rows:
@@ -102,6 +103,8 @@ class CheckpointListener(TrainingListener):
     def _apply_retention(self, rows: List[Checkpoint]):
         if self.keep_last is None:
             return
+        rows = [c for c in rows if os.path.exists(
+            os.path.join(self.directory, c.filename))]
         keep = {c.number for c in rows[-self.keep_last:]}
         if self.keep_mod:
             keep |= {c.number for c in rows if c.number % self.keep_mod == 0}
@@ -112,7 +115,9 @@ class CheckpointListener(TrainingListener):
                     os.remove(p)
 
     # --- static API (reference's static helpers) ----------------------------
-    def list_checkpoints(self) -> List[Checkpoint]:
+    def _read_rows(self) -> List[Checkpoint]:
+        """All rows ever written (including retention-deleted) — the
+        numbering authority."""
         if not os.path.exists(self._csv):
             return []
         out = []
@@ -120,8 +125,11 @@ class CheckpointListener(TrainingListener):
             for row in csv.reader(f):
                 if row:
                     out.append(Checkpoint(*row))
-        # drop rows whose zip was retention-deleted
-        return [c for c in out if os.path.exists(
+        return out
+
+    def list_checkpoints(self) -> List[Checkpoint]:
+        # only checkpoints whose zip still exists (retention-aware)
+        return [c for c in self._read_rows() if os.path.exists(
             os.path.join(self.directory, c.filename))]
 
     def last_checkpoint(self) -> Optional[Checkpoint]:

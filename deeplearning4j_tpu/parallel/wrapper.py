@@ -313,6 +313,9 @@ class ParallelWrapper:
                 iterator = AsyncDataSetIterator(
                     iterator, queue_size=self.prefetch_buffer)
         self._setup()
+        # each fit() may use a different batch size; the multi-host shape
+        # lock applies within one fit only
+        self._mp_target = None
         try:
             for _ in range(epochs):
                 for lst in m.listeners:

@@ -322,3 +322,517 @@ class UNet(GraphZooModel):
                                            loss_fn=LossBinaryXENT()), "head")
         g.set_outputs("output")
         return g.build()
+
+
+class Xception(GraphZooModel):
+    """Reference ``org.deeplearning4j.zoo.model.Xception``: entry flow
+    (conv32/2, conv64, separable-conv residual blocks 128/256/728), middle
+    flow (8 x three separable-conv-728 residual blocks), exit flow
+    (728->1024 residual, sepconv 1536, 2048, global average pool)."""
+
+    def __init__(self, num_classes: int = 1000, height: int = 299,
+                 width: int = 299, channels: int = 3, seed: int = 123,
+                 updater: IUpdater | None = None,
+                 middle_flow_repeats: int = 8):
+        self.num_classes = num_classes
+        self.height, self.width, self.channels = height, width, channels
+        self.seed = seed
+        self.updater = updater or Nesterovs(learning_rate=1e-2, momentum=0.9)
+        self.middle_flow_repeats = middle_flow_repeats
+
+    def conf(self) -> ComputationGraphConfiguration:
+        from deeplearning4j_tpu.conf.layers_cnn import SeparableConvolution2D
+
+        def sep(n):
+            return SeparableConvolution2D(
+                n_out=n, kernel_size=(3, 3), stride=(1, 1),
+                activation=Activation.IDENTITY,
+                convolution_mode=ConvolutionMode.SAME)
+
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed).updater(self.updater)
+             .weight_init(WeightInit.XAVIER)
+             .graph_builder()
+             .add_inputs("input")
+             .set_input_types(InputType.convolutional(
+                 self.height, self.width, self.channels)))
+        g.add_layer("c1", _conv(32, (3, 3), (2, 2),
+                                mode=ConvolutionMode.TRUNCATE,
+                                act=Activation.IDENTITY), "input")
+        g.add_layer("c1bn", BatchNormalization(activation=Activation.RELU),
+                    "c1")
+        g.add_layer("c2", _conv(64, (3, 3), act=Activation.IDENTITY), "c1bn")
+        g.add_layer("c2bn", BatchNormalization(activation=Activation.RELU),
+                    "c2")
+        prev = "c2bn"
+        # entry-flow residual blocks
+        for i, ch in enumerate((128, 256, 728)):
+            rname = f"e{i}_res"
+            g.add_layer(rname, _conv(ch, (1, 1), (2, 2),
+                                     act=Activation.IDENTITY,
+                                     mode=ConvolutionMode.SAME), prev)
+            g.add_layer(f"e{i}_s1", sep(ch), prev)
+            g.add_layer(f"e{i}_b1",
+                        BatchNormalization(activation=Activation.RELU),
+                        f"e{i}_s1")
+            g.add_layer(f"e{i}_s2", sep(ch), f"e{i}_b1")
+            g.add_layer(f"e{i}_b2", BatchNormalization(), f"e{i}_s2")
+            g.add_layer(f"e{i}_pool", _maxpool((3, 3), (2, 2),
+                                               ConvolutionMode.SAME),
+                        f"e{i}_b2")
+            g.add_vertex(f"e{i}_add",
+                         ElementWiseVertex(op=ElementWiseOp.ADD),
+                         f"e{i}_pool", rname)
+            prev = f"e{i}_add"
+        # middle flow
+        for r in range(self.middle_flow_repeats):
+            inp = prev
+            last = inp
+            for j in range(3):
+                g.add_layer(f"m{r}_a{j}",
+                            ActivationLayer(activation=Activation.RELU),
+                            last)
+                g.add_layer(f"m{r}_s{j}", sep(728), f"m{r}_a{j}")
+                g.add_layer(f"m{r}_b{j}", BatchNormalization(),
+                            f"m{r}_s{j}")
+                last = f"m{r}_b{j}"
+            g.add_vertex(f"m{r}_add",
+                         ElementWiseVertex(op=ElementWiseOp.ADD),
+                         last, inp)
+            prev = f"m{r}_add"
+        # exit flow
+        g.add_layer("x_res", _conv(1024, (1, 1), (2, 2),
+                                   act=Activation.IDENTITY,
+                                   mode=ConvolutionMode.SAME), prev)
+        g.add_layer("x_s1", sep(728), prev)
+        g.add_layer("x_b1", BatchNormalization(activation=Activation.RELU),
+                    "x_s1")
+        g.add_layer("x_s2", sep(1024), "x_b1")
+        g.add_layer("x_b2", BatchNormalization(), "x_s2")
+        g.add_layer("x_pool", _maxpool((3, 3), (2, 2), ConvolutionMode.SAME),
+                    "x_b2")
+        g.add_vertex("x_add", ElementWiseVertex(op=ElementWiseOp.ADD),
+                     "x_pool", "x_res")
+        g.add_layer("x_s3", sep(1536), "x_add")
+        g.add_layer("x_b3", BatchNormalization(activation=Activation.RELU),
+                    "x_s3")
+        g.add_layer("x_s4", sep(2048), "x_b3")
+        g.add_layer("x_b4", BatchNormalization(activation=Activation.RELU),
+                    "x_s4")
+        g.add_layer("gap", GlobalPoolingLayer(pooling_type=PoolingType.AVG),
+                    "x_b4")
+        g.add_layer("output", OutputLayer(n_out=self.num_classes,
+                                          activation=Activation.SOFTMAX,
+                                          loss_fn=LossMCXENT()), "gap")
+        g.set_outputs("output")
+        return g.build()
+
+
+class InceptionResNetV1(GraphZooModel):
+    """Reference ``org.deeplearning4j.zoo.model.InceptionResNetV1`` (the
+    FaceNet variant): stem, 5 x Inception-ResNet-A (scale 0.17), reduction-A,
+    10 x Inception-ResNet-B (scale 0.10), reduction-B, 5 x Inception-ResNet-C
+    (scale 0.20), average pool, embedding + softmax head. Residual scaling
+    uses ``ScaleVertex`` + ``ElementWiseVertex(Add)`` as in the reference."""
+
+    def __init__(self, num_classes: int = 1001, height: int = 160,
+                 width: int = 160, channels: int = 3,
+                 embedding_size: int = 128, seed: int = 123,
+                 updater: IUpdater | None = None,
+                 blocks_a: int = 5, blocks_b: int = 10, blocks_c: int = 5):
+        self.num_classes = num_classes
+        self.height, self.width, self.channels = height, width, channels
+        self.embedding_size = embedding_size
+        self.seed = seed
+        self.updater = updater or Adam(learning_rate=0.1)
+        self.blocks_a, self.blocks_b, self.blocks_c = blocks_a, blocks_b, blocks_c
+
+    def conf(self) -> ComputationGraphConfiguration:
+        from deeplearning4j_tpu.conf.graph import ScaleVertex
+
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed).updater(self.updater)
+             .weight_init(WeightInit.XAVIER)
+             .graph_builder()
+             .add_inputs("input")
+             .set_input_types(InputType.convolutional(
+                 self.height, self.width, self.channels)))
+
+        def cbr(name, n, k, s, inp, mode=ConvolutionMode.SAME):
+            g.add_layer(name, _conv(n, k, s, act=Activation.IDENTITY,
+                                    mode=mode), inp)
+            g.add_layer(name + "_bn",
+                        BatchNormalization(activation=Activation.RELU), name)
+            return name + "_bn"
+
+        # stem
+        p = cbr("s1", 32, (3, 3), (2, 2), "input",
+                ConvolutionMode.TRUNCATE)
+        p = cbr("s2", 32, (3, 3), (1, 1), p)
+        p = cbr("s3", 64, (3, 3), (1, 1), p)
+        g.add_layer("s4", _maxpool((3, 3), (2, 2)), p)
+        p = cbr("s5", 80, (1, 1), (1, 1), "s4")
+        p = cbr("s6", 192, (3, 3), (1, 1), p)
+        p = cbr("s7", 256, (3, 3), (2, 2), p, ConvolutionMode.SAME)
+
+        def block_a(i, inp):
+            b1 = cbr(f"a{i}_b1", 32, (1, 1), (1, 1), inp)
+            b2 = cbr(f"a{i}_b2b", 32, (3, 3), (1, 1),
+                     cbr(f"a{i}_b2a", 32, (1, 1), (1, 1), inp))
+            b3 = cbr(f"a{i}_b3c", 32, (3, 3), (1, 1),
+                     cbr(f"a{i}_b3b", 32, (3, 3), (1, 1),
+                         cbr(f"a{i}_b3a", 32, (1, 1), (1, 1), inp)))
+            g.add_vertex(f"a{i}_cat", MergeVertex(), b1, b2, b3)
+            g.add_layer(f"a{i}_up", _conv(256, (1, 1),
+                                          act=Activation.IDENTITY),
+                        f"a{i}_cat")
+            g.add_vertex(f"a{i}_scale", ScaleVertex(scale_factor=0.17),
+                         f"a{i}_up")
+            g.add_vertex(f"a{i}_add",
+                         ElementWiseVertex(op=ElementWiseOp.ADD),
+                         inp, f"a{i}_scale")
+            g.add_layer(f"a{i}_relu",
+                        ActivationLayer(activation=Activation.RELU),
+                        f"a{i}_add")
+            return f"a{i}_relu"
+
+        for i in range(self.blocks_a):
+            p = block_a(i, p)
+
+        # reduction-A -> 896 channels
+        g.add_layer("ra_pool", _maxpool((3, 3), (2, 2),
+                                        ConvolutionMode.SAME), p)
+        ra1 = cbr("ra_c1", 384, (3, 3), (2, 2), p, ConvolutionMode.SAME)
+        ra2 = cbr("ra_c2c", 256, (3, 3), (2, 2),
+                  cbr("ra_c2b", 192, (3, 3), (1, 1),
+                      cbr("ra_c2a", 192, (1, 1), (1, 1), p)),
+                  ConvolutionMode.SAME)
+        g.add_vertex("ra_cat", MergeVertex(), "ra_pool", ra1, ra2)
+        p = "ra_cat"  # 256+384+256 = 896
+
+        def block_b(i, inp):
+            b1 = cbr(f"b{i}_b1", 128, (1, 1), (1, 1), inp)
+            b2 = cbr(f"b{i}_b2c", 128, (7, 1), (1, 1),
+                     cbr(f"b{i}_b2b", 128, (1, 7), (1, 1),
+                         cbr(f"b{i}_b2a", 128, (1, 1), (1, 1), inp)))
+            g.add_vertex(f"b{i}_cat", MergeVertex(), b1, b2)
+            g.add_layer(f"b{i}_up", _conv(896, (1, 1),
+                                          act=Activation.IDENTITY),
+                        f"b{i}_cat")
+            g.add_vertex(f"b{i}_scale", ScaleVertex(scale_factor=0.10),
+                         f"b{i}_up")
+            g.add_vertex(f"b{i}_add",
+                         ElementWiseVertex(op=ElementWiseOp.ADD),
+                         inp, f"b{i}_scale")
+            g.add_layer(f"b{i}_relu",
+                        ActivationLayer(activation=Activation.RELU),
+                        f"b{i}_add")
+            return f"b{i}_relu"
+
+        for i in range(self.blocks_b):
+            p = block_b(i, p)
+
+        # reduction-B -> 1792 channels
+        g.add_layer("rb_pool", _maxpool((3, 3), (2, 2),
+                                        ConvolutionMode.SAME), p)
+        rb1 = cbr("rb_c1b", 384, (3, 3), (2, 2),
+                  cbr("rb_c1a", 256, (1, 1), (1, 1), p),
+                  ConvolutionMode.SAME)
+        rb2 = cbr("rb_c2b", 256, (3, 3), (2, 2),
+                  cbr("rb_c2a", 256, (1, 1), (1, 1), p),
+                  ConvolutionMode.SAME)
+        rb3 = cbr("rb_c3c", 256, (3, 3), (2, 2),
+                  cbr("rb_c3b", 256, (3, 3), (1, 1),
+                      cbr("rb_c3a", 256, (1, 1), (1, 1), p)),
+                  ConvolutionMode.SAME)
+        g.add_vertex("rb_cat", MergeVertex(), "rb_pool", rb1, rb2, rb3)
+        p = "rb_cat"  # 896+384+256+256 = 1792
+
+        def block_c(i, inp):
+            b1 = cbr(f"c{i}_b1", 192, (1, 1), (1, 1), inp)
+            b2 = cbr(f"c{i}_b2c", 192, (3, 1), (1, 1),
+                     cbr(f"c{i}_b2b", 192, (1, 3), (1, 1),
+                         cbr(f"c{i}_b2a", 192, (1, 1), (1, 1), inp)))
+            g.add_vertex(f"c{i}_cat", MergeVertex(), b1, b2)
+            g.add_layer(f"c{i}_up", _conv(1792, (1, 1),
+                                          act=Activation.IDENTITY),
+                        f"c{i}_cat")
+            g.add_vertex(f"c{i}_scale", ScaleVertex(scale_factor=0.20),
+                         f"c{i}_up")
+            g.add_vertex(f"c{i}_add",
+                         ElementWiseVertex(op=ElementWiseOp.ADD),
+                         inp, f"c{i}_scale")
+            g.add_layer(f"c{i}_relu",
+                        ActivationLayer(activation=Activation.RELU),
+                        f"c{i}_add")
+            return f"c{i}_relu"
+
+        for i in range(self.blocks_c):
+            p = block_c(i, p)
+
+        g.add_layer("gap", GlobalPoolingLayer(pooling_type=PoolingType.AVG),
+                    p)
+        g.add_layer("embedding", DenseLayer(
+            n_out=self.embedding_size, activation=Activation.IDENTITY), "gap")
+        g.add_layer("output", OutputLayer(n_out=self.num_classes,
+                                          activation=Activation.SOFTMAX,
+                                          loss_fn=LossMCXENT()), "embedding")
+        g.set_outputs("output")
+        return g.build()
+
+
+class TinyYOLO(GraphZooModel):
+    """Reference ``org.deeplearning4j.zoo.model.TinyYOLO``: Darknet-tiny
+    backbone (conv3x3 16..1024 with leaky-relu BN and maxpools) + 1x1
+    detection conv + ``Yolo2OutputLayer``; input 416x416 -> 13x13 grid,
+    5 anchor priors."""
+
+    PRIORS = ((1.08, 1.19), (3.42, 4.41), (6.63, 11.38), (9.42, 5.11),
+              (16.62, 10.52))
+
+    def __init__(self, num_classes: int = 20, height: int = 416,
+                 width: int = 416, channels: int = 3, seed: int = 123,
+                 updater: IUpdater | None = None,
+                 boxes: Tuple[Tuple[float, float], ...] | None = None):
+        self.num_classes = num_classes
+        self.height, self.width, self.channels = height, width, channels
+        self.seed = seed
+        self.updater = updater or Adam(learning_rate=1e-3)
+        self.boxes = boxes or self.PRIORS
+
+    def conf(self) -> ComputationGraphConfiguration:
+        from deeplearning4j_tpu.conf.layers_objdetect import Yolo2OutputLayer
+
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed).updater(self.updater)
+             .weight_init(WeightInit.XAVIER)
+             .graph_builder()
+             .add_inputs("input")
+             .set_input_types(InputType.convolutional(
+                 self.height, self.width, self.channels)))
+
+        def cbl(name, n, inp):  # conv + BN + leaky relu
+            g.add_layer(name, _conv(n, (3, 3), act=Activation.IDENTITY,
+                                    bias=False), inp)
+            g.add_layer(name + "_bn", BatchNormalization(
+                activation=Activation.LEAKYRELU), name)
+            return name + "_bn"
+
+        p = cbl("c1", 16, "input")
+        for i, n in enumerate((32, 64, 128, 256, 512)):
+            g.add_layer(f"p{i + 1}", _maxpool((2, 2), (2, 2)), p)
+            p = cbl(f"c{i + 2}", n, f"p{i + 1}")
+        # final pool is stride-1 SAME in tiny-yolo (keeps 13x13)
+        g.add_layer("p6", _maxpool((2, 2), (1, 1), ConvolutionMode.SAME), p)
+        p = cbl("c7", 1024, "p6")
+        p = cbl("c8", 1024, p)
+        nb = len(self.boxes)
+        g.add_layer("detect", _conv(nb * (5 + self.num_classes), (1, 1),
+                                    act=Activation.IDENTITY), p)
+        g.add_layer("yolo", Yolo2OutputLayer(boxes=tuple(self.boxes)),
+                    "detect")
+        g.set_outputs("yolo")
+        return g.build()
+
+
+class YOLO2(GraphZooModel):
+    """Reference ``org.deeplearning4j.zoo.model.YOLO2``: Darknet-19 backbone
+    with the passthrough route — the 26x26x512 feature map goes through a
+    1x1x64 conv and ``SpaceToDepth(2)`` then concats with the 13x13x1024
+    head before the detection conv (reference wiring via the same
+    vertices)."""
+
+    PRIORS = ((0.57273, 0.677385), (1.87446, 2.06253), (3.33843, 5.47434),
+              (7.88282, 3.52778), (9.77052, 9.16828))
+
+    def __init__(self, num_classes: int = 80, height: int = 416,
+                 width: int = 416, channels: int = 3, seed: int = 123,
+                 updater: IUpdater | None = None,
+                 boxes: Tuple[Tuple[float, float], ...] | None = None):
+        self.num_classes = num_classes
+        self.height, self.width, self.channels = height, width, channels
+        self.seed = seed
+        self.updater = updater or Adam(learning_rate=1e-3)
+        self.boxes = boxes or self.PRIORS
+
+    def conf(self) -> ComputationGraphConfiguration:
+        from deeplearning4j_tpu.conf.layers_cnn import SpaceToDepthLayer
+        from deeplearning4j_tpu.conf.layers_objdetect import Yolo2OutputLayer
+
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed).updater(self.updater)
+             .weight_init(WeightInit.XAVIER)
+             .graph_builder()
+             .add_inputs("input")
+             .set_input_types(InputType.convolutional(
+                 self.height, self.width, self.channels)))
+
+        def cbl(name, n, k, inp):
+            g.add_layer(name, _conv(n, k, act=Activation.IDENTITY,
+                                    bias=False), inp)
+            g.add_layer(name + "_bn", BatchNormalization(
+                activation=Activation.LEAKYRELU), name)
+            return name + "_bn"
+
+        # darknet-19 trunk
+        p = cbl("c1", 32, (3, 3), "input")
+        g.add_layer("p1", _maxpool((2, 2), (2, 2)), p)
+        p = cbl("c2", 64, (3, 3), "p1")
+        g.add_layer("p2", _maxpool((2, 2), (2, 2)), p)
+        p = cbl("c3", 128, (3, 3), "p2")
+        p = cbl("c4", 64, (1, 1), p)
+        p = cbl("c5", 128, (3, 3), p)
+        g.add_layer("p3", _maxpool((2, 2), (2, 2)), p)
+        p = cbl("c6", 256, (3, 3), "p3")
+        p = cbl("c7", 128, (1, 1), p)
+        p = cbl("c8", 256, (3, 3), p)
+        g.add_layer("p4", _maxpool((2, 2), (2, 2)), p)
+        p = cbl("c9", 512, (3, 3), "p4")
+        p = cbl("c10", 256, (1, 1), p)
+        p = cbl("c11", 512, (3, 3), p)
+        p = cbl("c12", 256, (1, 1), p)
+        route = cbl("c13", 512, (3, 3), p)  # 26x26x512 passthrough source
+        g.add_layer("p5", _maxpool((2, 2), (2, 2)), route)
+        p = cbl("c14", 1024, (3, 3), "p5")
+        p = cbl("c15", 512, (1, 1), p)
+        p = cbl("c16", 1024, (3, 3), p)
+        p = cbl("c17", 512, (1, 1), p)
+        p = cbl("c18", 1024, (3, 3), p)
+        p = cbl("c19", 1024, (3, 3), p)
+        p = cbl("c20", 1024, (3, 3), p)
+        # passthrough: 26x26x512 -> 1x1x64 -> space-to-depth -> 13x13x256
+        r = cbl("route_conv", 64, (1, 1), route)
+        g.add_layer("route_s2d", SpaceToDepthLayer(block_size=2), r)
+        g.add_vertex("concat", MergeVertex(), "route_s2d", p)
+        p = cbl("c21", 1024, (3, 3), "concat")
+        nb = len(self.boxes)
+        g.add_layer("detect", _conv(nb * (5 + self.num_classes), (1, 1),
+                                    act=Activation.IDENTITY), p)
+        g.add_layer("yolo", Yolo2OutputLayer(boxes=tuple(self.boxes)),
+                    "detect")
+        g.set_outputs("yolo")
+        return g.build()
+
+
+class NASNet(GraphZooModel):
+    """Reference ``org.deeplearning4j.zoo.model.NASNet`` (NASNet-A mobile
+    schema): stem conv, alternating stacks of NORMAL cells separated by
+    REDUCTION cells, each cell the NASNet-A 5-block DAG over (h, h_prev)
+    with separable convs / average pools / identities, 1x1 squeeze
+    adjustments on both inputs, block outputs concatenated."""
+
+    def __init__(self, num_classes: int = 1000, height: int = 224,
+                 width: int = 224, channels: int = 3, seed: int = 123,
+                 updater: IUpdater | None = None,
+                 penultimate_filters: int = 1056, num_cells: int = 4):
+        self.num_classes = num_classes
+        self.height, self.width, self.channels = height, width, channels
+        self.seed = seed
+        self.updater = updater or Adam(learning_rate=1e-3)
+        # NASNet-A (N @ P): filters per normal cell = P / 24 * 4
+        self.filters = max(penultimate_filters // 24, 8)
+        self.num_cells = num_cells
+
+    def conf(self) -> ComputationGraphConfiguration:
+        from deeplearning4j_tpu.conf.layers_cnn import SeparableConvolution2D
+
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed).updater(self.updater)
+             .weight_init(WeightInit.XAVIER)
+             .graph_builder()
+             .add_inputs("input")
+             .set_input_types(InputType.convolutional(
+                 self.height, self.width, self.channels)))
+
+        def sep(name, n, k, s, inp):
+            g.add_layer(name + "_r",
+                        ActivationLayer(activation=Activation.RELU), inp)
+            g.add_layer(name, SeparableConvolution2D(
+                n_out=n, kernel_size=k, stride=s,
+                activation=Activation.IDENTITY,
+                convolution_mode=ConvolutionMode.SAME), name + "_r")
+            g.add_layer(name + "_bn", BatchNormalization(), name)
+            return name + "_bn"
+
+        def squeeze(name, n, s, inp):
+            g.add_layer(name, _conv(n, (1, 1), s, act=Activation.IDENTITY,
+                                    mode=ConvolutionMode.SAME), inp)
+            g.add_layer(name + "_bn", BatchNormalization(), name)
+            return name + "_bn"
+
+        def avg3(name, s, inp):
+            g.add_layer(name, SubsamplingLayer(
+                pooling_type=PoolingType.AVG, kernel_size=(3, 3), stride=s,
+                convolution_mode=ConvolutionMode.SAME), inp)
+            return name
+
+        def add(name, a, b):
+            g.add_vertex(name, ElementWiseVertex(op=ElementWiseOp.ADD), a, b)
+            return name
+
+        def normal_cell(cid, h, h_prev, f, prev_stride=(1, 1)):
+            # adjust both inputs to f channels (reference squeeze/adjust);
+            # right after a reduction cell h_prev still has the pre-reduction
+            # spatial size, so its adjust runs at stride 2 (the reference's
+            # factorized-reduction adjust block)
+            h = squeeze(f"{cid}_adj", f, (1, 1), h)
+            hp = squeeze(f"{cid}_adjp", f, prev_stride, h_prev)
+            b1 = add(f"{cid}_b1", sep(f"{cid}_b1s", f, (3, 3), (1, 1), h), h)
+            b2 = add(f"{cid}_b2",
+                     sep(f"{cid}_b2a", f, (3, 3), (1, 1), hp),
+                     sep(f"{cid}_b2b", f, (5, 5), (1, 1), h))
+            b3 = add(f"{cid}_b3", avg3(f"{cid}_b3p", (1, 1), h), hp)
+            b4 = add(f"{cid}_b4", avg3(f"{cid}_b4a", (1, 1), hp),
+                     avg3(f"{cid}_b4b", (1, 1), hp))
+            b5 = add(f"{cid}_b5",
+                     sep(f"{cid}_b5a", f, (5, 5), (1, 1), hp),
+                     sep(f"{cid}_b5b", f, (3, 3), (1, 1), hp))
+            g.add_vertex(f"{cid}_out", MergeVertex(), b1, b2, b3, b4, b5)
+            return f"{cid}_out"
+
+        def reduction_cell(cid, h, h_prev, f):
+            h = squeeze(f"{cid}_adj", f, (1, 1), h)
+            hp = squeeze(f"{cid}_adjp", f, (1, 1), h_prev)
+            b1 = add(f"{cid}_b1",
+                     sep(f"{cid}_b1a", f, (5, 5), (2, 2), hp),
+                     sep(f"{cid}_b1b", f, (7, 7), (2, 2), h))
+            g.add_layer(f"{cid}_b2m", _maxpool((3, 3), (2, 2),
+                                               ConvolutionMode.SAME), h)
+            b2 = add(f"{cid}_b2", f"{cid}_b2m",
+                     sep(f"{cid}_b2s", f, (7, 7), (2, 2), hp))
+            b3 = add(f"{cid}_b3", avg3(f"{cid}_b3a", (2, 2), h),
+                     sep(f"{cid}_b3s", f, (5, 5), (2, 2), hp))
+            b4 = add(f"{cid}_b4", avg3(f"{cid}_b4a", (1, 1), b1),
+                     f"{cid}_b2m")
+            b5 = add(f"{cid}_b5", sep(f"{cid}_b5s", f, (3, 3), (1, 1), b1),
+                     avg3(f"{cid}_b5a", (2, 2), h))
+            g.add_vertex(f"{cid}_out", MergeVertex(), b2, b3, b4, b5)
+            return f"{cid}_out"
+
+        f = self.filters
+        g.add_layer("stem", _conv(f, (3, 3), (2, 2),
+                                  act=Activation.IDENTITY,
+                                  mode=ConvolutionMode.SAME), "input")
+        g.add_layer("stem_bn", BatchNormalization(), "stem")
+        h_prev, h = "stem_bn", "stem_bn"
+        cid = 0
+        for stack in range(3):
+            for ci in range(self.num_cells):
+                stride_prev = (2, 2) if stack > 0 and ci == 0 else (1, 1)
+                out = normal_cell(f"n{cid}", h, h_prev, f,
+                                  prev_stride=stride_prev)
+                h_prev, h = h, out
+                cid += 1
+            if stack < 2:
+                f *= 2
+                out = reduction_cell(f"r{stack}", h, h_prev, f)
+                h_prev, h = h, out
+        g.add_layer("final_relu", ActivationLayer(
+            activation=Activation.RELU), h)
+        g.add_layer("gap", GlobalPoolingLayer(pooling_type=PoolingType.AVG),
+                    "final_relu")
+        g.add_layer("output", OutputLayer(n_out=self.num_classes,
+                                          activation=Activation.SOFTMAX,
+                                          loss_fn=LossMCXENT()), "gap")
+        g.set_outputs("output")
+        return g.build()

@@ -118,3 +118,101 @@ class SimpleCNN(ZooModel):
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
         return MultiLayerNetwork(self.conf()).init()
+
+
+class AlexNet(ZooModel):
+    """Reference ``org.deeplearning4j.zoo.model.AlexNet``: conv11x11/4(96)
+    -> LRN -> maxpool3/2 -> conv5x5(256) -> LRN -> maxpool -> conv3x3(384)
+    x2 -> conv3x3(256) -> maxpool -> FC 4096 x2 (dropout 0.5) -> softmax."""
+
+    def __init__(self, num_classes: int = 1000, height: int = 224,
+                 width: int = 224, channels: int = 3, seed: int = 123,
+                 updater: IUpdater | None = None):
+        self.num_classes = num_classes
+        self.height, self.width, self.channels = height, width, channels
+        self.seed = seed
+        self.updater = updater or Nesterovs(learning_rate=1e-2, momentum=0.9)
+
+    def conf(self) -> MultiLayerConfiguration:
+        from deeplearning4j_tpu.conf.layers_cnn import (
+            LocalResponseNormalization,
+        )
+
+        conv = lambda n, k, s=(1, 1): ConvolutionLayer(  # noqa: E731
+            n_out=n, kernel_size=k, stride=s, activation=Activation.RELU,
+            convolution_mode=ConvolutionMode.SAME)
+        pool = lambda: SubsamplingLayer(  # noqa: E731
+            pooling_type=PoolingType.MAX, kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.TRUNCATE)
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed)
+                .updater(self.updater)
+                .weight_init(WeightInit.NORMAL)
+                .list()
+                .layer(ConvolutionLayer(
+                    n_out=96, kernel_size=(11, 11), stride=(4, 4),
+                    activation=Activation.RELU,
+                    convolution_mode=ConvolutionMode.TRUNCATE))
+                .layer(LocalResponseNormalization())
+                .layer(pool())
+                .layer(conv(256, (5, 5)))
+                .layer(LocalResponseNormalization())
+                .layer(pool())
+                .layer(conv(384, (3, 3)))
+                .layer(conv(384, (3, 3)))
+                .layer(conv(256, (3, 3)))
+                .layer(pool())
+                .layer(DenseLayer(n_out=4096, activation=Activation.RELU,
+                                  dropout=0.5))
+                .layer(DenseLayer(n_out=4096, activation=Activation.RELU,
+                                  dropout=0.5))
+                .layer(OutputLayer(n_out=self.num_classes,
+                                   activation=Activation.SOFTMAX,
+                                   loss_fn=LossMCXENT()))
+                .set_input_type(InputType.convolutional(
+                    self.height, self.width, self.channels))
+                .build())
+
+    def init(self):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        return MultiLayerNetwork(self.conf()).init()
+
+
+class TextGenerationLSTM(ZooModel):
+    """Reference ``org.deeplearning4j.zoo.model.TextGenerationLSTM``:
+    LSTM(256) x2 + RnnOutputLayer(MCXENT) over a character vocabulary,
+    trained on one-hot sequences (tBPTT-friendly)."""
+
+    def __init__(self, total_unique_characters: int = 47,
+                 max_length: int = 40, layer_size: int = 256,
+                 seed: int = 123, updater: IUpdater | None = None):
+        self.vocab = total_unique_characters
+        self.max_length = max_length
+        self.layer_size = layer_size
+        self.seed = seed
+        self.updater = updater or Adam(learning_rate=1e-3)
+
+    def conf(self) -> MultiLayerConfiguration:
+        from deeplearning4j_tpu.conf.layers_rnn import LSTM, RnnOutputLayer
+
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed)
+                .updater(self.updater)
+                .weight_init(WeightInit.XAVIER)
+                .list()
+                .layer(LSTM(n_out=self.layer_size,
+                            activation=Activation.TANH))
+                .layer(LSTM(n_out=self.layer_size,
+                            activation=Activation.TANH))
+                .layer(RnnOutputLayer(n_out=self.vocab,
+                                      activation=Activation.SOFTMAX,
+                                      loss_fn=LossMCXENT()))
+                .set_input_type(InputType.recurrent(
+                    self.vocab, timesteps=self.max_length))
+                .build())
+
+    def init(self):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        return MultiLayerNetwork(self.conf()).init()

@@ -1,0 +1,154 @@
+"""YOLO2 output layer + detection utils (reference:
+``YoloGradientCheckTests``, ``TestYolo2OutputLayer``, YoloUtils tests)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu import serde
+from deeplearning4j_tpu.conf.layers_objdetect import (
+    DetectedObject,
+    Yolo2OutputLayer,
+    get_predicted_objects,
+    iou,
+    nms,
+)
+
+PRIORS = ((1.0, 1.5), (3.0, 3.0))
+
+
+def _layer():
+    return Yolo2OutputLayer(boxes=PRIORS)
+
+
+def _label_grid(b=2, h=4, w=4, c=3):
+    """One object per example: box in cell (1,2) [x from 2..3, y 1..2]."""
+    labels = np.zeros((b, h, w, 4 + c), np.float32)
+    labels[:, 1, 2, 0:4] = [2.1, 1.2, 2.9, 1.9]  # x1,y1,x2,y2 grid units
+    labels[:, 1, 2, 4] = 1.0  # class 0
+    return labels
+
+
+def test_shapes_and_activation():
+    layer = _layer()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 4, 4, 2 * (5 + 3))), jnp.float32)
+    y, _ = layer.forward({}, {}, x)
+    assert y.shape == (2, 4, 4, 2 * (5 + 3))
+    grid = np.asarray(y).reshape(2, 4, 4, 2, 8)
+    # centers are inside their cells, confidences in (0,1), probs sum to 1
+    cx = grid[..., 0]
+    assert (cx >= 0).all() and (cx <= 4).all()
+    conf = grid[..., 4]
+    assert (conf > 0).all() and (conf < 1).all()
+    np.testing.assert_allclose(grid[..., 5:].sum(-1), 1.0, rtol=1e-5)
+
+
+def test_loss_finite_and_differentiable():
+    layer = _layer()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 4, 4, 16)) * 0.1, jnp.float32)
+    labels = jnp.asarray(_label_grid())
+
+    def loss(x):
+        return layer.score({}, x, labels)
+
+    val, grad = jax.value_and_grad(loss)(x)
+    assert np.isfinite(float(val))
+    assert np.isfinite(np.asarray(grad)).all()
+    assert float(jnp.abs(grad).sum()) > 0
+
+
+def test_loss_decreases_under_training():
+    from deeplearning4j_tpu.conf import Activation, InputType, WeightInit
+    from deeplearning4j_tpu.conf.graph import ComputationGraphConfiguration
+    from deeplearning4j_tpu.conf.layers_cnn import ConvolutionLayer, ConvolutionMode
+    from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+    from deeplearning4j_tpu.conf.updaters import Adam
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    g = (NeuralNetConfiguration.builder()
+         .seed(1).updater(Adam(1e-2)).weight_init(WeightInit.XAVIER)
+         .graph_builder()
+         .add_inputs("input")
+         .set_input_types(InputType.convolutional(16, 16, 3)))
+    g.add_layer("c1", ConvolutionLayer(
+        n_out=16, kernel_size=(3, 3), stride=(2, 2),
+        activation=Activation.RELU,
+        convolution_mode=ConvolutionMode.SAME), "input")
+    g.add_layer("c2", ConvolutionLayer(
+        n_out=16, kernel_size=(3, 3), stride=(2, 2),
+        activation=Activation.RELU,
+        convolution_mode=ConvolutionMode.SAME), "c1")
+    g.add_layer("detect", ConvolutionLayer(
+        n_out=2 * (5 + 3), kernel_size=(1, 1),
+        activation=Activation.IDENTITY,
+        convolution_mode=ConvolutionMode.SAME), "c2")
+    g.add_layer("yolo", Yolo2OutputLayer(boxes=PRIORS), "detect")
+    g.set_outputs("yolo")
+    net = ComputationGraph(g.build()).init()
+
+    rng = np.random.default_rng(0)
+    feats = rng.normal(size=(2, 16, 16, 3)).astype(np.float32)
+    ds = DataSet(feats, _label_grid(b=2, h=4, w=4, c=3))
+    s0 = net.fit_batch(ds)
+    for _ in range(30):
+        s1 = net.fit_batch(ds)
+    assert s1 < s0
+
+
+def test_get_predicted_objects_and_nms():
+    layer = _layer()
+    # hand-build an activated grid: [b,h,w,nb,(5+C)]
+    act = np.zeros((1, 4, 4, 2, 8), np.float32)
+    # strong detection at cell (1,2), anchor 0, class 1
+    act[0, 1, 2, 0] = [2.5, 1.5, 1.0, 1.0, 0.9, 0.05, 0.9, 0.05]
+    # overlapping weaker detection, same class -> NMS suppressed
+    act[0, 1, 2, 1] = [2.6, 1.4, 1.2, 1.2, 0.6, 0.05, 0.9, 0.05]
+    # distant detection, other class -> kept
+    act[0, 3, 0, 0] = [0.5, 3.5, 1.0, 1.0, 0.8, 0.9, 0.05, 0.05]
+    objs = get_predicted_objects(layer, act.reshape(1, 4, 4, 16),
+                                 threshold=0.4)
+    assert len(objs) == 3
+    kept = nms(objs, iou_threshold=0.4)
+    assert len(kept) == 2
+    classes = sorted(o.predicted_class for o in kept)
+    assert classes == [0, 1]
+
+
+def test_iou_math():
+    a = DetectedObject(0, 1.0, 1.0, 2.0, 2.0, 0, 1.0)
+    b = DetectedObject(0, 1.0, 1.0, 2.0, 2.0, 0, 1.0)
+    assert iou(a, b) == pytest.approx(1.0)
+    c = DetectedObject(0, 10.0, 10.0, 2.0, 2.0, 0, 1.0)
+    assert iou(a, c) == 0.0
+
+
+def test_serde_roundtrip():
+    layer = _layer()
+    back = serde.from_json(serde.to_json(layer))
+    assert back == layer
+    assert back.boxes == PRIORS
+
+
+def test_bad_depth_raises():
+    layer = _layer()
+    x = jnp.zeros((1, 4, 4, 15))  # not divisible by nb*(5+C)
+    with pytest.raises(ValueError):
+        layer.forward({}, {}, x)
+
+
+def test_mask_excludes_padded_examples():
+    layer = _layer()
+    rng = np.random.default_rng(0)
+    x1 = jnp.asarray(rng.normal(size=(2, 4, 4, 16)) * 0.1, jnp.float32)
+    labels = jnp.asarray(_label_grid(b=2))
+    # pad with a garbage third example, mask it out
+    x2 = jnp.concatenate([x1, jnp.ones((1, 4, 4, 16)) * 5.0])
+    labels2 = jnp.concatenate([labels, jnp.zeros((1, 4, 4, 7))])
+    mask = jnp.asarray([1.0, 1.0, 0.0])
+    unmasked = layer.score({}, x1, labels)
+    masked = layer.score({}, x2, labels2, mask=mask)
+    np.testing.assert_allclose(float(unmasked), float(masked), rtol=1e-6)

@@ -286,3 +286,88 @@ def test_transfer_net_serializes(tmp_path):
     assert isinstance(restored.conf.layers[0], FrozenLayer)
     np.testing.assert_allclose(restored.params_flat(), t_net.params_flat(),
                                rtol=1e-6)
+
+
+def test_early_stopping_validates_config():
+    net = MultiLayerNetwork(_conf())
+    ds = _data()
+    it = ArrayDataSetIterator(ds.features, ds.labels, batch=16)
+    with pytest.raises(ValueError):
+        EarlyStoppingTrainer(EarlyStoppingConfiguration(), net, it).fit()
+
+
+def test_early_stopping_conditions_reset_between_fits():
+    ds = _data()
+    cfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[
+            ScoreImprovementEpochTerminationCondition(1),
+            MaxEpochsTerminationCondition(10)],
+        score_calculator=DataSetLossCalculator(
+            ArrayDataSetIterator(ds.features, ds.labels, batch=16)))
+    for _ in range(2):  # reusing cfg must not carry _best/_bad over
+        net = MultiLayerNetwork(_conf(updater=Sgd(0.0)))
+        it = ArrayDataSetIterator(ds.features, ds.labels, batch=16)
+        result = EarlyStoppingTrainer(cfg, net, it).fit()
+        assert result.total_epochs >= 2  # epoch 0 eval + at least 1 more
+
+
+def test_early_stopping_eval_frequency_respects_patience():
+    ds = _data()
+    net = MultiLayerNetwork(_conf(updater=Sgd(0.0)))
+    it = ArrayDataSetIterator(ds.features, ds.labels, batch=16)
+    cfg = EarlyStoppingConfiguration(
+        evaluate_every_n_epochs=3,
+        epoch_termination_conditions=[
+            ScoreImprovementEpochTerminationCondition(1),
+            MaxEpochsTerminationCondition(30)],
+        score_calculator=DataSetLossCalculator(
+            ArrayDataSetIterator(ds.features, ds.labels, batch=16)))
+    result = EarlyStoppingTrainer(cfg, net, it).fit()
+    # evaluations at epochs 0,3,6: patience 1 -> stop on the 3rd eval
+    # (epoch 6), NOT at epoch 1 from stale-score checks
+    assert result.total_epochs == 7
+
+
+def test_checkpoint_numbering_survives_retention_restart(tmp_path):
+    net = MultiLayerNetwork(_conf())
+    net.init()
+    ds = _data()
+    cl = CheckpointListener(str(tmp_path), save_every_n_iterations=1,
+                            keep_last=2)
+    net.set_listeners(cl)
+    for _ in range(5):
+        net.fit_batch(ds)
+    # restart a new listener in the same directory
+    cl2 = CheckpointListener(str(tmp_path), save_every_n_iterations=1,
+                             keep_last=2)
+    net.set_listeners(cl2)
+    net.fit_batch(ds)
+    nums = [c.number for c in cl2.list_checkpoints()]
+    assert len(nums) == len(set(nums))  # no duplicate numbers
+    assert max(nums) == 5
+
+
+def test_graph_model_savers(tmp_path):
+    from deeplearning4j_tpu.conf.graph import ComputationGraphConfiguration
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    g = (NeuralNetConfiguration.builder()
+         .seed(1).updater(Adam(1e-2))
+         .graph_builder()
+         .add_inputs("in")
+         .set_input_types(InputType.feed_forward(4)))
+    g.add_layer("d", DenseLayer(n_out=8, activation=Activation.TANH), "in")
+    g.add_layer("out", OutputLayer(n_out=3, activation=Activation.SOFTMAX,
+                                   loss_fn=LossMCXENT()), "d")
+    g.set_outputs("out")
+    net = ComputationGraph(g.build()).init()
+    ds = _data()
+    it = ArrayDataSetIterator(ds.features, ds.labels, batch=16)
+    cfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(2)],
+        score_calculator=DataSetLossCalculator(
+            ArrayDataSetIterator(ds.features, ds.labels, batch=16)),
+        model_saver=LocalFileModelSaver(str(tmp_path)))
+    result = EarlyStoppingTrainer(cfg, net, it).fit()
+    best = result.get_best_model()
+    assert type(best).__name__ == "ComputationGraph"

@@ -93,3 +93,62 @@ class TestGraphZoo:
                             32, 32, 1)
         assert out.shape == (2, 32, 32, 1)
         assert (out >= 0).all() and (out <= 1).all()  # sigmoid head
+
+
+class TestNewZooModels:
+    def test_alexnet(self):
+        from deeplearning4j_tpu.zoo.models import AlexNet
+
+        net, out = _forward(AlexNet(num_classes=7, height=64, width=64),
+                            64, 64, 3)
+        assert out.shape == (2, 7)
+
+    def test_text_generation_lstm(self):
+        from deeplearning4j_tpu.zoo.models import TextGenerationLSTM
+
+        net = TextGenerationLSTM(total_unique_characters=30,
+                                 max_length=12).init()
+        x = np.random.default_rng(0).normal(size=(2, 12, 30)).astype(
+            np.float32)
+        out = np.asarray(net.output(x))
+        assert out.shape == (2, 12, 30)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+    def test_xception(self):
+        from deeplearning4j_tpu.zoo.graphs import Xception
+
+        net, out = _forward(Xception(num_classes=5, height=71, width=71,
+                                     middle_flow_repeats=1), 71, 71, 3)
+        assert out.shape == (2, 5)
+
+    def test_inception_resnet_v1(self):
+        from deeplearning4j_tpu.zoo.graphs import InceptionResNetV1
+
+        net, out = _forward(InceptionResNetV1(
+            num_classes=5, height=96, width=96, blocks_a=1, blocks_b=1,
+            blocks_c=1), 96, 96, 3)
+        assert out.shape == (2, 5)
+
+    def test_tiny_yolo(self):
+        from deeplearning4j_tpu.zoo.graphs import TinyYOLO
+
+        net, out = _forward(TinyYOLO(num_classes=3, height=64, width=64),
+                            64, 64, 3)
+        # 64/32 = 2x2 grid, 5 anchors * (5+3) = 40 channels
+        assert out.shape == (2, 2, 2, 40)
+
+    def test_yolo2_passthrough(self):
+        from deeplearning4j_tpu.zoo.graphs import YOLO2
+
+        net, out = _forward(YOLO2(num_classes=3, height=64, width=64),
+                            64, 64, 3)
+        assert out.shape == (2, 2, 2, 40)
+        assert "route_s2d" in net.conf.topo_order()
+
+    def test_nasnet(self):
+        from deeplearning4j_tpu.zoo.graphs import NASNet
+
+        net, out = _forward(NASNet(num_classes=5, height=32, width=32,
+                                   num_cells=1, penultimate_filters=96),
+                            32, 32, 3)
+        assert out.shape == (2, 5)
