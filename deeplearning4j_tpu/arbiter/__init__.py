@@ -1,0 +1,319 @@
+"""Arbiter — hyperparameter optimization.
+
+Reference: ``arbiter/`` (``org.deeplearning4j.arbiter.optimize``) —
+parameter spaces over the config DSL, ``RandomSearchGenerator`` /
+``GridSearchCandidateGenerator``, score functions, termination conditions,
+``LocalOptimizationRunner`` (SURVEY.md §2.2 L7).
+
+TPU-native shape: a ``MultiLayerSpace`` is a plain builder FUNCTION from
+sampled hyperparameters to a ``MultiLayerConfiguration`` (configs are data,
+so the space composes with everything else); the runner trains each
+candidate with the normal jitted path and returns an ``OptimizationResult``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Parameter spaces (reference org.deeplearning4j.arbiter.optimize.parameter)
+# ---------------------------------------------------------------------------
+
+class ParameterSpace:
+    def sample(self, rng: np.random.Generator):
+        raise NotImplementedError
+
+    def grid(self, points: int) -> List:
+        raise NotImplementedError
+
+
+class ContinuousParameterSpace(ParameterSpace):
+    """Uniform (or log-uniform) float range (reference class of the same
+    name)."""
+
+    def __init__(self, min_value: float, max_value: float,
+                 log_scale: bool = False):
+        self.lo, self.hi = float(min_value), float(max_value)
+        self.log_scale = log_scale
+
+    def sample(self, rng):
+        if self.log_scale:
+            return float(np.exp(rng.uniform(np.log(self.lo),
+                                            np.log(self.hi))))
+        return float(rng.uniform(self.lo, self.hi))
+
+    def grid(self, points):
+        if self.log_scale:
+            return list(np.exp(np.linspace(np.log(self.lo), np.log(self.hi),
+                                           points)))
+        return list(np.linspace(self.lo, self.hi, points))
+
+
+class IntegerParameterSpace(ParameterSpace):
+    def __init__(self, min_value: int, max_value: int):
+        self.lo, self.hi = int(min_value), int(max_value)
+
+    def sample(self, rng):
+        return int(rng.integers(self.lo, self.hi + 1))
+
+    def grid(self, points):
+        return sorted({int(round(v)) for v in
+                       np.linspace(self.lo, self.hi, points)})
+
+
+class DiscreteParameterSpace(ParameterSpace):
+    def __init__(self, *values):
+        self.values = list(values[0]) if len(values) == 1 and isinstance(
+            values[0], (list, tuple)) else list(values)
+
+    def sample(self, rng):
+        return self.values[int(rng.integers(0, len(self.values)))]
+
+    def grid(self, points):
+        return list(self.values)
+
+
+class BooleanSpace(DiscreteParameterSpace):
+    def __init__(self):
+        super().__init__(True, False)
+
+
+class FixedValue(ParameterSpace):
+    def __init__(self, value):
+        self.value = value
+
+    def sample(self, rng):
+        return self.value
+
+    def grid(self, points):
+        return [self.value]
+
+
+# ---------------------------------------------------------------------------
+# Candidate generators
+# ---------------------------------------------------------------------------
+
+class CandidateGenerator:
+    def __init__(self, spaces: Dict[str, ParameterSpace]):
+        self.spaces = dict(spaces)
+
+    def candidates(self):
+        raise NotImplementedError
+
+
+class RandomSearchGenerator(CandidateGenerator):
+    """Reference ``RandomSearchGenerator``: i.i.d. samples from every
+    space; infinite stream (bounded by termination conditions)."""
+
+    def __init__(self, spaces, seed: int = 42):
+        super().__init__(spaces)
+        self.rng = np.random.default_rng(seed)
+
+    def candidates(self):
+        while True:
+            yield {k: s.sample(self.rng) for k, s in self.spaces.items()}
+
+
+class GridSearchCandidateGenerator(CandidateGenerator):
+    """Reference ``GridSearchCandidateGenerator``: cartesian product with
+    ``discretization_count`` points per continuous axis."""
+
+    def __init__(self, spaces, discretization_count: int = 5):
+        super().__init__(spaces)
+        self.points = int(discretization_count)
+
+    def candidates(self):
+        keys = list(self.spaces)
+        axes = [self.spaces[k].grid(self.points) for k in keys]
+        for combo in itertools.product(*axes):
+            yield dict(zip(keys, combo))
+
+
+# ---------------------------------------------------------------------------
+# Score functions (reference org.deeplearning4j.arbiter.scoring)
+# ---------------------------------------------------------------------------
+
+class ScoreFunction:
+    minimize = True
+
+    def score(self, net, data_provider) -> float:
+        raise NotImplementedError
+
+
+class DataSetLossScoreFunction(ScoreFunction):
+    """Average test-set loss; lower is better."""
+
+    minimize = True
+
+    def score(self, net, data_provider):
+        it = data_provider.test_data()
+        total, n = 0.0, 0
+        for ds in it:
+            total += float(net.score(ds)) * ds.num_examples()
+            n += ds.num_examples()
+        it.reset()
+        return total / max(n, 1)
+
+
+class EvaluationScoreFunction(ScoreFunction):
+    """Classification metric (accuracy/f1); higher is better."""
+
+    minimize = False
+
+    def __init__(self, metric: str = "accuracy"):
+        self.metric = metric
+
+    def score(self, net, data_provider):
+        it = data_provider.test_data()
+        ev = net.evaluate(it)
+        it.reset()
+        return float(getattr(ev, self.metric)())
+
+
+class DataSetIteratorProvider:
+    """Reference ``DataProvider``: train/test iterators per candidate."""
+
+    def __init__(self, train_iterator, test_iterator):
+        self._train = train_iterator
+        self._test = test_iterator
+
+    def train_data(self):
+        self._train.reset()
+        return self._train
+
+    def test_data(self):
+        self._test.reset()
+        return self._test
+
+
+# ---------------------------------------------------------------------------
+# Termination + runner
+# ---------------------------------------------------------------------------
+
+class MaxCandidatesCondition:
+    def __init__(self, n: int):
+        self.n = int(n)
+
+    def terminate(self, n_done: int, start_time: float) -> bool:
+        return n_done >= self.n
+
+
+class MaxTimeCondition:
+    def __init__(self, seconds: float):
+        self.seconds = float(seconds)
+
+    def terminate(self, n_done, start_time):
+        return time.monotonic() - start_time > self.seconds
+
+
+class CandidateResult:
+    def __init__(self, index: int, values: dict, score: float, model,
+                 exception: Optional[BaseException] = None):
+        self.index = index
+        self.values = values
+        self.score = score
+        self.model = model
+        self.exception = exception
+
+
+class OptimizationResult:
+    def __init__(self, best: CandidateResult,
+                 results: List[CandidateResult]):
+        self.best = best
+        self.results = results
+
+    def best_score(self) -> float:
+        return self.best.score
+
+    def best_values(self) -> dict:
+        return self.best.values
+
+    def best_model(self):
+        return self.best.model
+
+
+class OptimizationConfiguration:
+    """Reference ``OptimizationConfiguration.Builder``."""
+
+    def __init__(self, candidate_generator: CandidateGenerator,
+                 data_provider: DataSetIteratorProvider,
+                 score_function: ScoreFunction,
+                 termination_conditions: Sequence,
+                 epochs_per_candidate: int = 1):
+        if not termination_conditions:
+            raise ValueError("at least one termination condition required "
+                             "(e.g. MaxCandidatesCondition)")
+        self.generator = candidate_generator
+        self.data_provider = data_provider
+        self.score_function = score_function
+        self.terminations = list(termination_conditions)
+        self.epochs = int(epochs_per_candidate)
+
+
+class LocalOptimizationRunner:
+    """Reference ``LocalOptimizationRunner``: sequential candidate training
+    (each candidate is one whole-graph compile + fit on the chip; arbiter's
+    thread pool would just contend for it)."""
+
+    def __init__(self, config: OptimizationConfiguration,
+                 model_builder: Callable[..., object]):
+        """``model_builder(**hyperparams)`` returns an UN-initialized
+        MultiLayerNetwork/ComputationGraph or a configuration with an
+        ``init``-able wrapper (the reference's ``MultiLayerSpace``
+        candidate)."""
+        self.config = config
+        self.model_builder = model_builder
+
+    def _materialize(self, values: dict):
+        from deeplearning4j_tpu.conf.graph import ComputationGraphConfiguration
+        from deeplearning4j_tpu.conf.multilayer import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        built = self.model_builder(**values)
+        if isinstance(built, MultiLayerConfiguration):
+            built = MultiLayerNetwork(built)
+        elif isinstance(built, ComputationGraphConfiguration):
+            built = ComputationGraph(built)
+        if getattr(built, "params", 1) is None:
+            built.init()
+        return built
+
+    def execute(self) -> OptimizationResult:
+        cfg = self.config
+        results: List[CandidateResult] = []
+        start = time.monotonic()
+        best: Optional[CandidateResult] = None
+        sign = 1.0 if cfg.score_function.minimize else -1.0
+        for i, values in enumerate(cfg.generator.candidates()):
+            if any(t.terminate(len(results), start)
+                   for t in cfg.terminations):
+                break
+            try:
+                net = self._materialize(values)
+                net.fit(cfg.data_provider.train_data(), epochs=cfg.epochs)
+                score = cfg.score_function.score(net, cfg.data_provider)
+            except Exception as e:  # a bad candidate must not kill the run
+                results.append(
+                    CandidateResult(i, values, math.nan, None, exception=e))
+                continue
+            res = CandidateResult(i, values, score, net)
+            results.append(res)
+            # a NaN-scored (diverged) candidate must never be "best"
+            if math.isfinite(score) and (
+                    best is None or not math.isfinite(best.score)
+                    or sign * score < sign * best.score):
+                best = res
+        if best is None:
+            errs = [r.exception for r in results if r.exception is not None]
+            detail = f"; first error: {errs[0]!r}" if errs else ""
+            raise RuntimeError(
+                f"no candidate completed with a finite score "
+                f"({len(results)} attempted){detail}")
+        return OptimizationResult(best, results)

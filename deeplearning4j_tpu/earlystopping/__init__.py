@@ -272,11 +272,11 @@ class EarlyStoppingTrainer:
 
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
-        if not cfg.epoch_conditions:
+        if not cfg.epoch_conditions and not cfg.iteration_conditions:
             raise ValueError(
-                "EarlyStoppingConfiguration needs at least one epoch "
-                "termination condition (e.g. MaxEpochsTerminationCondition) "
-                "or fit() would never return")
+                "EarlyStoppingConfiguration needs at least one termination "
+                "condition (e.g. MaxEpochsTerminationCondition) or fit() "
+                "would never return")
         for cond in cfg.epoch_conditions + cfg.iteration_conditions:
             cond.initialize()
         if self.net.params is None:

@@ -1,0 +1,24 @@
+"""NLP: word/paragraph embeddings + tokenization.
+
+Reference: ``deeplearning4j-nlp-parent/deeplearning4j-nlp`` —
+``org.deeplearning4j.models.word2vec.Word2Vec`` (SkipGram/CBOW with a
+dedicated native op in the reference), ``GloVe``, ``ParagraphVectors``,
+tokenizer SPI, ``WordVectorSerializer`` (SURVEY.md §2.2).
+
+TPU-native design: the reference trains embeddings word-pair-at-a-time
+through a custom nd4j ``SkipGram`` kernel; here training pairs are
+vectorized on the host (numpy) and consumed by ONE jitted negative-sampling
+step over whole batches — the embedding scatter-updates come from
+``jax.grad`` of the batched lookup, fused by XLA.
+"""
+
+from deeplearning4j_tpu.nlp.tokenization import (  # noqa: F401
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    NGramTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord  # noqa: F401
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec  # noqa: F401
+from deeplearning4j_tpu.nlp.paragraph import ParagraphVectors  # noqa: F401
+from deeplearning4j_tpu.nlp.glove import Glove  # noqa: F401
+from deeplearning4j_tpu.nlp import serializer as WordVectorSerializer  # noqa: F401,N812

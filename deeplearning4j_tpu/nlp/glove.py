@@ -1,0 +1,127 @@
+"""GloVe.
+
+Reference: ``org.deeplearning4j.models.glove.Glove`` — co-occurrence counts
+within a window, then AdaGrad on the weighted least-squares objective
+
+    J = Σ f(X_ij) (w_i·w̃_j + b_i + b̃_j − log X_ij)²,
+    f(x) = (x/x_max)^α clipped at 1.
+
+TPU-native: the co-occurrence pass is host-side (dict accumulation); the
+factorization runs as ONE jitted AdaGrad step over the whole non-zero set
+per epoch (the reference shuffles and updates pair-at-a-time in Java
+threads)."""
+
+from __future__ import annotations
+
+import functools
+from collections import defaultdict
+from typing import Iterable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def _glove_epoch(w, wc, b, bc, gw, gwc, gb, gbc, rows, cols, logx, fx, lr):
+    def loss_fn(w, wc, b, bc):
+        diff = (jnp.sum(w[rows] * wc[cols], -1) + b[rows] + bc[cols] - logx)
+        return 0.5 * jnp.sum(fx * diff * diff)
+
+    loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2, 3))(
+        w, wc, b, bc)
+
+    def ada(p, g, acc):
+        acc = acc + g * g
+        return p - lr * g / jnp.sqrt(acc + 1e-8), acc
+
+    w, gw = ada(w, grads[0], gw)
+    wc, gwc = ada(wc, grads[1], gwc)
+    b, gb = ada(b, grads[2], gb)
+    bc, gbc = ada(bc, grads[3], gbc)
+    return w, wc, b, bc, gw, gwc, gb, gbc, loss
+
+
+class Glove:
+    """Reference ``Glove.Builder`` surface: ``vector_length(layer_size)``,
+    window, min_word_frequency, x_max, alpha, learning_rate, epochs."""
+
+    def __init__(self, layer_size: int = 100, window_size: int = 5,
+                 min_word_frequency: int = 1, x_max: float = 100.0,
+                 alpha: float = 0.75, learning_rate: float = 0.05,
+                 epochs: int = 25, seed: int = 42,
+                 symmetric: bool = True,
+                 tokenizer_factory: Optional[object] = None):
+        self.layer_size = int(layer_size)
+        self.window = int(window_size)
+        self.min_word_frequency = int(min_word_frequency)
+        self.x_max = float(x_max)
+        self.alpha = float(alpha)
+        self.learning_rate = float(learning_rate)
+        self.epochs = int(epochs)
+        self.seed = int(seed)
+        self.symmetric = symmetric
+        self.tokenizer = tokenizer_factory or DefaultTokenizerFactory()
+        self.vocab: Optional[VocabCache] = None
+        self.syn0: Optional[np.ndarray] = None
+
+    def fit(self, sentences: Iterable) -> "Glove":
+        corpus = [self.tokenizer.tokenize(s) if isinstance(s, str) else list(s)
+                  for s in sentences]
+        self.vocab = VocabCache.build(iter(corpus), self.min_word_frequency)
+        V, D = len(self.vocab), self.layer_size
+        if V < 2:
+            raise ValueError("vocabulary too small for GloVe")
+
+        # host-side co-occurrence accumulation with 1/distance weighting
+        cooc = defaultdict(float)
+        for sent in corpus:
+            idxs = [self.vocab.index_of(t) for t in sent if t in self.vocab]
+            for i, wi in enumerate(idxs):
+                for j in range(max(0, i - self.window), i):
+                    wj = idxs[j]
+                    incr = 1.0 / (i - j)
+                    cooc[(wi, wj)] += incr
+                    if self.symmetric:
+                        cooc[(wj, wi)] += incr
+        if not cooc:
+            raise ValueError("no co-occurrences found")
+        rows = np.asarray([k[0] for k in cooc], np.int32)
+        cols = np.asarray([k[1] for k in cooc], np.int32)
+        x = np.asarray(list(cooc.values()), np.float32)
+        logx = jnp.asarray(np.log(x))
+        fx = jnp.asarray(np.minimum((x / self.x_max) ** self.alpha, 1.0))
+        rows_j, cols_j = jnp.asarray(rows), jnp.asarray(cols)
+
+        rng = np.random.default_rng(self.seed)
+        w = jnp.asarray((rng.random((V, D)) - 0.5) / D, jnp.float32)
+        wc = jnp.asarray((rng.random((V, D)) - 0.5) / D, jnp.float32)
+        b = jnp.zeros((V,), jnp.float32)
+        bc = jnp.zeros((V,), jnp.float32)
+        gw = jnp.full((V, D), 1e-8, jnp.float32)
+        gwc = jnp.full((V, D), 1e-8, jnp.float32)
+        gb = jnp.full((V,), 1e-8, jnp.float32)
+        gbc = jnp.full((V,), 1e-8, jnp.float32)
+        lr = jnp.asarray(self.learning_rate, jnp.float32)
+
+        for _ in range(self.epochs):
+            (w, wc, b, bc, gw, gwc, gb, gbc, loss) = _glove_epoch(
+                w, wc, b, bc, gw, gwc, gb, gbc, rows_j, cols_j, logx, fx, lr)
+        # final embedding = w + w̃ (GloVe paper / reference)
+        self.syn0 = np.asarray(w) + np.asarray(wc)
+        return self
+
+    # --- query (same surface as Word2Vec) -----------------------------------
+    def has_word(self, word: str) -> bool:
+        return self.vocab is not None and word in self.vocab
+
+    def get_word_vector(self, word: str) -> np.ndarray:
+        return self.syn0[self.vocab.index_of(word)]
+
+    def similarity(self, w1: str, w2: str) -> float:
+        a, b = self.get_word_vector(w1), self.get_word_vector(w2)
+        denom = np.linalg.norm(a) * np.linalg.norm(b)
+        return float(a @ b / denom) if denom > 0 else 0.0

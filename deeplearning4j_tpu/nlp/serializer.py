@@ -1,0 +1,91 @@
+"""WordVectorSerializer.
+
+Reference: ``org.deeplearning4j.models.embeddings.loader.
+WordVectorSerializer`` — ``writeWord2VecModel`` / ``readWord2VecModel`` and
+the classic text format (one ``word v1 v2 ...`` line per word, first line
+``V D``), word2vec-interchange-compatible."""
+
+from __future__ import annotations
+
+import io
+import zipfile
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
+
+
+def write_word_vectors(model, path: str) -> None:
+    """Classic text format (readable by gensim/word2vec tooling)."""
+    vocab, m = model.vocab, model.syn0
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(f"{len(vocab)} {m.shape[1]}\n")
+        for i, word in enumerate(vocab.words()):
+            vec = " ".join(f"{v:.6f}" for v in m[i])
+            f.write(f"{word} {vec}\n")
+
+
+def read_word_vectors(path: str):
+    """-> (VocabCache, matrix) from the classic text format."""
+    with open(path, encoding="utf-8") as f:
+        header = f.readline().split()
+        v_count, dim = int(header[0]), int(header[1])
+        cache = VocabCache()
+        mat = np.zeros((v_count, dim), np.float32)
+        for i in range(v_count):
+            parts = f.readline().rstrip("\n").split(" ")
+            word = parts[0]
+            mat[i] = np.asarray(parts[1:1 + dim], np.float32)
+            vw = VocabWord(word, 1, i)
+            cache._words[word] = vw
+            cache._by_index.append(vw)
+            cache.total_count += 1
+    return cache, mat
+
+
+def write_word2vec_model(model, path: str) -> None:
+    """Full-fidelity zip: vocab (word+count per line) + syn0/syn1 npy
+    (reference ``writeWord2VecModel`` zip layout, npz instead of the
+    reference's text payloads)."""
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        vocab_txt = "\n".join(f"{w}\t{c}" for w, c in
+                              zip(model.vocab.words(), model.vocab.counts()))
+        z.writestr("vocab.tsv", vocab_txt)
+        z.writestr("syn0.npy", _npy_bytes(model.syn0))
+        if getattr(model, "syn1", None) is not None:
+            z.writestr("syn1.npy", _npy_bytes(model.syn1))
+        cfg = (f"layer_size={model.layer_size}\n"
+               f"window={getattr(model, 'window', 0)}\n"
+               f"negative={getattr(model, 'negative', 0)}\n")
+        z.writestr("config.txt", cfg)
+
+
+def read_word2vec_model(path: str):
+    """-> a query-ready Word2Vec (training state restored; reference
+    ``readWord2VecModel``)."""
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    with zipfile.ZipFile(path) as z:
+        cfg = dict(line.split("=", 1)
+                   for line in z.read("config.txt").decode().splitlines()
+                   if "=" in line)
+        model = Word2Vec(layer_size=int(cfg.get("layer_size", 100)),
+                         window_size=int(cfg.get("window", 5)) or 5,
+                         negative=int(cfg.get("negative", 5)) or 5)
+        cache = VocabCache()
+        for line in z.read("vocab.tsv").decode().splitlines():
+            word, count = line.rsplit("\t", 1)
+            vw = VocabWord(word, int(count), len(cache._by_index))
+            cache._words[word] = vw
+            cache._by_index.append(vw)
+            cache.total_count += int(count)
+        model.vocab = cache
+        model.syn0 = _read_npy(z, "syn0.npy")
+        if "syn1.npy" in z.namelist():
+            model.syn1 = _read_npy(z, "syn1.npy")
+    return model
+
+
+# npy payload helpers shared with the model serializer
+from deeplearning4j_tpu.util.serializer import _npy_bytes, _read_npy  # noqa: E402

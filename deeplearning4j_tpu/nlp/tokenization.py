@@ -1,0 +1,50 @@
+"""Tokenizer SPI (reference ``org.deeplearning4j.text.tokenization`` —
+``TokenizerFactory`` / ``Tokenizer`` / ``TokenPreProcess``)."""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional
+
+
+class CommonPreprocessor:
+    """Reference ``CommonPreprocessor``: lowercase + strip punctuation."""
+
+    _PUNCT = re.compile(r"[\d\.:,\"'\(\)\[\]|/?!;]+")
+
+    def pre_process(self, token: str) -> str:
+        return self._PUNCT.sub("", token).lower()
+
+
+class DefaultTokenizerFactory:
+    """Whitespace tokenizer (reference ``DefaultTokenizerFactory``)."""
+
+    def __init__(self):
+        self._pre: Optional[CommonPreprocessor] = None
+
+    def set_token_pre_processor(self, pre) -> "DefaultTokenizerFactory":
+        self._pre = pre
+        return self
+
+    def tokenize(self, sentence: str) -> List[str]:
+        tokens = sentence.split()
+        if self._pre is not None:
+            tokens = [self._pre.pre_process(t) for t in tokens]
+        return [t for t in tokens if t]
+
+
+class NGramTokenizerFactory(DefaultTokenizerFactory):
+    """Reference ``NGramTokenizerFactory``: emits n-grams of the base
+    tokens joined by spaces, for n in [min_n, max_n]."""
+
+    def __init__(self, min_n: int = 1, max_n: int = 2):
+        super().__init__()
+        self.min_n, self.max_n = int(min_n), int(max_n)
+
+    def tokenize(self, sentence: str) -> List[str]:
+        base = super().tokenize(sentence)
+        out = []
+        for n in range(self.min_n, self.max_n + 1):
+            for i in range(len(base) - n + 1):
+                out.append(" ".join(base[i:i + n]))
+        return out

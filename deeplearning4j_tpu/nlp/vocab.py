@@ -1,0 +1,61 @@
+"""Vocabulary (reference ``org.deeplearning4j.models.word2vec.wordstore`` —
+``VocabCache`` / ``VocabWord``)."""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+from typing import Dict, Iterable, List
+
+
+@dataclasses.dataclass
+class VocabWord:
+    word: str
+    count: int
+    index: int
+
+
+class VocabCache:
+    """Word -> (count, index), built with a min-frequency cutoff; indices
+    ordered by descending frequency (reference ``AbstractCache``)."""
+
+    def __init__(self):
+        self._words: Dict[str, VocabWord] = {}
+        self._by_index: List[VocabWord] = []
+        self.total_count = 0
+
+    @classmethod
+    def build(cls, token_stream: Iterable[List[str]],
+              min_word_frequency: int = 1) -> "VocabCache":
+        counts = Counter()
+        for tokens in token_stream:
+            counts.update(tokens)
+        cache = cls()
+        for word, count in counts.most_common():
+            if count >= min_word_frequency:
+                vw = VocabWord(word, count, len(cache._by_index))
+                cache._words[word] = vw
+                cache._by_index.append(vw)
+                cache.total_count += count
+        return cache
+
+    def __len__(self):
+        return len(self._by_index)
+
+    def __contains__(self, word: str):
+        return word in self._words
+
+    def index_of(self, word: str) -> int:
+        return self._words[word].index
+
+    def word_at(self, index: int) -> str:
+        return self._by_index[index].word
+
+    def count_of(self, word: str) -> int:
+        return self._words[word].count
+
+    def words(self) -> List[str]:
+        return [v.word for v in self._by_index]
+
+    def counts(self) -> List[int]:
+        return [v.count for v in self._by_index]

@@ -1,0 +1,218 @@
+"""Word2Vec.
+
+Reference: ``org.deeplearning4j.models.word2vec.Word2Vec`` (Builder:
+``layerSize/windowSize/minWordFrequency/negativeSample/iterations/
+learningRate/sampling/seed``; elementsLearningAlgorithm SkipGram or CBOW,
+backed by dedicated nd4j native ops). The reference defaults to hierarchical
+softmax; per-word variable-length Huffman paths defeat XLA's static shapes,
+so the TPU build trains with NEGATIVE SAMPLING (``negative``, default 5) —
+the standard SGNS objective — in one jitted batched step:
+
+    loss = -log σ(v_c·u_o) - Σ_k log σ(-v_c·u_nk)
+
+Pairs are generated vectorized on the host (dynamic windows + frequency
+subsampling, as word2vec.c does); the unigram^0.75 negative table is sampled
+with jax PRNG inside the step.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+
+
+@functools.partial(jax.jit, static_argnums=(7,), donate_argnums=(0, 1))
+def _sgns_step(w_in, w_out, centers, contexts, table, rng, lr, negative):
+    """One negative-sampling SGD step over a batch of (center, context);
+    negatives drawn uniformly from the unigram^0.75 ``table``."""
+    idx = jax.random.randint(rng, (centers.shape[0], negative), 0,
+                             table.shape[0])
+    neg = table[idx]
+
+    def loss_fn(w_in, w_out):
+        v = w_in[centers]                       # [b, d]
+        u_pos = w_out[contexts]                 # [b, d]
+        u_neg = w_out[neg]                      # [b, k, d]
+        pos = jnp.sum(v * u_pos, -1)
+        negs = jnp.einsum("bd,bkd->bk", v, u_neg)
+        # SUM, not mean: each pair's embedding rows get a full lr-scaled
+        # update, matching word2vec.c's per-pair SGD semantics (mean would
+        # shrink per-row updates by the batch size)
+        return -(jnp.sum(jax.nn.log_sigmoid(pos))
+                 + jnp.sum(jax.nn.log_sigmoid(-negs)))
+
+    loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(w_in, w_out)
+    w_in = w_in - lr * grads[0]
+    w_out = w_out - lr * grads[1]
+    return w_in, w_out, loss
+
+
+class Word2Vec:
+    """Reference ``Word2Vec.Builder`` surface as keyword args; ``fit()``
+    over an iterable of sentences (strings or token lists)."""
+
+    def __init__(self, layer_size: int = 100, window_size: int = 5,
+                 min_word_frequency: int = 5, negative: int = 5,
+                 iterations: int = 1, epochs: int = 1,
+                 learning_rate: float = 0.025,
+                 min_learning_rate: float = 1e-4,
+                 sampling: float = 0.0, batch_size: int = 512,
+                 seed: int = 42,
+                 tokenizer_factory: Optional[object] = None,
+                 elements_learning_algorithm: str = "SkipGram"):
+        if elements_learning_algorithm not in ("SkipGram", "CBOW"):
+            raise ValueError("elements_learning_algorithm must be SkipGram "
+                             "or CBOW")
+        self.layer_size = int(layer_size)
+        self.window = int(window_size)
+        self.min_word_frequency = int(min_word_frequency)
+        self.negative = max(1, int(negative))
+        self.iterations = int(iterations)
+        self.epochs = int(epochs)
+        self.learning_rate = float(learning_rate)
+        self.min_learning_rate = float(min_learning_rate)
+        self.sampling = float(sampling)
+        self.batch_size = int(batch_size)
+        self.seed = int(seed)
+        self.tokenizer = tokenizer_factory or DefaultTokenizerFactory()
+        self.algorithm = elements_learning_algorithm
+        self.vocab: Optional[VocabCache] = None
+        self.syn0: Optional[np.ndarray] = None  # input vectors [V, D]
+        self.syn1: Optional[np.ndarray] = None  # output vectors [V, D]
+
+    # --- corpus handling ----------------------------------------------------
+    def _tokenized(self, sentences) -> List[List[str]]:
+        out = []
+        for s in sentences:
+            out.append(self.tokenizer.tokenize(s) if isinstance(s, str)
+                       else list(s))
+        return out
+
+    def _encode(self, corpus: List[List[str]]) -> List[np.ndarray]:
+        v = self.vocab
+        return [np.asarray([v.index_of(t) for t in sent if t in v],
+                           np.int32)
+                for sent in corpus]
+
+    def _pairs(self, encoded: Sequence[np.ndarray],
+               rng: np.random.Generator) -> np.ndarray:
+        """All (center, context) pairs with word2vec.c dynamic windows and
+        optional frequency subsampling; vectorized per sentence."""
+        counts = np.asarray(self.vocab.counts(), np.float64)
+        total = counts.sum()
+        keep_prob = None
+        if self.sampling > 0:
+            f = counts / total
+            keep_prob = np.minimum(
+                1.0, np.sqrt(self.sampling / f) + self.sampling / f)
+        pairs = []
+        for sent in encoded:
+            if keep_prob is not None and len(sent):
+                sent = sent[rng.random(len(sent)) < keep_prob[sent]]
+            n = len(sent)
+            if n < 2:
+                continue
+            b = rng.integers(1, self.window + 1, n)  # dynamic window sizes
+            for i in range(n):
+                lo, hi = max(0, i - b[i]), min(n, i + b[i] + 1)
+                for j in range(lo, hi):
+                    if j != i:
+                        pairs.append((sent[i], sent[j]))
+        if not pairs:
+            return np.zeros((0, 2), np.int32)
+        return np.asarray(pairs, np.int32)
+
+    # --- training -----------------------------------------------------------
+    def fit(self, sentences: Iterable) -> "Word2Vec":
+        corpus = self._tokenized(sentences)
+        self.vocab = VocabCache.build(iter(corpus), self.min_word_frequency)
+        if len(self.vocab) < 2:
+            raise ValueError("vocabulary has fewer than 2 words; lower "
+                             "min_word_frequency or supply more text")
+        V, D = len(self.vocab), self.layer_size
+        rng = np.random.default_rng(self.seed)
+        key = jax.random.PRNGKey(self.seed)
+        w_in = jnp.asarray(
+            (rng.random((V, D)) - 0.5) / D, jnp.float32)
+        w_out = jnp.zeros((V, D), jnp.float32)
+
+        # unigram^0.75 negative table (word2vec.c construction)
+        counts = np.asarray(self.vocab.counts(), np.float64) ** 0.75
+        probs = counts / counts.sum()
+        table = jnp.asarray(
+            rng.choice(V, size=max(V * 8, 1 << 16), p=probs), jnp.int32)
+
+        encoded = self._encode(corpus)
+        total_steps = None
+        step = 0
+        for ep in range(self.epochs):
+            pairs = self._pairs(encoded, rng)
+            if self.algorithm == "CBOW":
+                # CBOW ~ predict center from context: swap roles per pair
+                pairs = pairs[:, ::-1]
+            rng.shuffle(pairs)
+            if total_steps is None:
+                total_steps = max(
+                    1, self.epochs * self.iterations
+                    * (len(pairs) // self.batch_size + 1))
+            for _ in range(self.iterations):
+                for i in range(0, len(pairs), self.batch_size):
+                    chunk = pairs[i:i + self.batch_size]
+                    if len(chunk) < self.batch_size:  # static shapes: pad
+                        reps = self.batch_size - len(chunk)
+                        chunk = np.concatenate(
+                            [chunk, chunk[rng.integers(0, len(chunk), reps)]])
+                    frac = min(step / total_steps, 1.0)
+                    lr = max(self.min_learning_rate,
+                             self.learning_rate * (1.0 - frac))
+                    key, sub = jax.random.split(key)
+                    w_in, w_out, loss = _sgns_step(
+                        w_in, w_out, jnp.asarray(chunk[:, 0]),
+                        jnp.asarray(chunk[:, 1]), table, sub,
+                        jnp.asarray(lr, jnp.float32), self.negative)
+                    step += 1
+        self.syn0 = np.asarray(w_in)
+        self.syn1 = np.asarray(w_out)
+        return self
+
+    # --- query API (reference WordVectors interface) ------------------------
+    def has_word(self, word: str) -> bool:
+        return self.vocab is not None and word in self.vocab
+
+    def get_word_vector(self, word: str) -> np.ndarray:
+        return self.syn0[self.vocab.index_of(word)]
+
+    def get_word_vector_matrix(self) -> np.ndarray:
+        return self.syn0
+
+    def similarity(self, w1: str, w2: str) -> float:
+        a, b = self.get_word_vector(w1), self.get_word_vector(w2)
+        denom = np.linalg.norm(a) * np.linalg.norm(b)
+        return float(a @ b / denom) if denom > 0 else 0.0
+
+    def words_nearest(self, word_or_vec, top_n: int = 10) -> List[str]:
+        if isinstance(word_or_vec, str):
+            vec = self.get_word_vector(word_or_vec)
+            exclude = {word_or_vec}
+        else:
+            vec = np.asarray(word_or_vec)
+            exclude = set()
+        m = self.syn0
+        sims = (m @ vec) / (np.linalg.norm(m, axis=1)
+                            * max(np.linalg.norm(vec), 1e-9) + 1e-9)
+        order = np.argsort(-sims)
+        out = []
+        for idx in order:
+            w = self.vocab.word_at(int(idx))
+            if w not in exclude:
+                out.append(w)
+            if len(out) >= top_n:
+                break
+        return out

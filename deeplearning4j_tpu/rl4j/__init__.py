@@ -1,0 +1,15 @@
+"""RL4J-equivalent: deep reinforcement learning.
+
+Reference: ``rl4j/`` — ``QLearningDiscreteDense`` (DQN over dense
+observations: epsilon-greedy acting, experience replay, target network,
+double-DQN option), the ``MDP`` interface, gym adapters (SURVEY.md §2.2
+L7). TPU-native: the TD update is one jitted step (gather Q(s,a), TD
+targets from the target net, MSE on the taken actions) over replay batches.
+"""
+
+from deeplearning4j_tpu.rl4j.mdp import MDP, CartPole, SimpleToyMDP  # noqa: F401
+from deeplearning4j_tpu.rl4j.dqn import (  # noqa: F401
+    QLearningConfiguration,
+    QLearningDiscreteDense,
+    ReplayMemory,
+)

@@ -1,0 +1,208 @@
+"""DQN (reference ``org.deeplearning4j.rl4j.learning.sync.qlearning.discrete.
+QLearningDiscreteDense`` + ``QLearningConfiguration`` + ``ExpReplay``).
+
+The reference builds TD targets in Java per batch and calls net.fit; here
+the whole TD update — online Q gather, target-net max (or double-DQN
+argmax/gather), MSE on taken actions, Adam step — is ONE jitted function
+over replay batches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from collections import deque
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class QLearningConfiguration:
+    """Reference ``QLearningConfiguration`` fields (same names, snake_case)."""
+
+    seed: int = 123
+    max_epoch_step: int = 200
+    max_step: int = 10_000
+    exp_rep_max_size: int = 10_000
+    batch_size: int = 64
+    target_dqn_update_freq: int = 100
+    update_start: int = 100
+    reward_factor: float = 1.0
+    gamma: float = 0.99
+    error_clamp: float = 1.0
+    min_epsilon: float = 0.05
+    epsilon_nb_step: int = 3000
+    double_dqn: bool = True
+    learning_rate: float = 1e-3
+
+
+class ReplayMemory:
+    """Reference ``ExpReplay``: bounded FIFO of (s, a, r, s', done)."""
+
+    def __init__(self, max_size: int, seed: int = 0):
+        self._buf = deque(maxlen=int(max_size))
+        self.rng = np.random.default_rng(seed)
+
+    def store(self, s, a, r, s2, done):
+        self._buf.append((s, a, r, s2, done))
+
+    def __len__(self):
+        return len(self._buf)
+
+    def sample(self, n: int):
+        idx = self.rng.integers(0, len(self._buf), n)
+        s, a, r, s2, d = zip(*(self._buf[i] for i in idx))
+        return (np.stack(s), np.asarray(a, np.int32),
+                np.asarray(r, np.float32), np.stack(s2),
+                np.asarray(d, np.float32))
+
+
+def _mlp_init(key, sizes):
+    params = []
+    for i, (n_in, n_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, sub = jax.random.split(key)
+        w = jax.random.normal(sub, (n_in, n_out)) * np.sqrt(2.0 / n_in)
+        params.append({"W": w.astype(jnp.float32),
+                       "b": jnp.zeros((n_out,), jnp.float32)})
+    return params
+
+
+def _mlp_apply(params, x):
+    for i, layer in enumerate(params):
+        x = x @ layer["W"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+@functools.partial(jax.jit, static_argnums=(8,))
+def _td_step(params, opt_m, opt_v, target_params, batch, step, lr_gamma,
+             clamp, double_dqn):
+    s, a, r, s2, done = batch
+    lr, gamma = lr_gamma
+
+    def loss_fn(params):
+        q = _mlp_apply(params, s)                       # [b, A]
+        q_sa = jnp.take_along_axis(q, a[:, None], 1)[:, 0]
+        q2_t = _mlp_apply(target_params, s2)
+        if double_dqn:
+            a2 = jnp.argmax(_mlp_apply(params, s2), axis=1)
+            q2 = jnp.take_along_axis(q2_t, a2[:, None], 1)[:, 0]
+        else:
+            q2 = jnp.max(q2_t, axis=1)
+        target = r + gamma * (1.0 - done) * jax.lax.stop_gradient(q2)
+        err = q_sa - target
+        # Huber: quadratic inside ``error_clamp``, linear outside — a hard
+        # clip would zero the gradient exactly when Q diverges (the
+        # reference clamps the TD error with the same intent)
+        quad = jnp.minimum(jnp.abs(err), clamp)
+        lin = jnp.abs(err) - quad
+        return jnp.mean(0.5 * quad * quad + clamp * lin)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    # Adam
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    t = step + 1.0
+    new_p, new_m, new_v = [], [], []
+    for p, g, m, v in zip(params, grads, opt_m, opt_v):
+        layer_p, layer_m, layer_v = {}, {}, {}
+        for k in p:
+            mk = b1 * m[k] + (1 - b1) * g[k]
+            vk = b2 * v[k] + (1 - b2) * g[k] * g[k]
+            mhat = mk / (1 - b1 ** t)
+            vhat = vk / (1 - b2 ** t)
+            layer_p[k] = p[k] - lr * mhat / (jnp.sqrt(vhat) + eps)
+            layer_m[k], layer_v[k] = mk, vk
+        new_p.append(layer_p)
+        new_m.append(layer_m)
+        new_v.append(layer_v)
+    return new_p, new_m, new_v, loss
+
+
+@jax.jit
+def _q_values(params, obs):
+    return _mlp_apply(params, obs)
+
+
+class QLearningDiscreteDense:
+    """DQN trainer (reference class of the same name). ``hidden``: MLP
+    widths for the Q-network (the reference takes a ``DQNFactoryStdDense``
+    conf)."""
+
+    def __init__(self, mdp, config: Optional[QLearningConfiguration] = None,
+                 hidden: List[int] = (64, 64)):
+        self.mdp = mdp
+        self.cfg = config or QLearningConfiguration()
+        key = jax.random.PRNGKey(self.cfg.seed)
+        sizes = [mdp.observation_size, *hidden, mdp.action_size]
+        self.params = _mlp_init(key, sizes)
+        self.target_params = jax.tree_util.tree_map(lambda x: x, self.params)
+        self.opt_m = jax.tree_util.tree_map(jnp.zeros_like, self.params)
+        self.opt_v = jax.tree_util.tree_map(jnp.zeros_like, self.params)
+        self.replay = ReplayMemory(self.cfg.exp_rep_max_size, self.cfg.seed)
+        self.rng = np.random.default_rng(self.cfg.seed)
+        self.step_count = 0
+        self.episode_rewards: List[float] = []
+
+    # --- policy --------------------------------------------------------------
+    def epsilon(self) -> float:
+        cfg = self.cfg
+        frac = min(1.0, self.step_count / max(cfg.epsilon_nb_step, 1))
+        return 1.0 + frac * (cfg.min_epsilon - 1.0)
+
+    def act(self, obs, greedy: bool = False) -> int:
+        if not greedy and self.rng.random() < self.epsilon():
+            return int(self.rng.integers(0, self.mdp.action_size))
+        q = _q_values(self.params, jnp.asarray(obs[None]))
+        return int(jnp.argmax(q[0]))
+
+    # --- training ------------------------------------------------------------
+    def train(self) -> "QLearningDiscreteDense":
+        cfg = self.cfg
+        while self.step_count < cfg.max_step:
+            obs = self.mdp.reset()
+            ep_reward = 0.0
+            for _ in range(cfg.max_epoch_step):
+                a = self.act(obs)
+                obs2, r, done = self.mdp.step(a)
+                ep_reward += r
+                self.replay.store(obs, a, r * cfg.reward_factor, obs2,
+                                  float(done))
+                obs = obs2
+                self.step_count += 1
+                if (self.step_count >= cfg.update_start
+                        and len(self.replay) >= cfg.batch_size):
+                    batch = self.replay.sample(cfg.batch_size)
+                    batch = tuple(jnp.asarray(b) for b in batch)
+                    (self.params, self.opt_m, self.opt_v, _) = _td_step(
+                        self.params, self.opt_m, self.opt_v,
+                        self.target_params, batch,
+                        jnp.asarray(float(self.step_count), jnp.float32),
+                        (jnp.asarray(cfg.learning_rate, jnp.float32),
+                         jnp.asarray(cfg.gamma, jnp.float32)),
+                        jnp.asarray(cfg.error_clamp, jnp.float32),
+                        cfg.double_dqn)
+                if self.step_count % cfg.target_dqn_update_freq == 0:
+                    self.target_params = jax.tree_util.tree_map(
+                        lambda x: x, self.params)
+                if done or self.step_count >= cfg.max_step:
+                    break
+            self.episode_rewards.append(ep_reward)
+        return self
+
+    # --- evaluation ----------------------------------------------------------
+    def play(self, episodes: int = 1) -> float:
+        """Greedy rollouts; returns mean episode reward (reference
+        ``Policy#play``)."""
+        total = 0.0
+        for _ in range(episodes):
+            obs = self.mdp.reset()
+            for _ in range(self.cfg.max_epoch_step):
+                obs, r, done = self.mdp.step(self.act(obs, greedy=True))
+                total += r
+                if done:
+                    break
+        return total / episodes

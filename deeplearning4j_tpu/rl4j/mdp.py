@@ -1,0 +1,119 @@
+"""MDP interface + built-in environments.
+
+Reference: ``org.deeplearning4j.rl4j.mdp.MDP`` and the gym adapters;
+``CartPole`` reimplements the classic control dynamics in numpy so tests
+and examples run with zero external deps (the reference reaches it through
+gym-java-client)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+class MDP:
+    """step/reset/is_done contract (reference MDP<O, A, AS>)."""
+
+    observation_size: int
+    action_size: int
+
+    def reset(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, action: int) -> Tuple[np.ndarray, float, bool]:
+        """-> (observation, reward, done)."""
+        raise NotImplementedError
+
+    def is_done(self) -> bool:
+        raise NotImplementedError
+
+
+class SimpleToyMDP(MDP):
+    """Reference ``org.deeplearning4j.rl4j.mdp.toy.SimpleToy``: a chain of
+    ``length`` states; action 1 advances and pays 1, action 0 ends the
+    episode. Optimal return = length."""
+
+    observation_size = 1
+    action_size = 2
+
+    def __init__(self, length: int = 10):
+        self.length = int(length)
+        self._state = 0
+        self._done = False
+
+    def reset(self):
+        self._state = 0
+        self._done = False
+        return self._obs()
+
+    def _obs(self):
+        return np.asarray([self._state / self.length], np.float32)
+
+    def step(self, action):
+        if action == 1:
+            self._state += 1
+            reward = 1.0
+            self._done = self._state >= self.length
+        else:
+            reward = 0.0
+            self._done = True
+        return self._obs(), reward, self._done
+
+    def is_done(self):
+        return self._done
+
+
+class CartPole(MDP):
+    """Classic cart-pole balance (dynamics per Barto-Sutton-Anderson, the
+    same task the reference drives through gym's CartPole-v0)."""
+
+    observation_size = 4
+    action_size = 2
+
+    GRAVITY = 9.8
+    CART_MASS = 1.0
+    POLE_MASS = 0.1
+    POLE_HALF_LENGTH = 0.5
+    FORCE = 10.0
+    DT = 0.02
+    THETA_LIMIT = 12 * 2 * np.pi / 360
+    X_LIMIT = 2.4
+
+    def __init__(self, max_steps: int = 200, seed: int = 0):
+        self.max_steps = int(max_steps)
+        self.rng = np.random.default_rng(seed)
+        self._state = np.zeros(4, np.float32)
+        self._steps = 0
+        self._done = False
+
+    def reset(self):
+        self._state = self.rng.uniform(-0.05, 0.05, 4).astype(np.float32)
+        self._steps = 0
+        self._done = False
+        return self._state.copy()
+
+    def step(self, action):
+        x, x_dot, theta, theta_dot = self._state
+        force = self.FORCE if action == 1 else -self.FORCE
+        total_mass = self.CART_MASS + self.POLE_MASS
+        pml = self.POLE_MASS * self.POLE_HALF_LENGTH
+        cos_t, sin_t = np.cos(theta), np.sin(theta)
+        temp = (force + pml * theta_dot ** 2 * sin_t) / total_mass
+        theta_acc = (self.GRAVITY * sin_t - cos_t * temp) / (
+            self.POLE_HALF_LENGTH
+            * (4.0 / 3.0 - self.POLE_MASS * cos_t ** 2 / total_mass))
+        x_acc = temp - pml * theta_acc * cos_t / total_mass
+        x += self.DT * x_dot
+        x_dot += self.DT * x_acc
+        theta += self.DT * theta_dot
+        theta_dot += self.DT * theta_acc
+        self._state = np.asarray([x, x_dot, theta, theta_dot], np.float32)
+        self._steps += 1
+        self._done = bool(abs(x) > self.X_LIMIT
+                          or abs(theta) > self.THETA_LIMIT
+                          or self._steps >= self.max_steps)
+        return self._state.copy(), 1.0, self._done
+
+    def is_done(self):
+        return self._done

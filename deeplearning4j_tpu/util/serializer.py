@@ -121,11 +121,9 @@ def restore_model(path, load_updater: bool = True):
     """Restore whichever model type the zip holds (dispatches on the
     serialized configuration class, reference ``ModelSerializer`` static
     restore helpers)."""
-    import json as _json
-
     with zipfile.ZipFile(path) as z:
         conf_js = z.read("configuration.json").decode()
-    kind = _json.loads(conf_js).get("@type", "")
+    kind = json.loads(conf_js).get("@type", "")
     if "ComputationGraph" in kind:
         return restore_computation_graph(path, load_updater)
     return restore_multi_layer_network(path, load_updater)

@@ -1,0 +1,121 @@
+"""NLP: tokenizers, vocab, Word2Vec/ParagraphVectors/GloVe semantics,
+serializer round-trips (reference: deeplearning4j-nlp Word2VecTests etc.)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    Glove,
+    NGramTokenizerFactory,
+    ParagraphVectors,
+    VocabCache,
+    Word2Vec,
+    WordVectorSerializer,
+)
+
+
+def _corpus(n=300, seed=0):
+    """Two topic clusters: {cat,dog,pet} co-occur; {car,road,drive}
+    co-occur. Clear similarity structure for a tiny embedding."""
+    rng = np.random.default_rng(seed)
+    animals = ["cat", "dog", "pet", "fur", "tail"]
+    cars = ["car", "road", "drive", "wheel", "engine"]
+    sents = []
+    for _ in range(n):
+        pool = animals if rng.random() < 0.5 else cars
+        sents.append(" ".join(rng.choice(pool, size=6)))
+    return sents
+
+
+def test_tokenizers():
+    t = DefaultTokenizerFactory()
+    t.set_token_pre_processor(CommonPreprocessor())
+    assert t.tokenize("Hello, World! 123 foo") == ["hello", "world", "foo"]
+    ng = NGramTokenizerFactory(1, 2)
+    toks = ng.tokenize("a b c")
+    assert "a b" in toks and "b c" in toks and "a" in toks
+
+
+def test_vocab_cache():
+    v = VocabCache.build(iter([["a", "b", "a"], ["a", "c"]]),
+                         min_word_frequency=2)
+    assert len(v) == 1 and "a" in v and v.count_of("a") == 3
+    v2 = VocabCache.build(iter([["a", "b", "a"], ["a", "c"]]))
+    assert v2.index_of("a") == 0  # most frequent first
+
+
+def test_word2vec_learns_topics():
+    w2v = Word2Vec(layer_size=24, window_size=3, min_word_frequency=2,
+                   negative=5, epochs=3, batch_size=256, seed=1)
+    w2v.fit(_corpus())
+    assert w2v.has_word("cat") and w2v.has_word("car")
+    # within-topic similarity beats cross-topic
+    assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "road")
+    assert w2v.similarity("car", "drive") > w2v.similarity("car", "fur")
+    near = w2v.words_nearest("cat", top_n=4)
+    assert set(near) & {"dog", "pet", "fur", "tail"}
+
+
+def test_word2vec_cbow_runs():
+    w2v = Word2Vec(layer_size=16, min_word_frequency=2, epochs=1,
+                   batch_size=128, elements_learning_algorithm="CBOW")
+    w2v.fit(_corpus(100))
+    assert w2v.get_word_vector("cat").shape == (16,)
+
+
+def test_word2vec_rejects_bad_algorithm():
+    with pytest.raises(ValueError):
+        Word2Vec(elements_learning_algorithm="HierarchicalSoftmax")
+
+
+def test_serializer_text_roundtrip(tmp_path):
+    w2v = Word2Vec(layer_size=8, min_word_frequency=2, epochs=1,
+                   batch_size=128).fit(_corpus(80))
+    p = str(tmp_path / "vecs.txt")
+    WordVectorSerializer.write_word_vectors(w2v, p)
+    cache, mat = WordVectorSerializer.read_word_vectors(p)
+    assert len(cache) == len(w2v.vocab)
+    i = cache.index_of("cat")
+    np.testing.assert_allclose(mat[i], w2v.get_word_vector("cat"),
+                               atol=1e-5)
+
+
+def test_serializer_model_roundtrip(tmp_path):
+    w2v = Word2Vec(layer_size=8, min_word_frequency=2, epochs=1,
+                   batch_size=128).fit(_corpus(80))
+    p = str(tmp_path / "model.zip")
+    WordVectorSerializer.write_word2vec_model(w2v, p)
+    back = WordVectorSerializer.read_word2vec_model(p)
+    assert back.vocab.count_of("cat") == w2v.vocab.count_of("cat")
+    np.testing.assert_allclose(back.get_word_vector("dog"),
+                               w2v.get_word_vector("dog"))
+    assert back.similarity("cat", "dog") == pytest.approx(
+        w2v.similarity("cat", "dog"), abs=1e-6)
+
+
+def test_paragraph_vectors():
+    docs = (["the cat sat with the dog and pet the fur"] * 6
+            + ["the car took the road to drive the wheel"] * 6)
+    labels = [f"animal_{i}" for i in range(6)] + [f"car_{i}" for i in range(6)]
+    pv = ParagraphVectors(layer_size=16, min_word_frequency=1, epochs=8,
+                          batch_size=64, negative=3, seed=3)
+    pv.fit(docs, labels)
+    assert pv.get_paragraph_vector("animal_0").shape == (16,)
+    v = pv.infer_vector("cat dog pet")
+    assert v.shape == (16,) and np.isfinite(v).all()
+    near = pv.nearest_labels("cat dog pet fur", top_n=3)
+    assert any(l.startswith("animal") for l in near)
+
+
+def test_glove_learns_topics():
+    g = Glove(layer_size=16, window_size=3, min_word_frequency=2,
+              epochs=60, learning_rate=0.05, seed=2)
+    g.fit(_corpus(200))
+    assert g.similarity("cat", "dog") > g.similarity("cat", "road")
+
+
+def test_glove_empty_corpus_raises():
+    with pytest.raises(ValueError):
+        Glove(min_word_frequency=2).fit(["one-word"])
