@@ -1,0 +1,181 @@
+"""Additional built-in dataset iterators.
+
+Reference: ``org.deeplearning4j.datasets.iterator.impl.{EmnistDataSetIterator,
+Cifar10DataSetIterator, SvhnDataSetIterator}`` + fetchers in
+``deeplearning4j-datasets`` (auto-download + cache). Zero-egress resolution
+order mirrors :mod:`deeplearning4j_tpu.datasets.mnist`: (1) cached files in
+the standard formats under ``~/.deeplearning4j_tpu/<name>/``, (2) a
+deterministic learnable synthetic set.
+"""
+
+from __future__ import annotations
+
+import os
+import string
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.datasets.mnist import _FONT, synthesize
+
+_ROOT = Path(os.path.expanduser("~/.deeplearning4j_tpu"))
+
+# 5x7 glyphs for A-Z (coarse bitmap font; enough signal to be learnable)
+_LETTERS = {
+    "A": ["01110", "10001", "10001", "11111", "10001", "10001", "10001"],
+    "B": ["11110", "10001", "11110", "10001", "10001", "10001", "11110"],
+    "C": ["01110", "10001", "10000", "10000", "10000", "10001", "01110"],
+    "D": ["11110", "10001", "10001", "10001", "10001", "10001", "11110"],
+    "E": ["11111", "10000", "11110", "10000", "10000", "10000", "11111"],
+    "F": ["11111", "10000", "11110", "10000", "10000", "10000", "10000"],
+    "G": ["01110", "10001", "10000", "10111", "10001", "10001", "01111"],
+    "H": ["10001", "10001", "11111", "10001", "10001", "10001", "10001"],
+    "I": ["01110", "00100", "00100", "00100", "00100", "00100", "01110"],
+    "J": ["00111", "00010", "00010", "00010", "10010", "10010", "01100"],
+    "K": ["10001", "10010", "11100", "10010", "10001", "10001", "10001"],
+    "L": ["10000", "10000", "10000", "10000", "10000", "10000", "11111"],
+    "M": ["10001", "11011", "10101", "10101", "10001", "10001", "10001"],
+    "N": ["10001", "11001", "10101", "10011", "10001", "10001", "10001"],
+    "O": ["01110", "10001", "10001", "10001", "10001", "10001", "01110"],
+    "P": ["11110", "10001", "10001", "11110", "10000", "10000", "10000"],
+    "Q": ["01110", "10001", "10001", "10001", "10101", "10010", "01101"],
+    "R": ["11110", "10001", "10001", "11110", "10010", "10001", "10001"],
+    "S": ["01111", "10000", "01110", "00001", "00001", "10001", "01110"],
+    "T": ["11111", "00100", "00100", "00100", "00100", "00100", "00100"],
+    "U": ["10001", "10001", "10001", "10001", "10001", "10001", "01110"],
+    "V": ["10001", "10001", "10001", "10001", "01010", "01010", "00100"],
+    "W": ["10001", "10001", "10001", "10101", "10101", "11011", "10001"],
+    "X": ["10001", "01010", "00100", "00100", "01010", "10001", "10001"],
+    "Y": ["10001", "01010", "00100", "00100", "00100", "00100", "00100"],
+    "Z": ["11111", "00001", "00010", "00100", "01000", "10000", "11111"],
+}
+
+
+def _render_glyphs(glyphs, num, n_classes, seed, size=28):
+    rng = np.random.default_rng(seed)
+    keys = list(glyphs)
+    imgs = np.zeros((num, size, size), np.float32)
+    lab = rng.integers(0, n_classes, num)
+    for i, cls in enumerate(lab):
+        g = glyphs[keys[cls]]
+        scale = rng.integers(2, 4)
+        gh, gw = 7 * scale, 5 * scale
+        oy = rng.integers(1, size - gh - 1)
+        ox = rng.integers(1, size - gw - 1)
+        for r, row in enumerate(g):
+            for c, bit in enumerate(row):
+                if bit == "1":
+                    imgs[i, oy + r * scale:oy + (r + 1) * scale,
+                         ox + c * scale:ox + (c + 1) * scale] = 1.0
+    imgs += rng.normal(0, 0.08, imgs.shape).astype(np.float32)
+    imgs = np.clip(imgs, 0.0, 1.0)
+    return imgs[..., None], np.eye(n_classes, dtype=np.float32)[lab]
+
+
+class EmnistDataSetIterator(ArrayDataSetIterator):
+    """Reference ``EmnistDataSetIterator(dataset_type, batch, train)``;
+    sets: LETTERS (26), DIGITS (10), BALANCED (36 here: digits+letters)."""
+
+    LETTERS = "letters"
+    DIGITS = "digits"
+    BALANCED = "balanced"
+
+    def __init__(self, dataset_type: str = "letters", batch: int = 32,
+                 train: bool = True, seed: int = 123,
+                 num_examples: Optional[int] = None):
+        n = num_examples or (8192 if train else 2048)
+        s = seed + (0 if train else 777)
+        if dataset_type == self.DIGITS:
+            feats, labels = synthesize(n, s)
+        elif dataset_type == self.LETTERS:
+            feats, labels = _render_glyphs(_LETTERS, n, 26, s)
+        elif dataset_type == self.BALANCED:
+            both = dict(_LETTERS)
+            both.update({str(d): rows for d, rows in _FONT.items()})
+            feats, labels = _render_glyphs(both, n, 36, s)
+        else:
+            raise ValueError(f"unknown EMNIST set '{dataset_type}'")
+        self.num_classes = labels.shape[1]
+        super().__init__(feats, labels, batch, shuffle=True, seed=seed)
+
+
+def _load_cifar_binary(train: bool) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Standard CIFAR-10 binary format (data_batch_*.bin / test_batch.bin):
+    rows of [label u8, 3072 u8 RGB planar 32x32]."""
+    d = _ROOT / "cifar10"
+    names = ([f"data_batch_{i}.bin" for i in range(1, 6)] if train
+             else ["test_batch.bin"])
+    paths = [d / n for n in names]
+    if not all(p.exists() for p in paths):
+        return None
+    feats, labels = [], []
+    for p in paths:
+        raw = np.frombuffer(p.read_bytes(), np.uint8).reshape(-1, 3073)
+        labels.append(raw[:, 0])
+        img = raw[:, 1:].reshape(-1, 3, 32, 32)  # planar CHW
+        feats.append(np.transpose(img, (0, 2, 3, 1)))  # -> NHWC
+    from deeplearning4j_tpu import native
+
+    f = native.u8_to_f32(np.concatenate(feats))
+    l = np.concatenate(labels)
+    return f, np.eye(10, dtype=np.float32)[l]
+
+
+def _synthesize_color(num, n_classes, seed, size=32):
+    """Learnable color/shape classes: class determines hue + blob layout."""
+    rng = np.random.default_rng(seed)
+    lab = rng.integers(0, n_classes, num)
+    imgs = np.zeros((num, size, size, 3), np.float32)
+    hues = np.linspace(0.0, 1.0, n_classes, endpoint=False)
+    for i, cls in enumerate(lab):
+        h = hues[cls]
+        color = np.asarray([abs(np.sin(h * 6.28)), abs(np.sin(h * 6.28 + 2)),
+                            abs(np.sin(h * 6.28 + 4))], np.float32)
+        cx, cy = rng.integers(8, size - 8, 2)
+        r = 4 + (cls % 4) * 2
+        yy, xx = np.mgrid[0:size, 0:size]
+        mask = ((yy - cy) ** 2 + (xx - cx) ** 2) < r * r
+        if cls % 2:  # odd classes: square
+            mask = (abs(yy - cy) < r) & (abs(xx - cx) < r)
+        imgs[i][mask] = color
+    imgs += rng.normal(0, 0.05, imgs.shape).astype(np.float32)
+    return np.clip(imgs, 0, 1), np.eye(n_classes, dtype=np.float32)[lab]
+
+
+class Cifar10DataSetIterator(ArrayDataSetIterator):
+    """Reference ``Cifar10DataSetIterator``; NHWC [b,32,32,3] in [0,1]."""
+
+    def __init__(self, batch: int = 32, train: bool = True, seed: int = 123,
+                 num_examples: Optional[int] = None):
+        real = _load_cifar_binary(train)
+        if real is not None:
+            feats, labels = real
+            self.synthetic = False
+        else:
+            n = num_examples or (8192 if train else 2048)
+            feats, labels = _synthesize_color(
+                n, 10, seed + (0 if train else 777))
+            self.synthetic = True
+        if num_examples is not None:
+            feats, labels = feats[:num_examples], labels[:num_examples]
+        super().__init__(feats, labels, batch, shuffle=True, seed=seed)
+
+
+class SvhnDataSetIterator(ArrayDataSetIterator):
+    """Reference ``SvhnDataSetIterator``; synthetic = colored digit glyphs
+    on clutter (same label space as the real street-view house numbers)."""
+
+    def __init__(self, batch: int = 32, train: bool = True, seed: int = 123,
+                 num_examples: Optional[int] = None):
+        n = num_examples or (8192 if train else 2048)
+        rng = np.random.default_rng(seed + (0 if train else 777))
+        gray, labels = synthesize(n, seed + (0 if train else 777))
+        # colorize onto noisy background, resize 28->32 by padding
+        imgs = rng.uniform(0.0, 0.4, (n, 32, 32, 3)).astype(np.float32)
+        tint = rng.uniform(0.5, 1.0, (n, 1, 1, 3)).astype(np.float32)
+        imgs[:, 2:30, 2:30, :] += gray * tint
+        self.synthetic = True
+        super().__init__(np.clip(imgs, 0, 1), labels, batch, shuffle=True,
+                         seed=seed)

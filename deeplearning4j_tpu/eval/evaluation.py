@@ -281,3 +281,112 @@ class RegressionEvaluation:
         vp = self.sum_pred2[col] - self.sum_pred[col] ** 2 / n
         d = np.sqrt(vl * vp)
         return float(cov / d) if d else 0.0
+
+
+class ROCMultiClass:
+    """One-vs-all ROC per class (reference ``ROCMultiClass``): per-class
+    AUC/AUPRC plus macro average."""
+
+    def __init__(self, num_classes: int | None = None):
+        self.num_classes = num_classes
+        self._rocs: list[ROC] | None = None
+
+    def _ensure(self, n: int):
+        if self._rocs is None:
+            self.num_classes = self.num_classes or n
+            self._rocs = [ROC() for _ in range(self.num_classes)]
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        preds = np.asarray(predictions)
+        labels = labels.reshape(-1, labels.shape[-1])
+        preds = preds.reshape(-1, preds.shape[-1])
+        self._ensure(labels.shape[-1])
+        for c in range(self.num_classes):
+            self._rocs[c].eval(labels[:, c], preds[:, c], mask)
+        return self
+
+    def calculate_auc(self, class_idx: int) -> float:
+        return self._rocs[class_idx].calculate_auc()
+
+    def calculate_auprc(self, class_idx: int) -> float:
+        return self._rocs[class_idx].calculate_auprc()
+
+    def _defined(self):
+        # a class with no positives or no negatives has undefined ROC;
+        # skip it rather than dragging the macro average toward 0
+        out = []
+        for r in self._rocs:
+            y = (np.concatenate(r.labels) if r.labels
+                 else np.zeros(0, bool))
+            if 0 < int(y.sum()) < y.size:
+                out.append(r)
+        return out
+
+    def calculate_average_auc(self) -> float:
+        rocs = self._defined()
+        if not rocs:
+            return 0.0
+        return float(np.mean([r.calculate_auc() for r in rocs]))
+
+    def calculate_average_auprc(self) -> float:
+        rocs = self._defined()
+        if not rocs:
+            return 0.0
+        return float(np.mean([r.calculate_auprc() for r in rocs]))
+
+
+class EvaluationCalibration:
+    """Reliability/calibration accumulator (reference
+    ``EvaluationCalibration``): confidence-binned counts and accuracies
+    (reliability diagram data), residual histogram, and expected
+    calibration error."""
+
+    def __init__(self, reliability_bins: int = 10,
+                 histogram_bins: int = 50):
+        self.bins = int(reliability_bins)
+        self.hist_bins = int(histogram_bins)
+        self.bin_counts = np.zeros(self.bins, np.int64)
+        self.bin_correct = np.zeros(self.bins, np.int64)
+        self.bin_conf_sum = np.zeros(self.bins, np.float64)
+        self.residual_hist = np.zeros(self.hist_bins, np.int64)
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        preds = np.asarray(predictions)
+        labels = labels.reshape(-1, labels.shape[-1])
+        preds = preds.reshape(-1, preds.shape[-1])
+        if mask is not None:
+            m = np.asarray(mask).reshape(-1).astype(bool)
+            labels, preds = labels[m], preds[m]
+        conf = preds.max(-1)
+        correct = preds.argmax(-1) == labels.argmax(-1)
+        idx = np.clip((conf * self.bins).astype(int), 0, self.bins - 1)
+        np.add.at(self.bin_counts, idx, 1)
+        np.add.at(self.bin_correct, idx, correct.astype(np.int64))
+        np.add.at(self.bin_conf_sum, idx, conf)
+        # residual = |label - prob| over all entries (reference residual plot)
+        resid = np.abs(labels - preds).reshape(-1)
+        h = np.clip((resid * self.hist_bins).astype(int), 0,
+                    self.hist_bins - 1)
+        np.add.at(self.residual_hist, h, 1)
+        return self
+
+    def reliability_accuracy(self) -> np.ndarray:
+        """Per-bin observed accuracy (nan for empty bins)."""
+        with np.errstate(invalid="ignore"):
+            return self.bin_correct / np.where(self.bin_counts, self.bin_counts,
+                                               np.nan)
+
+    def reliability_confidence(self) -> np.ndarray:
+        with np.errstate(invalid="ignore"):
+            return self.bin_conf_sum / np.where(self.bin_counts,
+                                                self.bin_counts, np.nan)
+
+    def expected_calibration_error(self) -> float:
+        total = self.bin_counts.sum()
+        if total == 0:
+            return 0.0
+        acc = np.nan_to_num(self.reliability_accuracy())
+        conf = np.nan_to_num(self.reliability_confidence())
+        return float(np.sum(self.bin_counts * np.abs(acc - conf)) / total)

@@ -1,0 +1,3 @@
+"""Model import (reference ``deeplearning4j-modelimport``)."""
+
+from deeplearning4j_tpu.modelimport.keras import KerasModelImport  # noqa: F401

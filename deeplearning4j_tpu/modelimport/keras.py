@@ -1,0 +1,311 @@
+"""Keras HDF5 model import.
+
+Reference: ``deeplearning4j-modelimport`` —
+``KerasModelImport#importKerasSequentialModelAndWeights`` (per-layer
+``KerasLayer`` subclasses map configuration + weights into the DL4J config
+DSL). Here the mapping targets the TPU config DSL; weight layouts line up
+naturally (Keras kernels are [in, out] / HWIO, exactly this framework's
+layouts — the reference has to transpose into its NCHW/ [out, in] forms).
+
+Supports the Keras 2.x HDF5 format (``model_config`` JSON attribute +
+``model_weights`` groups): Sequential models with InputLayer, Dense, Conv2D,
+MaxPooling2D, AveragePooling2D, Flatten, Dropout, Activation,
+BatchNormalization, LSTM, Embedding, GlobalAveragePooling2D. LSTM gates are
+re-packed from Keras' IFCO order into this framework's IFOG.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.conf import InputType
+from deeplearning4j_tpu.conf.activations import Activation as Act
+from deeplearning4j_tpu.conf.layers import (
+    ActivationLayer,
+    DenseLayer,
+    DropoutLayer,
+    EmbeddingSequenceLayer,
+    OutputLayer,
+)
+from deeplearning4j_tpu.conf.layers_cnn import (
+    BatchNormalization,
+    ConvolutionLayer,
+    ConvolutionMode,
+    GlobalPoolingLayer,
+    PoolingType,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.conf.layers_rnn import LSTM
+from deeplearning4j_tpu.conf.losses import LossMCXENT, LossMSE
+from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+
+_ACTIVATIONS = {
+    "linear": Act.IDENTITY, "relu": Act.RELU, "softmax": Act.SOFTMAX,
+    "tanh": Act.TANH, "sigmoid": Act.SIGMOID, "elu": Act.ELU,
+    "selu": Act.SELU, "softplus": Act.SOFTPLUS, "softsign": Act.SOFTSIGN,
+    "swish": Act.SWISH, "gelu": Act.GELU, "hard_sigmoid": Act.HARDSIGMOID,
+}
+
+
+class InvalidKerasConfigurationException(ValueError):
+    """Reference exception of the same name."""
+
+
+def _act(name: Optional[str]) -> Act:
+    if not name:
+        return Act.IDENTITY
+    try:
+        return _ACTIVATIONS[name]
+    except KeyError:
+        raise InvalidKerasConfigurationException(
+            f"unsupported Keras activation '{name}' "
+            f"(supported: {sorted(_ACTIVATIONS)})")
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (list, tuple)):
+        return int(v[0]), int(v[1])
+    return int(v), int(v)
+
+
+def _mode(padding: str) -> ConvolutionMode:
+    return (ConvolutionMode.SAME if padding == "same"
+            else ConvolutionMode.TRUNCATE)
+
+
+class KerasModelImport:
+    """Static import API (reference class of the same name)."""
+
+    @staticmethod
+    def import_keras_sequential_model_and_weights(
+            path: str, enforce_training_config: bool = False):
+        """-> initialized MultiLayerNetwork with the Keras weights."""
+        import h5py
+
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        with h5py.File(path, "r") as f:
+            raw = f.attrs.get("model_config")
+            if raw is None:
+                raise InvalidKerasConfigurationException(
+                    "no model_config attribute — not a Keras HDF5 file "
+                    "saved with model.save()")
+            if isinstance(raw, bytes):
+                raw = raw.decode()
+            model_cfg = json.loads(raw)
+            if model_cfg.get("class_name") != "Sequential":
+                raise InvalidKerasConfigurationException(
+                    "only Sequential models supported here; use "
+                    "import_keras_model_and_weights for functional models "
+                    "(not yet implemented)")
+            layer_cfgs = model_cfg["config"]["layers"]
+            conf, names = _build_conf(layer_cfgs)
+            net = MultiLayerNetwork(conf)
+            net.init()
+            _load_weights(f, net, names)
+        return net
+
+
+def _input_type(first_cfg: dict):
+    shape = (first_cfg.get("config", {}).get("batch_input_shape")
+             or first_cfg.get("config", {}).get("batch_shape"))
+    if shape is None:
+        raise InvalidKerasConfigurationException(
+            "first layer must carry batch_input_shape")
+    dims = [d for d in shape[1:]]
+    if len(dims) == 1:
+        return InputType.feed_forward(int(dims[0]))
+    if len(dims) == 2:
+        return InputType.recurrent(int(dims[1]), timesteps=int(dims[0] or -1))
+    if len(dims) == 3:  # Keras default channels_last == our NHWC
+        return InputType.convolutional(int(dims[0]), int(dims[1]),
+                                       int(dims[2]))
+    raise InvalidKerasConfigurationException(
+        f"unsupported input rank {len(dims) + 1}")
+
+
+def _build_conf(layer_cfgs: List[dict]):
+    """-> (MultiLayerConfiguration, [keras_name in parameterized order])"""
+    input_type = None
+    mapped: List[Tuple[str, object]] = []  # (keras_name, layer_conf)
+    pending_cfgs = list(layer_cfgs)
+
+    for i, lc in enumerate(pending_cfgs):
+        cls = lc["class_name"]
+        cfg = lc.get("config", {})
+        name = cfg.get("name", f"layer_{i}")
+        if input_type is None and cls != "InputLayer":
+            input_type = _input_type(lc)
+        if cls == "InputLayer":
+            input_type = _input_type(lc)
+            continue
+        if cls == "Dense":
+            is_last = all(c["class_name"] in ("Activation", "Dropout")
+                          for c in pending_cfgs[i + 1:])
+            act = _act(cfg.get("activation"))
+            if is_last and act is Act.SOFTMAX:
+                layer = OutputLayer(n_out=int(cfg["units"]), activation=act,
+                                    loss_fn=LossMCXENT(), name=name)
+            elif is_last:
+                layer = OutputLayer(n_out=int(cfg["units"]), activation=act,
+                                    loss_fn=LossMSE(), name=name)
+            else:
+                layer = DenseLayer(n_out=int(cfg["units"]), activation=act,
+                                   name=name)
+        elif cls == "Conv2D":
+            layer = ConvolutionLayer(
+                n_out=int(cfg["filters"]),
+                kernel_size=_pair(cfg.get("kernel_size", 3)),
+                stride=_pair(cfg.get("strides", 1)),
+                convolution_mode=_mode(cfg.get("padding", "valid")),
+                activation=_act(cfg.get("activation")),
+                has_bias=bool(cfg.get("use_bias", True)), name=name)
+        elif cls in ("MaxPooling2D", "AveragePooling2D"):
+            layer = SubsamplingLayer(
+                pooling_type=(PoolingType.MAX if cls == "MaxPooling2D"
+                              else PoolingType.AVG),
+                kernel_size=_pair(cfg.get("pool_size", 2)),
+                stride=_pair(cfg.get("strides") or cfg.get("pool_size", 2)),
+                convolution_mode=_mode(cfg.get("padding", "valid")),
+                name=name)
+        elif cls == "Flatten":
+            # shape inference inserts CnnToFeedForwardPreProcessor; nothing
+            # to add explicitly
+            continue
+        elif cls == "Dropout":
+            layer = DropoutLayer(dropout=1.0 - float(cfg.get("rate", 0.0)),
+                                 name=name)
+        elif cls == "Activation":
+            layer = ActivationLayer(activation=_act(cfg.get("activation")),
+                                    name=name)
+        elif cls == "BatchNormalization":
+            layer = BatchNormalization(
+                eps=float(cfg.get("epsilon", 1e-3)),
+                decay=float(cfg.get("momentum", 0.99)), name=name)
+        elif cls == "LSTM":
+            if not cfg.get("return_sequences", False):
+                raise InvalidKerasConfigurationException(
+                    "LSTM with return_sequences=False: wrap with "
+                    "LastTimeStep manually (not auto-mapped)")
+            layer = LSTM(n_out=int(cfg["units"]),
+                         activation=_act(cfg.get("activation", "tanh")),
+                         gate_activation=_act(
+                             cfg.get("recurrent_activation", "sigmoid")),
+                         name=name)
+        elif cls == "Embedding":
+            layer = EmbeddingSequenceLayer(
+                n_out=int(cfg["output_dim"]),
+                n_in=int(cfg["input_dim"]), name=name)
+        elif cls == "GlobalAveragePooling2D":
+            layer = GlobalPoolingLayer(pooling_type=PoolingType.AVG,
+                                       name=name)
+        else:
+            raise InvalidKerasConfigurationException(
+                f"unsupported Keras layer class '{cls}'")
+        mapped.append((name, layer))
+
+    # fold a trailing Activation into the preceding OutputLayer (the common
+    # Keras idiom Dense(units) + Activation('softmax')) — the last layer
+    # must be the scoring layer
+    while (len(mapped) >= 2 and isinstance(mapped[-1][1], ActivationLayer)
+           and isinstance(mapped[-2][1], OutputLayer)
+           and mapped[-2][1].activation is Act.IDENTITY):
+        act = mapped[-1][1].activation
+        out = mapped[-2][1]
+        out.activation = act
+        if act is Act.SOFTMAX:
+            out.loss_fn = LossMCXENT()
+        mapped = mapped[:-1]
+
+    if input_type is None:
+        raise InvalidKerasConfigurationException("no input shape found")
+    b = NeuralNetConfiguration.builder().seed(12345).list()
+    for _, layer in mapped:
+        b.layer(layer)
+    b.set_input_type(input_type)
+    conf = b.build()
+    return conf, [n for n, _ in mapped]
+
+
+def _weight_group(f, keras_name: str):
+    mw = f["model_weights"]
+    if keras_name not in mw:
+        return None
+    g = mw[keras_name]
+    # Keras nests again by layer name (e.g. model_weights/dense/dense/...)
+    datasets: Dict[str, np.ndarray] = {}
+
+    def visit(name, obj):
+        import h5py
+
+        if isinstance(obj, h5py.Dataset):
+            datasets[name.split("/")[-1].split(":")[0]] = np.asarray(obj)
+
+    g.visititems(visit)
+    return datasets
+
+
+def _load_weights(f, net, keras_names: List[str]):
+    import jax.numpy as jnp
+
+    # map keras layer names onto OUR parameterized layers in order
+    param_layers = [(i, l) for i, l in enumerate(net.conf.layers)
+                    if l.param_order()]
+    pi = 0
+    for name in keras_names:
+        ws = _weight_group(f, name)
+        if not ws:
+            continue
+        if pi >= len(param_layers):
+            break
+        idx, layer = param_layers[pi]
+        tgt = net.params[str(idx)]
+        cls = type(layer).__name__
+        if "kernel" in ws and cls in ("DenseLayer", "OutputLayer",
+                                      "ConvolutionLayer"):
+            _check_and_set(tgt, "W", ws["kernel"])
+            if "bias" in ws and "b" in tgt:
+                _check_and_set(tgt, "b", ws["bias"])
+        elif cls == "LSTM":
+            u = layer.n_out
+            _check_and_set(tgt, "W", _ifco_to_ifog(ws["kernel"], u))
+            _check_and_set(tgt, "RW",
+                           _ifco_to_ifog(ws["recurrent_kernel"], u))
+            if "bias" in ws:
+                _check_and_set(tgt, "b", _ifco_to_ifog(ws["bias"], u))
+        elif cls == "BatchNormalization":
+            _check_and_set(tgt, "gamma", ws["gamma"])
+            _check_and_set(tgt, "beta", ws["beta"])
+            st = net.state.get(str(idx), {})
+            if "mean" in st:
+                st["mean"] = jnp.asarray(ws["moving_mean"])
+                st["var"] = jnp.asarray(ws["moving_variance"])
+        elif cls == "EmbeddingSequenceLayer":
+            key = "embeddings" if "embeddings" in ws else "kernel"
+            _check_and_set(tgt, "W", ws[key])
+        else:
+            raise InvalidKerasConfigurationException(
+                f"no weight mapping for layer {cls} <- keras '{name}'")
+        pi += 1
+
+
+def _check_and_set(tgt: dict, key: str, value: np.ndarray):
+    import jax.numpy as jnp
+
+    if key not in tgt:
+        raise InvalidKerasConfigurationException(f"missing param {key}")
+    if tuple(tgt[key].shape) != tuple(value.shape):
+        raise InvalidKerasConfigurationException(
+            f"shape mismatch for {key}: model {tuple(tgt[key].shape)} vs "
+            f"h5 {tuple(value.shape)}")
+    tgt[key] = jnp.asarray(value)
+
+
+def _ifco_to_ifog(w: np.ndarray, units: int) -> np.ndarray:
+    """Keras packs LSTM gates [i, f, c, o]; this framework packs
+    [i, f, o, g(=c)] (layers_rnn.py gate order)."""
+    i, f_, c, o = np.split(w, 4, axis=-1)
+    return np.concatenate([i, f_, o, c], axis=-1)

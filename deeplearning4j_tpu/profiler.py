@@ -1,0 +1,113 @@
+"""Profiling / numerics debugging.
+
+Reference: ``org.nd4j.linalg.profiler.OpProfiler`` +
+``ProfilerConfig.builder()`` enabled via
+``Nd4j.getExecutioner().setProfilingConfig(...)`` — per-op timing
+aggregation and NAN_PANIC/INF_PANIC checks hooked around every op dispatch
+(SURVEY.md §5.1).
+
+TPU-native: per-op timing is meaningless under whole-graph XLA fusion, so
+the equivalent surfaces are (1) ``check_nan/check_inf`` → jax's
+``debug_nans``/``debug_infs`` (the compiled program re-runs un-jitted on
+the first bad value and pinpoints the primitive — a stronger NAN_PANIC),
+(2) step-level timing through ``ProfilerListener`` (step-time aggregation
+per compiled program, the role of per-op-class totals; use
+``PerformanceListener`` for ex/sec), and
+(3) XProf device traces via ``start_trace``/``stop_trace``
+(``jax.profiler``) for kernel-level inspection in TensorBoard.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+
+@dataclasses.dataclass
+class ProfilerConfig:
+    """Reference ``ProfilerConfig`` surface (the flags that translate)."""
+
+    check_for_nan: bool = False
+    check_for_inf: bool = False
+    collect_step_stats: bool = True
+
+
+class OpProfiler:
+    """Process-wide profiler (reference singleton
+    ``OpProfiler.getInstance()``)."""
+
+    _instance: Optional["OpProfiler"] = None
+
+    def __init__(self):
+        self.config = ProfilerConfig(False, False, False)
+        self._trace_dir: Optional[str] = None
+
+    @classmethod
+    def get_instance(cls) -> "OpProfiler":
+        if cls._instance is None:
+            cls._instance = OpProfiler()
+        return cls._instance
+
+    # -- reference: Nd4j.getExecutioner().setProfilingConfig(cfg) ------------
+    def set_config(self, config: ProfilerConfig) -> "OpProfiler":
+        self.config = config
+        jax.config.update("jax_debug_nans", bool(config.check_for_nan))
+        jax.config.update("jax_debug_infs", bool(config.check_for_inf))
+        return self
+
+    def reset(self) -> "OpProfiler":
+        return self.set_config(ProfilerConfig(False, False, False))
+
+    # -- XProf traces (per-kernel timing in TensorBoard) ---------------------
+    def start_trace(self, log_dir: str) -> "OpProfiler":
+        jax.profiler.start_trace(log_dir)
+        self._trace_dir = log_dir
+        return self
+
+    def stop_trace(self) -> Optional[str]:
+        if self._trace_dir is not None:
+            jax.profiler.stop_trace()
+            d, self._trace_dir = self._trace_dir, None
+            return d
+        return None
+
+
+class ProfilerListener(TrainingListener):
+    """Step-level timing aggregation (the fused-program analogue of the
+    reference's per-op-class totals printed by ``OpProfiler#printOutDashboard``)."""
+
+    def __init__(self, warmup_iterations: int = 1):
+        self.warmup = int(warmup_iterations)
+        self._last: Optional[float] = None
+        self.step_times: List[float] = []
+        self._seen = 0
+
+    def iteration_done(self, model, iteration, epoch, score):
+        now = time.monotonic()
+        self._seen += 1
+        if self._last is not None and self._seen > self.warmup:
+            self.step_times.append(now - self._last)
+        self._last = now
+
+    # -- reporting ------------------------------------------------------------
+    def mean_step_seconds(self) -> float:
+        return (sum(self.step_times) / len(self.step_times)
+                if self.step_times else float("nan"))
+
+    def total_seconds(self) -> float:
+        return sum(self.step_times)
+
+    def summary(self) -> str:
+        if not self.step_times:
+            return "ProfilerListener: no steps recorded"
+        ts = sorted(self.step_times)
+        p50 = ts[len(ts) // 2]
+        p95 = ts[min(len(ts) - 1, int(len(ts) * 0.95))]
+        return (f"steps={len(ts)} mean={self.mean_step_seconds()*1e3:.2f}ms "
+                f"p50={p50*1e3:.2f}ms p95={p95*1e3:.2f}ms "
+                f"total={self.total_seconds():.3f}s")

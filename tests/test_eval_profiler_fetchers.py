@@ -1,0 +1,171 @@
+"""Eval extras (ROCMultiClass, calibration), profiler, dataset fetchers."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.fetchers import (
+    Cifar10DataSetIterator,
+    EmnistDataSetIterator,
+    SvhnDataSetIterator,
+)
+from deeplearning4j_tpu.eval.evaluation import (
+    EvaluationCalibration,
+    ROC,
+    ROCMultiClass,
+)
+from deeplearning4j_tpu.profiler import (
+    OpProfiler,
+    ProfilerConfig,
+    ProfilerListener,
+)
+
+
+# --------------------------------------------------------------------------
+# eval extras
+# --------------------------------------------------------------------------
+
+def test_roc_multiclass_perfect_and_random(rng):
+    n, c = 400, 3
+    labels = np.eye(c, dtype=np.float32)[rng.integers(0, c, n)]
+    # perfect predictions
+    roc = ROCMultiClass()
+    roc.eval(labels, labels * 0.9 + 0.05)
+    assert roc.calculate_average_auc() == pytest.approx(1.0)
+    # random predictions ~ 0.5
+    r2 = ROCMultiClass()
+    r2.eval(labels, rng.random((n, c)).astype(np.float32))
+    assert 0.35 < r2.calculate_average_auc() < 0.65
+    assert 0.0 <= r2.calculate_auprc(0) <= 1.0
+
+
+def test_calibration_well_calibrated_vs_overconfident(rng):
+    n, c = 4000, 2
+    # well-calibrated: p = true probability used to draw the label
+    p = rng.uniform(0.5, 0.99, n)
+    y = (rng.random(n) < p).astype(int)
+    labels = np.eye(2, dtype=np.float32)[y]
+    preds = np.stack([1 - p, p], axis=1)
+    cal = EvaluationCalibration()
+    cal.eval(labels, preds)
+    ece_good = cal.expected_calibration_error()
+
+    # overconfident: always claims 0.99
+    preds_bad = np.stack([np.full(n, 0.01), np.full(n, 0.99)], axis=1)
+    cal2 = EvaluationCalibration()
+    cal2.eval(labels, preds_bad)
+    ece_bad = cal2.expected_calibration_error()
+    assert ece_good < 0.05 < ece_bad
+    acc = cal.reliability_accuracy()
+    conf = cal.reliability_confidence()
+    assert acc.shape == (10,) and conf.shape == (10,)
+
+
+# --------------------------------------------------------------------------
+# profiler
+# --------------------------------------------------------------------------
+
+def test_profiler_nan_panic_toggle():
+    import jax
+    import jax.numpy as jnp
+
+    prof = OpProfiler.get_instance()
+    prof.set_config(ProfilerConfig(check_for_nan=True))
+    with pytest.raises(FloatingPointError):
+        jax.jit(lambda x: jnp.log(x))(jnp.asarray(-1.0)).block_until_ready()
+    prof.reset()
+    # disabled again: silently produces nan
+    v = jax.jit(lambda x: jnp.log(x))(jnp.asarray(-1.0))
+    assert np.isnan(float(v))
+
+
+def test_profiler_listener_collects_steps():
+    from deeplearning4j_tpu.conf import Activation, InputType
+    from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.conf.losses import LossMCXENT
+    from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+    from deeplearning4j_tpu.conf.updaters import Sgd
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_out=4, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=2, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(3))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    pl = ProfilerListener(warmup_iterations=1)
+    net.set_listeners(pl)
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(size=(8, 3)).astype(np.float32),
+                 np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)])
+    for _ in range(5):
+        net.fit_batch(ds)
+    assert len(pl.step_times) == 4  # deltas between 5 iters, minus warmup
+    assert "mean=" in pl.summary()
+
+
+# --------------------------------------------------------------------------
+# fetchers
+# --------------------------------------------------------------------------
+
+def test_emnist_variants():
+    for variant, classes in (("digits", 10), ("letters", 26),
+                             ("balanced", 36)):
+        it = EmnistDataSetIterator(variant, batch=16, num_examples=64)
+        ds = next(iter(it))
+        assert ds.features.shape == (16, 28, 28, 1)
+        assert ds.labels.shape == (16, classes)
+    with pytest.raises(ValueError):
+        EmnistDataSetIterator("bogus", batch=4)
+
+
+def test_cifar10_and_svhn_shapes():
+    c = Cifar10DataSetIterator(batch=8, num_examples=32)
+    ds = next(iter(c))
+    assert ds.features.shape == (8, 32, 32, 3)
+    assert ds.labels.shape == (8, 10)
+    assert 0.0 <= ds.features.min() and ds.features.max() <= 1.0
+    s = SvhnDataSetIterator(batch=8, num_examples=32)
+    ds2 = next(iter(s))
+    assert ds2.features.shape == (8, 32, 32, 3)
+
+
+def test_synthetic_cifar_is_learnable():
+    from deeplearning4j_tpu.conf import Activation, InputType
+    from deeplearning4j_tpu.conf.layers import OutputLayer
+    from deeplearning4j_tpu.conf.layers_cnn import (
+        ConvolutionLayer, ConvolutionMode, PoolingType, SubsamplingLayer)
+    from deeplearning4j_tpu.conf.losses import LossMCXENT
+    from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+    from deeplearning4j_tpu.conf.updaters import Adam
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-3))
+            .list()
+            .layer(ConvolutionLayer(n_out=16, kernel_size=(3, 3),
+                                    stride=(2, 2),
+                                    activation=Activation.RELU,
+                                    convolution_mode=ConvolutionMode.SAME))
+            .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                    kernel_size=(2, 2), stride=(2, 2)))
+            .layer(OutputLayer(n_out=10, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.convolutional(32, 32, 3))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    train = Cifar10DataSetIterator(batch=64, num_examples=512, seed=5)
+    net.fit(train, epochs=6)
+    ev = net.evaluate(Cifar10DataSetIterator(batch=64, num_examples=256,
+                                             train=False, seed=5))
+    assert ev.accuracy() > 0.3  # well above 10% chance
+
+
+def test_roc_multiclass_skips_absent_classes(rng):
+    labels = np.eye(3, dtype=np.float32)[np.array([0, 1, 0, 1] * 20)]
+    preds = labels * 0.9 + 0.05  # perfect, class 2 never appears
+    roc = ROCMultiClass()
+    roc.eval(labels, preds)
+    assert roc.calculate_average_auc() == pytest.approx(1.0)

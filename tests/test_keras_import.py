@@ -1,0 +1,190 @@
+"""Keras HDF5 import (reference: modelimport golden tests, SURVEY.md §4).
+
+No TensorFlow in this env, so fixtures are handcrafted in the exact Keras
+2.x HDF5 layout (model_config JSON attr + model_weights groups) and the
+oracle is manual numpy forward math."""
+
+import json
+
+import h5py
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport.keras import (
+    InvalidKerasConfigurationException,
+    KerasModelImport,
+)
+
+
+def _write_keras_h5(path, model_cfg, weights):
+    """weights: {layer_name: {weight_name: array}} in Keras layout."""
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(model_cfg)
+        mw = f.create_group("model_weights")
+        for lname, ws in weights.items():
+            g = mw.create_group(lname).create_group(lname)
+            names = []
+            for wname, arr in ws.items():
+                g.create_dataset(wname, data=arr)
+                names.append(f"{lname}/{lname}/{wname}:0".encode())
+            mw[lname].attrs["weight_names"] = names
+
+
+def _dense_cfg(name, units, activation, input_shape=None):
+    cfg = {"name": name, "units": units, "activation": activation,
+           "use_bias": True}
+    if input_shape is not None:
+        cfg["batch_input_shape"] = [None] + list(input_shape)
+    return {"class_name": "Dense", "config": cfg}
+
+
+def test_import_dense_mlp(tmp_path, rng):
+    w1 = rng.normal(size=(4, 8)).astype(np.float32)
+    b1 = rng.normal(size=(8,)).astype(np.float32)
+    w2 = rng.normal(size=(8, 3)).astype(np.float32)
+    b2 = rng.normal(size=(3,)).astype(np.float32)
+    cfg = {"class_name": "Sequential", "config": {"name": "seq", "layers": [
+        _dense_cfg("dense", 8, "tanh", input_shape=[4]),
+        _dense_cfg("dense_1", 3, "softmax"),
+    ]}}
+    path = str(tmp_path / "mlp.h5")
+    _write_keras_h5(path, cfg, {
+        "dense": {"kernel": w1, "bias": b1},
+        "dense_1": {"kernel": w2, "bias": b2},
+    })
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = rng.normal(size=(5, 4)).astype(np.float32)
+    got = np.asarray(net.output(x))
+    h = np.tanh(x @ w1 + b1)
+    logits = h @ w2 + b2
+    want = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_import_cnn(tmp_path, rng):
+    k = rng.normal(size=(3, 3, 1, 4), scale=0.5).astype(np.float32)
+    kb = rng.normal(size=(4,)).astype(np.float32)
+    w = rng.normal(size=(4 * 4 * 4, 2)).astype(np.float32)  # after pool
+    b = rng.normal(size=(2,)).astype(np.float32)
+    cfg = {"class_name": "Sequential", "config": {"name": "cnn", "layers": [
+        {"class_name": "Conv2D", "config": {
+            "name": "conv2d", "filters": 4, "kernel_size": [3, 3],
+            "strides": [1, 1], "padding": "same", "activation": "relu",
+            "use_bias": True, "batch_input_shape": [None, 8, 8, 1]}},
+        {"class_name": "MaxPooling2D", "config": {
+            "name": "pool", "pool_size": [2, 2], "strides": [2, 2],
+            "padding": "valid"}},
+        {"class_name": "Flatten", "config": {"name": "flatten"}},
+        _dense_cfg("dense", 2, "softmax"),
+    ]}}
+    path = str(tmp_path / "cnn.h5")
+    _write_keras_h5(path, cfg, {
+        "conv2d": {"kernel": k, "bias": kb},
+        "dense": {"kernel": w, "bias": b},
+    })
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = rng.normal(size=(2, 8, 8, 1)).astype(np.float32)
+    got = np.asarray(net.output(x))
+    assert got.shape == (2, 2)
+    np.testing.assert_allclose(got.sum(-1), 1.0, rtol=1e-5)
+    # conv weights landed untransposed (HWIO == HWIO)
+    np.testing.assert_array_equal(np.asarray(net.params["0"]["W"]), k)
+
+
+def test_import_lstm_gate_reorder(tmp_path, rng):
+    u, fdim = 5, 3
+    kernel = rng.normal(size=(fdim, 4 * u)).astype(np.float32)
+    rec = rng.normal(size=(u, 4 * u)).astype(np.float32)
+    bias = rng.normal(size=(4 * u,)).astype(np.float32)
+    w2 = rng.normal(size=(u, 2)).astype(np.float32)
+    b2 = np.zeros(2, np.float32)
+    cfg = {"class_name": "Sequential", "config": {"name": "rnn", "layers": [
+        {"class_name": "LSTM", "config": {
+            "name": "lstm", "units": u, "activation": "tanh",
+            "recurrent_activation": "sigmoid", "return_sequences": True,
+            "batch_input_shape": [None, 7, fdim]}},
+        _dense_cfg("dense", 2, "softmax"),
+    ]}}
+    path = str(tmp_path / "rnn.h5")
+    _write_keras_h5(path, cfg, {
+        "lstm": {"kernel": kernel, "recurrent_kernel": rec, "bias": bias},
+        "dense": {"kernel": w2, "bias": b2},
+    })
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = rng.normal(size=(2, 7, fdim)).astype(np.float32)
+    got = np.asarray(net.output(x))
+    assert got.shape == (2, 7, 2)
+
+    # manual Keras-order LSTM forward as the oracle
+    def sigmoid(z):
+        return 1.0 / (1.0 + np.exp(-z))
+
+    ki, kf, kc, ko = np.split(kernel, 4, axis=1)
+    ri, rf, rc, ro = np.split(rec, 4, axis=1)
+    bi, bf, bc, bo = np.split(bias, 4)
+    h = np.zeros((2, u), np.float32)
+    c = np.zeros((2, u), np.float32)
+    outs = []
+    for t in range(7):
+        xt = x[:, t]
+        i = sigmoid(xt @ ki + h @ ri + bi)
+        f_ = sigmoid(xt @ kf + h @ rf + bf)
+        g = np.tanh(xt @ kc + h @ rc + bc)
+        o = sigmoid(xt @ ko + h @ ro + bo)
+        c = f_ * c + i * g
+        h = o * np.tanh(c)
+        outs.append(h.copy())
+    hs = np.stack(outs, 1)
+    logits = hs @ w2 + b2
+    want = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_import_rejects_functional_and_bad_layers(tmp_path):
+    path = str(tmp_path / "bad.h5")
+    _write_keras_h5(path, {"class_name": "Functional", "config": {}}, {})
+    with pytest.raises(InvalidKerasConfigurationException):
+        KerasModelImport.import_keras_sequential_model_and_weights(path)
+
+    cfg = {"class_name": "Sequential", "config": {"layers": [
+        {"class_name": "Conv3D", "config": {
+            "name": "c3", "batch_input_shape": [None, 4, 4, 4, 1]}}]}}
+    path2 = str(tmp_path / "bad2.h5")
+    _write_keras_h5(path2, cfg, {})
+    with pytest.raises(InvalidKerasConfigurationException):
+        KerasModelImport.import_keras_sequential_model_and_weights(path2)
+
+
+def test_import_shape_mismatch_raises(tmp_path, rng):
+    cfg = {"class_name": "Sequential", "config": {"layers": [
+        _dense_cfg("dense", 8, "tanh", input_shape=[4]),
+    ]}}
+    path = str(tmp_path / "mismatch.h5")
+    _write_keras_h5(path, cfg, {
+        "dense": {"kernel": np.zeros((5, 8), np.float32),
+                  "bias": np.zeros(8, np.float32)},
+    })
+    with pytest.raises(InvalidKerasConfigurationException):
+        KerasModelImport.import_keras_sequential_model_and_weights(path)
+
+
+def test_trailing_activation_folds_into_output(tmp_path, rng):
+    w1 = rng.normal(size=(4, 3)).astype(np.float32)
+    b1 = rng.normal(size=(3,)).astype(np.float32)
+    cfg = {"class_name": "Sequential", "config": {"layers": [
+        _dense_cfg("dense", 3, "linear", input_shape=[4]),
+        {"class_name": "Activation", "config": {"name": "act",
+                                                "activation": "softmax"}},
+    ]}}
+    path = str(tmp_path / "trail.h5")
+    _write_keras_h5(path, cfg, {"dense": {"kernel": w1, "bias": b1}})
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = rng.normal(size=(5, 4)).astype(np.float32)
+    got = np.asarray(net.output(x))
+    logits = x @ w1 + b1
+    want = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    # and it trains (the last layer IS the output layer)
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 5)]
+    net.fit_batch(DataSet(x, y))
