@@ -42,7 +42,12 @@ def main():
                    updater=Adam(learning_rate=1e-3)).init()
 
     rng = np.random.default_rng(42)
-    features = rng.normal(size=(BATCH, IMG, IMG, 3)).astype(np.float32)
+    # uint8 image batches: the realistic image-pipeline dtype. They cross
+    # the host->device link as bytes (4x less traffic — the link, not the
+    # MXU, bounds this chip's step time) and are dequantized to [0,1]
+    # floats INSIDE the compiled step (ImagePreProcessingScaler's math
+    # moved on-device).
+    features = rng.integers(0, 256, (BATCH, IMG, IMG, 3), dtype=np.uint8)
     labels = np.eye(CLASSES, dtype=np.float32)[rng.integers(0, CLASSES, BATCH)]
     ds = DataSet(features, labels)
 
@@ -75,7 +80,7 @@ def main():
     if METRIC not in baselines:
         baselines[METRIC] = {
             "value": images_per_sec,
-            "config": f"ResNet50 train, batch={BATCH}, {IMG}x{IMG}x3, "
+            "config": f"ResNet50 train, batch={BATCH}, {IMG}x{IMG}x3 uint8 in, "
                       f"{CLASSES} classes, f32 params (bf16 MXU passes)",
             "device": str(devices[0]),
         }

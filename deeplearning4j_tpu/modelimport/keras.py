@@ -277,8 +277,12 @@ def _load_weights(f, net, keras_names: List[str]):
             if "bias" in ws:
                 _check_and_set(tgt, "b", _ifco_to_ifog(ws["bias"], u))
         elif cls == "BatchNormalization":
-            _check_and_set(tgt, "gamma", ws["gamma"])
-            _check_and_set(tgt, "beta", ws["beta"])
+            n = tgt["gamma"].shape[0]
+            # Keras BN with scale=False / center=False omits gamma/beta
+            _check_and_set(tgt, "gamma",
+                           ws.get("gamma", np.ones(n, np.float32)))
+            _check_and_set(tgt, "beta",
+                           ws.get("beta", np.zeros(n, np.float32)))
             st = net.state.get(str(idx), {})
             if "mean" in st:
                 st["mean"] = jnp.asarray(ws["moving_mean"])

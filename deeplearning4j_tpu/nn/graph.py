@@ -22,6 +22,7 @@ from deeplearning4j_tpu.conf.graph import ComputationGraphConfiguration
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.datasets.iterators import DataSetIterator
 from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.nn import io as nn_io
 from deeplearning4j_tpu.optimize import solver
 from deeplearning4j_tpu.optimize.listeners import TrainingListener
 from deeplearning4j_tpu.util import params as params_util
@@ -126,6 +127,7 @@ class ComputationGraph:
 
     def _loss(self, params, state, features: Sequence, labels: Sequence,
               lmasks: Sequence, rng, train=True):
+        features = tuple(self._dequant(f) for f in features)
         out_specs = self._output_specs()
         acts, new_state = self._forward(params, state, features, train, rng,
                                         skip={s.name for s in out_specs})
@@ -237,11 +239,16 @@ class ComputationGraph:
             self.epoch += 1
         return self
 
+    def _dequant(self, x):
+        return nn_io.dequant(x, self._dtype)
+
     def _prep_batch(self, ds):
         mds = _as_multi(ds)
-        features = tuple(jnp.asarray(np.asarray(f), self._dtype)
+        # uint8 features transfer as uint8 and dequantize inside the jit;
+        # already-on-device arrays pass through without a host round-trip
+        features = tuple(nn_io.as_device(f, self._dtype, feature=True)
                          for f in mds.features)
-        labels = tuple(jnp.asarray(np.asarray(l), self._dtype)
+        labels = tuple(nn_io.as_device(l, self._dtype)
                        for l in mds.labels)
         n_out = len(labels)
         if mds.labels_masks is not None:
@@ -283,16 +290,16 @@ class ComputationGraph:
             self.init()
         if self._output_fn is None:
             def out(params, state, xs):
+                xs = tuple(self._dequant(x) for x in xs)
                 acts, _ = self._forward(params, state, xs, train=False,
                                         rng=None)
                 return tuple(acts[n] for n in self.conf.network_outputs)
 
             self._output_fn = jax.jit(out)
-        # keep jax.Arrays as-is (preserves committed shardings, e.g. from
-        # ParallelInference); only host data goes through numpy
-        xs = tuple(
-            x.astype(self._dtype) if isinstance(x, jax.Array)
-            else jnp.asarray(np.asarray(x), self._dtype) for x in inputs)
+        # jax.Arrays pass through (keeps committed shardings); uint8
+        # features dequantize inside the jit, matching training
+        xs = tuple(nn_io.as_device(x, self._dtype, feature=True)
+                   for x in inputs)
         outs = self._output_fn(self.params, self.state, xs)
         return outs[0] if len(outs) == 1 else list(outs)
 

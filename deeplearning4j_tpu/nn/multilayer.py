@@ -23,6 +23,7 @@ import numpy as np
 
 from deeplearning4j_tpu.conf.multilayer import MultiLayerConfiguration
 from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn import io as nn_io
 from deeplearning4j_tpu.datasets.iterators import (
     ArrayDataSetIterator,
     DataSetIterator,
@@ -133,8 +134,12 @@ class MultiLayerNetwork:
                 "(reference: fit() requires an IOutputLayer)")
         return last
 
+    def _dequant(self, x):
+        return nn_io.dequant(x, self._dtype)
+
     def _loss(self, params, state, features, labels, fmask, lmask, rng,
               train=True, carries=None):
+        features = self._dequant(features)
         out_layer = self._output_layer()
         last = len(self.conf.layers) - 1
         x, new_state, new_carries = self._forward(
@@ -219,8 +224,8 @@ class MultiLayerNetwork:
 
     def _build_output_fn(self):
         def out(params, state, x, fmask):
-            y, _, _ = self._forward(params, state, x, train=False, rng=None,
-                                    fmask=fmask)
+            y, _, _ = self._forward(params, state, self._dequant(x),
+                                    train=False, rng=None, fmask=fmask)
             return y
 
         return jax.jit(out)
@@ -264,12 +269,12 @@ class MultiLayerNetwork:
         return self
 
     def _batch_arrays(self, ds: DataSet):
-        features = jnp.asarray(np.asarray(ds.features), self._dtype)
-        labels = jnp.asarray(np.asarray(ds.labels), self._dtype)
-        fmask = (jnp.asarray(np.asarray(ds.features_mask), self._dtype)
+        features = nn_io.as_device(ds.features, self._dtype, feature=True)
+        labels = nn_io.as_device(ds.labels, self._dtype)
+        fmask = (nn_io.as_device(ds.features_mask, self._dtype)
                  if ds.features_mask is not None else None)
         if ds.labels_mask is not None:
-            lmask = jnp.asarray(np.asarray(ds.labels_mask), self._dtype)
+            lmask = nn_io.as_device(ds.labels_mask, self._dtype)
         else:
             lmask = jnp.ones((features.shape[0],), self._dtype)
         return features, labels, fmask, lmask
@@ -423,15 +428,12 @@ class MultiLayerNetwork:
             self.init()
         if self._output_fn is None:
             self._output_fn = self._build_output_fn()
-        # keep jax.Arrays as-is (preserves any committed sharding, e.g.
-        # ParallelInference's P('data') placement); only host data goes
-        # through numpy
-        x = (x.astype(self._dtype) if isinstance(x, jax.Array)
-             else jnp.asarray(np.asarray(x), self._dtype))
+        # jax.Arrays pass through (keeps committed shardings); uint8
+        # features stay uint8 and dequantize inside the jit, matching
+        # training
+        x = nn_io.as_device(x, self._dtype, feature=True)
         if fmask is not None:
-            fmask = (fmask.astype(self._dtype)
-                     if isinstance(fmask, jax.Array)
-                     else jnp.asarray(np.asarray(fmask), self._dtype))
+            fmask = nn_io.as_device(fmask, self._dtype)
         return self._output_fn(self.params, self.state, x, fmask)
 
     def score(self, ds: DataSet = None) -> float:

@@ -169,3 +169,41 @@ def test_roc_multiclass_skips_absent_classes(rng):
     roc = ROCMultiClass()
     roc.eval(labels, preds)
     assert roc.calculate_average_auc() == pytest.approx(1.0)
+
+
+def test_u8_train_and_evaluate_consistent(rng):
+    """uint8 batches must see the SAME [0,1] dequantization in fit, score,
+    output, and evaluate (regression: output() used to cast u8 to raw
+    0-255 floats)."""
+    from deeplearning4j_tpu.conf import Activation, InputType
+    from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.conf.losses import LossMCXENT
+    from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+    from deeplearning4j_tpu.conf.updaters import Adam
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=2, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    x8 = rng.integers(0, 256, (32, 4), dtype=np.uint8)
+    y = np.eye(2, dtype=np.float32)[(x8[:, 0] > 127).astype(int)]
+    ds = DataSet(x8, y)
+    for _ in range(40):
+        net.fit_batch(ds)
+    # output on uint8 must match output on the dequantized floats
+    out_u8 = np.asarray(net.output(x8))
+    out_f = np.asarray(net.output(x8.astype(np.float32) / 255.0))
+    np.testing.assert_allclose(out_u8, out_f, rtol=1e-5, atol=1e-6)
+    # and evaluate agrees with training-time performance
+    ev = net.evaluate(ArrayDataSetIterator(x8, y, batch=32))
+    assert ev.accuracy() > 0.8
+    # score() path too
+    assert np.isfinite(net.score(ds))

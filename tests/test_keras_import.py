@@ -188,3 +188,24 @@ def test_trailing_activation_folds_into_output(tmp_path, rng):
     from deeplearning4j_tpu.datasets.dataset import DataSet
     y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 5)]
     net.fit_batch(DataSet(x, y))
+
+
+def test_bn_scale_false_imports(tmp_path, rng):
+    cfg = {"class_name": "Sequential", "config": {"layers": [
+        {"class_name": "BatchNormalization", "config": {
+            "name": "bn", "epsilon": 1e-3, "momentum": 0.99,
+            "batch_input_shape": [None, 4]}},
+        _dense_cfg("dense", 2, "softmax"),
+    ]}}
+    path = str(tmp_path / "bn.h5")
+    # scale=False: no gamma saved
+    _write_keras_h5(path, cfg, {
+        "bn": {"beta": np.zeros(4, np.float32),
+               "moving_mean": np.zeros(4, np.float32),
+               "moving_variance": np.ones(4, np.float32)},
+        "dense": {"kernel": rng.normal(size=(4, 2)).astype(np.float32),
+                  "bias": np.zeros(2, np.float32)},
+    })
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    np.testing.assert_array_equal(np.asarray(net.params["0"]["gamma"]),
+                                  np.ones(4, np.float32))
