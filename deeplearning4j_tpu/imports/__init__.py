@@ -1,0 +1,7 @@
+"""Model import from foreign graph formats.
+
+Reference: ``org.nd4j.imports`` — ``TFGraphMapper`` (frozen TensorFlow
+GraphDef -> SameDiff) and the partial ``OnnxGraphMapper``.
+"""
+
+from deeplearning4j_tpu.imports.tf import TFGraphMapper  # noqa: F401

@@ -1,0 +1,310 @@
+"""TensorFlow frozen-graph import.
+
+Reference: ``org.nd4j.imports.graphmapper.tf.TFGraphMapper#importGraph`` —
+maps a frozen GraphDef (protobuf) into SameDiff with per-op mappings and
+attribute translation (SURVEY.md §2.2). Here the target is the TPU
+SameDiff-equivalent; the protobuf schema is a vendored wire-compatible
+subset (``protos/tf_graph.proto``), so no TensorFlow installation is
+needed. TF's NHWC/HWIO layouts are ALSO this framework's native layouts, so
+conv/pool weights and attributes map without transposition (the reference
+must convert to NCHW).
+
+Supported ops: Placeholder, Const, Identity/StopGradient/NoOp, MatMul,
+BiasAdd, Add/AddV2/Sub/Mul/RealDiv/Maximum/Minimum/SquaredDifference,
+Relu/Relu6/Tanh/Sigmoid/Elu/Selu/Softplus/Exp/Log/Sqrt/Rsqrt/Square/Neg/
+Abs, Softmax, Conv2D, DepthwiseConv2dNative, MaxPool, AvgPool, FusedBatchNorm(V2/V3)
+(inference), Reshape, Squeeze, ExpandDims, Transpose, ConcatV2, Pad, Mean/
+Sum/Max/Min/Prod (reductions), ArgMax, Shape (static), Pack.
+Unsupported ops raise ``UnsupportedTFOpException`` listing the node.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.imports.protos import tf_graph_pb2 as pb
+from deeplearning4j_tpu.samediff import ops as _ops  # noqa: F401  (registers ops)
+from deeplearning4j_tpu.samediff.core import SameDiff, SDVariable
+
+_DTYPES = {
+    pb.DT_FLOAT: np.float32, pb.DT_DOUBLE: np.float64,
+    pb.DT_INT32: np.int32, pb.DT_INT64: np.int64, pb.DT_BOOL: np.bool_,
+    pb.DT_UINT8: np.uint8, pb.DT_INT8: np.int8, pb.DT_INT16: np.int16,
+    pb.DT_BFLOAT16: np.float32,  # widened on import
+    pb.DT_HALF: np.float16,
+}
+
+
+class UnsupportedTFOpException(ValueError):
+    """Reference: unmapped ops fail import with the op name listed."""
+
+
+def _tensor_to_np(t: "pb.TensorProto") -> np.ndarray:
+    dtype = _DTYPES.get(t.dtype)
+    if dtype is None:
+        raise UnsupportedTFOpException(f"unsupported tensor dtype {t.dtype}")
+    shape = tuple(d.size for d in t.tensor_shape.dim)
+    if t.tensor_content:
+        if t.dtype == pb.DT_BFLOAT16:
+            import ml_dtypes
+
+            arr = np.frombuffer(t.tensor_content,
+                                ml_dtypes.bfloat16).astype(np.float32)
+        elif t.dtype == pb.DT_HALF:
+            arr = np.frombuffer(t.tensor_content, np.float16)
+        else:
+            arr = np.frombuffer(t.tensor_content, dtype=dtype)
+        return arr.reshape(shape).copy()
+    if t.dtype in (pb.DT_HALF, pb.DT_BFLOAT16) and len(t.half_val):
+        # half/bfloat16 scalars live in half_val as raw 16-bit patterns
+        bits = np.asarray(list(t.half_val), np.uint16)
+        if t.dtype == pb.DT_HALF:
+            arr = bits.view(np.float16).astype(np.float32)
+        else:
+            import ml_dtypes
+
+            arr = bits.view(ml_dtypes.bfloat16).astype(np.float32)
+        if shape:
+            arr = (np.broadcast_to(arr, shape).copy() if arr.size == 1
+                   else arr.reshape(shape))
+        return arr
+    for field in ("float_val", "double_val", "int_val", "int64_val",
+                  "bool_val", "uint32_val", "uint64_val"):
+        vals = getattr(t, field)
+        if len(vals):
+            arr = np.asarray(list(vals), dtype=dtype)
+            if shape:
+                if arr.size == 1:
+                    arr = np.broadcast_to(arr, shape).copy()
+                else:
+                    arr = arr.reshape(shape)
+            return arr
+    return np.zeros(shape, dtype)
+
+
+def _clean(name: str) -> str:
+    """strip ':0' output suffixes and '^' control markers."""
+    if name.startswith("^"):
+        return ""
+    return name.split(":")[0]
+
+
+_BINARY = {"Add": "add", "AddV2": "add", "Sub": "sub", "Mul": "mul",
+           "RealDiv": "div", "Div": "div", "Maximum": "maximum",
+           "Minimum": "minimum", "SquaredDifference": "squared_difference",
+           "Pow": "pow", "FloorDiv": "floordiv", "Greater": "gt",
+           "GreaterEqual": "gte", "Less": "lt", "LessEqual": "lte",
+           "Equal": "eq"}
+# values are REGISTRY keys (activations live under nn., the rest math.)
+_UNARY = {"Relu": "nn.relu", "Tanh": "nn.tanh", "Sigmoid": "nn.sigmoid",
+          "Elu": "nn.elu", "Selu": "nn.selu", "Softplus": "nn.softplus",
+          "Exp": "math.exp", "Log": "math.log", "Sqrt": "math.sqrt",
+          "Rsqrt": "math.rsqrt", "Square": "math.square",
+          "Neg": "math.neg", "Abs": "math.abs", "Floor": "math.floor",
+          "Ceil": "math.ceil", "Sign": "math.sign", "Erf": "math.erf"}
+_REDUCE = {"Mean": "mean", "Sum": "sum", "Max": "amax", "Min": "amin",
+           "Prod": "prod"}
+
+
+def _require_nhwc(node):
+    df = node.attr["data_format"].s.decode() if node.attr[
+        "data_format"].s else "NHWC"
+    if df not in ("NHWC", ""):
+        raise UnsupportedTFOpException(
+            f"node {node.name!r} ({node.op}) uses data_format={df!r}; only "
+            f"NHWC graphs import (re-freeze with NHWC, or transpose)")
+
+
+class TFGraphMapper:
+    """Static import API (reference class of the same name)."""
+
+    @staticmethod
+    def import_graph(path_or_bytes) -> SameDiff:
+        """Frozen GraphDef (path or serialized bytes) -> SameDiff."""
+        if isinstance(path_or_bytes, (bytes, bytearray)):
+            data = bytes(path_or_bytes)
+        else:
+            with open(path_or_bytes, "rb") as f:
+                data = f.read()
+        graph = pb.GraphDef()
+        graph.ParseFromString(data)
+        return _Mapper(graph).run()
+
+
+class _Mapper:
+    def __init__(self, graph: "pb.GraphDef"):
+        self.graph = graph
+        self.sd = SameDiff.create()
+        # tf node name -> our variable name
+        self.names: dict[str, str] = {}
+        # Const node name -> numpy value (for static attrs: shapes, axes...)
+        self.const_np: dict[str, np.ndarray] = {}
+
+    # -- helpers -------------------------------------------------------------
+    def _inputs(self, node) -> list[str]:
+        return [c for c in (_clean(i) for i in node.input) if c]
+
+    def _var(self, tf_name: str) -> SDVariable:
+        return SDVariable(self.sd, self.names[tf_name])
+
+    def _static(self, tf_name: str, node) -> np.ndarray:
+        if tf_name not in self.const_np:
+            raise UnsupportedTFOpException(
+                f"node {node.name!r} ({node.op}) needs a Const input "
+                f"{tf_name!r} (dynamic shapes/axes are not importable)")
+        return self.const_np[tf_name]
+
+    def _bind(self, node, var: SDVariable):
+        # give the produced variable the TF node's name when free
+        if node.name not in self.sd.variables:
+            self.sd.rename_variable(var.name, node.name)
+            self.names[node.name] = node.name
+        else:
+            self.names[node.name] = var.name
+
+    # -- main ----------------------------------------------------------------
+    def run(self) -> SameDiff:
+        for node in self.graph.node:
+            self._map_node(node)
+        return self.sd
+
+    def _map_node(self, node):
+        sd, op = self.sd, node.op
+        ins = self._inputs(node)
+
+        if op == "Placeholder":
+            shape = None
+            if node.attr["shape"].HasField("shape"):
+                shape = tuple(d.size if d.size > 0 else None
+                              for d in node.attr["shape"].shape.dim) or None
+            v = sd.placeholder(node.name, shape=shape)
+            self.names[node.name] = v.name
+        elif op == "Const":
+            arr = _tensor_to_np(node.attr["value"].tensor)
+            self.const_np[node.name] = arr
+            v = sd.constant(arr, name=node.name)
+            self.names[node.name] = v.name
+        elif op in ("Identity", "StopGradient", "PreventGradient", "NoOp",
+                    "CheckNumerics"):
+            if ins:
+                self.names[node.name] = self.names[ins[0]]
+                # frozen graphs route Consts through 'w/read' Identities;
+                # static operands (shapes, axes, kernels) must survive
+                if ins[0] in self.const_np:
+                    self.const_np[node.name] = self.const_np[ins[0]]
+        elif op == "MatMul":
+            v = sd._op("math.matmul",
+                       [self._var(ins[0]), self._var(ins[1])],
+                       transpose_a=node.attr["transpose_a"].b,
+                       transpose_b=node.attr["transpose_b"].b)[0]
+            self._bind(node, v)
+        elif op == "BiasAdd":
+            v = sd._op("nn.biasAdd",
+                       [self._var(ins[0]), self._var(ins[1])])[0]
+            self._bind(node, v)
+        elif op in _BINARY:
+            v = sd._op(f"math.{_BINARY[op]}",
+                       [self._var(ins[0]), self._var(ins[1])])[0]
+            self._bind(node, v)
+        elif op in _UNARY:
+            v = sd._op(_UNARY[op], [self._var(ins[0])])[0]
+            self._bind(node, v)
+        elif op == "Relu6":
+            v = sd._op("math.clip_by_value", [self._var(ins[0])],
+                       lo=0.0, hi=6.0)[0]
+            self._bind(node, v)
+        elif op == "Softmax":
+            v = sd._op("nn.softmax", [self._var(ins[0])], axis=-1)[0]
+            self._bind(node, v)
+        elif op == "Conv2D":
+            _require_nhwc(node)
+            strides = tuple(node.attr["strides"].list.i)[1:3]
+            padding = node.attr["padding"].s.decode() or "SAME"
+            dil = tuple(node.attr["dilations"].list.i or (1, 1, 1, 1))[1:3]
+            x, w = self._var(ins[0]), self._var(ins[1])
+            zero = sd.constant(np.zeros((1,), np.float32))
+            v = sd._op("cnn.conv2d", [x, w, zero], strides=strides,
+                       padding=padding, dilation=dil)[0]
+            self._bind(node, v)
+        elif op == "DepthwiseConv2dNative":
+            _require_nhwc(node)
+            strides = tuple(node.attr["strides"].list.i)[1:3]
+            padding = node.attr["padding"].s.decode() or "SAME"
+            x, w = self._var(ins[0]), self._var(ins[1])
+            # TF depthwise kernel [H,W,C,mult] -> HWIO with grouping
+            wnp = self.const_np.get(ins[1])
+            if wnp is None:
+                raise UnsupportedTFOpException(
+                    f"{node.name}: depthwise kernels must be Const")
+            h, wd, c, m = wnp.shape
+            w2 = sd.constant(wnp.reshape(h, wd, 1, c * m), name=ins[1] + "_hwio")
+            zero = sd.constant(np.zeros((1,), np.float32))
+            v = sd._op("cnn.depthwiseConv2d", [x, w2, zero],
+                       strides=strides, padding=padding)[0]
+            self._bind(node, v)
+        elif op in ("MaxPool", "AvgPool"):
+            _require_nhwc(node)
+            k = tuple(node.attr["ksize"].list.i)[1:3]
+            s = tuple(node.attr["strides"].list.i)[1:3]
+            padding = node.attr["padding"].s.decode() or "VALID"
+            impl = "cnn.maxPooling2d" if op == "MaxPool" else "cnn.avgPooling2d"
+            v = sd._op(impl, [self._var(ins[0])], k=k, s=s,
+                       padding=padding)[0]
+            self._bind(node, v)
+        elif op in ("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"):
+            _require_nhwc(node)
+            eps = node.attr["epsilon"].f or 1e-3
+            x, gamma, beta, mean, var_ = (self._var(i) for i in ins[:5])
+            v = sd._op("nn.batchNorm", [x, mean, var_, gamma, beta],
+                       axis=-1, eps=float(eps))[0]
+            self._bind(node, v)
+        elif op == "Reshape":
+            shape = tuple(int(v) for v in self._static(ins[1], node))
+            v = sd._op("reshape", [self._var(ins[0])], shape=shape)[0]
+            self._bind(node, v)
+        elif op == "Squeeze":
+            dims = tuple(node.attr["squeeze_dims"].list.i)
+            v = sd._op("squeeze", [self._var(ins[0])],
+                       axis=dims or None)[0]
+            self._bind(node, v)
+        elif op == "ExpandDims":
+            axis = int(self._static(ins[1], node))
+            v = sd._op("expand_dims", [self._var(ins[0])], axis=axis)[0]
+            self._bind(node, v)
+        elif op == "Transpose":
+            perm = tuple(int(v) for v in self._static(ins[1], node))
+            v = sd._op("permute", [self._var(ins[0])], dims=perm)[0]
+            self._bind(node, v)
+        elif op == "ConcatV2":
+            axis = int(self._static(ins[-1], node))
+            v = sd._op("concat", [self._var(i) for i in ins[:-1]],
+                       axis=axis)[0]
+            self._bind(node, v)
+        elif op == "Pack":
+            axis = int(node.attr["axis"].i)
+            v = sd._op("stack", [self._var(i) for i in ins], axis=axis)[0]
+            self._bind(node, v)
+        elif op == "Pad":
+            pads = [tuple(int(x) for x in row)
+                    for row in self._static(ins[1], node)]
+            v = sd._op("nn.pad", [self._var(ins[0])], paddings=pads,
+                       mode="constant", value=0.0)[0]
+            self._bind(node, v)
+        elif op in _REDUCE:
+            axes = self._static(ins[1], node)
+            axis = tuple(int(a) for a in np.atleast_1d(axes))
+            keep = bool(node.attr["keep_dims"].b)
+            v = sd._op(f"reduce.{_REDUCE[op]}", [self._var(ins[0])],
+                       axis=axis, keepdims=keep)[0]
+            self._bind(node, v)
+        elif op == "ArgMax":
+            axis = int(self._static(ins[1], node))
+            v = sd._op("math.argmax", [self._var(ins[0])], axis=axis)[0]
+            self._bind(node, v)
+        elif op == "Shape":
+            v = sd._op("shape_of", [self._var(ins[0])])[0]
+            self._bind(node, v)
+        else:
+            raise UnsupportedTFOpException(
+                f"unmapped TF op {op!r} at node {node.name!r} "
+                f"(reference TFGraphMapper raises the same way)")

@@ -127,7 +127,8 @@ class ComputationGraph:
 
     def _loss(self, params, state, features: Sequence, labels: Sequence,
               lmasks: Sequence, rng, train=True):
-        features = tuple(self._dequant(f) for f in features)
+        features = tuple(self._dequant(f, i)
+                         for i, f in enumerate(features))
         out_specs = self._output_specs()
         acts, new_state = self._forward(params, state, features, train, rng,
                                         skip={s.name for s in out_specs})
@@ -239,8 +240,10 @@ class ComputationGraph:
             self.epoch += 1
         return self
 
-    def _dequant(self, x):
-        return nn_io.dequant(x, self._dtype)
+    def _dequant(self, x, idx: int = 0):
+        scale = (nn_io.image_input(self.conf.input_types[idx])
+                 if idx < len(self.conf.input_types) else True)
+        return nn_io.dequant(x, self._dtype, scale=scale)
 
     def _prep_batch(self, ds):
         mds = _as_multi(ds)
@@ -290,7 +293,7 @@ class ComputationGraph:
             self.init()
         if self._output_fn is None:
             def out(params, state, xs):
-                xs = tuple(self._dequant(x) for x in xs)
+                xs = tuple(self._dequant(x, i) for i, x in enumerate(xs))
                 acts, _ = self._forward(params, state, xs, train=False,
                                         rng=None)
                 return tuple(acts[n] for n in self.conf.network_outputs)

@@ -37,8 +37,19 @@ def as_device(a, dtype, feature: bool = False):
     return jax.device_put(a)
 
 
-def dequant(x, dtype):
-    """In-jit dequantization of uint8 features to [0, 1] floats."""
+def dequant(x, dtype, scale: bool = True):
+    """In-jit conversion of uint8 features: image-shaped inputs scale to
+    [0, 1] (``scale=True``); integer-valued inputs (e.g. embedding token
+    ids) just cast, preserving their values."""
     if x.dtype == jnp.uint8:
-        return x.astype(dtype) * (1.0 / 255.0)
+        x = x.astype(dtype)
+        return x * (1.0 / 255.0) if scale else x
     return x
+
+
+def image_input(input_type) -> bool:
+    """Whether a network InputType is image-shaped (uint8 batches then mean
+    pixels, dequantized to [0,1]); non-image uint8 (token ids) only cast."""
+    from deeplearning4j_tpu.conf import inputs as it
+
+    return isinstance(input_type, (it.Convolutional, it.ConvolutionalFlat))

@@ -135,7 +135,8 @@ class MultiLayerNetwork:
         return last
 
     def _dequant(self, x):
-        return nn_io.dequant(x, self._dtype)
+        return nn_io.dequant(x, self._dtype,
+                             scale=nn_io.image_input(self.conf.input_type))
 
     def _loss(self, params, state, features, labels, fmask, lmask, rng,
               train=True, carries=None):
@@ -233,8 +234,8 @@ class MultiLayerNetwork:
     def _build_rnn_step_fn(self):
         def out(params, state, carries, x, fmask):
             y, _, new_carries = self._forward(
-                params, state, x, train=False, rng=None, fmask=fmask,
-                carries=carries)
+                params, state, self._dequant(x), train=False, rng=None,
+                fmask=fmask, carries=carries)
             return y, new_carries
 
         return jax.jit(out)
@@ -389,7 +390,7 @@ class MultiLayerNetwork:
                     "UnsupportedOperationException here)")
         if self._rnn_step_fn is None:
             self._rnn_step_fn = self._build_rnn_step_fn()
-        x = jnp.asarray(np.asarray(x), self._dtype)
+        x = nn_io.as_device(x, self._dtype, feature=True)
         if x.ndim == 2:  # single timestep [batch, f]
             x = x[:, None, :]
         n = x.shape[0]

@@ -264,12 +264,16 @@ class TransferLearningHelper:
 
     def featurize(self, ds: DataSet) -> DataSet:
         """Forward through the frozen front (reference ``featurize``)."""
-        x = jnp.asarray(np.asarray(ds.features))
-        fmask = None if ds.features_mask is None else jnp.asarray(
-            np.asarray(ds.features_mask))
-        out, _, _ = self._net._forward(self._net.params, self._net.state, x,
-                                       train=False, rng=None, fmask=fmask,
-                                       upto=self._split)
+        from deeplearning4j_tpu.nn import io as nn_io
+
+        net = self._net
+        x = net._dequant(nn_io.as_device(ds.features, net._dtype,
+                                         feature=True))
+        fmask = None if ds.features_mask is None else nn_io.as_device(
+            ds.features_mask, net._dtype)
+        out, _, _ = net._forward(net.params, net.state, x,
+                                 train=False, rng=None, fmask=fmask,
+                                 upto=self._split)
         return DataSet(np.asarray(out), ds.labels, ds.features_mask,
                        ds.labels_mask)
 

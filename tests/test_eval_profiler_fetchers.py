@@ -172,9 +172,9 @@ def test_roc_multiclass_skips_absent_classes(rng):
 
 
 def test_u8_train_and_evaluate_consistent(rng):
-    """uint8 batches must see the SAME [0,1] dequantization in fit, score,
-    output, and evaluate (regression: output() used to cast u8 to raw
-    0-255 floats)."""
+    """uint8 batches must see the SAME conversion in fit, score, output,
+    and evaluate (feed-forward input: plain cast — the [0,1] scaling is
+    keyed to image-shaped InputTypes)."""
     from deeplearning4j_tpu.conf import Activation, InputType
     from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
     from deeplearning4j_tpu.conf.losses import LossMCXENT
@@ -198,12 +198,60 @@ def test_u8_train_and_evaluate_consistent(rng):
     ds = DataSet(x8, y)
     for _ in range(40):
         net.fit_batch(ds)
-    # output on uint8 must match output on the dequantized floats
+    # output on uint8 must match output on the plain-cast floats
     out_u8 = np.asarray(net.output(x8))
-    out_f = np.asarray(net.output(x8.astype(np.float32) / 255.0))
+    out_f = np.asarray(net.output(x8.astype(np.float32)))
     np.testing.assert_allclose(out_u8, out_f, rtol=1e-5, atol=1e-6)
-    # and evaluate agrees with training-time performance
+    # and evaluate agrees with training-time performance (raw 0-255
+    # inputs saturate tanh, so the bar is modest; consistency is the point)
     ev = net.evaluate(ArrayDataSetIterator(x8, y, batch=32))
-    assert ev.accuracy() > 0.8
+    assert ev.accuracy() > 0.7
     # score() path too
     assert np.isfinite(net.score(ds))
+
+
+def test_u8_token_ids_not_scaled(rng):
+    """uint8 inputs to NON-image networks (e.g. embedding token ids) must
+    keep their integer values (regression: blanket /255 broke embeddings)."""
+    from deeplearning4j_tpu.conf import Activation, InputType
+    from deeplearning4j_tpu.conf.layers import (EmbeddingSequenceLayer)
+    from deeplearning4j_tpu.conf.layers_rnn import RnnOutputLayer
+    from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+    from deeplearning4j_tpu.conf.updaters import Adam
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+            .list()
+            .layer(EmbeddingSequenceLayer(n_in=50, n_out=8))
+            .layer(RnnOutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(1, timesteps=6))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    ids8 = rng.integers(0, 50, (4, 6), dtype=np.uint8)
+    out_u8 = np.asarray(net.output(ids8))
+    out_int = np.asarray(net.output(ids8.astype(np.int32)))
+    np.testing.assert_allclose(out_u8, out_int, rtol=1e-5)
+
+
+def test_u8_rnn_time_step_matches_output(rng):
+    from deeplearning4j_tpu.conf import Activation, InputType
+    from deeplearning4j_tpu.conf.layers_rnn import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+    from deeplearning4j_tpu.conf.updaters import Adam
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+            .list()
+            .layer(LSTM(n_out=6))
+            .layer(RnnOutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(3, timesteps=5))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    x = rng.normal(size=(2, 5, 3)).astype(np.float32)
+    full = np.asarray(net.output(x))
+    net.rnn_clear_previous_state()
+    stream = np.concatenate(
+        [np.asarray(net.rnn_time_step(x[:, t])) for t in range(5)], axis=1)
+    np.testing.assert_allclose(stream, full, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,223 @@
+"""TF frozen-graph import (reference ``TFGraphTestAllSameDiff`` conformance
+suite, SURVEY.md §4 — goldens are numpy-math oracles since no TF exists in
+this env; graphs are built with the vendored wire-compatible protos)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.imports.protos import tf_graph_pb2 as pb
+from deeplearning4j_tpu.imports.tf import (
+    TFGraphMapper,
+    UnsupportedTFOpException,
+)
+
+
+def _const(g, name, arr):
+    arr = np.asarray(arr)
+    n = g.node.add()
+    n.name = name
+    n.op = "Const"
+    dt = {np.dtype(np.float32): pb.DT_FLOAT,
+          np.dtype(np.int32): pb.DT_INT32}[arr.dtype]
+    n.attr["dtype"].type = dt
+    t = n.attr["value"].tensor
+    t.dtype = dt
+    for d in arr.shape:
+        t.tensor_shape.dim.add().size = d
+    t.tensor_content = arr.tobytes()
+    return n
+
+
+def _node(g, name, op, *inputs, **attrs):
+    n = g.node.add()
+    n.name = name
+    n.op = op
+    n.input.extend(inputs)
+    for k, v in attrs.items():
+        if isinstance(v, bool):
+            n.attr[k].b = v
+        elif isinstance(v, bytes):
+            n.attr[k].s = v
+        elif isinstance(v, int):
+            n.attr[k].i = v
+        elif isinstance(v, float):
+            n.attr[k].f = v
+        elif isinstance(v, (list, tuple)):
+            n.attr[k].list.i.extend(v)
+    return n
+
+
+def _placeholder(g, name, shape):
+    n = g.node.add()
+    n.name = name
+    n.op = "Placeholder"
+    n.attr["dtype"].type = pb.DT_FLOAT
+    sh = n.attr["shape"].shape
+    for d in shape:
+        sh.dim.add().size = d if d else -1
+    return n
+
+
+def test_import_mlp(rng):
+    w1 = rng.normal(size=(4, 8)).astype(np.float32)
+    b1 = rng.normal(size=(8,)).astype(np.float32)
+    w2 = rng.normal(size=(8, 3)).astype(np.float32)
+    b2 = rng.normal(size=(3,)).astype(np.float32)
+    g = pb.GraphDef()
+    _placeholder(g, "input", (0, 4))
+    _const(g, "w1", w1)
+    _const(g, "b1", b1)
+    _const(g, "w2", w2)
+    _const(g, "b2", b2)
+    _node(g, "mm1", "MatMul", "input", "w1",
+          transpose_a=False, transpose_b=False)
+    _node(g, "add1", "BiasAdd", "mm1", "b1")
+    _node(g, "relu1", "Relu", "add1")
+    _node(g, "mm2", "MatMul", "relu1", "w2")
+    _node(g, "logits", "BiasAdd", "mm2", "b2")
+    _node(g, "probs", "Softmax", "logits")
+
+    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    x = rng.normal(size=(5, 4)).astype(np.float32)
+    out = sd.output({"input": x}, "probs")["probs"]
+    h = np.maximum(x @ w1 + b1, 0.0)
+    logits = h @ w2 + b2
+    want = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-6)
+
+
+def test_import_cnn(rng):
+    k = rng.normal(size=(3, 3, 2, 4), scale=0.3).astype(np.float32)
+    g = pb.GraphDef()
+    _placeholder(g, "img", (0, 8, 8, 2))
+    _const(g, "kernel", k)
+    _node(g, "conv", "Conv2D", "img", "kernel",
+          strides=[1, 1, 1, 1], padding=b"SAME")
+    _node(g, "relu", "Relu", "conv")
+    _node(g, "pool", "MaxPool", "relu",
+          ksize=[1, 2, 2, 1], strides=[1, 2, 2, 1], padding=b"VALID")
+    _const(g, "axes", np.asarray([1, 2], np.int32))
+    _node(g, "gap", "Mean", "pool", "axes", keep_dims=False)
+
+    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    x = rng.normal(size=(2, 8, 8, 2)).astype(np.float32)
+    out = np.asarray(sd.output({"img": x}, "gap")["gap"])
+    assert out.shape == (2, 4)
+    # oracle via jax reference conv
+    import jax
+
+    ref = jax.lax.conv_general_dilated(
+        x, k, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    ref = np.maximum(np.asarray(ref), 0)
+    ref = ref.reshape(2, 4, 2, 4, 2, 4)[:, :, :, :, :, :]
+    pooled = ref.reshape(2, 4, 2, 4, 2, 4).max(axis=(2, 4))
+    np.testing.assert_allclose(out, pooled.mean(axis=(1, 2)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_import_reshape_concat_reduce(rng):
+    g = pb.GraphDef()
+    _placeholder(g, "a", (0, 4))
+    _placeholder(g, "b", (0, 4))
+    _const(g, "shape", np.asarray([-1, 2, 2], np.int32))
+    _node(g, "r", "Reshape", "a", "shape")
+    _const(g, "ax", np.asarray(1, np.int32))
+    _node(g, "cat", "ConcatV2", "a", "b", "ax")
+    _const(g, "rax", np.asarray([1], np.int32))
+    _node(g, "m", "Mean", "cat", "rax", keep_dims=True)
+    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    a = rng.normal(size=(3, 4)).astype(np.float32)
+    b = rng.normal(size=(3, 4)).astype(np.float32)
+    outs = sd.output({"a": a, "b": b}, "r", "cat", "m")
+    assert np.asarray(outs["r"]).shape == (3, 2, 2)
+    np.testing.assert_allclose(np.asarray(outs["cat"]),
+                               np.concatenate([a, b], 1), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(outs["m"]),
+        np.concatenate([a, b], 1).mean(1, keepdims=True), rtol=1e-5)
+
+
+def test_import_fused_batchnorm(rng):
+    g = pb.GraphDef()
+    _placeholder(g, "x", (0, 4, 4, 3))
+    _const(g, "gamma", np.asarray([1.0, 2.0, 0.5], np.float32))
+    _const(g, "beta", np.asarray([0.1, -0.1, 0.0], np.float32))
+    _const(g, "mean", np.asarray([0.5, -0.5, 0.0], np.float32))
+    _const(g, "var", np.asarray([1.0, 4.0, 0.25], np.float32))
+    _node(g, "bn", "FusedBatchNormV3", "x", "gamma", "beta", "mean", "var",
+          epsilon=1e-3)
+    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    x = rng.normal(size=(2, 4, 4, 3)).astype(np.float32)
+    out = np.asarray(sd.output({"x": x}, "bn")["bn"])
+    want = ((x - [0.5, -0.5, 0.0]) / np.sqrt(np.asarray([1.0, 4.0, 0.25])
+                                             + 1e-3)
+            * [1.0, 2.0, 0.5] + [0.1, -0.1, 0.0])
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_identity_and_control_inputs(rng):
+    g = pb.GraphDef()
+    _placeholder(g, "x", (0, 3))
+    _node(g, "id", "Identity", "x")
+    _node(g, "sq", "Square", "id", "^x")  # control input ignored
+    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    x = rng.normal(size=(2, 3)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(sd.output({"x": x}, "sq")["sq"]),
+                               x * x, rtol=1e-6)
+
+
+def test_unsupported_op_raises():
+    g = pb.GraphDef()
+    _placeholder(g, "x", (0, 3))
+    _node(g, "w", "WeirdCustomOp", "x")
+    with pytest.raises(UnsupportedTFOpException) as e:
+        TFGraphMapper.import_graph(g.SerializeToString())
+    assert "WeirdCustomOp" in str(e.value)
+
+
+def test_dynamic_reshape_rejected(rng):
+    g = pb.GraphDef()
+    _placeholder(g, "x", (0, 4))
+    _placeholder(g, "shape", (2,))
+    _node(g, "r", "Reshape", "x", "shape")
+    with pytest.raises(UnsupportedTFOpException):
+        TFGraphMapper.import_graph(g.SerializeToString())
+
+
+def test_const_through_identity(rng):
+    g = pb.GraphDef()
+    _placeholder(g, "x", (0, 4))
+    _const(g, "shape_c", np.asarray([-1, 2, 2], np.int32))
+    _node(g, "shape_c/read", "Identity", "shape_c")
+    _node(g, "r", "Reshape", "x", "shape_c/read")
+    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    out = sd.output({"x": rng.normal(size=(3, 4)).astype(np.float32)}, "r")
+    assert np.asarray(out["r"]).shape == (3, 2, 2)
+
+
+def test_nchw_graph_rejected(rng):
+    g = pb.GraphDef()
+    _placeholder(g, "x", (0, 3, 8, 8))
+    _const(g, "k", rng.normal(size=(3, 3, 3, 4)).astype(np.float32))
+    n = _node(g, "conv", "Conv2D", "x", "k",
+              strides=[1, 1, 1, 1], padding=b"SAME")
+    n.attr["data_format"].s = b"NCHW"
+    with pytest.raises(UnsupportedTFOpException):
+        TFGraphMapper.import_graph(g.SerializeToString())
+
+
+def test_bfloat16_const_decodes():
+    import ml_dtypes
+
+    vals = np.asarray([1.5, -2.25, 0.5, 3.0], np.float32)
+    g = pb.GraphDef()
+    n = g.node.add()
+    n.name = "c"
+    n.op = "Const"
+    n.attr["dtype"].type = pb.DT_BFLOAT16
+    t = n.attr["value"].tensor
+    t.dtype = pb.DT_BFLOAT16
+    t.tensor_shape.dim.add().size = 4
+    t.tensor_content = vals.astype(ml_dtypes.bfloat16).tobytes()
+    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    np.testing.assert_allclose(np.asarray(sd.arrays["c"]), vals)
