@@ -4,4 +4,5 @@ Reference: ``org.nd4j.imports`` — ``TFGraphMapper`` (frozen TensorFlow
 GraphDef -> SameDiff) and the partial ``OnnxGraphMapper``.
 """
 
+from deeplearning4j_tpu.imports.onnx import OnnxGraphMapper  # noqa: F401
 from deeplearning4j_tpu.imports.tf import TFGraphMapper  # noqa: F401

@@ -1,0 +1,343 @@
+"""ONNX model import (partial, like the reference).
+
+Reference: ``org.nd4j.imports.graphmapper.onnx.OnnxGraphMapper`` — the
+reference's ONNX mapper is explicitly partial/skeleton compared to its TF
+path (SURVEY.md §2.2); this importer covers the common inference op set and
+raises ``UnsupportedOnnxOpException`` for the rest.
+
+ONNX graphs are NCHW; they import in their native layout (the samediff
+conv/pool ops take ``fmt="NCHW"`` and XLA re-lays-out during compilation),
+so weights (OIHW) land untransposed. Protobuf schema is a vendored
+wire-compatible subset (``protos/onnx_model.proto``) — no onnx package
+needed.
+
+Supported: Constant/initializers, Gemm, MatMul, Conv (incl. groups),
+Relu/Sigmoid/Tanh/Elu/Softplus/Exp/Log/Sqrt/Neg/Abs/LeakyRelu, Softmax,
+Add/Sub/Mul/Div/Pow, MaxPool/AveragePool/GlobalAveragePool,
+BatchNormalization (inference), Reshape, Flatten, Concat, Transpose,
+Identity, Squeeze/Unsqueeze, ReduceMean/ReduceSum/ReduceMax/ReduceMin,
+Clip, Dropout (inference pass-through), Pad (constant).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.imports.protos import onnx_model_pb2 as ox
+from deeplearning4j_tpu.samediff import ops as _ops  # noqa: F401
+from deeplearning4j_tpu.samediff.core import SameDiff, SDVariable
+
+_DTYPES = {1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16,
+           5: np.int16, 6: np.int32, 7: np.int64, 9: np.bool_,
+           10: np.float16, 11: np.float64}
+
+
+class UnsupportedOnnxOpException(ValueError):
+    pass
+
+
+def _tensor_to_np(t: "ox.TensorProto") -> np.ndarray:
+    dtype = _DTYPES.get(t.data_type)
+    if dtype is None:
+        if t.data_type == 16:  # BFLOAT16
+            import ml_dtypes
+
+            arr = np.frombuffer(t.raw_data, ml_dtypes.bfloat16)
+            return arr.astype(np.float32).reshape(tuple(t.dims)).copy()
+        raise UnsupportedOnnxOpException(
+            f"unsupported ONNX tensor dtype {t.data_type}")
+    shape = tuple(t.dims)
+    if t.raw_data:
+        return np.frombuffer(t.raw_data, dtype).reshape(shape).copy()
+    for field, ftype in (("float_data", np.float32),
+                         ("int32_data", np.int32),
+                         ("int64_data", np.int64),
+                         ("double_data", np.float64),
+                         ("uint64_data", np.uint64)):
+        vals = getattr(t, field)
+        if len(vals):
+            return np.asarray(list(vals), ftype).astype(dtype).reshape(shape)
+    return np.zeros(shape, dtype)
+
+
+def _attrs(node) -> dict:
+    out = {}
+    for a in node.attribute:
+        if a.type == 1:      # FLOAT
+            out[a.name] = a.f
+        elif a.type == 2:    # INT
+            out[a.name] = int(a.i)
+        elif a.type == 3:    # STRING
+            out[a.name] = a.s.decode()
+        elif a.type == 4:    # TENSOR
+            out[a.name] = _tensor_to_np(a.t)
+        elif a.type == 6:    # FLOATS
+            out[a.name] = tuple(a.floats)
+        elif a.type == 7:    # INTS
+            out[a.name] = tuple(int(v) for v in a.ints)
+        else:
+            out[a.name] = None
+    return out
+
+
+_UNARY = {"Relu": "nn.relu", "Sigmoid": "nn.sigmoid", "Tanh": "nn.tanh",
+          "Elu": "nn.elu", "Softplus": "nn.softplus", "Exp": "math.exp",
+          "Log": "math.log", "Sqrt": "math.sqrt", "Neg": "math.neg",
+          "Abs": "math.abs", "Erf": "math.erf", "Floor": "math.floor",
+          "Ceil": "math.ceil"}
+_BINARY = {"Add": "math.add", "Sub": "math.sub", "Mul": "math.mul",
+           "Div": "math.div", "Pow": "math.pow"}
+_REDUCE = {"ReduceMean": "reduce.mean", "ReduceSum": "reduce.sum",
+           "ReduceMax": "reduce.amax", "ReduceMin": "reduce.amin"}
+
+
+class OnnxGraphMapper:
+    """Static import API (reference class of the same name)."""
+
+    @staticmethod
+    def import_graph(path_or_bytes) -> SameDiff:
+        if isinstance(path_or_bytes, (bytes, bytearray)):
+            data = bytes(path_or_bytes)
+        else:
+            with open(path_or_bytes, "rb") as f:
+                data = f.read()
+        model = ox.ModelProto()
+        model.ParseFromString(data)
+        return _Mapper(model.graph).run()
+
+
+class _Mapper:
+    def __init__(self, graph: "ox.GraphProto"):
+        self.graph = graph
+        self.sd = SameDiff.create()
+        self.names: dict[str, str] = {}
+        self.const_np: dict[str, np.ndarray] = {}
+
+    def _var(self, name: str) -> SDVariable:
+        return SDVariable(self.sd, self.names[name])
+
+    def _static(self, name: str, node) -> np.ndarray:
+        if name not in self.const_np:
+            raise UnsupportedOnnxOpException(
+                f"node {node.name or node.op_type!r} needs a static "
+                f"initializer input {name!r}")
+        return self.const_np[name]
+
+    def _bind(self, out_name: str, var: SDVariable):
+        if out_name not in self.sd.variables:
+            self.sd.rename_variable(var.name, out_name)
+            self.names[out_name] = out_name
+        else:
+            self.names[out_name] = var.name
+
+    def run(self) -> SameDiff:
+        init_names = set()
+        for t in self.graph.initializer:
+            arr = _tensor_to_np(t)
+            self.const_np[t.name] = arr
+            v = self.sd.constant(arr, name=t.name)
+            self.names[t.name] = v.name
+            init_names.add(t.name)
+        for vi in self.graph.input:
+            if vi.name in init_names:
+                continue
+            shape = None
+            tt = vi.type.tensor_type
+            if tt.shape.dim:
+                shape = tuple(
+                    d.dim_value if d.WhichOneof("value") == "dim_value"
+                    and d.dim_value > 0 else None for d in tt.shape.dim)
+            v = self.sd.placeholder(vi.name, shape=shape)
+            self.names[vi.name] = v.name
+        for node in self.graph.node:
+            self._map_node(node)
+        # exporters often rename the final output via Identity; make every
+        # declared graph output addressable in the returned SameDiff
+        for vi in self.graph.output:
+            if vi.name not in self.sd.variables and vi.name in self.names:
+                self._bind(vi.name, self.sd._op(
+                    "identity", [self._var(vi.name)])[0])
+        return self.sd
+
+    def _map_node(self, node):
+        sd, op = self.sd, node.op_type
+        # ONNX encodes omitted optional inputs as empty strings
+        ins = [i for i in node.input if i]
+        outs = list(node.output)
+        at = _attrs(node)
+
+        if op == "Constant":
+            arr = at.get("value")
+            if arr is None:
+                raise UnsupportedOnnxOpException(
+                    f"Constant node {node.name!r} without tensor value")
+            self.const_np[outs[0]] = np.asarray(arr)
+            v = sd.constant(arr, name=outs[0])
+            self.names[outs[0]] = v.name
+        elif op == "Identity" or op == "Dropout":
+            self.names[outs[0]] = self.names[ins[0]]
+        elif op in _UNARY:
+            self._bind(outs[0], sd._op(_UNARY[op], [self._var(ins[0])])[0])
+        elif op in _BINARY:
+            self._bind(outs[0], sd._op(
+                _BINARY[op], [self._var(ins[0]), self._var(ins[1])])[0])
+        elif op == "LeakyRelu":
+            self._bind(outs[0], sd._op(
+                "nn.leakyRelu", [self._var(ins[0])],
+                alpha=at.get("alpha", 0.01))[0])
+        elif op == "Clip":
+            raw = list(node.input)
+            lo = (float(self._static(raw[1], node))
+                  if len(raw) > 1 and raw[1] else -np.inf)
+            hi = (float(self._static(raw[2], node))
+                  if len(raw) > 2 and raw[2] else np.inf)
+            lo = at.get("min", lo) if "min" in at else lo
+            hi = at.get("max", hi) if "max" in at else hi
+            self._bind(outs[0], sd._op(
+                "math.clip_by_value", [self._var(ins[0])], lo=lo, hi=hi)[0])
+        elif op == "Softmax":
+            self._bind(outs[0], sd._op(
+                "nn.softmax", [self._var(ins[0])],
+                axis=at.get("axis", -1))[0])
+        elif op == "MatMul":
+            self._bind(outs[0], sd._op(
+                "math.matmul", [self._var(ins[0]), self._var(ins[1])],
+                transpose_a=False, transpose_b=False)[0])
+        elif op == "Gemm":
+            a, b = self._var(ins[0]), self._var(ins[1])
+            y = sd._op("math.matmul", [a, b],
+                       transpose_a=bool(at.get("transA", 0)),
+                       transpose_b=bool(at.get("transB", 0)))[0]
+            alpha, beta = at.get("alpha", 1.0), at.get("beta", 1.0)
+            if alpha != 1.0:
+                y = sd._op("math.mul", [y, sd.constant(
+                    np.float32(alpha))])[0]
+            if len(ins) > 2:
+                c = self._var(ins[2])
+                if beta != 1.0:
+                    c = sd._op("math.mul", [c, sd.constant(
+                        np.float32(beta))])[0]
+                y = sd._op("math.add", [y, c])[0]
+            self._bind(outs[0], y)
+        elif op == "Conv":
+            strides = at.get("strides", (1, 1))
+            dil = at.get("dilations", (1, 1))
+            groups = at.get("group", 1)
+            pads = at.get("pads")
+            if at.get("auto_pad") == "SAME_LOWER":
+                raise UnsupportedOnnxOpException(
+                    f"{node.name or op}: auto_pad=SAME_LOWER pads at the "
+                    f"START; XLA SAME is SAME_UPPER — re-export with "
+                    f"explicit pads")
+            if at.get("auto_pad") == "SAME_UPPER":
+                padding = "SAME"
+            elif pads and any(pads):
+                padding = [(pads[0], pads[2]), (pads[1], pads[3])]
+            else:
+                padding = "VALID"
+            x, w = self._var(ins[0]), self._var(ins[1])
+            b = (self._var(ins[2]) if len(ins) > 2
+                 else sd.constant(np.zeros(1, np.float32)))
+            self._bind(outs[0], sd._op(
+                "cnn.conv2d", [x, w, b], strides=tuple(strides),
+                padding=padding, dilation=tuple(dil), fmt="NCHW",
+                groups=int(groups))[0])
+        elif op in ("MaxPool", "AveragePool"):
+            k = at["kernel_shape"]
+            s = at.get("strides", k)
+            pads = at.get("pads")
+            if at.get("auto_pad") == "SAME_LOWER":
+                raise UnsupportedOnnxOpException(
+                    f"{node.name or op}: auto_pad=SAME_LOWER unsupported "
+                    f"(XLA SAME is SAME_UPPER)")
+            if at.get("ceil_mode") or (op == "AveragePool"
+                                       and at.get("count_include_pad")):
+                raise UnsupportedOnnxOpException(
+                    f"{node.name or op}: ceil_mode/count_include_pad "
+                    f"unsupported")
+            if at.get("auto_pad") == "SAME_UPPER":
+                padding = "SAME"
+            elif pads and any(pads):
+                padding = [(0, 0), (0, 0), (pads[0], pads[2]),
+                           (pads[1], pads[3])]
+            else:
+                padding = "VALID"
+            impl = ("cnn.maxPooling2d" if op == "MaxPool"
+                    else "cnn.avgPooling2d")
+            self._bind(outs[0], sd._op(
+                impl, [self._var(ins[0])], k=tuple(k), s=tuple(s),
+                padding=padding, fmt="NCHW")[0])
+        elif op == "GlobalAveragePool":
+            self._bind(outs[0], sd._op(
+                "reduce.mean", [self._var(ins[0])], axis=(2, 3),
+                keepdims=True)[0])
+        elif op == "BatchNormalization":
+            eps = at.get("epsilon", 1e-5)
+            x = self._var(ins[0])
+            gamma, beta, mean, var_ = (self._var(i) for i in ins[1:5])
+            self._bind(outs[0], sd._op(
+                "nn.batchNorm", [x, mean, var_, gamma, beta], axis=1,
+                eps=float(eps))[0])
+        elif op == "Reshape":
+            shape = tuple(int(v) for v in self._static(ins[1], node))
+            self._bind(outs[0], sd._op(
+                "reshape_onnx", [self._var(ins[0])], shape=shape)[0])
+        elif op == "Flatten":
+            axis = at.get("axis", 1)
+            if axis != 1:
+                raise UnsupportedOnnxOpException(
+                    f"Flatten axis={axis} unsupported")
+            self._bind(outs[0],
+                       sd._op("flatten2d", [self._var(ins[0])])[0])
+        elif op == "Concat":
+            self._bind(outs[0], sd._op(
+                "concat", [self._var(i) for i in ins],
+                axis=at.get("axis", 0))[0])
+        elif op == "Transpose":
+            perm = at.get("perm")
+            if perm:
+                v = sd._op("permute", [self._var(ins[0])],
+                           dims=tuple(perm))[0]
+            else:
+                v = sd._op("transpose", [self._var(ins[0])])[0]
+            self._bind(outs[0], v)
+        elif op == "Squeeze":
+            axes = (tuple(at["axes"]) if "axes" in at and at["axes"]
+                    else (tuple(int(v) for v in self._static(ins[1], node))
+                          if len(ins) > 1 else None))
+            self._bind(outs[0], sd._op(
+                "squeeze", [self._var(ins[0])], axis=axes)[0])
+        elif op == "Unsqueeze":
+            axes = (tuple(at["axes"]) if "axes" in at and at["axes"]
+                    else tuple(int(v) for v in self._static(ins[1], node)))
+            self._bind(outs[0], sd._op(
+                "unsqueeze_onnx", [self._var(ins[0])], axes=axes)[0])
+        elif op in _REDUCE:
+            axes = at.get("axes")
+            if axes is None and len(ins) > 1:
+                axes = tuple(int(v) for v in self._static(ins[1], node))
+            keep = bool(at.get("keepdims", 1))
+            self._bind(outs[0], sd._op(
+                _REDUCE[op], [self._var(ins[0])],
+                axis=tuple(axes) if axes else None, keepdims=keep)[0])
+        elif op == "Pad":
+            mode = at.get("mode", "constant")
+            if mode != "constant":
+                raise UnsupportedOnnxOpException(f"Pad mode {mode!r}")
+            pads = at.get("pads")
+            if pads is None:
+                pads = tuple(int(v) for v in self._static(ins[1], node))
+            value = float(at.get("value", 0.0) or 0.0)
+            raw = list(node.input)
+            if len(raw) > 2 and raw[2]:  # opset 11+ constant_value input
+                value = float(self._static(raw[2], node))
+            n = len(pads) // 2
+            paddings = [(int(pads[i]), int(pads[i + n])) for i in range(n)]
+            self._bind(outs[0], sd._op(
+                "nn.pad", [self._var(ins[0])], paddings=paddings,
+                mode="constant", value=value)[0])
+        else:
+            raise UnsupportedOnnxOpException(
+                f"unmapped ONNX op {op!r} at node "
+                f"{node.name or outs[0]!r} (the reference's OnnxGraphMapper "
+                f"is likewise partial)")

@@ -110,6 +110,34 @@ class SDVariable:
         """Value of a VARIABLE/CONSTANT (reference ``SDVariable#getArr``)."""
         return self.sd.arrays[self._name]
 
+    def convert_to_variable(self) -> "SDVariable":
+        """CONSTANT -> trainable VARIABLE (reference
+        ``SDVariable#convertToVariable``) — how imported frozen weights
+        become fine-tunable."""
+        meta = self.sd.variables[self._name]
+        if meta.var_type == VariableType.CONSTANT:
+            meta.var_type = VariableType.VARIABLE
+            self.sd._fn_cache.clear()
+            # the trainable set changed: updater state must re-initialize
+            self.sd._updater_state = None
+        elif meta.var_type != VariableType.VARIABLE:
+            raise ValueError(
+                f"{self._name} is {meta.var_type}, not CONSTANT")
+        return self
+
+    def convert_to_constant(self) -> "SDVariable":
+        """VARIABLE -> frozen CONSTANT (reference
+        ``SDVariable#convertToConstant``)."""
+        meta = self.sd.variables[self._name]
+        if meta.var_type == VariableType.VARIABLE:
+            meta.var_type = VariableType.CONSTANT
+            self.sd._fn_cache.clear()
+            self.sd._updater_state = None
+        elif meta.var_type != VariableType.CONSTANT:
+            raise ValueError(
+                f"{self._name} is {meta.var_type}, not VARIABLE")
+        return self
+
     def set_arr(self, value):
         self.sd.arrays[self._name] = jnp.asarray(value)
         return self
@@ -653,6 +681,33 @@ def _init_array(shape, weight_init, dtype, key):
 
 
 # ---- structural op impls (registered) ----
+
+@register_op("identity")
+def _op_identity(x):
+    return x
+
+
+@register_op("reshape_onnx")
+def _op_reshape_onnx(x, *, shape):
+    """ONNX Reshape semantics: 0 copies the input dim, -1 infers."""
+    shape = tuple(x.shape[i] if s == 0 else s for i, s in enumerate(shape))
+    return x.reshape(shape)
+
+
+@register_op("unsqueeze_onnx")
+def _op_unsqueeze_onnx(x, *, axes):
+    """ONNX Unsqueeze: axes are relative to the OUTPUT rank."""
+    out_rank = x.ndim + len(axes)
+    for a in sorted(a % out_rank for a in axes):
+        x = jnp.expand_dims(x, a)
+    return x
+
+
+@register_op("flatten2d")
+def _op_flatten2d(x):
+    """[b, ...] -> [b, prod(...)] (ONNX Flatten / Keras Flatten)."""
+    return x.reshape(x.shape[0], -1)
+
 
 @register_op("reshape")
 def _op_reshape(x, *, shape):

@@ -444,12 +444,18 @@ def _sd_pad(self, x, paddings, mode="constant", value=0.0, name=None):
 # ======================= cnn =======================
 
 @register_op("cnn.conv2d")
-def _conv2d(x, w, b, *, strides, padding, dilation):
-    """NHWC x HWIO -> NHWC (TPU-native layout; reference defaults NCHW —
-    layout conversion is the importer's job, not the runtime's)."""
+def _conv2d(x, w, b, *, strides, padding, dilation, fmt="NHWC", groups=1):
+    """Default NHWC x HWIO -> NHWC (TPU-native layout). ``fmt="NCHW"``
+    supports imported ONNX graphs (weights then OIHW); XLA transposes into
+    its preferred layout during compilation either way."""
+    dn = (("NCHW", "OIHW", "NCHW") if fmt == "NCHW"
+          else ("NHWC", "HWIO", "NHWC"))
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=strides, padding=padding,
-        rhs_dilation=dilation, dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
+    if fmt == "NCHW":
+        return out + b.reshape(1, -1, 1, 1)
     return out + b
 
 
@@ -472,18 +478,22 @@ def _dwconv2d(x, w, b, *, strides, padding):
 
 
 @register_op("cnn.maxPooling2d")
-def _maxpool2d(x, *, k, s, padding):
+def _maxpool2d(x, *, k, s, padding, fmt="NHWC"):
+    dims = (1, 1, *k) if fmt == "NCHW" else (1, *k, 1)
+    strd = (1, 1, *s) if fmt == "NCHW" else (1, *s, 1)
     return jax.lax.reduce_window(
-        x, -jnp.inf, jax.lax.max, (1, *k, 1), (1, *s, 1), padding)
+        x, -jnp.inf, jax.lax.max, dims, strd, padding)
 
 
 @register_op("cnn.avgPooling2d")
-def _avgpool2d(x, *, k, s, padding):
+def _avgpool2d(x, *, k, s, padding, fmt="NHWC"):
+    dims = (1, 1, *k) if fmt == "NCHW" else (1, *k, 1)
+    strd = (1, 1, *s) if fmt == "NCHW" else (1, *s, 1)
     summed = jax.lax.reduce_window(
-        x, 0.0, jax.lax.add, (1, *k, 1), (1, *s, 1), padding)
+        x, 0.0, jax.lax.add, dims, strd, padding)
     ones = jnp.ones_like(x)
     counts = jax.lax.reduce_window(
-        ones, 0.0, jax.lax.add, (1, *k, 1), (1, *s, 1), padding)
+        ones, 0.0, jax.lax.add, dims, strd, padding)
     return summed / counts
 
 

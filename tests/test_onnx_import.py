@@ -1,0 +1,216 @@
+"""ONNX import (reference ``OnnxGraphMapper`` — partial mapper) with
+handcrafted models + numpy oracles."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.imports.onnx import (
+    OnnxGraphMapper,
+    UnsupportedOnnxOpException,
+)
+from deeplearning4j_tpu.imports.protos import onnx_model_pb2 as ox
+
+
+def _model():
+    m = ox.ModelProto()
+    m.ir_version = 8
+    op = m.opset_import.add()
+    op.version = 13
+    return m
+
+
+def _input(g, name, shape):
+    vi = g.input.add()
+    vi.name = name
+    tt = vi.type.tensor_type
+    tt.elem_type = 1
+    for d in shape:
+        dim = tt.shape.dim.add()
+        if d:
+            dim.dim_value = d
+        else:
+            dim.dim_param = "N"
+
+
+def _init(g, name, arr):
+    arr = np.asarray(arr)
+    t = g.initializer.add()
+    t.name = name
+    t.data_type = {np.dtype(np.float32): 1,
+                   np.dtype(np.int64): 7}[arr.dtype]
+    t.dims.extend(arr.shape)
+    t.raw_data = arr.tobytes()
+
+
+def _node(g, op_type, inputs, outputs, **attrs):
+    n = g.node.add()
+    n.op_type = op_type
+    n.input.extend(inputs)
+    n.output.extend(outputs)
+    for k, v in attrs.items():
+        a = n.attribute.add()
+        a.name = k
+        if isinstance(v, float):
+            a.type = 1
+            a.f = v
+        elif isinstance(v, int):
+            a.type = 2
+            a.i = v
+        elif isinstance(v, str):
+            a.type = 3
+            a.s = v.encode()
+        elif isinstance(v, (list, tuple)):
+            a.type = 7
+            a.ints.extend(v)
+    return n
+
+
+def test_import_gemm_mlp(rng):
+    w1 = rng.normal(size=(4, 8)).astype(np.float32)
+    b1 = rng.normal(size=(8,)).astype(np.float32)
+    w2 = rng.normal(size=(8, 3)).astype(np.float32)
+    m = _model()
+    g = m.graph
+    _input(g, "x", (0, 4))
+    _init(g, "w1", w1)
+    _init(g, "b1", b1)
+    _init(g, "w2", w2)
+    _node(g, "Gemm", ["x", "w1", "b1"], ["h"], alpha=1.0, beta=1.0)
+    _node(g, "Relu", ["h"], ["hr"])
+    _node(g, "MatMul", ["hr", "w2"], ["logits"])
+    _node(g, "Softmax", ["logits"], ["probs"], axis=-1)
+
+    sd = OnnxGraphMapper.import_graph(m.SerializeToString())
+    x = rng.normal(size=(5, 4)).astype(np.float32)
+    out = np.asarray(sd.output({"x": x}, "probs")["probs"])
+    h = np.maximum(x @ w1 + b1, 0)
+    logits = h @ w2
+    want = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+def test_import_nchw_conv(rng):
+    k = rng.normal(size=(4, 2, 3, 3), scale=0.3).astype(np.float32)  # OIHW
+    kb = rng.normal(size=(4,)).astype(np.float32)
+    m = _model()
+    g = m.graph
+    _input(g, "img", (0, 2, 8, 8))  # NCHW
+    _init(g, "k", k)
+    _init(g, "kb", kb)
+    _node(g, "Conv", ["img", "k", "kb"], ["conv"],
+          kernel_shape=[3, 3], strides=[1, 1], pads=[1, 1, 1, 1])
+    _node(g, "Relu", ["conv"], ["r"])
+    _node(g, "MaxPool", ["r"], ["p"], kernel_shape=[2, 2], strides=[2, 2])
+    _node(g, "GlobalAveragePool", ["p"], ["gap"])
+    _node(g, "Flatten", ["gap"], ["flat"], axis=1)
+
+    sd = OnnxGraphMapper.import_graph(m.SerializeToString())
+    x = rng.normal(size=(2, 2, 8, 8)).astype(np.float32)
+    out = np.asarray(sd.output({"img": x}, "flat")["flat"])
+    assert out.shape == (2, 4)
+    # oracle
+    import jax
+
+    ref = jax.lax.conv_general_dilated(
+        x, k, (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    ref = np.maximum(np.asarray(ref) + kb[None, :, None, None], 0)
+    pooled = ref.reshape(2, 4, 4, 2, 4, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(out, pooled.mean(axis=(2, 3)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_import_batchnorm_reshape(rng):
+    m = _model()
+    g = m.graph
+    _input(g, "x", (0, 3, 4, 4))
+    _init(g, "gamma", np.asarray([1.0, 2.0, 0.5], np.float32))
+    _init(g, "beta", np.asarray([0.1, -0.1, 0.0], np.float32))
+    _init(g, "mean", np.asarray([0.5, -0.5, 0.0], np.float32))
+    _init(g, "var", np.asarray([1.0, 4.0, 0.25], np.float32))
+    _node(g, "BatchNormalization", ["x", "gamma", "beta", "mean", "var"],
+          ["bn"], epsilon=1e-3)
+    _init(g, "shape", np.asarray([-1, 48], np.int64))
+    _node(g, "Reshape", ["bn", "shape"], ["flat"])
+    sd = OnnxGraphMapper.import_graph(m.SerializeToString())
+    x = rng.normal(size=(2, 3, 4, 4)).astype(np.float32)
+    out = np.asarray(sd.output({"x": x}, "flat")["flat"])
+    assert out.shape == (2, 48)
+    want = ((x - np.asarray([0.5, -0.5, 0.0])[None, :, None, None])
+            / np.sqrt(np.asarray([1.0, 4.0, 0.25])[None, :, None, None]
+                      + 1e-3)
+            * np.asarray([1.0, 2.0, 0.5])[None, :, None, None]
+            + np.asarray([0.1, -0.1, 0.0])[None, :, None, None])
+    np.testing.assert_allclose(out, want.reshape(2, 48), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_unsupported_onnx_op_raises(rng):
+    m = _model()
+    g = m.graph
+    _input(g, "x", (0, 4))
+    _node(g, "LSTM", ["x"], ["y"])
+    with pytest.raises(UnsupportedOnnxOpException) as e:
+        OnnxGraphMapper.import_graph(m.SerializeToString())
+    assert "LSTM" in str(e.value)
+
+
+def test_reshape_zero_dim_and_identity_output(rng):
+    m = _model()
+    g = m.graph
+    _input(g, "x", (0, 3, 4))
+    _init(g, "shape", np.asarray([0, 12], np.int64))
+    _node(g, "Reshape", ["x", "shape"], ["r"])
+    _node(g, "Identity", ["r"], ["final_output"])
+    vo = g.output.add()
+    vo.name = "final_output"
+    sd = OnnxGraphMapper.import_graph(m.SerializeToString())
+    x = rng.normal(size=(2, 3, 4)).astype(np.float32)
+    out = np.asarray(sd.output({"x": x}, "final_output")["final_output"])
+    np.testing.assert_allclose(out, x.reshape(2, 12), rtol=1e-6)
+
+
+def test_unsqueeze_negative_axes(rng):
+    m = _model()
+    g = m.graph
+    _input(g, "x", (0, 3))
+    _node(g, "Unsqueeze", ["x"], ["u"], axes=[-2, -1])
+    sd = OnnxGraphMapper.import_graph(m.SerializeToString())
+    x = rng.normal(size=(2, 3)).astype(np.float32)
+    out = np.asarray(sd.output({"x": x}, "u")["u"])
+    assert out.shape == (2, 3, 1, 1)
+
+
+def test_clip_empty_optional_input(rng):
+    m = _model()
+    g = m.graph
+    _input(g, "x", (0, 3))
+    _init(g, "maxv", np.asarray(0.5, np.float32).reshape(()))
+    n = _node(g, "Clip", [], ["c"])
+    n.input.extend(["x", "", "maxv"])  # min omitted via empty name
+    sd = OnnxGraphMapper.import_graph(m.SerializeToString())
+    x = np.asarray([[-2.0, 0.2, 3.0]], np.float32)
+    out = np.asarray(sd.output({"x": x}, "c")["c"])
+    np.testing.assert_allclose(out, [[-2.0, 0.2, 0.5]], rtol=1e-6)
+
+
+def test_same_lower_rejected(rng):
+    m = _model()
+    g = m.graph
+    _input(g, "x", (0, 2, 8, 8))
+    _init(g, "k", rng.normal(size=(4, 2, 2, 2)).astype(np.float32))
+    _node(g, "Conv", ["x", "k"], ["c"], kernel_shape=[2, 2],
+          auto_pad="SAME_LOWER")
+    with pytest.raises(UnsupportedOnnxOpException):
+        OnnxGraphMapper.import_graph(m.SerializeToString())
+
+
+def test_pad_constant_value(rng):
+    m = _model()
+    g = m.graph
+    _input(g, "x", (0, 2))
+    _node(g, "Pad", ["x"], ["p"], pads=[0, 1, 0, 1], value=5.0)
+    sd = OnnxGraphMapper.import_graph(m.SerializeToString())
+    x = np.ones((1, 2), np.float32)
+    out = np.asarray(sd.output({"x": x}, "p")["p"])
+    np.testing.assert_allclose(out, [[5.0, 1.0, 1.0, 5.0]])

@@ -221,3 +221,91 @@ def test_bfloat16_const_decodes():
     t.tensor_content = vals.astype(ml_dtypes.bfloat16).tobytes()
     sd = TFGraphMapper.import_graph(g.SerializeToString())
     np.testing.assert_allclose(np.asarray(sd.arrays["c"]), vals)
+
+
+def test_imported_graph_fine_tunes(rng):
+    """Reference flow: import frozen graph -> convertToVariable -> fit
+    (the BERT-fine-tune path at small scale)."""
+    from deeplearning4j_tpu.conf.updaters import Adam
+    from deeplearning4j_tpu.samediff.core import SDVariable
+    from deeplearning4j_tpu.samediff.training import TrainingConfig
+
+    w1 = rng.normal(size=(4, 8), scale=0.5).astype(np.float32)
+    b1 = np.zeros(8, np.float32)
+    w2 = rng.normal(size=(8, 2), scale=0.5).astype(np.float32)
+    g = pb.GraphDef()
+    _placeholder(g, "input", (0, 4))
+    _const(g, "w1", w1)
+    _const(g, "b1", b1)
+    _const(g, "w2", w2)
+    _node(g, "mm1", "MatMul", "input", "w1",
+          transpose_a=False, transpose_b=False)
+    _node(g, "a1", "BiasAdd", "mm1", "b1")
+    _node(g, "r1", "Relu", "a1")
+    _node(g, "logits", "MatMul", "r1", "w2")
+
+    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    for wname in ("w1", "b1", "w2"):
+        SDVariable(sd, wname).convert_to_variable()
+    labels = sd.placeholder("labels", shape=(None, 2))
+    logits = SDVariable(sd, "logits")
+    loss = sd.loss.softmaxCrossEntropy(labels, logits)
+    sd.set_training_config(TrainingConfig(
+        updater=Adam(1e-2), data_set_feature_mapping=["input"],
+        data_set_label_mapping=["labels"]))
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+
+    x = rng.normal(size=(32, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+    it = ListDataSetIterator([DataSet(x, y)])
+    losses = []
+    for _ in range(30):
+        sd.fit(it)
+        losses.append(float(np.asarray(
+            sd.output({"input": x, "labels": y},
+                      loss.name)[loss.name])))
+    assert losses[-1] < losses[0]
+    # frozen-by-choice: w1 stays put if converted back to constant
+    np.testing.assert_raises(
+        AssertionError, np.testing.assert_allclose,
+        np.asarray(sd.arrays["w2"]), w2)
+
+
+def test_progressive_unfreezing_resets_updater_state(rng):
+    """convert_to_variable after a fit must re-init updater state (it used
+    to KeyError on the newly trainable name)."""
+    from deeplearning4j_tpu.conf.updaters import Adam
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.samediff.core import SDVariable
+    from deeplearning4j_tpu.samediff.training import TrainingConfig
+
+    w1 = rng.normal(size=(4, 8), scale=0.5).astype(np.float32)
+    w2 = rng.normal(size=(8, 2), scale=0.5).astype(np.float32)
+    g = pb.GraphDef()
+    _placeholder(g, "input", (0, 4))
+    _const(g, "w1", w1)
+    _const(g, "w2", w2)
+    _node(g, "mm1", "MatMul", "input", "w1",
+          transpose_a=False, transpose_b=False)
+    _node(g, "r1", "Relu", "mm1")
+    _node(g, "logits", "MatMul", "r1", "w2")
+    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    SDVariable(sd, "w2").convert_to_variable()
+    labels = sd.placeholder("labels", shape=(None, 2))
+    from deeplearning4j_tpu.samediff.core import SDVariable as V
+
+    sd.loss.softmaxCrossEntropy(labels, V(sd, "logits"))
+    sd.set_training_config(TrainingConfig(
+        updater=Adam(1e-2), data_set_feature_mapping=["input"],
+        data_set_label_mapping=["labels"]))
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+    it = ListDataSetIterator([DataSet(x, y)])
+    sd.fit(it)
+    SDVariable(sd, "w1").convert_to_variable()  # progressive unfreeze
+    sd.fit(it)  # must not KeyError
+    assert not np.allclose(np.asarray(sd.arrays["w1"]), w1)
