@@ -1,0 +1,537 @@
+"""Remaining layer confs completing the reference's ~60-layer surface.
+
+Reference: ``org.deeplearning4j.nn.conf.layers.*`` — Convolution3D,
+Subsampling3DLayer, Subsampling1DLayer, Upsampling1D/3D, Cropping1D/3D,
+ZeroPadding1DLayer/ZeroPadding3DLayer, DepthwiseConvolution2D,
+LocallyConnected1D/2D, PReLULayer, ElementWiseMultiplicationLayer,
+RepeatVector, MaskLayer, GravesBidirectionalLSTM.
+
+Layouts: 3D volumes are NDHWC (TPU-native; reference NCDHW), 1D sequences
+``[batch, time, channels]`` (see layers_rnn.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu import serde
+from deeplearning4j_tpu.conf import inputs as it
+from deeplearning4j_tpu.conf.activations import Activation
+from deeplearning4j_tpu.conf.layers import BaseLayer, Layer
+from deeplearning4j_tpu.conf.layers_cnn import ConvolutionMode, PoolingType
+from deeplearning4j_tpu.conf.layers_rnn import (
+    Bidirectional,
+    BidirectionalMode,
+    GravesLSTM,
+)
+
+
+def _triple(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v, v)
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
+
+
+def _out3d(size, k, s, mode):
+    if mode is ConvolutionMode.SAME:
+        return -(-size // s)
+    return (size - k) // s + 1
+
+
+# ---------------------------------------------------------------------------
+# 3D convolutions / pooling / resizing
+# ---------------------------------------------------------------------------
+
+@serde.register
+@dataclasses.dataclass
+class Convolution3D(BaseLayer):
+    """Reference ``Convolution3D`` — NDHWC x DHWIO (reference NCDHW)."""
+
+    n_out: int = 0
+    kernel_size: Tuple[int, int, int] = (2, 2, 2)
+    stride: Tuple[int, int, int] = (1, 1, 1)
+    convolution_mode: ConvolutionMode = ConvolutionMode.SAME
+    has_bias: bool = True
+
+    def output_type(self, input_type):
+        assert isinstance(input_type, it.Convolutional3D), input_type
+        k, s = _triple(self.kernel_size), _triple(self.stride)
+        m = self.convolution_mode
+        return it.Convolutional3D(
+            depth=_out3d(input_type.depth, k[0], s[0], m),
+            height=_out3d(input_type.height, k[1], s[1], m),
+            width=_out3d(input_type.width, k[2], s[2], m),
+            channels=self.n_out)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        kd, kh, kw = _triple(self.kernel_size)
+        in_c = input_type.channels
+        fan_in = kd * kh * kw * in_c
+        w = self.weight_init.init(key, (kd, kh, kw, in_c, self.n_out),
+                                  fan_in, kd * kh * kw * self.n_out, dtype,
+                                  self.distribution)
+        p = {"W": w}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def param_order(self):
+        return ["W", "b"] if self.has_bias else ["W"]
+
+    def forward(self, params, state, x, train=False, rng=None):
+        x = self._dropout_input(x, train, rng)
+        pad = ("SAME" if self.convolution_mode is ConvolutionMode.SAME
+               else "VALID")
+        y = lax.conv_general_dilated(
+            x, params["W"], window_strides=_triple(self.stride), padding=pad,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation.apply(y), state
+
+
+@serde.register
+@dataclasses.dataclass
+class Cnn3DToFeedForwardPreProcessor(Layer):
+    """Reference ``Cnn3DToFeedForwardPreProcessor``: flatten NDHWC volumes
+    into [batch, d*h*w*c] for dense layers."""
+
+    depth: int = 0
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def output_type(self, input_type):
+        return it.FeedForward(size=input_type.arity())
+
+    def forward(self, params, state, x, train=False, rng=None):
+        return x.reshape(x.shape[0], -1), state
+
+
+@serde.register
+@dataclasses.dataclass
+class Subsampling3DLayer(Layer):
+    """Reference ``Subsampling3DLayer``."""
+
+    pooling_type: PoolingType = PoolingType.MAX
+    kernel_size: Tuple[int, int, int] = (2, 2, 2)
+    stride: Tuple[int, int, int] = (2, 2, 2)
+    convolution_mode: ConvolutionMode = ConvolutionMode.TRUNCATE
+
+    def output_type(self, input_type):
+        k, s = _triple(self.kernel_size), _triple(self.stride)
+        m = self.convolution_mode
+        return it.Convolutional3D(
+            depth=_out3d(input_type.depth, k[0], s[0], m),
+            height=_out3d(input_type.height, k[1], s[1], m),
+            width=_out3d(input_type.width, k[2], s[2], m),
+            channels=input_type.channels)
+
+    def forward(self, params, state, x, train=False, rng=None):
+        k = (1, *_triple(self.kernel_size), 1)
+        s = (1, *_triple(self.stride), 1)
+        pad = ("SAME" if self.convolution_mode is ConvolutionMode.SAME
+               else "VALID")
+        if self.pooling_type is PoolingType.MAX:
+            y = lax.reduce_window(x, -jnp.inf, lax.max, k, s, pad)
+        else:
+            tot = lax.reduce_window(x, 0.0, lax.add, k, s, pad)
+            cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, k, s,
+                                    pad)
+            y = tot / cnt
+        return y, state
+
+
+@serde.register
+@dataclasses.dataclass
+class Subsampling1DLayer(Layer):
+    """Reference ``Subsampling1DLayer`` over [batch, time, channels]."""
+
+    pooling_type: PoolingType = PoolingType.MAX
+    kernel_size: int = 2
+    stride: int = 2
+    convolution_mode: ConvolutionMode = ConvolutionMode.TRUNCATE
+
+    def output_type(self, input_type):
+        ts = input_type.timesteps
+        if ts and ts > 0:
+            ts = _out3d(ts, self.kernel_size, self.stride,
+                        self.convolution_mode)
+        return it.Recurrent(size=input_type.size, timesteps=ts)
+
+    def forward(self, params, state, x, train=False, rng=None):
+        k = (1, self.kernel_size, 1)
+        s = (1, self.stride, 1)
+        pad = ("SAME" if self.convolution_mode is ConvolutionMode.SAME
+               else "VALID")
+        if self.pooling_type is PoolingType.MAX:
+            y = lax.reduce_window(x, -jnp.inf, lax.max, k, s, pad)
+        else:
+            tot = lax.reduce_window(x, 0.0, lax.add, k, s, pad)
+            cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, k, s,
+                                    pad)
+            y = tot / cnt
+        return y, state
+
+
+@serde.register
+@dataclasses.dataclass
+class Upsampling1D(Layer):
+    """Reference ``Upsampling1D``: repeat along time."""
+
+    size: int = 2
+
+    def output_type(self, input_type):
+        ts = input_type.timesteps
+        return it.Recurrent(size=input_type.size,
+                            timesteps=ts * self.size if ts and ts > 0 else ts)
+
+    def forward(self, params, state, x, train=False, rng=None):
+        return jnp.repeat(x, self.size, axis=1), state
+
+
+@serde.register
+@dataclasses.dataclass
+class Upsampling3D(Layer):
+    """Reference ``Upsampling3D``."""
+
+    size: Tuple[int, int, int] = (2, 2, 2)
+
+    def output_type(self, input_type):
+        sd, sh, sw = _triple(self.size)
+        return it.Convolutional3D(
+            depth=input_type.depth * sd, height=input_type.height * sh,
+            width=input_type.width * sw, channels=input_type.channels)
+
+    def forward(self, params, state, x, train=False, rng=None):
+        sd, sh, sw = _triple(self.size)
+        x = jnp.repeat(x, sd, axis=1)
+        x = jnp.repeat(x, sh, axis=2)
+        return jnp.repeat(x, sw, axis=3), state
+
+
+@serde.register
+@dataclasses.dataclass
+class Cropping1D(Layer):
+    """Reference ``Cropping1D``: crop [top, bottom] timesteps."""
+
+    cropping: Tuple[int, int] = (0, 0)
+
+    def output_type(self, input_type):
+        a, b = _pair(self.cropping)
+        ts = input_type.timesteps
+        return it.Recurrent(size=input_type.size,
+                            timesteps=ts - a - b if ts and ts > 0 else ts)
+
+    def forward(self, params, state, x, train=False, rng=None):
+        a, b = _pair(self.cropping)
+        return x[:, a:x.shape[1] - b, :], state
+
+
+@serde.register
+@dataclasses.dataclass
+class Cropping3D(Layer):
+    """Reference ``Cropping3D``."""
+
+    cropping: Tuple[int, int, int, int, int, int] = (0, 0, 0, 0, 0, 0)
+
+    def output_type(self, input_type):
+        c = self.cropping
+        return it.Convolutional3D(
+            depth=input_type.depth - c[0] - c[1],
+            height=input_type.height - c[2] - c[3],
+            width=input_type.width - c[4] - c[5],
+            channels=input_type.channels)
+
+    def forward(self, params, state, x, train=False, rng=None):
+        c = self.cropping
+        return x[:, c[0]:x.shape[1] - c[1], c[2]:x.shape[2] - c[3],
+                 c[4]:x.shape[3] - c[5], :], state
+
+
+@serde.register
+@dataclasses.dataclass
+class ZeroPadding1DLayer(Layer):
+    """Reference ``ZeroPadding1DLayer``."""
+
+    padding: Tuple[int, int] = (0, 0)
+
+    def output_type(self, input_type):
+        a, b = _pair(self.padding)
+        ts = input_type.timesteps
+        return it.Recurrent(size=input_type.size,
+                            timesteps=ts + a + b if ts and ts > 0 else ts)
+
+    def forward(self, params, state, x, train=False, rng=None):
+        a, b = _pair(self.padding)
+        return jnp.pad(x, ((0, 0), (a, b), (0, 0))), state
+
+
+@serde.register
+@dataclasses.dataclass
+class ZeroPadding3DLayer(Layer):
+    """Reference ``ZeroPadding3DLayer``."""
+
+    padding: Tuple[int, int, int, int, int, int] = (0, 0, 0, 0, 0, 0)
+
+    def output_type(self, input_type):
+        p = self.padding
+        return it.Convolutional3D(
+            depth=input_type.depth + p[0] + p[1],
+            height=input_type.height + p[2] + p[3],
+            width=input_type.width + p[4] + p[5],
+            channels=input_type.channels)
+
+    def forward(self, params, state, x, train=False, rng=None):
+        p = self.padding
+        return jnp.pad(x, ((0, 0), (p[0], p[1]), (p[2], p[3]),
+                           (p[4], p[5]), (0, 0))), state
+
+
+# ---------------------------------------------------------------------------
+# 2D extras
+# ---------------------------------------------------------------------------
+
+@serde.register
+@dataclasses.dataclass
+class DepthwiseConvolution2D(BaseLayer):
+    """Reference ``DepthwiseConvolution2D``: per-channel conv with a
+    ``depth_multiplier`` (nOut = nIn * depth_multiplier)."""
+
+    kernel_size: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    depth_multiplier: int = 1
+    convolution_mode: ConvolutionMode = ConvolutionMode.SAME
+    has_bias: bool = True
+
+    def output_type(self, input_type):
+        k, s = _pair(self.kernel_size), _pair(self.stride)
+        m = self.convolution_mode
+        return it.Convolutional(
+            height=_out3d(input_type.height, k[0], s[0], m),
+            width=_out3d(input_type.width, k[1], s[1], m),
+            channels=input_type.channels * self.depth_multiplier)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        kh, kw = _pair(self.kernel_size)
+        c = input_type.channels
+        n_out = c * self.depth_multiplier
+        fan_in = kh * kw
+        w = self.weight_init.init(key, (kh, kw, 1, n_out), fan_in,
+                                  kh * kw * self.depth_multiplier, dtype,
+                                  self.distribution)
+        p = {"W": w}
+        if self.has_bias:
+            p["b"] = jnp.full((n_out,), self.bias_init, dtype)
+        return p
+
+    def param_order(self):
+        return ["W", "b"] if self.has_bias else ["W"]
+
+    def forward(self, params, state, x, train=False, rng=None):
+        x = self._dropout_input(x, train, rng)
+        pad = ("SAME" if self.convolution_mode is ConvolutionMode.SAME
+               else "VALID")
+        y = lax.conv_general_dilated(
+            x, params["W"], window_strides=_pair(self.stride), padding=pad,
+            feature_group_count=x.shape[-1],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation.apply(y), state
+
+
+@serde.register
+@dataclasses.dataclass
+class LocallyConnected2D(BaseLayer):
+    """Reference ``LocallyConnected2D``: convolution with UNSHARED weights
+    per output position. Weights [outH, outW, kh*kw*inC, nOut]; the patch
+    extraction + per-position contraction is one einsum on the MXU."""
+
+    n_out: int = 0
+    kernel_size: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    has_bias: bool = True
+
+    def _out_hw(self, input_type):
+        k, s = _pair(self.kernel_size), _pair(self.stride)
+        return ((input_type.height - k[0]) // s[0] + 1,
+                (input_type.width - k[1]) // s[1] + 1)
+
+    def output_type(self, input_type):
+        oh, ow = self._out_hw(input_type)
+        return it.Convolutional(height=oh, width=ow, channels=self.n_out)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        kh, kw = _pair(self.kernel_size)
+        oh, ow = self._out_hw(input_type)
+        c = input_type.channels
+        fan_in = kh * kw * c
+        w = self.weight_init.init(key, (oh, ow, fan_in, self.n_out), fan_in,
+                                  self.n_out, dtype, self.distribution)
+        p = {"W": w}
+        if self.has_bias:
+            p["b"] = jnp.full((oh, ow, self.n_out), self.bias_init, dtype)
+        return p
+
+    def param_order(self):
+        return ["W", "b"] if self.has_bias else ["W"]
+
+    def forward(self, params, state, x, train=False, rng=None):
+        x = self._dropout_input(x, train, rng)
+        kh, kw = _pair(self.kernel_size)
+        patches = lax.conv_general_dilated_patches(
+            x, (kh, kw), _pair(self.stride), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        # conv_general_dilated_patches emits channel-major patches
+        # [C*kh*kw]; weights were initialized against that flat order
+        y = jnp.einsum("bhwk,hwko->bhwo", patches, params["W"])
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation.apply(y), state
+
+
+@serde.register
+@dataclasses.dataclass
+class LocallyConnected1D(BaseLayer):
+    """Reference ``LocallyConnected1D`` over [batch, time, channels]."""
+
+    n_out: int = 0
+    kernel_size: int = 3
+    stride: int = 1
+    has_bias: bool = True
+
+    def _out_t(self, input_type):
+        return (input_type.timesteps - self.kernel_size) // self.stride + 1
+
+    def output_type(self, input_type):
+        return it.Recurrent(size=self.n_out, timesteps=self._out_t(input_type))
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        ot = self._out_t(input_type)
+        fan_in = self.kernel_size * input_type.size
+        w = self.weight_init.init(key, (ot, fan_in, self.n_out), fan_in,
+                                  self.n_out, dtype, self.distribution)
+        p = {"W": w}
+        if self.has_bias:
+            p["b"] = jnp.full((ot, self.n_out), self.bias_init, dtype)
+        return p
+
+    def param_order(self):
+        return ["W", "b"] if self.has_bias else ["W"]
+
+    def forward(self, params, state, x, train=False, rng=None):
+        x = self._dropout_input(x, train, rng)
+        patches = lax.conv_general_dilated_patches(
+            x[:, :, None, :], (self.kernel_size, 1), (self.stride, 1),
+            "VALID", dimension_numbers=("NHWC", "HWIO", "NHWC"))[:, :, 0, :]
+        y = jnp.einsum("btk,tko->bto", patches, params["W"])
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation.apply(y), state
+
+
+@serde.register
+@dataclasses.dataclass
+class PReLULayer(BaseLayer):
+    """Reference ``PReLULayer``: y = max(0,x) + alpha*min(0,x) with
+    learnable per-channel alpha."""
+
+    def output_type(self, input_type):
+        return input_type
+
+    def _alpha_shape(self, input_type):
+        if isinstance(input_type, it.Convolutional):
+            return (input_type.channels,)
+        if isinstance(input_type, it.Recurrent):
+            return (input_type.size,)
+        return (input_type.size,)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        return {"alpha": jnp.full(self._alpha_shape(input_type), 0.25,
+                                  dtype)}
+
+    def param_order(self):
+        return ["alpha"]
+
+    def regularized_param_keys(self):
+        return []
+
+    def forward(self, params, state, x, train=False, rng=None):
+        a = params["alpha"]
+        return jnp.maximum(x, 0) + a * jnp.minimum(x, 0), state
+
+
+@serde.register
+@dataclasses.dataclass
+class ElementWiseMultiplicationLayer(BaseLayer):
+    """Reference ``ElementWiseMultiplicationLayer``: out = act(x ⊙ w + b),
+    learnable per-feature scale + shift."""
+
+    def output_type(self, input_type):
+        return input_type
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        n = input_type.size
+        return {"W": jnp.ones((n,), dtype),
+                "b": jnp.full((n,), self.bias_init, dtype)}
+
+    def param_order(self):
+        return ["W", "b"]
+
+    def forward(self, params, state, x, train=False, rng=None):
+        x = self._dropout_input(x, train, rng)
+        return self.activation.apply(x * params["W"] + params["b"]), state
+
+
+@serde.register
+@dataclasses.dataclass
+class RepeatVector(Layer):
+    """Reference ``RepeatVector``: [batch, size] -> [batch, n, size]."""
+
+    repetition_factor: int = 1
+
+    def output_type(self, input_type):
+        return it.Recurrent(size=input_type.size,
+                            timesteps=self.repetition_factor)
+
+    def forward(self, params, state, x, train=False, rng=None):
+        return jnp.repeat(x[:, None, :], self.repetition_factor, axis=1), \
+            state
+
+
+@serde.register
+@dataclasses.dataclass
+class MaskLayer(Layer):
+    """Reference ``util.MaskLayer``: zero out masked timesteps."""
+
+    uses_mask = True
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None):
+        if mask is None:
+            return x, state
+        return x * jnp.asarray(mask, x.dtype)[:, :, None], state
+
+
+@serde.register
+@dataclasses.dataclass
+class GravesBidirectionalLSTM(Bidirectional):
+    """Reference ``GravesBidirectionalLSTM`` = bidirectional Graves LSTM
+    with CONCAT combining (kept as its own conf class for parity; the
+    modern reference deprecates it in favor of Bidirectional(GravesLSTM))."""
+
+    n_out: int = 0
+    forget_gate_bias_init: float = 1.0
+
+    def __post_init__(self):
+        if self.layer is None:
+            self.layer = GravesLSTM(
+                n_out=self.n_out,
+                forget_gate_bias_init=self.forget_gate_bias_init)

@@ -244,6 +244,17 @@ def _insert_preprocessors(layers: List[Layer], input_type) -> List[Layer]:
                 height=cur.height, width=cur.width, channels=cur.channels)
             out.append(pre)
             cur = pre.output_type(cur)
+        if (isinstance(cur, it.Convolutional3D)
+                and isinstance(layer, DenseLayer)):
+            from deeplearning4j_tpu.conf.layers_extra import (
+                Cnn3DToFeedForwardPreProcessor,
+            )
+
+            pre = Cnn3DToFeedForwardPreProcessor(
+                depth=cur.depth, height=cur.height, width=cur.width,
+                channels=cur.channels)
+            out.append(pre)
+            cur = pre.output_type(cur)
         if isinstance(cur, it.ConvolutionalFlat):
             # reference treats flat CNN input as FF into dense, CNN into conv
             from deeplearning4j_tpu.conf.layers import FeedForwardToCnnPreProcessor

@@ -49,6 +49,16 @@ def _tensor_to_np(t: "ox.TensorProto") -> np.ndarray:
     shape = tuple(t.dims)
     if t.raw_data:
         return np.frombuffer(t.raw_data, dtype).reshape(shape).copy()
+    if t.data_type in (10, 16) and len(t.int32_data):
+        # fp16/bf16 typed storage is BIT PATTERNS in int32_data
+        bits = np.asarray(list(t.int32_data), np.uint16)
+        if t.data_type == 10:
+            arr = bits.view(np.float16)
+        else:
+            import ml_dtypes
+
+            arr = bits.view(ml_dtypes.bfloat16).astype(np.float32)
+        return np.asarray(arr).reshape(shape)
     for field, ftype in (("float_data", np.float32),
                          ("int32_data", np.int32),
                          ("int64_data", np.int64),
@@ -57,6 +67,11 @@ def _tensor_to_np(t: "ox.TensorProto") -> np.ndarray:
         vals = getattr(t, field)
         if len(vals):
             return np.asarray(list(vals), ftype).astype(dtype).reshape(shape)
+    if int(np.prod(shape, dtype=np.int64)) > 0:
+        raise UnsupportedOnnxOpException(
+            f"tensor {t.name!r} has no inline data — models with EXTERNAL "
+            f"data storage are not importable (re-export with "
+            f"save_as_external_data=False)")
     return np.zeros(shape, dtype)
 
 
@@ -103,12 +118,15 @@ class OnnxGraphMapper:
                 data = f.read()
         model = ox.ModelProto()
         model.ParseFromString(data)
-        return _Mapper(model.graph).run()
+        opset = max((o.version for o in model.opset_import
+                     if o.domain in ("", "ai.onnx")), default=13)
+        return _Mapper(model.graph, opset).run()
 
 
 class _Mapper:
-    def __init__(self, graph: "ox.GraphProto"):
+    def __init__(self, graph: "ox.GraphProto", opset: int = 13):
         self.graph = graph
+        self.opset = int(opset)
         self.sd = SameDiff.create()
         self.names: dict[str, str] = {}
         self.const_np: dict[str, np.ndarray] = {}
@@ -176,6 +194,8 @@ class _Mapper:
             self.names[outs[0]] = v.name
         elif op == "Identity" or op == "Dropout":
             self.names[outs[0]] = self.names[ins[0]]
+            if ins[0] in self.const_np:  # keep static operands resolvable
+                self.const_np[outs[0]] = self.const_np[ins[0]]
         elif op in _UNARY:
             self._bind(outs[0], sd._op(_UNARY[op], [self._var(ins[0])])[0])
         elif op in _BINARY:
@@ -196,9 +216,15 @@ class _Mapper:
             self._bind(outs[0], sd._op(
                 "math.clip_by_value", [self._var(ins[0])], lo=lo, hi=hi)[0])
         elif op == "Softmax":
-            self._bind(outs[0], sd._op(
-                "nn.softmax", [self._var(ins[0])],
-                axis=at.get("axis", -1))[0])
+            if self.opset < 13:
+                # opset<13: default axis 1, flatten-to-2D semantics
+                self._bind(outs[0], sd._op(
+                    "softmax_flattened", [self._var(ins[0])],
+                    axis=at.get("axis", 1))[0])
+            else:
+                self._bind(outs[0], sd._op(
+                    "nn.softmax", [self._var(ins[0])],
+                    axis=at.get("axis", -1))[0])
         elif op == "MatMul":
             self._bind(outs[0], sd._op(
                 "math.matmul", [self._var(ins[0]), self._var(ins[1])],

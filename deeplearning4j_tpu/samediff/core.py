@@ -703,6 +703,17 @@ def _op_unsqueeze_onnx(x, *, axes):
     return x
 
 
+@register_op("softmax_flattened")
+def _op_softmax_flattened(x, *, axis):
+    """ONNX opset<13 Softmax: coerce to 2D at ``axis``, softmax the flat
+    tail, restore shape."""
+    import numpy as _np
+
+    lead = int(_np.prod(x.shape[:axis], dtype=_np.int64)) if axis else 1
+    flat = x.reshape(lead, -1)
+    return jax.nn.softmax(flat, axis=-1).reshape(x.shape)
+
+
 @register_op("flatten2d")
 def _op_flatten2d(x):
     """[b, ...] -> [b, prod(...)] (ONNX Flatten / Keras Flatten)."""

@@ -1,0 +1,195 @@
+"""Extra layer confs: shapes, gradients, serde (reference layer-surface
+completion — Convolution3D, locally-connected, PReLU, etc.)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu import serde
+from deeplearning4j_tpu.conf import Activation, InputType
+from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.conf.layers_cnn import PoolingType
+from deeplearning4j_tpu.conf.layers_extra import (
+    Convolution3D,
+    Cropping1D,
+    Cropping3D,
+    DepthwiseConvolution2D,
+    ElementWiseMultiplicationLayer,
+    GravesBidirectionalLSTM,
+    LocallyConnected1D,
+    LocallyConnected2D,
+    MaskLayer,
+    PReLULayer,
+    RepeatVector,
+    Subsampling1DLayer,
+    Subsampling3DLayer,
+    Upsampling1D,
+    Upsampling3D,
+    ZeroPadding1DLayer,
+    ZeroPadding3DLayer,
+)
+from deeplearning4j_tpu.conf.losses import LossMCXENT
+from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+from deeplearning4j_tpu.conf.updaters import NoOp
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.util.gradcheck import gradient_check
+
+KEY = jax.random.PRNGKey(0)
+
+
+def test_conv3d_stack_shapes(rng):
+    t = InputType.convolutional_3d(8, 8, 8, 2)
+    c = Convolution3D(n_out=4, kernel_size=(3, 3, 3), stride=(2, 2, 2))
+    out = c.output_type(t)
+    assert (out.depth, out.height, out.width, out.channels) == (4, 4, 4, 4)
+    params = c.init(KEY, t)
+    x = jnp.asarray(rng.normal(size=(2, 8, 8, 8, 2)), jnp.float32)
+    y, _ = c.forward(params, {}, x)
+    assert y.shape == (2, 4, 4, 4, 4)
+
+    p = Subsampling3DLayer(kernel_size=(2, 2, 2), stride=(2, 2, 2))
+    y2, _ = p.forward({}, {}, y)
+    assert y2.shape == (2, 2, 2, 2, 4)
+
+    u = Upsampling3D(size=(2, 2, 2))
+    y3, _ = u.forward({}, {}, y2)
+    assert y3.shape == (2, 4, 4, 4, 4)
+
+    z = ZeroPadding3DLayer(padding=(1, 1, 0, 0, 2, 2))
+    y4, _ = z.forward({}, {}, y3)
+    assert y4.shape == (2, 6, 4, 8, 4)
+    cr = Cropping3D(cropping=(1, 1, 0, 0, 2, 2))
+    y5, _ = cr.forward({}, {}, y4)
+    np.testing.assert_array_equal(np.asarray(y5), np.asarray(y3))
+
+
+def test_1d_layers(rng):
+    x = jnp.asarray(rng.normal(size=(2, 8, 3)), jnp.float32)
+    s = Subsampling1DLayer(pooling_type=PoolingType.AVG, kernel_size=2,
+                           stride=2)
+    y, _ = s.forward({}, {}, x)
+    assert y.shape == (2, 4, 3)
+    np.testing.assert_allclose(np.asarray(y[:, 0]),
+                               np.asarray((x[:, 0] + x[:, 1]) / 2),
+                               rtol=1e-6)
+    u = Upsampling1D(size=3)
+    assert u.forward({}, {}, y)[0].shape == (2, 12, 3)
+    zp = ZeroPadding1DLayer(padding=(1, 2))
+    assert zp.forward({}, {}, x)[0].shape == (2, 11, 3)
+    cr = Cropping1D(cropping=(1, 2))
+    assert cr.forward({}, {}, x)[0].shape == (2, 5, 3)
+
+
+def test_depthwise_matches_manual(rng):
+    t = InputType.convolutional(6, 6, 3)
+    d = DepthwiseConvolution2D(kernel_size=(3, 3), depth_multiplier=2,
+                               activation=Activation.IDENTITY)
+    params = d.init(KEY, t)
+    x = jnp.asarray(rng.normal(size=(1, 6, 6, 3)), jnp.float32)
+    y, _ = d.forward(params, {}, x)
+    assert y.shape == (1, 6, 6, 6)
+    assert d.output_type(t).channels == 6
+
+
+def test_locally_connected_2d_unshared(rng):
+    t = InputType.convolutional(5, 5, 2)
+    lc = LocallyConnected2D(n_out=3, kernel_size=(3, 3), stride=(1, 1),
+                            activation=Activation.IDENTITY)
+    params = lc.init(KEY, t)
+    assert params["W"].shape == (3, 3, 18, 3)
+    x = jnp.asarray(rng.normal(size=(2, 5, 5, 2)), jnp.float32)
+    y, _ = lc.forward(params, {}, x)
+    assert y.shape == (2, 3, 3, 3)
+    # unshared: zeroing ONE position's weights only changes that position
+    w2 = params["W"].at[1, 1].set(0.0)
+    y2, _ = lc.forward({**params, "W": w2}, {}, x)
+    diff = np.abs(np.asarray(y - y2)).sum(axis=(0, 3))
+    assert diff[1, 1] > 0
+    diff[1, 1] = 0
+    assert diff.sum() == 0
+
+
+def test_locally_connected_1d(rng):
+    t = InputType.recurrent(3, timesteps=7)
+    lc = LocallyConnected1D(n_out=4, kernel_size=3, stride=2,
+                            activation=Activation.TANH)
+    params = lc.init(KEY, t)
+    x = jnp.asarray(rng.normal(size=(2, 7, 3)), jnp.float32)
+    y, _ = lc.forward(params, {}, x)
+    assert y.shape == (2, 3, 4)
+
+
+def test_prelu_and_elementwise_mult(rng):
+    t = InputType.feed_forward(4)
+    pr = PReLULayer()
+    params = pr.init(KEY, t)
+    x = jnp.asarray([[-2.0, -1.0, 1.0, 2.0]], jnp.float32)
+    y, _ = pr.forward(params, {}, x)
+    np.testing.assert_allclose(np.asarray(y),
+                               [[-0.5, -0.25, 1.0, 2.0]], rtol=1e-6)
+    ew = ElementWiseMultiplicationLayer()
+    p2 = ew.init(KEY, t)
+    y2, _ = ew.forward(p2, {}, x)
+    np.testing.assert_allclose(np.asarray(y2), np.asarray(x), rtol=1e-6)
+
+
+def test_repeat_vector_and_mask_layer(rng):
+    rv = RepeatVector(repetition_factor=3)
+    x = jnp.asarray(rng.normal(size=(2, 4)), jnp.float32)
+    y, _ = rv.forward({}, {}, x)
+    assert y.shape == (2, 3, 4)
+    ml = MaskLayer()
+    seq = jnp.asarray(rng.normal(size=(2, 3, 4)), jnp.float32)
+    mask = jnp.asarray([[1, 1, 0], [1, 0, 0]], jnp.float32)
+    y2, _ = ml.forward({}, {}, seq, mask=mask)
+    np.testing.assert_allclose(np.asarray(y2[0, 2]), 0.0)
+    np.testing.assert_allclose(np.asarray(y2[1, 1:]), 0.0)
+
+
+def test_graves_bidirectional_lstm(rng):
+    from deeplearning4j_tpu.conf.layers_rnn import RnnOutputLayer
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12345).updater(NoOp()).list()
+            .layer(GravesBidirectionalLSTM(n_out=4))
+            .layer(RnnOutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(3, timesteps=5))
+            .build())
+    feats = rng.normal(size=(4, 5, 3)).astype(np.float64)
+    labels = np.eye(2)[rng.integers(0, 2, (4, 5))].astype(np.float64)
+    res = gradient_check(conf, DataSet(feats, labels), n_samples=50)
+    assert res.passed, res.summary()
+
+
+@pytest.mark.parametrize("layer_fn", [
+    lambda: PReLULayer(),
+    lambda: ElementWiseMultiplicationLayer(),
+])
+def test_extra_ff_gradients(layer_fn, rng):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12345).updater(NoOp()).list()
+            .layer(DenseLayer(n_out=5, activation=Activation.TANH))
+            .layer(layer_fn())
+            .layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    feats = rng.normal(size=(6, 4)).astype(np.float64)
+    labels = np.eye(3)[rng.integers(0, 3, 6)].astype(np.float64)
+    res = gradient_check(conf, DataSet(feats, labels), n_samples=50)
+    assert res.passed, res.summary()
+
+
+def test_serde_roundtrip():
+    for layer in (Convolution3D(n_out=4), Subsampling3DLayer(),
+                  Subsampling1DLayer(), Upsampling1D(size=3), Upsampling3D(),
+                  Cropping1D(cropping=(1, 2)), Cropping3D(),
+                  ZeroPadding1DLayer(padding=(1, 2)), ZeroPadding3DLayer(),
+                  DepthwiseConvolution2D(depth_multiplier=2),
+                  LocallyConnected2D(n_out=3), LocallyConnected1D(n_out=4),
+                  PReLULayer(), ElementWiseMultiplicationLayer(),
+                  RepeatVector(repetition_factor=3), MaskLayer(),
+                  GravesBidirectionalLSTM(n_out=4)):
+        back = serde.from_json(serde.to_json(layer))
+        assert back == layer, type(layer).__name__

@@ -210,3 +210,36 @@ def test_score_uses_eval_mode_batchnorm():
     s_eval = net.score(DataSet(x, y))
     grads, s_train_mode = net.compute_gradient_and_score(DataSet(x, y))
     assert abs(s_eval - s_train_mode) > 0.1
+
+
+def test_bfloat16_dtype_trains():
+    """conf.dtype('bfloat16'): params live in bf16 and training converges
+    (the reference's Nd4j.setDefaultDataTypes HALF/BFLOAT16 analog)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.conf import Activation, InputType
+    from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.conf.losses import LossMCXENT
+    from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+    from deeplearning4j_tpu.conf.updaters import Adam
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(1e-2)).dtype("bfloat16").list()
+            .layer(DenseLayer(n_out=8, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=2, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    assert net.params["0"]["W"].dtype == jnp.bfloat16
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+    ds = DataSet(x, y)
+    s0 = net.fit_batch(ds)
+    for _ in range(20):
+        s1 = net.fit_batch(ds)
+    assert s1 < s0

@@ -214,3 +214,60 @@ def test_pad_constant_value(rng):
     x = np.ones((1, 2), np.float32)
     out = np.asarray(sd.output({"x": x}, "p")["p"])
     np.testing.assert_allclose(out, [[5.0, 1.0, 1.0, 5.0]])
+
+
+def test_external_data_rejected():
+    m = _model()
+    g = m.graph
+    _input(g, "x", (0, 2))
+    t = g.initializer.add()
+    t.name = "w"
+    t.data_type = 1
+    t.dims.extend([2, 2])  # no inline payload at all
+    _node(g, "MatMul", ["x", "w"], ["y"])
+    with pytest.raises(UnsupportedOnnxOpException) as e:
+        OnnxGraphMapper.import_graph(m.SerializeToString())
+    assert "EXTERNAL" in str(e.value)
+
+
+def test_fp16_int32_bitpattern_decodes():
+    m = _model()
+    g = m.graph
+    _input(g, "x", (0, 2))
+    t = g.initializer.add()
+    t.name = "w"
+    t.data_type = 10  # FLOAT16
+    t.dims.extend([2])
+    t.int32_data.extend(
+        np.asarray([1.0, -2.5], np.float16).view(np.uint16).tolist())
+    _node(g, "Add", ["x", "w"], ["y"])
+    sd = OnnxGraphMapper.import_graph(m.SerializeToString())
+    np.testing.assert_allclose(
+        np.asarray(sd.arrays["w"], np.float32), [1.0, -2.5])
+
+
+def test_legacy_softmax_flattens(rng):
+    m = _model()
+    m.opset_import[0].version = 11
+    g = m.graph
+    _input(g, "x", (0, 2, 3))
+    _node(g, "Softmax", ["x"], ["p"])  # no axis attr -> legacy axis=1
+    sd = OnnxGraphMapper.import_graph(m.SerializeToString())
+    x = rng.normal(size=(2, 2, 3)).astype(np.float32)
+    out = np.asarray(sd.output({"x": x}, "p")["p"])
+    flat = x.reshape(2, 6)
+    want = (np.exp(flat) / np.exp(flat).sum(-1, keepdims=True)).reshape(
+        2, 2, 3)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_identity_propagates_static(rng):
+    m = _model()
+    g = m.graph
+    _input(g, "x", (0, 6))
+    _init(g, "shape", np.asarray([0, 2, 3], np.int64))
+    _node(g, "Identity", ["shape"], ["shape_id"])
+    _node(g, "Reshape", ["x", "shape_id"], ["r"])
+    sd = OnnxGraphMapper.import_graph(m.SerializeToString())
+    x = rng.normal(size=(2, 6)).astype(np.float32)
+    assert np.asarray(sd.output({"x": x}, "r")["r"]).shape == (2, 2, 3)
