@@ -13,9 +13,8 @@ Layouts: 3D volumes are NDHWC (TPU-native; reference NCDHW), 1D sequences
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Tuple
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -48,6 +47,27 @@ def _out3d(size, k, s, mode):
 # ---------------------------------------------------------------------------
 # 3D convolutions / pooling / resizing
 # ---------------------------------------------------------------------------
+
+def _pool(x, pooling_type, window, strides, pad, pnorm=2):
+    """Shared reduce_window pooling (semantics of the 2D SubsamplingLayer)."""
+    if pooling_type is PoolingType.MAX:
+        return lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pad)
+    if pooling_type is PoolingType.SUM:
+        return lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+    if pooling_type is PoolingType.AVG:
+        tot = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+        cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window,
+                                strides, pad)
+        return tot / cnt
+    if pooling_type is PoolingType.PNORM:
+        p = float(pnorm)
+        tot = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window,
+                                strides, pad)
+        return tot ** (1.0 / p)
+    raise ValueError(f"unknown pooling type {pooling_type}")
+
+
+
 
 @serde.register
 @dataclasses.dataclass
@@ -134,19 +154,14 @@ class Subsampling3DLayer(Layer):
             width=_out3d(input_type.width, k[2], s[2], m),
             channels=input_type.channels)
 
+    pnorm: int = 2
+
     def forward(self, params, state, x, train=False, rng=None):
         k = (1, *_triple(self.kernel_size), 1)
         s = (1, *_triple(self.stride), 1)
         pad = ("SAME" if self.convolution_mode is ConvolutionMode.SAME
                else "VALID")
-        if self.pooling_type is PoolingType.MAX:
-            y = lax.reduce_window(x, -jnp.inf, lax.max, k, s, pad)
-        else:
-            tot = lax.reduce_window(x, 0.0, lax.add, k, s, pad)
-            cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, k, s,
-                                    pad)
-            y = tot / cnt
-        return y, state
+        return _pool(x, self.pooling_type, k, s, pad, self.pnorm), state
 
 
 @serde.register
@@ -166,19 +181,14 @@ class Subsampling1DLayer(Layer):
                         self.convolution_mode)
         return it.Recurrent(size=input_type.size, timesteps=ts)
 
+    pnorm: int = 2
+
     def forward(self, params, state, x, train=False, rng=None):
         k = (1, self.kernel_size, 1)
         s = (1, self.stride, 1)
         pad = ("SAME" if self.convolution_mode is ConvolutionMode.SAME
                else "VALID")
-        if self.pooling_type is PoolingType.MAX:
-            y = lax.reduce_window(x, -jnp.inf, lax.max, k, s, pad)
-        else:
-            tot = lax.reduce_window(x, 0.0, lax.add, k, s, pad)
-            cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, k, s,
-                                    pad)
-            y = tot / cnt
-        return y, state
+        return _pool(x, self.pooling_type, k, s, pad, self.pnorm), state
 
 
 @serde.register
@@ -448,10 +458,10 @@ class PReLULayer(BaseLayer):
         return input_type
 
     def _alpha_shape(self, input_type):
-        if isinstance(input_type, it.Convolutional):
+        if isinstance(input_type, (it.Convolutional, it.Convolutional3D)):
             return (input_type.channels,)
-        if isinstance(input_type, it.Recurrent):
-            return (input_type.size,)
+        if isinstance(input_type, it.ConvolutionalFlat):
+            return (input_type.arity(),)
         return (input_type.size,)
 
     def init(self, key, input_type, dtype=jnp.float32):
@@ -535,3 +545,4 @@ class GravesBidirectionalLSTM(Bidirectional):
             self.layer = GravesLSTM(
                 n_out=self.n_out,
                 forget_gate_bias_init=self.forget_gate_bias_init)
+        self.mode = BidirectionalMode.CONCAT

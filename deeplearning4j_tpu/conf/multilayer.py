@@ -53,6 +53,10 @@ class MultiLayerConfiguration:
     tbptt_fwd_length: int = 20
     tbptt_back_length: int = 20
     dtype: str = "float32"
+    # TPU-native: rematerialize per-layer activations in the backward pass
+    # (jax.checkpoint) — trades FLOPs for HBM, no reference analog (the
+    # reference's workspaces manage allocator churn, not liveness)
+    gradient_checkpointing: bool = False
 
     def to_json(self) -> str:
         return serde.to_json(self)
@@ -155,6 +159,7 @@ class ListBuilder:
         self._layers: List[Layer] = []
         self._input_type = None
         self._backprop_type = BackpropType.STANDARD
+        self._grad_checkpoint = False
         self._tbptt_fwd = 20
         self._tbptt_back = 20
 
@@ -164,6 +169,12 @@ class ListBuilder:
 
     def set_input_type(self, input_type) -> "ListBuilder":
         self._input_type = input_type
+        return self
+
+    def gradient_checkpointing(self, enabled: bool = True) -> "ListBuilder":
+        """Recompute per-layer activations during backward instead of
+        storing them (``jax.checkpoint`` around every layer)."""
+        self._grad_checkpoint = bool(enabled)
         return self
 
     def backprop_type(self, bp: BackpropType, fwd: int = 20,
@@ -189,6 +200,7 @@ class ListBuilder:
             seed=self._base._seed,
             updater=self._base._updater,
             backprop_type=self._backprop_type,
+            gradient_checkpointing=self._grad_checkpoint,
             tbptt_fwd_length=self._tbptt_fwd,
             tbptt_back_length=self._tbptt_back,
             dtype=self._base._dtype,

@@ -37,28 +37,29 @@ class UnsupportedOnnxOpException(ValueError):
 
 
 def _tensor_to_np(t: "ox.TensorProto") -> np.ndarray:
+    shape = tuple(t.dims)
+    if t.data_type == 16:  # BFLOAT16: raw bytes or bit patterns
+        import ml_dtypes
+
+        if t.raw_data:
+            arr = np.frombuffer(t.raw_data, ml_dtypes.bfloat16)
+        elif len(t.int32_data):
+            arr = np.asarray(list(t.int32_data), np.uint16).view(
+                ml_dtypes.bfloat16)
+        else:
+            raise UnsupportedOnnxOpException(
+                f"tensor {t.name!r} (bfloat16) has no inline data")
+        return np.asarray(arr, np.float32).reshape(shape).copy()
     dtype = _DTYPES.get(t.data_type)
     if dtype is None:
-        if t.data_type == 16:  # BFLOAT16
-            import ml_dtypes
-
-            arr = np.frombuffer(t.raw_data, ml_dtypes.bfloat16)
-            return arr.astype(np.float32).reshape(tuple(t.dims)).copy()
         raise UnsupportedOnnxOpException(
             f"unsupported ONNX tensor dtype {t.data_type}")
-    shape = tuple(t.dims)
     if t.raw_data:
         return np.frombuffer(t.raw_data, dtype).reshape(shape).copy()
-    if t.data_type in (10, 16) and len(t.int32_data):
-        # fp16/bf16 typed storage is BIT PATTERNS in int32_data
+    if t.data_type == 10 and len(t.int32_data):
+        # fp16 typed storage is BIT PATTERNS in int32_data
         bits = np.asarray(list(t.int32_data), np.uint16)
-        if t.data_type == 10:
-            arr = bits.view(np.float16)
-        else:
-            import ml_dtypes
-
-            arr = bits.view(ml_dtypes.bfloat16).astype(np.float32)
-        return np.asarray(arr).reshape(shape)
+        return np.asarray(bits.view(np.float16)).reshape(shape)
     for field, ftype in (("float_data", np.float32),
                          ("int32_data", np.int32),
                          ("int64_data", np.int64),

@@ -105,6 +105,7 @@ class MultiLayerNetwork:
         ``rnn_time_step`` calls; None = start every RNN from zeros."""
         n = len(self.conf.layers) if upto is None else upto
         new_state, new_carries = {}, {}
+        remat = bool(getattr(self.conf, "gradient_checkpointing", False))
         for i in range(n):
             layer = self.conf.layers[i]
             p = params.get(str(i), {})
@@ -121,7 +122,15 @@ class MultiLayerNetwork:
                 if str(i) in state:
                     new_state[str(i)] = s
             else:
-                x, s2 = layer.forward(p, s, x, train=train, rng=lrng, **kw)
+                if remat and layer.has_params():
+                    def fwd(p, s, x, _layer=layer, _rng=lrng, _kw=kw):
+                        return _layer.forward(p, s, x, train=train,
+                                              rng=_rng, **_kw)
+
+                    x, s2 = jax.checkpoint(fwd)(p, s, x)
+                else:
+                    x, s2 = layer.forward(p, s, x, train=train, rng=lrng,
+                                          **kw)
                 if str(i) in state:
                     new_state[str(i)] = s2
         return x, new_state, new_carries

@@ -193,3 +193,18 @@ def test_serde_roundtrip():
                   GravesBidirectionalLSTM(n_out=4)):
         back = serde.from_json(serde.to_json(layer))
         assert back == layer, type(layer).__name__
+
+
+def test_pooling_sum_pnorm_and_prelu_shapes(rng):
+    x = jnp.asarray(rng.normal(size=(1, 4, 3)), jnp.float32)
+    s = Subsampling1DLayer(pooling_type=PoolingType.SUM, kernel_size=2,
+                           stride=2)
+    y, _ = s.forward({}, {}, x)
+    np.testing.assert_allclose(np.asarray(y[:, 0]),
+                               np.asarray(x[:, 0] + x[:, 1]), rtol=1e-6)
+    x3 = jnp.asarray(rng.normal(size=(1, 4, 4, 4, 2)), jnp.float32)
+    s3 = Subsampling3DLayer(pooling_type=PoolingType.SUM)
+    assert s3.forward({}, {}, x3)[0].shape == (1, 2, 2, 2, 2)
+    # PReLU handles 3D/flat input types
+    assert PReLULayer().init(KEY, InputType.convolutional_3d(4, 4, 4, 2))[
+        "alpha"].shape == (2,)

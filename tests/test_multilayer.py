@@ -243,3 +243,42 @@ def test_bfloat16_dtype_trains():
     for _ in range(20):
         s1 = net.fit_batch(ds)
     assert s1 < s0
+
+
+def test_gradient_checkpointing_matches_standard():
+    """.gradient_checkpointing(True): same loss/grads (remat changes
+    memory, not math)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.conf import Activation, InputType
+    from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.conf.losses import LossMCXENT
+    from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+    from deeplearning4j_tpu.conf.updaters import Sgd
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    def build(remat):
+        b = (NeuralNetConfiguration.builder()
+             .seed(7).updater(Sgd(0.1)).list()
+             .layer(DenseLayer(n_out=8, activation=Activation.TANH))
+             .layer(DenseLayer(n_out=8, activation=Activation.RELU))
+             .layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX,
+                                loss_fn=LossMCXENT())))
+        if remat:
+            b.gradient_checkpointing(True)
+        b.set_input_type(InputType.feed_forward(4))
+        net = MultiLayerNetwork(b.build())
+        net.init()
+        return net
+
+    a, b = build(False), build(True)
+    assert b.conf.gradient_checkpointing
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    ds = DataSet(x, y)
+    for _ in range(5):
+        la = a.fit_batch(ds)
+        lb = b.fit_batch(ds)
+    np.testing.assert_allclose(la, lb, rtol=1e-5)
+    np.testing.assert_allclose(a.params_flat(), b.params_flat(), rtol=1e-5)

@@ -271,3 +271,22 @@ def test_identity_propagates_static(rng):
     sd = OnnxGraphMapper.import_graph(m.SerializeToString())
     x = rng.normal(size=(2, 6)).astype(np.float32)
     assert np.asarray(sd.output({"x": x}, "r")["r"]).shape == (2, 2, 3)
+
+
+def test_bf16_int32_bitpattern_decodes():
+    import ml_dtypes
+
+    m = _model()
+    g = m.graph
+    _input(g, "x", (0, 2))
+    t = g.initializer.add()
+    t.name = "w"
+    t.data_type = 16  # BFLOAT16 via int32_data bit patterns
+    t.dims.extend([2])
+    t.int32_data.extend(
+        np.asarray([1.5, -3.0], ml_dtypes.bfloat16).view(
+            np.uint16).astype(np.int32).tolist())
+    _node(g, "Add", ["x", "w"], ["y"])
+    sd = OnnxGraphMapper.import_graph(m.SerializeToString())
+    np.testing.assert_allclose(np.asarray(sd.arrays["w"], np.float32),
+                               [1.5, -3.0])
