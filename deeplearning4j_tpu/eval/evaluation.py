@@ -390,3 +390,9 @@ class EvaluationCalibration:
         acc = np.nan_to_num(self.reliability_accuracy())
         conf = np.nan_to_num(self.reliability_confidence())
         return float(np.sum(self.bin_counts * np.abs(acc - conf)) / total)
+
+
+class ROCBinary(ROCMultiClass):
+    """Per-output-column binary ROC (reference ``ROCBinary`` for multi-label
+    sigmoid outputs) — same accumulation as ROCMultiClass, labels are
+    independent {0,1} columns rather than one-hot rows."""

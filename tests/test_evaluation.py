@@ -73,3 +73,14 @@ def test_evaluation_binary():
     eb.eval(labels, preds)
     assert np.isclose(eb.accuracy(0), 1.0)
     assert np.isclose(eb.recall(1), 0.5)
+
+
+def test_roc_binary_multilabel(rng):
+    from deeplearning4j_tpu.eval.evaluation import ROCBinary
+
+    n = 200
+    labels = (rng.random((n, 3)) > 0.5).astype(np.float32)
+    preds = np.clip(labels * 0.8 + rng.random((n, 3)) * 0.2, 0, 1)
+    roc = ROCBinary()
+    roc.eval(labels, preds.astype(np.float32))
+    assert roc.calculate_average_auc() > 0.9
