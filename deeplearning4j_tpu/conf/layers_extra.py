@@ -546,3 +546,41 @@ class GravesBidirectionalLSTM(Bidirectional):
                 n_out=self.n_out,
                 forget_gate_bias_init=self.forget_gate_bias_init)
         self.mode = BidirectionalMode.CONCAT
+
+
+@serde.register
+@dataclasses.dataclass
+class LayerNormalization(BaseLayer):
+    """Layer normalization over the feature axis with learnable gain/bias
+    (the reference exposes layer norm as ``DenseLayer.hasLayerNorm`` and
+    ``sd.nn.layerNorm``; a standalone conf layer makes Transformer blocks
+    composable in the graph DSL)."""
+
+    eps: float = 1e-5
+
+    def output_type(self, input_type):
+        return input_type
+
+    def _n(self, input_type):
+        if isinstance(input_type, it.Recurrent):
+            return input_type.size
+        if isinstance(input_type, (it.Convolutional, it.Convolutional3D)):
+            return input_type.channels
+        return input_type.size
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        n = self._n(input_type)
+        return {"gain": jnp.ones((n,), dtype),
+                "b": jnp.zeros((n,), dtype)}
+
+    def param_order(self):
+        return ["gain", "b"]
+
+    def regularized_param_keys(self):
+        return []
+
+    def forward(self, params, state, x, train=False, rng=None):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mu) * lax.rsqrt(var + self.eps)
+        return y * params["gain"] + params["b"], state

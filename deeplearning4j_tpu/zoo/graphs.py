@@ -836,3 +836,79 @@ class NASNet(GraphZooModel):
                                           loss_fn=LossMCXENT()), "gap")
         g.set_outputs("output")
         return g.build()
+
+
+class TransformerEncoder(GraphZooModel):
+    """Transformer encoder classifier (no direct reference zoo model — the
+    reference reaches Transformers only through SameDiff
+    ``multiHeadDotProductAttention`` / TF import, SURVEY.md §5.7; this makes
+    the same architecture a first-class graph config). Pre-LN blocks:
+    x + MHA(LN(x)), x + FFN(LN(x)); the attention core dispatches to the
+    Pallas flash kernel on TPU for long sequences
+    (``attention_impl='auto'``)."""
+
+    def __init__(self, num_classes: int = 2, vocab_size: int = 0,
+                 embed_dim: int = 64, n_heads: int = 4, n_layers: int = 2,
+                 ffn_dim: int = 0, max_len: int = 128, seed: int = 123,
+                 updater: IUpdater | None = None,
+                 attention_impl: str = "auto", causal: bool = False):
+        """``vocab_size``>0: token-id inputs through an embedding;
+        0: continuous ``[batch, time, embed_dim]`` inputs."""
+        self.num_classes = num_classes
+        self.vocab_size = vocab_size
+        self.embed_dim = embed_dim
+        self.n_heads = n_heads
+        self.n_layers = n_layers
+        self.ffn_dim = ffn_dim or 4 * embed_dim
+        self.max_len = max_len
+        self.seed = seed
+        self.updater = updater or Adam(learning_rate=1e-3)
+        self.attention_impl = attention_impl
+        self.causal = causal
+
+    def conf(self) -> ComputationGraphConfiguration:
+        from deeplearning4j_tpu.conf.layers import EmbeddingSequenceLayer
+        from deeplearning4j_tpu.conf.layers_attention import (
+            SelfAttentionLayer,
+        )
+        from deeplearning4j_tpu.conf.layers_extra import LayerNormalization
+
+        e = self.embed_dim
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed).updater(self.updater)
+             .weight_init(WeightInit.XAVIER)
+             .graph_builder()
+             .add_inputs("input")
+             .set_input_types(InputType.recurrent(
+                 e if not self.vocab_size else 1, timesteps=self.max_len)))
+        prev = "input"
+        if self.vocab_size:
+            g.add_layer("embed", EmbeddingSequenceLayer(
+                n_in=self.vocab_size, n_out=e), prev)
+            prev = "embed"
+        for i in range(self.n_layers):
+            g.add_layer(f"b{i}_ln1", LayerNormalization(), prev)
+            g.add_layer(f"b{i}_attn", SelfAttentionLayer(
+                n_out=e, n_heads=self.n_heads, causal=self.causal,
+                attention_impl=self.attention_impl), f"b{i}_ln1")
+            g.add_vertex(f"b{i}_res1",
+                         ElementWiseVertex(op=ElementWiseOp.ADD),
+                         prev, f"b{i}_attn")
+            g.add_layer(f"b{i}_ln2", LayerNormalization(), f"b{i}_res1")
+            g.add_layer(f"b{i}_ff1", DenseLayer(
+                n_out=self.ffn_dim, activation=Activation.GELU),
+                f"b{i}_ln2")
+            g.add_layer(f"b{i}_ff2", DenseLayer(
+                n_out=e, activation=Activation.IDENTITY), f"b{i}_ff1")
+            g.add_vertex(f"b{i}_res2",
+                         ElementWiseVertex(op=ElementWiseOp.ADD),
+                         f"b{i}_res1", f"b{i}_ff2")
+            prev = f"b{i}_res2"
+        g.add_layer("final_ln", LayerNormalization(), prev)
+        g.add_layer("pool", GlobalPoolingLayer(
+            pooling_type=PoolingType.AVG), "final_ln")
+        g.add_layer("output", OutputLayer(
+            n_out=self.num_classes, activation=Activation.SOFTMAX,
+            loss_fn=LossMCXENT()), "pool")
+        g.set_outputs("output")
+        return g.build()

@@ -152,3 +152,49 @@ class TestNewZooModels:
                                    num_cells=1, penultimate_filters=96),
                             32, 32, 3)
         assert out.shape == (2, 5)
+
+
+class TestTransformerEncoder:
+    def test_forward_and_learn(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.zoo.graphs import TransformerEncoder
+
+        rng = np.random.default_rng(0)
+        net = TransformerEncoder(num_classes=2, embed_dim=16, n_heads=2,
+                                 n_layers=2, max_len=8,
+                                 attention_impl="reference").init()
+        # task: class = sign of mean of first feature over time
+        x = rng.normal(size=(32, 8, 16)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x[:, :, 0].mean(1) > 0).astype(int)]
+        ds = DataSet(x, y)
+        s0 = net.fit_batch(ds)
+        for _ in range(40):
+            s1 = net.fit_batch(ds)
+        assert s1 < s0 * 0.7
+
+    def test_token_input_variant(self):
+        from deeplearning4j_tpu.zoo.graphs import TransformerEncoder
+
+        rng = np.random.default_rng(0)
+        net = TransformerEncoder(num_classes=3, vocab_size=50, embed_dim=16,
+                                 n_heads=2, n_layers=1, max_len=10).init()
+        ids = rng.integers(0, 50, (4, 10)).astype(np.int32)
+        out = np.asarray(net.output(ids))
+        assert out.shape == (4, 3)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+
+def test_layer_normalization_math(rng=None):
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.conf import InputType
+    from deeplearning4j_tpu.conf.layers_extra import LayerNormalization
+
+    rng = np.random.default_rng(0)
+    ln = LayerNormalization()
+    t = InputType.recurrent(8, timesteps=4)
+    params = ln.init(None, t)
+    x = jnp.asarray(rng.normal(size=(2, 4, 8), scale=3.0), jnp.float32)
+    y, _ = ln.forward(params, {}, x)
+    np.testing.assert_allclose(np.asarray(y).mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y).std(-1), 1.0, atol=1e-3)
