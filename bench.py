@@ -25,8 +25,8 @@ METRIC = "resnet50_train_images_per_sec_per_chip"
 BATCH = 64
 IMG = 224
 CLASSES = 1000
-STEPS_PER_RUN = 10
-RUNS = 3
+STEPS_PER_RUN = 12
+RUNS = 5
 BASELINE_FILE = Path(__file__).parent / "BENCH_BASELINE.json"
 
 
@@ -51,8 +51,10 @@ def main():
     labels = np.eye(CLASSES, dtype=np.float32)[rng.integers(0, CLASSES, BATCH)]
     ds = DataSet(features, labels)
 
-    # warmup: first step compiles
-    net.fit_batch(ds)
+    # warmup: first step compiles; a few extra steps settle the tunnel's
+    # post-compile transfer path (BASELINE.md notes the variance)
+    for _ in range(3):
+        net.fit_batch(ds)
     _ = net.score_value  # sync
 
     run_rates = []

@@ -6,9 +6,9 @@ LearnedSelfAttentionLayer, RecurrentAttentionLayer}`` and
 ``sd.nn.multiHeadDotProductAttention`` (the reference materializes the full
 attention matrix per head). TPU-native design: the projections are single
 large matmuls on the MXU and the softmax·V core goes through
-:func:`deeplearning4j_tpu.ops.dot_product_attention`, which dispatches to the
-Pallas flash kernel on TPU for long sequences (O(T) memory) — the reference
-has no such kernel.
+:func:`deeplearning4j_tpu.ops.dot_product_attention` (``auto`` = XLA
+blockwise for long sequences; ``attention_impl="flash"`` selects the
+strictly-O(T)-VMEM Pallas kernel — the reference has neither).
 
 Weight layout (locked by serializer round-trip tests): ``Wq/Wk/Wv:
 [nIn, nHeads*headSize]``, ``Wo: [nHeads*headSize, nOut]``, biases per

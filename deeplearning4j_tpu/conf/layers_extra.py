@@ -584,3 +584,37 @@ class LayerNormalization(BaseLayer):
         var = jnp.var(x, axis=-1, keepdims=True)
         y = (x - mu) * lax.rsqrt(var + self.eps)
         return y * params["gain"] + params["b"], state
+
+
+@serde.register
+@dataclasses.dataclass
+class PositionEmbeddingLayer(BaseLayer):
+    """Learned absolute position embeddings added to a sequence (no direct
+    reference layer — the reference reaches Transformers only through
+    SameDiff; kept here so TransformerEncoder is order-aware). Params
+    ``P: [max_len, size]``; sequences longer than ``max_len`` are
+    rejected at trace time."""
+
+    max_len: int = 512
+
+    def output_type(self, input_type):
+        return input_type
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        n = input_type.size
+        w = self.weight_init.init(key, (self.max_len, n), self.max_len, n,
+                                  dtype, self.distribution)
+        return {"P": w * 0.02}
+
+    def param_order(self):
+        return ["P"]
+
+    def regularized_param_keys(self):
+        return []
+
+    def forward(self, params, state, x, train=False, rng=None):
+        t = x.shape[1]
+        if t > self.max_len:
+            raise ValueError(f"sequence length {t} exceeds "
+                             f"max_len={self.max_len}")
+        return x + params["P"][None, :t, :], state

@@ -842,10 +842,11 @@ class TransformerEncoder(GraphZooModel):
     """Transformer encoder classifier (no direct reference zoo model — the
     reference reaches Transformers only through SameDiff
     ``multiHeadDotProductAttention`` / TF import, SURVEY.md §5.7; this makes
-    the same architecture a first-class graph config). Pre-LN blocks:
-    x + MHA(LN(x)), x + FFN(LN(x)); the attention core dispatches to the
-    Pallas flash kernel on TPU for long sequences
-    (``attention_impl='auto'``)."""
+    the same architecture a first-class graph config). Learned positional
+    embeddings, then pre-LN blocks: x + MHA(LN(x)), x + FFN(LN(x)). The
+    attention core goes through ``ops.dot_product_attention`` (``auto`` =
+    XLA blockwise for long sequences; ``attention_impl='flash'`` selects
+    the strictly-O(T)-VMEM Pallas kernel)."""
 
     def __init__(self, num_classes: int = 2, vocab_size: int = 0,
                  embed_dim: int = 64, n_heads: int = 4, n_layers: int = 2,
@@ -871,7 +872,10 @@ class TransformerEncoder(GraphZooModel):
         from deeplearning4j_tpu.conf.layers_attention import (
             SelfAttentionLayer,
         )
-        from deeplearning4j_tpu.conf.layers_extra import LayerNormalization
+        from deeplearning4j_tpu.conf.layers_extra import (
+            LayerNormalization,
+            PositionEmbeddingLayer,
+        )
 
         e = self.embed_dim
         g = (NeuralNetConfiguration.builder()
@@ -886,6 +890,9 @@ class TransformerEncoder(GraphZooModel):
             g.add_layer("embed", EmbeddingSequenceLayer(
                 n_in=self.vocab_size, n_out=e), prev)
             prev = "embed"
+        g.add_layer("pos", PositionEmbeddingLayer(max_len=self.max_len),
+                    prev)
+        prev = "pos"
         for i in range(self.n_layers):
             g.add_layer(f"b{i}_ln1", LayerNormalization(), prev)
             g.add_layer(f"b{i}_attn", SelfAttentionLayer(

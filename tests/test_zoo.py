@@ -172,6 +172,26 @@ class TestTransformerEncoder:
             s1 = net.fit_batch(ds)
         assert s1 < s0 * 0.7
 
+    def test_order_dependence_via_positions(self):
+        # without positional information this task is unlearnable: class =
+        # (first half mean of feature 0) > (second half mean of feature 0)
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.zoo.graphs import TransformerEncoder
+
+        rng = np.random.default_rng(3)
+        net = TransformerEncoder(num_classes=2, embed_dim=16, n_heads=2,
+                                 n_layers=2, max_len=8,
+                                 attention_impl="reference").init()
+        x = rng.normal(size=(64, 8, 16)).astype(np.float32)
+        cls = (x[:, :4, 0].mean(1) > x[:, 4:, 0].mean(1)).astype(int)
+        y = np.eye(2, dtype=np.float32)[cls]
+        ds = DataSet(x, y)
+        for _ in range(120):
+            net.fit_batch(ds)
+        preds = np.asarray(net.output(x)).argmax(-1)
+        acc = (preds == cls).mean()
+        assert acc > 0.85  # permutation-invariant models sit at ~0.5
+
     def test_token_input_variant(self):
         from deeplearning4j_tpu.zoo.graphs import TransformerEncoder
 
@@ -184,7 +204,7 @@ class TestTransformerEncoder:
         np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
 
 
-def test_layer_normalization_math(rng=None):
+def test_layer_normalization_math():
     import jax.numpy as jnp
 
     from deeplearning4j_tpu.conf import InputType
