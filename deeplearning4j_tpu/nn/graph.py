@@ -278,10 +278,10 @@ class ComputationGraph:
             self.params, self.state, self.opt_state, features, labels, lmasks,
             it, ep, rng)
         self.score_value = float(loss)
+        cur = self.iteration
+        self.iteration += 1  # listeners see iteration == next-to-run
         for lst in self.listeners:
-            lst.iteration_done(self, self.iteration, self.epoch,
-                               self.score_value)
-        self.iteration += 1
+            lst.iteration_done(self, cur, self.epoch, self.score_value)
         return self.score_value
 
     # --- inference / scoring ----------------------------------------------

@@ -310,10 +310,14 @@ class MultiLayerNetwork:
             lmask, it, ep, rng)
         self.last_batch_size = int(features.shape[0])
         self.score_value = float(loss)
-        for lst in self.listeners:
-            lst.iteration_done(self, self.iteration, self.epoch,
-                               self.score_value)
+        # increment BEFORE firing listeners: at listener time
+        # model.iteration is uniformly "next iteration to run" (tBPTT
+        # already works this way), while the arg stays the just-finished
+        # iteration's index
+        cur = self.iteration
         self.iteration += 1
+        for lst in self.listeners:
+            lst.iteration_done(self, cur, self.epoch, self.score_value)
         return self.score_value
 
     def _fit_tbptt(self, features, labels, fmask, lmask) -> float:

@@ -382,9 +382,10 @@ class ParallelWrapper:
             self.score_value = float(loss)
 
         m.score_value = self.score_value
+        cur = m.iteration
+        m.iteration += 1  # listeners see iteration == next-to-run
         for lst in m.listeners:
-            lst.iteration_done(m, m.iteration, m.epoch, self.score_value)
-        m.iteration += 1
+            lst.iteration_done(m, cur, m.epoch, self.score_value)
 
     def _write_back(self):
         """Publish trained params back onto the wrapped model (reference:

@@ -1,0 +1,14 @@
+"""DQN on CartPole (reference rl4j QLearningDiscreteDense example)."""
+import sys
+sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parents[1]))
+
+from deeplearning4j_tpu.rl4j import (CartPole, QLearningConfiguration,
+                                     QLearningDiscreteDense)
+
+cfg = QLearningConfiguration(
+    seed=3, max_step=6000, max_epoch_step=200, batch_size=64,
+    update_start=200, target_dqn_update_freq=100, epsilon_nb_step=3000,
+    learning_rate=5e-4)
+dqn = QLearningDiscreteDense(CartPole(max_steps=200, seed=3), cfg)
+dqn.train()
+print("greedy episode reward:", dqn.play(episodes=3))

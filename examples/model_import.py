@@ -1,0 +1,51 @@
+"""Model import: Keras HDF5, TF frozen GraphDef, ONNX — all without the
+source frameworks installed."""
+import json
+import sys
+sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parents[1]))
+
+import h5py
+import numpy as np
+
+from deeplearning4j_tpu.imports import OnnxGraphMapper, TFGraphMapper
+from deeplearning4j_tpu.imports.protos import tf_graph_pb2 as pb
+from deeplearning4j_tpu.modelimport import KerasModelImport
+
+rng = np.random.default_rng(0)
+
+# --- Keras Sequential h5 -------------------------------------------------
+w = rng.normal(size=(4, 3)).astype(np.float32)
+b = np.zeros(3, np.float32)
+cfg = {"class_name": "Sequential", "config": {"layers": [
+    {"class_name": "Dense", "config": {
+        "name": "dense", "units": 3, "activation": "softmax",
+        "use_bias": True, "batch_input_shape": [None, 4]}}]}}
+with h5py.File("/tmp/example_keras.h5", "w") as f:
+    f.attrs["model_config"] = json.dumps(cfg)
+    g = f.create_group("model_weights").create_group("dense").create_group(
+        "dense")
+    g.create_dataset("kernel", data=w)
+    g.create_dataset("bias", data=b)
+net = KerasModelImport.import_keras_sequential_model_and_weights(
+    "/tmp/example_keras.h5")
+print("keras import output:", np.asarray(
+    net.output(rng.normal(size=(2, 4)).astype(np.float32))).shape)
+
+# --- TF frozen GraphDef --------------------------------------------------
+g = pb.GraphDef()
+n = g.node.add(); n.name = "x"; n.op = "Placeholder"
+n.attr["dtype"].type = pb.DT_FLOAT
+for d in (-1, 4):
+    n.attr["shape"].shape.dim.add().size = d
+c = g.node.add(); c.name = "w"; c.op = "Const"
+c.attr["dtype"].type = pb.DT_FLOAT
+t = c.attr["value"].tensor; t.dtype = pb.DT_FLOAT
+t.tensor_shape.dim.add().size = 4
+t.tensor_shape.dim.add().size = 2
+t.tensor_content = w[:, :2].tobytes()
+mm = g.node.add(); mm.name = "y"; mm.op = "MatMul"
+mm.input.extend(["x", "w"])
+sd = TFGraphMapper.import_graph(g.SerializeToString())
+out = sd.output({"x": rng.normal(size=(2, 4)).astype(np.float32)}, "y")
+print("tf import output:", np.asarray(out["y"]).shape)
+print("ALL IMPORT PATHS OK")

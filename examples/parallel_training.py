@@ -1,0 +1,33 @@
+import sys
+sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parents[1]))
+import numpy as np, jax
+print("backend:", jax.default_backend())
+from deeplearning4j_tpu.conf import Activation, InputType
+from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.conf.losses import LossMCXENT
+from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+from deeplearning4j_tpu.conf.updaters import Sgd
+from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import (SparkDl4jMultiLayer,
+    SharedTrainingMaster, ParameterAveragingTrainingMaster)
+
+conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1)).list()
+        .layer(DenseLayer(n_out=8, activation=Activation.TANH))
+        .layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX, loss_fn=LossMCXENT()))
+        .set_input_type(InputType.feed_forward(4)).build())
+rng = np.random.default_rng(0)
+x = rng.normal(size=(64, 4)).astype(np.float32)
+y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+for master in (SharedTrainingMaster(), SharedTrainingMaster(threshold=1e-4),
+               ParameterAveragingTrainingMaster(averaging_frequency=2)):
+    net = MultiLayerNetwork(conf); net.init()
+    sn = SparkDl4jMultiLayer(None, net, master)
+    it = ArrayDataSetIterator(x, y, batch=32)
+    s0 = None
+    for _ in range(6):
+        sn.fit(it)
+        s0 = s0 or sn.score
+    print(type(master).__name__, f"{s0:.4f} -> {sn.score:.4f}")
+    assert sn.score < s0
+print("ALL CLUSTER DRIVE CHECKS PASSED")

@@ -1,0 +1,41 @@
+"""Transfer learning: freeze a trained front, swap the head, featurize."""
+import sys
+sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+from deeplearning4j_tpu.conf import Activation, InputType
+from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.conf.losses import LossMCXENT
+from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+from deeplearning4j_tpu.conf.updaters import Adam, Sgd
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.transferlearning import (
+    FineTuneConfiguration, TransferLearning, TransferLearningHelper)
+
+rng = np.random.default_rng(0)
+conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-2)).list()
+        .layer(DenseLayer(n_out=16, activation=Activation.TANH))
+        .layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX,
+                           loss_fn=LossMCXENT()))
+        .set_input_type(InputType.feed_forward(4)).build())
+base = MultiLayerNetwork(conf).init()
+x = rng.normal(size=(96, 4)).astype(np.float32)
+base.fit(x, np.eye(3, dtype=np.float32)[rng.integers(0, 3, 96)], epochs=5)
+
+# freeze the feature layer, put a fresh 5-class head on
+t_net = (TransferLearning.Builder(base)
+         .fine_tune_configuration(FineTuneConfiguration(updater=Sgd(0.05)))
+         .set_feature_extractor(0)
+         .remove_output_layer()
+         .add_layer(OutputLayer(n_out=5, activation=Activation.SOFTMAX,
+                                loss_fn=LossMCXENT()))
+         .build())
+y5 = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 96)]
+helper = TransferLearningHelper(t_net)
+feat = helper.featurize(DataSet(x, y5))
+for _ in range(20):
+    helper.fit_featurized(feat)
+print("tail score after featurized training:",
+      helper.unfrozen_mln().score_value)

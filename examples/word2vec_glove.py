@@ -1,0 +1,23 @@
+import sys
+sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parents[1]))
+import numpy as np, jax
+print("backend:", jax.default_backend())
+
+# Word2Vec on TPU
+from deeplearning4j_tpu.nlp import Word2Vec, WordVectorSerializer, Glove, ParagraphVectors
+rng = np.random.default_rng(0)
+animals = ["cat","dog","pet","fur","tail"]; cars = ["car","road","drive","wheel","engine"]
+sents = [" ".join(rng.choice(animals if rng.random()<.5 else cars, size=6)) for _ in range(300)]
+w2v = Word2Vec(layer_size=24, window_size=3, min_word_frequency=2, epochs=3, batch_size=256, seed=1).fit(sents)
+print("sim(cat,dog) %.3f  sim(cat,road) %.3f" % (w2v.similarity("cat","dog"), w2v.similarity("cat","road")))
+assert w2v.similarity("cat","dog") > w2v.similarity("cat","road")
+WordVectorSerializer.write_word2vec_model(w2v, "/tmp/w2v.zip")
+back = WordVectorSerializer.read_word2vec_model("/tmp/w2v.zip")
+assert abs(back.similarity("cat","dog") - w2v.similarity("cat","dog")) < 1e-6
+print("w2v serializer ok")
+
+g = Glove(layer_size=16, window_size=3, min_word_frequency=2, epochs=40).fit(sents)
+assert g.similarity("cat","dog") > g.similarity("cat","road")
+print("glove ok")
+
+print("NLP EXAMPLE DONE")

@@ -1,0 +1,27 @@
+"""TinyYOLO: train on synthetic boxes, extract detections with NMS."""
+import sys
+sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+from deeplearning4j_tpu.conf.layers_objdetect import (
+    Yolo2OutputLayer, get_predicted_objects, nms)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.zoo.graphs import TinyYOLO
+
+m = TinyYOLO(num_classes=3, height=64, width=64)
+net = m.init()
+rng = np.random.default_rng(0)
+feats = rng.normal(size=(4, 64, 64, 3)).astype(np.float32)
+labels = np.zeros((4, 2, 2, 7), np.float32)
+labels[:, 0, 1, 0:4] = [1.2, 0.2, 1.8, 0.9]  # grid-unit box
+labels[:, 0, 1, 4] = 1.0                     # class 0
+ds = DataSet(feats, labels)
+for i in range(150):
+    loss = net.fit_batch(ds)
+print("final yolo loss:", loss)
+layer = Yolo2OutputLayer(boxes=m.boxes)
+objs = nms(get_predicted_objects(layer, np.asarray(net.output(feats)),
+                                 threshold=0.05))
+print("detections:", [(o.example, o.predicted_class,
+                       round(o.confidence, 2)) for o in objs[:5]])
