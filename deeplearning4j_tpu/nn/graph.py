@@ -39,7 +39,7 @@ def _as_multi(ds) -> MultiDataSet:
         labels_masks=[ds.labels_mask] if ds.labels_mask is not None else None)
 
 
-class ComputationGraph:
+class ComputationGraph(nn_io.LazyScoreMixin):
     """DAG network (reference ``ComputationGraph``)."""
 
     def __init__(self, conf: ComputationGraphConfiguration):
@@ -50,7 +50,8 @@ class ComputationGraph:
         self.iteration = 0
         self.epoch = 0
         self.listeners: List[TrainingListener] = []
-        self.score_value: float = float("nan")
+        self._score_dev = None
+        self._score_cache: Optional[float] = float("nan")
         self._train_step = None
         self._output_fn = None
         self._score_fn = None
@@ -232,8 +233,11 @@ class ComputationGraph:
         for _ in range(epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self, self.epoch)
+            pending = []
             for ds in batches:
-                self.fit_batch(ds)
+                pending.append(self._fit_batch_async(ds))
+                nn_io.drain(pending)
+            nn_io.drain(pending, force=True)
             reset()
             for lst in self.listeners:
                 lst.on_epoch_end(self, self.epoch)
@@ -265,6 +269,12 @@ class ComputationGraph:
         return features, labels, lmasks
 
     def fit_batch(self, ds) -> float:
+        """One synced optimization step."""
+        return float(self._fit_batch_async(ds))
+
+    def _fit_batch_async(self, ds):
+        """One step without forcing a host sync (see
+        MultiLayerNetwork._fit_batch_async)."""
         if self.params is None:
             self.init()
         if self._train_step is None:
@@ -277,12 +287,13 @@ class ComputationGraph:
         self.params, self.state, self.opt_state, loss = self._train_step(
             self.params, self.state, self.opt_state, features, labels, lmasks,
             it, ep, rng)
-        self.score_value = float(loss)
+        self._score_dev = loss
+        self._score_cache = None
         cur = self.iteration
         self.iteration += 1  # listeners see iteration == next-to-run
         for lst in self.listeners:
-            lst.iteration_done(self, cur, self.epoch, self.score_value)
-        return self.score_value
+            lst.iteration_done(self, cur, self.epoch, loss)
+        return loss
 
     # --- inference / scoring ----------------------------------------------
     def output(self, *inputs):

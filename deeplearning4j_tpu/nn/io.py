@@ -53,3 +53,38 @@ def image_input(input_type) -> bool:
     from deeplearning4j_tpu.conf import inputs as it
 
     return isinstance(input_type, (it.Convolutional, it.ConvolutionalFlat))
+
+
+# bounded dispatch depth for async fit loops: the axon tunnel thrashes with
+# an unbounded queue yet pays ~100ms per host sync — a small pipeline
+# overlaps transfer/dispatch with compute
+DISPATCH_DEPTH = 4
+
+
+def drain(pending, force: bool = False):
+    """Block on queued step results when the pipeline is full (or at epoch
+    end with ``force``); returns the (possibly emptied) list."""
+    if pending and (force or len(pending) >= DISPATCH_DEPTH):
+        jax.block_until_ready(pending)
+        pending.clear()
+    return pending
+
+
+class LazyScoreMixin:
+    """``score_value`` backed by a device scalar, converted to float only
+    when read (both network classes share the async-fit contract)."""
+
+    _score_dev = None
+    _score_cache = None
+
+    @property
+    def score_value(self) -> float:
+        if self._score_cache is None and self._score_dev is not None:
+            self._score_cache = float(self._score_dev)
+        return (self._score_cache if self._score_cache is not None
+                else float("nan"))
+
+    @score_value.setter
+    def score_value(self, v):
+        self._score_dev = None
+        self._score_cache = None if v is None else float(v)

@@ -47,7 +47,7 @@ def _as_iterator(data, labels=None, batch_size: Optional[int] = None):
     raise TypeError(f"cannot build DataSetIterator from {type(data)}")
 
 
-class MultiLayerNetwork:
+class MultiLayerNetwork(nn_io.LazyScoreMixin):
     """Sequential network (reference ``MultiLayerNetwork``)."""
 
     def __init__(self, conf: MultiLayerConfiguration):
@@ -59,7 +59,8 @@ class MultiLayerNetwork:
         self.epoch = 0
         self.listeners: List[TrainingListener] = []
         self.last_batch_size: Optional[int] = None
-        self.score_value: float = float("nan")
+        self._score_dev = None
+        self._score_cache: Optional[float] = float("nan")
         self._train_step = None
         self._tbptt_step = None
         self._output_fn = None
@@ -270,8 +271,11 @@ class MultiLayerNetwork:
         for _ in range(epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self, self.epoch)
+            pending = []
             for ds in iterator:
-                self.fit_batch(ds)
+                pending.append(self._fit_batch_async(ds))
+                nn_io.drain(pending)
+            nn_io.drain(pending, force=True)
             iterator.reset()
             for lst in self.listeners:
                 lst.on_epoch_end(self, self.epoch)
@@ -289,9 +293,11 @@ class MultiLayerNetwork:
             lmask = jnp.ones((features.shape[0],), self._dtype)
         return features, labels, fmask, lmask
 
-    def fit_batch(self, ds: DataSet) -> float:
-        """One optimization step on one minibatch (tBPTT: one step per
-        segment, reference ``MultiLayerNetwork#doTruncatedBPTT``)."""
+    def _fit_batch_async(self, ds: DataSet):
+        """One step WITHOUT forcing a host sync: the loss stays a device
+        scalar (``score_value`` converts lazily); listeners receive the
+        device scalar and only sync when they actually read it (e.g.
+        ScoreIterationListener every N prints)."""
         if self.params is None:
             self.init()
         features, labels, fmask, lmask = self._batch_arrays(ds)
@@ -309,7 +315,8 @@ class MultiLayerNetwork:
             self.params, self.state, self.opt_state, features, labels, fmask,
             lmask, it, ep, rng)
         self.last_batch_size = int(features.shape[0])
-        self.score_value = float(loss)
+        self._score_dev = loss
+        self._score_cache = None
         # increment BEFORE firing listeners: at listener time
         # model.iteration is uniformly "next iteration to run" (tBPTT
         # already works this way), while the arg stays the just-finished
@@ -317,8 +324,13 @@ class MultiLayerNetwork:
         cur = self.iteration
         self.iteration += 1
         for lst in self.listeners:
-            lst.iteration_done(self, cur, self.epoch, self.score_value)
-        return self.score_value
+            lst.iteration_done(self, cur, self.epoch, loss)
+        return loss
+
+    def fit_batch(self, ds: DataSet) -> float:
+        """One optimization step on one minibatch, synced (tBPTT: one step
+        per segment, reference ``MultiLayerNetwork#doTruncatedBPTT``)."""
+        return float(self._fit_batch_async(ds))
 
     def _fit_tbptt(self, features, labels, fmask, lmask) -> float:
         """Truncated BPTT: slice the time axis into segments of
@@ -377,7 +389,10 @@ class MultiLayerNetwork:
         self.last_batch_size = int(n)
         self.score_value = float(np.mean(losses))
         for lst in self.listeners:
-            lst.iteration_done(self, self.iteration, self.epoch,
+            # arg = just-finished iteration index, matching the standard
+            # path (tBPTT counts one iteration per segment; the batch-level
+            # listener sees the LAST segment's index)
+            lst.iteration_done(self, self.iteration - 1, self.epoch,
                                self.score_value)
         return self.score_value
 

@@ -1,23 +1,21 @@
+"""LeNet on MNIST: zoo model -> fit -> evaluate -> serializer round-trip."""
 import sys
 sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parents[1]))
-from deeplearning4j_tpu.conf import Activation, InputType
-from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
-from deeplearning4j_tpu.conf.losses import LossMCXENT
-from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
-from deeplearning4j_tpu.conf.updaters import Adam
-from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
-from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
-conf = (NeuralNetConfiguration.builder()
-        .seed(123).updater(Adam(1e-3)).list()
-        .layer(DenseLayer(n_out=256, activation=Activation.RELU))
-        .layer(OutputLayer(n_out=10, activation=Activation.SOFTMAX,
-                           loss_fn=LossMCXENT()))
-        .set_input_type(InputType.convolutional(28, 28, 1))
-        .build())
-net = MultiLayerNetwork(conf).init()
-net.fit(MnistDataSetIterator(batch=128), epochs=5)
-acc = net.evaluate(MnistDataSetIterator(batch=128, train=False, num_examples=512)).accuracy()
-print("quickstart accuracy:", acc)
-assert acc > 0.6, acc
-print("README QUICKSTART OK")
+import numpy as np
+
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.util import serializer
+from deeplearning4j_tpu.zoo.models import LeNet
+
+net = LeNet(num_classes=10).init()
+net.fit(MnistDataSetIterator(batch=128), epochs=2)
+ev = net.evaluate(MnistDataSetIterator(batch=128, train=False))
+print("LeNet accuracy:", ev.accuracy())
+print(net.summary())
+
+serializer.write_model(net, "/tmp/lenet.zip")
+restored = serializer.restore_multi_layer_network("/tmp/lenet.zip")
+np.testing.assert_allclose(restored.params_flat(), net.params_flat(),
+                           rtol=1e-6)
+print("serializer round-trip exact")

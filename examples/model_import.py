@@ -48,4 +48,34 @@ mm.input.extend(["x", "w"])
 sd = TFGraphMapper.import_graph(g.SerializeToString())
 out = sd.output({"x": rng.normal(size=(2, 4)).astype(np.float32)}, "y")
 print("tf import output:", np.asarray(out["y"]).shape)
+
+# --- ONNX ModelProto -----------------------------------------------------
+from deeplearning4j_tpu.imports.protos import onnx_model_pb2 as ox
+
+m = ox.ModelProto()
+m.ir_version = 8
+m.opset_import.add().version = 13
+og = m.graph
+vi = og.input.add()
+vi.name = "x"
+tt = vi.type.tensor_type
+tt.elem_type = 1
+d = tt.shape.dim.add(); d.dim_param = "N"
+d = tt.shape.dim.add(); d.dim_value = 4
+t = og.initializer.add()
+t.name = "w"
+t.data_type = 1
+t.dims.extend([4, 3])
+t.raw_data = w.tobytes()
+node = og.node.add()
+node.op_type = "Gemm"
+node.input.extend(["x", "w"])
+node.output.append("y")
+node2 = og.node.add()
+node2.op_type = "Softmax"
+node2.input.append("y")
+node2.output.append("p")
+sd2 = OnnxGraphMapper.import_graph(m.SerializeToString())
+out2 = sd2.output({"x": rng.normal(size=(2, 4)).astype(np.float32)}, "p")
+print("onnx import output:", np.asarray(out2["p"]).shape)
 print("ALL IMPORT PATHS OK")
