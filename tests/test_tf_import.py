@@ -309,3 +309,26 @@ def test_progressive_unfreezing_resets_updater_state(rng):
     SDVariable(sd, "w1").convert_to_variable()  # progressive unfreeze
     sd.fit(it)  # must not KeyError
     assert not np.allclose(np.asarray(sd.arrays["w1"]), w1)
+
+
+def test_imported_graph_serializes(tmp_path, rng):
+    """Imported graphs round-trip through SameDiff save/load (reference:
+    TFGraphMapper output is a normal SameDiff, persistable as FlatBuffers)."""
+    from deeplearning4j_tpu.samediff.serde import load as sd_load
+    from deeplearning4j_tpu.samediff.serde import save as sd_save
+
+    w = rng.normal(size=(4, 3)).astype(np.float32)
+    g = pb.GraphDef()
+    _placeholder(g, "input", (0, 4))
+    _const(g, "w", w)
+    _node(g, "mm", "MatMul", "input", "w",
+          transpose_a=False, transpose_b=False)
+    _node(g, "out", "Softmax", "mm")
+    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    x = rng.normal(size=(2, 4)).astype(np.float32)
+    before = np.asarray(sd.output({"input": x}, "out")["out"])
+    path = str(tmp_path / "imported.sdz")
+    sd_save(sd, path)
+    sd2 = sd_load(path)
+    after = np.asarray(sd2.output({"input": x}, "out")["out"])
+    np.testing.assert_allclose(before, after, rtol=1e-6)
