@@ -64,6 +64,9 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dl4j_gather_rows.restype = None
     lib.dl4j_gather_rows.argtypes = [ctypes.c_char_p, P(i64), i64, i64,
                                      ctypes.c_char_p]
+    lib.dl4j_w2v_pairs.restype = i64
+    lib.dl4j_w2v_pairs.argtypes = [P(i32), P(i64), i64, i64,
+                                   ctypes.c_uint64, P(i32), i64]
     lib.dl4j_native_version.restype = ctypes.c_int
     lib.dl4j_native_threads.restype = ctypes.c_int
     return lib
@@ -265,6 +268,45 @@ def gather_rows(src: np.ndarray, indices: np.ndarray) -> np.ndarray:
         idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), idx.size,
         row_bytes, dst.ctypes.data_as(ctypes.c_char_p))
     return dst
+
+
+def w2v_pairs(sentences, window: int, seed: int = 1):
+    """Skip-gram (center, context) pairs with word2vec.c dynamic windows
+    (reference: the nd4j SkipGram native op's pair walk). ``sentences``:
+    list of int32 arrays of token indices. Returns int32 [n, 2]. Falls back
+    to the Python walk when the native lib is unavailable."""
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    sents = [np.ascontiguousarray(s, np.int32) for s in sentences if len(s)]
+    lib = get_lib()
+    if lib is None:
+        rng = np.random.default_rng(seed)
+        pairs = []
+        for sent in sents:
+            n = len(sent)
+            if n < 2:
+                continue
+            b = rng.integers(1, window + 1, n)
+            for i in range(n):
+                lo, hi = max(0, i - b[i]), min(n, i + b[i] + 1)
+                for j in range(lo, hi):
+                    if j != i:
+                        pairs.append((sent[i], sent[j]))
+        return (np.asarray(pairs, np.int32) if pairs
+                else np.zeros((0, 2), np.int32))
+    tokens = (np.concatenate(sents) if sents else np.zeros(0, np.int32))
+    offsets = np.zeros(len(sents) + 1, np.int64)
+    np.cumsum([len(s) for s in sents], out=offsets[1:])
+    cap = max(int(tokens.size) * 2 * int(window), 16)
+    out = np.empty((cap, 2), np.int32)
+    cnt = lib.dl4j_w2v_pairs(
+        tokens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(sents), int(window), ctypes.c_uint64(seed or 1).value,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), cap)
+    if cnt < 0:
+        raise ValueError(f"window must be >= 1, got {window}")
+    return out[:cnt].copy()
 
 
 def native_threads() -> int:

@@ -104,30 +104,21 @@ class Word2Vec:
     def _pairs(self, encoded: Sequence[np.ndarray],
                rng: np.random.Generator) -> np.ndarray:
         """All (center, context) pairs with word2vec.c dynamic windows and
-        optional frequency subsampling; vectorized per sentence."""
-        counts = np.asarray(self.vocab.counts(), np.float64)
-        total = counts.sum()
-        keep_prob = None
+        optional frequency subsampling. The pair walk runs in the native
+        library (the role of the reference's nd4j SkipGram native op);
+        subsampling filters host-side first."""
+        from deeplearning4j_tpu import native
+
+        sents = encoded
         if self.sampling > 0:
-            f = counts / total
+            counts = np.asarray(self.vocab.counts(), np.float64)
+            f = counts / counts.sum()
             keep_prob = np.minimum(
                 1.0, np.sqrt(self.sampling / f) + self.sampling / f)
-        pairs = []
-        for sent in encoded:
-            if keep_prob is not None and len(sent):
-                sent = sent[rng.random(len(sent)) < keep_prob[sent]]
-            n = len(sent)
-            if n < 2:
-                continue
-            b = rng.integers(1, self.window + 1, n)  # dynamic window sizes
-            for i in range(n):
-                lo, hi = max(0, i - b[i]), min(n, i + b[i] + 1)
-                for j in range(lo, hi):
-                    if j != i:
-                        pairs.append((sent[i], sent[j]))
-        if not pairs:
-            return np.zeros((0, 2), np.int32)
-        return np.asarray(pairs, np.int32)
+            sents = [sent[rng.random(len(sent)) < keep_prob[sent]]
+                     for sent in encoded if len(sent)]
+        return native.w2v_pairs(sents, self.window,
+                                seed=int(rng.integers(1, 2 ** 62)))
 
     # --- training -----------------------------------------------------------
     def fit(self, sentences: Iterable) -> "Word2Vec":

@@ -224,6 +224,49 @@ void dl4j_gather_rows(const char* src, const int64_t* idx, int64_t nidx,
     memcpy(dst + i * row_bytes, src + idx[i] * row_bytes, (size_t)row_bytes);
 }
 
+// ---------------------------------------------------------------------------
+// Word2Vec skip-gram pair generation (reference: the nd4j SkipGram native op
+// builds (center, context) pairs on the native side; word2vec.c dynamic
+// windows). Sentences arrive concatenated with an offsets array.
+// out: int32 pairs [cap][2]; returns pair count (<= cap guaranteed by the
+// caller sizing cap = total_tokens * 2 * window).
+// ---------------------------------------------------------------------------
+
+static inline uint64_t xorshift64(uint64_t* s) {
+  uint64_t x = *s;
+  x ^= x << 13;
+  x ^= x >> 7;
+  x ^= x << 17;
+  return *s = x;
+}
+
+int64_t dl4j_w2v_pairs(const int32_t* tokens, const int64_t* offsets,
+                       int64_t n_sentences, int64_t window, uint64_t seed,
+                       int32_t* out, int64_t cap) {
+  if (window < 1) return -1;  // caller raises; avoids modulo-by-zero
+  int64_t cnt = 0;
+  uint64_t st = seed ? seed : 0x9E3779B97F4A7C15ull;
+  for (int64_t si = 0; si < n_sentences; ++si) {
+    const int32_t* sent = tokens + offsets[si];
+    int64_t n = offsets[si + 1] - offsets[si];
+    if (n < 2) continue;
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t b = 1 + (int64_t)(xorshift64(&st) % (uint64_t)window);
+      int64_t lo = i - b < 0 ? 0 : i - b;
+      int64_t hi = i + b + 1 > n ? n : i + b + 1;
+      for (int64_t j = lo; j < hi; ++j) {
+        if (j == i) continue;
+        if (cnt < cap) {
+          out[cnt * 2] = sent[i];
+          out[cnt * 2 + 1] = sent[j];
+        }
+        ++cnt;
+      }
+    }
+  }
+  return cnt;
+}
+
 int dl4j_native_version() { return 1; }
 
 int dl4j_native_threads() {

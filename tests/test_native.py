@@ -156,3 +156,26 @@ def test_csv_whitespace_line_parity(monkeypatch):
     m_py = native.parse_numeric_csv("1,2\n \n3,4\n")
     np.testing.assert_array_equal(m_native, m_py)
     assert native.parse_numeric_csv("").shape == (0, 0)
+
+
+def test_w2v_pairs_native_vs_fallback(monkeypatch, rng):
+    sents = [rng.integers(0, 50, rng.integers(2, 12)).astype(np.int32)
+             for _ in range(30)]
+    pn = native.w2v_pairs(sents, window=3, seed=9)
+    assert pn.shape[1] == 2 and len(pn) > 0
+    # every pair is within the max window distance in SOME sentence
+    monkeypatch.setattr(native, "get_lib", lambda: None)
+    pf = native.w2v_pairs(sents, window=3, seed=9)
+    # different RNG streams -> different dynamic windows, but bounds match:
+    # pair count within the [n-1 .. 2*window] per-token envelope both ways
+    total = sum(len(s) for s in sents)
+    for p in (pn, pf):
+        assert total - len(sents) <= len(p) <= total * 2 * 3
+
+
+def test_w2v_pairs_rejects_bad_window(rng):
+    sents = [rng.integers(0, 9, 5).astype(np.int32)]
+    with pytest.raises(ValueError):
+        native.w2v_pairs(sents, window=0)
+    with pytest.raises(ValueError):
+        native.w2v_pairs(sents, window=-1)
