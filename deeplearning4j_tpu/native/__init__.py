@@ -270,43 +270,98 @@ def gather_rows(src: np.ndarray, indices: np.ndarray) -> np.ndarray:
     return dst
 
 
+_XORSHIFT_INIT = 0x9E3779B97F4A7C15
+_MASK64 = (1 << 64) - 1
+
+
+def _xorshift64_stream(seed: int):
+    """The SAME xorshift64 stream the C side uses — keeps native and
+    fallback paths bit-identical for a given seed."""
+    st = (seed or _XORSHIFT_INIT) & _MASK64
+    while True:
+        st = (st ^ (st << 13)) & _MASK64
+        st ^= st >> 7
+        st = (st ^ (st << 17)) & _MASK64
+        yield st
+
+
+# chunk bound for the worst-case pair buffer: tokens*2*window int32 pairs
+_W2V_CHUNK_TOKENS = 1 << 20
+
+
 def w2v_pairs(sentences, window: int, seed: int = 1):
     """Skip-gram (center, context) pairs with word2vec.c dynamic windows
     (reference: the nd4j SkipGram native op's pair walk). ``sentences``:
-    list of int32 arrays of token indices. Returns int32 [n, 2]. Falls back
-    to the Python walk when the native lib is unavailable."""
+    list of int32 arrays of token indices. Returns int32 [n, 2]. The
+    numpy fallback replays the identical RNG stream, so results are
+    bit-equal with or without the native lib."""
     if window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
     sents = [np.ascontiguousarray(s, np.int32) for s in sentences if len(s)]
     lib = get_lib()
     if lib is None:
-        rng = np.random.default_rng(seed)
+        rng = _xorshift64_stream(int(seed))
         pairs = []
         for sent in sents:
             n = len(sent)
             if n < 2:
+                # the C walk still consumes no RNG for n<2 sentences
                 continue
-            b = rng.integers(1, window + 1, n)
             for i in range(n):
-                lo, hi = max(0, i - b[i]), min(n, i + b[i] + 1)
+                b = 1 + (next(rng) % window)
+                lo, hi = max(0, i - b), min(n, i + b + 1)
                 for j in range(lo, hi):
                     if j != i:
                         pairs.append((sent[i], sent[j]))
         return (np.asarray(pairs, np.int32) if pairs
                 else np.zeros((0, 2), np.int32))
-    tokens = (np.concatenate(sents) if sents else np.zeros(0, np.int32))
-    offsets = np.zeros(len(sents) + 1, np.int64)
-    np.cumsum([len(s) for s in sents], out=offsets[1:])
-    cap = max(int(tokens.size) * 2 * int(window), 16)
-    out = np.empty((cap, 2), np.int32)
-    cnt = lib.dl4j_w2v_pairs(
-        tokens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-        len(sents), int(window), ctypes.c_uint64(seed or 1).value,
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), cap)
-    if cnt < 0:
-        raise ValueError(f"window must be >= 1, got {window}")
-    return out[:cnt].copy()
+    # chunk sentences so the worst-case buffer stays bounded (~8MB*window
+    # per chunk instead of corpus-sized)
+    chunks = []
+    cur, cur_tokens = [], 0
+    for sent in sents:
+        cur.append(sent)
+        cur_tokens += len(sent)
+        if cur_tokens >= _W2V_CHUNK_TOKENS:
+            chunks.append(cur)
+            cur, cur_tokens = [], 0
+    if cur:
+        chunks.append(cur)
+    results = []
+    # the C side advances its own stream copy; chunking stays transparent
+    # by re-seeding each chunk with the state after the draws consumed so
+    # far (one draw per token of every length>=2 sentence)
+    consumed = 0
+    for chunk in chunks:
+        tokens = np.concatenate(chunk)
+        offsets = np.zeros(len(chunk) + 1, np.int64)
+        np.cumsum([len(s) for s in chunk], out=offsets[1:])
+        cap = max(int(tokens.size) * 2 * int(window), 16)
+        out = np.empty((cap, 2), np.int32)
+        # seed for this chunk = state after the tokens consumed so far
+        chunk_seed = int(seed) if consumed == 0 else _advance(
+            int(seed), consumed)
+        cnt = lib.dl4j_w2v_pairs(
+            tokens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(chunk), int(window),
+            ctypes.c_uint64(chunk_seed or 1).value,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), cap)
+        if cnt < 0:
+            raise RuntimeError(f"native w2v_pairs failed: {cnt}")
+        results.append(out[:cnt].copy())
+        consumed += sum(len(s) for s in chunk if len(s) >= 2)
+    return (np.concatenate(results) if results
+            else np.zeros((0, 2), np.int32))
+
+
+def _advance(seed: int, steps: int) -> int:
+    st = (seed or _XORSHIFT_INIT) & _MASK64
+    for _ in range(steps):
+        st = (st ^ (st << 13)) & _MASK64
+        st ^= st >> 7
+        st = (st ^ (st << 17)) & _MASK64
+    return st
 
 
 def native_threads() -> int:

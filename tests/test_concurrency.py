@@ -1,0 +1,142 @@
+"""Concurrency stress: async prefetch under slow/bursty producers and
+concurrent ParallelInference callers (reference: the accumulator's dedicated
+multithreaded stress tests — SURVEY.md §5.2 notes races are otherwise
+handled by construction)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.conf import Activation, InputType
+from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.conf.losses import LossMCXENT
+from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+from deeplearning4j_tpu.conf.updaters import Sgd
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import (
+    DataSetIterator,
+    ListDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.prefetch import AsyncDataSetIterator
+
+
+class SlowIterator(DataSetIterator):
+    """Bursty producer with per-batch latency."""
+
+    def __init__(self, batches, delay=0.002):
+        self._batches = batches
+        self._delay = delay
+
+    def reset(self):
+        pass
+
+    def batch_size(self):
+        return self._batches[0].num_examples()
+
+    def __iter__(self):
+        for ds in self._batches:
+            time.sleep(self._delay)
+            yield ds
+
+
+def _batches(n, rng, rows=8):
+    return [DataSet(rng.normal(size=(rows, 4)).astype(np.float32),
+                    np.eye(2, dtype=np.float32)[
+                        rng.integers(0, 2, rows)])
+            for _ in range(n)]
+
+
+def test_async_iterator_preserves_order_and_count(rng):
+    batches = _batches(40, rng)
+    it = AsyncDataSetIterator(SlowIterator(batches), queue_size=3)
+    for round_ in range(3):  # reuse across epochs (producer restart)
+        seen = list(it)
+        assert len(seen) == 40
+        for got, want in zip(seen, batches):
+            np.testing.assert_array_equal(got.features, want.features)
+
+
+def test_async_iterator_propagates_producer_error(rng):
+    class Exploding(SlowIterator):
+        def __iter__(self):
+            yield self._batches[0]
+            raise RuntimeError("etl failure")
+
+    it = AsyncDataSetIterator(Exploding(_batches(2, rng)), queue_size=2)
+    with pytest.raises(RuntimeError, match="etl failure"):
+        list(it)
+
+
+def test_async_iterator_early_break_then_reuse(rng):
+    batches = _batches(20, rng)
+    it = AsyncDataSetIterator(SlowIterator(batches), queue_size=4)
+    for i, _ in enumerate(it):
+        if i == 3:
+            break  # consumer abandons mid-epoch
+    seen = list(it)  # fresh epoch restarts the producer cleanly
+    assert len(seen) == 20
+
+
+def test_parallel_inference_concurrent_callers(rng):
+    from deeplearning4j_tpu.parallel import ParallelInference
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_out=8, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=2, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    net = MultiLayerNetwork(conf)
+    net.init()
+    pi = ParallelInference(net)
+    rng_local = np.random.default_rng(0)
+    xs = [rng_local.normal(size=(16, 4)).astype(np.float32)
+          for _ in range(8)]
+    expected = [np.asarray(net.output(x)) for x in xs]
+
+    results = [None] * 8
+    errors = []
+
+    def worker(i):
+        try:
+            for _ in range(5):
+                results[i] = np.asarray(pi.output(xs[i]))
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    for got, want in zip(results, expected):
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_training_while_prefetching(rng):
+    """fit() over an async iterator with a slow producer: all batches
+    consumed, loss finite, no deadlock (bounded dispatch + bounded queue
+    interacting)."""
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_out=8, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=2, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    batches = _batches(30, rng)
+    it = AsyncDataSetIterator(SlowIterator(batches, delay=0.001),
+                              queue_size=2)
+    net.fit(it, epochs=2)
+    assert net.iteration == 60
+    assert np.isfinite(net.score_value)

@@ -163,14 +163,24 @@ def test_w2v_pairs_native_vs_fallback(monkeypatch, rng):
              for _ in range(30)]
     pn = native.w2v_pairs(sents, window=3, seed=9)
     assert pn.shape[1] == 2 and len(pn) > 0
-    # every pair is within the max window distance in SOME sentence
     monkeypatch.setattr(native, "get_lib", lambda: None)
     pf = native.w2v_pairs(sents, window=3, seed=9)
-    # different RNG streams -> different dynamic windows, but bounds match:
-    # pair count within the [n-1 .. 2*window] per-token envelope both ways
-    total = sum(len(s) for s in sents)
-    for p in (pn, pf):
-        assert total - len(sents) <= len(p) <= total * 2 * 3
+    # both paths replay the identical xorshift64 stream: BIT-EQUAL pairs
+    np.testing.assert_array_equal(pn, pf)
+
+
+def test_w2v_pairs_contents(rng):
+    # positional identity: with window w every emitted pair's context must
+    # lie within w positions of its center in the generating sentence
+    sent = np.arange(100, 110, dtype=np.int32)  # unique tokens
+    w = 2
+    pairs = native.w2v_pairs([sent], window=w, seed=5)
+    pos = {int(t): i for i, t in enumerate(sent)}
+    for c, ctx in pairs.tolist():
+        d = abs(pos[c] - pos[ctx])
+        assert 1 <= d <= w
+    # every center token appears (each token emits >= 1 pair)
+    assert {int(t) for t in sent} == {int(c) for c, _ in pairs.tolist()}
 
 
 def test_w2v_pairs_rejects_bad_window(rng):
@@ -179,3 +189,12 @@ def test_w2v_pairs_rejects_bad_window(rng):
         native.w2v_pairs(sents, window=0)
     with pytest.raises(ValueError):
         native.w2v_pairs(sents, window=-1)
+
+
+def test_w2v_pairs_chunked_matches_unchunked(monkeypatch, rng):
+    sents = [rng.integers(0, 50, rng.integers(2, 12)).astype(np.int32)
+             for _ in range(40)]
+    whole = native.w2v_pairs(sents, window=3, seed=11)
+    monkeypatch.setattr(native, "_W2V_CHUNK_TOKENS", 32)  # force many chunks
+    chunked = native.w2v_pairs(sents, window=3, seed=11)
+    np.testing.assert_array_equal(whole, chunked)

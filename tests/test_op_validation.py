@@ -1,0 +1,100 @@
+"""Per-op validation via the OpValidation harness (reference
+``org.nd4j.autodiff.validation.OpValidation`` — forward + gradient per op,
+with coverage accounting)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.samediff.core import SameDiff
+from deeplearning4j_tpu.samediff.validation import (
+    TestCase,
+    coverage_report,
+    validate,
+)
+
+
+def _case(build, inputs, expected, **kw):
+    sd = SameDiff.create()
+    build(sd)
+    return TestCase(sd, inputs, expected, **kw)
+
+
+def test_matmul_and_bias():
+    sd = SameDiff.create()
+    a = sd.placeholder("a", shape=(2, 3), dtype="float64")
+    b = sd.placeholder("b", shape=(3, 2), dtype="float64")
+    y = sd.math.mmul(a, b, name="y")
+    av = np.arange(6, dtype=np.float64).reshape(2, 3)
+    bv = np.arange(6, dtype=np.float64).reshape(3, 2) * 0.5
+    validate(TestCase(sd, {"a": av, "b": bv}, {"y": av @ bv}))
+
+
+def test_elementwise_family():
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(4,), dtype="float64")
+    y = sd.placeholder("y", shape=(4,), dtype="float64")
+    s = (x * y + x - y / 2.0).rename("s")
+    xv = np.asarray([0.5, -1.0, 2.0, 3.0])
+    yv = np.asarray([1.0, 2.0, -0.5, 0.25])
+    validate(TestCase(sd, {"x": xv, "y": yv},
+                      {"s": xv * yv + xv - yv / 2.0}))
+
+
+def test_activations_and_reductions():
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(3, 4), dtype="float64")
+    h = sd.nn.tanh(x)
+    m = sd.math.mean(h, dims=(1,), name="m")
+    xv = np.linspace(-2, 2, 12).reshape(3, 4)
+    validate(TestCase(sd, {"x": xv}, {"m": np.tanh(xv).mean(1)}))
+
+
+def test_softmax_gradient():
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(2, 5), dtype="float64")
+    p = sd.nn.softmax(x, name="p")
+    xv = np.random.default_rng(0).normal(size=(2, 5))
+    e = np.exp(xv - xv.max(1, keepdims=True))
+    validate(TestCase(sd, {"x": xv}, {"p": e / e.sum(1, keepdims=True)}))
+
+
+def test_conv2d_forward_and_grad():
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(1, 4, 4, 2), dtype="float64")
+    w = sd.placeholder("w", shape=(2, 2, 2, 3), dtype="float64")
+    b = sd.constant(np.zeros(3))
+    y = sd.cnn.conv2d(x, w, b, strides=(1, 1), padding="VALID", name="y")
+    rng = np.random.default_rng(1)
+    xv = rng.normal(size=(1, 4, 4, 2))
+    wv = rng.normal(size=(2, 2, 2, 3)) * 0.5
+    import jax
+
+    want = np.asarray(jax.lax.conv_general_dilated(
+        xv, wv, (1, 1), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC")))
+    validate(TestCase(sd, {"x": xv, "w": wv}, {"y": want},
+                      max_rel_error=1e-3))
+
+
+def test_layer_norm_grad():
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(2, 6), dtype="float64")
+    g = sd.constant(np.ones(6))
+    b = sd.constant(np.zeros(6))
+    y = sd.nn.layerNorm(x, g, b, name="y")
+    xv = np.random.default_rng(2).normal(size=(2, 6)) * 3
+    mu = xv.mean(-1, keepdims=True)
+    var = xv.var(-1, keepdims=True)
+    validate(TestCase(sd, {"x": xv}, {"y": (xv - mu) / np.sqrt(var + 1e-5)},
+                      max_rel_error=1e-3))
+
+
+def test_coverage_accounting_floor():
+    """Reference parity: op validation keeps a coverage ledger. The floor
+    asserts the harness is actually recording (the broader suite exercises
+    ops through the layer/graph tests; this ledger counts only
+    harness-validated ops)."""
+    rep = coverage_report()
+    assert rep["registered"] > 150  # the registry is substantial
+    assert rep["validated"] >= 8    # every case in this file records ops
+    assert isinstance(rep["missing"], list)
