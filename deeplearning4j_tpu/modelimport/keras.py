@@ -38,6 +38,11 @@ from deeplearning4j_tpu.conf.layers_cnn import (
     PoolingType,
     SubsamplingLayer,
 )
+from deeplearning4j_tpu.conf.graph import (
+    ElementWiseOp,
+    ElementWiseVertex,
+    MergeVertex,
+)
 from deeplearning4j_tpu.conf.layers_rnn import LSTM
 from deeplearning4j_tpu.conf.losses import LossMCXENT, LossMSE
 from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
@@ -88,25 +93,55 @@ class KerasModelImport:
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
         with h5py.File(path, "r") as f:
-            raw = f.attrs.get("model_config")
-            if raw is None:
-                raise InvalidKerasConfigurationException(
-                    "no model_config attribute — not a Keras HDF5 file "
-                    "saved with model.save()")
-            if isinstance(raw, bytes):
-                raw = raw.decode()
-            model_cfg = json.loads(raw)
+            model_cfg = _read_model_config(f)
             if model_cfg.get("class_name") != "Sequential":
                 raise InvalidKerasConfigurationException(
                     "only Sequential models supported here; use "
-                    "import_keras_model_and_weights for functional models "
-                    "(not yet implemented)")
+                    "import_keras_model_and_weights for functional models")
             layer_cfgs = model_cfg["config"]["layers"]
             conf, names = _build_conf(layer_cfgs)
             net = MultiLayerNetwork(conf)
             net.init()
             _load_weights(f, net, names)
         return net
+
+    @staticmethod
+    def import_keras_model_and_weights(
+            path: str, enforce_training_config: bool = False):
+        """Functional-model import -> initialized ComputationGraph
+        (reference ``importKerasModelAndWeights``). Sequential files are
+        dispatched to the sequential path."""
+        import h5py
+
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        with h5py.File(path, "r") as f:
+            model_cfg = _read_model_config(f)
+            if model_cfg.get("class_name") == "Sequential":
+                pass  # fall through to the sequential path below
+            elif model_cfg.get("class_name") not in ("Model", "Functional"):
+                raise InvalidKerasConfigurationException(
+                    f"unsupported model class "
+                    f"'{model_cfg.get('class_name')}'")
+            else:
+                conf, names = _build_graph_conf(model_cfg["config"])
+                net = ComputationGraph(conf)
+                net.init()
+                _load_graph_weights(f, net, names)
+                return net
+        return KerasModelImport.import_keras_sequential_model_and_weights(
+            path, enforce_training_config)
+
+
+def _read_model_config(f) -> dict:
+    raw = f.attrs.get("model_config")
+    if raw is None:
+        raise InvalidKerasConfigurationException(
+            "no model_config attribute — not a Keras HDF5 file "
+            "saved with model.save()")
+    if isinstance(raw, bytes):
+        raw = raw.decode()
+    return json.loads(raw)
 
 
 def _input_type(first_cfg: dict):
@@ -127,6 +162,241 @@ def _input_type(first_cfg: dict):
         f"unsupported input rank {len(dims) + 1}")
 
 
+def _map_layer(cls: str, cfg: dict, name: str, is_output: bool = False):
+    """One Keras layer config -> one layer conf (or None for structural
+    layers that vanish here: InputLayer/Flatten). Shared by the Sequential
+    and functional paths."""
+    if cls == "Dense":
+        act = _act(cfg.get("activation"))
+        if is_output and act is Act.SOFTMAX:
+            return OutputLayer(n_out=int(cfg["units"]), activation=act,
+                               loss_fn=LossMCXENT(), name=name)
+        if is_output:
+            return OutputLayer(n_out=int(cfg["units"]), activation=act,
+                               loss_fn=LossMSE(), name=name)
+        return DenseLayer(n_out=int(cfg["units"]), activation=act, name=name)
+    if cls == "Conv2D":
+        return ConvolutionLayer(
+            n_out=int(cfg["filters"]),
+            kernel_size=_pair(cfg.get("kernel_size", 3)),
+            stride=_pair(cfg.get("strides", 1)),
+            convolution_mode=_mode(cfg.get("padding", "valid")),
+            activation=_act(cfg.get("activation")),
+            has_bias=bool(cfg.get("use_bias", True)), name=name)
+    if cls in ("MaxPooling2D", "AveragePooling2D"):
+        return SubsamplingLayer(
+            pooling_type=(PoolingType.MAX if cls == "MaxPooling2D"
+                          else PoolingType.AVG),
+            kernel_size=_pair(cfg.get("pool_size", 2)),
+            stride=_pair(cfg.get("strides") or cfg.get("pool_size", 2)),
+            convolution_mode=_mode(cfg.get("padding", "valid")), name=name)
+    if cls == "Dropout":
+        return DropoutLayer(dropout=1.0 - float(cfg.get("rate", 0.0)),
+                            name=name)
+    if cls == "Activation":
+        return ActivationLayer(activation=_act(cfg.get("activation")),
+                               name=name)
+    if cls == "BatchNormalization":
+        return BatchNormalization(eps=float(cfg.get("epsilon", 1e-3)),
+                                  decay=float(cfg.get("momentum", 0.99)),
+                                  name=name)
+    if cls == "LSTM":
+        if not cfg.get("return_sequences", False):
+            raise InvalidKerasConfigurationException(
+                "LSTM with return_sequences=False: wrap with "
+                "LastTimeStep manually (not auto-mapped)")
+        return LSTM(n_out=int(cfg["units"]),
+                    activation=_act(cfg.get("activation", "tanh")),
+                    gate_activation=_act(
+                        cfg.get("recurrent_activation", "sigmoid")),
+                    name=name)
+    if cls == "Embedding":
+        return EmbeddingSequenceLayer(n_out=int(cfg["output_dim"]),
+                                      n_in=int(cfg["input_dim"]), name=name)
+    if cls == "GlobalAveragePooling2D":
+        return GlobalPoolingLayer(pooling_type=PoolingType.AVG, name=name)
+    raise InvalidKerasConfigurationException(
+        f"unsupported Keras layer class '{cls}'")
+
+
+def _inbound_names(layer_cfg: dict) -> List[str]:
+    """Parse ``inbound_nodes`` (Keras 2.x nested-list format, plus the
+    Keras 3 dict form) -> list of producer layer names."""
+    nodes = layer_cfg.get("inbound_nodes") or []
+    if not nodes:
+        return []
+    if len(nodes) > 1:
+        raise InvalidKerasConfigurationException(
+            f"layer '{layer_cfg.get('config', {}).get('name')}' is called "
+            f"{len(nodes)} times (shared layer) — weight sharing across "
+            "calls is not supported by this importer")
+    node = nodes[0]
+    names: List[str] = []
+    if isinstance(node, dict):
+        for a in node.get("args", []):
+            items = a if isinstance(a, list) else [a]
+            for item in items:
+                hist = (item.get("config", {}).get("keras_history")
+                        if isinstance(item, dict) else None)
+                if hist:
+                    names.append(hist[0])
+        return names
+    for item in node:
+        names.append(item[0])
+    return names
+
+
+_MERGE_CLASSES = {
+    "Add": ElementWiseOp.ADD, "Subtract": ElementWiseOp.SUBTRACT,
+    "Multiply": ElementWiseOp.PRODUCT, "Average": ElementWiseOp.AVERAGE,
+    "Maximum": ElementWiseOp.MAX,
+}
+
+
+def _build_graph_conf(config: dict):
+    """Functional-model config -> (ComputationGraphConfiguration,
+    [keras name in order] for weight loading). DAG wiring comes from
+    ``inbound_nodes``; Flatten vanishes (the builder auto-inserts
+    CnnToFeedForward preprocessors from input types)."""
+    layer_cfgs = config["layers"]
+    out_names = {o[0] if isinstance(o, list) else o
+                 for o in config.get("output_layers", [])}
+
+    # fold a terminal Activation into its preceding linear Dense (the
+    # Dense(units) + Activation('softmax') idiom) so the scoring vertex is
+    # an OutputLayer — mirrors the Sequential path's fold
+    cfg_by_name: Dict[str, dict] = {}
+    for i, lc in enumerate(layer_cfgs):
+        n = lc.get("config", {}).get("name") or lc.get("name") or f"layer_{i}"
+        cfg_by_name[n] = lc
+    folded: Dict[str, str] = {}      # activation name -> dense name
+    for out in list(out_names):
+        lc = cfg_by_name.get(out)
+        if lc is None or lc["class_name"] != "Activation":
+            continue
+        ins = _inbound_names(lc)
+        prev = cfg_by_name.get(ins[0]) if len(ins) == 1 else None
+        if (prev is not None and prev["class_name"] == "Dense"
+                and prev["config"].get("activation") in (None, "linear")
+                # the Dense must feed ONLY this Activation — folding would
+                # change what any other consumer branch sees
+                and sum(ins[0] in _inbound_names(c) for c in layer_cfgs) == 1
+                and ins[0] not in out_names):
+            prev["config"]["activation"] = lc["config"].get("activation")
+            folded[out] = ins[0]
+            out_names.discard(out)
+            out_names.add(ins[0])
+
+    b = (NeuralNetConfiguration.builder().seed(12345).graph_builder())
+    alias: Dict[str, str] = {}   # structural layers forward their input
+    param_names: List[str] = []
+    input_type_of: Dict[str, object] = {}
+
+    for i, lc in enumerate(layer_cfgs):
+        cls = lc["class_name"]
+        cfg = lc.get("config", {})
+        name = cfg.get("name") or lc.get("name") or f"layer_{i}"
+        inputs = [alias.get(n, n) for n in _inbound_names(lc)]
+        if name in folded:
+            alias[name] = inputs[0]
+            continue
+        if cls == "InputLayer":
+            input_type_of[name] = _input_type(lc)
+            continue
+        if not inputs:
+            raise InvalidKerasConfigurationException(
+                f"layer '{name}' has no inbound nodes — not a functional "
+                "model config")
+        if cls == "Flatten":
+            alias[name] = inputs[0]
+            continue
+        if cls == "Concatenate":
+            if cfg.get("axis", -1) != -1:
+                raise InvalidKerasConfigurationException(
+                    "Concatenate: only axis=-1 (feature/channel) supported")
+            b.add_vertex(name, MergeVertex(), *inputs)
+            continue
+        if cls in _MERGE_CLASSES:
+            b.add_vertex(name, ElementWiseVertex(op=_MERGE_CLASSES[cls]),
+                         *inputs)
+            continue
+        layer = _map_layer(cls, cfg, name, is_output=name in out_names)
+        b.add_layer(name, layer, *inputs)
+        param_names.append(name)
+
+    # network input ORDER comes from config['input_layers'] (the order the
+    # user passed to keras.Model(inputs=...)), not layer-list order
+    in_order = [o[0] if isinstance(o, list) else o
+                for o in config.get("input_layers", [])]
+    if not in_order:
+        in_order = list(input_type_of)
+    unknown = [n for n in in_order if n not in input_type_of]
+    if unknown:
+        raise InvalidKerasConfigurationException(
+            f"input_layers name {unknown} not found among InputLayer "
+            "definitions")
+    b.add_inputs(*in_order)
+    b.set_input_types(*(input_type_of[n] for n in in_order))
+
+    outputs = [alias.get(n, n) for n in
+               (o[0] if isinstance(o, list) else o
+                for o in config.get("output_layers", []))]
+    if not outputs:
+        raise InvalidKerasConfigurationException("no output_layers in config")
+    b.set_outputs(*outputs)
+    return b.build(), param_names
+
+
+def _copy_layer_weights(tgt: dict, layer, ws: Dict[str, np.ndarray],
+                        state: dict, keras_name: str):
+    """Copy one Keras weight group into one layer's param dict (shared by
+    the Sequential and functional loaders). ``state`` is the layer's
+    mutable state dict (BN moving stats) — may be empty."""
+    import jax.numpy as jnp
+
+    cls = type(layer).__name__
+    if "kernel" in ws and cls in ("DenseLayer", "OutputLayer",
+                                  "ConvolutionLayer"):
+        _check_and_set(tgt, "W", ws["kernel"])
+        if "bias" in ws and "b" in tgt:
+            _check_and_set(tgt, "b", ws["bias"])
+    elif cls == "LSTM":
+        u = layer.n_out
+        _check_and_set(tgt, "W", _ifco_to_ifog(ws["kernel"], u))
+        _check_and_set(tgt, "RW", _ifco_to_ifog(ws["recurrent_kernel"], u))
+        if "bias" in ws:
+            _check_and_set(tgt, "b", _ifco_to_ifog(ws["bias"], u))
+    elif cls == "BatchNormalization":
+        n = tgt["gamma"].shape[0]
+        # Keras BN with scale=False / center=False omits gamma/beta
+        _check_and_set(tgt, "gamma", ws.get("gamma", np.ones(n, np.float32)))
+        _check_and_set(tgt, "beta", ws.get("beta", np.zeros(n, np.float32)))
+        if "mean" in state:
+            state["mean"] = jnp.asarray(ws["moving_mean"])
+            state["var"] = jnp.asarray(ws["moving_variance"])
+    elif cls == "EmbeddingSequenceLayer":
+        key = "embeddings" if "embeddings" in ws else "kernel"
+        _check_and_set(tgt, "W", ws[key])
+    else:
+        raise InvalidKerasConfigurationException(
+            f"no weight mapping for layer {cls} <- keras '{keras_name}'")
+
+
+def _load_graph_weights(f, net, keras_names: List[str]):
+    """Copy Keras weight groups into ComputationGraph params (keyed by
+    vertex name — identical to the Keras layer name here)."""
+    for name in keras_names:
+        ws = _weight_group(f, name)
+        if not ws:
+            continue
+        if name not in (net.params or {}):
+            raise InvalidKerasConfigurationException(
+                f"h5 has weights for '{name}' but the graph has no "
+                "parameterized vertex of that name")
+        _copy_layer_weights(net.params[name], net._vmap[name].vertex.layer,
+                            ws, net.state.get(name, {}), name)
+
+
 def _build_conf(layer_cfgs: List[dict]):
     """-> (MultiLayerConfiguration, [keras_name in parameterized order])"""
     input_type = None
@@ -142,69 +412,14 @@ def _build_conf(layer_cfgs: List[dict]):
         if cls == "InputLayer":
             input_type = _input_type(lc)
             continue
-        if cls == "Dense":
-            is_last = all(c["class_name"] in ("Activation", "Dropout")
-                          for c in pending_cfgs[i + 1:])
-            act = _act(cfg.get("activation"))
-            if is_last and act is Act.SOFTMAX:
-                layer = OutputLayer(n_out=int(cfg["units"]), activation=act,
-                                    loss_fn=LossMCXENT(), name=name)
-            elif is_last:
-                layer = OutputLayer(n_out=int(cfg["units"]), activation=act,
-                                    loss_fn=LossMSE(), name=name)
-            else:
-                layer = DenseLayer(n_out=int(cfg["units"]), activation=act,
-                                   name=name)
-        elif cls == "Conv2D":
-            layer = ConvolutionLayer(
-                n_out=int(cfg["filters"]),
-                kernel_size=_pair(cfg.get("kernel_size", 3)),
-                stride=_pair(cfg.get("strides", 1)),
-                convolution_mode=_mode(cfg.get("padding", "valid")),
-                activation=_act(cfg.get("activation")),
-                has_bias=bool(cfg.get("use_bias", True)), name=name)
-        elif cls in ("MaxPooling2D", "AveragePooling2D"):
-            layer = SubsamplingLayer(
-                pooling_type=(PoolingType.MAX if cls == "MaxPooling2D"
-                              else PoolingType.AVG),
-                kernel_size=_pair(cfg.get("pool_size", 2)),
-                stride=_pair(cfg.get("strides") or cfg.get("pool_size", 2)),
-                convolution_mode=_mode(cfg.get("padding", "valid")),
-                name=name)
-        elif cls == "Flatten":
+        if cls == "Flatten":
             # shape inference inserts CnnToFeedForwardPreProcessor; nothing
             # to add explicitly
             continue
-        elif cls == "Dropout":
-            layer = DropoutLayer(dropout=1.0 - float(cfg.get("rate", 0.0)),
-                                 name=name)
-        elif cls == "Activation":
-            layer = ActivationLayer(activation=_act(cfg.get("activation")),
-                                    name=name)
-        elif cls == "BatchNormalization":
-            layer = BatchNormalization(
-                eps=float(cfg.get("epsilon", 1e-3)),
-                decay=float(cfg.get("momentum", 0.99)), name=name)
-        elif cls == "LSTM":
-            if not cfg.get("return_sequences", False):
-                raise InvalidKerasConfigurationException(
-                    "LSTM with return_sequences=False: wrap with "
-                    "LastTimeStep manually (not auto-mapped)")
-            layer = LSTM(n_out=int(cfg["units"]),
-                         activation=_act(cfg.get("activation", "tanh")),
-                         gate_activation=_act(
-                             cfg.get("recurrent_activation", "sigmoid")),
-                         name=name)
-        elif cls == "Embedding":
-            layer = EmbeddingSequenceLayer(
-                n_out=int(cfg["output_dim"]),
-                n_in=int(cfg["input_dim"]), name=name)
-        elif cls == "GlobalAveragePooling2D":
-            layer = GlobalPoolingLayer(pooling_type=PoolingType.AVG,
-                                       name=name)
-        else:
-            raise InvalidKerasConfigurationException(
-                f"unsupported Keras layer class '{cls}'")
+        is_last = (cls == "Dense"
+                   and all(c["class_name"] in ("Activation", "Dropout")
+                           for c in pending_cfgs[i + 1:]))
+        layer = _map_layer(cls, cfg, name, is_output=is_last)
         mapped.append((name, layer))
 
     # fold a trailing Activation into the preceding OutputLayer (the common
@@ -249,8 +464,6 @@ def _weight_group(f, keras_name: str):
 
 
 def _load_weights(f, net, keras_names: List[str]):
-    import jax.numpy as jnp
-
     # map keras layer names onto OUR parameterized layers in order
     param_layers = [(i, l) for i, l in enumerate(net.conf.layers)
                     if l.param_order()]
@@ -262,37 +475,8 @@ def _load_weights(f, net, keras_names: List[str]):
         if pi >= len(param_layers):
             break
         idx, layer = param_layers[pi]
-        tgt = net.params[str(idx)]
-        cls = type(layer).__name__
-        if "kernel" in ws and cls in ("DenseLayer", "OutputLayer",
-                                      "ConvolutionLayer"):
-            _check_and_set(tgt, "W", ws["kernel"])
-            if "bias" in ws and "b" in tgt:
-                _check_and_set(tgt, "b", ws["bias"])
-        elif cls == "LSTM":
-            u = layer.n_out
-            _check_and_set(tgt, "W", _ifco_to_ifog(ws["kernel"], u))
-            _check_and_set(tgt, "RW",
-                           _ifco_to_ifog(ws["recurrent_kernel"], u))
-            if "bias" in ws:
-                _check_and_set(tgt, "b", _ifco_to_ifog(ws["bias"], u))
-        elif cls == "BatchNormalization":
-            n = tgt["gamma"].shape[0]
-            # Keras BN with scale=False / center=False omits gamma/beta
-            _check_and_set(tgt, "gamma",
-                           ws.get("gamma", np.ones(n, np.float32)))
-            _check_and_set(tgt, "beta",
-                           ws.get("beta", np.zeros(n, np.float32)))
-            st = net.state.get(str(idx), {})
-            if "mean" in st:
-                st["mean"] = jnp.asarray(ws["moving_mean"])
-                st["var"] = jnp.asarray(ws["moving_variance"])
-        elif cls == "EmbeddingSequenceLayer":
-            key = "embeddings" if "embeddings" in ws else "kernel"
-            _check_and_set(tgt, "W", ws[key])
-        else:
-            raise InvalidKerasConfigurationException(
-                f"no weight mapping for layer {cls} <- keras '{name}'")
+        _copy_layer_weights(net.params[str(idx)], layer, ws,
+                            net.state.get(str(idx), {}), name)
         pi += 1
 
 

@@ -66,7 +66,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
                                      ctypes.c_char_p]
     lib.dl4j_w2v_pairs.restype = i64
     lib.dl4j_w2v_pairs.argtypes = [P(i32), P(i64), i64, i64,
-                                   ctypes.c_uint64, P(i32), i64]
+                                   P(ctypes.c_uint64), P(i32), i64]
     lib.dl4j_native_version.restype = ctypes.c_int
     lib.dl4j_native_threads.restype = ctypes.c_int
     return lib
@@ -328,40 +328,28 @@ def w2v_pairs(sentences, window: int, seed: int = 1):
     if cur:
         chunks.append(cur)
     results = []
-    # the C side advances its own stream copy; chunking stays transparent
-    # by re-seeding each chunk with the state after the draws consumed so
-    # far (one draw per token of every length>=2 sentence)
-    consumed = 0
+    # the C walk reads its RNG state from io_state and writes the final
+    # state back, so chunking continues ONE stream with no host-side
+    # replay. Seed 0 maps to the same init constant as the fallback, and
+    # xorshift64 never reaches state 0 from nonzero — bit-parity holds
+    # for every seed.
+    io_state = ctypes.c_uint64((int(seed) or _XORSHIFT_INIT) & _MASK64)
     for chunk in chunks:
         tokens = np.concatenate(chunk)
         offsets = np.zeros(len(chunk) + 1, np.int64)
         np.cumsum([len(s) for s in chunk], out=offsets[1:])
         cap = max(int(tokens.size) * 2 * int(window), 16)
         out = np.empty((cap, 2), np.int32)
-        # seed for this chunk = state after the tokens consumed so far
-        chunk_seed = int(seed) if consumed == 0 else _advance(
-            int(seed), consumed)
         cnt = lib.dl4j_w2v_pairs(
             tokens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-            len(chunk), int(window),
-            ctypes.c_uint64(chunk_seed or 1).value,
+            len(chunk), int(window), ctypes.byref(io_state),
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), cap)
         if cnt < 0:
             raise RuntimeError(f"native w2v_pairs failed: {cnt}")
         results.append(out[:cnt].copy())
-        consumed += sum(len(s) for s in chunk if len(s) >= 2)
     return (np.concatenate(results) if results
             else np.zeros((0, 2), np.int32))
-
-
-def _advance(seed: int, steps: int) -> int:
-    st = (seed or _XORSHIFT_INIT) & _MASK64
-    for _ in range(steps):
-        st = (st ^ (st << 13)) & _MASK64
-        st ^= st >> 7
-        st = (st ^ (st << 17)) & _MASK64
-    return st
 
 
 def native_threads() -> int:

@@ -13,3 +13,15 @@ from deeplearning4j_tpu.rl4j.dqn import (  # noqa: F401
     QLearningDiscreteDense,
     ReplayMemory,
 )
+from deeplearning4j_tpu.rl4j.a3c import (  # noqa: F401
+    A3CConfiguration,
+    A3CDiscreteDense,
+    AsyncNStepQLearningDiscreteDense,
+    AsyncQLearningConfiguration,
+)
+from deeplearning4j_tpu.rl4j.policy import (  # noqa: F401
+    ACPolicy,
+    DQNPolicy,
+    EpsGreedy,
+    Policy,
+)

@@ -1,0 +1,387 @@
+"""A3C and async n-step Q-learning (reference ``org.deeplearning4j.rl4j.
+learning.async.a3c.discrete.A3CDiscreteDense`` and ``learning.async.
+nstep.discrete.AsyncNStepQLearningDiscreteDense``).
+
+The reference runs ``numThreads`` AsyncThreads, each holding a local copy
+of the global network: roll out up to ``nstep`` transitions, compute
+n-step returns, push gradients into a shared ``AsyncGlobal`` which applies
+them to the global params (Hogwild-style, no barrier). Here the rollout
+loop stays host-side per worker thread, but the entire gradient
+computation + Adam application is ONE jitted function; workers apply it to
+the shared params under a lock (exact, not lossy — the JVM version's
+unsynchronized adds are an artifact of its runtime, not a feature).
+
+Actor-critic loss matches the reference's ``ActorCriticLoss``:
+policy head -log pi(a|s) * advantage with entropy bonus ``BETA``,
+value head MSE on n-step returns.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dqn import _mlp_apply, _mlp_init, _q_values, linear_epsilon
+
+
+@dataclasses.dataclass
+class A3CConfiguration:
+    """Reference ``A3CLearningConfiguration`` fields (snake_case)."""
+
+    seed: int = 123
+    max_epoch_step: int = 200
+    max_step: int = 8_000
+    num_threads: int = 2
+    nstep: int = 5
+    gamma: float = 0.99
+    reward_factor: float = 1.0
+    learning_rate: float = 1e-3
+    entropy_beta: float = 0.01          # ActorCriticLoss.BETA
+
+
+@dataclasses.dataclass
+class AsyncQLearningConfiguration:
+    """Reference ``AsyncQLearningConfiguration`` (async n-step Q)."""
+
+    seed: int = 123
+    max_epoch_step: int = 200
+    max_step: int = 8_000
+    num_threads: int = 2
+    nstep: int = 5
+    gamma: float = 0.99
+    reward_factor: float = 1.0
+    learning_rate: float = 1e-3
+    target_dqn_update_freq: int = 500
+    min_epsilon: float = 0.05
+    epsilon_nb_step: int = 3_000
+
+
+def _ac_init(key, obs_size: int, hidden, action_size: int):
+    """Shared trunk + separate policy/value heads (reference
+    ``ActorCriticFactoryCompoundStdDense``)."""
+    trunk = _mlp_init(key, [obs_size, *hidden])
+    k_pi, k_v = jax.random.split(jax.random.fold_in(key, 1))
+    n_last = hidden[-1]
+    pi = {"W": jax.random.normal(k_pi, (n_last, action_size))
+               * np.sqrt(1.0 / n_last).astype(np.float32),
+          "b": jnp.zeros((action_size,), jnp.float32)}
+    v = {"W": jax.random.normal(k_v, (n_last, 1))
+              * np.sqrt(1.0 / n_last).astype(np.float32),
+         "b": jnp.zeros((1,), jnp.float32)}
+    return {"trunk": trunk, "pi": pi, "v": v}
+
+
+def _ac_apply(params, x):
+    h = x
+    for layer in params["trunk"]:
+        h = jax.nn.relu(h @ layer["W"] + layer["b"])
+    logits = h @ params["pi"]["W"] + params["pi"]["b"]
+    value = (h @ params["v"]["W"] + params["v"]["b"])[:, 0]
+    return logits, value
+
+
+def _adam(params, grads, m, v, step, lr):
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    t = step + 1.0
+
+    def upd(p, g, m_, v_):
+        mk = b1 * m_ + (1 - b1) * g
+        vk = b2 * v_ + (1 - b2) * g * g
+        mhat = mk / (1 - b1 ** t)
+        vhat = vk / (1 - b2 ** t)
+        return p - lr * mhat / (jnp.sqrt(vhat) + eps), mk, vk
+
+    flat = jax.tree_util.tree_map(upd, params, grads, m, v)
+    new_p = jax.tree_util.tree_map(lambda x: x[0], flat,
+                                   is_leaf=lambda x: isinstance(x, tuple))
+    new_m = jax.tree_util.tree_map(lambda x: x[1], flat,
+                                   is_leaf=lambda x: isinstance(x, tuple))
+    new_v = jax.tree_util.tree_map(lambda x: x[2], flat,
+                                   is_leaf=lambda x: isinstance(x, tuple))
+    return new_p, new_m, new_v
+
+
+@jax.jit
+def _a3c_step(params, opt_m, opt_v, batch, step, lr_beta):
+    """One n-step actor-critic update over a rollout segment (returns are
+    already discounted host-side)."""
+    s, a, returns = batch
+    lr, beta = lr_beta
+
+    def loss_fn(params):
+        logits, value = _ac_apply(params, s)
+        logp = jax.nn.log_softmax(logits)
+        p = jnp.exp(logp)
+        adv = jax.lax.stop_gradient(returns - value)
+        pi_loss = -jnp.mean(
+            jnp.take_along_axis(logp, a[:, None], 1)[:, 0] * adv)
+        entropy = -jnp.mean(jnp.sum(p * logp, axis=1))
+        v_loss = jnp.mean((returns - value) ** 2)
+        return pi_loss + 0.5 * v_loss - beta * entropy
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    new_p, new_m, new_v = _adam(params, grads, opt_m, opt_v, step, lr)
+    return new_p, new_m, new_v, loss
+
+
+@jax.jit
+def _nstepq_step(params, opt_m, opt_v, batch, step, lr):
+    """Async n-step Q update: MSE of Q(s,a) against precomputed targets."""
+    s, a, targets = batch
+
+    def loss_fn(params):
+        q = _mlp_apply(params, s)
+        q_sa = jnp.take_along_axis(q, a[:, None], 1)[:, 0]
+        return jnp.mean((q_sa - targets) ** 2)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    new_p, new_m, new_v = _adam(params, grads, opt_m, opt_v, step, lr)
+    return new_p, new_m, new_v, loss
+
+
+@jax.jit
+def _policy_logits(params, obs):
+    return _ac_apply(params, obs)[0]
+
+
+def _select_from_logits(logits: np.ndarray,
+                        rng: Optional[np.random.Generator]) -> int:
+    """Categorical sample from softmax(logits); greedy argmax if ``rng``
+    is None. Shared by the A3C learner and ``ACPolicy``."""
+    if rng is None:
+        return int(np.argmax(logits))
+    z = logits - logits.max()
+    p = np.exp(z) / np.exp(z).sum()
+    return int(rng.choice(len(p), p=p))
+
+
+class _AsyncGlobal:
+    """Reference ``AsyncGlobal``: the shared params + optimizer state that
+    worker threads apply their gradient steps to."""
+
+    def __init__(self, params):
+        self.lock = threading.Lock()
+        self.params = params
+        self.opt_m = jax.tree_util.tree_map(jnp.zeros_like, params)
+        self.opt_v = jax.tree_util.tree_map(jnp.zeros_like, params)
+        self.step_count = 0          # global env-step counter (T)
+        self.update_count = 0
+
+
+class A3CDiscreteDense:
+    """Advantage actor-critic over dense observations (reference class of
+    the same name). ``mdp_factory`` builds one MDP per worker thread."""
+
+    def __init__(self, mdp_factory, config: Optional[A3CConfiguration] = None,
+                 hidden: List[int] = (64, 64)):
+        self.mdp_factory = mdp_factory
+        self.cfg = config or A3CConfiguration()
+        probe = mdp_factory(0)
+        self.action_size = probe.action_size
+        key = jax.random.PRNGKey(self.cfg.seed)
+        params = _ac_init(key, probe.observation_size, list(hidden),
+                          probe.action_size)
+        self.shared = _AsyncGlobal(params)
+        self.episode_rewards: List[float] = []
+        self._reward_lock = threading.Lock()
+
+    @property
+    def params(self):
+        return self.shared.params
+
+    def act(self, obs, rng: np.random.Generator,
+            greedy: bool = False) -> int:
+        logits = np.asarray(_policy_logits(self.shared.params,
+                                           jnp.asarray(obs[None])))[0]
+        return _select_from_logits(logits, None if greedy else rng)
+
+    def _worker(self, tid: int):
+        cfg = self.cfg
+        mdp = self.mdp_factory(tid)
+        rng = np.random.default_rng(cfg.seed + 1000 * (tid + 1))
+        shared = self.shared
+        obs = mdp.reset()
+        ep_reward, ep_steps = 0.0, 0
+        while shared.step_count < cfg.max_step:
+            states, actions, rewards = [], [], []
+            done = False
+            for _ in range(cfg.nstep):
+                a = self.act(obs, rng)
+                obs2, r, done = mdp.step(a)
+                states.append(obs)
+                actions.append(a)
+                rewards.append(r * cfg.reward_factor)
+                ep_reward += r
+                ep_steps += 1
+                obs = obs2
+                with shared.lock:
+                    shared.step_count += 1
+                if done or ep_steps >= cfg.max_epoch_step:
+                    break
+            # bootstrap from V(s_last) unless terminal
+            if done or ep_steps >= cfg.max_epoch_step:
+                boot = 0.0
+            else:
+                _, value = _ac_apply(shared.params, jnp.asarray(obs[None]))
+                boot = float(value[0])
+            returns = np.empty(len(rewards), np.float32)
+            acc = boot
+            for i in range(len(rewards) - 1, -1, -1):
+                acc = rewards[i] + cfg.gamma * acc
+                returns[i] = acc
+            batch = (jnp.asarray(np.stack(states)),
+                     jnp.asarray(actions, jnp.int32),
+                     jnp.asarray(returns))
+            with shared.lock:
+                (shared.params, shared.opt_m, shared.opt_v, _) = _a3c_step(
+                    shared.params, shared.opt_m, shared.opt_v, batch,
+                    jnp.asarray(float(shared.update_count), jnp.float32),
+                    (jnp.asarray(cfg.learning_rate, jnp.float32),
+                     jnp.asarray(cfg.entropy_beta, jnp.float32)))
+                shared.update_count += 1
+            if done or ep_steps >= cfg.max_epoch_step:
+                with self._reward_lock:
+                    self.episode_rewards.append(ep_reward)
+                obs = mdp.reset()
+                ep_reward, ep_steps = 0.0, 0
+
+    def train(self) -> "A3CDiscreteDense":
+        threads = [threading.Thread(target=self._worker, args=(t,),
+                                    daemon=True)
+                   for t in range(self.cfg.num_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return self
+
+    def play(self, episodes: int = 1) -> float:
+        """Greedy rollouts via ``ACPolicy`` semantics."""
+        mdp = self.mdp_factory(-1)
+        rng = np.random.default_rng(0)
+        total = 0.0
+        for _ in range(episodes):
+            obs = mdp.reset()
+            for _ in range(self.cfg.max_epoch_step):
+                obs, r, done = mdp.step(self.act(obs, rng, greedy=True))
+                total += r
+                if done:
+                    break
+        return total / episodes
+
+
+class AsyncNStepQLearningDiscreteDense:
+    """Async n-step Q-learning (reference class of the same name): worker
+    threads, eps-greedy behavior, n-step targets bootstrapped from a
+    periodically-synced target network."""
+
+    def __init__(self, mdp_factory,
+                 config: Optional[AsyncQLearningConfiguration] = None,
+                 hidden: List[int] = (64, 64)):
+        self.mdp_factory = mdp_factory
+        self.cfg = config or AsyncQLearningConfiguration()
+        probe = mdp_factory(0)
+        self.action_size = probe.action_size
+        key = jax.random.PRNGKey(self.cfg.seed)
+        params = _mlp_init(key, [probe.observation_size, *hidden,
+                                 probe.action_size])
+        self.shared = _AsyncGlobal(params)
+        self.target_params = jax.tree_util.tree_map(lambda x: x, params)
+        self.episode_rewards: List[float] = []
+        self._reward_lock = threading.Lock()
+
+    @property
+    def params(self):
+        return self.shared.params
+
+    def epsilon(self) -> float:
+        return linear_epsilon(self.shared.step_count, self.cfg.min_epsilon,
+                              self.cfg.epsilon_nb_step)
+
+    def act(self, obs, rng: np.random.Generator,
+            greedy: bool = False) -> int:
+        if not greedy and rng.random() < self.epsilon():
+            return int(rng.integers(0, self.action_size))
+        q = _q_values(self.shared.params, jnp.asarray(obs[None]))
+        return int(jnp.argmax(q[0]))
+
+    def _worker(self, tid: int):
+        cfg = self.cfg
+        mdp = self.mdp_factory(tid)
+        rng = np.random.default_rng(cfg.seed + 1000 * (tid + 1))
+        shared = self.shared
+        obs = mdp.reset()
+        ep_reward, ep_steps = 0.0, 0
+        while shared.step_count < cfg.max_step:
+            states, actions, rewards = [], [], []
+            done = False
+            for _ in range(cfg.nstep):
+                a = self.act(obs, rng)
+                obs2, r, done = mdp.step(a)
+                states.append(obs)
+                actions.append(a)
+                rewards.append(r * cfg.reward_factor)
+                ep_reward += r
+                ep_steps += 1
+                obs = obs2
+                with shared.lock:
+                    shared.step_count += 1
+                if done or ep_steps >= cfg.max_epoch_step:
+                    break
+            if done or ep_steps >= cfg.max_epoch_step:
+                boot = 0.0
+            else:
+                q = _q_values(self.target_params, jnp.asarray(obs[None]))
+                boot = float(jnp.max(q[0]))
+            targets = np.empty(len(rewards), np.float32)
+            acc = boot
+            for i in range(len(rewards) - 1, -1, -1):
+                acc = rewards[i] + cfg.gamma * acc
+                targets[i] = acc
+            batch = (jnp.asarray(np.stack(states)),
+                     jnp.asarray(actions, jnp.int32),
+                     jnp.asarray(targets))
+            with shared.lock:
+                (shared.params, shared.opt_m, shared.opt_v, _) = (
+                    _nstepq_step(
+                        shared.params, shared.opt_m, shared.opt_v, batch,
+                        jnp.asarray(float(shared.update_count), jnp.float32),
+                        jnp.asarray(cfg.learning_rate, jnp.float32)))
+                shared.update_count += 1
+                if shared.update_count % max(
+                        1, cfg.target_dqn_update_freq // cfg.nstep) == 0:
+                    self.target_params = jax.tree_util.tree_map(
+                        lambda x: x, shared.params)
+            if done or ep_steps >= cfg.max_epoch_step:
+                with self._reward_lock:
+                    self.episode_rewards.append(ep_reward)
+                obs = mdp.reset()
+                ep_reward, ep_steps = 0.0, 0
+
+    def train(self) -> "AsyncNStepQLearningDiscreteDense":
+        threads = [threading.Thread(target=self._worker, args=(t,),
+                                    daemon=True)
+                   for t in range(self.cfg.num_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return self
+
+    def play(self, episodes: int = 1) -> float:
+        mdp = self.mdp_factory(-1)
+        rng = np.random.default_rng(0)
+        total = 0.0
+        for _ in range(episodes):
+            obs = mdp.reset()
+            for _ in range(self.cfg.max_epoch_step):
+                obs, r, done = mdp.step(self.act(obs, rng, greedy=True))
+                total += r
+                if done:
+                    break
+        return total / episodes

@@ -60,6 +60,13 @@ class ReplayMemory:
                 np.asarray(d, np.float32))
 
 
+def linear_epsilon(count: int, min_epsilon: float, nb_step: int) -> float:
+    """Linear anneal 1.0 -> ``min_epsilon`` over ``nb_step`` counts (the
+    reference ``EpsGreedy`` schedule, shared by every learner here)."""
+    frac = min(1.0, count / max(nb_step, 1))
+    return 1.0 + frac * (min_epsilon - 1.0)
+
+
 def _mlp_init(key, sizes):
     params = []
     for i, (n_in, n_out) in enumerate(zip(sizes[:-1], sizes[1:])):
@@ -149,9 +156,8 @@ class QLearningDiscreteDense:
 
     # --- policy --------------------------------------------------------------
     def epsilon(self) -> float:
-        cfg = self.cfg
-        frac = min(1.0, self.step_count / max(cfg.epsilon_nb_step, 1))
-        return 1.0 + frac * (cfg.min_epsilon - 1.0)
+        return linear_epsilon(self.step_count, self.cfg.min_epsilon,
+                              self.cfg.epsilon_nb_step)
 
     def act(self, obs, greedy: bool = False) -> int:
         if not greedy and self.rng.random() < self.epsilon():

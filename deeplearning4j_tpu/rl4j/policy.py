@@ -1,0 +1,81 @@
+"""Policies (reference ``org.deeplearning4j.rl4j.policy``: ``Policy``,
+``DQNPolicy``, ``ACPolicy``, ``EpsGreedy``): action selection decoupled
+from the learner, plus ``play`` rollouts for evaluation."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .a3c import _policy_logits, _select_from_logits
+from .dqn import _q_values, linear_epsilon
+
+
+class Policy:
+    """``nextAction(obs) -> int`` + greedy ``play`` (reference Policy)."""
+
+    def next_action(self, obs) -> int:
+        raise NotImplementedError
+
+    def play(self, mdp, episodes: int = 1, max_steps: int = 1000) -> float:
+        total = 0.0
+        for _ in range(episodes):
+            obs = mdp.reset()
+            for _ in range(max_steps):
+                obs, r, done = mdp.step(self.next_action(obs))
+                total += r
+                if done:
+                    break
+        return total / episodes
+
+
+class DQNPolicy(Policy):
+    """Greedy argmax over Q-values (reference ``DQNPolicy``)."""
+
+    def __init__(self, params):
+        self.params = params
+
+    def next_action(self, obs) -> int:
+        q = _q_values(self.params, jnp.asarray(np.asarray(obs)[None]))
+        return int(jnp.argmax(q[0]))
+
+
+class ACPolicy(Policy):
+    """Samples from the actor's softmax; greedy if ``rng`` is None
+    (reference ``ACPolicy``)."""
+
+    def __init__(self, params, rng: np.random.Generator = None):
+        self.params = params
+        self.rng = rng
+
+    def next_action(self, obs) -> int:
+        logits = np.asarray(
+            _policy_logits(self.params, jnp.asarray(np.asarray(obs)[None])))[0]
+        return _select_from_logits(logits, self.rng)
+
+
+class EpsGreedy(Policy):
+    """Wraps a policy with annealed-epsilon random exploration (reference
+    ``EpsGreedy``): linear 1.0 -> ``min_epsilon`` over ``epsilon_nb_step``
+    calls."""
+
+    def __init__(self, policy: Policy, action_size: int,
+                 min_epsilon: float = 0.05, epsilon_nb_step: int = 3000,
+                 rng: np.random.Generator = None):
+        self.policy = policy
+        self.action_size = int(action_size)
+        self.min_epsilon = float(min_epsilon)
+        self.epsilon_nb_step = int(epsilon_nb_step)
+        self.rng = rng or np.random.default_rng(0)
+        self.calls = 0
+
+    def epsilon(self) -> float:
+        return linear_epsilon(self.calls, self.min_epsilon,
+                              self.epsilon_nb_step)
+
+    def next_action(self, obs) -> int:
+        eps = self.epsilon()
+        self.calls += 1
+        if self.rng.random() < eps:
+            return int(self.rng.integers(0, self.action_size))
+        return self.policy.next_action(obs)

@@ -23,6 +23,8 @@ _VALIDATED: set[str] = set()
 class TestCase:
     """One op validation case (reference ``TestCase``)."""
 
+    __test__ = False  # not a pytest class, despite the (parity) name
+
     def __init__(self, sd: SameDiff, inputs: dict, expected: dict,
                  grad_wrt: list | None = None, epsilon: float = 1e-6,
                  max_rel_error: float = 1e-4):

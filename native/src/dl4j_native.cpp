@@ -240,12 +240,15 @@ static inline uint64_t xorshift64(uint64_t* s) {
   return *s = x;
 }
 
+// io_state: in = RNG state to start from (0 maps to the init constant);
+// out = state after the walk, so chunked callers can continue the stream
+// without replaying draws host-side.
 int64_t dl4j_w2v_pairs(const int32_t* tokens, const int64_t* offsets,
-                       int64_t n_sentences, int64_t window, uint64_t seed,
-                       int32_t* out, int64_t cap) {
+                       int64_t n_sentences, int64_t window,
+                       uint64_t* io_state, int32_t* out, int64_t cap) {
   if (window < 1) return -1;  // caller raises; avoids modulo-by-zero
   int64_t cnt = 0;
-  uint64_t st = seed ? seed : 0x9E3779B97F4A7C15ull;
+  uint64_t st = *io_state ? *io_state : 0x9E3779B97F4A7C15ull;
   for (int64_t si = 0; si < n_sentences; ++si) {
     const int32_t* sent = tokens + offsets[si];
     int64_t n = offsets[si + 1] - offsets[si];
@@ -264,10 +267,11 @@ int64_t dl4j_w2v_pairs(const int32_t* tokens, const int64_t* offsets,
       }
     }
   }
+  *io_state = st;
   return cnt;
 }
 
-int dl4j_native_version() { return 1; }
+int dl4j_native_version() { return 2; }
 
 int dl4j_native_threads() {
 #if defined(_OPENMP)

@@ -213,3 +213,64 @@ def test_dqn_cartpole_improves():
     random_score = float(np.mean(dqn.episode_rewards[:5]))
     assert trained_score > random_score
     assert trained_score > 50
+
+
+# --------------------------------------------------------------------------
+# async learners (A3C, n-step Q) + policies
+# --------------------------------------------------------------------------
+
+def test_a3c_learns_toy_chain():
+    from deeplearning4j_tpu.rl4j import A3CConfiguration, A3CDiscreteDense
+    cfg = A3CConfiguration(seed=5, max_step=6000, max_epoch_step=20,
+                           num_threads=2, nstep=5, learning_rate=3e-3)
+    a3c = A3CDiscreteDense(lambda tid: SimpleToyMDP(length=8), cfg,
+                           hidden=[32])
+    a3c.train()
+    assert a3c.shared.update_count > 0
+    assert a3c.play(episodes=3) >= 7.0
+
+
+def test_async_nstep_q_learns_toy_chain():
+    from deeplearning4j_tpu.rl4j import (
+        AsyncNStepQLearningDiscreteDense,
+        AsyncQLearningConfiguration,
+    )
+    cfg = AsyncQLearningConfiguration(
+        seed=7, max_step=6000, max_epoch_step=20, num_threads=2, nstep=5,
+        learning_rate=3e-3, epsilon_nb_step=2500,
+        target_dqn_update_freq=200)
+    ql = AsyncNStepQLearningDiscreteDense(
+        lambda tid: SimpleToyMDP(length=8), cfg, hidden=[32])
+    ql.train()
+    assert ql.play(episodes=3) >= 7.0
+
+
+def test_policies():
+    from deeplearning4j_tpu.rl4j import (
+        A3CConfiguration,
+        A3CDiscreteDense,
+        ACPolicy,
+        DQNPolicy,
+        EpsGreedy,
+        QLearningConfiguration,
+        QLearningDiscreteDense,
+    )
+    mdp = SimpleToyMDP(length=5)
+    dqn = QLearningDiscreteDense(mdp, QLearningConfiguration(max_step=1),
+                                 hidden=[8])
+    pol = DQNPolicy(dqn.params)
+    assert pol.next_action(mdp.reset()) in (0, 1)
+    assert isinstance(pol.play(SimpleToyMDP(length=3), episodes=1), float)
+
+    a3c = A3CDiscreteDense(lambda tid: SimpleToyMDP(length=5),
+                           A3CConfiguration(max_step=1), hidden=[8])
+    acp = ACPolicy(a3c.params, rng=np.random.default_rng(0))
+    assert acp.next_action(mdp.reset()) in (0, 1)
+    greedy = ACPolicy(a3c.params)
+    assert greedy.next_action(mdp.reset()) in (0, 1)
+
+    eps = EpsGreedy(pol, action_size=2, min_epsilon=0.1,
+                    epsilon_nb_step=10, rng=np.random.default_rng(0))
+    acts = [eps.next_action(mdp.reset()) for _ in range(20)]
+    assert set(acts) <= {0, 1}
+    assert eps.epsilon() == pytest.approx(0.1)

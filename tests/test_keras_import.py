@@ -209,3 +209,248 @@ def test_bn_scale_false_imports(tmp_path, rng):
     net = KerasModelImport.import_keras_sequential_model_and_weights(path)
     np.testing.assert_array_equal(np.asarray(net.params["0"]["gamma"]),
                                   np.ones(4, np.float32))
+
+
+# --------------------------------------------------------------------------
+# functional (Model) import -> ComputationGraph
+# --------------------------------------------------------------------------
+
+def _functional_cfg(layers, inputs, outputs):
+    return {"class_name": "Model", "config": {
+        "name": "model", "layers": layers,
+        "input_layers": [[n, 0, 0] for n in inputs],
+        "output_layers": [[n, 0, 0] for n in outputs]}}
+
+
+def _node(names):
+    return [[[n, 0, 0, {}] for n in names]]
+
+
+def test_import_functional_residual_mlp(tmp_path, rng):
+    w1 = rng.normal(size=(4, 4)).astype(np.float32)
+    b1 = rng.normal(size=(4,)).astype(np.float32)
+    w2 = rng.normal(size=(4, 3)).astype(np.float32)
+    b2 = rng.normal(size=(3,)).astype(np.float32)
+    layers = [
+        {"class_name": "InputLayer", "config": {
+            "name": "in", "batch_input_shape": [None, 4]}},
+        {"class_name": "Dense", "config": {
+            "name": "d1", "units": 4, "activation": "relu",
+            "use_bias": True}, "inbound_nodes": _node(["in"])},
+        {"class_name": "Add", "config": {"name": "res"},
+         "inbound_nodes": _node(["d1", "in"])},
+        {"class_name": "Dense", "config": {
+            "name": "out", "units": 3, "activation": "softmax",
+            "use_bias": True}, "inbound_nodes": _node(["res"])},
+    ]
+    cfg = _functional_cfg(layers, ["in"], ["out"])
+    path = str(tmp_path / "func.h5")
+    _write_keras_h5(path, cfg, {
+        "d1": {"kernel": w1, "bias": b1},
+        "out": {"kernel": w2, "bias": b2},
+    })
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    x = rng.normal(size=(6, 4)).astype(np.float32)
+    got = np.asarray(net.output(x))
+    h = np.maximum(x @ w1 + b1, 0.0) + x
+    logits = h @ w2 + b2
+    want = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_import_functional_two_branch_concat(tmp_path, rng):
+    wa = rng.normal(size=(5, 3)).astype(np.float32)
+    ba = rng.normal(size=(3,)).astype(np.float32)
+    wb = rng.normal(size=(5, 2)).astype(np.float32)
+    bb = rng.normal(size=(2,)).astype(np.float32)
+    wo = rng.normal(size=(5, 2)).astype(np.float32)
+    bo = rng.normal(size=(2,)).astype(np.float32)
+    layers = [
+        {"class_name": "InputLayer", "config": {
+            "name": "in", "batch_input_shape": [None, 5]}},
+        {"class_name": "Dense", "config": {
+            "name": "a", "units": 3, "activation": "tanh",
+            "use_bias": True}, "inbound_nodes": _node(["in"])},
+        {"class_name": "Dense", "config": {
+            "name": "b", "units": 2, "activation": "sigmoid",
+            "use_bias": True}, "inbound_nodes": _node(["in"])},
+        {"class_name": "Concatenate", "config": {"name": "cat", "axis": -1},
+         "inbound_nodes": _node(["a", "b"])},
+        {"class_name": "Dense", "config": {
+            "name": "out", "units": 2, "activation": "linear",
+            "use_bias": True}, "inbound_nodes": _node(["cat"])},
+    ]
+    cfg = _functional_cfg(layers, ["in"], ["out"])
+    path = str(tmp_path / "func2.h5")
+    _write_keras_h5(path, cfg, {
+        "a": {"kernel": wa, "bias": ba},
+        "b": {"kernel": wb, "bias": bb},
+        "out": {"kernel": wo, "bias": bo},
+    })
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    x = rng.normal(size=(4, 5)).astype(np.float32)
+    got = np.asarray(net.output(x))
+    ha = np.tanh(x @ wa + ba)
+    hb = 1.0 / (1.0 + np.exp(-(x @ wb + bb)))
+    want = np.concatenate([ha, hb], -1) @ wo + bo
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_import_functional_flatten_cnn(tmp_path, rng):
+    k = rng.normal(size=(3, 3, 1, 2), scale=0.5).astype(np.float32)
+    kb = rng.normal(size=(2,)).astype(np.float32)
+    w = rng.normal(size=(8 * 8 * 2, 3)).astype(np.float32)
+    b = rng.normal(size=(3,)).astype(np.float32)
+    layers = [
+        {"class_name": "InputLayer", "config": {
+            "name": "img", "batch_input_shape": [None, 8, 8, 1]}},
+        {"class_name": "Conv2D", "config": {
+            "name": "conv", "filters": 2, "kernel_size": [3, 3],
+            "strides": [1, 1], "padding": "same", "activation": "relu",
+            "use_bias": True}, "inbound_nodes": _node(["img"])},
+        {"class_name": "Flatten", "config": {"name": "flat"},
+         "inbound_nodes": _node(["conv"])},
+        {"class_name": "Dense", "config": {
+            "name": "out", "units": 3, "activation": "softmax",
+            "use_bias": True}, "inbound_nodes": _node(["flat"])},
+    ]
+    cfg = _functional_cfg(layers, ["img"], ["out"])
+    path = str(tmp_path / "func3.h5")
+    _write_keras_h5(path, cfg, {
+        "conv": {"kernel": k, "bias": kb},
+        "out": {"kernel": w, "bias": b},
+    })
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    x = rng.normal(size=(2, 8, 8, 1)).astype(np.float32)
+    got = np.asarray(net.output(x))
+    assert got.shape == (2, 3)
+    np.testing.assert_allclose(got.sum(-1), 1.0, rtol=1e-5)
+
+
+def test_import_functional_dispatches_sequential(tmp_path, rng):
+    w = rng.normal(size=(4, 3)).astype(np.float32)
+    b = rng.normal(size=(3,)).astype(np.float32)
+    cfg = {"class_name": "Sequential", "config": {"name": "seq", "layers": [
+        _dense_cfg("dense", 3, "softmax", input_shape=[4]),
+    ]}}
+    path = str(tmp_path / "seq.h5")
+    _write_keras_h5(path, cfg, {"dense": {"kernel": w, "bias": b}})
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    assert isinstance(net, MultiLayerNetwork)
+
+
+def test_import_functional_trailing_activation_folds(tmp_path, rng):
+    w = rng.normal(size=(4, 3)).astype(np.float32)
+    b = rng.normal(size=(3,)).astype(np.float32)
+    layers = [
+        {"class_name": "InputLayer", "config": {
+            "name": "in", "batch_input_shape": [None, 4]}},
+        {"class_name": "Dense", "config": {
+            "name": "logits", "units": 3, "activation": "linear",
+            "use_bias": True}, "inbound_nodes": _node(["in"])},
+        {"class_name": "Activation", "config": {
+            "name": "sm", "activation": "softmax"},
+         "inbound_nodes": _node(["logits"])},
+    ]
+    cfg = _functional_cfg(layers, ["in"], ["sm"])
+    path = str(tmp_path / "fold.h5")
+    _write_keras_h5(path, cfg, {"logits": {"kernel": w, "bias": b}})
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    x = rng.normal(size=(5, 4)).astype(np.float32)
+    got = np.asarray(net.output(x))
+    z = x @ w + b
+    want = np.exp(z) / np.exp(z).sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    # the folded graph must be trainable (scoring vertex is an OutputLayer)
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    labels = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 5)]
+    net.fit_batch(DataSet(x, labels))
+
+
+def test_import_functional_shared_layer_rejected(tmp_path, rng):
+    layers = [
+        {"class_name": "InputLayer", "config": {
+            "name": "in", "batch_input_shape": [None, 4]}},
+        {"class_name": "Dense", "config": {
+            "name": "shared", "units": 4, "activation": "relu",
+            "use_bias": True},
+         "inbound_nodes": [[["in", 0, 0, {}]], [["in", 0, 0, {}]]]},
+    ]
+    cfg = _functional_cfg(layers, ["in"], ["shared"])
+    path = str(tmp_path / "shared.h5")
+    _write_keras_h5(path, cfg, {})
+    with pytest.raises(InvalidKerasConfigurationException,
+                       match="shared layer"):
+        KerasModelImport.import_keras_model_and_weights(path)
+
+
+def test_import_functional_multi_input_order(tmp_path, rng):
+    # input_layers order (b then a) deliberately differs from the
+    # layers-list definition order (a then b)
+    wa = rng.normal(size=(3, 2)).astype(np.float32)
+    wb = rng.normal(size=(5, 2)).astype(np.float32)
+    wo = rng.normal(size=(4, 2)).astype(np.float32)
+    bo = rng.normal(size=(2,)).astype(np.float32)
+    layers = [
+        {"class_name": "InputLayer", "config": {
+            "name": "a", "batch_input_shape": [None, 3]}},
+        {"class_name": "InputLayer", "config": {
+            "name": "b", "batch_input_shape": [None, 5]}},
+        {"class_name": "Dense", "config": {
+            "name": "da", "units": 2, "activation": "linear",
+            "use_bias": False}, "inbound_nodes": _node(["a"])},
+        {"class_name": "Dense", "config": {
+            "name": "db", "units": 2, "activation": "linear",
+            "use_bias": False}, "inbound_nodes": _node(["b"])},
+        {"class_name": "Concatenate", "config": {"name": "cat", "axis": -1},
+         "inbound_nodes": _node(["da", "db"])},
+        {"class_name": "Dense", "config": {
+            "name": "out", "units": 2, "activation": "linear",
+            "use_bias": True}, "inbound_nodes": _node(["cat"])},
+    ]
+    cfg = _functional_cfg(layers, ["b", "a"], ["out"])
+    path = str(tmp_path / "multi.h5")
+    _write_keras_h5(path, cfg, {
+        "da": {"kernel": wa}, "db": {"kernel": wb},
+        "out": {"kernel": wo, "bias": bo},
+    })
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    assert net.conf.network_inputs == ("b", "a")
+    xb = rng.normal(size=(4, 5)).astype(np.float32)
+    xa = rng.normal(size=(4, 3)).astype(np.float32)
+    got = np.asarray(net.output(xb, xa))  # keras Model(inputs=[b, a]) order
+    want = np.concatenate([xa @ wa, xb @ wb], -1) @ wo + bo
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_import_functional_fanout_dense_not_folded(tmp_path, rng):
+    # logits feeds BOTH an output Activation and a second head: the fold
+    # must not fire (it would corrupt the second branch)
+    w1 = rng.normal(size=(4, 3)).astype(np.float32)
+    w2 = rng.normal(size=(3, 2)).astype(np.float32)
+    b2 = rng.normal(size=(2,)).astype(np.float32)
+    layers = [
+        {"class_name": "InputLayer", "config": {
+            "name": "in", "batch_input_shape": [None, 4]}},
+        {"class_name": "Dense", "config": {
+            "name": "logits", "units": 3, "activation": "linear",
+            "use_bias": False}, "inbound_nodes": _node(["in"])},
+        {"class_name": "Activation", "config": {
+            "name": "sm", "activation": "softmax"},
+         "inbound_nodes": _node(["logits"])},
+        {"class_name": "Dense", "config": {
+            "name": "aux", "units": 2, "activation": "linear",
+            "use_bias": True}, "inbound_nodes": _node(["logits"])},
+    ]
+    cfg = _functional_cfg(layers, ["in"], ["sm", "aux"])
+    path = str(tmp_path / "fanout.h5")
+    _write_keras_h5(path, cfg, {
+        "logits": {"kernel": w1}, "aux": {"kernel": w2, "bias": b2}})
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    x = rng.normal(size=(5, 4)).astype(np.float32)
+    got_sm, got_aux = [np.asarray(o) for o in net.output(x)]
+    z = x @ w1
+    want_sm = np.exp(z) / np.exp(z).sum(-1, keepdims=True)
+    np.testing.assert_allclose(got_sm, want_sm, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(got_aux, z @ w2 + b2, rtol=1e-4, atol=1e-5)

@@ -20,7 +20,7 @@ def _expected_flips(g, tau):
 
 def test_native_builds():
     assert native.available(), "native library failed to build/load"
-    assert native.get_lib().dl4j_native_version() == 1
+    assert native.get_lib().dl4j_native_version() == 2
 
 
 def test_threshold_roundtrip(grads):
