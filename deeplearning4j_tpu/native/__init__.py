@@ -22,8 +22,13 @@ from typing import Optional
 
 import numpy as np
 
+_ABI_VERSION = 2  # must match dl4j_native_version() in dl4j_native.cpp
 _SRC = Path(__file__).resolve().parents[2] / "native" / "src" / "dl4j_native.cpp"
-_OUT = Path(__file__).resolve().parents[2] / "native" / "build" / "libdl4j_native.so"
+# the ABI version is part of the artifact name: an incompatible cached .so
+# from an older source tree can never be picked up by a newer wrapper
+# (mtime staleness alone can miss restored/copied build dirs)
+_OUT = (Path(__file__).resolve().parents[2] / "native" / "build"
+        / f"libdl4j_native_v{_ABI_VERSION}.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -89,7 +94,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
                      or _OUT.stat().st_mtime < _SRC.stat().st_mtime)
             if stale and not _build():
                 return None
-            _lib = _bind(ctypes.CDLL(str(_OUT)))
+            lib = _bind(ctypes.CDLL(str(_OUT)))
+            if lib.dl4j_native_version() != _ABI_VERSION:
+                return None  # refuse a mismatched binary outright
+            _lib = lib
         except Exception:
             _lib = None
     return _lib

@@ -72,8 +72,11 @@ class OpNode:
     outputs: tuple
     attrs: dict = dataclasses.field(default_factory=dict)
     # non-serializable callable attrs (control flow bodies); graph with any
-    # of these saves config-only
+    # of these saves config-only UNLESS the callable was traced into a
+    # serializable child graph recorded in ``subgraphs`` (same keys)
     fn_attrs: dict = dataclasses.field(default_factory=dict)
+    # fn_attr key -> JSON-able child-graph dict (see serde.subgraph_dict)
+    subgraphs: dict = dataclasses.field(default_factory=dict)
 
 
 class SDVariable:
@@ -411,7 +414,7 @@ class SameDiff:
         return self.constant(x).name
 
     def _op(self, op_name, inputs, n_out=1, name=None, fn_attrs=None,
-            **attrs) -> list[SDVariable]:
+            subgraphs=None, **attrs) -> list[SDVariable]:
         if op_name not in OP_REGISTRY:
             raise KeyError(f"op {op_name!r} not in registry")
         node_name = self._unique(name or op_name)
@@ -423,7 +426,8 @@ class SameDiff:
             self.variables[o] = VarMeta(o, VariableType.ARRAY,
                                         producer=node_name, output_index=i)
         self.ops[node_name] = OpNode(node_name, op_name, in_names, out_names,
-                                     dict(attrs), dict(fn_attrs or {}))
+                                     dict(attrs), dict(fn_attrs or {}),
+                                     dict(subgraphs or {}))
         self._fn_cache.clear()
         return [SDVariable(self, o) for o in out_names]
 
@@ -616,27 +620,115 @@ class SameDiff:
                              name=name)
 
     # ---------------- control flow (structured, lax-backed) ----------------
+    def _try_trace(self, fn, n_args):
+        """Trace ``fn`` symbolically into a fresh child SameDiff by calling
+        it on placeholder SDVariables. Returns (child, out_names,
+        serializable) when the callable stayed inside SDVariable ops
+        (``serializable`` is False if a NESTED control-flow body inside it
+        used raw jax — executable, but save() must reject it), or None when
+        ``fn`` itself used raw jax/numpy (still executable via the raw
+        closure, just never saveable)."""
+        child = SameDiff()
+        args = [child.placeholder(f"arg{i}") for i in range(n_args)]
+        before_ops = set(self.ops)
+        before_vars = set(self.variables)
+        try:
+            out = fn(*args)
+        except Exception:
+            out = None
+        # a callable mixing parent-graph variables creates stray nodes in
+        # the PARENT during the probe — roll those back and fall back
+        if set(self.ops) != before_ops or set(self.variables) != before_vars:
+            for k in set(self.ops) - before_ops:
+                del self.ops[k]
+            for k in set(self.variables) - before_vars:
+                del self.variables[k]
+                self.arrays.pop(k, None)
+            self._fn_cache.clear()
+            return None
+        if out is None:
+            return None
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        if not all(isinstance(o, SDVariable) and o.sd is child
+                   for o in outs):
+            return None
+        serializable = True
+        for op in child.ops.values():
+            if any(i not in child.variables for i in op.inputs):
+                return None  # referenced a variable outside the child graph
+            if set(op.fn_attrs) - set(op.subgraphs):
+                # nested control flow with an untraceable body: the child
+                # graph runs fine but would serialize without the inner
+                # callables — mark the whole subgraph unsaveable
+                serializable = False
+        return child, [o.name for o in outs], serializable
+
     def cond(self, pred, true_fn, false_fn, operands, name=None):
         """Structured conditional — replaces the reference's Switch/Merge
         frame machinery with ``lax.cond`` (compiler-friendly; both branches
-        traced once). ``true_fn``/``false_fn`` map arrays -> array."""
+        traced once). ``true_fn``/``false_fn`` map arrays -> array. When
+        the callables stay inside SDVariable ops the graph remains
+        serializable (save/load round-trips the branches)."""
+        from deeplearning4j_tpu.samediff import serde as _serde
+
+        n = len(operands)
+        traced_t = self._try_trace(true_fn, n)
+        traced_f = self._try_trace(false_fn, n)
+        fn_attrs = {"true_fn": true_fn, "false_fn": false_fn}
+        subgraphs = {}
+        if traced_t is not None and traced_f is not None:
+            (ct, ot, st), (cf, of, sf) = traced_t, traced_f
+            fn_attrs = {"true_fn": subgraph_callable(ct, ot, single=True),
+                        "false_fn": subgraph_callable(cf, of, single=True)}
+            if st and sf:
+                subgraphs = {
+                    "true_fn": _serde.subgraph_dict(ct, ot, single=True),
+                    "false_fn": _serde.subgraph_dict(cf, of, single=True)}
         return self._op("cond", [pred] + list(operands), name=name,
-                        fn_attrs={"true_fn": true_fn,
-                                  "false_fn": false_fn})[0]
+                        fn_attrs=fn_attrs, subgraphs=subgraphs)[0]
 
     def while_loop(self, cond_fn, body_fn, operands, name=None):
         """Structured while — replaces Enter/Exit/NextIteration frames with
         ``lax.while_loop``. ``operands`` is the loop carry (list of vars);
-        returns the final carry as a tuple of SDVariables."""
-        return self._op("while_loop", list(operands),
-                        n_out=len(operands), name=name,
-                        fn_attrs={"cond_fn": cond_fn, "body_fn": body_fn})
+        returns the final carry as a tuple of SDVariables. Serializable
+        when the callables stay inside SDVariable ops."""
+        from deeplearning4j_tpu.samediff import serde as _serde
+
+        n = len(operands)
+        traced_c = self._try_trace(cond_fn, n)
+        traced_b = self._try_trace(body_fn, n)
+        fn_attrs = {"cond_fn": cond_fn, "body_fn": body_fn}
+        subgraphs = {}
+        if traced_c is not None and traced_b is not None:
+            (cc, oc, sc), (cb, ob, sb) = traced_c, traced_b
+            fn_attrs = {"cond_fn": subgraph_callable(cc, oc, single=True),
+                        "body_fn": subgraph_callable(cb, ob, single=False)}
+            if sc and sb:
+                subgraphs = {
+                    "cond_fn": _serde.subgraph_dict(cc, oc, single=True),
+                    "body_fn": _serde.subgraph_dict(cb, ob, single=False)}
+        return self._op("while_loop", list(operands), n_out=n, name=name,
+                        fn_attrs=fn_attrs, subgraphs=subgraphs)
 
     def scan(self, body_fn, init, xs, name=None):
         """``lax.scan`` over leading axis of ``xs``; body maps
-        (carry, x) -> (carry, y). Returns (final_carry, ys)."""
+        (carry, x) -> (carry, y). Returns (final_carry, ys). Serializable
+        when ``body_fn`` stays inside SDVariable ops."""
+        from deeplearning4j_tpu.samediff import serde as _serde
+
+        traced = self._try_trace(body_fn, 2)
+        fn_attrs = {"body_fn": body_fn}
+        subgraphs = {}
+        if traced is not None:
+            child, outs, ser = traced
+            if len(outs) == 2:
+                fn_attrs = {"body_fn": subgraph_callable(child, outs,
+                                                         single=False)}
+                if ser:
+                    subgraphs = {"body_fn": _serde.subgraph_dict(
+                        child, outs, single=False)}
         return self._op("scan_op", [init, xs], n_out=2, name=name,
-                        fn_attrs={"body_fn": body_fn})
+                        fn_attrs=fn_attrs, subgraphs=subgraphs)
 
     # ---------------- persistence ----------------
     def save(self, path, save_updater_state: bool = True):
@@ -659,6 +751,21 @@ class SameDiff:
             lines.append(f"  OP {op.op_name:<18} {op.name:<24} "
                          f"{op.inputs} -> {op.outputs}")
         return "\n".join(lines)
+
+
+def subgraph_callable(child: "SameDiff", out_names: list, single: bool):
+    """Turn a traced child graph into a plain ``f(*arrays) -> array/tuple``
+    suitable for ``lax.cond/while_loop/scan`` bodies."""
+    fn = child.make_function(tuple(out_names))
+    arg_names = [v.name for v in child.variables.values()
+                 if v.var_type == VariableType.PLACEHOLDER]
+
+    def call(*xs):
+        res = fn(child.arrays, dict(zip(arg_names, xs)))
+        outs = [res[o] for o in out_names]
+        return outs[0] if single else tuple(outs)
+
+    return call
 
 
 def _init_array(shape, weight_init, dtype, key):
@@ -809,8 +916,14 @@ def _op_cond(pred, *operands, true_fn, false_fn):
 
 @register_op("while_loop")
 def _op_while_loop(*operands, cond_fn, body_fn):
+    def body(c):
+        r = body_fn(*c)
+        # a single-carry body may return a bare array; tuple(r) would
+        # wrongly iterate its elements
+        return tuple(r) if isinstance(r, (tuple, list)) else (r,)
+
     out = jax.lax.while_loop(lambda c: cond_fn(*c).astype(bool).reshape(()),
-                             lambda c: tuple(body_fn(*c)), tuple(operands))
+                             body, tuple(operands))
     return out
 
 

@@ -6,10 +6,12 @@ Format here: one ``.sdz`` zip = ``graph.json`` (variables + op nodes with
 registry names and JSON attrs) + ``arrays.npz`` (VARIABLE/CONSTANT values)
 + optional ``updater_state.npz``. The op registry is the schema — loading
 re-links each node to its pure-jax impl by name, so a loaded graph compiles
-to the identical XLA program. Graphs containing control-flow callables
-(``cond``/``while_loop``/``scan``) carry non-serializable closures and are
-rejected with a clear error, matching the spirit of the reference's
-unsupported-op FlatBuffers failures.
+to the identical XLA program. Control flow (``cond``/``while_loop``/
+``scan``) serializes too when its callables were traced into child graphs
+(see ``SameDiff._try_trace``) — the child graph rides along as JSON and
+the callable is rebuilt at load, the role of the reference's FlatBuffers
+control-flow frames. Only bodies written against raw jax (not SDVariable
+ops) are unserializable and rejected with a clear error.
 """
 
 from __future__ import annotations
@@ -24,27 +26,23 @@ import numpy as np
 FORMAT_VERSION = 1
 
 
-def save(sd, path, save_updater_state: bool = True) -> None:
-    for op in sd.ops.values():
-        if op.fn_attrs:
+def _check_serializable_ops(ops, where=""):
+    for op in ops:
+        missing = set(op.fn_attrs) - set(op.subgraphs)
+        if missing:
             raise ValueError(
-                f"op {op.name!r} ({op.op_name}) holds python callables "
-                "(control flow); such graphs are not serializable")
+                f"op {op.name!r} ({op.op_name}){where} holds python "
+                f"callables {sorted(missing)} that were not traceable as "
+                "SDVariable subgraphs (they use raw jax/numpy); such "
+                "graphs are not serializable")
+
+
+def save(sd, path, save_updater_state: bool = True) -> None:
+    _check_serializable_ops(sd.ops.values())
     graph = {
         "format_version": FORMAT_VERSION,
-        "variables": [
-            {"name": v.name, "var_type": v.var_type,
-             "shape": list(v.shape) if v.shape is not None else None,
-             "dtype": v.dtype, "producer": v.producer,
-             "output_index": v.output_index}
-            for v in sd.variables.values()
-        ],
-        "ops": [
-            {"name": o.name, "op_name": o.op_name,
-             "inputs": list(o.inputs), "outputs": list(o.outputs),
-             "attrs": _jsonable_attrs(o.attrs)}
-            for o in sd.ops.values()
-        ],
+        "variables": _var_dicts(sd),
+        "ops": _op_dicts(sd),
         "loss_variables": list(sd.loss_variables),
         "iteration_count": sd._iteration_count,
         "epoch_count": sd._epoch_count,
@@ -82,15 +80,81 @@ def load(path):
             tuple(v["shape"]) if v["shape"] is not None else None,
             v["dtype"], v.get("producer"), v.get("output_index", 0))
     for o in graph["ops"]:
+        subgraphs = o.get("subgraphs", {})
+        fn_attrs = {k: callable_from_subgraph(d)
+                    for k, d in subgraphs.items()}
         sd.ops[o["name"]] = OpNode(
             o["name"], o["op_name"], tuple(o["inputs"]),
-            tuple(o["outputs"]), _restore_attrs(o["attrs"]))
+            tuple(o["outputs"]), _restore_attrs(o["attrs"]),
+            fn_attrs, subgraphs)
     sd.arrays = {k: jnp.asarray(v) for k, v in arrays.items()}
     sd.loss_variables = list(graph.get("loss_variables", []))
     sd._iteration_count = graph.get("iteration_count", 0)
     sd._epoch_count = graph.get("epoch_count", 0)
     sd._updater_state = updater_state
     return sd
+
+
+def _var_dicts(sd) -> list:
+    return [
+        {"name": v.name, "var_type": v.var_type,
+         "shape": list(v.shape) if v.shape is not None else None,
+         "dtype": v.dtype, "producer": v.producer,
+         "output_index": v.output_index}
+        for v in sd.variables.values()
+    ]
+
+
+def _op_dicts(sd) -> list:
+    out = []
+    for o in sd.ops.values():
+        d = {"name": o.name, "op_name": o.op_name,
+             "inputs": list(o.inputs), "outputs": list(o.outputs),
+             "attrs": _jsonable_attrs(o.attrs)}
+        if o.subgraphs:
+            d["subgraphs"] = o.subgraphs
+        out.append(d)
+    return out
+
+
+def subgraph_dict(child, out_names: list, single: bool) -> dict:
+    """JSON-able form of a traced control-flow child graph (the role of the
+    reference's FlatBuffers control-flow frames). Arrays (constants created
+    inside the body, e.g. the ``2.0`` in ``lambda v: v * 2.0``) are inlined
+    as nested lists — they are scalars/small by construction. Nested
+    control flow recurses through ``_op_dicts``' subgraphs field."""
+    return {
+        "variables": _var_dicts(child),
+        "ops": _op_dicts(child),
+        "arrays": {k: {"data": np.asarray(v).tolist(),
+                       "dtype": str(np.asarray(v).dtype)}
+                   for k, v in child.arrays.items()},
+        "outputs": list(out_names),
+        "single": bool(single),
+    }
+
+
+def callable_from_subgraph(d: dict):
+    """Rebuild the lax-body callable from its serialized child graph."""
+    from deeplearning4j_tpu.samediff.core import (OpNode, SameDiff, VarMeta,
+                                                  subgraph_callable)
+
+    child = SameDiff()
+    for v in d["variables"]:
+        child.variables[v["name"]] = VarMeta(
+            v["name"], v["var_type"],
+            tuple(v["shape"]) if v["shape"] is not None else None,
+            v["dtype"], v.get("producer"), v.get("output_index", 0))
+    for o in d["ops"]:
+        subgraphs = o.get("subgraphs", {})
+        child.ops[o["name"]] = OpNode(
+            o["name"], o["op_name"], tuple(o["inputs"]),
+            tuple(o["outputs"]), _restore_attrs(o["attrs"]),
+            {k: callable_from_subgraph(sg) for k, sg in subgraphs.items()},
+            subgraphs)
+    child.arrays = {k: jnp.asarray(v["data"], dtype=v["dtype"])
+                    for k, v in d["arrays"].items()}
+    return subgraph_callable(child, list(d["outputs"]), bool(d["single"]))
 
 
 def _jsonable_attrs(attrs: dict) -> dict:

@@ -1,7 +1,10 @@
-"""Static dashboard renderer (reference ``UIServer`` web app, SURVEY.md
-§5.5) — emits one self-contained HTML file with inline SVG charts: score vs
-iteration, update:param log-ratio per layer, param mean magnitudes, and
-iteration timing. No server, no JS dependencies; re-render to refresh."""
+"""Training dashboard (reference ``UIServer`` web app, SURVEY.md §5.5) —
+self-contained HTML with inline SVG charts: score vs iteration,
+update:param log-ratio per layer, param mean magnitudes, and iteration
+timing. Two modes: ``render(path)`` writes a static file; ``start(port)``
+serves it live over HTTP (stdlib ThreadingHTTPServer — the role of the
+reference's Play/Vertx server) with a ``/train/stats.json`` endpoint and
+auto-refresh, no JS dependencies."""
 
 from __future__ import annotations
 
@@ -94,6 +97,62 @@ class UIServer:
 
     def render(self, path: str) -> str:
         """Write the dashboard HTML; returns the path."""
+        with open(path, "w") as f:
+            f.write(self.render_html())
+        return path
+
+    def start(self, port: int = 9000) -> int:
+        """Serve the dashboard live (reference ``UIServer`` web server).
+        ``port=0`` picks a free port; returns the bound port. Endpoints:
+        ``/`` (auto-refreshing dashboard), ``/train/stats.json`` (raw
+        records)."""
+        import http.server
+        import json as _json
+        import threading
+
+        if getattr(self, "_httpd", None) is not None:
+            return self._httpd.server_address[1]
+        ui = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path in ("/", "/train", "/train/overview"):
+                    payload = ui.render_html(refresh_seconds=5).encode()
+                    ctype = "text/html; charset=utf-8"
+                elif self.path == "/train/stats.json":
+                    recs = [r for st in ui._storages for r in st.records()]
+                    payload = _json.dumps(recs).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *args):
+                pass  # keep training logs clean
+
+        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+                                                      Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    def stop(self):
+        httpd = getattr(self, "_httpd", None)
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+            self._httpd = None
+        return self
+
+    def render_html(self, refresh_seconds: int = 0) -> str:
+        """The dashboard as an HTML string."""
         records = [r for st in self._storages for r in st.records()]
         records.sort(key=lambda r: (r.get("session", ""),
                                     r.get("iteration", 0)))
@@ -126,12 +185,12 @@ class UIServer:
             _chart("Parameter mean magnitude", pmag),
             _chart("Iteration time", timing, "seconds"),
         ]) or "<p>No stats collected yet.</p>"
-        doc = ("<!doctype html><html><head><meta charset='utf-8'>"
-               "<title>deeplearning4j_tpu training</title><style>"
-               "body{font-family:sans-serif;margin:24px;background:#fafafa}"
-               ".chart{background:#fff;border:1px solid #ddd;margin:12px 0;"
-               "padding:8px}h3{margin:4px 0}</style></head><body>"
-               f"<h1>Training dashboard</h1>{body}</body></html>")
-        with open(path, "w") as f:
-            f.write(doc)
-        return path
+        refresh = (f"<meta http-equiv='refresh' content='{refresh_seconds}'>"
+                   if refresh_seconds else "")
+        return ("<!doctype html><html><head><meta charset='utf-8'>"
+                f"{refresh}"
+                "<title>deeplearning4j_tpu training</title><style>"
+                "body{font-family:sans-serif;margin:24px;background:#fafafa}"
+                ".chart{background:#fff;border:1px solid #ddd;margin:12px 0;"
+                "padding:8px}h3{margin:4px 0}</style></head><body>"
+                f"<h1>Training dashboard</h1>{body}</body></html>")

@@ -394,3 +394,33 @@ def test_orbax_async_checkpointing(tmp_path):
     assert restored.epoch == net.epoch
     # exact resume: training continues from the restored updater state
     restored.fit_batch(ds)
+
+
+def test_ui_server_live_http(tmp_path):
+    import json
+    import urllib.request
+
+    net = MultiLayerNetwork(_conf())
+    net.init()
+    storage = InMemoryStatsStorage()
+    net.set_listeners(StatsListener(storage, frequency=1))
+    ds = _data()
+    for _ in range(3):
+        net.fit_batch(ds)
+    ui = UIServer.get_instance().attach(storage)
+    port = ui.start(port=0)  # free port
+    try:
+        html = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=10).read().decode()
+        assert "Model score" in html and "<svg" in html
+        assert "http-equiv='refresh'" in html
+        stats = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/train/stats.json", timeout=10).read())
+        assert len(stats) == 3 and "score" in stats[0]
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope",
+                                   timeout=10)
+        assert exc_info.value.code == 404
+    finally:
+        ui.stop()
+        UIServer.get_instance().detach(storage)
