@@ -53,6 +53,28 @@ class DataSet:
             _cat([d.labels_mask for d in datasets]),
         )
 
+    def migrate(self) -> "DataSet":
+        """Move all arrays to device memory in place (reference
+        ``DataSet#migrate`` moves into the current workspace). uint8
+        features keep their dtype (dequantized inside the jitted step).
+        The fit paths also do this write-back automatically, so a DataSet
+        reused across epochs transfers once."""
+        import jax
+
+        for attr in ("features", "labels", "features_mask", "labels_mask"):
+            v = getattr(self, attr)
+            if v is not None and not isinstance(v, jax.Array):
+                setattr(self, attr, jax.device_put(np.asarray(v)))
+        return self
+
+    def detach(self) -> "DataSet":
+        """Back to host numpy (reference ``DataSet#detach``)."""
+        for attr in ("features", "labels", "features_mask", "labels_mask"):
+            v = getattr(self, attr)
+            if v is not None:
+                setattr(self, attr, np.asarray(v))
+        return self
+
 
 def _slice(arr, a, b):
     return None if arr is None else np.asarray(arr)[a:b]

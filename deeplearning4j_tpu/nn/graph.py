@@ -249,7 +249,14 @@ class ComputationGraph(nn_io.LazyScoreMixin):
                  if idx < len(self.conf.input_types) else True)
         return nn_io.dequant(x, self._dtype, scale=scale)
 
-    def _prep_batch(self, ds):
+    def _prep_batch(self, ds, lazy_lmasks: bool = False,
+                    write_back: bool = False):
+        """``lazy_lmasks``: missing masks stay None (the jitted step builds
+        all-ones defaults on device — eager ``jnp.ones`` would cost a
+        dispatch round-trip per step). ``write_back``: store staged device
+        arrays back into the container so a DataSet reused across epochs
+        transfers once (reference ``DataSet#migrate``, applied by the fit
+        path only — score/eval leave the caller's arrays untouched)."""
         mds = _as_multi(ds)
         # uint8 features transfer as uint8 and dequantize inside the jit;
         # already-on-device arrays pass through without a host round-trip
@@ -258,14 +265,26 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         labels = tuple(nn_io.as_device(l, self._dtype)
                        for l in mds.labels)
         n_out = len(labels)
-        if mds.labels_masks is not None:
-            lmasks = tuple(
-                jnp.asarray(np.asarray(m), self._dtype) if m is not None
-                else jnp.ones((labels[i].shape[0],), self._dtype)
-                for i, m in enumerate(mds.labels_masks))
-        else:
-            lmasks = tuple(jnp.ones((labels[i].shape[0],), self._dtype)
-                           for i in range(n_out))
+        masks = (mds.labels_masks if mds.labels_masks is not None
+                 else (None,) * n_out)
+        lmasks = tuple(
+            jnp.asarray(np.asarray(m), self._dtype) if m is not None
+            else (None if lazy_lmasks
+                  else jnp.ones((labels[i].shape[0],), self._dtype))
+            for i, m in enumerate(masks))
+        if write_back:
+            if isinstance(ds, MultiDataSet):
+                ds.features = list(features)
+                ds.labels = list(labels)
+                if ds.labels_masks is not None:
+                    ds.labels_masks = [
+                        lm if orig is not None else None
+                        for lm, orig in zip(lmasks, ds.labels_masks)]
+            elif isinstance(ds, DataSet):
+                ds.features = features[0]
+                ds.labels = labels[0]
+                if ds.labels_mask is not None:
+                    ds.labels_mask = lmasks[0]
         return features, labels, lmasks
 
     def fit_batch(self, ds) -> float:
@@ -278,19 +297,35 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         if self.params is None:
             self.init()
         if self._train_step is None:
-            self._train_step = jax.jit(self.train_step_fn(),
-                                       donate_argnums=(0, 1, 2))
-        features, labels, lmasks = self._prep_batch(ds)
-        rng = jax.random.fold_in(self._base_key, self.iteration + 1_000_003)
-        it = jnp.asarray(float(self.iteration), jnp.float32)
-        ep = jnp.asarray(float(self.epoch), jnp.float32)
-        self.params, self.state, self.opt_state, loss = self._train_step(
+            raw = self.train_step_fn()
+            dtype = self._dtype
+
+            # per-step scalars (iteration, epoch, rng fold, default masks)
+            # live inside the jit — each eager host op would cost a
+            # dispatch round-trip (see nn_io device counters)
+            def step(params, state, opt_state, features, labels, lmasks,
+                     itc, ep, base_key):
+                it, rng = nn_io.step_scalars(itc, base_key)
+                lmasks = tuple(
+                    jnp.ones((l.shape[0],), dtype) if m is None else m
+                    for m, l in zip(lmasks, labels))
+                new_p, new_s, new_o, loss = raw(
+                    params, state, opt_state, features, labels, lmasks,
+                    it, ep, rng)
+                return new_p, new_s, new_o, loss, itc + 1
+
+            self._train_step = jax.jit(step, donate_argnums=(0, 1, 2, 6))
+        features, labels, lmasks = self._prep_batch(ds, lazy_lmasks=True,
+                                                    write_back=True)
+        (self.params, self.state, self.opt_state, loss,
+         new_itc) = self._train_step(
             self.params, self.state, self.opt_state, features, labels, lmasks,
-            it, ep, rng)
+            self.device_iteration(), self.device_epoch(), self._base_key)
         self._score_dev = loss
         self._score_cache = None
         cur = self.iteration
         self.iteration += 1  # listeners see iteration == next-to-run
+        self.advance_device_iteration(new_itc)
         for lst in self.listeners:
             lst.iteration_done(self, cur, self.epoch, loss)
         return loss

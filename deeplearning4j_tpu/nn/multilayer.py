@@ -228,7 +228,23 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         return afn
 
     def _build_train_step(self):
-        return jax.jit(self.train_step_fn(), donate_argnums=(0, 1, 2))
+        raw = self.train_step_fn()
+        dtype = self._dtype
+
+        # all per-step scalar work (iteration, epoch, rng fold, default
+        # mask) happens INSIDE the jit: the only host-side cost per step is
+        # the batch transfer + one dispatch (see nn_io device counters)
+        def step(params, state, opt_state, features, labels, fmask, lmask,
+                 itc, ep, base_key):
+            it, rng = nn_io.step_scalars(itc, base_key)
+            if lmask is None:
+                lmask = jnp.ones((features.shape[0],), dtype)
+            new_p, new_s, new_o, loss = raw(
+                params, state, opt_state, features, labels, fmask, lmask,
+                it, ep, rng)
+            return new_p, new_s, new_o, loss, itc + 1
+
+        return jax.jit(step, donate_argnums=(0, 1, 2, 7))
 
     def _build_tbptt_step(self):
         return jax.jit(self.train_step_fn(), donate_argnums=(0, 1, 2, 10))
@@ -282,15 +298,32 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
             self.epoch += 1
         return self
 
-    def _batch_arrays(self, ds: DataSet):
+    def _batch_arrays(self, ds: DataSet, lazy_lmask: bool = False,
+                      write_back: bool = False):
+        """``lazy_lmask``: a missing labels mask stays None (the jitted
+        train step builds the all-ones default on device — an eager
+        ``jnp.ones`` here would cost a dispatch round-trip per step).
+        ``write_back``: store staged device arrays back into ``ds`` so a
+        DataSet reused across epochs transfers once (reference
+        ``DataSet#migrate``, applied by the fit path only — score/eval
+        leave the caller's arrays untouched; call ``ds.migrate()`` there)."""
         features = nn_io.as_device(ds.features, self._dtype, feature=True)
         labels = nn_io.as_device(ds.labels, self._dtype)
         fmask = (nn_io.as_device(ds.features_mask, self._dtype)
                  if ds.features_mask is not None else None)
         if ds.labels_mask is not None:
             lmask = nn_io.as_device(ds.labels_mask, self._dtype)
+        elif lazy_lmask:
+            lmask = None
         else:
             lmask = jnp.ones((features.shape[0],), self._dtype)
+        if write_back:
+            ds.features = features
+            ds.labels = labels
+            if fmask is not None:
+                ds.features_mask = fmask
+            if ds.labels_mask is not None:
+                ds.labels_mask = lmask
         return features, labels, fmask, lmask
 
     def _fit_batch_async(self, ds: DataSet):
@@ -300,20 +333,22 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         ScoreIterationListener every N prints)."""
         if self.params is None:
             self.init()
-        features, labels, fmask, lmask = self._batch_arrays(ds)
+        features, labels, fmask, lmask = self._batch_arrays(
+            ds, lazy_lmask=True, write_back=True)
         from deeplearning4j_tpu.conf.multilayer import BackpropType
 
         if (self.conf.backprop_type is BackpropType.TRUNCATED_BPTT
                 and features.ndim == 3):
+            if lmask is None:
+                lmask = jnp.ones((features.shape[0],), self._dtype)
             return self._fit_tbptt(features, labels, fmask, lmask)
         if self._train_step is None:
             self._train_step = self._build_train_step()
-        rng = jax.random.fold_in(self._base_key, self.iteration + 1_000_003)
-        it = jnp.asarray(float(self.iteration), jnp.float32)
-        ep = jnp.asarray(float(self.epoch), jnp.float32)
-        self.params, self.state, self.opt_state, loss = self._train_step(
+        (self.params, self.state, self.opt_state, loss,
+         new_itc) = self._train_step(
             self.params, self.state, self.opt_state, features, labels, fmask,
-            lmask, it, ep, rng)
+            lmask, self.device_iteration(), self.device_epoch(),
+            self._base_key)
         self.last_batch_size = int(features.shape[0])
         self._score_dev = loss
         self._score_cache = None
@@ -323,6 +358,7 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         # iteration's index
         cur = self.iteration
         self.iteration += 1
+        self.advance_device_iteration(new_itc)
         for lst in self.listeners:
             lst.iteration_done(self, cur, self.epoch, loss)
         return loss

@@ -632,21 +632,30 @@ class SameDiff:
         args = [child.placeholder(f"arg{i}") for i in range(n_args)]
         before_ops = set(self.ops)
         before_vars = set(self.variables)
+        polluted = False
         try:
             out = fn(*args)
-        except Exception:
+        except (TypeError, AttributeError):
+            # raw jax/numpy applied to an SDVariable placeholder fails with
+            # one of these; anything else (KeyError from a bad op name,
+            # user bugs) propagates so it surfaces at the cond/while/scan
+            # call site, not at a distant jit trace. NOTE: the probe CALLS
+            # the body once at graph build — side effects run here too.
             out = None
-        # a callable mixing parent-graph variables creates stray nodes in
-        # the PARENT during the probe — roll those back and fall back
-        if set(self.ops) != before_ops or set(self.variables) != before_vars:
-            for k in set(self.ops) - before_ops:
-                del self.ops[k]
-            for k in set(self.variables) - before_vars:
-                del self.variables[k]
-                self.arrays.pop(k, None)
-            self._fn_cache.clear()
-            return None
-        if out is None:
+        finally:
+            # a callable mixing parent-graph variables creates stray nodes
+            # in the PARENT during the probe — always roll those back
+            # (including when a user bug propagates out of the probe)
+            if (set(self.ops) != before_ops
+                    or set(self.variables) != before_vars):
+                polluted = True
+                for k in set(self.ops) - before_ops:
+                    del self.ops[k]
+                for k in set(self.variables) - before_vars:
+                    del self.variables[k]
+                    self.arrays.pop(k, None)
+                self._fn_cache.clear()
+        if polluted or out is None:
             return None
         outs = list(out) if isinstance(out, (list, tuple)) else [out]
         if not all(isinstance(o, SDVariable) and o.sd is child

@@ -282,3 +282,61 @@ def test_gradient_checkpointing_matches_standard():
         lb = b.fit_batch(ds)
     np.testing.assert_allclose(la, lb, rtol=1e-5)
     np.testing.assert_allclose(a.params_flat(), b.params_flat(), rtol=1e-5)
+
+
+def test_dataset_device_writeback_and_migrate():
+    """Fitting writes staged device arrays back into the DataSet (reference
+    DataSet#migrate semantics): a reused batch transfers once."""
+    import jax
+
+    from deeplearning4j_tpu.conf import Activation, InputType
+    from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.conf.losses import LossMCXENT
+    from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+    from deeplearning4j_tpu.conf.updaters import Sgd
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_out=8, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=2, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(size=(16, 4)).astype(np.float32),
+                 np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)])
+    assert isinstance(ds.features, np.ndarray)
+    l1 = net.fit_batch(ds)
+    assert isinstance(ds.features, jax.Array)  # written back
+    assert isinstance(ds.labels, jax.Array)
+    l2 = net.fit_batch(ds)  # second pass: no host->device transfer
+    assert np.isfinite(l1) and np.isfinite(l2)
+
+    ds.detach()
+    assert isinstance(ds.features, np.ndarray)
+    ds.migrate()
+    assert isinstance(ds.features, jax.Array)
+
+
+def test_score_does_not_mutate_dataset():
+    """Only the fit path writes device arrays back; score()/evaluate()
+    leave the caller's host arrays untouched."""
+    from deeplearning4j_tpu.conf import Activation, InputType
+    from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.conf.losses import LossMCXENT
+    from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+    from deeplearning4j_tpu.conf.updaters import Sgd
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_out=4, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=2, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(3)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(size=(8, 3)).astype(np.float32),
+                 np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)])
+    net.score(ds)
+    assert isinstance(ds.features, np.ndarray)
+    assert isinstance(ds.labels, np.ndarray)
