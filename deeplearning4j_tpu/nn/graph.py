@@ -267,8 +267,11 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         n_out = len(labels)
         masks = (mds.labels_masks if mds.labels_masks is not None
                  else (None,) * n_out)
+        # as_device passes an already-on-device mask through (the
+        # write-back below stores device masks; re-staging them would pull
+        # device->host and re-upload per step)
         lmasks = tuple(
-            jnp.asarray(np.asarray(m), self._dtype) if m is not None
+            nn_io.as_device(m, self._dtype) if m is not None
             else (None if lazy_lmasks
                   else jnp.ones((labels[i].shape[0],), self._dtype))
             for i, m in enumerate(masks))

@@ -247,7 +247,17 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         return jax.jit(step, donate_argnums=(0, 1, 2, 7))
 
     def _build_tbptt_step(self):
-        return jax.jit(self.train_step_fn(), donate_argnums=(0, 1, 2, 10))
+        raw = self.train_step_fn()
+
+        def step(params, state, opt_state, features, labels, fmask, lmask,
+                 itc, ep, base_key, carries):
+            it, rng = nn_io.step_scalars(itc, base_key)
+            new_p, new_s, new_o, loss, new_c = raw(
+                params, state, opt_state, features, labels, fmask, lmask,
+                it, ep, rng, carries)
+            return new_p, new_s, new_o, loss, new_c, itc + 1
+
+        return jax.jit(step, donate_argnums=(0, 1, 2, 7, 10))
 
     def _build_output_fn(self):
         def out(params, state, x, fmask):
@@ -340,7 +350,9 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         if (self.conf.backprop_type is BackpropType.TRUNCATED_BPTT
                 and features.ndim == 3):
             if lmask is None:
-                lmask = jnp.ones((features.shape[0],), self._dtype)
+                # HOST array: segments of it stage with each step call
+                # instead of costing an eager device op per batch
+                lmask = np.ones((features.shape[0],), self._dtype)
             return self._fit_tbptt(features, labels, fmask, lmask)
         if self._train_step is None:
             self._train_step = self._build_train_step()
@@ -386,9 +398,11 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         back = min(back, seg)
         n, total_t = features.shape[0], features.shape[1]
         if fmask is None:
-            fmask = jnp.ones((n, total_t), self._dtype)
+            fmask = np.ones((n, total_t), self._dtype)
         if lmask.ndim == 1:  # per-example -> per-timestep
-            lmask = lmask[:, None] * jnp.ones((n, total_t), self._dtype)
+            ones_t = (np.ones if isinstance(lmask, np.ndarray)
+                      else jnp.ones)((n, total_t), self._dtype)
+            lmask = lmask[:, None] * ones_t
         carries = {str(i): layer.zero_carry(n, self._dtype)
                    for i, layer in enumerate(self.conf.layers)
                    if getattr(layer, "has_carry", False)}
@@ -412,18 +426,17 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
                 l_seg = _pad_time(l_seg[:, cut:], seg)
                 fm_seg = _pad_time(fm_seg[:, cut:], seg)
                 lm_seg = _pad_time(lm_seg[:, cut:], seg)
-            rng = jax.random.fold_in(self._base_key,
-                                     self.iteration + 1_000_003)
-            it = jnp.asarray(float(self.iteration), jnp.float32)
-            ep = jnp.asarray(float(self.epoch), jnp.float32)
-            (self.params, self.state, self.opt_state, loss,
-             carries) = self._tbptt_step(
+            (self.params, self.state, self.opt_state, loss, carries,
+             new_itc) = self._tbptt_step(
                 self.params, self.state, self.opt_state, f_seg, l_seg,
-                fm_seg, lm_seg, it, ep, rng, carries)
-            losses.append(float(loss))
+                fm_seg, lm_seg, self.device_iteration(), self.device_epoch(),
+                self._base_key, carries)
+            losses.append(loss)  # device scalars; one sync below
             self.iteration += 1
+            self.advance_device_iteration(new_itc)
         self.last_batch_size = int(n)
-        self.score_value = float(np.mean(losses))
+        # one device-side reduce + one sync for the whole segment chain
+        self.score_value = float(jnp.mean(jnp.stack(losses)))
         for lst in self.listeners:
             # arg = just-finished iteration index, matching the standard
             # path (tBPTT counts one iteration per segment; the batch-level
@@ -584,12 +597,14 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
 
 
 def _pad_time(arr, seg: int):
-    """Zero-pad [batch, t, ...] (or [batch, t]) to t == seg on axis 1."""
+    """Zero-pad [batch, t, ...] (or [batch, t]) to t == seg on axis 1.
+    numpy stays numpy (host masks stage with the step call); device arrays
+    pad on device."""
     t = arr.shape[1]
     if t == seg:
         return arr
     width = [(0, 0), (0, seg - t)] + [(0, 0)] * (arr.ndim - 2)
-    return jnp.pad(arr, width)
+    return (np.pad if isinstance(arr, np.ndarray) else jnp.pad)(arr, width)
 
 
 def _fmt_type(t) -> str:

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from deeplearning4j_tpu.nn import io as nn_io
 from deeplearning4j_tpu.parallel import mesh as mesh_mod
 from deeplearning4j_tpu.parallel.compression import (
     ThresholdAlgorithm,
@@ -75,7 +76,7 @@ def _mean_leading(tree):
     return _tree_map(lambda x: x.mean(axis=0), tree)
 
 
-class ParallelWrapper:
+class ParallelWrapper(nn_io.LazyScoreMixin):
     """Multi-device data-parallel trainer (reference ``ParallelWrapper``).
 
     Usage (reference ``ParallelWrapper.Builder`` equivalent)::
@@ -194,16 +195,23 @@ class ParallelWrapper:
             # batch shardings drive SPMD partitioning, XLA inserts the
             # all-reduce
             if self._step is None:
-                self._step = jax.jit(m.train_step_fn(),
-                                     donate_argnums=(0, 1, 2))
+                raw = m.train_step_fn()
+
+                def exact_step(params, state, opt, *rest):
+                    *batch, itc, ep, base_key = rest
+                    it, rng = nn_io.step_scalars(itc, base_key)
+                    return raw(params, state, opt, *batch, it, ep, rng)
+
+                self._step = jax.jit(exact_step, donate_argnums=(0, 1, 2))
 
     # --- step builders ------------------------------------------------------
     def _build_threshold_step(self):
         gfn = self.model.grad_fn()
         afn = self.model.apply_updates_fn()
 
-        def step(params, state, opt, residual, batch, it, ep, rng, tau,
-                 cvec):
+        def step(params, state, opt, residual, batch, itc, ep, base_key,
+                 tau, cvec):
+            it, rng = nn_io.step_scalars(itc, base_key)
             idx = jax.lax.axis_index(DATA)
             rng = jax.random.fold_in(rng, idx)
             loss, new_state, grads = gfn(params, state, *batch, rng)
@@ -241,7 +249,8 @@ class ParallelWrapper:
     def _build_averaging_step(self):
         raw = self.model.train_step_fn()
 
-        def step(params, state, opt, batch, it, ep, rng, cvec):
+        def step(params, state, opt, batch, itc, ep, base_key, cvec):
+            it, rng = nn_io.step_scalars(itc, base_key)
             idx = jax.lax.axis_index(DATA)
             rng = jax.random.fold_in(rng, idx)
             p = _tree_map(lambda x: x[0], params)
@@ -354,38 +363,42 @@ class ParallelWrapper:
         batch = self._data_sharded(mesh_mod.pad_leading(batch, target))
         counts = mesh_mod.shard_valid_counts(rows, self.local_workers)
         cvec = self._data_sharded(jnp.asarray(counts))
-        rng = jax.random.fold_in(m._base_key, m.iteration + 1_000_003)
-        it = jnp.asarray(float(m.iteration), jnp.float32)
-        ep = jnp.asarray(float(m.epoch), jnp.float32)
+        # numpy scalars stage with the call (~0.1ms) — python ints or eager
+        # jnp.asarray/fold_in would each cost a 20-65ms tunnel round-trip
+        itc = np.int32(m.iteration)
+        ep = np.float32(m.epoch)
 
         if self.training_mode is TrainingMode.AVERAGING:
             (self._params, self._state, self._opt, loss) = self._step(
-                self._params, self._state, self._opt, batch, it, ep, rng,
-                cvec)
-            self.score_value = float(loss)
+                self._params, self._state, self._opt, batch, itc, ep,
+                m._base_key, cvec)
             if (m.iteration + 1) % self.averaging_frequency == 0:
                 self._params, self._state, self._opt = self._avg(
                     self._params, self._state, self._opt)
         elif self.threshold_algorithm is not None:
-            tau = jnp.asarray(self._tau, jnp.float32)
+            tau = np.float32(self._tau)
             (self._params, self._state, self._opt, self._residual, loss,
              sparsity) = self._step(self._params, self._state, self._opt,
-                                    self._residual, batch, it, ep, rng, tau,
-                                    cvec)
-            self.score_value = float(loss)
+                                    self._residual, batch, itc, ep,
+                                    m._base_key, tau, cvec)
+            # the adaptive threshold needs the sparsity on host — this mode
+            # inherently syncs per step (as the reference's EncodingHandler
+            # feedback loop does)
             self._tau = float(self.threshold_algorithm.update(
                 self._tau, float(sparsity)))
         else:
             out = self._step(self._params, self._state, self._opt, *batch,
-                             it, ep, rng)
+                             itc, ep, m._base_key)
             self._params, self._state, self._opt, loss = out[:4]
-            self.score_value = float(loss)
 
-        m.score_value = self.score_value
+        self._score_dev = loss
+        self._score_cache = None
+        m._score_dev = loss
+        m._score_cache = None
         cur = m.iteration
         m.iteration += 1  # listeners see iteration == next-to-run
         for lst in m.listeners:
-            lst.iteration_done(m, cur, m.epoch, self.score_value)
+            lst.iteration_done(m, cur, m.epoch, loss)
 
     def _write_back(self):
         """Publish trained params back onto the wrapped model (reference:

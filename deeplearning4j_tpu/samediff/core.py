@@ -550,6 +550,7 @@ class SameDiff:
     def set_training_config(self, cfg) -> None:
         self.training_config = cfg
         self._updater_state = None
+        self._fn_cache.pop("__train_step__", None)
 
     def fit(self, iterator=None, epochs: int = 1, features=None, labels=None):
         from deeplearning4j_tpu.samediff.training import fit as _fit

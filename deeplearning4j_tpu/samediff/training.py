@@ -15,6 +15,7 @@ import typing as tp
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deeplearning4j_tpu.conf.updaters import IUpdater, Sgd
 
@@ -77,13 +78,25 @@ class TrainingConfig:
 
 
 class History:
-    """Reference ``org.nd4j.autodiff.listeners.records.History`` (thin)."""
+    """Reference ``org.nd4j.autodiff.listeners.records.History`` (thin).
+
+    Losses accumulate as device scalars and materialize to floats on first
+    read — a per-step ``float()`` would force a full host sync per
+    iteration (~100ms on the axon tunnel)."""
 
     def __init__(self):
-        self.loss_curve: list[float] = []
+        self._pending: list = []
+        self._curve: list[float] = []
 
-    def append(self, loss: float):
-        self.loss_curve.append(float(loss))
+    def append(self, loss):
+        self._pending.append(loss)
+
+    @property
+    def loss_curve(self) -> list[float]:
+        if self._pending:
+            self._curve.extend(float(v) for v in self._pending)
+            self._pending.clear()
+        return self._curve
 
 
 def make_train_step(sd, cfg: TrainingConfig):
@@ -132,7 +145,17 @@ def fit(sd, iterator=None, epochs: int = 1, features=None, labels=None):
     cfg = sd.training_config
     if cfg is None:
         raise ValueError("call set_training_config() first")
-    step, trainable_names, _ = make_train_step(sd, cfg)
+    # cache the jitted step inside _fn_cache (cleared on graph mutation):
+    # rebuilding per fit() call would retrace/recompile every time. Keyed
+    # by cfg IDENTITY (the entry holds the cfg, so its id can't be
+    # recycled); set_training_config() with a new cfg misses naturally.
+    # Mutating a TrainingConfig in place between fits is not supported —
+    # call set_training_config with a fresh config.
+    cached = sd._fn_cache.get("__train_step__")
+    if cached is None or cached[0] is not cfg:
+        cached = (cfg, make_train_step(sd, cfg))
+        sd._fn_cache["__train_step__"] = cached
+    step, trainable_names, _ = cached[1]
 
     trainables = {n: sd.arrays[n] for n in trainable_names}
     frozen = {k: v for k, v in sd.arrays.items()
@@ -153,6 +176,14 @@ def fit(sd, iterator=None, epochs: int = 1, features=None, labels=None):
             from deeplearning4j_tpu.datasets.dataset import DataSet
             yield DataSet(features, labels)
 
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn import io as nn_io
+
+    # the dispatch queue persists ACROSS fit() calls: forcing a sync per
+    # call would pay the expensive post-program host sync every step for
+    # the common one-batch-per-fit pattern; the bounded queue syncs every
+    # DISPATCH_DEPTH steps instead, wherever those steps came from
+    pending = sd.__dict__.setdefault("_dispatch_pending", [])
     for _ in range(epochs):
         for ds in batches():
             ph = {}
@@ -172,10 +203,28 @@ def fit(sd, iterator=None, epochs: int = 1, features=None, labels=None):
                     getattr(ds, "labels_mask", None) is not None:
                 ph[cfg.data_set_label_mask_mapping[0]] = jnp.asarray(
                     ds.labels_mask)
+            # write staged arrays back so a reused DataSet transfers once
+            # (reference DataSet#migrate semantics, matching the networks)
+            if isinstance(ds, DataSet):
+                fmap = list(cfg.data_set_feature_mapping or [])[:len(feats)]
+                lmap = list(cfg.data_set_label_mapping or [])[:len(labs)]
+                if len(fmap) == len(feats):
+                    staged = [ph[n] for n in fmap]
+                    ds.features = (staged if isinstance(
+                        ds.features, (list, tuple)) else staged[0])
+                if len(lmap) == len(labs):
+                    staged = [ph[n] for n in lmap]
+                    ds.labels = (staged if isinstance(
+                        ds.labels, (list, tuple)) else staged[0])
+            # np scalar stages with the call; a bare python int would take
+            # the slow weak-type conversion path (~20ms on the tunnel)
             trainables, opt_state, loss = step(
-                trainables, frozen, opt_state, sd._iteration_count, ph)
+                trainables, frozen, opt_state,
+                np.float32(sd._iteration_count), ph)
             sd._iteration_count += 1
             history.append(loss)
+            pending.append(loss)
+            nn_io.drain(pending)  # bounded async dispatch, no per-step sync
             for lst in sd._listeners:
                 if hasattr(lst, "iteration_done"):
                     lst.iteration_done(sd, sd._iteration_count, float(loss))
