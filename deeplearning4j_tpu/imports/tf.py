@@ -10,12 +10,18 @@ conv/pool weights and attributes map without transposition (the reference
 must convert to NCHW).
 
 Supported ops: Placeholder, Const, Identity/StopGradient/NoOp, MatMul,
-BiasAdd, Add/AddV2/Sub/Mul/RealDiv/Maximum/Minimum/SquaredDifference,
-Relu/Relu6/Tanh/Sigmoid/Elu/Selu/Softplus/Exp/Log/Sqrt/Rsqrt/Square/Neg/
-Abs, Softmax, Conv2D, DepthwiseConv2dNative, MaxPool, AvgPool, FusedBatchNorm(V2/V3)
-(inference), Reshape, Squeeze, ExpandDims, Transpose, ConcatV2, Pad, Mean/
-Sum/Max/Min/Prod (reductions), ArgMax, Shape (static), Pack.
-Unsupported ops raise ``UnsupportedTFOpException`` listing the node.
+BatchMatMul(V2), BiasAdd, the elementwise binary family (Add/AddV2/Sub/
+Mul/RealDiv/Maximum/Minimum/SquaredDifference/Pow/FloorDiv/comparisons),
+the unary family (Relu/Relu6/Tanh/Sigmoid/Elu/Selu/Softplus/Exp/Log/
+Log1p/Expm1/Sqrt/Rsqrt/Square/Neg/Abs/Floor/Ceil/Round/Sign/Erf/
+Reciprocal/Sin/Cos/Tan), LeakyRelu, Softmax, LogSoftmax, Conv2D,
+DepthwiseConv2dNative, MaxPool, AvgPool, FusedBatchNorm(V2/V3)
+(inference), Reshape, Squeeze, ExpandDims, Transpose, ConcatV2, Pad,
+Mean/Sum/Max/Min/Prod (reductions), ArgMax, Shape (static), Pack,
+Unpack, Split/SplitV, Cast, Gather/GatherV2, OneHot, Select(V2), Fill,
+Range, Tile, Slice, StridedSlice, Cumsum — the surface BERT-class frozen
+graphs need. Unsupported ops raise ``UnsupportedTFOpException`` listing
+the node.
 """
 
 from __future__ import annotations
@@ -83,10 +89,15 @@ def _tensor_to_np(t: "pb.TensorProto") -> np.ndarray:
 
 
 def _clean(name: str) -> str:
-    """strip ':0' output suffixes and '^' control markers."""
+    """strip ':0' output suffixes and '^' control markers; keep ':N' for
+    N>0 — multi-output nodes (Split, Unpack) register each output under
+    its suffixed name."""
     if name.startswith("^"):
         return ""
-    return name.split(":")[0]
+    if ":" in name:
+        base, idx = name.rsplit(":", 1)
+        return base if idx == "0" else name
+    return name
 
 
 _BINARY = {"Add": "add", "AddV2": "add", "Sub": "sub", "Mul": "mul",
@@ -98,10 +109,14 @@ _BINARY = {"Add": "add", "AddV2": "add", "Sub": "sub", "Mul": "mul",
 # values are REGISTRY keys (activations live under nn., the rest math.)
 _UNARY = {"Relu": "nn.relu", "Tanh": "nn.tanh", "Sigmoid": "nn.sigmoid",
           "Elu": "nn.elu", "Selu": "nn.selu", "Softplus": "nn.softplus",
-          "Exp": "math.exp", "Log": "math.log", "Sqrt": "math.sqrt",
+          "Exp": "math.exp", "Log": "math.log", "Log1p": "math.log1p",
+          "Expm1": "math.expm1", "Sqrt": "math.sqrt",
           "Rsqrt": "math.rsqrt", "Square": "math.square",
           "Neg": "math.neg", "Abs": "math.abs", "Floor": "math.floor",
-          "Ceil": "math.ceil", "Sign": "math.sign", "Erf": "math.erf"}
+          "Ceil": "math.ceil", "Round": "math.round",
+          "Sign": "math.sign", "Erf": "math.erf",
+          "Reciprocal": "math.reciprocal", "Inv": "math.reciprocal",
+          "Sin": "math.sin", "Cos": "math.cos", "Tan": "math.tan"}
 _REDUCE = {"Mean": "mean", "Sum": "sum", "Max": "amax", "Min": "amin",
            "Prod": "prod"}
 
@@ -161,6 +176,13 @@ class _Mapper:
             self.names[node.name] = node.name
         else:
             self.names[node.name] = var.name
+
+    def _bind_multi(self, node, vars_: list):
+        """Multi-output node: output i is referenced as 'name:i' (output 0
+        also as the bare name)."""
+        self.names[node.name] = vars_[0].name
+        for i, v in enumerate(vars_):
+            self.names[f"{node.name}:{i}"] = v.name
 
     # -- main ----------------------------------------------------------------
     def run(self) -> SameDiff:
@@ -303,6 +325,123 @@ class _Mapper:
             self._bind(node, v)
         elif op == "Shape":
             v = sd._op("shape_of", [self._var(ins[0])])[0]
+            self._bind(node, v)
+        elif op == "Cast":
+            dtype = _DTYPES.get(node.attr["DstT"].type)
+            if dtype is None:
+                raise UnsupportedTFOpException(
+                    f"{node.name}: Cast to unsupported dtype")
+            v = sd._op("cast", [self._var(ins[0])],
+                       dtype=np.dtype(dtype).name)[0]
+            self._bind(node, v)
+        elif op in ("Gather", "GatherV2"):
+            if node.attr["batch_dims"].i:
+                raise UnsupportedTFOpException(
+                    f"{node.name}: Gather with batch_dims unsupported")
+            axis = (int(self._static(ins[2], node)) if len(ins) > 2 else 0)
+            v = sd._op("gather", [self._var(ins[0]), self._var(ins[1])],
+                       axis=axis)[0]
+            self._bind(node, v)
+        elif op in ("BatchMatMul", "BatchMatMulV2"):
+            v = sd._op("math.matmul",
+                       [self._var(ins[0]), self._var(ins[1])],
+                       transpose_a=node.attr["adj_x"].b,
+                       transpose_b=node.attr["adj_y"].b)[0]
+            self._bind(node, v)
+        elif op in ("Select", "SelectV2"):
+            # v1 Select row-selects with a rank-1 cond; SelectV2 broadcasts
+            impl = "select_tf" if op == "Select" else "math.where"
+            v = sd._op(impl, [self._var(i) for i in ins[:3]])[0]
+            self._bind(node, v)
+        elif op == "OneHot":
+            depth = int(self._static(ins[1], node))
+            on = float(self._static(ins[2], node)) if len(ins) > 2 else 1.0
+            off = float(self._static(ins[3], node)) if len(ins) > 3 else 0.0
+            # proto3 default for a missing axis attr is 0, but TF's
+            # default is -1 — only honor the attr when present
+            axis = int(node.attr["axis"].i) if "axis" in node.attr else -1
+            v = sd._op("one_hot", [self._var(ins[0])], depth=depth,
+                       axis=axis)[0]
+            if (on, off) != (1.0, 0.0):
+                v = v * (on - off) + off
+            self._bind(node, v)
+        elif op == "Split":
+            axis = int(self._static(ins[0], node))
+            num = int(node.attr["num_split"].i)
+            vs = sd._op("split", [self._var(ins[1])], n_out=num,
+                        axis=axis, num=num)
+            self._bind_multi(node, vs)
+        elif op == "SplitV":
+            sizes = [int(s) for s in self._static(ins[1], node)]
+            axis = int(self._static(ins[2], node))
+            vs = sd._op("split", [self._var(ins[0])], n_out=len(sizes),
+                        axis=axis, sizes=tuple(sizes))
+            self._bind_multi(node, vs)
+        elif op == "Unpack":
+            num = int(node.attr["num"].i)
+            axis = int(node.attr["axis"].i)
+            vs = sd._op("unstack", [self._var(ins[0])], n_out=num,
+                        axis=axis, num=num)
+            self._bind_multi(node, vs)
+        elif op == "Fill":
+            dims = tuple(int(d) for d in self._static(ins[0], node))
+            value = self._static(ins[1], node)
+            arr = np.full(dims, np.asarray(value).reshape(-1)[0])
+            self.const_np[node.name] = arr
+            v = sd.constant(arr, name=node.name)
+            self.names[node.name] = v.name
+        elif op == "Range":
+            start, limit, delta = (self._static(i, node) for i in ins[:3])
+            dtype = np.result_type(start, limit, delta)
+            arr = np.arange(np.asarray(start).item(),
+                            np.asarray(limit).item(),
+                            np.asarray(delta).item()).astype(dtype)
+            self.const_np[node.name] = arr
+            v = sd.constant(arr, name=node.name)
+            self.names[node.name] = v.name
+        elif op == "Tile":
+            reps = tuple(int(r) for r in self._static(ins[1], node))
+            v = sd._op("tile", [self._var(ins[0])], reps=reps)[0]
+            self._bind(node, v)
+        elif op == "Slice":
+            begin = [int(b) for b in self._static(ins[1], node)]
+            size = [int(s) for s in self._static(ins[2], node)]
+            # TF size=-1 means "to the end": express via end_mask bits
+            end = [b + s for b, s in zip(begin, size)]
+            end_mask = sum(1 << i for i, s in enumerate(size) if s == -1)
+            v = sd._op("strided_slice", [self._var(ins[0])],
+                       begin=tuple(begin), end=tuple(end),
+                       strides=(1,) * len(begin), end_mask=end_mask)[0]
+            self._bind(node, v)
+        elif op == "StridedSlice":
+            begin = tuple(int(b) for b in self._static(ins[1], node))
+            end = tuple(int(e) for e in self._static(ins[2], node))
+            strides = tuple(int(s) for s in self._static(ins[3], node))
+            v = sd._op("strided_slice", [self._var(ins[0])],
+                       begin=begin, end=end, strides=strides,
+                       begin_mask=int(node.attr["begin_mask"].i),
+                       end_mask=int(node.attr["end_mask"].i),
+                       ellipsis_mask=int(node.attr["ellipsis_mask"].i),
+                       new_axis_mask=int(node.attr["new_axis_mask"].i),
+                       shrink_axis_mask=int(
+                           node.attr["shrink_axis_mask"].i))[0]
+            self._bind(node, v)
+        elif op == "LeakyRelu":
+            # explicit alpha=0.0 (== Relu) must not fall back to the 0.2
+            # default — check attr presence, not truthiness
+            alpha = (node.attr["alpha"].f if "alpha" in node.attr else 0.2)
+            v = sd._op("nn.leakyRelu", [self._var(ins[0])],
+                       alpha=float(alpha))[0]
+            self._bind(node, v)
+        elif op == "LogSoftmax":
+            v = sd._op("nn.logSoftmax", [self._var(ins[0])], axis=-1)[0]
+            self._bind(node, v)
+        elif op == "Cumsum":
+            if node.attr["exclusive"].b or node.attr["reverse"].b:
+                raise UnsupportedTFOpException(
+                    f"{node.name}: exclusive/reverse Cumsum unsupported")
+            axis = int(self._static(ins[1], node))
+            v = sd._op("math.cumsum", [self._var(ins[0])], axis=axis)[0]
             self._bind(node, v)
         else:
             raise UnsupportedTFOpException(

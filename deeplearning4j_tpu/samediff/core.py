@@ -868,6 +868,54 @@ def _op_unstack(x, *, axis, num):
     return tuple(jnp.squeeze(p, axis=axis) for p in parts)
 
 
+@register_op("split")
+def _op_split(x, *, axis, num=None, sizes=None):
+    """Even split (``num``) or ragged split (``sizes``, TF SplitV). A
+    single ``-1`` size is inferred from the input dim (TF semantics);
+    shapes are concrete at trace time."""
+    if sizes is not None:
+        sizes = [int(s) for s in sizes]
+        if sizes.count(-1) > 1:
+            raise ValueError("split: at most one size may be -1")
+        if -1 in sizes:
+            known = sum(s for s in sizes if s >= 0)
+            sizes[sizes.index(-1)] = int(x.shape[axis]) - known
+        cuts = list(np.cumsum(sizes[:-1]))
+        return tuple(jnp.split(x, cuts, axis=axis))
+    return tuple(jnp.split(x, num, axis=axis))
+
+
+@register_op("select_tf")
+def _op_select_tf(cond, a, b):
+    """TF ``Select`` (v1): a rank-1 condition of length B against rank-N
+    operands selects whole leading-dim rows (unlike where's trailing
+    broadcast)."""
+    c = cond.astype(bool)
+    if c.ndim == 1 and a.ndim > 1:
+        c = c.reshape((-1,) + (1,) * (a.ndim - 1))
+    return jnp.where(c, a, b)
+
+
+@register_op("strided_slice")
+def _op_strided_slice(x, *, begin, end, strides, begin_mask=0, end_mask=0,
+                      ellipsis_mask=0, new_axis_mask=0, shrink_axis_mask=0):
+    """TF StridedSlice semantics for STATIC begin/end/strides, with the
+    common masks (begin/end/shrink). Cite: reference StridedSlice import in
+    TFGraphMapper per-op mappings."""
+    if ellipsis_mask or new_axis_mask:
+        raise NotImplementedError(
+            "strided_slice: ellipsis_mask/new_axis_mask not supported")
+    idx = []
+    for i in range(len(begin)):
+        if shrink_axis_mask & (1 << i):
+            idx.append(int(begin[i]))
+            continue
+        b = None if (begin_mask & (1 << i)) else int(begin[i])
+        e = None if (end_mask & (1 << i)) else int(end[i])
+        idx.append(slice(b, e, int(strides[i])))
+    return x[tuple(idx)]
+
+
 @register_op("squeeze")
 def _op_squeeze(x, *, axis):
     return jnp.squeeze(x, axis=axis)
@@ -899,8 +947,9 @@ def _op_gather(x, indices, *, axis):
 
 
 @register_op("one_hot")
-def _op_one_hot(indices, *, depth):
-    return jax.nn.one_hot(indices.astype(jnp.int32), depth)
+def _op_one_hot(indices, *, depth, axis=-1):
+    r = jax.nn.one_hot(indices.astype(jnp.int32), depth)
+    return jnp.moveaxis(r, -1, axis) if axis != -1 else r
 
 
 @register_op("shape_of")

@@ -80,9 +80,13 @@ class TrainingConfig:
 class History:
     """Reference ``org.nd4j.autodiff.listeners.records.History`` (thin).
 
-    Losses accumulate as device scalars and materialize to floats on first
-    read — a per-step ``float()`` would force a full host sync per
-    iteration (~100ms on the axon tunnel)."""
+    Losses accumulate as device scalars and materialize to floats on read
+    — a per-step ``float()`` would force a full host sync per iteration
+    (~100ms on the axon tunnel). The pending list self-flushes past
+    ``_FLUSH_AT`` so a long unobserved run doesn't pin one device buffer
+    per step (one stacked transfer, not a sync per scalar)."""
+
+    _FLUSH_AT = 512
 
     def __init__(self):
         self._pending: list = []
@@ -90,12 +94,18 @@ class History:
 
     def append(self, loss):
         self._pending.append(loss)
+        if len(self._pending) >= self._FLUSH_AT:
+            self._flush()
+
+    def _flush(self):
+        if self._pending:
+            self._curve.extend(
+                np.asarray(jnp.stack(self._pending)).tolist())
+            self._pending.clear()
 
     @property
     def loss_curve(self) -> list[float]:
-        if self._pending:
-            self._curve.extend(float(v) for v in self._pending)
-            self._pending.clear()
+        self._flush()
         return self._curve
 
 

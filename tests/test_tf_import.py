@@ -332,3 +332,204 @@ def test_imported_graph_serializes(tmp_path, rng):
     sd2 = sd_load(path)
     after = np.asarray(sd2.output({"input": x}, "out")["out"])
     np.testing.assert_allclose(before, after, rtol=1e-6)
+
+
+# --------------------------------------------------------------------------
+# BERT-class op surface: gather/batchmatmul/stridedslice/split/onehot/...
+# --------------------------------------------------------------------------
+
+def _run(g, feeds, out):
+    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    return np.asarray(sd.output(feeds, out)[out])
+
+
+def test_import_gather_and_onehot(rng):
+    table = rng.normal(size=(10, 6)).astype(np.float32)
+    g = pb.GraphDef()
+    _const(g, "table", table)
+    _const(g, "ids", np.asarray([1, 7, 3], np.int32))
+    _const(g, "axis", np.asarray(0, np.int32))
+    _node(g, "emb", "GatherV2", "table", "ids", "axis")
+    _const(g, "depth", np.asarray(5, np.int32))
+    _const(g, "on", np.asarray(2.0, np.float32))
+    _const(g, "off", np.asarray(-1.0, np.float32))
+    _node(g, "oh", "OneHot", "ids", "depth", "on", "off")
+    got = _run(g, {}, "emb")
+    np.testing.assert_allclose(got, table[[1, 7, 3]], rtol=1e-5)
+    oh = _run(g, {}, "oh")
+    want = np.full((3, 5), -1.0, np.float32)
+    for r, c in enumerate([1, 7, 3]):
+        if c < 5:
+            want[r, c] = 2.0
+    np.testing.assert_allclose(oh, want, rtol=1e-5)
+
+
+def test_import_batchmatmul_select_cast(rng):
+    a = rng.normal(size=(2, 3, 4)).astype(np.float32)
+    b = rng.normal(size=(2, 4, 5)).astype(np.float32)
+    g = pb.GraphDef()
+    _const(g, "a", a)
+    _const(g, "b", b)
+    _node(g, "mm", "BatchMatMulV2", "a", "b", adj_x=False, adj_y=False)
+    got = _run(g, {}, "mm")
+    np.testing.assert_allclose(got, a @ b, rtol=1e-4, atol=1e-5)
+
+    g2 = pb.GraphDef()
+    _const(g2, "x", np.asarray([1.0, -2.0, 3.0], np.float32))
+    _const(g2, "y", np.asarray([10.0, 20.0, 30.0], np.float32))
+    _const(g2, "zero", np.asarray([0.0, 0.0, 0.0], np.float32))
+    _node(g2, "c", "Greater", "x", "zero")
+    _node(g2, "sel", "SelectV2", "c", "x", "y")
+    cast = _node(g2, "i", "Cast", "sel")
+    cast.attr["DstT"].type = pb.DT_INT32
+    np.testing.assert_array_equal(_run(g2, {}, "sel"), [1.0, 20.0, 3.0])
+    out = _run(g2, {}, "i")
+    assert out.dtype == np.int32
+    np.testing.assert_array_equal(out, [1, 20, 3])
+
+
+def test_import_split_unpack_multi_output(rng):
+    x = rng.normal(size=(4, 6)).astype(np.float32)
+    g = pb.GraphDef()
+    _const(g, "x", x)
+    _const(g, "axis", np.asarray(1, np.int32))
+    _node(g, "sp", "Split", "axis", "x", num_split=3)
+    # consume outputs 0 and 2
+    _node(g, "s02", "Add", "sp", "sp:2")
+    got = _run(g, {}, "s02")
+    np.testing.assert_allclose(got, x[:, 0:2] + x[:, 4:6], rtol=1e-5)
+
+    g2 = pb.GraphDef()
+    _const(g2, "x", x)
+    _node(g2, "u", "Unpack", "x", num=4, axis=0)
+    _node(g2, "last2", "Sub", "u:3", "u:1")
+    got = _run(g2, {}, "last2")
+    np.testing.assert_allclose(got, x[3] - x[1], rtol=1e-5)
+
+
+def test_import_stridedslice_slice_tile_range(rng):
+    x = rng.normal(size=(5, 8)).astype(np.float32)
+    g = pb.GraphDef()
+    _const(g, "x", x)
+    _const(g, "b", np.asarray([1, 2], np.int32))
+    _const(g, "e", np.asarray([4, 8], np.int32))
+    _const(g, "s", np.asarray([1, 2], np.int32))
+    _node(g, "ss", "StridedSlice", "x", "b", "e", "s",
+          begin_mask=0, end_mask=0, ellipsis_mask=0, new_axis_mask=0,
+          shrink_axis_mask=0)
+    np.testing.assert_allclose(_run(g, {}, "ss"), x[1:4, 2:8:2], rtol=1e-5)
+
+    # shrink_axis on dim 0 -> x[2, :3]
+    g2 = pb.GraphDef()
+    _const(g2, "x", x)
+    _const(g2, "b", np.asarray([2, 0], np.int32))
+    _const(g2, "e", np.asarray([3, 3], np.int32))
+    _const(g2, "s", np.asarray([1, 1], np.int32))
+    _node(g2, "row", "StridedSlice", "x", "b", "e", "s",
+          shrink_axis_mask=1)
+    np.testing.assert_allclose(_run(g2, {}, "row"), x[2, :3], rtol=1e-5)
+
+    g3 = pb.GraphDef()
+    _const(g3, "x", x)
+    _const(g3, "b", np.asarray([1, 0], np.int32))
+    _const(g3, "sz", np.asarray([2, -1], np.int32))
+    _node(g3, "sl", "Slice", "x", "b", "sz")
+    np.testing.assert_allclose(_run(g3, {}, "sl"), x[1:3, :], rtol=1e-5)
+
+    g4 = pb.GraphDef()
+    _const(g4, "x", np.asarray([[1.0, 2.0]], np.float32))
+    _const(g4, "reps", np.asarray([2, 3], np.int32))
+    _node(g4, "t", "Tile", "x", "reps")
+    np.testing.assert_allclose(_run(g4, {}, "t"),
+                               np.tile([[1.0, 2.0]], (2, 3)))
+
+    g5 = pb.GraphDef()
+    _const(g5, "st", np.asarray(0, np.int32))
+    _const(g5, "li", np.asarray(6, np.int32))
+    _const(g5, "d", np.asarray(2, np.int32))
+    _node(g5, "r", "Range", "st", "li", "d")
+    _const(g5, "dims", np.asarray([2, 2], np.int32))
+    _const(g5, "val", np.asarray(7.0, np.float32))
+    _node(g5, "f", "Fill", "dims", "val")
+    np.testing.assert_array_equal(_run(g5, {}, "r"), [0, 2, 4])
+    np.testing.assert_allclose(_run(g5, {}, "f"), np.full((2, 2), 7.0))
+
+
+def test_import_attention_block_end_to_end(rng):
+    """Mini self-attention built the way BERT frozen graphs express it:
+    batched matmuls, scale, softmax, strided slicing."""
+    B, T, D = 2, 4, 8
+    x = rng.normal(size=(B, T, D)).astype(np.float32)
+    wq = rng.normal(size=(D, D), scale=0.3).astype(np.float32)
+    wk = rng.normal(size=(D, D), scale=0.3).astype(np.float32)
+    g = pb.GraphDef()
+    _placeholder(g, "x", (0, T, D))
+    _const(g, "wq", wq)
+    _const(g, "wk", wk)
+    _const(g, "scale", np.asarray(1.0 / np.sqrt(D), np.float32))
+    _node(g, "q", "BatchMatMulV2", "x", "wq")
+    _node(g, "k", "BatchMatMulV2", "x", "wk")
+    _node(g, "scores", "BatchMatMulV2", "q", "k", adj_y=True)
+    _node(g, "scaled", "Mul", "scores", "scale")
+    _node(g, "probs", "Softmax", "scaled")
+    _node(g, "ctx", "BatchMatMulV2", "probs", "x")
+    got = _run(g, {"x": x}, "ctx")
+    q, k = x @ wq, x @ wk
+    s = (q @ k.transpose(0, 2, 1)) / np.sqrt(D)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, p @ x, rtol=1e-4, atol=1e-5)
+
+
+def test_import_edge_semantics(rng):
+    # SplitV with an inferred -1 size
+    x = rng.normal(size=(4, 6)).astype(np.float32)
+    g = pb.GraphDef()
+    _const(g, "x", x)
+    _const(g, "sizes", np.asarray([-1, 2], np.int32))
+    _const(g, "axis", np.asarray(1, np.int32))
+    _node(g, "sp", "SplitV", "x", "sizes", "axis", num_split=2)
+    _const(g, "zero", np.zeros((4, 4), np.float32))
+    _node(g, "first", "Add", "sp", "zero")
+    got = _run(g, {}, "first")
+    np.testing.assert_allclose(got, x[:, :4], rtol=1e-5)
+
+    # float Range
+    g2 = pb.GraphDef()
+    _const(g2, "st", np.asarray(0.0, np.float32))
+    _const(g2, "li", np.asarray(1.0, np.float32))
+    _const(g2, "d", np.asarray(0.25, np.float32))
+    _node(g2, "r", "Range", "st", "li", "d")
+    np.testing.assert_allclose(_run(g2, {}, "r"), [0.0, 0.25, 0.5, 0.75])
+
+    # Select (v1) with rank-1 cond row-selects
+    g3 = pb.GraphDef()
+    _const(g3, "c", np.asarray([1.0, 0.0], np.float32))
+    _const(g3, "zero", np.asarray([0.0, 0.0], np.float32))
+    _const(g3, "a", np.asarray([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]],
+                               np.float32))
+    _const(g3, "b", np.asarray([[9.0, 9.0, 9.0], [8.0, 8.0, 8.0]],
+                               np.float32))
+    _node(g3, "cb", "Greater", "c", "zero")
+    _node(g3, "sel", "Select", "cb", "a", "b")
+    np.testing.assert_allclose(_run(g3, {}, "sel"),
+                               [[1.0, 2.0, 3.0], [8.0, 8.0, 8.0]])
+
+    # OneHot axis=0
+    g4 = pb.GraphDef()
+    _const(g4, "ids", np.asarray([1, 0, 2], np.int32))
+    _const(g4, "depth", np.asarray(3, np.int32))
+    _const(g4, "on", np.asarray(1.0, np.float32))
+    _const(g4, "off", np.asarray(0.0, np.float32))
+    oh = _node(g4, "oh", "OneHot", "ids", "depth", "on", "off")
+    oh.attr["axis"].i = 0
+    got = _run(g4, {}, "oh")
+    assert got.shape == (3, 3)
+    np.testing.assert_allclose(got, np.eye(3)[[1, 0, 2]].T)
+
+    # LeakyRelu with explicit alpha=0.0 behaves as Relu
+    g5 = pb.GraphDef()
+    _const(g5, "x", np.asarray([-2.0, 3.0], np.float32))
+    lr = _node(g5, "y", "LeakyRelu", "x")
+    lr.attr["alpha"].f = 0.0
+    np.testing.assert_allclose(_run(g5, {}, "y"), [0.0, 3.0])
