@@ -179,10 +179,16 @@ class _Mapper:
 
     def _bind_multi(self, node, vars_: list):
         """Multi-output node: output i is referenced as 'name:i' (output 0
-        also as the bare name)."""
-        self.names[node.name] = vars_[0].name
+        also as the bare name). Like ``_bind``, outputs take the TF names
+        when free so ``sd.output(..., 'name')``/'name:i' work."""
         for i, v in enumerate(vars_):
-            self.names[f"{node.name}:{i}"] = v.name
+            tf_name = node.name if i == 0 else f"{node.name}:{i}"
+            if tf_name not in self.sd.variables:
+                self.sd.rename_variable(v.name, tf_name)
+                self.names[tf_name] = tf_name
+            else:
+                self.names[tf_name] = v.name
+        self.names[f"{node.name}:0"] = self.names[node.name]
 
     # -- main ----------------------------------------------------------------
     def run(self) -> SameDiff:
@@ -355,15 +361,21 @@ class _Mapper:
             self._bind(node, v)
         elif op == "OneHot":
             depth = int(self._static(ins[1], node))
-            on = float(self._static(ins[2], node)) if len(ins) > 2 else 1.0
-            off = float(self._static(ins[3], node)) if len(ins) > 3 else 0.0
+            on_arr = (self._static(ins[2], node) if len(ins) > 2
+                      else np.float32(1.0))
+            off_arr = (self._static(ins[3], node) if len(ins) > 3
+                       else np.float32(0.0))
+            on, off = float(on_arr), float(off_arr)
             # proto3 default for a missing axis attr is 0, but TF's
             # default is -1 — only honor the attr when present
             axis = int(node.attr["axis"].i) if "axis" in node.attr else -1
+            dtype = np.result_type(on_arr, off_arr).name  # TF: T of on/off
             v = sd._op("one_hot", [self._var(ins[0])], depth=depth,
-                       axis=axis)[0]
+                       axis=axis, dtype=dtype)[0]
             if (on, off) != (1.0, 0.0):
-                v = v * (on - off) + off
+                on_c = sd.constant(np.asarray(on_arr))
+                off_c = sd.constant(np.asarray(off_arr))
+                v = v * (on_c - off_c) + off_c
             self._bind(node, v)
         elif op == "Split":
             axis = int(self._static(ins[0], node))
@@ -414,6 +426,11 @@ class _Mapper:
                        strides=(1,) * len(begin), end_mask=end_mask)[0]
             self._bind(node, v)
         elif op == "StridedSlice":
+            if (node.attr["ellipsis_mask"].i
+                    or node.attr["new_axis_mask"].i):
+                raise UnsupportedTFOpException(
+                    f"{node.name}: StridedSlice ellipsis_mask/"
+                    "new_axis_mask not supported")
             begin = tuple(int(b) for b in self._static(ins[1], node))
             end = tuple(int(e) for e in self._static(ins[2], node))
             strides = tuple(int(s) for s in self._static(ins[3], node))

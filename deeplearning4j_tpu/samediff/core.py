@@ -947,8 +947,8 @@ def _op_gather(x, indices, *, axis):
 
 
 @register_op("one_hot")
-def _op_one_hot(indices, *, depth, axis=-1):
-    r = jax.nn.one_hot(indices.astype(jnp.int32), depth)
+def _op_one_hot(indices, *, depth, axis=-1, dtype="float32"):
+    r = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=dtype)
     return jnp.moveaxis(r, -1, axis) if axis != -1 else r
 
 

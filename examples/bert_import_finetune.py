@@ -1,0 +1,44 @@
+"""BERT-style frozen-graph import + fine-tune (BASELINE config #5).
+
+Builds a small transformer-encoder GraphDef the way TF freezes BERT
+(Gather embeddings, BatchMatMul attention, decomposed-Erf GELU, layernorm
+from Mean/SquaredDifference/Rsqrt, StridedSlice CLS pooler), imports it
+with TFGraphMapper, converts the head + attention weights to trainables,
+and fine-tunes with ``sd.fit`` — the reference's
+``importGraph`` -> ``convertToVariable`` -> ``fit`` flow.
+"""
+import sys
+sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parents[1] / "tests"))
+from test_tf_import import _build_mini_bert  # fixture builder doubles as demo
+
+from deeplearning4j_tpu.conf.updaters import Adam
+from deeplearning4j_tpu.imports.tf import TFGraphMapper
+from deeplearning4j_tpu.samediff import TrainingConfig
+from deeplearning4j_tpu.samediff.core import SDVariable
+
+rng = np.random.default_rng(0)
+graph, _ = _build_mini_bert(rng)
+sd = TFGraphMapper.import_graph(graph.SerializeToString())
+print(f"imported: {len(sd.ops)} ops, {len(sd.variables)} variables")
+
+for name in ("w_cls", "b_cls", "wq", "wk", "wv", "wo"):
+    SDVariable(sd, name).convert_to_variable()
+labels = sd.placeholder("labels", shape=(None, 3))
+sd.loss.softmaxCrossEntropy(labels, SDVariable(sd, "logits"), name="loss")
+sd.set_training_config(TrainingConfig.builder()
+                       .updater(Adam(learning_rate=0.01))
+                       .data_set_feature_mapping("ids")
+                       .data_set_label_mapping("labels").build())
+
+ids = rng.integers(0, 50, (64, 8)).astype(np.int32)
+y = np.eye(3, dtype=np.float32)[ids.sum(1) % 3]
+hist = None
+for epoch in range(40):
+    hist = sd.fit(features=ids, labels=y)
+print("fine-tune loss:", hist.loss_curve[-1])
+preds = np.asarray(sd.output({"ids": ids}, "logits")["logits"]).argmax(1)
+print("train accuracy:", (preds == ids.sum(1) % 3).mean())

@@ -2,6 +2,8 @@
 suite, SURVEY.md §4 — goldens are numpy-math oracles since no TF exists in
 this env; graphs are built with the vendored wire-compatible protos)."""
 
+import math as _math
+
 import numpy as np
 import pytest
 
@@ -533,3 +535,201 @@ def test_import_edge_semantics(rng):
     lr = _node(g5, "y", "LeakyRelu", "x")
     lr.attr["alpha"].f = 0.0
     np.testing.assert_allclose(_run(g5, {}, "y"), [0.0, 3.0])
+
+
+# --------------------------------------------------------------------------
+# BASELINE config #5 (stretch): BERT-style encoder import + fine-tune
+# --------------------------------------------------------------------------
+
+def _int_placeholder(g, name, shape):
+    n = g.node.add()
+    n.name = name
+    n.op = "Placeholder"
+    n.attr["dtype"].type = pb.DT_INT32
+    sh = n.attr["shape"].shape
+    for d in shape:
+        sh.dim.add().size = d if d else -1
+    return n
+
+
+def _layernorm(g, prefix, x, gamma, beta, axm1):
+    _node(g, f"{prefix}_mu", "Mean", x, axm1, keep_dims=True)
+    _node(g, f"{prefix}_sqd", "SquaredDifference", x, f"{prefix}_mu")
+    _node(g, f"{prefix}_var", "Mean", f"{prefix}_sqd", axm1, keep_dims=True)
+    _node(g, f"{prefix}_vare", "Add", f"{prefix}_var", "ln_eps")
+    _node(g, f"{prefix}_rstd", "Rsqrt", f"{prefix}_vare")
+    _node(g, f"{prefix}_cen", "Sub", x, f"{prefix}_mu")
+    _node(g, f"{prefix}_nrm", "Mul", f"{prefix}_cen", f"{prefix}_rstd")
+    _node(g, f"{prefix}_scl", "Mul", f"{prefix}_nrm", gamma)
+    _node(g, f"{prefix}_out", "Add", f"{prefix}_scl", beta)
+    return f"{prefix}_out"
+
+
+def _np_layernorm(x, gamma, beta, eps=1e-6):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + eps) * gamma + beta
+
+
+def _build_mini_bert(rng, V=50, T=8, D=16, H=2, C=3):
+    """-> (GraphDef, weights dict) for a 1-layer BERT-style encoder with
+    embeddings, MHA, GELU FFN, layernorms, CLS pooler + classifier —
+    expressed the way TF frozen graphs decompose it."""
+    hd = D // H
+    w = {
+        "emb": rng.normal(size=(V, D), scale=0.5).astype(np.float32),
+        "pos": rng.normal(size=(T, D), scale=0.1).astype(np.float32),
+        "wq": rng.normal(size=(D, D), scale=0.2).astype(np.float32),
+        "wk": rng.normal(size=(D, D), scale=0.2).astype(np.float32),
+        "wv": rng.normal(size=(D, D), scale=0.2).astype(np.float32),
+        "wo": rng.normal(size=(D, D), scale=0.2).astype(np.float32),
+        "g1": np.ones(D, np.float32), "b1": np.zeros(D, np.float32),
+        "w_ff1": rng.normal(size=(D, 4 * D), scale=0.2).astype(np.float32),
+        "w_ff2": rng.normal(size=(4 * D, D), scale=0.2).astype(np.float32),
+        "g2": np.ones(D, np.float32), "b2": np.zeros(D, np.float32),
+        "w_cls": rng.normal(size=(D, C), scale=0.2).astype(np.float32),
+        "b_cls": np.zeros(C, np.float32),
+    }
+    g = pb.GraphDef()
+    _int_placeholder(g, "ids", (0, T))
+    for k, v in w.items():
+        _const(g, k, v)
+    _const(g, "axis0", np.asarray(0, np.int32))
+    _const(g, "axm1", np.asarray([-1], np.int32))
+    _const(g, "ln_eps", np.asarray(1e-6, np.float32))
+    _const(g, "half", np.asarray(0.5, np.float32))
+    _const(g, "one", np.asarray(1.0, np.float32))
+    _const(g, "sqrt2", np.asarray(np.sqrt(2.0), np.float32))
+    _const(g, "scale", np.asarray(1.0 / np.sqrt(hd), np.float32))
+    _const(g, "shape_heads", np.asarray([-1, T, H, hd], np.int32))
+    _const(g, "shape_flat", np.asarray([-1, T, D], np.int32))
+    _const(g, "perm_heads", np.asarray([0, 2, 1, 3], np.int32))
+
+    _node(g, "x0", "GatherV2", "emb", "ids", "axis0")
+    _node(g, "x", "Add", "x0", "pos")
+    # --- attention
+    for nm in ("q", "k", "v"):
+        _node(g, f"{nm}p", "BatchMatMulV2", "x", f"w{nm}")
+        _node(g, f"{nm}h0", "Reshape", f"{nm}p", "shape_heads")
+        _node(g, f"{nm}h", "Transpose", f"{nm}h0", "perm_heads")
+    _node(g, "scores", "BatchMatMulV2", "qh", "kh", adj_y=True)
+    _node(g, "scaled", "Mul", "scores", "scale")
+    _node(g, "probs", "Softmax", "scaled")
+    _node(g, "ctx0", "BatchMatMulV2", "probs", "vh")
+    _node(g, "ctx1", "Transpose", "ctx0", "perm_heads")
+    _node(g, "ctx2", "Reshape", "ctx1", "shape_flat")
+    _node(g, "attn", "BatchMatMulV2", "ctx2", "wo")
+    _node(g, "res1", "Add", "x", "attn")
+    ln1 = _layernorm(g, "ln1", "res1", "g1", "b1", "axm1")
+    # --- FFN with decomposed GELU
+    _node(g, "ff1", "BatchMatMulV2", ln1, "w_ff1")
+    _node(g, "gdiv", "RealDiv", "ff1", "sqrt2")
+    _node(g, "gerf", "Erf", "gdiv")
+    _node(g, "g1p", "Add", "gerf", "one")
+    _node(g, "gmul", "Mul", "ff1", "g1p")
+    _node(g, "gelu", "Mul", "gmul", "half")
+    _node(g, "ff2", "BatchMatMulV2", "gelu", "w_ff2")
+    _node(g, "res2", "Add", ln1, "ff2")
+    ln2 = _layernorm(g, "ln2", "res2", "g2", "b2", "axm1")
+    # --- CLS pooler + classifier
+    _const(g, "ss_b", np.asarray([0, 0, 0], np.int32))
+    _const(g, "ss_e", np.asarray([0, 1, 0], np.int32))
+    _const(g, "ss_s", np.asarray([1, 1, 1], np.int32))
+    _node(g, "cls", "StridedSlice", ln2, "ss_b", "ss_e", "ss_s",
+          begin_mask=0b101, end_mask=0b101, shrink_axis_mask=0b010)
+    _node(g, "logits0", "MatMul", "cls", "w_cls",
+          transpose_a=False, transpose_b=False)
+    _node(g, "logits", "BiasAdd", "logits0", "b_cls")
+    return g, w
+
+
+def _np_mini_bert(ids, w, T=8, D=16, H=2):
+    hd = D // H
+    x = w["emb"][ids] + w["pos"]
+    B = x.shape[0]
+
+    def heads(m):
+        return m.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
+
+    q, k, v = (heads(x @ w[f"w{n}"]) for n in "qkv")
+    s = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(hd)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ctx = (p @ v).transpose(0, 2, 1, 3).reshape(B, T, D)
+    x1 = _np_layernorm(x + ctx @ w["wo"], w["g1"], w["b1"])
+    h = x1 @ w["w_ff1"]
+    gelu = 0.5 * h * (1.0 + np.vectorize(_math.erf)(h / np.sqrt(2.0)))
+    x2 = _np_layernorm(x1 + gelu @ w["w_ff2"], w["g2"], w["b2"])
+    return x2[:, 0, :] @ w["w_cls"] + w["b_cls"]
+
+
+def test_import_mini_bert_matches_oracle(rng):
+    g, w = _build_mini_bert(rng)
+    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    ids = rng.integers(0, 50, (4, 8)).astype(np.int32)
+    got = np.asarray(sd.output({"ids": ids}, "logits")["logits"])
+    want = _np_mini_bert(ids, w)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_imported_bert_fine_tunes(rng):
+    """The reference's BERT fine-tune flow (BASELINE config #5): import a
+    frozen graph, convert_to_variable the head, train with sd.fit."""
+    from deeplearning4j_tpu.conf.updaters import Adam
+    from deeplearning4j_tpu.samediff import TrainingConfig
+    from deeplearning4j_tpu.samediff.core import SDVariable
+
+    g, w = _build_mini_bert(rng)
+    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    for name in ("w_cls", "b_cls", "wq", "wk", "wv", "wo"):
+        SDVariable(sd, name).convert_to_variable()
+    labels = sd.placeholder("labels", shape=(None, 3))
+    logits = SDVariable(sd, "logits")
+    sd.loss.softmaxCrossEntropy(labels, logits, name="loss")
+    sd.set_training_config(TrainingConfig.builder()
+                           .updater(Adam(learning_rate=0.01))
+                           .data_set_feature_mapping("ids")
+                           .data_set_label_mapping("labels").build())
+    ids = rng.integers(0, 50, (32, 8)).astype(np.int32)
+    cls = (ids.sum(1) % 3)
+    y = np.eye(3, dtype=np.float32)[cls]
+    first = None
+    for _ in range(30):
+        hist = sd.fit(features=ids, labels=y)
+        if first is None:
+            first = hist.loss_curve[-1]
+    assert hist.loss_curve[-1] < first
+
+
+def test_multi_output_addressable_and_import_time_errors(rng):
+    x = rng.normal(size=(4, 6)).astype(np.float32)
+    g = pb.GraphDef()
+    _const(g, "x", x)
+    _node(g, "u", "Unpack", "x", num=4, axis=0)
+    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    np.testing.assert_allclose(np.asarray(sd.output({}, "u")["u"]), x[0],
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(sd.output({}, "u:2")["u:2"]),
+                               x[2], rtol=1e-5)
+
+    # ellipsis_mask rejected AT IMPORT with the node named
+    g2 = pb.GraphDef()
+    _const(g2, "x", x)
+    _const(g2, "b", np.asarray([0], np.int32))
+    _const(g2, "e", np.asarray([1], np.int32))
+    _const(g2, "s", np.asarray([1], np.int32))
+    _node(g2, "ss", "StridedSlice", "x", "b", "e", "s", ellipsis_mask=1)
+    with pytest.raises(UnsupportedTFOpException, match="ss"):
+        TFGraphMapper.import_graph(g2.SerializeToString())
+
+    # int OneHot keeps its dtype
+    g3 = pb.GraphDef()
+    _const(g3, "ids", np.asarray([0, 2], np.int32))
+    _const(g3, "depth", np.asarray(3, np.int32))
+    _const(g3, "on", np.asarray(1, np.int32))
+    _const(g3, "off", np.asarray(0, np.int32))
+    _node(g3, "oh", "OneHot", "ids", "depth", "on", "off")
+    out = np.asarray(TFGraphMapper.import_graph(
+        g3.SerializeToString()).output({}, "oh")["oh"])
+    assert out.dtype == np.int32
+    np.testing.assert_array_equal(out, np.eye(3, dtype=np.int32)[[0, 2]])
