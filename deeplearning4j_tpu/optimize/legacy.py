@@ -1,0 +1,231 @@
+"""Legacy full-batch solvers (reference ``org.deeplearning4j.optimize.
+solvers``: ``LineGradientDescent``, ``ConjugateGradient``, ``LBFGS`` —
+SURVEY.md §2.2 "Solver/optimizers (DL4J level)").
+
+TPU-native design: each solver's ENTIRE optimize loop — search direction,
+backtracking (Armijo) line search, L-BFGS two-loop recursion over
+fixed-size circular history buffers — is one ``lax.while_loop`` compiled
+around the model's full-batch loss-of-flat-params function. The reference
+iterates these in Java with one JNI round-trip per op; here the loop never
+leaves the device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.util import params as params_util
+
+
+def _flat_loss_fn(net, ds):
+    """-> (pure f(flat)->loss, flat0) for either network class.
+
+    The loss is evaluated in EVAL mode (train=False): line searches need a
+    deterministic objective (dropout would break the Armijo condition) and
+    BatchNorm must use the same running stats the final ``score``/
+    ``output`` will — optimizing batch stats while never updating running
+    stats would report a loss the saved model can't reproduce."""
+    if hasattr(net, "_batch_arrays"):        # MultiLayerNetwork
+        features, labels, fmask, lmask = net._batch_arrays(ds)
+        conf, like, state = net.conf, net.params, net.state
+
+        def f(flat):
+            p = params_util.unflatten_params(conf, flat, like)
+            loss, _ = net._loss(p, state, features, labels, fmask, lmask,
+                                None, train=False)
+            return loss
+    else:                                     # ComputationGraph
+        features, labels, lmasks = net._prep_batch(ds)
+        conf, like, state = net.conf, net.params, net.state
+
+        def f(flat):
+            p = params_util.unflatten_params(conf, flat, like)
+            loss, _ = net._loss(p, state, features, labels, lmasks,
+                                rng=None, train=False)
+            return loss
+    return f, jnp.asarray(net.params_flat())
+
+
+def _line_search(f, x, d, loss, g, step0, c1=1e-4, max_halvings=20):
+    """Backtracking Armijo search along ``d`` (reference
+    ``BackTrackLineSearch``). Returns (alpha, new_loss)."""
+    slope = jnp.vdot(g, d)
+
+    def cond(st):
+        alpha, cur, halvings = st
+        return jnp.logical_and(halvings < max_halvings,
+                               cur > loss + c1 * alpha * slope)
+
+    def body(st):
+        alpha, _, halvings = st
+        alpha = alpha * 0.5
+        return alpha, f(x + alpha * d), halvings + 1
+
+    alpha0 = jnp.asarray(step0, x.dtype)
+    alpha, new_loss, _ = jax.lax.while_loop(
+        cond, body, (alpha0, f(x + alpha0 * d), jnp.asarray(0)))
+    # a failed search (still above the Armijo bound) must not move uphill
+    take = new_loss <= loss
+    return jnp.where(take, alpha, 0.0), jnp.where(take, new_loss, loss)
+
+
+@dataclasses.dataclass
+class _BaseLegacySolver:
+    """Shared optimize() driver: minimize the full-batch loss, write the
+    result back through ``set_params_flat``."""
+
+    max_iterations: int = 100
+    tolerance: float = 1e-6
+    step_size: float = 1.0
+
+    def optimize(self, net, ds):
+        f, x0 = _flat_loss_fn(net, ds)
+        x, loss = self._minimize(f, x0)
+        net.set_params_flat(np.asarray(x))
+        return float(loss)
+
+
+class LineGradientDescent(_BaseLegacySolver):
+    """Steepest descent + line search (reference class of the same name)."""
+
+    def _minimize(self, f, x0):
+        vg = jax.value_and_grad(f)
+        tol, step0 = self.tolerance, self.step_size
+
+        def cond(st):
+            k, _, _, g, done = st
+            return jnp.logical_and(
+                jnp.logical_and(k < self.max_iterations, ~done),
+                jnp.linalg.norm(g) > tol)
+
+        def body(st):
+            k, x, loss, g, _ = st
+            alpha, new_loss = _line_search(f, x, -g, loss, g, step0)
+            x2 = x - alpha * g
+            _, g2 = vg(x2)
+            done = jnp.abs(loss - new_loss) < tol
+            return k + 1, x2, new_loss, g2, done
+
+        loss0, g0 = vg(x0)
+        _, x, loss, _, _ = jax.jit(lambda s: jax.lax.while_loop(
+            cond, body, s))((jnp.asarray(0), x0, loss0, g0,
+                             jnp.asarray(False)))
+        return x, loss
+
+
+class ConjugateGradient(_BaseLegacySolver):
+    """Polak-Ribiere nonlinear CG with automatic restart (reference class
+    of the same name)."""
+
+    def _minimize(self, f, x0):
+        vg = jax.value_and_grad(f)
+        tol, step0 = self.tolerance, self.step_size
+
+        def cond(st):
+            k, _, _, g, _, done = st
+            return jnp.logical_and(
+                jnp.logical_and(k < self.max_iterations, ~done),
+                jnp.linalg.norm(g) > tol)
+
+        def body(st):
+            k, x, loss, g, d, _ = st
+            alpha, new_loss = _line_search(f, x, d, loss, g, step0)
+            x2 = x + alpha * d
+            _, g2 = vg(x2)
+            beta = jnp.maximum(
+                jnp.vdot(g2, g2 - g) / jnp.maximum(jnp.vdot(g, g), 1e-30),
+                0.0)  # PR+ : restart (beta=0) when the curvature turns
+            d2 = -g2 + beta * d
+            # a non-descent direction falls back to steepest descent
+            d2 = jnp.where(jnp.vdot(d2, g2) < 0, d2, -g2)
+            done = jnp.abs(loss - new_loss) < tol
+            return k + 1, x2, new_loss, g2, d2, done
+
+        loss0, g0 = vg(x0)
+        _, x, loss, _, _, _ = jax.jit(lambda s: jax.lax.while_loop(
+            cond, body, s))((jnp.asarray(0), x0, loss0, g0, -g0,
+                             jnp.asarray(False)))
+        return x, loss
+
+
+class LBFGS(_BaseLegacySolver):
+    """Limited-memory BFGS (reference class of the same name). History of
+    ``m`` (s, y) pairs in circular device buffers; the two-loop recursion
+    runs as ``fori_loop`` passes inside the compiled solver loop."""
+
+    m: int = 10
+
+    def __init__(self, max_iterations: int = 100, tolerance: float = 1e-6,
+                 step_size: float = 1.0, m: int = 10):
+        super().__init__(max_iterations, tolerance, step_size)
+        self.m = int(m)
+
+    def _minimize(self, f, x0):
+        vg = jax.value_and_grad(f)
+        n = x0.shape[0]
+        m, tol, step0 = self.m, self.tolerance, self.step_size
+
+        def direction(g, S, Y, rho, k):
+            """Two-loop recursion; entries >= k (not yet written) have
+            rho=0 and contribute nothing."""
+            q = g
+
+            def bwd(i, carry):
+                q, alphas = carry
+                idx = (k - 1 - i) % m
+                a = rho[idx] * jnp.vdot(S[idx], q)
+                a = jnp.where(i < jnp.minimum(k, m), a, 0.0)
+                return q - a * Y[idx], alphas.at[idx].set(a)
+
+            q, alphas = jax.lax.fori_loop(
+                0, m, bwd, (q, jnp.zeros((m,), x0.dtype)))
+            # initial Hessian scaling gamma = s.y / y.y of the newest pair
+            newest = (k - 1) % m
+            sy = jnp.vdot(S[newest], Y[newest])
+            yy = jnp.vdot(Y[newest], Y[newest])
+            gamma = jnp.where(k > 0, sy / jnp.maximum(yy, 1e-30), 1.0)
+            r = gamma * q
+
+            def fwd(i, r):
+                idx = (k - jnp.minimum(k, m) + i) % m
+                b = rho[idx] * jnp.vdot(Y[idx], r)
+                upd = S[idx] * (alphas[idx] - b)
+                return r + jnp.where(i < jnp.minimum(k, m), upd, 0.0)
+
+            r = jax.lax.fori_loop(0, m, fwd, r)
+            return -r
+
+        def cond(st):
+            k, _, _, g, _, _, _, done = st
+            return jnp.logical_and(
+                jnp.logical_and(k < self.max_iterations, ~done),
+                jnp.linalg.norm(g) > tol)
+
+        def body(st):
+            k, x, loss, g, S, Y, rho, _ = st
+            d = direction(g, S, Y, rho, k)
+            d = jnp.where(jnp.vdot(d, g) < 0, d, -g)
+            alpha, new_loss = _line_search(f, x, d, loss, g, step0)
+            x2 = x + alpha * d
+            _, g2 = vg(x2)
+            s, y = x2 - x, g2 - g
+            sy = jnp.vdot(s, y)
+            idx = k % m
+            ok = sy > 1e-10  # curvature condition; else skip the pair
+            S = jnp.where(ok, S.at[idx].set(s), S)
+            Y = jnp.where(ok, Y.at[idx].set(y), Y)
+            rho = jnp.where(ok, rho.at[idx].set(1.0 / sy), rho)
+            done = jnp.abs(loss - new_loss) < tol
+            return k + 1, x2, new_loss, g2, S, Y, rho, done
+
+        loss0, g0 = vg(x0)
+        st0 = (jnp.asarray(0), x0, loss0, g0,
+               jnp.zeros((m, n), x0.dtype), jnp.zeros((m, n), x0.dtype),
+               jnp.zeros((m,), x0.dtype), jnp.asarray(False))
+        _, x, loss, _, _, _, _, _ = jax.jit(
+            lambda s: jax.lax.while_loop(cond, body, s))(st0)
+        return x, loss

@@ -5,14 +5,16 @@ Reference: ``deeplearning4j-ui-parent`` — ``StatsListener`` feeding a
 (SURVEY.md §5.5). TPU-native equivalent: the listener computes the same
 signature diagnostics (score, per-layer param/update mean magnitudes and
 their RATIO — DL4J's signature training health metric), storage is
-in-memory or JSONL on disk, and ``UIServer.render`` emits a self-contained
-static HTML dashboard (inline SVG charts, zero server/JS deps) instead of a
-Play/Vertx web server.
+in-memory or JSONL on disk, and ``UIServer`` serves a self-contained
+dashboard (inline SVG, zero JS deps) either statically (``render``) or
+live over HTTP (``start``), with ``RemoteUIStatsStorageRouter`` POSTing
+worker stats to a central server like the reference's remote router.
 """
 
 from deeplearning4j_tpu.ui.stats import (  # noqa: F401
     FileStatsStorage,
     InMemoryStatsStorage,
+    RemoteUIStatsStorageRouter,
     StatsListener,
     StatsStorage,
 )

@@ -133,6 +133,28 @@ class UIServer:
                 self.end_headers()
                 self.wfile.write(payload)
 
+            def do_POST(self):
+                # remote stats intake (reference RemoteUIStatsStorageRouter
+                # -> UIServer remote listening): workers POST records here
+                if self.path != "/train/post":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    record = _json.loads(self.rfile.read(length))
+                except ValueError:
+                    record = None
+                if not isinstance(record, dict):
+                    # a non-dict record would poison every later render
+                    self.send_response(400)
+                    self.end_headers()
+                    return
+                ui.remote_storage().put(record)
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
             def log_message(self, *args):
                 pass  # keep training logs clean
 
@@ -150,6 +172,26 @@ class UIServer:
             httpd.server_close()
             self._httpd = None
         return self
+
+    def remote_storage(self) -> StatsStorage:
+        """Auto-attached storage receiving POSTed records from
+        ``RemoteUIStatsStorageRouter`` clients (lock-guarded: concurrent
+        first POSTs from ThreadingHTTPServer handler threads must not race
+        the lazy init)."""
+        import threading
+
+        lock = getattr(self, "_remote_lock", None)
+        if lock is None:
+            lock = self.__dict__.setdefault("_remote_lock",
+                                            threading.Lock())
+        with lock:
+            st = getattr(self, "_remote_storage", None)
+            if st is None:
+                from deeplearning4j_tpu.ui.stats import InMemoryStatsStorage
+
+                st = self._remote_storage = InMemoryStatsStorage()
+                self.attach(st)
+        return st
 
     def render_html(self, refresh_seconds: int = 0) -> str:
         """The dashboard as an HTML string."""

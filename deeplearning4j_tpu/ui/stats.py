@@ -61,6 +61,71 @@ class FileStatsStorage(StatsStorage):
         return list(self._records)
 
 
+class RemoteUIStatsStorageRouter(StatsStorage):
+    """POSTs each record to a remote ``UIServer`` (reference class of the
+    same name: listeners on worker machines route stats to a central
+    dashboard). Delivery is ASYNC with retries, like the reference's
+    queued router: a dashboard outage must never crash or stall the
+    training loop. Records are also kept locally so ``records()`` works;
+    ``dropped`` counts records that exhausted their retries."""
+
+    def __init__(self, url: str, retries: int = 3, timeout: float = 10.0):
+        import queue
+
+        self.url = url.rstrip("/")
+        self.retries = int(retries)
+        self.timeout = float(timeout)
+        self.dropped = 0
+        self._records: List[dict] = []
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread = None
+
+    def _ensure_thread(self):
+        import threading
+
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(target=self._worker,
+                                            daemon=True)
+            self._thread.start()
+
+    def _worker(self):
+        import urllib.request
+
+        while True:
+            record = self._q.get()
+            try:
+                data = json.dumps(record).encode()
+                for attempt in range(self.retries):
+                    try:
+                        req = urllib.request.Request(
+                            self.url + "/train/post", data=data,
+                            headers={"Content-Type": "application/json"})
+                        urllib.request.urlopen(
+                            req, timeout=self.timeout).read()
+                        break
+                    except Exception:
+                        time.sleep(0.2 * (attempt + 1))
+                else:
+                    self.dropped += 1
+            finally:
+                self._q.task_done()
+
+    def put(self, record):
+        self._records.append(record)
+        self._q.put(record)
+        self._ensure_thread()
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Wait until queued records are delivered (or dropped)."""
+        deadline = time.time() + timeout
+        while self._q.unfinished_tasks and time.time() < deadline:
+            time.sleep(0.02)
+        return self._q.unfinished_tasks == 0
+
+    def records(self):
+        return list(self._records)
+
+
 def _mean_magnitude(tree) -> Dict[str, float]:
     out = {}
     for layer_idx, params in (tree or {}).items():

@@ -9,7 +9,7 @@ import pytest
 
 from deeplearning4j_tpu.conf import Activation, InputType, WeightInit
 from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
-from deeplearning4j_tpu.conf.losses import LossMCXENT
+from deeplearning4j_tpu.conf.losses import LossMCXENT, LossMSE
 from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
 from deeplearning4j_tpu.conf.updaters import Adam, Sgd
 from deeplearning4j_tpu.datasets.dataset import DataSet
@@ -424,3 +424,117 @@ def test_ui_server_live_http(tmp_path):
     finally:
         ui.stop()
         UIServer.get_instance().detach(storage)
+
+
+# --------------------------------------------------------------------------
+# legacy full-batch solvers (LineGradientDescent / ConjugateGradient / LBFGS)
+# --------------------------------------------------------------------------
+
+def _solver_net_and_data(seed=7):
+    rng = np.random.default_rng(seed)
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_out=8, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=1, activation=Activation.IDENTITY,
+                               loss_fn=LossMSE()))
+            .set_input_type(InputType.feed_forward(3)).build())
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork as MLN
+    net = MLN(conf).init()
+    x = rng.normal(size=(64, 3)).astype(np.float32)
+    y = (x @ np.asarray([[1.0], [-2.0], [0.5]], np.float32)
+         + 0.1 * rng.normal(size=(64, 1)).astype(np.float32))
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    return net, DataSet(x, y)
+
+
+@pytest.mark.parametrize("solver_cls", ["LineGradientDescent",
+                                        "ConjugateGradient", "LBFGS"])
+def test_legacy_solver_minimizes(solver_cls):
+    from deeplearning4j_tpu.optimize import legacy
+
+    net, ds = _solver_net_and_data()
+    before = net.score(ds)
+    solver = getattr(legacy, solver_cls)(max_iterations=60)
+    final = solver.optimize(net, ds)
+    after = net.score(ds)
+    assert after < before * 0.2
+    assert final == pytest.approx(after, rel=0.05)
+
+
+def test_lbfgs_beats_line_gd_iteration_for_iteration():
+    from deeplearning4j_tpu.optimize.legacy import LBFGS, LineGradientDescent
+
+    net1, ds = _solver_net_and_data(seed=11)
+    net2, _ = _solver_net_and_data(seed=11)
+    LineGradientDescent(max_iterations=15).optimize(net1, ds)
+    LBFGS(max_iterations=15).optimize(net2, ds)
+    assert net2.score(ds) <= net1.score(ds) * 1.05  # curvature should help
+
+
+def test_legacy_solver_on_graph():
+    from deeplearning4j_tpu.optimize.legacy import LBFGS
+
+    rng = np.random.default_rng(5)
+    from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration as NNC
+    b = NNC.builder().seed(5).updater(Sgd(0.1)).graph_builder()
+    b.add_inputs("in")
+    b.add_layer("h", DenseLayer(n_out=6, activation=Activation.TANH), "in")
+    b.add_layer("out", OutputLayer(n_out=1, activation=Activation.IDENTITY,
+                                   loss_fn=LossMSE()), "h")
+    b.set_outputs("out")
+    b.set_input_types(InputType.feed_forward(2))
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    net = ComputationGraph(b.build()).init()
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    x = rng.normal(size=(32, 2)).astype(np.float32)
+    y = (x[:, :1] * x[:, 1:] + 0.5).astype(np.float32)
+    ds = DataSet(x, y)
+    before = net.score(ds)
+    LBFGS(max_iterations=80).optimize(net, ds)
+    assert net.score(ds) < before * 0.5
+
+
+def test_remote_ui_stats_router():
+    from deeplearning4j_tpu.ui import RemoteUIStatsStorageRouter
+
+    ui = UIServer.get_instance()
+    port = ui.start(port=0)
+    try:
+        router = RemoteUIStatsStorageRouter(f"http://127.0.0.1:{port}")
+        net = MultiLayerNetwork(_conf())
+        net.init()
+        net.set_listeners(StatsListener(router, frequency=1))
+        ds = _data()
+        for _ in range(3):
+            net.fit_batch(ds)
+        assert router.flush()  # delivery is async
+        # the server's auto-attached remote storage received the records
+        assert len(ui.remote_storage().records()) == 3
+        assert "score" in ui.remote_storage().records()[0]
+        # and the dashboard renders them
+        assert "Model score" in ui.render_html()
+
+        # a non-dict body is rejected (it would poison every later render)
+        import urllib.error
+        import urllib.request
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/train/post", data=b"42",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+        assert "Model score" in ui.render_html()  # still renders
+    finally:
+        ui.stop()
+        ui.detach(ui.remote_storage())
+        ui._remote_storage = None
+
+    # a dashboard outage must not crash the training loop
+    router2 = RemoteUIStatsStorageRouter(f"http://127.0.0.1:{port}",
+                                         retries=1, timeout=0.5)
+    net2 = MultiLayerNetwork(_conf())
+    net2.init()
+    net2.set_listeners(StatsListener(router2, frequency=1))
+    net2.fit_batch(_data())          # server is down: no exception
+    router2.flush(timeout=10.0)
+    assert router2.dropped == 1
