@@ -15,6 +15,9 @@ from __future__ import annotations
 
 import numpy as np
 
+from deeplearning4j_tpu.samediff import ops as _ops  # noqa: F401  — importing
+# populates OP_REGISTRY (namespaces are otherwise lazy; a validate() call
+# before any namespace use must still see the full registry)
 from deeplearning4j_tpu.samediff.core import OP_REGISTRY, SameDiff, SDVariable
 
 _VALIDATED: set[str] = set()
@@ -32,7 +35,10 @@ class TestCase:
         self.inputs = {k: np.asarray(v, np.float64)
                        for k, v in inputs.items()}
         self.expected = {k: np.asarray(v) for k, v in expected.items()}
-        self.grad_wrt = grad_wrt or list(self.inputs)
+        # grad_wrt=[] means "forward-only" (bool/int outputs, non-smooth
+        # ops); only None defaults to checking every input
+        self.grad_wrt = (list(self.inputs) if grad_wrt is None
+                         else list(grad_wrt))
         self.epsilon = float(epsilon)
         self.max_rel_error = float(max_rel_error)
 
@@ -69,6 +75,10 @@ def _validate_x64(case: TestCase) -> None:
                                    for k, v in ph_vals.items()})
         return sum(jnp.sum(v) for v in res.values())
 
+    if not case.grad_wrt:
+        for node in sd.ops.values():
+            _VALIDATED.add(node.op_name)
+        return
     analytic = jax.grad(lambda pv: scalar(pv))(
         {k: jnp.asarray(v) for k, v in case.inputs.items()})
     for k in case.grad_wrt:

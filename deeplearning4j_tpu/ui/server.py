@@ -77,7 +77,11 @@ class UIServer:
     _instance: Optional["UIServer"] = None
 
     def __init__(self):
+        import threading
+
         self._storages: List[StatsStorage] = []
+        self._remote_storage: Optional[StatsStorage] = None
+        self._remote_lock = threading.Lock()
 
     @classmethod
     def get_instance(cls) -> "UIServer":
@@ -178,20 +182,13 @@ class UIServer:
         ``RemoteUIStatsStorageRouter`` clients (lock-guarded: concurrent
         first POSTs from ThreadingHTTPServer handler threads must not race
         the lazy init)."""
-        import threading
-
-        lock = getattr(self, "_remote_lock", None)
-        if lock is None:
-            lock = self.__dict__.setdefault("_remote_lock",
-                                            threading.Lock())
-        with lock:
-            st = getattr(self, "_remote_storage", None)
-            if st is None:
+        with self._remote_lock:
+            if self._remote_storage is None:
                 from deeplearning4j_tpu.ui.stats import InMemoryStatsStorage
 
-                st = self._remote_storage = InMemoryStatsStorage()
-                self.attach(st)
-        return st
+                self._remote_storage = InMemoryStatsStorage()
+                self.attach(self._remote_storage)
+            return self._remote_storage
 
     def render_html(self, refresh_seconds: int = 0) -> str:
         """The dashboard as an HTML string."""

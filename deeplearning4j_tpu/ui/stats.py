@@ -94,7 +94,11 @@ class RemoteUIStatsStorageRouter(StatsStorage):
         while True:
             record = self._q.get()
             try:
-                data = json.dumps(record).encode()
+                try:
+                    data = json.dumps(record).encode()
+                except (TypeError, ValueError):
+                    self.dropped += 1  # unserializable record: drop, keep
+                    continue           # the worker alive
                 for attempt in range(self.retries):
                     try:
                         req = urllib.request.Request(
@@ -104,7 +108,8 @@ class RemoteUIStatsStorageRouter(StatsStorage):
                             req, timeout=self.timeout).read()
                         break
                     except Exception:
-                        time.sleep(0.2 * (attempt + 1))
+                        if attempt < self.retries - 1:
+                            time.sleep(0.2 * (attempt + 1))
                 else:
                     self.dropped += 1
             finally:
