@@ -1,0 +1,135 @@
+"""Audio records + features (reference ``datavec-data-audio``:
+``WavFileRecordReader``, ``Wave``/spectrogram via the musicg lib, and the
+MFCC pipeline the examples build on it).
+
+TPU-native: decode with the stdlib ``wave`` module (zero-egress env, no
+native codec), features are plain numpy — frames are produced host-side
+exactly like the image pipeline, then batched into the jitted train step.
+"""
+
+from __future__ import annotations
+
+import wave
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datavec.records import RecordReader
+from deeplearning4j_tpu.datavec.split import InputSplit
+
+
+def read_wav(path: str):
+    """-> (samples float32 in [-1, 1] shaped [frames] (mono-mixed),
+    sample_rate). Supports 8/16/32-bit PCM WAV."""
+    with wave.open(path, "rb") as f:
+        n = f.getnframes()
+        raw = f.readframes(n)
+        width = f.getsampwidth()
+        channels = f.getnchannels()
+        rate = f.getframerate()
+    if width == 1:       # unsigned 8-bit
+        x = (np.frombuffer(raw, np.uint8).astype(np.float32) - 128.0) / 128.0
+    elif width == 2:
+        x = np.frombuffer(raw, "<i2").astype(np.float32) / 32768.0
+    elif width == 4:
+        x = np.frombuffer(raw, "<i4").astype(np.float32) / 2147483648.0
+    else:
+        raise ValueError(f"unsupported WAV sample width {width}")
+    if channels > 1:
+        x = x.reshape(-1, channels).mean(axis=1)
+    return x, rate
+
+
+class WavFileRecordReader(RecordReader):
+    """Reference class of the same name: record = [waveform ndarray,
+    sample_rate] plus a trailing label index when
+    ``label_from_parent_dir`` is set; one record per file."""
+
+    def __init__(self, label_from_parent_dir: bool = False):
+        self.label_from_parent_dir = label_from_parent_dir
+        self._labels: Optional[List[str]] = None
+        self._split: Optional[InputSplit] = None
+
+    def initialize(self, split: InputSplit):
+        self._split = split
+        if self.label_from_parent_dir:
+            from pathlib import Path
+
+            self._labels = sorted({Path(p).parent.name
+                                   for p in split.locations()})
+        return self
+
+    def labels(self):
+        return self._labels
+
+    def __iter__(self):
+        from pathlib import Path
+
+        for loc in self._split.locations():
+            x, rate = read_wav(loc)
+            rec = [x, rate]
+            if self._labels is not None:
+                rec.append(self._labels.index(Path(loc).parent.name))
+            yield rec
+
+    def reset(self):
+        return None
+
+
+def frame_signal(x: np.ndarray, frame_length: int, hop: int) -> np.ndarray:
+    """[T] -> [n_frames, frame_length] with a trailing zero-padded frame."""
+    if len(x) < frame_length:
+        x = np.pad(x, (0, frame_length - len(x)))
+    n = 1 + max(0, (len(x) - frame_length + hop - 1) // hop)
+    total = (n - 1) * hop + frame_length
+    x = np.pad(x, (0, max(0, total - len(x))))
+    idx = np.arange(frame_length)[None, :] + hop * np.arange(n)[:, None]
+    return x[idx]
+
+
+def spectrogram(x: np.ndarray, frame_length: int = 256,
+                hop: Optional[int] = None) -> np.ndarray:
+    """Hann-windowed magnitude spectrogram [n_frames, frame_length//2+1]
+    (reference ``Spectrogram`` from musicg)."""
+    hop = hop or frame_length // 2
+    frames = frame_signal(np.asarray(x, np.float32), frame_length, hop)
+    window = np.hanning(frame_length).astype(np.float32)
+    return np.abs(np.fft.rfft(frames * window, axis=-1)).astype(np.float32)
+
+
+def _mel_filterbank(n_mels: int, n_fft: int, rate: float) -> np.ndarray:
+    def hz_to_mel(f):
+        return 2595.0 * np.log10(1.0 + f / 700.0)
+
+    def mel_to_hz(m):
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+
+    mels = np.linspace(0.0, hz_to_mel(rate / 2), n_mels + 2)
+    freqs = mel_to_hz(mels)
+    bins = np.floor((n_fft + 1) * freqs / rate).astype(int)
+    n_bins = n_fft // 2 + 1
+    fb = np.zeros((n_mels, n_bins), np.float32)
+    for i in range(n_mels):
+        lo, mid, hi = bins[i], bins[i + 1], bins[i + 2]
+        if mid > lo:
+            fb[i, lo:mid] = (np.arange(lo, mid) - lo) / (mid - lo)
+        if hi > mid:
+            fb[i, mid:hi] = (hi - np.arange(mid, hi)) / (hi - mid)
+    return fb
+
+
+def mfcc(x: np.ndarray, rate: float, n_mfcc: int = 13, n_mels: int = 26,
+         frame_length: int = 256, hop: Optional[int] = None) -> np.ndarray:
+    """[T] -> [n_frames, n_mfcc] mel-frequency cepstral coefficients
+    (reference MFCC feature path; DCT-II, ortho-normalized)."""
+    spec = spectrogram(x, frame_length, hop)           # [F, bins]
+    power = spec ** 2
+    fb = _mel_filterbank(n_mels, frame_length, float(rate))
+    mel = np.log(power @ fb.T + 1e-10)                 # [F, n_mels]
+    # DCT-II (ortho) without scipy
+    k = np.arange(n_mels)
+    basis = np.cos(np.pi * np.outer(np.arange(n_mfcc), (2 * k + 1))
+                   / (2.0 * n_mels))
+    basis *= np.sqrt(2.0 / n_mels)
+    basis[0] *= np.sqrt(0.5)
+    return (mel @ basis.T).astype(np.float32)

@@ -233,3 +233,77 @@ def test_transform_reader_reset_delegates():
     wrapped = TransformProcessRecordReader(inner, tp)
     wrapped.reset()
     assert inner.resets == 1
+
+
+# --------------------------------------------------------------------------
+# audio (reference datavec-data-audio: WavFileRecordReader, spectrogram,
+# MFCC features)
+# --------------------------------------------------------------------------
+
+def _write_wav(path, samples, rate=8000, width=2, channels=1):
+    import wave
+
+    with wave.open(str(path), "wb") as f:
+        f.setnchannels(channels)
+        f.setsampwidth(width)
+        f.setframerate(rate)
+        if width == 2:
+            data = (np.clip(samples, -1, 1) * 32767).astype("<i2")
+        elif width == 1:
+            data = ((np.clip(samples, -1, 1) * 127) + 128).astype(np.uint8)
+        else:
+            data = (np.clip(samples, -1, 1) * (2**31 - 1)).astype("<i4")
+        if channels > 1:
+            data = np.repeat(data[:, None], channels, axis=1)
+        f.writeframes(data.tobytes())
+
+
+def test_wav_reader_roundtrip(tmp_path):
+    from deeplearning4j_tpu.datavec.audio import WavFileRecordReader, read_wav
+    from deeplearning4j_tpu.datavec.split import FileSplit
+
+    t = np.arange(800) / 8000.0
+    sig = 0.5 * np.sin(2 * np.pi * 440.0 * t)
+    for label in ("dog", "cat"):
+        d = tmp_path / label
+        d.mkdir()
+        _write_wav(d / "a.wav", sig)
+    x, rate = read_wav(str(tmp_path / "dog" / "a.wav"))
+    assert rate == 8000 and x.shape == (800,)
+    np.testing.assert_allclose(x, sig, atol=2e-4)
+
+    rr = WavFileRecordReader(label_from_parent_dir=True).initialize(
+        FileSplit(tmp_path, allowed_extensions=["wav"]))
+    recs = list(rr)
+    assert len(recs) == 2
+    assert rr.labels() == ["cat", "dog"]
+    waveform, rate2, label_idx = recs[0]
+    assert rate2 == 8000 and label_idx in (0, 1)
+
+    # 8-bit and stereo decode paths
+    _write_wav(tmp_path / "w8.wav", sig, width=1)
+    x8, _ = read_wav(str(tmp_path / "w8.wav"))
+    np.testing.assert_allclose(x8, sig, atol=2e-2)
+    _write_wav(tmp_path / "st.wav", sig, channels=2)
+    xs, _ = read_wav(str(tmp_path / "st.wav"))
+    assert xs.shape == (800,)
+
+
+def test_spectrogram_peak_and_mfcc_shape():
+    from deeplearning4j_tpu.datavec.audio import mfcc, spectrogram
+
+    rate, freq = 8000.0, 1000.0
+    t = np.arange(4096) / rate
+    sig = np.sin(2 * np.pi * freq * t).astype(np.float32)
+    spec = spectrogram(sig, frame_length=256)
+    assert spec.shape[1] == 129
+    # energy peaks at the sine's bin: 1000/8000*256 = bin 32
+    assert int(np.argmax(spec.mean(axis=0))) == 32
+
+    feats = mfcc(sig, rate, n_mfcc=13)
+    assert feats.shape[1] == 13
+    assert np.isfinite(feats).all()
+    # MFCCs of a pure tone differ from white noise
+    noise = np.random.default_rng(0).normal(size=4096).astype(np.float32)
+    f_noise = mfcc(noise, rate, n_mfcc=13)
+    assert np.abs(feats.mean(0) - f_noise.mean(0)).max() > 1.0
