@@ -9,6 +9,7 @@ exactly like the image pipeline, then batched into the jitted train step.
 
 from __future__ import annotations
 
+import functools
 import wave
 from typing import List, Optional
 
@@ -51,11 +52,12 @@ class WavFileRecordReader(RecordReader):
         self._split: Optional[InputSplit] = None
 
     def initialize(self, split: InputSplit):
+        from deeplearning4j_tpu.datavec.image import ParentPathLabelGenerator
+
         self._split = split
         if self.label_from_parent_dir:
-            from pathlib import Path
-
-            self._labels = sorted({Path(p).parent.name
+            gen = self._label_gen = ParentPathLabelGenerator()
+            self._labels = sorted({gen.label_for(p)
                                    for p in split.locations()})
         return self
 
@@ -63,13 +65,11 @@ class WavFileRecordReader(RecordReader):
         return self._labels
 
     def __iter__(self):
-        from pathlib import Path
-
         for loc in self._split.locations():
             x, rate = read_wav(loc)
             rec = [x, rate]
             if self._labels is not None:
-                rec.append(self._labels.index(Path(loc).parent.name))
+                rec.append(self._labels.index(self._label_gen.label_for(loc)))
             yield rec
 
     def reset(self):
@@ -97,6 +97,7 @@ def spectrogram(x: np.ndarray, frame_length: int = 256,
     return np.abs(np.fft.rfft(frames * window, axis=-1)).astype(np.float32)
 
 
+@functools.lru_cache(maxsize=16)  # identical per dataset: one file per call
 def _mel_filterbank(n_mels: int, n_fft: int, rate: float) -> np.ndarray:
     def hz_to_mel(f):
         return 2595.0 * np.log10(1.0 + f / 700.0)
@@ -126,10 +127,15 @@ def mfcc(x: np.ndarray, rate: float, n_mfcc: int = 13, n_mels: int = 26,
     power = spec ** 2
     fb = _mel_filterbank(n_mels, frame_length, float(rate))
     mel = np.log(power @ fb.T + 1e-10)                 # [F, n_mels]
-    # DCT-II (ortho) without scipy
+    return (mel @ _dct_basis(n_mfcc, n_mels).T).astype(np.float32)
+
+
+@functools.lru_cache(maxsize=16)
+def _dct_basis(n_mfcc: int, n_mels: int) -> np.ndarray:
+    """DCT-II (ortho) basis without scipy."""
     k = np.arange(n_mels)
     basis = np.cos(np.pi * np.outer(np.arange(n_mfcc), (2 * k + 1))
                    / (2.0 * n_mels))
     basis *= np.sqrt(2.0 / n_mels)
     basis[0] *= np.sqrt(0.5)
-    return (mel @ basis.T).astype(np.float32)
+    return basis

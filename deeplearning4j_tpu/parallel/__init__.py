@@ -36,3 +36,10 @@ from deeplearning4j_tpu.parallel.wrapper import (  # noqa: F401
     ParallelWrapper,
     TrainingMode,
 )
+from deeplearning4j_tpu.parallel.tensor import (  # noqa: F401
+    shard_tp_params,
+    tp_block_apply,
+    tp_block_init,
+    tp_block_shardings,
+    tp_train_step,
+)

@@ -1,0 +1,131 @@
+"""Tensor parallelism over the mesh ``model`` axis (beyond the reference:
+DL4J has no TP — SURVEY.md §2.3 lists it absent; the pjit/GSPMD idiom
+makes it nearly free, so this module provides it as a first-class mode).
+
+Megatron-style sharded transformer block: QKV and FFN-in projections are
+COLUMN-parallel (output features sharded over ``model``), attention-out
+and FFN-out are ROW-parallel (input features sharded) — the math is
+written ONCE and annotated with shardings; GSPMD partitions the matmuls
+and inserts the all-reduce where row-parallel layers sum partial results.
+Attention heads shard naturally because heads live on the column-parallel
+feature dim.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def tp_block_init(key, d_model: int, n_heads: int, d_ff: int,
+                  dtype=jnp.float32) -> dict:
+    """Pre-LN attention + FFN residual block params (single logical copy;
+    shard with :func:`tp_block_shardings`)."""
+    ks = jax.random.split(key, 4)
+    s_attn = 1.0 / np.sqrt(d_model)
+    s_ff = 1.0 / np.sqrt(d_ff)
+    return {
+        "ln1_g": jnp.ones((d_model,), dtype),
+        "ln1_b": jnp.zeros((d_model,), dtype),
+        "w_qkv": (s_attn * jax.random.normal(ks[0], (d_model, 3 * d_model))
+                  ).astype(dtype),
+        "w_out": (s_attn * jax.random.normal(ks[1], (d_model, d_model))
+                  ).astype(dtype),
+        "ln2_g": jnp.ones((d_model,), dtype),
+        "ln2_b": jnp.zeros((d_model,), dtype),
+        "w_ff1": (s_attn * jax.random.normal(ks[2], (d_model, d_ff))
+                  ).astype(dtype),
+        "b_ff1": jnp.zeros((d_ff,), dtype),
+        "w_ff2": (s_ff * jax.random.normal(ks[3], (d_ff, d_model))
+                  ).astype(dtype),
+    }
+
+
+def tp_block_shardings(mesh: Mesh) -> dict:
+    """NamedSharding per param: column-parallel weights shard their OUTPUT
+    dim over ``model``, row-parallel weights their INPUT dim; layernorm
+    params replicate."""
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    return {
+        "ln1_g": ns(), "ln1_b": ns(),
+        "w_qkv": ns(None, MODEL_AXIS),     # column-parallel
+        "w_out": ns(MODEL_AXIS, None),     # row-parallel
+        "ln2_g": ns(), "ln2_b": ns(),
+        "w_ff1": ns(None, MODEL_AXIS),     # column-parallel
+        "b_ff1": ns(MODEL_AXIS),
+        "w_ff2": ns(MODEL_AXIS, None),     # row-parallel
+    }
+
+
+def _layernorm(x, g, b, eps=1e-6):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+def tp_block_apply(params: dict, x, n_heads: int, mesh: Mesh = None,
+                   causal: bool = True):
+    """[B, T, D] -> [B, T, D]. With sharded params GSPMD runs attention
+    heads and FFN columns model-parallel; the constraint hints keep the
+    intermediate activations on the ``model`` axis until the row-parallel
+    matmuls reduce them. ``n_heads`` is static (a pytree leaf would trace
+    to an array and break the head reshape)."""
+    B, T, D = x.shape
+    hd = D // n_heads
+
+    def hint(v, *spec):
+        if mesh is None or MODEL_AXIS not in mesh.shape:
+            return v
+        return jax.lax.with_sharding_constraint(
+            v, NamedSharding(mesh, P(*spec)))
+
+    h = _layernorm(x, params["ln1_g"], params["ln1_b"])
+    qkv = hint(h @ params["w_qkv"], DATA_AXIS, None, MODEL_AXIS)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(m):
+        return m.reshape(B, T, n_heads, hd).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    scores = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(hd)
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask, scores, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = (probs @ v).transpose(0, 2, 1, 3).reshape(B, T, D)
+    ctx = hint(ctx, DATA_AXIS, None, MODEL_AXIS)
+    x = x + ctx @ params["w_out"]          # row-parallel: GSPMD psums here
+
+    h = _layernorm(x, params["ln2_g"], params["ln2_b"])
+    ff = hint(jax.nn.gelu(h @ params["w_ff1"] + params["b_ff1"]),
+              DATA_AXIS, None, MODEL_AXIS)
+    return x + ff @ params["w_ff2"]        # row-parallel reduce
+
+
+def shard_tp_params(params: dict, mesh: Mesh) -> dict:
+    """Place a logical param tree onto the mesh per tp_block_shardings."""
+    shardings = tp_block_shardings(mesh)
+    return {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+
+
+def tp_train_step(mesh: Mesh, n_heads: int, lr: float = 1e-2):
+    """-> jitted (params, x, targets) -> (new_params, loss): MSE training
+    step over a data x model mesh — gradients of column/row-parallel
+    weights stay sharded; the data-axis gradient all-reduce and the
+    model-axis partial-sum reduces are all GSPMD-inserted."""
+    def loss_fn(params, x, targets):
+        y = tp_block_apply(params, x, n_heads, mesh)
+        return jnp.mean((y - targets) ** 2)
+
+    def step(params, x, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, targets)
+        new = {k: v - lr * grads[k] for k, v in params.items()}
+        return new, loss
+
+    return jax.jit(step)

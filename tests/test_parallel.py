@@ -203,3 +203,47 @@ def test_graph_parallel_wrapper():
             np.testing.assert_allclose(
                 np.asarray(serial.params[k][pk]),
                 np.asarray(par.params[k][pk]), atol=2e-5)
+
+
+# --------------------------------------------------------------------------
+# tensor parallelism (beyond reference parity: Megatron-style TP block)
+# --------------------------------------------------------------------------
+
+def test_tensor_parallel_block_matches_single_device():
+    import jax
+    from jax.sharding import Mesh
+
+    from deeplearning4j_tpu.parallel.tensor import (
+        shard_tp_params,
+        tp_block_apply,
+        tp_block_init,
+        tp_train_step,
+    )
+
+    D, H, F, B, T = 16, 4, 32, 4, 6
+    params = tp_block_init(jax.random.PRNGKey(0), D, H, F)
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, T, D))
+
+    # single-logical-device reference
+    want = tp_block_apply(params, x, H)
+
+    devices = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devices, ("data", "model"))
+    sharded = shard_tp_params(params, mesh)
+    # weights really live sharded over the model axis
+    spec = sharded["w_qkv"].sharding.spec
+    assert "model" in str(spec)
+    with mesh:
+        got = jax.jit(lambda p, x: tp_block_apply(p, x, H, mesh))(sharded, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    # training step: loss decreases, params stay sharded
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (B, T, D))
+    step = tp_train_step(mesh, H, lr=0.05)
+    with mesh:
+        p, l0 = step(sharded, x, tgt)
+        for _ in range(10):
+            p, loss = step(p, x, tgt)
+    assert float(loss) < float(l0)
+    assert "model" in str(p["w_ff1"].sharding.spec)
