@@ -43,3 +43,4 @@ from deeplearning4j_tpu.parallel.tensor import (  # noqa: F401
     tp_block_shardings,
     tp_train_step,
 )
+from deeplearning4j_tpu.parallel.serving import InferenceServer  # noqa: F401

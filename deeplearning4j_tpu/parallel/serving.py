@@ -1,0 +1,126 @@
+"""HTTP model serving (the role of the reference's ``ParallelInference``
+deployments and libnd4j's ``GraphServer``: a long-lived process answering
+inference requests over the network).
+
+Stdlib ``ThreadingHTTPServer``; concurrent requests ride the model's
+jitted forward (optionally through :class:`ParallelInference` for
+multi-device batch sharding). Endpoints:
+
+- ``POST /predict``  body ``{"inputs": [...]}`` (nested lists, one array
+  per network input) -> ``{"outputs": [...]}``
+- ``GET  /model``    model summary + input/output metadata
+- ``GET  /healthz``  liveness
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+import numpy as np
+
+
+class InferenceServer:
+    """Serve a MultiLayerNetwork / ComputationGraph / ParallelInference.
+
+    Usage::
+
+        server = InferenceServer(net).start(port=0)
+        # POST http://127.0.0.1:{server.port}/predict {"inputs": [[...]]}
+        server.stop()
+    """
+
+    def __init__(self, model, dtype=np.float32):
+        self.model = model
+        self.dtype = dtype
+        self._httpd = None
+        self._thread = None
+        self.port: Optional[int] = None
+        self._lock = threading.Lock()  # one forward at a time: the jitted
+        # call itself pipelines; serializing here keeps results ordered
+
+    # --- inference ----------------------------------------------------------
+    def _predict(self, inputs):
+        xs = [np.asarray(a, self.dtype) for a in inputs]
+        with self._lock:
+            out = self.model.output(*xs)
+        outs = out if isinstance(out, list) else [out]
+        return [np.asarray(o).tolist() for o in outs]
+
+    def _model_info(self) -> dict:
+        m = self.model
+        net = getattr(m, "model", m)  # unwrap ParallelInference
+        info = {"type": type(net).__name__}
+        conf = getattr(net, "conf", None)
+        if conf is not None:
+            if hasattr(conf, "network_inputs"):
+                info["inputs"] = list(conf.network_inputs)
+                info["outputs"] = list(conf.network_outputs)
+            if hasattr(net, "num_params"):
+                info["num_params"] = int(net.num_params())
+        return info
+
+    # --- lifecycle ----------------------------------------------------------
+    def start(self, port: int = 0, host: str = "127.0.0.1"):
+        import http.server
+
+        if self._httpd is not None:
+            return self
+        srv = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _send(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(200, {"status": "ok"})
+                elif self.path == "/model":
+                    self._send(200, srv._model_info())
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._send(404, {"error": "not found"})
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(length))
+                    inputs = req["inputs"]
+                    if not isinstance(inputs, list) or not inputs:
+                        raise ValueError("inputs must be a non-empty list")
+                except (ValueError, KeyError, TypeError) as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                try:
+                    outs = srv._predict(inputs)
+                except Exception as e:  # model/runtime failure -> 500 JSON,
+                    # never a dropped connection
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                self._send(200, {"outputs": outs})
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self.port = None
+        return self

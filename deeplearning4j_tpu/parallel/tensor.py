@@ -24,7 +24,11 @@ from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 def tp_block_init(key, d_model: int, n_heads: int, d_ff: int,
                   dtype=jnp.float32) -> dict:
     """Pre-LN attention + FFN residual block params (single logical copy;
-    shard with :func:`tp_block_shardings`)."""
+    shard with :func:`tp_block_shardings`). ``n_heads`` validates the
+    head split here so the apply-time reshape can't fail cryptically."""
+    if d_model % n_heads != 0:
+        raise ValueError(
+            f"d_model={d_model} must be divisible by n_heads={n_heads}")
     ks = jax.random.split(key, 4)
     s_attn = 1.0 / np.sqrt(d_model)
     s_ff = 1.0 / np.sqrt(d_ff)

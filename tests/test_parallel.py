@@ -247,3 +247,91 @@ def test_tensor_parallel_block_matches_single_device():
             p, loss = step(p, x, tgt)
     assert float(loss) < float(l0)
     assert "model" in str(p["w_ff1"].sharding.spec)
+
+
+def test_inference_server_http():
+    import json
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    from deeplearning4j_tpu.conf import Activation, InputType
+    from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.conf.losses import LossMCXENT
+    from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+    from deeplearning4j_tpu.conf.updaters import Sgd
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel import InferenceServer
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_out=8, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    server = InferenceServer(net).start(port=0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        health = json.loads(urllib.request.urlopen(
+            base + "/healthz", timeout=10).read())
+        assert health["status"] == "ok"
+        info = json.loads(urllib.request.urlopen(
+            base + "/model", timeout=10).read())
+        assert info["type"] == "MultiLayerNetwork"
+
+        x = np.random.default_rng(0).normal(size=(5, 4)).astype(np.float32)
+        req = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps({"inputs": [x.tolist()]}).encode(),
+            headers={"Content-Type": "application/json"})
+        resp = json.loads(urllib.request.urlopen(req, timeout=30).read())
+        got = np.asarray(resp["outputs"][0])
+        want = np.asarray(net.output(x))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+        # malformed request -> 400 with an error message
+        bad = urllib.request.Request(
+            base + "/predict", data=b'{"nope": 1}',
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad, timeout=10)
+        assert ei.value.code == 400
+    finally:
+        server.stop()
+
+
+def test_inference_server_500_on_model_failure():
+    import json
+    import urllib.error
+    import urllib.request
+
+    from deeplearning4j_tpu.parallel import InferenceServer
+
+    class Broken:
+        params = {}
+
+        def output(self, *xs):
+            raise RuntimeError("device exploded")
+
+    server = InferenceServer(Broken()).start(port=0)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/predict",
+            data=json.dumps({"inputs": [[1.0]]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 500
+        assert "device exploded" in ei.value.read().decode()
+    finally:
+        server.stop()
+
+
+def test_tp_block_init_validates_heads():
+    import jax
+
+    from deeplearning4j_tpu.parallel import tp_block_init
+
+    with pytest.raises(ValueError, match="divisible"):
+        tp_block_init(jax.random.PRNGKey(0), 16, 3, 64)
