@@ -224,9 +224,10 @@ class CandidateResult:
 
 class OptimizationResult:
     def __init__(self, best: CandidateResult,
-                 results: List[CandidateResult]):
+                 results: List[CandidateResult], minimize: bool = True):
         self.best = best
         self.results = results
+        self.minimize = minimize
 
     def best_score(self) -> float:
         return self.best.score
@@ -236,6 +237,48 @@ class OptimizationResult:
 
     def best_model(self):
         return self.best.model
+
+    def render(self, path: str) -> str:
+        """Static search report (the reference's arbiter-ui module:
+        candidate scores, running best, best hyperparameters)."""
+        import html as _html
+
+        from deeplearning4j_tpu.ui.server import _chart
+
+        # non-finite scores (diverged, no exception raised) count as
+        # failed: a NaN in the series would blank the whole chart
+        ok = [r for r in self.results
+              if r.exception is None and math.isfinite(r.score)]
+        xs = [float(r.index) for r in ok]
+        ys = [float(r.score) for r in ok]
+        pick = min if self.minimize else max
+        running = []
+        cur = None
+        for r in ok:
+            cur = r.score if cur is None else pick(cur, r.score)
+            running.append(float(cur))
+        body = _chart("Candidate score vs index",
+                      {"score": (xs, ys), "running best": (xs, running)})
+        failed = len(self.results) - len(ok)
+        rows = "".join(
+            f"<tr><td>{_html.escape(str(k))}</td>"
+            f"<td>{_html.escape(repr(v))}</td></tr>"
+            for k, v in sorted(self.best.values.items()))
+        doc = ("<!doctype html><html><head><meta charset='utf-8'>"
+               "<title>arbiter search</title><style>"
+               "body{font-family:sans-serif;margin:24px;background:#fafafa}"
+               ".chart{background:#fff;border:1px solid #ddd;margin:12px 0;"
+               "padding:8px}table{border-collapse:collapse}"
+               "td{border:1px solid #ccc;padding:4px 8px}</style></head>"
+               f"<body><h1>Hyperparameter search</h1>"
+               f"<p>{len(ok)} candidates evaluated"
+               f"{f', {failed} failed' if failed else ''}; best score "
+               f"{self.best.score:.6g} at candidate {self.best.index}.</p>"
+               f"{body}<h3>Best hyperparameters</h3>"
+               f"<table>{rows}</table></body></html>")
+        with open(path, "w") as f:
+            f.write(doc)
+        return path
 
 
 class OptimizationConfiguration:
@@ -316,4 +359,5 @@ class LocalOptimizationRunner:
             raise RuntimeError(
                 f"no candidate completed with a finite score "
                 f"({len(results)} attempted){detail}")
-        return OptimizationResult(best, results)
+        return OptimizationResult(best, results,
+                                  minimize=cfg.score_function.minimize)

@@ -41,8 +41,26 @@ class InferenceServer:
         # call itself pipelines; serializing here keeps results ordered
 
     # --- inference ----------------------------------------------------------
-    def _predict(self, inputs):
-        xs = [np.asarray(a, self.dtype) for a in inputs]
+    def _expected_inputs(self) -> int:
+        net = getattr(self.model, "model", self.model)
+        conf = getattr(net, "conf", None)
+        if conf is not None and hasattr(conf, "network_inputs"):
+            return len(conf.network_inputs)
+        return 1  # MultiLayerNetwork & co: one feature array
+
+    def _parse_inputs(self, inputs):
+        """Client-error surface: arity + array conversion problems raise
+        ValueError (mapped to 400), never reach the model as a 500."""
+        expected = self._expected_inputs()
+        if len(inputs) != expected:
+            raise ValueError(
+                f"model takes {expected} input array(s), got {len(inputs)}")
+        try:
+            return [np.asarray(a, self.dtype) for a in inputs]
+        except (ValueError, TypeError) as e:
+            raise ValueError(f"malformed input array: {e}")
+
+    def _predict(self, xs):
         with self._lock:
             out = self.model.output(*xs)
         outs = out if isinstance(out, list) else [out]
@@ -96,11 +114,12 @@ class InferenceServer:
                     inputs = req["inputs"]
                     if not isinstance(inputs, list) or not inputs:
                         raise ValueError("inputs must be a non-empty list")
+                    xs = srv._parse_inputs(inputs)
                 except (ValueError, KeyError, TypeError) as e:
                     self._send(400, {"error": str(e)})
                     return
                 try:
-                    outs = srv._predict(inputs)
+                    outs = srv._predict(xs)
                 except Exception as e:  # model/runtime failure -> 500 JSON,
                     # never a dropped connection
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})

@@ -274,3 +274,22 @@ def test_policies():
     acts = [eps.next_action(mdp.reset()) for _ in range(20)]
     assert set(acts) <= {0, 1}
     assert eps.epsilon() == pytest.approx(0.1)
+
+
+def test_search_report_renders(tmp_path):
+    from deeplearning4j_tpu.arbiter import CandidateResult, OptimizationResult
+
+    results = [CandidateResult(i, {"lr": 0.1 / (i + 1)}, 1.0 / (i + 1), None)
+               for i in range(5)]
+    results.append(CandidateResult(5, {"lr": 0.0}, float("nan"), None,
+                                   exception=RuntimeError("diverged")))
+    # diverged WITHOUT an exception: NaN score must not blank the chart
+    results.append(CandidateResult(6, {"lr": 9.9}, float("nan"), None))
+    res = OptimizationResult(results[4], results, minimize=True)
+    path = res.render(str(tmp_path / "search.html"))
+    text = open(path).read()
+    assert "Candidate score" in text and "<svg" in text
+    assert "nan" not in text.split("<svg")[1].split("</svg>")[0]
+    assert "2 failed" in text
+    assert "best score 0.2" in text
+    assert "lr" in text

@@ -335,3 +335,41 @@ def test_tp_block_init_validates_heads():
 
     with pytest.raises(ValueError, match="divisible"):
         tp_block_init(jax.random.PRNGKey(0), 16, 3, 64)
+
+
+def test_inference_server_input_validation():
+    import json
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    from deeplearning4j_tpu.conf import Activation, InputType
+    from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.conf.losses import LossMCXENT
+    from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+    from deeplearning4j_tpu.conf.updaters import Sgd
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel import InferenceServer
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_out=4, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=2, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(3)).build())
+    server = InferenceServer(MultiLayerNetwork(conf).init()).start(port=0)
+    try:
+        base = f"http://127.0.0.1:{server.port}/predict"
+        x = [[1.0, 2.0, 3.0]]
+        for payload, match in [
+                ({"inputs": [x, x]}, "takes 1 input"),   # wrong arity
+                ({"inputs": [[[1.0, 2.0], [3.0]]]}, "malformed")]:  # ragged
+            req = urllib.request.Request(
+                base, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 400
+            assert match in ei.value.read().decode()
+    finally:
+        server.stop()
