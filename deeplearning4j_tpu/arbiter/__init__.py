@@ -264,18 +264,18 @@ class OptimizationResult:
             f"<tr><td>{_html.escape(str(k))}</td>"
             f"<td>{_html.escape(repr(v))}</td></tr>"
             for k, v in sorted(self.best.values.items()))
-        doc = ("<!doctype html><html><head><meta charset='utf-8'>"
-               "<title>arbiter search</title><style>"
-               "body{font-family:sans-serif;margin:24px;background:#fafafa}"
-               ".chart{background:#fff;border:1px solid #ddd;margin:12px 0;"
-               "padding:8px}table{border-collapse:collapse}"
-               "td{border:1px solid #ccc;padding:4px 8px}</style></head>"
-               f"<body><h1>Hyperparameter search</h1>"
-               f"<p>{len(ok)} candidates evaluated"
-               f"{f', {failed} failed' if failed else ''}; best score "
-               f"{self.best.score:.6g} at candidate {self.best.index}.</p>"
-               f"{body}<h3>Best hyperparameters</h3>"
-               f"<table>{rows}</table></body></html>")
+        from deeplearning4j_tpu.ui.server import _page
+
+        doc = _page(
+            "arbiter search",
+            f"<h1>Hyperparameter search</h1>"
+            f"<p>{len(ok)} candidates evaluated"
+            f"{f', {failed} failed' if failed else ''}; best score "
+            f"{self.best.score:.6g} at candidate {self.best.index}.</p>"
+            f"{body}<h3>Best hyperparameters</h3>"
+            f"<table>{rows}</table>",
+            style_extra="table{border-collapse:collapse}"
+                        "td{border:1px solid #ccc;padding:4px 8px}")
         with open(path, "w") as f:
             f.write(doc)
         return path

@@ -63,6 +63,7 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         self._score_cache: Optional[float] = float("nan")
         self._train_step = None
         self._tbptt_step = None
+        self._tbptt_scan = None
         self._output_fn = None
         self._score_fn = None
         self._rnn_step_fn = None
@@ -380,6 +381,62 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         per segment, reference ``MultiLayerNetwork#doTruncatedBPTT``)."""
         return float(self._fit_batch_async(ds))
 
+    def _fit_tbptt_scan(self, features, labels, fmask, lmask, seg,
+                        carries):
+        n_seg = -(-int(features.shape[1]) // seg)
+        if self._tbptt_scan is None:
+            raw = self.train_step_fn()
+
+            def segments(arr):
+                # [B, T, ...] -> [n_seg, B, seg, ...], tail zero-padded —
+                # INSIDE the jit: shapes are static under trace, so the
+                # segmentation costs zero extra dispatches. n_seg derives
+                # from the traced shape (NOT closed over: a different T
+                # retraces with its own count)
+                ns = -(-arr.shape[1] // seg)
+                arr = _pad_time(jnp.asarray(arr), ns * seg)
+                shaped = arr.reshape(arr.shape[0], ns, seg,
+                                     *arr.shape[2:])
+                return jnp.moveaxis(shaped, 1, 0)
+
+            def run(params, state, opt, features, labels, fmask, lmask,
+                    itc, ep, base_key, carries):
+                segs = tuple(segments(a)
+                             for a in (features, labels, fmask, lmask))
+
+                def body(carry, xs):
+                    params, state, opt, carries, itc = carry
+                    f_s, l_s, fm_s, lm_s = xs
+                    it, rng = nn_io.step_scalars(itc, base_key)
+                    params, state, opt, loss, carries = raw(
+                        params, state, opt, f_s, l_s, fm_s, lm_s, it, ep,
+                        rng, carries)
+                    return (params, state, opt, carries, itc + 1), loss
+
+                (params, state, opt, carries, itc), losses = jax.lax.scan(
+                    body, (params, state, opt, carries, itc), segs)
+                return params, state, opt, itc, jnp.mean(losses)
+
+            # carries are zeros rebuilt per batch and not returned — not
+            # donated (unusable donations just warn)
+            self._tbptt_scan = jax.jit(run, donate_argnums=(0, 1, 2))
+        (self.params, self.state, self.opt_state, new_itc,
+         mean_loss) = self._tbptt_scan(
+            self.params, self.state, self.opt_state, features, labels,
+            fmask, lmask, self.device_iteration(), self.device_epoch(),
+            self._base_key, carries)
+        self.iteration += n_seg
+        self.advance_device_iteration(new_itc)
+        self.last_batch_size = int(features.shape[0])
+        self._score_dev = mean_loss
+        self._score_cache = None
+        for lst in self.listeners:
+            # one batch-level call, arg = last segment's iteration index
+            # (same contract as the segment-loop path)
+            lst.iteration_done(self, self.iteration - 1, self.epoch,
+                               mean_loss)
+        return mean_loss  # device scalar: the async fit pipeline queues it
+
     def _fit_tbptt(self, features, labels, fmask, lmask) -> float:
         """Truncated BPTT: slice the time axis into segments of
         ``tbptt_fwd_length``, one parameter update per segment, RNN state
@@ -391,8 +448,6 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
                 f"nOut], got shape {tuple(labels.shape)} (reference tBPTT "
                 "operates on sequence labels; use STANDARD backprop for "
                 "sequence-level classification heads)")
-        if self._tbptt_step is None:
-            self._tbptt_step = self._build_tbptt_step()
         seg = int(self.conf.tbptt_fwd_length)
         back = int(self.conf.tbptt_back_length or seg)
         back = min(back, seg)
@@ -406,8 +461,15 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         carries = {str(i): layer.zero_carry(n, self._dtype)
                    for i, layer in enumerate(self.conf.layers)
                    if getattr(layer, "has_carry", False)}
-        if back < seg and self._rnn_step_fn is None:
+        if back == seg:
+            # common case: the WHOLE segment chain is one compiled
+            # lax.scan — no Python loop, one dispatch, one sync
+            return self._fit_tbptt_scan(features, labels, fmask, lmask,
+                                        seg, carries)
+        if self._rnn_step_fn is None:
             self._rnn_step_fn = self._build_rnn_step_fn()
+        if self._tbptt_step is None:
+            self._tbptt_step = self._build_tbptt_step()
         losses = []
         for start in range(0, total_t, seg):
             f_seg = _pad_time(features[:, start:start + seg], seg)

@@ -34,6 +34,18 @@ def _polyline(xs: Sequence[float], ys: Sequence[float],
             f'points="{pts}"/>')
 
 
+def _page(title: str, body: str, head_extra: str = "",
+          style_extra: str = "") -> str:
+    """Shared HTML shell for the dashboard and the arbiter search report
+    (one place for charset/fonts/chart styling)."""
+    return ("<!doctype html><html><head><meta charset='utf-8'>"
+            f"{head_extra}<title>{html.escape(title)}</title><style>"
+            "body{font-family:sans-serif;margin:24px;background:#fafafa}"
+            ".chart{background:#fff;border:1px solid #ddd;margin:12px 0;"
+            "padding:8px}h3{margin:4px 0}"
+            f"{style_extra}</style></head><body>{body}</body></html>")
+
+
 def _chart(title: str, series: Dict[str, Tuple[List[float], List[float]]],
            y_label: str = "") -> str:
     allx = [x for xs, _ in series.values() for x in xs]
@@ -226,10 +238,6 @@ class UIServer:
         ]) or "<p>No stats collected yet.</p>"
         refresh = (f"<meta http-equiv='refresh' content='{refresh_seconds}'>"
                    if refresh_seconds else "")
-        return ("<!doctype html><html><head><meta charset='utf-8'>"
-                f"{refresh}"
-                "<title>deeplearning4j_tpu training</title><style>"
-                "body{font-family:sans-serif;margin:24px;background:#fafafa}"
-                ".chart{background:#fff;border:1px solid #ddd;margin:12px 0;"
-                "padding:8px}h3{margin:4px 0}</style></head><body>"
-                f"<h1>Training dashboard</h1>{body}</body></html>")
+        return _page("deeplearning4j_tpu training",
+                     f"<h1>Training dashboard</h1>{body}",
+                     head_extra=refresh)
