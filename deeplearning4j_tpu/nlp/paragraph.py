@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.nlp.word2vec import Word2Vec, _sgns_step
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec, _sgns_step_counter
 
 
 @functools.partial(jax.jit, static_argnums=(6,))
@@ -93,11 +93,13 @@ class ParagraphVectors(Word2Vec):
                         [chunk, chunk[rng.integers(0, len(chunk), reps)]])
                 lr = max(self.min_learning_rate,
                          self.learning_rate * (1.0 - step / total))
-                key, sub = jax.random.split(key)
-                doc_vecs, w_out, _ = _sgns_step(
-                    doc_vecs, w_out, jnp.asarray(chunk[:, 0]),
-                    jnp.asarray(chunk[:, 1]), self._table, sub,
-                    jnp.asarray(lr, jnp.float32), self.negative)
+                # numpy args stage with the one dispatch; the rng folds
+                # in-jit from the step counter (tunnel round-trip per
+                # eager op otherwise — see nn/io.py)
+                doc_vecs, w_out, _ = _sgns_step_counter(
+                    doc_vecs, w_out, np.ascontiguousarray(chunk[:, 0]),
+                    np.ascontiguousarray(chunk[:, 1]), self._table, key,
+                    np.int32(step), np.float32(lr), self.negative)
                 step += 1
         self.doc_vectors = np.asarray(doc_vecs)
         self.syn1 = np.asarray(w_out)

@@ -28,7 +28,17 @@ from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
 from deeplearning4j_tpu.nlp.vocab import VocabCache
 
 
-@functools.partial(jax.jit, static_argnums=(7,), donate_argnums=(0, 1))
+@functools.partial(jax.jit, static_argnums=(8,), donate_argnums=(0, 1))
+def _sgns_step_counter(w_in, w_out, centers, contexts, table, base_key,
+                       stepc, lr, negative):
+    """Per-step rng derives IN-JIT from (base_key, step counter): an eager
+    host-side jax.random.split would cost a ~60ms tunnel round-trip per
+    batch (see nn/io.py)."""
+    rng = jax.random.fold_in(base_key, stepc)
+    return _sgns_step(w_in, w_out, centers, contexts, table, rng, lr,
+                      negative)
+
+
 def _sgns_step(w_in, w_out, centers, contexts, table, rng, lr, negative):
     """One negative-sampling SGD step over a batch of (center, context);
     negatives drawn uniformly from the unigram^0.75 ``table``."""
@@ -163,11 +173,12 @@ class Word2Vec:
                     frac = min(step / total_steps, 1.0)
                     lr = max(self.min_learning_rate,
                              self.learning_rate * (1.0 - frac))
-                    key, sub = jax.random.split(key)
-                    w_in, w_out, loss = _sgns_step(
-                        w_in, w_out, jnp.asarray(chunk[:, 0]),
-                        jnp.asarray(chunk[:, 1]), table, sub,
-                        jnp.asarray(lr, jnp.float32), self.negative)
+                    # numpy args stage with the ONE dispatch; eager
+                    # jnp.asarray/random.split would each round-trip
+                    w_in, w_out, loss = _sgns_step_counter(
+                        w_in, w_out, np.ascontiguousarray(chunk[:, 0]),
+                        np.ascontiguousarray(chunk[:, 1]), table, key,
+                        np.int32(step), np.float32(lr), self.negative)
                     step += 1
         self.syn0 = np.asarray(w_in)
         self.syn1 = np.asarray(w_out)

@@ -344,12 +344,14 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         ScoreIterationListener every N prints)."""
         if self.params is None:
             self.init()
-        features, labels, fmask, lmask = self._batch_arrays(
-            ds, lazy_lmask=True, write_back=True)
         from deeplearning4j_tpu.conf.multilayer import BackpropType
 
-        if (self.conf.backprop_type is BackpropType.TRUNCATED_BPTT
-                and features.ndim == 3):
+        tbptt = self.conf.backprop_type is BackpropType.TRUNCATED_BPTT
+        if tbptt:
+            ds = self._tbptt_prepad(ds)
+        features, labels, fmask, lmask = self._batch_arrays(
+            ds, lazy_lmask=True, write_back=True)
+        if tbptt and features.ndim == 3:
             if lmask is None:
                 # HOST array: segments of it stage with each step call
                 # instead of costing an eager device op per batch
@@ -381,10 +383,49 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         per segment, reference ``MultiLayerNetwork#doTruncatedBPTT``)."""
         return float(self._fit_batch_async(ds))
 
+    def _tbptt_prepad(self, ds: DataSet) -> DataSet:
+        """Variable-length host batches (fresh numpy per batch, NLP
+        streams): pad T to a multiple of tbptt_fwd_length in NUMPY (free)
+        so the scan jit's cache key quantizes to the segment count instead
+        of retracing for every distinct T. Padded steps get zero masks.
+        Device-resident / non-multiple recurring batches pass through —
+        they compile once per distinct T anyway. Returns a NEW DataSet
+        (the caller's arrays are never mutated)."""
+        f = ds.features
+        if not isinstance(f, np.ndarray) or f.ndim != 3:
+            return ds
+        seg = int(self.conf.tbptt_fwd_length)
+        t = f.shape[1]
+        pad = (-t) % seg
+        if pad == 0:
+            return ds
+        n = f.shape[0]
+
+        def pad_t(a, fill=0.0):
+            width = [(0, 0), (0, pad)] + [(0, 0)] * (np.ndim(a) - 2)
+            return np.pad(np.asarray(a), width,
+                          constant_values=fill).astype(np.asarray(a).dtype)
+
+        fmask = (pad_t(ds.features_mask) if ds.features_mask is not None
+                 else np.pad(np.ones((n, t), self._dtype), [(0, 0), (0, pad)]))
+        lm = ds.labels_mask
+        if lm is not None and np.ndim(lm) == 1:   # per-example -> per-step
+            lm = np.asarray(lm)[:, None] * np.ones((n, t), self._dtype)
+        lmask = (pad_t(lm) if lm is not None
+                 else np.pad(np.ones((n, t), self._dtype), [(0, 0), (0, pad)]))
+        labels = (pad_t(ds.labels) if np.ndim(ds.labels) == 3
+                  else ds.labels)
+        return DataSet(pad_t(f), labels, features_mask=fmask,
+                       labels_mask=lmask)
+
     def _fit_tbptt_scan(self, features, labels, fmask, lmask, seg,
                         carries):
         n_seg = -(-int(features.shape[1]) // seg)
+        # cache keyed by seg: a conf.tbptt_fwd_length change between fits
+        # must not silently reuse a closure compiled for the old length
         if self._tbptt_scan is None:
+            self._tbptt_scan = {}
+        if seg not in self._tbptt_scan:
             raw = self.train_step_fn()
 
             def segments(arr):
@@ -419,9 +460,9 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
 
             # carries are zeros rebuilt per batch and not returned — not
             # donated (unusable donations just warn)
-            self._tbptt_scan = jax.jit(run, donate_argnums=(0, 1, 2))
+            self._tbptt_scan[seg] = jax.jit(run, donate_argnums=(0, 1, 2))
         (self.params, self.state, self.opt_state, new_itc,
-         mean_loss) = self._tbptt_scan(
+         mean_loss) = self._tbptt_scan[seg](
             self.params, self.state, self.opt_state, features, labels,
             fmask, lmask, self.device_iteration(), self.device_epoch(),
             self._base_key, carries)

@@ -419,3 +419,37 @@ def test_graves_lstm_peepholes_change_output():
     p2["pO"] = jnp.ones_like(p["pO"])
     y1, _ = layer.forward_with_carry(p2, carry, x)
     assert float(jnp.abs(y1 - y0).max()) > 1e-6
+
+
+def test_tbptt_seg_change_and_prepad(rng):
+    """Changing tbptt_fwd_length between fits must not reuse a stale
+    compiled closure; variable-length numpy batches pre-pad so the scan
+    cache quantizes to the segment count."""
+    from deeplearning4j_tpu.conf.multilayer import BackpropType
+
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-3))
+            .list()
+            .layer(LSTM(n_out=8, activation=Activation.TANH))
+            .layer(RnnOutputLayer(n_out=2, activation=Activation.SOFTMAX))
+            .set_input_type(InputType.recurrent(3, timesteps=20))
+            .backprop_type(BackpropType.TRUNCATED_BPTT, 5, 5)
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = rng.normal(size=(4, 20, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (4, 20))]
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    net.fit_batch(DataSet(x, y))
+    assert net.iteration == 4  # 20/5 segments
+
+    # variable length, NOT a multiple of seg: prepad -> 4 segments of 5
+    x2 = rng.normal(size=(4, 17, 3)).astype(np.float32)
+    y2 = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (4, 17))]
+    ds2 = DataSet(x2, y2)
+    net.fit_batch(ds2)
+    assert net.iteration == 8
+    assert ds2.features.shape[1] == 17  # caller's DataSet untouched
+
+    # seg change between fits: fresh compile, segment count follows
+    net.conf.tbptt_fwd_length = 10
+    net.fit_batch(DataSet(x, y))
+    assert net.iteration == 10  # +2 segments of 10
