@@ -399,6 +399,12 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         pad = (-t) % seg
         if pad == 0:
             return ds
+        # reuse the padded copy across epochs: write_back migrates ITS
+        # arrays to device on the first fit, so a reused DataSet still
+        # transfers once (keyed on the original features object)
+        cached = getattr(ds, "_tbptt_padded", None)
+        if cached is not None and cached[0] is f and cached[2] == seg:
+            return cached[1]
         n = f.shape[0]
 
         def pad_t(a, fill=0.0):
@@ -415,8 +421,13 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
                  else np.pad(np.ones((n, t), self._dtype), [(0, 0), (0, pad)]))
         labels = (pad_t(ds.labels) if np.ndim(ds.labels) == 3
                   else ds.labels)
-        return DataSet(pad_t(f), labels, features_mask=fmask,
-                       labels_mask=lmask)
+        padded = DataSet(pad_t(f), labels, features_mask=fmask,
+                         labels_mask=lmask)
+        try:
+            ds._tbptt_padded = (f, padded, seg)
+        except AttributeError:
+            pass  # exotic immutable containers just re-pad
+        return padded
 
     def _fit_tbptt_scan(self, features, labels, fmask, lmask, seg,
                         carries):

@@ -450,6 +450,35 @@ def test_tbptt_seg_change_and_prepad(rng):
     assert ds2.features.shape[1] == 17  # caller's DataSet untouched
 
     # seg change between fits: fresh compile, segment count follows
+    # (back length too — back < seg would take the loop path instead of
+    # the seg-keyed scan cache this test guards)
     net.conf.tbptt_fwd_length = 10
+    net.conf.tbptt_back_length = 10
     net.fit_batch(DataSet(x, y))
     assert net.iteration == 10  # +2 segments of 10
+
+
+def test_tbptt_prepad_caches_across_epochs(rng):
+    """The padded copy is reused so a reused DataSet transfers once."""
+    import jax
+
+    from deeplearning4j_tpu.conf.multilayer import BackpropType
+
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-3))
+            .list()
+            .layer(LSTM(n_out=8, activation=Activation.TANH))
+            .layer(RnnOutputLayer(n_out=2, activation=Activation.SOFTMAX))
+            .set_input_type(InputType.recurrent(3, timesteps=17))
+            .backprop_type(BackpropType.TRUNCATED_BPTT, 5, 5)
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = rng.normal(size=(4, 17, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (4, 17))]
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    ds = DataSet(x, y)
+    net.fit_batch(ds)
+    padded1 = ds._tbptt_padded[1]
+    assert isinstance(padded1.features, jax.Array)  # write_back migrated
+    net.fit_batch(ds)
+    assert ds._tbptt_padded[1] is padded1  # same copy, no re-pad
+    assert ds.features is x                # caller arrays untouched
