@@ -34,10 +34,16 @@ from deeplearning4j_tpu.conf.layers_cnn import (
     BatchNormalization,
     ConvolutionLayer,
     ConvolutionMode,
+    Cropping2D,
     GlobalPoolingLayer,
     PoolingType,
+    SeparableConvolution2D,
     SubsamplingLayer,
+    Upsampling2D,
+    ZeroPaddingLayer,
 )
+from deeplearning4j_tpu.conf.layers_extra import DepthwiseConvolution2D
+from deeplearning4j_tpu.conf.layers_rnn import SimpleRnn
 from deeplearning4j_tpu.conf.graph import (
     ElementWiseOp,
     ElementWiseVertex,
@@ -205,6 +211,10 @@ def _map_layer(cls: str, cfg: dict, name: str, is_output: bool = False):
             raise InvalidKerasConfigurationException(
                 "LSTM with return_sequences=False: wrap with "
                 "LastTimeStep manually (not auto-mapped)")
+        if cfg.get("go_backwards", False):
+            raise InvalidKerasConfigurationException(
+                f"{name}: go_backwards RNNs are not auto-mapped (use a "
+                "Bidirectional wrapper or reverse the input)")
         return LSTM(n_out=int(cfg["units"]),
                     activation=_act(cfg.get("activation", "tanh")),
                     gate_activation=_act(
@@ -215,8 +225,65 @@ def _map_layer(cls: str, cfg: dict, name: str, is_output: bool = False):
                                       n_in=int(cfg["input_dim"]), name=name)
     if cls == "GlobalAveragePooling2D":
         return GlobalPoolingLayer(pooling_type=PoolingType.AVG, name=name)
+    if cls == "GlobalMaxPooling2D":
+        return GlobalPoolingLayer(pooling_type=PoolingType.MAX, name=name)
+    if cls == "SeparableConv2D":
+        return SeparableConvolution2D(
+            n_out=int(cfg["filters"]),
+            kernel_size=_pair(cfg.get("kernel_size", 3)),
+            stride=_pair(cfg.get("strides", 1)),
+            dilation=_pair(cfg.get("dilation_rate", 1)),
+            depth_multiplier=int(cfg.get("depth_multiplier", 1)),
+            convolution_mode=_mode(cfg.get("padding", "valid")),
+            activation=_act(cfg.get("activation")),
+            has_bias=bool(cfg.get("use_bias", True)), name=name)
+    if cls == "DepthwiseConv2D":
+        if _pair(cfg.get("dilation_rate", 1)) != (1, 1):
+            raise InvalidKerasConfigurationException(
+                f"{name}: dilated DepthwiseConv2D not supported")
+        return DepthwiseConvolution2D(
+            kernel_size=_pair(cfg.get("kernel_size", 3)),
+            stride=_pair(cfg.get("strides", 1)),
+            depth_multiplier=int(cfg.get("depth_multiplier", 1)),
+            convolution_mode=_mode(cfg.get("padding", "valid")),
+            activation=_act(cfg.get("activation")),
+            has_bias=bool(cfg.get("use_bias", True)), name=name)
+    if cls == "UpSampling2D":
+        if cfg.get("interpolation", "nearest") != "nearest":
+            raise InvalidKerasConfigurationException(
+                f"{name}: only nearest-neighbour UpSampling2D supported")
+        return Upsampling2D(size=_pair(cfg.get("size", 2)), name=name)
+    if cls == "ZeroPadding2D":
+        (t, b), (l, r) = _pad_pairs(cfg.get("padding", 1))
+        return ZeroPaddingLayer(padding=(t, b, l, r), name=name)
+    if cls == "Cropping2D":
+        (t, b), (l, r) = _pad_pairs(cfg.get("cropping", 0))
+        return Cropping2D(cropping=(t, b, l, r), name=name)
+    if cls == "SimpleRNN":
+        if not cfg.get("return_sequences", False):
+            raise InvalidKerasConfigurationException(
+                "SimpleRNN with return_sequences=False: wrap with "
+                "LastTimeStep manually (not auto-mapped)")
+        if cfg.get("go_backwards", False):
+            raise InvalidKerasConfigurationException(
+                f"{name}: go_backwards RNNs are not auto-mapped (use a "
+                "Bidirectional wrapper or reverse the input)")
+        return SimpleRnn(n_out=int(cfg["units"]),
+                         activation=_act(cfg.get("activation", "tanh")),
+                         name=name)
     raise InvalidKerasConfigurationException(
         f"unsupported Keras layer class '{cls}'")
+
+
+def _pad_pairs(v):
+    """Keras 2D padding/cropping spec: int | (sym_h, sym_w) |
+    ((t, b), (l, r)) -> ((t, b), (l, r))."""
+    if isinstance(v, int):
+        return (v, v), (v, v)
+    a, b = v
+    if isinstance(a, int):
+        return (a, a), (b, b)
+    return (int(a[0]), int(a[1])), (int(b[0]), int(b[1]))
 
 
 def _inbound_names(layer_cfg: dict) -> List[str]:
@@ -377,6 +444,26 @@ def _copy_layer_weights(tgt: dict, layer, ws: Dict[str, np.ndarray],
     elif cls == "EmbeddingSequenceLayer":
         key = "embeddings" if "embeddings" in ws else "kernel"
         _check_and_set(tgt, "W", ws[key])
+    elif cls == "SeparableConvolution2D":
+        # Keras depthwise kernel [kh,kw,c,mult] -> grouped HWIO
+        # [kh,kw,1,c*mult]; pointwise matches directly
+        dk = ws["depthwise_kernel"]
+        kh, kw, c, m = dk.shape
+        _check_and_set(tgt, "dW", dk.reshape(kh, kw, 1, c * m))
+        _check_and_set(tgt, "pW", ws["pointwise_kernel"])
+        if "bias" in ws and "b" in tgt:
+            _check_and_set(tgt, "b", ws["bias"])
+    elif cls == "DepthwiseConvolution2D":
+        dk = ws["depthwise_kernel"]
+        kh, kw, c, m = dk.shape
+        _check_and_set(tgt, "W", dk.reshape(kh, kw, 1, c * m))
+        if "bias" in ws and "b" in tgt:
+            _check_and_set(tgt, "b", ws["bias"])
+    elif cls == "SimpleRnn":
+        _check_and_set(tgt, "W", ws["kernel"])
+        _check_and_set(tgt, "RW", ws["recurrent_kernel"])
+        if "bias" in ws and "b" in tgt:
+            _check_and_set(tgt, "b", ws["bias"])
     else:
         raise InvalidKerasConfigurationException(
             f"no weight mapping for layer {cls} <- keras '{keras_name}'")

@@ -401,9 +401,14 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
             return ds
         # reuse the padded copy across epochs: write_back migrates ITS
         # arrays to device on the first fit, so a reused DataSet still
-        # transfers once (keyed on the original features object)
+        # transfers once. Keyed on the IDENTITY of every array the pad
+        # consumed — replacing labels/masks invalidates the cache.
+        # (In-place writes into the same numpy buffer are not detectable;
+        # replace the array to retrain on new data.)
+        key = (f, ds.labels, ds.features_mask, ds.labels_mask, seg)
         cached = getattr(ds, "_tbptt_padded", None)
-        if cached is not None and cached[0] is f and cached[2] == seg:
+        if cached is not None and len(cached[0]) == len(key) and all(
+                a is b for a, b in zip(cached[0], key)):
             return cached[1]
         n = f.shape[0]
 
@@ -424,7 +429,7 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         padded = DataSet(pad_t(f), labels, features_mask=fmask,
                          labels_mask=lmask)
         try:
-            ds._tbptt_padded = (f, padded, seg)
+            ds._tbptt_padded = (key, padded)
         except AttributeError:
             pass  # exotic immutable containers just re-pad
         return padded

@@ -454,3 +454,122 @@ def test_import_functional_fanout_dense_not_folded(tmp_path, rng):
     want_sm = np.exp(z) / np.exp(z).sum(-1, keepdims=True)
     np.testing.assert_allclose(got_sm, want_sm, rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(got_aux, z @ w2 + b2, rtol=1e-4, atol=1e-5)
+
+
+def test_import_extended_cnn_layers(tmp_path, rng):
+    """SeparableConv2D / DepthwiseConv2D / UpSampling2D / ZeroPadding2D /
+    GlobalMaxPooling2D mappings with weight repacking."""
+    C, M, F = 2, 2, 3
+    dk = rng.normal(size=(3, 3, C, M), scale=0.4).astype(np.float32)
+    pk = rng.normal(size=(1, 1, C * M, F), scale=0.4).astype(np.float32)
+    sb = rng.normal(size=(F,)).astype(np.float32)
+    dk2 = rng.normal(size=(3, 3, F, 1), scale=0.4).astype(np.float32)
+    w = rng.normal(size=(F, 2)).astype(np.float32)
+    b = rng.normal(size=(2,)).astype(np.float32)
+    cfg = {"class_name": "Sequential", "config": {"name": "seq", "layers": [
+        {"class_name": "SeparableConv2D", "config": {
+            "name": "sep", "filters": F, "kernel_size": [3, 3],
+            "strides": [1, 1], "padding": "same", "depth_multiplier": M,
+            "activation": "relu", "use_bias": True,
+            "batch_input_shape": [None, 8, 8, C]}},
+        {"class_name": "ZeroPadding2D", "config": {
+            "name": "zp", "padding": [[1, 1], [1, 1]]}},
+        {"class_name": "DepthwiseConv2D", "config": {
+            "name": "dw", "kernel_size": [3, 3], "strides": [1, 1],
+            "padding": "valid", "depth_multiplier": 1,
+            "activation": "linear", "use_bias": False}},
+        {"class_name": "UpSampling2D", "config": {
+            "name": "up", "size": [2, 2]}},
+        {"class_name": "GlobalMaxPooling2D", "config": {"name": "gmp"}},
+        _dense_cfg("dense", 2, "softmax"),
+    ]}}
+    path = str(tmp_path / "ext.h5")
+    _write_keras_h5(path, cfg, {
+        "sep": {"depthwise_kernel": dk, "pointwise_kernel": pk, "bias": sb},
+        "dw": {"depthwise_kernel": dk2},
+        "dense": {"kernel": w, "bias": b},
+    })
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = rng.normal(size=(2, 8, 8, C)).astype(np.float32)
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, 2)
+    np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+    # depthwise weights landed repacked
+    assert net.params["0"]["dW"].shape == (3, 3, 1, C * M)
+    assert net.params["2"]["W"].shape == (3, 3, 1, F)
+
+
+def test_import_simple_rnn(tmp_path, rng):
+    k = rng.normal(size=(3, 4), scale=0.4).astype(np.float32)
+    rk = rng.normal(size=(4, 4), scale=0.4).astype(np.float32)
+    rb = rng.normal(size=(4,)).astype(np.float32)
+    cfg = {"class_name": "Sequential", "config": {"name": "seq", "layers": [
+        {"class_name": "SimpleRNN", "config": {
+            "name": "rnn", "units": 4, "activation": "tanh",
+            "return_sequences": True,
+            "batch_input_shape": [None, 6, 3]}},
+    ]}}
+    path = str(tmp_path / "rnn.h5")
+    _write_keras_h5(path, cfg, {
+        "rnn": {"kernel": k, "recurrent_kernel": rk, "bias": rb}})
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    np.testing.assert_allclose(np.asarray(net.params["0"]["W"]), k)
+    np.testing.assert_allclose(np.asarray(net.params["0"]["RW"]), rk)
+    x = rng.normal(size=(2, 6, 3)).astype(np.float32)
+    y = np.asarray(net.output(x))
+    assert y.shape == (2, 6, 4)
+    # oracle: plain tanh RNN
+    h = np.zeros((2, 4), np.float32)
+    want = []
+    for t in range(6):
+        h = np.tanh(x[:, t] @ k + h @ rk + rb)
+        want.append(h)
+    np.testing.assert_allclose(y, np.stack(want, 1), rtol=1e-4, atol=1e-5)
+
+
+def test_import_depthwise_numeric_oracle(tmp_path, rng):
+    """depth_multiplier > 1 repack checked against an explicit loop (a
+    transposed reshape would silently pass shape-only checks)."""
+    C, M = 2, 2
+    dk = rng.normal(size=(3, 3, C, M), scale=0.5).astype(np.float32)
+    cfg = {"class_name": "Sequential", "config": {"name": "s", "layers": [
+        {"class_name": "DepthwiseConv2D", "config": {
+            "name": "dw", "kernel_size": [3, 3], "strides": [1, 1],
+            "padding": "valid", "depth_multiplier": M,
+            "activation": "linear", "use_bias": False,
+            "batch_input_shape": [None, 5, 5, C]}},
+    ]}}
+    path = str(tmp_path / "dw.h5")
+    _write_keras_h5(path, cfg, {"dw": {"depthwise_kernel": dk}})
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = rng.normal(size=(1, 5, 5, C)).astype(np.float32)
+    got = np.asarray(net.output(x))
+    # TF depthwise semantics: out[..., c*M + m] = conv(x[..., c], dk[..., c, m])
+    want = np.zeros((1, 3, 3, C * M), np.float32)
+    for i in range(3):
+        for j in range(3):
+            patch = x[0, i:i + 3, j:j + 3, :]                 # [3, 3, C]
+            for c in range(C):
+                for m in range(M):
+                    want[0, i, j, c * M + m] = np.sum(
+                        patch[:, :, c] * dk[:, :, c, m])
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_import_rejects_unsupported_rnn_and_dilation(tmp_path, rng):
+    for layers, match in [
+        ([{"class_name": "SimpleRNN", "config": {
+            "name": "r", "units": 4, "return_sequences": True,
+            "go_backwards": True, "batch_input_shape": [None, 6, 3]}}],
+         "go_backwards"),
+        ([{"class_name": "DepthwiseConv2D", "config": {
+            "name": "d", "kernel_size": [3, 3], "dilation_rate": [2, 2],
+            "padding": "valid", "batch_input_shape": [None, 8, 8, 2]}}],
+         "dilated"),
+    ]:
+        cfg = {"class_name": "Sequential",
+               "config": {"name": "s", "layers": layers}}
+        path = str(tmp_path / f"bad_{match}.h5")
+        _write_keras_h5(path, cfg, {})
+        with pytest.raises(InvalidKerasConfigurationException, match=match):
+            KerasModelImport.import_keras_sequential_model_and_weights(path)

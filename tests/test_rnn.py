@@ -482,3 +482,27 @@ def test_tbptt_prepad_caches_across_epochs(rng):
     net.fit_batch(ds)
     assert ds._tbptt_padded[1] is padded1  # same copy, no re-pad
     assert ds.features is x                # caller arrays untouched
+
+
+def test_tbptt_prepad_cache_invalidates_on_label_change(rng):
+    from deeplearning4j_tpu.conf.multilayer import BackpropType
+
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2))
+            .list()
+            .layer(SimpleRnn(n_out=4))
+            .layer(RnnOutputLayer(n_out=2, activation=Activation.SOFTMAX))
+            .set_input_type(InputType.recurrent(2, timesteps=7))
+            .backprop_type(BackpropType.TRUNCATED_BPTT, 3, 3)
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = rng.normal(size=(4, 7, 2)).astype(np.float32)
+    y1 = np.eye(2, dtype=np.float32)[np.zeros((4, 7), int)]
+    y2 = np.eye(2, dtype=np.float32)[np.ones((4, 7), int)]
+    ds = DataSet(x, y1)
+    net.fit_batch(ds)
+    first = ds._tbptt_padded[1]
+    ds.labels = y2              # swapping labels must invalidate the cache
+    net.fit_batch(ds)
+    assert ds._tbptt_padded[1] is not first
+    np.testing.assert_allclose(
+        np.asarray(ds._tbptt_padded[1].labels[:, :7]), y2)
