@@ -24,7 +24,7 @@ import numpy as np
 N_BATCHES = 12
 
 METRIC = "resnet50_train_images_per_sec_per_chip"
-BATCH = 64
+BATCH = 256
 IMG = 224
 CLASSES = 1000
 RUNS = 5
@@ -32,15 +32,24 @@ BASELINE_FILE = Path(__file__).parent / "BENCH_BASELINE.json"
 
 
 def main():
+    import dataclasses
+
     import jax
 
     from deeplearning4j_tpu.conf.updaters import Adam
     from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
     from deeplearning4j_tpu.zoo.graphs import ResNet50
 
     devices = jax.devices()
-    net = ResNet50(num_classes=CLASSES, height=IMG, width=IMG,
-                   updater=Adam(learning_rate=1e-3)).init()
+    # protocol v4: batch 256 + the bf16 compute policy (f32 master params,
+    # bf16 forward/backward — conf.compute_dtype). Measured on v5e: device
+    # step 64ms -> 34ms at batch 64, 115ms at batch 256 (2.2x throughput);
+    # see BASELINE.md MFU table.
+    cfg = ResNet50(num_classes=CLASSES, height=IMG, width=IMG,
+                   updater=Adam(learning_rate=1e-3)).conf()
+    cfg = dataclasses.replace(cfg, compute_dtype="bfloat16")
+    net = ComputationGraph(cfg).init()
 
     from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
 
@@ -88,7 +97,7 @@ def main():
         baselines[METRIC] = {
             "value": images_per_sec,
             "config": f"ResNet50 train, batch={BATCH}, {IMG}x{IMG}x3 uint8 in, "
-                      f"{CLASSES} classes, f32 params (bf16 MXU passes)",
+                      f"{CLASSES} classes, f32 params + bf16 compute policy",
             "device": str(devices[0]),
         }
         BASELINE_FILE.write_text(json.dumps(baselines, indent=2))

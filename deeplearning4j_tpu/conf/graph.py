@@ -409,6 +409,8 @@ class ComputationGraphConfiguration:
     tbptt_fwd_length: int = 20
     tbptt_back_length: int = 20
     dtype: str = "float32"
+    # mixed-precision compute dtype (see MultiLayerConfiguration.compute_dtype)
+    compute_dtype: Optional[str] = None
 
     def to_json(self) -> str:
         return serde.to_json(self)
@@ -549,6 +551,7 @@ class GraphBuilder:
             tbptt_fwd_length=self._tbptt_fwd,
             tbptt_back_length=self._tbptt_back,
             dtype=self._base._dtype,
+            compute_dtype=self._base._compute_dtype,
         )
         if self._input_types:
             _insert_graph_preprocessors(conf)

@@ -362,25 +362,31 @@ class BatchNormalization(BaseLayer):
 
     def forward(self, params, state, x, train=False, rng=None):
         axes = tuple(range(x.ndim - 1))  # all but channel/feature axis
+        # statistics in the STATE dtype (f32 under the bf16 compute
+        # policy): a bf16 mean/var over 1e5+ elements accumulates visible
+        # error, and quantizing the running averages every step would
+        # drift them; the casts fuse into the surrounding elementwise ops
+        sdt = state["mean"].dtype
+        x32 = x.astype(sdt)
         if train:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            mean = jnp.mean(x32, axis=axes)
+            var = jnp.var(x32, axis=axes)
             new_state = {
                 "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
                 "var": self.decay * state["var"] + (1 - self.decay) * var,
             }
         elif self.use_batch_mean_in_eval:
             # reference isMinibatch=false: batch statistics at inference
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            mean = jnp.mean(x32, axis=axes)
+            var = jnp.var(x32, axis=axes)
             new_state = state
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
-        xhat = (x - mean) * lax.rsqrt(var + self.eps)
+        xhat = (x32 - mean) * lax.rsqrt(var + self.eps)
         if not self.lock_gamma_beta:
             xhat = xhat * params["gamma"] + params["beta"]
-        return self.activation.apply(xhat), new_state
+        return self.activation.apply(xhat).astype(x.dtype), new_state
 
 
 @serde.register

@@ -56,6 +56,10 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         self._output_fn = None
         self._score_fn = None
         self._dtype = jnp.dtype(conf.dtype)
+        # mixed precision: forward/backward in compute_dtype (bf16), params/
+        # opt-state/BN-stats/loss in dtype (f32 masters) — see the conf field
+        self._cdtype = (jnp.dtype(conf.compute_dtype)
+                        if getattr(conf, "compute_dtype", None) else None)
         self._base_key = jax.random.PRNGKey(conf.seed)
         self._topo = conf.topo_order()
         self._vmap = conf.vertex_map()
@@ -126,16 +130,33 @@ class ComputationGraph(nn_io.LazyScoreMixin):
                     "(reference: outputs must be IOutputLayer vertices)")
         return specs
 
+    def _fwd_cast(self, params, features: Sequence, full: bool = False):
+        """Mixed-precision cast: params/features to the compute dtype.
+        ``full=True`` = the pass runs through the output vertices — their
+        params stay f32 masters so logits land in the storage dtype.
+        No-op without a policy."""
+        if self._cdtype is None:
+            return params, tuple(features)
+        cast = nn_io.cast_floats(params, self._cdtype)
+        if full:
+            for name in self.conf.network_outputs:
+                if name in params:
+                    cast[name] = params[name]
+        return cast, nn_io.cast_floats(tuple(features), self._cdtype)
+
     def _loss(self, params, state, features: Sequence, labels: Sequence,
               lmasks: Sequence, rng, train=True):
         features = tuple(self._dequant(f, i)
                          for i, f in enumerate(features))
         out_specs = self._output_specs()
-        acts, new_state = self._forward(params, state, features, train, rng,
-                                        skip={s.name for s in out_specs})
+        fwd_params, features = self._fwd_cast(params, features)
+        acts, new_state = self._forward(fwd_params, state, features, train,
+                                        rng, skip={s.name for s in out_specs})
         loss = 0.0
         for i, spec in enumerate(out_specs):
-            x = acts[spec.inputs[0]]
+            # output-vertex activation + loss in the storage dtype on the
+            # f32 master params (bf16 log-softmax loses gradient bits)
+            x = acts[spec.inputs[0]].astype(self._dtype)
             loss = loss + spec.vertex.score(params.get(spec.name, {}), x,
                                             labels[i], lmasks[i])
         loss = loss + self._regularization_score(params)
@@ -247,7 +268,7 @@ class ComputationGraph(nn_io.LazyScoreMixin):
     def _dequant(self, x, idx: int = 0):
         scale = (nn_io.image_input(self.conf.input_types[idx])
                  if idx < len(self.conf.input_types) else True)
-        return nn_io.dequant(x, self._dtype, scale=scale)
+        return nn_io.dequant(x, self._cdtype or self._dtype, scale=scale)
 
     def _prep_batch(self, ds, lazy_lmasks: bool = False,
                     write_back: bool = False):
@@ -343,9 +364,11 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         if self._output_fn is None:
             def out(params, state, xs):
                 xs = tuple(self._dequant(x, i) for i, x in enumerate(xs))
+                params, xs = self._fwd_cast(params, xs, full=True)
                 acts, _ = self._forward(params, state, xs, train=False,
                                         rng=None)
-                return tuple(acts[n] for n in self.conf.network_outputs)
+                return tuple(acts[n].astype(self._dtype)
+                             for n in self.conf.network_outputs)
 
             self._output_fn = jax.jit(out)
         # jax.Arrays pass through (keeps committed shardings); uint8

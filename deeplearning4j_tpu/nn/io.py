@@ -49,6 +49,19 @@ def dequant(x, dtype, scale: bool = True):
     return x
 
 
+def cast_floats(tree, dtype):
+    """Cast every floating-point leaf of a pytree to ``dtype`` (the
+    mixed-precision compute cast: f32 master params -> bf16 compute
+    copies inside the jitted step; its transpose under ``jax.grad``
+    up-casts gradients back to the master dtype for free). Non-float
+    leaves (int token ids, uint8 images) pass through untouched."""
+    dt = jnp.dtype(dtype)
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dt)
+        if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != dt else x,
+        tree)
+
+
 def image_input(input_type) -> bool:
     """Whether a network InputType is image-shaped (uint8 batches then mean
     pixels, dequantized to [0,1]); non-image uint8 (token ids) only cast."""

@@ -69,6 +69,10 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         self._rnn_step_fn = None
         self._rnn_carries = None
         self._dtype = jnp.dtype(conf.dtype)
+        # mixed precision: forward/backward in compute_dtype (bf16), params/
+        # opt-state/BN-stats/loss in dtype (f32 masters) — see the conf field
+        self._cdtype = (jnp.dtype(conf.compute_dtype)
+                        if getattr(conf, "compute_dtype", None) else None)
         self._base_key = jax.random.PRNGKey(conf.seed)
 
     # --- lifecycle ---------------------------------------------------------
@@ -146,17 +150,39 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         return last
 
     def _dequant(self, x):
-        return nn_io.dequant(x, self._dtype,
+        return nn_io.dequant(x, self._cdtype or self._dtype,
                              scale=nn_io.image_input(self.conf.input_type))
+
+    def _fwd_cast(self, params, x, fmask, full: bool = False):
+        """Mixed-precision cast for one forward pass: params/input/mask to
+        the compute dtype. ``full=True`` = the pass runs THROUGH the output
+        layer — its params stay f32 masters so logits land in the storage
+        dtype (promotion does the upcast). No-op without a policy."""
+        if self._cdtype is None:
+            return params, x, fmask
+        cast = nn_io.cast_floats(params, self._cdtype)
+        if full:
+            last = str(len(self.conf.layers) - 1)
+            if last in params:
+                cast[last] = params[last]
+        x, fmask = nn_io.cast_floats((x, fmask), self._cdtype)
+        return cast, x, fmask
 
     def _loss(self, params, state, features, labels, fmask, lmask, rng,
               train=True, carries=None):
         features = self._dequant(features)
         out_layer = self._output_layer()
         last = len(self.conf.layers) - 1
+        fwd_params, features, fmask = self._fwd_cast(params, features, fmask)
+        if self._cdtype is not None and carries is not None:
+            carries = nn_io.cast_floats(carries, self._cdtype)
         x, new_state, new_carries = self._forward(
-            params, state, features, train=train, rng=rng, fmask=fmask,
+            fwd_params, state, features, train=train, rng=rng, fmask=fmask,
             upto=last, carries=carries)
+        # output-layer activation + loss in the storage dtype on the f32
+        # master params: log-softmax over many classes is exactly where
+        # bf16 loses bits that show up in gradients
+        x = x.astype(self._dtype)
         loss = out_layer.score(params.get(str(last), {}), x, labels, lmask)
         loss = loss + solver.regularization_score(self.conf.layers, params)
         return loss, (new_state, new_carries)
@@ -262,18 +288,24 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
 
     def _build_output_fn(self):
         def out(params, state, x, fmask):
-            y, _, _ = self._forward(params, state, self._dequant(x),
+            params, x, fmask = self._fwd_cast(params, self._dequant(x),
+                                              fmask, full=True)
+            y, _, _ = self._forward(params, state, x,
                                     train=False, rng=None, fmask=fmask)
-            return y
+            return y.astype(self._dtype)
 
         return jax.jit(out)
 
     def _build_rnn_step_fn(self):
         def out(params, state, carries, x, fmask):
+            params, x, fmask = self._fwd_cast(params, self._dequant(x),
+                                              fmask, full=True)
+            if self._cdtype is not None:
+                carries = nn_io.cast_floats(carries, self._cdtype)
             y, _, new_carries = self._forward(
-                params, state, self._dequant(x), train=False, rng=None,
+                params, state, x, train=False, rng=None,
                 fmask=fmask, carries=carries)
-            return y, new_carries
+            return y.astype(self._dtype), new_carries
 
         return jax.jit(out)
 
@@ -515,7 +547,7 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
             ones_t = (np.ones if isinstance(lmask, np.ndarray)
                       else jnp.ones)((n, total_t), self._dtype)
             lmask = lmask[:, None] * ones_t
-        carries = {str(i): layer.zero_carry(n, self._dtype)
+        carries = {str(i): layer.zero_carry(n, self._cdtype or self._dtype)
                    for i, layer in enumerate(self.conf.layers)
                    if getattr(layer, "has_carry", False)}
         if back == seg:
@@ -592,7 +624,7 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         n = x.shape[0]
         if self._rnn_carries is None:
             self._rnn_carries = {
-                str(i): layer.zero_carry(n, self._dtype)
+                str(i): layer.zero_carry(n, self._cdtype or self._dtype)
                 for i, layer in enumerate(self.conf.layers)
                 if getattr(layer, "has_carry", False)}
         fmask = (None if fmask is None
@@ -606,17 +638,22 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         self._rnn_carries = None
 
     def rnn_get_previous_state(self, layer_idx: int):
-        """Reference ``#rnnGetPreviousState(layer)``."""
+        """Reference ``#rnnGetPreviousState(layer)``. Returned state is in
+        the storage dtype (internal carries live in the compute dtype)."""
         if self._rnn_carries is None:
             return None
-        return self._rnn_carries.get(str(layer_idx))
+        c = self._rnn_carries.get(str(layer_idx))
+        if c is None or self._cdtype is None:
+            return c
+        return nn_io.cast_floats(c, self._dtype)
 
     def rnn_set_previous_state(self, layer_idx: int, state: dict):
         """Reference ``#rnnSetPreviousState(layer, state)``."""
         if self._rnn_carries is None:
             self._rnn_carries = {}
         self._rnn_carries[str(layer_idx)] = {
-            k: jnp.asarray(v, self._dtype) for k, v in state.items()}
+            k: jnp.asarray(v, self._cdtype or self._dtype)
+            for k, v in state.items()}
 
     # --- inference / scoring ----------------------------------------------
     def output(self, x, batch_size: Optional[int] = None, fmask=None):

@@ -1,0 +1,134 @@
+"""Mixed-precision compute policy (``conf.compute_dtype``).
+
+Contract (BASELINE.md round-2 MFU work): forward/backward run in the
+compute dtype (bf16), while params, optimizer state, BN statistics, the
+loss, and all user-visible outputs stay in the storage dtype (f32
+masters). The reference has one global DataType
+(``NeuralNetConfiguration.Builder#dataType``); the TPU-first design
+splits storage from compute because bf16 matmuls are ~2x faster on the
+MXU while f32 masters keep updater semantics exact.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.conf.activations import Activation
+from deeplearning4j_tpu.conf.inputs import InputType
+from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.conf.layers_cnn import (
+    BatchNormalization,
+    ConvolutionLayer,
+)
+from deeplearning4j_tpu.conf.layers_rnn import LSTM, RnnOutputLayer
+from deeplearning4j_tpu.conf.losses import LossMCXENT
+from deeplearning4j_tpu.conf.multilayer import (
+    BackpropType,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.conf.updaters import Adam
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _cnn_conf(compute_dtype=None):
+    b = (NeuralNetConfiguration.builder().seed(7)
+         .updater(Adam(learning_rate=1e-2)))
+    if compute_dtype is not None:
+        b = b.compute_dtype(compute_dtype)
+    return (b.list()
+            .layer(ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                    activation=Activation.RELU))
+            .layer(BatchNormalization())
+            .layer(DenseLayer(n_out=32, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=10, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.convolutional(8, 8, 1)).build())
+
+
+def _batch(n=16):
+    rng = np.random.default_rng(0)
+    return DataSet(rng.normal(size=(n, 8, 8, 1)).astype(np.float32),
+                   np.eye(10, dtype=np.float32)[rng.integers(0, 10, n)])
+
+
+def test_json_round_trip_preserves_compute_dtype():
+    conf = _cnn_conf("bfloat16")
+    conf2 = type(conf).from_json(conf.to_json())
+    assert conf2.compute_dtype == "bfloat16"
+    assert _cnn_conf().compute_dtype is None
+
+
+def test_bf16_policy_trains_and_keeps_f32_masters():
+    net = MultiLayerNetwork(_cnn_conf("bfloat16")).init()
+    ds = _batch()
+    l0 = net.fit_batch(ds)
+    for _ in range(30):
+        l = net.fit_batch(ds)
+    assert l < l0 * 0.7
+    for lp in net.params.values():
+        for pv in lp.values():
+            assert pv.dtype == jnp.float32
+    for s in net.state.values():  # BN running stats stay f32
+        for sv in s.values():
+            assert sv.dtype == jnp.float32
+    out = net.output(ds.features)
+    assert out.dtype == jnp.float32
+
+
+def test_bf16_policy_tracks_f32_training():
+    """Same seed/data: the bf16 run should follow the f32 run closely —
+    the policy changes precision, not semantics."""
+    ds = _batch()
+    nets = [MultiLayerNetwork(_cnn_conf(cd)).init()
+            for cd in (None, "bfloat16")]
+    losses = []
+    for net in nets:
+        for _ in range(10):
+            l = net.fit_batch(ds)
+        losses.append(l)
+    assert losses[1] == pytest.approx(losses[0], rel=0.25)
+
+
+def test_bf16_policy_tbptt_and_streaming():
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater(Adam(learning_rate=1e-2)).compute_dtype("bfloat16")
+            .list()
+            .layer(LSTM(n_out=16))
+            .layer(RnnOutputLayer(n_out=4, activation=Activation.SOFTMAX,
+                                  loss_fn=LossMCXENT()))
+            .backprop_type(BackpropType.TRUNCATED_BPTT, fwd=5, back=5)
+            .set_input_type(InputType.recurrent(3, 20)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(size=(4, 20, 3)).astype(np.float32),
+                 np.eye(4, dtype=np.float32)[rng.integers(0, 4, (4, 20))])
+    l0 = net.fit_batch(ds)
+    for _ in range(20):
+        l = net.fit_batch(ds)
+    assert l < l0
+    y = net.rnn_time_step(rng.normal(size=(4, 2, 3)).astype(np.float32))
+    assert y.dtype == jnp.float32 and y.shape == (4, 2, 4)
+
+
+def test_bf16_policy_computation_graph():
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.zoo.graphs import ResNet50
+
+    cfg = ResNet50(num_classes=10, height=32, width=32,
+                   updater=Adam(learning_rate=1e-3)).conf()
+    cfg = dataclasses.replace(cfg, compute_dtype="bfloat16")
+    g = ComputationGraph(cfg).init()
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.integers(0, 256, (8, 32, 32, 3), dtype=np.uint8),
+                 np.eye(10, dtype=np.float32)[rng.integers(0, 10, 8)])
+    l0 = g.fit_batch(ds)
+    for _ in range(10):
+        l = g.fit_batch(ds)
+    assert l < l0
+    for lp in g.params.values():
+        for pv in lp.values():
+            assert pv.dtype == jnp.float32
+    assert g.output(ds.features).dtype == jnp.float32
