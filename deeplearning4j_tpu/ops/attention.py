@@ -14,8 +14,10 @@ one semantics,
   local compute inside ring attention (ops/ring.py).
 - ``flash_attention``: Pallas TPU kernel (fwd + custom-VJP bwd), blocks
   streamed HBM→VMEM by the pipeline, f32 accumulators in VMEM scratch,
-  log-sum-exp saved for the backward. Grid iterates key blocks in the
-  innermost (sequential) dimension so scratch persists across them.
+  softmax max/denominator saved lane-replicated for the backward. Grid
+  iterates key blocks in the innermost (sequential) dimension so scratch
+  persists across them; on the v5e this is the fastest trainable path at
+  long T (BASELINE.md round-2 table) and the only one at T=16k.
 
 All take ``q, k, v: [batch, heads, time, head_dim]``, optional
 ``key_mask: [batch, time_k]`` (1.0 = valid, 0.0 = padding) and ``causal``.
@@ -122,147 +124,196 @@ def blockwise_attention(q, k, v, key_mask=None, causal=False, scale=None,
 # ---------------------------------------------------------------------------
 # Tier 2: Pallas flash kernel
 # ---------------------------------------------------------------------------
+#
+# Mosaic-friendly structure (the round-1 kernel lost 9-14x to XLA; these
+# are the fixes, each a measured TPU layout/pipeline rule):
+# - every ref keeps >= 128 lanes: running max/denominator live as
+#   [block_q, 128] lane-replicated tiles (a [bq, 1] ref forces degenerate
+#   1-lane layouts), and the key-padding mask is laid out lane-major as
+#   [batch, 8, Tk] instead of [.., Tk, 1];
+# - 4D grid (batch, heads, q blocks, k blocks) over the native
+#   [B, H, T, D] arrays — no host-side reshape to [B*H, T, D];
+# - causal skipping redirects the kv index map to block 0 for skipped
+#   blocks, so the pipeline never DMAs data the kernel won't read
+#   (a pl.when gate alone still pays the HBM traffic);
+# - the accumulator is kept pre-normalized (rescaled by 1/l every step),
+#   so the final store is a cast, and softmax residuals are saved as
+#   l and m (lane-replicated) rather than one packed lse.
 
-def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, sm, causal, block_q, block_k, nk,
-                tq, tk):
-    j = pl.program_id(2)
+_LANES = 128
+_SUBLANES = 8
+
+
+def _below_diag(i, bq, j, bk, off):
+    """True when key block j intersects the causal lower triangle of query
+    block i (``off = tk - tq`` aligns the diagonal for cross-attention)."""
+    return (i + 1) * bq - 1 + off >= j * bk
+
+
+def _rep(x, n):
+    """[bq, 128] lane-replicated tile -> [bq, n] (n % 128 == 0 on TPU;
+    n < 128 happens only with the small blocks interpret-mode tests use)."""
+    return jnp.tile(x, (1, n // _LANES)) if n >= _LANES else x[:, :n]
+
+
+_lane_fit = _rep  # accumulator width d follows the same rule
+
+
+def _block_mask(km_ref, causal, i, j, bq, bk, off):
+    """Combined padding+causal mask for the current [bq, bk] tile, or None."""
+    mask = None
+    if km_ref is not None:
+        mask = km_ref[0, :1, :] > 0  # [1, bk], broadcasts over rows
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq + off
+        cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
+        cm = cols <= rows
+        mask = cm if mask is None else jnp.logical_and(mask, cm)
+    return mask
+
+
+def _scores(q_ref, k_ref, km_ref, sm, causal, i, j, off):
+    """Masked, scaled [bq, bk] logits tile in f32."""
+    s = jax.lax.dot_general(
+        q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if sm != 1.0:
+        s = s * sm
+    bq, bk = s.shape
+    mask = _block_mask(km_ref, causal, i, j, bq, bk, off)
+    return s if mask is None else jnp.where(mask, s, NEG_INF)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, l_ref, m_ref,
+                m_sc, l_sc, acc_sc, *, sm, causal, nk, off):
+    j = pl.program_id(3)
 
     @pl.when(j == 0)
     def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
+        m_sc[...] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
 
-    i = pl.program_id(1)
-    # causal: key block strictly above the diagonal contributes nothing
-    run = True if not causal else (j * block_k <= (i + 1) * block_q - 1 + (tk - tq))
+    i = pl.program_id(2)
+    bq, d = q_ref.shape[2], q_ref.shape[3]
+    bk = k_ref.shape[2]
+    run = True if not causal else _below_diag(i, bq, j, bk, off)
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * sm
-        km = km_ref[0, :, 0].astype(jnp.float32)
-        s = jnp.where(km[None, :] > 0, s, NEG_INF)
-        if causal:
-            qpos = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0) + (tk - tq)
-            kpos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kpos <= qpos, s, NEG_INF)
-        m_prev = m_ref[...]  # [bq, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m_prev - m_new)
-        l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
-            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_ref[...] = m_new
+        s = _scores(q_ref, k_ref, km_ref, sm, causal, i, j, off)
+        m_prev, l_prev = m_sc[...], l_sc[...]
+        m_next = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])  # [bq,128]
+        p = jnp.exp(s - _rep(m_next, bk))
+        alpha = jnp.exp(m_prev - m_next)
+        l_corr = alpha * l_prev
+        l_next = jnp.sum(p, axis=1)[:, None] + l_corr
+        m_sc[...] = m_next
+        l_sc[...] = l_next
+        l_inv = jnp.where(l_next == 0.0, 1.0, 1.0 / l_next)
+        acc_sc[...] *= _lane_fit(l_corr * l_inv, d)
+        pv = jax.lax.dot(p.astype(v_ref.dtype), v_ref[0, 0],
+                         preferred_element_type=jnp.float32)
+        acc_sc[...] += pv * _lane_fit(l_inv, d)
 
     @pl.when(j == nk - 1)
-    def _final():
-        l = jnp.maximum(l_ref[...], 1e-30)
-        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
-        lse_ref[0] = m_ref[...] + jnp.log(l)
+    def _store():
+        o_ref[0, 0] = acc_sc[...].astype(o_ref.dtype)
+        l_ref[0, 0] = l_sc[...]
+        m_ref[0, 0] = m_sc[...]
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, delta_ref,
-               dq_out, dq_acc, *, sm, causal, block_q, block_k, nk, tq, tk):
-    j = pl.program_id(2)
+def _p_tile(q_ref, k_ref, km_ref, l_ref, m_ref, sm, causal, i, j, off):
+    """Recompute the normalized probability tile p = exp(s - m) / l."""
+    s = _scores(q_ref, k_ref, km_ref, sm, causal, i, j, off)
+    bk = s.shape[1]
+    l = l_ref[0, 0]
+    l_inv = jnp.where(l == 0.0, 1.0, 1.0 / l)
+    return jnp.exp(s - _rep(m_ref[0, 0], bk)) * _rep(l_inv, bk)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, l_ref, m_ref, di_ref,
+               dq_ref, dq_sc, *, sm, causal, nk, off):
+    j = pl.program_id(3)
 
     @pl.when(j == 0)
     def _init():
-        dq_acc[...] = jnp.zeros_like(dq_acc)
+        dq_sc[...] = jnp.zeros_like(dq_sc)
 
-    i = pl.program_id(1)
-    run = True if not causal else (j * block_k <= (i + 1) * block_q - 1 + (tk - tq))
+    i = pl.program_id(2)
+    bq = q_ref.shape[2]
+    bk = k_ref.shape[2]
+    run = True if not causal else _below_diag(i, bq, j, bk, off)
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * sm
-        km = km_ref[0, :, 0].astype(jnp.float32)
-        s = jnp.where(km[None, :] > 0, s, NEG_INF)
-        if causal:
-            qpos = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0) + (tk - tq)
-            kpos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kpos <= qpos, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0])
-        do = do_ref[0].astype(jnp.float32)
-        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
-                                 (((1,), (1,)), ((), ())),
+        p = _p_tile(q_ref, k_ref, km_ref, l_ref, m_ref, sm, causal, i, j, off)
+        do = do_ref[0, 0]
+        dp = jax.lax.dot_general(do, v_ref[0, 0], (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0])
-        dq_acc[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm
+        ds = p * (dp - _rep(di_ref[0, 0], bk))
+        if sm != 1.0:
+            ds = ds * sm
+        dq_sc[...] += jax.lax.dot(ds.astype(k_ref.dtype), k_ref[0, 0],
+                                  preferred_element_type=jnp.float32)
 
     @pl.when(j == nk - 1)
-    def _final():
-        dq_out[0] = dq_acc[...].astype(dq_out.dtype)
+    def _store():
+        dq_ref[0, 0] = dq_sc[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, delta_ref,
-                dk_out, dv_out, dk_acc, dv_acc, *, sm, causal, block_q,
-                block_k, nq, tq, tk):
-    i = pl.program_id(2)  # query block index (innermost)
-    j = pl.program_id(1)  # key block index
+def _dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, l_ref, m_ref, di_ref,
+                dk_ref, dv_ref, dk_sc, dv_sc, *, sm, causal, nq, off):
+    i = pl.program_id(3)  # query block (innermost, sequential)
+    j = pl.program_id(2)  # key block
 
     @pl.when(i == 0)
     def _init():
-        dk_acc[...] = jnp.zeros_like(dk_acc)
-        dv_acc[...] = jnp.zeros_like(dv_acc)
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
 
-    run = True if not causal else (j * block_k <= (i + 1) * block_q - 1 + (tk - tq))
+    bq = q_ref.shape[2]
+    bk = k_ref.shape[2]
+    run = True if not causal else _below_diag(i, bq, j, bk, off)
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * sm
-        km = km_ref[0, :, 0].astype(jnp.float32)
-        s = jnp.where(km[None, :] > 0, s, NEG_INF)
-        if causal:
-            qpos = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0) + (tk - tq)
-            kpos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kpos <= qpos, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0])  # [bq, bk]
-        do = do_ref[0].astype(jnp.float32)
-        dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
-                                 (((1,), (1,)), ((), ())),
+        p = _p_tile(q_ref, k_ref, km_ref, l_ref, m_ref, sm, causal, i, j, off)
+        do = do_ref[0, 0]
+        dv_sc[...] += jax.lax.dot(p.astype(do.dtype).T, do,
+                                  preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_ref[0, 0], (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0])
-        dk_acc[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm
+        ds = p * (dp - _rep(di_ref[0, 0], bk))
+        if sm != 1.0:
+            ds = ds * sm
+        dk_sc[...] += jax.lax.dot(ds.astype(q_ref.dtype).T, q_ref[0, 0],
+                                  preferred_element_type=jnp.float32)
 
     @pl.when(i == nq - 1)
-    def _final():
-        dk_out[0] = dk_acc[...].astype(dk_out.dtype)
-        dv_out[0] = dv_acc[...].astype(dv_out.dtype)
+    def _store():
+        dk_ref[0, 0] = dk_sc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_sc[...].astype(dv_ref.dtype)
 
 
 def _tpu_compiler_params(interpret: bool):
-    """Mosaic params shared by the three kernels: batch and q/k-block grid
-    dims are parallel, the streamed (scratch-accumulating) dim sequential."""
+    """Batch/head/query grid dims are parallel; the innermost streamed
+    (scratch-accumulating) dim is sequential."""
     if interpret or not _HAS_PLTPU:
         return None
     return pltpu.CompilerParams(
-        dimension_semantics=("parallel", "parallel", "arbitrary"),
-        vmem_limit_bytes=64 * 1024 * 1024)
+        dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
+
+
+def _cost(b, h, tq, tk, d, causal, bwd: bool):
+    """Rough CostEstimate so Mosaic schedules the pipeline sensibly."""
+    frac = 0.5 if causal else 1.0
+    matmuls = 5 if bwd else 2  # s, pv fwd; s, dp, dq, dk, dv bwd
+    return pl.CostEstimate(
+        flops=int(matmuls * 2 * b * h * tq * tk * d * frac),
+        transcendentals=int(b * h * tq * tk * frac),
+        bytes_accessed=int((4 if bwd else 2) * b * h * (tq + tk) * d * 2),
+    )
 
 
 def _pad_t(x, blk):
@@ -272,161 +323,211 @@ def _pad_t(x, blk):
         if pad else (x, t)
 
 
+def _mask_operand(km, b, tk0, tk):
+    """Lane-major mask operand [batch, 8, tk] (sublane-tiled), or None
+    when no mask is needed. Padding keys forced to 0 even without a user
+    mask (the padded tail must not attend). Always f32: Mosaic's VPU has
+    no bf16 compare, and the kernel tests ``> 0`` directly."""
+    if km is None and tk == tk0:
+        return None
+    if km is None:
+        km = jnp.ones((b, tk0), jnp.float32)
+    km = jnp.pad(jnp.asarray(km, jnp.float32), ((0, 0), (0, tk - tk0)))
+    return jnp.broadcast_to(km[:, None, :], (b, _SUBLANES, km.shape[1]))
+
+
+def _blk(requested, t):
+    """Effective block size: >= one lane tile, padded-t divides it."""
+    return min(requested, max(_LANES, 1 << (t - 1).bit_length()))
+
+
+def _index_maps(causal, bq, bk, off):
+    """(q, kv, mask) BlockSpec index maps for grid (b, h, i_q, j_kv). The
+    causal redirect points skipped kv blocks at block 0 so the pipeline
+    never DMAs data the kernel won't read — shared by fwd and dq so the
+    skip logic cannot diverge between them."""
+
+    def q_map(b_, h_, i, j):
+        return (b_, h_, i, 0)
+
+    def kv_map(b_, h_, i, j):
+        if causal:
+            j = jax.lax.select(_below_diag(i, bq, j, bk, off), j, 0)
+        return (b_, h_, j, 0)
+
+    def km_map(b_, h_, i, j):
+        if causal:
+            j = jax.lax.select(_below_diag(i, bq, j, bk, off), j, 0)
+        return (b_, 0, j)
+
+    return q_map, kv_map, km_map
+
+
 def _flash_fwd_impl(q, k, v, km, causal, scale, block_q, block_k, interpret):
     b, h, tq0, d = q.shape
     tk0 = k.shape[2]
+    if d > _LANES and d % _LANES:
+        raise NotImplementedError(
+            f"head_dim {d} > {_LANES} must be a multiple of {_LANES}")
     sm = _scale(q, scale)
-    bq = min(block_q, max(tq0, 8))
-    bk = min(block_k, max(tk0, 8))
+    bq = _blk(block_q, tq0)
+    bk = _blk(block_k, tk0)
     q, tq = _pad_t(q, bq)
     k, tk = _pad_t(k, bk)
     v, _ = _pad_t(v, bk)
-    km = jnp.pad(jnp.asarray(km, q.dtype), ((0, 0), (0, tk - tk0)))
-
-    bh = b * h
-    qf = q.reshape(bh, tq, d)
-    kf = k.reshape(bh, tk, d)
-    vf = v.reshape(bh, tk, d)
-    kmf = jnp.broadcast_to(km[:, None, :], (b, h, tk)).reshape(bh, tk, 1)
+    kmo = _mask_operand(km, b, tk0, tk)
     nq, nk = tq // bq, tk // bk
+    off = tk0 - tq0
+    q_map, kv_map, km_map = _index_maps(causal, bq, bk, off)
 
-    kern = functools.partial(_fwd_kernel, sm=sm, causal=causal, block_q=bq,
-                             block_k=bk, nk=nk, tq=tq0, tk=tk0)
-    scratch = [pltpu.VMEM((bq, d), jnp.float32),
-               pltpu.VMEM((bq, 1), jnp.float32),
-               pltpu.VMEM((bq, 1), jnp.float32)]
-
-    out, lse = pl.pallas_call(
-        kern,
-        grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b_, i, j: (b_, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b_, i, j: (b_, j, 0)),
-            pl.BlockSpec((1, bk, 1), lambda b_, i, j: (b_, j, 0)),
-        ],
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), q_map),
+        pl.BlockSpec((1, 1, bk, d), kv_map),
+        pl.BlockSpec((1, 1, bk, d), kv_map),
+        None if kmo is None else pl.BlockSpec((1, _SUBLANES, bk), km_map),
+    ]
+    out, l, m = pl.pallas_call(
+        functools.partial(_fwd_kernel, sm=sm, causal=causal, nk=nk, off=off),
+        grid=(b, h, nq, nk),
+        in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, 1, bq, d), q_map),
+            pl.BlockSpec((1, 1, bq, _LANES), q_map),
+            pl.BlockSpec((1, 1, bq, _LANES), q_map),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, tq, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, tq, _LANES), jnp.float32),
         ],
-        scratch_shapes=scratch,
+        scratch_shapes=[pltpu.VMEM((bq, _LANES), jnp.float32),
+                        pltpu.VMEM((bq, _LANES), jnp.float32),
+                        pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=_tpu_compiler_params(interpret),
+        cost_estimate=_cost(b, h, tq, tk, d, causal, bwd=False),
         interpret=interpret,
-    )(qf, kf, vf, kmf)
-    out = out.reshape(b, h, tq, d)[:, :, :tq0]
-    lse = lse.reshape(b, h, tq)[:, :, :tq0]
-    return out, lse
+    )(q, k, v, kmo)  # a None operand pairs with its None spec
+    # residuals packed to one lane: the kernel writes them lane-replicated
+    # (layout), but only [b, h, tq0] of information is worth keeping
+    # around between forward and backward (536MB -> 4MB at T=16k B4/H8)
+    return out[:, :, :tq0], l[:, :, :tq0, 0], m[:, :, :tq0, 0]
 
 
-def _flash_bwd_impl(q, k, v, km, out, lse, g, causal, scale, block_q,
+def _flash_bwd_impl(q, k, v, km, out, l, m, g, causal, scale, block_q,
                     block_k, interpret):
     b, h, tq0, d = q.shape
     tk0 = k.shape[2]
     sm = _scale(q, scale)
-    bq = min(block_q, max(tq0, 8))
-    bk = min(block_k, max(tk0, 8))
+    bq = _blk(block_q, tq0)
+    bk = _blk(block_k, tk0)
     qp, tq = _pad_t(q, bq)
     kp, tk = _pad_t(k, bk)
     vp, _ = _pad_t(v, bk)
     gp, _ = _pad_t(g, bq)
-    op, _ = _pad_t(out, bq)
-    kmf0 = jnp.pad(jnp.asarray(km, q.dtype), ((0, 0), (0, tk - tk0)))
-
-    delta = jnp.sum(gp.astype(jnp.float32) * op.astype(jnp.float32), axis=-1)
-    # padded query rows: lse = -inf would make exp() explode; clamp them
-    lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, tq - tq0)),
-                   constant_values=jnp.inf)
-
-    bh = b * h
-    qf, kf, vf = (x.reshape(bh, -1, d) for x in (qp, kp, vp))
-    gf = gp.reshape(bh, tq, d)
-    kmf = jnp.broadcast_to(kmf0[:, None, :], (b, h, tk)).reshape(bh, tk, 1)
-    lsef = lsep.reshape(bh, tq, 1)
-    deltaf = delta.reshape(bh, tq, 1)
+    kmo = _mask_operand(km, b, tk0, tk)
     nq, nk = tq // bq, tk // bk
+    off = tk0 - tq0
+    q_map, kv_map, km_map = _index_maps(causal, bq, bk, off)
+
+    # per-row residuals arrive packed [b, h, tq0]; rebuild the
+    # lane-replicated [.., tq, 128] operands the kernels read (padded q
+    # rows: do = 0 zeroes their dk/dv contribution; l pads to 1.0 so the
+    # recomputed p stays finite)
+    def lanes(x, pad_value=0.0):
+        x = jnp.broadcast_to(x[..., None], (b, h, tq0, _LANES))
+        return jnp.pad(x, ((0, 0), (0, 0), (0, tq - tq0), (0, 0)),
+                       constant_values=pad_value)
+
+    di = lanes(jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                       axis=-1))
+    lp = lanes(l, pad_value=1.0)
+    mp = lanes(m)
+
+    q_spec = pl.BlockSpec((1, 1, bq, d), q_map)
+    kv_spec = pl.BlockSpec((1, 1, bk, d), kv_map)
+    km_spec = None if kmo is None else pl.BlockSpec((1, _SUBLANES, bk), km_map)
+    lm_spec = pl.BlockSpec((1, 1, bq, _LANES), q_map)
+    operands = (qp, kp, vp, kmo, gp, lp, mp, di)
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, sm=sm, causal=causal, block_q=bq,
-                          block_k=bk, nk=nk, tq=tq0, tk=tk0),
-        grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b_, i, j: (b_, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b_, i, j: (b_, j, 0)),
-            pl.BlockSpec((1, bk, 1), lambda b_, i, j: (b_, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b_, i, j: (b_, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        functools.partial(_dq_kernel, sm=sm, causal=causal, nk=nk, off=off),
+        grid=(b, h, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec, km_spec, q_spec, lm_spec,
+                  lm_spec, lm_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=_tpu_compiler_params(interpret),
+        cost_estimate=_cost(b, h, tq, tk, d, causal, bwd=True),
         interpret=interpret,
-    )(qf, kf, vf, kmf, gf, lsef, deltaf)
+    )(*operands)
+
+    # dkv grid: kv blocks outer, q blocks inner (scratch accumulates over
+    # q); skipped q blocks redirect their DMAs to the last q block, which
+    # is always live under the causal gate
+    def q_map_t(b_, h_, j, i):
+        if causal:
+            i = jax.lax.select(_below_diag(i, bq, j, bk, off), i, nq - 1)
+        return (b_, h_, i, 0)
+
+    def kv_map_t(b_, h_, j, i):
+        return (b_, h_, j, 0)
+
+    def km_map_t(b_, h_, j, i):
+        return (b_, 0, j)
+
+    q_spec_t = pl.BlockSpec((1, 1, bq, d), q_map_t)
+    kv_spec_t = pl.BlockSpec((1, 1, bk, d), kv_map_t)
+    km_spec_t = (None if kmo is None
+                 else pl.BlockSpec((1, _SUBLANES, bk), km_map_t))
+    lm_spec_t = pl.BlockSpec((1, 1, bq, _LANES), q_map_t)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, sm=sm, causal=causal, block_q=bq,
-                          block_k=bk, nq=nq, tq=tq0, tk=tk0),
-        grid=(bh, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b_, j, i: (b_, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b_, j, i: (b_, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b_, j, i: (b_, j, 0)),
-            pl.BlockSpec((1, bk, 1), lambda b_, j, i: (b_, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda b_, j, i: (b_, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b_, j, i: (b_, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b_, j, i: (b_, i, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b_, j, i: (b_, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b_, j, i: (b_, j, 0)),
-        ],
+        functools.partial(_dkv_kernel, sm=sm, causal=causal, nq=nq, off=off),
+        grid=(b, h, nk, nq),
+        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, km_spec_t, q_spec_t,
+                  lm_spec_t, lm_spec_t, lm_spec_t],
+        out_specs=[kv_spec_t, kv_spec_t],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+            jax.ShapeDtypeStruct((b, h, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, tk, d), v.dtype),
         ],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         compiler_params=_tpu_compiler_params(interpret),
+        cost_estimate=_cost(b, h, tq, tk, d, causal, bwd=True),
         interpret=interpret,
-    )(qf, kf, vf, kmf, gf, lsef, deltaf)
+    )(*operands)
 
-    dq = dq.reshape(b, h, tq, d)[:, :, :tq0]
-    dk = dk.reshape(b, h, tk, d)[:, :, :tk0]
-    dv = dv.reshape(b, h, tk, d)[:, :, :tk0]
-    return dq, dk, dv
+    return (dq[:, :, :tq0], dk[:, :, :tk0], dv[:, :, :tk0])
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def _flash(q, k, v, km, causal, scale, block_q, block_k, interpret):
-    out, _ = _flash_fwd_impl(q, k, v, km, causal, scale, block_q, block_k,
-                             interpret)
+    out, _, _ = _flash_fwd_impl(q, k, v, km, causal, scale, block_q, block_k,
+                                interpret)
     return out
 
 
 def _flash_fwd(q, k, v, km, causal, scale, block_q, block_k, interpret):
-    out, lse = _flash_fwd_impl(q, k, v, km, causal, scale, block_q, block_k,
-                               interpret)
-    return out, (q, k, v, km, out, lse)
+    out, l, m = _flash_fwd_impl(q, k, v, km, causal, scale, block_q, block_k,
+                                interpret)
+    return out, (q, k, v, km, out, l, m)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, km, out, lse = res
-    dq, dk, dv = _flash_bwd_impl(q, k, v, km, out, lse, g, causal, scale,
+    q, k, v, km, out, l, m = res
+    dq, dk, dv = _flash_bwd_impl(q, k, v, km, out, l, m, g, causal, scale,
                                  block_q, block_k, interpret)
-    return dq, dk, dv, jnp.zeros_like(km)
+    dkm = None if km is None else jnp.zeros_like(km)
+    return dq, dk, dv, dkm
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, key_mask=None, causal=False, scale=None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 512, block_k: int = 512,
                     interpret: Optional[bool] = None):
     """FlashAttention as a Pallas TPU kernel with a custom-VJP backward.
 
@@ -435,10 +536,10 @@ def flash_attention(q, k, v, key_mask=None, causal=False, scale=None,
     oracle)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if key_mask is None:
-        key_mask = jnp.ones((q.shape[0], k.shape[2]), q.dtype)
-    return _flash(q, k, v, jnp.asarray(key_mask, q.dtype), causal, scale,
-                  block_q, block_k, interpret)
+    km = None if key_mask is None else jnp.asarray(key_mask)
+    if km is not None and not jnp.issubdtype(km.dtype, jnp.floating):
+        km = km.astype(jnp.float32)  # bool/int masks: keep the vjp float
+    return _flash(q, k, v, km, causal, scale, block_q, block_k, interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -448,14 +549,22 @@ def flash_attention(q, k, v, key_mask=None, causal=False, scale=None,
 def dot_product_attention(q, k, v, key_mask=None, causal=False, scale=None,
                           impl: str = "auto"):
     """Pick the right tier. Measured on the v5e chip (B4/H8/D64, bf16,
-    causal): full materialization fails to COMPILE at T=16384 and the
-    blockwise scan matches its speed everywhere it does compile (~160ms net
-    at T=16k), while the hand Pallas kernel is grid-overhead-bound (~5-14x
-    slower) — XLA's fusion wins this one, so `auto` never picks it. The
-    Pallas kernel remains the explicitly-selectable (`impl="flash"`)
-    strictly-O(T)-VMEM option and the backward-kernel reference."""
+    causal; BASELINE.md round-2 table): the round-2 Pallas kernel is the
+    fastest trainable path at long T — T=4096 fwd+bwd 34ms vs blockwise
+    52ms, and T=16384 fwd 39ms vs 213ms (5.5x) where blockwise's backward
+    cannot even compile (the scan carries one O(B*H*T*D) residual per key
+    block: 17.5GB > HBM). ``auto`` therefore picks: full materialization
+    for short sequences (the whole problem fits one fused kernel), the
+    Pallas flash kernel on TPU beyond that, and the blockwise scan
+    everywhere the kernel can't run (non-TPU backends, exotic head dims)."""
+    d = q.shape[-1]
+    flash_ok = (jax.default_backend() == "tpu"
+                and (d <= _LANES or d % _LANES == 0))
     if impl == "auto":
-        impl = "reference" if q.shape[2] <= 1024 else "blockwise"
+        if q.shape[2] <= 1024:
+            impl = "reference"
+        else:
+            impl = "flash" if flash_ok else "blockwise"
     if impl == "flash":
         return flash_attention(q, k, v, key_mask, causal, scale)
     if impl == "blockwise":
