@@ -232,7 +232,16 @@ def _p_tile(q_ref, k_ref, km_ref, l_ref, m_ref, sm, causal, i, j, off):
     return jnp.exp(s - _rep(m_ref[0, 0], bk)) * _rep(l_inv, bk)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, l_ref, m_ref, di_ref,
+def _di_tile(do, o_ref):
+    """di = rowsum(do * o) recomputed in-kernel from the fwd output block:
+    [bq, 1], broadcasts against the [bq, bk] dp tile. Passing o (bf16,
+    d lanes) instead of a lane-replicated di operand saves a
+    [B, H, Tq, 128] f32 HBM materialization per backward."""
+    return jnp.sum(do.astype(jnp.float32) * o_ref[0, 0].astype(jnp.float32),
+                   axis=1)[:, None]
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, o_ref, l_ref, m_ref,
                dq_ref, dq_sc, *, sm, causal, nk, off):
     j = pl.program_id(3)
 
@@ -251,7 +260,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, l_ref, m_ref, di_ref,
         do = do_ref[0, 0]
         dp = jax.lax.dot_general(do, v_ref[0, 0], (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - _rep(di_ref[0, 0], bk))
+        ds = p * (dp - _di_tile(do, o_ref))
         if sm != 1.0:
             ds = ds * sm
         dq_sc[...] += jax.lax.dot(ds.astype(k_ref.dtype), k_ref[0, 0],
@@ -262,7 +271,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, l_ref, m_ref, di_ref,
         dq_ref[0, 0] = dq_sc[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, l_ref, m_ref, di_ref,
+def _dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, o_ref, l_ref, m_ref,
                 dk_ref, dv_ref, dk_sc, dv_sc, *, sm, causal, nq, off):
     i = pl.program_id(3)  # query block (innermost, sequential)
     j = pl.program_id(2)  # key block
@@ -284,7 +293,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, l_ref, m_ref, di_ref,
                                   preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v_ref[0, 0], (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - _rep(di_ref[0, 0], bk))
+        ds = p * (dp - _di_tile(do, o_ref))
         if sm != 1.0:
             ds = ds * sm
         dk_sc[...] += jax.lax.dot(ds.astype(q_ref.dtype).T, q_ref[0, 0],
@@ -337,7 +346,10 @@ def _mask_operand(km, b, tk0, tk):
 
 
 def _blk(requested, t):
-    """Effective block size: >= one lane tile, padded-t divides it."""
+    """Effective block size: >= one lane tile, a multiple of the lane
+    width (the lane-replication math requires it), padded-t divides it."""
+    if requested > _LANES:
+        requested -= requested % _LANES
     return min(requested, max(_LANES, 1 << (t - 1).bit_length()))
 
 
@@ -432,27 +444,28 @@ def _flash_bwd_impl(q, k, v, km, out, l, m, g, causal, scale, block_q,
     # per-row residuals arrive packed [b, h, tq0]; rebuild the
     # lane-replicated [.., tq, 128] operands the kernels read (padded q
     # rows: do = 0 zeroes their dk/dv contribution; l pads to 1.0 so the
-    # recomputed p stays finite)
+    # recomputed p stays finite). These two transients (l, m) are the
+    # only lane-replicated HBM operands — di is recomputed in-kernel
+    # from the (bf16, d-lane) fwd output instead.
     def lanes(x, pad_value=0.0):
         x = jnp.broadcast_to(x[..., None], (b, h, tq0, _LANES))
         return jnp.pad(x, ((0, 0), (0, 0), (0, tq - tq0), (0, 0)),
                        constant_values=pad_value)
 
-    di = lanes(jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                       axis=-1))
     lp = lanes(l, pad_value=1.0)
     mp = lanes(m)
+    op, _ = _pad_t(out, bq)
 
     q_spec = pl.BlockSpec((1, 1, bq, d), q_map)
     kv_spec = pl.BlockSpec((1, 1, bk, d), kv_map)
     km_spec = None if kmo is None else pl.BlockSpec((1, _SUBLANES, bk), km_map)
     lm_spec = pl.BlockSpec((1, 1, bq, _LANES), q_map)
-    operands = (qp, kp, vp, kmo, gp, lp, mp, di)
+    operands = (qp, kp, vp, kmo, gp, op, lp, mp)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm=sm, causal=causal, nk=nk, off=off),
         grid=(b, h, nq, nk),
-        in_specs=[q_spec, kv_spec, kv_spec, km_spec, q_spec, lm_spec,
+        in_specs=[q_spec, kv_spec, kv_spec, km_spec, q_spec, q_spec,
                   lm_spec, lm_spec],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
@@ -486,7 +499,7 @@ def _flash_bwd_impl(q, k, v, km, out, l, m, g, causal, scale, block_q,
         functools.partial(_dkv_kernel, sm=sm, causal=causal, nq=nq, off=off),
         grid=(b, h, nk, nq),
         in_specs=[q_spec_t, kv_spec_t, kv_spec_t, km_spec_t, q_spec_t,
-                  lm_spec_t, lm_spec_t, lm_spec_t],
+                  q_spec_t, lm_spec_t, lm_spec_t],
         out_specs=[kv_spec_t, kv_spec_t],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, tk, d), k.dtype),
