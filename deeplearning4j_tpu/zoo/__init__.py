@@ -7,3 +7,9 @@ from deeplearning4j_tpu.zoo.graphs import (
     UNet,
 )
 from deeplearning4j_tpu.zoo.models import LeNet, SimpleCNN, ZooModel
+from deeplearning4j_tpu.zoo.pretrained import (
+    PretrainedType,
+    load_pretrained,
+    restore_partial,
+    save_pretrained,
+)

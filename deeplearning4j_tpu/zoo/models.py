@@ -1,8 +1,8 @@
 """Model zoo — sequential models.
 
 Reference: ``org.deeplearning4j.zoo.model.*`` (``ZooModel`` SPI: ``init()``
-builds a config; pretrained download is a no-op here — zero-egress env, the
-checksum-verified download machinery lives in ``zoo.pretrained``).
+builds a config; ``initPretrained(type)`` loads checksum-verified cached
+weights — see :mod:`deeplearning4j_tpu.zoo.pretrained`).
 ComputationGraph-based zoo models (ResNet50, VGG16, …) are in
 :mod:`deeplearning4j_tpu.zoo.graphs`.
 """
@@ -10,6 +10,7 @@ ComputationGraph-based zoo models (ResNet50, VGG16, …) are in
 from __future__ import annotations
 
 from deeplearning4j_tpu.conf import Activation, InputType, WeightInit
+from deeplearning4j_tpu.zoo.pretrained import PretrainedMixin
 from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
 from deeplearning4j_tpu.conf.layers_cnn import (
     BatchNormalization,
@@ -26,8 +27,11 @@ from deeplearning4j_tpu.conf.multilayer import (
 from deeplearning4j_tpu.conf.updaters import Adam, IUpdater, Nesterovs
 
 
-class ZooModel:
-    """SPI base (reference ``org.deeplearning4j.zoo.ZooModel``)."""
+class ZooModel(PretrainedMixin):
+    """SPI base (reference ``org.deeplearning4j.zoo.ZooModel``): ``conf()``
+    builds the configuration, ``init()`` the network, and the mixin
+    provides ``init_pretrained`` / ``pretrained_available`` /
+    ``pretrained_url`` / ``pretrained_checksum``."""
 
     def init(self):
         """Build the (un-initialized) network object."""

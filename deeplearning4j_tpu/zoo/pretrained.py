@@ -1,0 +1,215 @@
+"""Pretrained-weights machinery for the model zoo.
+
+Reference: ``org.deeplearning4j.zoo.ZooModel#initPretrained(PretrainedType)``
++ ``DL4JResources``: per-model weight artifacts are fetched by URL into a
+local cache directory (``~/.deeplearning4j/models/<model>/``), verified
+against a hard-coded checksum, and loaded into the zoo topology.
+
+TPU-native shape of the same workflow:
+
+- The weight-artifact format IS the ModelSerializer zip
+  (:mod:`deeplearning4j_tpu.util.serializer`) — config JSON + flat
+  coefficients + runtime state — so a pretrained artifact is exactly a
+  saved model and round-trips through the same code path.
+- Cache layout: ``$DL4J_TPU_HOME/models/<model_name>_<type>.zip`` with a
+  ``.sha256`` sidecar (``DL4J_TPU_HOME`` defaults to
+  ``~/.deeplearning4j_tpu``; reference: ``DL4JResources.getBaseDirectory``).
+- Checksums: every load re-hashes the artifact and compares against the
+  sidecar written at publish time (corruption detection, the reference's
+  checksum role); a model class may additionally pin a hard-coded hash in
+  ``PRETRAINED_CHECKSUMS`` exactly like the reference pins its
+  ``pretrainedChecksum(type)`` longs.
+- Zero-egress environments: ``fetch=True`` attempts the model's
+  ``PRETRAINED_URLS`` entry over HTTP exactly like the reference; when
+  the artifact is already cached (the supported path here) no network is
+  touched. ``save_pretrained`` is the publish half the reference keeps
+  server-side: it writes the artifact + sidecar into the cache so local
+  fixtures, converted checkpoints, or institutionally-mirrored weights
+  slot into ``init_pretrained`` unchanged.
+- Partial load (``restore_partial``): copy every parameter whose
+  layer/key + shape matches from artifact to target network — the
+  transfer-learning entry point when the head differs (reference users
+  do this via ``TransferLearning`` after ``initPretrained``).
+"""
+
+from __future__ import annotations
+
+import enum
+import hashlib
+import os
+import urllib.request
+from pathlib import Path
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.util import serializer
+
+
+class PretrainedType(enum.Enum):
+    """Reference ``org.deeplearning4j.zoo.PretrainedType``."""
+
+    IMAGENET = "imagenet"
+    IMAGENETLARGE = "imagenetlarge"
+    MNIST = "mnist"
+    CIFAR10 = "cifar10"
+    VGGFACE = "vggface"
+    SEGMENT = "segment"
+
+
+def base_directory() -> Path:
+    """Reference ``DL4JResources#getBaseDirectory`` (env-overridable)."""
+    root = os.environ.get("DL4J_TPU_HOME",
+                          os.path.join(os.path.expanduser("~"),
+                                       ".deeplearning4j_tpu"))
+    return Path(root)
+
+
+def model_cache_dir() -> Path:
+    return base_directory() / "models"
+
+
+def artifact_path(model_name: str, ptype: PretrainedType) -> Path:
+    return model_cache_dir() / f"{model_name}_{ptype.value}.zip"
+
+
+def sha256_of(path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def save_pretrained(net, model_name: str, ptype: PretrainedType,
+                    save_updater: bool = False) -> Path:
+    """Publish a network's weights as a cached pretrained artifact
+    (the server-side half of the reference's pretrained pipeline, made
+    local): writes ``<cache>/<model_name>_<type>.zip`` + ``.sha256``."""
+    path = artifact_path(model_name, ptype)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    serializer.write_model(net, path, save_updater=save_updater)
+    digest = sha256_of(path)
+    path.with_suffix(".zip.sha256").write_text(digest + "\n")
+    return path
+
+
+def _verify(path: Path, expected: str | None, model_name: str) -> None:
+    actual = sha256_of(path)
+    sidecar = path.with_suffix(".zip.sha256")
+    if sidecar.exists():
+        recorded = sidecar.read_text().strip()
+        if actual != recorded:
+            raise IOError(
+                f"checksum mismatch for {path}: artifact hashes to "
+                f"{actual[:16]}… but its sidecar records {recorded[:16]}… "
+                "(corrupted download/copy — delete the artifact and "
+                "re-fetch; reference: ZooModel#initPretrained checksum "
+                "failure)")
+    if expected is not None and actual != expected:
+        raise IOError(
+            f"checksum mismatch for {model_name}: artifact hashes to "
+            f"{actual[:16]}… but the model pins {expected[:16]}…")
+
+
+def load_pretrained(model, ptype: PretrainedType = PretrainedType.IMAGENET,
+                    fetch: bool = True, load_updater: bool = False):
+    """Core of ``ZooModel#initPretrained``: resolve the cached artifact
+    (fetching it by URL if missing and the model publishes one), verify
+    checksums, and restore the network."""
+    name = getattr(model, "model_name", None) or type(model).__name__
+    if not model.pretrained_available(ptype):
+        raise ValueError(
+            f"{name} has no pretrained weights for {ptype.name} "
+            "(reference: initPretrained throws UnsupportedOperationException)"
+        )
+    path = artifact_path(name, ptype)
+    if not path.exists():
+        url = model.pretrained_url(ptype)
+        if not (fetch and url):
+            raise FileNotFoundError(
+                f"no cached artifact at {path} and no fetchable URL; "
+                "publish weights locally with zoo.pretrained.save_pretrained"
+                "(net, model_name, type) or place the artifact in the cache")
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".zip.part")
+        try:
+            urllib.request.urlretrieve(url, tmp)  # noqa: S310 — model URL
+        except Exception as e:
+            tmp.unlink(missing_ok=True)
+            raise IOError(
+                f"could not fetch {name} {ptype.name} weights from {url}: "
+                f"{e} (zero-egress environment? pre-populate the cache via "
+                "save_pretrained or copy the artifact to "
+                f"{path})") from e
+        tmp.rename(path)
+        # record the downloaded artifact's hash so every later load can
+        # detect cache corruption even without a class-pinned checksum
+        path.with_suffix(".zip.sha256").write_text(sha256_of(path) + "\n")
+    _verify(path, model.pretrained_checksum(ptype), name)
+    return serializer.restore_model(path, load_updater=load_updater)
+
+
+def restore_partial(path, net) -> tuple[list, list]:
+    """Copy every parameter (and runtime-state entry) whose layer key,
+    param key, and shape match from the artifact into ``net`` (already
+    initialized). Returns (loaded, skipped) key lists. This is the
+    weight-surgery primitive behind transfer learning with a replaced
+    head: load the backbone, leave mismatched layers at init."""
+    donor = serializer.restore_model(path, load_updater=False)
+    loaded, skipped = [], []
+    for lk, lp in donor.params.items():
+        for pk, pv in lp.items():
+            tgt = net.params.get(lk, {})
+            if pk in tgt and tuple(tgt[pk].shape) == tuple(pv.shape):
+                net.params[lk][pk] = jnp.asarray(pv)
+                loaded.append(f"{lk}/{pk}")
+            else:
+                skipped.append(f"{lk}/{pk}")
+    for lk, ls in donor.state.items():
+        for sk, sv in ls.items():
+            tgt = net.state.get(lk, {})
+            if sk in tgt and tuple(tgt[sk].shape) == tuple(sv.shape):
+                net.state[lk][sk] = jnp.asarray(sv)
+                loaded.append(f"state:{lk}/{sk}")
+            else:
+                skipped.append(f"state:{lk}/{sk}")
+    return loaded, skipped
+
+
+class PretrainedMixin:
+    """Mixed into ``ZooModel``: the ``initPretrained`` API surface.
+
+    Subclasses declare availability by populating ``PRETRAINED_URLS``
+    (type -> URL, may be empty-string for cache-only models) and
+    optionally ``PRETRAINED_CHECKSUMS`` (type -> sha256 hex, the
+    reference's ``pretrainedChecksum``)."""
+
+    #: type -> URL; presence of the key marks the weights as available
+    PRETRAINED_URLS: dict = {}
+    #: type -> sha256 hex digest pinned at publish time (optional)
+    PRETRAINED_CHECKSUMS: dict = {}
+
+    @property
+    def model_name(self) -> str:
+        return type(self).__name__
+
+    def pretrained_available(self, ptype: PretrainedType) -> bool:
+        """Reference ``ZooModel#pretrainedAvailable``. True also when a
+        cache-only artifact exists locally (published via
+        ``save_pretrained``)."""
+        return (ptype in self.PRETRAINED_URLS
+                or artifact_path(self.model_name, ptype).exists())
+
+    def pretrained_url(self, ptype: PretrainedType):
+        """Reference ``ZooModel#pretrainedUrl(type)``."""
+        return self.PRETRAINED_URLS.get(ptype) or None
+
+    def pretrained_checksum(self, ptype: PretrainedType):
+        """Reference ``ZooModel#pretrainedChecksum(type)``."""
+        return self.PRETRAINED_CHECKSUMS.get(ptype)
+
+    def init_pretrained(self, ptype: PretrainedType = PretrainedType.IMAGENET,
+                        load_updater: bool = False):
+        """Reference ``ZooModel#initPretrained(type)`` — returns the
+        network with pretrained weights loaded (checksum-verified)."""
+        return load_pretrained(self, ptype, load_updater=load_updater)

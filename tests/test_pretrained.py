@@ -1,0 +1,115 @@
+"""Zoo pretrained-weights machinery (``ZooModel#initPretrained`` parity).
+
+Zero-egress protocol: the cache is populated via ``save_pretrained`` (the
+local publish half) and ``init_pretrained`` resolves/verifies/loads from
+it — the same artifact + checksum flow the reference drives through its
+weight-download CDN, minus the network leg.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.zoo import (
+    LeNet,
+    ResNet50,
+    PretrainedType,
+    restore_partial,
+    save_pretrained,
+)
+from deeplearning4j_tpu.zoo import pretrained as zp
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+@pytest.fixture(autouse=True)
+def _cache_home(tmp_path, monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_HOME", str(tmp_path))
+    yield tmp_path
+
+
+def test_init_pretrained_round_trip_lenet():
+    model = LeNet(num_classes=10, height=8, width=8)
+    net = model.init()
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(size=(4, 8, 8, 1)).astype(np.float32),
+                 np.eye(10, dtype=np.float32)[rng.integers(0, 10, 4)])
+    net.fit_batch(ds)  # non-trivial weights
+    save_pretrained(net, model.model_name, PretrainedType.MNIST)
+
+    assert model.pretrained_available(PretrainedType.MNIST)
+    assert not model.pretrained_available(PretrainedType.VGGFACE)
+    restored = model.init_pretrained(PretrainedType.MNIST)
+    np.testing.assert_allclose(restored.params_flat(), net.params_flat())
+    # loaded model is usable directly
+    out = restored.output(ds.features)
+    assert out.shape == (4, 10)
+
+
+def test_init_pretrained_round_trip_resnet50_graph():
+    model = ResNet50(num_classes=5, height=32, width=32)
+    net = model.init()
+    save_pretrained(net, model.model_name, PretrainedType.IMAGENET)
+    restored = model.init_pretrained(PretrainedType.IMAGENET)
+    np.testing.assert_allclose(restored.params_flat(), net.params_flat())
+
+
+def test_checksum_corruption_detected(tmp_path):
+    model = LeNet(num_classes=10, height=8, width=8)
+    save_pretrained(model.init(), model.model_name, PretrainedType.MNIST)
+    path = zp.artifact_path(model.model_name, PretrainedType.MNIST)
+    data = bytearray(path.read_bytes())
+    data[len(data) // 2] ^= 0xFF
+    path.write_bytes(bytes(data))
+    with pytest.raises(IOError, match="checksum mismatch"):
+        model.init_pretrained(PretrainedType.MNIST)
+
+
+def test_pinned_class_checksum_enforced():
+    model = LeNet(num_classes=10, height=8, width=8)
+    save_pretrained(model.init(), model.model_name, PretrainedType.MNIST)
+    model.PRETRAINED_CHECKSUMS = {PretrainedType.MNIST: "0" * 64}
+    with pytest.raises(IOError, match="pins"):
+        model.init_pretrained(PretrainedType.MNIST)
+
+
+def test_unavailable_type_raises():
+    model = LeNet(num_classes=10, height=8, width=8)
+    with pytest.raises(ValueError, match="no pretrained weights"):
+        model.init_pretrained(PretrainedType.VGGFACE)
+
+
+def test_restore_partial_feeds_transfer_learning():
+    """The flagship workflow: pretrained backbone, new head, fine-tune."""
+    donor_model = LeNet(num_classes=10, height=8, width=8)
+    donor = donor_model.init()
+    path = save_pretrained(donor, donor_model.model_name,
+                           PretrainedType.MNIST)
+
+    target = LeNet(num_classes=3, height=8, width=8).init()  # new head
+    loaded, skipped = restore_partial(path, target)
+    # backbone convs + dense load; the 10-class output layer (index 6,
+    # after the auto-inserted CNN->FF preprocessor at 4) is skipped
+    assert any(k.startswith("0/") for k in loaded)
+    assert skipped == ["6/W", "6/b"]
+    np.testing.assert_allclose(
+        np.asarray(target.params["0"]["W"]),
+        np.asarray(donor.params["0"]["W"]))
+
+    from deeplearning4j_tpu.nn.transferlearning import TransferLearning
+
+    tuned = (TransferLearning.Builder(target)
+             .set_feature_extractor(5)  # freeze through the dense layer
+             .build())
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(size=(4, 8, 8, 1)).astype(np.float32),
+                 np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4)])
+    before = np.asarray(tuned.params["0"]["W"]).copy()
+    tuned.fit_batch(ds)
+    # frozen backbone untouched, head trains
+    np.testing.assert_allclose(np.asarray(tuned.params["0"]["W"]), before)
+
+
+def test_missing_cache_and_url_message():
+    model = LeNet(num_classes=10, height=8, width=8)
+    model.PRETRAINED_URLS = {PretrainedType.MNIST: ""}  # available, no URL
+    with pytest.raises(FileNotFoundError, match="save_pretrained"):
+        model.init_pretrained(PretrainedType.MNIST)
