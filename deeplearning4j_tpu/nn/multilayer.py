@@ -378,17 +378,13 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
             self.init()
         from deeplearning4j_tpu.conf.multilayer import BackpropType
 
-        tbptt = self.conf.backprop_type is BackpropType.TRUNCATED_BPTT
+        tbptt = (self.conf.backprop_type is BackpropType.TRUNCATED_BPTT
+                 and np.ndim(ds.features) == 3)
         if tbptt:
-            ds = self._tbptt_prepad(ds)
+            # one normalization path shared with ParallelWrapper
+            return self._fit_tbptt(*self.tbptt_batch_arrays(ds))
         features, labels, fmask, lmask = self._batch_arrays(
             ds, lazy_lmask=True, write_back=True)
-        if tbptt and features.ndim == 3:
-            if lmask is None:
-                # HOST array: segments of it stage with each step call
-                # instead of costing an eager device op per batch
-                lmask = np.ones((features.shape[0],), self._dtype)
-            return self._fit_tbptt(features, labels, fmask, lmask)
         if self._train_step is None:
             self._train_step = self._build_train_step()
         (self.params, self.state, self.opt_state, loss,
@@ -466,54 +462,99 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
             pass  # exotic immutable containers just re-pad
         return padded
 
-    def _fit_tbptt_scan(self, features, labels, fmask, lmask, seg,
-                        carries):
+    def tbptt_scan_fn(self, seg: int):
+        """The raw (unjitted) whole-batch tBPTT runner: segments the time
+        axis INSIDE the trace and scans the per-segment train step with
+        detached carries — ``(params, state, opt, features, labels, fmask,
+        lmask, itc, ep, base_key) -> (params, state, opt, new_itc,
+        mean_loss)``. Exposed (like ``train_step_fn``) so ParallelWrapper
+        can jit it over a mesh with the batch axis sharded — the same
+        compiled segment chain, SPMD-partitioned."""
+        raw = self.train_step_fn()
+        cdt = self._cdtype or self._dtype
+
+        def segments(arr):
+            # [B, T, ...] -> [n_seg, B, seg, ...], tail zero-padded —
+            # INSIDE the jit: shapes are static under trace, so the
+            # segmentation costs zero extra dispatches. n_seg derives
+            # from the traced shape (NOT closed over: a different T
+            # retraces with its own count)
+            ns = -(-arr.shape[1] // seg)
+            arr = _pad_time(jnp.asarray(arr), ns * seg)
+            shaped = arr.reshape(arr.shape[0], ns, seg,
+                                 *arr.shape[2:])
+            return jnp.moveaxis(shaped, 1, 0)
+
+        def run(params, state, opt, features, labels, fmask, lmask,
+                itc, ep, base_key):
+            segs = tuple(segments(a)
+                         for a in (features, labels, fmask, lmask))
+            # anchor the zero carries to the features: under shard_map the
+            # batch is varied over the mesh axis, and a bare jnp.zeros is
+            # not — lax.scan then rejects the carry (vma mismatch). The
+            # +0*sum() is free under jit and a no-op outside shard_map.
+            anchor = jnp.sum(features[:1, :1]) * 0
+            carries = {str(i): layer.zero_carry(features.shape[0], cdt)
+                       for i, layer in enumerate(self.conf.layers)
+                       if getattr(layer, "has_carry", False)}
+            carries = jax.tree_util.tree_map(
+                lambda z: z + anchor.astype(z.dtype), carries)
+
+            def body(carry, xs):
+                params, state, opt, carries, itc = carry
+                f_s, l_s, fm_s, lm_s = xs
+                it, rng = nn_io.step_scalars(itc, base_key)
+                params, state, opt, loss, carries = raw(
+                    params, state, opt, f_s, l_s, fm_s, lm_s, it, ep,
+                    rng, carries)
+                return (params, state, opt, carries, itc + 1), loss
+
+            (params, state, opt, carries, itc), losses = jax.lax.scan(
+                body, (params, state, opt, carries, itc), segs)
+            return params, state, opt, itc, jnp.mean(losses)
+
+        return run
+
+    def tbptt_batch_arrays(self, ds: DataSet):
+        """Stage one tBPTT batch fully normalized for ``tbptt_scan_fn``:
+        prepadded time axis, per-timestep labels validated, all-ones
+        default masks, 1-D labels mask expanded per-timestep. Used by
+        ParallelWrapper to feed the sharded scan runner the exact arrays
+        the single-device path trains on."""
+        ds = self._tbptt_prepad(ds)
+        features, labels, fmask, lmask = self._batch_arrays(
+            ds, lazy_lmask=True, write_back=True)
+        if labels.ndim != 3:
+            raise ValueError(
+                "truncated BPTT needs per-timestep labels [batch, time, "
+                f"nOut], got shape {tuple(labels.shape)} (reference tBPTT "
+                "operates on sequence labels; use STANDARD backprop for "
+                "sequence-level classification heads)")
+        n, total_t = features.shape[0], features.shape[1]
+        if fmask is None:
+            fmask = np.ones((n, total_t), self._dtype)
+        if lmask is None:
+            lmask = np.ones((n, total_t), self._dtype)
+        elif lmask.ndim == 1:
+            ones_t = (np.ones if isinstance(lmask, np.ndarray)
+                      else jnp.ones)((n, total_t), self._dtype)
+            lmask = lmask[:, None] * ones_t
+        return features, labels, fmask, lmask
+
+    def _fit_tbptt_scan(self, features, labels, fmask, lmask, seg):
         n_seg = -(-int(features.shape[1]) // seg)
         # cache keyed by seg: a conf.tbptt_fwd_length change between fits
         # must not silently reuse a closure compiled for the old length
         if self._tbptt_scan is None:
             self._tbptt_scan = {}
         if seg not in self._tbptt_scan:
-            raw = self.train_step_fn()
-
-            def segments(arr):
-                # [B, T, ...] -> [n_seg, B, seg, ...], tail zero-padded —
-                # INSIDE the jit: shapes are static under trace, so the
-                # segmentation costs zero extra dispatches. n_seg derives
-                # from the traced shape (NOT closed over: a different T
-                # retraces with its own count)
-                ns = -(-arr.shape[1] // seg)
-                arr = _pad_time(jnp.asarray(arr), ns * seg)
-                shaped = arr.reshape(arr.shape[0], ns, seg,
-                                     *arr.shape[2:])
-                return jnp.moveaxis(shaped, 1, 0)
-
-            def run(params, state, opt, features, labels, fmask, lmask,
-                    itc, ep, base_key, carries):
-                segs = tuple(segments(a)
-                             for a in (features, labels, fmask, lmask))
-
-                def body(carry, xs):
-                    params, state, opt, carries, itc = carry
-                    f_s, l_s, fm_s, lm_s = xs
-                    it, rng = nn_io.step_scalars(itc, base_key)
-                    params, state, opt, loss, carries = raw(
-                        params, state, opt, f_s, l_s, fm_s, lm_s, it, ep,
-                        rng, carries)
-                    return (params, state, opt, carries, itc + 1), loss
-
-                (params, state, opt, carries, itc), losses = jax.lax.scan(
-                    body, (params, state, opt, carries, itc), segs)
-                return params, state, opt, itc, jnp.mean(losses)
-
-            # carries are zeros rebuilt per batch and not returned — not
-            # donated (unusable donations just warn)
-            self._tbptt_scan[seg] = jax.jit(run, donate_argnums=(0, 1, 2))
+            self._tbptt_scan[seg] = jax.jit(self.tbptt_scan_fn(seg),
+                                            donate_argnums=(0, 1, 2))
         (self.params, self.state, self.opt_state, new_itc,
          mean_loss) = self._tbptt_scan[seg](
             self.params, self.state, self.opt_state, features, labels,
             fmask, lmask, self.device_iteration(), self.device_epoch(),
-            self._base_key, carries)
+            self._base_key)
         self.iteration += n_seg
         self.advance_device_iteration(new_itc)
         self.last_batch_size = int(features.shape[0])
@@ -530,31 +571,21 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         """Truncated BPTT: slice the time axis into segments of
         ``tbptt_fwd_length``, one parameter update per segment, RNN state
         carried (detached) between segments. The tail segment is zero-padded
-        with a 0 mask so every segment has the same (compiled-once) shape."""
-        if labels.ndim != 3:
-            raise ValueError(
-                "truncated BPTT needs per-timestep labels [batch, time, "
-                f"nOut], got shape {tuple(labels.shape)} (reference tBPTT "
-                "operates on sequence labels; use STANDARD backprop for "
-                "sequence-level classification heads)")
+        with a 0 mask so every segment has the same (compiled-once) shape.
+        Inputs are pre-normalized by ``tbptt_batch_arrays`` (the single
+        validation/defaulting path, shared with ParallelWrapper)."""
         seg = int(self.conf.tbptt_fwd_length)
         back = int(self.conf.tbptt_back_length or seg)
         back = min(back, seg)
         n, total_t = features.shape[0], features.shape[1]
-        if fmask is None:
-            fmask = np.ones((n, total_t), self._dtype)
-        if lmask.ndim == 1:  # per-example -> per-timestep
-            ones_t = (np.ones if isinstance(lmask, np.ndarray)
-                      else jnp.ones)((n, total_t), self._dtype)
-            lmask = lmask[:, None] * ones_t
+        if back == seg:
+            # common case: the WHOLE segment chain is one compiled
+            # lax.scan — no Python loop, one dispatch, one sync (zero
+            # carries are built inside the jit)
+            return self._fit_tbptt_scan(features, labels, fmask, lmask, seg)
         carries = {str(i): layer.zero_carry(n, self._cdtype or self._dtype)
                    for i, layer in enumerate(self.conf.layers)
                    if getattr(layer, "has_carry", False)}
-        if back == seg:
-            # common case: the WHOLE segment chain is one compiled
-            # lax.scan — no Python loop, one dispatch, one sync
-            return self._fit_tbptt_scan(features, labels, fmask, lmask,
-                                        seg, carries)
         if self._rnn_step_fn is None:
             self._rnn_step_fn = self._build_rnn_step_fn()
         if self._tbptt_step is None:

@@ -113,12 +113,24 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                 f"mesh data axis = {self.workers}, workers = {workers}")
         from deeplearning4j_tpu.conf.multilayer import BackpropType
 
-        if (not self._is_graph
-                and model.conf.backprop_type is BackpropType.TRUNCATED_BPTT):
-            raise NotImplementedError(
-                "ParallelWrapper does not segment truncated-BPTT batches; "
-                "train tBPTT models with net.fit() or use STANDARD backprop "
-                "under the wrapper")
+        self._tbptt = (not self._is_graph and model.conf.backprop_type
+                       is BackpropType.TRUNCATED_BPTT)
+        if self._tbptt:
+            seg = int(model.conf.tbptt_fwd_length)
+            back = int(model.conf.tbptt_back_length or seg)
+            if back < seg:
+                raise NotImplementedError(
+                    "ParallelWrapper supports tBPTT only with "
+                    "tbptt_back_length == tbptt_fwd_length (the compiled "
+                    "scan path); the back < fwd segment loop is single-"
+                    "device only")
+            if threshold_algorithm is not None:
+                raise NotImplementedError(
+                    "threshold-compressed gradients are not implemented "
+                    "for tBPTT batches; use exact SHARED_GRADIENTS or "
+                    "AVERAGING (compression is a DCN feature — reference "
+                    "RNN training under ParallelWrapper uses plain modes)")
+            self._tbptt_seg = seg
         procs = jax.process_count()
         if self.workers % procs != 0 or self.workers < procs:
             raise ValueError(
@@ -146,6 +158,8 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         """-> tuple of batch arrays matching the model's train-step args."""
         if self._is_graph:
             return self.model._prep_batch(ds)
+        if self._tbptt:
+            return self.model.tbptt_batch_arrays(ds)
         return self.model._batch_arrays(ds)
 
     def _batch_rows(self, batch) -> int:
@@ -195,14 +209,23 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             # batch shardings drive SPMD partitioning, XLA inserts the
             # all-reduce
             if self._step is None:
-                raw = m.train_step_fn()
+                if self._tbptt:
+                    # the model's whole-batch segment-scan runner, SPMD-
+                    # partitioned: batch axis sharded, params replicated;
+                    # the per-segment gradient all-reduce is XLA-inserted
+                    # exactly as in the standard step
+                    self._step = jax.jit(m.tbptt_scan_fn(self._tbptt_seg),
+                                         donate_argnums=(0, 1, 2))
+                else:
+                    raw = m.train_step_fn()
 
-                def exact_step(params, state, opt, *rest):
-                    *batch, itc, ep, base_key = rest
-                    it, rng = nn_io.step_scalars(itc, base_key)
-                    return raw(params, state, opt, *batch, it, ep, rng)
+                    def exact_step(params, state, opt, *rest):
+                        *batch, itc, ep, base_key = rest
+                        it, rng = nn_io.step_scalars(itc, base_key)
+                        return raw(params, state, opt, *batch, it, ep, rng)
 
-                self._step = jax.jit(exact_step, donate_argnums=(0, 1, 2))
+                    self._step = jax.jit(exact_step,
+                                         donate_argnums=(0, 1, 2))
 
     # --- step builders ------------------------------------------------------
     def _build_threshold_step(self):
@@ -247,16 +270,27 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         return jax.jit(sharded, donate_argnums=(0, 1, 2, 3))
 
     def _build_averaging_step(self):
-        raw = self.model.train_step_fn()
+        if self._tbptt:
+            run = self.model.tbptt_scan_fn(self._tbptt_seg)
+        else:
+            raw = self.model.train_step_fn()
 
         def step(params, state, opt, batch, itc, ep, base_key, cvec):
-            it, rng = nn_io.step_scalars(itc, base_key)
             idx = jax.lax.axis_index(DATA)
-            rng = jax.random.fold_in(rng, idx)
             p = _tree_map(lambda x: x[0], params)
             s = _tree_map(lambda x: x[0], state)
             o = _tree_map(lambda x: x[0], opt)
-            new_p, new_s, new_o, loss = raw(p, s, o, *batch, it, ep, rng)
+            if self._tbptt:
+                # per-replica rng stream via the folded base key; the
+                # runner derives per-segment scalars itself
+                key = jax.random.fold_in(base_key, idx)
+                new_p, new_s, new_o, _, loss = run(p, s, o, *batch, itc,
+                                                   ep, key)
+            else:
+                it, rng = nn_io.step_scalars(itc, base_key)
+                rng = jax.random.fold_in(rng, idx)
+                new_p, new_s, new_o, loss = raw(p, s, o, *batch, it, ep,
+                                                rng)
             # an all-padding replica (final ragged batch smaller than the
             # worker count) must not move: regularization/momentum would
             # otherwise update it and later be averaged into real replicas
@@ -367,12 +401,16 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         # jnp.asarray/fold_in would each cost a 20-65ms tunnel round-trip
         itc = np.int32(m.iteration)
         ep = np.float32(m.epoch)
+        # tBPTT counts one iteration per SEGMENT (reference semantics)
+        inc = (-(-int(jax.tree_util.tree_leaves(batch)[0].shape[1])
+                 // self._tbptt_seg) if self._tbptt else 1)
 
         if self.training_mode is TrainingMode.AVERAGING:
             (self._params, self._state, self._opt, loss) = self._step(
                 self._params, self._state, self._opt, batch, itc, ep,
                 m._base_key, cvec)
-            if (m.iteration + 1) % self.averaging_frequency == 0:
+            if (m.iteration + inc) // self.averaging_frequency \
+                    > m.iteration // self.averaging_frequency:
                 self._params, self._state, self._opt = self._avg(
                     self._params, self._state, self._opt)
         elif self.threshold_algorithm is not None:
@@ -389,16 +427,18 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         else:
             out = self._step(self._params, self._state, self._opt, *batch,
                              itc, ep, m._base_key)
-            self._params, self._state, self._opt, loss = out[:4]
+            if self._tbptt:
+                self._params, self._state, self._opt, _, loss = out
+            else:
+                self._params, self._state, self._opt, loss = out[:4]
 
         self._score_dev = loss
         self._score_cache = None
         m._score_dev = loss
         m._score_cache = None
-        cur = m.iteration
-        m.iteration += 1  # listeners see iteration == next-to-run
+        m.iteration += inc  # listeners see iteration == next-to-run
         for lst in m.listeners:
-            lst.iteration_done(m, cur, m.epoch, loss)
+            lst.iteration_done(m, m.iteration - 1, m.epoch, loss)
 
     def _write_back(self):
         """Publish trained params back onto the wrapped model (reference:

@@ -93,9 +93,12 @@ def save_pretrained(net, model_name: str, ptype: PretrainedType,
     return path
 
 
-def _verify(path: Path, expected: str | None, model_name: str) -> None:
-    actual = sha256_of(path)
+def _verify(path: Path, expected: str | None, model_name: str,
+            actual: str | None = None) -> None:
     sidecar = path.with_suffix(".zip.sha256")
+    if expected is None and not sidecar.exists():
+        return  # nothing to check against — skip the full-file hash
+    actual = actual or sha256_of(path)
     if sidecar.exists():
         recorded = sidecar.read_text().strip()
         if actual != recorded:
@@ -144,8 +147,11 @@ def load_pretrained(model, ptype: PretrainedType = PretrainedType.IMAGENET,
         tmp.rename(path)
         # record the downloaded artifact's hash so every later load can
         # detect cache corruption even without a class-pinned checksum
-        path.with_suffix(".zip.sha256").write_text(sha256_of(path) + "\n")
-    _verify(path, model.pretrained_checksum(ptype), name)
+        digest = sha256_of(path)
+        path.with_suffix(".zip.sha256").write_text(digest + "\n")
+        _verify(path, model.pretrained_checksum(ptype), name, actual=digest)
+    else:
+        _verify(path, model.pretrained_checksum(ptype), name)
     return serializer.restore_model(path, load_updater=load_updater)
 
 

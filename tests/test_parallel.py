@@ -373,3 +373,107 @@ def test_inference_server_input_validation():
             assert match in ei.value.read().decode()
     finally:
         server.stop()
+
+
+# --- tBPTT under the wrapper (round 2: SURVEY §3.4 + §5.7) -----------------
+
+def _rnn_conf(seed=12345, updater=None):
+    from deeplearning4j_tpu.conf.layers_rnn import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.conf.multilayer import BackpropType
+
+    return (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(updater or Adam(learning_rate=0.02))
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(LSTM(n_out=12))
+            .layer(RnnOutputLayer(n_out=3, activation=Activation.SOFTMAX,
+                                  loss_fn=LossMCXENT()))
+            .backprop_type(BackpropType.TRUNCATED_BPTT, fwd=5, back=5)
+            .set_input_type(InputType.recurrent(4, 20))
+            .build())
+
+
+def _rnn_data(n=16, t=20, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, t, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (n, t))]
+    return x, y
+
+
+def test_tbptt_shared_gradients_exact_matches_single_device():
+    """tBPTT under the wrapper (exact mode, 8-device mesh) == the
+    single-device compiled segment scan on the same global batch."""
+    x, y = _rnn_data(16)
+    serial = MultiLayerNetwork(_rnn_conf()).init()
+    par = MultiLayerNetwork(_rnn_conf()).init()
+
+    pw = ParallelWrapper(par, training_mode=TrainingMode.SHARED_GRADIENTS)
+    for _ in range(2):
+        serial.fit_batch(DataSet(x, y))
+    it = ArrayDataSetIterator(x, y, batch=16)
+    pw.fit(it, epochs=2)
+
+    assert par.iteration == serial.iteration == 8  # 2 batches x 4 segments
+    for k in serial.params:
+        for pk in serial.params[k]:
+            np.testing.assert_allclose(
+                np.asarray(serial.params[k][pk]),
+                np.asarray(par.params[k][pk]), atol=3e-5,
+                err_msg=f"layer {k} param {pk}")
+
+
+def test_tbptt_shared_gradients_ragged_batch():
+    """13 rows over 8 workers: padded rows carry zero masks end-to-end."""
+    x, y = _rnn_data(13, seed=3)
+    serial = MultiLayerNetwork(_rnn_conf()).init()
+    par = MultiLayerNetwork(_rnn_conf()).init()
+    pw = ParallelWrapper(par)
+    serial.fit_batch(DataSet(x, y))
+    pw.fit(ArrayDataSetIterator(x, y, batch=13), epochs=1)
+    for k in serial.params:
+        for pk in serial.params[k]:
+            np.testing.assert_allclose(
+                np.asarray(serial.params[k][pk]),
+                np.asarray(par.params[k][pk]), atol=3e-5)
+
+
+def test_tbptt_averaging_converges():
+    """AVERAGING mode with tBPTT: loss decreases and final params are
+    finite (replicas run independent local segment scans, then average)."""
+    x, y = _rnn_data(16, seed=5)
+    par = MultiLayerNetwork(_rnn_conf(seed=7)).init()
+    pw = ParallelWrapper(par, training_mode=TrainingMode.AVERAGING,
+                         averaging_frequency=4)
+    it = ArrayDataSetIterator(x, y, batch=16)
+    pw.fit(it, epochs=1)
+    first = pw.score_value
+    pw.fit(it, epochs=4)
+    assert np.isfinite(pw.score_value)
+    assert pw.score_value < first
+    flat = par.params_flat()
+    assert np.all(np.isfinite(flat))
+
+
+def test_tbptt_threshold_mode_rejected():
+    par = MultiLayerNetwork(_rnn_conf()).init()
+    with pytest.raises(NotImplementedError, match="threshold"):
+        ParallelWrapper(par, threshold_algorithm=ThresholdAlgorithm(1e-3))
+
+
+def test_tbptt_back_lt_fwd_rejected():
+    from deeplearning4j_tpu.conf.layers_rnn import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.conf.multilayer import BackpropType
+
+    conf = (NeuralNetConfiguration.builder()
+            .updater(Adam(learning_rate=0.02))
+            .list()
+            .layer(LSTM(n_out=8))
+            .layer(RnnOutputLayer(n_out=3, activation=Activation.SOFTMAX,
+                                  loss_fn=LossMCXENT()))
+            .backprop_type(BackpropType.TRUNCATED_BPTT, fwd=5, back=3)
+            .set_input_type(InputType.recurrent(4, 20))
+            .build())
+    par = MultiLayerNetwork(conf).init()
+    with pytest.raises(NotImplementedError, match="back"):
+        ParallelWrapper(par)
