@@ -601,6 +601,67 @@ class SameDiff:
     def one_hot(self, indices, depth, name=None):
         return self._op("one_hot", [indices], name=name, depth=int(depth))[0]
 
+    # scatter family (reference SDBaseOps scatter*: rows of `ref` selected
+    # by `indices` (axis 0) combined with `updates`; duplicates accumulate)
+    def scatter_update(self, ref, indices, updates, name=None):
+        return self._op("scatter.update", [ref, indices, updates],
+                        name=name)[0]
+
+    def scatter_add(self, ref, indices, updates, name=None):
+        return self._op("scatter.add", [ref, indices, updates], name=name)[0]
+
+    def scatter_sub(self, ref, indices, updates, name=None):
+        return self._op("scatter.sub", [ref, indices, updates], name=name)[0]
+
+    def scatter_mul(self, ref, indices, updates, name=None):
+        return self._op("scatter.mul", [ref, indices, updates], name=name)[0]
+
+    def scatter_div(self, ref, indices, updates, name=None):
+        return self._op("scatter.div", [ref, indices, updates], name=name)[0]
+
+    def scatter_max(self, ref, indices, updates, name=None):
+        return self._op("scatter.max", [ref, indices, updates], name=name)[0]
+
+    def scatter_min(self, ref, indices, updates, name=None):
+        return self._op("scatter.min", [ref, indices, updates], name=name)[0]
+
+    def gather_nd(self, x, indices, name=None):
+        return self._op("gather_nd", [x, indices], name=name)[0]
+
+    # segment family (reference SDBaseOps segment* / unsortedSegment*: the
+    # jax impls don't require sorted ids, so both surfaces share one op.
+    # DEVIATION: num_segments is always required — XLA needs static output
+    # shapes, so the sorted variants cannot infer it from the ids at run
+    # time the way the reference kernels do)
+    def _segment(self, kind, data, ids, num_segments, name):
+        return self._op(f"segment.{kind}", [data, ids], name=name,
+                        num_segments=int(num_segments))[0]
+
+    def segment_sum(self, data, ids, num_segments, name=None):
+        return self._segment("sum", data, ids, num_segments, name)
+
+    def segment_mean(self, data, ids, num_segments, name=None):
+        return self._segment("mean", data, ids, num_segments, name)
+
+    def segment_max(self, data, ids, num_segments, name=None):
+        return self._segment("max", data, ids, num_segments, name)
+
+    def segment_min(self, data, ids, num_segments, name=None):
+        return self._segment("min", data, ids, num_segments, name)
+
+    def segment_prod(self, data, ids, num_segments, name=None):
+        return self._segment("prod", data, ids, num_segments, name)
+
+    unsorted_segment_sum = segment_sum
+    unsorted_segment_mean = segment_mean
+    unsorted_segment_max = segment_max
+    unsorted_segment_min = segment_min
+    unsorted_segment_prod = segment_prod
+
+    def sequence_mask(self, lengths, maxlen, dtype="float32", name=None):
+        return self._op("sequence_mask", [lengths], name=name,
+                        maxlen=int(maxlen), dtype=str(dtype))[0]
+
     def shape_of(self, x, name=None):
         return self._op("shape_of", [x], name=name)[0]
 

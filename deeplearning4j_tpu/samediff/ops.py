@@ -858,6 +858,53 @@ def _lstsq(a, b):
     return jnp.linalg.lstsq(a, b)[0]
 
 
+@register_op("linalg.triangularSolve")
+def _triangular_solve(a, b, *, lower, adjoint):
+    return jax.scipy.linalg.solve_triangular(a, b, lower=lower,
+                                             trans=1 if adjoint else 0)
+
+
+@register_op("linalg.logdet")
+def _logdet(x):
+    # reference logdet: log(det(x)) for positive-definite input
+    return jnp.linalg.slogdet(x)[1]
+
+
+@register_op("linalg.matrixBandPart")
+def _band_part(x, *, num_lower, num_upper):
+    n, m = x.shape[-2], x.shape[-1]
+    i = jnp.arange(n)[:, None]
+    j = jnp.arange(m)[None, :]
+    keep_lo = (i - j) <= num_lower if num_lower >= 0 else True
+    keep_hi = (j - i) <= num_upper if num_upper >= 0 else True
+    return jnp.where(jnp.logical_and(keep_lo, keep_hi), x, 0)
+
+
+@register_op("linalg.tri")
+def _tri(*, rows, cols, k, dtype):
+    return jnp.tri(rows, cols, k, dtype=dtype)
+
+
+@register_op("linalg.triu")
+def _triu(x, *, k):
+    return jnp.triu(x, k)
+
+
+@register_op("linalg.tril")
+def _tril(x, *, k):
+    return jnp.tril(x, k)
+
+
+@register_op("linalg.eye")
+def _eye(*, rows, cols, dtype):
+    return jnp.eye(rows, cols, dtype=dtype)
+
+
+@register_op("linalg.diagPart")
+def _diag_part(x):
+    return jnp.diagonal(x, axis1=-2, axis2=-1)
+
+
 class SDLinalg(_Namespace):
     """Reference ``sd.linalg()``."""
 
@@ -884,6 +931,90 @@ class SDLinalg(_Namespace):
 
     def lstsq(self, a, b, name=None):
         return self._op("linalg.lstsq", [a, b], name=name)[0]
+
+    def triangularSolve(self, a, b, lower=True, adjoint=False, name=None):
+        return self._op("linalg.triangularSolve", [a, b], name=name,
+                        lower=bool(lower), adjoint=bool(adjoint))[0]
+
+    def logdet(self, x, name=None):
+        return self._op("linalg.logdet", [x], name=name)[0]
+
+    def matrixBandPart(self, x, num_lower, num_upper, name=None):
+        return self._op("linalg.matrixBandPart", [x], name=name,
+                        num_lower=int(num_lower), num_upper=int(num_upper))[0]
+
+    def tri(self, rows, cols=None, k=0, dtype="float32", name=None):
+        return self._op("linalg.tri", [], name=name, rows=int(rows),
+                        cols=int(cols if cols is not None else rows),
+                        k=int(k), dtype=dtype)[0]
+
+    def triu(self, x, k=0, name=None):
+        return self._op("linalg.triu", [x], name=name, k=int(k))[0]
+
+    def tril(self, x, k=0, name=None):
+        return self._op("linalg.tril", [x], name=name, k=int(k))[0]
+
+    def eye(self, rows, cols=None, dtype="float32", name=None):
+        return self._op("linalg.eye", [], name=name, rows=int(rows),
+                        cols=int(cols if cols is not None else rows),
+                        dtype=dtype)[0]
+
+    def diagPart(self, x, name=None):
+        return self._op("linalg.diagPart", [x], name=name)[0]
+
+
+# ======================= scatter / gather-nd / segment =======================
+# Reference: SDBaseOps scatterAdd/Sub/Mul/Div/Max/Min/Update, gatherNd,
+# segmentSum/Mean/Max/Min/Prod + unsortedSegment* (libnd4j
+# ops/declarable/generic/parity_ops/scatter*.cpp, segment*.cpp). Indices
+# select rows on axis 0; duplicate indices accumulate (scatter add/sub)
+# or combine by the op, matching the reference kernels.
+
+_SCATTER = {
+    "update": lambda ref, i, u: ref.at[i].set(u),
+    "add": lambda ref, i, u: ref.at[i].add(u),
+    "sub": lambda ref, i, u: ref.at[i].add(-u),
+    "mul": lambda ref, i, u: ref.at[i].multiply(u),
+    "div": lambda ref, i, u: ref.at[i].divide(u),
+    "max": lambda ref, i, u: ref.at[i].max(u),
+    "min": lambda ref, i, u: ref.at[i].min(u),
+}
+for _n, _f in _SCATTER.items():
+    register_op(f"scatter.{_n}")(
+        lambda ref, idx, upd, _f=_f: _f(ref, idx.astype(jnp.int32), upd))
+
+
+@register_op("gather_nd")
+def _gather_nd(x, idx):
+    idx = jnp.moveaxis(idx.astype(jnp.int32), -1, 0)
+    return x[tuple(idx)]
+
+
+def _segment_mean(x, ids, num_segments):
+    tot = jax.ops.segment_sum(x, ids, num_segments)
+    cnt = jax.ops.segment_sum(jnp.ones(ids.shape, x.dtype), ids,
+                              num_segments)
+    return tot / jnp.maximum(cnt, 1.0).reshape(
+        cnt.shape + (1,) * (tot.ndim - cnt.ndim))
+
+
+_SEGMENT = {
+    "sum": jax.ops.segment_sum,
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+    "prod": jax.ops.segment_prod,
+    "mean": _segment_mean,
+}
+for _n, _f in _SEGMENT.items():
+    register_op(f"segment.{_n}")(
+        lambda x, ids, *, num_segments, _f=_f: _f(
+            x, ids.astype(jnp.int32), num_segments))
+
+
+@register_op("sequence_mask")
+def _sequence_mask(lengths, *, maxlen, dtype):
+    m = jnp.arange(maxlen) < lengths.astype(jnp.int32)[..., None]
+    return m.astype(dtype)
 
 
 # ======================= image =======================
@@ -923,8 +1054,137 @@ def _crop_resize(x, *, y0, x0, h, w, out_h, out_w):
     return jax.image.resize(crop, (b, out_h, out_w, c), method="bilinear")
 
 
+def _rgb_to_hsv_impl(x):
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    mx = jnp.maximum(jnp.maximum(r, g), b)
+    mn = jnp.minimum(jnp.minimum(r, g), b)
+    d = mx - mn
+    safe = jnp.where(d == 0, 1.0, d)
+    h = jnp.where(
+        mx == r, (g - b) / safe % 6.0,
+        jnp.where(mx == g, (b - r) / safe + 2.0, (r - g) / safe + 4.0))
+    h = jnp.where(d == 0, 0.0, h) / 6.0
+    s = jnp.where(mx == 0, 0.0, d / jnp.where(mx == 0, 1.0, mx))
+    return jnp.stack([h, s, mx], axis=-1)
+
+
+def _hsv_to_rgb_impl(x):
+    h, s, v = x[..., 0] * 6.0, x[..., 1], x[..., 2]
+    i = jnp.floor(h)
+    f = h - i
+    p = v * (1 - s)
+    q = v * (1 - s * f)
+    t = v * (1 - s * (1 - f))
+    i = i.astype(jnp.int32) % 6
+    r = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [v, q, p, p, t, v])
+    g = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [t, v, v, q, p, p])
+    b = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [p, p, t, v, v, q])
+    return jnp.stack([r, g, b], axis=-1)
+
+
+register_op("image.rgbToHsv")(_rgb_to_hsv_impl)
+register_op("image.hsvToRgb")(_hsv_to_rgb_impl)
+
+
+@register_op("image.rgbToGrayscale")
+def _rgb_to_gray(x):
+    w = jnp.asarray([0.2989, 0.5870, 0.1140], x.dtype)
+    return jnp.sum(x * w, axis=-1, keepdims=True)
+
+
+@register_op("image.adjustHue")
+def _adjust_hue(x, *, delta):
+    hsv = _rgb_to_hsv_impl(x)
+    h = (hsv[..., 0] + delta) % 1.0
+    return _hsv_to_rgb_impl(jnp.stack([h, hsv[..., 1], hsv[..., 2]], -1))
+
+
+@register_op("image.adjustSaturation")
+def _adjust_saturation(x, *, factor):
+    hsv = _rgb_to_hsv_impl(x)
+    s = jnp.clip(hsv[..., 1] * factor, 0.0, 1.0)
+    return _hsv_to_rgb_impl(jnp.stack([hsv[..., 0], s, hsv[..., 2]], -1))
+
+
+@register_op("image.extractImagePatches")
+def _extract_patches(x, *, kh, kw, sh, sw, padding):
+    # [B,H,W,C] -> [B,OH,OW,kh*kw*C] (TF extract_image_patches layout)
+    b, _, _, c = x.shape
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    # patches come channel-major [.., C*kh*kw]; reorder to kh*kw*C
+    oh, ow = patches.shape[1], patches.shape[2]
+    patches = patches.reshape(b, oh, ow, c, kh * kw)
+    return jnp.swapaxes(patches, -1, -2).reshape(b, oh, ow, kh * kw * c)
+
+
+@register_op("image.nonMaxSuppression")
+def _nms(boxes, scores, *, max_output_size, iou_threshold, score_threshold):
+    """Greedy NMS, static output (TF nonMaxSuppressionPadded semantics:
+    [max_output_size] selected indices, -1 padded). Boxes [n, 4] as
+    (y1, x1, y2, x2)."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    bs = boxes[order]
+    area = jnp.maximum(bs[:, 2] - bs[:, 0], 0) * jnp.maximum(
+        bs[:, 3] - bs[:, 1], 0)
+    suppressed = scores[order] < score_threshold
+
+    def body(i, sup):
+        yy1 = jnp.maximum(bs[i, 0], bs[:, 0])
+        xx1 = jnp.maximum(bs[i, 1], bs[:, 1])
+        yy2 = jnp.minimum(bs[i, 2], bs[:, 2])
+        xx2 = jnp.minimum(bs[i, 3], bs[:, 3])
+        inter = jnp.maximum(yy2 - yy1, 0) * jnp.maximum(xx2 - xx1, 0)
+        iou = inter / jnp.maximum(area[i] + area - inter, 1e-9)
+        kill = (jnp.arange(n) > i) & (iou > iou_threshold) & ~sup[i]
+        return sup | kill
+
+    sup = jax.lax.fori_loop(0, n, body, suppressed)
+    k = min(max_output_size, n)
+    pos = jnp.argsort(sup, stable=True)[:k]
+    sel = jnp.where(sup[pos], -1, order[pos]).astype(jnp.int32)
+    # static [max_output_size] output even when fewer boxes exist
+    return jnp.pad(sel, (0, max_output_size - k), constant_values=-1)
+
+
 class SDImage(_Namespace):
     """Reference ``sd.image()``."""
+
+    def rgbToHsv(self, x, name=None):
+        return self._op("image.rgbToHsv", [x], name=name)[0]
+
+    def hsvToRgb(self, x, name=None):
+        return self._op("image.hsvToRgb", [x], name=name)[0]
+
+    def rgbToGrayscale(self, x, name=None):
+        return self._op("image.rgbToGrayscale", [x], name=name)[0]
+
+    def adjustHue(self, x, delta, name=None):
+        return self._op("image.adjustHue", [x], name=name,
+                        delta=float(delta))[0]
+
+    def adjustSaturation(self, x, factor, name=None):
+        return self._op("image.adjustSaturation", [x], name=name,
+                        factor=float(factor))[0]
+
+    def extractImagePatches(self, x, kh, kw, sh=1, sw=1, padding="VALID",
+                            name=None):
+        return self._op("image.extractImagePatches", [x], name=name,
+                        kh=int(kh), kw=int(kw), sh=int(sh), sw=int(sw),
+                        padding=padding)[0]
+
+    def nonMaxSuppression(self, boxes, scores, max_output_size,
+                          iou_threshold=0.5, score_threshold=-1e30,
+                          name=None):
+        return self._op("image.nonMaxSuppression", [boxes, scores],
+                        name=name, max_output_size=int(max_output_size),
+                        iou_threshold=float(iou_threshold),
+                        score_threshold=float(score_threshold))[0]
 
     def resizeBilinear(self, x, height, width, name=None):
         return self._op("image.resizeBilinear", [x], name=name,
@@ -960,8 +1220,57 @@ for _n, _f in {
     register_op(f"bitwise.{_n}")(_f)
 
 
+def _bit_width(x):
+    return jnp.iinfo(x.dtype).bits
+
+
+@register_op("bitwise.cyclicShiftLeft")
+def _rotl(x, s):
+    w = _bit_width(x)
+    s = s.astype(x.dtype) % w
+    # (w - s) % w: a shift equal to the bit width is undefined in XLA
+    return (x << s) | _logical_rshift(x, (w - s) % w, w)
+
+
+@register_op("bitwise.cyclicShiftRight")
+def _rotr(x, s):
+    w = _bit_width(x)
+    s = s.astype(x.dtype) % w
+    return _logical_rshift(x, s, w) | (x << ((w - s) % w))
+
+
+def _logical_rshift(x, s, w):
+    # >> on signed ints is arithmetic; rotate needs the logical shift
+    ux = x.astype(jnp.dtype(f"uint{w}"))
+    return (ux >> s.astype(ux.dtype)).astype(x.dtype)
+
+
+@register_op("bitwise.toggleBits")
+def _toggle_bits(x):
+    return jnp.invert(x)
+
+
+@register_op("bitwise.bitsHammingDistance")
+def _hamming(a, b):
+    diff = jnp.bitwise_xor(a, b)
+    ud = diff.astype(jnp.dtype(f"uint{_bit_width(diff)}"))
+    return jnp.sum(jax.lax.population_count(ud).astype(jnp.int32))
+
+
 class SDBitwise(_Namespace):
     """Reference ``sd.bitwise()``."""
+
+    def cyclicShiftLeft(self, x, shift, name=None):
+        return self._op("bitwise.cyclicShiftLeft", [x, shift], name=name)[0]
+
+    def cyclicShiftRight(self, x, shift, name=None):
+        return self._op("bitwise.cyclicShiftRight", [x, shift], name=name)[0]
+
+    def toggleBits(self, x, name=None):
+        return self._op("bitwise.toggleBits", [x], name=name)[0]
+
+    def bitsHammingDistance(self, a, b, name=None):
+        return self._op("bitwise.bitsHammingDistance", [a, b], name=name)[0]
 
     def and_(self, a, b, name=None):
         return self._op("bitwise.and_", [a, b], name=name)[0]

@@ -32,8 +32,14 @@ class TestCase:
                  grad_wrt: list | None = None, epsilon: float = 1e-6,
                  max_rel_error: float = 1e-4):
         self.sd = sd
-        self.inputs = {k: np.asarray(v, np.float64)
-                       for k, v in inputs.items()}
+        # float inputs promote to f64 (the reference's double-precision
+        # gradient-check protocol); integer/bool inputs keep their dtype
+        # (bitwise/scatter-index operands must stay integral)
+        self.inputs = {
+            k: (np.asarray(v, np.float64)
+                if np.issubdtype(np.asarray(v).dtype, np.floating)
+                else np.asarray(v))
+            for k, v in inputs.items()}
         self.expected = {k: np.asarray(v) for k, v in expected.items()}
         # grad_wrt=[] means "forward-only" (bool/int outputs, non-smooth
         # ops); only None defaults to checking every input
@@ -76,12 +82,19 @@ def _validate_x64(case: TestCase) -> None:
     fn = sd.make_function(out_names)
 
     def scalar(ph_vals):
-        res = fn(dict(sd.arrays), {k: jnp.asarray(v, jnp.float64)
-                                   for k, v in ph_vals.items()})
+        res = fn(dict(sd.arrays), {
+            k: (jnp.asarray(v, jnp.float64)
+                if np.issubdtype(jnp.asarray(v).dtype, np.floating)
+                else jnp.asarray(v))
+            for k, v in ph_vals.items()})
         return sum(jnp.sum(v) for v in res.values())
 
-    analytic = jax.grad(lambda pv: scalar(pv))(
-        {k: jnp.asarray(v) for k, v in case.inputs.items()})
+    # differentiate ONLY the requested (float) placeholders — int/bool
+    # operands (indices, segment ids, masks) ride along as constants
+    fixed = {k: v for k, v in case.inputs.items() if k not in case.grad_wrt}
+    analytic = jax.grad(lambda pv: scalar({**fixed, **pv}))(
+        {k: jnp.asarray(v) for k, v in case.inputs.items()
+         if k in case.grad_wrt})
     for k in case.grad_wrt:
         a = np.asarray(analytic[k], np.float64).ravel()
         x0 = case.inputs[k].copy()
