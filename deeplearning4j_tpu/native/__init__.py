@@ -96,7 +96,19 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 return None
             lib = _bind(ctypes.CDLL(str(_OUT)))
             if lib.dl4j_native_version() != _ABI_VERSION:
-                return None  # refuse a mismatched binary outright
+                if stale:
+                    # we JUST built from current source and it still
+                    # mismatches: wrapper/source version skew — a rebuild
+                    # cannot help, fail fast (cached via _tried)
+                    return None
+                # old artifact under the right filename: delete and
+                # rebuild ONCE from current source
+                _OUT.unlink(missing_ok=True)
+                if not _build():
+                    return None
+                lib = _bind(ctypes.CDLL(str(_OUT)))
+                if lib.dl4j_native_version() != _ABI_VERSION:
+                    return None
             _lib = lib
         except Exception:
             _lib = None

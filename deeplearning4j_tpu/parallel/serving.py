@@ -80,7 +80,8 @@ class InferenceServer:
         return info
 
     # --- lifecycle ----------------------------------------------------------
-    def start(self, port: int = 0, host: str = "127.0.0.1"):
+    def start(self, port: int = 0, host: str = "127.0.0.1",
+              max_body_bytes: int = 64 * 1024 * 1024):
         import http.server
 
         if self._httpd is not None:
@@ -109,6 +110,12 @@ class InferenceServer:
                     self._send(404, {"error": "not found"})
                     return
                 length = int(self.headers.get("Content-Length", 0))
+                if length < 0 or length > max_body_bytes:
+                    # reject before reading: one oversized request (or a
+                    # negative length turning read() unbounded) must not
+                    # exhaust the serving process's memory
+                    self._send(413, {"error": "request body too large"})
+                    return
                 try:
                     req = json.loads(self.rfile.read(length))
                     inputs = req["inputs"]

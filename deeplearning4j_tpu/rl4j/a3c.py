@@ -219,8 +219,6 @@ class A3CDiscreteDense:
                 ep_reward += r
                 ep_steps += 1
                 obs = obs2
-                with shared.lock:
-                    shared.step_count += 1
                 if done or ep_steps >= cfg.max_epoch_step:
                     break
             # bootstrap from V(s_last) unless terminal
@@ -238,6 +236,10 @@ class A3CDiscreteDense:
                      jnp.asarray(actions, jnp.int32),
                      jnp.asarray(returns))
             with shared.lock:
+                # steps accumulate once per rollout segment: a per-step
+                # lock acquisition would contend with the update lock and
+                # serialize collection across workers
+                shared.step_count += len(rewards)
                 (shared.params, shared.opt_m, shared.opt_v, _) = _a3c_step(
                     shared.params, shared.opt_m, shared.opt_v, batch,
                     jnp.asarray(float(shared.update_count), jnp.float32),
@@ -329,8 +331,6 @@ class AsyncNStepQLearningDiscreteDense:
                 ep_reward += r
                 ep_steps += 1
                 obs = obs2
-                with shared.lock:
-                    shared.step_count += 1
                 if done or ep_steps >= cfg.max_epoch_step:
                     break
             if done or ep_steps >= cfg.max_epoch_step:
@@ -347,6 +347,8 @@ class AsyncNStepQLearningDiscreteDense:
                      jnp.asarray(actions, jnp.int32),
                      jnp.asarray(targets))
             with shared.lock:
+                # segment-granular step accounting (see A3C worker note)
+                shared.step_count += len(rewards)
                 (shared.params, shared.opt_m, shared.opt_v, _) = (
                     _nstepq_step(
                         shared.params, shared.opt_m, shared.opt_v, batch,

@@ -699,10 +699,12 @@ class SameDiff:
             out = fn(*args)
         except (TypeError, AttributeError):
             # raw jax/numpy applied to an SDVariable placeholder fails with
-            # one of these; anything else (KeyError from a bad op name,
-            # user bugs) propagates so it surfaces at the cond/while/scan
-            # call site, not at a distant jit trace. NOTE: the probe CALLS
-            # the body once at graph build — side effects run here too.
+            # one of these (incl. float(v) coercions — TypeError); the set
+            # stays NARROW on purpose: a ValueError from a genuine user bug
+            # must propagate here, at the cond/while/scan call site, not be
+            # silently routed to the raw-closure path to resurface at a
+            # distant jit trace. NOTE: the probe CALLS the body once at
+            # graph build — side effects run here too (see cond docstring).
             out = None
         finally:
             # a callable mixing parent-graph variables creates stray nodes
@@ -739,7 +741,13 @@ class SameDiff:
         frame machinery with ``lax.cond`` (compiler-friendly; both branches
         traced once). ``true_fn``/``false_fn`` map arrays -> array. When
         the callables stay inside SDVariable ops the graph remains
-        serializable (save/load round-trips the branches)."""
+        serializable (save/load round-trips the branches).
+
+        BUILD-TIME PROBE CONTRACT (also for while_loop/scan): each body is
+        CALLED once on symbolic placeholders at graph build to decide
+        serializability — side effects in the body run at build time, and
+        bodies needing concrete values (``float(v)``, data-dependent
+        Python branching) fall back to the raw-closure (unsaveable) path."""
         from deeplearning4j_tpu.samediff import serde as _serde
 
         n = len(operands)

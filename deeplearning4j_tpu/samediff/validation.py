@@ -42,9 +42,12 @@ class TestCase:
             for k, v in inputs.items()}
         self.expected = {k: np.asarray(v) for k, v in expected.items()}
         # grad_wrt=[] means "forward-only" (bool/int outputs, non-smooth
-        # ops); only None defaults to checking every input
-        self.grad_wrt = (list(self.inputs) if grad_wrt is None
-                         else list(grad_wrt))
+        # ops); None defaults to every FLOAT input (integral operands —
+        # indices, segment ids — are not differentiable)
+        self.grad_wrt = (
+            [k for k, v in self.inputs.items()
+             if np.issubdtype(v.dtype, np.floating)]
+            if grad_wrt is None else list(grad_wrt))
         self.epsilon = float(epsilon)
         self.max_rel_error = float(max_rel_error)
 

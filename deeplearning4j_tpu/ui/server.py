@@ -117,11 +117,15 @@ class UIServer:
             f.write(self.render_html())
         return path
 
-    def start(self, port: int = 9000) -> int:
+    def start(self, port: int = 9000, host: str = "127.0.0.1",
+              max_body_bytes: int = 8 * 1024 * 1024) -> int:
         """Serve the dashboard live (reference ``UIServer`` web server).
         ``port=0`` picks a free port; returns the bound port. Endpoints:
         ``/`` (auto-refreshing dashboard), ``/train/stats.json`` (raw
-        records)."""
+        records). ``host`` defaults to loopback; bind ``"0.0.0.0"`` to
+        receive cross-machine ``RemoteUIStatsStorageRouter`` posts (the
+        reference's remote-router deployment). POST bodies above
+        ``max_body_bytes`` are rejected with 413 before being read."""
         import http.server
         import json as _json
         import threading
@@ -157,6 +161,12 @@ class UIServer:
                     self.end_headers()
                     return
                 length = int(self.headers.get("Content-Length", 0))
+                if length < 0 or length > max_body_bytes:
+                    # one oversized post (or a negative length turning
+                    # read() unbounded) must not exhaust server memory
+                    self.send_response(413)
+                    self.end_headers()
+                    return
                 try:
                     record = _json.loads(self.rfile.read(length))
                 except ValueError:
@@ -174,8 +184,7 @@ class UIServer:
             def log_message(self, *args):
                 pass  # keep training logs clean
 
-        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port),
-                                                      Handler)
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
