@@ -80,6 +80,12 @@ class BaseRecurrentLayer(BaseLayer):
 
     n_out: int = 0
     activation: Activation = Activation.TANH
+    # Keras go_backwards semantics: process the sequence time-reversed and
+    # emit outputs in PROCESSING order (i.e. reversed relative to the
+    # input). Applies to the whole-sequence forward only — carry-threaded
+    # paths (tBPTT segments, rnn_time_step streaming) reject it, exactly
+    # like streaming is undefined for Bidirectional.
+    go_backwards: bool = False
 
     uses_mask = True
     has_carry = True
@@ -96,6 +102,10 @@ class BaseRecurrentLayer(BaseLayer):
         raise NotImplementedError
 
     def forward(self, params, state, x, train=False, rng=None, mask=None):
+        if self.go_backwards:
+            x = jnp.flip(x, axis=1)
+            mask = (None if mask is None
+                    else jnp.flip(jnp.asarray(mask), axis=1))
         carry = self.zero_carry(x.shape[0], x.dtype)
         y, _ = self.forward_with_carry(params, carry, x, mask=mask,
                                        train=train, rng=rng)
@@ -249,6 +259,79 @@ class GravesLSTM(LSTM):
         return ["W", "RW", "pI", "pF", "pO"]
     # forward_with_carry inherited: LSTM's scan applies the pI/pF/pO
     # peephole terms whenever those params are present
+
+
+@serde.register
+@dataclasses.dataclass
+class GRU(BaseRecurrentLayer):
+    """Gated recurrent unit (Cho et al. 2014; Keras-compatible — the
+    reference's Keras importer maps GRU onto its own recurrent stack, this
+    framework implements the cell natively). Packed weights in Keras'
+    Z|R|H gate order along the last axis so imported kernels copy
+    verbatim: W [nIn, 3*nOut], RW [nOut, 3*nOut], b [3*nOut], plus a
+    recurrent bias rb [3*nOut] when ``reset_after`` (the Keras 2 default
+    variant: the reset gate applies AFTER the recurrent matmul)."""
+
+    gate_activation: Activation = Activation.SIGMOID
+    reset_after: bool = False
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        n_in = _rnn_in_size(input_type)
+        h = self.n_out
+        k1, k2 = jax.random.split(key)
+        p = {
+            "W": self.weight_init.init(k1, (n_in, 3 * h), n_in, h, dtype,
+                                       self.distribution),
+            "RW": self.weight_init.init(k2, (h, 3 * h), h, h, dtype,
+                                        self.distribution),
+            "b": jnp.full((3 * h,), self.bias_init, dtype),
+        }
+        if self.reset_after:
+            p["rb"] = jnp.zeros((3 * h,), dtype)
+        return p
+
+    def param_order(self):
+        return (["W", "RW", "b", "rb"] if self.reset_after
+                else ["W", "RW", "b"])
+
+    def regularized_param_keys(self):
+        return ["W", "RW"]
+
+    def zero_carry(self, batch, dtype=jnp.float32):
+        return {"h": jnp.zeros((batch, self.n_out), dtype)}
+
+    def forward_with_carry(self, params, carry, x, mask=None, train=False,
+                           rng=None):
+        x = self._dropout_input(x, train, rng)
+        m = _mask_bt1(mask, x)
+        h = self.n_out
+        xw = jnp.einsum("btf,fg->btg", x, params["W"]) + params["b"]
+        rw, rb = params["RW"], params.get("rb")
+
+        def step(h_prev, inp):
+            xw_t, m_t = inp
+            if self.reset_after:
+                hr = h_prev @ rw + rb
+                z = self.gate_activation.apply(xw_t[:, :h] + hr[:, :h])
+                r = self.gate_activation.apply(
+                    xw_t[:, h:2 * h] + hr[:, h:2 * h])
+                hh = self.activation.apply(
+                    xw_t[:, 2 * h:] + r * hr[:, 2 * h:])
+            else:
+                hr = h_prev @ rw[:, :2 * h]
+                z = self.gate_activation.apply(xw_t[:, :h] + hr[:, :h])
+                r = self.gate_activation.apply(
+                    xw_t[:, h:2 * h] + hr[:, h:2 * h])
+                hh = self.activation.apply(
+                    xw_t[:, 2 * h:] + (r * h_prev) @ rw[:, 2 * h:])
+            h_new = z * h_prev + (1.0 - z) * hh
+            h_t = m_t * h_new + (1.0 - m_t) * h_prev
+            return h_t, m_t * h_new
+
+        h_f, ys = jax.lax.scan(
+            step, carry["h"],
+            (jnp.swapaxes(xw, 0, 1), jnp.swapaxes(m, 0, 1)))
+        return jnp.swapaxes(ys, 0, 1), {"h": h_f}
 
 
 @serde.register_enum

@@ -84,6 +84,10 @@ def _tensor_to_np(t: "pb.TensorProto") -> np.ndarray:
                     arr = np.broadcast_to(arr, shape).copy()
                 else:
                     arr = arr.reshape(shape)
+            elif arr.size == 1:
+                # rank-0 TensorProto: empty tensor_shape + one value is a
+                # SCALAR (a (1,) array here breaks loop-carry shapes)
+                arr = arr.reshape(())
             return arr
     return np.zeros(shape, dtype)
 
@@ -154,10 +158,24 @@ class _Mapper:
         self.names: dict[str, str] = {}
         # Const node name -> numpy value (for static attrs: shapes, axes...)
         self.const_np: dict[str, np.ndarray] = {}
+        # TF2 functional control flow: While/If bodies live in the graph's
+        # FunctionDefLibrary (reference TFGraphMapper handles the v1
+        # Enter/Merge/Switch frames instead; the functional form is what
+        # tf.function/saved-model freezing emits today)
+        self.funcs = {f.signature.name: f
+                      for f in graph.library.function}
 
     # -- helpers -------------------------------------------------------------
     def _inputs(self, node) -> list[str]:
         return [c for c in (_clean(i) for i in node.input) if c]
+
+    def _func(self, fname: str, node) -> "pb.FunctionDef":
+        f = self.funcs.get(fname)
+        if f is None:
+            raise UnsupportedTFOpException(
+                f"node {node.name!r} ({node.op}) references function "
+                f"{fname!r} absent from the graph's function library")
+        return f
 
     def _var(self, tf_name: str) -> SDVariable:
         return SDVariable(self.sd, self.names[tf_name])
@@ -283,9 +301,34 @@ class _Mapper:
             _require_nhwc(node)
             eps = node.attr["epsilon"].f or 1e-3
             x, gamma, beta, mean, var_ = (self._var(i) for i in ins[:5])
-            v = sd._op("nn.batchNorm", [x, mean, var_, gamma, beta],
+            # NOTE: proto3 can't distinguish a missing is_training attr
+            # from an explicit false; TF's op default is True, but frozen
+            # graphs are inference graphs — treat absent/false as
+            # inference and require an explicit true for the training form
+            if node.attr["is_training"].b:
+                # training mode: batch statistics computed in-graph (the
+                # mean/variance inputs are ignored, as in TF); outputs
+                # 1/2 are the batch stats so a fine-tune step can consume
+                # them for running-average updates
+                mean = sd._op("reduce.mean", [x], axis=(0, 1, 2),
+                              keepdims=False)[0]
+                d = sd._op("math.sub", [x, mean])[0]
+                var_ = sd._op("reduce.mean",
+                              [sd._op("math.mul", [d, d])[0]],
+                              axis=(0, 1, 2), keepdims=False)[0]
+            y = sd._op("nn.batchNorm", [x, mean, var_, gamma, beta],
                        axis=-1, eps=float(eps))[0]
-            self._bind(node, v)
+            # TF output layout: y, batch_mean, batch_variance,
+            # reserve_space_1/2 (+3 in V3) — reserves alias the stats.
+            # Stats outputs get identity wrappers: _bind_multi renames
+            # variables to 'node:i', which must never rename a SHARED
+            # input (the inference form passes the running-stats consts
+            # straight through)
+            stats = [mean, var_, mean, var_]
+            if op == "FusedBatchNormV3":
+                stats.append(var_)
+            outs = [y] + [sd._op("identity", [t])[0] for t in stats]
+            self._bind_multi(node, outs)
         elif op == "Reshape":
             shape = tuple(int(v) for v in self._static(ins[1], node))
             v = sd._op("reshape", [self._var(ins[0])], shape=shape)[0]
@@ -460,7 +503,86 @@ class _Mapper:
             axis = int(self._static(ins[1], node))
             v = sd._op("math.cumsum", [self._var(ins[0])], axis=axis)[0]
             self._bind(node, v)
+        elif op in ("While", "StatelessWhile"):
+            cond_f = self._func(node.attr["cond"].func.name, node)
+            body_f = self._func(node.attr["body"].func.name, node)
+            operands = [self._var(i) for i in ins]
+
+            def cond_fn(*args):
+                return _FuncMapper(self, cond_f, args).run_body()[0]
+
+            def body_fn(*args):
+                return _FuncMapper(self, body_f, args).run_body()
+
+            outs = sd.while_loop(cond_fn, body_fn, operands,
+                                 name=node.name + "_while")
+            self._bind_multi(node, list(outs))
+        elif op in ("If", "StatelessIf"):
+            then_f = self._func(node.attr["then_branch"].func.name, node)
+            else_f = self._func(node.attr["else_branch"].func.name, node)
+            if len(then_f.signature.output_arg) != 1:
+                raise UnsupportedTFOpException(
+                    f"{node.name}: If with {len(then_f.signature.output_arg)}"
+                    " outputs unsupported (single-output branches only)")
+            pred = self._var(ins[0])
+            operands = [self._var(i) for i in ins[1:]]
+
+            def then_fn(*args):
+                return _FuncMapper(self, then_f, args).run_body()[0]
+
+            def else_fn(*args):
+                return _FuncMapper(self, else_f, args).run_body()[0]
+
+            v = sd.cond(pred, then_fn, else_fn, operands,
+                        name=node.name + "_if")
+            self._bind(node, v)
         else:
             raise UnsupportedTFOpException(
                 f"unmapped TF op {op!r} at node {node.name!r} "
                 f"(reference TFGraphMapper raises the same way)")
+
+
+def _clean_func_ref(ref: str) -> str:
+    """FunctionDef-body tensor reference -> node key. Inside a function,
+    inputs are ``node:output_arg_name:index`` (vs the graph's
+    ``node:index``); output 0 shortens to the bare node name so
+    single-output ops resolve, other indices keep ``node:index``."""
+    if ref.startswith("^"):
+        return ""
+    parts = ref.split(":")
+    if len(parts) == 1:
+        return parts[0]
+    idx = parts[-1]
+    return parts[0] if idx == "0" else f"{parts[0]}:{idx}"
+
+
+class _FuncMapper(_Mapper):
+    """Maps one FunctionDef body (a While/If branch) into the SameDiff
+    graph its argument variables live in — during ``sd.while_loop``'s
+    build probe that is the fresh child subgraph, so imported control
+    flow serializes exactly like natively-built control flow."""
+
+    def __init__(self, parent: _Mapper, fdef, args):
+        self.graph = parent.graph
+        self.funcs = parent.funcs
+        if len(args) != len(fdef.signature.input_arg):
+            raise UnsupportedTFOpException(
+                f"function {fdef.signature.name!r} takes "
+                f"{len(fdef.signature.input_arg)} args, got {len(args)}")
+        self.sd = args[0].sd if args else parent.sd
+        self.names = {a.name: v.name
+                      for a, v in zip(fdef.signature.input_arg, args)}
+        self.const_np = {}
+        self.fdef = fdef
+
+    def _inputs(self, node) -> list[str]:
+        return [c for c in (_clean_func_ref(i) for i in node.input) if c]
+
+    def run_body(self) -> list:
+        for node in self.fdef.node_def:
+            self._map_node(node)
+        outs = []
+        for out_arg in self.fdef.signature.output_arg:
+            ref = _clean_func_ref(self.fdef.ret[out_arg.name])
+            outs.append(SDVariable(self.sd, self.names[ref]))
+        return outs

@@ -8,10 +8,14 @@ naturally (Keras kernels are [in, out] / HWIO, exactly this framework's
 layouts — the reference has to transpose into its NCHW/ [out, in] forms).
 
 Supports the Keras 2.x HDF5 format (``model_config`` JSON attribute +
-``model_weights`` groups): Sequential models with InputLayer, Dense, Conv2D,
-MaxPooling2D, AveragePooling2D, Flatten, Dropout, Activation,
-BatchNormalization, LSTM, Embedding, GlobalAveragePooling2D. LSTM gates are
-re-packed from Keras' IFCO order into this framework's IFOG.
+``model_weights`` groups): Sequential and functional models with
+InputLayer, Dense, Conv1D/2D/3D, Separable/DepthwiseConv2D, pooling and
+global pooling, Flatten, Dropout, Activation, BatchNormalization,
+ZeroPadding2D/Cropping2D/UpSampling2D, RepeatVector, Embedding,
+SimpleRNN/LSTM/GRU (incl. ``go_backwards`` and GRU ``reset_after``), and
+the Bidirectional wrapper (forward_*/backward_* weight groups ->
+f/b-prefixed params). LSTM gates are re-packed from Keras' IFCO order
+into this framework's IFOG; GRU's Z|R|H packing is shared.
 """
 
 from __future__ import annotations
@@ -42,14 +46,24 @@ from deeplearning4j_tpu.conf.layers_cnn import (
     Upsampling2D,
     ZeroPaddingLayer,
 )
-from deeplearning4j_tpu.conf.layers_extra import DepthwiseConvolution2D
+from deeplearning4j_tpu.conf.layers_cnn import Convolution1DLayer
+from deeplearning4j_tpu.conf.layers_extra import (
+    Convolution3D,
+    DepthwiseConvolution2D,
+    RepeatVector,
+)
 from deeplearning4j_tpu.conf.layers_rnn import SimpleRnn
 from deeplearning4j_tpu.conf.graph import (
     ElementWiseOp,
     ElementWiseVertex,
     MergeVertex,
 )
-from deeplearning4j_tpu.conf.layers_rnn import LSTM
+from deeplearning4j_tpu.conf.layers_rnn import (
+    GRU,
+    LSTM,
+    Bidirectional,
+    BidirectionalMode,
+)
 from deeplearning4j_tpu.conf.losses import LossMCXENT, LossMSE
 from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
 
@@ -164,6 +178,9 @@ def _input_type(first_cfg: dict):
     if len(dims) == 3:  # Keras default channels_last == our NHWC
         return InputType.convolutional(int(dims[0]), int(dims[1]),
                                        int(dims[2]))
+    if len(dims) == 4:  # Conv3D: channels_last NDHWC
+        return InputType.convolutional_3d(int(dims[0]), int(dims[1]),
+                                          int(dims[2]), int(dims[3]))
     raise InvalidKerasConfigurationException(
         f"unsupported input rank {len(dims) + 1}")
 
@@ -211,15 +228,47 @@ def _map_layer(cls: str, cfg: dict, name: str, is_output: bool = False):
             raise InvalidKerasConfigurationException(
                 "LSTM with return_sequences=False: wrap with "
                 "LastTimeStep manually (not auto-mapped)")
-        if cfg.get("go_backwards", False):
-            raise InvalidKerasConfigurationException(
-                f"{name}: go_backwards RNNs are not auto-mapped (use a "
-                "Bidirectional wrapper or reverse the input)")
         return LSTM(n_out=int(cfg["units"]),
                     activation=_act(cfg.get("activation", "tanh")),
                     gate_activation=_act(
                         cfg.get("recurrent_activation", "sigmoid")),
+                    go_backwards=bool(cfg.get("go_backwards", False)),
                     name=name)
+    if cls == "GRU":
+        if not cfg.get("return_sequences", False):
+            raise InvalidKerasConfigurationException(
+                "GRU with return_sequences=False: wrap with "
+                "LastTimeStep manually (not auto-mapped)")
+        # reset_after absent = Keras <= 2.1 files, whose GRU math is
+        # reset-BEFORE — default False (Keras >= 2.2 always writes the key)
+        return GRU(n_out=int(cfg["units"]),
+                   activation=_act(cfg.get("activation", "tanh")),
+                   gate_activation=_act(
+                       cfg.get("recurrent_activation", "sigmoid")),
+                   reset_after=bool(cfg.get("reset_after", False)),
+                   go_backwards=bool(cfg.get("go_backwards", False)),
+                   name=name)
+    if cls == "Bidirectional":
+        inner_cfg = cfg.get("layer", {})
+        if inner_cfg.get("config", {}).get("go_backwards", False):
+            raise InvalidKerasConfigurationException(
+                f"{name}: Bidirectional over a go_backwards layer is not "
+                "supported (the wrapper's own reversal would compose with "
+                "it; re-export with go_backwards=False)")
+        inner = _map_layer(inner_cfg.get("class_name"),
+                           dict(inner_cfg.get("config", {}),
+                                go_backwards=False),
+                           name + "_inner")
+        merge = {"concat": BidirectionalMode.CONCAT,
+                 "sum": BidirectionalMode.ADD,
+                 "ave": BidirectionalMode.AVERAGE,
+                 "mul": BidirectionalMode.MUL}.get(
+            cfg.get("merge_mode", "concat"))
+        if merge is None:
+            raise InvalidKerasConfigurationException(
+                f"{name}: unsupported Bidirectional merge_mode "
+                f"{cfg.get('merge_mode')!r}")
+        return Bidirectional(layer=inner, mode=merge, name=name)
     if cls == "Embedding":
         return EmbeddingSequenceLayer(n_out=int(cfg["output_dim"]),
                                       n_in=int(cfg["input_dim"]), name=name)
@@ -264,13 +313,40 @@ def _map_layer(cls: str, cfg: dict, name: str, is_output: bool = False):
             raise InvalidKerasConfigurationException(
                 "SimpleRNN with return_sequences=False: wrap with "
                 "LastTimeStep manually (not auto-mapped)")
-        if cfg.get("go_backwards", False):
-            raise InvalidKerasConfigurationException(
-                f"{name}: go_backwards RNNs are not auto-mapped (use a "
-                "Bidirectional wrapper or reverse the input)")
         return SimpleRnn(n_out=int(cfg["units"]),
                          activation=_act(cfg.get("activation", "tanh")),
+                         go_backwards=bool(cfg.get("go_backwards", False)),
                          name=name)
+    if cls == "Conv1D":
+        one = lambda v: int(v[0] if isinstance(v, (list, tuple)) else v)  # noqa: E731
+        if one(cfg.get("dilation_rate", 1)) != 1:
+            raise InvalidKerasConfigurationException(
+                f"{name}: dilated Conv1D not supported")
+        if cfg.get("padding") == "causal":
+            raise InvalidKerasConfigurationException(
+                f"{name}: causal Conv1D padding not supported (pad the "
+                "input explicitly)")
+        return Convolution1DLayer(
+            n_out=int(cfg["filters"]), kernel=one(cfg.get("kernel_size", 3)),
+            stride1d=one(cfg.get("strides", 1)),
+            convolution_mode=_mode(cfg.get("padding", "valid")),
+            activation=_act(cfg.get("activation")),
+            has_bias=bool(cfg.get("use_bias", True)), name=name)
+    if cls == "Conv3D":
+        triple = (lambda v: tuple(int(x) for x in v)
+                  if isinstance(v, (list, tuple)) else (int(v),) * 3)
+        if triple(cfg.get("dilation_rate", 1)) != (1, 1, 1):
+            raise InvalidKerasConfigurationException(
+                f"{name}: dilated Conv3D not supported")
+        return Convolution3D(
+            n_out=int(cfg["filters"]),
+            kernel_size=triple(cfg.get("kernel_size", 2)),
+            stride=triple(cfg.get("strides", 1)),
+            convolution_mode=_mode(cfg.get("padding", "valid")),
+            activation=_act(cfg.get("activation")),
+            has_bias=bool(cfg.get("use_bias", True)), name=name)
+    if cls == "RepeatVector":
+        return RepeatVector(repetition_factor=int(cfg["n"]), name=name)
     raise InvalidKerasConfigurationException(
         f"unsupported Keras layer class '{cls}'")
 
@@ -418,12 +494,32 @@ def _copy_layer_weights(tgt: dict, layer, ws: Dict[str, np.ndarray],
                         state: dict, keras_name: str):
     """Copy one Keras weight group into one layer's param dict (shared by
     the Sequential and functional loaders). ``state`` is the layer's
-    mutable state dict (BN moving stats) — may be empty."""
+    mutable state dict (BN moving stats) — may be empty. ``ws`` keys are
+    h5 paths; flattened to leaf names here (wrappers consume the paths)."""
     import jax.numpy as jnp
 
     cls = type(layer).__name__
+    if cls == "Bidirectional":
+        # keras nests forward_<name>/... and backward_<name>/... weight
+        # groups; our param dict prefixes the inner keys with f/b
+        def _is_backward(path: str) -> bool:
+            return any(p.startswith("backward") for p in path.split("/"))
+
+        fws = _leaves({k: v for k, v in ws.items() if not _is_backward(k)})
+        bws = _leaves({k: v for k, v in ws.items() if _is_backward(k)})
+        sub_f = {k[1:]: v for k, v in tgt.items() if k.startswith("f")}
+        sub_b = {k[1:]: v for k, v in tgt.items() if k.startswith("b")}
+        _copy_layer_weights(sub_f, layer.layer, fws, {},
+                            keras_name + "/forward")
+        _copy_layer_weights(sub_b, layer.layer, bws, {},
+                            keras_name + "/backward")
+        tgt.update({f"f{k}": v for k, v in sub_f.items()})
+        tgt.update({f"b{k}": v for k, v in sub_b.items()})
+        return
+    ws = _leaves(ws)
     if "kernel" in ws and cls in ("DenseLayer", "OutputLayer",
-                                  "ConvolutionLayer"):
+                                  "ConvolutionLayer", "Convolution1DLayer",
+                                  "Convolution3D"):
         _check_and_set(tgt, "W", ws["kernel"])
         if "bias" in ws and "b" in tgt:
             _check_and_set(tgt, "b", ws["bias"])
@@ -464,6 +560,17 @@ def _copy_layer_weights(tgt: dict, layer, ws: Dict[str, np.ndarray],
         _check_and_set(tgt, "RW", ws["recurrent_kernel"])
         if "bias" in ws and "b" in tgt:
             _check_and_set(tgt, "b", ws["bias"])
+    elif cls == "GRU":
+        # keras packs z|r|h — identical to this framework's GRU layout
+        _check_and_set(tgt, "W", ws["kernel"])
+        _check_and_set(tgt, "RW", ws["recurrent_kernel"])
+        if "bias" in ws:
+            bias = ws["bias"]
+            if bias.ndim == 2:  # reset_after: [2, 3u] = input/recurrent
+                _check_and_set(tgt, "b", bias[0])
+                _check_and_set(tgt, "rb", bias[1])
+            else:
+                _check_and_set(tgt, "b", bias)
     else:
         raise InvalidKerasConfigurationException(
             f"no weight mapping for layer {cls} <- keras '{keras_name}'")
@@ -533,21 +640,29 @@ def _build_conf(layer_cfgs: List[dict]):
 
 
 def _weight_group(f, keras_name: str):
+    """-> {relative_path: array} for the layer's weight group (paths keep
+    the nesting so wrappers like Bidirectional can tell forward_*/
+    backward_* apart; use :func:`_leaves` for leaf-name access)."""
     mw = f["model_weights"]
     if keras_name not in mw:
         return None
     g = mw[keras_name]
-    # Keras nests again by layer name (e.g. model_weights/dense/dense/...)
     datasets: Dict[str, np.ndarray] = {}
 
     def visit(name, obj):
         import h5py
 
         if isinstance(obj, h5py.Dataset):
-            datasets[name.split("/")[-1].split(":")[0]] = np.asarray(obj)
+            datasets[name] = np.asarray(obj)
 
     g.visititems(visit)
     return datasets
+
+
+def _leaves(ws: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """{path: arr} -> {leaf_name_without_:0: arr} (Keras nests again by
+    layer name, e.g. model_weights/dense/dense/kernel:0)."""
+    return {k.split("/")[-1].split(":")[0]: v for k, v in ws.items()}
 
 
 def _load_weights(f, net, keras_names: List[str]):

@@ -521,6 +521,12 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         default masks, 1-D labels mask expanded per-timestep. Used by
         ParallelWrapper to feed the sharded scan runner the exact arrays
         the single-device path trains on."""
+        for i, layer in enumerate(self.conf.layers):
+            if getattr(layer, "go_backwards", False):
+                raise RuntimeError(
+                    f"layer {i}: go_backwards RNNs cannot train with "
+                    "truncated BPTT (carries thread forward in time); use "
+                    "STANDARD backprop")
         ds = self._tbptt_prepad(ds)
         features, labels, fmask, lmask = self._batch_arrays(
             ds, lazy_lmask=True, write_back=True)
@@ -647,6 +653,10 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
                     "(including wrapped ones): the backward pass needs the "
                     "full sequence (reference throws "
                     "UnsupportedOperationException here)")
+            if getattr(layer, "go_backwards", False):
+                raise RuntimeError(
+                    "rnn_time_step is unsupported for go_backwards RNNs: "
+                    "reversed processing needs the full sequence")
         if self._rnn_step_fn is None:
             self._rnn_step_fn = self._build_rnn_step_fn()
         x = nn_io.as_device(x, self._dtype, feature=True)

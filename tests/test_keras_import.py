@@ -147,8 +147,8 @@ def test_import_rejects_functional_and_bad_layers(tmp_path):
         KerasModelImport.import_keras_sequential_model_and_weights(path)
 
     cfg = {"class_name": "Sequential", "config": {"layers": [
-        {"class_name": "Conv3D", "config": {
-            "name": "c3", "batch_input_shape": [None, 4, 4, 4, 1]}}]}}
+        {"class_name": "ConvLSTM2D", "config": {
+            "name": "cl", "batch_input_shape": [None, 4, 4, 4, 1]}}]}}
     path2 = str(tmp_path / "bad2.h5")
     _write_keras_h5(path2, cfg, {})
     with pytest.raises(InvalidKerasConfigurationException):
@@ -559,9 +559,9 @@ def test_import_depthwise_numeric_oracle(tmp_path, rng):
 def test_import_rejects_unsupported_rnn_and_dilation(tmp_path, rng):
     for layers, match in [
         ([{"class_name": "SimpleRNN", "config": {
-            "name": "r", "units": 4, "return_sequences": True,
-            "go_backwards": True, "batch_input_shape": [None, 6, 3]}}],
-         "go_backwards"),
+            "name": "r", "units": 4, "return_sequences": False,
+            "batch_input_shape": [None, 6, 3]}}],
+         "return_sequences"),
         ([{"class_name": "DepthwiseConv2D", "config": {
             "name": "d", "kernel_size": [3, 3], "dilation_rate": [2, 2],
             "padding": "valid", "batch_input_shape": [None, 8, 8, 2]}}],
@@ -573,3 +573,235 @@ def test_import_rejects_unsupported_rnn_and_dilation(tmp_path, rng):
         _write_keras_h5(path, cfg, {})
         with pytest.raises(InvalidKerasConfigurationException, match=match):
             KerasModelImport.import_keras_sequential_model_and_weights(path)
+
+
+# --------------------------------------------------------------------------
+# round 2: GRU / Bidirectional / go_backwards / Conv1D / Conv3D /
+# RepeatVector
+# --------------------------------------------------------------------------
+
+def _sigmoid(z):
+    return 1.0 / (1.0 + np.exp(-z))
+
+
+def _np_gru(x, kernel, rec, b_in, b_rec, reset_after):
+    """Keras-order GRU forward (z|r|h packing)."""
+    u = rec.shape[0]
+    kz, kr, kh = np.split(kernel, 3, axis=1)
+    rz, rr, rh = np.split(rec, 3, axis=1)
+    bz, br, bh = np.split(b_in, 3)
+    h = np.zeros((x.shape[0], u), np.float32)
+    outs = []
+    for t in range(x.shape[1]):
+        xt = x[:, t]
+        if reset_after:
+            rbz, rbr, rbh = np.split(b_rec, 3)
+            z = _sigmoid(xt @ kz + bz + h @ rz + rbz)
+            r = _sigmoid(xt @ kr + br + h @ rr + rbr)
+            hh = np.tanh(xt @ kh + bh + r * (h @ rh + rbh))
+        else:
+            z = _sigmoid(xt @ kz + bz + h @ rz)
+            r = _sigmoid(xt @ kr + br + h @ rr)
+            hh = np.tanh(xt @ kh + bh + (r * h) @ rh)
+        h = z * h + (1 - z) * hh
+        outs.append(h.copy())
+    return np.stack(outs, 1)
+
+
+@pytest.mark.parametrize("reset_after", [True, False])
+def test_import_gru(tmp_path, rng, reset_after):
+    u, fdim, t = 4, 3, 6
+    kernel = rng.normal(size=(fdim, 3 * u)).astype(np.float32)
+    rec = rng.normal(size=(u, 3 * u)).astype(np.float32)
+    if reset_after:
+        bias = rng.normal(size=(2, 3 * u)).astype(np.float32)
+        b_in, b_rec = bias[0], bias[1]
+    else:
+        bias = rng.normal(size=(3 * u,)).astype(np.float32)
+        b_in, b_rec = bias, None
+    w2 = rng.normal(size=(u, 2)).astype(np.float32)
+    cfg = {"class_name": "Sequential", "config": {"name": "g", "layers": [
+        {"class_name": "GRU", "config": {
+            "name": "gru", "units": u, "activation": "tanh",
+            "recurrent_activation": "sigmoid", "return_sequences": True,
+            "reset_after": reset_after,
+            "batch_input_shape": [None, t, fdim]}},
+        _dense_cfg("dense", 2, "softmax"),
+    ]}}
+    path = str(tmp_path / "gru.h5")
+    _write_keras_h5(path, cfg, {
+        "gru": {"kernel": kernel, "recurrent_kernel": rec, "bias": bias},
+        "dense": {"kernel": w2, "bias": np.zeros(2, np.float32)},
+    })
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = rng.normal(size=(2, t, fdim)).astype(np.float32)
+    got = np.asarray(net.output(x))
+    hs = _np_gru(x, kernel, rec, b_in, b_rec, reset_after)
+    logits = hs @ w2
+    want = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_import_bidirectional_lstm(tmp_path, rng):
+    u, fdim, t = 3, 2, 5
+    mk = lambda *s: rng.normal(size=s).astype(np.float32)
+    fk, fr, fb = mk(fdim, 4 * u), mk(u, 4 * u), mk(4 * u)
+    bk, br, bb = mk(fdim, 4 * u), mk(u, 4 * u), mk(4 * u)
+    w2 = mk(2 * u, 2)
+    cfg = {"class_name": "Sequential", "config": {"name": "b", "layers": [
+        {"class_name": "Bidirectional", "config": {
+            "name": "bidi", "merge_mode": "concat",
+            "batch_input_shape": [None, t, fdim],
+            "layer": {"class_name": "LSTM", "config": {
+                "name": "lstm", "units": u, "activation": "tanh",
+                "recurrent_activation": "sigmoid",
+                "return_sequences": True}}}},
+        _dense_cfg("dense", 2, "softmax"),
+    ]}}
+    path = str(tmp_path / "bidi.h5")
+    # keras nests forward_lstm/backward_lstm groups under the wrapper
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(cfg)
+        mw = f.create_group("model_weights")
+        g = mw.create_group("bidi").create_group("bidi")
+        gf = g.create_group("forward_lstm")
+        gf.create_dataset("kernel", data=fk)
+        gf.create_dataset("recurrent_kernel", data=fr)
+        gf.create_dataset("bias", data=fb)
+        gb = g.create_group("backward_lstm")
+        gb.create_dataset("kernel", data=bk)
+        gb.create_dataset("recurrent_kernel", data=br)
+        gb.create_dataset("bias", data=bb)
+        gd = mw.create_group("dense").create_group("dense")
+        gd.create_dataset("kernel", data=w2)
+        gd.create_dataset("bias", data=np.zeros(2, np.float32))
+
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = rng.normal(size=(2, t, fdim)).astype(np.float32)
+    got = np.asarray(net.output(x))
+
+    def np_lstm(x, kernel, rec, bias):
+        ki, kf_, kc, ko = np.split(kernel, 4, axis=1)
+        ri, rf_, rc, ro = np.split(rec, 4, axis=1)
+        bi, bf_, bc, bo = np.split(bias, 4)
+        h = np.zeros((x.shape[0], u), np.float32)
+        c = np.zeros((x.shape[0], u), np.float32)
+        outs = []
+        for ti in range(x.shape[1]):
+            xt = x[:, ti]
+            i = _sigmoid(xt @ ki + h @ ri + bi)
+            f_ = _sigmoid(xt @ kf_ + h @ rf_ + bf_)
+            g_ = np.tanh(xt @ kc + h @ rc + bc)
+            o = _sigmoid(xt @ ko + h @ ro + bo)
+            c = f_ * c + i * g_
+            h = o * np.tanh(c)
+            outs.append(h.copy())
+        return np.stack(outs, 1)
+
+    yf = np_lstm(x, fk, fr, fb)
+    yb = np_lstm(x[:, ::-1], bk, br, bb)[:, ::-1]
+    hs = np.concatenate([yf, yb], axis=-1)
+    logits = hs @ w2
+    want = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+    # the flagship follow-up: the imported model fine-tunes
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (2, t))]
+    l0 = net.fit_batch(DataSet(x, y))
+    for _ in range(5):
+        l = net.fit_batch(DataSet(x, y))
+    assert l < l0
+
+
+def test_import_go_backwards_simple_rnn(tmp_path, rng):
+    u, fdim, t = 3, 2, 4
+    k = rng.normal(size=(fdim, u)).astype(np.float32)
+    r = rng.normal(size=(u, u)).astype(np.float32)
+    b = rng.normal(size=(u,)).astype(np.float32)
+    cfg = {"class_name": "Sequential", "config": {"name": "s", "layers": [
+        {"class_name": "SimpleRNN", "config": {
+            "name": "rnn", "units": u, "activation": "tanh",
+            "return_sequences": True, "go_backwards": True,
+            "batch_input_shape": [None, t, fdim]}},
+        _dense_cfg("dense", 2, "softmax"),
+    ]}}
+    path = str(tmp_path / "gb.h5")
+    _write_keras_h5(path, cfg, {
+        "rnn": {"kernel": k, "recurrent_kernel": r, "bias": b},
+        "dense": {"kernel": rng.normal(size=(u, 2)).astype(np.float32),
+                  "bias": np.zeros(2, np.float32)},
+    })
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = rng.normal(size=(2, t, fdim)).astype(np.float32)
+    got = np.asarray(net.output(x))
+    assert net.conf.layers[0].go_backwards is True
+    # keras go_backwards: process reversed input, outputs in processing
+    # order — layer output equals rnn(x[:, ::-1])
+    wd = np.asarray(net.params["1"]["W"])
+    h = np.zeros((2, u), np.float32)
+    outs = []
+    for ti in range(t - 1, -1, -1):
+        h = np.tanh(x[:, ti] @ k + h @ r + b)
+        outs.append(h.copy())
+    hs = np.stack(outs, 1)
+    logits = hs @ wd
+    want = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_import_conv1d_conv3d_repeatvector(tmp_path, rng):
+    # Conv1D over [b, t, f]
+    k1 = rng.normal(size=(3, 2, 4), scale=0.5).astype(np.float32)
+    b1 = rng.normal(size=(4,)).astype(np.float32)
+    cfg = {"class_name": "Sequential", "config": {"name": "c", "layers": [
+        {"class_name": "Conv1D", "config": {
+            "name": "conv1d", "filters": 4, "kernel_size": [3],
+            "strides": [1], "padding": "valid", "activation": "relu",
+            "use_bias": True, "batch_input_shape": [None, 8, 2]}},
+    ]}}
+    path = str(tmp_path / "c1.h5")
+    _write_keras_h5(path, cfg, {"conv1d": {"kernel": k1, "bias": b1}})
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = rng.normal(size=(2, 8, 2)).astype(np.float32)
+    got = np.asarray(net.output(x))
+    want = np.zeros((2, 6, 4), np.float32)
+    for i in range(6):
+        want[:, i] = np.maximum(
+            np.einsum("bwc,wco->bo", x[:, i:i + 3], k1) + b1, 0.0)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    # Conv3D over [b, d, h, w, c]
+    k3 = rng.normal(size=(2, 2, 2, 1, 3), scale=0.5).astype(np.float32)
+    b3 = np.zeros(3, np.float32)
+    cfg3 = {"class_name": "Sequential", "config": {"name": "c3", "layers": [
+        {"class_name": "Conv3D", "config": {
+            "name": "conv3d", "filters": 3, "kernel_size": [2, 2, 2],
+            "strides": [1, 1, 1], "padding": "valid",
+            "activation": "linear", "use_bias": True,
+            "batch_input_shape": [None, 4, 4, 4, 1]}},
+    ]}}
+    p3 = str(tmp_path / "c3.h5")
+    _write_keras_h5(p3, cfg3, {"conv3d": {"kernel": k3, "bias": b3}})
+    net3 = KerasModelImport.import_keras_sequential_model_and_weights(p3)
+    x3 = rng.normal(size=(1, 4, 4, 4, 1)).astype(np.float32)
+    got3 = np.asarray(net3.output(x3))
+    assert got3.shape == (1, 3, 3, 3, 3)
+    want000 = np.einsum("dhwc,dhwco->o", x3[0, :2, :2, :2], k3)
+    np.testing.assert_allclose(got3[0, 0, 0, 0], want000, rtol=1e-4,
+                               atol=1e-5)
+
+    # RepeatVector: [b, f] -> [b, n, f]
+    cfgr = {"class_name": "Sequential", "config": {"name": "r", "layers": [
+        _dense_cfg("dense", 3, "tanh", input_shape=[2]),
+        {"class_name": "RepeatVector", "config": {"name": "rep", "n": 4}},
+    ]}}
+    pr = str(tmp_path / "rep.h5")
+    wd = rng.normal(size=(2, 3)).astype(np.float32)
+    _write_keras_h5(pr, cfgr, {
+        "dense": {"kernel": wd, "bias": np.zeros(3, np.float32)}})
+    netr = KerasModelImport.import_keras_sequential_model_and_weights(pr)
+    xr = rng.normal(size=(2, 2)).astype(np.float32)
+    gotr = np.asarray(netr.output(xr))
+    wantr = np.repeat(np.tanh(xr @ wd)[:, None, :], 4, axis=1)
+    np.testing.assert_allclose(gotr, wantr, rtol=1e-4, atol=1e-5)

@@ -733,3 +733,180 @@ def test_multi_output_addressable_and_import_time_errors(rng):
         g3.SerializeToString()).output({}, "oh")["oh"])
     assert out.dtype == np.int32
     np.testing.assert_array_equal(out, np.eye(3, dtype=np.int32)[[0, 2]])
+
+
+# --------------------------------------------------------------------------
+# round 2: TF2 functional control flow (While/If via FunctionDefLibrary)
+# + training-mode FusedBatchNorm
+# --------------------------------------------------------------------------
+
+def _func(g, name, in_args, out_ret, nodes):
+    """Add a FunctionDef: in_args = [names], out_ret = {out_name: ref},
+    nodes = list of (name, op, inputs, attrs)."""
+    f = g.library.function.add()
+    f.signature.name = name
+    for a in in_args:
+        arg = f.signature.input_arg.add()
+        arg.name = a
+        arg.type = pb.DT_FLOAT
+    for o in out_ret:
+        arg = f.signature.output_arg.add()
+        arg.name = o
+        arg.type = pb.DT_FLOAT
+    for nname, nop, nins, nattrs in nodes:
+        n = f.node_def.add()
+        n.name = nname
+        n.op = nop
+        n.input.extend(nins)
+        for k, v in nattrs.items():
+            if isinstance(v, bool):
+                n.attr[k].b = v
+            elif isinstance(v, int):
+                n.attr[k].i = v
+            elif isinstance(v, float):
+                n.attr[k].f = v
+    for o, ref in out_ret.items():
+        f.ret[o] = ref
+    return f
+
+
+def test_import_while_loop(rng):
+    """x_{t+1} = x_t * a + 1 iterated until i >= 5, as a TF2 StatelessWhile
+    with cond/body FunctionDefs."""
+    g = pb.GraphDef()
+    _placeholder(g, "x", (3,))
+    _const(g, "i0", np.asarray(0.0, np.float32))
+    # cond(i, x): i < 5
+    f = _func(g, "loop_cond", ["i", "x"], {"out": "less:z:0"},
+              [("five", "Const", [], {}),
+               ("less", "Less", ["i", "five"], {})])
+    t = f.node_def[0].attr["value"].tensor
+    t.dtype = pb.DT_FLOAT
+    t.float_val.append(5.0)
+    # body(i, x): (i+1, x*1.5 + 1)
+    f2 = _func(g, "loop_body", ["i", "x"],
+               {"i_out": "inc:z:0", "x_out": "plus1:z:0"},
+               [("one", "Const", [], {}),
+                ("scale", "Const", [], {}),
+                ("inc", "AddV2", ["i", "one"], {}),
+                ("mul", "Mul", ["x", "scale"], {}),
+                ("plus1", "AddV2", ["mul", "one"], {})])
+    f2.node_def[0].attr["value"].tensor.dtype = pb.DT_FLOAT
+    f2.node_def[0].attr["value"].tensor.float_val.append(1.0)
+    f2.node_def[1].attr["value"].tensor.dtype = pb.DT_FLOAT
+    f2.node_def[1].attr["value"].tensor.float_val.append(1.5)
+
+    w = _node(g, "loop", "StatelessWhile", "i0", "x")
+    w.attr["cond"].func.name = "loop_cond"
+    w.attr["body"].func.name = "loop_body"
+
+    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    xv = rng.normal(size=(3,)).astype(np.float32)
+    out = sd.output({"x": xv}, "loop:1")["loop:1"]
+    want = xv.copy()
+    for _ in range(5):
+        want = want * 1.5 + 1.0
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5)
+    # the imported control flow serializes like native control flow
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "while.sdnb")
+        sd.save(p)
+        sd2 = type(sd).load(p)
+        out2 = sd2.output({"x": xv}, "loop:1")["loop:1"]
+        np.testing.assert_allclose(np.asarray(out2), want, rtol=1e-5)
+
+
+def test_import_if(rng):
+    g = pb.GraphDef()
+    _placeholder(g, "x", (4,))
+    _const(g, "thr", np.asarray(0.0, np.float32))
+    _const(g, "sum_axes", np.asarray([0], np.int32))
+    _node(g, "total", "Sum", "x", "sum_axes", keep_dims=False)
+    _node(g, "pred", "Greater", "total", "thr")
+    _func(g, "then_f", ["x"], {"out": "dbl:z:0"},
+          [("dbl", "AddV2", ["x", "x"], {})])
+    _func(g, "else_f", ["x"], {"out": "neg:y:0"},
+          [("neg", "Neg", ["x"], {})])
+    n = _node(g, "branch", "StatelessIf", "pred", "x")
+    n.attr["then_branch"].func.name = "then_f"
+    n.attr["else_branch"].func.name = "else_f"
+
+    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    for xv in (np.asarray([1, 2, 3, 4], np.float32),
+               np.asarray([-1, -2, -3, -4], np.float32)):
+        out = sd.output({"x": xv}, "branch")["branch"]
+        want = xv * 2 if xv.sum() > 0 else -xv
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5)
+
+
+def test_import_training_batchnorm_and_finetune(rng):
+    """FusedBatchNormV3 with is_training=True: batch statistics computed
+    in-graph; the imported graph fine-tunes (gradients flow through the
+    stats)."""
+    gamma = np.abs(rng.normal(size=(2,))).astype(np.float32) + 0.5
+    beta = rng.normal(size=(2,)).astype(np.float32)
+    g = pb.GraphDef()
+    _placeholder(g, "x", (0, 4, 4, 2))
+    _const(g, "gamma", gamma)
+    _const(g, "beta", beta)
+    _const(g, "zero_m", np.zeros(2, np.float32))
+    _const(g, "zero_v", np.ones(2, np.float32))
+    bn = _node(g, "bn", "FusedBatchNormV3", "x", "gamma", "beta",
+               "zero_m", "zero_v", epsilon=1e-3, is_training=True,
+               data_format=b"NHWC")
+
+    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    xv = rng.normal(size=(3, 4, 4, 2)).astype(np.float32) * 2 + 1
+    outs = sd.output({"x": xv}, "bn", "bn:1", "bn:2")
+    mu = xv.mean(axis=(0, 1, 2))
+    var = xv.var(axis=(0, 1, 2))
+    want = gamma * (xv - mu) / np.sqrt(var + 1e-3) + beta
+    np.testing.assert_allclose(np.asarray(outs["bn"]), want,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(outs["bn:1"]), mu, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs["bn:2"]), var, rtol=1e-4,
+                               atol=1e-5)
+    # gradients flow through the batch statistics (fine-tune path)
+    import jax
+    import jax.numpy as jnp
+
+    fn = sd.make_function(("bn",))
+
+    def loss(x):
+        return jnp.sum(fn(dict(sd.arrays), {"x": x})["bn"] ** 2)
+
+    gx = jax.grad(loss)(jnp.asarray(xv))
+    assert np.all(np.isfinite(np.asarray(gx)))
+    assert float(jnp.sum(jnp.abs(gx))) > 0
+
+
+def test_import_inference_batchnorm_multi_output_refs():
+    """is_training absent -> inference form; bn:1/bn:2 pass the supplied
+    running stats through (TF output layout)."""
+    g = pb.GraphDef()
+    _placeholder(g, "x", (0, 2, 2, 1))
+    _const(g, "gamma", np.ones(1, np.float32))
+    _const(g, "beta", np.zeros(1, np.float32))
+    _const(g, "m", np.asarray([0.5], np.float32))
+    _const(g, "v", np.asarray([2.0], np.float32))
+    _node(g, "bn", "FusedBatchNorm", "x", "gamma", "beta", "m", "v",
+          epsilon=1e-3, data_format=b"NHWC")
+    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    xv = np.ones((1, 2, 2, 1), np.float32)
+    outs = sd.output({"x": xv}, "bn", "bn:1")
+    np.testing.assert_allclose(np.asarray(outs["bn"]),
+                               (xv - 0.5) / np.sqrt(2.0 + 1e-3), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs["bn:1"]), [0.5])
+
+
+def test_import_missing_function_raises():
+    g = pb.GraphDef()
+    _placeholder(g, "x", (2,))
+    _const(g, "i0", np.asarray(0.0, np.float32))
+    n = _node(g, "loop", "StatelessWhile", "i0", "x")
+    n.attr["cond"].func.name = "nope"
+    n.attr["body"].func.name = "nada"
+    with pytest.raises(UnsupportedTFOpException, match="function library"):
+        TFGraphMapper.import_graph(g.SerializeToString())
