@@ -22,6 +22,7 @@ from deeplearning4j_tpu.datavec.records import (
 )
 from deeplearning4j_tpu.datavec.schema import Schema, ColumnType
 from deeplearning4j_tpu.datavec.transform import TransformProcess
+from deeplearning4j_tpu.datavec.join import Join, JoinType, execute_join
 from deeplearning4j_tpu.datavec.bridge import (
     RecordReaderDataSetIterator, SequenceRecordReaderDataSetIterator,
 )
@@ -37,6 +38,6 @@ __all__ = [
     "CollectionSequenceRecordReader", "CSVSequenceRecordReader",
     "RegexLineRecordReader", "JsonRecordReader",
     "TransformProcessRecordReader",
-    "Schema", "ColumnType", "TransformProcess",
+    "Schema", "ColumnType", "TransformProcess", "Join", "JoinType", "execute_join",
     "RecordReaderDataSetIterator", "SequenceRecordReaderDataSetIterator",
 ]

@@ -1,0 +1,156 @@
+"""Record joins.
+
+Reference: ``org.datavec.api.transform.join.Join`` (Builder with
+``JoinType {Inner, LeftOuter, RightOuter, FullOuter}``, join columns, and
+left/right schemas) executed by ``LocalTransformExecutor#executeJoin``.
+
+Output record layout matches the reference: the join columns once, then
+the remaining left columns, then the remaining right columns. Rows
+missing on one side (outer joins) fill that side's columns with ``None``
+(the reference's NullWritable); duplicate keys produce the cartesian
+product of the matching groups, like any relational join.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu import serde
+from deeplearning4j_tpu.datavec.schema import Schema
+from deeplearning4j_tpu.datavec.transform import value_of
+
+
+@serde.register_enum
+class JoinType(enum.Enum):
+    """Reference ``Join.JoinType``."""
+
+    INNER = "Inner"
+    LEFT_OUTER = "LeftOuter"
+    RIGHT_OUTER = "RightOuter"
+    FULL_OUTER = "FullOuter"
+
+
+@serde.register
+@dataclasses.dataclass
+class Join:
+    """Reference ``Join`` (built via :class:`JoinBuilder` /
+    ``Join.Builder``)."""
+
+    join_type: JoinType = JoinType.INNER
+    left_schema: Optional[Schema] = None
+    right_schema: Optional[Schema] = None
+    join_columns: Tuple[str, ...] = ()
+    # when the right side names its key columns differently
+    right_join_columns: Optional[Tuple[str, ...]] = None
+
+    class Builder:
+        def __init__(self, join_type: JoinType = JoinType.INNER):
+            self._type = join_type
+            self._left = self._right = None
+            self._cols: Tuple[str, ...] = ()
+            self._rcols: Optional[Tuple[str, ...]] = None
+
+        def set_join_columns(self, *names: str) -> "Join.Builder":
+            self._cols = tuple(names)
+            return self
+
+        def set_join_columns_right(self, *names: str) -> "Join.Builder":
+            self._rcols = tuple(names)
+            return self
+
+        def set_schemas(self, left: Schema, right: Schema) -> "Join.Builder":
+            self._left, self._right = left, right
+            return self
+
+        def build(self) -> "Join":
+            j = Join(join_type=self._type, left_schema=self._left,
+                     right_schema=self._right, join_columns=self._cols,
+                     right_join_columns=self._rcols)
+            j.output_schema()  # validate eagerly, like the reference
+            return j
+
+    def _right_keys(self) -> Tuple[str, ...]:
+        return self.right_join_columns or self.join_columns
+
+    def output_schema(self) -> Schema:
+        """Join columns once (left naming), then left remainder, then
+        right remainder (reference ``Join#getOutputSchema``)."""
+        if self.left_schema is None or self.right_schema is None:
+            raise ValueError("Join needs both schemas (setSchemas)")
+        if not self.join_columns:
+            raise ValueError("Join needs at least one join column")
+        if len(self._right_keys()) != len(self.join_columns):
+            raise ValueError(
+                f"join key arity mismatch: {len(self.join_columns)} left "
+                f"columns vs {len(self._right_keys())} right (keys are "
+                "compared positionally)")
+        for n in self.join_columns:
+            self.left_schema.index_of(n)   # raises on unknown
+        for n in self._right_keys():
+            self.right_schema.index_of(n)
+        cols = [self.left_schema.columns[self.left_schema.index_of(n)]
+                for n in self.join_columns]
+        cols += [c for c in self.left_schema.columns
+                 if c.name not in self.join_columns]
+        right_drop = set(self._right_keys())
+        taken = {c.name for c in cols}
+        for c in self.right_schema.columns:
+            if c.name in right_drop:
+                continue
+            if c.name in taken:
+                raise ValueError(
+                    f"column {c.name!r} exists on both sides; rename one "
+                    "(reference Join requires unique non-key names)")
+            cols.append(c)
+        return Schema(columns=tuple(cols))
+
+    # -- execution ----------------------------------------------------------
+    def _key(self, record: Sequence, schema: Schema,
+             names: Tuple[str, ...]) -> Tuple:
+        return tuple(value_of(record[schema.index_of(n)]) for n in names)
+
+    def execute(self, left_records: Sequence[Sequence],
+                right_records: Sequence[Sequence]) -> List[List]:
+        """Hash join (reference ``LocalTransformExecutor#executeJoin``)."""
+        ls, rs = self.left_schema, self.right_schema
+        lkeys, rkeys = self.join_columns, self._right_keys()
+        l_rest = [i for i, c in enumerate(ls.columns)
+                  if c.name not in lkeys]
+        r_rest = [i for i, c in enumerate(rs.columns)
+                  if c.name not in set(rkeys)]
+
+        groups: dict = {}
+        for rec in right_records:
+            groups.setdefault(self._key(rec, rs, rkeys), []).append(rec)
+
+        out: List[List] = []
+        matched_keys = set()
+        for rec in left_records:
+            k = self._key(rec, ls, lkeys)
+            key_vals = [rec[ls.index_of(n)] for n in lkeys]
+            lvals = [rec[i] for i in l_rest]
+            matches = groups.get(k)
+            if matches:
+                matched_keys.add(k)
+                for r in matches:
+                    out.append(key_vals + lvals + [r[i] for i in r_rest])
+            elif self.join_type in (JoinType.LEFT_OUTER,
+                                    JoinType.FULL_OUTER):
+                out.append(key_vals + lvals + [None] * len(r_rest))
+        if self.join_type in (JoinType.RIGHT_OUTER, JoinType.FULL_OUTER):
+            for k, recs in groups.items():
+                if k in matched_keys:
+                    continue
+                for r in recs:
+                    key_vals = [r[rs.index_of(n)] for n in rkeys]
+                    out.append(key_vals + [None] * len(l_rest)
+                               + [r[i] for i in r_rest])
+        return out
+
+
+def execute_join(join: Join, left_records: Sequence[Sequence],
+                 right_records: Sequence[Sequence]) -> List[List]:
+    """Functional alias mirroring ``LocalTransformExecutor.executeJoin``."""
+    return join.execute(left_records, right_records)

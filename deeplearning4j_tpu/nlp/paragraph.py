@@ -44,6 +44,12 @@ class ParagraphVectors(Word2Vec):
     def __init__(self, **kwargs):
         kwargs.setdefault("min_word_frequency", 1)
         super().__init__(**kwargs)
+        if self.hs:
+            raise ValueError(
+                "ParagraphVectors trains PV-DBOW with negative sampling "
+                "only (its doc-vector phase reuses the SGNS step against "
+                "the [V, D] word-output matrix; the HS inner-node table "
+                "has V-1 rows) — use negative >= 1")
         self.doc_vectors: Optional[np.ndarray] = None
         self.labels: List[str] = []
         self._label_index: Dict[str, int] = {}

@@ -57,7 +57,8 @@ def write_word2vec_model(model, path: str) -> None:
             z.writestr("syn1.npy", _npy_bytes(model.syn1))
         cfg = (f"layer_size={model.layer_size}\n"
                f"window={getattr(model, 'window', 0)}\n"
-               f"negative={getattr(model, 'negative', 0)}\n")
+               f"negative={getattr(model, 'negative', 0)}\n"
+               f"hs={int(bool(getattr(model, 'hs', False)))}\n")
         z.writestr("config.txt", cfg)
 
 
@@ -70,9 +71,13 @@ def read_word2vec_model(path: str):
         cfg = dict(line.split("=", 1)
                    for line in z.read("config.txt").decode().splitlines()
                    if "=" in line)
+        hs = bool(int(cfg.get("hs", "0")))
+        negative = int(cfg.get("negative", 5))
+        if not hs and negative <= 0:  # legacy files wrote 0 for defaults
+            negative = 5
         model = Word2Vec(layer_size=int(cfg.get("layer_size", 100)),
                          window_size=int(cfg.get("window", 5)) or 5,
-                         negative=int(cfg.get("negative", 5)) or 5)
+                         negative=negative, use_hierarchic_softmax=hs)
         cache = VocabCache()
         for line in z.read("vocab.tsv").decode().splitlines():
             word, count = line.rsplit("\t", 1)

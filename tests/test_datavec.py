@@ -307,3 +307,81 @@ def test_spectrogram_peak_and_mfcc_shape():
     noise = np.random.default_rng(0).normal(size=4096).astype(np.float32)
     f_noise = mfcc(noise, rate, n_mfcc=13)
     assert np.abs(feats.mean(0) - f_noise.mean(0)).max() > 1.0
+
+
+# --- round 2: joins (reference org.datavec.api.transform.join.Join) --------
+
+def _join_schemas():
+    from deeplearning4j_tpu.datavec.schema import SchemaBuilder
+
+    left = (SchemaBuilder().add_column_string("id")
+            .add_column_integer("age").build())
+    right = (SchemaBuilder().add_column_string("id")
+             .add_column_string("city").build())
+    return left, right
+
+
+def test_inner_join():
+    from deeplearning4j_tpu.datavec import Join, JoinType
+
+    left, right = _join_schemas()
+    j = (Join.Builder(JoinType.INNER)
+         .set_join_columns("id").set_schemas(left, right).build())
+    out_schema = j.output_schema()
+    assert [c.name for c in out_schema.columns] == ["id", "age", "city"]
+    lrows = [["a", 30], ["b", 25], ["c", 40]]
+    rrows = [["a", "paris"], ["c", "rome"], ["d", "oslo"]]
+    got = j.execute(lrows, rrows)
+    assert sorted(map(tuple, got)) == [("a", 30, "paris"), ("c", 40, "rome")]
+
+
+def test_left_right_full_outer_joins():
+    from deeplearning4j_tpu.datavec import Join, JoinType
+
+    left, right = _join_schemas()
+    lrows = [["a", 30], ["b", 25]]
+    rrows = [["a", "paris"], ["d", "oslo"]]
+
+    def run(t):
+        return sorted(map(tuple, Join.Builder(t).set_join_columns("id")
+                          .set_schemas(left, right).build()
+                          .execute(lrows, rrows)))
+
+    assert run(JoinType.LEFT_OUTER) == [("a", 30, "paris"), ("b", 25, None)]
+    assert run(JoinType.RIGHT_OUTER) == [("a", 30, "paris"),
+                                         ("d", None, "oslo")]
+    assert run(JoinType.FULL_OUTER) == [("a", 30, "paris"), ("b", 25, None),
+                                        ("d", None, "oslo")]
+
+
+def test_join_duplicate_keys_cartesian_and_renamed_right_key():
+    from deeplearning4j_tpu.datavec import Join, JoinType
+    from deeplearning4j_tpu.datavec.schema import SchemaBuilder
+
+    left = (SchemaBuilder().add_column_string("k")
+            .add_column_integer("v").build())
+    right = (SchemaBuilder().add_column_string("rk")
+             .add_column_integer("w").build())
+    j = (Join.Builder(JoinType.INNER).set_join_columns("k")
+         .set_join_columns_right("rk").set_schemas(left, right).build())
+    got = j.execute([["x", 1], ["x", 2]], [["x", 10], ["x", 20]])
+    assert sorted(map(tuple, got)) == [
+        ("x", 1, 10), ("x", 1, 20), ("x", 2, 10), ("x", 2, 20)]
+
+
+def test_join_validates_columns():
+    from deeplearning4j_tpu.datavec import Join, JoinType
+    from deeplearning4j_tpu.datavec.schema import SchemaBuilder
+
+    left, right = _join_schemas()
+    with pytest.raises(KeyError):
+        (Join.Builder(JoinType.INNER).set_join_columns("nope")
+         .set_schemas(left, right).build())
+    # colliding non-key names must be rejected
+    l2 = (SchemaBuilder().add_column_string("id")
+          .add_column_integer("x").build())
+    r2 = (SchemaBuilder().add_column_string("id")
+          .add_column_integer("x").build())
+    with pytest.raises(ValueError, match="both sides"):
+        (Join.Builder(JoinType.INNER).set_join_columns("id")
+         .set_schemas(l2, r2).build())

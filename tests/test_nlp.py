@@ -119,3 +119,67 @@ def test_glove_learns_topics():
 def test_glove_empty_corpus_raises():
     with pytest.raises(ValueError):
         Glove(min_word_frequency=2).fit(["one-word"])
+
+
+# --- round 2: true CBOW + hierarchical softmax -----------------------------
+
+def _topic_check(w2v):
+    """Topic words cluster: in-topic similarity beats cross-topic."""
+    sim_in = w2v.similarity("cat", "dog")
+    sim_cross = w2v.similarity("cat", "car")
+    assert sim_in > sim_cross, (sim_in, sim_cross)
+
+
+def test_word2vec_cbow_learns_topics():
+    w2v = Word2Vec(layer_size=16, window_size=3, min_word_frequency=1,
+                   epochs=8, seed=7, batch_size=256,
+                   elements_learning_algorithm="CBOW")
+    w2v.fit(_corpus(400))
+    _topic_check(w2v)
+    # CBOW context example assembly produced the masked window shape
+    assert w2v.syn1.shape == (len(w2v.vocab), 16)
+
+
+def test_word2vec_hierarchical_softmax_skipgram():
+    w2v = Word2Vec(layer_size=16, window_size=3, min_word_frequency=1,
+                   epochs=8, seed=7, batch_size=256, negative=0)
+    assert w2v.hs  # negative=0 -> reference default HS
+    w2v.fit(_corpus(400))
+    _topic_check(w2v)
+    # HS output table holds the V-1 Huffman inner nodes
+    assert w2v.syn1.shape == (len(w2v.vocab) - 1, 16)
+
+
+def test_word2vec_hierarchical_softmax_cbow():
+    w2v = Word2Vec(layer_size=16, window_size=3, min_word_frequency=1,
+                   epochs=8, seed=3, batch_size=256,
+                   elements_learning_algorithm="CBOW",
+                   use_hierarchic_softmax=True)
+    w2v.fit(_corpus(400))
+    _topic_check(w2v)
+
+
+def test_huffman_codes_properties():
+    from deeplearning4j_tpu.nlp.word2vec import build_huffman
+
+    counts = [100, 50, 20, 10, 5, 2, 1]
+    C, P, M = build_huffman(counts)
+    V = len(counts)
+    lengths = M.sum(1).astype(int)
+    # prefix-free: no code is a prefix of another
+    codes = ["".join(str(int(b)) for b in C[i, :lengths[i]])
+             for i in range(V)]
+    for i in range(V):
+        for j in range(V):
+            if i != j:
+                assert not codes[j].startswith(codes[i])
+    # frequent words get codes no longer than rarer ones
+    assert lengths[0] == min(lengths)
+    assert lengths[-1] == max(lengths)
+    # points index the V-1 inner nodes
+    assert P.max() <= V - 2 and P.min() >= 0
+
+
+def test_word2vec_zero_negative_without_hs_rejected():
+    with pytest.raises(ValueError, match="negative"):
+        Word2Vec(negative=0, use_hierarchic_softmax=False)
