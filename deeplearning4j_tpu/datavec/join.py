@@ -107,15 +107,15 @@ class Join:
         return Schema(columns=tuple(cols))
 
     # -- execution ----------------------------------------------------------
-    def _key(self, record: Sequence, schema: Schema,
-             names: Tuple[str, ...]) -> Tuple:
-        return tuple(value_of(record[schema.index_of(n)]) for n in names)
-
     def execute(self, left_records: Sequence[Sequence],
                 right_records: Sequence[Sequence]) -> List[List]:
         """Hash join (reference ``LocalTransformExecutor#executeJoin``)."""
+        self.output_schema()  # validate even for hand-built/deserialized Joins
         ls, rs = self.left_schema, self.right_schema
         lkeys, rkeys = self.join_columns, self._right_keys()
+        # index lists precomputed once — index_of is a linear column scan
+        l_key_idx = [ls.index_of(n) for n in lkeys]
+        r_key_idx = [rs.index_of(n) for n in rkeys]
         l_rest = [i for i, c in enumerate(ls.columns)
                   if c.name not in lkeys]
         r_rest = [i for i, c in enumerate(rs.columns)
@@ -123,13 +123,14 @@ class Join:
 
         groups: dict = {}
         for rec in right_records:
-            groups.setdefault(self._key(rec, rs, rkeys), []).append(rec)
+            k = tuple(value_of(rec[i]) for i in r_key_idx)
+            groups.setdefault(k, []).append(rec)
 
         out: List[List] = []
         matched_keys = set()
         for rec in left_records:
-            k = self._key(rec, ls, lkeys)
-            key_vals = [rec[ls.index_of(n)] for n in lkeys]
+            k = tuple(value_of(rec[i]) for i in l_key_idx)
+            key_vals = [rec[i] for i in l_key_idx]
             lvals = [rec[i] for i in l_rest]
             matches = groups.get(k)
             if matches:
@@ -144,8 +145,8 @@ class Join:
                 if k in matched_keys:
                     continue
                 for r in recs:
-                    key_vals = [r[rs.index_of(n)] for n in rkeys]
-                    out.append(key_vals + [None] * len(l_rest)
+                    out.append([r[i] for i in r_key_idx]
+                               + [None] * len(l_rest)
                                + [r[i] for i in r_rest])
         return out
 
