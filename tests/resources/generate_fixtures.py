@@ -1,0 +1,393 @@
+"""Generate the committed import-conformance fixtures.
+
+Reference pattern: ``dl4j-test-resources`` — a directory of REAL model
+files + golden input/output pairs, driven by a parameterized conformance
+test (``TFGraphTestAllSameDiff``). This environment is zero-egress and
+has no TensorFlow/Keras, so the fixtures are written here ONCE, with the
+exact on-disk formats those writers produce:
+
+- Keras ``.h5``: HDF5 with ``model_config``/``keras_version``/``backend``
+  root attributes, ``model_weights`` with ``layer_names`` +
+  ``top_level_model_weights`` bookkeeping attrs, per-layer
+  ``weight_names`` attrs, and ``<layer>/<layer>/<weight>:0`` dataset
+  paths — the Keras 2.x ``save_model`` layout, byte-stable across runs
+  (fixed weights, no timestamps).
+- TF ``.pb``: a frozen GraphDef serialized through the wire-compatible
+  vendored protos — protobuf wire bytes are identical to what TF's own
+  writer emits for the same message content (same field numbers, same
+  serialization order).
+
+Golden outputs are computed by INDEPENDENT numpy forward math at
+generation time, never by the importer under test. Run this script only
+to regenerate after a deliberate format change; the test suite consumes
+the committed binaries.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.join(HERE, "conformance")
+sys.path.insert(0, os.path.join(HERE, "..", ".."))
+
+
+def _keras_h5(path, model_cfg, weights, layer_order):
+    import h5py
+
+    with h5py.File(path, "w", track_order=True) as f:
+        f.attrs["model_config"] = json.dumps(model_cfg)
+        f.attrs["keras_version"] = b"2.10.0"
+        f.attrs["backend"] = b"tensorflow"
+        mw = f.create_group("model_weights")
+        mw.attrs["layer_names"] = [n.encode() for n in layer_order]
+        mw.attrs["backend"] = b"tensorflow"
+        mw.attrs["keras_version"] = b"2.10.0"
+        for lname in layer_order:
+            g = mw.create_group(lname)
+            ws = weights.get(lname, {})
+            names = []
+            if ws:
+                inner = g.create_group(lname)
+                for wname, arr in ws.items():
+                    inner.create_dataset(f"{wname}:0", data=arr)
+                    names.append(f"{lname}/{wname}:0".encode())
+            g.attrs["weight_names"] = names
+        tl = f.create_group("top_level_model_weights")
+        tl.attrs["weight_names"] = []
+
+
+def _write(case, files):
+    d = os.path.join(ROOT, case)
+    os.makedirs(d, exist_ok=True)
+    for name, data in files.items():
+        p = os.path.join(d, name)
+        if isinstance(data, np.ndarray):
+            np.save(p, data)
+        elif isinstance(data, (bytes, bytearray)):
+            with open(p, "wb") as f:
+                f.write(data)
+        else:
+            with open(p, "w") as f:
+                json.dump(data, f, indent=1, sort_keys=True)
+
+
+def gen_keras_mlp():
+    rng = np.random.default_rng(1234)
+    w1 = rng.normal(size=(4, 8)).astype(np.float32)
+    b1 = rng.normal(size=(8,)).astype(np.float32)
+    w2 = rng.normal(size=(8, 3)).astype(np.float32)
+    b2 = rng.normal(size=(3,)).astype(np.float32)
+    cfg = {"class_name": "Sequential",
+           "config": {"name": "sequential", "layers": [
+               {"class_name": "InputLayer", "config": {
+                   "batch_input_shape": [None, 4], "dtype": "float32",
+                   "sparse": False, "ragged": False,
+                   "name": "dense_input"}},
+               {"class_name": "Dense", "config": {
+                   "name": "dense", "trainable": True, "dtype": "float32",
+                   "units": 8, "activation": "tanh", "use_bias": True,
+                   "batch_input_shape": [None, 4]}},
+               {"class_name": "Dense", "config": {
+                   "name": "dense_1", "trainable": True,
+                   "dtype": "float32", "units": 3,
+                   "activation": "softmax", "use_bias": True}}]}}
+    x = rng.normal(size=(5, 4)).astype(np.float32)
+    h = np.tanh(x @ w1 + b1)
+    logits = h @ w2 + b2
+    y = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    os.makedirs(os.path.join(ROOT, "keras_mlp"), exist_ok=True)
+    _keras_h5(os.path.join(ROOT, "keras_mlp", "model.h5"), cfg,
+              {"dense": {"kernel": w1, "bias": b1},
+               "dense_1": {"kernel": w2, "bias": b2}},
+              ["dense_input", "dense", "dense_1"])
+    _write("keras_mlp", {
+        "input.npy": x, "expected.npy": y.astype(np.float32),
+        "META.json": {"kind": "keras", "rtol": 1e-4, "atol": 1e-5,
+                      "desc": "Sequential Dense(tanh)+Dense(softmax)"},
+    })
+
+
+def gen_keras_gru():
+    rng = np.random.default_rng(77)
+    u, fdim, t = 4, 3, 6
+    kernel = rng.normal(size=(fdim, 3 * u)).astype(np.float32)
+    rec = rng.normal(size=(u, 3 * u)).astype(np.float32)
+    bias = rng.normal(size=(2, 3 * u)).astype(np.float32)
+    w2 = rng.normal(size=(u, 2)).astype(np.float32)
+    b2 = np.zeros(2, np.float32)
+    cfg = {"class_name": "Sequential",
+           "config": {"name": "sequential", "layers": [
+               {"class_name": "GRU", "config": {
+                   "name": "gru", "trainable": True, "dtype": "float32",
+                   "units": u, "activation": "tanh",
+                   "recurrent_activation": "sigmoid",
+                   "return_sequences": True, "reset_after": True,
+                   "go_backwards": False,
+                   "batch_input_shape": [None, t, fdim]}},
+               {"class_name": "Dense", "config": {
+                   "name": "dense", "trainable": True, "dtype": "float32",
+                   "units": 2, "activation": "softmax",
+                   "use_bias": True}}]}}
+    x = rng.normal(size=(2, t, fdim)).astype(np.float32)
+
+    def sigmoid(z):
+        return 1.0 / (1.0 + np.exp(-z))
+
+    kz, kr, kh = np.split(kernel, 3, axis=1)
+    rz, rr, rh = np.split(rec, 3, axis=1)
+    bz, br, bh = np.split(bias[0], 3)
+    rbz, rbr, rbh = np.split(bias[1], 3)
+    hstate = np.zeros((2, u), np.float32)
+    outs = []
+    for ti in range(t):
+        xt = x[:, ti]
+        z = sigmoid(xt @ kz + bz + hstate @ rz + rbz)
+        r = sigmoid(xt @ kr + br + hstate @ rr + rbr)
+        hh = np.tanh(xt @ kh + bh + r * (hstate @ rh + rbh))
+        hstate = z * hstate + (1 - z) * hh
+        outs.append(hstate.copy())
+    hs = np.stack(outs, 1)
+    logits = hs @ w2 + b2
+    y = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    os.makedirs(os.path.join(ROOT, "keras_gru"), exist_ok=True)
+    _keras_h5(os.path.join(ROOT, "keras_gru", "model.h5"), cfg,
+              {"gru": {"kernel": kernel, "recurrent_kernel": rec,
+                       "bias": bias},
+               "dense": {"kernel": w2, "bias": b2}},
+              ["gru", "dense"])
+    _write("keras_gru", {
+        "input.npy": x, "expected.npy": y.astype(np.float32),
+        "META.json": {"kind": "keras", "rtol": 1e-3, "atol": 1e-4,
+                      "desc": "GRU(reset_after) + Dense(softmax)"},
+    })
+
+
+def gen_keras_bidirectional():
+    import h5py
+
+    rng = np.random.default_rng(31)
+    u, fdim, t = 3, 2, 5
+    mk = lambda *s: rng.normal(size=s).astype(np.float32)  # noqa: E731
+    fk, fr, fb = mk(fdim, 4 * u), mk(u, 4 * u), mk(4 * u)
+    bk, br, bb = mk(fdim, 4 * u), mk(u, 4 * u), mk(4 * u)
+    w2, b2 = mk(2 * u, 2), np.zeros(2, np.float32)
+    cfg = {"class_name": "Sequential",
+           "config": {"name": "sequential", "layers": [
+               {"class_name": "Bidirectional", "config": {
+                   "name": "bidirectional", "trainable": True,
+                   "dtype": "float32", "merge_mode": "concat",
+                   "batch_input_shape": [None, t, fdim],
+                   "layer": {"class_name": "LSTM", "config": {
+                       "name": "lstm", "trainable": True,
+                       "dtype": "float32", "units": u,
+                       "activation": "tanh",
+                       "recurrent_activation": "sigmoid",
+                       "return_sequences": True,
+                       "go_backwards": False}}}},
+               {"class_name": "Dense", "config": {
+                   "name": "dense", "trainable": True,
+                   "dtype": "float32", "units": 2,
+                   "activation": "softmax", "use_bias": True}}]}}
+    d = os.path.join(ROOT, "keras_bidirectional")
+    os.makedirs(d, exist_ok=True)
+    with h5py.File(os.path.join(d, "model.h5"), "w", track_order=True) as f:
+        f.attrs["model_config"] = json.dumps(cfg)
+        f.attrs["keras_version"] = b"2.10.0"
+        f.attrs["backend"] = b"tensorflow"
+        mw = f.create_group("model_weights")
+        mw.attrs["layer_names"] = [b"bidirectional", b"dense"]
+        g = mw.create_group("bidirectional").create_group("bidirectional")
+        names = []
+        for sub, (kk, rr, bbias) in (("forward_lstm", (fk, fr, fb)),
+                                     ("backward_lstm", (bk, br, bb))):
+            gg = g.create_group(sub)
+            cell = gg.create_group("lstm_cell")  # keras 2.10 nests the cell
+            cell.create_dataset("kernel:0", data=kk)
+            cell.create_dataset("recurrent_kernel:0", data=rr)
+            cell.create_dataset("bias:0", data=bbias)
+            names += [f"bidirectional/{sub}/lstm_cell/{w}:0".encode()
+                      for w in ("kernel", "recurrent_kernel", "bias")]
+        mw["bidirectional"].attrs["weight_names"] = names
+        gd = mw.create_group("dense").create_group("dense")
+        gd.create_dataset("kernel:0", data=w2)
+        gd.create_dataset("bias:0", data=b2)
+        mw["dense"].attrs["weight_names"] = [b"dense/kernel:0",
+                                             b"dense/bias:0"]
+
+    def sigmoid(z):
+        return 1.0 / (1.0 + np.exp(-z))
+
+    def np_lstm(x, kernel, rec, bias):
+        ki, kf, kc, ko = np.split(kernel, 4, axis=1)
+        ri, rf, rc, ro = np.split(rec, 4, axis=1)
+        bi, bf, bc, bo = np.split(bias, 4)
+        h = np.zeros((x.shape[0], u), np.float32)
+        c = np.zeros((x.shape[0], u), np.float32)
+        outs = []
+        for ti in range(x.shape[1]):
+            xt = x[:, ti]
+            i = sigmoid(xt @ ki + h @ ri + bi)
+            fgt = sigmoid(xt @ kf + h @ rf + bf)
+            gg = np.tanh(xt @ kc + h @ rc + bc)
+            o = sigmoid(xt @ ko + h @ ro + bo)
+            c = fgt * c + i * gg
+            h = o * np.tanh(c)
+            outs.append(h.copy())
+        return np.stack(outs, 1)
+
+    x = rng.normal(size=(2, t, fdim)).astype(np.float32)
+    hs = np.concatenate([np_lstm(x, fk, fr, fb),
+                         np_lstm(x[:, ::-1], bk, br, bb)[:, ::-1]], -1)
+    logits = hs @ w2 + b2
+    y = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    _write("keras_bidirectional", {
+        "input.npy": x, "expected.npy": y.astype(np.float32),
+        "META.json": {"kind": "keras", "rtol": 1e-3, "atol": 1e-4,
+                      "desc": "Bidirectional(LSTM, concat) with "
+                              "forward_/backward_ lstm_cell nesting"},
+    })
+
+
+def gen_tf_mlp():
+    from deeplearning4j_tpu.imports.protos import tf_graph_pb2 as pb
+
+    rng = np.random.default_rng(55)
+    w1 = rng.normal(size=(4, 6)).astype(np.float32)
+    b1 = rng.normal(size=(6,)).astype(np.float32)
+    w2 = rng.normal(size=(6, 3)).astype(np.float32)
+
+    g = pb.GraphDef()
+    n = g.node.add()
+    n.name, n.op = "input", "Placeholder"
+    n.attr["dtype"].type = pb.DT_FLOAT
+    sh = n.attr["shape"].shape
+    sh.dim.add().size = -1
+    sh.dim.add().size = 4
+
+    def const(name, arr):
+        c = g.node.add()
+        c.name, c.op = name, "Const"
+        c.attr["dtype"].type = pb.DT_FLOAT
+        tns = c.attr["value"].tensor
+        tns.dtype = pb.DT_FLOAT
+        for d in arr.shape:
+            tns.tensor_shape.dim.add().size = d
+        tns.tensor_content = arr.tobytes()
+
+    def node(name, op, *ins, **attrs):
+        m = g.node.add()
+        m.name, m.op = name, op
+        m.input.extend(ins)
+        for k, v in attrs.items():
+            if isinstance(v, bool):
+                m.attr[k].b = v
+        return m
+
+    const("w1", w1)
+    const("b1", b1)
+    const("w2", w2)
+    node("mm1", "MatMul", "input", "w1", transpose_a=False,
+         transpose_b=False)
+    node("h", "BiasAdd", "mm1", "b1")
+    node("relu", "Relu", "h")
+    node("logits", "MatMul", "relu", "w2", transpose_a=False,
+         transpose_b=False)
+    node("probs", "Softmax", "logits")
+
+    x = rng.normal(size=(3, 4)).astype(np.float32)
+    hidden = np.maximum(x @ w1 + b1, 0.0)
+    logits = hidden @ w2
+    y = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    _write("tf_mlp", {
+        "graph.pb": g.SerializeToString(),
+        "input.npy": x, "expected.npy": y.astype(np.float32),
+        "META.json": {"kind": "tf", "input": "input", "output": "probs",
+                      "rtol": 1e-4, "atol": 1e-5,
+                      "desc": "frozen MLP GraphDef"},
+    })
+
+
+def gen_tf_while():
+    from deeplearning4j_tpu.imports.protos import tf_graph_pb2 as pb
+
+    g = pb.GraphDef()
+    n = g.node.add()
+    n.name, n.op = "x", "Placeholder"
+    n.attr["dtype"].type = pb.DT_FLOAT
+    n.attr["shape"].shape.dim.add().size = 4
+    c = g.node.add()
+    c.name, c.op = "i0", "Const"
+    c.attr["dtype"].type = pb.DT_FLOAT
+    c.attr["value"].tensor.dtype = pb.DT_FLOAT
+    c.attr["value"].tensor.float_val.append(0.0)
+
+    fc = g.library.function.add()
+    fc.signature.name = "while_cond"
+    for a in ("i", "x"):
+        arg = fc.signature.input_arg.add()
+        arg.name, arg.type = a, pb.DT_FLOAT
+    oa = fc.signature.output_arg.add()
+    oa.name, oa.type = "ok", pb.DT_FLOAT
+    lim = fc.node_def.add()
+    lim.name, lim.op = "lim", "Const"
+    lim.attr["value"].tensor.dtype = pb.DT_FLOAT
+    lim.attr["value"].tensor.float_val.append(4.0)
+    lt = fc.node_def.add()
+    lt.name, lt.op = "lt", "Less"
+    lt.input.extend(["i", "lim"])
+    fc.ret["ok"] = "lt:z:0"
+
+    fb = g.library.function.add()
+    fb.signature.name = "while_body"
+    for a in ("i", "x"):
+        arg = fb.signature.input_arg.add()
+        arg.name, arg.type = a, pb.DT_FLOAT
+    for o in ("io", "xo"):
+        arg = fb.signature.output_arg.add()
+        arg.name, arg.type = o, pb.DT_FLOAT
+    one = fb.node_def.add()
+    one.name, one.op = "one", "Const"
+    one.attr["value"].tensor.dtype = pb.DT_FLOAT
+    one.attr["value"].tensor.float_val.append(1.0)
+    inc = fb.node_def.add()
+    inc.name, inc.op = "inc", "AddV2"
+    inc.input.extend(["i", "one"])
+    sc = fb.node_def.add()
+    sc.name, sc.op = "scale", "Const"
+    sc.attr["value"].tensor.dtype = pb.DT_FLOAT
+    sc.attr["value"].tensor.float_val.append(1.5)
+    scl = fb.node_def.add()
+    scl.name, scl.op = "half_more", "Mul"
+    scl.input.extend(["x", "scale"])
+    fb.ret["io"] = "inc:z:0"
+    fb.ret["xo"] = "half_more:z:0"
+
+    w = g.node.add()
+    w.name, w.op = "loop", "StatelessWhile"
+    w.input.extend(["i0", "x"])
+    w.attr["cond"].func.name = "while_cond"
+    w.attr["body"].func.name = "while_body"
+
+    x = np.asarray([1.0, -2.0, 0.5, 4.0], np.float32)
+    y = x * (1.5 ** 4)
+    _write("tf_while", {
+        "graph.pb": g.SerializeToString(),
+        "input.npy": x, "expected.npy": y.astype(np.float32),
+        "META.json": {"kind": "tf", "input": "x", "output": "loop:1",
+                      "rtol": 1e-4, "atol": 1e-5,
+                      "desc": "StatelessWhile (x*1.5, 4 iters) via "
+                              "FunctionDefLibrary"},
+    })
+
+
+if __name__ == "__main__":
+    os.makedirs(ROOT, exist_ok=True)
+    gen_keras_mlp()
+    gen_keras_gru()
+    gen_keras_bidirectional()
+    gen_tf_mlp()
+    gen_tf_while()
+    print("fixtures written under", ROOT)

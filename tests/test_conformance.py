@@ -1,0 +1,59 @@
+"""Directory-driven import conformance suite.
+
+Reference pattern: ``TFGraphTestAllSameDiff`` over the
+``dl4j-test-resources`` artifact — a directory of committed model
+binaries + golden input/output pairs; the test is parameterized over
+whatever the directory contains, so adding a fixture adds coverage with
+no new code. Fixtures here are COMMITTED binaries in the writers' exact
+on-disk formats (see ``tests/resources/generate_fixtures.py`` — this
+zero-egress env has no TF/Keras to author them, which is the honest
+limit of format conformance available; goldens are independent numpy
+forward math, never the importer's own output).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+RES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "resources", "conformance")
+CASES = sorted(d for d in (os.listdir(RES) if os.path.isdir(RES) else [])
+               if os.path.isdir(os.path.join(RES, d)))
+
+
+def _load(case):
+    d = os.path.join(RES, case)
+    with open(os.path.join(d, "META.json")) as f:
+        meta = json.load(f)
+    x = np.load(os.path.join(d, "input.npy"))
+    want = np.load(os.path.join(d, "expected.npy"))
+    return d, meta, x, want
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_import_conformance(case):
+    d, meta, x, want = _load(case)
+    if meta["kind"] == "keras":
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+
+        net = KerasModelImport.import_keras_model_and_weights(
+            os.path.join(d, "model.h5"))
+        got = np.asarray(net.output(x))
+    elif meta["kind"] == "tf":
+        from deeplearning4j_tpu.imports.tf import TFGraphMapper
+
+        sd = TFGraphMapper.import_graph(os.path.join(d, "graph.pb"))
+        out = sd.output({meta["input"]: x}, meta["output"])
+        got = np.asarray(out[meta["output"]])
+    else:  # pragma: no cover
+        pytest.fail(f"unknown fixture kind {meta['kind']!r}")
+    np.testing.assert_allclose(got, want, rtol=meta.get("rtol", 1e-4),
+                               atol=meta.get("atol", 1e-5),
+                               err_msg=f"conformance mismatch for {case}")
+
+
+def test_conformance_dir_nonempty():
+    """The suite must never silently pass because the fixtures vanished."""
+    assert len(CASES) >= 4, CASES
