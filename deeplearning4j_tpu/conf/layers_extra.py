@@ -503,6 +503,45 @@ class ElementWiseMultiplicationLayer(BaseLayer):
 
 @serde.register
 @dataclasses.dataclass
+class Permute(Layer):
+    """Permute the non-batch axes (Keras ``Permute``; 1-indexed dims over
+    the non-batch axes, Keras convention). Recurrent input [b, t, f] with
+    dims (2, 1) becomes [b, f, t]; Convolutional input permutes any of
+    (h, w, c). The reference's Keras importer lowers this onto a permute
+    preprocessor; here it is a plain stateless layer."""
+
+    dims: Tuple[int, ...] = ()
+
+    def _perm(self, rank: int) -> Tuple[int, ...]:
+        if sorted(self.dims) != list(range(1, rank)):
+            raise ValueError(
+                f"Permute dims {self.dims} must be a permutation of "
+                f"1..{rank - 1} (1-indexed non-batch axes)")
+        return (0,) + tuple(self.dims)
+
+    def output_type(self, input_type):
+        if isinstance(input_type, it.Recurrent):
+            sizes = [input_type.timesteps, input_type.size]
+            self._perm(3)
+            out = [sizes[d - 1] for d in self.dims]
+            return it.Recurrent(size=out[1], timesteps=out[0])
+        if isinstance(input_type, it.Convolutional):
+            sizes = [input_type.height, input_type.width,
+                     input_type.channels]
+            self._perm(4)
+            out = [sizes[d - 1] for d in self.dims]
+            return it.Convolutional(height=out[0], width=out[1],
+                                    channels=out[2])
+        raise ValueError(
+            f"Permute supports Recurrent/Convolutional input, got "
+            f"{input_type}")
+
+    def forward(self, params, state, x, train=False, rng=None):
+        return jnp.transpose(x, self._perm(x.ndim)), state
+
+
+@serde.register
+@dataclasses.dataclass
 class RepeatVector(Layer):
     """Reference ``RepeatVector``: [batch, size] -> [batch, n, size]."""
 

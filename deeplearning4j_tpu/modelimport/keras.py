@@ -50,6 +50,7 @@ from deeplearning4j_tpu.conf.layers_cnn import Convolution1DLayer
 from deeplearning4j_tpu.conf.layers_extra import (
     Convolution3D,
     DepthwiseConvolution2D,
+    Permute,
     RepeatVector,
 )
 from deeplearning4j_tpu.conf.layers_rnn import SimpleRnn
@@ -347,6 +348,8 @@ def _map_layer(cls: str, cfg: dict, name: str, is_output: bool = False):
             has_bias=bool(cfg.get("use_bias", True)), name=name)
     if cls == "RepeatVector":
         return RepeatVector(repetition_factor=int(cfg["n"]), name=name)
+    if cls == "Permute":
+        return Permute(dims=tuple(int(d) for d in cfg["dims"]), name=name)
     raise InvalidKerasConfigurationException(
         f"unsupported Keras layer class '{cls}'")
 

@@ -62,7 +62,6 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         self._score_dev = None
         self._score_cache: Optional[float] = float("nan")
         self._train_step = None
-        self._tbptt_step = None
         self._tbptt_scan = None
         self._output_fn = None
         self._score_fn = None
@@ -273,19 +272,6 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
 
         return jax.jit(step, donate_argnums=(0, 1, 2, 7))
 
-    def _build_tbptt_step(self):
-        raw = self.train_step_fn()
-
-        def step(params, state, opt_state, features, labels, fmask, lmask,
-                 itc, ep, base_key, carries):
-            it, rng = nn_io.step_scalars(itc, base_key)
-            new_p, new_s, new_o, loss, new_c = raw(
-                params, state, opt_state, features, labels, fmask, lmask,
-                it, ep, rng, carries)
-            return new_p, new_s, new_o, loss, new_c, itc + 1
-
-        return jax.jit(step, donate_argnums=(0, 1, 2, 7, 10))
-
     def _build_output_fn(self):
         def out(params, state, x, fmask):
             params, x, fmask = self._fwd_cast(params, self._dequant(x),
@@ -433,25 +419,34 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         # consumed — replacing labels/masks invalidates the cache.
         # (In-place writes into the same numpy buffer are not detectable;
         # replace the array to retrain on new data.)
-        key = (f, ds.labels, ds.features_mask, ds.labels_mask, seg)
+        key = (f, ds.labels, ds.features_mask, ds.labels_mask, seg,
+               int(self.conf.tbptt_back_length or seg))
         cached = getattr(ds, "_tbptt_padded", None)
         if cached is not None and len(cached[0]) == len(key) and all(
                 a is b for a, b in zip(cached[0], key)):
             return cached[1]
         n = f.shape[0]
+        back = min(int(self.conf.tbptt_back_length or seg), seg)
+        # back < fwd: insert the padding BEFORE the tail segment's real
+        # steps (left-align them) so they land inside the gradient window,
+        # not the no-grad state-advance head — masked steps pass RNN state
+        # through unchanged, so this is exactly the reference's
+        # shorter-tail-slice semantics. back == fwd keeps the plain right
+        # pad (window covers the whole segment either way).
+        split = t - (t % seg) if back < seg else t
 
         def pad_t(a, fill=0.0):
-            width = [(0, 0), (0, pad)] + [(0, 0)] * (np.ndim(a) - 2)
-            return np.pad(np.asarray(a), width,
-                          constant_values=fill).astype(np.asarray(a).dtype)
+            a = np.asarray(a)
+            z = np.full((n, pad) + a.shape[2:], fill, a.dtype)
+            return np.concatenate([a[:, :split], z, a[:, split:]], axis=1)
 
-        fmask = (pad_t(ds.features_mask) if ds.features_mask is not None
-                 else np.pad(np.ones((n, t), self._dtype), [(0, 0), (0, pad)]))
+        fmask = pad_t(ds.features_mask if ds.features_mask is not None
+                      else np.ones((n, t), self._dtype))
         lm = ds.labels_mask
         if lm is not None and np.ndim(lm) == 1:   # per-example -> per-step
             lm = np.asarray(lm)[:, None] * np.ones((n, t), self._dtype)
-        lmask = (pad_t(lm) if lm is not None
-                 else np.pad(np.ones((n, t), self._dtype), [(0, 0), (0, pad)]))
+        lmask = pad_t(lm if lm is not None
+                      else np.ones((n, t), self._dtype))
         labels = (pad_t(ds.labels) if np.ndim(ds.labels) == 3
                   else ds.labels)
         padded = DataSet(pad_t(f), labels, features_mask=fmask,
@@ -462,25 +457,46 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
             pass  # exotic immutable containers just re-pad
         return padded
 
-    def tbptt_scan_fn(self, seg: int):
+    def tbptt_scan_fn(self, seg: int, back: Optional[int] = None):
         """The raw (unjitted) whole-batch tBPTT runner: segments the time
         axis INSIDE the trace and scans the per-segment train step with
         detached carries — ``(params, state, opt, features, labels, fmask,
         lmask, itc, ep, base_key) -> (params, state, opt, new_itc,
         mean_loss)``. Exposed (like ``train_step_fn``) so ParallelWrapper
         can jit it over a mesh with the batch axis sharded — the same
-        compiled segment chain, SPMD-partitioned."""
+        compiled segment chain, SPMD-partitioned.
+
+        ``back < seg`` (reference ``tbptt_back_length < fwd_length``): the
+        first ``seg - back`` steps of each segment only advance the RNN
+        state in inference mode — no gradient flows through them (they run
+        outside the train step's loss closure) — and the parameter update
+        trains on the trailing ``back`` window. Still ONE compiled scan."""
         raw = self.train_step_fn()
         cdt = self._cdtype or self._dtype
+        back = seg if back is None else min(int(back), seg)
+        cut = seg - back
+        last = len(self.conf.layers) - 1
 
         def segments(arr):
             # [B, T, ...] -> [n_seg, B, seg, ...], tail zero-padded —
             # INSIDE the jit: shapes are static under trace, so the
             # segmentation costs zero extra dispatches. n_seg derives
             # from the traced shape (NOT closed over: a different T
-            # retraces with its own count)
-            ns = -(-arr.shape[1] // seg)
-            arr = _pad_time(jnp.asarray(arr), ns * seg)
+            # retraces with its own count). back < fwd: the tail pad goes
+            # BEFORE its real steps so they stay inside the gradient
+            # window (mirrors _tbptt_prepad for device-resident batches).
+            arr = jnp.asarray(arr)
+            t = arr.shape[1]
+            ns = -(-t // seg)
+            pad = ns * seg - t
+            if pad and cut:
+                z = jnp.zeros(arr.shape[:1] + (pad,) + arr.shape[2:],
+                              arr.dtype)
+                arr = jnp.concatenate(
+                    [arr[:, :t - (t % seg)], z, arr[:, t - (t % seg):]],
+                    axis=1)
+            else:
+                arr = _pad_time(arr, ns * seg)
             shaped = arr.reshape(arr.shape[0], ns, seg,
                                  *arr.shape[2:])
             return jnp.moveaxis(shaped, 1, 0)
@@ -503,6 +519,19 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
             def body(carry, xs):
                 params, state, opt, carries, itc = carry
                 f_s, l_s, fm_s, lm_s = xs
+                if cut:
+                    # state-advance over the head of the segment: the
+                    # params used here are the scan carry (constants with
+                    # respect to the train step's loss argument), so no
+                    # gradient reaches these timesteps — reference
+                    # truncates the backward pass at back_length
+                    fwd_p, f_c, fm_c = self._fwd_cast(
+                        params, self._dequant(f_s[:, :cut]), fm_s[:, :cut])
+                    _, _, carries = self._forward(
+                        fwd_p, state, f_c, train=False, rng=None,
+                        fmask=fm_c, upto=last, carries=carries)
+                    f_s, l_s, fm_s, lm_s = (a[:, cut:] for a in
+                                            (f_s, l_s, fm_s, lm_s))
                 it, rng = nn_io.step_scalars(itc, base_key)
                 params, state, opt, loss, carries = raw(
                     params, state, opt, f_s, l_s, fm_s, lm_s, it, ep,
@@ -547,17 +576,17 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
             lmask = lmask[:, None] * ones_t
         return features, labels, fmask, lmask
 
-    def _fit_tbptt_scan(self, features, labels, fmask, lmask, seg):
+    def _fit_tbptt_scan(self, features, labels, fmask, lmask, seg, back):
         n_seg = -(-int(features.shape[1]) // seg)
-        # cache keyed by seg: a conf.tbptt_fwd_length change between fits
-        # must not silently reuse a closure compiled for the old length
+        # cache keyed by (seg, back): a conf.tbptt_*_length change between
+        # fits must not silently reuse a closure compiled for old lengths
         if self._tbptt_scan is None:
             self._tbptt_scan = {}
-        if seg not in self._tbptt_scan:
-            self._tbptt_scan[seg] = jax.jit(self.tbptt_scan_fn(seg),
-                                            donate_argnums=(0, 1, 2))
+        if (seg, back) not in self._tbptt_scan:
+            self._tbptt_scan[seg, back] = jax.jit(
+                self.tbptt_scan_fn(seg, back), donate_argnums=(0, 1, 2))
         (self.params, self.state, self.opt_state, new_itc,
-         mean_loss) = self._tbptt_scan[seg](
+         mean_loss) = self._tbptt_scan[seg, back](
             self.params, self.state, self.opt_state, features, labels,
             fmask, lmask, self.device_iteration(), self.device_epoch(),
             self._base_key)
@@ -576,62 +605,18 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
     def _fit_tbptt(self, features, labels, fmask, lmask) -> float:
         """Truncated BPTT: slice the time axis into segments of
         ``tbptt_fwd_length``, one parameter update per segment, RNN state
-        carried (detached) between segments. The tail segment is zero-padded
-        with a 0 mask so every segment has the same (compiled-once) shape.
-        Inputs are pre-normalized by ``tbptt_batch_arrays`` (the single
+        carried (detached) between segments; when ``tbptt_back_length <
+        fwd_length`` the head of each segment advances state without
+        gradients. The WHOLE chain is one compiled ``lax.scan`` either way
+        (round 2: the back<fwd Python segment loop became part of the scan
+        body). The tail segment is zero-padded with a 0 mask so every
+        segment has the same (compiled-once) shape. Inputs are
+        pre-normalized by ``tbptt_batch_arrays`` (the single
         validation/defaulting path, shared with ParallelWrapper)."""
         seg = int(self.conf.tbptt_fwd_length)
         back = int(self.conf.tbptt_back_length or seg)
-        back = min(back, seg)
-        n, total_t = features.shape[0], features.shape[1]
-        if back == seg:
-            # common case: the WHOLE segment chain is one compiled
-            # lax.scan — no Python loop, one dispatch, one sync (zero
-            # carries are built inside the jit)
-            return self._fit_tbptt_scan(features, labels, fmask, lmask, seg)
-        carries = {str(i): layer.zero_carry(n, self._cdtype or self._dtype)
-                   for i, layer in enumerate(self.conf.layers)
-                   if getattr(layer, "has_carry", False)}
-        if self._rnn_step_fn is None:
-            self._rnn_step_fn = self._build_rnn_step_fn()
-        if self._tbptt_step is None:
-            self._tbptt_step = self._build_tbptt_step()
-        losses = []
-        for start in range(0, total_t, seg):
-            f_seg = _pad_time(features[:, start:start + seg], seg)
-            l_seg = _pad_time(labels[:, start:start + seg], seg)
-            fm_seg = _pad_time(fmask[:, start:start + seg], seg)
-            lm_seg = _pad_time(lmask[:, start:start + seg], seg)
-            if back < seg:
-                # tbptt_back_length < fwd: the first seg-back steps only
-                # advance RNN state (no gradient flows through them —
-                # reference truncates the backward pass at backLength)
-                cut = seg - back
-                _, carries = self._rnn_step_fn(
-                    self.params, self.state, carries,
-                    f_seg[:, :cut], fm_seg[:, :cut])
-                f_seg = _pad_time(f_seg[:, cut:], seg)
-                l_seg = _pad_time(l_seg[:, cut:], seg)
-                fm_seg = _pad_time(fm_seg[:, cut:], seg)
-                lm_seg = _pad_time(lm_seg[:, cut:], seg)
-            (self.params, self.state, self.opt_state, loss, carries,
-             new_itc) = self._tbptt_step(
-                self.params, self.state, self.opt_state, f_seg, l_seg,
-                fm_seg, lm_seg, self.device_iteration(), self.device_epoch(),
-                self._base_key, carries)
-            losses.append(loss)  # device scalars; one sync below
-            self.iteration += 1
-            self.advance_device_iteration(new_itc)
-        self.last_batch_size = int(n)
-        # one device-side reduce + one sync for the whole segment chain
-        self.score_value = float(jnp.mean(jnp.stack(losses)))
-        for lst in self.listeners:
-            # arg = just-finished iteration index, matching the standard
-            # path (tBPTT counts one iteration per segment; the batch-level
-            # listener sees the LAST segment's index)
-            lst.iteration_done(self, self.iteration - 1, self.epoch,
-                               self.score_value)
-        return self.score_value
+        return self._fit_tbptt_scan(features, labels, fmask, lmask, seg,
+                                    min(back, seg))
 
     # --- stateful RNN inference (reference rnnTimeStep API) -----------------
     def rnn_time_step(self, x, fmask=None):

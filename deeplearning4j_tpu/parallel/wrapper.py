@@ -118,12 +118,6 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         if self._tbptt:
             seg = int(model.conf.tbptt_fwd_length)
             back = int(model.conf.tbptt_back_length or seg)
-            if back < seg:
-                raise NotImplementedError(
-                    "ParallelWrapper supports tBPTT only with "
-                    "tbptt_back_length == tbptt_fwd_length (the compiled "
-                    "scan path); the back < fwd segment loop is single-"
-                    "device only")
             if threshold_algorithm is not None:
                 raise NotImplementedError(
                     "threshold-compressed gradients are not implemented "
@@ -131,6 +125,7 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                     "AVERAGING (compression is a DCN feature — reference "
                     "RNN training under ParallelWrapper uses plain modes)")
             self._tbptt_seg = seg
+            self._tbptt_back = min(back, seg)
         procs = jax.process_count()
         if self.workers % procs != 0 or self.workers < procs:
             raise ValueError(
@@ -214,7 +209,8 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                     # partitioned: batch axis sharded, params replicated;
                     # the per-segment gradient all-reduce is XLA-inserted
                     # exactly as in the standard step
-                    self._step = jax.jit(m.tbptt_scan_fn(self._tbptt_seg),
+                    self._step = jax.jit(m.tbptt_scan_fn(self._tbptt_seg,
+                                                         self._tbptt_back),
                                          donate_argnums=(0, 1, 2))
                 else:
                     raw = m.train_step_fn()
@@ -271,7 +267,8 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
 
     def _build_averaging_step(self):
         if self._tbptt:
-            run = self.model.tbptt_scan_fn(self._tbptt_seg)
+            run = self.model.tbptt_scan_fn(self._tbptt_seg,
+                                           self._tbptt_back)
         else:
             raw = self.model.train_step_fn()
 

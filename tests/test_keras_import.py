@@ -805,3 +805,25 @@ def test_import_conv1d_conv3d_repeatvector(tmp_path, rng):
     gotr = np.asarray(netr.output(xr))
     wantr = np.repeat(np.tanh(xr @ wd)[:, None, :], 4, axis=1)
     np.testing.assert_allclose(gotr, wantr, rtol=1e-4, atol=1e-5)
+
+
+def test_import_permute(tmp_path, rng):
+    cfg = {"class_name": "Sequential", "config": {"name": "p", "layers": [
+        {"class_name": "Permute", "config": {
+            "name": "perm", "dims": [2, 1],
+            "batch_input_shape": [None, 4, 3]}},
+    ]}}
+    path = str(tmp_path / "perm.h5")
+    _write_keras_h5(path, cfg, {})
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = rng.normal(size=(2, 4, 3)).astype(np.float32)
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, x.transpose(0, 2, 1), rtol=1e-6)
+
+
+def test_permute_validates_dims():
+    from deeplearning4j_tpu.conf.layers_extra import Permute
+    from deeplearning4j_tpu.conf.inputs import InputType
+
+    with pytest.raises(ValueError, match="permutation"):
+        Permute(dims=(1, 3)).output_type(InputType.recurrent(3, timesteps=4))

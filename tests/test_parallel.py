@@ -461,22 +461,35 @@ def test_tbptt_threshold_mode_rejected():
         ParallelWrapper(par, threshold_algorithm=ThresholdAlgorithm(1e-3))
 
 
-def test_tbptt_back_lt_fwd_rejected():
+def test_tbptt_back_lt_fwd_exact_matches_single_device():
+    """back < fwd (state-advance head + short backprop window) under the
+    wrapper == the single-device compiled path on the same batch."""
     from deeplearning4j_tpu.conf.layers_rnn import LSTM, RnnOutputLayer
     from deeplearning4j_tpu.conf.multilayer import BackpropType
 
-    conf = (NeuralNetConfiguration.builder()
-            .updater(Adam(learning_rate=0.02))
-            .list()
-            .layer(LSTM(n_out=8))
-            .layer(RnnOutputLayer(n_out=3, activation=Activation.SOFTMAX,
-                                  loss_fn=LossMCXENT()))
-            .backprop_type(BackpropType.TRUNCATED_BPTT, fwd=5, back=3)
-            .set_input_type(InputType.recurrent(4, 20))
-            .build())
-    par = MultiLayerNetwork(conf).init()
-    with pytest.raises(NotImplementedError, match="back"):
-        ParallelWrapper(par)
+    def conf():
+        return (NeuralNetConfiguration.builder()
+                .seed(5).updater(Adam(learning_rate=0.02))
+                .weight_init(WeightInit.XAVIER)
+                .list()
+                .layer(LSTM(n_out=8))
+                .layer(RnnOutputLayer(n_out=3, activation=Activation.SOFTMAX,
+                                      loss_fn=LossMCXENT()))
+                .backprop_type(BackpropType.TRUNCATED_BPTT, fwd=5, back=3)
+                .set_input_type(InputType.recurrent(4, 20))
+                .build())
+
+    x, y = _rnn_data(16, seed=9)
+    serial = MultiLayerNetwork(conf()).init()
+    par = MultiLayerNetwork(conf()).init()
+    serial.fit_batch(DataSet(x, y))
+    ParallelWrapper(par).fit(ArrayDataSetIterator(x, y, batch=16), epochs=1)
+    for k in serial.params:
+        for pk in serial.params[k]:
+            np.testing.assert_allclose(
+                np.asarray(serial.params[k][pk]),
+                np.asarray(par.params[k][pk]), atol=3e-5,
+                err_msg=f"layer {k} param {pk}")
 
 
 def test_weak_scaling_no_serialization():

@@ -506,3 +506,41 @@ def test_tbptt_prepad_cache_invalidates_on_label_change(rng):
     assert ds._tbptt_padded[1] is not first
     np.testing.assert_allclose(
         np.asarray(ds._tbptt_padded[1].labels[:, :7]), y2)
+
+
+def test_tbptt_back_lt_fwd_tail_segment_trains(rng):
+    """fwd=5, back=3, T=11: the tail segment's single real step must land
+    in the GRADIENT window, not the no-grad state-advance head (round-2
+    fix: tail padding is inserted before the real steps). Oracle: two
+    identical nets fit on data differing ONLY in the t=10 labels must end
+    with different params."""
+    from deeplearning4j_tpu.conf.layers_rnn import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.conf.multilayer import (
+        BackpropType, NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.conf.losses import LossMCXENT
+    from deeplearning4j_tpu.conf.updaters import Sgd
+    from deeplearning4j_tpu.conf import Activation, InputType, WeightInit
+
+    def conf():
+        return (NeuralNetConfiguration.builder()
+                .seed(3).updater(Sgd(learning_rate=0.1))
+                .weight_init(WeightInit.XAVIER).list()
+                .layer(LSTM(n_out=8))
+                .layer(RnnOutputLayer(n_out=3, activation=Activation.SOFTMAX,
+                                      loss_fn=LossMCXENT()))
+                .backprop_type(BackpropType.TRUNCATED_BPTT, fwd=5, back=3)
+                .set_input_type(InputType.recurrent(2, 11)).build())
+
+    x = rng.normal(size=(4, 11, 2)).astype(np.float32)
+    y1 = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (4, 11))]
+    y2 = y1.copy()
+    y2[:, 10] = np.roll(y1[:, 10], 1, axis=-1)  # only t=10 differs
+    a = MultiLayerNetwork(conf()).init()
+    b = MultiLayerNetwork(conf()).init()
+    la = a.fit_batch(DataSet(x, y1))
+    lb = b.fit_batch(DataSet(x, y2))
+    diff = np.max(np.abs(a.params_flat() - b.params_flat()))
+    assert diff > 0, "tail-segment labels had no gradient effect"
+    # and the mean loss is not diluted by a hard-zero tail segment
+    assert la > 0 and lb > 0
