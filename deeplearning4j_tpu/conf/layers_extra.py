@@ -524,6 +524,11 @@ class Permute(Layer):
             sizes = [input_type.timesteps, input_type.size]
             self._perm(3)
             out = [sizes[d - 1] for d in self.dims]
+            if out[1] is not None and out[1] < 0:
+                raise ValueError(
+                    f"Permute {self.dims}: the variable-length time axis "
+                    "(timesteps=-1) cannot become the feature axis — "
+                    "downstream layers need a static feature size")
             return it.Recurrent(size=out[1], timesteps=out[0])
         if isinstance(input_type, it.Convolutional):
             sizes = [input_type.height, input_type.width,

@@ -520,22 +520,24 @@ class _Mapper:
         elif op in ("If", "StatelessIf"):
             then_f = self._func(node.attr["then_branch"].func.name, node)
             else_f = self._func(node.attr["else_branch"].func.name, node)
-            if len(then_f.signature.output_arg) != 1:
-                raise UnsupportedTFOpException(
-                    f"{node.name}: If with {len(then_f.signature.output_arg)}"
-                    " outputs unsupported (single-output branches only)")
+            n_out = len(then_f.signature.output_arg)
             pred = self._var(ins[0])
             operands = [self._var(i) for i in ins[1:]]
 
             def then_fn(*args):
-                return _FuncMapper(self, then_f, args).run_body()[0]
+                outs = _FuncMapper(self, then_f, args).run_body()
+                return outs[0] if n_out == 1 else outs
 
             def else_fn(*args):
-                return _FuncMapper(self, else_f, args).run_body()[0]
+                outs = _FuncMapper(self, else_f, args).run_body()
+                return outs[0] if n_out == 1 else outs
 
             v = sd.cond(pred, then_fn, else_fn, operands,
-                        name=node.name + "_if")
-            self._bind(node, v)
+                        name=node.name + "_if", n_out=n_out)
+            if n_out == 1:
+                self._bind(node, v)
+            else:
+                self._bind_multi(node, list(v))
         else:
             raise UnsupportedTFOpException(
                 f"unmapped TF op {op!r} at node {node.name!r} "

@@ -736,12 +736,15 @@ class SameDiff:
                 serializable = False
         return child, [o.name for o in outs], serializable
 
-    def cond(self, pred, true_fn, false_fn, operands, name=None):
+    def cond(self, pred, true_fn, false_fn, operands, name=None,
+             n_out: int = 1):
         """Structured conditional — replaces the reference's Switch/Merge
         frame machinery with ``lax.cond`` (compiler-friendly; both branches
-        traced once). ``true_fn``/``false_fn`` map arrays -> array. When
-        the callables stay inside SDVariable ops the graph remains
-        serializable (save/load round-trips the branches).
+        traced once). ``true_fn``/``false_fn`` map arrays -> array (or a
+        tuple of ``n_out`` arrays — both branches must agree). Returns one
+        SDVariable, or a tuple of ``n_out`` of them. When the callables
+        stay inside SDVariable ops the graph remains serializable
+        (save/load round-trips the branches).
 
         BUILD-TIME PROBE CONTRACT (also for while_loop/scan): each body is
         CALLED once on symbolic placeholders at graph build to decide
@@ -751,20 +754,26 @@ class SameDiff:
         from deeplearning4j_tpu.samediff import serde as _serde
 
         n = len(operands)
+        single = n_out == 1
         traced_t = self._try_trace(true_fn, n)
         traced_f = self._try_trace(false_fn, n)
         fn_attrs = {"true_fn": true_fn, "false_fn": false_fn}
         subgraphs = {}
         if traced_t is not None and traced_f is not None:
             (ct, ot, st), (cf, of, sf) = traced_t, traced_f
-            fn_attrs = {"true_fn": subgraph_callable(ct, ot, single=True),
-                        "false_fn": subgraph_callable(cf, of, single=True)}
+            if len(ot) != n_out or len(of) != n_out:
+                raise ValueError(
+                    f"cond branches returned {len(ot)}/{len(of)} outputs, "
+                    f"expected n_out={n_out}")
+            fn_attrs = {"true_fn": subgraph_callable(ct, ot, single=single),
+                        "false_fn": subgraph_callable(cf, of, single=single)}
             if st and sf:
                 subgraphs = {
-                    "true_fn": _serde.subgraph_dict(ct, ot, single=True),
-                    "false_fn": _serde.subgraph_dict(cf, of, single=True)}
-        return self._op("cond", [pred] + list(operands), name=name,
-                        fn_attrs=fn_attrs, subgraphs=subgraphs)[0]
+                    "true_fn": _serde.subgraph_dict(ct, ot, single=single),
+                    "false_fn": _serde.subgraph_dict(cf, of, single=single)}
+        outs = self._op("cond", [pred] + list(operands), n_out=n_out,
+                        name=name, fn_attrs=fn_attrs, subgraphs=subgraphs)
+        return outs[0] if single else tuple(outs)
 
     def while_loop(self, cond_fn, body_fn, operands, name=None):
         """Structured while — replaces Enter/Exit/NextIteration frames with

@@ -426,3 +426,24 @@ def test_serde_nested_control_flow(tmp_path):
     assert float(o3.eval()) == pytest.approx(np.sin(2.0))
     with pytest.raises(ValueError, match="not\\s+serializable"):
         sd3.save(str(tmp_path / "bad.sdz"))
+
+
+def test_cond_multi_output_exec_and_serde(tmp_path):
+    sd = SameDiff()
+    x = sd.placeholder("x", (3,))
+    p = sd.math.gt(sd.math.sum(x), sd.constant(np.float64(0.0)))
+    a, b = sd.cond(p,
+                   lambda v: (v * 2.0, -v),
+                   lambda v: (-v, v * 2.0),
+                   [x], n_out=2)
+    a.rename("a"); b.rename("b")
+    xv = np.asarray([1.0, 2.0, 3.0])
+    out = sd.output({"x": xv}, "a", "b")
+    np.testing.assert_allclose(np.asarray(out["a"]), xv * 2)
+    np.testing.assert_allclose(np.asarray(out["b"]), -xv)
+    path = str(tmp_path / "mcond.sdnb")
+    sd.save(path)
+    sd2 = SameDiff.load(path)
+    out2 = sd2.output({"x": -xv}, "a", "b")
+    np.testing.assert_allclose(np.asarray(out2["a"]), xv)
+    np.testing.assert_allclose(np.asarray(out2["b"]), -xv * 2)

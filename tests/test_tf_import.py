@@ -910,3 +910,31 @@ def test_import_missing_function_raises():
     n.attr["body"].func.name = "nada"
     with pytest.raises(UnsupportedTFOpException, match="function library"):
         TFGraphMapper.import_graph(g.SerializeToString())
+
+
+def test_import_if_multi_output(rng):
+    """If branches returning TWO tensors (round 2: multi-output sd.cond)."""
+    g = pb.GraphDef()
+    _placeholder(g, "x", (4,))
+    _const(g, "thr", np.asarray(0.0, np.float32))
+    _const(g, "sum_axes", np.asarray([0], np.int32))
+    _node(g, "total", "Sum", "x", "sum_axes", keep_dims=False)
+    _node(g, "pred", "Greater", "total", "thr")
+    _func(g, "then2", ["x"], {"a": "dbl:z:0", "b": "neg:y:0"},
+          [("dbl", "AddV2", ["x", "x"], {}),
+           ("neg", "Neg", ["x"], {})])
+    _func(g, "else2", ["x"], {"a": "neg:y:0", "b": "dbl:z:0"},
+          [("dbl", "AddV2", ["x", "x"], {}),
+           ("neg", "Neg", ["x"], {})])
+    n = _node(g, "branch", "StatelessIf", "pred", "x")
+    n.attr["then_branch"].func.name = "then2"
+    n.attr["else_branch"].func.name = "else2"
+
+    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    for xv in (np.asarray([1, 2, 3, 4], np.float32),
+               np.asarray([-1, -2, -3, -4], np.float32)):
+        out = sd.output({"x": xv}, "branch", "branch:1")
+        wa, wb = ((xv * 2, -xv) if xv.sum() > 0 else (-xv, xv * 2))
+        np.testing.assert_allclose(np.asarray(out["branch"]), wa, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(out["branch:1"]), wb,
+                                   rtol=1e-5)
