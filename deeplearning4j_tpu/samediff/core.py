@@ -775,11 +775,20 @@ class SameDiff:
                         name=name, fn_attrs=fn_attrs, subgraphs=subgraphs)
         return outs[0] if single else tuple(outs)
 
-    def while_loop(self, cond_fn, body_fn, operands, name=None):
+    def while_loop(self, cond_fn, body_fn, operands, name=None,
+                   max_iterations: Optional[int] = None):
         """Structured while — replaces Enter/Exit/NextIteration frames with
         ``lax.while_loop``. ``operands`` is the loop carry (list of vars);
         returns the final carry as a tuple of SDVariables. Serializable
-        when the callables stay inside SDVariable ops."""
+        when the callables stay inside SDVariable ops.
+
+        ``max_iterations``: an upper trip-count bound. When given, the
+        loop lowers to a masked ``lax.scan`` of exactly that length —
+        results match the unbounded form whenever the loop exits within
+        the bound, and the loop becomes REVERSE-DIFFERENTIABLE (training
+        can backprop through it; raw ``lax.while_loop`` has no transpose
+        rule — the reference's TrainingSession backprops through its loop
+        frames, and this is the TPU-native path to the same capability)."""
         from deeplearning4j_tpu.samediff import serde as _serde
 
         n = len(operands)
@@ -796,7 +805,9 @@ class SameDiff:
                     "cond_fn": _serde.subgraph_dict(cc, oc, single=True),
                     "body_fn": _serde.subgraph_dict(cb, ob, single=False)}
         return self._op("while_loop", list(operands), n_out=n, name=name,
-                        fn_attrs=fn_attrs, subgraphs=subgraphs)
+                        fn_attrs=fn_attrs, subgraphs=subgraphs,
+                        max_iterations=(None if max_iterations is None
+                                        else int(max_iterations)))
 
     def scan(self, body_fn, init, xs, name=None):
         """``lax.scan`` over leading axis of ``xs``; body maps
@@ -1052,15 +1063,39 @@ def _op_cond(pred, *operands, true_fn, false_fn):
 
 
 @register_op("while_loop")
-def _op_while_loop(*operands, cond_fn, body_fn):
-    def body(c):
-        r = body_fn(*c)
+def _op_while_loop(*operands, cond_fn, body_fn, max_iterations=None):
+    def as_carry(r):
         # a single-carry body may return a bare array; tuple(r) would
         # wrongly iterate its elements
         return tuple(r) if isinstance(r, (tuple, list)) else (r,)
 
-    out = jax.lax.while_loop(lambda c: cond_fn(*c).astype(bool).reshape(()),
-                             body, tuple(operands))
+    if max_iterations is None:
+        return jax.lax.while_loop(
+            lambda c: cond_fn(*c).astype(bool).reshape(()),
+            lambda c: as_carry(body_fn(*c)), tuple(operands))
+
+    # bounded form: a scan over max_iterations steps — identical results
+    # whenever the loop exits within the bound, and REVERSE-DIFFERENTIABLE
+    # (lax.while_loop has no transpose rule; scan does). The step is a
+    # lax.cond, NOT a jnp.where over an always-evaluated body: once the
+    # condition goes false the body never runs, so a body that would be
+    # undefined past exit (divide-by-zero at the boundary, say) neither
+    # poisons the forward nor turns the where-transpose into 0*inf NaNs.
+    def step(c):
+        new = as_carry(body_fn(*c))
+        if len(new) != len(c):
+            raise ValueError(
+                f"while_loop body returned {len(new)} outputs for a "
+                f"{len(c)}-element carry (the unbounded lowering rejects "
+                "this too)")
+        return new
+
+    def body(c, _):
+        pred = cond_fn(*c).astype(bool).reshape(())
+        return jax.lax.cond(pred, step, lambda c: c, c), None
+
+    out, _ = jax.lax.scan(body, tuple(operands), None,
+                          length=int(max_iterations))
     return out
 
 

@@ -447,3 +447,78 @@ def test_cond_multi_output_exec_and_serde(tmp_path):
     out2 = sd2.output({"x": -xv}, "a", "b")
     np.testing.assert_allclose(np.asarray(out2["a"]), xv)
     np.testing.assert_allclose(np.asarray(out2["b"]), -xv * 2)
+
+
+def test_bounded_while_loop_differentiable(tmp_path):
+    """while_loop(max_iterations=N) lowers to a masked scan: same results
+    as the unbounded form when the loop exits in time, and jax.grad works
+    through it (raw lax.while_loop has no transpose rule)."""
+    import jax
+    import jax.numpy as jnp
+
+    def build(bound):
+        sd = SameDiff()
+        x = sd.placeholder("x", ())
+        i0 = sd.constant(np.float64(0.0), name="i0")
+        outs = sd.while_loop(
+            lambda i, v: i < 3.0,
+            lambda i, v: (i + 1.0, v * 2.0),
+            [i0, x], max_iterations=bound)
+        outs[1].rename("y")
+        return sd
+
+    sd = build(10)
+    out = sd.output({"x": np.float64(1.5)}, "y")
+    np.testing.assert_allclose(np.asarray(out["y"]), 1.5 * 8)
+    # unbounded result agrees
+    out_u = build(None).output({"x": np.float64(1.5)}, "y")
+    np.testing.assert_allclose(np.asarray(out_u["y"]), 1.5 * 8)
+    # gradient: d(8x)/dx = 8 — impossible with the unbounded lowering
+    fn = sd.make_function(("y",))
+    g = jax.grad(lambda x: jnp.sum(
+        fn(dict(sd.arrays), {"x": x})["y"]))(jnp.asarray(1.5))
+    np.testing.assert_allclose(np.asarray(g), 8.0)
+    # serde round-trips the bound
+    path = str(tmp_path / "bw.sdnb")
+    sd.save(path)
+    sd2 = SameDiff.load(path)
+    out2 = sd2.output({"x": np.float64(2.0)}, "y")
+    np.testing.assert_allclose(np.asarray(out2["y"]), 16.0)
+
+
+def test_bounded_while_loop_boundary_safe_gradient():
+    """The masked step must NOT evaluate the body past loop exit: a body
+    that divides by zero exactly at the exit state would otherwise poison
+    gradients with 0*inf NaNs (review finding; lax.cond evaluates only
+    the live branch)."""
+    import jax
+    import jax.numpy as jnp
+
+    sd = SameDiff()
+    x = sd.placeholder("x", ())
+    i0 = sd.constant(np.float32(0.0), name="i0")
+    outs = sd.while_loop(
+        lambda i, v: i < 3.0,
+        lambda i, v: (i + 1.0, v / (3.0 - i)),  # div-by-zero AT exit i=3
+        [i0, x], max_iterations=10)
+    outs[1].rename("y")
+    fn = sd.make_function(("y",))
+    out = fn(dict(sd.arrays), {"x": jnp.asarray(6.0)})["y"]
+    np.testing.assert_allclose(np.asarray(out), 1.0)  # 6/(3*2*1)
+    g = jax.grad(lambda x: jnp.sum(
+        fn(dict(sd.arrays), {"x": x})["y"]))(jnp.asarray(6.0))
+    np.testing.assert_allclose(np.asarray(g), 1.0 / 6.0, rtol=1e-6)
+
+
+def test_bounded_while_loop_body_arity_checked():
+    import pytest as _pytest
+
+    sd = SameDiff()
+    x = sd.placeholder("x", ())
+    i0 = sd.constant(np.float32(0.0), name="i0")
+    with _pytest.raises(ValueError, match="carry"):
+        outs = sd.while_loop(
+            lambda i, v: i < 3.0,
+            lambda i, v: (i + 1.0, v * 2.0, v + 1.0),  # 3 outs, 2 carry
+            [i0, x], max_iterations=4)
+        sd.output({"x": np.float32(1.0)}, outs[1].name)
