@@ -318,6 +318,19 @@ class ComputationGraph(nn_io.LazyScoreMixin):
     def _fit_batch_async(self, ds):
         """One step without forcing a host sync (see
         MultiLayerNetwork._fit_batch_async)."""
+        from deeplearning4j_tpu.conf.multilayer import BackpropType
+
+        if self.conf.backprop_type is BackpropType.TRUNCATED_BPTT:
+            # silently training STANDARD against a tBPTT config would be
+            # worse than refusing: the graph runtime does not thread RNN
+            # carries across segments (DEVIATION from the reference's
+            # ComputationGraph tBPTT; MultiLayerNetwork has the full
+            # compiled segment-scan implementation). Inference/serde of
+            # such configs still works — only training refuses.
+            raise NotImplementedError(
+                "ComputationGraph does not implement truncated BPTT "
+                "training; use MultiLayerNetwork for tBPTT or STANDARD "
+                "backprop for graph models")
         if self.params is None:
             self.init()
         if self._train_step is None:

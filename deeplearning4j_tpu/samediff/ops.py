@@ -259,6 +259,37 @@ def _sd_trace(self, x, name=None):
     return self._op("math.trace", [x], name=name)[0]
 
 
+def _def_reduce3(opn):
+    def m(self, x, y, dims=None, keepdims=False, name=None, _n=opn):
+        return self._op(f"math.{_n}", [x, y], name=name, axis=_axes(dims),
+                        keepdims=bool(keepdims))[0]
+    m.__name__ = opn
+    setattr(SDMath, opn, m)
+
+
+for _n in ("euclideanDistance", "manhattanDistance", "cosineSimilarity",
+           "cosineDistance", "dot", "hammingDistance", "jaccardDistance"):
+    _def_reduce3(_n)
+
+_add_simple(SDMath, ["lgamma", "digamma", "rint"],
+            lambda self, n, x, name=None: self._un(n, x, name))
+
+
+@_def(SDMath, "standardize")
+def _sd_standardize(self, x, dims=-1, name=None):
+    return self._op("math.standardize", [x], name=name, axis=_axes(dims))[0]
+
+
+@_def(SDMath, "isMax")
+def _sd_is_max(self, x, dims=-1, name=None):
+    return self._op("math.isMax", [x], name=name, axis=_axes(dims))[0]
+
+
+@_def(SDMath, "cross")
+def _sd_cross(self, a, b, name=None):
+    return self._op("math.cross", [a, b], name=name)[0]
+
+
 # ======================= nn =======================
 
 _NN_UNARY = {
@@ -961,6 +992,104 @@ class SDLinalg(_Namespace):
 
     def diagPart(self, x, name=None):
         return self._op("linalg.diagPart", [x], name=name)[0]
+
+
+# ======================= reduce3 / statistics =======================
+# Reference: libnd4j's "reduce3" pairwise-reduction op family
+# (euclidean/manhattan/cosine/jaccard/hamming distances, dot) exposed on
+# SDMath, plus the entropy/standardize statistics ops.
+
+_EPS3 = 1e-12
+
+
+def _r3(fn):
+    return lambda x, y, *, axis, keepdims: fn(x, y, axis, keepdims)
+
+
+_REDUCE3 = {
+    "euclideanDistance": _r3(lambda x, y, a, k: jnp.sqrt(
+        jnp.sum((x - y) ** 2, axis=a, keepdims=k))),
+    "manhattanDistance": _r3(lambda x, y, a, k: jnp.sum(
+        jnp.abs(x - y), axis=a, keepdims=k)),
+    "cosineSimilarity": _r3(lambda x, y, a, k: jnp.sum(
+        x * y, axis=a, keepdims=k) / (
+        jnp.sqrt(jnp.sum(x * x, axis=a, keepdims=k))
+        * jnp.sqrt(jnp.sum(y * y, axis=a, keepdims=k)) + _EPS3)),
+    "dot": _r3(lambda x, y, a, k: jnp.sum(x * y, axis=a, keepdims=k)),
+    "hammingDistance": _r3(lambda x, y, a, k: jnp.sum(
+        (x != y).astype(jnp.int32), axis=a, keepdims=k)),  # exact count
+    # (int32 like countZero/countNonZero: f32 accumulation would go
+    # inexact past 2^24 mismatches)
+    "jaccardDistance": _r3(lambda x, y, a, k: 1.0 - jnp.sum(
+        jnp.minimum(x, y), axis=a, keepdims=k) / (jnp.sum(
+            jnp.maximum(x, y), axis=a, keepdims=k) + _EPS3)),
+}
+for _n, _f in _REDUCE3.items():
+    register_op(f"math.{_n}")(_f)
+
+
+@register_op("math.cosineDistance")
+def _cosine_distance(x, y, *, axis, keepdims):
+    return 1.0 - _REDUCE3["cosineSimilarity"](x, y, axis=axis,
+                                              keepdims=keepdims)
+
+
+_STATS = {
+    # entropy family over a distribution along `axis` (reference SDMath)
+    "entropy": lambda x, a, k: -jnp.sum(x * jnp.log(x + _EPS3), axis=a,
+                                        keepdims=k),
+    "logEntropy": lambda x, a, k: jnp.log(-jnp.sum(
+        x * jnp.log(x + _EPS3), axis=a, keepdims=k) + _EPS3),
+    "shannonEntropy": lambda x, a, k: -jnp.sum(
+        x * jnp.log2(x + _EPS3), axis=a, keepdims=k),
+    "amean": lambda x, a, k: jnp.mean(jnp.abs(x), axis=a, keepdims=k),
+    "asum": lambda x, a, k: jnp.sum(jnp.abs(x), axis=a, keepdims=k),
+    "countZero": lambda x, a, k: jnp.sum((x == 0).astype(jnp.int32),
+                                         axis=a, keepdims=k),
+    "zeroFraction": lambda x, a, k: jnp.mean((x == 0).astype(jnp.float32),
+                                             axis=a, keepdims=k),
+}
+for _n, _f in _STATS.items():
+    register_op(f"reduce.{_n}")(
+        lambda x, *, axis, keepdims, _f=_f: _f(x, axis, keepdims))
+for _n in _STATS:
+    def _mk_stat(_n=_n):
+        def m(self, x, dims=None, keepdims=False, name=None):
+            return self._red(_n, x, dims, keepdims, name)
+        m.__name__ = _n
+        return m
+    setattr(SDMath, _n, _mk_stat())
+
+
+@register_op("math.standardize")
+def _standardize(x, *, axis):
+    mu = jnp.mean(x, axis=axis, keepdims=True)
+    sd_ = jnp.std(x, axis=axis, keepdims=True)
+    return (x - mu) / (sd_ + _EPS3)
+
+
+@register_op("math.isMax")
+def _is_max(x, *, axis):
+    """Reference libnd4j IsMax: EXACTLY one 1 per reduction slice (at the
+    argmax index), even on ties — a mask of all maxima would break
+    downstream one-hot assumptions."""
+    if axis is not None and len(axis) != 1:
+        raise NotImplementedError("isMax supports a single dimension")
+    ax = -1 if axis is None else int(axis[0])
+    idx = jnp.argmax(x, axis=ax)
+    return jnp.moveaxis(
+        jax.nn.one_hot(idx, x.shape[ax], dtype=x.dtype), -1, ax)
+
+
+@register_op("math.cross")
+def _cross(a, b):
+    return jnp.cross(a, b, axis=-1)
+
+
+for _n, _f in {"lgamma": jax.scipy.special.gammaln,
+               "digamma": jax.scipy.special.digamma,
+               "rint": jnp.rint}.items():
+    register_op(f"math.{_n}")(_f)
 
 
 # ======================= scatter / gather-nd / segment =======================

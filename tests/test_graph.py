@@ -386,3 +386,31 @@ class TestGraphGradients:
                      rng.normal(size=(4, 2)).astype(np.float32))
         res = gradient_check_graph(g, ds, n_samples=60)
         assert res.passed, res.failures
+
+
+def test_graph_tbptt_training_rejected_but_loadable():
+    """A TRUNCATED_BPTT graph config loads and infers (serde must not
+    break on saved models); only training refuses, with a clear error
+    (DEVIATION: graph tBPTT is MultiLayerNetwork-only here)."""
+    import pytest as _pytest
+
+    from deeplearning4j_tpu.conf.multilayer import BackpropType
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(learning_rate=0.01))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_out=4, activation=Activation.TANH),
+                       "in")
+            .add_layer("out", OutputLayer(n_out=2,
+                                          activation=Activation.SOFTMAX,
+                                          loss_fn=LossMCXENT()), "d")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(3))
+            .backprop_type(BackpropType.TRUNCATED_BPTT, fwd=4, back=4)
+            .build())
+    net = ComputationGraph(conf).init()  # constructing/inferring is fine
+    x = np.zeros((2, 3), np.float32)
+    assert np.asarray(net.output(x)).shape == (2, 2)
+    with _pytest.raises(NotImplementedError, match="truncated BPTT"):
+        net.fit_batch(DataSet(x, np.eye(2, dtype=np.float32)[[0, 1]]))

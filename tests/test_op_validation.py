@@ -1002,6 +1002,8 @@ def test_coverage_registry_complete():
     _run_math_misc()
     _run_structural_misc()
     _run_cnn_nn_extra()
+    _run_reduce3()
+    _run_stats_misc()
     rep = coverage_report()
     unexpected = sorted(set(rep["missing"]) - set(_EXEMPT))
     assert not unexpected, (
@@ -1009,3 +1011,101 @@ def test_coverage_registry_complete():
         "sweep entry in test_op_validation.py or an explicit exemption "
         "with a pointer to the covering test")
     assert rep["validated"] >= 190, rep["validated"]
+
+
+# --- round 2b: reduce3 distances / statistics / misc math -------------------
+
+def _run_reduce3():
+    rng = np.random.default_rng(81)
+    xv = rng.uniform(0.2, 2.0, size=(3, 4))
+    yv = rng.uniform(0.2, 2.0, size=(3, 4))
+    sd = SameDiff()
+    x = sd.placeholder("x", (3, 4))
+    y = sd.placeholder("y", (3, 4))
+    sd.math.euclideanDistance(x, y, dims=(1,), name="eu")
+    sd.math.manhattanDistance(x, y, dims=(1,), name="mh")
+    sd.math.cosineSimilarity(x, y, dims=(1,), name="cs")
+    sd.math.cosineDistance(x, y, dims=(1,), name="cd")
+    sd.math.dot(x, y, dims=(1,), name="dt")
+    sd.math.hammingDistance(x, y, dims=(1,), name="hm")
+    sd.math.jaccardDistance(x, y, dims=(1,), name="jc")
+    cs = (xv * yv).sum(1) / (np.linalg.norm(xv, axis=1)
+                             * np.linalg.norm(yv, axis=1) + 1e-12)
+    validate(TestCase(sd, {"x": xv, "y": yv}, {
+        "eu": np.sqrt(((xv - yv) ** 2).sum(1)),
+        "mh": np.abs(xv - yv).sum(1),
+        "cs": cs, "cd": 1.0 - cs,
+        "dt": (xv * yv).sum(1),
+        "hm": (xv != yv).sum(1).astype(np.float64),
+        "jc": 1.0 - np.minimum(xv, yv).sum(1)
+        / (np.maximum(xv, yv).sum(1) + 1e-12),
+    }, grad_wrt=["x", "y"], max_rel_error=1e-3))
+
+
+def test_reduce3_sweep():
+    _run_reduce3()
+
+
+def _run_stats_misc():
+    rng = np.random.default_rng(82)
+    p = rng.uniform(0.05, 1.0, size=(2, 5))
+    p = p / p.sum(1, keepdims=True)          # distributions per row
+    xv = rng.uniform(0.5, 2.0, size=(2, 5))
+    xz = xv.copy()
+    xz[0, 1] = 0.0                            # a zero for countZero
+    v3a = rng.normal(size=(4, 3))
+    v3b = rng.normal(size=(4, 3))
+
+    sd = SameDiff()
+    pp = sd.placeholder("p", (2, 5))
+    x = sd.placeholder("x", (2, 5))
+    xzv = sd.placeholder("xz", (2, 5))
+    a3 = sd.placeholder("a3", (4, 3))
+    b3 = sd.placeholder("b3", (4, 3))
+    sd.math.entropy(pp, dims=(1,), name="ent")
+    sd.math.logEntropy(pp, dims=(1,), name="lent")
+    sd.math.shannonEntropy(pp, dims=(1,), name="sent")
+    sd.math.amean(x, dims=(1,), name="am")
+    sd.math.asum(x, dims=(1,), name="as")
+    sd.math.countZero(xzv, dims=(1,), name="cz")
+    sd.math.zeroFraction(xzv, dims=(1,), name="zf")
+    sd.math.standardize(x, dims=(1,), name="std")
+    sd.math.isMax(x, dims=(1,), name="im")
+    sd.math.cross(a3, b3, name="cr")
+    sd.math.lgamma(x, name="lg")
+    sd.math.digamma(x, name="dg")
+    sd.math.rint(x, name="ri")
+
+    import scipy.special as sps
+
+    ent = -(p * np.log(p + 1e-12)).sum(1)
+    mu = xv.mean(1, keepdims=True)
+    sdv = xv.std(1, keepdims=True)
+    validate(TestCase(
+        sd, {"p": p, "x": xv, "xz": xz, "a3": v3a, "b3": v3b},
+        {"ent": ent, "lent": np.log(ent + 1e-12),
+         "sent": -(p * np.log2(p + 1e-12)).sum(1),
+         "am": np.abs(xv).mean(1), "as": np.abs(xv).sum(1),
+         "cz": (xz == 0).sum(1), "zf": (xz == 0).mean(1),
+         "std": (xv - mu) / (sdv + 1e-12),
+         "im": np.eye(5)[xv.argmax(1)],
+         "cr": np.cross(v3a, v3b),
+         "lg": sps.gammaln(xv), "dg": sps.digamma(xv),
+         "ri": np.rint(xv)},
+        grad_wrt=[], max_rel_error=1e-3))
+
+
+def test_stats_misc_sweep():
+    _run_stats_misc()
+
+
+def test_is_max_tie_breaks_to_single_one():
+    """Reference IsMax semantics: exactly one 1 on tied maxima."""
+    sd = SameDiff()
+    x = sd.placeholder("x", (2, 3))
+    sd.math.isMax(x, dims=(1,), name="im")
+    out = sd.output({"x": np.asarray([[1.0, 3.0, 3.0],
+                                      [2.0, 2.0, 1.0]])}, "im")
+    got = np.asarray(out["im"])
+    np.testing.assert_allclose(got.sum(1), [1.0, 1.0])
+    np.testing.assert_allclose(got, [[0, 1, 0], [1, 0, 0]])
