@@ -99,11 +99,15 @@ class LayerVertex(GraphVertex):
     def regularized_param_keys(self):
         return self.layer.regularized_param_keys()
 
-    def forward(self, params, state, inputs, train=False, rng=None):
+    def forward(self, params, state, inputs, train=False, rng=None,
+                mask=None):
         x = inputs[0]
         if self.preprocessor is not None:
             x, _ = self.preprocessor.forward({}, {}, x, train=train, rng=None)
-        return self.layer.forward(params, state, x, train=train, rng=rng)
+        kw = ({"mask": mask} if mask is not None
+              and getattr(self.layer, "uses_mask", False) else {})
+        return self.layer.forward(params, state, x, train=train, rng=rng,
+                                  **kw)
 
     # score hook when wrapping an output layer (reference: output vertices
     # must be LayerVertex over an IOutputLayer)

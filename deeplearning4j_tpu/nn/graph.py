@@ -18,7 +18,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.conf.graph import ComputationGraphConfiguration
+from deeplearning4j_tpu.conf.graph import (
+    ComputationGraphConfiguration,
+    LayerVertex,
+)
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.datasets.iterators import DataSetIterator
 from deeplearning4j_tpu.eval.evaluation import Evaluation
@@ -63,6 +66,29 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         self._base_key = jax.random.PRNGKey(conf.seed)
         self._topo = conf.topo_order()
         self._vmap = conf.vertex_map()
+        # feature-mask propagation (reference: ComputationGraph
+        # feedForwardMaskArrays): a per-timestep mask follows a vertex's
+        # output only while it stays sequence-shaped — a vertex whose
+        # output leaves Recurrent (pooling over time, LastTimeStep,
+        # flatten) terminates it
+        from deeplearning4j_tpu.conf import inputs as _it
+
+        types = conf.vertex_output_types()
+        in_types = {n: [types[s] for s in self._vmap[n].inputs]
+                    for n in self._topo}
+
+        def _stops(name):
+            out = types[name]
+            if not isinstance(out, _it.Recurrent):
+                return True
+            # time-RESIZING vertices (strided Conv1D, 1D pooling/crop/
+            # upsample) would hand a wrong-length mask downstream — the
+            # reference resizes masks per vertex; here the mask terminates
+            ins = [t for t in in_types[name]
+                   if isinstance(t, _it.Recurrent)]
+            return any(t.timesteps != out.timesteps for t in ins)
+
+        self._mask_stops = {name: _stops(name) for name in self._topo}
 
     # --- lifecycle ---------------------------------------------------------
     def init(self) -> "ComputationGraph":
@@ -99,23 +125,41 @@ class ComputationGraph(nn_io.LazyScoreMixin):
 
     # --- functional core ---------------------------------------------------
     def _forward(self, params, state, inputs: Sequence, train: bool, rng,
-                 skip=frozenset()):
+                 skip=frozenset(), fmasks=None):
         """Pure DAG forward. ``inputs`` aligned with conf.network_inputs.
         Returns (activations dict incl. every vertex, new_state). ``skip``:
         vertex names left unevaluated (the loss path skips output vertices —
-        their fused activation+loss is computed by score())."""
+        their fused activation+loss is computed by score()). ``fmasks``:
+        per-input [batch, time] feature masks (or None), propagated along
+        sequence-shaped paths and handed to mask-consuming layers
+        (reference ``feedForwardMaskArrays``)."""
         acts: Dict[str, object] = dict(zip(self.conf.network_inputs, inputs))
+        masks: Dict[str, object] = {}
+        if fmasks is not None:
+            masks.update(zip(self.conf.network_inputs, fmasks))
         new_state = {}
         for i, name in enumerate(self._topo):
             if name in skip:
                 continue
             spec = self._vmap[name]
             xs = [acts[src] for src in spec.inputs]
+            in_masks = [masks.get(src) for src in spec.inputs
+                        if masks.get(src) is not None]
+            # multiple masked inputs (merge vertices): AND the masks —
+            # a step is valid only where every input is (reference
+            # combines per-input masks the same way)
+            mask = None
+            for m in in_masks:
+                mask = m if mask is None else jnp.minimum(mask, m)
             p = params.get(name, {})
             s = state.get(name, {})
             vrng = jax.random.fold_in(rng, i) if rng is not None else None
-            y, s2 = spec.vertex.forward(p, s, xs, train=train, rng=vrng)
+            kw = ({"mask": mask} if mask is not None
+                  and isinstance(spec.vertex, LayerVertex) else {})
+            y, s2 = spec.vertex.forward(p, s, xs, train=train, rng=vrng,
+                                        **kw)
             acts[name] = y
+            masks[name] = None if self._mask_stops[name] else mask
             if name in state:
                 new_state[name] = s2
         return acts, new_state
@@ -145,13 +189,14 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         return cast, nn_io.cast_floats(tuple(features), self._cdtype)
 
     def _loss(self, params, state, features: Sequence, labels: Sequence,
-              lmasks: Sequence, rng, train=True):
+              fmasks: Sequence, lmasks: Sequence, rng, train=True):
         features = tuple(self._dequant(f, i)
                          for i, f in enumerate(features))
         out_specs = self._output_specs()
         fwd_params, features = self._fwd_cast(params, features)
         acts, new_state = self._forward(fwd_params, state, features, train,
-                                        rng, skip={s.name for s in out_specs})
+                                        rng, skip={s.name for s in out_specs},
+                                        fmasks=fmasks)
         loss = 0.0
         for i, spec in enumerate(out_specs):
             # output-vertex activation + loss in the storage dtype on the
@@ -178,10 +223,11 @@ class ComputationGraph(nn_io.LazyScoreMixin):
     def train_step_fn(self):
         """Raw (unjitted) pure train step for parallel wrappers (stage-7)."""
 
-        def step(params, state, opt_state, features, labels, lmasks, it, ep,
-                 rng):
+        def step(params, state, opt_state, features, labels, fmasks,
+                 lmasks, it, ep, rng):
             def loss_fn(p):
-                return self._loss(p, state, features, labels, lmasks, rng)
+                return self._loss(p, state, features, labels, fmasks,
+                                  lmasks, rng)
 
             (loss, new_state), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
@@ -200,12 +246,13 @@ class ComputationGraph(nn_io.LazyScoreMixin):
 
     def grad_fn(self):
         """Backward only, updater NOT applied: (params, state, features,
-        labels, lmasks, rng) -> (loss, new_state, grads). ParallelWrapper's
-        gradient-exchange hook point (SURVEY.md §3.4)."""
+        labels, fmasks, lmasks, rng) -> (loss, new_state, grads).
+        ParallelWrapper's gradient-exchange hook point (SURVEY.md §3.4)."""
 
-        def gfn(params, state, features, labels, lmasks, rng):
+        def gfn(params, state, features, labels, fmasks, lmasks, rng):
             def loss_fn(p):
-                return self._loss(p, state, features, labels, lmasks, rng)
+                return self._loss(p, state, features, labels, fmasks,
+                                  lmasks, rng)
 
             (loss, new_state), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
@@ -286,6 +333,10 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         labels = tuple(nn_io.as_device(l, self._dtype)
                        for l in mds.labels)
         n_out = len(labels)
+        fmasks = tuple(
+            nn_io.as_device(m, self._dtype) if m is not None else None
+            for m in (mds.features_masks if mds.features_masks is not None
+                      else (None,) * len(features)))
         masks = (mds.labels_masks if mds.labels_masks is not None
                  else (None,) * n_out)
         # as_device passes an already-on-device mask through (the
@@ -300,6 +351,8 @@ class ComputationGraph(nn_io.LazyScoreMixin):
             if isinstance(ds, MultiDataSet):
                 ds.features = list(features)
                 ds.labels = list(labels)
+                if ds.features_masks is not None:
+                    ds.features_masks = list(fmasks)
                 if ds.labels_masks is not None:
                     ds.labels_masks = [
                         lm if orig is not None else None
@@ -307,9 +360,11 @@ class ComputationGraph(nn_io.LazyScoreMixin):
             elif isinstance(ds, DataSet):
                 ds.features = features[0]
                 ds.labels = labels[0]
+                if ds.features_mask is not None:
+                    ds.features_mask = fmasks[0]
                 if ds.labels_mask is not None:
                     ds.labels_mask = lmasks[0]
-        return features, labels, lmasks
+        return features, labels, fmasks, lmasks
 
     def fit_batch(self, ds) -> float:
         """One synced optimization step."""
@@ -340,24 +395,25 @@ class ComputationGraph(nn_io.LazyScoreMixin):
             # per-step scalars (iteration, epoch, rng fold, default masks)
             # live inside the jit — each eager host op would cost a
             # dispatch round-trip (see nn_io device counters)
-            def step(params, state, opt_state, features, labels, lmasks,
-                     itc, ep, base_key):
+            def step(params, state, opt_state, features, labels, fmasks,
+                     lmasks, itc, ep, base_key):
                 it, rng = nn_io.step_scalars(itc, base_key)
                 lmasks = tuple(
                     jnp.ones((l.shape[0],), dtype) if m is None else m
                     for m, l in zip(lmasks, labels))
                 new_p, new_s, new_o, loss = raw(
-                    params, state, opt_state, features, labels, lmasks,
-                    it, ep, rng)
+                    params, state, opt_state, features, labels, fmasks,
+                    lmasks, it, ep, rng)
                 return new_p, new_s, new_o, loss, itc + 1
 
-            self._train_step = jax.jit(step, donate_argnums=(0, 1, 2, 6))
-        features, labels, lmasks = self._prep_batch(ds, lazy_lmasks=True,
-                                                    write_back=True)
+            self._train_step = jax.jit(step, donate_argnums=(0, 1, 2, 7))
+        features, labels, fmasks, lmasks = self._prep_batch(
+            ds, lazy_lmasks=True, write_back=True)
         (self.params, self.state, self.opt_state, loss,
          new_itc) = self._train_step(
-            self.params, self.state, self.opt_state, features, labels, lmasks,
-            self.device_iteration(), self.device_epoch(), self._base_key)
+            self.params, self.state, self.opt_state, features, labels,
+            fmasks, lmasks, self.device_iteration(), self.device_epoch(),
+            self._base_key)
         self._score_dev = loss
         self._score_cache = None
         cur = self.iteration
@@ -368,18 +424,19 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         return loss
 
     # --- inference / scoring ----------------------------------------------
-    def output(self, *inputs):
+    def output(self, *inputs, fmasks=None):
         """Forward pass, eval mode (reference ``#output(INDArray...)``).
         Returns a list aligned with conf.network_outputs (single array if
-        one output)."""
+        one output). ``fmasks``: per-input feature masks (reference
+        ``#output(INDArray[], INDArray[] featureMasks, ...)``)."""
         if self.params is None:
             self.init()
         if self._output_fn is None:
-            def out(params, state, xs):
+            def out(params, state, xs, fmasks):
                 xs = tuple(self._dequant(x, i) for i, x in enumerate(xs))
                 params, xs = self._fwd_cast(params, xs, full=True)
                 acts, _ = self._forward(params, state, xs, train=False,
-                                        rng=None)
+                                        rng=None, fmasks=fmasks)
                 return tuple(acts[n].astype(self._dtype)
                              for n in self.conf.network_outputs)
 
@@ -388,7 +445,10 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         # features dequantize inside the jit, matching training
         xs = tuple(nn_io.as_device(x, self._dtype, feature=True)
                    for x in inputs)
-        outs = self._output_fn(self.params, self.state, xs)
+        fm = tuple(nn_io.as_device(m, self._dtype) if m is not None else None
+                   for m in (fmasks if fmasks is not None
+                             else (None,) * len(xs)))
+        outs = self._output_fn(self.params, self.state, xs, fm)
         return outs[0] if len(outs) == 1 else list(outs)
 
     def score(self, ds=None) -> float:
@@ -397,22 +457,23 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         if self.params is None:
             self.init()
         if self._score_fn is None:
-            def score(params, state, features, labels, lmasks):
-                loss, _ = self._loss(params, state, features, labels, lmasks,
-                                     rng=None, train=False)
+            def score(params, state, features, labels, fmasks, lmasks):
+                loss, _ = self._loss(params, state, features, labels,
+                                     fmasks, lmasks, rng=None, train=False)
                 return loss
 
             self._score_fn = jax.jit(score)
-        features, labels, lmasks = self._prep_batch(ds)
-        return float(self._score_fn(self.params, self.state, features, labels,
-                                    lmasks))
+        features, labels, fmasks, lmasks = self._prep_batch(ds)
+        return float(self._score_fn(self.params, self.state, features,
+                                    labels, fmasks, lmasks))
 
     def evaluate(self, iterator, evaluation: Optional[Evaluation] = None):
         """Reference ``#evaluate(DataSetIterator)`` — first output vertex."""
         ev = evaluation if evaluation is not None else Evaluation()
         for ds in iterator:
             mds = _as_multi(ds)
-            out = self.output(*mds.features)
+            out = self.output(*mds.features,
+                              fmasks=mds.features_masks)
             if isinstance(out, list):
                 out = out[0]
             mask = (mds.labels_masks[0]
@@ -427,11 +488,11 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         ``#computeGradientAndScore``)."""
         if self.params is None:
             self.init()
-        features, labels, lmasks = self._prep_batch(ds)
+        features, labels, fmasks, lmasks = self._prep_batch(ds)
 
         def loss_fn(p):
-            return self._loss(p, self.state, features, labels, lmasks,
-                              rng=None)
+            return self._loss(p, self.state, features, labels, fmasks,
+                              lmasks, rng=None)
 
         (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             self.params)

@@ -39,13 +39,13 @@ def _flat_loss_fn(net, ds):
                                 None, train=False)
             return loss
     else:                                     # ComputationGraph
-        features, labels, lmasks = net._prep_batch(ds)
+        features, labels, fmasks, lmasks = net._prep_batch(ds)
         conf, like, state = net.conf, net.params, net.state
 
         def f(flat):
             p = params_util.unflatten_params(conf, flat, like)
-            loss, _ = net._loss(p, state, features, labels, lmasks,
-                                rng=None, train=False)
+            loss, _ = net._loss(p, state, features, labels, fmasks,
+                                lmasks, rng=None, train=False)
             return loss
     return f, jnp.asarray(net.params_flat())
 

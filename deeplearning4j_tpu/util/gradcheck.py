@@ -187,6 +187,10 @@ def gradient_check_graph(conf, mds, epsilon: float = 1e-6,
                          for f in mds.features)
         labels = tuple(jnp.asarray(np.asarray(l), jnp.float64)
                        for l in mds.labels)
+        fmasks = tuple(
+            jnp.asarray(np.asarray(m), jnp.float64) if m is not None else None
+            for m in (mds.features_masks if mds.features_masks is not None
+                      else (None,) * len(features)))
         if mds.labels_masks is not None:
             lmasks = tuple(
                 jnp.asarray(np.asarray(m), jnp.float64) if m is not None
@@ -197,5 +201,5 @@ def gradient_check_graph(conf, mds, epsilon: float = 1e-6,
                            for l in labels)
 
         return _check_net_params_gradient(
-            conf64, net, (features, labels, lmasks), epsilon, max_rel_error,
-            abs_error_threshold, n_samples, seed)
+            conf64, net, (features, labels, fmasks, lmasks), epsilon,
+            max_rel_error, abs_error_threshold, n_samples, seed)

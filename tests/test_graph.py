@@ -414,3 +414,44 @@ def test_graph_tbptt_training_rejected_but_loadable():
     assert np.asarray(net.output(x)).shape == (2, 2)
     with _pytest.raises(NotImplementedError, match="truncated BPTT"):
         net.fit_batch(DataSet(x, np.eye(2, dtype=np.float32)[[0, 1]]))
+
+
+def test_graph_feature_mask_propagation():
+    """Feature masks reach mask-consuming layer vertices (reference
+    ComputationGraph feedForwardMaskArrays): a masked tail must not
+    change earlier outputs, and masked steps emit zeros."""
+    from deeplearning4j_tpu.conf.layers_rnn import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater(Adam(learning_rate=0.01))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("lstm", LSTM(n_out=6), "in")
+            .add_layer("out", RnnOutputLayer(n_out=2,
+                                             activation=Activation.SOFTMAX,
+                                             loss_fn=LossMCXENT()), "lstm")
+            .set_outputs("out")
+            .set_input_types(InputType.recurrent(3, 8))
+            .build())
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 8, 3)).astype(np.float32)
+    fmask = np.ones((2, 8), np.float32)
+    fmask[:, 5:] = 0.0  # valid prefix of 5 steps
+
+    full = np.asarray(net.output(x, fmasks=[fmask]))
+    trunc = np.asarray(net.output(x[:, :5]))
+    unmasked = np.asarray(net.output(x))
+    # valid prefix matches the truncated-sequence run exactly
+    np.testing.assert_allclose(full[:, :5], trunc, rtol=1e-5, atol=1e-6)
+    # and the mask actually reached the LSTM: masked-tail outputs differ
+    # from the unmasked run (LSTM zeroes masked hidden states; a causal
+    # prefix check alone would pass even if the mask were dropped)
+    assert not np.allclose(full[:, 5:], unmasked[:, 5:])
+    # masked-mask path actually trains too (loss finite, fit runs)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (2, 8))]
+    mds = MultiDataSet(features=[x], labels=[y], features_masks=[fmask],
+                       labels_masks=[fmask])
+    l0 = net.fit_batch(mds)
+    assert np.isfinite(l0)
