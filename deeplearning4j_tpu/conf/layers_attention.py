@@ -6,9 +6,10 @@ LearnedSelfAttentionLayer, RecurrentAttentionLayer}`` and
 ``sd.nn.multiHeadDotProductAttention`` (the reference materializes the full
 attention matrix per head). TPU-native design: the projections are single
 large matmuls on the MXU and the softmax·V core goes through
-:func:`deeplearning4j_tpu.ops.dot_product_attention` (``auto`` = XLA
-blockwise for long sequences; ``attention_impl="flash"`` selects the
-strictly-O(T)-VMEM Pallas kernel — the reference has neither).
+:func:`deeplearning4j_tpu.ops.dot_product_attention` (``auto`` = full
+materialization for short sequences, the Pallas flash kernel on TPU
+beyond T=1024 — the fastest trainable long-T path, BASELINE.md — and the
+XLA blockwise scan elsewhere; ``attention_impl`` forces a tier).
 
 Weight layout (locked by serializer round-trip tests): ``Wq/Wk/Wv:
 [nIn, nHeads*headSize]``, ``Wo: [nHeads*headSize, nOut]``, biases per
