@@ -221,16 +221,21 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         """Backward only, updater NOT applied: (params, state, features,
         labels, fmask, lmask, rng) -> (loss, new_state, grads). The split
         point where ParallelWrapper interposes gradient exchange (reference
-        ``EncodingHandler#encodeUpdates`` hook, SURVEY.md §3.4)."""
+        ``EncodingHandler#encodeUpdates`` hook, SURVEY.md §3.4). With
+        ``carries`` (a tBPTT segment) the return gains detached
+        ``new_carries``."""
 
-        def gfn(params, state, features, labels, fmask, lmask, rng):
+        def gfn(params, state, features, labels, fmask, lmask, rng,
+                carries=None):
             def loss_fn(p):
                 return self._loss(p, state, features, labels, fmask, lmask,
-                                  rng)
+                                  rng, carries=carries)
 
-            (loss, (new_state, _)), grads = jax.value_and_grad(
+            (loss, (new_state, new_carries)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            return loss, new_state, grads
+            if carries is None:
+                return loss, new_state, grads
+            return loss, new_state, grads, jax.lax.stop_gradient(new_carries)
 
         return gfn
 
@@ -472,19 +477,55 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         outside the train step's loss closure) — and the parameter update
         trains on the trailing ``back`` window. Still ONE compiled scan."""
         raw = self.train_step_fn()
-        cdt = self._cdtype or self._dtype
+        segments, zero_carries, advance, _ = self.tbptt_scan_parts(seg,
+                                                                   back)
+
+        def run(params, state, opt, features, labels, fmask, lmask,
+                itc, ep, base_key):
+            segs = tuple(segments(a)
+                         for a in (features, labels, fmask, lmask))
+            carries = zero_carries(features)
+
+            def body(carry, xs):
+                params, state, opt, carries, itc = carry
+                f_s, l_s, fm_s, lm_s = xs
+                f_s, l_s, fm_s, lm_s, carries = advance(
+                    params, state, carries, f_s, l_s, fm_s, lm_s)
+                it, rng = nn_io.step_scalars(itc, base_key)
+                params, state, opt, loss, carries = raw(
+                    params, state, opt, f_s, l_s, fm_s, lm_s, it, ep,
+                    rng, carries)
+                return (params, state, opt, carries, itc + 1), loss
+
+            (params, state, opt, carries, itc), losses = jax.lax.scan(
+                body, (params, state, opt, carries, itc), segs)
+            return params, state, opt, itc, jnp.mean(losses)
+
+        return run
+
+    def tbptt_scan_parts(self, seg: int, back: Optional[int] = None):
+        """Shared tBPTT scan plumbing — ``(segments, zero_carries, advance,
+        cut)`` — used by :meth:`tbptt_scan_fn` and ParallelWrapper's
+        compressed-gradient scan:
+
+        - ``segments(arr)``: [B, T, ...] -> [n_seg, B, seg, ...] in-trace
+          (tail zero-padded; with ``back < seg`` the tail pad goes BEFORE
+          its real steps so they stay inside the gradient window).
+        - ``zero_carries(features)``: per-layer zero RNN carries, vma-
+          anchored to the batch so the scan carry is shard_map-legal.
+        - ``advance(params, state, carries, f, l, fm, lm)``: consume the
+          segment's no-grad head (``cut`` steps, inference mode) and
+          return the trimmed gradient window + advanced carries."""
         back = seg if back is None else min(int(back), seg)
         cut = seg - back
         last = len(self.conf.layers) - 1
+        cdt = self._cdtype or self._dtype
 
         def segments(arr):
-            # [B, T, ...] -> [n_seg, B, seg, ...], tail zero-padded —
             # INSIDE the jit: shapes are static under trace, so the
             # segmentation costs zero extra dispatches. n_seg derives
             # from the traced shape (NOT closed over: a different T
-            # retraces with its own count). back < fwd: the tail pad goes
-            # BEFORE its real steps so they stay inside the gradient
-            # window (mirrors _tbptt_prepad for device-resident batches).
+            # retraces with its own count).
             arr = jnp.asarray(arr)
             t = arr.shape[1]
             ns = -(-t // seg)
@@ -501,10 +542,7 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
                                  *arr.shape[2:])
             return jnp.moveaxis(shaped, 1, 0)
 
-        def run(params, state, opt, features, labels, fmask, lmask,
-                itc, ep, base_key):
-            segs = tuple(segments(a)
-                         for a in (features, labels, fmask, lmask))
+        def zero_carries(features):
             # anchor the zero carries to the features: under shard_map the
             # batch is varied over the mesh axis, and a bare jnp.zeros is
             # not — lax.scan then rejects the carry (vma mismatch). The
@@ -513,36 +551,26 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
             carries = {str(i): layer.zero_carry(features.shape[0], cdt)
                        for i, layer in enumerate(self.conf.layers)
                        if getattr(layer, "has_carry", False)}
-            carries = jax.tree_util.tree_map(
+            return jax.tree_util.tree_map(
                 lambda z: z + anchor.astype(z.dtype), carries)
 
-            def body(carry, xs):
-                params, state, opt, carries, itc = carry
-                f_s, l_s, fm_s, lm_s = xs
-                if cut:
-                    # state-advance over the head of the segment: the
-                    # params used here are the scan carry (constants with
-                    # respect to the train step's loss argument), so no
-                    # gradient reaches these timesteps — reference
-                    # truncates the backward pass at back_length
-                    fwd_p, f_c, fm_c = self._fwd_cast(
-                        params, self._dequant(f_s[:, :cut]), fm_s[:, :cut])
-                    _, _, carries = self._forward(
-                        fwd_p, state, f_c, train=False, rng=None,
-                        fmask=fm_c, upto=last, carries=carries)
-                    f_s, l_s, fm_s, lm_s = (a[:, cut:] for a in
-                                            (f_s, l_s, fm_s, lm_s))
-                it, rng = nn_io.step_scalars(itc, base_key)
-                params, state, opt, loss, carries = raw(
-                    params, state, opt, f_s, l_s, fm_s, lm_s, it, ep,
-                    rng, carries)
-                return (params, state, opt, carries, itc + 1), loss
+        def advance(params, state, carries, f_s, l_s, fm_s, lm_s):
+            if cut:
+                # state-advance over the head of the segment: the params
+                # used here are scan-carry constants with respect to the
+                # train step's loss argument, so no gradient reaches
+                # these timesteps — reference truncates the backward
+                # pass at back_length
+                fwd_p, f_c, fm_c = self._fwd_cast(
+                    params, self._dequant(f_s[:, :cut]), fm_s[:, :cut])
+                _, _, carries = self._forward(
+                    fwd_p, state, f_c, train=False, rng=None,
+                    fmask=fm_c, upto=last, carries=carries)
+                f_s, l_s, fm_s, lm_s = (a[:, cut:] for a in
+                                        (f_s, l_s, fm_s, lm_s))
+            return f_s, l_s, fm_s, lm_s, carries
 
-            (params, state, opt, carries, itc), losses = jax.lax.scan(
-                body, (params, state, opt, carries, itc), segs)
-            return params, state, opt, itc, jnp.mean(losses)
-
-        return run
+        return segments, zero_carries, advance, cut
 
     def tbptt_batch_arrays(self, ds: DataSet):
         """Stage one tBPTT batch fully normalized for ``tbptt_scan_fn``:

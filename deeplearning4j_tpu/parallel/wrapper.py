@@ -118,12 +118,6 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         if self._tbptt:
             seg = int(model.conf.tbptt_fwd_length)
             back = int(model.conf.tbptt_back_length or seg)
-            if threshold_algorithm is not None:
-                raise NotImplementedError(
-                    "threshold-compressed gradients are not implemented "
-                    "for tBPTT batches; use exact SHARED_GRADIENTS or "
-                    "AVERAGING (compression is a DCN feature — reference "
-                    "RNN training under ParallelWrapper uses plain modes)")
             self._tbptt_seg = seg
             self._tbptt_back = min(back, seg)
         procs = jax.process_count()
@@ -227,9 +221,78 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
     def _build_threshold_step(self):
         gfn = self.model.grad_fn()
         afn = self.model.apply_updates_fn()
+        tbptt = self._tbptt
+        if tbptt:
+            segments, zero_carries, advance, _ = \
+                self.model.tbptt_scan_parts(self._tbptt_seg,
+                                            self._tbptt_back)
+
+        def exchange(params, opt, res, grads, loss, new_state, c,
+                     ctot, n, it, ep, tau):
+            """The accumulator's per-iteration exchange: reweight for
+            ragged shards, encode(grad + residual) -> ±tau flips, psum
+            the messages, apply the shared sum (shared by the standard
+            and per-segment tBPTT paths)."""
+            w = c * n / ctot
+            grads = _tree_map(lambda g: g * w, grads)
+            enc, new_res, sparsity = encode_tree(grads, res, tau)
+            shared = _tree_map(lambda e: jax.lax.psum(e, DATA), enc)
+            new_params, new_opt = afn(params, opt, shared, it, ep)
+            loss = jax.lax.psum(loss * c, DATA) / ctot
+            new_state = _tree_map(
+                lambda s: jax.lax.psum(s * (c / ctot), DATA), new_state)
+            return (new_params, new_state, new_opt, new_res, loss,
+                    jax.lax.pmean(sparsity, DATA))
+
+        def tbptt_step(params, state, opt, residual, batch, itc, ep,
+                       base_key, tau, cvec):
+            """Per-SEGMENT threshold exchange inside one compiled scan —
+            the reference exchanges every iteration, and tBPTT counts one
+            iteration per segment; residuals carry across segments and
+            batches."""
+            c = cvec[0]
+            n = jax.lax.psum(1.0, DATA)
+            ctot = jnp.maximum(jax.lax.psum(c, DATA), 1.0)
+            res = _tree_map(lambda r: r[0], residual)
+            features, labels, fmask, lmask = batch
+            segs = tuple(segments(a)
+                         for a in (features, labels, fmask, lmask))
+            carries = zero_carries(features)
+
+            algo = self.threshold_algorithm
+
+            def body(carry, xs):
+                params, state, opt, res, carries, itc, tau_c = carry
+                f_s, l_s, fm_s, lm_s = xs
+                f_s, l_s, fm_s, lm_s, carries = advance(
+                    params, state, carries, f_s, l_s, fm_s, lm_s)
+                it, rng = nn_io.step_scalars(itc, base_key)
+                rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA))
+                loss, new_state, grads, carries = gfn(
+                    params, state, f_s, l_s, fm_s, lm_s, rng,
+                    carries=carries)
+                params, state, opt, res, loss, sparsity = exchange(
+                    params, opt, res, grads, loss, new_state, c,
+                    ctot, n, it, ep, tau_c)
+                # per-SEGMENT adaptive tau (the reference's EncodingHandler
+                # retunes every iteration; update() is pure jnp by design)
+                tau_c = jnp.asarray(algo.update(tau_c, sparsity),
+                                    jnp.float32)
+                return ((params, state, opt, res, carries, itc + 1, tau_c),
+                        loss)
+
+            ((params, state, opt, res, carries, itc, tau),
+             losses) = jax.lax.scan(
+                body, (params, state, opt, res, carries, itc,
+                       jnp.asarray(tau, jnp.float32)), segs)
+            return (params, state, opt, _tree_map(lambda r: r[None], res),
+                    jnp.mean(losses), tau)
 
         def step(params, state, opt, residual, batch, itc, ep, base_key,
                  tau, cvec):
+            if tbptt:
+                return tbptt_step(params, state, opt, residual, batch,
+                                  itc, ep, base_key, tau, cvec)
             it, rng = nn_io.step_scalars(itc, base_key)
             idx = jax.lax.axis_index(DATA)
             rng = jax.random.fold_in(rng, idx)
@@ -241,20 +304,10 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             c = cvec[0]
             n = jax.lax.psum(1.0, DATA)
             ctot = jnp.maximum(jax.lax.psum(c, DATA), 1.0)
-            w = c * n / ctot
-            grads = _tree_map(lambda g: g * w, grads)
             res = _tree_map(lambda r: r[0], residual)
-            # encode(grad + residual) -> ±tau flips; remainder stays local
-            enc, new_res, sparsity = encode_tree(grads, res, tau)
-            # the accumulator's exchange: every worker applies the SUM of
-            # all workers' encoded messages (its own + peers')
-            shared = _tree_map(lambda e: jax.lax.psum(e, DATA), enc)
-            new_params, new_opt = afn(params, opt, shared, it, ep)
-            loss = jax.lax.psum(loss * c, DATA) / ctot
-            new_state = _tree_map(
-                lambda s: jax.lax.psum(s * (c / ctot), DATA), new_state)
-            # sparsity feedback for AdaptiveThresholdAlgorithm (host-side)
-            sparsity = jax.lax.pmean(sparsity, DATA)
+            (new_params, new_state, new_opt, new_res, loss,
+             sparsity) = exchange(params, opt, res, grads, loss,
+                                  new_state, c, ctot, n, it, ep, tau)
             return (new_params, new_state, new_opt,
                     _tree_map(lambda r: r[None], new_res), loss, sparsity)
 
@@ -413,14 +466,18 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         elif self.threshold_algorithm is not None:
             tau = np.float32(self._tau)
             (self._params, self._state, self._opt, self._residual, loss,
-             sparsity) = self._step(self._params, self._state, self._opt,
+             feedback) = self._step(self._params, self._state, self._opt,
                                     self._residual, batch, itc, ep,
                                     m._base_key, tau, cvec)
-            # the adaptive threshold needs the sparsity on host — this mode
+            # the adaptive threshold needs feedback on host — this mode
             # inherently syncs per step (as the reference's EncodingHandler
-            # feedback loop does)
-            self._tau = float(self.threshold_algorithm.update(
-                self._tau, float(sparsity)))
+            # feedback loop does). tBPTT steps retune tau per SEGMENT
+            # inside the scan and return the final tau directly.
+            if self._tbptt:
+                self._tau = float(feedback)
+            else:
+                self._tau = float(self.threshold_algorithm.update(
+                    self._tau, float(feedback)))
         else:
             out = self._step(self._params, self._state, self._opt, *batch,
                              itc, ep, m._base_key)
