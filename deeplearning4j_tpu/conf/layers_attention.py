@@ -6,10 +6,11 @@ LearnedSelfAttentionLayer, RecurrentAttentionLayer}`` and
 ``sd.nn.multiHeadDotProductAttention`` (the reference materializes the full
 attention matrix per head). TPU-native design: the projections are single
 large matmuls on the MXU and the softmax·V core goes through
-:func:`deeplearning4j_tpu.ops.dot_product_attention` (``auto`` = full
-materialization for short sequences, the Pallas flash kernel on TPU
-beyond T=1024 — the fastest trainable long-T path, BASELINE.md — and the
-XLA blockwise scan elsewhere; ``attention_impl`` forces a tier).
+:func:`deeplearning4j_tpu.ops.dot_product_attention` (``auto``, from the
+committed ``bench_attention.py`` measurement: full materialization to
+T=1024, the XLA blockwise scan in the moderate band, the Pallas flash
+kernel from T=4096 up — the fastest long-T path and the only one that
+compiles backward at T=16k; ``attention_impl`` forces a tier).
 
 Weight layout (locked by serializer round-trip tests): ``Wq/Wk/Wv:
 [nIn, nHeads*headSize]``, ``Wo: [nHeads*headSize, nOut]``, biases per
@@ -45,14 +46,15 @@ def _merge_heads(x):
     return jnp.transpose(x, (0, 2, 1, 3)).reshape(b, t, h * d)
 
 
-def _mha(params, q_in, kv_in, nheads, key_mask, causal=False, impl="auto"):
+def _mha(params, q_in, kv_in, nheads, key_mask, causal=False, impl="auto",
+         train=True):
     """Projected multi-head attention over [B, T, E] inputs."""
     q = q_in @ params["Wq"] + params["bq"]
     k = kv_in @ params["Wk"] + params["bk"]
     v = kv_in @ params["Wv"] + params["bv"]
     o = dot_product_attention(_split_heads(q, nheads), _split_heads(k, nheads),
                               _split_heads(v, nheads), key_mask=key_mask,
-                              causal=causal, impl=impl)
+                              causal=causal, impl=impl, train=train)
     return _merge_heads(o) @ params["Wo"] + params["bo"]
 
 
@@ -75,6 +77,11 @@ class SelfAttentionLayer(BaseLayer):
     attention_impl: str = "auto"  # auto|flash|blockwise|reference
 
     uses_mask = True
+
+    def streaming_safe(self) -> bool:
+        # attention needs the WHOLE sequence; per-segment rnn_time_step
+        # calls would attend only within each call's window
+        return False
 
     def _head_size(self, n_in):
         if not self.project_input:
@@ -122,11 +129,11 @@ class SelfAttentionLayer(BaseLayer):
             q = _split_heads(x, 1)
             o = dot_product_attention(q, q, q, key_mask=mask,
                                       causal=self.causal,
-                                      impl=self.attention_impl)
+                                      impl=self.attention_impl, train=train)
             y = _merge_heads(o)
         else:
             y = _mha(params, x, x, self.n_heads, mask, self.causal,
-                     self.attention_impl)
+                     self.attention_impl, train=train)
         y = self.activation.apply(y)
         if mask is not None:  # masked-out steps emit zeros, as the reference
             y = y * jnp.asarray(mask, y.dtype)[:, :, None]
@@ -154,6 +161,11 @@ class LearnedSelfAttentionLayer(BaseLayer):
     attention_impl: str = "auto"
 
     uses_mask = True
+
+    def streaming_safe(self) -> bool:
+        # attention needs the WHOLE sequence; per-segment rnn_time_step
+        # calls would attend only within each call's window
+        return False
 
     def _dims(self, n_in):
         hs = self.head_size or ((self.n_out if self.project_input else n_in)
@@ -210,7 +222,7 @@ class LearnedSelfAttentionLayer(BaseLayer):
         o = dot_product_attention(
             _split_heads(q, self.n_heads), _split_heads(k, self.n_heads),
             _split_heads(v, self.n_heads), key_mask=mask,
-            impl=self.attention_impl)
+            impl=self.attention_impl, train=train)
         y = _merge_heads(o)
         if self.project_input:
             y = y @ params["Wo"] + params["bo"]
@@ -238,6 +250,11 @@ class RecurrentAttentionLayer(BaseLayer):
 
     uses_mask = True
     has_carry = True
+
+    def streaming_safe(self) -> bool:
+        # attention needs the WHOLE sequence; per-segment rnn_time_step
+        # calls would attend only within each call's window
+        return False
 
     def _dims(self):
         hs = self.head_size or (self.n_out // self.n_heads)

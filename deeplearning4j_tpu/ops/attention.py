@@ -347,10 +347,17 @@ def _mask_operand(km, b, tk0, tk):
 
 def _blk(requested, t):
     """Effective block size: >= one lane tile, a multiple of the lane
-    width (the lane-replication math requires it), padded-t divides it."""
+    width (the lane-replication math requires it), padded-t divides it.
+    When t sits just above a block multiple, shrink to the largest
+    128-multiple keeping the padding waste <= t/8 — T=640 with 512-blocks
+    would otherwise pad to 1024 and silently burn ~60% of the compute/HBM
+    on masked rows (round-2 advisor finding)."""
     if requested > _LANES:
         requested -= requested % _LANES
-    return min(requested, max(_LANES, 1 << (t - 1).bit_length()))
+    b = min(requested, max(_LANES, 1 << (t - 1).bit_length()))
+    while b > _LANES and (-(-t // b)) * b - t > t // 8:
+        b -= _LANES
+    return b
 
 
 def _index_maps(causal, bq, bk, off):
@@ -559,25 +566,42 @@ def flash_attention(q, k, v, key_mask=None, causal=False, scale=None,
 # Dispatcher
 # ---------------------------------------------------------------------------
 
+# Measured crossover (committed bench_attention.py, v5e, B4/H8/D64 bf16
+# causal, N=20 queue-timed + value-forced sync — two confirming runs):
+#   T=2048: blockwise 4.4-7.7ms fwd / 6.3-6.9ms fwd+bwd vs flash
+#           7.0-8.5 / 7.1-8.3 — blockwise wins or ties both modes;
+#   T=4096: flash 7.1-7.6ms fwd / 10.2-12.0ms fwd+bwd vs blockwise
+#           8.4 / 32.5-36.2 — flash wins both modes;
+#   T=8192: flash 11.6 / 28.8 vs blockwise 42.2 / 178.4 — no contest,
+#           and blockwise fwd+bwd cannot compile at all by T=16384 (the
+#           scan saves one O(B*H*T*D) residual per key block > HBM).
+# The crossover is the same for training and inference, so `train` does
+# not change the choice today; it stays in the signature because the
+# layers pass their mode and a future re-measurement may split the rule
+# again (the round-2 dispatcher was wrong precisely because fwd-only was
+# never measured separately).
+_FLASH_MIN_T = 4096
+
+
 def dot_product_attention(q, k, v, key_mask=None, causal=False, scale=None,
-                          impl: str = "auto"):
-    """Pick the right tier. Measured on the v5e chip (B4/H8/D64, bf16,
-    causal; BASELINE.md round-2 table): the round-2 Pallas kernel is the
-    fastest trainable path at long T — T=4096 fwd+bwd 34ms vs blockwise
-    52ms, and T=16384 fwd 39ms vs 213ms (5.5x) where blockwise's backward
-    cannot even compile (the scan carries one O(B*H*T*D) residual per key
-    block: 17.5GB > HBM). ``auto`` therefore picks: full materialization
-    for short sequences (the whole problem fits one fused kernel), the
-    Pallas flash kernel on TPU beyond that, and the blockwise scan
-    everywhere the kernel can't run (non-TPU backends, exotic head dims)."""
+                          impl: str = "auto", train: bool = True):
+    """Pick the right tier, from measurement (regenerate with
+    ``python bench_attention.py`` on-chip; the table above and
+    BASELINE.md's copy come from that script): full materialization for
+    short sequences (one fused kernel), the XLA blockwise scan in the
+    moderate band, the Pallas flash kernel from T=4096 up — and blockwise
+    everywhere the kernel can't run (non-TPU backends, exotic head
+    dims)."""
     d = q.shape[-1]
     flash_ok = (jax.default_backend() == "tpu"
                 and (d <= _LANES or d % _LANES == 0))
     if impl == "auto":
         if q.shape[2] <= 1024:
             impl = "reference"
+        elif flash_ok and q.shape[2] >= _FLASH_MIN_T:
+            impl = "flash"
         else:
-            impl = "flash" if flash_ok else "blockwise"
+            impl = "blockwise"
     if impl == "flash":
         return flash_attention(q, k, v, key_mask, causal, scale)
     if impl == "blockwise":

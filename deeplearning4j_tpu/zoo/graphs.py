@@ -844,9 +844,10 @@ class TransformerEncoder(GraphZooModel):
     ``multiHeadDotProductAttention`` / TF import, SURVEY.md §5.7; this makes
     the same architecture a first-class graph config). Learned positional
     embeddings, then pre-LN blocks: x + MHA(LN(x)), x + FFN(LN(x)). The
-    attention core goes through ``ops.dot_product_attention`` (``auto`` =
-    XLA blockwise for long sequences; ``attention_impl='flash'`` selects
-    the strictly-O(T)-VMEM Pallas kernel)."""
+    attention core goes through ``ops.dot_product_attention`` (``auto``
+    dispatches by measured crossover — bench_attention.py — to full
+    materialization, XLA blockwise, or the Pallas flash kernel;
+    ``attention_impl='flash'`` forces the strictly-O(T)-VMEM kernel)."""
 
     def __init__(self, num_classes: int = 2, vocab_size: int = 0,
                  embed_dim: int = 64, n_heads: int = 4, n_layers: int = 2,
