@@ -109,6 +109,28 @@ class LayerVertex(GraphVertex):
         return self.layer.forward(params, state, x, train=train, rng=rng,
                                   **kw)
 
+    # recurrent carry pass-through (tBPTT / stateful inference): a
+    # LayerVertex is carry-bearing iff its wrapped layer is — the graph
+    # runtime threads {vertex name: carry} across tBPTT segments exactly
+    # as MultiLayerNetwork threads {layer idx: carry} (reference:
+    # ComputationGraph#rnnUpdateStateWithTBPTTState)
+    @property
+    def has_carry(self) -> bool:
+        return getattr(self.layer, "has_carry", False)
+
+    def zero_carry(self, batch: int, dtype=jnp.float32):
+        return self.layer.zero_carry(batch, dtype)
+
+    def forward_with_carry(self, params, carry, inputs, train=False,
+                           rng=None, mask=None):
+        x = inputs[0]
+        if self.preprocessor is not None:
+            x, _ = self.preprocessor.forward({}, {}, x, train=train, rng=None)
+        kw = ({"mask": mask} if mask is not None
+              and getattr(self.layer, "uses_mask", False) else {})
+        return self.layer.forward_with_carry(params, carry, x, train=train,
+                                             rng=rng, **kw)
+
     # score hook when wrapping an output layer (reference: output vertices
     # must be LayerVertex over an IOutputLayer)
     def score(self, params, x, labels, mask=None):
@@ -146,6 +168,11 @@ class AttentionVertex(GraphVertex):
 
     def _head_size(self, nq):
         return self.head_size or (self.n_out // self.n_heads)
+
+    def streaming_safe(self) -> bool:
+        # attention needs the WHOLE sequence; per-segment rnn_time_step
+        # calls would attend only within each call's window
+        return False
 
     def output_type(self, input_types):
         tq = input_types[0]
@@ -200,7 +227,7 @@ class AttentionVertex(GraphVertex):
         o = dot_product_attention(
             _split_heads(q, self.n_heads), _split_heads(k, self.n_heads),
             _split_heads(v, self.n_heads), key_mask=mask,
-            impl=self.attention_impl)
+            impl=self.attention_impl, train=train)
         y = _merge_heads(o)
         if self.project_input:
             y = y @ params["Wo"] + params["bo"]

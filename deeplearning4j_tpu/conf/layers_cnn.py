@@ -174,6 +174,29 @@ class Convolution1DLayer(ConvolutionLayer):
             y = y + params["b"]
         return self.activation.apply(y), state
 
+    def streaming_safe(self) -> bool:
+        """Streaming (``rnn_time_step``) slices the sequence at arbitrary
+        boundaries; a conv window spanning a boundary would silently see
+        zeros instead of the previous segment's steps. Only a pointwise
+        UNPADDED conv is exact (explicit time padding would inject
+        synthetic steps per call)."""
+        return (self.kernel == 1 and self.stride1d == 1
+                and (self.convolution_mode is ConvolutionMode.SAME
+                     or self.padding1d == 0))
+
+    def resize_mask(self, mask):
+        """Downsample a [batch, time] mask through this layer's time
+        geometry (reference ``feedForwardMaskArray``): an output step is
+        valid iff ANY input step in its receptive field is — max-pooling
+        the mask with the conv's kernel/stride/padding. Zero padding
+        contributes 0 (invalid)."""
+        if self.convolution_mode is ConvolutionMode.SAME:
+            pad = "SAME"
+        else:
+            pad = [(0, 0), (self.padding1d, self.padding1d)]
+        return lax.reduce_window(mask, 0.0, lax.max, (1, self.kernel),
+                                 (1, self.stride1d), pad)
+
 
 @serde.register
 @dataclasses.dataclass

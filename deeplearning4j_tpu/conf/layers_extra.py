@@ -169,6 +169,10 @@ class Subsampling3DLayer(Layer):
 class Subsampling1DLayer(Layer):
     """Reference ``Subsampling1DLayer`` over [batch, time, channels]."""
 
+    def streaming_safe(self) -> bool:
+        # windows/offsets span rnn_time_step call boundaries -> inexact
+        return False
+
     pooling_type: PoolingType = PoolingType.MAX
     kernel_size: int = 2
     stride: int = 2
@@ -190,11 +194,23 @@ class Subsampling1DLayer(Layer):
                else "VALID")
         return _pool(x, self.pooling_type, k, s, pad, self.pnorm), state
 
+    def resize_mask(self, mask):
+        """[batch, time] mask through the pooling time geometry (reference
+        ``feedForwardMaskArray``: masks are max-pooled)."""
+        pad = ("SAME" if self.convolution_mode is ConvolutionMode.SAME
+               else "VALID")
+        return lax.reduce_window(mask, 0.0, lax.max, (1, self.kernel_size),
+                                 (1, self.stride), pad)
+
 
 @serde.register
 @dataclasses.dataclass
 class Upsampling1D(Layer):
     """Reference ``Upsampling1D``: repeat along time."""
+
+    def streaming_safe(self) -> bool:
+        # windows/offsets span rnn_time_step call boundaries -> inexact
+        return False
 
     size: int = 2
 
@@ -205,6 +221,9 @@ class Upsampling1D(Layer):
 
     def forward(self, params, state, x, train=False, rng=None):
         return jnp.repeat(x, self.size, axis=1), state
+
+    def resize_mask(self, mask):
+        return jnp.repeat(mask, self.size, axis=1)
 
 
 @serde.register
@@ -232,6 +251,10 @@ class Upsampling3D(Layer):
 class Cropping1D(Layer):
     """Reference ``Cropping1D``: crop [top, bottom] timesteps."""
 
+    def streaming_safe(self) -> bool:
+        # windows/offsets span rnn_time_step call boundaries -> inexact
+        return False
+
     cropping: Tuple[int, int] = (0, 0)
 
     def output_type(self, input_type):
@@ -243,6 +266,10 @@ class Cropping1D(Layer):
     def forward(self, params, state, x, train=False, rng=None):
         a, b = _pair(self.cropping)
         return x[:, a:x.shape[1] - b, :], state
+
+    def resize_mask(self, mask):
+        a, b = _pair(self.cropping)
+        return mask[:, a:mask.shape[1] - b]
 
 
 @serde.register
@@ -271,6 +298,10 @@ class Cropping3D(Layer):
 class ZeroPadding1DLayer(Layer):
     """Reference ``ZeroPadding1DLayer``."""
 
+    def streaming_safe(self) -> bool:
+        # windows/offsets span rnn_time_step call boundaries -> inexact
+        return False
+
     padding: Tuple[int, int] = (0, 0)
 
     def output_type(self, input_type):
@@ -282,6 +313,11 @@ class ZeroPadding1DLayer(Layer):
     def forward(self, params, state, x, train=False, rng=None):
         a, b = _pair(self.padding)
         return jnp.pad(x, ((0, 0), (a, b), (0, 0))), state
+
+    def resize_mask(self, mask):
+        # padded timesteps are synthetic -> invalid (0) in the mask
+        a, b = _pair(self.padding)
+        return jnp.pad(mask, ((0, 0), (a, b)))
 
 
 @serde.register
@@ -412,6 +448,10 @@ class LocallyConnected2D(BaseLayer):
 @dataclasses.dataclass
 class LocallyConnected1D(BaseLayer):
     """Reference ``LocallyConnected1D`` over [batch, time, channels]."""
+
+    def streaming_safe(self) -> bool:
+        # per-position kernels window the time axis across call boundaries
+        return False
 
     n_out: int = 0
     kernel_size: int = 3

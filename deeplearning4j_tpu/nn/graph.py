@@ -56,8 +56,11 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         self._score_dev = None
         self._score_cache: Optional[float] = float("nan")
         self._train_step = None
+        self._tbptt_scan = None
         self._output_fn = None
         self._score_fn = None
+        self._rnn_step_fn = None
+        self._rnn_carries = None
         self._dtype = jnp.dtype(conf.dtype)
         # mixed precision: forward/backward in compute_dtype (bf16), params/
         # opt-state/BN-stats/loss in dtype (f32 masters) — see the conf field
@@ -66,29 +69,10 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         self._base_key = jax.random.PRNGKey(conf.seed)
         self._topo = conf.topo_order()
         self._vmap = conf.vertex_map()
-        # feature-mask propagation (reference: ComputationGraph
-        # feedForwardMaskArrays): a per-timestep mask follows a vertex's
-        # output only while it stays sequence-shaped — a vertex whose
-        # output leaves Recurrent (pooling over time, LastTimeStep,
-        # flatten) terminates it
-        from deeplearning4j_tpu.conf import inputs as _it
-
-        types = conf.vertex_output_types()
-        in_types = {n: [types[s] for s in self._vmap[n].inputs]
-                    for n in self._topo}
-
-        def _stops(name):
-            out = types[name]
-            if not isinstance(out, _it.Recurrent):
-                return True
-            # time-RESIZING vertices (strided Conv1D, 1D pooling/crop/
-            # upsample) would hand a wrong-length mask downstream — the
-            # reference resizes masks per vertex; here the mask terminates
-            ins = [t for t in in_types[name]
-                   if isinstance(t, _it.Recurrent)]
-            return any(t.timesteps != out.timesteps for t in ins)
-
-        self._mask_stops = {name: _stops(name) for name in self._topo}
+        # feature-mask propagation: see nn_io.propagate_mask (reference
+        # ComputationGraph feedForwardMaskArrays) — decided per vertex from
+        # TRACED output shapes in _forward, so variable-length configs
+        # (unknown conf timesteps) keep/resize/terminate correctly too
 
     # --- lifecycle ---------------------------------------------------------
     def init(self) -> "ComputationGraph":
@@ -125,19 +109,22 @@ class ComputationGraph(nn_io.LazyScoreMixin):
 
     # --- functional core ---------------------------------------------------
     def _forward(self, params, state, inputs: Sequence, train: bool, rng,
-                 skip=frozenset(), fmasks=None):
+                 skip=frozenset(), fmasks=None, carries=None):
         """Pure DAG forward. ``inputs`` aligned with conf.network_inputs.
-        Returns (activations dict incl. every vertex, new_state). ``skip``:
-        vertex names left unevaluated (the loss path skips output vertices —
-        their fused activation+loss is computed by score()). ``fmasks``:
-        per-input [batch, time] feature masks (or None), propagated along
-        sequence-shaped paths and handed to mask-consuming layers
-        (reference ``feedForwardMaskArrays``)."""
+        Returns (activations dict incl. every vertex, new_state,
+        new_carries). ``skip``: vertex names left unevaluated (the loss path
+        skips output vertices — their fused activation+loss is computed by
+        score()). ``fmasks``: per-input [batch, time] feature masks (or
+        None), propagated along sequence-shaped paths and handed to
+        mask-consuming layers (reference ``feedForwardMaskArrays``).
+        ``carries``: {vertex name: carry} recurrent state threaded across
+        tBPTT segments (reference ``rnnUpdateStateWithTBPTTState``);
+        None = every RNN vertex starts from its zero carry."""
         acts: Dict[str, object] = dict(zip(self.conf.network_inputs, inputs))
         masks: Dict[str, object] = {}
         if fmasks is not None:
             masks.update(zip(self.conf.network_inputs, fmasks))
-        new_state = {}
+        new_state, new_carries = {}, {}
         for i, name in enumerate(self._topo):
             if name in skip:
                 continue
@@ -156,13 +143,23 @@ class ComputationGraph(nn_io.LazyScoreMixin):
             vrng = jax.random.fold_in(rng, i) if rng is not None else None
             kw = ({"mask": mask} if mask is not None
                   and isinstance(spec.vertex, LayerVertex) else {})
-            y, s2 = spec.vertex.forward(p, s, xs, train=train, rng=vrng,
-                                        **kw)
+            if carries is not None and getattr(spec.vertex, "has_carry",
+                                               False):
+                c = carries.get(name)
+                if c is None:
+                    c = spec.vertex.zero_carry(xs[0].shape[0], xs[0].dtype)
+                y, c2 = spec.vertex.forward_with_carry(
+                    p, c, xs, train=train, rng=vrng, **kw)
+                new_carries[name] = c2
+                s2 = s
+            else:
+                y, s2 = spec.vertex.forward(p, s, xs, train=train, rng=vrng,
+                                            **kw)
             acts[name] = y
-            masks[name] = None if self._mask_stops[name] else mask
+            masks[name] = nn_io.propagate_mask(mask, y, spec.vertex)
             if name in state:
                 new_state[name] = s2
-        return acts, new_state
+        return acts, new_state, new_carries
 
     def _output_specs(self):
         specs = self.conf.output_vertices()
@@ -189,14 +186,18 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         return cast, nn_io.cast_floats(tuple(features), self._cdtype)
 
     def _loss(self, params, state, features: Sequence, labels: Sequence,
-              fmasks: Sequence, lmasks: Sequence, rng, train=True):
+              fmasks: Sequence, lmasks: Sequence, rng, train=True,
+              carries=None):
         features = tuple(self._dequant(f, i)
                          for i, f in enumerate(features))
         out_specs = self._output_specs()
         fwd_params, features = self._fwd_cast(params, features)
-        acts, new_state = self._forward(fwd_params, state, features, train,
-                                        rng, skip={s.name for s in out_specs},
-                                        fmasks=fmasks)
+        if self._cdtype is not None and carries is not None:
+            carries = nn_io.cast_floats(carries, self._cdtype)
+        acts, new_state, new_carries = self._forward(
+            fwd_params, state, features, train, rng,
+            skip={s.name for s in out_specs}, fmasks=fmasks,
+            carries=carries)
         loss = 0.0
         for i, spec in enumerate(out_specs):
             # output-vertex activation + loss in the storage dtype on the
@@ -205,7 +206,7 @@ class ComputationGraph(nn_io.LazyScoreMixin):
             loss = loss + spec.vertex.score(params.get(spec.name, {}), x,
                                             labels[i], lmasks[i])
         loss = loss + self._regularization_score(params)
-        return loss, new_state
+        return loss, (new_state, new_carries)
 
     def _regularization_score(self, params):
         total = 0.0
@@ -224,12 +225,12 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         """Raw (unjitted) pure train step for parallel wrappers (stage-7)."""
 
         def step(params, state, opt_state, features, labels, fmasks,
-                 lmasks, it, ep, rng):
+                 lmasks, it, ep, rng, carries=None):
             def loss_fn(p):
                 return self._loss(p, state, features, labels, fmasks,
-                                  lmasks, rng)
+                                  lmasks, rng, carries=carries)
 
-            (loss, new_state), grads = jax.value_and_grad(
+            (loss, (new_state, new_carries)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
             new_params, new_opt = {}, {}
             for k in params:
@@ -240,23 +241,34 @@ class ComputationGraph(nn_io.LazyScoreMixin):
                 g = solver.normalize_layer_gradients(layer_conf, grads[k])
                 new_params[k], new_opt[k] = solver.apply_updater_to_layer(
                     layer_conf, upd, params[k], g, opt_state[k], lr, it, ep)
-            return new_params, new_state, new_opt, loss
+            if carries is None:
+                return new_params, new_state, new_opt, loss
+            # tBPTT: the next segment resumes from this segment's final RNN
+            # state, detached (gradients do not flow across segments —
+            # reference BackpropType.TruncatedBPTT semantics)
+            new_carries = jax.lax.stop_gradient(new_carries)
+            return new_params, new_state, new_opt, loss, new_carries
 
         return step
 
     def grad_fn(self):
         """Backward only, updater NOT applied: (params, state, features,
         labels, fmasks, lmasks, rng) -> (loss, new_state, grads).
-        ParallelWrapper's gradient-exchange hook point (SURVEY.md §3.4)."""
+        ParallelWrapper's gradient-exchange hook point (SURVEY.md §3.4).
+        With ``carries`` (a tBPTT segment) the return gains detached
+        ``new_carries``."""
 
-        def gfn(params, state, features, labels, fmasks, lmasks, rng):
+        def gfn(params, state, features, labels, fmasks, lmasks, rng,
+                carries=None):
             def loss_fn(p):
                 return self._loss(p, state, features, labels, fmasks,
-                                  lmasks, rng)
+                                  lmasks, rng, carries=carries)
 
-            (loss, new_state), grads = jax.value_and_grad(
+            (loss, (new_state, new_carries)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            return loss, new_state, grads
+            if carries is None:
+                return loss, new_state, grads
+            return loss, new_state, grads, jax.lax.stop_gradient(new_carries)
 
         return gfn
 
@@ -375,19 +387,25 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         MultiLayerNetwork._fit_batch_async)."""
         from deeplearning4j_tpu.conf.multilayer import BackpropType
 
-        if self.conf.backprop_type is BackpropType.TRUNCATED_BPTT:
-            # silently training STANDARD against a tBPTT config would be
-            # worse than refusing: the graph runtime does not thread RNN
-            # carries across segments (DEVIATION from the reference's
-            # ComputationGraph tBPTT; MultiLayerNetwork has the full
-            # compiled segment-scan implementation). Inference/serde of
-            # such configs still works — only training refuses.
-            raise NotImplementedError(
-                "ComputationGraph does not implement truncated BPTT "
-                "training; use MultiLayerNetwork for tBPTT or STANDARD "
-                "backprop for graph models")
         if self.params is None:
             self.init()
+        if self.conf.backprop_type is BackpropType.TRUNCATED_BPTT:
+            ndims = [np.ndim(f) for f in _as_multi(ds).features]
+            if all(d == 3 for d in ndims):
+                # one normalization path shared with ParallelWrapper
+                return self._fit_tbptt(*self.tbptt_batch_arrays(ds))
+            if any(d == 3 for d in ndims):
+                # a MIXED seq/static batch must not silently train
+                # STANDARD against a tBPTT config (ParallelWrapper raises
+                # for the same model; fit must not diverge from it)
+                raise ValueError(
+                    "ComputationGraph truncated BPTT requires every "
+                    "network input to be a sequence [batch, time, size]; "
+                    f"got feature ranks {ndims}. Use STANDARD backprop "
+                    "for mixed sequence/static inputs")
+            # no sequence inputs at all: plain static batch under a tBPTT
+            # conf trains via the standard step (MultiLayerNetwork's
+            # behavior for 2-D features)
         if self._train_step is None:
             raw = self.train_step_fn()
             dtype = self._dtype
@@ -423,6 +441,355 @@ class ComputationGraph(nn_io.LazyScoreMixin):
             lst.iteration_done(self, cur, self.epoch, loss)
         return loss
 
+    # --- truncated BPTT (reference ComputationGraph#doTruncatedBPTT) -------
+    def _tbptt_prepad(self, ds):
+        """Variable-length host batches: pad T to a multiple of
+        tbptt_fwd_length in NUMPY (free) so the scan jit's cache key
+        quantizes to the segment count instead of retracing per distinct T
+        (same scheme as MultiLayerNetwork._tbptt_prepad, generalized to
+        MultiDataSet). Padded steps get zero masks; with back < fwd the
+        padding goes BEFORE the tail segment's real steps so they stay
+        inside the gradient window. Returns a MultiDataSet (a new one when
+        padding applies — the caller's arrays are never mutated)."""
+        mds = _as_multi(ds)
+        fs = list(mds.features)
+        if not all(isinstance(f, np.ndarray) and f.ndim == 3 for f in fs):
+            return mds
+        seg = int(self.conf.tbptt_fwd_length)
+        t = fs[0].shape[1]
+        pad = (-t) % seg
+        back = min(int(self.conf.tbptt_back_length or seg), seg)
+        # reuse the padded (or wrapped) copy across epochs (write_back
+        # migrates ITS arrays to device on first fit). Keyed on the
+        # IDENTITY of every array consumed — replacing any invalidates.
+        key = (tuple(fs), tuple(mds.labels),
+               tuple(mds.features_masks or ()),
+               tuple(mds.labels_masks or ()), seg, back)
+        cached = getattr(ds, "_tbptt_padded", None)
+        if cached is not None and len(cached[0]) == len(key) and all(
+                (a is b if not isinstance(a, tuple)
+                 else len(a) == len(b) and all(x is y for x, y in zip(a, b)))
+                for a, b in zip(cached[0], key)):
+            return cached[1]
+        if pad == 0:
+            # no padding needed — but still cache the MultiDataSet wrapper
+            # (a DataSet input gets a FRESH wrapper per _as_multi call, and
+            # the device write-back would be lost every epoch otherwise)
+            if ds is not mds:
+                try:
+                    ds._tbptt_padded = (key, mds)
+                except AttributeError:
+                    pass
+            return mds
+        n = fs[0].shape[0]
+        split = t - (t % seg) if back < seg else t
+
+        def pad_t(a):
+            a = np.asarray(a)
+            z = np.zeros((n, pad) + a.shape[2:], a.dtype)
+            return np.concatenate([a[:, :split], z, a[:, split:]], axis=1)
+
+        in_masks = (list(mds.features_masks)
+                    if mds.features_masks is not None else [None] * len(fs))
+        fmasks = [pad_t(m if m is not None else np.ones((n, t), self._dtype))
+                  for m in in_masks]
+        out_masks = (list(mds.labels_masks)
+                     if mds.labels_masks is not None
+                     else [None] * len(mds.labels))
+        lmasks = []
+        for m in out_masks:
+            if m is not None and np.ndim(m) == 1:  # per-example -> per-step
+                m = np.asarray(m)[:, None] * np.ones((n, t), self._dtype)
+            lmasks.append(pad_t(m if m is not None
+                                else np.ones((n, t), self._dtype)))
+        labels = [pad_t(l) if np.ndim(l) == 3 else l for l in mds.labels]
+        padded = MultiDataSet(features=[pad_t(f) for f in fs], labels=labels,
+                              features_masks=fmasks, labels_masks=lmasks)
+        try:
+            ds._tbptt_padded = (key, padded)
+        except AttributeError:
+            pass  # exotic immutable containers just re-pad
+        return padded
+
+    def tbptt_scan_parts(self, seg: int, back: Optional[int] = None):
+        """Shared tBPTT scan plumbing for the DAG — ``(segments,
+        zero_carries, advance, cut)`` — the vertex-topology generalization
+        of ``MultiLayerNetwork.tbptt_scan_parts`` (same contract, so
+        ParallelWrapper's scans work for both model types):
+
+        - ``segments(group)``: tree-maps [B, T, ...] -> [n_seg, B, seg,
+          ...] over a tuple of per-input (or per-output) arrays in-trace.
+        - ``zero_carries(features)``: per-RNN-vertex zero carries keyed by
+          vertex name, vma-anchored to the batch for shard_map.
+        - ``advance(params, state, carries, f, l, fm, lm)``: consume each
+          segment's no-grad head (``cut`` steps, inference mode through
+          the DAG minus output vertices) and return the trimmed gradient
+          window + advanced carries."""
+        back = seg if back is None else min(int(back), seg)
+        cut = seg - back
+        out_names = set(self.conf.network_outputs)
+        cdt = self._cdtype or self._dtype
+
+        def _seg_one(arr):
+            # INSIDE the jit: static shapes, zero extra dispatches. n_seg
+            # derives from the traced shape (a different T retraces with
+            # its own count).
+            arr = jnp.asarray(arr)
+            t = arr.shape[1]
+            ns = -(-t // seg)
+            pad = ns * seg - t
+            if pad and cut:
+                z = jnp.zeros(arr.shape[:1] + (pad,) + arr.shape[2:],
+                              arr.dtype)
+                arr = jnp.concatenate(
+                    [arr[:, :t - (t % seg)], z, arr[:, t - (t % seg):]],
+                    axis=1)
+            elif pad:
+                width = [(0, 0), (0, pad)] + [(0, 0)] * (arr.ndim - 2)
+                arr = jnp.pad(arr, width)
+            shaped = arr.reshape(arr.shape[0], ns, seg, *arr.shape[2:])
+            return jnp.moveaxis(shaped, 1, 0)
+
+        def segments(group):
+            return jax.tree_util.tree_map(_seg_one, group)
+
+        def zero_carries(features):
+            # anchor to the features: under shard_map the batch is varied
+            # over the mesh axis and a bare jnp.zeros is not — lax.scan
+            # would reject the carry (vma mismatch). Free under plain jit.
+            f0 = jax.tree_util.tree_leaves(features)[0]
+            anchor = jnp.sum(f0[:1, :1]) * 0
+            carries = {
+                name: self._vmap[name].vertex.zero_carry(f0.shape[0], cdt)
+                for name in self._topo
+                if getattr(self._vmap[name].vertex, "has_carry", False)}
+            return jax.tree_util.tree_map(
+                lambda z: z + anchor.astype(z.dtype), carries)
+
+        def advance(params, state, carries, f_s, l_s, fm_s, lm_s):
+            if cut:
+                # state-advance over the head of the segment: no gradient
+                # reaches these timesteps (reference truncates the
+                # backward pass at back_length); output vertices skipped
+                f_c = tuple(self._dequant(f[:, :cut], i)
+                            for i, f in enumerate(f_s))
+                fm_c = tuple(m[:, :cut] for m in fm_s)
+                fwd_p, f_c = self._fwd_cast(params, f_c)
+                _, _, carries = self._forward(
+                    fwd_p, state, f_c, train=False, rng=None,
+                    skip=out_names, fmasks=fm_c, carries=carries)
+                f_s, l_s, fm_s, lm_s = jax.tree_util.tree_map(
+                    lambda a: a[:, cut:], (f_s, l_s, fm_s, lm_s))
+            return f_s, l_s, fm_s, lm_s, carries
+
+        return segments, zero_carries, advance, cut
+
+    def tbptt_scan_fn(self, seg: int, back: Optional[int] = None):
+        """The raw (unjitted) whole-batch tBPTT runner for the DAG —
+        ``(params, state, opt, features, labels, fmasks, lmasks, itc, ep,
+        base_key) -> (params, state, opt, new_itc, mean_loss)`` with tuple
+        batch groups — segment scan with detached carries, same contract
+        as ``MultiLayerNetwork.tbptt_scan_fn`` so ParallelWrapper jits it
+        over a mesh unchanged."""
+        raw = self.train_step_fn()
+        segments, zero_carries, advance, _ = self.tbptt_scan_parts(seg,
+                                                                   back)
+
+        def run(params, state, opt, features, labels, fmasks, lmasks,
+                itc, ep, base_key):
+            segs = tuple(segments(g)
+                         for g in (features, labels, fmasks, lmasks))
+            carries = zero_carries(features)
+
+            def body(carry, xs):
+                params, state, opt, carries, itc = carry
+                f_s, l_s, fm_s, lm_s = xs
+                f_s, l_s, fm_s, lm_s, carries = advance(
+                    params, state, carries, f_s, l_s, fm_s, lm_s)
+                it, rng = nn_io.step_scalars(itc, base_key)
+                params, state, opt, loss, carries = raw(
+                    params, state, opt, f_s, l_s, fm_s, lm_s, it, ep,
+                    rng, carries)
+                return (params, state, opt, carries, itc + 1), loss
+
+            (params, state, opt, carries, itc), losses = jax.lax.scan(
+                body, (params, state, opt, carries, itc), segs)
+            return params, state, opt, itc, jnp.mean(losses)
+
+        return run
+
+    def tbptt_batch_arrays(self, ds):
+        """Stage one tBPTT batch fully normalized for ``tbptt_scan_fn``:
+        prepadded time axis, every input a sequence sharing one T,
+        per-timestep labels validated, all-ones default masks, 1-D labels
+        masks expanded per-timestep. ParallelWrapper feeds the sharded
+        scan runner these exact arrays."""
+        def _check_layer(layer, name):
+            while layer is not None:
+                if getattr(layer, "go_backwards", False):
+                    raise RuntimeError(
+                        f"vertex {name!r}: go_backwards RNNs cannot train "
+                        "with truncated BPTT (carries thread forward in "
+                        "time); use STANDARD backprop")
+                layer = getattr(layer, "layer", None)
+
+        for name in self._topo:
+            _check_layer(getattr(self._vmap[name].vertex, "layer", None),
+                         name)
+        mds = self._tbptt_prepad(ds)
+        features, labels, fmasks, lmasks = self._prep_batch(
+            mds, lazy_lmasks=True, write_back=True)
+        if any(np.ndim(f) != 3 for f in features):
+            raise ValueError(
+                "ComputationGraph truncated BPTT requires every network "
+                "input to be a sequence [batch, time, size]; got shapes "
+                f"{[tuple(np.shape(f)) for f in features]}")
+        ts = {int(f.shape[1]) for f in features}
+        if len(ts) != 1:
+            raise ValueError(
+                f"tBPTT inputs must share one time length, got {sorted(ts)}")
+        total_t = ts.pop()
+        n = int(features[0].shape[0])
+        for i, l in enumerate(labels):
+            if np.ndim(l) != 3 or int(l.shape[1]) != total_t:
+                raise ValueError(
+                    f"truncated BPTT needs per-timestep labels [batch, "
+                    f"{total_t}, nOut] for output {i}, got shape "
+                    f"{tuple(np.shape(l))} (reference tBPTT operates on "
+                    "sequence labels)")
+        fmasks = tuple(m if m is not None
+                       else np.ones((n, total_t), self._dtype)
+                       for m in fmasks)
+        norm_lmasks = []
+        for m in lmasks:
+            if m is None:
+                m = np.ones((n, total_t), self._dtype)
+            elif np.ndim(m) == 1:  # per-example -> per-step
+                ones_t = (np.ones if isinstance(m, np.ndarray)
+                          else jnp.ones)((n, total_t), self._dtype)
+                m = m[:, None] * ones_t
+            norm_lmasks.append(m)
+        return features, labels, fmasks, tuple(norm_lmasks)
+
+    def _fit_tbptt(self, features, labels, fmasks, lmasks):
+        """Truncated BPTT over the DAG: one parameter update per
+        tbptt_fwd_length segment, RNN-vertex carries threaded (detached)
+        between segments, back<fwd no-grad head — the WHOLE chain one
+        compiled ``lax.scan`` (the DAG equivalent of
+        ``MultiLayerNetwork._fit_tbptt``)."""
+        seg = int(self.conf.tbptt_fwd_length)
+        back = min(int(self.conf.tbptt_back_length or seg), seg)
+        n_seg = -(-int(features[0].shape[1]) // seg)
+        # cache keyed by (seg, back): a conf length change between fits
+        # must not silently reuse a closure compiled for old lengths
+        if self._tbptt_scan is None:
+            self._tbptt_scan = {}
+        if (seg, back) not in self._tbptt_scan:
+            self._tbptt_scan[seg, back] = jax.jit(
+                self.tbptt_scan_fn(seg, back), donate_argnums=(0, 1, 2))
+        (self.params, self.state, self.opt_state, new_itc,
+         mean_loss) = self._tbptt_scan[seg, back](
+            self.params, self.state, self.opt_state, features, labels,
+            fmasks, lmasks, self.device_iteration(), self.device_epoch(),
+            self._base_key)
+        self.iteration += n_seg
+        self.advance_device_iteration(new_itc)
+        self._score_dev = mean_loss
+        self._score_cache = None
+        for lst in self.listeners:
+            # one batch-level call, arg = last segment's iteration index
+            lst.iteration_done(self, self.iteration - 1, self.epoch,
+                               mean_loss)
+        return mean_loss  # device scalar: the async fit pipeline queues it
+
+    # --- stateful RNN inference (reference CG#rnnTimeStep) ------------------
+    def rnn_time_step(self, *inputs, fmasks=None):
+        """Streaming inference: feed sequence segments [batch, t, f], get
+        outputs with per-RNN-vertex state persisted across calls
+        (reference ``ComputationGraph#rnnTimeStep``)."""
+        if self.params is None:
+            self.init()
+        for name in self._topo:
+            # checks the VERTEX itself too (AttentionVertex attends over
+            # the whole sequence and has no .layer), then its layer chain
+            nn_io.check_streaming_safe(self._vmap[name].vertex,
+                                       f"vertex {name!r}")
+        if self._rnn_step_fn is None:
+            def out(params, state, carries, xs, fmasks):
+                xs = tuple(self._dequant(x, i) for i, x in enumerate(xs))
+                params, xs = self._fwd_cast(params, xs, full=True)
+                if self._cdtype is not None:
+                    carries = nn_io.cast_floats(carries, self._cdtype)
+                acts, _, new_carries = self._forward(
+                    params, state, xs, train=False, rng=None,
+                    fmasks=fmasks, carries=carries)
+                return (tuple(acts[n].astype(self._dtype)
+                              for n in self.conf.network_outputs),
+                        new_carries)
+
+            self._rnn_step_fn = jax.jit(out)
+        xs = tuple(nn_io.as_device(x, self._dtype, feature=True)
+                   for x in inputs)
+        xs = tuple(x[:, None, :] if x.ndim == 2 else x for x in xs)
+        n = xs[0].shape[0]
+        if self._rnn_carries is None:
+            self._rnn_carries = {
+                name: self._vmap[name].vertex.zero_carry(
+                    n, self._cdtype or self._dtype)
+                for name in self._topo
+                if getattr(self._vmap[name].vertex, "has_carry", False)}
+        fm = tuple(nn_io.as_device(m, self._dtype) if m is not None else None
+                   for m in (fmasks if fmasks is not None
+                             else (None,) * len(xs)))
+        outs, self._rnn_carries = self._rnn_step_fn(
+            self.params, self.state, self._rnn_carries, xs, fm)
+        return outs[0] if len(outs) == 1 else list(outs)
+
+    def rnn_clear_previous_state(self):
+        """Reference ``#rnnClearPreviousState``."""
+        self._rnn_carries = None
+
+    def rnn_get_previous_state(self, vertex_name: str):
+        """Reference ``#rnnGetPreviousState(layerName)``. Returned state is
+        in the storage dtype (internal carries live in the compute dtype)."""
+        if self._rnn_carries is None:
+            return None
+        c = self._rnn_carries.get(vertex_name)
+        if c is None or self._cdtype is None:
+            return c
+        return nn_io.cast_floats(c, self._dtype)
+
+    def rnn_set_previous_state(self, vertex_name: str, state: dict):
+        """Reference ``#rnnSetPreviousState(layerName, state)``."""
+        if self._rnn_carries is None:
+            self._rnn_carries = {}
+        self._rnn_carries[vertex_name] = {
+            k: jnp.asarray(v, self._cdtype or self._dtype)
+            for k, v in state.items()}
+
+    def feed_forward(self, *inputs, fmasks=None) -> Dict[str, object]:
+        """Per-vertex activations, eval mode (reference
+        ``ComputationGraph#feedForward`` returning Map<String, INDArray>).
+        Powers the StatsListener activation histograms."""
+        if self.params is None:
+            self.init()
+        if getattr(self, "_feed_forward_fn", None) is None:
+            def ff(params, state, xs, fmasks):
+                xs = tuple(self._dequant(x, i) for i, x in enumerate(xs))
+                params, xs = self._fwd_cast(params, xs, full=True)
+                acts, _, _ = self._forward(params, state, xs, train=False,
+                                           rng=None, fmasks=fmasks)
+                return {n: acts[n].astype(self._dtype)
+                        for n in self._topo}
+
+            self._feed_forward_fn = jax.jit(ff)
+        xs = tuple(nn_io.as_device(x, self._dtype, feature=True)
+                   for x in inputs)
+        fm = tuple(nn_io.as_device(m, self._dtype) if m is not None else None
+                   for m in (fmasks if fmasks is not None
+                             else (None,) * len(xs)))
+        return dict(self._feed_forward_fn(self.params, self.state, xs, fm))
+
     # --- inference / scoring ----------------------------------------------
     def output(self, *inputs, fmasks=None):
         """Forward pass, eval mode (reference ``#output(INDArray...)``).
@@ -435,8 +802,8 @@ class ComputationGraph(nn_io.LazyScoreMixin):
             def out(params, state, xs, fmasks):
                 xs = tuple(self._dequant(x, i) for i, x in enumerate(xs))
                 params, xs = self._fwd_cast(params, xs, full=True)
-                acts, _ = self._forward(params, state, xs, train=False,
-                                        rng=None, fmasks=fmasks)
+                acts, _, _ = self._forward(params, state, xs, train=False,
+                                           rng=None, fmasks=fmasks)
                 return tuple(acts[n].astype(self._dtype)
                              for n in self.conf.network_outputs)
 

@@ -146,3 +146,63 @@ class LazyScoreMixin:
             self._ep_dev = jnp.asarray(float(self.epoch), jnp.float32)
             self._ep_mirror = self.epoch
         return self._ep_dev
+
+
+def propagate_mask(mask, y, layer_or_vertex):
+    """Thread a [batch, time] feature mask past one layer/vertex whose
+    OUTPUT is ``y`` (reference ``feedForwardMaskArray`` semantics, decided
+    from traced shapes so unknown conf timesteps work): same-T sequence
+    output keeps the mask; a time-RESIZING layer exposing ``resize_mask``
+    (strided Conv1D, 1D pooling/crop/upsample/pad — max-pool semantics)
+    transforms it; losing the sequence shape (pooling over time,
+    LastTimeStep, flatten) or resizing without a resizer terminates it."""
+    if mask is None:
+        return None
+    if getattr(y, "ndim", 0) != 3:
+        return None
+    if y.shape[1] == mask.shape[1]:
+        return mask
+    layer = layer_or_vertex
+    while layer is not None:
+        rm = getattr(layer, "resize_mask", None)
+        if rm is not None:
+            resized = rm(mask)
+            return resized if resized.shape[1] == y.shape[1] else None
+        layer = getattr(layer, "layer", None)
+    return None
+
+
+def check_streaming_safe(layer, label: str):
+    """Shared ``rnn_time_step`` guard: reject layers whose per-segment
+    streaming would silently diverge from the full-sequence forward —
+    Bidirectional / go_backwards (need the whole sequence) and carry-less
+    time-mixing layers (``streaming_safe() is False``: windowed convs/
+    pools/crops/pads, full-sequence attention). Walks wrapper ``.layer``
+    chains."""
+    def contains_bidirectional(l):
+        if type(l).__name__ == "Bidirectional":
+            return True
+        inner = getattr(l, "layer", None)
+        return inner is not None and contains_bidirectional(inner)
+
+    if contains_bidirectional(layer):
+        raise RuntimeError(
+            f"rnn_time_step is unsupported for Bidirectional layers "
+            f"({label}, including wrapped ones): the backward pass needs "
+            "the full sequence (reference throws "
+            "UnsupportedOperationException here)")
+    inner = layer
+    while inner is not None:
+        if getattr(inner, "go_backwards", False):
+            raise RuntimeError(
+                f"rnn_time_step is unsupported for go_backwards RNNs "
+                f"({label}): reversed processing needs the full sequence")
+        safe = getattr(inner, "streaming_safe", None)
+        if safe is not None and not safe():
+            raise RuntimeError(
+                f"rnn_time_step is unsupported for {label} "
+                f"({type(inner).__name__}): it mixes/resizes the time "
+                "axis without recurrent state, so per-segment streaming "
+                "would silently diverge from the full forward at call "
+                "boundaries")
+        inner = getattr(inner, "layer", None)

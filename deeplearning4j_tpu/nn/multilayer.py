@@ -105,7 +105,14 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
                  upto: int = None, carries=None):
         """Pure forward pass over layers [0, upto). Returns (x, new_state,
         new_carries). ``fmask``: per-timestep features mask [batch, time],
-        given only to mask-consuming layers (RNNs, wrappers). ``carries``:
+        given only to mask-consuming layers (RNNs, wrappers) and RESIZED
+        through time-resizing layers (reference ``feedForwardMaskArray``
+        through the stack, round 3 — decided from TRACED shapes, so
+        variable-length configs with unknown conf timesteps resize too):
+        output stays [B, T, ..] with the mask's T -> keep; T changed and
+        the layer exposes ``resize_mask`` (strided Conv1D / 1D pooling /
+        crop / upsample / pad, max-pool semantics) -> resize; sequence
+        shape lost or no resizer -> the mask terminates. ``carries``:
         {layer_idx: carry} recurrent state threaded across tBPTT segments /
         ``rnn_time_step`` calls; None = start every RNN from zeros."""
         n = len(self.conf.layers) if upto is None else upto
@@ -138,6 +145,7 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
                                           **kw)
                 if str(i) in state:
                     new_state[str(i)] = s2
+            fmask = nn_io.propagate_mask(fmask, x, layer)
         return x, new_state, new_carries
 
     def _output_layer(self):
@@ -653,23 +661,8 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         ``MultiLayerNetwork#rnnTimeStep``)."""
         if self.params is None:
             self.init()
-        def contains_bidirectional(layer):
-            if type(layer).__name__ == "Bidirectional":
-                return True
-            inner = getattr(layer, "layer", None)
-            return inner is not None and contains_bidirectional(inner)
-
-        for layer in self.conf.layers:
-            if contains_bidirectional(layer):
-                raise RuntimeError(
-                    "rnn_time_step is unsupported for Bidirectional layers "
-                    "(including wrapped ones): the backward pass needs the "
-                    "full sequence (reference throws "
-                    "UnsupportedOperationException here)")
-            if getattr(layer, "go_backwards", False):
-                raise RuntimeError(
-                    "rnn_time_step is unsupported for go_backwards RNNs: "
-                    "reversed processing needs the full sequence")
+        for i, layer in enumerate(self.conf.layers):
+            nn_io.check_streaming_safe(layer, f"layer {i}")
         if self._rnn_step_fn is None:
             self._rnn_step_fn = self._build_rnn_step_fn()
         x = nn_io.as_device(x, self._dtype, feature=True)
@@ -708,6 +701,38 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         self._rnn_carries[str(layer_idx)] = {
             k: jnp.asarray(v, self._cdtype or self._dtype)
             for k, v in state.items()}
+
+    def feed_forward(self, x, fmask=None):
+        """Per-layer activations, eval mode (reference
+        ``MultiLayerNetwork#feedForward`` returning one activation per
+        layer, input excluded). Powers the StatsListener activation
+        histograms."""
+        if self.params is None:
+            self.init()
+        if getattr(self, "_feed_forward_fn", None) is None:
+            # one pass collecting every layer output (same walk as
+            # _forward, kept inline so each activation is captured)
+            def ff(params, state, x, fmask):
+                params, x, fmask = self._fwd_cast(params, self._dequant(x),
+                                                  fmask, full=True)
+                acts = []
+                for i, layer in enumerate(self.conf.layers):
+                    p = params.get(str(i), {})
+                    s = state.get(str(i), {})
+                    kw = ({"mask": fmask}
+                          if getattr(layer, "uses_mask", False) else {})
+                    x, _ = layer.forward(p, s, x, train=False, rng=None,
+                                         **kw)
+                    fmask = nn_io.propagate_mask(fmask, x, layer)
+                    acts.append(x.astype(self._dtype))
+                return acts
+
+            self._feed_forward_fn = jax.jit(ff)
+        x = nn_io.as_device(x, self._dtype, feature=True)
+        if fmask is not None:
+            fmask = nn_io.as_device(fmask, self._dtype)
+        return list(self._feed_forward_fn(self.params, self.state, x,
+                                          fmask))
 
     # --- inference / scoring ----------------------------------------------
     def output(self, x, batch_size: Optional[int] = None, fmask=None):

@@ -113,8 +113,9 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                 f"mesh data axis = {self.workers}, workers = {workers}")
         from deeplearning4j_tpu.conf.multilayer import BackpropType
 
-        self._tbptt = (not self._is_graph and model.conf.backprop_type
-                       is BackpropType.TRUNCATED_BPTT)
+        # both model types expose the same tbptt_scan_fn/parts/
+        # batch_arrays protocol (ComputationGraph since round 3)
+        self._tbptt = model.conf.backprop_type is BackpropType.TRUNCATED_BPTT
         if self._tbptt:
             seg = int(model.conf.tbptt_fwd_length)
             back = int(model.conf.tbptt_back_length or seg)
@@ -145,10 +146,10 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
     # --- model-type adapters -----------------------------------------------
     def _prep(self, ds):
         """-> tuple of batch arrays matching the model's train-step args."""
-        if self._is_graph:
-            return self.model._prep_batch(ds)
         if self._tbptt:
             return self.model.tbptt_batch_arrays(ds)
+        if self._is_graph:
+            return self.model._prep_batch(ds)
         return self.model._batch_arrays(ds)
 
     def _batch_rows(self, batch) -> int:

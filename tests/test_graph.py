@@ -388,12 +388,11 @@ class TestGraphGradients:
         assert res.passed, res.failures
 
 
-def test_graph_tbptt_training_rejected_but_loadable():
+def test_graph_tbptt_conf_loads_and_nonseq_falls_back_to_standard():
     """A TRUNCATED_BPTT graph config loads and infers (serde must not
-    break on saved models); only training refuses, with a clear error
-    (DEVIATION: graph tBPTT is MultiLayerNetwork-only here)."""
-    import pytest as _pytest
-
+    break on saved models). Round 3: graph tBPTT training is implemented
+    (tests/test_graph_tbptt.py); a NON-sequence batch under a tBPTT conf
+    trains via the standard step, as MultiLayerNetwork does."""
     from deeplearning4j_tpu.conf.multilayer import BackpropType
 
     conf = (NeuralNetConfiguration.builder()
@@ -412,8 +411,8 @@ def test_graph_tbptt_training_rejected_but_loadable():
     net = ComputationGraph(conf).init()  # constructing/inferring is fine
     x = np.zeros((2, 3), np.float32)
     assert np.asarray(net.output(x)).shape == (2, 2)
-    with _pytest.raises(NotImplementedError, match="truncated BPTT"):
-        net.fit_batch(DataSet(x, np.eye(2, dtype=np.float32)[[0, 1]]))
+    loss = net.fit_batch(DataSet(x, np.eye(2, dtype=np.float32)[[0, 1]]))
+    assert np.isfinite(loss) and net.iteration == 1
 
 
 def test_graph_feature_mask_propagation():

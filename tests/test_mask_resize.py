@@ -1,0 +1,210 @@
+"""Feature-mask RESIZING through time-resizing layers (reference
+``feedForwardMaskArray``: Conv1D/Subsampling1D/Upsampling1D/Cropping1D/
+ZeroPadding1D transform the [batch, time] mask through their own time
+geometry instead of terminating it — round-2 verdict item #6)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.conf import Activation, InputType, WeightInit
+from deeplearning4j_tpu.conf.layers_cnn import (
+    Convolution1DLayer,
+    ConvolutionMode,
+    PoolingType,
+)
+from deeplearning4j_tpu.conf.layers_extra import (
+    Cropping1D,
+    Subsampling1DLayer,
+    Upsampling1D,
+    ZeroPadding1DLayer,
+)
+from deeplearning4j_tpu.conf.layers_rnn import LSTM, RnnOutputLayer
+from deeplearning4j_tpu.conf.losses import LossMCXENT
+from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+from deeplearning4j_tpu.conf.updaters import Adam
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+# --------------------------------------------------------------------------
+# resize_mask unit semantics (manual downsampled-mask parity)
+# --------------------------------------------------------------------------
+def test_resize_mask_oracles():
+    m = np.asarray([[1, 1, 1, 1, 0, 0, 0, 0],
+                    [1, 1, 1, 0, 0, 0, 0, 0]], np.float32)
+
+    conv = Convolution1DLayer(n_out=4, kernel=2, stride1d=2,
+                              convolution_mode=ConvolutionMode.TRUNCATE)
+    # windows [0,1] [2,3] [4,5] [6,7]; valid iff ANY input step valid
+    np.testing.assert_array_equal(
+        np.asarray(conv.resize_mask(m)),
+        [[1, 1, 0, 0], [1, 1, 0, 0]])
+
+    pool = Subsampling1DLayer(pooling_type=PoolingType.MAX, kernel_size=2,
+                              stride=2)
+    np.testing.assert_array_equal(
+        np.asarray(pool.resize_mask(m)),
+        [[1, 1, 0, 0], [1, 1, 0, 0]])
+
+    up = Upsampling1D(size=2)
+    np.testing.assert_array_equal(
+        np.asarray(up.resize_mask(m[:, :3])),
+        [[1, 1, 1, 1, 1, 1], [1, 1, 1, 1, 1, 1]])
+
+    crop = Cropping1D(cropping=(1, 2))
+    np.testing.assert_array_equal(
+        np.asarray(crop.resize_mask(m)),
+        [[1, 1, 1, 0, 0], [1, 1, 0, 0, 0]])
+
+    pad = ZeroPadding1DLayer(padding=(1, 1))
+    got = np.asarray(pad.resize_mask(m[:, :3]))
+    np.testing.assert_array_equal(got, [[0, 1, 1, 1, 0], [0, 1, 1, 1, 0]])
+
+
+def test_resize_mask_straddling_window_counts_valid():
+    """A pooling window straddling the valid/invalid boundary stays VALID
+    (max semantics): valid length 3 with k=2/s=2 -> [1, 1]."""
+    pool = Subsampling1DLayer(kernel_size=2, stride=2)
+    m = np.asarray([[1, 1, 1, 0]], np.float32)
+    np.testing.assert_array_equal(np.asarray(pool.resize_mask(m)), [[1, 1]])
+
+
+# --------------------------------------------------------------------------
+# end-to-end: masked strided-conv sequence models keep masking downstream
+# --------------------------------------------------------------------------
+def _mln_conf():
+    return (NeuralNetConfiguration.builder()
+            .seed(7)
+            .updater(Adam(learning_rate=0.01))
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(Convolution1DLayer(
+                n_out=5, kernel=2, stride1d=2, activation=Activation.TANH,
+                convolution_mode=ConvolutionMode.TRUNCATE))
+            .layer(LSTM(n_out=6))
+            .layer(RnnOutputLayer(n_out=2, activation=Activation.SOFTMAX,
+                                  loss_fn=LossMCXENT()))
+            .set_input_type(InputType.recurrent(3, timesteps=8))
+            .build())
+
+
+def _cg_conf():
+    return (NeuralNetConfiguration.builder()
+            .seed(7)
+            .updater(Adam(learning_rate=0.01))
+            .weight_init(WeightInit.XAVIER)
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.recurrent(3, timesteps=8))
+            .add_layer("conv", Convolution1DLayer(
+                n_out=5, kernel=2, stride1d=2, activation=Activation.TANH,
+                convolution_mode=ConvolutionMode.TRUNCATE), "in")
+            .add_layer("lstm", LSTM(n_out=6), "conv")
+            .add_layer("out", RnnOutputLayer(n_out=2,
+                                             activation=Activation.SOFTMAX,
+                                             loss_fn=LossMCXENT()), "lstm")
+            .set_outputs("out")
+            .build())
+
+
+def _masked_batch():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 8, 3)).astype(np.float32)
+    mask = np.ones((2, 8), np.float32)
+    mask[0, 4:] = 0.0          # sample 0: valid length 4 -> conv mask [1,1,0,0]
+    return x, mask
+
+
+@pytest.mark.parametrize("kind", ["mln", "cg"])
+def test_strided_conv_mask_reaches_downstream_rnn(kind):
+    """Perturbing input steps that are masked out (and whose conv windows
+    are FULLY masked) must not change ANY output step: the LSTM after the
+    strided conv must receive the downsampled mask (round 2 terminated it,
+    so the perturbation leaked through the conv into live LSTM state)."""
+    x, mask = _masked_batch()
+    x2 = x.copy()
+    x2[0, 4:] += 3.21          # fully-masked windows [4,5], [6,7]
+
+    if kind == "mln":
+        net = MultiLayerNetwork(_mln_conf()).init()
+        y1 = np.asarray(net.output(x, fmask=mask))
+        y2 = np.asarray(net.output(x2, fmask=mask))
+    else:
+        net = ComputationGraph(_cg_conf()).init()
+        y1 = np.asarray(net.output(x, fmasks=[mask]))
+        y2 = np.asarray(net.output(x2, fmasks=[mask]))
+    np.testing.assert_allclose(y1, y2, atol=1e-6)
+    # the unmasked sample must still see real (non-frozen) dynamics:
+    # perturbing ITS tail changes its outputs
+    x3 = x.copy()
+    x3[1, 4:] += 3.21
+    y3 = (np.asarray(net.output(x3, fmask=mask)) if kind == "mln"
+          else np.asarray(net.output(x3, fmasks=[mask])))
+    assert np.abs(y3[1] - y1[1]).max() > 1e-4
+
+
+def test_mln_masked_strided_conv_trains():
+    """fit() with per-timestep labels through the resized mask chain:
+    labels mask downsampling is the caller's job (labels are already at
+    the conv-output rate), feature masks resize internally."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    x, mask = _masked_batch()
+    rng = np.random.default_rng(1)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (2, 4))]
+    lmask = np.ones((2, 4), np.float32)
+    lmask[0, 2:] = 0.0
+    net = MultiLayerNetwork(_mln_conf()).init()
+    loss = net.fit_batch(DataSet(x, y, features_mask=mask,
+                                 labels_mask=lmask))
+    assert np.isfinite(loss)
+    flat = net.params_flat()
+    assert np.all(np.isfinite(flat))
+
+def test_variable_length_conf_resizes_mask():
+    """Unknown conf timesteps (InputType.recurrent(3), the variable-length
+    case masks exist for) must still resize the mask: the decision is made
+    from TRACED shapes, not static conf types (round-3 review finding)."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).updater(Adam(learning_rate=0.01))
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(Convolution1DLayer(
+                n_out=5, kernel=2, stride1d=2, activation=Activation.TANH,
+                convolution_mode=ConvolutionMode.TRUNCATE))
+            .layer(LSTM(n_out=6))
+            .layer(RnnOutputLayer(n_out=2, activation=Activation.SOFTMAX,
+                                  loss_fn=LossMCXENT()))
+            .set_input_type(InputType.recurrent(3))   # timesteps unknown
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x, mask = _masked_batch()
+    y1 = np.asarray(net.output(x, fmask=mask))        # must not crash
+    # identical semantics to the static-timesteps config
+    static = MultiLayerNetwork(_mln_conf()).init()
+    y2 = np.asarray(static.output(x, fmask=mask))
+    np.testing.assert_allclose(y1, y2, atol=1e-6)
+
+
+def test_attention_vertex_streaming_refused():
+    """AttentionVertex has no wrapped .layer but attends over the whole
+    sequence — rnn_time_step must refuse it (round-3 review finding)."""
+    from deeplearning4j_tpu.conf.graph import AttentionVertex
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater(Adam(learning_rate=0.01))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.recurrent(4, 6))
+            .add_layer("rnn", LSTM(n_out=8), "in")
+            .add_vertex("att", AttentionVertex(n_out=8, n_heads=2),
+                        "rnn", "rnn", "rnn")
+            .add_layer("out", RnnOutputLayer(n_out=2,
+                                             activation=Activation.SOFTMAX,
+                                             loss_fn=LossMCXENT()), "att")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    x = np.random.default_rng(0).normal(size=(2, 6, 4)).astype(np.float32)
+    with pytest.raises(RuntimeError, match="rnn_time_step is unsupported"):
+        net.rnn_time_step(x)
