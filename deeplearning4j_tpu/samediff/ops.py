@@ -1417,6 +1417,1021 @@ class SDBitwise(_Namespace):
         return self._op("bitwise.rightShift", [a, b], name=name)[0]
 
 
+# ======================= round 3: cnn 3d/transposed family =======================
+# Reference: libnd4j declarable ops conv3dnew/deconv2d/deconv3d/sconv2d/
+# maxpool3dnew/avgpool3dnew/pooling1d/upsampling1d-3d/space_to_depth/
+# depth_to_space/space_to_batch/batch_to_space/lrn/im2col/col2im/dilation2d
+# exposed through SDCNN (SURVEY.md §2.1 "Declarable ops library"). Layouts
+# are TPU-native channels-last (NWC / NHWC / NDHWC); XLA retiles for the
+# MXU during compilation.
+
+@register_op("cnn.conv3d")
+def _conv3d(x, w, b, *, strides, padding, dilation):
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
+        rhs_dilation=dilation,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    return out + b
+
+
+@register_op("cnn.deconv2d")
+def _deconv2d(x, w, b, *, strides, padding):
+    out = jax.lax.conv_transpose(
+        x, w, strides=strides, padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return out + b
+
+
+@register_op("cnn.deconv3d")
+def _deconv3d(x, w, b, *, strides, padding):
+    out = jax.lax.conv_transpose(
+        x, w, strides=strides, padding=padding,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    return out + b
+
+
+@register_op("cnn.sconv2d")
+def _sconv2d(x, wd, wp, b, *, strides, padding, mult):
+    """Separable conv (reference sconv2d): depthwise ``wd`` [kh, kw, 1,
+    C*mult] then pointwise ``wp`` [1, 1, C*mult, O]."""
+    c = x.shape[-1]
+    if wd.shape[-1] != c * mult:
+        raise ValueError(
+            f"sconv2d: depthwise weights last dim {wd.shape[-1]} != "
+            f"channels {c} * depth multiplier {mult}")
+    dw = jax.lax.conv_general_dilated(
+        x, wd, window_strides=strides, padding=padding,
+        feature_group_count=c,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    out = jax.lax.conv_general_dilated(
+        dw, wp, window_strides=(1, 1), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return out + b
+
+
+def _pool(x, dims, strd, padding, kind):
+    if kind == "max":
+        return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims, strd,
+                                     padding)
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strd, padding)
+    counts = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add, dims,
+                                   strd, padding)
+    return summed / counts
+
+
+@register_op("cnn.maxPooling1d")
+def _maxpool1d(x, *, k, s, padding):
+    return _pool(x, (1, k, 1), (1, s, 1), padding, "max")
+
+
+@register_op("cnn.avgPooling1d")
+def _avgpool1d(x, *, k, s, padding):
+    return _pool(x, (1, k, 1), (1, s, 1), padding, "avg")
+
+
+@register_op("cnn.maxPooling3d")
+def _maxpool3d(x, *, k, s, padding):
+    return _pool(x, (1, *k, 1), (1, *s, 1), padding, "max")
+
+
+@register_op("cnn.avgPooling3d")
+def _avgpool3d(x, *, k, s, padding):
+    return _pool(x, (1, *k, 1), (1, *s, 1), padding, "avg")
+
+
+@register_op("cnn.upsampling1d")
+def _upsample1d(x, *, scale):
+    return jnp.repeat(x, scale, axis=1)
+
+
+@register_op("cnn.upsampling3d")
+def _upsample3d(x, *, scale):
+    for ax in (1, 2, 3):
+        x = jnp.repeat(x, scale, axis=ax)
+    return x
+
+
+@register_op("cnn.spaceToDepth")
+def _space_to_depth(x, *, block):
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    return jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(
+        n, h // block, w // block, block * block * c)
+
+
+@register_op("cnn.depthToSpace")
+def _depth_to_space(x, *, block):
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, block, block, c // (block * block))
+    return jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(
+        n, h * block, w * block, c // (block * block))
+
+
+@register_op("cnn.spaceToBatch")
+def _space_to_batch(x, *, block, pads):
+    n, h, w, c = x.shape
+    x = jnp.pad(x, ((0, 0), tuple(pads[0]), tuple(pads[1]), (0, 0)))
+    hp, wp = x.shape[1], x.shape[2]
+    x = x.reshape(n, hp // block, block, wp // block, block, c)
+    return jnp.transpose(x, (2, 4, 0, 1, 3, 5)).reshape(
+        n * block * block, hp // block, wp // block, c)
+
+
+@register_op("cnn.batchToSpace")
+def _batch_to_space(x, *, block, crops):
+    nb, h, w, c = x.shape
+    n = nb // (block * block)
+    x = x.reshape(block, block, n, h, w, c)
+    x = jnp.transpose(x, (2, 3, 0, 4, 1, 5)).reshape(
+        n, h * block, w * block, c)
+    (ct, cb), (cl, cr) = crops
+    return x[:, ct:x.shape[1] - cb, cl:x.shape[2] - cr, :]
+
+
+@register_op("cnn.localResponseNormalization")
+def _lrn(x, *, depth, bias, alpha, beta):
+    """TF/cuDNN-style across-channel LRN (reference lrn platform helper):
+    out = x / (bias + alpha * sum_{c-depth..c+depth} x^2) ** beta."""
+    sq = jnp.square(x)
+    win = 2 * depth + 1
+    ssum = jax.lax.reduce_window(
+        sq, 0.0, jax.lax.add, (1, 1, 1, win), (1, 1, 1, 1),
+        [(0, 0), (0, 0), (0, 0), (depth, depth)])
+    return x / jnp.power(bias + alpha * ssum, beta)
+
+
+def _im2col_impl(x, k, s, padding):
+    return jax.lax.conv_general_dilated_patches(
+        x, filter_shape=k, window_strides=s, padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+@register_op("cnn.im2col")
+def _im2col(x, *, k, s, padding):
+    """Patches [N, H', W', C*kh*kw] (channel-major within a patch — the
+    layout ``conv_general_dilated_patches`` produces for NHWC)."""
+    return _im2col_impl(x, k, s, padding)
+
+
+@register_op("cnn.col2im")
+def _col2im(cols, *, shape, k, s, padding):
+    """Exact transpose of im2col (scatter-add of patch columns back into
+    the image) — implemented AS the transpose: the VJP of the im2col
+    primitive, which is precisely col2im's definition."""
+    _, vjp = jax.vjp(lambda x: _im2col_impl(x, k, s, padding),
+                     jnp.zeros(shape, cols.dtype))
+    return vjp(cols)[0]
+
+
+@register_op("cnn.dilation2d")
+def _dilation2d(x, w, *, strides, rates):
+    """Morphological (grayscale) dilation, TF semantics:
+    out[i,j,c] = max_{di,dj} x[i*s + di*r, j*s + dj*r, c] + w[di, dj, c].
+    VALID padding; kernel extents are static so the max unrolls."""
+    kh, kw, _ = w.shape
+    sh, sw = strides
+    rh, rw = rates
+    n, h, wd, c = x.shape
+    oh = (h - (kh - 1) * rh - 1) // sh + 1
+    ow = (wd - (kw - 1) * rw - 1) // sw + 1
+    out = jnp.full((n, oh, ow, c), -jnp.inf, x.dtype)
+    for di in range(kh):
+        for dj in range(kw):
+            patch = jax.lax.slice(
+                x, (0, di * rh, dj * rw, 0),
+                (n, di * rh + (oh - 1) * sh + 1, dj * rw + (ow - 1) * sw + 1,
+                 c), (1, sh, sw, 1))
+            out = jnp.maximum(out, patch + w[di, dj])
+    return out
+
+
+@_def(SDCNN, "conv3d")
+def _sd_conv3d(self, x, w, b=None, strides=(1, 1, 1), padding="SAME",
+               dilation=(1, 1, 1), name=None):
+    if b is None:
+        b = self.sd.constant(jnp.zeros((w.shape[-1],) if w.shape else (1,)))
+    return self._op("cnn.conv3d", [x, w, b], name=name,
+                    strides=tuple(strides), padding=padding,
+                    dilation=tuple(dilation))[0]
+
+
+@_def(SDCNN, "deconv2d")
+def _sd_deconv2d(self, x, w, b=None, strides=(1, 1), padding="SAME",
+                 name=None):
+    if b is None:
+        b = self.sd.constant(jnp.zeros((w.shape[-1],) if w.shape else (1,)))
+    return self._op("cnn.deconv2d", [x, w, b], name=name,
+                    strides=tuple(strides), padding=padding)[0]
+
+
+@_def(SDCNN, "deconv3d")
+def _sd_deconv3d(self, x, w, b=None, strides=(1, 1, 1), padding="SAME",
+                 name=None):
+    if b is None:
+        b = self.sd.constant(jnp.zeros((w.shape[-1],) if w.shape else (1,)))
+    return self._op("cnn.deconv3d", [x, w, b], name=name,
+                    strides=tuple(strides), padding=padding)[0]
+
+
+@_def(SDCNN, "sconv2d")
+def _sd_sconv2d(self, x, wd, wp, b=None, strides=(1, 1), padding="SAME",
+                mult=1, name=None):
+    if b is None:
+        b = self.sd.constant(jnp.zeros((wp.shape[-1],) if wp.shape else (1,)))
+    return self._op("cnn.sconv2d", [x, wd, wp, b], name=name,
+                    strides=tuple(strides), padding=padding,
+                    mult=int(mult))[0]
+
+
+@_def(SDCNN, "maxPooling1d")
+def _sd_maxpool1d(self, x, k=2, s=2, padding="VALID", name=None):
+    return self._op("cnn.maxPooling1d", [x], name=name, k=int(k), s=int(s),
+                    padding=padding)[0]
+
+
+@_def(SDCNN, "avgPooling1d")
+def _sd_avgpool1d(self, x, k=2, s=2, padding="VALID", name=None):
+    return self._op("cnn.avgPooling1d", [x], name=name, k=int(k), s=int(s),
+                    padding=padding)[0]
+
+
+@_def(SDCNN, "maxPooling3d")
+def _sd_maxpool3d(self, x, k=(2, 2, 2), s=(2, 2, 2), padding="VALID",
+                  name=None):
+    return self._op("cnn.maxPooling3d", [x], name=name, k=tuple(k),
+                    s=tuple(s), padding=padding)[0]
+
+
+@_def(SDCNN, "avgPooling3d")
+def _sd_avgpool3d(self, x, k=(2, 2, 2), s=(2, 2, 2), padding="VALID",
+                  name=None):
+    return self._op("cnn.avgPooling3d", [x], name=name, k=tuple(k),
+                    s=tuple(s), padding=padding)[0]
+
+
+@_def(SDCNN, "upsampling1d")
+def _sd_upsample1d(self, x, scale=2, name=None):
+    return self._op("cnn.upsampling1d", [x], name=name, scale=int(scale))[0]
+
+
+@_def(SDCNN, "upsampling3d")
+def _sd_upsample3d(self, x, scale=2, name=None):
+    return self._op("cnn.upsampling3d", [x], name=name, scale=int(scale))[0]
+
+
+@_def(SDCNN, "spaceToDepth")
+def _sd_s2d(self, x, block=2, name=None):
+    return self._op("cnn.spaceToDepth", [x], name=name, block=int(block))[0]
+
+
+@_def(SDCNN, "depthToSpace")
+def _sd_d2s(self, x, block=2, name=None):
+    return self._op("cnn.depthToSpace", [x], name=name, block=int(block))[0]
+
+
+@_def(SDCNN, "spaceToBatch")
+def _sd_s2b(self, x, block=2, pads=((0, 0), (0, 0)), name=None):
+    return self._op("cnn.spaceToBatch", [x], name=name, block=int(block),
+                    pads=tuple(tuple(int(p) for p in pp) for pp in pads))[0]
+
+
+@_def(SDCNN, "batchToSpace")
+def _sd_b2s(self, x, block=2, crops=((0, 0), (0, 0)), name=None):
+    return self._op("cnn.batchToSpace", [x], name=name, block=int(block),
+                    crops=tuple(tuple(int(c) for c in cc) for cc in crops))[0]
+
+
+@_def(SDCNN, "localResponseNormalization")
+def _sd_lrn(self, x, depth=2, bias=1.0, alpha=1.0, beta=0.5, name=None):
+    return self._op("cnn.localResponseNormalization", [x], name=name,
+                    depth=int(depth), bias=float(bias), alpha=float(alpha),
+                    beta=float(beta))[0]
+
+
+@_def(SDCNN, "im2col")
+def _sd_im2col(self, x, k=(2, 2), s=(1, 1), padding="VALID", name=None):
+    return self._op("cnn.im2col", [x], name=name, k=tuple(k), s=tuple(s),
+                    padding=padding)[0]
+
+
+@_def(SDCNN, "col2im")
+def _sd_col2im(self, cols, shape, k=(2, 2), s=(1, 1), padding="VALID",
+               name=None):
+    return self._op("cnn.col2im", [cols], name=name, shape=tuple(shape),
+                    k=tuple(k), s=tuple(s), padding=padding)[0]
+
+
+@_def(SDCNN, "dilation2d")
+def _sd_dilation2d(self, x, w, strides=(1, 1), rates=(1, 1), name=None):
+    return self._op("cnn.dilation2d", [x, w], name=name,
+                    strides=tuple(strides), rates=tuple(rates))[0]
+
+
+# ======================= round 3: rnn cells =======================
+
+@register_op("rnn.lstmCell")
+def _lstm_cell(x, h, c, w, r, b):
+    """One LSTM step (reference sd.rnn.lstmCell): x [B,I], h/c [B,H]."""
+    hidden = r.shape[0]
+    z = x @ w + h @ r + b
+    i, f, g, o = (z[:, :hidden], z[:, hidden:2 * hidden],
+                  z[:, 2 * hidden:3 * hidden], z[:, 3 * hidden:])
+    c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+@register_op("rnn.gruCell")
+def _gru_cell(x, h, w, r, b):
+    hidden = r.shape[0]
+    zx = x @ w + b
+    zh = h @ r
+    rg = jax.nn.sigmoid(zx[:, :hidden] + zh[:, :hidden])
+    zg = jax.nn.sigmoid(zx[:, hidden:2 * hidden] + zh[:, hidden:2 * hidden])
+    ng = jnp.tanh(zx[:, 2 * hidden:] + rg * zh[:, 2 * hidden:])
+    return (1 - zg) * ng + zg * h
+
+
+def _sru_step(xt, c, wx, bf, br):
+    """One SRU step (Lei et al.; reference sru/sruCell). ``wx`` is the
+    precomputed x @ W [B, 3H] block (xtilde, f-gate, r-gate)."""
+    hidden = c.shape[-1]
+    xt_t = wx[:, :hidden]
+    f = jax.nn.sigmoid(wx[:, hidden:2 * hidden] + bf)
+    r = jax.nn.sigmoid(wx[:, 2 * hidden:] + br)
+    c_new = f * c + (1 - f) * xt_t
+    h_new = r * jnp.tanh(c_new) + (1 - r) * xt
+    return h_new, c_new
+
+
+@register_op("rnn.sru")
+def _sru(x, w, b, c0):
+    """SRU over [T,B,I] with I == H (highway connection); w [I,3H],
+    b [2H] = (bf, br). The heavy matmul runs ONCE outside the scan."""
+    hidden = c0.shape[-1]
+    bf, br = b[:hidden], b[hidden:]
+    wx = jnp.einsum("tbi,ih->tbh", x, w)
+
+    def step(c, inp):
+        xt, wxt = inp
+        h_new, c_new = _sru_step(xt, c, wxt, bf, br)
+        return c_new, h_new
+
+    c_f, ys = jax.lax.scan(step, c0, (x, wx))
+    return ys, c_f
+
+
+@register_op("rnn.sruCell")
+def _sru_cell(x, c, w, b):
+    hidden = c.shape[-1]
+    return _sru_step(x, c, x @ w, b[:hidden], b[hidden:])
+
+
+@_def(SDRNN, "lstmCell")
+def _sd_lstm_cell(self, x, h, c, w, r, b, name=None):
+    return self._op("rnn.lstmCell", [x, h, c, w, r, b], n_out=2, name=name)
+
+
+@_def(SDRNN, "gruCell")
+def _sd_gru_cell(self, x, h, w, r, b, name=None):
+    return self._op("rnn.gruCell", [x, h, w, r, b], name=name)[0]
+
+
+@_def(SDRNN, "sru")
+def _sd_sru(self, x, w, b, c0, name=None):
+    return self._op("rnn.sru", [x, w, b, c0], n_out=2, name=name)
+
+
+@_def(SDRNN, "sruCell")
+def _sd_sru_cell(self, x, c, w, b, name=None):
+    return self._op("rnn.sruCell", [x, c, w, b], n_out=2, name=name)
+
+
+# ======================= round 3: math / transforms =======================
+
+@register_op("math.cube")
+def _cube(x):
+    return x * x * x
+
+
+@register_op("math.oneMinus")
+def _one_minus(x):
+    return 1.0 - x
+
+
+@register_op("math.step")
+def _step(x, *, cutoff):
+    return (x > cutoff).astype(x.dtype)
+
+
+@register_op("math.rationalTanh")
+def _rational_tanh(x):
+    """Reference RationalTanh: 1.7159 * tanh_approx(2x/3) with
+    tanh_approx(y) = sign(y) * (1 - 1/(1 + |y| + y^2 + 1.41645 y^4))."""
+    y = 2.0 * x / 3.0
+    ay = jnp.abs(y)
+    approx = 1.0 - 1.0 / (1.0 + ay + y * y + 1.41645 * y ** 4)
+    return 1.7159 * jnp.sign(y) * approx
+
+
+@register_op("math.rectifiedTanh")
+def _rectified_tanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+@register_op("math.fmod")
+def _fmod(a, b):
+    # C-style remainder (sign follows the dividend) — distinct from
+    # math.mod's floored modulo, as in the reference's FModOp vs ModOp
+    return jnp.fmod(a, b)
+
+
+@register_op("math.lerp")
+def _lerp(a, b, *, weight):
+    return a + weight * (b - a)
+
+
+@register_op("math.isStrictlyIncreasing")
+def _is_strictly_increasing(x):
+    d = jnp.diff(x.reshape(-1))
+    return jnp.all(d > 0).astype(jnp.float32)
+
+
+@register_op("math.isNonDecreasing")
+def _is_non_decreasing(x):
+    d = jnp.diff(x.reshape(-1))
+    return jnp.all(d >= 0).astype(jnp.float32)
+
+
+@register_op("math.mergeAdd")
+def _merge_add(*xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@register_op("math.mergeAvg")
+def _merge_avg(*xs):
+    return _merge_add(*xs) / float(len(xs))
+
+
+@register_op("math.mergeMax")
+def _merge_max(*xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = jnp.maximum(out, x)
+    return out
+
+
+@register_op("math.moments")
+def _moments(x, *, axis, keepdims):
+    return (jnp.mean(x, axis=axis, keepdims=keepdims),
+            jnp.var(x, axis=axis, keepdims=keepdims))
+
+
+@register_op("math.meshgrid")
+def _meshgrid(*xs, indexing):
+    return tuple(jnp.meshgrid(*xs, indexing=indexing))
+
+
+@register_op("math.confusionMatrix")
+def _confusion_matrix(labels, pred, *, num_classes):
+    lo = jax.nn.one_hot(labels.astype(jnp.int32), num_classes)
+    po = jax.nn.one_hot(pred.astype(jnp.int32), num_classes)
+    return (lo.T @ po).astype(jnp.int32)
+
+
+@register_op("math.sequenceMask")
+def _sequence_mask(lengths, *, maxlen):
+    return (jnp.arange(maxlen)[None, :]
+            < lengths.astype(jnp.int32)[:, None]).astype(jnp.float32)
+
+
+@register_op("math.reverseSequence")
+def _reverse_sequence(x, seq_lengths, *, seq_axis, batch_axis):
+    """Reverse the first ``seq_lengths[b]`` entries of each sequence, the
+    tail stays in place (TF/reference ReverseSequence semantics)."""
+    x = jnp.moveaxis(x, (batch_axis, seq_axis), (0, 1))
+    t = x.shape[1]
+    ts = jnp.arange(t)[None, :]
+    ln = seq_lengths.astype(jnp.int32)[:, None]
+    src = jnp.where(ts < ln, ln - 1 - ts, ts)
+    idx = src.reshape(src.shape + (1,) * (x.ndim - 2))
+    out = jnp.take_along_axis(x, jnp.broadcast_to(idx, x.shape), axis=1)
+    return jnp.moveaxis(out, (0, 1), (batch_axis, seq_axis))
+
+
+@register_op("math.batchMmul")
+def _batch_mmul(a, b):
+    return jnp.matmul(a, b)
+
+
+@register_op("math.zeta")
+def _zeta(x, q):
+    return jax.scipy.special.zeta(x, q)
+
+
+@register_op("math.polygamma")
+def _polygamma(x, *, n):
+    return jax.scipy.special.polygamma(n, x)
+
+
+@register_op("math.igamma")
+def _igamma(a, x):
+    return jax.scipy.special.gammainc(a, x)
+
+
+@register_op("math.igammac")
+def _igammac(a, x):
+    return jax.scipy.special.gammaincc(a, x)
+
+
+@register_op("math.betainc")
+def _betainc(a, b, x):
+    return jax.scipy.special.betainc(a, b, x)
+
+
+@register_op("math.clipByNorm")
+def _clip_by_norm(x, *, clip, axis):
+    n = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True))
+    return jnp.where(n > clip, x * (clip / jnp.maximum(n, 1e-12)), x)
+
+
+@register_op("math.clipByAvgNorm")
+def _clip_by_avg_norm(x, *, clip, axis):
+    cnt = 1
+    for a in (axis if axis is not None else range(x.ndim)):
+        cnt *= x.shape[a]
+    n = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True)) / cnt
+    return jnp.where(n > clip, x * (clip / jnp.maximum(n, 1e-30)), x)
+
+
+@register_op("math.bincount")
+def _bincount(x, *, length):
+    return jnp.bincount(x.astype(jnp.int32).reshape(-1), length=length)
+
+
+@register_op("math.dynamicStitch")
+def _dynamic_stitch(*arrs, size):
+    """TF dynamicStitch: first half of the operands are index vectors,
+    second half the matching data slices; later partitions win ties
+    (overlapping indices). TF sizes the output max(index)+1 from DATA —
+    impossible under jit's static shapes — so ``size`` must be static:
+    pass it explicitly for overlapping/sparse indices, or leave the
+    default (sum of index lengths — exact for the dominant
+    dynamicPartition->dynamicStitch round trip, where the indices
+    partition 0..N-1)."""
+    n = len(arrs) // 2
+    idxs, data = arrs[:n], arrs[n:]
+    if size is None:
+        size = sum(int(i.shape[0]) for i in idxs)
+    out = jnp.zeros((size,) + data[0].shape[1:], data[0].dtype)
+    for i, d in zip(idxs, data):
+        out = out.at[i.astype(jnp.int32)].set(d)
+    return out
+
+
+@_def(SDMath, "cube")
+def _sd_cube(self, x, name=None):
+    return self._op("math.cube", [x], name=name)[0]
+
+
+@_def(SDMath, "oneMinus")
+def _sd_one_minus(self, x, name=None):
+    return self._op("math.oneMinus", [x], name=name)[0]
+
+
+@_def(SDMath, "step")
+def _sd_step(self, x, cutoff=0.0, name=None):
+    return self._op("math.step", [x], name=name, cutoff=float(cutoff))[0]
+
+
+@_def(SDMath, "rationalTanh")
+def _sd_rational_tanh(self, x, name=None):
+    return self._op("math.rationalTanh", [x], name=name)[0]
+
+
+@_def(SDMath, "rectifiedTanh")
+def _sd_rectified_tanh(self, x, name=None):
+    return self._op("math.rectifiedTanh", [x], name=name)[0]
+
+
+@_def(SDMath, "fmod")
+def _sd_fmod(self, a, b, name=None):
+    return self._op("math.fmod", [a, b], name=name)[0]
+
+
+@_def(SDMath, "lerp")
+def _sd_lerp(self, a, b, weight, name=None):
+    return self._op("math.lerp", [a, b], name=name, weight=float(weight))[0]
+
+
+@_def(SDMath, "isStrictlyIncreasing")
+def _sd_isi(self, x, name=None):
+    return self._op("math.isStrictlyIncreasing", [x], name=name)[0]
+
+
+@_def(SDMath, "isNonDecreasing")
+def _sd_ind(self, x, name=None):
+    return self._op("math.isNonDecreasing", [x], name=name)[0]
+
+
+@_def(SDMath, "mergeAdd")
+def _sd_merge_add(self, *xs, name=None):
+    return self._op("math.mergeAdd", list(xs), name=name)[0]
+
+
+@_def(SDMath, "mergeAvg")
+def _sd_merge_avg(self, *xs, name=None):
+    return self._op("math.mergeAvg", list(xs), name=name)[0]
+
+
+@_def(SDMath, "mergeMax")
+def _sd_merge_max(self, *xs, name=None):
+    return self._op("math.mergeMax", list(xs), name=name)[0]
+
+
+@_def(SDMath, "moments")
+def _sd_moments(self, x, dims=None, keepdims=False, name=None):
+    return self._op("math.moments", [x], n_out=2, name=name,
+                    axis=_axes(dims), keepdims=bool(keepdims))
+
+
+@_def(SDMath, "meshgrid")
+def _sd_meshgrid(self, *xs, indexing="xy", name=None):
+    return self._op("math.meshgrid", list(xs), n_out=len(xs), name=name,
+                    indexing=indexing)
+
+
+@_def(SDMath, "confusionMatrix")
+def _sd_confusion(self, labels, pred, num_classes, name=None):
+    return self._op("math.confusionMatrix", [labels, pred], name=name,
+                    num_classes=int(num_classes))[0]
+
+
+@_def(SDMath, "sequenceMask")
+def _sd_seq_mask(self, lengths, maxlen, name=None):
+    return self._op("math.sequenceMask", [lengths], name=name,
+                    maxlen=int(maxlen))[0]
+
+
+@_def(SDMath, "reverseSequence")
+def _sd_rev_seq(self, x, seq_lengths, seq_axis=1, batch_axis=0, name=None):
+    return self._op("math.reverseSequence", [x, seq_lengths], name=name,
+                    seq_axis=int(seq_axis), batch_axis=int(batch_axis))[0]
+
+
+@_def(SDMath, "batchMmul")
+def _sd_batch_mmul(self, a, b, name=None):
+    return self._op("math.batchMmul", [a, b], name=name)[0]
+
+
+@_def(SDMath, "zeta")
+def _sd_zeta(self, x, q, name=None):
+    return self._op("math.zeta", [x, q], name=name)[0]
+
+
+@_def(SDMath, "polygamma")
+def _sd_polygamma(self, x, n=0, name=None):
+    return self._op("math.polygamma", [x], name=name, n=int(n))[0]
+
+
+@_def(SDMath, "igamma")
+def _sd_igamma(self, a, x, name=None):
+    return self._op("math.igamma", [a, x], name=name)[0]
+
+
+@_def(SDMath, "igammac")
+def _sd_igammac(self, a, x, name=None):
+    return self._op("math.igammac", [a, x], name=name)[0]
+
+
+@_def(SDMath, "betainc")
+def _sd_betainc(self, a, b, x, name=None):
+    return self._op("math.betainc", [a, b, x], name=name)[0]
+
+
+@_def(SDMath, "clipByNorm")
+def _sd_clip_by_norm(self, x, clip, dims=None, name=None):
+    return self._op("math.clipByNorm", [x], name=name, clip=float(clip),
+                    axis=_axes(dims))[0]
+
+
+@_def(SDMath, "clipByAvgNorm")
+def _sd_clip_by_avg_norm(self, x, clip, dims=None, name=None):
+    return self._op("math.clipByAvgNorm", [x], name=name, clip=float(clip),
+                    axis=_axes(dims))[0]
+
+
+@_def(SDMath, "bincount")
+def _sd_bincount(self, x, length, name=None):
+    return self._op("math.bincount", [x], name=name, length=int(length))[0]
+
+
+@_def(SDMath, "dynamicStitch")
+def _sd_dynamic_stitch(self, indices, data, size=None, name=None):
+    return self._op("math.dynamicStitch", list(indices) + list(data),
+                    name=name,
+                    size=None if size is None else int(size))[0]
+
+
+# ======================= round 3: nn activations =======================
+
+@register_op("nn.prelu")
+def _prelu(x, alpha):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+@register_op("nn.crelu")
+def _crelu(x):
+    return jnp.concatenate([jnp.maximum(x, 0), jnp.maximum(-x, 0)], axis=-1)
+
+
+@register_op("nn.logSigmoid")
+def _log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@register_op("nn.thresholdRelu")
+def _threshold_relu(x, *, cutoff):
+    return jnp.where(x > cutoff, x, 0.0)
+
+
+@register_op("nn.preciseGelu")
+def _precise_gelu(x):
+    # exact erf-based GELU (nn.gelu is the tanh approximation, as the
+    # reference's GELU/PreciseGELU pair distinguishes)
+    return jax.nn.gelu(x, approximate=False)
+
+
+@_def(SDNN, "prelu")
+def _sd_prelu(self, x, alpha, name=None):
+    return self._op("nn.prelu", [x, alpha], name=name)[0]
+
+
+@_def(SDNN, "crelu")
+def _sd_crelu(self, x, name=None):
+    return self._op("nn.crelu", [x], name=name)[0]
+
+
+@_def(SDNN, "logSigmoid")
+def _sd_log_sigmoid(self, x, name=None):
+    return self._op("nn.logSigmoid", [x], name=name)[0]
+
+
+@_def(SDNN, "thresholdRelu")
+def _sd_threshold_relu(self, x, cutoff=0.0, name=None):
+    return self._op("nn.thresholdRelu", [x], name=name,
+                    cutoff=float(cutoff))[0]
+
+
+@_def(SDNN, "preciseGelu")
+def _sd_precise_gelu(self, x, name=None):
+    return self._op("nn.preciseGelu", [x], name=name)[0]
+
+
+# ======================= round 3: random =======================
+
+@register_op("random.exponential")
+def _rand_exponential(*, seed, shape, lam):
+    return jax.random.exponential(jax.random.PRNGKey(seed), shape) / lam
+
+
+@register_op("random.gamma")
+def _rand_gamma(*, seed, shape, alpha, beta):
+    return jax.random.gamma(jax.random.PRNGKey(seed), alpha, shape) / beta
+
+
+@register_op("random.poisson")
+def _rand_poisson(*, seed, shape, lam):
+    return jax.random.poisson(jax.random.PRNGKey(seed), lam,
+                              shape).astype(jnp.float32)
+
+
+@register_op("random.logNormal")
+def _rand_log_normal(*, seed, shape, mean, stddev):
+    return jnp.exp(mean + stddev * jax.random.normal(
+        jax.random.PRNGKey(seed), shape))
+
+
+@register_op("random.truncatedNormal")
+def _rand_truncated_normal(*, seed, shape, mean, stddev):
+    return mean + stddev * jax.random.truncated_normal(
+        jax.random.PRNGKey(seed), -2.0, 2.0, shape)
+
+
+@register_op("random.shuffle")
+def _rand_shuffle(x, *, seed):
+    return jax.random.permutation(jax.random.PRNGKey(seed), x, axis=0)
+
+
+@_def(SDRandom, "exponential")
+def _sd_rand_exp(self, lam, shape, seed=0, name=None):
+    return self._op("random.exponential", [], name=name, seed=int(seed),
+                    shape=tuple(shape), lam=float(lam))[0]
+
+
+@_def(SDRandom, "gamma")
+def _sd_rand_gamma(self, alpha, beta, shape, seed=0, name=None):
+    return self._op("random.gamma", [], name=name, seed=int(seed),
+                    shape=tuple(shape), alpha=float(alpha),
+                    beta=float(beta))[0]
+
+
+@_def(SDRandom, "poisson")
+def _sd_rand_poisson(self, lam, shape, seed=0, name=None):
+    return self._op("random.poisson", [], name=name, seed=int(seed),
+                    shape=tuple(shape), lam=float(lam))[0]
+
+
+@_def(SDRandom, "logNormal")
+def _sd_rand_lognormal(self, mean, stddev, shape, seed=0, name=None):
+    return self._op("random.logNormal", [], name=name, seed=int(seed),
+                    shape=tuple(shape), mean=float(mean),
+                    stddev=float(stddev))[0]
+
+
+@_def(SDRandom, "truncatedNormal")
+def _sd_rand_truncnormal(self, mean, stddev, shape, seed=0, name=None):
+    return self._op("random.truncatedNormal", [], name=name, seed=int(seed),
+                    shape=tuple(shape), mean=float(mean),
+                    stddev=float(stddev))[0]
+
+
+@_def(SDRandom, "shuffle")
+def _sd_rand_shuffle(self, x, seed=0, name=None):
+    return self._op("random.shuffle", [x], name=name, seed=int(seed))[0]
+
+
+# ======================= round 3: image =======================
+
+_YUV = jnp.array([[0.299, 0.587, 0.114],
+                  [-0.14714119, -0.28886916, 0.43601035],
+                  [0.61497538, -0.51496512, -0.10001026]])
+_YIQ = jnp.array([[0.299, 0.587, 0.114],
+                  [0.59590059, -0.27455667, -0.32134392],
+                  [0.21153661, -0.52273617, 0.31119955]])
+
+
+@register_op("image.rgbToYuv")
+def _rgb_to_yuv(x):
+    return x @ _YUV.T.astype(x.dtype)
+
+
+@register_op("image.yuvToRgb")
+def _yuv_to_rgb(x):
+    return x @ jnp.linalg.inv(_YUV).T.astype(x.dtype)
+
+
+@register_op("image.rgbToYiq")
+def _rgb_to_yiq(x):
+    return x @ _YIQ.T.astype(x.dtype)
+
+
+@register_op("image.yiqToRgb")
+def _yiq_to_rgb(x):
+    return x @ jnp.linalg.inv(_YIQ).T.astype(x.dtype)
+
+
+@register_op("image.resizeBicubic")
+def _resize_bicubic(x, *, height, width):
+    b, _, _, c = x.shape
+    return jax.image.resize(x, (b, height, width, c), method="cubic")
+
+
+@register_op("image.imageResize")
+def _image_resize(x, *, height, width, method):
+    b, _, _, c = x.shape
+    return jax.image.resize(x, (b, height, width, c), method=method)
+
+
+@_def(SDImage, "rgbToYuv")
+def _sd_rgb_yuv(self, x, name=None):
+    return self._op("image.rgbToYuv", [x], name=name)[0]
+
+
+@_def(SDImage, "yuvToRgb")
+def _sd_yuv_rgb(self, x, name=None):
+    return self._op("image.yuvToRgb", [x], name=name)[0]
+
+
+@_def(SDImage, "rgbToYiq")
+def _sd_rgb_yiq(self, x, name=None):
+    return self._op("image.rgbToYiq", [x], name=name)[0]
+
+
+@_def(SDImage, "yiqToRgb")
+def _sd_yiq_rgb(self, x, name=None):
+    return self._op("image.yiqToRgb", [x], name=name)[0]
+
+
+@_def(SDImage, "resizeBicubic")
+def _sd_resize_bicubic(self, x, height, width, name=None):
+    return self._op("image.resizeBicubic", [x], name=name,
+                    height=int(height), width=int(width))[0]
+
+
+@_def(SDImage, "imageResize")
+def _sd_image_resize(self, x, height, width, method="bilinear", name=None):
+    method = {"bilinear": "linear", "bicubic": "cubic"}.get(method, method)
+    return self._op("image.imageResize", [x], name=name, height=int(height),
+                    width=int(width), method=method)[0]
+
+
+# ======================= round 3: linalg =======================
+
+@register_op("linalg.expm")
+def _expm(x):
+    return jax.scipy.linalg.expm(x)
+
+
+@register_op("linalg.pinv")
+def _pinv(x):
+    return jnp.linalg.pinv(x)
+
+
+@register_op("linalg.matrixSetDiag")
+def _matrix_set_diag(x, diag):
+    n, m = x.shape[-2], x.shape[-1]
+    k = min(n, m)
+    eye = jnp.eye(n, m, dtype=bool)
+    d = jnp.zeros(x.shape, x.dtype)
+    idx = jnp.arange(k)
+    d = d.at[..., idx, idx].set(diag[..., :k])
+    return jnp.where(eye, d, x)
+
+
+@_def(SDLinalg, "expm")
+def _sd_expm(self, x, name=None):
+    return self._op("linalg.expm", [x], name=name)[0]
+
+
+@_def(SDLinalg, "pinv")
+def _sd_pinv(self, x, name=None):
+    return self._op("linalg.pinv", [x], name=name)[0]
+
+
+@_def(SDLinalg, "matrixSetDiag")
+def _sd_matrix_set_diag(self, x, diag, name=None):
+    return self._op("linalg.matrixSetDiag", [x, diag], name=name)[0]
+
+
+# ======================= round 3: segment / reduce / loss =======================
+
+@register_op("segment.unsortedSegmentSqrtN")
+def _segment_sqrt_n(data, ids, *, num_segments):
+    s = jax.ops.segment_sum(data, ids.astype(jnp.int32), num_segments)
+    cnt = jax.ops.segment_sum(jnp.ones(ids.shape, data.dtype),
+                              ids.astype(jnp.int32), num_segments)
+    shape = cnt.shape + (1,) * (s.ndim - cnt.ndim)
+    return s / jnp.sqrt(jnp.maximum(cnt, 1.0)).reshape(shape)
+
+
+@register_op("reduce.logSumExp")
+def _log_sum_exp(x, *, axis, keepdims):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdims)
+
+
+@_def(SDMath, "logSumExp")
+def _sd_logsumexp(self, x, dims=None, keepdims=False, name=None):
+    return self._op("reduce.logSumExp", [x], name=name, axis=_axes(dims),
+                    keepdims=bool(keepdims))[0]
+
+
+@register_op("loss.l2Loss")
+def _l2_loss(x):
+    return jnp.sum(x * x) / 2.0
+
+
+@register_op("loss.weightedCrossEntropy")
+def _weighted_ce(labels, logits, *, weight):
+    """TF weighted_cross_entropy_with_logits (reference
+    weightedCrossEntropyWithLogits): positive class reweighted by
+    ``weight``; numerically-stable log1p(exp(-|x|)) form."""
+    q = weight
+    per = ((1 - labels) * logits
+           + (1 + (q - 1) * labels)
+           * (jnp.log1p(jnp.exp(-jnp.abs(logits)))
+              + jnp.maximum(-logits, 0.0)))
+    return jnp.mean(per)
+
+
+@_def(SDLoss, "l2Loss")
+def _sd_l2_loss(self, x, name=None):
+    out = self._op("loss.l2Loss", [x], name=name)[0]
+    self.sd.mark_loss(out)
+    return out
+
+
+@_def(SDLoss, "weightedCrossEntropyWithLogits")
+def _sd_weighted_ce(self, labels, logits, weight=1.0, name=None):
+    out = self._op("loss.weightedCrossEntropy", [labels, logits], name=name,
+                   weight=float(weight))[0]
+    self.sd.mark_loss(out)
+    return out
+
+
 NAMESPACES = {
     "math": SDMath, "nn": SDNN, "cnn": SDCNN, "rnn": SDRNN, "loss": SDLoss,
     "random": SDRandom, "linalg": SDLinalg, "image": SDImage,

@@ -976,6 +976,12 @@ _EXEMPT = {
     "random.bernoulli": "stochastic; tests/test_samediff.py rng determinism",
     "nn.dotProductAttention": "tests/test_attention_layers.py",
     "nn.multiHeadDotProductAttention": "tests/test_attention_layers.py",
+    "random.exponential": "stochastic; test_random_round3_statistics",
+    "random.gamma": "stochastic; test_random_round3_statistics",
+    "random.poisson": "stochastic; test_random_round3_statistics",
+    "random.logNormal": "stochastic; test_random_round3_statistics",
+    "random.truncatedNormal": "stochastic; test_random_round3_statistics",
+    "random.shuffle": "stochastic; test_random_round3_statistics",
 }
 
 
@@ -1004,13 +1010,21 @@ def test_coverage_registry_complete():
     _run_cnn_nn_extra()
     _run_reduce3()
     _run_stats_misc()
+    _run_cnn_round3()
+    _run_cnn_pool_space_round3()
+    _run_cnn_lrn_im2col_round3()
+    _run_rnn_cells_round3()
+    _run_math_round3()
+    _run_math_structural_round3()
+    _run_nn_image_round3()
+    _run_linalg_segment_loss_round3()
     rep = coverage_report()
     unexpected = sorted(set(rep["missing"]) - set(_EXEMPT))
     assert not unexpected, (
         f"registered ops without validation coverage: {unexpected} — add a "
         "sweep entry in test_op_validation.py or an explicit exemption "
         "with a pointer to the covering test")
-    assert rep["validated"] >= 190, rep["validated"]
+    assert rep["validated"] >= 280, rep["validated"]
 
 
 # --- round 2b: reduce3 distances / statistics / misc math -------------------
@@ -1109,3 +1123,512 @@ def test_is_max_tie_breaks_to_single_one():
     got = np.asarray(out["im"])
     np.testing.assert_allclose(got.sum(1), [1.0, 1.0])
     np.testing.assert_allclose(got, [[0, 1, 0], [1, 0, 0]])
+
+
+# --- round 3: cnn 3d / transposed / space-batch family ----------------------
+
+def _run_cnn_round3():
+    import jax as _jax
+
+    rng = np.random.default_rng(91)
+    x3 = rng.normal(size=(1, 3, 4, 4, 2))
+    w3 = rng.normal(size=(2, 2, 2, 2, 3), scale=0.5)
+    x2 = rng.normal(size=(1, 4, 4, 2))
+    wdc = rng.normal(size=(2, 2, 2, 3), scale=0.5)
+    wd = rng.normal(size=(2, 2, 1, 2), scale=0.5)
+    wp = rng.normal(size=(1, 1, 2, 4), scale=0.5)
+
+    sd = SameDiff()
+    a3 = sd.placeholder("a3", (1, 3, 4, 4, 2))
+    k3 = sd.placeholder("k3", (2, 2, 2, 2, 3))
+    a2 = sd.placeholder("a2", (1, 4, 4, 2))
+    kdc = sd.placeholder("kdc", (2, 2, 2, 3))
+    kd = sd.placeholder("kd", (2, 2, 1, 2))
+    kp = sd.placeholder("kp", (1, 1, 2, 4))
+    sd.cnn.conv3d(a3, k3, strides=(1, 1, 1), padding="VALID", name="c3")
+    sd.cnn.deconv2d(a2, kdc, strides=(2, 2), padding="VALID", name="d2")
+    sd.cnn.deconv3d(a3, k3, strides=(1, 1, 1), padding="VALID", name="d3")
+    sd.cnn.sconv2d(a2, kd, kp, strides=(1, 1), padding="VALID", name="sc")
+
+    dn3 = ("NDHWC", "DHWIO", "NDHWC")
+    dn2 = ("NHWC", "HWIO", "NHWC")
+    want_c3 = np.asarray(_jax.lax.conv_general_dilated(
+        x3, w3, (1, 1, 1), "VALID", dimension_numbers=dn3))
+    want_d2 = np.asarray(_jax.lax.conv_transpose(
+        x2, wdc, (2, 2), "VALID", dimension_numbers=dn2))
+    want_d3 = np.asarray(_jax.lax.conv_transpose(
+        x3, w3, (1, 1, 1), "VALID", dimension_numbers=dn3))
+    dwo = _jax.lax.conv_general_dilated(
+        x2, wd, (1, 1), "VALID", feature_group_count=2,
+        dimension_numbers=dn2)
+    want_sc = np.asarray(_jax.lax.conv_general_dilated(
+        dwo, wp, (1, 1), "VALID", dimension_numbers=dn2))
+    validate(TestCase(
+        sd, {"a3": x3, "k3": w3, "a2": x2, "kdc": wdc, "kd": wd, "kp": wp},
+        {"c3": want_c3, "d2": want_d2, "d3": want_d3, "sc": want_sc},
+        max_rel_error=1e-3))
+
+
+def test_cnn_round3_sweep():
+    _run_cnn_round3()
+
+
+def _run_cnn_pool_space_round3():
+    rng = np.random.default_rng(92)
+    x1 = rng.normal(size=(2, 6, 3))
+    x3 = rng.normal(size=(1, 4, 4, 4, 2))
+    x2 = rng.normal(size=(1, 4, 4, 8))
+
+    sd = SameDiff()
+    a1 = sd.placeholder("a1", (2, 6, 3))
+    a3 = sd.placeholder("a3", (1, 4, 4, 4, 2))
+    a2 = sd.placeholder("a2", (1, 4, 4, 8))
+    sd.cnn.maxPooling1d(a1, k=2, s=2, name="mp1")
+    sd.cnn.avgPooling1d(a1, k=2, s=2, name="ap1")
+    sd.cnn.maxPooling3d(a3, k=(2, 2, 2), s=(2, 2, 2), name="mp3")
+    sd.cnn.avgPooling3d(a3, k=(2, 2, 2), s=(2, 2, 2), name="ap3")
+    sd.cnn.upsampling1d(a1, scale=2, name="up1")
+    sd.cnn.upsampling3d(a3, scale=2, name="up3")
+    sd.cnn.spaceToDepth(a2, block=2, name="s2d")
+    sd.cnn.depthToSpace(a2, block=2, name="d2s")
+    sd.cnn.spaceToBatch(a2, block=2, name="s2b")
+    sd.cnn.batchToSpace(sd.cnn.spaceToBatch(a2, block=2), block=2,
+                        name="b2s_rt")
+
+    mp1 = x1.reshape(2, 3, 2, 3).max(axis=2)
+    ap1 = x1.reshape(2, 3, 2, 3).mean(axis=2)
+    x3b = x3.reshape(1, 2, 2, 2, 2, 2, 2, 2)
+    mp3 = x3b.max(axis=(2, 4, 6))
+    ap3 = x3b.mean(axis=(2, 4, 6))
+    # independent numpy oracle for space<->depth (TF semantics)
+    n, h, w, c = x2.shape
+    s2d = x2.reshape(n, h // 2, 2, w // 2, 2, c).transpose(
+        0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2, 4 * c)
+    d2s = x2.reshape(n, h, w, 2, 2, c // 4).transpose(
+        0, 1, 3, 2, 4, 5).reshape(n, h * 2, w * 2, c // 4)
+    s2b = x2.reshape(n, h // 2, 2, w // 2, 2, c).transpose(
+        2, 4, 0, 1, 3, 5).reshape(4 * n, h // 2, w // 2, c)
+    validate(TestCase(
+        sd, {"a1": x1, "a3": x3, "a2": x2},
+        {"mp1": mp1, "ap1": ap1, "mp3": mp3, "ap3": ap3,
+         "up1": x1.repeat(2, axis=1),
+         "up3": x3.repeat(2, axis=1).repeat(2, axis=2).repeat(2, axis=3),
+         "s2d": s2d, "d2s": d2s, "s2b": s2b, "b2s_rt": x2},
+        max_rel_error=1e-3))
+
+
+def test_cnn_pool_space_round3_sweep():
+    _run_cnn_pool_space_round3()
+
+
+def _run_cnn_lrn_im2col_round3():
+    rng = np.random.default_rng(93)
+    x = rng.normal(size=(1, 3, 3, 4))
+    xw = rng.normal(size=(1, 4, 4, 2))
+    wdil = rng.normal(size=(2, 2, 2), scale=0.5)
+
+    sd = SameDiff()
+    a = sd.placeholder("a", (1, 3, 3, 4))
+    aw = sd.placeholder("aw", (1, 4, 4, 2))
+    kdil = sd.placeholder("kdil", (2, 2, 2))
+    sd.cnn.localResponseNormalization(a, depth=1, bias=1.0, alpha=0.5,
+                                      beta=0.75, name="lrn")
+    cols = sd.cnn.im2col(aw, k=(2, 2), s=(1, 1), padding="VALID",
+                         name="cols")
+    sd.cnn.col2im(cols, shape=(1, 4, 4, 2), k=(2, 2), s=(1, 1),
+                  padding="VALID", name="img")
+    sd.cnn.dilation2d(aw, kdil, strides=(1, 1), rates=(1, 1), name="dil")
+
+    # LRN numpy oracle (across-channel window +-1)
+    lrn = np.zeros_like(x)
+    for c in range(4):
+        lo, hi = max(0, c - 1), min(4, c + 2)
+        ssum = (x[..., lo:hi] ** 2).sum(-1)
+        lrn[..., c] = x[..., c] / (1.0 + 0.5 * ssum) ** 0.75
+    # im2col: channel-major (c, kh, kw) feature ordering per patch
+    cols_np = np.zeros((1, 3, 3, 8))
+    for i in range(3):
+        for j in range(3):
+            patch = xw[0, i:i + 2, j:j + 2, :]          # [2, 2, C]
+            cols_np[0, i, j] = patch.transpose(2, 0, 1).reshape(-1)
+    # col2im: scatter-add the SAME patches back
+    img_np = np.zeros((1, 4, 4, 2))
+    for i in range(3):
+        for j in range(3):
+            img_np[0, i:i + 2, j:j + 2, :] += cols_np[0, i, j].reshape(
+                2, 2, 2).transpose(1, 2, 0)
+    dil = np.zeros((1, 3, 3, 2))
+    for i in range(3):
+        for j in range(3):
+            dil[0, i, j] = (xw[0, i:i + 2, j:j + 2, :] + wdil).max((0, 1))
+    validate(TestCase(
+        sd, {"a": x, "aw": xw, "kdil": wdil},
+        {"lrn": lrn, "cols": cols_np, "img": img_np, "dil": dil},
+        max_rel_error=1e-3))
+
+
+def test_cnn_lrn_im2col_round3_sweep():
+    _run_cnn_lrn_im2col_round3()
+
+
+# --- round 3: rnn cells -----------------------------------------------------
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _run_rnn_cells_round3():
+    rng = np.random.default_rng(94)
+    B, I, H = 2, 3, 4
+    x = rng.normal(size=(B, I))
+    h0 = rng.normal(size=(B, H)) * 0.3
+    c0 = rng.normal(size=(B, H)) * 0.3
+    wl = rng.normal(size=(I, 4 * H), scale=0.4)
+    rl = rng.normal(size=(H, 4 * H), scale=0.4)
+    bl = rng.normal(size=(4 * H,), scale=0.1)
+    wg = rng.normal(size=(I, 3 * H), scale=0.4)
+    rg = rng.normal(size=(H, 3 * H), scale=0.4)
+    bg = rng.normal(size=(3 * H,), scale=0.1)
+    xs = rng.normal(size=(B, H))           # sru needs I == H
+    cs = rng.normal(size=(B, H)) * 0.3
+    ws = rng.normal(size=(H, 3 * H), scale=0.4)
+    bs = rng.normal(size=(2 * H,), scale=0.1)
+    xseq = rng.normal(size=(3, B, H))
+
+    sd = SameDiff()
+    px = sd.placeholder("x", (B, I))
+    ph = sd.placeholder("h", (B, H))
+    pc = sd.placeholder("c", (B, H))
+    pwl = sd.placeholder("wl", (I, 4 * H))
+    prl = sd.placeholder("rl", (H, 4 * H))
+    pbl = sd.placeholder("bl", (4 * H,))
+    pwg = sd.placeholder("wg", (I, 3 * H))
+    prg = sd.placeholder("rg", (H, 3 * H))
+    pbg = sd.placeholder("bg", (3 * H,))
+    pxs = sd.placeholder("xs", (B, H))
+    pcs = sd.placeholder("cs", (B, H))
+    pws = sd.placeholder("ws", (H, 3 * H))
+    pbs = sd.placeholder("bs", (2 * H,))
+    pxq = sd.placeholder("xq", (3, B, H))
+    hh, cc = sd.rnn.lstmCell(px, ph, pc, pwl, prl, pbl, name="lc")
+    hh.rename("lc_h"); cc.rename("lc_c")
+    sd.rnn.gruCell(px, ph, pwg, prg, pbg, name="gc")
+    sh, scc = sd.rnn.sruCell(pxs, pcs, pws, pbs, name="sc")
+    sh.rename("sc_h"); scc.rename("sc_c")
+    ys, cf = sd.rnn.sru(pxq, pws, pbs, pcs, name="sr")
+    ys.rename("sr_y"); cf.rename("sr_c")
+
+    # numpy oracles of the same gate formulas
+    z = x @ wl + h0 @ rl + bl
+    i, f, g, o = (z[:, :H], z[:, H:2 * H], z[:, 2 * H:3 * H], z[:, 3 * H:])
+    lc_c = _sigmoid(f) * c0 + _sigmoid(i) * np.tanh(g)
+    lc_h = _sigmoid(o) * np.tanh(lc_c)
+    zx = x @ wg + bg
+    zh = h0 @ rg
+    rgt = _sigmoid(zx[:, :H] + zh[:, :H])
+    zgt = _sigmoid(zx[:, H:2 * H] + zh[:, H:2 * H])
+    ngt = np.tanh(zx[:, 2 * H:] + rgt * zh[:, 2 * H:])
+    gc = (1 - zgt) * ngt + zgt * h0
+
+    def sru_step_np(xt, c):
+        wx = xt @ ws
+        xt_t = wx[:, :H]
+        fg = _sigmoid(wx[:, H:2 * H] + bs[:H])
+        rg_ = _sigmoid(wx[:, 2 * H:] + bs[H:])
+        c_new = fg * c + (1 - fg) * xt_t
+        h_new = rg_ * np.tanh(c_new) + (1 - rg_) * xt
+        return h_new, c_new
+
+    sc_h, sc_c = sru_step_np(xs, cs)
+    c = cs
+    sr_y = np.zeros((3, B, H))
+    for t in range(3):
+        sr_y[t], c = sru_step_np(xseq[t], c)
+    validate(TestCase(
+        sd, {"x": x, "h": h0, "c": c0, "wl": wl, "rl": rl, "bl": bl,
+             "wg": wg, "rg": rg, "bg": bg, "xs": xs, "cs": cs, "ws": ws,
+             "bs": bs, "xq": xseq},
+        {"lc_h": lc_h, "lc_c": lc_c, "gc": gc, "sc_h": sc_h, "sc_c": sc_c,
+         "sr_y": sr_y, "sr_c": c},
+        max_rel_error=1e-3))
+
+
+def test_rnn_cells_round3_sweep():
+    _run_rnn_cells_round3()
+
+
+# --- round 3: math transforms / merges / special functions ------------------
+
+def _run_math_round3():
+    import scipy.special as sps
+
+    rng = np.random.default_rng(95)
+    xv = rng.normal(size=(2, 5))
+    yv = rng.normal(size=(2, 5))
+    zv = rng.normal(size=(2, 5))
+    pos = rng.uniform(0.5, 3.0, size=(2, 4))
+    q = rng.uniform(0.5, 2.0, size=(2, 4))
+    ab = rng.uniform(1.0, 3.0, size=(2, 4))
+    xb = rng.uniform(0.05, 0.95, size=(2, 4))
+    bm_a = rng.normal(size=(3, 2, 4))
+    bm_b = rng.normal(size=(3, 4, 2))
+
+    sd = SameDiff()
+    x = sd.placeholder("x", (2, 5))
+    y = sd.placeholder("y", (2, 5))
+    z = sd.placeholder("z", (2, 5))
+    p = sd.placeholder("p", (2, 4))
+    pq = sd.placeholder("q", (2, 4))
+    pa = sd.placeholder("pa", (2, 4))
+    pxb = sd.placeholder("pxb", (2, 4))
+    ba = sd.placeholder("ba", (3, 2, 4))
+    bb = sd.placeholder("bb", (3, 4, 2))
+    sd.math.cube(x, name="cu")
+    sd.math.oneMinus(x, name="om")
+    sd.math.step(x, cutoff=0.1, name="st")
+    sd.math.rationalTanh(x, name="rt")
+    sd.math.rectifiedTanh(x, name="rh")
+    sd.math.fmod(x, sd.math.oneMinus(sd.math.step(y, cutoff=100.0)) * 2.0
+                 + 0.5, name="fm")
+    sd.math.lerp(x, y, 0.3, name="lp")
+    sd.math.mergeAdd(x, y, z, name="ma")
+    sd.math.mergeAvg(x, y, z, name="mv")
+    sd.math.mergeMax(x, y, z, name="mm")
+    sd.math.logSumExp(x, dims=(1,), name="lse")
+    sd.math.zeta(p + 1.5, pq, name="zt")
+    sd.math.polygamma(p, n=1, name="pg")
+    sd.math.igamma(pa, pxb, name="ig")
+    sd.math.igammac(pa, pxb, name="ic")
+    sd.math.betainc(pa, pa, pxb, name="bi")
+    sd.math.clipByNorm(x, 1.5, dims=(1,), name="cn")
+    sd.math.clipByAvgNorm(x, 0.1, dims=(1,), name="ca")
+    sd.math.batchMmul(ba, bb, name="bm")
+
+    ry = 0.5 + 2.0 * (1.0 - (yv > 100.0))   # == 2.5 everywhere
+    yy = 2.0 * xv / 3.0
+    rt = 1.7159 * np.sign(yy) * (
+        1.0 - 1.0 / (1.0 + np.abs(yy) + yy ** 2 + 1.41645 * yy ** 4))
+    nrm = np.sqrt((xv ** 2).sum(1, keepdims=True))
+    cn = np.where(nrm > 1.5, xv * 1.5 / nrm, xv)
+    avg = nrm / 5.0
+    ca = np.where(avg > 0.1, xv * 0.1 / np.maximum(avg, 1e-30), xv)
+    validate(TestCase(
+        sd, {"x": xv, "y": yv, "z": zv, "p": pos, "q": q, "pa": ab,
+             "pxb": xb, "ba": bm_a, "bb": bm_b},
+        {"cu": xv ** 3, "om": 1.0 - xv,
+         "st": (xv > 0.1).astype(np.float64),
+         "rt": rt, "rh": np.maximum(0.0, np.tanh(xv)),
+         "fm": np.fmod(xv, ry), "lp": xv + 0.3 * (yv - xv),
+         "ma": xv + yv + zv, "mv": (xv + yv + zv) / 3.0,
+         "mm": np.maximum(np.maximum(xv, yv), zv),
+         "lse": sps.logsumexp(xv, axis=1),
+         "zt": sps.zeta(pos + 1.5, q),
+         "pg": sps.polygamma(1, pos),
+         "ig": sps.gammainc(ab, xb), "ic": sps.gammaincc(ab, xb),
+         "bi": sps.betainc(ab, ab, xb),
+         "cn": cn, "ca": ca,
+         "bm": bm_a @ bm_b},
+        grad_wrt=["x", "y", "z", "ba", "bb"], max_rel_error=1e-3))
+
+
+def test_math_round3_sweep():
+    _run_math_round3()
+
+
+def _run_math_structural_round3():
+    rng = np.random.default_rng(96)
+    xv = np.asarray([1.0, 2.0, 5.0, 7.0])
+    seq = rng.normal(size=(3, 5, 2))
+    lens = np.asarray([2, 5, 0])
+    labels = np.asarray([0, 1, 2, 1])
+    preds = np.asarray([0, 2, 2, 1])
+    ints = np.asarray([0, 1, 1, 3, 1])
+    i1 = np.asarray([0, 2])
+    i2 = np.asarray([1, 3])
+    d1 = rng.normal(size=(2, 3))
+    d2 = rng.normal(size=(2, 3))
+    mg_x = np.asarray([1.0, 2.0, 3.0])
+    mg_y = np.asarray([4.0, 5.0])
+
+    sd = SameDiff()
+    x = sd.placeholder("x", (4,))
+    s = sd.placeholder("s", (3, 5, 2))
+    ln = sd.placeholder("ln", (3,))
+    lb = sd.placeholder("lb", (4,))
+    pr = sd.placeholder("pr", (4,))
+    iv = sd.placeholder("iv", (5,))
+    pi1 = sd.placeholder("i1", (2,))
+    pi2 = sd.placeholder("i2", (2,))
+    pd1 = sd.placeholder("d1", (2, 3))
+    pd2 = sd.placeholder("d2", (2, 3))
+    mx = sd.placeholder("mx", (3,))
+    my = sd.placeholder("my", (2,))
+    sd.math.isStrictlyIncreasing(x, name="isi")
+    sd.math.isNonDecreasing(x, name="ind")
+    sd.math.sequenceMask(ln, maxlen=5, name="sm")
+    sd.math.reverseSequence(s, ln, seq_axis=1, batch_axis=0, name="rs")
+    sd.math.confusionMatrix(lb, pr, 3, name="cm")
+    sd.math.bincount(iv, length=4, name="bc")
+    sd.math.dynamicStitch([pi1, pi2], [pd1, pd2], name="ds")
+    g1, g2 = sd.math.moments(s, dims=(1, 2), name="mo")
+    g1.rename("mo_mean"); g2.rename("mo_var")
+    m1, m2 = sd.math.meshgrid(mx, my, name="mg")
+    m1.rename("mg_x"); m2.rename("mg_y")
+
+    rs = seq.copy()
+    for b in range(3):
+        L = lens[b]
+        rs[b, :L] = seq[b, :L][::-1]
+    cm = np.zeros((3, 3), np.int32)
+    for l, pp in zip(labels, preds):
+        cm[l, pp] += 1
+    ds = np.zeros((4, 3))
+    ds[i1] = d1
+    ds[i2] = d2
+    mgx, mgy = np.meshgrid(mg_x, mg_y)
+    validate(TestCase(
+        sd, {"x": xv, "s": seq, "ln": lens, "lb": labels, "pr": preds,
+             "iv": ints, "i1": i1, "i2": i2, "d1": d1, "d2": d2,
+             "mx": mg_x, "my": mg_y},
+        {"isi": 1.0, "ind": 1.0,
+         "sm": (np.arange(5)[None] < lens[:, None]).astype(np.float64),
+         "rs": rs, "cm": cm, "bc": np.bincount(ints, minlength=4),
+         "ds": ds, "mo_mean": seq.mean((1, 2)), "mo_var": seq.var((1, 2)),
+         "mg_x": mgx, "mg_y": mgy},
+        grad_wrt=[], max_rel_error=1e-3))
+
+
+def test_math_structural_round3_sweep():
+    _run_math_structural_round3()
+
+
+# --- round 3: nn activations / image color / linalg / segment / loss --------
+
+def _run_nn_image_round3():
+    import scipy.special as sps
+
+    rng = np.random.default_rng(97)
+    xv = rng.normal(size=(2, 6))
+    alpha = rng.uniform(0.1, 0.4, size=(6,))
+    img = rng.uniform(0.0, 1.0, size=(1, 3, 3, 3))
+
+    sd = SameDiff()
+    x = sd.placeholder("x", (2, 6))
+    al = sd.placeholder("al", (6,))
+    im = sd.placeholder("im", (1, 3, 3, 3))
+    sd.nn.prelu(x, al, name="pr")
+    sd.nn.crelu(x, name="cr")
+    sd.nn.logSigmoid(x, name="ls")
+    sd.nn.thresholdRelu(x, cutoff=0.2, name="tr")
+    sd.nn.preciseGelu(x, name="pg")
+    sd.image.rgbToYuv(im, name="yuv")
+    sd.image.yuvToRgb(sd.image.rgbToYuv(im), name="yuv_rt")
+    sd.image.rgbToYiq(im, name="yiq")
+    sd.image.yiqToRgb(sd.image.rgbToYiq(im), name="yiq_rt")
+    sd.image.resizeBicubic(im, 3, 3, name="bc")       # identity size
+    sd.image.imageResize(im, 6, 6, method="nearest", name="rn")
+
+    yuv_m = np.array([[0.299, 0.587, 0.114],
+                      [-0.14714119, -0.28886916, 0.43601035],
+                      [0.61497538, -0.51496512, -0.10001026]])
+    yiq_m = np.array([[0.299, 0.587, 0.114],
+                      [0.59590059, -0.27455667, -0.32134392],
+                      [0.21153661, -0.52273617, 0.31119955]])
+    validate(TestCase(
+        sd, {"x": xv, "al": alpha, "im": img},
+        {"pr": np.where(xv >= 0, xv, alpha * xv),
+         "cr": np.concatenate([np.maximum(xv, 0), np.maximum(-xv, 0)], -1),
+         "ls": np.log(1.0 / (1.0 + np.exp(-xv))),
+         "tr": np.where(xv > 0.2, xv, 0.0),
+         "pg": 0.5 * xv * (1.0 + sps.erf(xv / np.sqrt(2.0))),
+         "yuv": img @ yuv_m.T, "yuv_rt": img,
+         "yiq": img @ yiq_m.T, "yiq_rt": img,
+         "bc": img, "rn": img.repeat(2, axis=1).repeat(2, axis=2)},
+        grad_wrt=["x", "al"], max_rel_error=1e-3))
+
+
+def test_nn_image_round3_sweep():
+    _run_nn_image_round3()
+
+
+def _run_linalg_segment_loss_round3():
+    import scipy.linalg as spl
+
+    rng = np.random.default_rng(98)
+    m = rng.normal(size=(3, 3)) * 0.4
+    rect = rng.normal(size=(4, 3))
+    dg = rng.normal(size=(3,))
+    data = rng.normal(size=(6, 2))
+    ids = np.asarray([0, 2, 0, 1, 2, 2])
+    lx = rng.normal(size=(2, 4))
+    llab = rng.integers(0, 2, size=(2, 4)).astype(np.float64)
+    llog = rng.normal(size=(2, 4))
+
+    sd = SameDiff()
+    pm = sd.placeholder("m", (3, 3))
+    pr = sd.placeholder("r", (4, 3))
+    pdg = sd.placeholder("dg", (3,))
+    pdata = sd.placeholder("data", (6, 2))
+    pids = sd.placeholder("ids", (6,))
+    px = sd.placeholder("lx", (2, 4))
+    plab = sd.placeholder("llab", (2, 4))
+    plog = sd.placeholder("llog", (2, 4))
+    sd.linalg.expm(pm, name="em")
+    sd.linalg.pinv(pr, name="pv")
+    sd.linalg.matrixSetDiag(pm, pdg, name="msd")
+    sd._op("segment.unsortedSegmentSqrtN", [pdata, pids], name="sq",
+           num_segments=3)
+    sd.loss.l2Loss(px, name="l2")
+    sd.loss.weightedCrossEntropyWithLogits(plab, plog, weight=2.0,
+                                           name="wce")
+
+    msd = m.copy()
+    np.fill_diagonal(msd, dg)
+    ssum = np.zeros((3, 2))
+    cnt = np.zeros(3)
+    for d, i in zip(data, ids):
+        ssum[i] += d
+        cnt[i] += 1
+    q = 2.0
+    per = ((1 - llab) * llog
+           + (1 + (q - 1) * llab)
+           * (np.log1p(np.exp(-np.abs(llog))) + np.maximum(-llog, 0.0)))
+    validate(TestCase(
+        sd, {"m": m, "r": rect, "dg": dg, "data": data, "ids": ids,
+             "lx": lx, "llab": llab, "llog": llog},
+        {"em": spl.expm(m), "pv": np.linalg.pinv(rect), "msd": msd,
+         "sq": ssum / np.sqrt(np.maximum(cnt, 1.0))[:, None],
+         "l2": (lx ** 2).sum() / 2.0, "wce": per.mean()},
+        grad_wrt=["data", "lx", "llog"], max_rel_error=1e-3))
+
+
+def test_linalg_segment_loss_round3_sweep():
+    _run_linalg_segment_loss_round3()
+
+
+def test_random_round3_statistics():
+    """Determinism + distribution sanity for the round-3 stochastic ops
+    (the _EXEMPT pointers for random.* land here)."""
+    sd = SameDiff()
+    e = sd.random.exponential(2.0, (4000,), seed=7, name="e")
+    g = sd.random.gamma(3.0, 2.0, (4000,), seed=8, name="g")
+    p = sd.random.poisson(4.0, (4000,), seed=9, name="p")
+    ln = sd.random.logNormal(0.0, 0.25, (4000,), seed=10, name="ln")
+    tn = sd.random.truncatedNormal(1.0, 0.5, (4000,), seed=11, name="tn")
+    x = sd.placeholder("x", (100,))
+    sd.random.shuffle(x, seed=12, name="sh")
+
+    xv = np.arange(100, dtype=np.float64)
+    o1 = sd.output({"x": xv}, "e", "g", "p", "ln", "tn", "sh")
+    o2 = sd.output({"x": xv}, "e", "g", "p", "ln", "tn", "sh")
+    for k in o1:
+        np.testing.assert_array_equal(np.asarray(o1[k]), np.asarray(o2[k]))
+    assert abs(np.mean(o1["e"]) - 0.5) < 0.05          # Exp(lam=2): 1/2
+    assert abs(np.mean(o1["g"]) - 1.5) < 0.1           # Gamma(3, beta=2)
+    assert abs(np.mean(o1["p"]) - 4.0) < 0.2           # Poisson(4)
+    assert abs(np.mean(o1["ln"]) - np.exp(0.03125)) < 0.05
+    tnv = np.asarray(o1["tn"])
+    assert tnv.min() >= 0.0 and tnv.max() <= 2.0       # +-2 sigma bounds
+    assert abs(np.mean(tnv) - 1.0) < 0.05
+    sh = np.asarray(o1["sh"])
+    assert sorted(sh.tolist()) == xv.tolist() and not np.all(sh == xv)
