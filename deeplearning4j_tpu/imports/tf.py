@@ -125,13 +125,19 @@ _REDUCE = {"Mean": "mean", "Sum": "sum", "Max": "amax", "Min": "amin",
            "Prod": "prod"}
 
 
-def _require_nhwc(node):
-    df = node.attr["data_format"].s.decode() if node.attr[
-        "data_format"].s else "NHWC"
-    if df not in ("NHWC", ""):
+def _data_format(node) -> str:
+    """NHWC (TF default, also this framework's native layout) or NCHW
+    (GPU-targeted frozen graphs — the reference imports those too). NCHW
+    nodes import by sandwiching the NHWC op between transposes; adjacent
+    pairs cancel during XLA compilation, so a fully-NCHW graph pays one
+    transpose at each conv-stack boundary at most."""
+    df = (node.attr["data_format"].s.decode()
+          if node.attr["data_format"].s else "NHWC")
+    if df not in ("NHWC", "NCHW", ""):
         raise UnsupportedTFOpException(
-            f"node {node.name!r} ({node.op}) uses data_format={df!r}; only "
-            f"NHWC graphs import (re-freeze with NHWC, or transpose)")
+            f"node {node.name!r} ({node.op}) uses data_format={df!r}; "
+            "only NHWC/NCHW import")
+    return df or "NHWC"
 
 
 class TFGraphMapper:
@@ -180,6 +186,14 @@ class _Mapper:
     def _var(self, tf_name: str) -> SDVariable:
         return SDVariable(self.sd, self.names[tf_name])
 
+    def _to_nhwc(self, v: SDVariable, df: str) -> SDVariable:
+        return (self.sd._op("permute", [v], dims=(0, 2, 3, 1))[0]
+                if df == "NCHW" else v)
+
+    def _from_nhwc(self, v: SDVariable, df: str) -> SDVariable:
+        return (self.sd._op("permute", [v], dims=(0, 3, 1, 2))[0]
+                if df == "NCHW" else v)
+
     def _static(self, tf_name: str, node) -> np.ndarray:
         if tf_name not in self.const_np:
             raise UnsupportedTFOpException(
@@ -210,9 +224,177 @@ class _Mapper:
 
     # -- main ----------------------------------------------------------------
     def run(self) -> SameDiff:
+        frames, member_frame, last_enter = self._build_v1_frames()
+        emitted = set()
         for node in self.graph.node:
+            fname = member_frame.get(node.name)
+            if fname is not None:
+                # every value enters a frame through an Enter node, so by
+                # the LAST Enter all loop inputs are mapped and no Exit
+                # consumer has run yet (Exits are downstream of the
+                # Switch -> LoopCond -> Merge -> Enter chain)
+                if fname not in emitted and node is last_enter[fname]:
+                    self._emit_v1_frame(frames[fname])
+                    emitted.add(fname)
+                continue
             self._map_node(node)
         return self.sd
+
+    # -- TF1 control-flow frames (Enter/Merge/Switch/NextIteration/Exit) -----
+    _V1_OPS = ("Enter", "RefEnter", "Merge", "RefMerge", "Switch",
+               "RefSwitch", "Exit", "RefExit", "NextIteration",
+               "RefNextIteration", "LoopCond")
+
+    def _build_v1_frames(self):
+        """Reconstruct v1 while-loop frames (reference ``TFGraphMapper``
+        executes these via FrameIter state in the InferenceSession; here
+        each frame lowers to ONE structured ``sd.while_loop``). Returns
+        ``(frames, member_frame, last_enter)``; all empty when the graph
+        has no v1 control flow. Single-level frames only (nested while
+        loops raise). A Switch/Merge OUTSIDE any frame is v1 ``tf.cond``
+        — unsupported (TF2 functional If imports instead)."""
+        self._node_by_name = {n.name: n for n in self.graph.node}
+        if not any(n.op in self._V1_OPS for n in self.graph.node):
+            return {}, {}, {}
+
+        def base(ref):
+            c = _clean(ref)
+            return c.rsplit(":", 1)[0] if ":" in c else c
+
+        frames: dict[str, dict] = {}
+        member_frame: dict[str, str] = {}
+        for n in self.graph.node:
+            if n.op in ("Enter", "RefEnter"):
+                fname = n.attr["frame_name"].s.decode()
+                f = frames.setdefault(fname, {
+                    "name": fname, "enters": [], "merges": [],
+                    "switches": [], "exits": [], "next_iters": [],
+                    "loopcond": None, "interior": []})
+                f["enters"].append(n)
+                member_frame[n.name] = fname
+        # flood the frame membership forward from the Enters, stopping at
+        # Exit (its consumers are outside); scaffolding classifies by op
+        changed = True
+        while changed:
+            changed = False
+            for n in self.graph.node:
+                if n.name in member_frame:
+                    continue
+                for ref in n.input:
+                    b = base(ref)
+                    if not b or b not in member_frame:
+                        continue
+                    if self._node_by_name[b].op in ("Exit", "RefExit"):
+                        continue
+                    fname = member_frame[b]
+                    member_frame[n.name] = fname
+                    f = frames[fname]
+                    if n.op in ("Merge", "RefMerge"):
+                        f["merges"].append(n)
+                    elif n.op in ("Switch", "RefSwitch"):
+                        f["switches"].append(n)
+                    elif n.op in ("Exit", "RefExit"):
+                        f["exits"].append(n)
+                    elif n.op in ("NextIteration", "RefNextIteration"):
+                        f["next_iters"].append(n)
+                    elif n.op == "LoopCond":
+                        f["loopcond"] = n
+                    else:
+                        f["interior"].append(n)
+                    changed = True
+                    break
+        # an Enter's input lives OUTSIDE its own frame by construction, so
+        # any membership at all means the frame nests inside another
+        for f in frames.values():
+            for e in f["enters"]:
+                b = base(e.input[0])
+                if b in member_frame:
+                    raise UnsupportedTFOpException(
+                        f"nested while frames are not supported (Enter "
+                        f"{e.name!r} of frame {f['name']!r} consumes "
+                        f"{b!r} inside frame {member_frame[b]!r})")
+        stray = [n.name for n in self.graph.node
+                 if n.op in ("Merge", "Switch") and n.name not in member_frame]
+        if stray:
+            raise UnsupportedTFOpException(
+                f"v1 Switch/Merge outside a while frame (tf.cond v1) is "
+                f"not supported: {stray} — re-export with TF2 functional "
+                "control flow (If/StatelessIf imports)")
+        last_enter = {fname: f["enters"][-1] for fname, f in frames.items()}
+        for f in frames.values():
+            if f["loopcond"] is None or not f["merges"]:
+                raise UnsupportedTFOpException(
+                    f"while frame {f['name']!r} has no LoopCond/Merge — "
+                    "not a loop structure this importer understands")
+        return frames, member_frame, last_enter
+
+    def _emit_v1_frame(self, f):
+        """One frame -> ``sd.while_loop``: loop vars are the Merges (init
+        from their Enters), the body runs Switch:1 -> NextIteration, the
+        cond runs Merge -> LoopCond; loop-INVARIANT Enters (constants
+        entering the frame) ride along as extra unchanged carries. Exits
+        bind to the loop outputs."""
+        sd = self.sd
+
+        def base(ref):
+            c = _clean(ref)
+            return c.rsplit(":", 1)[0] if ":" in c else c
+
+        enter_names = {e.name for e in f["enters"]}
+        next_names = {n.name for n in f["next_iters"]}
+        loop_vars = []          # (merge, enter node, next_iteration node)
+        used_enters = set()
+        for m in f["merges"]:
+            refs = [r for r in m.input if not r.startswith("^")]
+            enter = next((self._node_by_name[base(r)] for r in refs
+                          if base(r) in enter_names), None)
+            ni = next((self._node_by_name[base(r)] for r in refs
+                       if base(r) in next_names), None)
+            if enter is None or ni is None:
+                raise UnsupportedTFOpException(
+                    f"Merge {m.name!r}: expected one Enter and one "
+                    "NextIteration input")
+            used_enters.add(enter.name)
+            loop_vars.append((m, enter, ni))
+        inv_enters = [e for e in f["enters"] if e.name not in used_enters]
+        switches = {base(s.input[0]): s for s in f["switches"]}
+        exits = {base(e.input[0]): e for e in f["exits"]}
+
+        init = [self._var(_clean(e.input[0])) for _, e, _ in loop_vars]
+        init += [self._var(_clean(e.input[0])) for e in inv_enters]
+        n_loop = len(loop_vars)
+        cond_target = _clean(f["loopcond"].input[0])
+
+        def bind_common(args):
+            bound = {}
+            for (m, _, _), a in zip(loop_vars, args):
+                bound[m.name] = a          # Merge output 0 = the value
+            for e, a in zip(inv_enters, args[n_loop:]):
+                bound[e.name] = a
+            return bound
+
+        def cond_fn(*args):
+            fm = _V1FrameMapper(self, bind_common(args), args[0].sd)
+            return fm.resolve(cond_target)
+
+        def body_fn(*args):
+            bound = bind_common(args)
+            for (m, _, _), a in zip(loop_vars, args):
+                s = switches.get(m.name)
+                if s is not None:
+                    bound[f"{s.name}:1"] = a   # body reads the true branch
+            fm = _V1FrameMapper(self, bound, args[0].sd)
+            outs = [fm.resolve(_clean(ni.input[0]))
+                    for _, _, ni in loop_vars]
+            return outs + list(args[n_loop:])  # invariants pass through
+
+        outs = sd.while_loop(cond_fn, body_fn, init,
+                             name=f["name"].replace("/", "_") + "_while")
+        for i, (m, _, _) in enumerate(loop_vars):
+            s = switches.get(m.name)
+            e = exits.get(s.name) if s is not None else None
+            if e is not None:
+                self._bind(e, outs[i])
 
     def _map_node(self, node):
         sd, op = self.sd, node.op
@@ -245,8 +427,14 @@ class _Mapper:
                        transpose_b=node.attr["transpose_b"].b)[0]
             self._bind(node, v)
         elif op == "BiasAdd":
-            v = sd._op("nn.biasAdd",
-                       [self._var(ins[0]), self._var(ins[1])])[0]
+            if _data_format(node) == "NCHW":
+                # bias adds over axis 1: reshape to [C, 1, 1] broadcast
+                b = self._var(ins[1])
+                b3 = sd._op("reshape", [b], shape=(-1, 1, 1))[0]
+                v = sd._op("math.add", [self._var(ins[0]), b3])[0]
+            else:
+                v = sd._op("nn.biasAdd",
+                           [self._var(ins[0]), self._var(ins[1])])[0]
             self._bind(node, v)
         elif op in _BINARY:
             v = sd._op(f"math.{_BINARY[op]}",
@@ -263,21 +451,26 @@ class _Mapper:
             v = sd._op("nn.softmax", [self._var(ins[0])], axis=-1)[0]
             self._bind(node, v)
         elif op == "Conv2D":
-            _require_nhwc(node)
-            strides = tuple(node.attr["strides"].list.i)[1:3]
+            df = _data_format(node)
+            hw = slice(2, 4) if df == "NCHW" else slice(1, 3)
+            strides = tuple(node.attr["strides"].list.i)[hw]
             padding = node.attr["padding"].s.decode() or "SAME"
-            dil = tuple(node.attr["dilations"].list.i or (1, 1, 1, 1))[1:3]
+            dil = tuple(node.attr["dilations"].list.i or (1,) * 4)[hw]
             x, w = self._var(ins[0]), self._var(ins[1])
+            x = self._to_nhwc(x, df)
             zero = sd.constant(np.zeros((1,), np.float32))
             v = sd._op("cnn.conv2d", [x, w, zero], strides=strides,
                        padding=padding, dilation=dil)[0]
-            self._bind(node, v)
+            self._bind(node, self._from_nhwc(v, df))
         elif op == "DepthwiseConv2dNative":
-            _require_nhwc(node)
-            strides = tuple(node.attr["strides"].list.i)[1:3]
+            df = _data_format(node)
+            hw = slice(2, 4) if df == "NCHW" else slice(1, 3)
+            strides = tuple(node.attr["strides"].list.i)[hw]
             padding = node.attr["padding"].s.decode() or "SAME"
             x, w = self._var(ins[0]), self._var(ins[1])
+            x = self._to_nhwc(x, df)
             # TF depthwise kernel [H,W,C,mult] -> HWIO with grouping
+            # (kernel layout is HWCM regardless of data_format)
             wnp = self.const_np.get(ins[1])
             if wnp is None:
                 raise UnsupportedTFOpException(
@@ -287,24 +480,37 @@ class _Mapper:
             zero = sd.constant(np.zeros((1,), np.float32))
             v = sd._op("cnn.depthwiseConv2d", [x, w2, zero],
                        strides=strides, padding=padding)[0]
-            self._bind(node, v)
+            self._bind(node, self._from_nhwc(v, df))
         elif op in ("MaxPool", "AvgPool"):
-            _require_nhwc(node)
-            k = tuple(node.attr["ksize"].list.i)[1:3]
-            s = tuple(node.attr["strides"].list.i)[1:3]
+            df = _data_format(node)
+            hw = slice(2, 4) if df == "NCHW" else slice(1, 3)
+            k = tuple(node.attr["ksize"].list.i)[hw]
+            s = tuple(node.attr["strides"].list.i)[hw]
             padding = node.attr["padding"].s.decode() or "VALID"
             impl = "cnn.maxPooling2d" if op == "MaxPool" else "cnn.avgPooling2d"
-            v = sd._op(impl, [self._var(ins[0])], k=k, s=s,
-                       padding=padding)[0]
-            self._bind(node, v)
+            x = self._to_nhwc(self._var(ins[0]), df)
+            v = sd._op(impl, [x], k=k, s=s, padding=padding)[0]
+            self._bind(node, self._from_nhwc(v, df))
         elif op in ("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"):
-            _require_nhwc(node)
+            df = _data_format(node)
             eps = node.attr["epsilon"].f or 1e-3
             x, gamma, beta, mean, var_ = (self._var(i) for i in ins[:5])
-            # NOTE: proto3 can't distinguish a missing is_training attr
-            # from an explicit false; TF's op default is True, but frozen
-            # graphs are inference graphs — treat absent/false as
-            # inference and require an explicit true for the training form
+            x = self._to_nhwc(x, df)
+            # proto3 can't distinguish a missing is_training attr from an
+            # explicit false; TF's OP default is True, but frozen graphs
+            # are inference graphs — treat absent as inference LOUDLY
+            # (round-2 advisor: a GraphDef saved with default attrs
+            # stripped would otherwise import with silently different
+            # numerics) and require an explicit true for the training form
+            if "is_training" not in node.attr:
+                import warnings
+
+                warnings.warn(
+                    f"{node.name}: FusedBatchNorm has no is_training attr; "
+                    "importing as INFERENCE (running stats). TF's op "
+                    "default is training — if this graph was saved with "
+                    "default-valued attrs stripped, re-freeze it with "
+                    "explicit attrs", stacklevel=2)
             if node.attr["is_training"].b:
                 # training mode: batch statistics computed in-graph (the
                 # mean/variance inputs are ignored, as in TF); outputs
@@ -327,6 +533,7 @@ class _Mapper:
             stats = [mean, var_, mean, var_]
             if op == "FusedBatchNormV3":
                 stats.append(var_)
+            y = self._from_nhwc(y, df)
             outs = [y] + [sd._op("identity", [t])[0] for t in stats]
             self._bind_multi(node, outs)
         elif op == "Reshape":
@@ -556,6 +763,47 @@ def _clean_func_ref(ref: str) -> str:
         return parts[0]
     idx = parts[-1]
     return parts[0] if idx == "0" else f"{parts[0]}:{idx}"
+
+
+class _V1FrameMapper(_Mapper):
+    """Maps one SLICE of a v1 while frame (the cond subgraph from the
+    Merges, or the body subgraph from the Switches' true branches) on
+    demand into the ``sd.while_loop`` build-probe subgraph. Interior nodes
+    resolve recursively; in-frame Consts map locally; anything else from
+    outside the frame is a structure error (TF1 values enter via Enter)."""
+
+    def __init__(self, parent: "_Mapper", bound: dict, sd):
+        self.graph = parent.graph
+        self.funcs = parent.funcs
+        self.sd = sd
+        self._node_by_name = parent._node_by_name
+        self.names = {k: v.name for k, v in bound.items()}
+        self.const_np = dict(parent.const_np)
+
+    def resolve(self, ref: str) -> SDVariable:
+        self._ensure(ref)
+        return SDVariable(self.sd, self.names[ref])
+
+    def _ensure(self, ref: str):
+        if not ref or ref in self.names:
+            return
+        key = ref.rsplit(":", 1)[0] if ":" in ref else ref
+        node = self._node_by_name.get(key)
+        if node is None:
+            raise UnsupportedTFOpException(
+                f"unknown node {ref!r} referenced inside a while frame")
+        if node.op in _Mapper._V1_OPS:
+            raise UnsupportedTFOpException(
+                f"{node.name}: {node.op} reached while slicing a v1 while "
+                "frame — the value should be a loop carry (nested/cyclic "
+                "structure this importer does not understand)")
+        if node.op == "Placeholder":
+            raise UnsupportedTFOpException(
+                f"{node.name}: Placeholder read inside a while frame; TF1 "
+                "loops must import values through Enter nodes")
+        for i in self._inputs(node):
+            self._ensure(i)
+        self._map_node(node)
 
 
 class _FuncMapper(_Mapper):

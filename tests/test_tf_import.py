@@ -197,15 +197,52 @@ def test_const_through_identity(rng):
     assert np.asarray(out["r"]).shape == (3, 2, 2)
 
 
-def test_nchw_graph_rejected(rng):
+def test_nchw_graph_imports(rng):
+    """GPU-targeted NCHW graphs import (round 3): the mapper sandwiches
+    each NCHW node between transposes, so results match the NHWC oracle
+    exactly — conv + bias + pool + batch-norm, all in NCHW."""
+    import jax
+
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)   # NCHW
+    k = rng.normal(size=(3, 3, 3, 4)).astype(np.float32)   # HWIO always
+    b = rng.normal(size=(4,)).astype(np.float32)
+    gamma = rng.uniform(0.5, 1.5, 4).astype(np.float32)
+    beta = rng.normal(size=(4,)).astype(np.float32)
+    mean = rng.normal(size=(4,)).astype(np.float32) * 0.1
+    var = rng.uniform(0.5, 1.5, 4).astype(np.float32)
+
     g = pb.GraphDef()
     _placeholder(g, "x", (0, 3, 8, 8))
-    _const(g, "k", rng.normal(size=(3, 3, 3, 4)).astype(np.float32))
+    _const(g, "k", k)
+    _const(g, "b", b)
+    for nm, arr in (("gamma", gamma), ("beta", beta), ("mean", mean),
+                    ("var", var)):
+        _const(g, nm, arr)
     n = _node(g, "conv", "Conv2D", "x", "k",
               strides=[1, 1, 1, 1], padding=b"SAME")
     n.attr["data_format"].s = b"NCHW"
-    with pytest.raises(UnsupportedTFOpException):
-        TFGraphMapper.import_graph(g.SerializeToString())
+    n2 = _node(g, "bias", "BiasAdd", "conv", "b")
+    n2.attr["data_format"].s = b"NCHW"
+    n3 = _node(g, "bn", "FusedBatchNormV3", "bias", "gamma", "beta",
+               "mean", "var", epsilon=1e-3, is_training=False)
+    n3.attr["data_format"].s = b"NCHW"
+    n4 = _node(g, "pool", "MaxPool", "bn",
+               ksize=[1, 1, 2, 2], strides=[1, 1, 2, 2], padding=b"VALID")
+    n4.attr["data_format"].s = b"NCHW"
+
+    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    out = np.asarray(sd.output({"x": x}, "pool")["pool"])
+    assert out.shape == (2, 4, 4, 4)  # NCHW out
+
+    # NHWC oracle on transposed data
+    xh = x.transpose(0, 2, 3, 1)
+    y = np.asarray(jax.lax.conv_general_dilated(
+        xh, k, (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))) + b
+    y = gamma * (y - mean) / np.sqrt(var + 1e-3) + beta
+    want = y.reshape(2, 4, 2, 4, 2, 4).max(axis=(2, 4))  # 2x2 maxpool
+    np.testing.assert_allclose(out, want.transpose(0, 3, 1, 2),
+                               rtol=2e-4, atol=2e-4)
 
 
 def test_bfloat16_const_decodes():
@@ -938,3 +975,90 @@ def test_import_if_multi_output(rng):
         np.testing.assert_allclose(np.asarray(out["branch"]), wa, rtol=1e-5)
         np.testing.assert_allclose(np.asarray(out["branch:1"]), wb,
                                    rtol=1e-5)
+
+
+def test_import_v1_while_frames(rng):
+    """TF1 frame control flow (round 3): Enter/Merge/Switch/LoopCond/
+    NextIteration/Exit reconstruct into ONE structured sd.while_loop.
+    Loop: i=0, acc=1; while i < 5: i += 1; acc *= 2 -> i=5, acc=32; a
+    loop-INVARIANT Enter (the limit) rides through, and downstream nodes
+    consume the Exit outputs."""
+    g = pb.GraphDef()
+    _const(g, "i0", np.asarray(0.0, np.float32))
+    _const(g, "acc0", np.asarray(1.0, np.float32))
+    _const(g, "limit", np.asarray(5.0, np.float32))
+    _node(g, "enter_i", "Enter", "i0", frame_name=b"loop")
+    _node(g, "enter_acc", "Enter", "acc0", frame_name=b"loop")
+    n = _node(g, "enter_limit", "Enter", "limit", frame_name=b"loop")
+    n.attr["is_constant"].b = True
+    _node(g, "merge_i", "Merge", "enter_i", "next_i")
+    _node(g, "merge_acc", "Merge", "enter_acc", "next_acc")
+    _node(g, "less", "Less", "merge_i", "enter_limit")
+    _node(g, "cond", "LoopCond", "less")
+    _node(g, "switch_i", "Switch", "merge_i", "cond")
+    _node(g, "switch_acc", "Switch", "merge_acc", "cond")
+    _const(g, "one", np.asarray(1.0, np.float32))
+    _const(g, "two", np.asarray(2.0, np.float32))
+    _node(g, "add_i", "Add", "switch_i:1", "one")
+    _node(g, "mul_acc", "Mul", "switch_acc:1", "two")
+    _node(g, "next_i", "NextIteration", "add_i")
+    _node(g, "next_acc", "NextIteration", "mul_acc")
+    _node(g, "exit_i", "Exit", "switch_i")
+    _node(g, "exit_acc", "Exit", "switch_acc")
+    _node(g, "final", "Mul", "exit_acc", "exit_i")
+
+    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    out = sd.output({}, "exit_i", "exit_acc", "final")
+    assert float(np.asarray(out["exit_i"])) == 5.0
+    assert float(np.asarray(out["exit_acc"])) == 32.0
+    assert float(np.asarray(out["final"])) == 160.0
+
+
+def test_import_v1_while_serializes(tmp_path, rng):
+    """The reconstructed while_loop round-trips through serde like
+    natively-built control flow."""
+    g = pb.GraphDef()
+    _const(g, "x0", np.asarray(2.0, np.float32))
+    _const(g, "lim", np.asarray(100.0, np.float32))
+    _node(g, "enter_x", "Enter", "x0", frame_name=b"f")
+    n = _node(g, "enter_l", "Enter", "lim", frame_name=b"f")
+    n.attr["is_constant"].b = True
+    _node(g, "merge_x", "Merge", "enter_x", "next_x")
+    _node(g, "less", "Less", "merge_x", "enter_l")
+    _node(g, "cond", "LoopCond", "less")
+    _node(g, "switch_x", "Switch", "merge_x", "cond")
+    _node(g, "sq", "Mul", "switch_x:1", "switch_x:1")
+    _node(g, "next_x", "NextIteration", "sq")
+    _node(g, "exit_x", "Exit", "switch_x")
+    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    assert float(np.asarray(sd.output({}, "exit_x")["exit_x"])) == 256.0
+
+    from deeplearning4j_tpu.samediff.core import SameDiff
+
+    p = str(tmp_path / "v1while.sd")
+    sd.save(p)
+    sd2 = SameDiff.load(p)
+    assert float(np.asarray(sd2.output({}, "exit_x")["exit_x"])) == 256.0
+
+
+def test_import_v1_cond_rejected(rng):
+    """v1 Switch/Merge OUTSIDE a while frame (tf.cond v1) stays
+    unsupported with a clear error (TF2 functional If imports)."""
+    g = pb.GraphDef()
+    _const(g, "p", np.asarray(1, np.int32))
+    _const(g, "x", np.asarray(1.0, np.float32))
+    _node(g, "sw", "Switch", "x", "p")
+    _node(g, "m", "Merge", "sw", "sw:1")
+    with pytest.raises(UnsupportedTFOpException, match="tf.cond v1"):
+        TFGraphMapper.import_graph(g.SerializeToString())
+
+
+def test_import_nested_v1_frames_rejected(rng):
+    g = pb.GraphDef()
+    _const(g, "x0", np.asarray(0.0, np.float32))
+    _node(g, "enter_a", "Enter", "x0", frame_name=b"outer")
+    _node(g, "enter_b", "Enter", "enter_a", frame_name=b"inner")
+    _node(g, "merge_a", "Merge", "enter_a", "enter_a")
+    _node(g, "cond", "LoopCond", "merge_a")
+    with pytest.raises(UnsupportedTFOpException, match="nested"):
+        TFGraphMapper.import_graph(g.SerializeToString())
