@@ -410,12 +410,27 @@ class Bidirectional(Layer):
         bwd_p = {k[1:]: v for k, v in params.items() if k.startswith("b")}
         rf, rb = (jax.random.split(rng) if rng is not None else (None, None))
         carry = self.layer.zero_carry(x.shape[0], x.dtype)
-        y_f, _ = self.layer.forward_with_carry(fwd_p, carry, x, mask=mask,
-                                               train=train, rng=rf)
-        x_rev = reverse_sequence(x, mask)
-        y_b, _ = self.layer.forward_with_carry(bwd_p, carry, x_rev, mask=mask,
-                                               train=train, rng=rb)
-        y_b = reverse_sequence(y_b, mask)
+        if getattr(self.layer, "go_backwards", False):
+            # Keras Bidirectional over a go_backwards inner layer (round
+            # 3): the FORWARD copy processes the sequence reversed and
+            # emits in processing order (go_backwards semantics, applied
+            # via explicit reversal around the raw scan), while the
+            # BACKWARD copy is the clone with go_backwards flipped off —
+            # plain order — whose output the wrapper time-reverses as
+            # always. Matches Keras' backward_layer construction.
+            y_f, _ = self.layer.forward_with_carry(
+                fwd_p, carry, reverse_sequence(x, mask), mask=mask,
+                train=train, rng=rf)
+            y_b, _ = self.layer.forward_with_carry(
+                bwd_p, carry, x, mask=mask, train=train, rng=rb)
+            y_b = reverse_sequence(y_b, mask)
+        else:
+            y_f, _ = self.layer.forward_with_carry(
+                fwd_p, carry, x, mask=mask, train=train, rng=rf)
+            x_rev = reverse_sequence(x, mask)
+            y_b, _ = self.layer.forward_with_carry(
+                bwd_p, carry, x_rev, mask=mask, train=train, rng=rb)
+            y_b = reverse_sequence(y_b, mask)
         if self.mode is BidirectionalMode.ADD:
             return y_f + y_b, state
         if self.mode is BidirectionalMode.MUL:

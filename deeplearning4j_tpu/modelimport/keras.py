@@ -251,14 +251,11 @@ def _map_layer(cls: str, cfg: dict, name: str, is_output: bool = False):
                    name=name)
     if cls == "Bidirectional":
         inner_cfg = cfg.get("layer", {})
-        if inner_cfg.get("config", {}).get("go_backwards", False):
-            raise InvalidKerasConfigurationException(
-                f"{name}: Bidirectional over a go_backwards layer is not "
-                "supported (the wrapper's own reversal would compose with "
-                "it; re-export with go_backwards=False)")
+        # go_backwards=True inner layers import as-is (round 3): the
+        # Bidirectional runtime applies Keras' exact composition (forward
+        # copy processes reversed, backward copy is the flipped clone)
         inner = _map_layer(inner_cfg.get("class_name"),
-                           dict(inner_cfg.get("config", {}),
-                                go_backwards=False),
+                           dict(inner_cfg.get("config", {})),
                            name + "_inner")
         merge = {"concat": BidirectionalMode.CONCAT,
                  "sum": BidirectionalMode.ADD,

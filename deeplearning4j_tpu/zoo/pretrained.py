@@ -149,6 +149,19 @@ def load_pretrained(model, ptype: PretrainedType = PretrainedType.IMAGENET,
         # detect cache corruption even without a class-pinned checksum
         digest = sha256_of(path)
         path.with_suffix(".zip.sha256").write_text(digest + "\n")
+        if model.pretrained_checksum(ptype) is None:
+            # trust-on-first-use (round-2 advisor): with no class-pinned
+            # checksum the sidecar is derived from the just-downloaded
+            # bytes, so it detects LATER corruption but cannot detect a
+            # tampered/truncated fetch — make that visible to the caller
+            import warnings
+
+            warnings.warn(
+                f"{name} {ptype.name}: no pinned checksum for this "
+                f"artifact — the download from {url} is trusted on first "
+                "use (the .sha256 sidecar only guards against cache "
+                "corruption, not a bad fetch). Pin pretrained_checksum() "
+                "to remove this trust assumption.", stacklevel=2)
         _verify(path, model.pretrained_checksum(ptype), name, actual=digest)
     else:
         _verify(path, model.pretrained_checksum(ptype), name)

@@ -827,3 +827,137 @@ def test_permute_validates_dims():
 
     with pytest.raises(ValueError, match="permutation"):
         Permute(dims=(1, 3)).output_type(InputType.recurrent(3, timesteps=4))
+
+
+def test_import_bidirectional_over_go_backwards(tmp_path, rng):
+    """Round 3 (round-2 residual): Bidirectional over a go_backwards
+    inner layer imports with Keras' exact composition — the forward copy
+    processes the sequence REVERSED and emits in processing order, the
+    backward copy is the flipped clone (plain order) whose output the
+    wrapper reverses."""
+    u, fdim, t = 3, 2, 5
+    mk = lambda *s: rng.normal(size=s).astype(np.float32)  # noqa: E731
+    fk, fr, fb = mk(fdim, 4 * u), mk(u, 4 * u), mk(4 * u)
+    bk, br, bb = mk(fdim, 4 * u), mk(u, 4 * u), mk(4 * u)
+    w2 = mk(2 * u, 2)
+    cfg = {"class_name": "Sequential", "config": {"name": "b", "layers": [
+        {"class_name": "Bidirectional", "config": {
+            "name": "bidi", "merge_mode": "concat",
+            "batch_input_shape": [None, t, fdim],
+            "layer": {"class_name": "LSTM", "config": {
+                "name": "lstm", "units": u, "activation": "tanh",
+                "recurrent_activation": "sigmoid",
+                "go_backwards": True,
+                "return_sequences": True}}}},
+        _dense_cfg("dense", 2, "softmax"),
+    ]}}
+    path = str(tmp_path / "bidi_gb.h5")
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(cfg)
+        mw = f.create_group("model_weights")
+        g = mw.create_group("bidi").create_group("bidi")
+        gf = g.create_group("forward_lstm")
+        gf.create_dataset("kernel", data=fk)
+        gf.create_dataset("recurrent_kernel", data=fr)
+        gf.create_dataset("bias", data=fb)
+        gb = g.create_group("backward_lstm")
+        gb.create_dataset("kernel", data=bk)
+        gb.create_dataset("recurrent_kernel", data=br)
+        gb.create_dataset("bias", data=bb)
+        gd = mw.create_group("dense").create_group("dense")
+        gd.create_dataset("kernel", data=w2)
+        gd.create_dataset("bias", data=np.zeros(2, np.float32))
+
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = rng.normal(size=(2, t, fdim)).astype(np.float32)
+    got = np.asarray(net.output(x))
+
+    def np_lstm(x, kernel, rec, bias):
+        ki, kf_, kc, ko = np.split(kernel, 4, axis=1)
+        ri, rf_, rc, ro = np.split(rec, 4, axis=1)
+        bi, bf_, bc, bo = np.split(bias, 4)
+        h = np.zeros((x.shape[0], u), np.float32)
+        c = np.zeros((x.shape[0], u), np.float32)
+        outs = []
+        for ti in range(x.shape[1]):
+            xt = x[:, ti]
+            i = _sigmoid(xt @ ki + h @ ri + bi)
+            f_ = _sigmoid(xt @ kf_ + h @ rf_ + bf_)
+            g_ = np.tanh(xt @ kc + h @ rc + bc)
+            o = _sigmoid(xt @ ko + h @ ro + bo)
+            c = f_ * c + i * g_
+            h = o * np.tanh(c)
+            outs.append(h.copy())
+        return np.stack(outs, 1)
+
+    yf = np_lstm(x[:, ::-1], fk, fr, fb)          # NOT re-reversed (Keras)
+    yb = np_lstm(x, bk, br, bb)[:, ::-1]          # flipped clone, reversed
+    hs = np.concatenate([yf, yb], axis=-1)
+    logits = hs @ w2
+    want = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+# --------------------------------------------------------------------------
+# round 3: HDF5 layout robustness (round-2 advisor: the fixtures are
+# self-authored, so exercise the reader over the on-disk variants a real
+# Keras writer produces — chunked/compressed datasets, attribute encodings,
+# wider dtypes)
+# --------------------------------------------------------------------------
+
+def _mlp_cfg(fdim=4):
+    return {"class_name": "Sequential", "config": {"name": "m", "layers": [
+        {"class_name": "Dense", "config": {
+            "name": "d1", "units": 6, "activation": "tanh",
+            "batch_input_shape": [None, fdim]}},
+        _dense_cfg("d2", 3, "softmax"),
+    ]}}
+
+
+@pytest.mark.parametrize("variant", ["chunked_gzip", "bytes_attr",
+                                     "vlen_str_attr", "float64"])
+def test_h5_layout_variants_import_identically(tmp_path, rng, variant):
+    fdim = 4
+    w1 = rng.normal(size=(fdim, 6)).astype(np.float32)
+    b1 = rng.normal(size=(6,)).astype(np.float32)
+    w2 = rng.normal(size=(6, 3)).astype(np.float32)
+    b2 = rng.normal(size=(3,)).astype(np.float32)
+    cfg_json = json.dumps(_mlp_cfg(fdim))
+
+    # reference file via the plain writer
+    ref = str(tmp_path / "ref.h5")
+    _write_keras_h5(ref, _mlp_cfg(fdim), {
+        "d1": {"kernel": w1, "bias": b1},
+        "d2": {"kernel": w2, "bias": b2}})
+    x = rng.normal(size=(5, fdim)).astype(np.float32)
+    want = np.asarray(
+        KerasModelImport.import_keras_sequential_model_and_weights(ref)
+        .output(x))
+
+    path = str(tmp_path / f"{variant}.h5")
+    with h5py.File(path, "w") as f:
+        if variant == "bytes_attr":
+            f.attrs["model_config"] = np.bytes_(cfg_json)
+        elif variant == "vlen_str_attr":
+            f.attrs.create("model_config", cfg_json,
+                           dtype=h5py.string_dtype("utf-8"))
+        else:
+            f.attrs["model_config"] = cfg_json
+        mw = f.create_group("model_weights")
+        for name, (k, b) in (("d1", (w1, b1)), ("d2", (w2, b2))):
+            g = mw.create_group(name).create_group(name)
+            if variant == "chunked_gzip":
+                g.create_dataset("kernel", data=k, chunks=(2, 3),
+                                 compression="gzip", shuffle=True)
+                g.create_dataset("bias", data=b, chunks=(2,),
+                                 compression="gzip")
+            elif variant == "float64":
+                g.create_dataset("kernel", data=k.astype(np.float64))
+                g.create_dataset("bias", data=b.astype(np.float64))
+            else:
+                g.create_dataset("kernel", data=k)
+                g.create_dataset("bias", data=b)
+
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
