@@ -82,6 +82,42 @@ def _chart(title: str, series: Dict[str, Tuple[List[float], List[float]]],
             f'</text></svg></div>')
 
 
+_HW, _HH = 150, 90
+
+
+def _hist_svg(h: dict, color: str) -> str:
+    """One small-multiple histogram: bars over [min, max]."""
+    counts = h.get("counts") or []
+    peak = max(counts) or 1
+    n = len(counts)
+    bw = (_HW - 8) / max(n, 1)
+    bars = "".join(
+        f'<rect x="{4 + i * bw:.1f}" '
+        f'y="{_HH - 18 - (c / peak) * (_HH - 26):.1f}" '
+        f'width="{max(bw - 1, 1):.1f}" '
+        f'height="{(c / peak) * (_HH - 26):.1f}" fill="{color}"/>'
+        for i, c in enumerate(counts))
+    return (f'<svg width="{_HW}" height="{_HH}">{bars}'
+            f'<text x="4" y="{_HH - 4}" font-size="9" fill="#666">'
+            f'{h.get("min", 0):.2g}</text>'
+            f'<text x="{_HW - 4}" y="{_HH - 4}" font-size="9" fill="#666" '
+            f'text-anchor="end">{h.get("max", 0):.2g}</text></svg>')
+
+
+def _hist_panel(title: str, per_layer: dict, color: str) -> str:
+    """Latest per-layer histograms as a row of small multiples (reference
+    dashboard: parameter/update/activation/gradient histogram panels)."""
+    if not per_layer:
+        return ""
+    cells = "".join(
+        f'<div style="display:inline-block;margin:4px;text-align:center">'
+        f'<div style="font-size:11px">{html.escape(str(layer))}</div>'
+        f'{_hist_svg(h, color)}</div>'
+        for layer, h in sorted(per_layer.items()))
+    return (f'<div class="chart"><h3>{html.escape(title)}</h3>{cells}'
+            f'</div>')
+
+
 class UIServer:
     """Reference ``UIServer#getInstance().attach(storage)`` — here a
     renderer over the same storage."""
@@ -238,12 +274,31 @@ class UIServer:
                 pmag.setdefault(f"layer {layer}", ([], []))
                 pmag[f"layer {layer}"][0].append(it)
                 pmag[f"layer {layer}"][1].append(v)
+        # latest histogram snapshot (reference dashboard histogram panels)
+        latest_hists = {}
+        for r in records:
+            for key in ("param_histograms", "update_histograms",
+                        "activation_histograms", "gradient_histograms"):
+                if r.get(key):
+                    latest_hists[key] = r[key]
         body = "".join([
             _chart("Model score vs iteration", score),
             _chart("log10 update:param ratio", ratio,
                    "(healthy ≈ -3)"),
             _chart("Parameter mean magnitude", pmag),
             _chart("Iteration time", timing, "seconds"),
+            _hist_panel("Parameter histograms (latest)",
+                        latest_hists.get("param_histograms", {}),
+                        "#1f77b4"),
+            _hist_panel("Update histograms (latest)",
+                        latest_hists.get("update_histograms", {}),
+                        "#d62728"),
+            _hist_panel("Activation histograms (latest)",
+                        latest_hists.get("activation_histograms", {}),
+                        "#2ca02c"),
+            _hist_panel("Gradient histograms (latest)",
+                        latest_hists.get("gradient_histograms", {}),
+                        "#9467bd"),
         ]) or "<p>No stats collected yet.</p>"
         refresh = (f"<meta http-equiv='refresh' content='{refresh_seconds}'>"
                    if refresh_seconds else "")

@@ -131,6 +131,37 @@ class RemoteUIStatsStorageRouter(StatsStorage):
         return list(self._records)
 
 
+def _histogram(values: np.ndarray, bins: int) -> Optional[dict]:
+    """Fixed-bin histogram record {min, max, counts} (the reference
+    ``StatsListener`` ships per-layer histograms to the dashboard's
+    parameter/update/activation/gradient panels)."""
+    v = np.asarray(values, np.float64).ravel()
+    v = v[np.isfinite(v)]
+    if v.size == 0:
+        return None
+    lo, hi = float(v.min()), float(v.max())
+    if lo == hi:
+        hi = lo + 1e-12
+    counts, _ = np.histogram(v, bins=bins, range=(lo, hi))
+    return {"min": lo, "max": hi, "counts": counts.tolist()}
+
+
+def _layer_histograms(tree, bins: int) -> Dict[str, dict]:
+    """One histogram per layer over the concatenation of its tensors."""
+    out = {}
+    for layer_idx, params in (tree or {}).items():
+        arrs = ([np.asarray(v).ravel() for v in params.values()]
+                if isinstance(params, dict)
+                else [np.asarray(params).ravel()])
+        if not arrs:
+            continue
+        h = _histogram(np.concatenate(arrs) if len(arrs) > 1 else arrs[0],
+                       bins)
+        if h is not None:
+            out[str(layer_idx)] = h
+    return out
+
+
 def _mean_magnitude(tree) -> Dict[str, float]:
     out = {}
     for layer_idx, params in (tree or {}).items():
@@ -151,13 +182,27 @@ class StatsListener(TrainingListener):
     score, examples/sec, per-layer parameter mean magnitude, UPDATE mean
     magnitude (params delta since the previous collection), and the
     log10(update/param) ratio — the reference's signature learning-rate
-    diagnostic (healthy ≈ -3)."""
+    diagnostic (healthy ≈ -3).
+
+    ``histograms=True`` additionally records per-layer parameter and
+    UPDATE histograms (round 3, the reference dashboard's signature
+    panels); with a ``sample_ds`` it also records per-layer ACTIVATION
+    histograms (via ``model.feed_forward``) and GRADIENT histograms (via
+    ``model.compute_gradient_and_score``) on that fixed probe batch.
+    Histogram cost is one host d2h of params (+ one extra fwd/bwd when
+    ``sample_ds`` is set) per collection — raise ``frequency`` to
+    amortize; measured in tests/test_training_tools.py."""
 
     def __init__(self, storage: StatsStorage, frequency: int = 1,
-                 session_id: Optional[str] = None):
+                 session_id: Optional[str] = None,
+                 histograms: bool = False, histogram_bins: int = 20,
+                 sample_ds=None):
         self.storage = storage
         self.frequency = max(1, int(frequency))
         self.session_id = session_id or f"session_{int(time.time())}"
+        self.histograms = bool(histograms)
+        self.histogram_bins = int(histogram_bins)
+        self.sample_ds = sample_ds
         self._prev_params = None
         self._last_time = None
 
@@ -196,6 +241,40 @@ class StatsListener(TrainingListener):
                 if p > 0 and u > 0:
                     ratios[k] = math.log10(u / p)
             rec["update_param_ratio_log10"] = ratios
+            if self.histograms:
+                rec["update_histograms"] = _layer_histograms(
+                    updates, self.histogram_bins)
+        if self.histograms:
+            rec["param_histograms"] = _layer_histograms(
+                params, self.histogram_bins)
+            if self.sample_ds is not None:
+                self._probe_histograms(model, rec)
         self._prev_params = params
         self._last_time = now
         self.storage.put(rec)
+
+    def _probe_histograms(self, model, rec):
+        """Activation + gradient histograms on the fixed probe batch."""
+        ds = self.sample_ds
+        try:
+            feats = getattr(ds, "features", ds)
+            if hasattr(model, "network_inputs") or hasattr(
+                    model.conf, "network_inputs"):  # ComputationGraph
+                feats = feats if isinstance(feats, (list, tuple)) else [feats]
+                acts = model.feed_forward(*feats)
+            else:
+                acts = {str(i): a
+                        for i, a in enumerate(model.feed_forward(feats))}
+            rec["activation_histograms"] = _layer_histograms(
+                {k: np.asarray(v) for k, v in acts.items()},
+                self.histogram_bins)
+        except (RuntimeError, TypeError, ValueError):
+            pass  # probe must never break training
+        try:
+            grads, _ = model.compute_gradient_and_score(ds)
+            rec["gradient_histograms"] = _layer_histograms(
+                {k: {pk: np.asarray(pv) for pk, pv in lg.items()}
+                 for k, lg in grads.items()},
+                self.histogram_bins)
+        except (RuntimeError, TypeError, ValueError):
+            pass

@@ -538,3 +538,101 @@ def test_remote_ui_stats_router():
     net2.fit_batch(_data())          # server is down: no exception
     router2.flush(timeout=10.0)
     assert router2.dropped == 1
+
+
+# --------------------------------------------------------------------------
+# round 3: per-layer histograms (reference dashboard histogram panels)
+# --------------------------------------------------------------------------
+
+def test_stats_listener_histograms_and_panels(tmp_path):
+    import time as _time
+
+    from deeplearning4j_tpu.ui.stats import _histogram
+
+    ds = _data(32)
+    net = MultiLayerNetwork(_conf()).init()
+    storage = InMemoryStatsStorage()
+    net.set_listeners(StatsListener(storage, frequency=1, histograms=True,
+                                    histogram_bins=16, sample_ds=ds))
+    net.fit(ArrayDataSetIterator(ds.features, ds.labels, 16), epochs=2)
+
+    recs = storage.records()
+    assert recs
+    last = recs[-1]
+    for key in ("param_histograms", "update_histograms",
+                "activation_histograms", "gradient_histograms"):
+        assert key in last, key
+        assert last[key], key
+        for layer, h in last[key].items():
+            assert sum(h["counts"]) > 0 and h["min"] <= h["max"], (key,
+                                                                   layer)
+            assert len(h["counts"]) == 16
+    # param histogram counts cover every parameter scalar of the layer
+    n0 = sum(np.asarray(v).size for v in net.params["0"].values())
+    assert sum(last["param_histograms"]["0"]["counts"]) == n0
+    # activation histograms keyed per layer (3 layers)
+    assert set(last["activation_histograms"]) == {"0", "1", "2"}
+
+    # dashboard renders the histogram panels
+    ui = UIServer().attach(storage)
+    html_text = ui.render_html()
+    for title in ("Parameter histograms", "Update histograms",
+                  "Activation histograms", "Gradient histograms"):
+        assert title in html_text
+
+    # degenerate input: constant tensor still histograms (min==max)
+    h = _histogram(np.zeros(10), 8)
+    assert sum(h["counts"]) == 10
+
+    # measured overhead: a histogram collection must stay well under the
+    # cost of a handful of training steps (here: just bounded sanity)
+    t0 = _time.monotonic()
+    net.fit_batch(ds)
+    assert _time.monotonic() - t0 < 30.0
+
+
+def test_feed_forward_returns_per_layer_activations():
+    ds = _data(8)
+    net = MultiLayerNetwork(_conf()).init()
+    acts = net.feed_forward(ds.features)
+    assert len(acts) == 3
+    assert np.asarray(acts[0]).shape == (8, 8)
+    assert np.asarray(acts[1]).shape == (8, 6)
+    assert np.asarray(acts[2]).shape == (8, 3)
+    np.testing.assert_allclose(np.asarray(acts[2]),
+                               np.asarray(net.output(ds.features)),
+                               atol=1e-6)
+
+
+def test_graph_feed_forward_and_histograms():
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater(Adam(1e-2))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(4))
+            .add_layer("h", DenseLayer(n_out=8, activation=Activation.TANH),
+                       "in")
+            .add_layer("out", OutputLayer(n_out=3,
+                                          activation=Activation.SOFTMAX,
+                                          loss_fn=LossMCXENT()), "h")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    ds = _data(16)
+    acts = net.feed_forward(ds.features)
+    assert set(acts) == {"h", "out"}
+    assert np.asarray(acts["h"]).shape == (16, 8)
+    np.testing.assert_allclose(np.asarray(acts["out"]),
+                               np.asarray(net.output(ds.features)),
+                               atol=1e-6)
+
+    storage = InMemoryStatsStorage()
+    net.set_listeners(StatsListener(storage, frequency=1, histograms=True,
+                                    sample_ds=ds))
+    net.fit_batch(ds)
+    net.fit_batch(ds)
+    last = storage.records()[-1]
+    assert set(last["activation_histograms"]) == {"h", "out"}
+    assert last["gradient_histograms"]
