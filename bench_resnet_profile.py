@@ -1,0 +1,190 @@
+"""ResNet-50 training-step breakdown on the real chip (the measured
+basis for BASELINE.md's MFU analysis — re-run this script to regenerate).
+
+Decomposes the batch-256 bf16 train step into:
+- full step (fwd + bwd + Adam, donated buffers, dependent-chain sync);
+- forward-only loss and value_and_grad (updater cost by subtraction);
+- per-PREFIX forward and forward+backward costs at each stage boundary
+  (stem, res2..res5, head) — the per-stage cost is the difference of
+  consecutive prefixes, so transposed-bwd-conv costs land in the stage
+  that owns them.
+
+Protocol: every closure is jitted; each measurement queues N identical
+calls then forces ONE value (``block_until_ready`` returns at dispatch
+on the axon tunnel, so a value read is the only real sync), min of 3
+reps, the measured null round-trip subtracted once per rep. Queuing
+identical calls is safe here because the inputs are the same arrays
+every call (the round-1 OOM-stall came from chained UN-donated train
+steps holding N params trees alive). Backward closures return a scalar
+REDUCED FROM THE GRADS — returning only the loss value lets XLA
+dead-code-eliminate the whole backward pass (the first version of this
+script did exactly that and measured fwd+bwd == fwd).
+"""
+
+import argparse
+import dataclasses
+import json
+import time
+
+import numpy as np
+
+BATCH = 256
+IMG = 224
+CLASSES = 1000
+N = 6
+
+BOUNDARIES = ["stem_bn", "stem_pool", "res2c_relu", "res3d_relu",
+              "res4f_relu", "res5c_relu", "avgpool"]
+
+
+def _sync(x):
+    import jax.numpy as jnp
+
+    return float(jnp.asarray(x).astype(jnp.float32).reshape(-1)[0])
+
+
+_RT_MS = [0.0]  # measured enqueue+value-sync round-trip, subtracted per rep
+
+
+def timed(fn, *args, n=N, reps=3):
+    out = fn(*args)
+    _sync(out)  # compile + settle
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(*args)
+        _sync(out)
+        ms = ((time.perf_counter() - t0) * 1000.0 - _RT_MS[0]) / n
+        best = min(best, ms)
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=BATCH)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--s2d", action="store_true",
+                    help="exact space-to-depth stem rewrite (MLPerf trick)")
+    ap.add_argument("--quick", action="store_true",
+                    help="only train_step / fwd / fwd+bwd (skip prefixes)")
+    args = ap.parse_args()
+    batch = args.batch
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.conf.updaters import Adam
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.zoo.graphs import ResNet50
+
+    model = ResNet50(num_classes=CLASSES, height=IMG, width=IMG,
+                     updater=Adam(learning_rate=1e-3))
+    model.stem_space_to_depth = bool(args.s2d)
+    cfg = dataclasses.replace(model.conf(), compute_dtype="bfloat16")
+    net = ComputationGraph(cfg).init()
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 256, (batch, IMG, IMG, 3),
+                                 dtype=np.uint8))
+    y = jnp.asarray(np.eye(CLASSES, dtype=np.float32)[
+        rng.integers(0, CLASSES, batch)])
+    lmask = jnp.ones((batch,), jnp.float32)
+
+    # null round-trip: queue 10 trivial calls + one value sync; the total
+    # IS the round-trip (per-call compute ~0)
+    null = jax.jit(lambda v: v + 1.0)
+    _sync(null(jnp.float32(0.0)))
+    rts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = jnp.float32(0.0)
+        for _ in range(10):
+            out = null(out)
+        _sync(out)
+        rts.append((time.perf_counter() - t0) * 1000.0)
+    _RT_MS[0] = min(rts)
+    rows = {"null_roundtrip": _RT_MS[0]}
+
+    params, state = net.params, net.state
+
+    # ---- full production step (donated, dependent chain via fit path) ----
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    ds = DataSet(np.asarray(x), np.asarray(y))
+    net.fit_batch(ds)  # compile + settle
+    net.fit_batch(ds)
+    t0 = time.perf_counter()
+    for _ in range(N):
+        net.fit_batch(ds)  # fit_batch syncs (float(loss)) per call
+    rows["train_step"] = ((time.perf_counter() - t0) * 1000.0
+                          - N * _RT_MS[0]) / N
+    params, state = net.params, net.state  # post-donation trees
+
+    # ---- forward-only loss + value_and_grad ----
+    def loss_fn(p, feats):
+        loss, _ = net._loss(p, state, (feats,), (y,), (None,), (lmask,),
+                            rng=None, train=True)
+        return loss
+
+    def grad_scalar(vg_out):
+        # depend on EVERY grad leaf or XLA DCEs the backward pass
+        v, g = vg_out
+        return v + sum(jnp.sum(l.astype(jnp.float32))
+                       for l in jax.tree_util.tree_leaves(g))
+
+    fwd = jax.jit(loss_fn)
+    rows["forward_loss"] = timed(fwd, params, x)
+
+    vg = jax.jit(lambda p, f: grad_scalar(jax.value_and_grad(loss_fn)(p, f)))
+    rows["forward_backward"] = timed(vg, params, x)
+
+    # ---- per-prefix forward / forward+backward ----
+    def prefix_fn(boundary):
+        keep = set()
+        for name in net._topo:
+            keep.add(name)
+            if name == boundary:
+                break
+        skip = set(net._topo) - keep
+
+        def run(p, feats):
+            feats = net._dequant(feats, 0)
+            fp, (feats,) = net._fwd_cast(p, (feats,))
+            acts, _, _ = net._forward(fp, state, (feats,), train=True,
+                                      rng=None, skip=skip)
+            return acts[boundary].astype(jnp.float32).sum()
+
+        return run
+
+    for b in ([] if args.quick else BOUNDARIES):
+        f = prefix_fn(b)
+        rows[f"fwd_to_{b}"] = timed(jax.jit(f), params, x)
+        g = jax.jit(lambda p, feats, _f=f: grad_scalar(
+            jax.value_and_grad(_f)(p, feats)))
+        rows[f"fwdbwd_to_{b}"] = timed(g, params, x)
+
+    if args.json:
+        print(json.dumps({k: round(v, 2) for k, v in rows.items()}))
+        return
+
+    print(f"\nResNet-50 batch {batch} bf16 breakdown (ms; round-trip "
+          f"{_RT_MS[0]:.1f}ms subtracted; {N} queued calls/sync, min of "
+          f"3 reps)\n")
+    print(f"{'probe':>22} {'ms':>9}")
+    for k, v in rows.items():
+        print(f"{k:>22} {v:>9.1f}")
+    if not args.quick:
+        print("\nper-stage deltas (prefix differences):")
+        prev_f = prev_b = 0.0
+        for b in BOUNDARIES:
+            fv, bv = rows[f"fwd_to_{b}"], rows[f"fwdbwd_to_{b}"]
+            print(f"{b:>22} fwd {fv - prev_f:>7.1f}  "
+                  f"fwd+bwd {bv - prev_b:>7.1f}")
+            prev_f, prev_b = fv, bv
+    upd = rows["train_step"] - rows["forward_backward"]
+    print(f"\nupdater+overheads (train_step - fwd_bwd): {upd:.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -88,7 +88,7 @@ _HW, _HH = 150, 90
 def _hist_svg(h: dict, color: str) -> str:
     """One small-multiple histogram: bars over [min, max]."""
     counts = h.get("counts") or []
-    peak = max(counts) or 1
+    peak = max(counts, default=0) or 1
     n = len(counts)
     bw = (_HW - 8) / max(n, 1)
     bars = "".join(

@@ -268,7 +268,7 @@ class StatsListener(TrainingListener):
             rec["activation_histograms"] = _layer_histograms(
                 {k: np.asarray(v) for k, v in acts.items()},
                 self.histogram_bins)
-        except (RuntimeError, TypeError, ValueError):
+        except Exception:
             pass  # probe must never break training
         try:
             grads, _ = model.compute_gradient_and_score(ds)
@@ -276,5 +276,5 @@ class StatsListener(TrainingListener):
                 {k: {pk: np.asarray(pv) for pk, pv in lg.items()}
                  for k, lg in grads.items()},
                 self.histogram_bins)
-        except (RuntimeError, TypeError, ValueError):
-            pass
+        except Exception:
+            pass  # probe must never break training

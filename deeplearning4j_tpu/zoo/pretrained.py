@@ -41,6 +41,7 @@ import urllib.request
 from pathlib import Path
 
 import jax.numpy as jnp
+import numpy as np
 
 from deeplearning4j_tpu.util import serializer
 
@@ -179,6 +180,22 @@ def restore_partial(path, net) -> tuple[list, list]:
     for lk, lp in donor.params.items():
         for pk, pv in lp.items():
             tgt = net.params.get(lk, {})
+            if pk in tgt and tuple(tgt[pk].shape) != tuple(pv.shape):
+                # space-to-depth stem rewrite (ResNet50.stem_space_to_depth,
+                # exact): a reference [7,7,C,O] stem kernel loads into the
+                # rewrite's [4,4,4C,O] slot through the documented remap —
+                # without this, pretrained backbones would silently keep a
+                # RANDOM stem (round-3 review finding)
+                dv = np.asarray(pv)
+                if (pk == "W" and dv.ndim == 4 and dv.shape[:2] == (7, 7)
+                        and tuple(tgt[pk].shape)
+                        == (4, 4, 4 * dv.shape[2], dv.shape[3])):
+                    from deeplearning4j_tpu.zoo.graphs import ResNet50
+
+                    net.params[lk][pk] = jnp.asarray(
+                        ResNet50.stem_weights_to_s2d(dv))
+                    loaded.append(f"{lk}/{pk}")
+                    continue
             if pk in tgt and tuple(tgt[pk].shape) == tuple(pv.shape):
                 net.params[lk][pk] = jnp.asarray(pv)
                 loaded.append(f"{lk}/{pk}")

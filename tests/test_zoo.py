@@ -218,3 +218,78 @@ def test_layer_normalization_math():
     y, _ = ln.forward(params, {}, x)
     np.testing.assert_allclose(np.asarray(y).mean(-1), 0.0, atol=1e-5)
     np.testing.assert_allclose(np.asarray(y).std(-1), 1.0, atol=1e-3)
+
+
+def test_resnet_space_to_depth_stem_is_exact():
+    """stem_space_to_depth is an EXACT rewrite (round 3, MLPerf trick):
+    with stem weights remapped through stem_weights_to_s2d, the rewritten
+    network computes the SAME function as the reference topology."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.zoo.graphs import ResNet50
+
+    rng = np.random.default_rng(0)
+
+    # unit check of the conv identity itself
+    x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)).astype(np.float32))
+    w7 = jnp.asarray(rng.normal(size=(7, 7, 3, 8)).astype(np.float32))
+    ref = jax.lax.conv_general_dilated(
+        x, w7, (2, 2), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    xs = x.reshape(2, 16, 2, 16, 2, 3).transpose(0, 1, 3, 2, 4, 5) \
+        .reshape(2, 16, 16, 12)
+    xp = jnp.pad(xs, ((0, 0), (1, 2), (1, 2), (0, 0)))
+    w4 = jnp.asarray(ResNet50.stem_weights_to_s2d(np.asarray(w7)))
+    got = jax.lax.conv_general_dilated(
+        xp, w4, (1, 1), "VALID", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+    # end-to-end through the zoo wiring on a small ResNet50
+    base = ResNet50(num_classes=4, height=32, width=32, seed=9)
+    na = ComputationGraph(base.conf()).init()
+    s2d = ResNet50(num_classes=4, height=32, width=32, seed=9)
+    s2d.stem_space_to_depth = True
+    nb = ComputationGraph(s2d.conf()).init()
+
+    import jax as _jax
+
+    nb.params = _jax.tree_util.tree_map(jnp.asarray, dict(na.params))
+    nb.params["stem_conv"] = {"W": jnp.asarray(
+        ResNet50.stem_weights_to_s2d(np.asarray(na.params["stem_conv"]["W"])))}
+    nb.state = _jax.tree_util.tree_map(jnp.asarray, dict(na.state))
+
+    xin = rng.normal(size=(2, 32, 32, 3)).astype(np.float32)
+    ya = np.asarray(na.output(xin))
+    yb = np.asarray(nb.output(xin))
+    np.testing.assert_allclose(yb, ya, rtol=2e-3, atol=2e-4)
+
+
+def test_restore_partial_remaps_s2d_stem(tmp_path):
+    """Pretrained weights saved from the REFERENCE topology load into an
+    s2d-stem network: the [7,7,3,64] stem kernel remaps to [4,4,12,64]
+    through stem_weights_to_s2d instead of being silently skipped
+    (round-3 review finding)."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.util import serializer
+    from deeplearning4j_tpu.zoo.graphs import ResNet50
+    from deeplearning4j_tpu.zoo.pretrained import restore_partial
+
+    base = ResNet50(num_classes=4, height=32, width=32, seed=9)
+    na = ComputationGraph(base.conf()).init()
+    path = str(tmp_path / "ref.zip")
+    serializer.write_model(na, path)
+
+    s2d = ResNet50(num_classes=4, height=32, width=32, seed=1)
+    s2d.stem_space_to_depth = True
+    nb = ComputationGraph(s2d.conf()).init()
+    loaded, skipped = restore_partial(path, nb)
+    assert "stem_conv/W" in loaded
+    assert not any(k.startswith("stem_conv") for k in skipped)
+    # the loaded network computes the same function as the donor
+    x = np.random.default_rng(0).normal(size=(2, 32, 32, 3)) \
+        .astype(np.float32)
+    np.testing.assert_allclose(np.asarray(nb.output(x)),
+                               np.asarray(na.output(x)),
+                               rtol=2e-3, atol=2e-4)
