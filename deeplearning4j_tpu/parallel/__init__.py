@@ -44,3 +44,14 @@ from deeplearning4j_tpu.parallel.tensor import (  # noqa: F401
     tp_train_step,
 )
 from deeplearning4j_tpu.parallel.serving import InferenceServer  # noqa: F401
+from deeplearning4j_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_spmd_fn,
+    pipeline_train_step,
+    stack_stage_params,
+)
+from deeplearning4j_tpu.parallel.expert import (  # noqa: F401
+    moe_init,
+    moe_spmd_fn,
+    moe_train_step,
+    shard_moe_params,
+)

@@ -1,0 +1,164 @@
+"""Expert parallelism (MoE) over a mesh ``expert`` axis (beyond the
+reference: DL4J has no EP — SURVEY.md §2.3 lists it absent; on TPU the
+token exchange is ONE ``all_to_all`` over ICI each way, compiled into the
+program with everything else).
+
+Design (Mesh-TensorFlow/GShard-style, TPU-first):
+
+- E experts, one (or E/devices) per mesh shard; tokens arrive sharded
+  over the same axis (each shard owns T/E tokens — the data dimension
+  rides the expert axis, the standard GShard layout).
+- Top-1 router with capacity C per (source shard, expert): dispatch is
+  an einsum against a [T, E, C] one-hot tensor (differentiable; dropped
+  tokens — beyond capacity — pass through the residual untouched).
+- ``all_to_all`` sends each source shard's per-expert buffers to the
+  owning expert shard, the expert FFN runs on [E*C, d] (one big MXU
+  matmul), and the reverse ``all_to_all`` + combine-einsum scatters
+  results back, scaled by the router probability (so the router gets
+  gradients through the prob factor, exactly GShard's estimator).
+- An auxiliary load-balance loss (mean gate prob x mean assignment per
+  expert, scaled by E^2) keeps routing from collapsing.
+
+``moe_spmd_fn`` returns the jitted sharded layer; ``moe_train_step``
+wires loss + SGD with expert weights staying shard-local and router
+weights replicated (their gradient all-reduces with ``pmean``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel import mesh as mesh_mod
+
+from deeplearning4j_tpu.parallel.mesh import EXPERT_AXIS  # noqa: F401 — reserved in round 1
+
+
+def moe_init(key, d_model: int, d_hidden: int, n_experts: int,
+             dtype=jnp.float32) -> dict:
+    """One logical copy: router [d, E] (replicated) + per-expert FFN
+    weights with a leading [E] axis (shard ``P('expert')``)."""
+    import numpy as np
+
+    k1, k2, k3 = jax.random.split(key, 3)
+    s1 = 1.0 / np.sqrt(d_model)
+    s2 = 1.0 / np.sqrt(d_hidden)
+    return {
+        "router": (s1 * jax.random.normal(k1, (d_model, n_experts))
+                   ).astype(dtype),
+        "w1": (s1 * jax.random.normal(k2, (n_experts, d_model, d_hidden))
+               ).astype(dtype),
+        "w2": (s2 * jax.random.normal(k3, (n_experts, d_hidden, d_model))
+               ).astype(dtype),
+    }
+
+
+def shard_moe_params(params: dict, mesh: Mesh) -> dict:
+    return {
+        "router": jax.device_put(params["router"],
+                                 NamedSharding(mesh, P())),
+        "w1": jax.device_put(params["w1"],
+                             NamedSharding(mesh, P(EXPERT_AXIS))),
+        "w2": jax.device_put(params["w2"],
+                             NamedSharding(mesh, P(EXPERT_AXIS))),
+    }
+
+
+def _moe_local(params, x, n_experts: int, capacity: int):
+    """The per-shard MoE math (runs under shard_map; ``x`` is this
+    shard's [t, d] tokens, ``params['w1'/'w2']`` this shard's experts
+    [e_loc, d, h]/[e_loc, h, d]). Returns (y, aux_loss_local)."""
+    t, d = x.shape
+    logits = x @ params["router"]                     # [t, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top = jnp.argmax(probs, axis=-1)                  # [t]
+    gate = jnp.take_along_axis(probs, top[:, None], axis=-1)[:, 0]
+
+    onehot = jax.nn.one_hot(top, n_experts, dtype=x.dtype)   # [t, E]
+    pos = jnp.cumsum(onehot, axis=0) - onehot          # position in queue
+    keep = pos < capacity
+    # dispatch[t, e, c] = 1 iff token t is slot c of expert e (one-hot,
+    # capacity-dropped tokens have an all-zero row -> identity residual)
+    dispatch = (onehot * keep)[:, :, None] * jax.nn.one_hot(
+        pos.astype(jnp.int32), capacity, dtype=x.dtype)
+    send = jnp.einsum("td,tec->ecd", x, dispatch)      # [E, C, d]
+
+    # exchange: rows grouped by DEST expert -> after all_to_all the
+    # leading axis is the SOURCE shard, all buffers for MY experts
+    e_loc = params["w1"].shape[0]
+    send = send.reshape(n_experts // e_loc, e_loc * capacity, d)
+    recv = jax.lax.all_to_all(send, EXPERT_AXIS, split_axis=0,
+                              concat_axis=0, tiled=False)
+    # [n_shards, e_loc*C, d] -> [e_loc, n_shards*C, d]
+    n_shards = recv.shape[0]
+    recv = recv.reshape(n_shards, e_loc, capacity, d).transpose(
+        1, 0, 2, 3).reshape(e_loc, n_shards * capacity, d)
+
+    h = jnp.maximum(jnp.einsum("ecd,edh->ech", recv, params["w1"]), 0.0)
+    out = jnp.einsum("ech,ehd->ecd", h, params["w2"])
+
+    out = out.reshape(e_loc, n_shards, capacity, d).transpose(
+        1, 0, 2, 3).reshape(n_shards, e_loc * capacity, d)
+    back = jax.lax.all_to_all(out, EXPERT_AXIS, split_axis=0,
+                              concat_axis=0, tiled=False)
+    back = back.reshape(n_experts, capacity, d)
+    # combine, scaled by the router prob (router gradient path)
+    y = jnp.einsum("ecd,tec->td", back, dispatch) * gate[:, None]
+
+    # load-balance aux (GShard): E * sum_e mean(prob_e) * mean(assign_e)
+    assign = jnp.mean(onehot, axis=0)
+    prob_mean = jnp.mean(probs, axis=0)
+    aux = n_experts * jnp.sum(assign * prob_mean)
+    return x + y, aux                                  # residual
+
+
+def moe_spmd_fn(n_experts: int, capacity: int, mesh: Mesh):
+    """-> jitted ``(params, x) -> (y, aux)``: x [T, d] sharded over
+    ``expert`` (T % n_shards == 0), params via ``shard_moe_params``."""
+    def spmd(params, x):
+        p = {"router": params["router"],
+             "w1": params["w1"], "w2": params["w2"]}
+        y, aux = _moe_local(p, x, n_experts, capacity)
+        return y, jax.lax.pmean(aux, EXPERT_AXIS)
+
+    sharded = mesh_mod.shard_map(
+        spmd, mesh,
+        in_specs=({"router": P(), "w1": P(EXPERT_AXIS),
+                   "w2": P(EXPERT_AXIS)}, P(EXPERT_AXIS)),
+        out_specs=(P(EXPERT_AXIS), P()))
+    return jax.jit(sharded)
+
+
+def moe_train_step(n_experts: int, capacity: int, mesh: Mesh,
+                   lr: float = 0.05, aux_weight: float = 1e-2):
+    """-> jitted ``(params, x, target) -> (params, loss)``: MSE + aux
+    load-balance loss; expert-weight grads stay shard-local, the
+    replicated router's grad is ``pmean``-reduced."""
+    def spmd(params, x, target):
+        def loss_fn(p):
+            y, aux = _moe_local(p, x, n_experts, capacity)
+            mse = jnp.mean((y - target) ** 2)
+            return jax.lax.pmean(mse, EXPERT_AXIS) \
+                + aux_weight * jax.lax.pmean(aux, EXPERT_AXIS)
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        g = dict(g)
+        g["router"] = jax.lax.pmean(g["router"], EXPERT_AXIS)
+        new = {k: params[k] - lr * g[k] for k in params}
+        return new, loss
+
+    sharded = mesh_mod.shard_map(
+        spmd, mesh,
+        in_specs=({"router": P(), "w1": P(EXPERT_AXIS),
+                   "w2": P(EXPERT_AXIS)}, P(EXPERT_AXIS), P(EXPERT_AXIS)),
+        out_specs=({"router": P(), "w1": P(EXPERT_AXIS),
+                    "w2": P(EXPERT_AXIS)}, P()))
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+# Test oracle: run moe_spmd_fn over a ONE-device ``expert`` mesh (the
+# all_to_all degenerates to identity, every expert is local) and compare
+# against the sharded mesh on the same tokens. Capacity is per (source
+# shard, expert), so exact equivalence needs capacity large enough that
+# no token drops — the drop semantics get their own single-shard test.

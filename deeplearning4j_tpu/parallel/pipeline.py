@@ -1,0 +1,135 @@
+"""Pipeline parallelism over a mesh ``stage`` axis (beyond the reference:
+DL4J has no PP — SURVEY.md §2.3 lists it absent; on TPU the GPipe
+schedule is a ``lax.scan`` whose inter-stage hand-off is a ``ppermute``
+over ICI, so the WHOLE pipeline — all stages, all microbatches, forward
+AND backward — compiles into one XLA program).
+
+Design (TPU-first, not a thread/queue translation):
+
+- The network is S equal-signature stages (activation shape is identical
+  between stages — the transformer-stack case); stage s's params live
+  ONLY on mesh shard s (leading-axis sharding ``P('stage')``).
+- GPipe schedule with M microbatches runs ``S + M - 1`` scan steps.
+  Each step, every stage applies itself to the activation it holds and
+  ``ppermute``s the result one hop down the ring; stage 0 injects
+  microbatch ``t`` and the last stage's outputs for ``t >= S-1`` are the
+  pipeline outputs. Bubble steps compute on stale buffers whose results
+  are never consumed — they cost FLOPs (the classic bubble), never
+  correctness.
+- The BACKWARD schedule is not hand-written: ``ppermute`` and ``scan``
+  both have transpose rules, so ``jax.grad`` of the forward IS the
+  reverse pipeline (activations rematerialize per scan step the usual
+  way).
+
+``pipeline_spmd_fn`` returns a shard_map'd callable suitable for jit;
+``pipeline_train_step`` wires a loss + SGD update over the sharded
+per-stage params, with the gradient staying stage-local (no all-reduce:
+each stage owns its parameters, exactly pipeline parallelism's point).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel import mesh as mesh_mod
+
+from deeplearning4j_tpu.parallel.mesh import PIPELINE_AXIS as STAGE_AXIS  # noqa: E501 — the mesh module reserved the axis name in round 1
+
+
+def stack_stage_params(per_stage: list, mesh: Mesh):
+    """[S trees with identical structure] -> one tree with a leading
+    stage axis, sharded ``P('stage')`` so shard s holds stage s."""
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *per_stage)
+    sh = NamedSharding(mesh, P(STAGE_AXIS))
+    return jax.device_put(stacked, sh)
+
+
+def _gpipe_forward(stage_fn, my_params, x_micro, n_stages, n_micro):
+    """The per-shard GPipe schedule (shared by inference and training so
+    the two can never desynchronize): scan of apply + ppermute ring;
+    stage 0 injects microbatch t (clamped during drain bubbles — those
+    in-flight values are never collected); microbatch m completes on the
+    LAST stage at t = m + S - 1, and the psum over the one-hot last-stage
+    mask replicates the outputs."""
+    sid = jax.lax.axis_index(STAGE_AXIS)
+    total = n_stages + n_micro - 1
+    perm = [(s, (s + 1) % n_stages) for s in range(n_stages)]
+    # anchor the zero carry to the (device-varying) stage index: the
+    # scan carry must match ppermute's varied type under shard_map
+    buf = jnp.zeros_like(x_micro[0]) + (sid * 0).astype(x_micro.dtype)
+
+    def step(buf, t):
+        inj = x_micro[jnp.minimum(t, n_micro - 1)]
+        x = jnp.where(sid == 0, inj, buf)
+        y = stage_fn(my_params, x)
+        return jax.lax.ppermute(y, STAGE_AXIS, perm), y
+
+    _, ys = jax.lax.scan(step, buf, jnp.arange(total))
+    outs = ys[n_stages - 1:]
+    return jax.lax.psum(
+        jnp.where(sid == n_stages - 1, outs, jnp.zeros_like(outs)),
+        STAGE_AXIS)
+
+
+def pipeline_spmd_fn(stage_fn, n_stages: int, n_micro: int, mesh: Mesh):
+    """-> jitted ``(stage_params, x_micro) -> outputs``.
+
+    ``stage_fn(params, x) -> y`` is ONE stage's forward (pure jax; y has
+    x's shape). ``stage_params`` leaves carry a leading [S] axis sharded
+    over ``stage``; ``x_micro`` is [M, mb, ...] (replicated — only stage
+    0 reads it). Returns [M, mb, ...] outputs, replicated."""
+    if mesh.shape[STAGE_AXIS] != n_stages:
+        raise ValueError(
+            f"mesh stage axis = {mesh.shape[STAGE_AXIS]}, "
+            f"n_stages = {n_stages}")
+
+    def spmd(stage_params, x_micro):
+        my_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+        return _gpipe_forward(stage_fn, my_params, x_micro, n_stages,
+                              n_micro)
+
+    sharded = mesh_mod.shard_map(
+        spmd, mesh, in_specs=(P(STAGE_AXIS), P()), out_specs=P())
+    return jax.jit(sharded)
+
+
+def pipeline_train_step(stage_fn, loss_fn, n_stages: int, n_micro: int,
+                        mesh: Mesh, lr: float = 0.05):
+    """-> jitted ``(stage_params, x_micro, y_micro) -> (params, loss)``:
+    pipelined forward, mean microbatch loss, ``jax.grad`` (= the reverse
+    pipeline schedule), stage-LOCAL SGD (each shard updates only its own
+    stage's parameters — no gradient collective crosses stages)."""
+    if mesh.shape[STAGE_AXIS] != n_stages:
+        raise ValueError(
+            f"mesh stage axis = {mesh.shape[STAGE_AXIS]}, "
+            f"n_stages = {n_stages}")
+
+    def spmd(stage_params, x_micro, y_micro):
+        def fwd_loss(my_params):
+            outs = _gpipe_forward(stage_fn, my_params, x_micro, n_stages,
+                                  n_micro)
+            return loss_fn(outs, y_micro)
+
+        my_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+        loss, grads = jax.value_and_grad(fwd_loss)(my_params)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, my_params, grads)
+        return (jax.tree_util.tree_map(lambda a: a[None], new_params),
+                loss)
+
+    sharded = mesh_mod.shard_map(
+        spmd, mesh, in_specs=(P(STAGE_AXIS), P(), P()),
+        out_specs=(P(STAGE_AXIS), P()))
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def serial_reference(stage_fn, per_stage_params: list, x):
+    """The pipeline's oracle: apply the stages sequentially."""
+    for p in per_stage_params:
+        x = stage_fn(p, x)
+    return x
