@@ -9,18 +9,26 @@ needed. TF's NHWC/HWIO layouts are ALSO this framework's native layouts, so
 conv/pool weights and attributes map without transposition (the reference
 must convert to NCHW).
 
-Supported ops: Placeholder, Const, Identity/StopGradient/NoOp, MatMul,
-BatchMatMul(V2), BiasAdd, the elementwise binary family (Add/AddV2/Sub/
-Mul/RealDiv/Maximum/Minimum/SquaredDifference/Pow/FloorDiv/comparisons),
-the unary family (Relu/Relu6/Tanh/Sigmoid/Elu/Selu/Softplus/Exp/Log/
-Log1p/Expm1/Sqrt/Rsqrt/Square/Neg/Abs/Floor/Ceil/Round/Sign/Erf/
-Reciprocal/Sin/Cos/Tan), LeakyRelu, Softmax, LogSoftmax, Conv2D,
-DepthwiseConv2dNative, MaxPool, AvgPool, FusedBatchNorm(V2/V3)
-(inference), Reshape, Squeeze, ExpandDims, Transpose, ConcatV2, Pad,
-Mean/Sum/Max/Min/Prod (reductions), ArgMax, Shape (static), Pack,
-Unpack, Split/SplitV, Cast, Gather/GatherV2, OneHot, Select(V2), Fill,
-Range, Tile, Slice, StridedSlice, Cumsum — the surface BERT-class frozen
-graphs need. Unsupported ops raise ``UnsupportedTFOpException`` listing
+Supported ops (~120): Placeholder, Const, Identity/StopGradient/NoOp,
+MatMul, BatchMatMul(V2), Einsum/XlaEinsum, BiasAdd (NHWC + NCHW), the
+elementwise binary family (Add/AddV2/AddN/Sub/Mul/RealDiv/Maximum/
+Minimum/SquaredDifference/Pow/FloorDiv/Mod/FloorMod/TruncateMod/Atan2/
+Logical*/Igamma(c)/Zeta/comparisons), the unary family (Relu/Relu6/Tanh/
+Sigmoid/Elu/Selu/Softplus/Softsign/Exp/Log/Log1p/Expm1/Sqrt/Rsqrt/
+Square/Neg/Abs/Floor/Ceil/Round/Rint/Sign/Erf/Erfc/Reciprocal/trig +
+hyperbolic + inverses/Lgamma/Digamma/IsNan/IsInf/IsFinite/ZerosLike/
+OnesLike), LeakyRelu, Softmax, LogSoftmax, Conv2D + Conv3D,
+DepthwiseConv2dNative, MaxPool/AvgPool (2d+3d), FusedBatchNorm(V2/V3)
+(inference + training; NHWC and NCHW via transpose sandwiches),
+SpaceToDepth/DepthToSpace, SpaceToBatchND/BatchToSpaceND (square 2-D
+blocks), ResizeBilinear/ResizeNearestNeighbor, Reshape, Squeeze,
+ExpandDims, Transpose, ConcatV2, Pad/PadV2/MirrorPad, Mean/Sum/Max/Min/
+Prod (reductions), ArgMax/ArgMin, Shape (static), Pack, Unpack,
+Split/SplitV, Cast, Gather/GatherV2/GatherNd, OneHot, Select(V2),
+TopK(V2), ClipByValue, MatrixBandPart, Fill, Range, Tile, Slice,
+StridedSlice, Cumsum/Cumprod, ReverseV2 — the surface BERT-class frozen
+graphs need, plus TF2 functional While/If and TF1 control-flow frames
+(see run()). Unsupported ops raise ``UnsupportedTFOpException`` listing
 the node.
 """
 
@@ -109,7 +117,10 @@ _BINARY = {"Add": "add", "AddV2": "add", "Sub": "sub", "Mul": "mul",
            "Minimum": "minimum", "SquaredDifference": "squared_difference",
            "Pow": "pow", "FloorDiv": "floordiv", "Greater": "gt",
            "GreaterEqual": "gte", "Less": "lt", "LessEqual": "lte",
-           "Equal": "eq"}
+           "Equal": "eq", "NotEqual": "neq", "Mod": "fmod",
+           "FloorMod": "mod", "TruncateMod": "fmod", "Atan2": "atan2",
+           "LogicalAnd": "logical_and", "LogicalOr": "logical_or",
+           "Igamma": "igamma", "Igammac": "igammac", "Zeta": "zeta"}
 # values are REGISTRY keys (activations live under nn., the rest math.)
 _UNARY = {"Relu": "nn.relu", "Tanh": "nn.tanh", "Sigmoid": "nn.sigmoid",
           "Elu": "nn.elu", "Selu": "nn.selu", "Softplus": "nn.softplus",
@@ -118,9 +129,18 @@ _UNARY = {"Relu": "nn.relu", "Tanh": "nn.tanh", "Sigmoid": "nn.sigmoid",
           "Rsqrt": "math.rsqrt", "Square": "math.square",
           "Neg": "math.neg", "Abs": "math.abs", "Floor": "math.floor",
           "Ceil": "math.ceil", "Round": "math.round",
-          "Sign": "math.sign", "Erf": "math.erf",
+          "Sign": "math.sign", "Erf": "math.erf", "Erfc": "math.erfc",
           "Reciprocal": "math.reciprocal", "Inv": "math.reciprocal",
-          "Sin": "math.sin", "Cos": "math.cos", "Tan": "math.tan"}
+          "Sin": "math.sin", "Cos": "math.cos", "Tan": "math.tan",
+          "Sinh": "math.sinh", "Cosh": "math.cosh", "Asin": "math.asin",
+          "Acos": "math.acos", "Atan": "math.atan",
+          "Asinh": "math.asinh", "Acosh": "math.acosh",
+          "Atanh": "math.atanh", "Rint": "math.rint",
+          "Lgamma": "math.lgamma", "Digamma": "math.digamma",
+          "LogicalNot": "math.logical_not", "IsNan": "math.isnan",
+          "IsInf": "math.isinf", "IsFinite": "math.isfinite",
+          "Softsign": "nn.softsign", "ZerosLike": "zeros_like",
+          "OnesLike": "ones_like"}
 _REDUCE = {"Mean": "mean", "Sum": "sum", "Max": "amax", "Min": "amin",
            "Prod": "prod"}
 
@@ -575,9 +595,11 @@ class _Mapper:
             v = sd._op(f"reduce.{_REDUCE[op]}", [self._var(ins[0])],
                        axis=axis, keepdims=keep)[0]
             self._bind(node, v)
-        elif op == "ArgMax":
+        elif op in ("ArgMax", "ArgMin"):
             axis = int(self._static(ins[1], node))
-            v = sd._op("math.argmax", [self._var(ins[0])], axis=axis)[0]
+            impl = "math.argmax" if op == "ArgMax" else "math.argmin"
+            v = sd._op(impl, [self._var(ins[0])], axis=axis,
+                       keepdims=False)[0]
             self._bind(node, v)
         elif op == "Shape":
             v = sd._op("shape_of", [self._var(ins[0])])[0]
@@ -709,6 +731,130 @@ class _Mapper:
                     f"{node.name}: exclusive/reverse Cumsum unsupported")
             axis = int(self._static(ins[1], node))
             v = sd._op("math.cumsum", [self._var(ins[0])], axis=axis)[0]
+            self._bind(node, v)
+        elif op == "AddN":
+            v = sd._op("math.mergeAdd", [self._var(i) for i in ins])[0]
+            self._bind(node, v)
+        elif op == "ClipByValue":
+            lo = float(np.asarray(self._static(ins[1], node)).reshape(-1)[0])
+            hi = float(np.asarray(self._static(ins[2], node)).reshape(-1)[0])
+            v = sd._op("math.clip_by_value", [self._var(ins[0])],
+                       lo=lo, hi=hi)[0]
+            self._bind(node, v)
+        elif op == "Cumprod":
+            if node.attr["exclusive"].b or node.attr["reverse"].b:
+                raise UnsupportedTFOpException(
+                    f"{node.name}: exclusive/reverse Cumprod unsupported")
+            axis = int(self._static(ins[1], node))
+            v = sd._op("math.cumprod", [self._var(ins[0])], axis=axis)[0]
+            self._bind(node, v)
+        elif op == "ReverseV2":
+            dims = tuple(int(d) for d in
+                         np.atleast_1d(self._static(ins[1], node)))
+            v = sd._op("math.reverse", [self._var(ins[0])], dims=dims)[0]
+            self._bind(node, v)
+        elif op in ("SpaceToDepth", "DepthToSpace"):
+            if _data_format(node) != "NHWC":
+                raise UnsupportedTFOpException(
+                    f"{node.name}: {op} supports NHWC only")
+            block = int(node.attr["block_size"].i)
+            impl = ("cnn.spaceToDepth" if op == "SpaceToDepth"
+                    else "cnn.depthToSpace")
+            v = sd._op(impl, [self._var(ins[0])], block=block)[0]
+            self._bind(node, v)
+        elif op in ("SpaceToBatchND", "BatchToSpaceND"):
+            bs = [int(b) for b in self._static(ins[1], node)]
+            if len(bs) != 2 or bs[0] != bs[1]:
+                raise UnsupportedTFOpException(
+                    f"{node.name}: only square 2-D block shapes import, "
+                    f"got {bs}")
+            arg = [tuple(int(x) for x in row)
+                   for row in self._static(ins[2], node)]
+            if op == "SpaceToBatchND":
+                v = sd._op("cnn.spaceToBatch", [self._var(ins[0])],
+                           block=bs[0], pads=tuple(arg))[0]
+            else:
+                v = sd._op("cnn.batchToSpace", [self._var(ins[0])],
+                           block=bs[0], crops=tuple(arg))[0]
+            self._bind(node, v)
+        elif op in ("ResizeBilinear", "ResizeNearestNeighbor"):
+            if node.attr["align_corners"].b:
+                raise UnsupportedTFOpException(
+                    f"{node.name}: align_corners=True unsupported")
+            if not node.attr["half_pixel_centers"].b:
+                # jax.image.resize samples half-pixel centers; TF's
+                # legacy default grid (src = dst*scale) differs at any
+                # non-integer scale — refuse rather than silently shift
+                raise UnsupportedTFOpException(
+                    f"{node.name}: only half_pixel_centers=True resizes "
+                    "import (TF2's default; legacy TF1 grid unsupported)")
+            h, w = (int(s) for s in self._static(ins[1], node))
+            impl = ("image.resizeBilinear" if op == "ResizeBilinear"
+                    else "image.resizeNearest")
+            v = sd._op(impl, [self._var(ins[0])], height=h, width=w)[0]
+            self._bind(node, v)
+        elif op == "Conv3D":
+            df = (node.attr["data_format"].s.decode()
+                  if node.attr["data_format"].s else "NDHWC")
+            if df != "NDHWC":
+                raise UnsupportedTFOpException(
+                    f"{node.name}: Conv3D supports NDHWC only, got {df!r}")
+            strides = tuple(node.attr["strides"].list.i)[1:4]
+            padding = node.attr["padding"].s.decode() or "SAME"
+            dil = tuple(node.attr["dilations"].list.i or (1,) * 5)[1:4]
+            zero = sd.constant(np.zeros((1,), np.float32))
+            v = sd._op("cnn.conv3d",
+                       [self._var(ins[0]), self._var(ins[1]), zero],
+                       strides=strides, padding=padding, dilation=dil)[0]
+            self._bind(node, v)
+        elif op in ("MaxPool3D", "AvgPool3D"):
+            df = (node.attr["data_format"].s.decode()
+                  if node.attr["data_format"].s else "NDHWC")
+            if df != "NDHWC":
+                raise UnsupportedTFOpException(
+                    f"{node.name}: {op} supports NDHWC only, got {df!r}")
+            k = tuple(node.attr["ksize"].list.i)[1:4]
+            s = tuple(node.attr["strides"].list.i)[1:4]
+            padding = node.attr["padding"].s.decode() or "VALID"
+            impl = ("cnn.maxPooling3d" if op == "MaxPool3D"
+                    else "cnn.avgPooling3d")
+            v = sd._op(impl, [self._var(ins[0])], k=k, s=s,
+                       padding=padding)[0]
+            self._bind(node, v)
+        elif op in ("Einsum", "XlaEinsum"):
+            eq = node.attr["equation"].s.decode()
+            v = sd._op("math.einsum", [self._var(i) for i in ins],
+                       equation=eq)[0]
+            self._bind(node, v)
+        elif op == "GatherNd":
+            v = sd._op("math.gatherNd",
+                       [self._var(ins[0]), self._var(ins[1])])[0]
+            self._bind(node, v)
+        elif op in ("TopK", "TopKV2"):
+            k = (int(self._static(ins[1], node)) if len(ins) > 1
+                 else int(node.attr["k"].i))
+            vs = sd._op("math.topK", [self._var(ins[0])], n_out=2, k=k,
+                        sorted=True)
+            self._bind_multi(node, vs)
+        elif op == "PadV2":
+            pads = [tuple(int(x) for x in row)
+                    for row in self._static(ins[1], node)]
+            val = float(np.asarray(self._static(ins[2], node)).reshape(-1)[0])
+            v = sd._op("nn.pad", [self._var(ins[0])], paddings=pads,
+                       mode="constant", value=val)[0]
+            self._bind(node, v)
+        elif op == "MirrorPad":
+            pads = [tuple(int(x) for x in row)
+                    for row in self._static(ins[1], node)]
+            mode = node.attr["mode"].s.decode().lower() or "reflect"
+            v = sd._op("nn.pad", [self._var(ins[0])], paddings=pads,
+                       mode=mode, value=0.0)[0]
+            self._bind(node, v)
+        elif op == "MatrixBandPart":
+            lo = int(np.asarray(self._static(ins[1], node)).reshape(-1)[0])
+            hi = int(np.asarray(self._static(ins[2], node)).reshape(-1)[0])
+            v = sd._op("linalg.matrixBandPart", [self._var(ins[0])],
+                       num_lower=lo, num_upper=hi)[0]
             self._bind(node, v)
         elif op in ("While", "StatelessWhile"):
             cond_f = self._func(node.attr["cond"].func.name, node)

@@ -669,6 +669,15 @@ class ComputationGraph(nn_io.LazyScoreMixin):
                           else jnp.ones)((n, total_t), self._dtype)
                 m = m[:, None] * ones_t
             norm_lmasks.append(m)
+        for kind, group in (("features mask", fmasks),
+                            ("labels mask", norm_lmasks)):
+            for i, m in enumerate(group):
+                if int(np.shape(m)[1]) != total_t:
+                    raise ValueError(
+                        f"truncated BPTT {kind} {i} has {np.shape(m)[1]} "
+                        f"timesteps but the sequences have {total_t} — "
+                        "masks must be at the INPUT rate (a wrong-length "
+                        "mask would desynchronize the segment scan)")
         return features, labels, fmasks, tuple(norm_lmasks)
 
     def _fit_tbptt(self, features, labels, fmasks, lmasks):

@@ -2432,6 +2432,43 @@ def _sd_weighted_ce(self, labels, logits, weight=1.0, name=None):
     return out
 
 
+# ======================= round 3b: einsum / gatherNd / topK =======================
+# (TF-import surface: Einsum, GatherNd, TopKV2 — also first-class sd ops)
+
+@register_op("math.einsum")
+def _einsum(*arrays, equation):
+    return jnp.einsum(equation, *arrays)
+
+
+@register_op("math.gatherNd")
+def _gather_nd(x, indices):
+    idx = indices.astype(jnp.int32)
+    return x[tuple(jnp.moveaxis(idx, -1, 0))]
+
+
+@register_op("math.topK")
+def _top_k(x, *, k, sorted):
+    values, indices = jax.lax.top_k(x, k)
+    return values, indices
+
+
+@_def(SDMath, "einsum")
+def _sd_einsum(self, equation, *arrays, name=None):
+    return self._op("math.einsum", list(arrays), name=name,
+                    equation=str(equation))[0]
+
+
+@_def(SDMath, "gatherNd")
+def _sd_gather_nd(self, x, indices, name=None):
+    return self._op("math.gatherNd", [x, indices], name=name)[0]
+
+
+@_def(SDMath, "topK")
+def _sd_top_k(self, x, k, sorted=True, name=None):
+    return self._op("math.topK", [x], n_out=2, name=name, k=int(k),
+                    sorted=bool(sorted))
+
+
 NAMESPACES = {
     "math": SDMath, "nn": SDNN, "cnn": SDCNN, "rnn": SDRNN, "loss": SDLoss,
     "random": SDRandom, "linalg": SDLinalg, "image": SDImage,

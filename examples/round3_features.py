@@ -1,0 +1,196 @@
+"""Round-3 feature tour: ComputationGraph truncated-BPTT + streaming,
+mask resizing through strided convs, dashboard histograms, pipeline and
+expert parallelism, and TF1 while-loop import.
+
+Run anywhere (CPU works; set XLA_FLAGS=--xla_force_host_platform_device_count=8
+JAX_PLATFORMS=cpu for the parallelism sections on one machine):
+
+    python examples/round3_features.py
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.conf import Activation, InputType, WeightInit
+from deeplearning4j_tpu.conf.layers_cnn import (
+    Convolution1DLayer,
+    ConvolutionMode,
+)
+from deeplearning4j_tpu.conf.layers_rnn import LSTM, RnnOutputLayer
+from deeplearning4j_tpu.conf.losses import LossMCXENT
+from deeplearning4j_tpu.conf.multilayer import (
+    BackpropType,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.conf.updaters import Adam
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+rng = np.random.default_rng(0)
+
+# --- 1. ComputationGraph trains recurrent DAGs with truncated BPTT ---------
+conf = (NeuralNetConfiguration.builder()
+        .seed(1).updater(Adam(0.02)).weight_init(WeightInit.XAVIER)
+        .graph_builder()
+        .add_inputs("in")
+        .set_input_types(InputType.recurrent(4, 40))
+        .add_layer("rnn", LSTM(n_out=16), "in")
+        .add_layer("rnn2", LSTM(n_out=12), "rnn")
+        .add_layer("out", RnnOutputLayer(n_out=3,
+                                         activation=Activation.SOFTMAX,
+                                         loss_fn=LossMCXENT()), "rnn2")
+        .set_outputs("out")
+        .backprop_type(BackpropType.TRUNCATED_BPTT, fwd=5, back=3)
+        .build())
+net = ComputationGraph(conf).init()
+
+x = rng.normal(size=(8, 40, 4)).astype(np.float32)
+y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (8, 40))]
+mask = np.ones((8, 40), np.float32)
+mask[0, 25:] = 0.0        # variable-length sample
+for i in range(4):
+    loss = net.fit_batch(DataSet(x, y, features_mask=mask,
+                                 labels_mask=mask))
+print(f"CG tBPTT loss after 4 batches (32 segments): {float(loss):.4f}")
+
+# --- 1b. masks RESIZE through strided convs (standard backprop) ------------
+mconf = (NeuralNetConfiguration.builder()
+         .seed(3).updater(Adam(0.02)).weight_init(WeightInit.XAVIER)
+         .graph_builder()
+         .add_inputs("in")
+         .set_input_types(InputType.recurrent(4, 40))
+         .add_layer("conv", Convolution1DLayer(     # strided: T 40 -> 20
+             n_out=8, kernel=2, stride1d=2, activation=Activation.TANH,
+             convolution_mode=ConvolutionMode.TRUNCATE), "in")
+         .add_layer("rnn", LSTM(n_out=16), "conv")
+         .add_layer("out", RnnOutputLayer(n_out=3,
+                                          activation=Activation.SOFTMAX,
+                                          loss_fn=LossMCXENT()), "rnn")
+         .set_outputs("out")
+         .build())
+mnet = ComputationGraph(mconf).init()
+lmask = np.ones((8, 20), np.float32)   # labels at the conv-output rate
+lmask[0, 13:] = 0.0
+y20 = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (8, 20))]
+mloss = mnet.fit_batch(DataSet(x, y20, features_mask=mask,
+                               labels_mask=lmask))
+print(f"masked strided-conv graph loss: {float(mloss):.4f} "
+      "(the input mask was max-pool-resized to the 20-step rate)")
+
+# --- 2. streaming inference with per-vertex carries ------------------------
+chain = (NeuralNetConfiguration.builder()
+         .seed(2).updater(Adam(0.02))
+         .graph_builder()
+         .add_inputs("in")
+         .set_input_types(InputType.recurrent(4, 40))
+         .add_layer("rnn", LSTM(n_out=16), "in")
+         .add_layer("out", RnnOutputLayer(n_out=3), "rnn")
+         .set_outputs("out")
+         .build())
+snet = ComputationGraph(chain).init()
+snet.rnn_clear_previous_state()
+part1 = snet.rnn_time_step(x[:, :15])
+part2 = snet.rnn_time_step(x[:, 15:])
+full = snet.output(x)
+err = float(jnp.max(jnp.abs(
+    jnp.concatenate([part1, part2], axis=1) - full)))
+print(f"rnn_time_step vs full forward max err: {err:.2e}")
+
+# --- 3. dashboard histograms ------------------------------------------------
+from deeplearning4j_tpu.ui import InMemoryStatsStorage, StatsListener, UIServer
+
+storage = InMemoryStatsStorage()
+probe = DataSet(x, y, features_mask=mask, labels_mask=mask)
+net.set_listeners(StatsListener(storage, histograms=True,
+                                sample_ds=probe))
+net.fit_batch(probe)
+net.fit_batch(probe)
+panels = [k for k in storage.records()[-1]
+          if k.endswith("_histograms")]
+print("histogram panels recorded:", sorted(panels))
+UIServer().attach(storage).render("/tmp/round3_dashboard.html")
+
+# --- 4. pipeline + expert parallelism (needs >= 4 devices) ------------------
+if len(jax.devices()) >= 4:
+    from jax.sharding import Mesh
+
+    from deeplearning4j_tpu.parallel.expert import (
+        EXPERT_AXIS, moe_init, moe_train_step, shard_moe_params,
+    )
+    from deeplearning4j_tpu.parallel.pipeline import (
+        STAGE_AXIS, pipeline_train_step, stack_stage_params,
+    )
+
+    devs = np.array(jax.devices()[:4])
+    pmesh = Mesh(devs, (STAGE_AXIS,))
+    stages = [{"w": 0.3 * jax.random.normal(jax.random.PRNGKey(s), (8, 8)),
+               "b": jnp.zeros((8,))} for s in range(4)]
+    sp = stack_stage_params(stages, pmesh)
+    xm = jnp.asarray(rng.normal(size=(8, 4, 8)).astype(np.float32))
+    ym = jnp.asarray(rng.normal(size=(8, 4, 8)).astype(np.float32))
+    pstep = pipeline_train_step(
+        lambda p, x: jnp.tanh(x @ p["w"] + p["b"]),
+        lambda o, t: jnp.mean((o - t) ** 2), 4, 8, pmesh, lr=0.1)
+    for _ in range(5):
+        sp, ploss = pstep(sp, xm, ym)
+    print(f"GPipe pipeline (4 stages x 8 microbatches) loss: "
+          f"{float(ploss):.4f}")
+
+    emesh = Mesh(devs, (EXPERT_AXIS,))
+    ep = shard_moe_params(moe_init(jax.random.PRNGKey(7), 8, 32, 4), emesh)
+    xt = jnp.asarray(rng.normal(size=(32, 8)).astype(np.float32))
+    tt = jnp.asarray(rng.normal(size=(32, 8)).astype(np.float32))
+    estep = moe_train_step(4, capacity=32, mesh=emesh, lr=0.05)
+    for _ in range(5):
+        ep, eloss = estep(ep, xt, tt)
+    print(f"MoE expert-parallel (4 experts, all_to_all) loss: "
+          f"{float(eloss):.4f}")
+
+# --- 5. TF1 while-loop frame import ----------------------------------------
+from deeplearning4j_tpu.imports.protos import tf_graph_pb2 as pb
+from deeplearning4j_tpu.imports.tf import TFGraphMapper
+
+
+def _const(g, name, v):
+    n = g.node.add()
+    n.name, n.op = name, "Const"
+    n.attr["dtype"].type = pb.DT_FLOAT
+    t = n.attr["value"].tensor
+    t.dtype = pb.DT_FLOAT
+    t.tensor_content = np.asarray(v, np.float32).tobytes()
+
+
+def _n(g, name, op, *inputs, **attrs):
+    n = g.node.add()
+    n.name, n.op = name, op
+    n.input.extend(inputs)
+    for k, v in attrs.items():
+        n.attr[k].s = v
+    return n
+
+
+g = pb.GraphDef()
+_const(g, "i0", 0.0)
+_const(g, "acc0", 1.0)
+_const(g, "lim", 5.0)
+_n(g, "enter_i", "Enter", "i0", frame_name=b"L")
+_n(g, "enter_acc", "Enter", "acc0", frame_name=b"L")
+e = _n(g, "enter_lim", "Enter", "lim", frame_name=b"L")
+e.attr["is_constant"].b = True
+_n(g, "merge_i", "Merge", "enter_i", "next_i")
+_n(g, "merge_acc", "Merge", "enter_acc", "next_acc")
+_n(g, "less", "Less", "merge_i", "enter_lim")
+_n(g, "cond", "LoopCond", "less")
+_n(g, "sw_i", "Switch", "merge_i", "cond")
+_n(g, "sw_acc", "Switch", "merge_acc", "cond")
+_const(g, "one", 1.0)
+_n(g, "inc", "Add", "sw_i:1", "one")
+_n(g, "dbl", "Add", "sw_acc:1", "sw_acc:1")
+_n(g, "next_i", "NextIteration", "inc")
+_n(g, "next_acc", "NextIteration", "dbl")
+_n(g, "exit_acc", "Exit", "sw_acc")
+sd = TFGraphMapper.import_graph(g.SerializeToString())
+acc = float(np.asarray(sd.output({}, "exit_acc")["exit_acc"]))
+print(f"TF1 while-loop frames import: 2^5 = {acc:.0f}")

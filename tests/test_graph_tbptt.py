@@ -384,3 +384,14 @@ def test_padded_pointwise_conv_streaming_rejected():
     x = np.random.default_rng(0).normal(size=(2, 8, 4)).astype(np.float32)
     with pytest.raises(RuntimeError, match="rnn_time_step is unsupported"):
         cg.rnn_time_step(x)
+
+
+def test_cg_tbptt_rejects_wrong_length_masks():
+    """A mask at the wrong time rate (e.g. reused from a downsampled-rate
+    head) must raise up front, not desynchronize the segment scan
+    (found by examples/round3_features.py)."""
+    cg = ComputationGraph(_cg_conf(t=20)).init()
+    x, y = _seq_data(n=4, t=20)
+    bad = np.ones((4, 10), np.float32)
+    with pytest.raises(ValueError, match="INPUT rate"):
+        cg.fit_batch(DataSet(x, y, labels_mask=bad))

@@ -1018,6 +1018,7 @@ def test_coverage_registry_complete():
     _run_math_structural_round3()
     _run_nn_image_round3()
     _run_linalg_segment_loss_round3()
+    _run_einsum_gathernd_topk_round3()
     rep = coverage_report()
     unexpected = sorted(set(rep["missing"]) - set(_EXEMPT))
     assert not unexpected, (
@@ -1632,3 +1633,32 @@ def test_random_round3_statistics():
     assert abs(np.mean(tnv) - 1.0) < 0.05
     sh = np.asarray(o1["sh"])
     assert sorted(sh.tolist()) == xv.tolist() and not np.all(sh == xv)
+
+
+def _run_einsum_gathernd_topk_round3():
+    rng = np.random.default_rng(99)
+    a = rng.normal(size=(3, 4))
+    b = rng.normal(size=(4, 5))
+    x = rng.normal(size=(3, 4))
+    idx = np.asarray([[0, 1], [2, 3], [1, 0]])
+
+    sd = SameDiff()
+    pa = sd.placeholder("a", (3, 4))
+    pb = sd.placeholder("b", (4, 5))
+    px = sd.placeholder("x", (3, 4))
+    pi = sd.placeholder("i", (3, 2))
+    sd.math.einsum("ij,jk->ik", pa, pb, name="es")
+    sd.math.gatherNd(px, pi, name="gn")
+    v, ind = sd.math.topK(px, 2, name="tk")
+    v.rename("tk_v"); ind.rename("tk_i")
+    srt = np.sort(x, axis=-1)[:, ::-1]
+    validate(TestCase(
+        sd, {"a": a, "b": b, "x": x, "i": idx},
+        {"es": a @ b, "gn": x[idx[:, 0], idx[:, 1]],
+         "tk_v": srt[:, :2],
+         "tk_i": np.argsort(-x, axis=-1)[:, :2]},
+        grad_wrt=["a", "b"], max_rel_error=1e-3))
+
+
+def test_einsum_gathernd_topk_round3_sweep():
+    _run_einsum_gathernd_topk_round3()
