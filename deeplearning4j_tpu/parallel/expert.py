@@ -134,7 +134,20 @@ def moe_train_step(n_experts: int, capacity: int, mesh: Mesh,
                    lr: float = 0.05, aux_weight: float = 1e-2):
     """-> jitted ``(params, x, target) -> (params, loss)``: MSE + aux
     load-balance loss; expert-weight grads stay shard-local, the
-    replicated router's grad is ``pmean``-reduced."""
+    replicated router's grad is ``pmean``-reduced.
+
+    Why pmean and not psum (round-3 advisor follow-up, settled
+    empirically — see test_moe_train_step_gradients_match_single_device):
+    differentiating the ``pmean``-reduced loss inside the shard_map body
+    ALREADY cross-shard-accumulates the router cotangent — the AD
+    transpose of the psum collective inside pmean performs the reduction
+    — so ``g["router"]`` arrives as the full logical gradient, identical
+    on every shard (verified elementwise against the 1-device mesh).
+    ``pmean`` over identical replicas is an identity in both shard_map
+    semantics modes (varying-manual-axes tracking on or off); ``psum``
+    would over-scale the router gradient by n_shards when vma tracking
+    is off. The test pins one full train step against the 1-device mesh
+    elementwise, so any regression in either direction is caught."""
     def spmd(params, x, target):
         def loss_fn(p):
             y, aux = _moe_local(p, x, n_experts, capacity)

@@ -1434,20 +1434,42 @@ def _conv3d(x, w, b, *, strides, padding, dilation):
     return out + b
 
 
+def _deconv_nd(x, w, b, strides, padding, nd):
+    """Transposed conv = gradient-of-conv (scatter-add) semantics, as
+    the reference's deconv2d/deconv3d and this repo's Deconvolution2D
+    layer define: out[i*s+p, ..., o] += x[i, ..., c] * w[p, ..., c, o].
+    Expressed as a direct conv over the stride-dilated input with a
+    spatially-flipped kernel (round-3 advisor: plain lax.conv_transpose
+    omits the flip and diverges for asymmetric kernels; its "SAME" also
+    pads the dilated input one pixel differently from Deconvolution2D —
+    so padding is computed explicitly here, matching the layer exactly:
+    VALID -> out = (i-1)*s + k, SAME -> out = i*s). Pinned against an
+    independent numpy scatter oracle and against the layer in
+    test_op_validation.py."""
+    k = w.shape[:nd]
+    if padding == "SAME":
+        pts = [s + kk - 2 for s, kk in zip(strides, k)]
+        pad = [(pt // 2, pt - pt // 2) for pt in pts]
+    elif padding == "VALID":
+        pad = [(kk - 1, kk - 1) for kk in k]
+    else:
+        raise ValueError(f"deconv: unsupported padding {padding!r}")
+    spec = "DHW"[3 - nd:]
+    dn = (f"N{spec}C", f"{spec}IO", f"N{spec}C")
+    out = jax.lax.conv_general_dilated(
+        x, jnp.flip(w, tuple(range(nd))), window_strides=(1,) * nd,
+        padding=pad, lhs_dilation=strides, dimension_numbers=dn)
+    return out + b
+
+
 @register_op("cnn.deconv2d")
 def _deconv2d(x, w, b, *, strides, padding):
-    out = jax.lax.conv_transpose(
-        x, w, strides=strides, padding=padding,
-        dimension_numbers=("NHWC", "HWIO", "NHWC"))
-    return out + b
+    return _deconv_nd(x, w, b, strides, padding, 2)
 
 
 @register_op("cnn.deconv3d")
 def _deconv3d(x, w, b, *, strides, padding):
-    out = jax.lax.conv_transpose(
-        x, w, strides=strides, padding=padding,
-        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
-    return out + b
+    return _deconv_nd(x, w, b, strides, padding, 3)
 
 
 @register_op("cnn.sconv2d")
@@ -1743,12 +1765,18 @@ def _lstm_cell(x, h, c, w, r, b):
 
 @register_op("rnn.gruCell")
 def _gru_cell(x, h, w, r, b):
+    """One GRU step (reference sd.rnn.gruCell). Candidate uses the
+    ORIGINAL Cho et al. formulation the reference implements — reset
+    gate applied to the state BEFORE the recurrent matmul,
+    ng = tanh(x@Wc + (rg*h)@Rc) — not the CuDNN/``reset_after``
+    variant tanh(x@Wc + rg*(h@Rc)); the two differ numerically
+    (round-3 advisor)."""
     hidden = r.shape[0]
     zx = x @ w + b
-    zh = h @ r
+    zh = h @ r[:, :2 * hidden]
     rg = jax.nn.sigmoid(zx[:, :hidden] + zh[:, :hidden])
-    zg = jax.nn.sigmoid(zx[:, hidden:2 * hidden] + zh[:, hidden:2 * hidden])
-    ng = jnp.tanh(zx[:, 2 * hidden:] + rg * zh[:, 2 * hidden:])
+    zg = jax.nn.sigmoid(zx[:, hidden:2 * hidden] + zh[:, hidden:])
+    ng = jnp.tanh(zx[:, 2 * hidden:] + (rg * h) @ r[:, 2 * hidden:])
     return (1 - zg) * ng + zg * h
 
 

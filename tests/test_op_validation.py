@@ -1128,6 +1128,23 @@ def test_is_max_tie_breaks_to_single_one():
 
 # --- round 3: cnn 3d / transposed / space-batch family ----------------------
 
+def _deconv_scatter_oracle(x, w, strides):
+    """Transposed conv, VALID padding, by direct scatter-add (pure
+    numpy loops — deliberately independent of lax.conv_transpose)."""
+    n = x.shape[0]
+    spatial_in = x.shape[1:-1]
+    c_out = w.shape[-1]
+    k = w.shape[:-2]
+    out_spatial = tuple((i - 1) * s + kk
+                        for i, s, kk in zip(spatial_in, strides, k))
+    out = np.zeros((n,) + out_spatial + (c_out,), dtype=np.float64)
+    for idx in np.ndindex(*spatial_in):
+        for kidx in np.ndindex(*k):
+            pos = tuple(i * s + p for i, s, p in zip(idx, strides, kidx))
+            out[(slice(None),) + pos] += x[(slice(None),) + idx] @ w[kidx]
+    return out
+
+
 def _run_cnn_round3():
     import jax as _jax
 
@@ -1155,10 +1172,12 @@ def _run_cnn_round3():
     dn2 = ("NHWC", "HWIO", "NHWC")
     want_c3 = np.asarray(_jax.lax.conv_general_dilated(
         x3, w3, (1, 1, 1), "VALID", dimension_numbers=dn3))
-    want_d2 = np.asarray(_jax.lax.conv_transpose(
-        x2, wdc, (2, 2), "VALID", dimension_numbers=dn2))
-    want_d3 = np.asarray(_jax.lax.conv_transpose(
-        x3, w3, (1, 1, 1), "VALID", dimension_numbers=dn3))
+    # independent scatter-add oracle for transposed conv (the round-3
+    # oracle restated the implementation's conv_transpose call, which
+    # could not catch the missing spatial kernel flip):
+    # out[n, i*s+p, ..., o] += x[n, i, ..., c] * w[p, ..., c, o]
+    want_d2 = _deconv_scatter_oracle(x2, wdc, (2, 2))
+    want_d3 = _deconv_scatter_oracle(x3, w3, (1, 1, 1))
     dwo = _jax.lax.conv_general_dilated(
         x2, wd, (1, 1), "VALID", feature_group_count=2,
         dimension_numbers=dn2)
@@ -1172,6 +1191,36 @@ def _run_cnn_round3():
 
 def test_cnn_round3_sweep():
     _run_cnn_round3()
+
+
+def test_deconv2d_same_matches_layer():
+    """SAME-padded sd.cnn.deconv2d == the Deconvolution2D layer on the
+    same weights (the sd default is SAME; lax.conv_transpose's SAME pads
+    the dilated input one pixel differently, so the op computes its
+    padding explicitly — this pins the two code paths to one
+    convention, out = i*s with an asymmetric kernel)."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.conf.layers_cnn import (ConvolutionMode,
+                                                    Deconvolution2D)
+
+    rng = np.random.default_rng(95)
+    x = rng.normal(size=(1, 5, 5, 2)).astype(np.float32)
+    w = rng.normal(size=(3, 2, 2, 4), scale=0.5).astype(np.float32)
+
+    sd = SameDiff()
+    px = sd.placeholder("x", x.shape)
+    pw = sd.placeholder("w", w.shape)
+    sd.cnn.deconv2d(px, pw, strides=(2, 2), padding="SAME", name="d2")
+    got = np.asarray(sd.output({"x": x, "w": w}, "d2")["d2"])
+    assert got.shape == (1, 10, 10, 4)
+
+    layer = Deconvolution2D(n_out=4, kernel_size=(3, 2),
+                            stride=(2, 2), has_bias=False,
+                            convolution_mode=ConvolutionMode.SAME)
+    want, _ = layer.forward({"W": jnp.asarray(w)}, None, jnp.asarray(x))
+    np.testing.assert_allclose(got, np.asarray(want), rtol=1e-4,
+                               atol=1e-5)
 
 
 def _run_cnn_pool_space_round3():
@@ -1328,7 +1377,10 @@ def _run_rnn_cells_round3():
     zh = h0 @ rg
     rgt = _sigmoid(zx[:, :H] + zh[:, :H])
     zgt = _sigmoid(zx[:, H:2 * H] + zh[:, H:2 * H])
-    ngt = np.tanh(zx[:, 2 * H:] + rgt * zh[:, 2 * H:])
+    # original Cho et al. candidate — reset applied to the STATE before
+    # the recurrent matmul (reference gruCell semantics, round-3 advisor;
+    # the reset_after variant rgt * zh would differ numerically here)
+    ngt = np.tanh(zx[:, 2 * H:] + (rgt * h0) @ rg[:, 2 * H:])
     gc = (1 - zgt) * ngt + zgt * h0
 
     def sru_step_np(xt, c):

@@ -169,6 +169,40 @@ def test_moe_capacity_drops_pass_residual():
                                atol=1e-6)
 
 
+def test_moe_train_step_gradients_match_single_device():
+    """One moe_train_step on the 4-way expert mesh == the identical step
+    on a 1-device expert mesh, elementwise. Pins the router-gradient
+    reduction (round-3 advisor follow-up): differentiating the pmean'd
+    loss inside the shard_map body already cross-shard-accumulates the
+    router cotangent, so g["router"] arrives as the full logical
+    gradient replicated on every shard — the correct reduction is the
+    identity-on-replicas pmean moe_train_step uses (a psum would
+    over-scale by n_shards when vma tracking is off). aux_weight=0
+    because the load-balance aux uses per-shard token statistics that
+    legitimately differ between mesh sizes; capacity covers every token
+    so the queues cannot diverge either."""
+    E, DH, T, CAP = 4, 32, 32, 32
+    params = moe_init(jax.random.PRNGKey(3), D, DH, E)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(T, D)).astype(np.float32))
+    tgt = jnp.asarray(np.tanh(rng.normal(size=(T, D))).astype(np.float32))
+
+    results = {}
+    for n in (1, 4):
+        mesh = _expert_mesh(n)
+        step = moe_train_step(E, CAP, mesh, lr=0.1, aux_weight=0.0)
+        # fresh copy per mesh: the step donates its params, and on a
+        # 1-device mesh device_put aliases rather than copies
+        fresh = jax.tree.map(jnp.array, params)
+        new, loss = step(shard_moe_params(fresh, mesh), x, tgt)
+        results[n] = (jax.device_get(new), float(loss))
+
+    assert np.isclose(results[4][1], results[1][1], rtol=1e-5, atol=1e-6)
+    for k in ("router", "w1", "w2"):
+        np.testing.assert_allclose(results[4][0][k], results[1][0][k],
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+
+
 def test_moe_trains_and_balances():
     E, DH, T, CAP = 4, 32, 64, 32
     params = moe_init(jax.random.PRNGKey(2), D, DH, E)
