@@ -26,10 +26,13 @@ ExpandDims, Transpose, ConcatV2, Pad/PadV2/MirrorPad, Mean/Sum/Max/Min/
 Prod (reductions), ArgMax/ArgMin, Shape (static), Pack, Unpack,
 Split/SplitV, Cast, Gather/GatherV2/GatherNd, OneHot, Select(V2),
 TopK(V2), ClipByValue, MatrixBandPart, Fill, Range, Tile, Slice,
-StridedSlice, Cumsum/Cumprod, ReverseV2 — the surface BERT-class frozen
-graphs need, plus TF2 functional While/If and TF1 control-flow frames
-(see run()). Unsupported ops raise ``UnsupportedTFOpException`` listing
-the node.
+StridedSlice, Cumsum/Cumprod, ReverseV2, Where (bounded-shape
+convention — see math.whereNonzero), SparseSoftmaxCrossEntropyWithLogits
+(twin-output: per-example loss + backprop) — the surface BERT-class
+frozen graphs need, plus TF2 functional While/If and TF1 control-flow
+frames (see run()). Unsupported ops raise ``UnsupportedTFOpException``
+listing the node. A FusedBatchNorm with its ``is_training`` attr
+stripped fails closed unless ``bn_missing_is_training`` disambiguates.
 """
 
 from __future__ import annotations
@@ -164,8 +167,16 @@ class TFGraphMapper:
     """Static import API (reference class of the same name)."""
 
     @staticmethod
-    def import_graph(path_or_bytes) -> SameDiff:
-        """Frozen GraphDef (path or serialized bytes) -> SameDiff."""
+    def import_graph(path_or_bytes, *,
+                     bn_missing_is_training: bool | None = None) -> SameDiff:
+        """Frozen GraphDef (path or serialized bytes) -> SameDiff.
+
+        ``bn_missing_is_training``: a FusedBatchNorm node whose
+        ``is_training`` attr was stripped (proto3 default-value
+        elision) is ambiguous — TF's op default is training, frozen
+        inference graphs mean false. None (default) fails closed with
+        an error naming the node; True/False imports such nodes in
+        that mode explicitly."""
         if isinstance(path_or_bytes, (bytes, bytearray)):
             data = bytes(path_or_bytes)
         else:
@@ -173,12 +184,15 @@ class TFGraphMapper:
                 data = f.read()
         graph = pb.GraphDef()
         graph.ParseFromString(data)
-        return _Mapper(graph).run()
+        return _Mapper(
+            graph, bn_missing_is_training=bn_missing_is_training).run()
 
 
 class _Mapper:
-    def __init__(self, graph: "pb.GraphDef"):
+    def __init__(self, graph: "pb.GraphDef", *,
+                 bn_missing_is_training: bool | None = None):
         self.graph = graph
+        self.bn_missing_is_training = bn_missing_is_training
         self.sd = SameDiff.create()
         # tf node name -> our variable name
         self.names: dict[str, str] = {}
@@ -517,21 +531,24 @@ class _Mapper:
             x, gamma, beta, mean, var_ = (self._var(i) for i in ins[:5])
             x = self._to_nhwc(x, df)
             # proto3 can't distinguish a missing is_training attr from an
-            # explicit false; TF's OP default is True, but frozen graphs
-            # are inference graphs — treat absent as inference LOUDLY
-            # (round-2 advisor: a GraphDef saved with default attrs
-            # stripped would otherwise import with silently different
-            # numerics) and require an explicit true for the training form
-            if "is_training" not in node.attr:
-                import warnings
-
-                warnings.warn(
-                    f"{node.name}: FusedBatchNorm has no is_training attr; "
-                    "importing as INFERENCE (running stats). TF's op "
-                    "default is training — if this graph was saved with "
-                    "default-valued attrs stripped, re-freeze it with "
-                    "explicit attrs", stacklevel=2)
-            if node.attr["is_training"].b:
+            # explicit false, and TF's OP default is TRAINING — so a
+            # legal GraphDef saved with default-valued attrs stripped
+            # would import with silently inverted numerics whichever
+            # mode we guess. Fail CLOSED (round-3 verdict; round 3
+            # merely warned) unless the caller disambiguates via
+            # import_graph(..., bn_missing_is_training=True/False).
+            if "is_training" in node.attr:
+                training = node.attr["is_training"].b
+            elif self.bn_missing_is_training is not None:
+                training = bool(self.bn_missing_is_training)
+            else:
+                raise UnsupportedTFOpException(
+                    f"{node.name}: FusedBatchNorm has no is_training "
+                    "attr. TF's op default is training, but frozen "
+                    "inference graphs rely on the opposite; refusing to "
+                    "guess. Re-freeze with explicit attrs, or pass "
+                    "bn_missing_is_training=True/False to import_graph")
+            if training:
                 # training mode: batch statistics computed in-graph (the
                 # mean/variance inputs are ignored, as in TF); outputs
                 # 1/2 are the batch stats so a fine-tune step can consume
@@ -753,6 +770,33 @@ class _Mapper:
                          np.atleast_1d(self._static(ins[1], node)))
             v = sd._op("math.reverse", [self._var(ins[0])], dims=dims)[0]
             self._bind(node, v)
+        elif op == "Where":
+            # 1-input Where: data-dependent output size, which XLA
+            # cannot express — imports under the documented
+            # bounded-shape convention (math.whereNonzero): indices
+            # [size(x), rank] zero-padded past the true count, count
+            # exposed as output :1 (absent in TF; harmless extra).
+            # LOUD by design: downstream consumers TF wired against a
+            # [count, rank] tensor see the padded shape — a GatherNd
+            # sum-reduction, for instance, picks up element (0,...,0)
+            # an extra (size-count) times unless masked by :1
+            import warnings
+
+            warnings.warn(
+                f"{node.name}: Where imports with the bounded-shape "
+                "convention — indices are [size(input), rank] "
+                "zero-padded past the true count (count at output "
+                f"'{node.name}:1'). Downstream ops see the padded "
+                "shape; mask by the count output where TF relied on "
+                "the dynamic [count, rank] shape", stacklevel=2)
+            idx, count = sd._op("math.whereNonzero",
+                                [self._var(ins[0])], n_out=2)
+            self._bind_multi(node, [idx, count])
+        elif op == "SparseSoftmaxCrossEntropyWithLogits":
+            logits, labels = self._var(ins[0]), self._var(ins[1])
+            outs = sd._op("loss.sparseSoftmaxCrossEntropyWithLogits",
+                          [labels, logits], n_out=2)
+            self._bind_multi(node, list(outs))
         elif op in ("SpaceToDepth", "DepthToSpace"):
             if _data_format(node) != "NHWC":
                 raise UnsupportedTFOpException(
@@ -921,6 +965,7 @@ class _V1FrameMapper(_Mapper):
     def __init__(self, parent: "_Mapper", bound: dict, sd):
         self.graph = parent.graph
         self.funcs = parent.funcs
+        self.bn_missing_is_training = parent.bn_missing_is_training
         self.sd = sd
         self._node_by_name = parent._node_by_name
         self.names = {k: v.name for k, v in bound.items()}
@@ -961,6 +1006,7 @@ class _FuncMapper(_Mapper):
     def __init__(self, parent: _Mapper, fdef, args):
         self.graph = parent.graph
         self.funcs = parent.funcs
+        self.bn_missing_is_training = parent.bn_missing_is_training
         if len(args) != len(fdef.signature.input_arg):
             raise UnsupportedTFOpException(
                 f"function {fdef.signature.name!r} takes "

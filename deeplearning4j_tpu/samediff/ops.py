@@ -134,6 +134,27 @@ def _where(cond, a, b):
     return jnp.where(cond.astype(bool), a, b)
 
 
+@register_op("math.whereNonzero")
+def _where_nonzero(x):
+    """Coordinates of nonzero elements (TF 1-input ``Where``,
+    reference Where op) under the BOUNDED-SHAPE convention XLA
+    requires: the true output size is data-dependent, so this returns
+    ``(indices, count)`` with ``indices`` [size(x), rank] (default int
+    dtype; TF's op emits int64, irrelevant to consumers here) —
+    row-major coordinates of the nonzero elements in the first
+    ``count`` rows, zero-padded after — and ``count`` scalar int32.
+    Consumers must mask by ``count``; a GatherNd over the padded tail
+    reads element (0,...,0), never out of bounds."""
+    flat = x.reshape(-1).astype(bool)
+    n = flat.shape[0]
+    pos = jnp.arange(n)
+    tgt = jnp.where(flat, jnp.cumsum(flat) - 1, n)  # n -> dropped
+    lin = jnp.zeros_like(pos).at[tgt].set(pos, mode="drop")
+    count = jnp.sum(flat.astype(jnp.int32))
+    coords = jnp.stack(jnp.unravel_index(lin, x.shape), axis=-1)
+    return coords, count
+
+
 @register_op("math.reverse")
 def _reverse(x, *, dims):
     return jnp.flip(x, axis=dims)
@@ -242,6 +263,15 @@ def _sd_cumprod(self, x, axis=0, name=None):
 @_def(SDMath, "where")
 def _sd_where(self, cond, a, b, name=None):
     return self._op("math.where", [cond, a, b], name=name)[0]
+
+
+@_def(SDMath, "whereNonzero")
+def _sd_where_nonzero(self, x, name=None):
+    """-> (indices [size, rank] int, count int32) — bounded-shape
+    nonzero coordinates; see math.whereNonzero."""
+    idx, count = self._op("math.whereNonzero", [x], n_out=2,
+                           name=name)
+    return idx, count
 
 
 @_def(SDMath, "reverse")
@@ -696,14 +726,33 @@ def _sce(labels, logits, *, reduction, label_smoothing):
     return _apply_reduction(per, reduction)
 
 
-@register_op("loss.sparseSoftmaxCrossEntropy")
-def _ssce(labels, logits, *, reduction):
+def _sparse_ce_per_example(labels, logits):
+    """-> (per-example -log p[label], log_softmax(logits)) — shared by
+    the reduced and the TF twin-output sparse-CE forms."""
     lp = jax.nn.log_softmax(logits, axis=-1)
     per = -jnp.take_along_axis(
         lp, labels.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+    return per, lp
+
+
+@register_op("loss.sparseSoftmaxCrossEntropy")
+def _ssce(labels, logits, *, reduction):
+    per, _ = _sparse_ce_per_example(labels, logits)
     if per.ndim > 1:
         per = jnp.mean(per, axis=tuple(range(1, per.ndim)))
     return _apply_reduction(per, reduction)
+
+
+@register_op("loss.sparseSoftmaxCrossEntropyWithLogits")
+def _ssce_with_logits(labels, logits):
+    """TF ``SparseSoftmaxCrossEntropyWithLogits`` twin-output form:
+    (per-example loss [B], backprop [B, C] = softmax - onehot). The
+    backprop output exists so imported TF training graphs that consume
+    output :1 keep their hand-wired gradient path."""
+    per, lp = _sparse_ce_per_example(labels, logits)
+    backprop = jnp.exp(lp) - jax.nn.one_hot(
+        labels.astype(jnp.int32), logits.shape[-1], dtype=logits.dtype)
+    return per, backprop
 
 
 @register_op("loss.sigmoidCrossEntropy")
@@ -787,6 +836,14 @@ class SDLoss(_Namespace):
                                   reduction="mean"):
         return self._loss("sparseSoftmaxCrossEntropy", [labels, logits],
                           name=name, reduction=reduction)
+
+    def sparseSoftmaxCrossEntropyWithLogits(self, labels, logits,
+                                            name=None):
+        """TF twin-output form: (per-example loss, backprop) — no
+        reduction, nothing auto-marked as a loss variable (imported TF
+        graphs wire their own downstream reduction)."""
+        return tuple(self._op("loss.sparseSoftmaxCrossEntropyWithLogits",
+                              [labels, logits], n_out=2, name=name))
 
     def sigmoidCrossEntropy(self, labels, logits, name=None,
                             reduction="mean"):

@@ -1019,6 +1019,7 @@ def test_coverage_registry_complete():
     _run_nn_image_round3()
     _run_linalg_segment_loss_round3()
     _run_einsum_gathernd_topk_round3()
+    _run_where_sparse_ce_round4()
     rep = coverage_report()
     unexpected = sorted(set(rep["missing"]) - set(_EXEMPT))
     assert not unexpected, (
@@ -1026,6 +1027,46 @@ def test_coverage_registry_complete():
         "sweep entry in test_op_validation.py or an explicit exemption "
         "with a pointer to the covering test")
     assert rep["validated"] >= 280, rep["validated"]
+
+
+# --- round 4: bounded Where + TF twin-output sparse CE ----------------------
+
+def _run_where_sparse_ce_round4():
+    rng = np.random.default_rng(96)
+    xv = rng.normal(size=(3, 4))
+    xv[xv < 0.4] = 0.0
+    lv = rng.normal(size=(3, 5))
+    labels = np.asarray([1, 4, 0], np.int32)
+
+    sd = SameDiff()
+    px = sd.placeholder("x", (3, 4))
+    wi, wc = sd.math.whereNonzero(px, name="wn")
+    wi.rename("wi"); wc.rename("wc")
+    # forward-only: integer outputs
+    want = np.argwhere(xv)
+    wi_want = np.zeros((12, 2), np.int32)
+    wi_want[:len(want)] = want
+    validate(TestCase(sd, {"x": xv},
+                      {"wi": wi_want, "wc": np.int32(len(want))},
+                      grad_wrt=[]))
+
+    sd2 = SameDiff()
+    pl = sd2.placeholder("lg", (3, 5))
+    pt = sd2.placeholder("lb", (3,))
+    per, bp = sd2.loss.sparseSoftmaxCrossEntropyWithLogits(pt, pl,
+                                                           name="ce")
+    per.rename("ce_l"); bp.rename("ce_b")
+    e = np.exp(lv - lv.max(axis=-1, keepdims=True))
+    sm = e / e.sum(axis=-1, keepdims=True)
+    onehot = np.eye(5)[labels]
+    validate(TestCase(
+        sd2, {"lg": lv, "lb": labels},
+        {"ce_l": -np.log(sm[np.arange(3), labels]), "ce_b": sm - onehot},
+        grad_wrt=["lg"], max_rel_error=1e-3))
+
+
+def test_where_sparse_ce_round4_sweep():
+    _run_where_sparse_ce_round4()
 
 
 # --- round 2b: reduce3 distances / statistics / misc math -------------------

@@ -147,7 +147,7 @@ def test_import_fused_batchnorm(rng):
     _const(g, "mean", np.asarray([0.5, -0.5, 0.0], np.float32))
     _const(g, "var", np.asarray([1.0, 4.0, 0.25], np.float32))
     _node(g, "bn", "FusedBatchNormV3", "x", "gamma", "beta", "mean", "var",
-          epsilon=1e-3)
+          epsilon=1e-3, is_training=False)
     sd = TFGraphMapper.import_graph(g.SerializeToString())
     x = rng.normal(size=(2, 4, 4, 3)).astype(np.float32)
     out = np.asarray(sd.output({"x": x}, "bn")["bn"])
@@ -919,9 +919,9 @@ def test_import_training_batchnorm_and_finetune(rng):
     assert float(jnp.sum(jnp.abs(gx))) > 0
 
 
-def test_import_inference_batchnorm_multi_output_refs():
-    """is_training absent -> inference form; bn:1/bn:2 pass the supplied
-    running stats through (TF output layout)."""
+def _stripped_bn_graph():
+    """FusedBatchNorm whose is_training attr was stripped (proto3
+    default-value elision) — legal wire bytes, ambiguous semantics."""
     g = pb.GraphDef()
     _placeholder(g, "x", (0, 2, 2, 1))
     _const(g, "gamma", np.ones(1, np.float32))
@@ -930,12 +930,90 @@ def test_import_inference_batchnorm_multi_output_refs():
     _const(g, "v", np.asarray([2.0], np.float32))
     _node(g, "bn", "FusedBatchNorm", "x", "gamma", "beta", "m", "v",
           epsilon=1e-3, data_format=b"NHWC")
-    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    return g
+
+
+def test_import_batchnorm_missing_is_training_fails_closed():
+    """is_training absent -> refuse to guess (round-3 verdict: the
+    round-3 importer warned and silently picked the OPPOSITE of TF's op
+    default on legal input)."""
+    g = _stripped_bn_graph()
+    with pytest.raises(UnsupportedTFOpException,
+                       match="bn_missing_is_training"):
+        TFGraphMapper.import_graph(g.SerializeToString())
+
+
+def test_import_batchnorm_missing_is_training_override_inference():
+    """bn_missing_is_training=False -> inference form; bn:1/bn:2 pass
+    the supplied running stats through (TF output layout)."""
+    g = _stripped_bn_graph()
+    sd = TFGraphMapper.import_graph(g.SerializeToString(),
+                                    bn_missing_is_training=False)
     xv = np.ones((1, 2, 2, 1), np.float32)
     outs = sd.output({"x": xv}, "bn", "bn:1")
     np.testing.assert_allclose(np.asarray(outs["bn"]),
                                (xv - 0.5) / np.sqrt(2.0 + 1e-3), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(outs["bn:1"]), [0.5])
+
+
+def test_import_batchnorm_missing_is_training_override_training():
+    """bn_missing_is_training=True -> TF's op default: batch stats
+    computed in-graph, running-stat inputs ignored."""
+    g = _stripped_bn_graph()
+    sd = TFGraphMapper.import_graph(g.SerializeToString(),
+                                    bn_missing_is_training=True)
+    rng = np.random.default_rng(5)
+    xv = rng.normal(size=(2, 2, 2, 1)).astype(np.float32)
+    outs = sd.output({"x": xv}, "bn", "bn:1", "bn:2")
+    bm = xv.mean(axis=(0, 1, 2))
+    bv = xv.var(axis=(0, 1, 2))
+    np.testing.assert_allclose(np.asarray(outs["bn"]),
+                               (xv - bm) / np.sqrt(bv + 1e-3), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(outs["bn:1"]), bm, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs["bn:2"]), bv, rtol=1e-5)
+
+
+def test_import_where_bounded(rng):
+    """1-input Where under the bounded-shape convention: indices
+    [size(x), rank] zero-padded past the true nonzero count, count at
+    output :1; numpy np.argwhere is the oracle for the live rows."""
+    g = pb.GraphDef()
+    _placeholder(g, "x", (3, 4))
+    _node(g, "w", "Where", "x")
+    with pytest.warns(UserWarning, match="bounded-shape"):
+        sd = TFGraphMapper.import_graph(g.SerializeToString())
+    xv = rng.normal(size=(3, 4)).astype(np.float32)
+    xv[xv < 0.3] = 0.0
+    outs = sd.output({"x": xv}, "w", "w:1")
+    idx = np.asarray(outs["w"])
+    count = int(np.asarray(outs["w:1"]))
+    want = np.argwhere(xv)
+    assert idx.shape == (12, 2)
+    assert count == len(want)
+    np.testing.assert_array_equal(idx[:count], want)
+    np.testing.assert_array_equal(idx[count:], 0)
+
+
+def test_import_sparse_softmax_ce_with_logits(rng):
+    """Twin-output SparseSoftmaxCrossEntropyWithLogits vs numpy: loss
+    [B] per-example, backprop [B, C] = softmax - onehot."""
+    g = pb.GraphDef()
+    _placeholder(g, "logits", (0, 5))
+    _const(g, "labels", np.asarray([1, 4, 0], np.int32))
+    _node(g, "ce", "SparseSoftmaxCrossEntropyWithLogits",
+          "logits", "labels")
+    sd = TFGraphMapper.import_graph(g.SerializeToString())
+    lv = rng.normal(size=(3, 5)).astype(np.float32)
+    labels = np.asarray([1, 4, 0])
+    outs = sd.output({"logits": lv}, "ce", "ce:1")
+    e = np.exp(lv - lv.max(axis=-1, keepdims=True))
+    sm = e / e.sum(axis=-1, keepdims=True)
+    want_loss = -np.log(sm[np.arange(3), labels])
+    onehot = np.eye(5, dtype=np.float32)[labels]
+    np.testing.assert_allclose(np.asarray(outs["ce"]), want_loss,
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs["ce:1"]), sm - onehot,
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_import_missing_function_raises():
