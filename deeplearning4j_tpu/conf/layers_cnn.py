@@ -344,6 +344,23 @@ class SubsamplingLayer(Layer):
         return y, state
 
 
+def _bn_running_update(state, mean, var, decay):
+    """decay*running + (1-decay)*batch — the reference's update rule,
+    shared by BatchNormalization and FusedConvBN1x1 so their state
+    semantics cannot diverge."""
+    return {"mean": decay * state["mean"] + (1 - decay) * mean,
+            "var": decay * state["var"] + (1 - decay) * var}
+
+
+def _bn_normalize(y32, mean, var, eps, gamma, beta):
+    """(y-mean)*rsqrt(var+eps)*gamma + beta (gamma None = locked),
+    shared by BatchNormalization and FusedConvBN1x1."""
+    xhat = (y32 - mean) * lax.rsqrt(var + eps)
+    if gamma is not None:
+        xhat = xhat * gamma + beta
+    return xhat
+
+
 @serde.register
 @dataclasses.dataclass
 class BatchNormalization(BaseLayer):
@@ -392,12 +409,18 @@ class BatchNormalization(BaseLayer):
         sdt = state["mean"].dtype
         x32 = x.astype(sdt)
         if train:
+            # ONE-PASS statistics: E[x] and E[x^2] reduce in the same
+            # fused XLA pass over the activation, where jnp.var's
+            # two-pass form reads it twice (var needs mean first).
+            # Measured on-chip (BASELINE.md round-4): ResNet-50 batch-256
+            # step 115.4 -> 102.4 ms (-11%) — BN statistics were ~14% of
+            # the step per the XProf trace. The E[x^2]-E[x]^2
+            # cancellation at f32 is ~1e-7 relative at BN's mean/var
+            # scales (cuDNN's fused path makes the same trade).
             mean = jnp.mean(x32, axis=axes)
-            var = jnp.var(x32, axis=axes)
-            new_state = {
-                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
-                "var": self.decay * state["var"] + (1 - self.decay) * var,
-            }
+            var = jnp.maximum(
+                jnp.mean(x32 * x32, axis=axes) - mean * mean, 0.0)
+            new_state = _bn_running_update(state, mean, var, self.decay)
         elif self.use_batch_mean_in_eval:
             # reference isMinibatch=false: batch statistics at inference
             mean = jnp.mean(x32, axis=axes)
@@ -406,9 +429,123 @@ class BatchNormalization(BaseLayer):
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
-        xhat = (x32 - mean) * lax.rsqrt(var + self.eps)
-        if not self.lock_gamma_beta:
-            xhat = xhat * params["gamma"] + params["beta"]
+        xhat = _bn_normalize(
+            x32, mean, var, self.eps,
+            None if self.lock_gamma_beta else params["gamma"],
+            None if self.lock_gamma_beta else params["beta"])
+        return self.activation.apply(xhat).astype(x.dtype), new_state
+
+
+@serde.register
+@dataclasses.dataclass
+class FusedConvBN1x1(BaseLayer):
+    """Fused 1x1-convolution + train-mode batch norm as ONE layer whose
+    forward emits the conv output and the BN statistics in a single pass
+    over the activation (Pallas kernel, ``ops/conv_fused.py``).
+
+    Semantics == ``ConvolutionLayer(kernel=(1,1), has_bias=False,
+    activation=IDENTITY)`` followed by ``BatchNormalization(activation=
+    self.activation)`` — same params (W / gamma / beta), same running
+    mean/var state, same decay/eps conventions — so an unfused pair's
+    weights drop in 1:1 (``tests/test_zoo.py`` pins forward AND gradient
+    parity). The reference's cuDNN platform helper does this fusion
+    implicitly per SURVEY.md §2.1; XLA does not (its schedule re-reads y
+    for the statistics), hence the explicit kernel.
+
+    ``kernel_mode``: "off" (DEFAULT) takes the XLA path — the measured
+    winner: the end-to-end A/B (bench_fused_ab.py, BASELINE.md round 4)
+    shows the Pallas kernel integrated at all 36 ResNet-50 sites runs
+    311 ms/step vs XLA's 117 ms — XLA's tuned conv pipelining beats a
+    generic Mosaic matmul at these shapes by far more than the saved
+    statistics pass is worth. "auto" opts into the kernel on TPU when
+    shapes are blockable (off-TPU it runs the Pallas interpreter only
+    under ``force_kernel=True`` — CI). Both paths use identical one-pass
+    statistics; eval mode always rides XLA.
+    """
+
+    n_out: int = 0
+    stride: Tuple[int, int] = (1, 1)
+    decay: float = 0.9
+    eps: float = 1e-5
+    kernel_mode: str = "off"
+    force_kernel: bool = False  # tests: exercise the kernel off-TPU
+
+    def output_type(self, input_type):
+        assert isinstance(input_type, it.Convolutional)
+        sh, sw = _pair(self.stride)
+        return it.Convolutional(
+            height=_out_size(input_type.height, 1, sh, 0,
+                             ConvolutionMode.SAME),
+            width=_out_size(input_type.width, 1, sw, 0,
+                            ConvolutionMode.SAME),
+            channels=self.n_out,
+        )
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        in_c = input_type.channels
+        w = self.weight_init.init(key, (1, 1, in_c, self.n_out), in_c,
+                                  self.n_out, dtype, self.distribution)
+        return {"W": w,
+                "gamma": jnp.ones((self.n_out,), dtype),
+                "beta": jnp.zeros((self.n_out,), dtype)}
+
+    def init_state(self, input_type, dtype=jnp.float32):
+        return {"mean": jnp.zeros((self.n_out,), dtype),
+                "var": jnp.ones((self.n_out,), dtype)}
+
+    def param_order(self):
+        return ["W", "gamma", "beta"]
+
+    def regularized_param_keys(self):
+        return ["W"]
+
+    def _use_kernel(self, m, cin):
+        from deeplearning4j_tpu.ops import conv_fused
+
+        if not conv_fused.fusable(m, cin, self.n_out):
+            return False
+        if self.force_kernel:
+            return True
+        return self.kernel_mode != "off" and jax.default_backend() == "tpu"
+
+    def forward(self, params, state, x, train=False, rng=None):
+        from deeplearning4j_tpu.ops import conv_fused
+
+        x = self._dropout_input(x, train, rng)
+        sh, sw = _pair(self.stride)
+        xs = x[:, ::sh, ::sw, :] if (sh, sw) != (1, 1) else x
+        b, h, wd, cin = xs.shape
+        m = b * h * wd
+        sdt = state["mean"].dtype
+        if train and self._use_kernel(m, cin):
+            y, s, q = conv_fused.conv1x1_bn_stats(xs, params["W"])
+            mean = (s / m).astype(sdt)
+            var = (q / m).astype(sdt) - mean * mean
+        else:
+            y = lax.conv_general_dilated(
+                xs, params["W"], window_strides=(1, 1), padding="VALID",
+                dimension_numbers=_DIMNUMS)
+            y32 = y.astype(sdt)
+            if train:
+                # one-pass E[y^2]-E[y]^2 statistics, SAME formulation as
+                # the kernel's fused sums (cuDNN's fused BN does the
+                # same): keeps kernel-on and kernel-off numerically
+                # aligned; vs the two-pass jnp.var the difference is the
+                # usual f32 cancellation at mean^2 >> var, irrelevant at
+                # BN scale and pinned by tests/test_zoo.py
+                mean = jnp.mean(y32, axis=(0, 1, 2))
+                var = jnp.mean(y32 * y32, axis=(0, 1, 2)) - mean * mean
+            else:
+                mean, var = state["mean"], state["var"]
+        if train:
+            # one-pass E[y^2]-E[y]^2 can round slightly negative
+            var = jnp.maximum(var, 0.0)
+            new_state = _bn_running_update(state, mean, var, self.decay)
+        else:
+            new_state = state
+        xhat = _bn_normalize(y.astype(sdt), mean, var, self.eps,
+                             params["gamma"].astype(sdt),
+                             params["beta"].astype(sdt))
         return self.activation.apply(xhat).astype(x.dtype), new_state
 
 

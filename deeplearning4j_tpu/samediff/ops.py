@@ -2559,3 +2559,230 @@ NAMESPACES = {
     "random": SDRandom, "linalg": SDLinalg, "image": SDImage,
     "bitwise": SDBitwise,
 }
+
+
+# ======================= round 4: ctc / fft / embedding / s2b_nd =======================
+# Reference: libnd4j declarable ops ctc_loss (ops/declarable/generic/loss/
+# ctcLoss.cpp), fft/ifft/rfft/irfft (.../fft), embedding_lookup
+# (.../embeddings), space_to_batch_nd / batch_to_space_nd (.../tnse —
+# SURVEY.md §2.1 declarable-op catalog; named round-3 verdict gaps).
+
+_CTC_NEG = -1e30  # -inf surrogate: safe under logaddexp arithmetic
+
+
+@register_op("loss.ctcLoss")
+def _ctc_loss(target_labels, logits, target_label_lengths,
+              logit_input_lengths, *, blank_index):
+    """CTC negative log-likelihood per example (reference ctc_loss).
+
+    ``target_labels`` [B, L] int; ``logits`` [B, T, C] unnormalized;
+    lengths [B]. Log-space alpha (forward) recursion over the extended
+    blank-interleaved label sequence as ONE ``lax.scan`` over time —
+    XLA-friendly (static shapes, masked variable lengths; the backward
+    is autodiff through the scan, which yields the classic
+    soft-alignment-posterior gradient without a hand-written beta pass).
+    """
+    B, T, C = logits.shape
+    L = target_labels.shape[1]
+    labels = target_labels.astype(jnp.int32)
+    lab_len = target_label_lengths.astype(jnp.int32)
+    inp_len = logit_input_lengths.astype(jnp.int32)
+    # promote to >=f32 but PRESERVE f64 (the validation harness grad-checks
+    # in double precision, reference protocol)
+    logp = jax.nn.log_softmax(
+        logits.astype(jnp.promote_types(logits.dtype, jnp.float32)),
+        axis=-1)
+    S = 2 * L + 1
+    # extended sequence: blank at even s, label (s-1)//2 at odd s
+    ext = jnp.full((B, S), blank_index, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    s_idx = jnp.arange(S)
+    valid_s = s_idx[None, :] < (2 * lab_len + 1)[:, None]
+    # the s-2 skip transition: s>=2, l'[s] != blank, l'[s] != l'[s-2]
+    ext_m2 = jnp.concatenate(
+        [jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+    can_skip = (ext != blank_index) & (ext != ext_m2)
+
+    def emit(logp_t):  # [B, C] -> [B, S] log p of the extended symbol
+        e = jnp.take_along_axis(logp_t, ext, axis=1)
+        return jnp.where(valid_s, e, _CTC_NEG)
+
+    alpha = jnp.where(s_idx[None, :] < 2, emit(logp[:, 0]), _CTC_NEG)
+
+    def step(alpha, xs):
+        t, logp_t = xs
+        a1 = alpha
+        a2 = jnp.concatenate(
+            [jnp.full((B, 1), _CTC_NEG), alpha[:, :-1]], axis=1)
+        a3 = jnp.concatenate(
+            [jnp.full((B, 2), _CTC_NEG), alpha[:, :-2]], axis=1)
+        a3 = jnp.where(can_skip, a3, _CTC_NEG)
+        new = jnp.logaddexp(jnp.logaddexp(a1, a2), a3) + emit(logp_t)
+        # freeze finished examples (t beyond their input length)
+        new = jnp.where((t < inp_len)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = jax.lax.scan(
+        step, alpha, (jnp.arange(1, T), jnp.moveaxis(logp[:, 1:], 1, 0)))
+    end_blank = jnp.take_along_axis(alpha, (2 * lab_len)[:, None], axis=1)[:, 0]
+    end_label = jnp.where(
+        lab_len > 0,
+        jnp.take_along_axis(alpha,
+                            jnp.maximum(2 * lab_len - 1, 0)[:, None],
+                            axis=1)[:, 0],
+        _CTC_NEG)
+    tot = jnp.logaddexp(end_blank, end_label)
+    # infeasible alignment (input shorter than the minimum CTC length:
+    # every end state still at the -inf surrogate) -> +inf like the
+    # reference, not a huge-but-finite value with garbage gradients
+    return jnp.where(tot < 0.5 * _CTC_NEG, jnp.inf, -tot)
+
+
+@_def(SDLoss, "ctcLoss")
+def _sd_ctc_loss(self, target_labels, logit_input, target_label_lengths,
+                 logit_input_lengths, blank_index=0, name=None):
+    out = self._op("loss.ctcLoss",
+                   [target_labels, logit_input, target_label_lengths,
+                    logit_input_lengths],
+                   name=name, blank_index=int(blank_index))[0]
+    self.sd.mark_loss(out)
+    return out
+
+
+# --- fft family (jnp.fft lowers to XLA FFT HLO; TPU executes natively) ---
+
+@register_op("math.fft")
+def _fft(x):
+    return jnp.fft.fft(x)
+
+
+@register_op("math.ifft")
+def _ifft(x):
+    return jnp.fft.ifft(x)
+
+
+@register_op("math.rfft")
+def _rfft(x, *, n):
+    return jnp.fft.rfft(x, n=n)
+
+
+@register_op("math.irfft")
+def _irfft(x, *, n):
+    return jnp.fft.irfft(x, n=n)
+
+
+@register_op("math.fft2")
+def _fft2(x):
+    return jnp.fft.fft2(x)
+
+
+@register_op("math.ifft2")
+def _ifft2(x):
+    return jnp.fft.ifft2(x)
+
+
+@register_op("math.fft3")
+def _fft3(x):
+    return jnp.fft.fftn(x, axes=(-3, -2, -1))
+
+
+@register_op("math.ifft3")
+def _ifft3(x):
+    return jnp.fft.ifftn(x, axes=(-3, -2, -1))
+
+
+for _n in ("fft", "ifft", "fft2", "ifft2", "fft3", "ifft3"):
+    def _sd_fft(self, x, name=None, _n=_n):
+        return self._op(f"math.{_n}", [x], name=name)[0]
+    _sd_fft.__name__ = _n
+    setattr(SDMath, _n, _sd_fft)
+
+
+@_def(SDMath, "rfft")
+def _sd_rfft(self, x, n=None, name=None):
+    return self._op("math.rfft", [x], name=name,
+                    n=None if n is None else int(n))[0]
+
+
+@_def(SDMath, "irfft")
+def _sd_irfft(self, x, n=None, name=None):
+    return self._op("math.irfft", [x], name=name,
+                    n=None if n is None else int(n))[0]
+
+
+@register_op("nn.embeddingLookup")
+def _embedding_lookup(weights, ids):
+    """Reference embedding_lookup (div/mod partition strategies collapse:
+    sharded tables are one logical array under jax.sharding)."""
+    return jnp.take(weights, ids.astype(jnp.int32), axis=0)
+
+
+@_def(SDNN, "embeddingLookup")
+def _sd_embedding_lookup(self, weights, ids, name=None):
+    return self._op("nn.embeddingLookup", [weights, ids], name=name)[0]
+
+
+@register_op("cnn.spaceToBatchNd")
+def _space_to_batch_nd(x, *, block_shape, paddings):
+    """TF-convention SpaceToBatchND: pad spatial dims, move block
+    offsets into batch (block index varies slower than input batch)."""
+    bs = [int(b) for b in block_shape]
+    M = len(bs)
+    pads = [(0, 0)] + [tuple(int(q) for q in p) for p in paddings] \
+        + [(0, 0)] * (x.ndim - 1 - M)
+    x = jnp.pad(x, pads)
+    sh = x.shape
+    rs = [sh[0]]
+    for i in range(M):
+        rs += [sh[1 + i] // bs[i], bs[i]]
+    rs += list(sh[1 + M:])
+    x = x.reshape(rs)
+    perm = [2 * i + 2 for i in range(M)] + [0] \
+        + [2 * i + 1 for i in range(M)] + list(range(1 + 2 * M, len(rs)))
+    x = x.transpose(perm)
+    out_b = sh[0]
+    for b in bs:
+        out_b *= b
+    return x.reshape([out_b] + [sh[1 + i] // bs[i] for i in range(M)]
+                     + list(sh[1 + M:]))
+
+
+@register_op("cnn.batchToSpaceNd")
+def _batch_to_space_nd(x, *, block_shape, crops):
+    """Exact inverse of spaceToBatchNd (then crop)."""
+    bs = [int(b) for b in block_shape]
+    M = len(bs)
+    sh = x.shape
+    prod_b = 1
+    for b in bs:
+        prod_b *= b
+    b0 = sh[0] // prod_b
+    x = x.reshape(bs + [b0] + list(sh[1:]))
+    # inverse permutation of [b_1..b_M, B, S'_1..S'_M, rest]
+    perm = [M]
+    for i in range(M):
+        perm += [M + 1 + i, i]
+    perm += list(range(2 * M + 1, x.ndim))
+    x = x.transpose(perm)
+    x = x.reshape([b0] + [sh[1 + i] * bs[i] for i in range(M)]
+                  + list(sh[1 + M:]))
+    sl = [slice(None)]
+    for i in range(M):
+        c0, c1 = (int(q) for q in crops[i])
+        sl.append(slice(c0, x.shape[1 + i] - c1))
+    return x[tuple(sl)]
+
+
+@_def(SDCNN, "spaceToBatchNd")
+def _sd_s2b_nd(self, x, block_shape, paddings, name=None):
+    return self._op("cnn.spaceToBatchNd", [x], name=name,
+                    block_shape=tuple(int(b) for b in block_shape),
+                    paddings=tuple(tuple(int(q) for q in p)
+                                   for p in paddings))[0]
+
+
+@_def(SDCNN, "batchToSpaceNd")
+def _sd_b2s_nd(self, x, block_shape, crops, name=None):
+    return self._op("cnn.batchToSpaceNd", [x], name=name,
+                    block_shape=tuple(int(b) for b in block_shape),
+                    crops=tuple(tuple(int(q) for q in p) for p in crops))[0]

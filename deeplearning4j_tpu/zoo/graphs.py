@@ -154,8 +154,26 @@ class ResNet50(GraphZooModel):
                         out[m, n, f:f + cin] = k7[2 * m + a, 2 * n + b]
         return out
 
+    fused_conv_bn: bool = False
+    """Route every 1x1-conv + BN pair through ``FusedConvBN1x1`` (same
+    math, BN statistics fused into the conv's output pass by a Pallas
+    kernel — see ``ops/conv_fused.py``). ResNet-50 has 36 such pairs
+    (bottleneck a/c convs + projections); the unfused schedule re-reads
+    each conv output once for the statistics. Weights map 1:1 from the
+    unfused graph via :meth:`fused_param_remap`; parity pinned by
+    ``tests/test_zoo.py``. Default off keeps the reference's exact
+    layer-pair topology. Set via attribute after construction."""
+
     def _conv_bn(self, g, name, n_out, k, s, inp, act=True,
                  mode=ConvolutionMode.SAME):
+        if self.fused_conv_bn and tuple(k) == (1, 1):
+            from deeplearning4j_tpu.conf.layers_cnn import FusedConvBN1x1
+
+            g.add_layer(f"{name}_cb", FusedConvBN1x1(
+                n_out=n_out, stride=s,
+                activation=Activation.RELU if act else Activation.IDENTITY),
+                inp)
+            return f"{name}_cb"
         g.add_layer(f"{name}_conv",
                     _conv(n_out, k, s, Activation.IDENTITY, mode,
                           bias=False), inp)
@@ -163,6 +181,37 @@ class ResNet50(GraphZooModel):
             activation=Activation.RELU if act else Activation.IDENTITY),
             f"{name}_conv")
         return f"{name}_bn"
+
+    @staticmethod
+    def fused_param_remap(params, state):
+        """Map an unfused ResNet-50's params/state onto the
+        ``fused_conv_bn=True`` graph: every ``{n}_conv`` (W) + ``{n}_bn``
+        (gamma/beta, running mean/var) pair collapses into ``{n}_cb``
+        holding all five; non-1x1 layers pass through unchanged.
+        Transfer-learning/pretrained weights load through this."""
+        new_p, new_s = {}, {}
+        for k, v in params.items():
+            if k.endswith("_conv") and v.get("W") is not None \
+                    and v["W"].shape[:2] == (1, 1) \
+                    and f"{k[:-5]}_bn" in params and "b" not in v:
+                n = k[:-5]
+                new_p[f"{n}_cb"] = {"W": v["W"],
+                                    "gamma": params[f"{n}_bn"]["gamma"],
+                                    "beta": params[f"{n}_bn"]["beta"]}
+                new_s[f"{n}_cb"] = dict(state.get(f"{n}_bn", {}))
+            elif k.endswith("_bn") and f"{k[:-3]}_conv" in params \
+                    and params[f"{k[:-3]}_conv"].get("W") is not None \
+                    and params[f"{k[:-3]}_conv"]["W"].shape[:2] == (1, 1) \
+                    and "b" not in params[f"{k[:-3]}_conv"]:
+                continue  # folded into the _cb entry above
+            else:
+                new_p[k] = v
+                if k in state:
+                    new_s[k] = state[k]
+        for k, v in state.items():
+            if k not in new_s and k in new_p:
+                new_s[k] = v
+        return new_p, new_s
 
     def _bottleneck(self, g, name, inp, filters, stride, project):
         f1, f2, f3 = filters

@@ -1020,6 +1020,7 @@ def test_coverage_registry_complete():
     _run_linalg_segment_loss_round3()
     _run_einsum_gathernd_topk_round3()
     _run_where_sparse_ce_round4()
+    _run_round4_ctc_fft_embed()
     rep = coverage_report()
     unexpected = sorted(set(rep["missing"]) - set(_EXEMPT))
     assert not unexpected, (
@@ -1755,3 +1756,183 @@ def _run_einsum_gathernd_topk_round3():
 
 def test_einsum_gathernd_topk_round3_sweep():
     _run_einsum_gathernd_topk_round3()
+
+
+# --- round 4b: ctc loss / fft family / embedding / space-batch nd -----------
+
+def _ctc_loss_numpy(labels, logp, lab_len, inp_len, blank):
+    """Independent float64 forward-algorithm oracle (textbook alpha DP,
+    per-example python loops — deliberately NOT the op's vectorized
+    masked-scan formulation)."""
+    B = logp.shape[0]
+    out = np.zeros(B)
+    for b in range(B):
+        lab = labels[b][:lab_len[b]]
+        T = inp_len[b]
+        ext = [blank]
+        for c in lab:
+            ext += [int(c), blank]
+        S = len(ext)
+        alpha = np.full(S, -np.inf)
+        alpha[0] = logp[b, 0, blank]
+        if S > 1:
+            alpha[1] = logp[b, 0, ext[1]]
+        for t in range(1, T):
+            new = np.full(S, -np.inf)
+            for s in range(S):
+                acc = alpha[s]
+                if s >= 1:
+                    acc = np.logaddexp(acc, alpha[s - 1])
+                if s >= 2 and ext[s] != blank and ext[s] != ext[s - 2]:
+                    acc = np.logaddexp(acc, alpha[s - 2])
+                new[s] = acc + logp[b, t, ext[s]]
+            alpha = new
+        tot = alpha[S - 1]
+        if S > 1:
+            tot = np.logaddexp(tot, alpha[S - 2])
+        out[b] = -tot
+    return out
+
+
+def _run_round4_ctc_fft_embed():
+    rng = np.random.default_rng(101)
+
+    # --- ctcLoss vs the loop oracle + f64 central-difference gradient ---
+    B, T, C, L = 3, 6, 5, 2
+    logits = rng.normal(size=(B, T, C))
+    labels = np.asarray([[1, 2], [3, 3], [2, 0]], np.int32)
+    lab_len = np.asarray([2, 2, 1], np.int32)
+    inp_len = np.asarray([6, 5, 4], np.int32)
+    logp = logits - np.log(
+        np.exp(logits).sum(-1, keepdims=True))
+    want = _ctc_loss_numpy(labels, logp, lab_len, inp_len, blank=0)
+    sd = SameDiff()
+    pl = sd.placeholder("lg", (B, T, C))
+    pt = sd.constant(labels, "lb")
+    pll = sd.constant(lab_len, "ll")
+    pil = sd.constant(inp_len, "il")
+    sd.loss.ctcLoss(pt, pl, pll, pil, blank_index=0, name="ctc")
+    validate(TestCase(sd, {"lg": logits}, {"ctc": want},
+                      grad_wrt=["lg"], max_rel_error=1e-3))
+
+    # blank at C-1 (the TF convention) exercises the skip-mask path
+    blank = C - 1
+    labels2 = np.asarray([[0, 1], [2, 2], [1, 3]], np.int32)
+    want2 = _ctc_loss_numpy(labels2, logp, lab_len, inp_len, blank=blank)
+    sd = SameDiff()
+    pl = sd.placeholder("lg", (B, T, C))
+    sd.loss.ctcLoss(sd.constant(labels2, "lb"), pl,
+                    sd.constant(lab_len, "ll"), sd.constant(inp_len, "il"),
+                    blank_index=blank, name="ctc")
+    validate(TestCase(sd, {"lg": logits}, {"ctc": want2}, grad_wrt=[]))
+
+    # --- fft family (complex outputs validated through |.|; irfft real) ---
+    xv = rng.normal(size=(2, 8))
+    sd = SameDiff()
+    x = sd.placeholder("x", (2, 8))
+    sd.math.abs(sd.math.fft(x), name="f")
+    sd.math.abs(sd.math.ifft(x), name="fi")
+    sd.math.abs(sd.math.rfft(x), name="fr")
+    validate(TestCase(sd, {"x": xv}, {
+        "f": np.abs(np.fft.fft(xv)),
+        "fi": np.abs(np.fft.ifft(xv)),
+        "fr": np.abs(np.fft.rfft(xv)),
+    }, grad_wrt=["x"], max_rel_error=1e-3))
+
+    cv = rng.normal(size=(2, 5))  # irfft: real output, direct compare
+    sd = SameDiff()
+    x = sd.placeholder("x", (2, 5))
+    sd.math.irfft(sd.math.rfft(x), n=5, name="rt")  # round-trip = identity
+    validate(TestCase(sd, {"x": cv}, {"rt": cv}, grad_wrt=["x"],
+                      max_rel_error=1e-3))
+
+    x2 = rng.normal(size=(2, 4, 4))
+    sd = SameDiff()
+    x = sd.placeholder("x", (2, 4, 4))
+    sd.math.abs(sd.math.fft2(x), name="f2")
+    sd.math.abs(sd.math.ifft2(x), name="fi2")
+    validate(TestCase(sd, {"x": x2}, {
+        "f2": np.abs(np.fft.fft2(x2)),
+        "fi2": np.abs(np.fft.ifft2(x2))}, grad_wrt=[]))
+    x3 = rng.normal(size=(2, 2, 4, 4))
+    sd = SameDiff()
+    x = sd.placeholder("x", (2, 2, 4, 4))
+    sd.math.abs(sd.math.fft3(x), name="f3")
+    sd.math.abs(sd.math.ifft3(x), name="fi3")
+    validate(TestCase(sd, {"x": x3}, {
+        "f3": np.abs(np.fft.fftn(x3, axes=(-3, -2, -1))),
+        "fi3": np.abs(np.fft.ifftn(x3, axes=(-3, -2, -1)))}, grad_wrt=[]))
+
+    # --- embeddingLookup: values + gradient scatters into the table ---
+    wv = rng.normal(size=(6, 4))
+    ids = np.asarray([[0, 3], [5, 3]], np.int32)
+    sd = SameDiff()
+    w = sd.placeholder("w", (6, 4))
+    sd.nn.embeddingLookup(w, sd.constant(ids, "ids"), name="e")
+    validate(TestCase(sd, {"w": wv}, {"e": wv[ids]}, grad_wrt=["w"]))
+
+    # --- spaceToBatchNd / batchToSpaceNd vs an index-loop oracle ---
+    xv = rng.normal(size=(2, 4, 6, 3))
+    block = (2, 3)
+    pads = ((0, 0), (0, 0))
+
+    def s2b_oracle(x, block, pads):
+        x = np.pad(x, [(0, 0)] + [tuple(p) for p in pads] + [(0, 0)])
+        B, H, W, C = x.shape
+        bh, bw = block
+        out = np.zeros((B * bh * bw, H // bh, W // bw, C), x.dtype)
+        for b in range(B):
+            for i in range(H):
+                for j in range(W):
+                    ob = (i % bh * bw + j % bw) * B + b
+                    out[ob, i // bh, j // bw] = x[b, i, j]
+        return out
+
+    want = s2b_oracle(xv, block, pads)
+    sd = SameDiff()
+    x = sd.placeholder("x", (2, 4, 6, 3))
+    sd.cnn.spaceToBatchNd(x, block, pads, name="s")
+    validate(TestCase(sd, {"x": xv}, {"s": want}))
+
+    yv = rng.normal(size=(12, 2, 2, 3))
+    sd = SameDiff()
+    y = sd.placeholder("y", (12, 2, 2, 3))
+    sd.cnn.batchToSpaceNd(y, block, ((1, 0), (0, 1)), name="b")
+    inv = np.zeros((2, 4, 6, 3))
+    for ob in range(12):
+        for oi in range(2):
+            for oj in range(2):
+                blk, b = divmod(ob, 2)
+                bi, bj = divmod(blk, 3)
+                inv[b, oi * 2 + bi, oj * 3 + bj] = yv[ob, oi, oj]
+    validate(TestCase(sd, {"y": yv},
+                      {"b": inv[:, 1:, :-1]}, grad_wrt=["y"]))
+
+    # round-trip pins the two as exact inverses (with pad/crop)
+    sd = SameDiff()
+    x = sd.placeholder("x", (2, 4, 6, 3))
+    s = sd.cnn.spaceToBatchNd(x, block, ((2, 0), (1, 2)))
+    sd.cnn.batchToSpaceNd(s, block, ((2, 0), (1, 2)), name="rt")
+    validate(TestCase(sd, {"x": xv}, {"rt": xv}))
+
+
+def test_round4_ctc_fft_embed_sweep():
+    _run_round4_ctc_fft_embed()
+
+
+def test_ctc_loss_infeasible_is_inf():
+    """Input shorter than the minimum CTC alignment length -> +inf (the
+    reference surfaces the bad example; a huge finite value would
+    silently poison training with garbage gradients)."""
+    rng = np.random.default_rng(7)
+    logits = rng.normal(size=(2, 2, 5))
+    labels = np.asarray([[1, 2, 3], [1, 0, 0]], np.int32)
+    sd = SameDiff()
+    pl = sd.placeholder("lg", (2, 2, 5))
+    sd.loss.ctcLoss(sd.constant(labels, "lb"), pl,
+                    sd.constant(np.asarray([3, 1], np.int32), "ll"),
+                    sd.constant(np.asarray([2, 2], np.int32), "il"),
+                    blank_index=0, name="ctc")
+    out = np.asarray(sd.output({"lg": logits}, "ctc")["ctc"])
+    assert np.isinf(out[0]) and out[0] > 0   # T=2 < 3 labels: infeasible
+    assert np.isfinite(out[1])               # 1 label in T=2: feasible
