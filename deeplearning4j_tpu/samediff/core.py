@@ -628,6 +628,32 @@ class SameDiff:
     def gather_nd(self, x, indices, name=None):
         return self._op("gather_nd", [x, indices], name=name)[0]
 
+    # scatter-nd family (reference scatter_nd / scatter_nd_add /
+    # scatter_nd_sub / scatter_nd_update: index TUPLES in the trailing
+    # dim select elements; scatter_nd builds from zeros, duplicates sum)
+    def scatter_nd(self, indices, updates, shape, name=None):
+        return self._op("scatter.nd", [indices, updates], name=name,
+                        shape=tuple(int(s) for s in shape))[0]
+
+    def scatter_nd_add(self, ref, indices, updates, name=None):
+        return self._op("scatter.ndAdd", [ref, indices, updates],
+                        name=name)[0]
+
+    def scatter_nd_sub(self, ref, indices, updates, name=None):
+        return self._op("scatter.ndSub", [ref, indices, updates],
+                        name=name)[0]
+
+    def scatter_nd_update(self, ref, indices, updates, name=None):
+        return self._op("scatter.ndUpdate", [ref, indices, updates],
+                        name=name)[0]
+
+    def split_v(self, x, sizes, axis=0, name=None):
+        """Unequal-size split (reference split_v); `split` stays the
+        equal-parts form."""
+        return tuple(self._op("split_v", [x], n_out=len(sizes), name=name,
+                              sizes=tuple(int(s) for s in sizes),
+                              axis=int(axis)))
+
     # segment family (reference SDBaseOps segment* / unsortedSegment*: the
     # jax impls don't require sorted ids, so both surfaces share one op.
     # DEVIATION: num_segments is always required — XLA needs static output

@@ -2786,3 +2786,899 @@ def _sd_b2s_nd(self, x, block_shape, crops, name=None):
     return self._op("cnn.batchToSpaceNd", [x], name=name,
                     block_shape=tuple(int(b) for b in block_shape),
                     crops=tuple(tuple(int(q) for q in p) for p in crops))[0]
+
+
+# ======================= round 4b: math / reduce / structural tail =======================
+# Reference: libnd4j ops/declarable/generic/parity_ops + transforms —
+# roll, fill, linspace, range, repeat, broadcast_to, stop_gradient,
+# invert_permutation, nth_element, in_top_k, histogram(+fixed_width),
+# unique(+with_counts), listdiff, dynamic_partition, clip_by_global_norm,
+# compare_and_bitpack, divnonan/x*y, assign, equals_with_eps,
+# merge_max_index, first/last_index, match_condition, axpy,
+# sufficient_statistics / normalize_moments, choose, check_numerics.
+# Bounded-shape convention (XLA static shapes): ops whose reference output
+# size is data-dependent (unique, listdiff, choose, dynamic_partition)
+# return max-size zero-padded arrays + an explicit count output, exactly
+# like math.whereNonzero above.
+
+@register_op("math.stopGradient")
+def _stop_gradient(x):
+    return jax.lax.stop_gradient(x)
+
+
+@register_op("math.broadcastTo")
+def _broadcast_to(x, *, shape):
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+@register_op("math.fill")
+def _fill(*, shape, value, dtype):
+    return jnp.full(tuple(shape), value, dtype=dtype)
+
+
+@register_op("math.linspace")
+def _linspace(*, start, stop, num):
+    return jnp.linspace(start, stop, num)
+
+
+@register_op("math.range")
+def _range(*, start, limit, delta):
+    return jnp.arange(start, limit, delta)
+
+
+@register_op("math.repeat")
+def _repeat(x, *, repeats, axis):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register_op("math.roll")
+def _roll(x, *, shift, axis):
+    return jnp.roll(x, shift, axis=axis)
+
+
+@register_op("math.invertPermutation")
+def _invert_permutation(x):
+    n = x.shape[-1]
+    return jnp.zeros_like(x).at[..., x.astype(jnp.int32)].set(
+        jnp.arange(n, dtype=x.dtype)) if x.ndim == 1 else \
+        jax.vmap(lambda p: jnp.zeros_like(p).at[p.astype(jnp.int32)].set(
+            jnp.arange(n, dtype=p.dtype)))(x)
+
+
+@register_op("math.nthElement")
+def _nth_element(x, *, n, reverse):
+    s = jnp.sort(x, axis=-1)
+    idx = x.shape[-1] - 1 - n if reverse else n
+    return s[..., idx]
+
+
+@register_op("math.inTopK")
+def _in_top_k(predictions, targets, *, k):
+    t = targets.astype(jnp.int32)
+    target_score = jnp.take_along_axis(
+        predictions, t[:, None], axis=-1)[:, 0]
+    # TF semantics: count of strictly-greater scores < k
+    n_better = jnp.sum(predictions > target_score[:, None], axis=-1)
+    return n_better < k
+
+
+def _bin_counts(x, lo, hi, nbins):
+    """Shared histogram body; a degenerate (zero-width) range puts all
+    mass in bin 0 instead of dividing by zero."""
+    w = (hi - lo) / nbins
+    idx = jnp.clip(((x - lo) / jnp.where(w == 0, 1.0, w)).astype(jnp.int32),
+                   0, nbins - 1)
+    return jax.ops.segment_sum(jnp.ones(x.size, jnp.int32),
+                               idx.reshape(-1), nbins)
+
+
+@register_op("math.histogram")
+def _histogram(x, *, nbins):
+    return _bin_counts(x, jnp.min(x), jnp.max(x), nbins)
+
+
+@register_op("math.histogramFixedWidth")
+def _histogram_fixed_width(x, *, lo, hi, nbins):
+    return _bin_counts(x, lo, hi, nbins)
+
+
+def _unique_parts(x):
+    n = x.size
+    xf = x.reshape(-1)
+    u, inv = jnp.unique(xf, size=n, return_inverse=True, fill_value=0)
+    inv = inv.reshape(-1)
+    # first-occurrence position of each sorted-unique slot (n = "never")
+    first = jnp.full(n, n, jnp.int32).at[inv].min(
+        jnp.arange(n, dtype=jnp.int32))
+    order = jnp.argsort(first)  # padded slots (first=n) sort last
+    rank = jnp.argsort(order)
+    values = u[order]
+    indices = rank[inv]
+    counts = jnp.zeros(n, jnp.int32).at[inv].add(1)[order]
+    count = jnp.sum(first < n)
+    return values, indices.astype(jnp.int32), counts, count
+
+
+@register_op("math.unique")
+def _unique(x):
+    """First-occurrence-ordered unique values (TF convention), bounded
+    shape: (values zero-padded to x.size, inverse indices, count)."""
+    values, indices, _, count = _unique_parts(x)
+    return values, indices, count
+
+
+@register_op("math.uniqueWithCounts")
+def _unique_with_counts(x):
+    values, indices, counts, count = _unique_parts(x)
+    return values, indices, counts, count
+
+
+@register_op("math.listDiff")
+def _list_diff(x, y):
+    """Elements of x not present in y (order kept), bounded shape:
+    (values padded to x.size, their indices in x, count)."""
+    keep = ~jnp.isin(x, y)
+    n = x.size
+    (idx,) = jnp.nonzero(keep, size=n, fill_value=0)
+    count = jnp.sum(keep)
+    valid = jnp.arange(n) < count
+    return (jnp.where(valid, x[idx], 0), 
+            jnp.where(valid, idx, 0).astype(jnp.int32), count)
+
+
+@register_op("math.dynamicPartition")
+def _dynamic_partition(x, partitions, *, num_partitions):
+    """Bounded shape: each partition padded to len(x) rows; the LAST
+    output is the per-partition counts [num_partitions]."""
+    p = partitions.astype(jnp.int32)
+    n = x.shape[0]
+    outs = []
+    counts = []
+    for i in range(num_partitions):
+        keep = p == i
+        (idx,) = jnp.nonzero(keep, size=n, fill_value=0)
+        cnt = jnp.sum(keep)
+        valid = (jnp.arange(n) < cnt)
+        sel = x[idx]
+        sel = jnp.where(valid.reshape((n,) + (1,) * (x.ndim - 1)), sel, 0)
+        outs.append(sel)
+        counts.append(cnt)
+    return tuple(outs) + (jnp.stack(counts).astype(jnp.int32),)
+
+
+@register_op("math.clipByGlobalNorm")
+def _clip_by_global_norm(*arrays, clip_norm):
+    gn = jnp.sqrt(sum(jnp.sum(jnp.square(a)) for a in arrays))
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(gn, 1e-12))
+    out = tuple(a * scale for a in arrays)
+    return out if len(out) > 1 else out[0]
+
+
+@register_op("math.compareAndBitpack")
+def _compare_and_bitpack(x, *, threshold):
+    bits = (x > threshold).astype(jnp.uint8)
+    b = bits.reshape(x.shape[:-1] + (x.shape[-1] // 8, 8))
+    weights = (2 ** jnp.arange(7, -1, -1)).astype(jnp.uint8)
+    return jnp.sum(b * weights, axis=-1).astype(jnp.uint8)
+
+
+@register_op("math.divNoNan")
+def _div_no_nan(x, y):
+    return jnp.where(y == 0, 0.0, x / jnp.where(y == 0, 1.0, y))
+
+
+@register_op("math.xdivy")
+def _xdivy(x, y):
+    return jnp.where(x == 0, 0.0, x / jnp.where(x == 0, 1.0, y))
+
+
+@register_op("math.xlogy")
+def _xlogy(x, y):
+    return jax.scipy.special.xlogy(x, y)
+
+
+@register_op("math.truncatediv")
+def _truncatediv(x, y):
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        q = jnp.abs(x) // jnp.abs(y)
+        return (jnp.sign(x) * jnp.sign(y) * q).astype(x.dtype)
+    return jnp.trunc(x / y)
+
+
+@register_op("math.assign")
+def _assign(x, y):
+    """Reference assign: y broadcast onto x's shape (x supplies shape and
+    dtype only — whole-graph compilation has no in-place aliasing)."""
+    return jnp.broadcast_to(y, x.shape).astype(x.dtype)
+
+
+@register_op("math.relativeError")
+def _relative_error(x, y):
+    """Reference relative_error: |x-y| / max(|x|, |y|), 0 where both 0."""
+    denom = jnp.maximum(jnp.abs(x), jnp.abs(y))
+    return jnp.where(denom == 0, 0.0, jnp.abs(x - y)
+                     / jnp.where(denom == 0, 1.0, denom))
+
+
+@register_op("math.equalsWithEps")
+def _equals_with_eps(x, y, *, eps):
+    return jnp.all(jnp.abs(x - y) <= eps)
+
+
+@register_op("math.mergeMaxIndex")
+def _merge_max_index(*arrays):
+    return jnp.argmax(jnp.stack(arrays), axis=0).astype(jnp.int32)
+
+
+@register_op("math.firstIndex")
+def _first_index(x, *, condition, value):
+    mask = _COND_FNS[condition](x, value)
+    any_ = jnp.any(mask)
+    return jnp.where(any_, jnp.argmax(mask), -1)
+
+
+@register_op("math.lastIndex")
+def _last_index(x, *, condition, value):
+    mask = _COND_FNS[condition](x, value)
+    any_ = jnp.any(mask)
+    n = mask.size
+    return jnp.where(any_, n - 1 - jnp.argmax(mask.reshape(-1)[::-1]), -1)
+
+
+_COND_FNS = {
+    "gt": lambda x, v: x > v, "gte": lambda x, v: x >= v,
+    "lt": lambda x, v: x < v, "lte": lambda x, v: x <= v,
+    "eq": lambda x, v: x == v, "neq": lambda x, v: x != v,
+    "abs_gt": lambda x, v: jnp.abs(x) > v,
+    "abs_lt": lambda x, v: jnp.abs(x) < v,
+}
+
+
+@register_op("math.matchCondition")
+def _match_condition(x, *, condition, value):
+    """Reference MatchCondition reduce: COUNT of matching elements."""
+    return jnp.sum(_COND_FNS[condition](x, value)).astype(jnp.int64)
+
+
+@register_op("math.choose")
+def _choose(x, *, condition, value):
+    """Reference choose: matching elements compacted (bounded shape:
+    padded to x.size + count)."""
+    mask = _COND_FNS[condition](x, value).reshape(-1)
+    n = x.size
+    (idx,) = jnp.nonzero(mask, size=n, fill_value=0)
+    count = jnp.sum(mask)
+    valid = jnp.arange(n) < count
+    return jnp.where(valid, x.reshape(-1)[idx], 0), count
+
+
+@register_op("math.axpy")
+def _axpy(x, y, *, alpha):
+    return alpha * x + y
+
+
+@register_op("math.sufficientStatistics")
+def _sufficient_statistics(x, *, axis, shift):
+    axes = tuple(axis)
+    import math as _math
+
+    count = jnp.asarray(
+        _math.prod(x.shape[a] for a in axes), x.dtype)
+    xs = x - shift if shift is not None else x
+    return (count, jnp.sum(xs, axis=axes), jnp.sum(xs * xs, axis=axes))
+
+
+@register_op("math.normalizeMoments")
+def _normalize_moments(counts, mean_ss, var_ss, *, shift):
+    mean = mean_ss / counts
+    var = var_ss / counts - mean * mean
+    if shift is not None:
+        mean = mean + shift
+    return mean, var
+
+
+@register_op("math.checkNumerics")
+def _check_numerics(x, *, message):
+    """Reference check_numerics throws on NaN/Inf; under whole-graph jit
+    there is no host exception path, so this validates EAGERLY (concrete
+    arrays — e.g. SameDiff.output on real inputs executes op-by-op only
+    when debugging) and is identity when traced."""
+    if not isinstance(x, jax.core.Tracer):
+        if not bool(jnp.all(jnp.isfinite(x))):
+            raise FloatingPointError(f"check_numerics: {message}")
+    return x
+
+
+@register_op("math.rank")
+def _rank(x):
+    return jnp.asarray(x.ndim, jnp.int32)
+
+
+@register_op("math.sizeOp")
+def _size_op(x):
+    return jnp.asarray(x.size, jnp.int64)
+
+
+@register_op("split_v")
+def _split_v(x, *, sizes, axis):
+    total = x.shape[axis]
+    sizes = list(sizes)
+    if sizes.count(-1) > 1:
+        raise ValueError("split_v: at most one -1 size")
+    if -1 in sizes:
+        rest = total - sum(s for s in sizes if s != -1)
+        if rest < 0:
+            raise ValueError(f"split_v: sizes {sizes} exceed axis {total}")
+        sizes[sizes.index(-1)] = rest
+    if sum(sizes) != total:
+        raise ValueError(
+            f"split_v: sizes {sizes} must sum to axis length {total}")
+    outs = []
+    off = 0
+    for s in sizes:
+        sl = [slice(None)] * x.ndim
+        sl[axis] = slice(off, off + s)
+        outs.append(x[tuple(sl)])
+        off += s
+    return tuple(outs)
+
+
+@register_op("reduce.all")
+def _reduce_all(x, *, axis, keepdims):
+    return jnp.all(x, axis=axis, keepdims=keepdims)
+
+
+@register_op("reduce.any")
+def _reduce_any(x, *, axis, keepdims):
+    return jnp.any(x, axis=axis, keepdims=keepdims)
+
+
+@register_op("reduce.percentile")
+def _percentile(x, *, q, axis, keepdims, interpolation):
+    return jnp.percentile(x, q, axis=axis, keepdims=keepdims,
+                          method=interpolation)
+
+
+@register_op("reduce.median")
+def _median(x, *, axis, keepdims):
+    return jnp.median(x, axis=axis, keepdims=keepdims)
+
+
+@register_op("reduce.squaredNorm")
+def _squared_norm(x, *, axis, keepdims):
+    return jnp.sum(x * x, axis=axis, keepdims=keepdims)
+
+
+def _single_axis(axis):
+    if isinstance(axis, (tuple, list)):
+        assert len(axis) == 1, "iamax/iamin take one axis (reference iamax)"
+        return axis[0]
+    return axis
+
+
+@register_op("reduce.iamax")
+def _iamax(x, *, axis, keepdims):
+    ax = _single_axis(axis)
+    r = jnp.argmax(jnp.abs(x), axis=ax)
+    return jnp.expand_dims(r, ax) if keepdims and ax is not None else r
+
+
+@register_op("reduce.iamin")
+def _iamin(x, *, axis, keepdims):
+    ax = _single_axis(axis)
+    r = jnp.argmin(jnp.abs(x), axis=ax)
+    return jnp.expand_dims(r, ax) if keepdims and ax is not None else r
+
+
+# ======================= round 4c: nn / cnn / linalg / loss / quant tail =======================
+
+@register_op("nn.reluLayer")
+def _relu_layer(x, w, b):
+    return jax.nn.relu(x @ w + b)
+
+
+@register_op("nn.mirrorPad")
+def _mirror_pad(x, *, paddings, mode):
+    return jnp.pad(x, [tuple(p) for p in paddings],
+                   mode="reflect" if mode == "REFLECT" else "symmetric")
+
+
+@register_op("cnn.pnormPool2d")
+def _pnorm_pool2d(x, *, kernel, stride, padding, p):
+    s = jax.lax.reduce_window(
+        jnp.abs(x) ** p, 0.0, jax.lax.add,
+        (1, kernel[0], kernel[1], 1), (1, stride[0], stride[1], 1), padding)
+    return s ** (1.0 / p)
+
+
+@register_op("cnn.maxPoolWithArgmax")
+def _max_pool_with_argmax(x, *, kernel, stride, padding):
+    """Values + TF-convention argmax (flat index into [H*W*C] per batch).
+    Windows enumerated by static strided slices (kernel is small), the
+    argmax over the window axis — no dynamic shapes."""
+    b, h, w, c = x.shape
+    kh, kw = kernel
+    sh, sw = stride
+    if padding == "SAME":
+        oh, ow = -(-h // sh), -(-w // sw)
+        ph = max((oh - 1) * sh + kh - h, 0)
+        pw = max((ow - 1) * sw + kw - w, 0)
+        pt, pl = ph // 2, pw // 2
+        xp = jnp.pad(x, ((0, 0), (pt, ph - pt), (pl, pw - pl), (0, 0)),
+                     constant_values=-jnp.inf)
+        row0, col0 = -pt, -pl
+    else:
+        oh, ow = (h - kh) // sh + 1, (w - kw) // sw + 1
+        xp, row0, col0 = x, 0, 0
+    vals, flat = [], []
+    for ki in range(kh):
+        for kj in range(kw):
+            v = xp[:, ki:ki + sh * (oh - 1) + 1:sh,
+                   kj:kj + sw * (ow - 1) + 1:sw, :]
+            vals.append(v)
+            ri = row0 + ki + sh * jnp.arange(oh)
+            cj = col0 + kj + sw * jnp.arange(ow)
+            f = (ri[:, None] * w + cj[None, :])[None, :, :, None] * c \
+                + jnp.arange(c)[None, None, None, :]
+            flat.append(jnp.broadcast_to(f, v.shape))
+    stacked = jnp.stack(vals)
+    am = jnp.argmax(stacked, axis=0)
+    values = jnp.max(stacked, axis=0)
+    indices = jnp.take_along_axis(jnp.stack(flat), am[None], axis=0)[0]
+    return values, indices.astype(jnp.int64)
+
+
+@register_op("linalg.lu")
+def _lu(x):
+    """LU factorization, LAPACK convention: packed LU + pivot indices
+    (reference lu op returns the same pair)."""
+    lu, piv = jax.scipy.linalg.lu_factor(x)
+    return lu, piv.astype(jnp.int32)
+
+
+@register_op("linalg.matrixDiag")
+def _matrix_diag(x):
+    n = x.shape[-1]
+    return x[..., :, None] * jnp.eye(n, dtype=x.dtype)
+
+
+@register_op("loss.softmaxCrossEntropyWithLogits")
+def _sce_with_logits(labels, logits):
+    """TF twin-output form: (per-example loss, backprop = softmax -
+    labels) — dense-label sibling of sparseSoftmaxCrossEntropyWithLogits."""
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    per = -jnp.sum(labels * lp, axis=-1)
+    return per, jnp.exp(lp) - labels
+
+
+@register_op("loss.meanPairwiseSquaredError")
+def _mpse(labels, preds, *, reduction):
+    """Reference mean_pairwssqerr_loss: mean over ordered pairs (i, j) of
+    ((d_i - d_j)^2)/2 per example, d = preds - labels."""
+    d = (preds - labels).reshape(preds.shape[0], -1)
+    n = d.shape[-1]
+    s1 = jnp.sum(d, axis=-1)
+    s2 = jnp.sum(d * d, axis=-1)
+    # sum_{i<j} (d_i-d_j)^2 = n*s2 - s1^2 ; pairs = n*(n-1)/2; TF divides
+    # by pairs and halves via the ordered-pair double count
+    pairs = n * (n - 1)
+    per = jnp.where(pairs > 0, (n * s2 - s1 * s1) * 2.0 / pairs, 0.0)
+    return _apply_reduction(per, reduction)
+
+
+def _fake_quant(x, lo, hi, num_bits, narrow_range):
+    qmin = 1.0 if narrow_range else 0.0
+    qmax = float(2 ** num_bits - 1)
+    # TF nudged-range formula
+    scale = (hi - lo) / (qmax - qmin)
+    zp_float = qmin - lo / scale
+    zp = jnp.clip(jnp.round(zp_float), qmin, qmax)
+    nudged_lo = (qmin - zp) * scale
+    nudged_hi = (qmax - zp) * scale
+    xc = jnp.clip(x, nudged_lo, nudged_hi)
+    q = jnp.round((xc - nudged_lo) / scale) * scale + nudged_lo
+    # straight-through estimator, the TF/reference gradient: 1 inside
+    # the nudged range (via clip), 0 outside; round contributes nothing
+    return xc + jax.lax.stop_gradient(q - xc)
+
+
+@register_op("math.fakeQuantWithMinMaxArgs")
+def _fake_quant_args(x, *, lo, hi, num_bits, narrow_range):
+    return _fake_quant(x, lo, hi, num_bits, narrow_range)
+
+
+@register_op("math.fakeQuantWithMinMaxVars")
+def _fake_quant_vars(x, lo, hi, *, num_bits, narrow_range):
+    return _fake_quant(x, lo, hi, num_bits, narrow_range)
+
+
+@register_op("math.fakeQuantWithMinMaxVarsPerChannel")
+def _fake_quant_per_channel(x, lo, hi, *, num_bits, narrow_range):
+    return _fake_quant(x, lo, hi, num_bits, narrow_range)
+
+
+@register_op("bitwise.bitcast")
+def _bitcast(x, *, dtype):
+    return jax.lax.bitcast_convert_type(x, jnp.dtype(dtype))
+
+
+@register_op("image.resizeArea")
+def _resize_area(x, *, height, width):
+    """Area (box-filter) resize for INTEGER downscale factors — exact
+    block mean, the common data-pipeline case; other ratios raise (the
+    reference's general kernel is out of scope until needed)."""
+    b, h, w, c = x.shape
+    if h % height or w % width:
+        raise NotImplementedError(
+            "image.resizeArea: non-integer scale factors unsupported "
+            f"({h}x{w} -> {height}x{width})")
+    fh, fw = h // height, w // width
+    return jnp.mean(
+        x.reshape(b, height, fh, width, fw, c), axis=(2, 4))
+
+
+@register_op("image.randomCrop")
+def _random_crop(x, *, seed, height, width):
+    key = jax.random.PRNGKey(seed)
+    kh, kw = jax.random.split(key)
+    h0 = jax.random.randint(kh, (), 0, x.shape[1] - height + 1)
+    w0 = jax.random.randint(kw, (), 0, x.shape[2] - width + 1)
+    return jax.lax.dynamic_slice(
+        x, (0, h0, w0, 0), (x.shape[0], height, width, x.shape[3]))
+
+
+@register_op("random.multinomial")
+def _multinomial(logits, *, seed, num_samples):
+    s = jax.random.categorical(
+        jax.random.PRNGKey(seed), logits, axis=-1,
+        shape=(num_samples, logits.shape[0]))  # sample dim leads, then T
+    return s.T.astype(jnp.int64)
+
+
+@register_op("scatter.nd")
+def _scatter_nd(indices, updates, *, shape):
+    idx = indices.astype(jnp.int32)
+    return jnp.zeros(tuple(shape), updates.dtype).at[
+        tuple(jnp.moveaxis(idx, -1, 0))].add(updates, mode="drop")
+
+
+@register_op("scatter.ndAdd")
+def _scatter_nd_add(ref, indices, updates):
+    idx = indices.astype(jnp.int32)
+    return ref.at[tuple(jnp.moveaxis(idx, -1, 0))].add(updates, mode="drop")
+
+
+@register_op("scatter.ndSub")
+def _scatter_nd_sub(ref, indices, updates):
+    idx = indices.astype(jnp.int32)
+    return ref.at[tuple(jnp.moveaxis(idx, -1, 0))].add(-updates, mode="drop")
+
+
+@register_op("scatter.ndUpdate")
+def _scatter_nd_update(ref, indices, updates):
+    idx = indices.astype(jnp.int32)
+    return ref.at[tuple(jnp.moveaxis(idx, -1, 0))].set(updates, mode="drop")
+
+
+@register_op("rnn.ctcGreedyDecoder")
+def _ctc_greedy_decoder(logits, seq_lengths, *, blank_index, merge_repeated):
+    """Greedy (beam-width-1) CTC decode, bounded shape: best path argmax
+    per step, repeats merged, blanks removed -> (decoded [B, T] padded
+    with -1, lengths [B], neg-sum-logit score [B])."""
+    B, T, C = logits.shape
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    path = jnp.argmax(lp, axis=-1).astype(jnp.int32)          # [B, T]
+    score = -jnp.sum(jnp.max(lp, axis=-1) * (
+        jnp.arange(T)[None, :] < seq_lengths.astype(jnp.int32)[:, None]),
+        axis=-1)
+    t_idx = jnp.arange(T)[None, :]
+    in_len = t_idx < seq_lengths.astype(jnp.int32)[:, None]
+    prev = jnp.concatenate(
+        [jnp.full((B, 1), -1, jnp.int32), path[:, :-1]], axis=1)
+    keep = (path != blank_index) & in_len
+    if merge_repeated:
+        keep &= (path != prev)
+    # stable compaction of kept symbols to the front: dropped symbols
+    # scatter to the out-of-bounds index T and are discarded
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    out = jnp.full((B, T), -1, jnp.int32)
+    bidx = jnp.repeat(jnp.arange(B)[:, None], T, axis=1)
+    out = out.at[bidx, jnp.where(keep, pos, T)].set(path, mode="drop")
+    lengths = jnp.sum(keep, axis=1).astype(jnp.int32)
+    return out, lengths, score
+
+
+# --- round-4 tail: namespace surface -----------------------------------------
+
+def _def_simple_math(opn, n_in=1, n_out=1, **fixed):
+    def m(self, *xs, name=None, _n=opn, **kw):
+        args = list(xs[:n_in])
+        attrs = {**fixed, **kw}
+        r = self._op(f"math.{_n}", args, n_out=n_out, name=name, **attrs)
+        return r[0] if n_out == 1 else tuple(r)
+    m.__name__ = opn
+    setattr(SDMath, opn, m)
+
+
+_def_simple_math("stopGradient")
+_def_simple_math("xdivy", n_in=2)
+_def_simple_math("xlogy", n_in=2)
+_def_simple_math("divNoNan", n_in=2)
+_def_simple_math("truncatediv", n_in=2)
+_def_simple_math("assign", n_in=2)
+_def_simple_math("invertPermutation")
+_def_simple_math("unique", n_out=3)
+_def_simple_math("uniqueWithCounts", n_out=4)
+_def_simple_math("listDiff", n_in=2, n_out=3)
+_def_simple_math("rank")
+_def_simple_math("sizeOp")
+
+
+@_def(SDMath, "broadcastTo")
+def _sd_broadcast_to(self, x, shape, name=None):
+    return self._op("math.broadcastTo", [x], name=name,
+                    shape=tuple(int(s) for s in shape))[0]
+
+
+@_def(SDMath, "fill")
+def _sd_fill(self, shape, value, dtype="float32", name=None):
+    return self._op("math.fill", [], name=name,
+                    shape=tuple(int(s) for s in shape),
+                    value=float(value), dtype=str(dtype))[0]
+
+
+@_def(SDMath, "linspace")
+def _sd_linspace(self, start, stop, num, name=None):
+    return self._op("math.linspace", [], name=name, start=float(start),
+                    stop=float(stop), num=int(num))[0]
+
+
+@_def(SDMath, "range")
+def _sd_range(self, start, limit, delta=1, name=None):
+    return self._op("math.range", [], name=name, start=start, limit=limit,
+                    delta=delta)[0]
+
+
+@_def(SDMath, "repeat")
+def _sd_repeat(self, x, repeats, axis, name=None):
+    return self._op("math.repeat", [x], name=name, repeats=int(repeats),
+                    axis=int(axis))[0]
+
+
+@_def(SDMath, "roll")
+def _sd_roll(self, x, shift, axis=None, name=None):
+    return self._op("math.roll", [x], name=name, shift=shift,
+                    axis=axis if axis is None else int(axis))[0]
+
+
+@_def(SDMath, "nthElement")
+def _sd_nth_element(self, x, n, reverse=False, name=None):
+    return self._op("math.nthElement", [x], name=name, n=int(n),
+                    reverse=bool(reverse))[0]
+
+
+@_def(SDMath, "inTopK")
+def _sd_in_top_k(self, predictions, targets, k, name=None):
+    return self._op("math.inTopK", [predictions, targets], name=name,
+                    k=int(k))[0]
+
+
+@_def(SDMath, "histogram")
+def _sd_histogram(self, x, nbins, name=None):
+    return self._op("math.histogram", [x], name=name, nbins=int(nbins))[0]
+
+
+@_def(SDMath, "histogramFixedWidth")
+def _sd_histogram_fw(self, x, lo, hi, nbins, name=None):
+    return self._op("math.histogramFixedWidth", [x], name=name,
+                    lo=float(lo), hi=float(hi), nbins=int(nbins))[0]
+
+
+@_def(SDMath, "dynamicPartition")
+def _sd_dynamic_partition(self, x, partitions, num_partitions, name=None):
+    return tuple(self._op("math.dynamicPartition", [x, partitions],
+                          n_out=int(num_partitions) + 1, name=name,
+                          num_partitions=int(num_partitions)))
+
+
+@_def(SDMath, "clipByGlobalNorm")
+def _sd_clip_by_global_norm(self, arrays, clip_norm, name=None):
+    arrays = list(arrays)
+    r = self._op("math.clipByGlobalNorm", arrays, n_out=len(arrays),
+                 name=name, clip_norm=float(clip_norm))
+    return tuple(r)
+
+
+@_def(SDMath, "compareAndBitpack")
+def _sd_compare_and_bitpack(self, x, threshold, name=None):
+    return self._op("math.compareAndBitpack", [x], name=name,
+                    threshold=float(threshold))[0]
+
+
+@_def(SDMath, "relativeError")
+def _sd_relative_error(self, x, y, name=None):
+    return self._op("math.relativeError", [x, y], name=name)[0]
+
+
+@_def(SDMath, "equalsWithEps")
+def _sd_equals_with_eps(self, x, y, eps=1e-5, name=None):
+    return self._op("math.equalsWithEps", [x, y], name=name,
+                    eps=float(eps))[0]
+
+
+@_def(SDMath, "mergeMaxIndex")
+def _sd_merge_max_index(self, *arrays, name=None):
+    return self._op("math.mergeMaxIndex", list(arrays), name=name)[0]
+
+
+@_def(SDMath, "firstIndex")
+def _sd_first_index(self, x, condition, value, name=None):
+    return self._op("math.firstIndex", [x], name=name,
+                    condition=str(condition), value=float(value))[0]
+
+
+@_def(SDMath, "lastIndex")
+def _sd_last_index(self, x, condition, value, name=None):
+    return self._op("math.lastIndex", [x], name=name,
+                    condition=str(condition), value=float(value))[0]
+
+
+@_def(SDMath, "matchCondition")
+def _sd_match_condition(self, x, condition, value, name=None):
+    return self._op("math.matchCondition", [x], name=name,
+                    condition=str(condition), value=float(value))[0]
+
+
+@_def(SDMath, "choose")
+def _sd_choose(self, x, condition, value, name=None):
+    return tuple(self._op("math.choose", [x], n_out=2, name=name,
+                          condition=str(condition), value=float(value)))
+
+
+@_def(SDMath, "axpy")
+def _sd_axpy(self, x, y, alpha, name=None):
+    return self._op("math.axpy", [x, y], name=name, alpha=float(alpha))[0]
+
+
+@_def(SDMath, "sufficientStatistics")
+def _sd_sufficient_statistics(self, x, dims, shift=None, name=None):
+    return tuple(self._op("math.sufficientStatistics", [x], n_out=3,
+                          name=name, axis=_axes(dims),
+                          shift=None if shift is None else float(shift)))
+
+
+@_def(SDMath, "normalizeMoments")
+def _sd_normalize_moments(self, counts, mean_ss, var_ss, shift=None,
+                          name=None):
+    return tuple(self._op("math.normalizeMoments",
+                          [counts, mean_ss, var_ss], n_out=2, name=name,
+                          shift=None if shift is None else float(shift)))
+
+
+@_def(SDMath, "checkNumerics")
+def _sd_check_numerics(self, x, message="", name=None):
+    return self._op("math.checkNumerics", [x], name=name,
+                    message=str(message))[0]
+
+
+for _n in ("fakeQuantWithMinMaxVars", "fakeQuantWithMinMaxVarsPerChannel"):
+    def _sd_fq(self, x, lo, hi, num_bits=8, narrow_range=False, name=None,
+               _n=_n):
+        return self._op(f"math.{_n}", [x, lo, hi], name=name,
+                        num_bits=int(num_bits),
+                        narrow_range=bool(narrow_range))[0]
+    _sd_fq.__name__ = _n
+    setattr(SDMath, _n, _sd_fq)
+
+
+@_def(SDMath, "fakeQuantWithMinMaxArgs")
+def _sd_fq_args(self, x, lo=-6.0, hi=6.0, num_bits=8, narrow_range=False,
+                name=None):
+    return self._op("math.fakeQuantWithMinMaxArgs", [x], name=name,
+                    lo=float(lo), hi=float(hi), num_bits=int(num_bits),
+                    narrow_range=bool(narrow_range))[0]
+
+
+def _def_reduce4(opn):
+    def m(self, x, dims=None, keepdims=False, name=None, _n=opn):
+        return self._op(f"reduce.{_n}", [x], name=name, axis=_axes(dims),
+                        keepdims=bool(keepdims))[0]
+    m.__name__ = opn
+    setattr(SDMath, opn, m)
+
+
+for _n in ("all", "any", "median", "squaredNorm", "iamax", "iamin"):
+    _def_reduce4(_n)
+
+
+@_def(SDMath, "percentile")
+def _sd_percentile(self, x, q, dims=None, keepdims=False,
+                   interpolation="linear", name=None):
+    return self._op("reduce.percentile", [x], name=name, q=float(q),
+                    axis=_axes(dims), keepdims=bool(keepdims),
+                    interpolation=str(interpolation))[0]
+
+
+@_def(SDNN, "reluLayer")
+def _sd_relu_layer(self, x, w, b, name=None):
+    return self._op("nn.reluLayer", [x, w, b], name=name)[0]
+
+
+@_def(SDNN, "mirrorPad")
+def _sd_mirror_pad(self, x, paddings, mode="REFLECT", name=None):
+    return self._op("nn.mirrorPad", [x], name=name,
+                    paddings=tuple(tuple(int(q) for q in p)
+                                   for p in paddings), mode=str(mode))[0]
+
+
+@_def(SDCNN, "pnormPool2d")
+def _sd_pnorm_pool2d(self, x, kernel, stride, p=2.0, padding="VALID",
+                     name=None):
+    return self._op("cnn.pnormPool2d", [x], name=name,
+                    kernel=(int(kernel[0]), int(kernel[1])),
+                    stride=(int(stride[0]), int(stride[1])),
+                    padding=str(padding), p=float(p))[0]
+
+
+@_def(SDCNN, "maxPoolWithArgmax")
+def _sd_max_pool_with_argmax(self, x, kernel, stride, padding="VALID",
+                             name=None):
+    return tuple(self._op("cnn.maxPoolWithArgmax", [x], n_out=2, name=name,
+                          kernel=(int(kernel[0]), int(kernel[1])),
+                          stride=(int(stride[0]), int(stride[1])),
+                          padding=str(padding)))
+
+
+@_def(SDLinalg, "lu")
+def _sd_lu(self, x, name=None):
+    return tuple(self._op("linalg.lu", [x], n_out=2, name=name))
+
+
+@_def(SDLinalg, "matrixDiag")
+def _sd_matrix_diag(self, x, name=None):
+    return self._op("linalg.matrixDiag", [x], name=name)[0]
+
+
+@_def(SDLoss, "softmaxCrossEntropyWithLogits")
+def _sd_sce_with_logits(self, labels, logits, name=None):
+    return tuple(self._op("loss.softmaxCrossEntropyWithLogits",
+                          [labels, logits], n_out=2, name=name))
+
+
+@_def(SDLoss, "meanPairwiseSquaredError")
+def _sd_mpse(self, labels, predictions, name=None, reduction="mean"):
+    out = self._op("loss.meanPairwiseSquaredError", [labels, predictions],
+                   name=name, reduction=reduction)[0]
+    self.sd.mark_loss(out)
+    return out
+
+
+@_def(SDBitwise, "bitcast")
+def _sd_bitcast(self, x, dtype, name=None):
+    return self._op("bitwise.bitcast", [x], name=name, dtype=str(dtype))[0]
+
+
+@_def(SDImage, "resizeArea")
+def _sd_resize_area(self, x, height, width, name=None):
+    return self._op("image.resizeArea", [x], name=name, height=int(height),
+                    width=int(width))[0]
+
+
+@_def(SDImage, "randomCrop")
+def _sd_random_crop(self, x, height, width, seed=0, name=None):
+    return self._op("image.randomCrop", [x], name=name, seed=int(seed),
+                    height=int(height), width=int(width))[0]
+
+
+@_def(SDRandom, "multinomial")
+def _sd_multinomial(self, logits, num_samples, seed=0, name=None):
+    return self._op("random.multinomial", [logits], name=name,
+                    seed=int(seed), num_samples=int(num_samples))[0]
+
+
+@_def(SDRNN, "ctcGreedyDecoder")
+def _sd_ctc_greedy_decoder(self, logits, seq_lengths, blank_index=0,
+                           merge_repeated=True, name=None):
+    return tuple(self._op("rnn.ctcGreedyDecoder", [logits, seq_lengths],
+                          n_out=3, name=name, blank_index=int(blank_index),
+                          merge_repeated=bool(merge_repeated)))

@@ -982,6 +982,8 @@ _EXEMPT = {
     "random.logNormal": "stochastic; test_random_round3_statistics",
     "random.truncatedNormal": "stochastic; test_random_round3_statistics",
     "random.shuffle": "stochastic; test_random_round3_statistics",
+    "random.multinomial": "stochastic; test_round4_stochastic_ops_statistics",
+    "image.randomCrop": "stochastic; test_round4_stochastic_ops_statistics",
 }
 
 
@@ -1021,13 +1023,15 @@ def test_coverage_registry_complete():
     _run_einsum_gathernd_topk_round3()
     _run_where_sparse_ce_round4()
     _run_round4_ctc_fft_embed()
+    _run_round4_tail_math()
+    _run_round4_tail_misc()
     rep = coverage_report()
     unexpected = sorted(set(rep["missing"]) - set(_EXEMPT))
     assert not unexpected, (
         f"registered ops without validation coverage: {unexpected} — add a "
         "sweep entry in test_op_validation.py or an explicit exemption "
         "with a pointer to the covering test")
-    assert rep["validated"] >= 280, rep["validated"]
+    assert rep["validated"] >= 350, rep["validated"]
 
 
 # --- round 4: bounded Where + TF twin-output sparse CE ----------------------
@@ -1936,3 +1940,543 @@ def test_ctc_loss_infeasible_is_inf():
     out = np.asarray(sd.output({"lg": logits}, "ctc")["ctc"])
     assert np.isinf(out[0]) and out[0] > 0   # T=2 < 3 labels: infeasible
     assert np.isfinite(out[1])               # 1 label in T=2: feasible
+
+
+# --- round 4c: math/reduce/structural tail ----------------------------------
+
+def _run_round4_tail_math():
+    rng = np.random.default_rng(44)
+
+    # stopGradient: identity forward; gradient pinned to ZERO explicitly
+    # (the central-difference harness would see the identity, so the
+    # grad assertion lives outside validate())
+    xv = rng.normal(size=(2, 3))
+    sd = SameDiff()
+    x = sd.placeholder("x", (2, 3))
+    sd.math.stopGradient(x, name="sg")
+    validate(TestCase(sd, {"x": xv}, {"sg": xv}, grad_wrt=[]))
+    import jax as _jax
+    import jax.numpy as _jnp
+    fn = sd.make_function(("sg",))
+    g = _jax.grad(lambda v: sum(
+        _jnp.sum(o) for o in fn(dict(sd.arrays), {"x": v}).values()))(
+        _jnp.asarray(xv))
+    assert float(np.abs(np.asarray(g)).max()) == 0.0
+
+    sd = SameDiff()
+    x = sd.placeholder("x", (3,))
+    y = sd.placeholder("y", (2, 3))
+    sd.math.broadcastTo(x, (2, 3), name="b")
+    sd.math.assign(y, x, name="a")
+    sd.math.axpy(y, y, alpha=2.5, name="ax")
+    xv, yv = rng.normal(size=3), rng.normal(size=(2, 3))
+    validate(TestCase(sd, {"x": xv, "y": yv}, {
+        "b": np.broadcast_to(xv, (2, 3)),
+        "a": np.broadcast_to(xv, (2, 3)),
+        "ax": 2.5 * yv + yv}))
+
+    # generator ops (no inputs)
+    sd = SameDiff()
+    sd.math.fill((2, 3), 7.5, name="f")
+    sd.math.linspace(0.0, 1.0, 5, name="l")
+    sd.math.range(2, 11, 3, name="r")
+    validate(TestCase(sd, {}, {
+        "f": np.full((2, 3), 7.5, np.float32),
+        "l": np.linspace(0, 1, 5),
+        "r": np.arange(2, 11, 3)}, grad_wrt=[]))
+
+    sd = SameDiff()
+    x = sd.placeholder("x", (2, 4))
+    sd.math.repeat(x, 2, axis=1, name="rp")
+    sd.math.roll(x, 3, axis=1, name="ro")
+    xv = rng.normal(size=(2, 4))
+    validate(TestCase(sd, {"x": xv}, {
+        "rp": np.repeat(xv, 2, axis=1), "ro": np.roll(xv, 3, axis=1)}))
+
+    perm = np.asarray([2, 0, 3, 1], np.int32)
+    sd = SameDiff()
+    p = sd.constant(perm, "p")
+    sd.math.invertPermutation(p, name="ip")
+    validate(TestCase(sd, {}, {"ip": np.argsort(perm)}, grad_wrt=[]))
+
+    xv = rng.normal(size=(3, 6))
+    sd = SameDiff()
+    x = sd.placeholder("x", (3, 6))
+    sd.math.nthElement(x, 2, name="n2")
+    sd.math.nthElement(x, 1, reverse=True, name="n1r")
+    validate(TestCase(sd, {"x": xv}, {
+        "n2": np.sort(xv, -1)[:, 2], "n1r": np.sort(xv, -1)[:, -2]},
+        grad_wrt=[]))
+
+    preds = rng.normal(size=(4, 6))
+    targ = np.asarray([0, 2, 5, 1], np.int32)
+    want = np.array([np.sum(preds[i] > preds[i, targ[i]]) < 2
+                     for i in range(4)])
+    sd = SameDiff()
+    pp = sd.placeholder("p", (4, 6))
+    sd.math.inTopK(pp, sd.constant(targ, "t"), 2, name="k")
+    validate(TestCase(sd, {"p": preds}, {"k": want}, grad_wrt=[]))
+
+    xv = rng.normal(size=(50,)) * 2.1  # avoid exact bin boundaries
+    sd = SameDiff()
+    x = sd.placeholder("x", (50,))
+    sd.math.histogram(x, 8, name="h")
+    sd.math.histogramFixedWidth(x, -3.0, 3.0, 6, name="hf")
+    hf = np.histogram(np.clip(xv, -3.0, 2.999), bins=6, range=(-3, 3))[0]
+    validate(TestCase(sd, {"x": xv}, {
+        "h": np.histogram(xv, bins=8)[0], "hf": hf}, grad_wrt=[]))
+
+    # unique / uniqueWithCounts / listDiff (bounded first-occurrence)
+    xv = np.asarray([5., 3., 5., 1., 3., 5., 9., 1.])
+    u, fidx, inv, cnts = np.unique(xv, return_index=True,
+                                   return_inverse=True, return_counts=True)
+    order = np.argsort(fidx)
+    vals = np.zeros(8); vals[:len(u)] = u[order]
+    rank = np.argsort(order)
+    counts = np.zeros(8, np.int32); counts[:len(u)] = cnts[order]
+    sd = SameDiff()
+    x = sd.placeholder("x", (8,))
+    v1, i1, c1 = sd.math.unique(x, name="u")
+    v1.rename("uv"); i1.rename("ui"); c1.rename("uc")
+    v2, i2, n2, c2 = sd.math.uniqueWithCounts(x, name="uw")
+    v2.rename("wv"); n2.rename("wn"); c2.rename("wc")
+    validate(TestCase(sd, {"x": xv}, {
+        "uv": vals, "ui": rank[inv], "uc": np.int32(len(u)),
+        "wv": vals, "wn": counts, "wc": np.int32(len(u))}, grad_wrt=[]))
+
+    yv = np.asarray([3., 9.])
+    keep = ~np.isin(xv, yv)
+    dv = np.zeros(8); dv[:keep.sum()] = xv[keep]
+    di = np.zeros(8, np.int32); di[:keep.sum()] = np.nonzero(keep)[0]
+    sd = SameDiff()
+    x = sd.placeholder("x", (8,))
+    o, i, c = sd.math.listDiff(x, sd.constant(yv, "y"), name="ld")
+    o.rename("lv"); i.rename("li"); c.rename("lc")
+    validate(TestCase(sd, {"x": xv}, {
+        "lv": dv, "li": di, "lc": np.int64(keep.sum())}, grad_wrt=[]))
+
+    # dynamicPartition (bounded, counts as last output)
+    data = rng.normal(size=(6, 2))
+    parts = np.asarray([0, 2, 1, 0, 2, 2], np.int32)
+    sd = SameDiff()
+    x = sd.placeholder("x", (6, 2))
+    outs = sd.math.dynamicPartition(x, sd.constant(parts, "p"), 3,
+                                    name="dp")
+    for j, o in enumerate(outs[:3]):
+        o.rename(f"dp{j}")
+    outs[3].rename("dpc")
+    exp = {}
+    for j in range(3):
+        rows = data[parts == j]
+        pad = np.zeros((6, 2)); pad[:len(rows)] = rows
+        exp[f"dp{j}"] = pad
+    exp["dpc"] = np.asarray([2, 1, 3], np.int32)
+    validate(TestCase(sd, {"x": data}, exp, grad_wrt=[]))
+
+    # clipByGlobalNorm (active clip), xdivy/xlogy/divNoNan/truncatediv
+    av, bv = rng.normal(size=(2, 2)) * 3, rng.normal(size=(3,)) * 3
+    gn = np.sqrt((av ** 2).sum() + (bv ** 2).sum())
+    sc = min(1.0, 1.5 / gn)
+    sd = SameDiff()
+    a = sd.placeholder("a", (2, 2))
+    b = sd.placeholder("b", (3,))
+    ca, cb = sd.math.clipByGlobalNorm([a, b], 1.5, name="cg")
+    ca.rename("ca"); cb.rename("cb")
+    validate(TestCase(sd, {"a": av, "b": bv},
+                      {"ca": av * sc, "cb": bv * sc}, max_rel_error=1e-3))
+
+    xv = rng.uniform(0.5, 2.0, (2, 3))
+    yv = rng.uniform(0.5, 2.0, (2, 3))
+    sd = SameDiff()
+    x = sd.placeholder("x", (2, 3))
+    y = sd.placeholder("y", (2, 3))
+    sd.math.xdivy(x, y, name="xd")
+    sd.math.xlogy(x, y, name="xl")
+    sd.math.divNoNan(x, y, name="dn")
+    sd.math.truncatediv(x, y, name="td")
+    validate(TestCase(sd, {"x": xv, "y": yv}, {
+        "xd": xv / yv, "xl": xv * np.log(yv), "dn": xv / yv,
+        "td": np.trunc(xv / yv)}, grad_wrt=["x"], max_rel_error=1e-3))
+    # zero-handling (forward only)
+    sd = SameDiff()
+    x = sd.placeholder("x", (3,))
+    y = sd.placeholder("y", (3,))
+    sd.math.xdivy(x, y, name="xd")
+    sd.math.divNoNan(x, y, name="dn")
+    validate(TestCase(sd, {"x": np.asarray([0., 2., 0.]),
+                           "y": np.asarray([5., 0., 0.])},
+                      {"xd": np.asarray([0., np.inf, 0.]),
+                       "dn": np.asarray([0., 0., 0.])}, grad_wrt=[]))
+    itd = np.asarray([-7, 7, -9], np.int32), np.asarray([2, -2, 3], np.int32)
+    sd = SameDiff()
+    sd.math.truncatediv(sd.constant(itd[0], "a"), sd.constant(itd[1], "b"),
+                        name="t")
+    validate(TestCase(sd, {}, {"t": np.asarray([-3, -3, -3], np.int32)},
+                      grad_wrt=[]))
+
+    # condition family + compareAndBitpack + equalsWithEps + mergeMaxIndex
+    xv = np.asarray([0.1, -2.0, 3.0, 0.5, 3.0, -1.0])
+    sd = SameDiff()
+    x = sd.placeholder("x", (6,))
+    sd.math.firstIndex(x, "gt", 0.4, name="fi")
+    sd.math.lastIndex(x, "gt", 0.4, name="li")
+    sd.math.matchCondition(x, "abs_gt", 0.9, name="mc")
+    cv, cc = sd.math.choose(x, "lt", 0.0, name="ch")
+    cv.rename("chv"); cc.rename("chc")
+    validate(TestCase(sd, {"x": xv}, {
+        "fi": np.int64(2), "li": np.int64(4), "mc": np.int64(4),
+        "chv": np.asarray([-2., -1., 0, 0, 0, 0]), "chc": np.int64(2)},
+        grad_wrt=[]))
+
+    bits = np.asarray([[1., -1., 2., -3., 4., 0.5, -0.5, 2.]])
+    sd = SameDiff()
+    x = sd.placeholder("x", (1, 8))
+    sd.math.compareAndBitpack(x, 0.0, name="cb")
+    want = np.uint8(int("10101101", 2))
+    validate(TestCase(sd, {"x": bits}, {"cb": np.asarray([[want]])},
+                      grad_wrt=[]))
+
+    sd = SameDiff()
+    x = sd.placeholder("x", (3,))
+    y = sd.placeholder("y", (3,))
+    sd.math.equalsWithEps(x, y, eps=0.1, name="e")
+    sd.math.mergeMaxIndex(x, y, name="mm")
+    sd.math.relativeError(x, y, name="re")
+    xv, yv = np.asarray([1., 2., 3.]), np.asarray([1.05, 2.5, 2.9])
+    validate(TestCase(sd, {"x": xv, "y": yv}, {
+        "e": np.bool_(False), "mm": np.asarray([1, 1, 0], np.int32),
+        "re": np.abs(xv - yv) / np.maximum(np.abs(xv), np.abs(yv))},
+        grad_wrt=[]))
+
+    # sufficientStatistics -> normalizeMoments == mean/var
+    xv = rng.normal(size=(4, 5))
+    sd = SameDiff()
+    x = sd.placeholder("x", (4, 5))
+    cnt, s, ss = sd.math.sufficientStatistics(x, (0,), name="st")
+    cnt.rename("c"); s.rename("s"); ss.rename("ss")
+    mean, var = sd.math.normalizeMoments(cnt, s, ss, name="nm")
+    mean.rename("m"); var.rename("v")
+    validate(TestCase(sd, {"x": xv}, {
+        "c": np.float64(4), "s": xv.sum(0), "ss": (xv * xv).sum(0),
+        "m": xv.mean(0), "v": xv.var(0)}, max_rel_error=1e-3))
+
+    # checkNumerics (identity in-graph), rank / sizeOp, split_v
+    sd = SameDiff()
+    x = sd.placeholder("x", (2, 3))
+    sd.math.checkNumerics(x, "probe", name="cn")
+    sd.math.rank(x, name="rk")
+    sd.math.sizeOp(x, name="sz")
+    a, b2 = sd.split_v(x, (1, 2), axis=1, name="sv")
+    a.rename("sva"); b2.rename("svb")
+    xv = rng.normal(size=(2, 3))
+    validate(TestCase(sd, {"x": xv}, {
+        "cn": xv, "rk": np.int32(2), "sz": np.int64(6),
+        "sva": xv[:, :1], "svb": xv[:, 1:]}))
+
+    # reduce tail: all/any/median/percentile/squaredNorm/iamax/iamin
+    bv = np.asarray([[True, True], [True, False]])
+    sd = SameDiff()
+    x = sd.constant(bv, "b")
+    sd.math.all(x, dims=(1,), name="al")
+    sd.math.any(x, dims=(1,), name="an")
+    validate(TestCase(sd, {}, {"al": bv.all(1), "an": bv.any(1)},
+                      grad_wrt=[]))
+
+    xv = rng.normal(size=(3, 7))
+    sd = SameDiff()
+    x = sd.placeholder("x", (3, 7))
+    sd.math.median(x, dims=(1,), name="md")
+    sd.math.percentile(x, 30.0, dims=(1,), name="pc")
+    sd.math.squaredNorm(x, dims=(1,), name="sn")
+    sd.math.iamax(x, dims=(1,), name="ix")
+    sd.math.iamin(x, dims=(1,), name="im")
+    validate(TestCase(sd, {"x": xv}, {
+        "md": np.median(xv, 1), "pc": np.percentile(xv, 30.0, 1),
+        "sn": (xv * xv).sum(1), "ix": np.abs(xv).argmax(1),
+        "im": np.abs(xv).argmin(1)}, grad_wrt=[]))
+
+
+def test_round4_tail_math_sweep():
+    _run_round4_tail_math()
+
+
+# --- round 4d: nn/cnn/linalg/loss/quant/scatter/image tail ------------------
+
+def _run_round4_tail_misc():
+    rng = np.random.default_rng(45)
+
+    # nn.reluLayer / nn.mirrorPad
+    xv, wv, bv = (rng.normal(size=(3, 4)), rng.normal(size=(4, 5)),
+                  rng.normal(size=(5,)))
+    sd = SameDiff()
+    x = sd.placeholder("x", (3, 4))
+    w = sd.placeholder("w", (4, 5))
+    b = sd.placeholder("b", (5,))
+    sd.nn.reluLayer(x, w, b, name="rl")
+    validate(TestCase(sd, {"x": xv, "w": wv, "b": bv},
+                      {"rl": np.maximum(xv @ wv + bv, 0)},
+                      max_rel_error=1e-3))
+
+    xv = rng.normal(size=(3, 4))
+    sd = SameDiff()
+    x = sd.placeholder("x", (3, 4))
+    sd.nn.mirrorPad(x, ((1, 1), (2, 0)), mode="REFLECT", name="mr")
+    sd.nn.mirrorPad(x, ((1, 0), (0, 2)), mode="SYMMETRIC", name="ms")
+    validate(TestCase(sd, {"x": xv}, {
+        "mr": np.pad(xv, ((1, 1), (2, 0)), mode="reflect"),
+        "ms": np.pad(xv, ((1, 0), (0, 2)), mode="symmetric")},
+        max_rel_error=1e-3))
+
+    # cnn.avgPooling1d / pnormPool2d / maxPoolWithArgmax
+    xv = rng.normal(size=(2, 8, 3))
+    want = np.stack([xv[:, i * 2:i * 2 + 4].mean(1) for i in range(3)], 1)
+    sd = SameDiff()
+    x = sd.placeholder("x", (2, 8, 3))
+    sd.cnn.avgPooling1d(x, k=4, s=2, name="ap")
+    validate(TestCase(sd, {"x": xv}, {"ap": want}, max_rel_error=1e-3))
+
+    xv = rng.normal(size=(1, 4, 4, 2))
+    p = 3.0
+    w2 = np.zeros((1, 2, 2, 2))
+    for i in range(2):
+        for j in range(2):
+            blk = np.abs(xv[:, i * 2:i * 2 + 2, j * 2:j * 2 + 2]) ** p
+            w2[:, i, j] = blk.sum((1, 2)) ** (1 / p)
+    sd = SameDiff()
+    x = sd.placeholder("x", (1, 4, 4, 2))
+    sd.cnn.pnormPool2d(x, (2, 2), (2, 2), p=p, name="pp")
+    validate(TestCase(sd, {"x": xv}, {"pp": w2}, max_rel_error=1e-3))
+
+    xv = rng.normal(size=(2, 4, 6, 3))
+    sd = SameDiff()
+    x = sd.placeholder("x", (2, 4, 6, 3))
+    v, idx = sd.cnn.maxPoolWithArgmax(x, (2, 2), (2, 2), name="ma")
+    v.rename("mav"); idx.rename("mai")
+    vals = np.zeros((2, 2, 3, 3)); fidx = np.zeros((2, 2, 3, 3), np.int64)
+    for bi in range(2):
+        for i in range(2):
+            for j in range(3):
+                for c in range(3):
+                    win = xv[bi, i * 2:i * 2 + 2, j * 2:j * 2 + 2, c]
+                    k = np.argmax(win)
+                    ri, cj = divmod(k, 2)
+                    vals[bi, i, j, c] = win[ri, cj]
+                    fidx[bi, i, j, c] = ((i * 2 + ri) * 6 + j * 2 + cj) * 3 + c
+    validate(TestCase(sd, {"x": xv}, {"mav": vals, "mai": fidx},
+                      grad_wrt=[]))
+
+    # linalg.lu (vs scipy LAPACK) + matrixDiag
+    import scipy.linalg as sla
+    av = rng.normal(size=(4, 4)) + 4 * np.eye(4)
+    lu_ref, piv_ref = sla.lu_factor(av)
+    sd = SameDiff()
+    a = sd.placeholder("a", (4, 4))
+    l_, pv = sd.linalg.lu(a, name="lu")
+    l_.rename("lu_m"); pv.rename("lu_p")
+    validate(TestCase(sd, {"a": av},
+                      {"lu_m": lu_ref, "lu_p": piv_ref.astype(np.int32)},
+                      grad_wrt=[], max_rel_error=1e-3))
+    dv = rng.normal(size=(2, 3))
+    sd = SameDiff()
+    d = sd.placeholder("d", (2, 3))
+    sd.linalg.matrixDiag(d, name="md")
+    want = np.zeros((2, 3, 3))
+    for i in range(2):
+        want[i] = np.diag(dv[i])
+    validate(TestCase(sd, {"d": dv}, {"md": want}))
+
+    # loss twins + meanPairwiseSquaredError
+    lv = rng.normal(size=(3, 5))
+    onehot = np.eye(5)[[1, 4, 0]]
+    e = np.exp(lv - lv.max(-1, keepdims=True))
+    sm = e / e.sum(-1, keepdims=True)
+    sd = SameDiff()
+    lg = sd.placeholder("lg", (3, 5))
+    per, bp = sd.loss.softmaxCrossEntropyWithLogits(
+        sd.constant(onehot, "lb"), lg, name="ce")
+    per.rename("ce_l"); bp.rename("ce_b")
+    validate(TestCase(sd, {"lg": lv}, {
+        "ce_l": -(onehot * np.log(sm)).sum(-1), "ce_b": sm - onehot},
+        grad_wrt=["lg"], max_rel_error=1e-3))
+
+    labels = rng.normal(size=(2, 4))
+    preds = rng.normal(size=(2, 4))
+    d = preds - labels
+    per = np.zeros(2)
+    for i in range(2):
+        s = 0.0
+        for a2 in range(4):
+            for b2 in range(4):
+                s += (d[i, a2] - d[i, b2]) ** 2
+        per[i] = s / (4 * 3)
+    sd = SameDiff()
+    pl = sd.placeholder("l", (2, 4))
+    pp = sd.placeholder("p", (2, 4))
+    sd.loss.meanPairwiseSquaredError(pl, pp, name="mp")
+    validate(TestCase(sd, {"l": labels, "p": preds},
+                      {"mp": per.mean()}, max_rel_error=1e-3))
+
+    # fake quant: hand case — lo=0, hi=63.75, 8 bits -> scale 0.25
+    xv = np.asarray([-1.0, 0.1, 0.37, 10.12, 63.6, 70.0])
+    want = np.asarray([0.0, 0.0, 0.25, 10.0, 63.5, 63.75])
+    sd = SameDiff()
+    x = sd.placeholder("x", (6,))
+    sd.math.fakeQuantWithMinMaxArgs(x, 0.0, 63.75, 8, name="fa")
+    lo = sd.constant(np.float64(0.0), "lo")
+    hi = sd.constant(np.float64(63.75), "hi")
+    sd.math.fakeQuantWithMinMaxVars(x, lo, hi, 8, name="fv")
+    validate(TestCase(sd, {"x": xv}, {"fa": want, "fv": want},
+                      grad_wrt=[]))
+    # per-channel: different ranges per channel
+    xv = np.asarray([[0.3, -0.4], [1.7, 0.9]])
+    sd = SameDiff()
+    x = sd.placeholder("x", (2, 2))
+    lo = sd.constant(np.asarray([0.0, -0.5]), "lo")
+    hi = sd.constant(np.asarray([1.275, 0.775]), "hi")
+    sd.math.fakeQuantWithMinMaxVarsPerChannel(x, lo, hi, 8, name="fc")
+    # both ranges span 1.275 -> scale 0.005; values on the grid pass
+    # through, out-of-range values clip to the (nudged) range ends
+    want = np.asarray([[0.3, -0.4], [1.275, 0.775]])
+    validate(TestCase(sd, {"x": xv}, {"fc": want}, grad_wrt=[]))
+
+    # bitwise.bitcast: f32 bits == numpy view
+    xv = np.asarray([1.0, -2.5, 0.0], np.float32)
+    sd = SameDiff()
+    x = sd.constant(xv, "x")
+    sd.bitwise.bitcast(x, "int32", name="bc")
+    validate(TestCase(sd, {}, {"bc": xv.view(np.int32)}, grad_wrt=[]))
+
+    # image.resizeArea: integer-factor block mean
+    xv = rng.normal(size=(1, 4, 6, 2))
+    want = xv.reshape(1, 2, 2, 3, 2, 2).mean(axis=(2, 4))
+    sd = SameDiff()
+    x = sd.placeholder("x", (1, 4, 6, 2))
+    sd.image.resizeArea(x, 2, 3, name="ra")
+    validate(TestCase(sd, {"x": xv}, {"ra": want}, max_rel_error=1e-3))
+
+    # scatter-nd family vs numpy loops
+    idx = np.asarray([[0, 1], [2, 0], [0, 1]], np.int32)
+    upd = np.asarray([1.0, 2.0, 3.0])
+    want = np.zeros((3, 2)); want[0, 1] += 1 + 3; want[2, 0] += 2
+    refv = rng.normal(size=(3, 2))
+    sd = SameDiff()
+    u = sd.placeholder("u", (3,))
+    r = sd.placeholder("r", (3, 2))
+    sd.scatter_nd(sd.constant(idx, "i"), u, (3, 2), name="sn")
+    sd.scatter_nd_add(r, sd.constant(idx, "i2"), u, name="sa")
+    sd.scatter_nd_sub(r, sd.constant(idx, "i3"), u, name="ss")
+    validate(TestCase(sd, {"u": upd, "r": refv}, {
+        "sn": want, "sa": refv + want, "ss": refv - want}))
+    # ndUpdate: last-write-wins is unspecified for dup indices — use unique
+    idx2 = np.asarray([[0, 0], [1, 1]], np.int32)
+    upd2 = np.asarray([7.0, 8.0])
+    wantu = refv.copy(); wantu[0, 0] = 7; wantu[1, 1] = 8
+    sd = SameDiff()
+    r = sd.placeholder("r", (3, 2))
+    u = sd.placeholder("u", (2,))
+    sd.scatter_nd_update(r, sd.constant(idx2, "i"), u, name="su")
+    validate(TestCase(sd, {"r": refv, "u": upd2}, {"su": wantu}))
+
+    # rnn.ctcGreedyDecoder vs a loop oracle
+    lg = rng.normal(size=(2, 5, 4))
+    seq = np.asarray([5, 3], np.int32)
+    lp = lg - np.log(np.exp(lg).sum(-1, keepdims=True))
+    dec = np.full((2, 5), -1, np.int32)
+    lens = np.zeros(2, np.int32)
+    score = np.zeros(2)
+    for b in range(2):
+        path = lp[b].argmax(-1)
+        prev = -1
+        k = 0
+        for t in range(seq[b]):
+            score[b] -= lp[b, t].max()
+            s = path[t]
+            if s != 0 and s != prev:
+                dec[b, k] = s; k += 1
+            prev = s
+        lens[b] = k
+    sd = SameDiff()
+    x = sd.placeholder("x", (2, 5, 4))
+    o, ln, sc = sd.rnn.ctcGreedyDecoder(x, sd.constant(seq, "s"),
+                                        blank_index=0, name="gd")
+    o.rename("gd_o"); ln.rename("gd_l"); sc.rename("gd_s")
+    validate(TestCase(sd, {"x": lg}, {
+        "gd_o": dec, "gd_l": lens, "gd_s": score}, grad_wrt=[]))
+
+
+def test_round4_tail_misc_sweep():
+    _run_round4_tail_misc()
+
+
+def test_round4_stochastic_ops_statistics():
+    """random.multinomial / image.randomCrop: seed-deterministic, output
+    properties pinned (exemption pointers in _EXEMPT)."""
+    rng = np.random.default_rng(9)
+    logits = np.log(np.asarray([[0.7, 0.2, 0.1], [0.05, 0.05, 0.9]]))
+    sd = SameDiff()
+    x = sd.constant(logits, "x")
+    sd.random.multinomial(x, 4000, seed=5, name="m")
+    out = np.asarray(sd.output({}, "m")["m"])
+    assert out.shape == (2, 4000) and out.min() >= 0 and out.max() <= 2
+    frac0 = (out[0] == 0).mean()
+    frac2 = (out[1] == 2).mean()
+    assert 0.65 < frac0 < 0.75 and 0.85 < frac2 < 0.95
+    # determinism
+    sd2 = SameDiff()
+    x = sd2.constant(logits, "x")
+    sd2.random.multinomial(x, 4000, seed=5, name="m")
+    np.testing.assert_array_equal(out, np.asarray(sd2.output({}, "m")["m"]))
+
+    img = rng.normal(size=(2, 8, 10, 3)).astype(np.float32)
+    sd = SameDiff()
+    x = sd.constant(img, "x")
+    sd.image.randomCrop(x, 4, 5, seed=3, name="c")
+    crop = np.asarray(sd.output({}, "c")["c"])
+    assert crop.shape == (2, 4, 5, 3)
+    # the crop is a contiguous window of the source
+    found = any(
+        np.allclose(img[:, i:i + 4, j:j + 5], crop)
+        for i in range(5) for j in range(6))
+    assert found
+
+
+def test_round4_review_regressions():
+    """Round-4 review findings: fakeQuant straight-through gradient,
+    split_v -1/"rest" + size validation, scatter-nd out-of-bounds drop."""
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    # STE gradient: 1 inside the nudged range, 0 outside
+    sd = SameDiff()
+    x = sd.placeholder("x", (4,))
+    sd.math.fakeQuantWithMinMaxArgs(x, 0.0, 63.75, 8, name="q")
+    fn = sd.make_function(("q",))
+    g = _jax.grad(lambda v: float(0) + _jnp.sum(
+        fn(dict(sd.arrays), {"x": v})["q"]))(
+        _jnp.asarray([-5.0, 1.3, 60.0, 99.0]))
+    np.testing.assert_allclose(np.asarray(g), [0.0, 1.0, 1.0, 0.0])
+
+    # split_v: -1 takes the rest; bad sizes raise
+    sd = SameDiff()
+    x = sd.placeholder("x", (2, 5))
+    a, b = sd.split_v(x, (2, -1), axis=1, name="sv")
+    a.rename("a"); b.rename("b")
+    xv = np.arange(10.0).reshape(2, 5)
+    out = sd.output({"x": xv}, "a", "b")
+    assert np.asarray(out["a"]).shape == (2, 2)
+    np.testing.assert_array_equal(np.asarray(out["b"]), xv[:, 2:])
+    with pytest.raises(ValueError, match="must sum"):
+        sd2 = SameDiff()
+        x2 = sd2.placeholder("x", (2, 5))
+        a2, b2 = sd2.split_v(x2, (2, 2), axis=1, name="sv")
+        a2.rename("bad_a")
+        sd2.output({"x": xv}, "bad_a")
+
+    # scatter_nd: out-of-bounds index dropped, not clipped onto an edge
+    sd = SameDiff()
+    u = sd.constant(np.asarray([7.0, 1.0]), "u")
+    sd.scatter_nd(sd.constant(np.asarray([[5, 0], [1, 1]], np.int32), "i"),
+                  u, (3, 2), name="sn")
+    out = np.asarray(sd.output({}, "sn")["sn"])
+    want = np.zeros((3, 2)); want[1, 1] = 1.0
+    np.testing.assert_array_equal(out, want)
