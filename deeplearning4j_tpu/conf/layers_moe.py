@@ -1,0 +1,137 @@
+"""Mixture-of-Experts layer for the conf DSL (beyond the reference —
+DL4J has no MoE, SURVEY.md §2.3 lists expert parallelism absent; this
+makes GShard-style MoE a first-class layer that lowers through
+MultiLayerNetwork/ComputationGraph and trains data+expert-parallel under
+``ParallelWrapper(expert_parallel=True)`` with no hand-written
+shard_map).
+
+The math lives in ``parallel/expert.py::moe_apply`` (shared with the raw
+shard_map entrypoints, so the layer and the library demos cannot
+diverge): top-k routing with renormalized gates, per-expert capacity
+with residual pass-through for dropped tokens, and — when the expert
+weights arrive sharded (``e_loc < n_experts`` under the wrapper's
+shard_map) — an ``all_to_all`` token exchange over the active mesh axis.
+
+The GShard load-balance auxiliary loss reaches the training objective
+through the reserved state key :data:`AUX_LOSS_KEY`: the layer writes
+its (already ``aux_weight``-scaled) aux into the state it returns, and
+both network ``_loss`` implementations add every such entry to the
+score. In eval/``output()`` the state entry is ignored.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import serde
+from deeplearning4j_tpu.conf import inputs as it
+from deeplearning4j_tpu.conf.layers import BaseLayer
+
+#: Reserved state key: layers put auxiliary (train-time) loss terms here;
+#: MultiLayerNetwork/ComputationGraph ``_loss`` sums them into the score.
+AUX_LOSS_KEY = "__aux_loss__"
+
+
+@serde.register
+@dataclasses.dataclass
+class MoELayer(BaseLayer):
+    """GShard-style MoE FFN block: router -> top-k dispatch (capacity C)
+    -> per-expert relu FFN -> gated combine, residual around the whole
+    block (output size == input size).
+
+    ``capacity_factor`` sizes C = ceil(top_k * tokens / n_experts * cf)
+    per shard. Under ``ParallelWrapper(expert_parallel=True)`` the
+    ``w1/b1/w2/b2`` leaves shard over the mesh's data axis (experts ride
+    the same axis as the batch, the GShard layout — see
+    ``param_shard_axes``); standalone, all experts run locally."""
+
+    n_experts: int = 4
+    d_hidden: int = 0          # 0 -> 4 * d_model
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_weight: float = 1e-2
+    has_bias: bool = True
+    residual: bool = True
+    """False: emit only the expert-combine output (the surrounding graph
+    wires its own residual — the zoo transformer's explicit add vertex);
+    True: the layer is the full residual block."""
+
+
+    def _dims(self, input_type):
+        if isinstance(input_type, it.Recurrent):
+            return input_type.size
+        if isinstance(input_type, it.FeedForward):
+            return input_type.size
+        raise ValueError(
+            f"MoELayer needs recurrent/feed-forward input, got {input_type}")
+
+    def output_type(self, input_type):
+        self._dims(input_type)
+        return input_type  # residual block: shape-preserving
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        import jax
+
+        d = self._dims(input_type)
+        h = self.d_hidden or 4 * d
+        e = self.n_experts
+        k1, k2, k3 = jax.random.split(key, 3)
+        s1, s2 = 1.0 / math.sqrt(d), 1.0 / math.sqrt(h)
+        p = {
+            "router": (s1 * jax.random.normal(k1, (d, e))).astype(dtype),
+            "w1": (s1 * jax.random.normal(k2, (e, d, h))).astype(dtype),
+            "w2": (s2 * jax.random.normal(k3, (e, h, d))).astype(dtype),
+        }
+        if self.has_bias:
+            p["b1"] = jnp.zeros((e, h), dtype)
+            p["b2"] = jnp.zeros((e, d), dtype)
+        return p
+
+    def init_state(self, input_type, dtype=jnp.float32):
+        return {AUX_LOSS_KEY: jnp.zeros((), dtype)}
+
+    def param_order(self):
+        return (["router", "w1", "w2", "b1", "b2"] if self.has_bias
+                else ["router", "w1", "w2"])
+
+    def regularized_param_keys(self):
+        return ["w1", "w2"]
+
+    def param_shard_axes(self):
+        """Leaves whose LEADING axis shards over the expert mesh axis
+        (consumed by ParallelWrapper's expert-parallel spec builder)."""
+        keys = ["w1", "w2"] + (["b1", "b2"] if self.has_bias else [])
+        return {k: "expert" for k in keys}
+
+    def forward(self, params, state, x, train=False, rng=None):
+        from deeplearning4j_tpu.parallel import expert as expert_mod
+
+        x = self._dropout_input(x, train, rng)
+        shape = x.shape
+        d = shape[-1]
+        x2 = x.reshape(-1, d)
+        t = x2.shape[0]
+        e_loc = params["w1"].shape[0]
+        axis = None
+        if e_loc != self.n_experts:
+            axis = expert_mod.current_expert_axis()
+            if axis is None:
+                raise RuntimeError(
+                    f"MoELayer: expert weights arrived sharded "
+                    f"({e_loc}/{self.n_experts}) outside an "
+                    "active_expert_axis context — run through "
+                    "ParallelWrapper(expert_parallel=True)")
+        capacity = max(1, math.ceil(
+            self.top_k * t / self.n_experts * self.capacity_factor))
+        y2, aux = expert_mod.moe_apply(
+            params["router"], params["w1"], params["w2"], x2,
+            self.n_experts, capacity, top_k=self.top_k, axis_name=axis,
+            b1=params.get("b1"), b2=params.get("b2"),
+            residual=self.residual)
+        new_state = {AUX_LOSS_KEY: (self.aux_weight * aux).astype(
+            state[AUX_LOSS_KEY].dtype)} if train else state
+        y = self.activation.apply(y2).reshape(shape)
+        return y, new_state

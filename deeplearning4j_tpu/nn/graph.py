@@ -206,6 +206,15 @@ class ComputationGraph(nn_io.LazyScoreMixin):
             loss = loss + spec.vertex.score(params.get(spec.name, {}), x,
                                             labels[i], lmasks[i])
         loss = loss + self._regularization_score(params)
+        # auxiliary TRAIN-time loss terms layers stash in their state
+        # (MoE load-balance — conf/layers_moe.py AUX_LOSS_KEY); eval
+        # scores must not pick up the stale last-training-step value
+        if train:
+            from deeplearning4j_tpu.conf.layers_moe import AUX_LOSS_KEY
+
+            for s in new_state.values():
+                if isinstance(s, dict) and AUX_LOSS_KEY in s:
+                    loss = loss + s[AUX_LOSS_KEY].astype(self._dtype)
         return loss, (new_state, new_carries)
 
     def _regularization_score(self, params):

@@ -192,6 +192,12 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         x = x.astype(self._dtype)
         loss = out_layer.score(params.get(str(last), {}), x, labels, lmask)
         loss = loss + solver.regularization_score(self.conf.layers, params)
+        if train:  # eval must not pick up the stale training aux
+            from deeplearning4j_tpu.conf.layers_moe import AUX_LOSS_KEY
+
+            for s in new_state.values():
+                if isinstance(s, dict) and AUX_LOSS_KEY in s:
+                    loss = loss + s[AUX_LOSS_KEY].astype(self._dtype)
         return loss, (new_state, new_carries)
 
     def train_step_fn(self):

@@ -35,6 +35,27 @@ from deeplearning4j_tpu.parallel import mesh as mesh_mod
 from deeplearning4j_tpu.parallel.mesh import EXPERT_AXIS  # noqa: F401 — reserved in round 1
 
 
+# --- active expert-axis context (set by ParallelWrapper's expert-parallel
+# step around its shard_map body at TRACE time; read by MoELayer.forward
+# to name the all_to_all axis when its expert weights arrive sharded) ---
+import contextlib as _contextlib
+
+_ACTIVE_EXPERT_AXIS: list = [None]
+
+
+@_contextlib.contextmanager
+def active_expert_axis(name: str):
+    _ACTIVE_EXPERT_AXIS.append(name)
+    try:
+        yield
+    finally:
+        _ACTIVE_EXPERT_AXIS.pop()
+
+
+def current_expert_axis():
+    return _ACTIVE_EXPERT_AXIS[-1]
+
+
 def moe_init(key, d_model: int, d_hidden: int, n_experts: int,
              dtype=jnp.float32) -> dict:
     """One logical copy: router [d, E] (replicated) + per-expert FFN
@@ -65,61 +86,107 @@ def shard_moe_params(params: dict, mesh: Mesh) -> dict:
     }
 
 
-def _moe_local(params, x, n_experts: int, capacity: int):
-    """The per-shard MoE math (runs under shard_map; ``x`` is this
-    shard's [t, d] tokens, ``params['w1'/'w2']`` this shard's experts
-    [e_loc, d, h]/[e_loc, h, d]). Returns (y, aux_loss_local)."""
+def moe_apply(router, w1, w2, x, n_experts: int, capacity: int,
+              top_k: int = 1, axis_name: str | None = EXPERT_AXIS,
+              b1=None, b2=None, residual: bool = True):
+    """The MoE layer math, shared by the raw shard_map entrypoints below
+    AND the conf-DSL ``MoELayer`` (``conf/layers_moe.py``).
+
+    ``x`` [t, d] tokens (this shard's, when ``axis_name`` is bound);
+    ``w1`` [e_loc, d, h] / ``w2`` [e_loc, h, d] the LOCAL experts
+    (e_loc == n_experts when running unsharded); ``router`` [d, E]
+    replicated. ``top_k`` in {1, 2}: GShard top-2 routes each token to
+    its two best experts with gates renormalized over the pair; capacity
+    is counted per (source shard, expert) with the rank-0 choice queued
+    before rank-1 (GShard's ordering). ``axis_name=None`` (or e_loc ==
+    n_experts) skips the all_to_all — single-shard execution, used by CPU
+    tests and the conf layer's unsharded path. Returns (x + y, aux)."""
     t, d = x.shape
-    logits = x @ params["router"]                     # [t, E]
+    e_loc = w1.shape[0]
+    logits = x @ router                                # [t, E]
     probs = jax.nn.softmax(logits, axis=-1)
-    top = jnp.argmax(probs, axis=-1)                  # [t]
-    gate = jnp.take_along_axis(probs, top[:, None], axis=-1)[:, 0]
 
-    onehot = jax.nn.one_hot(top, n_experts, dtype=x.dtype)   # [t, E]
-    pos = jnp.cumsum(onehot, axis=0) - onehot          # position in queue
-    keep = pos < capacity
-    # dispatch[t, e, c] = 1 iff token t is slot c of expert e (one-hot,
-    # capacity-dropped tokens have an all-zero row -> identity residual)
-    dispatch = (onehot * keep)[:, :, None] * jax.nn.one_hot(
-        pos.astype(jnp.int32), capacity, dtype=x.dtype)
+    # top-k assignment matrix + per-(token, expert) gate weights,
+    # renormalized over the chosen experts (GShard combine weights)
+    kidx = jax.lax.top_k(probs, top_k)[1]              # [t, k]
+    hots = jax.nn.one_hot(kidx, n_experts, dtype=x.dtype)  # [t, k, E]
+    gates = jnp.take_along_axis(probs, kidx, axis=-1)  # [t, k]
+    gates = gates / jnp.maximum(
+        jnp.sum(gates, axis=-1, keepdims=True), 1e-9)
+
+    # capacity queue: rank-0 choices first, then rank-1 (stable order)
+    flat = hots.transpose(1, 0, 2).reshape(top_k * t, n_experts)
+    pos_flat = jnp.cumsum(flat, axis=0) - flat
+    pos = pos_flat.reshape(top_k, t, n_experts).transpose(1, 0, 2)
+    keep = pos < capacity                              # [t, k, E]
+    # dispatch[t, e, c]: token t occupies slot c of expert e (0/1; a
+    # token dropped by capacity keeps its residual only)
+    dispatch = jnp.einsum("tke,tkc->tec", hots * keep, jax.nn.one_hot(
+        jnp.sum(pos * hots, axis=-1).astype(jnp.int32), capacity,
+        dtype=x.dtype))
+    # combine[t, e, c] = dispatch * gate of that (t, e) pair
+    gate_te = jnp.einsum("tke,tk->te", hots * keep, gates)
+    combine = dispatch * gate_te[:, :, None]
+
     send = jnp.einsum("td,tec->ecd", x, dispatch)      # [E, C, d]
+    n_shards = n_experts // e_loc
+    if n_shards > 1:
+        if axis_name is None:
+            raise ValueError(
+                f"w1 holds {e_loc}/{n_experts} experts but no mesh axis "
+                "was given for the all_to_all exchange")
+        # rows grouped by DEST expert -> after all_to_all the leading
+        # axis is the SOURCE shard, all buffers for MY experts
+        send = send.reshape(n_shards, e_loc * capacity, d)
+        recv = jax.lax.all_to_all(send, axis_name, split_axis=0,
+                                  concat_axis=0, tiled=False)
+        # [n_shards, e_loc*C, d] -> [e_loc, n_shards*C, d]
+        recv = recv.reshape(n_shards, e_loc, capacity, d).transpose(
+            1, 0, 2, 3).reshape(e_loc, n_shards * capacity, d)
+    else:
+        recv = send
 
-    # exchange: rows grouped by DEST expert -> after all_to_all the
-    # leading axis is the SOURCE shard, all buffers for MY experts
-    e_loc = params["w1"].shape[0]
-    send = send.reshape(n_experts // e_loc, e_loc * capacity, d)
-    recv = jax.lax.all_to_all(send, EXPERT_AXIS, split_axis=0,
-                              concat_axis=0, tiled=False)
-    # [n_shards, e_loc*C, d] -> [e_loc, n_shards*C, d]
-    n_shards = recv.shape[0]
-    recv = recv.reshape(n_shards, e_loc, capacity, d).transpose(
-        1, 0, 2, 3).reshape(e_loc, n_shards * capacity, d)
+    h = jnp.einsum("ecd,edh->ech", recv, w1)
+    if b1 is not None:
+        h = h + b1[:, None, :]
+    h = jnp.maximum(h, 0.0)
+    out = jnp.einsum("ech,ehd->ecd", h, w2)
+    if b2 is not None:
+        out = out + b2[:, None, :]
 
-    h = jnp.maximum(jnp.einsum("ecd,edh->ech", recv, params["w1"]), 0.0)
-    out = jnp.einsum("ech,ehd->ecd", h, params["w2"])
+    if n_shards > 1:
+        out = out.reshape(e_loc, n_shards, capacity, d).transpose(
+            1, 0, 2, 3).reshape(n_shards, e_loc * capacity, d)
+        back = jax.lax.all_to_all(out, axis_name, split_axis=0,
+                                  concat_axis=0, tiled=False)
+        back = back.reshape(n_experts, capacity, d)
+    else:
+        back = out
+    # combine, scaled by the (renormalized) router gate — the router's
+    # gradient path
+    y = jnp.einsum("ecd,tec->td", back, combine)
 
-    out = out.reshape(e_loc, n_shards, capacity, d).transpose(
-        1, 0, 2, 3).reshape(n_shards, e_loc * capacity, d)
-    back = jax.lax.all_to_all(out, EXPERT_AXIS, split_axis=0,
-                              concat_axis=0, tiled=False)
-    back = back.reshape(n_experts, capacity, d)
-    # combine, scaled by the router prob (router gradient path)
-    y = jnp.einsum("ecd,tec->td", back, dispatch) * gate[:, None]
-
-    # load-balance aux (GShard): E * sum_e mean(prob_e) * mean(assign_e)
-    assign = jnp.mean(onehot, axis=0)
+    # load-balance aux (GShard): E * sum_e mean(prob_e) * mean(top-1
+    # assignment_e) — the rank-0 assignment only, per the paper
+    assign = jnp.mean(hots[:, 0], axis=0)
     prob_mean = jnp.mean(probs, axis=0)
     aux = n_experts * jnp.sum(assign * prob_mean)
-    return x + y, aux                                  # residual
+    return (x + y if residual else y), aux
 
 
-def moe_spmd_fn(n_experts: int, capacity: int, mesh: Mesh):
+def _moe_local(params, x, n_experts: int, capacity: int, top_k: int = 1):
+    return moe_apply(params["router"], params["w1"], params["w2"], x,
+                     n_experts, capacity, top_k=top_k)
+
+
+def moe_spmd_fn(n_experts: int, capacity: int, mesh: Mesh,
+                top_k: int = 1):
     """-> jitted ``(params, x) -> (y, aux)``: x [T, d] sharded over
     ``expert`` (T % n_shards == 0), params via ``shard_moe_params``."""
     def spmd(params, x):
         p = {"router": params["router"],
              "w1": params["w1"], "w2": params["w2"]}
-        y, aux = _moe_local(p, x, n_experts, capacity)
+        y, aux = _moe_local(p, x, n_experts, capacity, top_k=top_k)
         return y, jax.lax.pmean(aux, EXPERT_AXIS)
 
     sharded = mesh_mod.shard_map(
@@ -131,7 +198,8 @@ def moe_spmd_fn(n_experts: int, capacity: int, mesh: Mesh):
 
 
 def moe_train_step(n_experts: int, capacity: int, mesh: Mesh,
-                   lr: float = 0.05, aux_weight: float = 1e-2):
+                   lr: float = 0.05, aux_weight: float = 1e-2,
+                   top_k: int = 1):
     """-> jitted ``(params, x, target) -> (params, loss)``: MSE + aux
     load-balance loss; expert-weight grads stay shard-local, the
     replicated router's grad is ``pmean``-reduced.
@@ -150,7 +218,7 @@ def moe_train_step(n_experts: int, capacity: int, mesh: Mesh,
     elementwise, so any regression in either direction is caught."""
     def spmd(params, x, target):
         def loss_fn(p):
-            y, aux = _moe_local(p, x, n_experts, capacity)
+            y, aux = _moe_local(p, x, n_experts, capacity, top_k=top_k)
             mse = jnp.mean((y - target) ** 2)
             return jax.lax.pmean(mse, EXPERT_AXIS) \
                 + aux_weight * jax.lax.pmean(aux, EXPERT_AXIS)

@@ -6,9 +6,15 @@ AND backward — compiles into one XLA program).
 
 Design (TPU-first, not a thread/queue translation):
 
-- The network is S equal-signature stages (activation shape is identical
-  between stages — the transformer-stack case); stage s's params live
-  ONLY on mesh shard s (leading-axis sharding ``P('stage')``).
+- The network is S stages; stage s's params live ONLY on mesh shard s
+  (leading-axis sharding ``P('stage')``). The original entrypoints below
+  take equal-signature stages (activation shape identical between
+  stages — the transformer-stack case); :class:`HeteroPipeline` (round
+  4) lifts that to arbitrary per-stage parameter trees and activation
+  shapes via flat-packing + a stage-indexed ``lax.switch``, and
+  :class:`PipelineParallelWrapper` drives a whole MultiLayerNetwork
+  through it from the conf DSL, the stage axis composing with the data
+  axis on one mesh.
 - GPipe schedule with M microbatches runs ``S + M - 1`` scan steps.
   Each step, every stage applies itself to the activation it holds and
   ``ppermute``s the result one hop down the ring; stage 0 injects
@@ -33,6 +39,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.parallel import mesh as mesh_mod
@@ -133,3 +140,456 @@ def serial_reference(stage_fn, per_stage_params: list, x):
     for p in per_stage_params:
         x = stage_fn(p, x)
     return x
+
+
+# ===========================================================================
+# Round 4: heterogeneous stages + the ParallelWrapper-style entry
+# ===========================================================================
+#
+# The GPipe scan above requires equal-signature stages (one ring buffer
+# type). The general case — per-stage parameter trees AND activation
+# shapes — flattens both sides: every stage's params ravel into one
+# padded [Lmax] f32 vector (stacked [S, Lmax], sharded P('stage')), the
+# ring buffer is a padded [Amax] activation vector, and a lax.switch on
+# the stage index picks the stage's unflatten->apply->flatten branch (all
+# branches compile per shard; exactly one executes — the SPMD price of
+# heterogeneity, paid in compile time, not FLOPs). lax.switch, ppermute
+# and scan all transpose, so jax.grad is still the reverse schedule.
+
+
+def _flat_spec(tree):
+    """-> (leaf treedef/shapes spec, flat size). All leaves must share a
+    dtype (the flat vector is one leaf; elementwise updaters then act
+    identically to per-leaf application)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    dtypes = {l.dtype for l in leaves}
+    if len(dtypes) > 1:
+        raise ValueError(
+            f"pipeline stage params mix dtypes {dtypes}; cast first")
+    shapes = [l.shape for l in leaves]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    return (treedef, shapes, sizes), sum(sizes)
+
+
+def _flatten_tree(tree):
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.concatenate([jnp.ravel(l) for l in leaves]) if leaves \
+        else jnp.zeros((0,), jnp.float32)
+
+
+def _unflatten_tree(spec, flat):
+    treedef, shapes, sizes = spec
+    leaves = []
+    off = 0
+    for shp, sz in zip(shapes, sizes):
+        leaves.append(flat[off:off + sz].reshape(shp))
+        off += sz
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class HeteroPipeline:
+    """S stages with arbitrary per-stage params and activation shapes.
+
+    ``stage_fns[s](params_s, x_s) -> y_s`` pure; shapes are inferred by
+    ``jax.eval_shape`` chaining from ``example_in``. Use
+    :meth:`stack_params` to build the sharded [S, Lmax] tensor, then
+    :meth:`spmd_fn` / :meth:`train_step` (plain SGD) — or drive it
+    through :class:`PipelineParallelWrapper` for conf-updater training.
+
+    ``data_axis``: when the mesh also has a data axis, the microbatch
+    dimension shards over it and the stage ring runs per data-shard; the
+    AD of the pmean'd loss delivers data-global gradients (see
+    PipelineParallelWrapper._build_step).
+    """
+
+    def __init__(self, stage_fns, per_stage_params, example_in,
+                 mesh: Mesh, n_micro: int):
+        self.stage_fns = list(stage_fns)
+        self.n_stages = len(self.stage_fns)
+        self.n_micro = int(n_micro)
+        self.mesh = mesh
+        if mesh.shape[STAGE_AXIS] != self.n_stages:
+            raise ValueError(
+                f"mesh stage axis = {mesh.shape[STAGE_AXIS]}, "
+                f"n_stages = {self.n_stages}")
+        self.pspecs, psizes = zip(*[_flat_spec(p) for p in per_stage_params])
+        self.p_max = max(psizes)
+        # activation chain via eval_shape
+        self.in_shapes = []
+        x = jax.eval_shape(lambda a: a, example_in)
+        for f, p in zip(self.stage_fns, per_stage_params):
+            self.in_shapes.append(x.shape)
+            x = jax.eval_shape(f, p, x)
+        self.out_shape = x.shape
+        self.out_dtype = x.dtype
+        sizes = [int(np.prod(s)) for s in self.in_shapes] \
+            + [int(np.prod(self.out_shape))]
+        self.a_max = max(sizes)
+
+    def stack_params(self, per_stage_params):
+        flats = [_flatten_tree(p) for p in per_stage_params]
+        stacked = jnp.stack([
+            jnp.pad(f, (0, self.p_max - f.shape[0])) for f in flats])
+        return jax.device_put(
+            stacked, NamedSharding(self.mesh, P(STAGE_AXIS)))
+
+    def unstack_params(self, stacked):
+        out = []
+        for s, spec in enumerate(self.pspecs):
+            out.append(_unflatten_tree(spec, np.asarray(stacked[s])))
+        return out
+
+    def _stage_branch(self, s):
+        in_shape = self.in_shapes[s]
+        in_size = int(np.prod(in_shape))
+        f = self.stage_fns[s]
+        spec = self.pspecs[s]
+
+        def branch(flat_params, buf):
+            p = _unflatten_tree(spec, flat_params)
+            x = buf[:in_size].reshape(in_shape).astype(self.out_dtype)
+            y = f(p, x)
+            yf = jnp.ravel(y)
+            return jnp.pad(yf, (0, self.a_max - yf.shape[0]))
+
+        return branch
+
+    def _forward_local(self, my_flat, x_micro_flat):
+        """Per-shard GPipe schedule over the flat ring buffer."""
+        sid = jax.lax.axis_index(STAGE_AXIS)
+        S, M = self.n_stages, self.n_micro
+        total = S + M - 1
+        perm = [(s, (s + 1) % S) for s in range(S)]
+        branches = [self._stage_branch(s) for s in range(S)]
+        # the scan carry's varying-manual-axes type must match the step
+        # output (which varies on every mesh axis: stage via the ring,
+        # data via the microbatch shards) — pvary anchors the zero init
+        buf = jax.lax.pcast(jnp.zeros((self.a_max,), self.out_dtype),
+                            tuple(self.mesh.axis_names), to="varying")
+
+        def step(buf, t):
+            inj = x_micro_flat[jnp.minimum(t, M - 1)]
+            x = jnp.where(sid == 0, inj, buf)
+            y = jax.lax.switch(sid, branches, my_flat, x)
+            return jax.lax.ppermute(y, STAGE_AXIS, perm), y
+
+        _, ys = jax.lax.scan(step, buf, jnp.arange(total))
+        outs = ys[S - 1:]
+        outs = jax.lax.psum(
+            jnp.where(sid == S - 1, outs, jnp.zeros_like(outs)),
+            STAGE_AXIS)
+        out_size = int(np.prod(self.out_shape))
+        return outs[:, :out_size].reshape((M,) + tuple(self.out_shape))
+
+    def _flatten_micro(self, x_micro):
+        m = x_micro.shape[0]
+        flat = x_micro.reshape(m, -1)
+        return jnp.pad(flat, ((0, 0), (0, self.a_max - flat.shape[1]))) \
+            .astype(self.out_dtype)
+
+    def spmd_fn(self):
+        """-> jitted ``(stacked_params, x_micro [M, ...]) -> [M, ...]``
+        outputs (replicated)."""
+        def spmd(stacked, x_micro):
+            my_flat = stacked[0]
+            return self._forward_local(my_flat,
+                                       self._flatten_micro(x_micro))
+
+        return jax.jit(mesh_mod.shard_map(
+            spmd, self.mesh, in_specs=(P(STAGE_AXIS), P()),
+            out_specs=P()))
+
+    def train_step(self, loss_fn, lr: float = 0.05):
+        """Plain-SGD step (the raw API; PipelineParallelWrapper wires
+        conf updaters): ``(stacked, x_micro, y_micro) -> (stacked,
+        loss)``, gradients stage-local."""
+        def spmd(stacked, x_micro, y_micro):
+            def fwd(my_flat):
+                outs = self._forward_local(my_flat,
+                                           self._flatten_micro(x_micro))
+                return loss_fn(outs, y_micro)
+
+            loss, g = jax.value_and_grad(fwd)(stacked[0])
+            return (stacked[0] - lr * g)[None], loss
+
+        return jax.jit(mesh_mod.shard_map(
+            spmd, self.mesh, in_specs=(P(STAGE_AXIS), P(), P()),
+            out_specs=(P(STAGE_AXIS), P())), donate_argnums=(0,))
+
+
+def hetero_serial_reference(stage_fns, per_stage_params, x):
+    for f, p in zip(stage_fns, per_stage_params):
+        x = f(p, x)
+    return x
+
+
+class PipelineParallelWrapper:
+    """ParallelWrapper-style entry for PIPELINE-parallel training of a
+    ``MultiLayerNetwork`` (round-4 productization: stage partitioning,
+    conf-updater training, and the stage axis composing with the data
+    axis on one mesh — no hand-written shard_map in user code).
+
+    The network's layers split into ``n_stages`` contiguous stages
+    balanced by parameter count; each stage's params live only on its
+    mesh shard (flat-packed, :class:`HeteroPipeline`). The final layer
+    must be the loss head (``score``): its params replicate and its
+    score runs on the collected (replicated) pipeline outputs, so its
+    gradient needs no collective. With a ``data`` mesh axis the
+    microbatches shard over it; differentiating the data-pmean'd loss
+    under shard_map's varying-manual-axes AD yields data-global
+    gradients for the stage-local params automatically (same mechanism
+    as ParallelWrapper's expert mode — pinned by
+    tests/test_pipeline_expert.py).
+
+    v1 scope (clear refusals, not silent wrongness): stateless layers
+    only (no BatchNormalization running stats), no dropout, no tBPTT,
+    one global conf updater (elementwise — Sgd/Adam/RMSprop class; the
+    flat packing makes elementwise updaters exactly equal to per-leaf
+    application), batch divisible by n_micro * data_axis.
+    """
+
+    def __init__(self, model, n_micro: int = 4, mesh: Mesh | None = None,
+                 n_stages: int | None = None):
+        from deeplearning4j_tpu.conf.multilayer import BackpropType
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        if not isinstance(model, MultiLayerNetwork):
+            raise TypeError(
+                "PipelineParallelWrapper drives MultiLayerNetwork "
+                "(sequential stage partitioning); wrap ComputationGraph "
+                "models stage-by-stage with HeteroPipeline directly")
+        if model.params is None:
+            model.init()
+        if model.conf.backprop_type is BackpropType.TRUNCATED_BPTT:
+            raise ValueError("pipeline training does not compose with "
+                             "tBPTT yet")
+        self.model = model
+        if mesh is None:
+            devs = np.array(jax.devices())
+            mesh = Mesh(devs, (STAGE_AXIS,))
+        self.mesh = mesh
+        if STAGE_AXIS not in self.mesh.shape:
+            raise ValueError(f"mesh needs a '{STAGE_AXIS}' axis")
+        self.n_stages = n_stages or self.mesh.shape[STAGE_AXIS]
+        if self.mesh.shape[STAGE_AXIS] != self.n_stages:
+            raise ValueError(
+                f"mesh stage axis = {self.mesh.shape[STAGE_AXIS]} but "
+                f"n_stages = {self.n_stages}")
+        self.data_size = self.mesh.shape.get(mesh_mod.DATA_AXIS, 1)
+        self.n_micro = int(n_micro)
+
+        layers = model.conf.layers
+        if len(layers) - 1 < self.n_stages:
+            raise ValueError(
+                f"{len(layers) - 1} stage-able layers < {self.n_stages} "
+                "stages")
+        from deeplearning4j_tpu.conf.layers import GradientNormalization
+
+        for i, l in enumerate(layers[:-1]):
+            if model.state.get(str(i)):
+                raise ValueError(
+                    f"layer {i} ({type(l).__name__}) carries mutable "
+                    "state (running statistics); pipeline v1 supports "
+                    "stateless stages only")
+            if getattr(l, "dropout", 0.0):
+                raise ValueError(f"layer {i}: dropout under pipeline "
+                                 "training is not supported yet")
+            if getattr(l, "regularization", ()) \
+                    or getattr(l, "regularization_bias", ()):
+                raise ValueError(
+                    f"layer {i}: l1/l2/weight-decay regularization under "
+                    "pipeline training is not supported yet (the flat "
+                    "stage packing bypasses the per-layer solver path)")
+            if getattr(l, "updater", None) is not None:
+                raise ValueError(
+                    f"layer {i}: per-layer updater overrides are not "
+                    "supported under pipeline training (one global conf "
+                    "updater drives every stage)")
+            gn = getattr(l, "gradient_normalization", None)
+            if gn is not None and gn is not GradientNormalization.NONE:
+                raise ValueError(
+                    f"layer {i}: gradient normalization is not supported "
+                    "under pipeline training yet")
+        self.out_layer = layers[-1]
+        if not hasattr(self.out_layer, "score"):
+            raise ValueError("last layer must be a loss head (score())")
+
+        # contiguous partition of layers[0..L-2], balanced by param count
+        counts = [sum(int(np.prod(p.shape))
+                      for p in model.params.get(str(i), {}).values())
+                  for i in range(len(layers) - 1)]
+        total = sum(counts) or 1
+        bounds, acc, nxt = [0], 0.0, 1
+        for i, c in enumerate(counts):
+            acc += c
+            if (acc >= nxt * total / self.n_stages
+                    and nxt < self.n_stages
+                    and len(layers) - 1 - (i + 1)
+                    >= self.n_stages - nxt):
+                bounds.append(i + 1)
+                nxt += 1
+        while len(bounds) < self.n_stages:
+            bounds.append(len(layers) - 1)
+        bounds.append(len(layers) - 1)
+        self.stage_layers = [list(range(bounds[s], bounds[s + 1]))
+                             for s in range(self.n_stages)]
+
+        def make_stage(idxs):
+            def f(p, x):
+                for i in idxs:
+                    x, _ = layers[i].forward(p.get(str(i), {}), {}, x,
+                                             train=True)
+                return x
+            return f
+
+        self.stage_fns = [make_stage(idxs) for idxs in self.stage_layers]
+        self.stage_params = [
+            {str(i): model.params[str(i)] for i in idxs
+             if str(i) in model.params}
+            for idxs in self.stage_layers]
+        self.updater = model.conf.updater
+        self._pipe = None
+        self._step = None
+        self._stacked = None
+        self._flat_opt = None
+        self._out_params = None
+        self._out_opt = None
+        self.score_value = float("nan")
+
+    def _build(self, mb_shape):
+        import jax.tree_util as jtu
+
+        self._pipe = HeteroPipeline(
+            self.stage_fns, self.stage_params,
+            jax.ShapeDtypeStruct(mb_shape,
+                                 jnp.asarray(
+                                     self.model.params["0"]["W"]).dtype
+                                 if "W" in self.model.params.get("0", {})
+                                 else jnp.float32),
+            self.mesh, self.n_micro)
+        self._stacked = self._pipe.stack_params(self.stage_params)
+        upd = self.updater
+        # updater state over the flat per-stage vector, stacked [S, ...]
+        # (elementwise updaters act identically to per-leaf application)
+        opt0 = upd.init_state(jnp.zeros((self._pipe.p_max,), jnp.float32))
+        self._flat_opt = jax.device_put(
+            jtu.tree_map(lambda z: jnp.stack([z] * self.n_stages), opt0),
+            NamedSharding(self.mesh, P(STAGE_AXIS)))
+        li = str(len(self.model.conf.layers) - 1)
+        self._out_params = mesh_mod.replicate(
+            self.mesh, dict(self.model.params.get(li, {})))
+        self._out_opt = mesh_mod.replicate(self.mesh, {
+            k: upd.init_state(v)
+            for k, v in self.model.params.get(li, {}).items()})
+        self._step = self._build_step()
+
+    def _build_step(self):
+        pipe = self._pipe
+        upd = self.updater
+        out_layer = self.out_layer
+        has_data = mesh_mod.DATA_AXIS in self.mesh.shape \
+            and self.mesh.shape[mesh_mod.DATA_AXIS] > 1
+
+        def spmd(stacked, flat_opt, out_p, out_opt, x_micro, y_micro,
+                 it, ep):
+            my_flat = stacked[0]
+            my_opt = jax.tree_util.tree_map(lambda a: a[0], flat_opt)
+
+            def fwd(my_flat, out_p):
+                outs = pipe._forward_local(
+                    my_flat, pipe._flatten_micro(x_micro))
+                # mean over microbatches of the head's per-mb score
+                losses = [out_layer.score(out_p, outs[m], y_micro[m])
+                          for m in range(pipe.n_micro)]
+                loss = sum(losses) / pipe.n_micro
+                if has_data:
+                    loss = jax.lax.pmean(loss, mesh_mod.DATA_AXIS)
+                return loss
+
+            loss, (g_flat, g_out) = jax.value_and_grad(
+                fwd, argnums=(0, 1))(my_flat, out_p)
+            if has_data:
+                # defensive identity under vma tracking (see
+                # ParallelWrapper._build_expert_step)
+                g_flat = jax.lax.pmean(g_flat, mesh_mod.DATA_AXIS)
+                g_out = jax.tree_util.tree_map(
+                    lambda a: jax.lax.pmean(a, mesh_mod.DATA_AXIS), g_out)
+            lr = upd.current_lr(it, ep)
+            delta, new_opt = upd.update_leaf(g_flat, my_opt, lr, it, ep,
+                                             param=my_flat)
+            new_out, new_out_opt = {}, {}
+            for k, p in out_p.items():
+                d, new_out_opt[k] = upd.update_leaf(
+                    g_out[k], out_opt[k], lr, it, ep, param=p)
+                new_out[k] = p - d
+            return ((my_flat - delta)[None],
+                    jax.tree_util.tree_map(lambda a: a[None], new_opt),
+                    new_out, new_out_opt, loss)
+
+        SP = P(STAGE_AXIS)
+        DP = P(None, mesh_mod.DATA_AXIS) if has_data else P()
+        sharded = mesh_mod.shard_map(
+            spmd, self.mesh,
+            in_specs=(SP, SP, P(), P(), DP, DP, P(), P()),
+            out_specs=(SP, SP, P(), P(), P()))
+        return jax.jit(sharded, donate_argnums=(0, 1, 2, 3))
+
+    def fit_batch(self, ds) -> float:
+        import numpy as _np
+
+        m = self.model
+        if getattr(ds, "features_mask", None) is not None \
+                or getattr(ds, "labels_mask", None) is not None:
+            raise ValueError(
+                "masked DataSets are not supported under pipeline "
+                "training yet (the head's score runs unmasked)")
+        feats = _np.asarray(ds.features if hasattr(ds, "features") else ds[0])
+        labels = _np.asarray(ds.labels if hasattr(ds, "labels") else ds[1])
+        rows = feats.shape[0]
+        div = self.n_micro * self.data_size
+        if rows % div:
+            raise ValueError(
+                f"batch of {rows} rows must divide into n_micro x "
+                f"data_axis = {self.n_micro} x {self.data_size}")
+        mb = rows // self.n_micro
+        x_micro = feats.reshape((self.n_micro, mb) + feats.shape[1:])
+        y_micro = labels.reshape((self.n_micro, mb) + labels.shape[1:])
+        if self._pipe is None:
+            self._build((mb // self.data_size,) + feats.shape[1:])
+        (self._stacked, self._flat_opt, self._out_params, self._out_opt,
+         loss) = self._step(self._stacked, self._flat_opt,
+                            self._out_params, self._out_opt,
+                            jnp.asarray(x_micro), jnp.asarray(y_micro),
+                            _np.float32(m.iteration), _np.float32(m.epoch))
+        m.iteration += 1
+        self.score_value = float(loss)
+        return self.score_value
+
+    def fit(self, data, epochs: int = 1):
+        if not hasattr(data, "reset"):  # bare DataSet -> one-item iterator
+            from deeplearning4j_tpu.datasets.iterators import (
+                ListDataSetIterator,
+            )
+
+            data = ListDataSetIterator([data])
+        for _ in range(epochs):
+            for ds in data:
+                self.fit_batch(ds)
+            data.reset()
+            self.model.epoch += 1
+        self.write_back()
+        return self.model
+
+    def write_back(self):
+        """Publish trained stage params back onto the wrapped model."""
+        if self._pipe is None:
+            return
+        per_stage = self._pipe.unstack_params(np.asarray(self._stacked))
+        for sp in per_stage:
+            for k, v in sp.items():
+                self.model.params[k] = jax.tree_util.tree_map(jnp.asarray,
+                                                              v)
+        li = str(len(self.model.conf.layers) - 1)
+        if li in self.model.params:
+            self.model.params[li] = jax.tree_util.tree_map(
+                jnp.asarray, jax.device_get(self._out_params))

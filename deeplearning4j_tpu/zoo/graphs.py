@@ -953,9 +953,17 @@ class TransformerEncoder(GraphZooModel):
                  embed_dim: int = 64, n_heads: int = 4, n_layers: int = 2,
                  ffn_dim: int = 0, max_len: int = 128, seed: int = 123,
                  updater: IUpdater | None = None,
-                 attention_impl: str = "auto", causal: bool = False):
+                 attention_impl: str = "auto", causal: bool = False,
+                 moe_experts: int = 0, moe_top_k: int = 2,
+                 moe_capacity_factor: float = 1.25):
         """``vocab_size``>0: token-id inputs through an embedding;
-        0: continuous ``[batch, time, embed_dim]`` inputs."""
+        0: continuous ``[batch, time, embed_dim]`` inputs.
+
+        ``moe_experts`` > 0 replaces every block's dense FFN with a
+        GShard-style ``MoELayer`` (round-4 productization): the same
+        config then trains data+expert-parallel under
+        ``ParallelWrapper(expert_parallel=True)`` with no hand-written
+        shard_map."""
         self.num_classes = num_classes
         self.vocab_size = vocab_size
         self.embed_dim = embed_dim
@@ -967,6 +975,9 @@ class TransformerEncoder(GraphZooModel):
         self.updater = updater or Adam(learning_rate=1e-3)
         self.attention_impl = attention_impl
         self.causal = causal
+        self.moe_experts = moe_experts
+        self.moe_top_k = moe_top_k
+        self.moe_capacity_factor = moe_capacity_factor
 
     def conf(self) -> ComputationGraphConfiguration:
         from deeplearning4j_tpu.conf.layers import EmbeddingSequenceLayer
@@ -1003,14 +1014,25 @@ class TransformerEncoder(GraphZooModel):
                          ElementWiseVertex(op=ElementWiseOp.ADD),
                          prev, f"b{i}_attn")
             g.add_layer(f"b{i}_ln2", LayerNormalization(), f"b{i}_res1")
-            g.add_layer(f"b{i}_ff1", DenseLayer(
-                n_out=self.ffn_dim, activation=Activation.GELU),
-                f"b{i}_ln2")
-            g.add_layer(f"b{i}_ff2", DenseLayer(
-                n_out=e, activation=Activation.IDENTITY), f"b{i}_ff1")
+            if self.moe_experts:
+                from deeplearning4j_tpu.conf.layers_moe import MoELayer
+
+                g.add_layer(f"b{i}_moe", MoELayer(
+                    n_experts=self.moe_experts, d_hidden=self.ffn_dim,
+                    top_k=self.moe_top_k,
+                    capacity_factor=self.moe_capacity_factor,
+                    residual=False), f"b{i}_ln2")
+                ff_out = f"b{i}_moe"
+            else:
+                g.add_layer(f"b{i}_ff1", DenseLayer(
+                    n_out=self.ffn_dim, activation=Activation.GELU),
+                    f"b{i}_ln2")
+                g.add_layer(f"b{i}_ff2", DenseLayer(
+                    n_out=e, activation=Activation.IDENTITY), f"b{i}_ff1")
+                ff_out = f"b{i}_ff2"
             g.add_vertex(f"b{i}_res2",
                          ElementWiseVertex(op=ElementWiseOp.ADD),
-                         f"b{i}_res1", f"b{i}_ff2")
+                         f"b{i}_res1", ff_out)
             prev = f"b{i}_res2"
         g.add_layer("final_ln", LayerNormalization(), prev)
         g.add_layer("pool", GlobalPoolingLayer(

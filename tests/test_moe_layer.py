@@ -1,0 +1,253 @@
+"""Conf-DSL MoE layer (round-4 productization of expert parallelism):
+builder -> ComputationGraph/MultiLayerNetwork lowering, aux-loss wiring,
+serde round-trip, and data+expert-parallel training through
+ParallelWrapper(expert_parallel=True) with NO hand-written shard_map —
+pinned against the single-device run."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.conf import Activation, InputType, WeightInit
+from deeplearning4j_tpu.conf.graph import ElementWiseOp, ElementWiseVertex
+from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.conf.layers_moe import AUX_LOSS_KEY, MoELayer
+from deeplearning4j_tpu.conf.losses import LossMCXENT
+from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+from deeplearning4j_tpu.conf.updaters import Sgd
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.parallel import mesh as mesh_mod
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+D, CLASSES = 16, 4
+
+
+def _moe_graph(n_experts=4, top_k=2, aux_weight=1e-2, seed=7,
+               capacity_factor=8.0):
+    """input -> dense -> MoE (residual FFN) -> output; recurrent-free so
+    the EP token count is just the batch."""
+    g = (NeuralNetConfiguration.builder()
+         .seed(seed).updater(Sgd(learning_rate=0.1))
+         .weight_init(WeightInit.XAVIER)
+         .graph_builder()
+         .add_inputs("input")
+         .set_input_types(InputType.feed_forward(D)))
+    g.add_layer("embed", DenseLayer(n_out=D, activation=Activation.TANH),
+                "input")
+    g.add_layer("moe", MoELayer(
+        n_experts=n_experts, d_hidden=2 * D, top_k=top_k,
+        aux_weight=aux_weight, capacity_factor=capacity_factor), "embed")
+    g.add_layer("out", OutputLayer(n_out=CLASSES,
+                                   activation=Activation.SOFTMAX,
+                                   loss_fn=LossMCXENT()), "moe")
+    g.set_outputs("out")
+    return g.build()
+
+
+def _batch(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, D)).astype(np.float32)
+    y = np.eye(CLASSES, dtype=np.float32)[rng.integers(0, CLASSES, n)]
+    return DataSet(x, y)
+
+
+def test_moe_layer_trains_locally():
+    net = ComputationGraph(_moe_graph()).init()
+    ds = _batch()
+    first = net.fit_batch(ds)
+    for _ in range(30):
+        loss = net.fit_batch(ds)
+    assert loss < first * 0.7
+    out = net.output(ds.features)
+    assert out.shape == (32, CLASSES)
+    np.testing.assert_allclose(np.asarray(out).sum(-1), 1.0, rtol=1e-4)
+
+
+def test_moe_aux_loss_reaches_objective():
+    """aux_weight > 0 changes the reported training loss by exactly the
+    (weighted) load-balance term stashed under AUX_LOSS_KEY."""
+    n0 = ComputationGraph(_moe_graph(aux_weight=0.0)).init()
+    n1 = ComputationGraph(_moe_graph(aux_weight=0.5)).init()
+    n1.params = jax.tree_util.tree_map(
+        lambda a: jnp.array(a, copy=True), dict(n0.params))
+    n1.state = jax.tree_util.tree_map(
+        lambda a: jnp.array(a, copy=True), dict(n0.state))
+    ds = _batch()
+    l0 = n0.fit_batch(ds)
+    l1 = n1.fit_batch(ds)
+    aux = float(n1.state["moe"][AUX_LOSS_KEY])
+    assert aux > 0.0
+    np.testing.assert_allclose(l1 - l0, aux, rtol=1e-3, atol=1e-5)
+
+
+def test_moe_layer_serde_round_trip(tmp_path):
+    from deeplearning4j_tpu.util import serializer
+
+    net = ComputationGraph(_moe_graph(top_k=1)).init()
+    ds = _batch()
+    net.fit_batch(ds)
+    path = str(tmp_path / "moe.zip")
+    serializer.write_model(net, path)
+    loaded = serializer.restore_computation_graph(path)
+    lay = loaded.conf.vertex_map()["moe"].vertex.layer
+    assert isinstance(lay, MoELayer)
+    assert (lay.n_experts, lay.top_k) == (4, 1)
+    np.testing.assert_allclose(
+        np.asarray(loaded.output(ds.features)),
+        np.asarray(net.output(ds.features)), rtol=1e-5, atol=1e-6)
+
+
+def test_moe_expert_parallel_matches_single_device():
+    """One train step under ParallelWrapper(expert_parallel=True) on the
+    8-device mesh == the plain single-device fit_batch, elementwise on
+    every parameter (aux_weight=0: the aux statistics are per-shard by
+    design; capacity ample so no drops)."""
+    mesh = mesh_mod.single_host_mesh()
+    if mesh.shape[mesh_mod.DATA_AXIS] != 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    ds = _batch(n=32, seed=3)
+
+    ref = ComputationGraph(_moe_graph(n_experts=8, aux_weight=0.0)).init()
+    p0 = jax.tree_util.tree_map(lambda a: np.asarray(a).copy(), dict(ref.params))
+    s0 = jax.tree_util.tree_map(lambda a: np.asarray(a).copy(), dict(ref.state))
+    ref_loss = ref.fit_batch(ds)
+
+    ep = ComputationGraph(_moe_graph(n_experts=8, aux_weight=0.0)).init()
+    ep.params = jax.tree_util.tree_map(jnp.asarray, p0)
+    ep.state = jax.tree_util.tree_map(jnp.asarray, s0)
+    pw = ParallelWrapper(ep, mesh=mesh, expert_parallel=True)
+    pw.fit(ds)
+    np.testing.assert_allclose(pw.score_value, ref_loss, rtol=1e-4)
+    for k in ref.params:
+        for pk in ref.params[k]:
+            np.testing.assert_allclose(
+                np.asarray(ep.params[k][pk]),
+                np.asarray(ref.params[k][pk]), rtol=1e-3, atol=1e-5,
+                err_msg=f"{k}/{pk}")
+
+
+def test_moe_expert_parallel_multi_step_training():
+    """The EP wrapper actually trains (loss decreases over steps) with
+    top-2 routing and a real aux weight."""
+    mesh = mesh_mod.single_host_mesh()
+    if mesh.shape[mesh_mod.DATA_AXIS] != 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    net = ComputationGraph(_moe_graph(n_experts=8, aux_weight=1e-2)).init()
+    pw = ParallelWrapper(net, mesh=mesh, expert_parallel=True)
+    ds = _batch(n=64, seed=4)
+    losses = []
+    for _ in range(12):
+        pw.fit(ds)
+        losses.append(pw.score_value)
+    assert losses[-1] < losses[0] * 0.8
+
+
+def test_moe_expert_count_must_divide_axis():
+    mesh = mesh_mod.single_host_mesh()
+    if mesh.shape[mesh_mod.DATA_AXIS] != 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    net = ComputationGraph(_moe_graph(n_experts=6)).init()
+    with pytest.raises(ValueError, match="multiple of the data-axis"):
+        ParallelWrapper(net, mesh=mesh, expert_parallel=True)
+
+
+def test_zoo_transformer_moe_trains_expert_parallel():
+    """The round-4 'done' criterion: a transformer config with an MoE
+    layer trains data+expert-parallel straight from the builder DSL (zoo
+    TransformerEncoder(moe_experts=...) -> ParallelWrapper(
+    expert_parallel=True)) — no hand-written shard_map anywhere."""
+    from deeplearning4j_tpu.conf.updaters import Adam
+    from deeplearning4j_tpu.zoo.graphs import TransformerEncoder
+
+    mesh = mesh_mod.single_host_mesh()
+    if mesh.shape[mesh_mod.DATA_AXIS] != 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    model = TransformerEncoder(
+        num_classes=3, embed_dim=16, n_heads=2, n_layers=2, max_len=8,
+        moe_experts=8, moe_top_k=2, moe_capacity_factor=4.0,
+        updater=Adam(learning_rate=3e-3))
+    net = model.init()
+    assert any("moe" in k for k in net.params)
+    pw = ParallelWrapper(net, mesh=mesh, expert_parallel=True)
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(16, 8, 16)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    ds = DataSet(x, y)
+    losses = []
+    for _ in range(15):
+        pw.fit(ds)
+        losses.append(pw.score_value)
+    assert losses[-1] < losses[0] * 0.8
+    out = net.output(x)
+    assert out.shape == (16, 3)
+
+
+def test_moe_aux_not_in_eval_score():
+    """Round-4 review regression: the stale training-step aux must NOT
+    inflate eval scores (score() after fit_batch)."""
+    n0 = ComputationGraph(_moe_graph(aux_weight=0.0)).init()
+    n1 = ComputationGraph(_moe_graph(aux_weight=0.5)).init()
+    n1.params = jax.tree_util.tree_map(
+        lambda a: jnp.array(a, copy=True), dict(n0.params))
+    n1.state = jax.tree_util.tree_map(
+        lambda a: jnp.array(a, copy=True), dict(n0.state))
+    ds = _batch()
+    n0.fit_batch(ds)
+    n1.fit_batch(ds)
+    assert float(n1.state["moe"][AUX_LOSS_KEY]) > 0.0
+    # eval scores on FRESH data must not include the stashed aux: the two
+    # nets took the same data-loss trajectory modulo the aux gradient,
+    # so the scores differ by training dynamics only, not by +0.5*aux
+    ds2 = _batch(seed=99)
+    s0, s1 = n0.score(ds2), n1.score(ds2)
+    aux = float(n1.state["moe"][AUX_LOSS_KEY])
+    assert abs(s1 - s0) < 0.5 * aux
+
+
+def test_moe_expert_parallel_with_l2_matches_single_device():
+    """Round-4 review regression: l2 over the expert-sharded w1/w2 must
+    contribute its FULL (all-experts) penalty under EP, matching the
+    single-device step."""
+    mesh = mesh_mod.single_host_mesh()
+    if mesh.shape[mesh_mod.DATA_AXIS] != 8:
+        pytest.skip("needs the 8-device CPU mesh")
+
+    def build():
+        g = (NeuralNetConfiguration.builder()
+             .seed(7).updater(Sgd(learning_rate=0.1))
+             .weight_init(WeightInit.XAVIER)
+             .l2(1e-2)
+             .graph_builder()
+             .add_inputs("input")
+             .set_input_types(InputType.feed_forward(D)))
+        g.add_layer("embed", DenseLayer(n_out=D, activation=Activation.TANH),
+                    "input")
+        g.add_layer("moe", MoELayer(n_experts=8, d_hidden=2 * D, top_k=2,
+                                    aux_weight=0.0, capacity_factor=8.0),
+                    "embed")
+        g.add_layer("out", OutputLayer(n_out=CLASSES,
+                                       activation=Activation.SOFTMAX,
+                                       loss_fn=LossMCXENT()), "moe")
+        g.set_outputs("out")
+        return ComputationGraph(g.build()).init()
+
+    ds = _batch(n=32, seed=5)
+    ref = build()
+    p0 = jax.tree_util.tree_map(lambda a: np.asarray(a).copy(),
+                                dict(ref.params))
+    ref_loss = ref.fit_batch(ds)
+
+    ep = build()
+    ep.params = jax.tree_util.tree_map(jnp.asarray, p0)
+    pw = ParallelWrapper(ep, mesh=mesh, expert_parallel=True)
+    pw.fit(ds)
+    np.testing.assert_allclose(pw.score_value, ref_loss, rtol=1e-4)
+    for k in ref.params:
+        for pk in ref.params[k]:
+            np.testing.assert_allclose(
+                np.asarray(ep.params[k][pk]),
+                np.asarray(ref.params[k][pk]), rtol=1e-3, atol=1e-5,
+                err_msg=f"{k}/{pk}")
