@@ -25,6 +25,8 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+
+import jax
 from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
@@ -160,19 +162,96 @@ class AttentionVertex(GraphVertex):
     project_input: bool = True
     weight_init: "WeightInit" = None  # set in __post_init__
     attention_impl: str = "auto"
+    causal: bool = False
+    streaming_window: int = 0
+    """> 0 (requires ``causal``): the vertex streams through
+    ``rnn_time_step`` — and threads across tBPTT segments — with a
+    key/value cache of the most recent ``streaming_window`` steps.
+    EXACT causal attention while the streamed history fits the window;
+    sliding-window attention beyond it (the round-3 'attention-vertex
+    streaming' refusal, closed where the window allows). 0 = whole-
+    sequence attention only (streaming refuses, as before)."""
 
     def __post_init__(self):
         from deeplearning4j_tpu.conf.weights import WeightInit
         if self.weight_init is None:
             self.weight_init = WeightInit.XAVIER
+        if self.streaming_window and not self.causal:
+            raise ValueError(
+                "AttentionVertex: streaming_window requires causal=True "
+                "(non-causal attention reads future keys and cannot "
+                "stream)")
 
     def _head_size(self, nq):
         return self.head_size or (self.n_out // self.n_heads)
 
     def streaming_safe(self) -> bool:
-        # attention needs the WHOLE sequence; per-segment rnn_time_step
-        # calls would attend only within each call's window
-        return False
+        # whole-sequence attention cannot stream; a causal KV-cache
+        # window can (exact while history <= streaming_window)
+        return bool(self.causal and self.streaming_window > 0)
+
+    @property
+    def has_carry(self):
+        return self.streaming_safe()
+
+    def zero_carry(self, batch, dtype=jnp.float32):
+        w = int(self.streaming_window)
+        e = self.n_heads * (self.head_size or self.n_out // self.n_heads)
+        return {"k": jnp.zeros((batch, w, e), dtype),
+                "v": jnp.zeros((batch, w, e), dtype),
+                "m": jnp.zeros((batch, w), dtype)}
+
+    def forward_with_carry(self, params, carry, inputs, train=False,
+                           rng=None):
+        """Chunked causal attention over cached + current keys/values:
+        query i of the chunk sees every valid cached step plus chunk
+        steps <= i; the cache keeps the last ``streaming_window`` steps
+        (scores materialize [B, H, Tc, W+Tc] — streaming chunks are
+        small by construction)."""
+        from deeplearning4j_tpu.conf.layers_attention import (
+            _split_heads, _merge_heads)
+
+        q_in, k_in, v_in = inputs[0], inputs[1], inputs[2]
+        mask = inputs[3] if len(inputs) > 3 else None
+        if mask is not None and mask.ndim == 3:
+            mask = mask[:, :, 0]
+        if self.project_input:
+            q = q_in @ params["Wq"] + params["bq"]
+            k = k_in @ params["Wk"] + params["bk"]
+            v = v_in @ params["Wv"] + params["bv"]
+        else:
+            q, k, v = q_in, k_in, v_in
+        b, tc, _ = q.shape
+        w = int(self.streaming_window)
+        cm = carry["m"].astype(q.dtype)
+        chunk_m = (jnp.ones((b, tc), q.dtype) if mask is None
+                   else mask.astype(q.dtype))
+        kcat = jnp.concatenate([carry["k"].astype(k.dtype), k], axis=1)
+        vcat = jnp.concatenate([carry["v"].astype(v.dtype), v], axis=1)
+        mcat = jnp.concatenate([cm, chunk_m], axis=1)      # [B, W+Tc]
+        qh = _split_heads(q, self.n_heads)                 # [B, H, Tc, hs]
+        kh = _split_heads(kcat, self.n_heads)
+        vh = _split_heads(vcat, self.n_heads)
+        hs = qh.shape[-1]
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / jnp.sqrt(
+            jnp.asarray(hs, qh.dtype))
+        # band: chunk query i sees cached keys (j < W) + chunk j <= i
+        j = jnp.arange(w + tc)[None, :]
+        i = jnp.arange(tc)[:, None]
+        band = (j <= w + i).astype(qh.dtype)               # [Tc, W+Tc]
+        vis = band[None, None] * mcat[:, None, None, :]
+        scores = jnp.where(vis > 0, scores, -1e30)
+        # fully-masked rows (cold cache, masked query) -> zero output
+        any_vis = jnp.max(vis, axis=-1, keepdims=True)
+        att = jax.nn.softmax(scores, axis=-1) * any_vis
+        o = jnp.einsum("bhqk,bhkd->bhqd", att, vh)
+        y = _merge_heads(o)
+        if self.project_input:
+            y = y @ params["Wo"] + params["bo"]
+        new_carry = {"k": kcat[:, -w:].astype(carry["k"].dtype),
+                     "v": vcat[:, -w:].astype(carry["v"].dtype),
+                     "m": mcat[:, -w:].astype(carry["m"].dtype)}
+        return y, new_carry
 
     def output_type(self, input_types):
         tq = input_types[0]
@@ -227,7 +306,7 @@ class AttentionVertex(GraphVertex):
         o = dot_product_attention(
             _split_heads(q, self.n_heads), _split_heads(k, self.n_heads),
             _split_heads(v, self.n_heads), key_mask=mask,
-            impl=self.attention_impl, train=train)
+            causal=self.causal, impl=self.attention_impl, train=train)
         y = _merge_heads(o)
         if self.project_input:
             y = y @ params["Wo"] + params["bo"]

@@ -35,6 +35,17 @@ from deeplearning4j_tpu.conf.layers import BaseLayer
 AUX_LOSS_KEY = "__aux_loss__"
 
 
+def sum_aux_losses(new_state, dtype):
+    """Total of every layer's reserved aux-loss entry (train-time only —
+    callers gate on ``train``; shared by MultiLayerNetwork and
+    ComputationGraph ``_loss`` so the contract cannot diverge)."""
+    total = 0.0
+    for s in new_state.values():
+        if isinstance(s, dict) and AUX_LOSS_KEY in s:
+            total = total + s[AUX_LOSS_KEY].astype(dtype)
+    return total
+
+
 @serde.register
 @dataclasses.dataclass
 class MoELayer(BaseLayer):

@@ -172,6 +172,17 @@ def propagate_mask(mask, y, layer_or_vertex):
     return None
 
 
+def contains_go_backwards(layer) -> bool:
+    """Walks wrapper ``.layer`` chains for the Keras go_backwards flag
+    (shared by MultiLayerNetwork and ComputationGraph: such layers get
+    PER-SEGMENT RESET under tBPTT and refuse rnn_time_step streaming)."""
+    while layer is not None:
+        if getattr(layer, "go_backwards", False):
+            return True
+        layer = getattr(layer, "layer", None)
+    return False
+
+
 def check_streaming_safe(layer, label: str):
     """Shared ``rnn_time_step`` guard: reject layers whose per-segment
     streaming would silently diverge from the full-sequence forward —

@@ -47,6 +47,14 @@ def _as_iterator(data, labels=None, batch_size: Optional[int] = None):
     raise TypeError(f"cannot build DataSetIterator from {type(data)}")
 
 
+def _is_go_backwards_layer(layer) -> bool:
+    """go_backwards layers get PER-SEGMENT RESET under tBPTT (their
+    reversed scan's carry would come from the FUTURE segment) — same
+    contract as ComputationGraph (nn/graph.py _is_go_backwards); single-
+    segment training is exactly standard BPTT, pinned in tests."""
+    return nn_io.contains_go_backwards(layer)
+
+
 class MultiLayerNetwork(nn_io.LazyScoreMixin):
     """Sequential network (reference ``MultiLayerNetwork``)."""
 
@@ -124,7 +132,8 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
             s = state.get(str(i), {})
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
             kw = {"mask": fmask} if getattr(layer, "uses_mask", False) else {}
-            if carries is not None and getattr(layer, "has_carry", False):
+            if carries is not None and getattr(layer, "has_carry", False) \
+                    and not _is_go_backwards_layer(layer):
                 c = carries.get(str(i))
                 if c is None:
                     c = layer.zero_carry(x.shape[0], x.dtype)
@@ -193,11 +202,9 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         loss = out_layer.score(params.get(str(last), {}), x, labels, lmask)
         loss = loss + solver.regularization_score(self.conf.layers, params)
         if train:  # eval must not pick up the stale training aux
-            from deeplearning4j_tpu.conf.layers_moe import AUX_LOSS_KEY
+            from deeplearning4j_tpu.conf.layers_moe import sum_aux_losses
 
-            for s in new_state.values():
-                if isinstance(s, dict) and AUX_LOSS_KEY in s:
-                    loss = loss + s[AUX_LOSS_KEY].astype(self._dtype)
+            loss = loss + sum_aux_losses(new_state, self._dtype)
         return loss, (new_state, new_carries)
 
     def train_step_fn(self):
@@ -564,7 +571,8 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
             anchor = jnp.sum(features[:1, :1]) * 0
             carries = {str(i): layer.zero_carry(features.shape[0], cdt)
                        for i, layer in enumerate(self.conf.layers)
-                       if getattr(layer, "has_carry", False)}
+                       if getattr(layer, "has_carry", False)
+                       and not _is_go_backwards_layer(layer)}
             return jax.tree_util.tree_map(
                 lambda z: z + anchor.astype(z.dtype), carries)
 
@@ -592,12 +600,9 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         default masks, 1-D labels mask expanded per-timestep. Used by
         ParallelWrapper to feed the sharded scan runner the exact arrays
         the single-device path trains on."""
-        for i, layer in enumerate(self.conf.layers):
-            if getattr(layer, "go_backwards", False):
-                raise RuntimeError(
-                    f"layer {i}: go_backwards RNNs cannot train with "
-                    "truncated BPTT (carries thread forward in time); use "
-                    "STANDARD backprop")
+        # go_backwards layers train under tBPTT with PER-SEGMENT RESET
+        # (_is_go_backwards_layer; the round-3 refusal closed in round
+        # 4) — only rnn_time_step streaming still refuses them.
         ds = self._tbptt_prepad(ds)
         features, labels, fmask, lmask = self._batch_arrays(
             ds, lazy_lmask=True, write_back=True)

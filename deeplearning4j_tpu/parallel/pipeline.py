@@ -419,18 +419,24 @@ class PipelineParallelWrapper:
                       for p in model.params.get(str(i), {}).values())
                   for i in range(len(layers) - 1)]
         total = sum(counts) or 1
+        n_layers = len(layers) - 1
         bounds, acc, nxt = [0], 0.0, 1
         for i, c in enumerate(counts):
             acc += c
+            if nxt >= self.n_stages:
+                break
+            remaining_layers = n_layers - (i + 1)
+            remaining_stages = self.n_stages - nxt
+            # split at the balanced threshold — or FORCED when exactly
+            # enough layers remain to give every later stage one
+            # (otherwise trailing stages come out empty and their
+            # devices compute identity pass-throughs)
             if (acc >= nxt * total / self.n_stages
-                    and nxt < self.n_stages
-                    and len(layers) - 1 - (i + 1)
-                    >= self.n_stages - nxt):
+                    or remaining_layers == remaining_stages) \
+                    and remaining_layers >= remaining_stages:
                 bounds.append(i + 1)
                 nxt += 1
-        while len(bounds) < self.n_stages:
-            bounds.append(len(layers) - 1)
-        bounds.append(len(layers) - 1)
+        bounds.append(n_layers)
         self.stage_layers = [list(range(bounds[s], bounds[s + 1]))
                              for s in range(self.n_stages)]
 
@@ -454,6 +460,7 @@ class PipelineParallelWrapper:
         self._flat_opt = None
         self._out_params = None
         self._out_opt = None
+        self._built_mb_shape = None
         self.score_value = float("nan")
 
     def _build(self, mb_shape):
@@ -554,8 +561,18 @@ class PipelineParallelWrapper:
         mb = rows // self.n_micro
         x_micro = feats.reshape((self.n_micro, mb) + feats.shape[1:])
         y_micro = labels.reshape((self.n_micro, mb) + labels.shape[1:])
+        mb_shape = (mb // self.data_size,) + feats.shape[1:]
         if self._pipe is None:
-            self._build((mb // self.data_size,) + feats.shape[1:])
+            self._build(mb_shape)
+            self._built_mb_shape = mb_shape
+        elif mb_shape != self._built_mb_shape:
+            # the flat ring buffer and stage branches are compiled for
+            # one microbatch shape; a silently-padded smaller batch
+            # would train on phantom zero rows
+            raise ValueError(
+                f"pipeline compiled for microbatch shape "
+                f"{self._built_mb_shape}, got {mb_shape}; feed equal-"
+                "size batches (pad the trailing batch)")
         (self._stacked, self._flat_opt, self._out_params, self._out_opt,
          loss) = self._step(self._stacked, self._flat_opt,
                             self._out_params, self._out_opt,

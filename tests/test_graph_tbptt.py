@@ -291,20 +291,79 @@ def test_cg_rnn_time_step_matches_full_forward():
 # --------------------------------------------------------------------------
 # validation / refusal surface
 # --------------------------------------------------------------------------
-def test_cg_tbptt_rejects_go_backwards():
-    conf = (_base()
-            .graph_builder()
-            .add_inputs("in")
-            .set_input_types(InputType.recurrent(4, 10))
-            .add_layer("rnn", LSTM(n_out=6, go_backwards=True), "in")
-            .add_layer("out", RnnOutputLayer(n_out=2), "rnn")
-            .set_outputs("out")
-            .backprop_type(BackpropType.TRUNCATED_BPTT, fwd=5, back=5)
-            .build())
-    cg = ComputationGraph(conf).init()
-    x, y = _seq_data(n=2, t=10, classes=2)
-    with pytest.raises(RuntimeError, match="go_backwards"):
-        cg.fit_batch(DataSet(x, y))
+def _gb_conf(t, fwd, bidirectional=False, seed=12345):
+    g = (_base(seed)
+         .graph_builder()
+         .add_inputs("in")
+         .set_input_types(InputType.recurrent(4, t)))
+    if bidirectional:
+        from deeplearning4j_tpu.conf.layers_rnn import Bidirectional
+
+        g.add_layer("rnn", Bidirectional(
+            layer=LSTM(n_out=6, go_backwards=True)), "in")
+    else:
+        g.add_layer("rnn", LSTM(n_out=6, go_backwards=True), "in")
+    g.add_layer("out", RnnOutputLayer(n_out=2,
+                                      activation=Activation.SOFTMAX,
+                                      loss_fn=LossMCXENT()), "rnn")
+    g.set_outputs("out")
+    if fwd:
+        g.backprop_type(BackpropType.TRUNCATED_BPTT, fwd=fwd, back=fwd)
+    return g.build()
+
+
+@pytest.mark.parametrize("bidirectional", [False, True])
+def test_cg_tbptt_go_backwards_single_segment_is_standard(bidirectional):
+    """Round-3 refusal CLOSED: go_backwards (and Bidirectional over it)
+    trains under truncated BPTT. Single segment (T == fwd) is exactly
+    standard BPTT — losses and every parameter match elementwise."""
+    x, y = _seq_data(n=4, t=5, classes=2)
+    std = ComputationGraph(_gb_conf(5, fwd=0, bidirectional=bidirectional)
+                           ).init()
+    tb = ComputationGraph(_gb_conf(5, fwd=5, bidirectional=bidirectional)
+                          ).init()
+    for pk in std.params["rnn"]:
+        np.testing.assert_array_equal(np.asarray(std.params["rnn"][pk]),
+                                      np.asarray(tb.params["rnn"][pk]))
+    l_std = std.fit_batch(DataSet(x, y))
+    l_tb = tb.fit_batch(DataSet(x, y))
+    np.testing.assert_allclose(l_tb, l_std, rtol=1e-6)
+    for name in ("rnn", "out"):
+        for pk in std.params[name]:
+            np.testing.assert_allclose(
+                np.asarray(tb.params[name][pk]),
+                np.asarray(std.params[name][pk]), rtol=1e-5, atol=1e-7,
+                err_msg=f"{name}/{pk}")
+
+
+def test_cg_tbptt_go_backwards_multi_segment_per_segment_reset():
+    """Multi-segment semantics: the reversed direction RESETS each
+    segment (its carry would come from the future), so for a pure
+    go_backwards net tBPTT over [T] == sequential STANDARD fits on the
+    [fwd]-slices — the strongest oracle available, exact elementwise."""
+    x, y = _seq_data(n=4, t=10, classes=2)
+    tb = ComputationGraph(_gb_conf(10, fwd=5)).init()
+    std = ComputationGraph(_gb_conf(5, fwd=0)).init()
+    std.params = {k: {pk: np.asarray(v).copy()
+                      for pk, v in d.items()}
+                  for k, d in tb.params.items()}
+    l_tb = tb.fit_batch(DataSet(x, y))
+    l1 = std.fit_batch(DataSet(x[:, :5], y[:, :5]))
+    l2 = std.fit_batch(DataSet(x[:, 5:], y[:, 5:]))
+    np.testing.assert_allclose(l_tb, (l1 + l2) / 2.0, rtol=1e-5)
+    for name in ("rnn", "out"):
+        for pk in std.params[name]:
+            np.testing.assert_allclose(
+                np.asarray(tb.params[name][pk]),
+                np.asarray(std.params[name][pk]), rtol=1e-4, atol=1e-6,
+                err_msg=f"{name}/{pk}")
+
+
+def test_cg_rnn_time_step_still_refuses_go_backwards():
+    cg = ComputationGraph(_gb_conf(6, fwd=0)).init()
+    x, _ = _seq_data(n=2, t=6, classes=2)
+    with pytest.raises(RuntimeError, match="go_backwards|whole sequence"):
+        cg.rnn_time_step(x[:, :2])
 
 
 def test_cg_tbptt_rejects_sequence_level_labels():

@@ -208,3 +208,86 @@ def test_attention_vertex_streaming_refused():
     x = np.random.default_rng(0).normal(size=(2, 6, 4)).astype(np.float32)
     with pytest.raises(RuntimeError, match="rnn_time_step is unsupported"):
         net.rnn_time_step(x)
+
+
+def test_attention_vertex_streaming_with_window():
+    """Round-3 refusal closed where the window allows: a CAUSAL
+    AttentionVertex with streaming_window >= T streams through
+    rnn_time_step chunk by chunk and matches the full-sequence forward
+    exactly; the whole-sequence (default) vertex still refuses."""
+    from deeplearning4j_tpu.conf.graph import AttentionVertex
+
+    T = 6
+
+    def build(window):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(3).updater(Adam(learning_rate=0.01))
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(InputType.recurrent(4, T))
+                .add_layer("rnn", LSTM(n_out=8), "in")
+                .add_vertex("att", AttentionVertex(
+                    n_out=8, n_heads=2, causal=True,
+                    streaming_window=window), "rnn", "rnn", "rnn")
+                .add_layer("out", RnnOutputLayer(
+                    n_out=2, activation=Activation.SOFTMAX,
+                    loss_fn=LossMCXENT()), "att")
+                .set_outputs("out")
+                .build())
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        return ComputationGraph(conf).init()
+
+    net = build(window=T)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(3, T, 4)).astype(np.float32)
+    full = np.asarray(net.output(x))
+
+    streamed = []
+    for t0 in range(0, T, 2):           # three 2-step chunks
+        streamed.append(np.asarray(net.rnn_time_step(x[:, t0:t0 + 2])))
+    got = np.concatenate(streamed, axis=1)
+    np.testing.assert_allclose(got, full, rtol=1e-4, atol=1e-5)
+
+    # non-causal / windowless stays refused
+    import pytest as _pytest
+
+    from deeplearning4j_tpu.conf.graph import AttentionVertex as AV
+
+    with _pytest.raises(ValueError, match="requires causal"):
+        AV(n_out=8, n_heads=2, streaming_window=4)
+
+
+def test_attention_vertex_window_tbptt_trains():
+    """The windowed causal vertex also trains under truncated BPTT (the
+    KV cache threads across segments, transformer-XL style): finite and
+    decreasing loss."""
+    from deeplearning4j_tpu.conf.graph import AttentionVertex
+    from deeplearning4j_tpu.conf.multilayer import BackpropType
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    T = 8
+    conf = (NeuralNetConfiguration.builder()
+            .seed(5).updater(Adam(learning_rate=0.01))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.recurrent(4, T))
+            .add_layer("rnn", LSTM(n_out=8), "in")
+            .add_vertex("att", AttentionVertex(
+                n_out=8, n_heads=2, causal=True, streaming_window=4),
+                "rnn", "rnn", "rnn")
+            .add_layer("out", RnnOutputLayer(
+                n_out=2, activation=Activation.SOFTMAX,
+                loss_fn=LossMCXENT()), "att")
+            .set_outputs("out")
+            .backprop_type(BackpropType.TRUNCATED_BPTT, fwd=4, back=4)
+            .build())
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(4, T, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (4, T))]
+    first = net.fit_batch(DataSet(x, y))
+    for _ in range(15):
+        loss = net.fit_batch(DataSet(x, y))
+    assert np.isfinite(loss) and loss < first

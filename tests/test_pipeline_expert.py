@@ -476,3 +476,49 @@ def test_pipeline_wrapper_refusals():
         pw.fit_batch(DataSet(
             rng.normal(size=(8, 16)).astype(np.float32),
             np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]))
+
+
+def test_pipeline_wrapper_partition_never_empty():
+    """Round-4 review regression: heavily-skewed param counts must not
+    produce empty trailing stages (devices doing identity work)."""
+    from deeplearning4j_tpu.conf import Activation, InputType, WeightInit
+    from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.conf.losses import LossMCXENT
+    from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+    from deeplearning4j_tpu.conf.updaters import Sgd
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.pipeline import PipelineParallelWrapper
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Sgd(learning_rate=0.1))
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(DenseLayer(n_out=4, activation=Activation.TANH))
+            .layer(DenseLayer(n_out=4, activation=Activation.TANH))
+            .layer(DenseLayer(n_out=256, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=2, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    pw = PipelineParallelWrapper(net, n_micro=2, mesh=_stage_mesh(3),
+                                 n_stages=3)
+    assert all(pw.stage_layers), pw.stage_layers
+    assert [i for idxs in pw.stage_layers for i in idxs] == [0, 1, 2]
+
+
+def test_pipeline_wrapper_rejects_shrunk_batch():
+    """Round-4 review regression: a later batch with a different
+    microbatch shape must refuse, not train on phantom zero rows."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.parallel.pipeline import PipelineParallelWrapper
+
+    rng = np.random.default_rng(0)
+    net = _mlp_net()
+    pw = PipelineParallelWrapper(net, n_micro=2, mesh=_stage_mesh(4))
+    mk = lambda n: DataSet(
+        rng.normal(size=(n, 16)).astype(np.float32),
+        np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)])
+    pw.fit_batch(mk(8))
+    with pytest.raises(ValueError, match="compiled for microbatch"):
+        pw.fit_batch(mk(2))

@@ -544,3 +544,64 @@ def test_tbptt_back_lt_fwd_tail_segment_trains(rng):
     assert diff > 0, "tail-segment labels had no gradient effect"
     # and the mean loss is not diluted by a hard-zero tail segment
     assert la > 0 and lb > 0
+
+
+def test_mln_tbptt_go_backwards_matches_standard_and_slices():
+    """Round-4: go_backwards under MLN truncated BPTT (per-segment
+    reset, same contract as ComputationGraph): single segment == exact
+    standard BPTT; multi-segment == sequential standard fits on the
+    fwd-length slices."""
+    from deeplearning4j_tpu.conf import Activation, InputType, WeightInit
+    from deeplearning4j_tpu.conf.layers_rnn import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.conf.losses import LossMCXENT
+    from deeplearning4j_tpu.conf.multilayer import (
+        BackpropType,
+        NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.conf.updaters import Adam
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    def build(t, fwd):
+        b = (NeuralNetConfiguration.builder()
+             .seed(11).updater(Adam(learning_rate=0.02))
+             .weight_init(WeightInit.XAVIER)
+             .list()
+             .layer(LSTM(n_out=6, go_backwards=True))
+             .layer(RnnOutputLayer(n_out=2, activation=Activation.SOFTMAX,
+                                   loss_fn=LossMCXENT())))
+        if fwd:
+            b.backprop_type(BackpropType.TRUNCATED_BPTT, fwd=fwd, back=fwd)
+        return MultiLayerNetwork(
+            b.set_input_type(InputType.recurrent(4, t)).build()).init()
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(4, 10, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (4, 10))]
+
+    # single segment == standard
+    std = build(5, fwd=0)
+    tb = build(5, fwd=5)
+    l_std = std.fit_batch(DataSet(x[:, :5], y[:, :5]))
+    l_tb = tb.fit_batch(DataSet(x[:, :5], y[:, :5]))
+    np.testing.assert_allclose(l_tb, l_std, rtol=1e-6)
+    for k in std.params:
+        for pk in std.params[k]:
+            np.testing.assert_allclose(
+                np.asarray(tb.params[k][pk]),
+                np.asarray(std.params[k][pk]), rtol=1e-5, atol=1e-7)
+
+    # multi-segment == sequential slice fits
+    tb2 = build(10, fwd=5)
+    std2 = build(5, fwd=0)
+    std2.params = {k: {pk: np.asarray(v).copy() for pk, v in d.items()}
+                   for k, d in tb2.params.items()}
+    l_tb2 = tb2.fit_batch(DataSet(x, y))
+    l1 = std2.fit_batch(DataSet(x[:, :5], y[:, :5]))
+    l2 = std2.fit_batch(DataSet(x[:, 5:], y[:, 5:]))
+    np.testing.assert_allclose(l_tb2, (l1 + l2) / 2.0, rtol=1e-5)
+    for k in std2.params:
+        for pk in std2.params[k]:
+            np.testing.assert_allclose(
+                np.asarray(tb2.params[k][pk]),
+                np.asarray(std2.params[k][pk]), rtol=1e-4, atol=1e-6)
