@@ -256,6 +256,8 @@ class UIServer:
         ratio = {}
         pmag = {}
         timing = {}
+        hostmem = {}
+        devmem = {}
         for r in records:
             it = r.get("iteration", 0)
             sess = r.get("session", "s")
@@ -274,6 +276,20 @@ class UIServer:
                 pmag.setdefault(f"layer {layer}", ([], []))
                 pmag[f"layer {layer}"][0].append(it)
                 pmag[f"layer {layer}"][1].append(v)
+            # system/hardware series (reference dashboard System tab:
+            # host + per-device memory — SURVEY.md §5.5)
+            sysm = r.get("system", {})
+            if "host_rss_mb" in sysm:
+                hostmem.setdefault("host RSS", ([], []))
+                hostmem["host RSS"][0].append(it)
+                hostmem["host RSS"][1].append(sysm["host_rss_mb"])
+            for dev, dstats in sysm.get("devices", {}).items():
+                for key, label in (("mem_in_use_mb", "in use"),
+                                   ("peak_mem_mb", "peak")):
+                    if key in dstats:
+                        devmem.setdefault(f"{dev} {label}", ([], []))
+                        devmem[f"{dev} {label}"][0].append(it)
+                        devmem[f"{dev} {label}"][1].append(dstats[key])
         # latest histogram snapshot (reference dashboard histogram panels)
         latest_hists = {}
         for r in records:
@@ -287,6 +303,8 @@ class UIServer:
                    "(healthy ≈ -3)"),
             _chart("Parameter mean magnitude", pmag),
             _chart("Iteration time", timing, "seconds"),
+            _chart("Host memory (RSS)", hostmem, "MB"),
+            _chart("Device memory", devmem, "MB"),
             _hist_panel("Parameter histograms (latest)",
                         latest_hists.get("param_histograms", {}),
                         "#1f77b4"),

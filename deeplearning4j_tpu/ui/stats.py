@@ -5,6 +5,7 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -196,13 +197,14 @@ class StatsListener(TrainingListener):
     def __init__(self, storage: StatsStorage, frequency: int = 1,
                  session_id: Optional[str] = None,
                  histograms: bool = False, histogram_bins: int = 20,
-                 sample_ds=None):
+                 sample_ds=None, system_metrics: bool = True):
         self.storage = storage
         self.frequency = max(1, int(frequency))
         self.session_id = session_id or f"session_{int(time.time())}"
         self.histograms = bool(histograms)
         self.histogram_bins = int(histogram_bins)
         self.sample_ds = sample_ds
+        self.system_metrics = bool(system_metrics)
         self._prev_params = None
         self._last_time = None
 
@@ -249,6 +251,8 @@ class StatsListener(TrainingListener):
                 params, self.histogram_bins)
             if self.sample_ds is not None:
                 self._probe_histograms(model, rec)
+        if self.system_metrics:
+            rec["system"] = collect_system_metrics()
         self._prev_params = params
         self._last_time = now
         self.storage.put(rec)
@@ -278,3 +282,45 @@ class StatsListener(TrainingListener):
                 self.histogram_bins)
         except Exception:
             pass  # probe must never break training
+
+
+def collect_system_metrics() -> dict:
+    """Host + device memory snapshot (reference: the dashboard's System
+    tab charts JVM/off-heap memory and GPU memory per device — SURVEY.md
+    §5.5). Host RSS from /proc (zero-cost on linux, resource fallback);
+    device memory from ``Device.memory_stats()`` (PJRT allocator stats —
+    absent on some backends, recorded as {}). Collection must never
+    break training: every probe is best-effort."""
+    out: dict = {}
+    try:
+        with open("/proc/self/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        out["host_rss_mb"] = rss_pages * (os.sysconf("SC_PAGE_SIZE")
+                                          / 1e6)
+    except Exception:
+        try:
+            import resource
+
+            out["host_rss_mb"] = (resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss / 1e3)
+        except Exception:
+            pass
+    try:
+        import jax
+
+        devices = {}
+        for d in jax.local_devices():
+            stats = {}
+            try:
+                ms = d.memory_stats() or {}
+                if "bytes_in_use" in ms:
+                    stats["mem_in_use_mb"] = ms["bytes_in_use"] / 1e6
+                if "peak_bytes_in_use" in ms:
+                    stats["peak_mem_mb"] = ms["peak_bytes_in_use"] / 1e6
+            except Exception:
+                pass
+            devices[str(d)] = stats
+        out["devices"] = devices
+    except Exception:
+        pass
+    return out
