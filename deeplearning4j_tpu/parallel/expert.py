@@ -95,8 +95,10 @@ def moe_apply(router, w1, w2, x, n_experts: int, capacity: int,
     ``x`` [t, d] tokens (this shard's, when ``axis_name`` is bound);
     ``w1`` [e_loc, d, h] / ``w2`` [e_loc, h, d] the LOCAL experts
     (e_loc == n_experts when running unsharded); ``router`` [d, E]
-    replicated. ``top_k`` in {1, 2}: GShard top-2 routes each token to
-    its two best experts with gates renormalized over the pair; capacity
+    replicated. ``top_k`` in {1, 2}: top-1 is Switch-style (combine gate
+    = the RAW router probability, keeping the router differentiable
+    through the task loss); GShard top-2 routes each token to its two
+    best experts with gates renormalized over the pair; capacity
     is counted per (source shard, expert) with the rank-0 choice queued
     before rank-1 (GShard's ordering). ``axis_name=None`` (or e_loc ==
     n_experts) skips the all_to_all — single-shard execution, used by CPU
@@ -111,8 +113,14 @@ def moe_apply(router, w1, w2, x, n_experts: int, capacity: int,
     kidx = jax.lax.top_k(probs, top_k)[1]              # [t, k]
     hots = jax.nn.one_hot(kidx, n_experts, dtype=x.dtype)  # [t, k, E]
     gates = jnp.take_along_axis(probs, kidx, axis=-1)  # [t, k]
-    gates = gates / jnp.maximum(
-        jnp.sum(gates, axis=-1, keepdims=True), 1e-9)
+    if top_k > 1:
+        # GShard top-2+: gates renormalized over the chosen pair
+        gates = gates / jnp.maximum(
+            jnp.sum(gates, axis=-1, keepdims=True), 1e-9)
+    # top_k == 1 keeps the RAW router probability as the combine gate
+    # (Switch-Transformer): renormalizing would pin the gate at 1.0 and
+    # cut the router's task-loss gradient through the combine path,
+    # leaving it trainable only via the aux loss.
 
     # capacity queue: rank-0 choices first, then rank-1 (stable order)
     flat = hots.transpose(1, 0, 2).reshape(top_k * t, n_experts)
@@ -162,8 +170,8 @@ def moe_apply(router, w1, w2, x, n_experts: int, capacity: int,
         back = back.reshape(n_experts, capacity, d)
     else:
         back = out
-    # combine, scaled by the (renormalized) router gate — the router's
-    # gradient path
+    # combine, scaled by the router gate (raw top-1 prob for k=1,
+    # pair-renormalized for k>=2) — the router's task-loss gradient path
     y = jnp.einsum("ecd,tec->td", back, combine)
 
     # load-balance aux (GShard): E * sum_e mean(prob_e) * mean(top-1

@@ -300,9 +300,13 @@ def collect_system_metrics() -> dict:
     except Exception:
         try:
             import resource
+            import sys
 
+            # ru_maxrss is kilobytes on Linux but BYTES on macOS —
+            # and this fallback only runs where /proc is absent
+            div = 1e6 if sys.platform == "darwin" else 1e3
             out["host_rss_mb"] = (resource.getrusage(
-                resource.RUSAGE_SELF).ru_maxrss / 1e3)
+                resource.RUSAGE_SELF).ru_maxrss / div)
         except Exception:
             pass
     try:

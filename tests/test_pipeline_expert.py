@@ -203,6 +203,41 @@ def test_moe_train_step_gradients_match_single_device():
                                    rtol=1e-4, atol=1e-5, err_msg=k)
 
 
+def test_moe_top1_router_gets_task_gradient():
+    """Switch-style top-1 keeps the RAW router probability as the
+    combine gate (round-4 advisor, medium): with aux_weight=0 the router
+    must still receive a nonzero gradient through the task loss. A
+    pair-style renormalization would pin the gate at 1.0 and zero this
+    gradient exactly."""
+    from deeplearning4j_tpu.parallel.expert import moe_apply
+
+    E, DH, T, CAP = 4, 16, 32, 32
+    params = moe_init(jax.random.PRNGKey(11), D, DH, E)
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(T, D)).astype(np.float32))
+    tgt = jnp.asarray(rng.normal(size=(T, D)).astype(np.float32))
+
+    def task_loss(p):
+        y, _aux = moe_apply(p["router"], p["w1"], p["w2"], x, E, CAP,
+                            top_k=1, axis_name=None)
+        return jnp.mean((y - tgt) ** 2)  # NO aux term
+
+    g = jax.grad(task_loss)(params)
+    assert float(jnp.abs(g["router"]).max()) > 1e-6
+
+    # top-1 combine gate is the raw softmax prob: with identical experts
+    # the MoE output must equal x + p_top1 * ffn(x), not x + ffn(x)
+    w1 = jnp.broadcast_to(params["w1"][:1], params["w1"].shape)
+    w2 = jnp.broadcast_to(params["w2"][:1], params["w2"].shape)
+    y, _ = moe_apply(params["router"], w1, w2, x, E, CAP, top_k=1,
+                     axis_name=None)
+    probs = jax.nn.softmax(x @ params["router"], axis=-1)
+    p1 = jnp.max(probs, axis=-1, keepdims=True)
+    ffn = jnp.maximum(x @ params["w1"][0], 0.0) @ params["w2"][0]
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x + p1 * ffn),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_moe_trains_and_balances():
     E, DH, T, CAP = 4, 32, 64, 32
     params = moe_init(jax.random.PRNGKey(2), D, DH, E)
