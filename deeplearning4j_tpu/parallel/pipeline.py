@@ -323,46 +323,221 @@ def hetero_serial_reference(stage_fns, per_stage_params, x):
     return x
 
 
+# ===========================================================================
+# Round 5: pipeline training v2 — real networks (BN state, dropout,
+# regularization, per-layer updaters, ComputationGraph) + 1F1B schedule
+# ===========================================================================
+#
+# v1 refused every stateful/stochastic/regularized network. v2 lifts the
+# refusals the round-4 verdict named, TPU-first:
+#
+# - **Mutable layer state** (BatchNormalization running statistics):
+#   every stage's state flat-packs to one padded [s_max] f32 vector,
+#   stacked [S, s_max] over the stage axis, threaded through the GPipe
+#   scan carry and updated only on ACTIVE steps (bubble steps compute on
+#   stale ring buffers; their state deltas are masked out). Statistics
+#   update per-microbatch in micro order — exactly what a serial
+#   microbatched run produces.
+# - **Dropout**: the per-batch step key folds per microbatch then per
+#   layer/vertex topo index (``fold_in(fold_in(step_key, m), i)``), so
+#   the schedule (GPipe or 1F1B, any S) never changes the masks — the
+#   serial microbatched oracle reproduces them exactly.
+# - **Solver path**: gradients route through the SAME
+#   ``optimize.solver`` functions the plain networks use —
+#   per-layer gradient normalization, L1/L2 before the updater, weight
+#   decay after, per-layer updater overrides — inside a per-stage
+#   ``lax.switch`` branch that unflattens the stage's params/opt-state,
+#   applies the per-layer solver chain, and reflattens. Regularization
+#   score terms enter the differentiated loss via a stage-local branch
+#   + ``psum`` over the stage axis (mirroring ``MultiLayerNetwork._loss``).
+# - **ComputationGraph**: the topo order of non-output vertices
+#   partitions into contiguous segments balanced by parameter count; the
+#   ring buffer carries each boundary's CROSSING SET (every tensor
+#   produced before the cut and consumed at/after it — skip connections
+#   just widen the buffer), flat-packed with dtype-tagged slots so
+#   integer token inputs survive the f32 ring. (No reference parity: the
+#   reference has no PP at all, SURVEY.md §2.3.)
+#
+# Still refused (loudly): tBPTT, masked DataSets, aux-loss layers (MoE —
+# their per-microbatch aux term has no serial equivalent yet),
+# multi-output graphs, and compute_dtype policies.
+#
+# Schedules:
+#
+# - ``schedule="gpipe"`` (default): all-microbatch-resident scan;
+#   backward is the AD transpose of the scan (activations for all
+#   S + M - 1 steps live as scan residuals).
+# - ``schedule="1f1b"`` (one-forward-one-backward): a MANUALLY
+#   scheduled scan over ``T ≈ M + 2(S-1)`` slots driven by static
+#   per-stage timetables (greedy simulator, message-lifetime invariants
+#   asserted at build time). Each slot a stage runs at most one fwd
+#   micro-op (stashing only the stage INPUT) and one bwd micro-op
+#   (``jax.vjp`` recompute against the stashed input — rematerialization
+#   bounds live activations at O(S) stage-inputs instead of GPipe's
+#   O(S + M) full-step residuals, the verdict's liveness criterion).
+#   Gradients accumulate in the scan carry; the loss head folds into the
+#   last stage's bwd op. Assumes train-mode stage outputs do not READ
+#   mutable state (true for BatchNormalization, the only admitted
+#   stateful layer — train mode uses batch statistics).
+
+
+
+def _ensure_varying(x, axes):
+    """pcast to varying only on the mesh axes ``x`` does not already
+    vary on (pcast errors on varying->varying; shard-mapped inputs
+    arrive already varying on their sharded axes)."""
+    have = set(getattr(jax.typeof(x), "vma", ()) or ())
+    need = tuple(a for a in axes if a not in have)
+    return jax.lax.pcast(x, need, to="varying") if need else x
+
+def _flatten_f32(tree):
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((0,), jnp.float32)
+    return jnp.concatenate(
+        [jnp.ravel(l).astype(jnp.float32) for l in leaves])
+
+
+def _unflatten_cast(spec, flat, dtypes):
+    treedef, shapes, sizes = spec
+    leaves, off = [], 0
+    for shp, sz, dt in zip(shapes, sizes, dtypes):
+        leaves.append(flat[off:off + sz].reshape(shp).astype(dt))
+        off += sz
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _spec_with_dtypes(tree):
+    """-> ((treedef, shapes, sizes), dtypes, total) allowing mixed
+    dtypes (state/crossing tensors hold f32 + ints; the flat vector is
+    f32 with lossless int round-trip for |v| < 2^24)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = [l.shape for l in leaves]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    dtypes = [l.dtype for l in leaves]
+    return (treedef, shapes, sizes), dtypes, sum(sizes)
+
+
+def _pad_to(v, n):
+    return jnp.pad(v, (0, n - v.shape[0]))
+
+
+def _one_f1b_tables(S: int, M: int):
+    """Static 1F1B timetables: ``fwd[s, t]`` / ``bwd[s, t]`` = microbatch
+    index (or -1) stage ``s`` forwards / backwards at slot ``t``.
+
+    Greedy simulation of the classic non-interleaved schedule
+    (PipeDream-flush): each stage backwards the oldest ready microbatch
+    every slot, and forwards the next microbatch only while its
+    in-flight count (forwarded, not yet backwarded) stays under
+    ``S - s``. The message-lifetime invariants the scan's S-slot rings
+    rely on are asserted, not assumed."""
+    INF = 10 ** 9
+    fwd_t = np.full((S, M), INF, np.int64)   # slot of fwd(s, m)
+    bwd_t = np.full((S, M), INF, np.int64)
+    next_fwd = [0] * S
+    next_bwd = [0] * S
+    t = 0
+    while any(nb < M for nb in next_bwd):
+        if t > 4 * (S + M) + 16:
+            raise AssertionError("1F1B simulator did not converge")
+        for s in range(S):
+            def try_bwd():
+                m = next_bwd[s]
+                if m >= M or fwd_t[s][m] > t:
+                    return
+                if s < S - 1 and bwd_t[s + 1][m] >= t:
+                    return
+                bwd_t[s][m] = t
+                next_bwd[s] += 1
+
+            def try_fwd():
+                m = next_fwd[s]
+                if m >= M:
+                    return
+                if s > 0 and fwd_t[s - 1][m] >= t:
+                    return
+                if next_fwd[s] - next_bwd[s] >= S - s:
+                    return  # 1F1B in-flight bound
+                fwd_t[s][m] = t
+                next_fwd[s] += 1
+
+            if s == S - 1:
+                try_fwd()   # head may bwd its own fwd in the same slot
+                try_bwd()
+            else:
+                try_bwd()
+                try_fwd()
+        t += 1
+    total = t
+    # ring-lifetime invariants (S-slot rings indexed m % S):
+    for s in range(S):
+        for m in range(M):
+            if m + S < M:
+                # fwd message (s -> s+1): consumed before slot m+S lands
+                if s + 1 < S:
+                    assert fwd_t[s + 1][m] <= fwd_t[s][m + S], (s, m)
+                # bwd message (s+1 -> s): same, reversed direction
+                if s > 0:
+                    assert bwd_t[s - 1][m] <= bwd_t[s][m + S], (s, m)
+                # input stash at s: read strictly before fwd(m+S) lands
+                # (same-slot safe: branches run bwd before fwd at s<S-1,
+                # and at S-1 the bound keeps the pair disjoint)
+                assert bwd_t[s][m] <= fwd_t[s][m + S], (s, m)
+    fwd = np.full((S, total), -1, np.int32)
+    bwd = np.full((S, total), -1, np.int32)
+    for s in range(S):
+        for m in range(M):
+            fwd[s, fwd_t[s][m]] = m
+            bwd[s, bwd_t[s][m]] = m
+    return fwd, bwd, total
+
+
 class PipelineParallelWrapper:
     """ParallelWrapper-style entry for PIPELINE-parallel training of a
-    ``MultiLayerNetwork`` (round-4 productization: stage partitioning,
-    conf-updater training, and the stage axis composing with the data
-    axis on one mesh — no hand-written shard_map in user code).
+    ``MultiLayerNetwork`` OR ``ComputationGraph`` (round-5 v2: mutable
+    layer state, dropout, the full per-layer solver path, heterogeneous
+    crossing sets, and a 1F1B schedule — see the section comment above
+    for the design; no reference parity, DL4J has no PP, SURVEY.md §2.3).
 
-    The network's layers split into ``n_stages`` contiguous stages
-    balanced by parameter count; each stage's params live only on its
-    mesh shard (flat-packed, :class:`HeteroPipeline`). The final layer
-    must be the loss head (``score``): its params replicate and its
-    score runs on the collected (replicated) pipeline outputs, so its
-    gradient needs no collective. With a ``data`` mesh axis the
-    microbatches shard over it; differentiating the data-pmean'd loss
-    under shard_map's varying-manual-axes AD yields data-global
-    gradients for the stage-local params automatically (same mechanism
-    as ParallelWrapper's expert mode — pinned by
-    tests/test_pipeline_expert.py).
-
-    v1 scope (clear refusals, not silent wrongness): stateless layers
-    only (no BatchNormalization running stats), no dropout, no tBPTT,
-    one global conf updater (elementwise — Sgd/Adam/RMSprop class; the
-    flat packing makes elementwise updaters exactly equal to per-leaf
-    application), batch divisible by n_micro * data_axis.
+    The network partitions into ``n_stages`` contiguous stages balanced
+    by parameter count; stage s's params/opt-state/mutable-state live
+    only on mesh shard s (flat-packed, padded, ``P('stage')``). The
+    final layer (MLN) / single output vertex (CG) is the replicated loss
+    head. With a ``data`` mesh axis the microbatches shard over it and
+    gradients pmean across it. ``schedule``: ``"gpipe"`` (AD-transposed
+    scan) or ``"1f1b"`` (static-timetable fwd/bwd interleave with
+    input-stash rematerialization, O(S) activation liveness).
     """
 
     def __init__(self, model, n_micro: int = 4, mesh: Mesh | None = None,
-                 n_stages: int | None = None):
-        from deeplearning4j_tpu.conf.multilayer import BackpropType
+                 n_stages: int | None = None, schedule: str = "gpipe"):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
-        if not isinstance(model, MultiLayerNetwork):
+        if isinstance(model, MultiLayerNetwork):
+            self._is_graph = False
+        elif isinstance(model, ComputationGraph):
+            self._is_graph = True
+        else:
             raise TypeError(
-                "PipelineParallelWrapper drives MultiLayerNetwork "
-                "(sequential stage partitioning); wrap ComputationGraph "
-                "models stage-by-stage with HeteroPipeline directly")
+                "PipelineParallelWrapper drives MultiLayerNetwork or "
+                "ComputationGraph models")
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown schedule {schedule!r}")
+        self.schedule = schedule
         if model.params is None:
             model.init()
-        if model.conf.backprop_type is BackpropType.TRUNCATED_BPTT:
+        from deeplearning4j_tpu.conf.multilayer import BackpropType
+
+        if getattr(model.conf, "backprop_type", None) \
+                is BackpropType.TRUNCATED_BPTT:
             raise ValueError("pipeline training does not compose with "
                              "tBPTT yet")
+        if getattr(model, "_cdtype", None) is not None:
+            raise ValueError(
+                "compute_dtype policies are not supported under pipeline "
+                "training yet (the flat stage packing keeps f32 masters)")
         self.model = model
         if mesh is None:
             devs = np.array(jax.devices())
@@ -378,212 +553,797 @@ class PipelineParallelWrapper:
         self.data_size = self.mesh.shape.get(mesh_mod.DATA_AXIS, 1)
         self.n_micro = int(n_micro)
 
-        layers = model.conf.layers
-        if len(layers) - 1 < self.n_stages:
-            raise ValueError(
-                f"{len(layers) - 1} stage-able layers < {self.n_stages} "
-                "stages")
-        from deeplearning4j_tpu.conf.layers import GradientNormalization
+        from deeplearning4j_tpu.conf.layers_moe import AUX_LOSS_KEY
 
-        for i, l in enumerate(layers[:-1]):
-            if model.state.get(str(i)):
-                raise ValueError(
-                    f"layer {i} ({type(l).__name__}) carries mutable "
-                    "state (running statistics); pipeline v1 supports "
-                    "stateless stages only")
-            if getattr(l, "dropout", 0.0):
-                raise ValueError(f"layer {i}: dropout under pipeline "
-                                 "training is not supported yet")
-            if getattr(l, "regularization", ()) \
-                    or getattr(l, "regularization_bias", ()):
-                raise ValueError(
-                    f"layer {i}: l1/l2/weight-decay regularization under "
-                    "pipeline training is not supported yet (the flat "
-                    "stage packing bypasses the per-layer solver path)")
-            if getattr(l, "updater", None) is not None:
-                raise ValueError(
-                    f"layer {i}: per-layer updater overrides are not "
-                    "supported under pipeline training (one global conf "
-                    "updater drives every stage)")
-            gn = getattr(l, "gradient_normalization", None)
-            if gn is not None and gn is not GradientNormalization.NONE:
-                raise ValueError(
-                    f"layer {i}: gradient normalization is not supported "
-                    "under pipeline training yet")
-        self.out_layer = layers[-1]
-        if not hasattr(self.out_layer, "score"):
-            raise ValueError("last layer must be a loss head (score())")
+        if self._is_graph:
+            self._init_graph_plan(AUX_LOSS_KEY)
+        else:
+            self._init_mln_plan(AUX_LOSS_KEY)
 
-        # contiguous partition of layers[0..L-2], balanced by param count
-        counts = [sum(int(np.prod(p.shape))
-                      for p in model.params.get(str(i), {}).values())
-                  for i in range(len(layers) - 1)]
+        self._pipe_built = False
+        self.score_value = float("nan")
+
+    # --- partitioning ------------------------------------------------------
+
+    def _balanced_bounds(self, counts):
+        """Contiguous partition of ``len(counts)`` units into n_stages,
+        balanced by count, no stage empty (round-4 regression)."""
         total = sum(counts) or 1
-        n_layers = len(layers) - 1
+        n = len(counts)
+        if n < self.n_stages:
+            raise ValueError(
+                f"{n} stage-able layers < {self.n_stages} stages")
         bounds, acc, nxt = [0], 0.0, 1
         for i, c in enumerate(counts):
             acc += c
             if nxt >= self.n_stages:
                 break
-            remaining_layers = n_layers - (i + 1)
-            remaining_stages = self.n_stages - nxt
-            # split at the balanced threshold — or FORCED when exactly
-            # enough layers remain to give every later stage one
-            # (otherwise trailing stages come out empty and their
-            # devices compute identity pass-throughs)
+            remaining = n - (i + 1)
+            rem_stages = self.n_stages - nxt
             if (acc >= nxt * total / self.n_stages
-                    or remaining_layers == remaining_stages) \
-                    and remaining_layers >= remaining_stages:
+                    or remaining == rem_stages) and remaining >= rem_stages:
                 bounds.append(i + 1)
                 nxt += 1
-        bounds.append(n_layers)
+        bounds.append(n)
+        return bounds
+
+    def _check_key(self, key, conf, state, aux_key):
+        if isinstance(state.get(key), dict) and aux_key in state[key]:
+            raise ValueError(
+                f"{key}: layers carrying auxiliary losses (MoE) are not "
+                "supported under pipeline training yet")
+        if getattr(conf, "mask_dependent", False):
+            raise ValueError(f"{key}: mask-consuming layers need masked "
+                             "DataSets, unsupported under pipeline")
+
+    def _init_mln_plan(self, aux_key):
+        model = self.model
+        layers = model.conf.layers
+        self.out_layer = layers[-1]
+        if not hasattr(self.out_layer, "score"):
+            raise ValueError("last layer must be a loss head (score())")
+        self._head_key = str(len(layers) - 1)
+        for i, l in enumerate(layers[:-1]):
+            self._check_key(str(i), l, model.state, aux_key)
+        counts = [sum(int(np.prod(p.shape))
+                      for p in model.params.get(str(i), {}).values())
+                  for i in range(len(layers) - 1)]
+        bounds = self._balanced_bounds(counts)
         self.stage_layers = [list(range(bounds[s], bounds[s + 1]))
                              for s in range(self.n_stages)]
-
-        def make_stage(idxs):
-            def f(p, x):
-                for i in idxs:
-                    x, _ = layers[i].forward(p.get(str(i), {}), {}, x,
-                                             train=True)
-                return x
-            return f
-
-        self.stage_fns = [make_stage(idxs) for idxs in self.stage_layers]
-        self.stage_params = [
-            {str(i): model.params[str(i)] for i in idxs
-             if str(i) in model.params}
-            for idxs in self.stage_layers]
+        self.stage_keys = [[str(i) for i in idxs]
+                           for idxs in self.stage_layers]
+        # conf object + updater per key, for the solver branches
+        self._conf_of = {str(i): layers[i] for i in range(len(layers))}
+        self._upd_of = {str(i): (getattr(layers[i], "updater", None)
+                                 or model.conf.updater)
+                        for i in range(len(layers))}
         self.updater = model.conf.updater
-        self._pipe = None
-        self._step = None
-        self._stacked = None
-        self._flat_opt = None
-        self._out_params = None
-        self._out_opt = None
-        self._built_mb_shape = None
-        self.score_value = float("nan")
 
-    def _build(self, mb_shape):
+        # crossing sets: a chain crosses exactly one activation; infer
+        # the shape chain lazily at first fit (needs the microbatch
+        # shape). Stage apply closes over layer objects.
+        self._plan_kind = "chain"
+
+    def _init_graph_plan(self, aux_key):
+        model = self.model
+        conf = model.conf
+        if len(conf.network_outputs) != 1:
+            raise ValueError("pipeline training supports single-output "
+                             "graphs (got "
+                             f"{len(conf.network_outputs)})")
+        out_spec = conf.vertex_map()[conf.network_outputs[0]]
+        if not (hasattr(out_spec.vertex, "score")
+                and getattr(out_spec.vertex, "is_output", lambda: False)()):
+            raise ValueError("output vertex is not an output layer")
+        if len(out_spec.inputs) != 1:
+            raise ValueError("pipeline training needs a single-input "
+                             "output vertex")
+        self.out_layer = out_spec.vertex
+        self._head_key = out_spec.name
+        self._head_input = out_spec.inputs[0]
+        topo = [n for n in model._topo if n != out_spec.name]
+        self._topo_index = {n: i for i, n in enumerate(model._topo)}
+        for n in topo:
+            v = model._vmap[n].vertex
+            lconf = getattr(v, "layer", None) or v
+            self._check_key(n, lconf, model.state, aux_key)
+        counts = [sum(int(np.prod(p.shape))
+                      for p in model.params.get(n, {}).values())
+                  for n in topo]
+        bounds = self._balanced_bounds(counts)
+        self.stage_keys = [topo[bounds[s]:bounds[s + 1]]
+                           for s in range(self.n_stages)]
+        self.stage_layers = self.stage_keys  # alias for introspection
+        self._conf_of = {}
+        self._upd_of = {}
+        for n in list(topo) + [out_spec.name]:
+            v = model._vmap[n].vertex
+            self._conf_of[n] = getattr(v, "layer", None) or v
+            self._upd_of[n] = model._updater_for(n)
+        self.updater = conf.updater
+        self._plan_kind = "dag"
+
+        # crossing set per boundary b = names produced before b
+        # (network inputs count as produced at -1) and consumed at/after
+        # b (the head's input is consumed at boundary S)
+        seg_of = {}
+        for s, keys in enumerate(self.stage_keys):
+            for n in keys:
+                seg_of[n] = s
+        self._crossings = []
+        vmap = model._vmap
+        for b in range(self.n_stages + 1):
+            names = []
+            for src in list(conf.network_inputs) + topo:
+                prod = -1 if src in conf.network_inputs else seg_of[src]
+                if prod >= b:
+                    continue
+                consumers = [n for n in topo
+                             if src in vmap[n].inputs and seg_of[n] >= b]
+                # the head's input rides the ring all the way to the
+                # last boundary even with no further vertex consumers
+                if consumers or src == self._head_input:
+                    names.append(src)
+            self._crossings.append(names)
+        # final boundary carries exactly the head input
+        self._crossings[-1] = [self._head_input]
+
+    # --- build (first batch: shapes known) ---------------------------------
+
+    def _infer_shapes(self, feats):
+        """Activation/crossing shapes per boundary via eval_shape."""
+        model = self.model
+        key = jax.random.PRNGKey(0)
+        if self._plan_kind == "chain":
+            layers = model.conf.layers
+            shapes = {}
+            x = jax.eval_shape(lambda a: a, feats[0])
+            self._cross_specs = []
+            for s, idxs in enumerate(self.stage_layers):
+                self._cross_specs.append([("__x__", x.shape, x.dtype)])
+                for i in idxs:
+                    x = jax.eval_shape(
+                        lambda p, st, a, _l=layers[i]: _l.forward(
+                            p, st, a, train=True, rng=key)[0],
+                        model.params.get(str(i), {}),
+                        model.state.get(str(i), {}), x)
+            self._cross_specs.append([("__x__", x.shape, x.dtype)])
+            return
+        # dag: chain eval_shape through the topo order
+        vmap = model._vmap
+        acts = {n: jax.eval_shape(lambda a: a, f)
+                for n, f in zip(model.conf.network_inputs, feats)}
+        for keys in self.stage_keys:
+            for n in keys:
+                spec = vmap[n]
+                xs = [acts[src] for src in spec.inputs]
+                acts[n] = jax.eval_shape(
+                    lambda p, st, inp, _v=spec.vertex: _v.forward(
+                        p, st, inp, train=True, rng=key)[0],
+                    model.params.get(n, {}), model.state.get(n, {}), xs)
+        self._cross_specs = [
+            [(n, acts[n].shape, acts[n].dtype) for n in names]
+            for names in self._crossings]
+
+    def _pack_cross(self, tensors, specs):
+        """{name: tensor} -> padded flat f32 [a_max]."""
+        parts = [jnp.ravel(tensors[n]).astype(jnp.float32)
+                 for n, _s, _d in specs]
+        flat = jnp.concatenate(parts) if parts \
+            else jnp.zeros((0,), jnp.float32)
+        return _pad_to(flat, self.a_max)
+
+    def _unpack_cross(self, flat, specs):
+        out, off = {}, 0
+        for n, shp, dt in specs:
+            sz = int(np.prod(shp))
+            out[n] = flat[off:off + sz].reshape(shp).astype(dt)
+            off += sz
+        return out
+
+    def _make_apply(self, s):
+        """Stage s forward over flat buffers:
+        (flat_p, flat_s, buf, rng_m) -> (out_buf, new_flat_s)."""
+        model = self.model
+        in_specs = self._cross_specs[s]
+        out_specs_ = self._cross_specs[s + 1]
+        pspec, pdt = self._p_specs[s]
+        sspec, sdt = self._s_specs[s]
+        keys = self.stage_keys[s]
+
+        if self._plan_kind == "chain":
+            layers = model.conf.layers
+
+            def apply(flat_p, flat_s, buf, rng_m):
+                p = _unflatten_cast(pspec, flat_p, pdt)
+                st = _unflatten_cast(sspec, flat_s, sdt)
+                x = self._unpack_cross(buf, in_specs)["__x__"]
+                new_st = {}
+                for i in self.stage_layers[s]:
+                    k = str(i)
+                    lrng = jax.random.fold_in(rng_m, i)
+                    x, s2 = layers[i].forward(
+                        p.get(k, {}), st.get(k, {}), x, train=True,
+                        rng=lrng)
+                    if k in st:
+                        new_st[k] = s2
+                for k in st:
+                    new_st.setdefault(k, st[k])
+                return (self._pack_cross({"__x__": x}, out_specs_),
+                        _pad_to(_flatten_f32(new_st), self.s_max))
+
+            return apply
+
+        vmap = model._vmap
+
+        def apply(flat_p, flat_s, buf, rng_m):
+            p = _unflatten_cast(pspec, flat_p, pdt)
+            st = _unflatten_cast(sspec, flat_s, sdt)
+            acts = self._unpack_cross(buf, in_specs)
+            new_st = {}
+            for n in keys:
+                spec = vmap[n]
+                xs = [acts[src] for src in spec.inputs]
+                vrng = jax.random.fold_in(rng_m, self._topo_index[n])
+                y, s2 = spec.vertex.forward(
+                    p.get(n, {}), st.get(n, {}), xs, train=True,
+                    rng=vrng)
+                acts[n] = y
+                if n in st:
+                    new_st[n] = s2
+            for n in st:
+                new_st.setdefault(n, st[n])
+            return (self._pack_cross(acts, out_specs_),
+                    _pad_to(_flatten_f32(new_st), self.s_max))
+
+        return apply
+
+    def _make_update(self, s):
+        """Per-stage solver branch: (flat_p, flat_opt, g_flat, it, ep)
+        -> (new_flat_p, new_flat_opt) through normalize + regularize +
+        per-layer updater (optimize.solver — the SAME functions the
+        plain networks' train steps call)."""
+        from deeplearning4j_tpu.optimize import solver
+
+        pspec, pdt = self._p_specs[s]
+        ospec, odt = self._o_specs[s]
+        keys = self.stage_keys[s]
+
+        def update(flat_p, flat_opt, g_flat, it, ep):
+            p = _unflatten_cast(pspec, flat_p, pdt)
+            g = _unflatten_cast(pspec, g_flat, pdt)
+            opt = _unflatten_cast(ospec, flat_opt, odt)
+            new_p, new_opt = dict(p), dict(opt)
+            for k in keys:
+                if k not in p or not p[k]:
+                    continue
+                conf = self._conf_of[k]
+                upd = self._upd_of[k]
+                lr = upd.current_lr(it, ep)
+                gk = solver.normalize_layer_gradients(conf, g[k])
+                new_p[k], new_opt[k] = solver.apply_updater_to_layer(
+                    conf, upd, p[k], gk, opt[k], lr, it, ep)
+            return (_pad_to(_flatten_f32(new_p), self.p_max),
+                    _pad_to(_flatten_f32(new_opt), self.o_max))
+
+        return update
+
+    def _make_reg(self, s):
+        """Stage-local regularization score branch (differentiated into
+        the loss, mirroring MultiLayerNetwork._loss /
+        ComputationGraph._regularization_score)."""
+        pspec, pdt = self._p_specs[s]
+        keys = self.stage_keys[s]
+
+        def reg(flat_p):
+            p = _unflatten_cast(pspec, flat_p, pdt)
+            total = jnp.zeros((), jnp.float32)
+            for k in keys:
+                conf = self._conf_of[k]
+                vert = (self.model._vmap[k].vertex if self._plan_kind
+                        == "dag" else conf)
+                reg_keys = set(vert.regularized_param_keys())
+                for pk, pv in p.get(k, {}).items():
+                    regs = (getattr(conf, "regularization", ())
+                            if pk in reg_keys
+                            else getattr(conf, "regularization_bias", ()))
+                    for r in regs or ():
+                        total = total + r.score_term(pv)
+            return total
+
+        return reg
+
+    def _head_reg(self, out_p):
+        conf = self._conf_of[self._head_key]
+        vert = (self.model._vmap[self._head_key].vertex
+                if self._plan_kind == "dag" else conf)
+        reg_keys = set(vert.regularized_param_keys())
+        total = jnp.zeros((), jnp.float32)
+        for pk, pv in out_p.items():
+            regs = (getattr(conf, "regularization", ())
+                    if pk in reg_keys
+                    else getattr(conf, "regularization_bias", ()))
+            for r in regs or ():
+                total = total + r.score_term(pv)
+        return total
+
+    def _build(self, feats):
         import jax.tree_util as jtu
 
-        self._pipe = HeteroPipeline(
-            self.stage_fns, self.stage_params,
-            jax.ShapeDtypeStruct(mb_shape,
-                                 jnp.asarray(
-                                     self.model.params["0"]["W"]).dtype
-                                 if "W" in self.model.params.get("0", {})
-                                 else jnp.float32),
-            self.mesh, self.n_micro)
-        self._stacked = self._pipe.stack_params(self.stage_params)
-        upd = self.updater
-        # updater state over the flat per-stage vector, stacked [S, ...]
-        # (elementwise updaters act identically to per-leaf application)
-        opt0 = upd.init_state(jnp.zeros((self._pipe.p_max,), jnp.float32))
-        self._flat_opt = jax.device_put(
-            jtu.tree_map(lambda z: jnp.stack([z] * self.n_stages), opt0),
-            NamedSharding(self.mesh, P(STAGE_AXIS)))
-        li = str(len(self.model.conf.layers) - 1)
-        self._out_params = mesh_mod.replicate(
-            self.mesh, dict(self.model.params.get(li, {})))
-        self._out_opt = mesh_mod.replicate(self.mesh, {
-            k: upd.init_state(v)
-            for k, v in self.model.params.get(li, {}).items()})
-        self._step = self._build_step()
+        model = self.model
+        S = self.n_stages
+        self._infer_shapes(feats)
+        self.a_max = max(
+            sum(int(np.prod(shp)) for _n, shp, _d in specs)
+            for specs in self._cross_specs)
 
-    def _build_step(self):
-        pipe = self._pipe
-        upd = self.updater
+        self.stage_params = [
+            {k: dict(model.params[k]) for k in keys if k in model.params}
+            for keys in self.stage_keys]
+        self.stage_state = [
+            {k: dict(model.state[k]) for k in keys
+             if isinstance(model.state.get(k), dict) and model.state[k]}
+            for keys in self.stage_keys]
+        upd_states = [
+            {k: {pk: self._upd_of[k].init_state(pv)
+                 for pk, pv in sp[k].items()} for k in sp}
+            for sp in self.stage_params]
+
+        self._p_specs, self._s_specs, self._o_specs = [], [], []
+        p_sizes, s_sizes, o_sizes = [], [], []
+        for sp, ss, so in zip(self.stage_params, self.stage_state,
+                              upd_states):
+            spec, dt, n = _spec_with_dtypes(sp)
+            self._p_specs.append((spec, dt))
+            p_sizes.append(n)
+            spec, dt, n = _spec_with_dtypes(ss)
+            self._s_specs.append((spec, dt))
+            s_sizes.append(n)
+            spec, dt, n = _spec_with_dtypes(so)
+            self._o_specs.append((spec, dt))
+            o_sizes.append(n)
+        self.p_max = max(max(p_sizes), 1)
+        self.s_max = max(max(s_sizes), 1)
+        self.o_max = max(max(o_sizes), 1)
+
+        sh = NamedSharding(self.mesh, P(STAGE_AXIS))
+        self._stacked = jax.device_put(jnp.stack(
+            [_pad_to(_flatten_f32(sp), self.p_max)
+             for sp in self.stage_params]), sh)
+        self._stacked_state = jax.device_put(jnp.stack(
+            [_pad_to(_flatten_f32(ss), self.s_max)
+             for ss in self.stage_state]), sh)
+        self._stacked_opt = jax.device_put(jnp.stack(
+            [_pad_to(_flatten_f32(so), self.o_max)
+             for so in upd_states]), sh)
+
+        self._out_params = mesh_mod.replicate(
+            self.mesh, dict(model.params.get(self._head_key, {})))
+        head_upd = self._upd_of[self._head_key]
+        self._out_opt = mesh_mod.replicate(self.mesh, {
+            k: head_upd.init_state(v)
+            for k, v in model.params.get(self._head_key, {}).items()})
+
+        self._applies = [self._make_apply(s) for s in range(S)]
+        self._updates = [self._make_update(s) for s in range(S)]
+        self._regs = [self._make_reg(s) for s in range(S)]
+        self._base_key = jax.random.PRNGKey(
+            getattr(model.conf, "seed", 0) or 0)
+        self._step = (self._build_step_gpipe() if self.schedule == "gpipe"
+                      else self._build_step_1f1b())
+        self._pipe_built = True
+
+    # --- schedules ---------------------------------------------------------
+
+    def _head_score_fn(self):
         out_layer = self.out_layer
+        head_specs = self._cross_specs[-1]
+
+        def score(out_p, out_buf, label):
+            x = next(iter(self._unpack_cross(out_buf, head_specs)
+                          .values()))
+            return out_layer.score(out_p, x, label, None)
+
+        return score
+
+    def _common_post(self, loss, g_flat, g_out, has_data):
+        if has_data:
+            loss = jax.lax.pmean(loss, mesh_mod.DATA_AXIS)
+            g_flat = jax.lax.pmean(g_flat, mesh_mod.DATA_AXIS)
+            g_out = jax.tree_util.tree_map(
+                lambda a: jax.lax.pmean(a, mesh_mod.DATA_AXIS), g_out)
+        return loss, g_flat, g_out
+
+    def _apply_updates(self, sid, my_flat, my_opt, g_flat, out_p,
+                       out_opt, g_out, it, ep):
+        from deeplearning4j_tpu.optimize import solver
+
+        axes = tuple(self.mesh.axis_names)
+        upd_branches = [
+            (lambda fp, fo, g, i, e, f=f: tuple(
+                _ensure_varying(o, axes) for o in f(fp, fo, g, i, e)))
+            for f in self._updates]
+        new_flat, new_opt = jax.lax.switch(
+            sid, upd_branches, my_flat, my_opt, g_flat, it, ep)
+        head_conf = self._conf_of[self._head_key]
+        head_upd = self._upd_of[self._head_key]
+        lr = head_upd.current_lr(it, ep)
+        gh = solver.normalize_layer_gradients(head_conf, g_out)
+        new_out, new_out_opt = solver.apply_updater_to_layer(
+            head_conf, head_upd, out_p, gh, out_opt, lr, it, ep)
+        return new_flat, new_opt, new_out, new_out_opt
+
+    def _build_step_gpipe(self):
+        S, M = self.n_stages, self.n_micro
         has_data = mesh_mod.DATA_AXIS in self.mesh.shape \
             and self.mesh.shape[mesh_mod.DATA_AXIS] > 1
+        head_score = self._head_score_fn()
 
-        def spmd(stacked, flat_opt, out_p, out_opt, x_micro, y_micro,
-                 it, ep):
+        def spmd(stacked, stacked_st, flat_opt, out_p, out_opt,
+                 x_micro, y_micro, it, ep):
+            sid = jax.lax.axis_index(STAGE_AXIS)
             my_flat = stacked[0]
-            my_opt = jax.tree_util.tree_map(lambda a: a[0], flat_opt)
+            my_state = stacked_st[0]
+            my_opt = flat_opt[0]
+            step_key = jax.random.fold_in(self._base_key,
+                                          it.astype(jnp.int32))
+            x_flat = jax.vmap(
+                lambda xm: self._pack_cross(
+                    {n: x for n, x in zip(
+                        [nm for nm, _s, _d in self._cross_specs[0]],
+                        xm if isinstance(xm, tuple) else (xm,))},
+                    self._cross_specs[0]))(x_micro)
+            # everything the switch branches close over must share one
+            # varying type, or the per-branch residual avals diverge and
+            # AD of lax.switch fails its typematch join
+            axes_all = tuple(self.mesh.axis_names)
+            x_flat = _ensure_varying(x_flat, axes_all)
+            step_key = _ensure_varying(step_key, axes_all)
+
+            total = S + M - 1
+            perm = [(s, (s + 1) % S) for s in range(S)]
+
+            # branches take UNIFORM inputs (flat_p, fs, x, rng_m);
+            # every t/sid-dependent value is computed OUTSIDE the
+            # switch — per-branch divergence in closed-over values makes
+            # AD's per-branch residual avals fail their typematch join.
+            # Outputs are pcast-anchored: a stage with no mutable state
+            # returns constant zeros, which would type as non-varying
+            # against its siblings' varying outputs
+            branches = [
+                (lambda fp, fs, x, r, f=f: tuple(
+                    _ensure_varying(o, axes_all) for o in f(fp, fs, x,
+                                                            r)))
+                for f in self._applies]
 
             def fwd(my_flat, out_p):
-                outs = pipe._forward_local(
-                    my_flat, pipe._flatten_micro(x_micro))
-                # mean over microbatches of the head's per-mb score
-                losses = [out_layer.score(out_p, outs[m], y_micro[m])
-                          for m in range(pipe.n_micro)]
-                loss = sum(losses) / pipe.n_micro
+                buf0 = _ensure_varying(
+                    jnp.zeros((self.a_max,), jnp.float32), axes_all)
+                st0 = _ensure_varying(my_state, axes_all)
+
+                def step(carry, t):
+                    buf, fs = carry
+                    m = jnp.clip(t - sid, 0, M - 1)
+                    active = jnp.logical_and(t >= sid, t - sid < M)
+                    x = jnp.where(sid == 0, x_flat[m], buf)
+                    rng_m = jax.random.fold_in(step_key, m)
+                    y, new_s = jax.lax.switch(sid, branches, my_flat,
+                                              fs, x, rng_m)
+                    fs2 = jnp.where(active, new_s, fs)
+                    return (jax.lax.ppermute(y, STAGE_AXIS, perm),
+                            fs2), y
+
+                (_, final_state), ys = jax.lax.scan(
+                    step, (buf0, st0), jnp.arange(total))
+                outs = ys[S - 1:]
+                outs = jax.lax.psum(
+                    jnp.where(sid == S - 1, outs, jnp.zeros_like(outs)),
+                    STAGE_AXIS)
+                losses = [head_score(out_p, outs[m], y_micro[m])
+                          for m in range(M)]
+                loss = sum(losses) / M
+                reg_branches = [
+                    (lambda fp, f=f: _ensure_varying(f(fp), axes_all))
+                    for f in self._regs]
+                loss = loss + jax.lax.psum(
+                    jax.lax.switch(sid, reg_branches, my_flat),
+                    STAGE_AXIS)
+                loss = loss + self._head_reg(out_p)
                 if has_data:
                     loss = jax.lax.pmean(loss, mesh_mod.DATA_AXIS)
-                return loss
+                return loss, final_state
 
-            loss, (g_flat, g_out) = jax.value_and_grad(
-                fwd, argnums=(0, 1))(my_flat, out_p)
-            if has_data:
-                # defensive identity under vma tracking (see
-                # ParallelWrapper._build_expert_step)
-                g_flat = jax.lax.pmean(g_flat, mesh_mod.DATA_AXIS)
-                g_out = jax.tree_util.tree_map(
-                    lambda a: jax.lax.pmean(a, mesh_mod.DATA_AXIS), g_out)
-            lr = upd.current_lr(it, ep)
-            delta, new_opt = upd.update_leaf(g_flat, my_opt, lr, it, ep,
-                                             param=my_flat)
-            new_out, new_out_opt = {}, {}
-            for k, p in out_p.items():
-                d, new_out_opt[k] = upd.update_leaf(
-                    g_out[k], out_opt[k], lr, it, ep, param=p)
-                new_out[k] = p - d
-            return ((my_flat - delta)[None],
+            (loss, final_state), (g_flat, g_out) = jax.value_and_grad(
+                fwd, argnums=(0, 1), has_aux=True)(my_flat, out_p)
+            loss, g_flat, g_out = self._common_post(loss, g_flat, g_out,
+                                                    has_data)
+            if has_data:  # running stats averaged across data replicas
+                final_state = jax.lax.pmean(final_state,
+                                            mesh_mod.DATA_AXIS)
+            new_flat, new_opt, new_out, new_out_opt = \
+                self._apply_updates(sid, my_flat, my_opt, g_flat, out_p,
+                                    out_opt, g_out, it, ep)
+            return (new_flat[None], final_state[None],
                     jax.tree_util.tree_map(lambda a: a[None], new_opt),
                     new_out, new_out_opt, loss)
 
+        return self._shard_step(spmd, has_data)
+
+    def _build_step_1f1b(self):
+        S, M = self.n_stages, self.n_micro
+        has_data = mesh_mod.DATA_AXIS in self.mesh.shape \
+            and self.mesh.shape[mesh_mod.DATA_AXIS] > 1
+        head_score = self._head_score_fn()
+        fwd_tbl, bwd_tbl, total = _one_f1b_tables(S, M)
+        fwd_tbl = jnp.asarray(fwd_tbl)
+        bwd_tbl = jnp.asarray(bwd_tbl)
+
+        def spmd(stacked, stacked_st, flat_opt, out_p, out_opt,
+                 x_micro, y_micro, it, ep):
+            sid = jax.lax.axis_index(STAGE_AXIS)
+            my_flat = stacked[0]
+            my_state = stacked_st[0]
+            my_opt = flat_opt[0]
+            step_key = jax.random.fold_in(self._base_key,
+                                          it.astype(jnp.int32))
+            x_flat = jax.vmap(
+                lambda xm: self._pack_cross(
+                    {n: x for n, x in zip(
+                        [nm for nm, _s, _d in self._cross_specs[0]],
+                        xm if isinstance(xm, tuple) else (xm,))},
+                    self._cross_specs[0]))(x_micro)
+            axes_all = tuple(self.mesh.axis_names)
+            x_flat = _ensure_varying(x_flat, axes_all)
+            step_key = _ensure_varying(step_key, axes_all)
+            y_micro = _ensure_varying(y_micro, axes_all)
+
+            perm_dn = [(s, (s + 1) % S) for s in range(S)]
+            perm_up = [(s, (s - 1) % S) for s in range(S)]
+            A = self.a_max
+            axes = tuple(self.mesh.axis_names)
+
+            def vary(x):
+                return jax.tree_util.tree_map(
+                    lambda a: _ensure_varying(a, axes), x)
+
+            def make_branch(s):
+                apply = self._applies[s]
+                f_tbl = fwd_tbl[s]
+                b_tbl = bwd_tbl[s]
+
+                def y_only(flat_p, flat_s, x, rng_m):
+                    return _ensure_varying(
+                        apply(flat_p, flat_s, x, rng_m)[0], axes)
+
+                def branch(flat_p, carry, msgs, t):
+                    (fs, stash, fring, bring, g_acc, g_out_acc,
+                     loss_acc) = carry
+                    (fmsg_y, fmsg_m, fmsg_v,
+                     bmsg_y, bmsg_m, bmsg_v) = msgs
+                    # receive (messages produced at slot t-1)
+                    if s > 0:
+                        fring = jnp.where(
+                            fmsg_v > 0,
+                            jax.lax.dynamic_update_index_in_dim(
+                                fring, fmsg_y, fmsg_m % S, 0), fring)
+                    if s < S - 1:
+                        bring = jnp.where(
+                            bmsg_v > 0,
+                            jax.lax.dynamic_update_index_in_dim(
+                                bring, bmsg_y, bmsg_m % S, 0), bring)
+
+                    mf = f_tbl[t]
+                    mb = b_tbl[t]
+
+                    # --- forward micro-op ---
+                    def do_fwd(args):
+                        fs, stash = args
+                        m = jnp.maximum(mf, 0)
+                        x = x_flat[m] if s == 0 \
+                            else fring[m % S]
+                        rng_m = jax.random.fold_in(step_key, m)
+                        y, new_s = apply(flat_p, fs, x, rng_m)
+                        y = _ensure_varying(y, axes)
+                        new_s = _ensure_varying(new_s, axes)
+                        stash = jax.lax.dynamic_update_index_in_dim(
+                            stash, x, m % S, 0)
+                        return new_s, stash, y
+
+                    def skip_fwd(args):
+                        fs, stash = args
+                        return fs, stash, jnp.zeros((A,), jnp.float32)
+
+                    fs, stash, fwd_y = jax.lax.cond(
+                        mf >= 0, do_fwd, skip_fwd, (fs, stash))
+
+                    # --- backward micro-op (vjp recompute vs stash) ---
+                    def do_bwd(args):
+                        g_acc, g_out_acc, loss_acc = args
+                        m = jnp.maximum(mb, 0)
+                        x = stash[m % S]
+                        rng_m = jax.random.fold_in(step_key, m)
+                        if s == S - 1:
+                            def head_fn(fp, xx, op):
+                                y = y_only(fp, fs, xx, rng_m)
+                                return head_score(op, y,
+                                                  y_micro[m]) / M
+                            lm, vjp = jax.vjp(head_fn, flat_p, x,
+                                              out_p)
+                            gp, gx, gop = vjp(jnp.ones((), lm.dtype))
+                            g_out_acc = jax.tree_util.tree_map(
+                                jnp.add, g_out_acc, gop)
+                            loss_acc = loss_acc + lm
+                        else:
+                            ct = bring[m % S]
+                            _, vjp = jax.vjp(
+                                lambda fp, xx: y_only(fp, fs, xx,
+                                                      rng_m),
+                                flat_p, x)
+                            gp, gx = vjp(ct)
+                        return (g_acc + gp, g_out_acc, loss_acc), gx
+
+                    def skip_bwd(args):
+                        return args, jnp.zeros((A,), jnp.float32)
+
+                    (g_acc, g_out_acc, loss_acc), bwd_gx = jax.lax.cond(
+                        mb >= 0, do_bwd, skip_bwd,
+                        (g_acc, g_out_acc, loss_acc))
+
+                    new_msgs = (fwd_y, jnp.maximum(mf, 0),
+                                (mf >= 0).astype(jnp.int32),
+                                bwd_gx, jnp.maximum(mb, 0),
+                                (mb >= 0).astype(jnp.int32))
+                    return (fs, stash, fring, bring, g_acc, g_out_acc,
+                            loss_acc), new_msgs
+
+                return branch
+
+            branches = [make_branch(s) for s in range(S)]
+
+            g_out0 = jax.tree_util.tree_map(jnp.zeros_like, out_p)
+            carry0 = (vary(my_state),
+                      vary(jnp.zeros((S, A), jnp.float32)),
+                      vary(jnp.zeros((S, A), jnp.float32)),
+                      vary(jnp.zeros((S, A), jnp.float32)),
+                      vary(jnp.zeros((self.p_max,), jnp.float32)),
+                      jax.tree_util.tree_map(vary, g_out0),
+                      vary(jnp.zeros((), jnp.float32)))
+            msgs0 = (vary(jnp.zeros((A,), jnp.float32)),
+                     vary(jnp.zeros((), jnp.int32)),
+                     vary(jnp.zeros((), jnp.int32)),
+                     vary(jnp.zeros((A,), jnp.float32)),
+                     vary(jnp.zeros((), jnp.int32)),
+                     vary(jnp.zeros((), jnp.int32)))
+
+            def step(carry, t):
+                inner, msgs = carry
+                inner, out_msgs = jax.lax.switch(
+                    sid, branches, my_flat, inner, msgs, t)
+                fy, fm, fv, by, bm, bv = out_msgs
+                sent = (jax.lax.ppermute(fy, STAGE_AXIS, perm_dn),
+                        jax.lax.ppermute(fm, STAGE_AXIS, perm_dn),
+                        jax.lax.ppermute(fv, STAGE_AXIS, perm_dn),
+                        jax.lax.ppermute(by, STAGE_AXIS, perm_up),
+                        jax.lax.ppermute(bm, STAGE_AXIS, perm_up),
+                        jax.lax.ppermute(bv, STAGE_AXIS, perm_up))
+                return (inner, sent), t
+
+            (inner, _), _ = jax.lax.scan(
+                step, (carry0, msgs0), jnp.arange(total))
+            (final_state, _stash, _fr, _br, g_flat, g_out_acc,
+             loss_acc) = inner
+
+            # loss lives on the last stage; grads are stage-local
+            loss = jax.lax.psum(
+                jnp.where(sid == S - 1, loss_acc, 0.0), STAGE_AXIS)
+            g_out = jax.lax.psum(
+                jax.tree_util.tree_map(
+                    lambda a: jnp.where(sid == S - 1, a,
+                                        jnp.zeros_like(a)),
+                    g_out_acc), STAGE_AXIS)
+            # regularization: score + analytic gradient (what AD of the
+            # gpipe fwd produces)
+            reg_branches = [
+                (lambda fp, f=f: _ensure_varying(f(fp), axes))
+                for f in self._regs]
+            reg_s, reg_g = jax.value_and_grad(
+                lambda fp: jax.lax.switch(sid, reg_branches,
+                                          fp))(my_flat)
+            loss = loss + jax.lax.psum(reg_s, STAGE_AXIS)
+            g_flat = g_flat + reg_g
+            hr, hg = jax.value_and_grad(self._head_reg)(out_p)
+            loss = loss + hr
+            g_out = jax.tree_util.tree_map(jnp.add, g_out, hg)
+            loss, g_flat, g_out = self._common_post(loss, g_flat, g_out,
+                                                    has_data)
+            if has_data:
+                final_state = jax.lax.pmean(final_state,
+                                            mesh_mod.DATA_AXIS)
+            new_flat, new_opt, new_out, new_out_opt = \
+                self._apply_updates(sid, my_flat, my_opt, g_flat, out_p,
+                                    out_opt, g_out, it, ep)
+            return (new_flat[None], final_state[None],
+                    jax.tree_util.tree_map(lambda a: a[None], new_opt),
+                    new_out, new_out_opt, loss)
+
+        return self._shard_step(spmd, has_data)
+
+    def _shard_step(self, spmd, has_data):
         SP = P(STAGE_AXIS)
         DP = P(None, mesh_mod.DATA_AXIS) if has_data else P()
+        if self._plan_kind == "dag":
+            xspec = tuple(DP for _ in self.model.conf.network_inputs)
+        else:
+            xspec = DP
         sharded = mesh_mod.shard_map(
             spmd, self.mesh,
-            in_specs=(SP, SP, P(), P(), DP, DP, P(), P()),
-            out_specs=(SP, SP, P(), P(), P()))
-        return jax.jit(sharded, donate_argnums=(0, 1, 2, 3))
+            in_specs=(SP, SP, SP, P(), P(), xspec, DP, P(), P()),
+            out_specs=(SP, SP, SP, P(), P(), P()))
+        return jax.jit(sharded, donate_argnums=(0, 1, 2, 3, 4))
+
+    # --- user API ----------------------------------------------------------
 
     def fit_batch(self, ds) -> float:
         import numpy as _np
 
         m = self.model
         if getattr(ds, "features_mask", None) is not None \
-                or getattr(ds, "labels_mask", None) is not None:
+                or getattr(ds, "labels_mask", None) is not None \
+                or any(x is not None for x in
+                       (getattr(ds, "features_masks", None) or ())) \
+                or any(x is not None for x in
+                       (getattr(ds, "labels_masks", None) or ())):
             raise ValueError(
                 "masked DataSets are not supported under pipeline "
                 "training yet (the head's score runs unmasked)")
-        feats = _np.asarray(ds.features if hasattr(ds, "features") else ds[0])
-        labels = _np.asarray(ds.labels if hasattr(ds, "labels") else ds[1])
-        rows = feats.shape[0]
+        if self._plan_kind == "dag":
+            from deeplearning4j_tpu.nn.graph import _as_multi
+
+            mds = _as_multi(ds)
+            feats = tuple(_np.asarray(f) for f in mds.features)
+            labels = _np.asarray(mds.labels[0])
+        else:
+            feats = (_np.asarray(ds.features
+                                 if hasattr(ds, "features") else ds[0]),)
+            labels = _np.asarray(ds.labels
+                                 if hasattr(ds, "labels") else ds[1])
+        rows = feats[0].shape[0]
         div = self.n_micro * self.data_size
         if rows % div:
             raise ValueError(
                 f"batch of {rows} rows must divide into n_micro x "
                 f"data_axis = {self.n_micro} x {self.data_size}")
         mb = rows // self.n_micro
-        x_micro = feats.reshape((self.n_micro, mb) + feats.shape[1:])
+        x_micro = tuple(f.reshape((self.n_micro, mb) + f.shape[1:])
+                        for f in feats)
         y_micro = labels.reshape((self.n_micro, mb) + labels.shape[1:])
-        mb_shape = (mb // self.data_size,) + feats.shape[1:]
-        if self._pipe is None:
-            self._build(mb_shape)
-            self._built_mb_shape = mb_shape
-        elif mb_shape != self._built_mb_shape:
-            # the flat ring buffer and stage branches are compiled for
-            # one microbatch shape; a silently-padded smaller batch
-            # would train on phantom zero rows
+        mb_shapes = tuple((mb // self.data_size,) + f.shape[1:]
+                          for f in feats)
+        if not self._pipe_built:
+            micro_feats = tuple(
+                jax.ShapeDtypeStruct(s, jnp.asarray(f[:1]).dtype)
+                for s, f in zip(mb_shapes, feats))
+            self._build(micro_feats)
+            self._built_mb_shapes = mb_shapes
+        elif mb_shapes != self._built_mb_shapes:
             raise ValueError(
                 f"pipeline compiled for microbatch shape "
-                f"{self._built_mb_shape}, got {mb_shape}; feed equal-"
+                f"{self._built_mb_shapes}, got {mb_shapes}; feed equal-"
                 "size batches (pad the trailing batch)")
-        (self._stacked, self._flat_opt, self._out_params, self._out_opt,
-         loss) = self._step(self._stacked, self._flat_opt,
-                            self._out_params, self._out_opt,
-                            jnp.asarray(x_micro), jnp.asarray(y_micro),
-                            _np.float32(m.iteration), _np.float32(m.epoch))
+        x_in = (tuple(jnp.asarray(x) for x in x_micro)
+                if self._plan_kind == "dag" else jnp.asarray(x_micro[0]))
+        (self._stacked, self._stacked_state, self._stacked_opt,
+         self._out_params, self._out_opt, loss) = self._step(
+            self._stacked, self._stacked_state, self._stacked_opt,
+            self._out_params, self._out_opt, x_in, jnp.asarray(y_micro),
+            _np.float32(m.iteration), _np.float32(m.epoch))
         m.iteration += 1
         self.score_value = float(loss)
         return self.score_value
 
     def fit(self, data, epochs: int = 1):
-        if not hasattr(data, "reset"):  # bare DataSet -> one-item iterator
+        if not hasattr(data, "reset"):
             from deeplearning4j_tpu.datasets.iterators import (
                 ListDataSetIterator,
             )
@@ -598,15 +1358,24 @@ class PipelineParallelWrapper:
         return self.model
 
     def write_back(self):
-        """Publish trained stage params back onto the wrapped model."""
-        if self._pipe is None:
+        """Publish trained stage params + mutable state back onto the
+        wrapped model."""
+        if not self._pipe_built:
             return
-        per_stage = self._pipe.unstack_params(np.asarray(self._stacked))
-        for sp in per_stage:
-            for k, v in sp.items():
-                self.model.params[k] = jax.tree_util.tree_map(jnp.asarray,
-                                                              v)
-        li = str(len(self.model.conf.layers) - 1)
-        if li in self.model.params:
-            self.model.params[li] = jax.tree_util.tree_map(
+        stacked = np.asarray(self._stacked)
+        stacked_st = np.asarray(self._stacked_state)
+        for s in range(self.n_stages):
+            (pspec, pdt) = self._p_specs[s]
+            tree = _unflatten_cast(pspec, jnp.asarray(stacked[s]), pdt)
+            for k, v in tree.items():
+                self.model.params[k] = jax.tree_util.tree_map(
+                    jnp.asarray, v)
+            (sspec, sdt) = self._s_specs[s]
+            stree = _unflatten_cast(sspec, jnp.asarray(stacked_st[s]),
+                                    sdt)
+            for k, v in stree.items():
+                self.model.state[k] = jax.tree_util.tree_map(
+                    jnp.asarray, v)
+        if self._head_key in self.model.params:
+            self.model.params[self._head_key] = jax.tree_util.tree_map(
                 jnp.asarray, jax.device_get(self._out_params))

@@ -1,0 +1,498 @@
+"""Pipeline-parallel training v2 (round 5): real networks under
+``PipelineParallelWrapper`` — BatchNormalization running statistics,
+dropout, L1/L2/weight-decay, per-layer updaters, ComputationGraph
+partitioning, and the 1F1B schedule.
+
+The oracle everywhere is the SAME math with the pipeline dimension
+collapsed: a serial MICROBATCHED train step (forward per microbatch with
+state threaded in micro order, mean of per-micro head scores + the
+regularization score, the per-layer solver chain) — this is what the
+pipeline computes by construction; plain full-batch ``fit_batch`` is NOT
+the oracle once BN statistics or dropout masks depend on the microbatch
+split. The rng fold chain is pinned:
+``fold_in(fold_in(fold_in(PRNGKey(seed), it), m), layer_index)``.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.conf import Activation, InputType, WeightInit
+from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.conf.layers_cnn import (
+    BatchNormalization,
+    ConvolutionLayer,
+    ConvolutionMode,
+)
+from deeplearning4j_tpu.conf.losses import LossMCXENT
+from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+from deeplearning4j_tpu.conf.regularization import (
+    L1Regularization,
+    L2Regularization,
+    WeightDecay,
+)
+from deeplearning4j_tpu.conf.updaters import Adam, Nesterovs, Sgd
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.pipeline import (
+    STAGE_AXIS,
+    PipelineParallelWrapper,
+)
+
+
+def _stage_mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), (STAGE_AXIS,))
+
+
+def _copy_params(net):
+    return jax.tree_util.tree_map(lambda a: np.asarray(a).copy(),
+                                  dict(net.params))
+
+
+def _mln_oracle_step(net, x, y, n_micro, it=0, ep=0):
+    """Serial microbatched oracle: threads state per micro, folds rng
+    exactly as the pipeline does, differentiates loss + reg score, then
+    runs the per-layer solver chain. Returns (new_params, new_state,
+    loss)."""
+    from deeplearning4j_tpu.optimize import solver
+
+    layers = net.conf.layers
+    last = len(layers) - 1
+    params = jax.tree_util.tree_map(jnp.asarray, dict(net.params))
+    state0 = jax.tree_util.tree_map(jnp.asarray, dict(net.state))
+    base = jax.random.PRNGKey(net.conf.seed)
+    step_key = jax.random.fold_in(base, it)
+    M = n_micro
+    x_micro = x.reshape((M, -1) + x.shape[1:])
+    y_micro = y.reshape((M, -1) + y.shape[1:])
+
+    def loss_fn(p):
+        cur = {k: dict(v) for k, v in state0.items()}
+        total = 0.0
+        for m in range(M):
+            rng_m = jax.random.fold_in(step_key, m)
+            xa = jnp.asarray(x_micro[m])
+            for i in range(last):
+                lrng = jax.random.fold_in(rng_m, i)
+                xa, s2 = layers[i].forward(
+                    p.get(str(i), {}), cur.get(str(i), {}), xa,
+                    train=True, rng=lrng)
+                if str(i) in cur:
+                    cur[str(i)] = s2
+            total = total + layers[last].score(
+                p.get(str(last), {}), xa, jnp.asarray(y_micro[m]), None)
+        loss = total / M
+        loss = loss + solver.regularization_score(layers, p)
+        return loss, cur
+
+    (loss, new_state), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(params)
+    new_params = {}
+    for k in params:
+        layer = layers[int(k)]
+        upd = getattr(layer, "updater", None) or net.conf.updater
+        lr = upd.current_lr(np.float32(it), np.float32(ep))
+        opt = {pk: upd.init_state(pv) for pk, pv in params[k].items()}
+        g = solver.normalize_layer_gradients(layer, grads[k])
+        new_params[k], _ = solver.apply_updater_to_layer(
+            layer, upd, params[k], g, opt, lr, np.float32(it),
+            np.float32(ep))
+    return new_params, new_state, float(loss)
+
+
+def _assert_tree_close(actual, expected, rtol=1e-4, atol=1e-5, msg=""):
+    for k in expected:
+        for pk in expected[k]:
+            np.testing.assert_allclose(
+                np.asarray(actual[k][pk]), np.asarray(expected[k][pk]),
+                rtol=rtol, atol=atol, err_msg=f"{msg}{k}/{pk}")
+
+
+def _bn_dropout_conv_net(seed=7, updater=None):
+    """The verdict's target: a conv net with BN running stats AND
+    dropout — v1 refused both."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(updater or Sgd(learning_rate=0.05))
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                    stride=(1, 1),
+                                    convolution_mode=ConvolutionMode
+                                    .SAME,
+                                    activation=Activation.IDENTITY))
+            .layer(BatchNormalization())
+            .layer(DenseLayer(n_out=16, activation=Activation.TANH,
+                              dropout=0.5))
+            .layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.convolutional(8, 8, 3))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batch(rng, n=12, h=8, w=8, c=3, classes=3):
+    x = rng.normal(size=(n, h, w, c)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n)]
+    return x, y
+
+
+def test_bn_dropout_net_matches_microbatched_oracle():
+    """Round-4 verdict item #2's done criterion: a BN+dropout conv net
+    trains under PipelineParallelWrapper matching the serial oracle
+    elementwise — params AND running statistics."""
+    rng = np.random.default_rng(0)
+    x, y = _batch(rng)
+
+    ref = _bn_dropout_conv_net()
+    exp_params, exp_state, exp_loss = _mln_oracle_step(ref, x, y,
+                                                       n_micro=3)
+
+    net = _bn_dropout_conv_net()
+    pw = PipelineParallelWrapper(net, n_micro=3, mesh=_stage_mesh(3))
+    loss = pw.fit_batch(DataSet(x, y))
+    pw.write_back()
+    np.testing.assert_allclose(loss, exp_loss, rtol=1e-5)
+    _assert_tree_close(net.params, exp_params)
+    _assert_tree_close(net.state, exp_state, msg="state:")
+
+
+def test_bn_state_updates_in_micro_order_across_steps():
+    """Running statistics must advance per microbatch per step (decay
+    applied M times per batch), matching the oracle over several
+    steps."""
+    rng = np.random.default_rng(3)
+    x, y = _batch(rng)
+
+    ref = _bn_dropout_conv_net(seed=11)
+    net = _bn_dropout_conv_net(seed=11)
+    pw = PipelineParallelWrapper(net, n_micro=2, mesh=_stage_mesh(2),
+                                 n_stages=2)
+    for it in range(3):
+        exp_params, exp_state, _ = _mln_oracle_step(ref, x, y,
+                                                    n_micro=2, it=it)
+        ref.params = jax.tree_util.tree_map(jnp.asarray, exp_params)
+        ref.state = jax.tree_util.tree_map(jnp.asarray, exp_state)
+        pw.fit_batch(DataSet(x, y))
+    pw.write_back()
+    _assert_tree_close(net.state, ref.state, msg="state:")
+    # NOTE: multi-step parameter equality needs opt-state threading in
+    # the oracle; Sgd is stateless so params must match too
+    _assert_tree_close(net.params, ref.params)
+
+
+def _reg_mixed_updater_net(seed=13):
+    """L1+L2 on one layer, WeightDecay on another, a per-layer updater
+    override, and CLIP gradient normalization — the whole solver
+    path."""
+    from deeplearning4j_tpu.conf.layers import GradientNormalization
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Adam(learning_rate=0.01))
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(DenseLayer(n_out=14, activation=Activation.TANH,
+                              regularization=(
+                                  L2Regularization(l2=1e-2),
+                                  L1Regularization(l1=1e-3))))
+            .layer(DenseLayer(n_out=10, activation=Activation.TANH,
+                              regularization=(WeightDecay(coeff=1e-2),),
+                              updater=Nesterovs(learning_rate=0.05,
+                                                momentum=0.9)))
+            .layer(DenseLayer(
+                n_out=12, activation=Activation.TANH,
+                gradient_normalization=GradientNormalization
+                .CLIP_L2_PER_LAYER,
+                gradient_normalization_threshold=0.5))
+            .layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT(),
+                               regularization=(
+                                   L2Regularization(l2=1e-2),)))
+            .set_input_type(InputType.feed_forward(16))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_regularization_and_per_layer_updaters_match_oracle():
+    """v1 refused l1/l2/weight-decay, per-layer updaters and gradient
+    normalization; v2 routes the flat stage packing through the real
+    solver path — pinned against the oracle elementwise (and against
+    plain fit_batch, which is equivalent here: no BN/dropout)."""
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(8, 16)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+
+    ref = _reg_mixed_updater_net()
+    exp_params, _, exp_loss = _mln_oracle_step(ref, x, y, n_micro=2)
+    plain = _reg_mixed_updater_net()
+    plain_loss = plain.fit_batch(DataSet(x, y))
+
+    net = _reg_mixed_updater_net()
+    pw = PipelineParallelWrapper(net, n_micro=2, mesh=_stage_mesh(3))
+    loss = pw.fit_batch(DataSet(x, y))
+    pw.write_back()
+    np.testing.assert_allclose(loss, exp_loss, rtol=1e-5)
+    np.testing.assert_allclose(loss, plain_loss, rtol=1e-5)
+    _assert_tree_close(net.params, exp_params)
+    _assert_tree_close(net.params, dict(plain.params), rtol=1e-4,
+                       atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# ComputationGraph under the wrapper
+# --------------------------------------------------------------------------
+
+
+def _transformer(seed=21, n_layers=2):
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.zoo.graphs import TransformerEncoder
+
+    model = TransformerEncoder(
+        num_classes=3, embed_dim=16, n_heads=2, n_layers=n_layers,
+        max_len=12, seed=seed, updater=Sgd(learning_rate=0.05))
+    return ComputationGraph(model.conf()).init()
+
+
+def _cg_oracle_step(net, feats, labels, n_micro, it=0, ep=0):
+    """Microbatched serial oracle for a single-output CG, mirroring the
+    wrapper's vertex-topo rng fold."""
+    from deeplearning4j_tpu.optimize import solver
+
+    conf = net.conf
+    vmap = net._vmap
+    topo = net._topo
+    out_name = conf.network_outputs[0]
+    out_spec = vmap[out_name]
+    params = jax.tree_util.tree_map(jnp.asarray, dict(net.params))
+    state0 = jax.tree_util.tree_map(jnp.asarray, dict(net.state))
+    base = jax.random.PRNGKey(conf.seed)
+    step_key = jax.random.fold_in(base, it)
+    M = n_micro
+    f_micro = [f.reshape((M, -1) + f.shape[1:]) for f in feats]
+    y_micro = labels.reshape((M, -1) + labels.shape[1:])
+    topo_index = {n: i for i, n in enumerate(topo)}
+
+    def loss_fn(p):
+        cur = {k: dict(v) for k, v in state0.items()}
+        total = 0.0
+        for m in range(M):
+            rng_m = jax.random.fold_in(step_key, m)
+            acts = {n: jnp.asarray(f[m])
+                    for n, f in zip(conf.network_inputs, f_micro)}
+            for n in topo:
+                if n == out_name:
+                    continue
+                spec = vmap[n]
+                xs = [acts[src] for src in spec.inputs]
+                vrng = jax.random.fold_in(rng_m, topo_index[n])
+                yv, s2 = spec.vertex.forward(
+                    p.get(n, {}), cur.get(n, {}), xs, train=True,
+                    rng=vrng)
+                acts[n] = yv
+                if n in cur:
+                    cur[n] = s2
+            total = total + out_spec.vertex.score(
+                p.get(out_name, {}), acts[out_spec.inputs[0]],
+                jnp.asarray(y_micro[m]), None)
+        loss = total / M + net._regularization_score(p)
+        return loss, cur
+
+    (loss, new_state), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(params)
+    new_params = {}
+    for k in params:
+        v = vmap[k].vertex
+        layer_conf = getattr(v, "layer", None) or v
+        upd = net._updater_for(k)
+        lr = upd.current_lr(np.float32(it), np.float32(ep))
+        opt = {pk: upd.init_state(pv) for pk, pv in params[k].items()}
+        g = solver.normalize_layer_gradients(layer_conf, grads[k])
+        new_params[k], _ = solver.apply_updater_to_layer(
+            layer_conf, upd, params[k], g, opt, lr, np.float32(it),
+            np.float32(ep))
+    return new_params, new_state, float(loss)
+
+
+def test_transformer_graph_matches_microbatched_oracle():
+    """The verdict's second done criterion: the zoo TransformerEncoder
+    (a ComputationGraph — LN/MHA/FFN blocks with residual skips, i.e.
+    real crossing sets) trains under PipelineParallelWrapper matching
+    the serial oracle elementwise."""
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=(8, 12, 16)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+
+    ref = _transformer()
+    exp_params, _, exp_loss = _cg_oracle_step(ref, [x], y, n_micro=2)
+
+    net = _transformer()
+    pw = PipelineParallelWrapper(net, n_micro=2, mesh=_stage_mesh(4))
+    loss = pw.fit_batch(DataSet(x, y))
+    pw.write_back()
+    np.testing.assert_allclose(loss, exp_loss, rtol=1e-5)
+    _assert_tree_close(net.params, exp_params, rtol=2e-4, atol=2e-5)
+
+
+def test_transformer_graph_trains_multi_step():
+    net = _transformer(seed=31)
+    pw = PipelineParallelWrapper(net, n_micro=2, mesh=_stage_mesh(4))
+    rng = np.random.default_rng(31)
+    x = rng.normal(size=(8, 12, 16)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    first = pw.fit_batch(DataSet(x, y))
+    for _ in range(15):
+        loss = pw.fit_batch(DataSet(x, y))
+    assert np.isfinite(loss) and loss < first
+
+
+def test_graph_refusals():
+    """CG-specific v2 refusals: multi-output graphs, MoE aux layers."""
+    from deeplearning4j_tpu.conf.layers_moe import MoELayer
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    g = (NeuralNetConfiguration.builder()
+         .seed(1).updater(Sgd(learning_rate=0.1))
+         .weight_init(WeightInit.XAVIER)
+         .graph_builder()
+         .add_inputs("in")
+         .set_input_types(InputType.recurrent(8, timesteps=6)))
+    g.add_layer("moe", MoELayer(n_experts=2, d_hidden=16), "in")
+    from deeplearning4j_tpu.conf.layers_rnn import RnnOutputLayer
+
+    g.add_layer("out", RnnOutputLayer(n_out=3,
+                                      activation=Activation.SOFTMAX,
+                                      loss_fn=LossMCXENT()), "moe")
+    g.set_outputs("out")
+    net = ComputationGraph(g.build()).init()
+    with pytest.raises(ValueError, match="auxiliary losses"):
+        PipelineParallelWrapper(net, n_micro=2, mesh=_stage_mesh(2))
+
+
+# --------------------------------------------------------------------------
+# 1F1B schedule
+# --------------------------------------------------------------------------
+
+
+def test_1f1b_tables_invariants():
+    from deeplearning4j_tpu.parallel.pipeline import _one_f1b_tables
+
+    for S, M in ((2, 4), (3, 5), (4, 8), (4, 3), (1, 4), (5, 16)):
+        fwd, bwd, total = _one_f1b_tables(S, M)
+        # every micro forwarded and backwarded exactly once per stage
+        for s in range(S):
+            assert sorted(m for m in fwd[s] if m >= 0) == list(range(M))
+            assert sorted(m for m in bwd[s] if m >= 0) == list(range(M))
+        # dependencies: fwd consumes upstream fwd from an EARLIER slot,
+        # bwd consumes downstream bwd from an earlier slot (head: own
+        # fwd same slot allowed), bwd after own fwd
+        slot_f = {(s, m): t for s in range(S)
+                  for t, m in enumerate(fwd[s]) if m >= 0}
+        slot_b = {(s, m): t for s in range(S)
+                  for t, m in enumerate(bwd[s]) if m >= 0}
+        for s in range(S):
+            for m in range(M):
+                if s > 0:
+                    assert slot_f[(s - 1, m)] < slot_f[(s, m)]
+                if s < S - 1:
+                    assert slot_b[(s + 1, m)] < slot_b[(s, m)]
+                assert slot_f[(s, m)] <= slot_b[(s, m)]
+        # the MEMORY claim: in-flight (forwarded, not yet backwarded)
+        # micros at stage s never exceed S - s
+        for s in range(S):
+            for t in range(total):
+                inflight = sum(
+                    1 for m in range(M)
+                    if slot_f[(s, m)] <= t < slot_b[(s, m)])
+                assert inflight <= S - s, (S, M, s, t, inflight)
+        # and the schedule is never longer than GPipe's fwd+bwd sweep
+        assert total <= 2 * (S + M - 1), (S, M, total)
+
+
+@pytest.mark.parametrize("build,mkbatch,micros", [
+    (_bn_dropout_conv_net,
+     lambda rng: _batch(rng), 3),
+    (_reg_mixed_updater_net,
+     lambda rng: (rng.normal(size=(8, 16)).astype(np.float32),
+                  np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]),
+     4),
+])
+def test_1f1b_matches_gpipe(build, mkbatch, micros):
+    """Gradient equality between schedules: one step under
+    schedule='1f1b' == the same step under 'gpipe', elementwise (both
+    run the identical per-micro math; only accumulation order and
+    activation liveness differ)."""
+    rng = np.random.default_rng(17)
+    x, y = mkbatch(rng)
+
+    nets = {}
+    for sched in ("gpipe", "1f1b"):
+        net = build()
+        pw = PipelineParallelWrapper(net, n_micro=micros,
+                                     mesh=_stage_mesh(3), n_stages=3,
+                                     schedule=sched)
+        loss = pw.fit_batch(DataSet(x, y))
+        pw.write_back()
+        nets[sched] = (net, loss)
+    np.testing.assert_allclose(nets["1f1b"][1], nets["gpipe"][1],
+                               rtol=1e-5)
+    _assert_tree_close(dict(nets["1f1b"][0].params),
+                       dict(nets["gpipe"][0].params))
+    _assert_tree_close(dict(nets["1f1b"][0].state),
+                       dict(nets["gpipe"][0].state), msg="state:")
+
+
+def test_1f1b_transformer_graph_matches_gpipe():
+    rng = np.random.default_rng(23)
+    x = rng.normal(size=(12, 12, 16)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 12)]
+    nets = {}
+    for sched in ("gpipe", "1f1b"):
+        net = _transformer(seed=41)
+        pw = PipelineParallelWrapper(net, n_micro=3,
+                                     mesh=_stage_mesh(4),
+                                     schedule=sched)
+        loss = pw.fit_batch(DataSet(x, y))
+        pw.write_back()
+        nets[sched] = (net, loss)
+    np.testing.assert_allclose(nets["1f1b"][1], nets["gpipe"][1],
+                               rtol=1e-5)
+    _assert_tree_close(dict(nets["1f1b"][0].params),
+                       dict(nets["gpipe"][0].params), rtol=2e-4,
+                       atol=2e-5)
+
+
+def test_1f1b_activation_liveness_bounded():
+    """The schedule's point: 1F1B's live activation memory is O(S)
+    stage-inputs (stash + rings), while GPipe's AD saves residuals for
+    every scan step — so growing M must grow GPipe's temp memory
+    linearly while 1F1B's stays ~flat (rings/stash are [S, a_max]
+    regardless of M)."""
+    def temp_bytes(schedule, micros):
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(4 * micros, 16)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4 * micros)]
+        net = _reg_mixed_updater_net()
+        pw = PipelineParallelWrapper(net, n_micro=micros,
+                                     mesh=_stage_mesh(4),
+                                     schedule=schedule)
+        pw.fit_batch(DataSet(x, y))
+        lowered = pw._step.lower(
+            pw._stacked, pw._stacked_state, pw._stacked_opt,
+            pw._out_params, pw._out_opt,
+            jnp.asarray(x.reshape((micros, 4, 16))),
+            jnp.asarray(y.reshape((micros, 4, 3))),
+            np.float32(1), np.float32(0))
+        mem = lowered.compile().memory_analysis()
+        if mem is None:
+            pytest.skip("memory_analysis unavailable on this backend")
+        return mem.temp_size_in_bytes
+
+    g_small, g_big = temp_bytes("gpipe", 4), temp_bytes("gpipe", 16)
+    f_small, f_big = temp_bytes("1f1b", 4), temp_bytes("1f1b", 16)
+    # gpipe residuals grow with M; 1f1b bounded by the S-slot rings
+    assert g_big > 1.5 * g_small, (g_small, g_big)
+    assert f_big < 1.25 * f_small + 4096 * 16 * 4, (f_small, f_big)
+    assert f_big < g_big, (f_big, g_big)
