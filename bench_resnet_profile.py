@@ -68,6 +68,9 @@ def main():
                     help="exact space-to-depth stem rewrite (MLPerf trick)")
     ap.add_argument("--quick", action="store_true",
                     help="only train_step / fwd / fwd+bwd (skip prefixes)")
+    ap.add_argument("--phases", action="store_true",
+                    help="per-phase step breakdown (ingest / compute / "
+                         "sync overlap) instead of the prefix sweep")
     args = ap.parse_args()
     batch = args.batch
 
@@ -120,6 +123,79 @@ def main():
     rows["train_step"] = ((time.perf_counter() - t0) * 1000.0
                           - N * _RT_MS[0]) / N
     params, state = net.params, net.state  # post-donation trees
+
+    # ---- per-phase breakdown: ingest / compute / sync-after-overlap ----
+    # (round 6 — the denominator for the "<3% of step time in gradient
+    # sync + ingest" criterion; on one chip gradient sync is 0 and the
+    # ingest share is whatever the double-buffered ring fails to hide)
+    if args.phases:
+        from deeplearning4j_tpu.datasets.dataset import DataSet as _DS
+        from deeplearning4j_tpu.datasets.iterators import (
+            ListDataSetIterator,
+        )
+        from deeplearning4j_tpu.datasets.prefetch import DeviceRingIterator
+
+        rng2 = np.random.default_rng(7)
+        n_stream = 6
+        fresh = [
+            _DS(rng2.integers(0, 256, (batch, IMG, IMG, 3),
+                              dtype=np.uint8),
+                np.eye(CLASSES, dtype=np.float32)[
+                    rng2.integers(0, CLASSES, batch)])
+            for _ in range(n_stream)]
+
+        # raw host->device transfer cost of one uint8 batch (fresh buffer
+        # per rep so no caching), value-synced
+        ing = []
+        for ds_f in fresh[:3]:
+            t0 = time.perf_counter()
+            dev = jax.device_put(np.asarray(ds_f.features))
+            _sync(dev[0, 0, 0, :1])
+            ing.append((time.perf_counter() - t0) * 1000.0 - _RT_MS[0])
+        rows["ingest_h2d"] = min(ing)
+
+        def stream_ms(iterator):
+            t0 = time.perf_counter()
+            net.fit(iterator, epochs=1)
+            _ = net.score_value  # sync
+            return ((time.perf_counter() - t0) * 1000.0) / n_stream
+
+        # compute baseline through the SAME fit loop, batches already
+        # device-resident (write_back migrated them on a priming epoch) —
+        # so the streaming/ring deltas isolate INGEST, not fit-loop host
+        # overhead vs a bare-jit dispatch
+        cached = ListDataSetIterator(fresh)
+        net.fit(cached, epochs=1)  # priming epoch: migrate + settle
+        rows["step_cached_fit"] = stream_ms(cached)
+        # sequential streaming: transfer serialized with the step
+        rows["step_streaming"] = stream_ms(ListDataSetIterator([
+            _DS(np.array(d.features), np.array(d.labels))
+            for d in fresh]))
+        # double-buffered ring: batch N+1's device_put overlaps step N
+        rows["step_ring"] = stream_ms(DeviceRingIterator(
+            ListDataSetIterator([
+                _DS(np.array(d.features), np.array(d.labels))
+                for d in fresh]), depth=2, donate=True))
+
+        comp = rows["step_cached_fit"]
+        ring = rows["step_ring"]
+        rows["ingest_after_overlap"] = max(0.0, ring - comp)
+        rows["grad_sync"] = 0.0  # single chip: no DP collective
+        denom = max(ring, comp)
+        rows["sync_plus_ingest_pct_of_step"] = round(
+            100.0 * (rows["grad_sync"] + rows["ingest_after_overlap"])
+            / denom, 2)
+
+    if args.phases:
+        if args.json:
+            print(json.dumps({k: round(v, 2) for k, v in rows.items()}))
+            return
+        print(f"\nResNet-50 batch {batch} per-PHASE breakdown (ms)\n")
+        for k, v in rows.items():
+            print(f"{k:>28} {v:>9.2f}")
+        print("\nstep share of (grad sync + unhidden ingest): "
+              f"{rows['sync_plus_ingest_pct_of_step']:.2f}%")
+        return
 
     # ---- forward-only loss + value_and_grad ----
     def loss_fn(p, feats):

@@ -1,15 +1,28 @@
-"""Async prefetching iterator.
+"""Async prefetching iterators.
 
 Reference: ``org.nd4j.linalg.dataset.api.iterator.AsyncDataSetIterator`` —
 a background thread pulls from the wrapped iterator into a bounded queue so
 ETL overlaps training (the reference wraps every ``fit`` iterator in one,
-SURVEY.md §3.1). TPU version: the worker can additionally ``device_put``
-batches so the host→HBM transfer also overlaps the running step
-(double-buffering); the training loop then consumes device-resident arrays.
+SURVEY.md §3.1).
+
+TPU additions:
+
+- ``AsyncDataSetIterator(device_put=True)``: the worker thread also
+  ``device_put``s batches, so the host->HBM transfer happens off the
+  training thread.
+- ``DeviceRingIterator`` (round 6): a DEPTH-deep device ring on the
+  consumer thread — batch N+1's (async) ``device_put`` is issued before
+  batch N is handed to the training loop, so the transfer overlaps the
+  running step without any thread handoff, and the buffers of batches the
+  consumer has moved past are donated back (deleted) so the ring holds at
+  most ``depth + 1`` batches of HBM regardless of epoch length. Compose
+  them for ETL + transfer overlap:
+  ``DeviceRingIterator(AsyncDataSetIterator(it))``.
 """
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 from typing import Optional
@@ -114,3 +127,99 @@ class AsyncDataSetIterator(DataSetIterator):
             self._shutdown()
         except Exception:
             pass
+
+
+class DeviceRingIterator(DataSetIterator):
+    """Double-buffered device ingest (default ``depth=2``).
+
+    ``jax.device_put`` is asynchronous: issuing batch N+1's transfer
+    BEFORE handing batch N to the training loop lets the host->device copy
+    ride under the running step instead of serializing after it. The ring
+    keeps ``depth`` staged batches in flight; when the consumer comes back
+    for the next batch it has necessarily dispatched compute on the
+    previous one, so the batch BEFORE that is consumed — its device
+    buffers are donated back (``jax.Array.delete``; in-flight executions
+    hold their own buffer references, so early deletion only releases the
+    Python-side handle's claim on HBM). Donation applies ONLY to arrays
+    this iterator staged itself — already-device-resident inputs (e.g. an
+    ``AsyncDataSetIterator(device_put=True)`` upstream, or write-back-
+    migrated DataSets) pass through untouched, so reuse across epochs
+    stays safe.
+
+    Non-``DataSet`` items (MultiDataSet) pass through unstaged."""
+
+    def __init__(self, wrapped: DataSetIterator, depth: int = 2,
+                 donate: bool = True, device=None):
+        self.wrapped = wrapped
+        self.depth = max(1, int(depth))
+        self.donate = bool(donate)
+        self.device = device
+        self.staged_count = 0
+        self.retired_count = 0
+
+    def batch_size(self):
+        return self.wrapped.batch_size()
+
+    def total_examples(self):
+        return self.wrapped.total_examples()
+
+    def _stage(self, ds):
+        """-> (device DataSet, owned device arrays). Issues the async
+        transfers; owned = only the arrays staged here (donation-safe)."""
+        import jax
+
+        if not isinstance(ds, DataSet):
+            return ds, []
+        owned = []
+        put = (lambda a: jax.device_put(a, self.device)) if self.device \
+            else jax.device_put
+
+        def stage(a):
+            if a is None or isinstance(a, jax.Array):
+                return a
+            d = put(np.asarray(a))
+            owned.append(d)
+            return d
+
+        staged = DataSet(stage(ds.features), stage(ds.labels),
+                         stage(ds.features_mask), stage(ds.labels_mask))
+        self.staged_count += 1
+        return staged, owned
+
+    def _retire(self, owned):
+        if not self.donate:
+            return
+        for a in owned:
+            try:
+                a.delete()
+            except Exception:
+                pass  # backend without explicit delete / already freed
+        if owned:
+            self.retired_count += 1
+
+    def __iter__(self):
+        ring = collections.deque()
+        last_owned = None
+        for ds in self.wrapped:
+            ring.append(self._stage(ds))
+            if len(ring) < self.depth:
+                continue
+            out, owned = ring.popleft()
+            yield out
+            # the consumer is back for the next batch: it has dispatched
+            # compute on ``out``; the batch it held BEFORE ``out`` is
+            # consumed — donate its buffers
+            if last_owned is not None:
+                self._retire(last_owned)
+            last_owned = owned
+        while ring:
+            out, owned = ring.popleft()
+            yield out
+            if last_owned is not None:
+                self._retire(last_owned)
+            last_owned = owned
+        # the final batch's buffers stay referenced until the generator
+        # is collected: the epoch-end sync may still be reading them
+
+    def reset(self):
+        self.wrapped.reset()

@@ -26,7 +26,7 @@ from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.datasets.iterators import DataSetIterator
 from deeplearning4j_tpu.eval.evaluation import Evaluation
 from deeplearning4j_tpu.nn import io as nn_io
-from deeplearning4j_tpu.optimize import solver
+from deeplearning4j_tpu.optimize import aot_cache, solver
 from deeplearning4j_tpu.optimize.listeners import TrainingListener
 from deeplearning4j_tpu.util import params as params_util
 
@@ -117,6 +117,15 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         layer = getattr(v, "layer", None)
         return (getattr(layer, "updater", None) if layer is not None else None) \
             or self.conf.updater
+
+    def _graph_key(self) -> str:
+        """AOT-cache graph signature (optimize.aot_cache): content-keyed
+        on the conf when its repr is deterministic, so clones and fresh
+        instances of the same graph reuse compiled step executables."""
+        if getattr(self, "_graph_key_cache", None) is None:
+            self._graph_key_cache = "cg:" + aot_cache.graph_signature(
+                self.conf, fallback=self)
+        return self._graph_key_cache
 
     # --- functional core ---------------------------------------------------
     def _forward(self, params, state, inputs: Sequence, train: bool, rng,
@@ -443,7 +452,9 @@ class ComputationGraph(nn_io.LazyScoreMixin):
                     lmasks, it, ep, rng)
                 return new_p, new_s, new_o, loss, itc + 1
 
-            self._train_step = jax.jit(step, donate_argnums=(0, 1, 2, 7))
+            self._train_step = aot_cache.wrap(
+                jax.jit(step, donate_argnums=(0, 1, 2, 7)),
+                self._graph_key(), "train_step:d012+itc")
         features, labels, fmasks, lmasks = self._prep_batch(
             ds, lazy_lmasks=True, write_back=True)
         (self.params, self.state, self.opt_state, loss,
@@ -705,8 +716,10 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         if self._tbptt_scan is None:
             self._tbptt_scan = {}
         if (seg, back) not in self._tbptt_scan:
-            self._tbptt_scan[seg, back] = jax.jit(
-                self.tbptt_scan_fn(seg, back), donate_argnums=(0, 1, 2))
+            self._tbptt_scan[seg, back] = aot_cache.wrap(
+                jax.jit(self.tbptt_scan_fn(seg, back),
+                        donate_argnums=(0, 1, 2)),
+                self._graph_key(), f"tbptt_scan:{seg}:{back}:d012")
         (self.params, self.state, self.opt_state, new_itc,
          mean_loss) = self._tbptt_scan[seg, back](
             self.params, self.state, self.opt_state, features, labels,
@@ -827,7 +840,8 @@ class ComputationGraph(nn_io.LazyScoreMixin):
                 return tuple(acts[n].astype(self._dtype)
                              for n in self.conf.network_outputs)
 
-            self._output_fn = jax.jit(out)
+            self._output_fn = aot_cache.wrap(jax.jit(out),
+                                             self._graph_key(), "output")
         # jax.Arrays pass through (keeps committed shardings); uint8
         # features dequantize inside the jit, matching training
         xs = tuple(nn_io.as_device(x, self._dtype, feature=True)
@@ -849,7 +863,8 @@ class ComputationGraph(nn_io.LazyScoreMixin):
                                      fmasks, lmasks, rng=None, train=False)
                 return loss
 
-            self._score_fn = jax.jit(score)
+            self._score_fn = aot_cache.wrap(jax.jit(score),
+                                            self._graph_key(), "score")
         features, labels, fmasks, lmasks = self._prep_batch(ds)
         return float(self._score_fn(self.params, self.state, features,
                                     labels, fmasks, lmasks))

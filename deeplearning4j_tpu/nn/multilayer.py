@@ -30,7 +30,7 @@ from deeplearning4j_tpu.datasets.iterators import (
     ListDataSetIterator,
 )
 from deeplearning4j_tpu.eval.evaluation import Evaluation
-from deeplearning4j_tpu.optimize import solver
+from deeplearning4j_tpu.optimize import aot_cache, solver
 from deeplearning4j_tpu.optimize.listeners import TrainingListener
 from deeplearning4j_tpu.util import params as params_util
 
@@ -107,6 +107,15 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
     def _updater_for(self, layer_idx: int):
         layer = self.conf.layers[layer_idx]
         return getattr(layer, "updater", None) or self.conf.updater
+
+    def _graph_key(self) -> str:
+        """AOT-cache graph signature (optimize.aot_cache): content-keyed on
+        the conf when its repr is deterministic, so clones and fresh
+        instances of the same network reuse compiled step executables."""
+        if getattr(self, "_graph_key_cache", None) is None:
+            self._graph_key_cache = "mln:" + aot_cache.graph_signature(
+                self.conf, fallback=self)
+        return self._graph_key_cache
 
     # --- functional core ---------------------------------------------------
     def _forward(self, params, state, x, train: bool, rng, fmask=None,
@@ -296,7 +305,8 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
                 it, ep, rng)
             return new_p, new_s, new_o, loss, itc + 1
 
-        return jax.jit(step, donate_argnums=(0, 1, 2, 7))
+        return aot_cache.wrap(jax.jit(step, donate_argnums=(0, 1, 2, 7)),
+                              self._graph_key(), "train_step:d012+itc")
 
     def _build_output_fn(self):
         def out(params, state, x, fmask):
@@ -306,7 +316,7 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
                                     train=False, rng=None, fmask=fmask)
             return y.astype(self._dtype)
 
-        return jax.jit(out)
+        return aot_cache.wrap(jax.jit(out), self._graph_key(), "output")
 
     def _build_rnn_step_fn(self):
         def out(params, state, carries, x, fmask):
@@ -329,7 +339,7 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
                                  lmask, rng=None, train=False)
             return loss
 
-        return jax.jit(score)
+        return aot_cache.wrap(jax.jit(score), self._graph_key(), "score")
 
     # --- training ----------------------------------------------------------
     def fit(self, data, labels=None, epochs: int = 1,
@@ -630,8 +640,10 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         if self._tbptt_scan is None:
             self._tbptt_scan = {}
         if (seg, back) not in self._tbptt_scan:
-            self._tbptt_scan[seg, back] = jax.jit(
-                self.tbptt_scan_fn(seg, back), donate_argnums=(0, 1, 2))
+            self._tbptt_scan[seg, back] = aot_cache.wrap(
+                jax.jit(self.tbptt_scan_fn(seg, back),
+                        donate_argnums=(0, 1, 2)),
+                self._graph_key(), f"tbptt_scan:{seg}:{back}:d012")
         (self.params, self.state, self.opt_state, new_itc,
          mean_loss) = self._tbptt_scan[seg, back](
             self.params, self.state, self.opt_state, features, labels,

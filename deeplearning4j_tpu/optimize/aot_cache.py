@@ -1,0 +1,234 @@
+"""AOT step-executable cache.
+
+``jax.jit`` keeps a per-jit-object trace cache, so every model instance
+that builds a fresh jitted step pays a full retrace+recompile even when an
+identical network was compiled seconds ago — and a silent retrace (shape
+drift, a rebuilt wrapper, a cloned model) is invisible until the step-time
+spike shows up in a profile. This module makes compilation explicit and
+shared:
+
+- executables are keyed by ``(graph signature, step kind, input avals +
+  shardings, donation set)`` and compiled ONCE per key via
+  ``jit(...).lower(*args).compile()``;
+- the key is process-global, so a cloned/re-instantiated model with the
+  same configuration reuses the already-compiled executable instead of
+  retracing;
+- every dispatch records a hit or a miss, and misses record their compile
+  seconds — surfaced through ``optimize.listeners.AotCacheStatsListener``
+  and the ``ui.stats`` System tab, so "zero recompiles across repeated
+  fit() calls" is an observable invariant instead of a hope.
+
+The reference has no equivalent (each fit walks the op graph from Java
+every iteration); this is the TPU-native hot-path contract: the ONLY
+per-step host work is a cache lookup + one dispatch.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+
+class AotCacheStats:
+    """Process-global counters (thread-safe; the async fit loops dispatch
+    from one thread but listeners may read from another)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        with getattr(self, "_lock", threading.Lock()):
+            self.hits = 0
+            self.misses = 0
+            self.compile_seconds = 0.0
+            self.entries = 0
+            self.fallbacks = 0
+            self.overflows = 0
+            self.last_miss_key = None
+
+    def record_hit(self):
+        with self._lock:
+            self.hits += 1
+
+    def record_miss(self, key, seconds: float):
+        with self._lock:
+            self.misses += 1
+            self.compile_seconds += float(seconds)
+            self.entries += 1
+            self.last_miss_key = key
+
+    def record_fallback(self):
+        with self._lock:
+            self.fallbacks += 1
+
+    def record_overflow(self):
+        with self._lock:
+            self.overflows += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "entries": self.entries,
+                "compile_seconds": round(self.compile_seconds, 3),
+                "fallbacks": self.fallbacks,
+                "overflows": self.overflows,
+            }
+
+
+STATS = AotCacheStats()
+
+# key -> compiled executable. Bounded: evicting a compiled XLA program to
+# recompile it later is strictly worse than holding it, and a process that
+# compiles >256 distinct step signatures has a retrace bug this cache
+# exists to SURFACE (the stats keep counting either way).
+_MAX_ENTRIES = 256
+_EXECUTABLES: dict = {}
+_LOCK = threading.Lock()
+
+
+def stats() -> dict:
+    """Current cache counters (the System-tab record)."""
+    return STATS.snapshot()
+
+
+def clear():
+    """Drop every cached executable (tests; a long-lived server swapping
+    model families can call this to release device programs). Identity
+    pins are released with the entries they guarded."""
+    with _LOCK:
+        _EXECUTABLES.clear()
+        _ID_PINNED.clear()
+    STATS.reset()
+
+
+def _leaf_sig(x):
+    # jax Arrays cache their aval — ~0.1us vs ~6us for .shape/.dtype
+    # property chains; this function runs per leaf per step
+    a = getattr(x, "aval", None)
+    if a is not None:
+        return (a.shape, a.dtype)
+    if isinstance(x, np.ndarray) or hasattr(x, "dtype"):
+        return (np.shape(x), np.asarray(x).dtype if not hasattr(x, "dtype")
+                else x.dtype)
+    # python scalars are weak-typed under jit; keyed by type
+    return type(x).__name__
+
+
+def signature_of(args):
+    """Hashable abstract signature of a call's arguments: per-leaf
+    (shape, dtype) + the argument treedef (which encodes structure,
+    including None-vs-array optional args). Built from cached avals —
+    this runs on the per-step dispatch path, so it must stay ~0.1us per
+    leaf. Shardings are NOT keyed: the wrapped entry points are the
+    single-device model steps (mesh-parallel wrappers keep their own
+    jits), and a sharding/layout mismatch at call time falls back to the
+    plain jit (see AotStep.__call__)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    return (tuple(map(_leaf_sig, leaves)), treedef)
+
+
+# objects keyed by identity are PINNED here so their id() can never be
+# recycled by the allocator and collide with a later object's key while
+# the (immortal) executable cache still holds entries under it
+_ID_PINNED: list = []
+
+
+def pin_id(obj) -> int:
+    """-> id(obj), with obj kept alive for the life of the cache."""
+    _ID_PINNED.append(obj)
+    return id(obj)
+
+
+def graph_signature(obj, fallback=None) -> str:
+    """Stable content key for a model configuration: the sha1 of its repr
+    when that repr is deterministic, else an identity key (two instances
+    then never share — the safe direction; the keyed object is pinned so
+    CPython address reuse cannot alias it). conf objects are nested
+    dataclasses whose reprs embed every hyperparameter; reprs containing
+    raw object addresses simply fail to match across instances."""
+    try:
+        r = repr(obj)
+    except Exception:
+        r = None
+    # "..." = numpy's large-array elision: the repr no longer uniquely
+    # identifies the config, so fall back to identity (never shares)
+    if r and "..." not in r:
+        return hashlib.sha1(r.encode()).hexdigest()
+    return f"id:{pin_id(obj if fallback is None else fallback)}"
+
+
+class AotStep:
+    """A jitted step behind the executable cache.
+
+    Call it exactly like the wrapped jit. The first call for a given
+    input signature lowers + compiles (a recorded miss); every later call
+    with the same signature — from this model instance or any other that
+    shares the graph key — dispatches the cached executable (a hit).
+    ``donate_argnums`` must be baked into ``jit_fn``; it is part of the
+    key via ``fn_key`` so differently-donating wrappers never collide.
+    """
+
+    def __init__(self, jit_fn: Callable, graph_key: str, fn_key: str):
+        self._jit = jit_fn
+        self._key = (graph_key, fn_key)
+
+    def __call__(self, *args):
+        key = self._key + (signature_of(args),)
+        exe = _EXECUTABLES.get(key)
+        if exe is None:
+            with _LOCK:
+                exe = _EXECUTABLES.get(key)
+                if exe is None:
+                    if len(_EXECUTABLES) >= _MAX_ENTRIES:
+                        # full cache: dispatch the plain jit, whose own
+                        # trace cache amortizes this signature — re-AOT-
+                        # compiling per CALL here would turn an evicted
+                        # key into a compile-per-step pathology
+                        STATS.record_overflow()
+                        return self._jit(*args)
+                    t0 = time.perf_counter()
+                    exe = self._jit.lower(*args).compile()
+                    STATS.record_miss(key, time.perf_counter() - t0)
+                    _EXECUTABLES[key] = exe
+            return exe(*args)
+        try:
+            out = exe(*args)
+        except (TypeError, ValueError):
+            # an input property outside the signature (committed mesh
+            # sharding, exotic layout) diverged from the lowering — the
+            # plain jit handles it (and compiles its own specialization).
+            # Counted separately so the stats don't report a silent
+            # retrace as a hit.
+            STATS.record_fallback()
+            return self._jit(*args)
+        STATS.record_hit()
+        return out
+
+    # escape hatches for probes that want the raw jit (bench scripts call
+    # .lower() for memory analysis)
+    def lower(self, *args):
+        return self._jit.lower(*args)
+
+    @property
+    def jit_fn(self):
+        return self._jit
+
+
+def wrap(jit_fn: Callable, graph_key: str, fn_key: str,
+         enabled: Optional[bool] = None) -> Callable:
+    """Wrap a jitted step in the AOT cache. ``enabled=False`` returns the
+    jit untouched (env kill-switch honored when ``enabled`` is None)."""
+    import os
+
+    if enabled is None:
+        enabled = os.environ.get("DL4J_TPU_AOT_CACHE", "1") != "0"
+    return AotStep(jit_fn, graph_key, fn_key) if enabled else jit_fn

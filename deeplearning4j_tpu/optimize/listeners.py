@@ -110,6 +110,46 @@ class TimeIterationListener(TrainingListener):
                   f"(iteration {iteration}/{self.total})", file=self.stream)
 
 
+class AotCacheStatsListener(TrainingListener):
+    """Report the AOT step-executable cache (optimize.aot_cache) every N
+    iterations: hits / misses / cached entries / cumulative compile
+    seconds — the observable form of "zero recompiles across repeated
+    fit() calls". A miss after warmup means a silent retrace (shape
+    drift, a rebuilt step) that would otherwise only show up as an
+    unexplained step-time spike. ``history`` keeps the per-collection
+    snapshots for programmatic checks (tests, dashboards)."""
+
+    def __init__(self, frequency: int = 10, stream=None,
+                 print_stats: bool = True):
+        self.frequency = max(1, int(frequency))
+        self.stream = stream or sys.stdout
+        self.print_stats = bool(print_stats)
+        self.history: List[dict] = []
+        self._last = None
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if iteration % self.frequency:
+            return
+        from deeplearning4j_tpu.optimize import aot_cache
+
+        snap = aot_cache.stats()
+        snap["iteration"] = int(iteration)
+        self.history.append(snap)
+        if self.print_stats:
+            delta_miss = (snap["misses"] - self._last["misses"]
+                          if self._last else snap["misses"])
+            msg = (f"[aot-cache] iter {iteration}: {snap['hits']} hits, "
+                   f"{snap['misses']} misses ({snap['entries']} "
+                   f"executables, {snap['compile_seconds']:.2f}s compile)")
+            if self._last and delta_miss:
+                msg += f" — {delta_miss} NEW compile(s) since last report"
+            if snap.get("fallbacks"):
+                msg += (f" — {snap['fallbacks']} sharding/layout "
+                        "fallback(s) to plain jit")
+            print(msg, file=self.stream)
+        self._last = snap
+
+
 class EvaluativeListener(TrainingListener):
     """Periodic evaluation during fit (reference ``EvaluativeListener``)."""
 

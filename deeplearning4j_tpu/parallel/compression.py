@@ -103,3 +103,76 @@ def encode_tree(grads, residuals, tau):
             jax.tree_util.tree_unflatten(treedef, new_res), sparsity)
 
 
+# ---------------------------------------------------------------------------
+# Bucketed, overlap-scheduled all-reduce
+# ---------------------------------------------------------------------------
+#
+# The reference's EncodedGradientsAccumulator streams per-parameter update
+# messages as they are produced; a single fused all-reduce instead waits for
+# the WHOLE backward pass before any byte crosses the interconnect. Bucketing
+# recovers the overlap on TPU: the gradient pytree is partitioned into
+# size-targeted buckets in REVERSE-topological order (the last layers'
+# grads — the first ones backprop produces — land in bucket 0), and each
+# bucket is reduced by its own collective. An ``optimization_barrier`` chain
+# pins the issue ORDER of the collectives (bucket 0 first) without adding
+# data dependencies on later gradients, so XLA's latency-hiding scheduler
+# can run bucket k's all-reduce while the backward pass is still producing
+# bucket k+1's gradients. Cite: arXiv:1905.04035 (collective performance
+# during gradient accumulation dominates DP scaling) and arXiv:2112.01075
+# (decomposing one big transfer into scheduled collective chunks).
+
+
+def bucket_partition(sizes, bucket_bytes: int):
+    """Partition leaf indices into size-targeted buckets, walking the
+    leaves in REVERSE order (reverse-topological: backprop computes the
+    deepest layers' grads first). Returns a list of index lists; every
+    index appears exactly once. A leaf larger than ``bucket_bytes`` gets
+    its own bucket."""
+    buckets, cur, acc = [], [], 0
+    for i in reversed(range(len(sizes))):
+        if cur and acc + sizes[i] > bucket_bytes:
+            buckets.append(cur)
+            cur, acc = [], 0
+        cur.append(i)
+        acc += sizes[i]
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def bucketed_psum(tree, axis_name, bucket_bytes=None):
+    """``lax.psum`` a pytree over ``axis_name`` in size-targeted buckets.
+
+    ``bucket_bytes=None`` (or a tree of <= 1 leaf) falls back to ONE fused
+    variadic psum — the single-collective baseline. Otherwise each bucket
+    becomes one variadic psum, issued in reverse-topological order with an
+    ``optimization_barrier`` chain tying bucket k+1's operands to bucket
+    k's result so the collectives cannot be merged or reordered — the
+    overlap schedule described above. The reduction itself is unchanged
+    (same per-leaf cross-shard sum), so bucketed and fused results are
+    numerically identical."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return tree
+    if bucket_bytes is None or len(leaves) <= 1:
+        return jax.tree_util.tree_unflatten(
+            treedef, list(jax.lax.psum(tuple(leaves), axis_name)))
+    sizes = [l.size * l.dtype.itemsize for l in leaves]
+    out = [None] * len(leaves)
+    pin = None
+    for bucket in bucket_partition(sizes, int(bucket_bytes)):
+        vals = tuple(leaves[i] for i in bucket)
+        if pin is not None:
+            # order pin: this bucket's reduce is scheduled after the
+            # previous bucket's — a pure scheduling edge, no math
+            pinned = jax.lax.optimization_barrier(vals + (pin,))
+            vals = tuple(pinned[:-1])
+        red = jax.lax.psum(vals, axis_name)
+        pin = red[0]
+        for i, r in zip(bucket, red):
+            out[i] = r
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+

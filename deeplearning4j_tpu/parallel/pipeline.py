@@ -78,7 +78,7 @@ def _gpipe_forward(stage_fn, my_params, x_micro, n_stages, n_micro):
 
     _, ys = jax.lax.scan(step, buf, jnp.arange(total))
     outs = ys[n_stages - 1:]
-    return jax.lax.psum(
+    return psum_replicate(
         jnp.where(sid == n_stages - 1, outs, jnp.zeros_like(outs)),
         STAGE_AXIS)
 
@@ -263,9 +263,9 @@ class HeteroPipeline:
         branches = [self._stage_branch(s) for s in range(S)]
         # the scan carry's varying-manual-axes type must match the step
         # output (which varies on every mesh axis: stage via the ring,
-        # data via the microbatch shards) — pvary anchors the zero init
-        buf = jax.lax.pcast(jnp.zeros((self.a_max,), self.out_dtype),
-                            tuple(self.mesh.axis_names), to="varying")
+        # data via the microbatch shards) — anchor the zero init varying
+        buf = _ensure_varying(jnp.zeros((self.a_max,), self.out_dtype),
+                              tuple(self.mesh.axis_names))
 
         def step(buf, t):
             inj = x_micro_flat[jnp.minimum(t, M - 1)]
@@ -275,7 +275,7 @@ class HeteroPipeline:
 
         _, ys = jax.lax.scan(step, buf, jnp.arange(total))
         outs = ys[S - 1:]
-        outs = jax.lax.psum(
+        outs = psum_replicate(
             jnp.where(sid == S - 1, outs, jnp.zeros_like(outs)),
             STAGE_AXIS)
         out_size = int(np.prod(self.out_shape))
@@ -382,13 +382,48 @@ def hetero_serial_reference(stage_fns, per_stage_params, x):
 
 
 
-def _ensure_varying(x, axes):
-    """pcast to varying only on the mesh axes ``x`` does not already
-    vary on (pcast errors on varying->varying; shard-mapped inputs
-    arrive already varying on their sharded axes)."""
-    have = set(getattr(jax.typeof(x), "vma", ()) or ())
-    need = tuple(a for a in axes if a not in have)
-    return jax.lax.pcast(x, need, to="varying") if need else x
+# shared version-adaptive vma probe + anchor (see parallel/mesh.py)
+_HAS_VMA = mesh_mod.EFFICIENT_PSUM_TRANSPOSE
+_ensure_varying = mesh_mod.ensure_varying
+
+
+# --- transpose-correct replication collectives --------------------------
+#
+# ``jax.grad`` INSIDE a shard_map body differentiates per shard. Under the
+# varying-manual-axes type system psum's transpose is replication-aware,
+# but under older check_rep jax the raw transpose psums the (already
+# replicated) cotangent — every psum inside a differentiated region
+# multiplies its gradient contribution by the axis size (measured: the
+# GPipe collect produced exactly S x the serial gradients). The fix is the
+# math the pattern actually means: ``out = sum_s x_s`` replicated, so
+# d out / d x_s = 1 per shard — the transpose is the IDENTITY on each
+# shard's cotangent. ``_psum_id_t`` pins that with a custom_vjp; new-vma
+# jax keeps the native psum (its transpose is already correct).
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _psum_id_t(x, axis_name):
+    return jax.lax.psum(x, axis_name)
+
+
+def _psum_id_t_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _psum_id_t_bwd(axis_name, _res, ct):
+    return (ct,)
+
+
+_psum_id_t.defvjp(_psum_id_t_fwd, _psum_id_t_bwd)
+
+
+def psum_replicate(x, axis_name):
+    """psum usable inside a differentiated shard_map region: the forward
+    is a plain psum; the backward is per-shard identity (see above)."""
+    if _HAS_VMA:
+        return jax.lax.psum(x, axis_name)
+    return _psum_id_t(x, axis_name)
+
 
 def _flatten_f32(tree):
     leaves = jax.tree_util.tree_leaves(tree)
@@ -959,7 +994,13 @@ class PipelineParallelWrapper:
                        out_opt, g_out, it, ep):
         from deeplearning4j_tpu.optimize import solver
 
-        axes = tuple(self.mesh.axis_names)
+        # pcast the switch branches' outputs varying on the STAGE axis
+        # only: the gradients arriving here are already data-axis-
+        # invariant (pmean'd in _common_post), and the stacked-params /
+        # opt out_specs are P(stage) — marking the outputs varying on
+        # 'data' too would make shard_map's replication check reject the
+        # step on a composed pipeline x data mesh (round-5 regression)
+        axes = (STAGE_AXIS,)
         upd_branches = [
             (lambda fp, fo, g, i, e, f=f: tuple(
                 _ensure_varying(o, axes) for o in f(fp, fo, g, i, e)))
@@ -1037,7 +1078,10 @@ class PipelineParallelWrapper:
                 (_, final_state), ys = jax.lax.scan(
                     step, (buf0, st0), jnp.arange(total))
                 outs = ys[S - 1:]
-                outs = jax.lax.psum(
+                # transpose-correct collect: inside this differentiated
+                # region every replication psum must backprop as the
+                # per-shard identity (see psum_replicate)
+                outs = psum_replicate(
                     jnp.where(sid == S - 1, outs, jnp.zeros_like(outs)),
                     STAGE_AXIS)
                 losses = [head_score(out_p, outs[m], y_micro[m])
@@ -1046,11 +1090,17 @@ class PipelineParallelWrapper:
                 reg_branches = [
                     (lambda fp, f=f: _ensure_varying(f(fp), axes_all))
                     for f in self._regs]
-                loss = loss + jax.lax.psum(
+                loss = loss + psum_replicate(
                     jax.lax.switch(sid, reg_branches, my_flat),
                     STAGE_AXIS)
                 loss = loss + self._head_reg(out_p)
-                if has_data:
+                if has_data and _HAS_VMA:
+                    # vma jax: pmean inside the differentiated region and
+                    # the AD machinery psums the replicated-param
+                    # cotangents itself. check_rep jax differentiates the
+                    # PER-SHARD loss instead; _common_post's forward
+                    # pmean of the per-shard grads is the data mean
+                    # (classic pmap calculus — same numbers)
                     loss = jax.lax.pmean(loss, mesh_mod.DATA_AXIS)
                 return loss, final_state
 
@@ -1152,10 +1202,11 @@ class PipelineParallelWrapper:
 
                     def skip_fwd(args):
                         fs, stash = args
-                        return fs, stash, jnp.zeros((A,), jnp.float32)
-
-                    fs, stash, fwd_y = jax.lax.cond(
-                        mf >= 0, do_fwd, skip_fwd, (fs, stash))
+                        # the skip branch's zeros must carry the SAME
+                        # varying manual axes as do_fwd's y, or lax.cond
+                        # rejects the branch join at trace time
+                        return fs, stash, _ensure_varying(
+                            jnp.zeros((A,), jnp.float32), axes)
 
                     # --- backward micro-op (vjp recompute vs stash) ---
                     def do_bwd(args):
@@ -1184,11 +1235,29 @@ class PipelineParallelWrapper:
                         return (g_acc + gp, g_out_acc, loss_acc), gx
 
                     def skip_bwd(args):
-                        return args, jnp.zeros((A,), jnp.float32)
+                        return args, _ensure_varying(
+                            jnp.zeros((A,), jnp.float32), axes)
 
-                    (g_acc, g_out_acc, loss_acc), bwd_gx = jax.lax.cond(
-                        mb >= 0, do_bwd, skip_bwd,
-                        (g_acc, g_out_acc, loss_acc))
+                    # micro-op ORDER must match the simulator's slot
+                    # priority (the _one_f1b_tables stash invariant
+                    # ``bwd_t[s][m] <= fwd_t[s][m + S]`` is same-slot
+                    # safe only under it): stages s < S-1 run bwd FIRST,
+                    # so a same-slot fwd(m+S) cannot overwrite the
+                    # stash[m % S] entry bwd(m) is about to recompute
+                    # against; the head stage runs fwd first because it
+                    # may backward its OWN forward in the same slot.
+                    if s == S - 1:
+                        fs, stash, fwd_y = jax.lax.cond(
+                            mf >= 0, do_fwd, skip_fwd, (fs, stash))
+                        (g_acc, g_out_acc, loss_acc), bwd_gx = \
+                            jax.lax.cond(mb >= 0, do_bwd, skip_bwd,
+                                         (g_acc, g_out_acc, loss_acc))
+                    else:
+                        (g_acc, g_out_acc, loss_acc), bwd_gx = \
+                            jax.lax.cond(mb >= 0, do_bwd, skip_bwd,
+                                         (g_acc, g_out_acc, loss_acc))
+                        fs, stash, fwd_y = jax.lax.cond(
+                            mf >= 0, do_fwd, skip_fwd, (fs, stash))
 
                     new_msgs = (fwd_y, jnp.maximum(mf, 0),
                                 (mf >= 0).astype(jnp.int32),

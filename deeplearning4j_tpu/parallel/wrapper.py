@@ -48,6 +48,7 @@ from deeplearning4j_tpu.nn import io as nn_io
 from deeplearning4j_tpu.parallel import mesh as mesh_mod
 from deeplearning4j_tpu.parallel.compression import (
     ThresholdAlgorithm,
+    bucketed_psum,
     encode_tree,
 )
 
@@ -65,6 +66,11 @@ class TrainingMode(enum.Enum):
 
 def _tree_map(f, *trees):
     return jax.tree_util.tree_map(f, *trees)
+
+
+# shared version-adaptive vma helpers (see parallel/mesh.py)
+_EFFICIENT_PSUM_TRANSPOSE = mesh_mod.EFFICIENT_PSUM_TRANSPOSE
+_vary_on = mesh_mod.ensure_varying
 
 
 def _stack(tree, n: int):
@@ -95,7 +101,8 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                  average_updaters: bool = True,
                  threshold_algorithm: Optional[ThresholdAlgorithm] = None,
                  prefetch_buffer: int = 2,
-                 mesh=None, expert_parallel: bool = False):
+                 mesh=None, expert_parallel: bool = False,
+                 gradient_bucket_mb: Optional[float] = None):
         from deeplearning4j_tpu.nn.graph import ComputationGraph
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
@@ -150,6 +157,32 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         self.average_updaters = bool(average_updaters)
         self.threshold_algorithm = threshold_algorithm
         self.prefetch_buffer = int(prefetch_buffer)
+        # bucketed, overlap-scheduled gradient sync (compression.py
+        # bucketed_psum): None = the default single-collective paths
+        # (exact mode: XLA-SPMD-inserted all-reduce; threshold mode: one
+        # fused psum of the encoded tree). A number switches both
+        # SHARED_GRADIENTS variants to explicit reverse-topological
+        # buckets of ~that many MB, issue-order pinned so communication
+        # overlaps the remaining backward pass; 0 means "explicit
+        # shard_map exchange, single fused collective" (the bucketing
+        # A/B baseline). AVERAGING mode buckets its periodic parameter-
+        # averaging collective the same way.
+        if gradient_bucket_mb is None:
+            self.gradient_bucket_bytes = None
+            self._explicit_exchange = False
+        else:
+            mb = float(gradient_bucket_mb)
+            if mb < 0:
+                raise ValueError(
+                    f"gradient_bucket_mb must be >= 0, got {mb}")
+            self.gradient_bucket_bytes = (int(mb * 2 ** 20) if mb > 0
+                                          else None)
+            self._explicit_exchange = True
+        if self._explicit_exchange and (self.expert_parallel or self._tbptt):
+            raise ValueError(
+                "gradient_bucket_mb composes with the standard "
+                "SHARED_GRADIENTS / AVERAGING steps only (no "
+                "expert_parallel, no tBPTT yet)")
         self.score_value = float("nan")
         # device-resident training trees (replicated or replica-stacked)
         self._params = self._state = self._opt = None
@@ -229,9 +262,12 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             self._opt = self._replicated(m.opt_state)
             # exact mode: the model's own fused step, jitted over the mesh —
             # batch shardings drive SPMD partitioning, XLA inserts the
-            # all-reduce
+            # all-reduce. With gradient_bucket_mb set, the explicit
+            # shard_map exchange takes over (bucketed_psum schedule).
             if self._step is None:
-                if self._tbptt:
+                if self._explicit_exchange:
+                    self._step = self._build_bucketed_exact_step()
+                elif self._tbptt:
                     # the model's whole-batch segment-scan runner, SPMD-
                     # partitioned: batch axis sharded, params replicated;
                     # the per-segment gradient all-reduce is XLA-inserted
@@ -331,10 +367,22 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
 
             ((loss, (new_state, _)), grads) = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            # defensive identity under vma tracking; the correct
-            # reduction if tracking is ever off (see parallel/expert.py)
+            # replicated leaves: pmean — a defensive identity under vma
+            # tracking, and the correct per-shard-grads mean when the
+            # old check_rep transpose leaves partials. Expert-SHARDED
+            # leaves under check_rep jax accumulate the SUM over shards'
+            # loss terms (the old psum transpose cancels pmean's 1/n and
+            # scales the psum(extra) reg correction by n) — dividing by
+            # the shard count restores exactly the intended
+            # (1/n)·sum(data grads) + full local reg gradient; vma jax
+            # needs no correction (see parallel/expert.py for the same
+            # calculus on the raw MoE step, pinned by
+            # test_moe_expert_parallel_matches_single_device).
+            n_sh = float(self.workers)
             grads = {
-                k: {pk: (g if pspec[k][pk] != P()
+                k: {pk: ((g if _EFFICIENT_PSUM_TRANSPOSE
+                          else _tree_map(lambda a: a / n_sh, g))
+                         if pspec[k][pk] != P()
                          else _tree_map(
                              lambda a: jax.lax.pmean(a, DATA), g))
                     for pk, g in vg.items()}
@@ -374,7 +422,12 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             w = c * n / ctot
             grads = _tree_map(lambda g: g * w, grads)
             enc, new_res, sparsity = encode_tree(grads, res, tau)
-            shared = _tree_map(lambda e: jax.lax.psum(e, DATA), enc)
+            # the accumulator's message exchange: one fused collective by
+            # default, or reverse-topological size-targeted buckets whose
+            # issue order is pinned so the reduce of the last layers'
+            # messages overlaps the backward still producing the first
+            # layers' (compression.bucketed_psum)
+            shared = bucketed_psum(enc, DATA, self.gradient_bucket_bytes)
             new_params, new_opt = afn(params, opt, shared, it, ep)
             loss = jax.lax.psum(loss * c, DATA) / ctot
             new_state = _tree_map(
@@ -456,6 +509,45 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             out_specs=(P(), P(), P(), P(DATA), P(), P()))
         return jax.jit(sharded, donate_argnums=(0, 1, 2, 3))
 
+    def _build_bucketed_exact_step(self):
+        """Exact SHARED_GRADIENTS as an EXPLICIT shard_map exchange: the
+        per-shard backward runs locally, the raw gradients all-reduce
+        through ``bucketed_psum`` (issue-order-pinned reverse-topological
+        buckets — or one fused collective at bucket size 0), and the
+        updater applies the global-mean gradient. Semantically identical
+        to the default SPMD path (which lets XLA insert one fused
+        all-reduce), with the collective schedule under our control so
+        communication overlaps the remaining backprop."""
+        gfn = self.model.grad_fn()
+        afn = self.model.apply_updates_fn()
+        bucket = self.gradient_bucket_bytes
+
+        def step(params, state, opt, batch, itc, ep, base_key, cvec):
+            it, rng = nn_io.step_scalars(itc, base_key)
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA))
+            loss, new_state, grads = gfn(params, state, *batch, rng)
+            # ragged batches: gfn normalized by the LOCAL shard's valid
+            # rows; reweight by c/ctot so the bucketed sum equals the
+            # global per-example mean (all-padding shards contribute 0)
+            c = cvec[0]
+            ctot = jnp.maximum(jax.lax.psum(c, DATA), 1.0)
+            w = c / ctot
+            grads = _tree_map(lambda g: g * w, grads)
+            shared = bucketed_psum(grads, DATA, bucket)
+            new_params, new_opt = afn(params, opt, shared, it, ep)
+            loss = jax.lax.psum(loss * c, DATA) / ctot
+            new_state = _tree_map(
+                lambda s: (jax.lax.psum(s * w, DATA)
+                           if jnp.issubdtype(s.dtype, jnp.floating) else s),
+                new_state)
+            return new_params, new_state, new_opt, loss
+
+        sharded = shard_map(
+            step, self.mesh,
+            in_specs=(P(), P(), P(), P(DATA), P(), P(), P(), P(DATA)),
+            out_specs=(P(), P(), P(), P()))
+        return jax.jit(sharded, donate_argnums=(0, 1, 2))
+
     def _build_averaging_step(self):
         if self._tbptt:
             run = self.model.tbptt_scan_fn(self._tbptt_seg,
@@ -501,6 +593,8 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
 
     def _build_average_fn(self):
         avg_upd = self.average_updaters
+        if self._explicit_exchange:
+            return self._build_bucketed_average_fn()
 
         def average(params, state, opt):
             def bmean(x):
@@ -514,6 +608,43 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             return params, state, opt
 
         return jax.jit(average, donate_argnums=(0, 1, 2))
+
+    def _build_bucketed_average_fn(self):
+        """The periodic barrier-average as an explicit shard_map exchange:
+        each shard contributes its local replica sum and the cross-replica
+        mean arrives through ``bucketed_psum`` — the same issue-order-
+        pinned bucket schedule as the gradient paths, applied to the
+        AVERAGING collective."""
+        avg_upd = self.average_updaters
+        total = float(self.workers)
+        bucket = self.gradient_bucket_bytes
+
+        def average(params, state, opt):
+            def local_sum(tree):
+                return _tree_map(lambda x: jnp.sum(x, axis=0), tree)
+
+            group = (local_sum(params), local_sum(state))
+            if avg_upd:
+                group = group + (local_sum(opt),)
+            shared = bucketed_psum(group, DATA, bucket)
+
+            def back(mean_tree, like):
+                return _tree_map(
+                    lambda m, x: _vary_on(
+                        jnp.broadcast_to((m / total)[None],
+                                         x.shape).astype(x.dtype), (DATA,)),
+                    mean_tree, like)
+
+            new_params = back(shared[0], params)
+            new_state = back(shared[1], state)
+            new_opt = back(shared[2], opt) if avg_upd else opt
+            return new_params, new_state, new_opt
+
+        sharded = shard_map(
+            average, self.mesh,
+            in_specs=(P(DATA), P(DATA), P(DATA)),
+            out_specs=(P(DATA), P(DATA), P(DATA)))
+        return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
     # --- training loop ------------------------------------------------------
     def fit(self, data, labels=None, epochs: int = 1):
@@ -616,6 +747,10 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             else:
                 self._tau = float(self.threshold_algorithm.update(
                     self._tau, float(feedback)))
+        elif self._explicit_exchange:
+            (self._params, self._state, self._opt, loss) = self._step(
+                self._params, self._state, self._opt, batch, itc, ep,
+                m._base_key, cvec)
         else:
             if self.expert_parallel and self._step is None:
                 self._step = self._build_expert_step(len(batch))

@@ -474,10 +474,49 @@ class SameDiff:
 
         return fn
 
+    def graph_signature(self) -> str:
+        """Structural content key of the graph (non-ARRAY variables + op
+        topology + attrs) for the AOT executable cache: two SameDiff
+        instances holding the same program share compiled step/output
+        executables; any graph mutation changes the key. Raw-closure
+        control-flow bodies key by identity — never shared, and PINNED
+        (aot_cache.pin_id) so a dead graph's recycled addresses cannot
+        alias a new graph's key while its executables persist."""
+        import hashlib
+
+        from deeplearning4j_tpu.optimize import aot_cache as _aot
+
+        h = hashlib.sha1()
+        for v in self.variables.values():
+            if v.var_type != VariableType.ARRAY:
+                h.update(
+                    f"{v.var_type}|{v.name}|{v.shape}|{v.dtype}\n".encode())
+        for op in self.ops.values():
+            h.update(f"{op.name}|{op.op_name}|{op.inputs}|"
+                     f"{op.outputs}|".encode())
+            try:
+                h.update(repr(sorted(op.attrs.items())).encode())
+            except Exception:
+                h.update(f"id:{_aot.pin_id(op)}".encode())
+            for k in sorted(op.fn_attrs):
+                sub = op.subgraphs.get(k)
+                if sub is not None:
+                    h.update(f"{k}:sub:{repr(sub)}".encode())
+                else:
+                    h.update(
+                        f"{k}:fn:{_aot.pin_id(op.fn_attrs[k])}".encode())
+            h.update(b"\n")
+        h.update(repr(sorted(self.loss_variables)).encode())
+        return h.hexdigest()
+
     def _jitted(self, outputs: tuple):
         if outputs not in self._fn_cache:
+            from deeplearning4j_tpu.optimize import aot_cache
+
             raw = self.make_function(outputs)
-            self._fn_cache[outputs] = jax.jit(raw)
+            self._fn_cache[outputs] = aot_cache.wrap(
+                jax.jit(raw), "sd:" + self.graph_signature(),
+                f"output:{outputs}")
         return self._fn_cache[outputs]
 
     def output(self, placeholders: dict | None, *outputs) -> dict:

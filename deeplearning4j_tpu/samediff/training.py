@@ -146,7 +146,19 @@ def make_train_step(sd, cfg: TrainingConfig):
             new_params[n] = p - upd
         return new_params, new_state, loss
 
-    return jax.jit(train_step), trainable_names, loss_names
+    from deeplearning4j_tpu.optimize import aot_cache
+
+    # the executable bakes in the updater, regularization, minimize sign
+    # and the loss-variable subset — they MUST be part of the key, or two
+    # TrainingConfigs over the same graph would share one compiled step
+    # with the first config's lr/sign/loss frozen in
+    cfg_key = aot_cache.graph_signature(
+        (repr(updater), tuple(map(repr, regs)), sign, loss_names),
+        fallback=cfg)
+    step = aot_cache.wrap(jax.jit(train_step),
+                          "sd:" + sd.graph_signature(),
+                          f"train_step:{cfg_key}")
+    return step, trainable_names, loss_names
 
 
 def fit(sd, iterator=None, epochs: int = 1, features=None, labels=None):

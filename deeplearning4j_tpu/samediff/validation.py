@@ -59,7 +59,13 @@ def validate(case: TestCase) -> None:
     graph."""
     import jax
 
-    with jax.enable_x64(True):
+    if hasattr(jax, "enable_x64"):
+        ctx = jax.enable_x64(True)
+    else:  # older jax spells it jax.experimental.enable_x64
+        from jax.experimental import enable_x64
+
+        ctx = enable_x64(True)
+    with ctx:
         _validate_x64(case)
 
 
@@ -100,24 +106,55 @@ def _validate_x64(case: TestCase) -> None:
          if k in case.grad_wrt})
     for k in case.grad_wrt:
         a = np.asarray(analytic[k], np.float64).ravel()
-        x0 = case.inputs[k].copy()
-        flat = x0.ravel()
-        for idx in range(flat.size):
-            orig = flat[idx]
-            flat[idx] = orig + case.epsilon
-            up = float(scalar({**case.inputs, k: x0}))
-            flat[idx] = orig - case.epsilon
-            dn = float(scalar({**case.inputs, k: x0}))
-            flat[idx] = orig
-            numeric = (up - dn) / (2 * case.epsilon)
+        x0 = np.asarray(case.inputs[k])
+        flat0 = x0.ravel()
+        n = flat0.size
+
+        # VMAPPED central differences in chunks: one compiled call per
+        # chunk of up/down evaluations instead of two EAGER whole-graph
+        # executions per element (the per-element loop dominated the
+        # tier-1 op-validation wall time). Tiny inputs (n <= 8) keep the
+        # eager loop — a jit+vmap compile costs more than 16 eager evals
+        # of a small graph. Same evaluations, same math, either way.
+        numeric = np.empty(n, np.float64)
+        if n <= 8:
+            work = flat0.copy()
+            for idx in range(n):
+                orig = work[idx]
+                work[idx] = orig + case.epsilon
+                up = float(scalar({**case.inputs,
+                                   k: work.reshape(x0.shape)}))
+                work[idx] = orig - case.epsilon
+                dn = float(scalar({**case.inputs,
+                                   k: work.reshape(x0.shape)}))
+                work[idx] = orig
+                numeric[idx] = (up - dn) / (2 * case.epsilon)
+        else:
+            def scalar_k(xk_flat, _k=k, _shape=x0.shape):
+                return scalar({**case.inputs, _k: xk_flat.reshape(_shape)})
+
+            fv = jax.jit(jax.vmap(scalar_k))
+            chunk = 256
+            for start in range(0, n, chunk):
+                ii = np.arange(start, min(start + chunk, n))
+                pert = np.zeros((len(ii), n), x0.dtype)
+                pert[np.arange(len(ii)), ii] = case.epsilon
+                up = np.asarray(fv(jnp.asarray(flat0[None] + pert)),
+                                np.float64)
+                dn = np.asarray(fv(jnp.asarray(flat0[None] - pert)),
+                                np.float64)
+                numeric[ii] = (up - dn) / (2 * case.epsilon)
+
+        for idx in range(n):
             # central differences bottom out around eps_machine/epsilon —
             # treat both-tiny as matching zero
-            if abs(numeric) < 1e-7 and abs(a[idx]) < 1e-7:
+            if abs(numeric[idx]) < 1e-7 and abs(a[idx]) < 1e-7:
                 continue
-            denom = max(abs(numeric), abs(a[idx]), 1e-8)
-            rel = abs(numeric - a[idx]) / denom
+            denom = max(abs(numeric[idx]), abs(a[idx]), 1e-8)
+            rel = abs(numeric[idx] - a[idx]) / denom
             assert rel < case.max_rel_error, (
-                f"gradient mismatch for {k}[{idx}]: numeric={numeric:.3e} "
+                f"gradient mismatch for {k}[{idx}]: "
+                f"numeric={numeric[idx]:.3e} "
                 f"analytic={a[idx]:.3e} rel={rel:.3e}")
 
     for node in sd.ops.values():

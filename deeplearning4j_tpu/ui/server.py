@@ -258,6 +258,7 @@ class UIServer:
         timing = {}
         hostmem = {}
         devmem = {}
+        aotc = {}
         for r in records:
             it = r.get("iteration", 0)
             sess = r.get("session", "s")
@@ -290,6 +291,14 @@ class UIServer:
                         devmem.setdefault(f"{dev} {label}", ([], []))
                         devmem[f"{dev} {label}"][0].append(it)
                         devmem[f"{dev} {label}"][1].append(dstats[key])
+            # AOT executable cache (optimize.aot_cache): a rising miss
+            # count after warmup = silent retraces eating step time
+            for key, label in (("misses", "compiles"), ("hits", "hits"),
+                               ("compile_seconds", "compile s (cum)")):
+                if key in sysm.get("aot_cache", {}):
+                    aotc.setdefault(label, ([], []))
+                    aotc[label][0].append(it)
+                    aotc[label][1].append(sysm["aot_cache"][key])
         # latest histogram snapshot (reference dashboard histogram panels)
         latest_hists = {}
         for r in records:
@@ -305,6 +314,9 @@ class UIServer:
             _chart("Iteration time", timing, "seconds"),
             _chart("Host memory (RSS)", hostmem, "MB"),
             _chart("Device memory", devmem, "MB"),
+            _chart("AOT executable cache", aotc,
+                   "(hits/misses cumulative; misses after warmup = "
+                   "silent retraces)"),
             _hist_panel("Parameter histograms (latest)",
                         latest_hists.get("param_histograms", {}),
                         "#1f77b4"),

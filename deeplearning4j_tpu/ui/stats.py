@@ -310,6 +310,15 @@ def collect_system_metrics() -> dict:
         except Exception:
             pass
     try:
+        # AOT step-executable cache counters (optimize.aot_cache): the
+        # System tab charts hits/misses/compile seconds so a silent
+        # retrace shows up next to the memory it costs
+        from deeplearning4j_tpu.optimize import aot_cache
+
+        out["aot_cache"] = aot_cache.stats()
+    except Exception:
+        pass
+    try:
         import jax
 
         devices = {}

@@ -987,11 +987,19 @@ _EXEMPT = {
 }
 
 
+@pytest.mark.slow
 def test_coverage_registry_complete():
     """THE coverage gate (reference: OpValidation coverage accounting
     fails CI for registered-but-untested ops). Runs every sweep in this
     module in-process, then requires the missing set to be exactly the
-    documented exemptions."""
+    documented exemptions.
+
+    Marked slow (round 6): it re-executes every sweep this module ALREADY
+    runs as individual tier-1 tests (~95s of duplicate f64 work purely to
+    populate one process-local coverage set); the tier-1 budget is hard
+    (ROADMAP 870s) and the per-op validation itself still runs there.
+    Run explicitly (``pytest -m slow tests/test_op_validation.py``) for
+    the registry-completeness assertion."""
     test_coverage_after_sweep()
     for case in _NN_SWEEP:
         _run_nn_unary(*case)
@@ -2480,3 +2488,25 @@ def test_round4_review_regressions():
     out = np.asarray(sd.output({}, "sn")["sn"])
     want = np.zeros((3, 2)); want[1, 1] = 1.0
     np.testing.assert_array_equal(out, want)
+
+
+# --- round 6: cheap in-tier-1 coverage gate ---------------------------------
+
+
+def test_zz_coverage_registry_light():
+    """Tier-1 stand-in for the slow-marked test_coverage_registry_complete:
+    when this module runs as a whole (tier-1 runs one process, definition
+    order, random ordering disabled), every sweep above has already
+    populated the process-local coverage set, so the registry-completeness
+    assertion costs nothing extra here. Skips when invoked in isolation
+    (the slow test remains the order-independent form)."""
+    rep = coverage_report()
+    if rep["validated"] < 100:
+        pytest.skip("module sweeps did not run in this process; use "
+                    "pytest -m slow test_coverage_registry_complete")
+    unexpected = sorted(set(rep["missing"]) - set(_EXEMPT))
+    assert not unexpected, (
+        f"registered ops without validation coverage: {unexpected} — add a "
+        "sweep entry in test_op_validation.py or an explicit exemption "
+        "with a pointer to the covering test")
+    assert rep["validated"] >= 350, rep["validated"]

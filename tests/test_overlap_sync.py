@@ -1,0 +1,391 @@
+"""Round-6 hot-path machinery: bucketed overlap-scheduled gradient sync
+(parallel/compression.bucketed_psum + ParallelWrapper.gradient_bucket_mb),
+the AOT step-executable cache (optimize/aot_cache), and the double-buffered
+device ingest ring (datasets/prefetch.DeviceRingIterator).
+
+All under ``JAX_PLATFORMS=cpu`` (conftest): the 8 virtual devices exercise
+the real collective/sharding paths; numerics are the oracle.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deeplearning4j_tpu.conf import Activation, InputType, WeightInit
+from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.conf.losses import LossMCXENT
+from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+from deeplearning4j_tpu.conf.updaters import Sgd
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.datasets.prefetch import DeviceRingIterator
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize import aot_cache
+from deeplearning4j_tpu.parallel import ParallelWrapper, TrainingMode
+from deeplearning4j_tpu.parallel.compression import (
+    ThresholdAlgorithm,
+    bucket_partition,
+    bucketed_psum,
+)
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, shard_map
+
+
+def _mlp(seed=3):
+    """No dropout / no BN: the explicit shard_map exchange folds rng per
+    shard and computes BN stats per shard, so the SPMD-vs-shard_map parity
+    below is exact only for deterministic per-example nets."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Sgd(learning_rate=0.1))
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(DenseLayer(n_out=12, activation=Activation.TANH))
+            .layer(DenseLayer(n_out=8, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(6))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batch(rng, n=16):
+    x = rng.normal(size=(n, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return DataSet(x, y)
+
+
+def _params_close(a, b, rtol=2e-5, atol=1e-6, msg=""):
+    for k in b:
+        for pk in b[k]:
+            np.testing.assert_allclose(
+                np.asarray(a[k][pk]), np.asarray(b[k][pk]),
+                rtol=rtol, atol=atol, err_msg=f"{msg}{k}/{pk}")
+
+
+# --------------------------------------------------------------------------
+# bucket partitioning + bucketed_psum primitive
+# --------------------------------------------------------------------------
+
+
+def test_bucket_partition_reverse_topological_and_complete():
+    sizes = [100, 50, 200, 10, 10, 10]
+    buckets = bucket_partition(sizes, bucket_bytes=60)
+    # every index exactly once
+    flat = [i for b in buckets for i in b]
+    assert sorted(flat) == list(range(len(sizes)))
+    # reverse order: the LAST leaves (first grads out of backprop) lead
+    assert flat == list(reversed(range(len(sizes))))
+    # size targeting: the three 10s pack together, big leaves go alone
+    assert buckets[0] == [5, 4, 3]
+    for b in buckets:
+        assert b, "no empty buckets"
+    # one giant leaf still gets a bucket
+    assert bucket_partition([10 ** 9], 1024) == [[0]]
+
+
+@pytest.mark.parametrize("bucket_bytes", [None, 64, 10 ** 9])
+def test_bucketed_psum_matches_fused(bucket_bytes):
+    """Inside a shard_map, bucketed and single-fused psum produce
+    identical reductions for an uneven pytree."""
+    mesh = Mesh(np.array(jax.devices()[:4]), (DATA_AXIS,))
+    rng = np.random.default_rng(0)
+    tree = {
+        "a": jnp.asarray(rng.normal(size=(4, 8, 3)).astype(np.float32)),
+        "b": jnp.asarray(rng.normal(size=(4, 2)).astype(np.float32)),
+        "c": [jnp.asarray(rng.normal(size=(4, 17)).astype(np.float32)),
+              jnp.asarray(rng.normal(size=(4, 1)).astype(np.float32))],
+    }
+
+    def body(t):
+        return bucketed_psum(t, DATA_AXIS, bucket_bytes)
+
+    def body_ref(t):
+        return jax.tree_util.tree_map(
+            lambda x: jax.lax.psum(x, DATA_AXIS), t)
+
+    specs = jax.tree_util.tree_map(lambda _: P(DATA_AXIS), tree)
+    got = jax.jit(shard_map(body, mesh, in_specs=(specs,),
+                            out_specs=specs))(tree)
+    want = jax.jit(shard_map(body_ref, mesh, in_specs=(specs,),
+                             out_specs=specs))(tree)
+    for g, w in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(want)):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+# --------------------------------------------------------------------------
+# ParallelWrapper: bucketed sync == unbucketed, all three modes
+# --------------------------------------------------------------------------
+
+
+def test_bucketed_exact_mode_matches_spmd_and_fused():
+    """SHARED_GRADIENTS exact: the explicit bucketed shard_map exchange
+    (small buckets AND the bucket-size-0 single-fused fallback) matches
+    the default XLA-SPMD path elementwise after multiple steps."""
+    rng = np.random.default_rng(1)
+    ds = _batch(rng)
+    out = {}
+    for name, kw in (("spmd", {}),
+                     ("fused", {"gradient_bucket_mb": 0}),
+                     ("bucketed", {"gradient_bucket_mb": 0.0002})):
+        net = _mlp()
+        ParallelWrapper(net, prefetch_buffer=0, **kw).fit(ds, epochs=2)
+        out[name] = net.params
+    _params_close(out["fused"], out["spmd"], msg="fused-vs-spmd:")
+    # bucketing only regroups the collectives — bit-identical to fused
+    _params_close(out["bucketed"], out["fused"], rtol=1e-7, atol=1e-8,
+                  msg="bucketed-vs-fused:")
+
+
+def test_bucketed_threshold_mode_matches_unbucketed():
+    """SHARED_GRADIENTS + ThresholdAlgorithm: bucketing the encoded
+    message exchange leaves params AND the carried residual identical —
+    3 epochs so the residual self-correction crosses steps."""
+    rng = np.random.default_rng(2)
+    ds = _batch(rng)
+    out = {}
+    for name, kw in (("plain", {}),
+                     ("bucketed", {"gradient_bucket_mb": 0.0002})):
+        net = _mlp(seed=5)
+        pw = ParallelWrapper(net,
+                             threshold_algorithm=ThresholdAlgorithm(1e-3),
+                             prefetch_buffer=0, **kw)
+        pw.fit(ds, epochs=3)
+        out[name] = (net.params,
+                     jax.tree_util.tree_map(np.asarray, pw._residual))
+    _params_close(out["bucketed"][0], out["plain"][0], rtol=1e-7,
+                  atol=1e-8, msg="threshold:")
+    for g, w in zip(jax.tree_util.tree_leaves(out["bucketed"][1]),
+                    jax.tree_util.tree_leaves(out["plain"][1])):
+        np.testing.assert_allclose(g, w, rtol=1e-7, atol=1e-8,
+                                   err_msg="residual carry-over")
+
+
+def test_bucketed_averaging_matches_unbucketed():
+    """AVERAGING: the bucketed shard_map barrier-average == the plain
+    stacked-mean collective."""
+    rng = np.random.default_rng(3)
+    ds = _batch(rng)
+    out = {}
+    for name, kw in (("plain", {}),
+                     ("bucketed", {"gradient_bucket_mb": 0.0002})):
+        net = _mlp(seed=7)
+        ParallelWrapper(net, training_mode=TrainingMode.AVERAGING,
+                        averaging_frequency=1, prefetch_buffer=0,
+                        **kw).fit(ds, epochs=2)
+        out[name] = net.params
+    _params_close(out["bucketed"], out["plain"], msg="averaging:")
+
+
+def test_bucket_config_refusals():
+    net = _mlp()
+    with pytest.raises(ValueError, match="gradient_bucket_mb"):
+        ParallelWrapper(net, gradient_bucket_mb=-1)
+    with pytest.raises(ValueError, match="SHARED_GRADIENTS / AVERAGING"):
+        ParallelWrapper(net, gradient_bucket_mb=1, expert_parallel=True)
+
+
+# --------------------------------------------------------------------------
+# AOT step-executable cache
+# --------------------------------------------------------------------------
+
+
+def test_aot_cache_hit_on_refit_miss_on_shape_change():
+    aot_cache.clear()
+    rng = np.random.default_rng(4)
+    ds = _batch(rng, n=8)
+    net = _mlp(seed=9)
+    net.fit_batch(ds)
+    s1 = aot_cache.stats()
+    assert s1["misses"] >= 1 and s1["compile_seconds"] > 0
+    # refit, unchanged shapes: ZERO recompiles (the acceptance invariant)
+    net.fit_batch(ds)
+    net.fit_batch(ds)
+    s2 = aot_cache.stats()
+    assert s2["misses"] == s1["misses"], (s1, s2)
+    assert s2["hits"] >= s1["hits"] + 2
+    # a batch-shape change is a recorded miss, not a silent retrace
+    net.fit_batch(_batch(rng, n=4))
+    s3 = aot_cache.stats()
+    assert s3["misses"] == s2["misses"] + 1
+
+
+def test_aot_cache_shares_executables_across_instances():
+    """A clone (same conf object) must reuse the compiled step — the
+    cross-instance point of content-keying the graph signature."""
+    aot_cache.clear()
+    rng = np.random.default_rng(5)
+    ds = _batch(rng, n=8)
+    net = _mlp(seed=11)
+    net.fit_batch(ds)
+    misses = aot_cache.stats()["misses"]
+    clone = net.clone()
+    clone.fit_batch(ds)
+    assert aot_cache.stats()["misses"] == misses
+    # and a structurally-identical FRESH conf shares too (content key)
+    fresh = _mlp(seed=11)
+    fresh.fit_batch(ds)
+    assert aot_cache.stats()["misses"] == misses
+
+
+def test_aot_cache_numerics_unchanged():
+    rng = np.random.default_rng(6)
+    ds = _batch(rng, n=8)
+    import os
+
+    aot_cache.clear()
+    a = _mlp(seed=13)
+    la = a.fit_batch(ds)
+    os.environ["DL4J_TPU_AOT_CACHE"] = "0"
+    try:
+        b = _mlp(seed=13)
+        lb = b.fit_batch(ds)
+    finally:
+        os.environ.pop("DL4J_TPU_AOT_CACHE", None)
+    np.testing.assert_allclose(la, lb, rtol=1e-6)
+    _params_close(a.params, b.params, rtol=1e-6, atol=1e-7)
+
+
+def test_aot_cache_stats_listener_and_system_tab():
+    from deeplearning4j_tpu.optimize.listeners import AotCacheStatsListener
+    from deeplearning4j_tpu.ui.stats import collect_system_metrics
+
+    aot_cache.clear()
+    rng = np.random.default_rng(7)
+    ds = _batch(rng, n=8)
+    net = _mlp(seed=15)
+    lst = AotCacheStatsListener(frequency=1, print_stats=False)
+    net.set_listeners(lst)
+    net.fit_batch(ds)
+    net.fit_batch(ds)
+    assert lst.history, "listener collected nothing"
+    snap = lst.history[-1]
+    assert snap["misses"] >= 1 and "compile_seconds" in snap
+    sysm = collect_system_metrics()
+    assert "aot_cache" in sysm and sysm["aot_cache"]["misses"] >= 1
+
+
+def test_samediff_aot_cache_zero_recompiles_across_fits():
+    from deeplearning4j_tpu.samediff.core import SameDiff
+    from deeplearning4j_tpu.samediff.training import TrainingConfig
+
+    aot_cache.clear()
+    rng = np.random.default_rng(8)
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 4))
+    label = sd.placeholder("label", shape=(None, 2))
+    w = sd.var("w", shape=(4, 2), key=jax.random.PRNGKey(0))
+    out = x @ w
+    sd.loss.meanSquaredError(label, out, name="loss")
+    sd.set_training_config(
+        TrainingConfig.builder()
+        .updater(Sgd(learning_rate=0.1))
+        .data_set_feature_mapping("x")
+        .data_set_label_mapping("label")
+        .build())
+    feats = rng.normal(size=(8, 4)).astype(np.float32)
+    labels = rng.normal(size=(8, 2)).astype(np.float32)
+    sd.fit(features=feats, labels=labels)
+    misses = aot_cache.stats()["misses"]
+    sd.fit(features=feats, labels=labels)
+    sd.fit(features=feats, labels=labels)
+    s = aot_cache.stats()
+    assert s["misses"] == misses, "refit recompiled"
+    assert s["hits"] >= 2
+
+
+def test_samediff_aot_cache_distinguishes_training_configs():
+    """Two TrainingConfigs over the SAME graph bake different updaters
+    into the step — the cache must key them apart (round-6 review): a
+    collision would silently train with the first config's lr."""
+    from deeplearning4j_tpu.samediff.core import SameDiff
+    from deeplearning4j_tpu.samediff.training import TrainingConfig
+
+    aot_cache.clear()
+    rng = np.random.default_rng(9)
+    feats = rng.normal(size=(8, 4)).astype(np.float32)
+    labels = rng.normal(size=(8, 2)).astype(np.float32)
+
+    def build(lr):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(None, 4))
+        label = sd.placeholder("label", shape=(None, 2))
+        w = sd.var("w", shape=(4, 2), key=jax.random.PRNGKey(3))
+        sd.loss.meanSquaredError(label, x @ w, name="loss")
+        sd.set_training_config(
+            TrainingConfig.builder()
+            .updater(Sgd(learning_rate=lr))
+            .data_set_feature_mapping("x")
+            .data_set_label_mapping("label")
+            .build())
+        return sd, w
+
+    sd_a, w_a = build(0.1)
+    w0 = np.asarray(sd_a.arrays["w"]).copy()
+    sd_a.fit(features=feats, labels=labels)
+    delta_a = np.abs(np.asarray(sd_a.arrays["w"]) - w0).max()
+
+    sd_b, w_b = build(0.0)  # identical graph, ZERO learning rate
+    w0b = np.asarray(sd_b.arrays["w"]).copy()
+    sd_b.fit(features=feats, labels=labels)
+    delta_b = np.abs(np.asarray(sd_b.arrays["w"]) - w0b).max()
+
+    assert delta_a > 1e-4, "lr=0.1 config did not train"
+    assert delta_b == 0.0, (
+        "lr=0 config moved params — executable shared across configs")
+
+
+# --------------------------------------------------------------------------
+# double-buffered device ingest
+# --------------------------------------------------------------------------
+
+
+def _ring_batches(n=6):
+    return [DataSet(np.full((4, 6), i, np.float32),
+                    np.eye(3, dtype=np.float32)[np.full(4, i % 3)])
+            for i in range(n)]
+
+
+def test_device_ring_preserves_order_and_stages_on_device():
+    ring = DeviceRingIterator(ListDataSetIterator(_ring_batches()),
+                              depth=2, donate=False)
+    seen = []
+    for b in ring:
+        assert isinstance(b.features, jax.Array)
+        seen.append(float(np.asarray(b.features)[0, 0]))
+    assert seen == [float(i) for i in range(6)]
+    assert ring.staged_count == 6
+
+
+def test_device_ring_donates_consumed_buffers():
+    ring = DeviceRingIterator(ListDataSetIterator(_ring_batches()),
+                              depth=2, donate=True)
+    held = list(ring)
+    assert ring.retired_count >= len(held) - 2
+    deleted = sum(1 for b in held[:-2] if b.features.is_deleted())
+    assert deleted == len(held) - 2, "consumed buffers were not donated"
+    # the in-flight tail stays alive for the epoch-end sync
+    assert not held[-1].features.is_deleted()
+
+
+def test_device_ring_never_touches_source_arrays():
+    batches = _ring_batches()
+    hosts = [b.features for b in batches]
+    ring = DeviceRingIterator(ListDataSetIterator(batches), depth=2)
+    for _ in ring:
+        pass
+    for b, h in zip(batches, hosts):
+        assert b.features is h, "source DataSet was mutated"
+
+
+def test_training_through_device_ring_matches_plain():
+    batches = _ring_batches()
+    plain = _mlp(seed=17)
+    ringed = _mlp(seed=17)
+    plain.fit(ListDataSetIterator(batches), epochs=2)
+    ringed.fit(DeviceRingIterator(ListDataSetIterator(_ring_batches()),
+                                  depth=2, donate=True), epochs=2)
+    np.testing.assert_allclose(ringed.score_value, plain.score_value,
+                               rtol=1e-6)
+    _params_close(ringed.params, plain.params, rtol=1e-6, atol=1e-7)

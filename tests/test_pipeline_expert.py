@@ -478,37 +478,97 @@ def test_pipeline_wrapper_stage_times_data():
 
 
 def test_pipeline_wrapper_refusals():
-    """BN state and non-divisible batches refuse loudly."""
+    """v2's REMAINING refusals (the v1 BN-state refusal is lifted —
+    tests/test_pipeline_v2.py trains BN+dropout nets): tBPTT, masked
+    DataSets, MoE aux losses, compute_dtype policies, multi-output
+    graphs, and non-divisible batches all refuse loudly."""
+    import dataclasses
+
     from deeplearning4j_tpu.conf import Activation, InputType, WeightInit
     from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
-    from deeplearning4j_tpu.conf.layers_cnn import BatchNormalization
+    from deeplearning4j_tpu.conf.layers_moe import MoELayer
+    from deeplearning4j_tpu.conf.layers_rnn import LSTM, RnnOutputLayer
     from deeplearning4j_tpu.conf.losses import LossMCXENT
-    from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+    from deeplearning4j_tpu.conf.multilayer import (
+        BackpropType,
+        NeuralNetConfiguration,
+    )
     from deeplearning4j_tpu.conf.updaters import Sgd
     from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
     from deeplearning4j_tpu.parallel.pipeline import PipelineParallelWrapper
 
-    conf = (NeuralNetConfiguration.builder()
-            .seed(1).updater(Sgd(learning_rate=0.1))
-            .weight_init(WeightInit.XAVIER)
-            .list()
-            .layer(DenseLayer(n_out=8, activation=Activation.TANH))
-            .layer(BatchNormalization())
-            .layer(DenseLayer(n_out=8, activation=Activation.TANH))
-            .layer(OutputLayer(n_out=2, activation=Activation.SOFTMAX,
-                               loss_fn=LossMCXENT()))
-            .set_input_type(InputType.feed_forward(4))
-            .build())
-    bn_net = MultiLayerNetwork(conf).init()
-    with pytest.raises(ValueError, match="mutable state"):
-        PipelineParallelWrapper(bn_net, n_micro=2, mesh=_stage_mesh(2))
-
-    net = _mlp_net()
-    pw = PipelineParallelWrapper(net, n_micro=3, mesh=_stage_mesh(4))
     rng = np.random.default_rng(0)
+
+    # tBPTT composes with ParallelWrapper, not the pipeline yet
+    rnn_conf = (NeuralNetConfiguration.builder()
+                .seed(1).updater(Sgd(learning_rate=0.1))
+                .list()
+                .layer(LSTM(n_out=8))
+                .layer(RnnOutputLayer(n_out=2,
+                                      activation=Activation.SOFTMAX,
+                                      loss_fn=LossMCXENT()))
+                .backprop_type(BackpropType.TRUNCATED_BPTT, fwd=4, back=4)
+                .set_input_type(InputType.recurrent(4, timesteps=8))
+                .build())
+    rnn = MultiLayerNetwork(rnn_conf).init()
+    with pytest.raises(ValueError, match="tBPTT"):
+        PipelineParallelWrapper(rnn, n_micro=2, mesh=_stage_mesh(2))
+
+    # masked DataSets: the head's score runs unmasked
+    net = _mlp_net()
+    pw = PipelineParallelWrapper(net, n_micro=2, mesh=_stage_mesh(4))
+    x = rng.normal(size=(8, 16)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    with pytest.raises(ValueError, match="masked DataSets"):
+        pw.fit_batch(DataSet(x, y,
+                             labels_mask=np.ones((8,), np.float32)))
+
+    # MoE aux-loss layers (per-micro aux has no serial equivalent yet)
+    moe_conf = (NeuralNetConfiguration.builder()
+                .seed(1).updater(Sgd(learning_rate=0.1))
+                .weight_init(WeightInit.XAVIER)
+                .list()
+                .layer(MoELayer(n_experts=2, d_hidden=8))
+                .layer(RnnOutputLayer(n_out=2,
+                                      activation=Activation.SOFTMAX,
+                                      loss_fn=LossMCXENT()))
+                .set_input_type(InputType.recurrent(8, timesteps=4))
+                .build())
+    moe_net = MultiLayerNetwork(moe_conf).init()
+    with pytest.raises(ValueError, match="auxiliary losses"):
+        PipelineParallelWrapper(moe_net, n_micro=2, mesh=_stage_mesh(2))
+
+    # compute_dtype policies (flat stage packing keeps f32 masters)
+    mp_net = _mlp_net()
+    mp_net = MultiLayerNetwork(
+        dataclasses.replace(mp_net.conf, compute_dtype="bfloat16")).init()
+    with pytest.raises(ValueError, match="compute_dtype"):
+        PipelineParallelWrapper(mp_net, n_micro=2, mesh=_stage_mesh(2))
+
+    # multi-output graphs
+    g = (NeuralNetConfiguration.builder()
+         .seed(1).updater(Sgd(learning_rate=0.1))
+         .weight_init(WeightInit.XAVIER)
+         .graph_builder()
+         .add_inputs("in")
+         .set_input_types(InputType.feed_forward(8)))
+    g.add_layer("h", DenseLayer(n_out=8, activation=Activation.TANH), "in")
+    g.add_layer("o1", OutputLayer(n_out=2, activation=Activation.SOFTMAX,
+                                  loss_fn=LossMCXENT()), "h")
+    g.add_layer("o2", OutputLayer(n_out=2, activation=Activation.SOFTMAX,
+                                  loss_fn=LossMCXENT()), "h")
+    g.set_outputs("o1", "o2")
+    multi = ComputationGraph(g.build()).init()
+    with pytest.raises(ValueError, match="single-output"):
+        PipelineParallelWrapper(multi, n_micro=2, mesh=_stage_mesh(2))
+
+    # non-divisible batches refuse (unchanged from v1)
+    pw2 = PipelineParallelWrapper(_mlp_net(), n_micro=3,
+                                  mesh=_stage_mesh(4))
     with pytest.raises(ValueError, match="must divide"):
-        pw.fit_batch(DataSet(
+        pw2.fit_batch(DataSet(
             rng.normal(size=(8, 16)).astype(np.float32),
             np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]))
 

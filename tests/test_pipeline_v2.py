@@ -464,6 +464,25 @@ def test_1f1b_transformer_graph_matches_gpipe():
                        atol=2e-5)
 
 
+def _deep_mlp_net(seed=19):
+    """>= 4 stage-able layers so a 4-stage mesh partitions one layer per
+    stage — the O(S) liveness claim is only exercised when every stage
+    actually holds work (a 3-layer net under 4 stages refuses)."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Sgd(learning_rate=0.05))
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(DenseLayer(n_out=24, activation=Activation.TANH))
+            .layer(DenseLayer(n_out=20, activation=Activation.TANH))
+            .layer(DenseLayer(n_out=18, activation=Activation.TANH))
+            .layer(DenseLayer(n_out=12, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(16))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
 def test_1f1b_activation_liveness_bounded():
     """The schedule's point: 1F1B's live activation memory is O(S)
     stage-inputs (stash + rings), while GPipe's AD saves residuals for
@@ -474,7 +493,7 @@ def test_1f1b_activation_liveness_bounded():
         rng = np.random.default_rng(2)
         x = rng.normal(size=(4 * micros, 16)).astype(np.float32)
         y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4 * micros)]
-        net = _reg_mixed_updater_net()
+        net = _deep_mlp_net()
         pw = PipelineParallelWrapper(net, n_micro=micros,
                                      mesh=_stage_mesh(4),
                                      schedule=schedule)

@@ -17,7 +17,7 @@ from deeplearning4j_tpu.ops.attention import reference_attention
 from deeplearning4j_tpu.ops.ring import ring_attention_local
 from deeplearning4j_tpu.parallel.mesh import shard_map
 
-B, T, E, H = 4, 32, 16, 4
+B, T, E, H = 4, 16, 16, 4
 HD = E // H
 
 
@@ -59,11 +59,21 @@ def _loss(params, x, targets, attn_fn):
 
 @pytest.fixture
 def mesh2d():
-    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    # 2x2 (was 2x4 over T=32): the ring scan's compile time scales with
+    # the sequence-shard count and dominated tier-1 (~133s for this one
+    # test); 2 sequence shards still rotate K/V through a genuine
+    # cross-device ring and 2 data shards still exercise the combined
+    # reduction — same math, half the unrolled collective graph
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
     return Mesh(devs, ("data", "sequence"))
 
 
+@pytest.mark.slow
 def test_ring_sharded_training_matches_unsharded(rng, mesh2d):
+    # slow (round 6): ~60s of compile for one test on the 2-core CPU box;
+    # the tier-1 870s budget is hard, ring-attention GRADIENT math stays
+    # covered in tier-1 by test_attention.py::test_ring_gradients_match,
+    # and this end-to-end parity run executes via ``pytest -m slow``.
     seq_n = mesh2d.shape["sequence"]
     params = _init_params(jax.random.PRNGKey(0))
     x = jnp.asarray(rng.standard_normal((B, T, E)), jnp.float32)
@@ -92,13 +102,22 @@ def test_ring_sharded_training_matches_unsharded(rng, mesh2d):
                 pred_part = _forward_partial(p, xl, attn)
                 # time axis is sharded: psum completes the time-mean
                 pred = jax.lax.psum(pred_part, "sequence")
-                # normalize by the GLOBAL batch: params are replicated, so
-                # shard_map's AD already psums their cotangents over every
-                # mesh axis — per-shard grads come out as the full global
-                # gradient with no manual collective
+                # normalize by the GLOBAL batch: under vma jax, params
+                # are replicated so shard_map's AD already psums their
+                # cotangents over every mesh axis — per-shard grads come
+                # out as the full global gradient with no manual
+                # collective (check_rep jax needs the explicit reduction
+                # below)
                 return jnp.sum((pred - tl) ** 2) / B
 
             loss, g = jax.value_and_grad(loss_fn)(params)
+            if not (hasattr(jax, "typeof") and hasattr(jax.lax, "pcast")):
+                # check_rep jax: per-shard AD leaves partial grads (and
+                # the old psum transpose scales the sequence path by
+                # seq_n) — reduce to the global gradient explicitly
+                g = jax.tree_util.tree_map(
+                    lambda v: jax.lax.psum(v, ("data", "sequence"))
+                    / seq_n, g)
             loss = jax.lax.psum(loss, "data")  # global loss value
             return jax.tree_util.tree_map(
                 lambda p, gg: p - 0.1 * gg, params, g), loss
