@@ -1,0 +1,16 @@
+# Developer/CI targets. The tier-1 suite command of record lives in
+# ROADMAP.md; these are the quick subsets.
+
+PY ?= python
+
+.PHONY: telemetry-smoke
+# Telemetry-layer smoke: span/registry/export tests + the check that
+# bench_resnet_profile.py --phases keys match telemetry phase names.
+telemetry-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests -q -m telemetry \
+		-p no:cacheprovider
+
+.PHONY: tier1
+tier1:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider

@@ -28,6 +28,19 @@ import time
 
 import numpy as np
 
+from deeplearning4j_tpu.telemetry import PHASES
+
+PHASE_INGEST, PHASE_COMPUTE, PHASE_GRAD_SYNC = PHASES
+
+# --phases output rows, keyed off the framework's canonical phase names
+# (deeplearning4j_tpu.telemetry.PHASES) so the bench breakdown and the
+# telemetry spans cannot drift apart — pinned by tests/test_telemetry.py
+PHASE_ROWS = {
+    PHASE_INGEST: (f"{PHASE_INGEST}_h2d", f"{PHASE_INGEST}_after_overlap"),
+    PHASE_COMPUTE: ("step_cached_fit", "step_streaming", "step_ring"),
+    PHASE_GRAD_SYNC: (PHASE_GRAD_SYNC,),
+}
+
 BATCH = 256
 IMG = 224
 CLASSES = 1000
@@ -152,7 +165,7 @@ def main():
             dev = jax.device_put(np.asarray(ds_f.features))
             _sync(dev[0, 0, 0, :1])
             ing.append((time.perf_counter() - t0) * 1000.0 - _RT_MS[0])
-        rows["ingest_h2d"] = min(ing)
+        rows[f"{PHASE_INGEST}_h2d"] = min(ing)
 
         def stream_ms(iterator):
             t0 = time.perf_counter()
@@ -179,11 +192,12 @@ def main():
 
         comp = rows["step_cached_fit"]
         ring = rows["step_ring"]
-        rows["ingest_after_overlap"] = max(0.0, ring - comp)
-        rows["grad_sync"] = 0.0  # single chip: no DP collective
+        rows[f"{PHASE_INGEST}_after_overlap"] = max(0.0, ring - comp)
+        rows[PHASE_GRAD_SYNC] = 0.0  # single chip: no DP collective
         denom = max(ring, comp)
         rows["sync_plus_ingest_pct_of_step"] = round(
-            100.0 * (rows["grad_sync"] + rows["ingest_after_overlap"])
+            100.0 * (rows[PHASE_GRAD_SYNC]
+                     + rows[f"{PHASE_INGEST}_after_overlap"])
             / denom, 2)
 
     if args.phases:

@@ -168,6 +168,8 @@ class DeviceRingIterator(DataSetIterator):
         transfers; owned = only the arrays staged here (donation-safe)."""
         import jax
 
+        from deeplearning4j_tpu import telemetry
+
         if not isinstance(ds, DataSet):
             return ds, []
         owned = []
@@ -181,8 +183,11 @@ class DeviceRingIterator(DataSetIterator):
             owned.append(d)
             return d
 
-        staged = DataSet(stage(ds.features), stage(ds.labels),
-                         stage(ds.features_mask), stage(ds.labels_mask))
+        with telemetry.span(telemetry.PHASE_INGEST):
+            staged = DataSet(stage(ds.features), stage(ds.labels),
+                             stage(ds.features_mask), stage(ds.labels_mask))
+        if telemetry.enabled() and owned:
+            telemetry.record_ingest(sum(int(a.nbytes) for a in owned))
         self.staged_count += 1
         return staged, owned
 

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.conf.graph import (
     ComputationGraphConfiguration,
     LayerVertex,
@@ -421,7 +422,9 @@ class ComputationGraph(nn_io.LazyScoreMixin):
             ndims = [np.ndim(f) for f in _as_multi(ds).features]
             if all(d == 3 for d in ndims):
                 # one normalization path shared with ParallelWrapper
-                return self._fit_tbptt(*self.tbptt_batch_arrays(ds))
+                with telemetry.span(telemetry.PHASE_INGEST):
+                    args = self.tbptt_batch_arrays(ds)
+                return self._fit_tbptt(*args)
             if any(d == 3 for d in ndims):
                 # a MIXED seq/static batch must not silently train
                 # STANDARD against a tBPTT config (ParallelWrapper raises
@@ -455,13 +458,19 @@ class ComputationGraph(nn_io.LazyScoreMixin):
             self._train_step = aot_cache.wrap(
                 jax.jit(step, donate_argnums=(0, 1, 2, 7)),
                 self._graph_key(), "train_step:d012+itc")
-        features, labels, fmasks, lmasks = self._prep_batch(
-            ds, lazy_lmasks=True, write_back=True)
-        (self.params, self.state, self.opt_state, loss,
-         new_itc) = self._train_step(
-            self.params, self.state, self.opt_state, features, labels,
-            fmasks, lmasks, self.device_iteration(), self.device_epoch(),
-            self._base_key)
+        with telemetry.span(telemetry.PHASE_INGEST):
+            features, labels, fmasks, lmasks = self._prep_batch(
+                ds, lazy_lmasks=True, write_back=True)
+        with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
+            (self.params, self.state, self.opt_state, loss,
+             new_itc) = self._train_step(
+                self.params, self.state, self.opt_state, features, labels,
+                fmasks, lmasks, self.device_iteration(),
+                self.device_epoch(), self._base_key)
+            _sp.set_result(loss)
+        with telemetry.span(telemetry.PHASE_GRAD_SYNC) as _sp:
+            _sp.set_result(self.params)  # single device: ~0 (see MLN)
+        telemetry.record_step("graph", int(features[0].shape[0]))
         self._score_dev = loss
         self._score_cache = None
         cur = self.iteration
@@ -720,11 +729,14 @@ class ComputationGraph(nn_io.LazyScoreMixin):
                 jax.jit(self.tbptt_scan_fn(seg, back),
                         donate_argnums=(0, 1, 2)),
                 self._graph_key(), f"tbptt_scan:{seg}:{back}:d012")
-        (self.params, self.state, self.opt_state, new_itc,
-         mean_loss) = self._tbptt_scan[seg, back](
-            self.params, self.state, self.opt_state, features, labels,
-            fmasks, lmasks, self.device_iteration(), self.device_epoch(),
-            self._base_key)
+        with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
+            (self.params, self.state, self.opt_state, new_itc,
+             mean_loss) = self._tbptt_scan[seg, back](
+                self.params, self.state, self.opt_state, features, labels,
+                fmasks, lmasks, self.device_iteration(),
+                self.device_epoch(), self._base_key)
+            _sp.set_result(mean_loss)
+        telemetry.record_step("graph", int(features[0].shape[0]))
         self.iteration += n_seg
         self.advance_device_iteration(new_itc)
         self._score_dev = mean_loss

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.conf.multilayer import MultiLayerConfiguration
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.nn import io as nn_io
@@ -404,16 +405,27 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
                  and np.ndim(ds.features) == 3)
         if tbptt:
             # one normalization path shared with ParallelWrapper
-            return self._fit_tbptt(*self.tbptt_batch_arrays(ds))
-        features, labels, fmask, lmask = self._batch_arrays(
-            ds, lazy_lmask=True, write_back=True)
+            with telemetry.span(telemetry.PHASE_INGEST):
+                args = self.tbptt_batch_arrays(ds)
+            return self._fit_tbptt(*args)
+        with telemetry.span(telemetry.PHASE_INGEST):
+            features, labels, fmask, lmask = self._batch_arrays(
+                ds, lazy_lmask=True, write_back=True)
         if self._train_step is None:
             self._train_step = self._build_train_step()
-        (self.params, self.state, self.opt_state, loss,
-         new_itc) = self._train_step(
-            self.params, self.state, self.opt_state, features, labels, fmask,
-            lmask, self.device_iteration(), self.device_epoch(),
-            self._base_key)
+        with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
+            (self.params, self.state, self.opt_state, loss,
+             new_itc) = self._train_step(
+                self.params, self.state, self.opt_state, features, labels,
+                fmask, lmask, self.device_iteration(), self.device_epoch(),
+                self._base_key)
+            _sp.set_result(loss)
+        with telemetry.span(telemetry.PHASE_GRAD_SYNC) as _sp:
+            # single device: the step has no collective — once the loss is
+            # ready the updated params are too, so this span records ~0
+            # (the same convention bench_resnet_profile.py --phases uses)
+            _sp.set_result(self.params)
+        telemetry.record_step("multilayer", int(features.shape[0]))
         self.last_batch_size = int(features.shape[0])
         self._score_dev = loss
         self._score_cache = None
@@ -644,11 +656,14 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
                 jax.jit(self.tbptt_scan_fn(seg, back),
                         donate_argnums=(0, 1, 2)),
                 self._graph_key(), f"tbptt_scan:{seg}:{back}:d012")
-        (self.params, self.state, self.opt_state, new_itc,
-         mean_loss) = self._tbptt_scan[seg, back](
-            self.params, self.state, self.opt_state, features, labels,
-            fmask, lmask, self.device_iteration(), self.device_epoch(),
-            self._base_key)
+        with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
+            (self.params, self.state, self.opt_state, new_itc,
+             mean_loss) = self._tbptt_scan[seg, back](
+                self.params, self.state, self.opt_state, features, labels,
+                fmask, lmask, self.device_iteration(), self.device_epoch(),
+                self._base_key)
+            _sp.set_result(mean_loss)
+        telemetry.record_step("multilayer", int(features.shape[0]))
         self.iteration += n_seg
         self.advance_device_iteration(new_itc)
         self.last_batch_size = int(features.shape[0])

@@ -43,7 +43,14 @@ class ScoreIterationListener(TrainingListener):
 
 
 class PerformanceListener(TrainingListener):
-    """Examples/sec + iterations/sec (reference ``PerformanceListener``)."""
+    """Batches/sec + examples/sec (reference ``PerformanceListener``).
+
+    The timing window RESETS at every epoch start: a listener kept across
+    ``fit()`` calls would otherwise carry the previous fit's last
+    timestamp into the new run, and the first report after a refit would
+    average the idle wall-clock between fits into its rate (arbitrarily
+    low examples/sec after a pause). ``on_epoch_start`` fires at the top
+    of every fit epoch, so each run re-primes cleanly."""
 
     def __init__(self, frequency: int = 10, report_batch: bool = True,
                  stream=None):
@@ -52,7 +59,14 @@ class PerformanceListener(TrainingListener):
         self.stream = stream or sys.stdout
         self._last_time = None
         self._last_iter = None
+        self.last_batches_per_sec: Optional[float] = None
         self.last_examples_per_sec: Optional[float] = None
+
+    def on_epoch_start(self, model, epoch):
+        # a fresh fit (or epoch) must not rate against the previous one's
+        # final timestamp — re-prime on the first iteration instead
+        self._last_time = None
+        self._last_iter = None
 
     def iteration_done(self, model, iteration, epoch, score):
         now = time.perf_counter()
@@ -60,11 +74,12 @@ class PerformanceListener(TrainingListener):
             iters = iteration - self._last_iter
             dt = now - self._last_time
             if dt > 0 and iters > 0:
-                ips = iters / dt
+                bps = iters / dt
+                self.last_batches_per_sec = bps
                 batch = getattr(model, "last_batch_size", None)
-                msg = f"iterations/sec: {ips:.2f}"
+                msg = f"batches/sec: {bps:.2f}"
                 if batch and self.report_batch:
-                    self.last_examples_per_sec = ips * batch
+                    self.last_examples_per_sec = bps * batch
                     msg += f", examples/sec: {self.last_examples_per_sec:.2f}"
                 print(msg, file=self.stream)
             self._last_time = now

@@ -27,6 +27,7 @@ from __future__ import annotations
 import dataclasses
 
 import jax.numpy as jnp
+import numpy as np
 
 
 def threshold_encode(g, tau):
@@ -138,6 +139,25 @@ def bucket_partition(sizes, bucket_bytes: int):
     if cur:
         buckets.append(cur)
     return buckets
+
+
+def bucket_layout(tree, bucket_bytes=None):
+    """Host-side preview of :func:`bucketed_psum`'s schedule for a pytree
+    of (possibly abstract) arrays: the list of per-bucket payload sizes in
+    bytes, in issue order. ``bucket_bytes=None`` (the single fused
+    collective) returns one bucket holding the whole tree. Used by the
+    telemetry layer to record per-bucket collective bytes without running
+    the compiled exchange."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return []
+    sizes = [l.size * np.dtype(l.dtype).itemsize for l in leaves]
+    if bucket_bytes is None or len(leaves) <= 1:
+        return [sum(sizes)]
+    return [sum(sizes[i] for i in bucket)
+            for bucket in bucket_partition(sizes, int(bucket_bytes))]
 
 
 def bucketed_psum(tree, axis_name, bucket_bytes=None):

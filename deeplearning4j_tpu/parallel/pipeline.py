@@ -1377,6 +1377,8 @@ class PipelineParallelWrapper:
                                  if hasattr(ds, "features") else ds[0]),)
             labels = _np.asarray(ds.labels
                                  if hasattr(ds, "labels") else ds[1])
+        from deeplearning4j_tpu import telemetry
+
         rows = feats[0].shape[0]
         div = self.n_micro * self.data_size
         if rows % div:
@@ -1384,12 +1386,12 @@ class PipelineParallelWrapper:
                 f"batch of {rows} rows must divide into n_micro x "
                 f"data_axis = {self.n_micro} x {self.data_size}")
         mb = rows // self.n_micro
-        x_micro = tuple(f.reshape((self.n_micro, mb) + f.shape[1:])
-                        for f in feats)
-        y_micro = labels.reshape((self.n_micro, mb) + labels.shape[1:])
         mb_shapes = tuple((mb // self.data_size,) + f.shape[1:]
                           for f in feats)
         if not self._pipe_built:
+            # one-time pipeline construction (tracing, stage packing) —
+            # deliberately OUTSIDE the ingest span: attributing seconds of
+            # build cost to "ingest" would corrupt the phase breakdown
             micro_feats = tuple(
                 jax.ShapeDtypeStruct(s, jnp.asarray(f[:1]).dtype)
                 for s, f in zip(mb_shapes, feats))
@@ -1400,13 +1402,24 @@ class PipelineParallelWrapper:
                 f"pipeline compiled for microbatch shape "
                 f"{self._built_mb_shapes}, got {mb_shapes}; feed equal-"
                 "size batches (pad the trailing batch)")
-        x_in = (tuple(jnp.asarray(x) for x in x_micro)
-                if self._plan_kind == "dag" else jnp.asarray(x_micro[0]))
-        (self._stacked, self._stacked_state, self._stacked_opt,
-         self._out_params, self._out_opt, loss) = self._step(
-            self._stacked, self._stacked_state, self._stacked_opt,
-            self._out_params, self._out_opt, x_in, jnp.asarray(y_micro),
-            _np.float32(m.iteration), _np.float32(m.epoch))
+        with telemetry.span(telemetry.PHASE_INGEST):
+            x_micro = tuple(f.reshape((self.n_micro, mb) + f.shape[1:])
+                            for f in feats)
+            y_micro = labels.reshape((self.n_micro, mb) + labels.shape[1:])
+            x_in = (tuple(jnp.asarray(x) for x in x_micro)
+                    if self._plan_kind == "dag" else jnp.asarray(x_micro[0]))
+            y_in = jnp.asarray(y_micro)
+        with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
+            (self._stacked, self._stacked_state, self._stacked_opt,
+             self._out_params, self._out_opt, loss) = self._step(
+                self._stacked, self._stacked_state, self._stacked_opt,
+                self._out_params, self._out_opt, x_in, y_in,
+                _np.float32(m.iteration), _np.float32(m.epoch))
+            _sp.set_result(loss)
+        if telemetry.enabled():
+            telemetry.record_step("pipeline", rows)
+            telemetry.record_pipeline_schedule(self.n_stages, self.n_micro,
+                                               self.schedule)
         m.iteration += 1
         self.score_value = float(loss)
         return self.score_value

@@ -44,10 +44,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.nn import io as nn_io
 from deeplearning4j_tpu.parallel import mesh as mesh_mod
 from deeplearning4j_tpu.parallel.compression import (
     ThresholdAlgorithm,
+    bucket_layout,
     bucketed_psum,
     encode_tree,
 )
@@ -692,30 +694,64 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             self._write_back()
         return m
 
+    def _record_exchange(self, did_average: bool = False):
+        """Telemetry: count this step's cross-replica payload (the
+        per-shard gradient tree — what one fused all-reduce or the bucket
+        chain moves; an upper bound under expert_parallel, whose sharded
+        leaves stay local). The bucket layout is recorded once per
+        schedule."""
+        m = self.model
+        if self.training_mode is TrainingMode.AVERAGING:
+            # params (+state, + optionally opt) cross only on averaging
+            # iterations, not every step
+            if did_average:
+                group = (m.params, m.state) + (
+                    (m.opt_state,) if self.average_updaters else ())
+                layout = bucket_layout(group, self.gradient_bucket_bytes
+                                       if self._explicit_exchange else None)
+                telemetry.record_collective("average", sum(layout),
+                                            len(layout))
+            return
+        layout = getattr(self, "_grad_layout", None)
+        if layout is None:
+            layout = self._grad_layout = bucket_layout(
+                m.params, self.gradient_bucket_bytes)
+            op = ("threshold_psum" if self.threshold_algorithm is not None
+                  else "grad_psum")
+            telemetry.record_bucket_layout(op, layout)
+        telemetry.record_collective(
+            "threshold_psum" if self.threshold_algorithm is not None
+            else "grad_psum", sum(layout), len(layout))
+
     def _fit_batch(self, ds):
         m = self.model
-        batch = self._prep(ds)
-        rows = self._batch_rows(batch)
-        # multi-process: this batch is the LOCAL partition; pad/split over
-        # the local worker count, then assemble the global sharded batch
-        target = math.ceil(rows / self.local_workers) * self.local_workers
-        if jax.process_count() > 1:
-            # SPMD: every host must present identically-shaped local
-            # batches. Lock the shape to the first batch's padded size and
-            # pad tails up to it (unequal partitions beyond that are a
-            # documented contract violation -> clear error, not a hang).
-            if self._mp_target is None:
-                self._mp_target = target
-            if target > self._mp_target:
-                raise ValueError(
-                    f"multi-host batch of {rows} rows exceeds the "
-                    f"established per-host batch of {self._mp_target}; "
-                    f"all hosts must feed equal-size batches (repartition "
-                    f"your data as Spark does in the reference)")
-            target = self._mp_target
-        batch = self._data_sharded(mesh_mod.pad_leading(batch, target))
-        counts = mesh_mod.shard_valid_counts(rows, self.local_workers)
-        cvec = self._data_sharded(jnp.asarray(counts))
+        with telemetry.span(telemetry.PHASE_INGEST):
+            batch = self._prep(ds)
+            rows = self._batch_rows(batch)
+            # multi-process: this batch is the LOCAL partition; pad/split
+            # over the local worker count, then assemble the global
+            # sharded batch
+            target = (math.ceil(rows / self.local_workers)
+                      * self.local_workers)
+            if jax.process_count() > 1:
+                # SPMD: every host must present identically-shaped local
+                # batches. Lock the shape to the first batch's padded size
+                # and pad tails up to it (unequal partitions beyond that
+                # are a documented contract violation -> clear error, not
+                # a hang).
+                if self._mp_target is None:
+                    self._mp_target = target
+                if target > self._mp_target:
+                    raise ValueError(
+                        f"multi-host batch of {rows} rows exceeds the "
+                        f"established per-host batch of {self._mp_target}; "
+                        f"all hosts must feed equal-size batches "
+                        f"(repartition your data as Spark does in the "
+                        f"reference)")
+                target = self._mp_target
+            batch = self._data_sharded(mesh_mod.pad_leading(batch, target))
+            counts = mesh_mod.shard_valid_counts(rows, self.local_workers)
+            cvec = self._data_sharded(jnp.asarray(counts))
         # numpy scalars stage with the call (~0.1ms) — python ints or eager
         # jnp.asarray/fold_in would each cost a 20-65ms tunnel round-trip
         itc = np.int32(m.iteration)
@@ -724,42 +760,56 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         inc = (-(-int(jax.tree_util.tree_leaves(batch)[0].shape[1])
                  // self._tbptt_seg) if self._tbptt else 1)
 
-        if self.training_mode is TrainingMode.AVERAGING:
-            (self._params, self._state, self._opt, loss) = self._step(
-                self._params, self._state, self._opt, batch, itc, ep,
-                m._base_key, cvec)
-            if (m.iteration + inc) // self.averaging_frequency \
-                    > m.iteration // self.averaging_frequency:
-                self._params, self._state, self._opt = self._avg(
-                    self._params, self._state, self._opt)
-        elif self.threshold_algorithm is not None:
-            tau = np.float32(self._tau)
-            (self._params, self._state, self._opt, self._residual, loss,
-             feedback) = self._step(self._params, self._state, self._opt,
-                                    self._residual, batch, itc, ep,
-                                    m._base_key, tau, cvec)
-            # the adaptive threshold needs feedback on host — this mode
-            # inherently syncs per step (as the reference's EncodingHandler
-            # feedback loop does). tBPTT steps retune tau per SEGMENT
-            # inside the scan and return the final tau directly.
-            if self._tbptt:
-                self._tau = float(feedback)
+        did_avg = False
+        with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
+            if self.training_mode is TrainingMode.AVERAGING:
+                (self._params, self._state, self._opt, loss) = self._step(
+                    self._params, self._state, self._opt, batch, itc, ep,
+                    m._base_key, cvec)
+                did_avg = ((m.iteration + inc) // self.averaging_frequency
+                           > m.iteration // self.averaging_frequency)
+                if did_avg:
+                    self._params, self._state, self._opt = self._avg(
+                        self._params, self._state, self._opt)
+            elif self.threshold_algorithm is not None:
+                tau = np.float32(self._tau)
+                (self._params, self._state, self._opt, self._residual, loss,
+                 feedback) = self._step(self._params, self._state,
+                                        self._opt, self._residual, batch,
+                                        itc, ep, m._base_key, tau, cvec)
+                # the adaptive threshold needs feedback on host — this mode
+                # inherently syncs per step (as the reference's
+                # EncodingHandler feedback loop does). tBPTT steps retune
+                # tau per SEGMENT inside the scan and return the final tau
+                # directly.
+                if self._tbptt:
+                    self._tau = float(feedback)
+                else:
+                    self._tau = float(self.threshold_algorithm.update(
+                        self._tau, float(feedback)))
+            elif self._explicit_exchange:
+                (self._params, self._state, self._opt, loss) = self._step(
+                    self._params, self._state, self._opt, batch, itc, ep,
+                    m._base_key, cvec)
             else:
-                self._tau = float(self.threshold_algorithm.update(
-                    self._tau, float(feedback)))
-        elif self._explicit_exchange:
-            (self._params, self._state, self._opt, loss) = self._step(
-                self._params, self._state, self._opt, batch, itc, ep,
-                m._base_key, cvec)
-        else:
-            if self.expert_parallel and self._step is None:
-                self._step = self._build_expert_step(len(batch))
-            out = self._step(self._params, self._state, self._opt, *batch,
-                             itc, ep, m._base_key)
-            if self._tbptt:
-                self._params, self._state, self._opt, _, loss = out
-            else:
-                self._params, self._state, self._opt, loss = out[:4]
+                if self.expert_parallel and self._step is None:
+                    self._step = self._build_expert_step(len(batch))
+                out = self._step(self._params, self._state, self._opt,
+                                 *batch, itc, ep, m._base_key)
+                if self._tbptt:
+                    self._params, self._state, self._opt, _, loss = out
+                else:
+                    self._params, self._state, self._opt, loss = out[:4]
+            _sp.set_result(loss)
+        with telemetry.span(telemetry.PHASE_GRAD_SYNC) as _sp:
+            # the gradient all-reduce runs INSIDE the compiled step and the
+            # psum'd loss already depends on it, so the separable host-side
+            # residue here is the wait for the updated params tree (~0;
+            # use XProf for the kernel-level collective/compute split)
+            _sp.set_result(self._params)
+        if telemetry.enabled():
+            telemetry.record_step("parallel", rows)
+            self._record_exchange(did_avg)
 
         self._score_dev = loss
         self._score_cache = None

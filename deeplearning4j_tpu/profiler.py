@@ -19,7 +19,9 @@ per compiled program, the role of per-op-class totals; use
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -65,16 +67,35 @@ class OpProfiler:
 
     # -- XProf traces (per-kernel timing in TensorBoard) ---------------------
     def start_trace(self, log_dir: str) -> "OpProfiler":
+        """Begin an XProf device trace into ``log_dir`` (created if
+        missing). Starting while a trace is active restarts into the new
+        directory rather than leaking jax's active-trace state."""
+        if self._trace_dir is not None:
+            self.stop_trace()
+        os.makedirs(log_dir, exist_ok=True)
         jax.profiler.start_trace(log_dir)
         self._trace_dir = log_dir
         return self
 
     def stop_trace(self) -> Optional[str]:
+        """End the active trace and return its directory. A second stop
+        (or a stop with no trace running) is a no-op returning None."""
         if self._trace_dir is not None:
-            jax.profiler.stop_trace()
             d, self._trace_dir = self._trace_dir, None
+            jax.profiler.stop_trace()
             return d
         return None
+
+    @contextlib.contextmanager
+    def trace(self, log_dir: str):
+        """Context-manager form: ``with OpProfiler.get_instance().trace(d):``
+        brackets the traced region; the trace stops on exit even when the
+        body raises."""
+        self.start_trace(log_dir)
+        try:
+            yield log_dir
+        finally:
+            self.stop_trace()
 
 
 class ProfilerListener(TrainingListener):
@@ -91,7 +112,14 @@ class ProfilerListener(TrainingListener):
         now = time.monotonic()
         self._seen += 1
         if self._last is not None and self._seen > self.warmup:
-            self.step_times.append(now - self._last)
+            dt = now - self._last
+            self.step_times.append(dt)
+            # route step stats through the telemetry registry (the
+            # process-wide aggregation the reference's OpProfiler
+            # singleton provided): /metrics then serves the same numbers
+            from deeplearning4j_tpu import telemetry
+
+            telemetry.record_step_seconds(dt, path="profiler")
         self._last = now
 
     # -- reporting ------------------------------------------------------------

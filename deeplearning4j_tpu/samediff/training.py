@@ -206,43 +206,58 @@ def fit(sd, iterator=None, epochs: int = 1, features=None, labels=None):
     # the common one-batch-per-fit pattern; the bounded queue syncs every
     # DISPATCH_DEPTH steps instead, wherever those steps came from
     pending = sd.__dict__.setdefault("_dispatch_pending", [])
+    from deeplearning4j_tpu import telemetry
+
     for _ in range(epochs):
         for ds in batches():
-            ph = {}
-            feats = ds.features if isinstance(ds.features, (list, tuple)) \
-                else [ds.features]
-            labs = ds.labels if isinstance(ds.labels, (list, tuple)) \
-                else [ds.labels]
-            for name, arr in zip(cfg.data_set_feature_mapping, feats):
-                ph[name] = jnp.asarray(arr)
-            for name, arr in zip(cfg.data_set_label_mapping, labs):
-                ph[name] = jnp.asarray(arr)
-            if cfg.data_set_feature_mask_mapping and \
-                    getattr(ds, "features_mask", None) is not None:
-                ph[cfg.data_set_feature_mask_mapping[0]] = jnp.asarray(
-                    ds.features_mask)
-            if cfg.data_set_label_mask_mapping and \
-                    getattr(ds, "labels_mask", None) is not None:
-                ph[cfg.data_set_label_mask_mapping[0]] = jnp.asarray(
-                    ds.labels_mask)
-            # write staged arrays back so a reused DataSet transfers once
-            # (reference DataSet#migrate semantics, matching the networks)
-            if isinstance(ds, DataSet):
-                fmap = list(cfg.data_set_feature_mapping or [])[:len(feats)]
-                lmap = list(cfg.data_set_label_mapping or [])[:len(labs)]
-                if len(fmap) == len(feats):
-                    staged = [ph[n] for n in fmap]
-                    ds.features = (staged if isinstance(
-                        ds.features, (list, tuple)) else staged[0])
-                if len(lmap) == len(labs):
-                    staged = [ph[n] for n in lmap]
-                    ds.labels = (staged if isinstance(
-                        ds.labels, (list, tuple)) else staged[0])
+            with telemetry.span(telemetry.PHASE_INGEST):
+                ph = {}
+                feats = (ds.features
+                         if isinstance(ds.features, (list, tuple))
+                         else [ds.features])
+                labs = ds.labels if isinstance(ds.labels, (list, tuple)) \
+                    else [ds.labels]
+                for name, arr in zip(cfg.data_set_feature_mapping, feats):
+                    ph[name] = jnp.asarray(arr)
+                for name, arr in zip(cfg.data_set_label_mapping, labs):
+                    ph[name] = jnp.asarray(arr)
+                if cfg.data_set_feature_mask_mapping and \
+                        getattr(ds, "features_mask", None) is not None:
+                    ph[cfg.data_set_feature_mask_mapping[0]] = jnp.asarray(
+                        ds.features_mask)
+                if cfg.data_set_label_mask_mapping and \
+                        getattr(ds, "labels_mask", None) is not None:
+                    ph[cfg.data_set_label_mask_mapping[0]] = jnp.asarray(
+                        ds.labels_mask)
+                # write staged arrays back so a reused DataSet transfers
+                # once (reference DataSet#migrate semantics, matching the
+                # networks)
+                if isinstance(ds, DataSet):
+                    fmap = list(cfg.data_set_feature_mapping
+                                or [])[:len(feats)]
+                    lmap = list(cfg.data_set_label_mapping or [])[:len(labs)]
+                    if len(fmap) == len(feats):
+                        staged = [ph[n] for n in fmap]
+                        ds.features = (staged if isinstance(
+                            ds.features, (list, tuple)) else staged[0])
+                    if len(lmap) == len(labs):
+                        staged = [ph[n] for n in lmap]
+                        ds.labels = (staged if isinstance(
+                            ds.labels, (list, tuple)) else staged[0])
             # np scalar stages with the call; a bare python int would take
             # the slow weak-type conversion path (~20ms on the tunnel)
-            trainables, opt_state, loss = step(
-                trainables, frozen, opt_state,
-                np.float32(sd._iteration_count), ph)
+            with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
+                trainables, opt_state, loss = step(
+                    trainables, frozen, opt_state,
+                    np.float32(sd._iteration_count), ph)
+                _sp.set_result(loss)
+            with telemetry.span(telemetry.PHASE_GRAD_SYNC) as _sp:
+                _sp.set_result(trainables)  # single device: ~0
+            if telemetry.enabled():
+                rows = getattr(ph.get(next(iter(ph), None), None),
+                               "shape", (0,))
+                telemetry.record_step("samediff",
+                                      int(rows[0]) if rows else 0)
             sd._iteration_count += 1
             history.append(loss)
             pending.append(loss)

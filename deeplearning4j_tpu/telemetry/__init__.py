@@ -1,0 +1,212 @@
+"""Unified telemetry: step-trace spans, metrics registry, export surfaces.
+
+Three pillars (docs/observability.md has the guided tour):
+
+1. **Spans** (``telemetry.span("ingest"|"compute"|"grad_sync")``): a
+   low-overhead, nesting-aware span API recording into a ring buffer;
+   exported as Chrome-trace JSON and aggregated into per-phase
+   p50/p95/p99 histograms. ``enable(sync=True)`` makes spans
+   ``jax.block_until_ready`` their registered result so durations are
+   true device times; the default async mode never syncs.
+2. **Registry** (``telemetry.registry.REGISTRY``): process-wide
+   counters/gauges/histograms — steps, examples, collective bytes,
+   ingest bytes, pipeline bubble fraction — plus scrape-time collectors
+   for AOT-cache stats, device-memory watermarks and host RSS.
+3. **Export**: ``/metrics`` (Prometheus text) + ``/metrics.json`` on
+   ``ui.server.UIServer``, a ``TelemetryListener`` bridging into
+   ``ui.stats`` storages, and ``dump_jsonl`` for offline diffing.
+
+The master switch gates every hot-path write: with telemetry disabled
+(the default) each instrumented site costs ONE flag check — no
+allocation, no lock, no host sync. Scrape surfaces (collectors,
+``/metrics``) work even while disabled; only per-step recording stops.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.telemetry import registry as registry  # noqa: F401
+from deeplearning4j_tpu.telemetry import spans as spans  # noqa: F401
+from deeplearning4j_tpu.telemetry.export import (  # noqa: F401
+    TelemetryListener,
+    dump_jsonl,
+    telemetry_record,
+)
+from deeplearning4j_tpu.telemetry.registry import REGISTRY  # noqa: F401
+from deeplearning4j_tpu.telemetry.spans import (  # noqa: F401
+    PHASE_COMPUTE,
+    PHASE_GRAD_SYNC,
+    PHASE_INGEST,
+    PHASES,
+    enable,
+    enabled,
+    disable,
+    events,
+    export_chrome_trace,
+    phase_stats,
+    span,
+    sync_mode,
+)
+
+
+def reset() -> None:
+    """Clear recorded spans AND metrics (flags/collectors untouched) —
+    the per-test / per-bench-round zero point."""
+    spans.reset()
+    REGISTRY.reset()
+
+
+# --------------------------------------------------------------------------
+# hot-path recording helpers (each is one flag check when disabled)
+# --------------------------------------------------------------------------
+
+def record_step(path: str, examples: int = 0) -> None:
+    """Count one optimization step (and its examples) for a training
+    path: ``multilayer`` / ``graph`` / ``samediff`` / ``parallel`` /
+    ``pipeline``."""
+    if not spans._enabled:
+        return
+    REGISTRY.counter("dl4j_training_steps_total",
+                     help="optimization steps", path=path).inc()
+    if examples:
+        REGISTRY.counter("dl4j_training_examples_total",
+                         help="examples consumed", path=path).inc(examples)
+
+
+def record_collective(op: str, nbytes: float, buckets: int = 1) -> None:
+    """Count one cross-replica exchange: ``nbytes`` = per-shard payload
+    crossing the interconnect, ``buckets`` = collectives issued for it
+    (1 = single fused all-reduce)."""
+    if not spans._enabled:
+        return
+    REGISTRY.counter("dl4j_collective_bytes_total",
+                     help="per-shard bytes exchanged", op=op).inc(nbytes)
+    REGISTRY.counter("dl4j_collective_ops_total",
+                     help="collectives issued", op=op).inc(buckets)
+
+
+def record_bucket_layout(op: str, bucket_bytes_list) -> None:
+    """Record a bucketed collective's layout (once per compiled schedule):
+    bucket count gauge + per-bucket byte sizes histogram."""
+    if not spans._enabled:
+        return
+    REGISTRY.gauge("dl4j_collective_buckets",
+                   help="buckets in the collective schedule", op=op).set(
+        len(bucket_bytes_list))
+    h = REGISTRY.histogram("dl4j_collective_bucket_bytes",
+                           help="per-bucket payload bytes", op=op)
+    for b in bucket_bytes_list:
+        h.observe(b)
+
+
+def record_ingest(nbytes: float, batches: int = 1) -> None:
+    """Count host->device batch staging (DeviceRingIterator and friends)."""
+    if not spans._enabled:
+        return
+    REGISTRY.counter("dl4j_ingest_batches_total",
+                     help="batches staged to device").inc(batches)
+    REGISTRY.counter("dl4j_ingest_bytes_total",
+                     help="bytes staged to device").inc(nbytes)
+
+
+def record_pipeline_schedule(n_stages: int, n_micro: int,
+                             schedule: str) -> None:
+    """Record a pipeline wrapper's static bubble fraction
+    ``(S-1)/(S+M-1)`` — the drain/fill cost both GPipe and 1F1B
+    (PipeDream-flush) schedules pay."""
+    if not spans._enabled:
+        return
+    frac = (n_stages - 1) / max(n_stages + n_micro - 1, 1)
+    REGISTRY.gauge("dl4j_pipeline_bubble_fraction",
+                   help="(S-1)/(S+M-1) fill/drain bubble",
+                   schedule=schedule).set(frac)
+    REGISTRY.gauge("dl4j_pipeline_stages", schedule=schedule).set(n_stages)
+    REGISTRY.gauge("dl4j_pipeline_microbatches",
+                   schedule=schedule).set(n_micro)
+
+
+def record_step_seconds(seconds: float, path: str = "listener") -> None:
+    """Observe one step duration into the registry histogram (the
+    ProfilerListener / OpProfiler routing)."""
+    if not spans._enabled:
+        return
+    REGISTRY.histogram("dl4j_step_seconds", help="host-observed step time",
+                       path=path).observe(seconds)
+
+
+# --------------------------------------------------------------------------
+# scrape-time collectors (run on snapshot/render, never per step)
+# --------------------------------------------------------------------------
+
+@REGISTRY.register_collector
+def _collect_aot_cache(reg) -> None:
+    from deeplearning4j_tpu.optimize import aot_cache
+
+    st = aot_cache.stats()
+    for k in ("hits", "misses", "entries", "fallbacks", "overflows"):
+        reg.gauge(f"dl4j_aot_cache_{k}",
+                  help="AOT step-executable cache").set(st[k])
+    reg.gauge("dl4j_aot_cache_compile_seconds_total").set(
+        st["compile_seconds"])
+    total = st["hits"] + st["misses"]
+    reg.gauge("dl4j_aot_cache_hit_ratio",
+              help="hits / (hits + misses)").set(
+        st["hits"] / total if total else 0.0)
+
+
+@REGISTRY.register_collector
+def _collect_device_memory(reg) -> None:
+    import jax
+
+    for d in jax.local_devices():
+        try:
+            ms = d.memory_stats() or {}
+        except Exception:
+            ms = {}
+        if "bytes_in_use" in ms:
+            reg.gauge("dl4j_device_bytes_in_use", device=str(d)).set(
+                ms["bytes_in_use"])
+        if "peak_bytes_in_use" in ms:
+            reg.gauge("dl4j_device_peak_bytes",
+                      help="HBM high-watermark", device=str(d)).set(
+                ms["peak_bytes_in_use"])
+    try:
+        live = jax.live_arrays()
+        reg.gauge("dl4j_live_arrays",
+                  help="process-wide live jax.Array handles").set(len(live))
+        reg.gauge("dl4j_live_array_bytes").set(
+            sum(getattr(a, "nbytes", 0) or 0 for a in live))
+    except Exception:
+        pass
+
+
+@REGISTRY.register_collector
+def _collect_host_memory(reg) -> None:
+    try:
+        with open("/proc/self/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        import os
+
+        reg.gauge("dl4j_host_rss_bytes").set(
+            rss_pages * os.sysconf("SC_PAGE_SIZE"))
+    except Exception:
+        pass
+
+
+def prometheus_text() -> str:
+    """The full ``/metrics`` payload: registry metrics + span phase
+    histograms rendered as summaries."""
+    text = REGISTRY.render_prometheus()
+    phases = phase_stats()
+    if phases:
+        lines = ["# TYPE dl4j_phase_ms summary"]
+        for name, st in phases.items():
+            for q in ("p50", "p95", "p99"):
+                lines.append(
+                    f'dl4j_phase_ms{{phase="{name}",quantile='
+                    f'"0.{q[1:]}"}} {st[f"{q}_ms"]:.9g}')
+            lines.append(f'dl4j_phase_ms_sum{{phase="{name}"}} '
+                         f'{st["total_ms"]:.9g}')
+            lines.append(f'dl4j_phase_ms_count{{phase="{name}"}} '
+                         f'{st["count"]}')
+        text += "\n".join(lines) + "\n"
+    return text

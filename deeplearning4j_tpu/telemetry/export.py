@@ -1,0 +1,67 @@
+"""Export surfaces: StatsStorage bridge + JSONL persistence.
+
+- ``TelemetryListener`` snapshots the registry + phase histograms into any
+  ``ui.stats.StatsStorage`` every N iterations, so telemetry rides the
+  same dashboard/remote-router plumbing as StatsListener records.
+- ``dump_jsonl`` appends one self-contained snapshot line to a file —
+  the offline-diff format for comparing bench rounds
+  (``jq`` / ``FileStatsStorage`` both read it).
+
+The HTTP surfaces (``/metrics`` Prometheus text, ``/metrics.json``) live
+on ``ui.server.UIServer``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+
+def telemetry_record(session_id: Optional[str] = None,
+                     iteration: Optional[int] = None) -> dict:
+    """One combined snapshot: registry metrics + span phase histograms."""
+    from deeplearning4j_tpu.telemetry import registry, spans
+
+    rec = {
+        "timestamp": time.time(),
+        "telemetry": registry.REGISTRY.snapshot(),
+        "phases": spans.phase_stats(),
+    }
+    if session_id is not None:
+        rec["session"] = session_id
+    if iteration is not None:
+        rec["iteration"] = int(iteration)
+    return rec
+
+
+def dump_jsonl(path: str, extra: Optional[dict] = None) -> str:
+    """Append one snapshot line to ``path`` (JSONL). ``extra`` keys merge
+    into the record (e.g. ``{"round": "r07", "bench": "resnet"}``) so
+    offline diffs across bench rounds can self-describe."""
+    rec = telemetry_record()
+    if extra:
+        rec.update(extra)
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    return path
+
+
+class TelemetryListener(TrainingListener):
+    """Bridge the registry + phase stats into a ``StatsStorage`` every
+    ``frequency`` iterations (the reference dashboard's System-tab role,
+    generalized to the whole metrics registry). Collection is a pure host
+    read — no device sync — so it composes with the async fit loops."""
+
+    def __init__(self, storage, frequency: int = 10,
+                 session_id: Optional[str] = None):
+        self.storage = storage
+        self.frequency = max(1, int(frequency))
+        self.session_id = session_id or f"telemetry_{int(time.time())}"
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if iteration % self.frequency:
+            return
+        self.storage.put(telemetry_record(self.session_id, iteration))

@@ -1,0 +1,231 @@
+"""Step-trace spans — the "where did this step's time go" primitive.
+
+A span brackets one host-observable phase of a training step::
+
+    with telemetry.span("ingest"):
+        features, labels = stage(batch)
+    with telemetry.span("compute") as sp:
+        loss = sp.set_result(train_step(...))   # async dispatch
+
+Finished spans land in a bounded ring buffer (process-wide, thread-safe
+under the GIL via ``deque(maxlen=...)``) and can be exported as
+Chrome-trace JSON (``chrome://tracing`` / Perfetto) or aggregated into
+per-phase histograms (p50/p95/p99).
+
+Timing is ``jax.block_until_ready``-aware: jax dispatch is asynchronous,
+so a span around a jitted call measures only the enqueue (~µs) unless the
+device result is forced. ``Span.set_result(x)`` registers the call's
+output; when spans were enabled with ``sync=True`` the span blocks on it
+before taking the end timestamp, so the recorded duration is the real
+device time of the phase. With ``sync=False`` (the default) nothing ever
+forces a host sync — the async fit pipeline stays fully queued and the
+spans record host-side dispatch cost only.
+
+Disabled mode is the hot-path contract: ``span(name)`` is ONE module-flag
+check returning a shared no-op singleton — no allocation, no lock, no
+host sync (pinned by tests/test_telemetry.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+# Canonical training-phase names. Every instrumented training path
+# (MultiLayerNetwork, ComputationGraph, SameDiff, ParallelWrapper,
+# PipelineParallelWrapper) reports this same breakdown, and
+# bench_resnet_profile.py --phases derives its row keys from these so the
+# bench and the framework cannot drift (tests/test_telemetry.py).
+PHASE_INGEST = "ingest"
+PHASE_COMPUTE = "compute"
+PHASE_GRAD_SYNC = "grad_sync"
+PHASES = (PHASE_INGEST, PHASE_COMPUTE, PHASE_GRAD_SYNC)
+
+_enabled = False
+_sync = False
+_ring: "collections.deque" = collections.deque(maxlen=4096)
+_tls = threading.local()
+
+
+class _NullSpan:
+    """Shared disabled-mode span: every method is a no-op."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set_result(self, x):
+        return x
+
+    def annotate(self, **kw):
+        return self
+
+
+NULL_SPAN = _NullSpan()
+
+
+class Span:
+    __slots__ = ("name", "t0", "t1", "depth", "parent", "_result", "attrs")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.t0 = self.t1 = 0
+        self.depth = 0
+        self.parent: Optional[str] = None
+        self._result = None
+        self.attrs: Optional[dict] = None
+
+    def set_result(self, x):
+        """Register the phase's device output; returned unchanged. In
+        sync mode the span blocks on it before closing, so the duration
+        covers the device work — in async mode it is never touched."""
+        self._result = x
+        return x
+
+    def annotate(self, **kw):
+        if self.attrs is None:
+            self.attrs = {}
+        self.attrs.update(kw)
+        return self
+
+    def __enter__(self):
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        self.depth = len(stack)
+        self.parent = stack[-1].name if stack else None
+        stack.append(self)
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        if _sync and self._result is not None:
+            try:
+                import jax
+
+                jax.block_until_ready(self._result)
+            except Exception:
+                pass  # non-jax results (or deleted buffers) time as-is
+        self.t1 = time.perf_counter_ns()
+        self._result = None  # never pin device buffers in the ring
+        stack = getattr(_tls, "stack", None)
+        if stack and stack[-1] is self:
+            stack.pop()
+        _ring.append((self.name, self.t0, self.t1 - self.t0, self.depth,
+                      self.parent, threading.get_ident(), self.attrs))
+        return False
+
+
+def span(name: str):
+    """A timing span for one phase. Disabled: one flag check, shared
+    no-op singleton (zero allocation). Enabled: records into the ring."""
+    if not _enabled:
+        return NULL_SPAN
+    return Span(name)
+
+
+def enable(sync: bool = False, ring_size: int = 4096) -> None:
+    """Turn span recording on. ``sync=True`` makes spans block on their
+    registered device result (``set_result``) for true per-phase device
+    timing — at the cost of one host sync per span, so keep it off for
+    production throughput runs."""
+    global _enabled, _sync, _ring
+    if ring_size != _ring.maxlen:
+        _ring = collections.deque(_ring, maxlen=int(ring_size))
+    _sync = bool(sync)
+    _enabled = True
+
+
+def disable() -> None:
+    """Turn recording off (the ring is kept so traces remain exportable)."""
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def sync_mode() -> bool:
+    return _enabled and _sync
+
+
+def reset() -> None:
+    """Drop recorded spans (flags untouched)."""
+    _ring.clear()
+
+
+def events() -> List[dict]:
+    """Finished spans, oldest first, as dicts (ns timestamps)."""
+    return [{"name": n, "start_ns": t0, "duration_ns": dur, "depth": depth,
+             "parent": parent, "thread": tid,
+             **({"attrs": attrs} if attrs else {})}
+            for (n, t0, dur, depth, parent, tid, attrs) in list(_ring)]
+
+
+def nearest_rank(sorted_vals, q: float):
+    """Nearest-rank percentile (q in [0, 1]) over a sorted list — the ONE
+    quantile definition shared by span phase stats and
+    ``registry.Histogram`` so both /metrics surfaces agree."""
+    n = len(sorted_vals)
+    if n == 0:
+        return float("nan")
+    rank = max(1, -(-int(q * 1000 * n) // 1000))  # ceil(q*n), int math
+    return sorted_vals[min(n, rank) - 1]
+
+
+_percentile = nearest_rank  # internal alias
+
+
+def phase_stats() -> Dict[str, dict]:
+    """Aggregate the ring into per-phase duration histograms:
+    ``{name: {count, total_ms, mean_ms, p50_ms, p95_ms, p99_ms, max_ms}}``
+    (sorted by name — deterministic for a given ring)."""
+    per: Dict[str, List[int]] = {}
+    for (name, _t0, dur, _d, _p, _tid, _a) in list(_ring):
+        per.setdefault(name, []).append(dur)
+    out = {}
+    for name in sorted(per):
+        ds = sorted(per[name])
+        total = sum(ds)
+        out[name] = {
+            "count": len(ds),
+            "total_ms": total / 1e6,
+            "mean_ms": total / len(ds) / 1e6,
+            "p50_ms": _percentile(ds, 0.50) / 1e6,
+            "p95_ms": _percentile(ds, 0.95) / 1e6,
+            "p99_ms": _percentile(ds, 0.99) / 1e6,
+            "max_ms": ds[-1] / 1e6,
+        }
+    return out
+
+
+def export_chrome_trace(path: str) -> str:
+    """Write the ring as Chrome-trace JSON (complete "X" events, µs),
+    loadable in chrome://tracing / Perfetto / TensorBoard's trace viewer.
+    Returns ``path``."""
+    pid = os.getpid()
+    evts = []
+    for (name, t0, dur, depth, parent, tid, attrs) in list(_ring):
+        args = {"depth": depth}
+        if parent:
+            args["parent"] = parent
+        if attrs:
+            args.update(attrs)
+        evts.append({"name": name, "ph": "X", "ts": t0 / 1e3,
+                     "dur": dur / 1e3, "pid": pid, "tid": tid,
+                     "args": args})
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": evts, "displayTimeUnit": "ms"}, f)
+    return path

@@ -3,8 +3,9 @@ self-contained HTML with inline SVG charts: score vs iteration,
 update:param log-ratio per layer, param mean magnitudes, and iteration
 timing. Two modes: ``render(path)`` writes a static file; ``start(port)``
 serves it live over HTTP (stdlib ThreadingHTTPServer — the role of the
-reference's Play/Vertx server) with a ``/train/stats.json`` endpoint and
-auto-refresh, no JS dependencies."""
+reference's Play/Vertx server) with ``/train/stats.json``, a Prometheus
+``/metrics`` scrape + ``/metrics.json`` (telemetry subsystem,
+docs/observability.md) and auto-refresh, no JS dependencies."""
 
 from __future__ import annotations
 
@@ -178,6 +179,19 @@ class UIServer:
                 elif self.path == "/train/stats.json":
                     recs = [r for st in ui._storages for r in st.records()]
                     payload = _json.dumps(recs).encode()
+                    ctype = "application/json"
+                elif self.path == "/metrics":
+                    # Prometheus text exposition: registry metrics +
+                    # span phase summaries (telemetry subsystem)
+                    from deeplearning4j_tpu import telemetry
+
+                    payload = telemetry.prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path == "/metrics.json":
+                    from deeplearning4j_tpu import telemetry
+
+                    payload = _json.dumps(
+                        telemetry.telemetry_record()).encode()
                     ctype = "application/json"
                 else:
                     self.send_response(404)

@@ -40,16 +40,29 @@ class InMemoryStatsStorage(StatsStorage):
 
 class FileStatsStorage(StatsStorage):
     """JSONL-on-disk storage (reference ``FileStatsStorage`` uses MapDB;
-    JSONL keeps it greppable and append-only)."""
+    JSONL keeps it greppable and append-only). Corrupt or truncated lines
+    (a run killed mid-write, a partial copy) are SKIPPED on load — counted
+    in ``corrupt_lines`` — instead of poisoning every later read: the
+    reference reopens damaged MapDB files the same forgiving way."""
 
     def __init__(self, path: str):
         self.path = str(path)
         self._records: List[dict] = []
+        self.corrupt_lines = 0
         try:
             with open(self.path) as f:
                 for line in f:
-                    if line.strip():
-                        self._records.append(json.loads(line))
+                    if not line.strip():
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        self.corrupt_lines += 1
+                        continue
+                    if isinstance(rec, dict):
+                        self._records.append(rec)
+                    else:
+                        self.corrupt_lines += 1
         except FileNotFoundError:
             pass
 
