@@ -10,6 +10,14 @@ telemetry-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests -q -m telemetry \
 		-p no:cacheprovider
 
+.PHONY: health-smoke
+# Health-layer smoke: guard-vector math, anomaly policies
+# (WARN/SKIP_STEP/ROLLBACK/HALT), and the induced-NaN e2e that must HALT
+# cleanly and leave a flight-recorder crash bundle behind.
+health-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests -q -m health \
+		-p no:cacheprovider
+
 .PHONY: tier1
 tier1:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \

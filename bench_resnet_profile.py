@@ -84,6 +84,11 @@ def main():
     ap.add_argument("--phases", action="store_true",
                     help="per-phase step breakdown (ingest / compute / "
                          "sync overlap) instead of the prefix sweep")
+    ap.add_argument("--health", action="store_true",
+                    help="enable the in-graph health guards (WARN policy) "
+                         "so train_step / --phases rows measure the "
+                         "guarded step — compare against a run without "
+                         "the flag for the guard overhead (<5%% target)")
     args = ap.parse_args()
     batch = args.batch
 
@@ -93,6 +98,11 @@ def main():
     from deeplearning4j_tpu.conf.updaters import Adam
     from deeplearning4j_tpu.nn.graph import ComputationGraph
     from deeplearning4j_tpu.zoo.graphs import ResNet50
+
+    if args.health:
+        from deeplearning4j_tpu.telemetry import health
+
+        health.configure(policy=health.AnomalyPolicy.WARN)
 
     model = ResNet50(num_classes=CLASSES, height=IMG, width=IMG,
                      updater=Adam(learning_rate=1e-3))

@@ -44,7 +44,12 @@ class MaxEpochsTerminationCondition(EpochTerminationCondition):
 
 class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
     """Stop after ``max_epochs_without_improvement`` non-improving epochs
-    (improvement = score drop greater than ``min_improvement``)."""
+    (improvement = score drop greater than ``min_improvement``).
+
+    NaN-safe: a non-finite score terminates EXPLICITLY (``last_reason``
+    says why) instead of silently counting as "no improvement" — with
+    float comparisons every NaN compare is False, so a diverged run
+    would otherwise grind through the whole patience window on NaN."""
 
     def __init__(self, max_epochs_without_improvement: int,
                  min_improvement: float = 0.0):
@@ -52,28 +57,49 @@ class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
         self.min_improvement = float(min_improvement)
         self._best = float("inf")
         self._bad = 0
+        self.last_reason: Optional[str] = None
 
     def initialize(self):
         self._best = float("inf")
         self._bad = 0
+        self.last_reason = None
 
     def terminate(self, epoch, score):
+        if not np.isfinite(score):
+            self.last_reason = f"non-finite score {score} at epoch {epoch}"
+            return True
         if score < self._best - self.min_improvement:
             self._best = score
             self._bad = 0
             return False
         self._bad += 1
-        return self._bad > self.patience
+        if self._bad > self.patience:
+            self.last_reason = (f"no improvement in {self._bad} epochs "
+                                f"(best {self._best})")
+            return True
+        return False
 
 
 class BestScoreEpochTerminationCondition(EpochTerminationCondition):
-    """Stop once the score is at/below a target (reference class)."""
+    """Stop once the score is at/below a target (reference class).
+    NaN-safe: a non-finite score terminates explicitly (it will never
+    reach the target; ``score <= target`` is silently False for NaN)."""
 
     def __init__(self, best_expected_score: float):
         self.target = float(best_expected_score)
+        self.last_reason: Optional[str] = None
+
+    def initialize(self):
+        self.last_reason = None
 
     def terminate(self, epoch, score):
-        return score <= self.target
+        if not np.isfinite(score):
+            self.last_reason = f"non-finite score {score} at epoch {epoch}"
+            return True
+        if score <= self.target:
+            self.last_reason = f"score {score} reached target {self.target}"
+            return True
+        return False
 
 
 class IterationTerminationCondition:
@@ -113,6 +139,41 @@ class MaxScoreIterationTerminationCondition(IterationTerminationCondition):
 class InvalidScoreIterationTerminationCondition(IterationTerminationCondition):
     def terminate(self, score):
         return not np.isfinite(score)
+
+
+class DivergenceTerminationCondition(IterationTerminationCondition):
+    """Stop the fit when the run diverges: a non-finite iteration score,
+    OR the health monitor (``telemetry.health``) observed non-finite
+    steps since this fit started — so an in-graph guard trip (e.g. a
+    NaN gradient under ``SKIP_STEP``, where the *score* may still look
+    finite) also terminates the early-stopping loop."""
+
+    def __init__(self):
+        self._baseline = 0
+        self.last_reason: Optional[str] = None
+
+    def initialize(self):
+        from deeplearning4j_tpu.telemetry import health
+
+        m = health.monitor()
+        m.flush()
+        self._baseline = m.nonfinite_steps
+        self.last_reason = None
+
+    def terminate(self, score):
+        if not np.isfinite(score):
+            self.last_reason = f"non-finite score {score}"
+            return True
+        from deeplearning4j_tpu.telemetry import health
+
+        m = health.monitor()
+        m.flush()
+        if m.nonfinite_steps > self._baseline:
+            self.last_reason = (
+                f"{m.nonfinite_steps - self._baseline} non-finite step(s) "
+                f"observed by the health monitor (policy {m.policy.value})")
+            return True
+        return False
 
 
 # ---------------------------------------------------------------------------
@@ -292,6 +353,9 @@ class EarlyStoppingTrainer:
                 for cond in cfg.iteration_conditions:
                     if cond.terminate(score):
                         details = f"{type(cond).__name__} at score {score}"
+                        why = getattr(cond, "last_reason", None)
+                        if why:
+                            details += f" ({why})"
                         reason = TerminationReason.ITERATION
                         stop = True
                         break
@@ -320,6 +384,9 @@ class EarlyStoppingTrainer:
                     continue
                 if cond.terminate(epoch, scores.get(epoch, best_score)):
                     details = type(cond).__name__
+                    why = getattr(cond, "last_reason", None)
+                    if why:
+                        details += f" ({why})"
                     reason = TerminationReason.EPOCH
                     stop = True
                     break

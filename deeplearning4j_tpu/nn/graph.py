@@ -250,8 +250,14 @@ class ComputationGraph(nn_io.LazyScoreMixin):
                     total = total + r.score_term(p)
         return total
 
-    def train_step_fn(self):
-        """Raw (unjitted) pure train step for parallel wrappers (stage-7)."""
+    def train_step_fn(self, guards: str = ""):
+        """Raw (unjitted) pure train step for parallel wrappers (stage-7).
+
+        ``guards`` (``telemetry.health.graph_mode()``): ``"observe"``
+        appends the packed health guard vector; ``"skip"`` additionally
+        applies the in-graph SKIP_STEP select (see MultiLayerNetwork
+        ``train_step_fn`` — identical contract)."""
+        from deeplearning4j_tpu.telemetry import health
 
         def step(params, state, opt_state, features, labels, fmasks,
                  lmasks, it, ep, rng, carries=None):
@@ -270,12 +276,31 @@ class ComputationGraph(nn_io.LazyScoreMixin):
                 g = solver.normalize_layer_gradients(layer_conf, grads[k])
                 new_params[k], new_opt[k] = solver.apply_updater_to_layer(
                     layer_conf, upd, params[k], g, opt_state[k], lr, it, ep)
+            if carries is not None:
+                # tBPTT: the next segment resumes from this segment's
+                # final RNN state, detached (gradients do not flow across
+                # segments — reference BackpropType.TruncatedBPTT)
+                new_carries = jax.lax.stop_gradient(new_carries)
+            if guards:
+                vec = health.guard_vector(loss, grads, params=params,
+                                          new_params=new_params)
+                if guards == "skip":
+                    if carries is None:
+                        (new_params, new_state, new_opt) = health.apply_skip(
+                            vec, (new_params, new_state, new_opt),
+                            (params, state, opt_state))
+                    else:
+                        (new_params, new_state, new_opt,
+                         new_carries) = health.apply_skip(
+                            vec,
+                            (new_params, new_state, new_opt, new_carries),
+                            (params, state, opt_state, carries))
+                if carries is None:
+                    return new_params, new_state, new_opt, loss, vec
+                return (new_params, new_state, new_opt, loss, new_carries,
+                        vec)
             if carries is None:
                 return new_params, new_state, new_opt, loss
-            # tBPTT: the next segment resumes from this segment's final RNN
-            # state, detached (gradients do not flow across segments —
-            # reference BackpropType.TruncatedBPTT semantics)
-            new_carries = jax.lax.stop_gradient(new_carries)
             return new_params, new_state, new_opt, loss, new_carries
 
         return step
@@ -339,18 +364,21 @@ class ComputationGraph(nn_io.LazyScoreMixin):
             reset = lambda: None  # noqa: E731
         else:
             raise TypeError(f"cannot fit from {type(data)}")
-        for _ in range(epochs):
-            for lst in self.listeners:
-                lst.on_epoch_start(self, self.epoch)
-            pending = []
-            for ds in batches:
-                pending.append(self._fit_batch_async(ds))
-                nn_io.drain(pending)
-            nn_io.drain(pending, force=True)
-            reset()
-            for lst in self.listeners:
-                lst.on_epoch_end(self, self.epoch)
-            self.epoch += 1
+        from deeplearning4j_tpu.telemetry import flightrec
+
+        with flightrec.flight_recorder(model=self):
+            for _ in range(epochs):
+                for lst in self.listeners:
+                    lst.on_epoch_start(self, self.epoch)
+                pending = []
+                for ds in batches:
+                    pending.append(self._fit_batch_async(ds))
+                    nn_io.drain(pending)
+                nn_io.drain(pending, force=True)
+                reset()
+                for lst in self.listeners:
+                    lst.on_epoch_end(self, self.epoch)
+                self.epoch += 1
         return self
 
     def _dequant(self, x, idx: int = 0):
@@ -437,8 +465,12 @@ class ComputationGraph(nn_io.LazyScoreMixin):
             # no sequence inputs at all: plain static batch under a tBPTT
             # conf trains via the standard step (MultiLayerNetwork's
             # behavior for 2-D features)
-        if self._train_step is None:
-            raw = self.train_step_fn()
+        from deeplearning4j_tpu.telemetry import health
+
+        mode = health.graph_mode()
+        if self._train_step is None \
+                or getattr(self, "_train_step_mode", "") != mode:
+            raw = self.train_step_fn(guards=mode)
             dtype = self._dtype
 
             # per-step scalars (iteration, epoch, rng fold, default masks)
@@ -450,23 +482,32 @@ class ComputationGraph(nn_io.LazyScoreMixin):
                 lmasks = tuple(
                     jnp.ones((l.shape[0],), dtype) if m is None else m
                     for m, l in zip(lmasks, labels))
-                new_p, new_s, new_o, loss = raw(
-                    params, state, opt_state, features, labels, fmasks,
-                    lmasks, it, ep, rng)
+                out = raw(params, state, opt_state, features, labels,
+                          fmasks, lmasks, it, ep, rng)
+                new_p, new_s, new_o, loss = out[:4]
+                if mode:
+                    return new_p, new_s, new_o, loss, itc + 1, out[4]
                 return new_p, new_s, new_o, loss, itc + 1
 
             self._train_step = aot_cache.wrap(
                 jax.jit(step, donate_argnums=(0, 1, 2, 7)),
-                self._graph_key(), "train_step:d012+itc")
+                self._graph_key(),
+                f"train_step:d012+itc{health.cache_tag()}")
+            self._train_step_mode = mode
+            self._guard_keys = health.bucket_keys(self.params or {})
         with telemetry.span(telemetry.PHASE_INGEST):
             features, labels, fmasks, lmasks = self._prep_batch(
                 ds, lazy_lmasks=True, write_back=True)
+        gvec = None
         with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
-            (self.params, self.state, self.opt_state, loss,
-             new_itc) = self._train_step(
+            out = self._train_step(
                 self.params, self.state, self.opt_state, features, labels,
                 fmasks, lmasks, self.device_iteration(),
                 self.device_epoch(), self._base_key)
+            (self.params, self.state, self.opt_state, loss,
+             new_itc) = out[:5]
+            if mode:
+                gvec = out[5]
             _sp.set_result(loss)
         with telemetry.span(telemetry.PHASE_GRAD_SYNC) as _sp:
             _sp.set_result(self.params)  # single device: ~0 (see MLN)
@@ -476,6 +517,11 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         cur = self.iteration
         self.iteration += 1  # listeners see iteration == next-to-run
         self.advance_device_iteration(new_itc)
+        if mode:
+            health.observe_step(
+                self, "graph", cur, self.epoch, loss, gvec,
+                self._guard_keys, batch=(features, labels),
+                rng_seed=int(getattr(self.conf, "seed", 0) or 0))
         for lst in self.listeners:
             lst.iteration_done(self, cur, self.epoch, loss)
         return loss
@@ -624,19 +670,23 @@ class ComputationGraph(nn_io.LazyScoreMixin):
 
         return segments, zero_carries, advance, cut
 
-    def tbptt_scan_fn(self, seg: int, back: Optional[int] = None):
+    def tbptt_scan_fn(self, seg: int, back: Optional[int] = None,
+                      guards: str = ""):
         """The raw (unjitted) whole-batch tBPTT runner for the DAG —
         ``(params, state, opt, features, labels, fmasks, lmasks, itc, ep,
         base_key) -> (params, state, opt, new_itc, mean_loss)`` with tuple
         batch groups — segment scan with detached carries, same contract
         as ``MultiLayerNetwork.tbptt_scan_fn`` so ParallelWrapper jits it
-        over a mesh unchanged."""
-        raw = self.train_step_fn()
+        over a mesh unchanged (``guards`` appends the max-aggregated
+        health guard vector, same as there)."""
+        raw = self.train_step_fn(guards=guards)
         segments, zero_carries, advance, _ = self.tbptt_scan_parts(seg,
                                                                    back)
 
         def run(params, state, opt, features, labels, fmasks, lmasks,
                 itc, ep, base_key):
+            from deeplearning4j_tpu.telemetry import health
+
             segs = tuple(segments(g)
                          for g in (features, labels, fmasks, lmasks))
             carries = zero_carries(features)
@@ -647,14 +697,22 @@ class ComputationGraph(nn_io.LazyScoreMixin):
                 f_s, l_s, fm_s, lm_s, carries = advance(
                     params, state, carries, f_s, l_s, fm_s, lm_s)
                 it, rng = nn_io.step_scalars(itc, base_key)
-                params, state, opt, loss, carries = raw(
-                    params, state, opt, f_s, l_s, fm_s, lm_s, it, ep,
-                    rng, carries)
+                out = raw(params, state, opt, f_s, l_s, fm_s, lm_s, it,
+                          ep, rng, carries)
+                if guards:
+                    params, state, opt, loss, carries, vec = out
+                    return (params, state, opt, carries, itc + 1), (loss,
+                                                                    vec)
+                params, state, opt, loss, carries = out
                 return (params, state, opt, carries, itc + 1), loss
 
-            (params, state, opt, carries, itc), losses = jax.lax.scan(
+            (params, state, opt, carries, itc), ys = jax.lax.scan(
                 body, (params, state, opt, carries, itc), segs)
-            return params, state, opt, itc, jnp.mean(losses)
+            if guards:
+                losses, vecs = ys
+                return (params, state, opt, itc, jnp.mean(losses),
+                        health.combine(vecs))
+            return params, state, opt, itc, jnp.mean(ys)
 
         return run
 
@@ -717,30 +775,45 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         between segments, back<fwd no-grad head — the WHOLE chain one
         compiled ``lax.scan`` (the DAG equivalent of
         ``MultiLayerNetwork._fit_tbptt``)."""
+        from deeplearning4j_tpu.telemetry import health
+
+        mode = health.graph_mode()
         seg = int(self.conf.tbptt_fwd_length)
         back = min(int(self.conf.tbptt_back_length or seg), seg)
         n_seg = -(-int(features[0].shape[1]) // seg)
-        # cache keyed by (seg, back): a conf length change between fits
-        # must not silently reuse a closure compiled for old lengths
+        # cache keyed by (seg, back, health mode): a conf length (or
+        # guard-mode) change between fits must not silently reuse a
+        # closure compiled for the old configuration
         if self._tbptt_scan is None:
             self._tbptt_scan = {}
-        if (seg, back) not in self._tbptt_scan:
-            self._tbptt_scan[seg, back] = aot_cache.wrap(
-                jax.jit(self.tbptt_scan_fn(seg, back),
+        if (seg, back, mode) not in self._tbptt_scan:
+            self._tbptt_scan[seg, back, mode] = aot_cache.wrap(
+                jax.jit(self.tbptt_scan_fn(seg, back, guards=mode),
                         donate_argnums=(0, 1, 2)),
-                self._graph_key(), f"tbptt_scan:{seg}:{back}:d012")
+                self._graph_key(),
+                f"tbptt_scan:{seg}:{back}:d012{health.cache_tag()}")
+        gvec = None
         with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
-            (self.params, self.state, self.opt_state, new_itc,
-             mean_loss) = self._tbptt_scan[seg, back](
+            out = self._tbptt_scan[seg, back, mode](
                 self.params, self.state, self.opt_state, features, labels,
                 fmasks, lmasks, self.device_iteration(),
                 self.device_epoch(), self._base_key)
+            (self.params, self.state, self.opt_state, new_itc,
+             mean_loss) = out[:5]
+            if mode:
+                gvec = out[5]
             _sp.set_result(mean_loss)
         telemetry.record_step("graph", int(features[0].shape[0]))
         self.iteration += n_seg
         self.advance_device_iteration(new_itc)
         self._score_dev = mean_loss
         self._score_cache = None
+        if mode:
+            self._guard_keys = health.bucket_keys(self.params)
+            health.observe_step(
+                self, "graph", self.iteration - 1, self.epoch, mean_loss,
+                gvec, self._guard_keys, batch=(features, labels),
+                rng_seed=int(getattr(self.conf, "seed", 0) or 0))
         for lst in self.listeners:
             # one batch-level call, arg = last segment's iteration index
             lst.iteration_done(self, self.iteration - 1, self.epoch,

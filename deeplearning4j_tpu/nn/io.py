@@ -116,6 +116,20 @@ class LazyScoreMixin:
         self._score_dev = None
         self._score_cache = None if v is None else float(v)
 
+    # --- health-layer rollback hooks ---------------------------------------
+    # (telemetry.health ROLLBACK policy; wrappers holding device-resident
+    # training trees override these with their own capture/restore)
+
+    def _health_snapshot(self):
+        from deeplearning4j_tpu.optimize import checkpoint
+
+        return checkpoint.snapshot_training_state(self)
+
+    def _health_restore(self, snap):
+        from deeplearning4j_tpu.optimize import checkpoint
+
+        checkpoint.restore_training_state(self, snap)
+
     # --- device-resident step counters -------------------------------------
     # Every eager host-side op (jnp.asarray, fold_in, jnp.ones) costs a
     # full dispatch round-trip — ~30-65ms each over the axon tunnel, vs

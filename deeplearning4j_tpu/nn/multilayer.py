@@ -217,9 +217,17 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
             loss = loss + sum_aux_losses(new_state, self._dtype)
         return loss, (new_state, new_carries)
 
-    def train_step_fn(self):
+    def train_step_fn(self, guards: str = ""):
         """The raw (unjitted) pure train step — exposed so parallel wrappers
-        can jit it under a Mesh with explicit shardings (stage-7 path)."""
+        can jit it under a Mesh with explicit shardings (stage-7 path).
+
+        ``guards`` (``telemetry.health.graph_mode()``): ``"observe"``
+        appends the packed health guard vector to the step outputs;
+        ``"skip"`` additionally applies the in-graph SKIP_STEP select
+        (an anomalous step's params/state/opt/carries revert to their
+        inputs). ``""`` compiles the unguarded step."""
+        from deeplearning4j_tpu.telemetry import health
+
         layers = self.conf.layers
 
         def step(params, state, opt_state, features, labels, fmask, lmask,
@@ -238,12 +246,31 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
                 g = solver.normalize_layer_gradients(layer, grads[k])
                 new_params[k], new_opt[k] = solver.apply_updater_to_layer(
                     layer, upd, params[k], g, opt_state[k], lr, it, ep)
+            if carries is not None:
+                # tBPTT: the next segment resumes from this segment's
+                # final RNN state, detached (gradients do not flow across
+                # segments — reference BackpropType.TruncatedBPTT)
+                new_carries = jax.lax.stop_gradient(new_carries)
+            if guards:
+                vec = health.guard_vector(loss, grads, params=params,
+                                          new_params=new_params)
+                if guards == "skip":
+                    if carries is None:
+                        (new_params, new_state, new_opt) = health.apply_skip(
+                            vec, (new_params, new_state, new_opt),
+                            (params, state, opt_state))
+                    else:
+                        (new_params, new_state, new_opt,
+                         new_carries) = health.apply_skip(
+                            vec,
+                            (new_params, new_state, new_opt, new_carries),
+                            (params, state, opt_state, carries))
+                if carries is None:
+                    return new_params, new_state, new_opt, loss, vec
+                return (new_params, new_state, new_opt, loss, new_carries,
+                        vec)
             if carries is None:
                 return new_params, new_state, new_opt, loss
-            # tBPTT: the next segment resumes from this segment's final RNN
-            # state, detached (gradients do not flow across segments —
-            # reference BackpropType.TruncatedBPTT semantics)
-            new_carries = jax.lax.stop_gradient(new_carries)
             return new_params, new_state, new_opt, loss, new_carries
 
         return step
@@ -290,7 +317,10 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         return afn
 
     def _build_train_step(self):
-        raw = self.train_step_fn()
+        from deeplearning4j_tpu.telemetry import health
+
+        mode = health.graph_mode()
+        raw = self.train_step_fn(guards=mode)
         dtype = self._dtype
 
         # all per-step scalar work (iteration, epoch, rng fold, default
@@ -301,13 +331,18 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
             it, rng = nn_io.step_scalars(itc, base_key)
             if lmask is None:
                 lmask = jnp.ones((features.shape[0],), dtype)
-            new_p, new_s, new_o, loss = raw(
-                params, state, opt_state, features, labels, fmask, lmask,
-                it, ep, rng)
+            out = raw(params, state, opt_state, features, labels, fmask,
+                      lmask, it, ep, rng)
+            new_p, new_s, new_o, loss = out[:4]
+            if mode:
+                return new_p, new_s, new_o, loss, itc + 1, out[4]
             return new_p, new_s, new_o, loss, itc + 1
 
-        return aot_cache.wrap(jax.jit(step, donate_argnums=(0, 1, 2, 7)),
-                              self._graph_key(), "train_step:d012+itc")
+        self._train_step_mode = mode
+        self._guard_keys = health.bucket_keys(self.params or {})
+        return aot_cache.wrap(
+            jax.jit(step, donate_argnums=(0, 1, 2, 7)),
+            self._graph_key(), f"train_step:d012+itc{health.cache_tag()}")
 
     def _build_output_fn(self):
         def out(params, state, x, fmask):
@@ -347,21 +382,24 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
             batch_size: Optional[int] = None):
         """Train (reference ``MultiLayerNetwork#fit`` overloads: iterator,
         DataSet, or (features, labels) arrays)."""
+        from deeplearning4j_tpu.telemetry import flightrec
+
         if self.params is None:
             self.init()
         iterator = _as_iterator(data, labels, batch_size)
-        for _ in range(epochs):
-            for lst in self.listeners:
-                lst.on_epoch_start(self, self.epoch)
-            pending = []
-            for ds in iterator:
-                pending.append(self._fit_batch_async(ds))
-                nn_io.drain(pending)
-            nn_io.drain(pending, force=True)
-            iterator.reset()
-            for lst in self.listeners:
-                lst.on_epoch_end(self, self.epoch)
-            self.epoch += 1
+        with flightrec.flight_recorder(model=self):
+            for _ in range(epochs):
+                for lst in self.listeners:
+                    lst.on_epoch_start(self, self.epoch)
+                pending = []
+                for ds in iterator:
+                    pending.append(self._fit_batch_async(ds))
+                    nn_io.drain(pending)
+                nn_io.drain(pending, force=True)
+                iterator.reset()
+                for lst in self.listeners:
+                    lst.on_epoch_end(self, self.epoch)
+                self.epoch += 1
         return self
 
     def _batch_arrays(self, ds: DataSet, lazy_lmask: bool = False,
@@ -411,14 +449,22 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         with telemetry.span(telemetry.PHASE_INGEST):
             features, labels, fmask, lmask = self._batch_arrays(
                 ds, lazy_lmask=True, write_back=True)
-        if self._train_step is None:
+        from deeplearning4j_tpu.telemetry import health
+
+        mode = health.graph_mode()
+        if self._train_step is None \
+                or getattr(self, "_train_step_mode", "") != mode:
             self._train_step = self._build_train_step()
+        gvec = None
         with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
-            (self.params, self.state, self.opt_state, loss,
-             new_itc) = self._train_step(
+            out = self._train_step(
                 self.params, self.state, self.opt_state, features, labels,
                 fmask, lmask, self.device_iteration(), self.device_epoch(),
                 self._base_key)
+            (self.params, self.state, self.opt_state, loss,
+             new_itc) = out[:5]
+            if mode:
+                gvec = out[5]
             _sp.set_result(loss)
         with telemetry.span(telemetry.PHASE_GRAD_SYNC) as _sp:
             # single device: the step has no collective — once the loss is
@@ -436,6 +482,11 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         cur = self.iteration
         self.iteration += 1
         self.advance_device_iteration(new_itc)
+        if mode:
+            health.observe_step(
+                self, "multilayer", cur, self.epoch, loss, gvec,
+                self._guard_keys, batch=(features, labels),
+                rng_seed=int(getattr(self.conf, "seed", 0) or 0))
         for lst in self.listeners:
             lst.iteration_done(self, cur, self.epoch, loss)
         return loss
@@ -505,7 +556,8 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
             pass  # exotic immutable containers just re-pad
         return padded
 
-    def tbptt_scan_fn(self, seg: int, back: Optional[int] = None):
+    def tbptt_scan_fn(self, seg: int, back: Optional[int] = None,
+                      guards: str = ""):
         """The raw (unjitted) whole-batch tBPTT runner: segments the time
         axis INSIDE the trace and scans the per-segment train step with
         detached carries — ``(params, state, opt, features, labels, fmask,
@@ -518,13 +570,20 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         first ``seg - back`` steps of each segment only advance the RNN
         state in inference mode — no gradient flows through them (they run
         outside the train step's loss closure) — and the parameter update
-        trains on the trailing ``back`` window. Still ONE compiled scan."""
-        raw = self.train_step_fn()
+        trains on the trailing ``back`` window. Still ONE compiled scan.
+
+        ``guards``: with a health mode set the per-segment guard vectors
+        (``telemetry.health``) aggregate elementwise-max across the scan
+        and the run returns an extra trailing vector; ``"skip"`` reverts
+        each anomalous SEGMENT's update inside the scan body."""
+        raw = self.train_step_fn(guards=guards)
         segments, zero_carries, advance, _ = self.tbptt_scan_parts(seg,
                                                                    back)
 
         def run(params, state, opt, features, labels, fmask, lmask,
                 itc, ep, base_key):
+            from deeplearning4j_tpu.telemetry import health
+
             segs = tuple(segments(a)
                          for a in (features, labels, fmask, lmask))
             carries = zero_carries(features)
@@ -535,14 +594,22 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
                 f_s, l_s, fm_s, lm_s, carries = advance(
                     params, state, carries, f_s, l_s, fm_s, lm_s)
                 it, rng = nn_io.step_scalars(itc, base_key)
-                params, state, opt, loss, carries = raw(
-                    params, state, opt, f_s, l_s, fm_s, lm_s, it, ep,
-                    rng, carries)
+                out = raw(params, state, opt, f_s, l_s, fm_s, lm_s, it,
+                          ep, rng, carries)
+                if guards:
+                    params, state, opt, loss, carries, vec = out
+                    return (params, state, opt, carries, itc + 1), (loss,
+                                                                    vec)
+                params, state, opt, loss, carries = out
                 return (params, state, opt, carries, itc + 1), loss
 
-            (params, state, opt, carries, itc), losses = jax.lax.scan(
+            (params, state, opt, carries, itc), ys = jax.lax.scan(
                 body, (params, state, opt, carries, itc), segs)
-            return params, state, opt, itc, jnp.mean(losses)
+            if guards:
+                losses, vecs = ys
+                return (params, state, opt, itc, jnp.mean(losses),
+                        health.combine(vecs))
+            return params, state, opt, itc, jnp.mean(ys)
 
         return run
 
@@ -646,22 +713,31 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         return features, labels, fmask, lmask
 
     def _fit_tbptt_scan(self, features, labels, fmask, lmask, seg, back):
+        from deeplearning4j_tpu.telemetry import health
+
+        mode = health.graph_mode()
         n_seg = -(-int(features.shape[1]) // seg)
-        # cache keyed by (seg, back): a conf.tbptt_*_length change between
-        # fits must not silently reuse a closure compiled for old lengths
+        # cache keyed by (seg, back, health mode): a conf.tbptt_*_length
+        # (or guard-mode) change between fits must not silently reuse a
+        # closure compiled for the old configuration
         if self._tbptt_scan is None:
             self._tbptt_scan = {}
-        if (seg, back) not in self._tbptt_scan:
-            self._tbptt_scan[seg, back] = aot_cache.wrap(
-                jax.jit(self.tbptt_scan_fn(seg, back),
+        if (seg, back, mode) not in self._tbptt_scan:
+            self._tbptt_scan[seg, back, mode] = aot_cache.wrap(
+                jax.jit(self.tbptt_scan_fn(seg, back, guards=mode),
                         donate_argnums=(0, 1, 2)),
-                self._graph_key(), f"tbptt_scan:{seg}:{back}:d012")
+                self._graph_key(),
+                f"tbptt_scan:{seg}:{back}:d012{health.cache_tag()}")
+        gvec = None
         with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
-            (self.params, self.state, self.opt_state, new_itc,
-             mean_loss) = self._tbptt_scan[seg, back](
+            out = self._tbptt_scan[seg, back, mode](
                 self.params, self.state, self.opt_state, features, labels,
                 fmask, lmask, self.device_iteration(), self.device_epoch(),
                 self._base_key)
+            (self.params, self.state, self.opt_state, new_itc,
+             mean_loss) = out[:5]
+            if mode:
+                gvec = out[5]
             _sp.set_result(mean_loss)
         telemetry.record_step("multilayer", int(features.shape[0]))
         self.iteration += n_seg
@@ -669,6 +745,13 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         self.last_batch_size = int(features.shape[0])
         self._score_dev = mean_loss
         self._score_cache = None
+        if mode:
+            self._guard_keys = health.bucket_keys(self.params)
+            health.observe_step(
+                self, "multilayer", self.iteration - 1, self.epoch,
+                mean_loss, gvec, self._guard_keys,
+                batch=(features, labels),
+                rng_seed=int(getattr(self.conf, "seed", 0) or 0))
         for lst in self.listeners:
             # one batch-level call, arg = last segment's iteration index
             # (same contract as the segment-loop path)

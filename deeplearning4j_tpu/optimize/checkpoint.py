@@ -14,8 +14,50 @@ import os
 import time
 from typing import List, Optional
 
+import numpy as np
+
 from deeplearning4j_tpu.optimize.listeners import TrainingListener
 from deeplearning4j_tpu.util import serializer
+
+
+def snapshot_training_state(model) -> dict:
+    """Host copy of everything an exact in-process resume needs:
+    params + layer state + updater state + counters. The copies are
+    numpy (``np.asarray`` syncs on the device values), so a later donated
+    step can never invalidate the snapshot — this is what the health
+    layer's ROLLBACK policy restores from."""
+    import jax
+
+    host = lambda t: jax.tree_util.tree_map(  # noqa: E731
+        lambda x: np.asarray(x), t)
+    return {
+        "params": host(model.params),
+        "state": host(model.state),
+        "opt_state": host(model.opt_state),
+        "iteration": int(model.iteration),
+        "epoch": int(model.epoch),
+    }
+
+
+def restore_training_state(model, snap: dict) -> None:
+    """Inverse of :func:`snapshot_training_state`: re-stage the snapshot
+    onto the model (fresh device copies — the snapshot stays valid for
+    repeated rollbacks). Counters rewind too, so LR schedules and RNG
+    folds replay exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    dev = lambda t: jax.tree_util.tree_map(  # noqa: E731
+        lambda x: jnp.asarray(x), t)
+    model.params = dev(snap["params"])
+    model.state = dev(snap["state"])
+    model.opt_state = dev(snap["opt_state"])
+    model.iteration = int(snap["iteration"])
+    model.epoch = int(snap["epoch"])
+    # invalidate the lazy score (it reflects the rolled-back step)
+    if hasattr(model, "_score_dev"):
+        model._score_dev = None
+        model._score_cache = None
 
 
 class Checkpoint:
@@ -92,11 +134,19 @@ class CheckpointListener(TrainingListener):
         serializer.write_model(model, os.path.join(self.directory, fname))
         new_row = Checkpoint(num, time.time(), iteration, epoch, fname)
         rows = self._read_rows() + [new_row]
-        with open(self._csv, "w", newline="") as f:
-            w = csv.writer(f)
-            for c in rows:
-                w.writerow([c.number, c.timestamp, c.iteration, c.epoch,
-                            c.filename])
+        # atomic rewrite: a crash mid-write must never truncate the
+        # numbering authority (same temp+replace scheme as write_model)
+        tmp = f"{self._csv}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w", newline="") as f:
+                w = csv.writer(f)
+                for c in rows:
+                    w.writerow([c.number, c.timestamp, c.iteration,
+                                c.epoch, c.filename])
+            os.replace(tmp, self._csv)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
         self._last_save_time = time.monotonic()
         self._apply_retention(rows)
 

@@ -165,6 +165,46 @@ class AotCacheStatsListener(TrainingListener):
         self._last = snap
 
 
+class HealthListener(TrainingListener):
+    """Surface the training-health monitor (``telemetry.health``) through
+    the listener SPI: every N iterations flush the monitor's lazily
+    queued guard vectors and report counts + the latest gradient norm /
+    update:param ratio. A report line prints only when something changed
+    (``print_all=True`` prints every collection). ``history`` keeps the
+    per-collection reports for programmatic checks."""
+
+    def __init__(self, frequency: int = 10, stream=None,
+                 print_all: bool = False):
+        self.frequency = max(1, int(frequency))
+        self.stream = stream or sys.stdout
+        self.print_all = bool(print_all)
+        self.history: List[dict] = []
+        self._last_nonfinite = 0
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if iteration % self.frequency:
+            return
+        from deeplearning4j_tpu.telemetry import health
+
+        rep = health.report()
+        rep["iteration"] = int(iteration)
+        self.history.append(rep)
+        new_bad = rep["nonfinite_steps"] - self._last_nonfinite
+        self._last_nonfinite = rep["nonfinite_steps"]
+        if new_bad or self.print_all:
+            last = rep.get("last") or {}
+            msg = (f"[health] iter {iteration}: status={rep['status']}, "
+                   f"{rep['nonfinite_steps']} non-finite step(s)")
+            if rep["skipped_steps"]:
+                msg += f", {rep['skipped_steps']} skipped"
+            if rep["rollbacks"]:
+                msg += f", {rep['rollbacks']} rollback(s)"
+            if last:
+                msg += (f", grad_norm={last['grad_norm']:.4g}, "
+                        f"update:param={last['update_param_ratio']:.3g}")
+            print(msg, file=self.stream)
+
+
 class EvaluativeListener(TrainingListener):
     """Periodic evaluation during fit (reference ``EvaluativeListener``)."""
 

@@ -1421,23 +1421,72 @@ class PipelineParallelWrapper:
             telemetry.record_pipeline_schedule(self.n_stages, self.n_micro,
                                                self.schedule)
         m.iteration += 1
+        from deeplearning4j_tpu.telemetry import health
+
+        if health.enabled():
+            # loss-only guard: the pipeline step's gradients live
+            # stage-local inside the compiled scan; a non-finite gradient
+            # reaches the psum'd loss within the same step, and fit_batch
+            # syncs on the loss below anyway, so detection stays
+            # step-accurate with no extra transfer. skipped=False: no
+            # in-graph select here — an anomalous update under SKIP_STEP
+            # was applied, and must never be reported as discarded.
+            gvec = health.loss_guard(loss)
+            health.observe_step(
+                self, "pipeline", m.iteration - 1, m.epoch, loss, gvec,
+                ("all",), batch=feats + (labels,), skipped=False)
+        # the anomalous step's score stays visible (NaN after a rollback
+        # too — the same contract as the network paths)
         self.score_value = float(loss)
         return self.score_value
 
     def fit(self, data, epochs: int = 1):
+        from deeplearning4j_tpu.telemetry import flightrec
+
         if not hasattr(data, "reset"):
             from deeplearning4j_tpu.datasets.iterators import (
                 ListDataSetIterator,
             )
 
             data = ListDataSetIterator([data])
-        for _ in range(epochs):
-            for ds in data:
-                self.fit_batch(ds)
-            data.reset()
-            self.model.epoch += 1
+        with flightrec.flight_recorder(model=self.model):
+            for _ in range(epochs):
+                for ds in data:
+                    self.fit_batch(ds)
+                data.reset()
+                self.model.epoch += 1
         self.write_back()
         return self.model
+
+    # --- health-layer rollback hooks ---------------------------------------
+    def _health_snapshot(self):
+        """Device copies of the stacked stage trees + head params (the
+        donated step buffers can never invalidate them)."""
+        import jax.numpy as _jnp
+
+        copy = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            _jnp.copy, t)
+        return {"stacked": copy(self._stacked),
+                "stacked_state": copy(self._stacked_state),
+                "stacked_opt": copy(self._stacked_opt),
+                "out_params": copy(self._out_params),
+                "out_opt": copy(self._out_opt),
+                "iteration": int(self.model.iteration),
+                "epoch": int(self.model.epoch)}
+
+    def _health_restore(self, snap):
+        import jax.numpy as _jnp
+
+        copy = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            _jnp.copy, t)
+        # fresh copies: the snapshot must survive repeated rollbacks
+        self._stacked = copy(snap["stacked"])
+        self._stacked_state = copy(snap["stacked_state"])
+        self._stacked_opt = copy(snap["stacked_opt"])
+        self._out_params = copy(snap["out_params"])
+        self._out_opt = copy(snap["out_opt"])
+        self.model.iteration = snap["iteration"]
+        self.model.epoch = snap["epoch"]
 
     def write_back(self):
         """Publish trained stage params + mutable state back onto the

@@ -216,8 +216,16 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
 
     def _setup(self):
         """Place model params on the mesh; compile step fns only once (they
-        are config-keyed, so repeated fit() calls reuse the jit cache)."""
+        are config-keyed, so repeated fit() calls reuse the jit cache).
+        A health-mode change between fits invalidates the compiled step
+        (guarded and unguarded executables differ)."""
+        from deeplearning4j_tpu.telemetry import health
+
         m = self.model
+        mode = health.graph_mode()
+        if getattr(self, "_health_mode", None) != mode:
+            self._step = None
+            self._health_mode = mode
         if self.training_mode is TrainingMode.AVERAGING:
             # multi-process: each process contributes its LOCAL replicas;
             # shard_batch assembles the [workers]-leading global tree
@@ -273,12 +281,14 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                     # the model's whole-batch segment-scan runner, SPMD-
                     # partitioned: batch axis sharded, params replicated;
                     # the per-segment gradient all-reduce is XLA-inserted
-                    # exactly as in the standard step
-                    self._step = jax.jit(m.tbptt_scan_fn(self._tbptt_seg,
-                                                         self._tbptt_back),
-                                         donate_argnums=(0, 1, 2))
+                    # exactly as in the standard step (guards ride along
+                    # from the model's own scan)
+                    self._step = jax.jit(
+                        m.tbptt_scan_fn(self._tbptt_seg, self._tbptt_back,
+                                        guards=mode),
+                        donate_argnums=(0, 1, 2))
                 else:
-                    raw = m.train_step_fn()
+                    raw = m.train_step_fn(guards=mode)
 
                     def exact_step(params, state, opt, *rest):
                         *batch, itc, ep, base_key = rest
@@ -407,20 +417,26 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
 
     # --- step builders ------------------------------------------------------
     def _build_threshold_step(self):
+        from deeplearning4j_tpu.telemetry import health
+
         gfn = self.model.grad_fn()
         afn = self.model.apply_updates_fn()
         tbptt = self._tbptt
+        mode = health.graph_mode()
         if tbptt:
             segments, zero_carries, advance, _ = \
                 self.model.tbptt_scan_parts(self._tbptt_seg,
                                             self._tbptt_back)
 
-        def exchange(params, opt, res, grads, loss, new_state, c,
-                     ctot, n, it, ep, tau):
+        def exchange(params, opt, res, grads, loss, new_state, old_state,
+                     c, ctot, n, it, ep, tau):
             """The accumulator's per-iteration exchange: reweight for
             ragged shards, encode(grad + residual) -> ±tau flips, psum
             the messages, apply the shared sum (shared by the standard
-            and per-segment tBPTT paths)."""
+            and per-segment tBPTT paths). With a health mode the guard
+            vector is computed on the SHARED (summed) messages — what the
+            updater actually consumes — and SKIP_STEP reverts params/
+            state/opt AND the residual."""
             w = c * n / ctot
             grads = _tree_map(lambda g: g * w, grads)
             enc, new_res, sparsity = encode_tree(grads, res, tau)
@@ -434,8 +450,17 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             loss = jax.lax.psum(loss * c, DATA) / ctot
             new_state = _tree_map(
                 lambda s: jax.lax.psum(s * (c / ctot), DATA), new_state)
+            vec = None
+            if mode:
+                vec = health.guard_vector(loss, shared, params=params,
+                                          new_params=new_params)
+                if mode == "skip":
+                    (new_params, new_state, new_opt,
+                     new_res) = health.apply_skip(
+                        vec, (new_params, new_state, new_opt, new_res),
+                        (params, old_state, opt, res))
             return (new_params, new_state, new_opt, new_res, loss,
-                    jax.lax.pmean(sparsity, DATA))
+                    jax.lax.pmean(sparsity, DATA), vec)
 
         def tbptt_step(params, state, opt, residual, batch, itc, ep,
                        base_key, tau, cvec):
@@ -464,22 +489,28 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                 loss, new_state, grads, carries = gfn(
                     params, state, f_s, l_s, fm_s, lm_s, rng,
                     carries=carries)
-                params, state, opt, res, loss, sparsity = exchange(
-                    params, opt, res, grads, loss, new_state, c,
+                params, state, opt, res, loss, sparsity, vec = exchange(
+                    params, opt, res, grads, loss, new_state, state, c,
                     ctot, n, it, ep, tau_c)
                 # per-SEGMENT adaptive tau (the reference's EncodingHandler
                 # retunes every iteration; update() is pure jnp by design)
                 tau_c = jnp.asarray(algo.update(tau_c, sparsity),
                                     jnp.float32)
+                ys = (loss, vec) if mode else loss
                 return ((params, state, opt, res, carries, itc + 1, tau_c),
-                        loss)
+                        ys)
 
             ((params, state, opt, res, carries, itc, tau),
-             losses) = jax.lax.scan(
+             ys) = jax.lax.scan(
                 body, (params, state, opt, res, carries, itc,
                        jnp.asarray(tau, jnp.float32)), segs)
-            return (params, state, opt, _tree_map(lambda r: r[None], res),
-                    jnp.mean(losses), tau)
+            out = (params, state, opt, _tree_map(lambda r: r[None], res))
+            if mode:
+                from deeplearning4j_tpu.telemetry import health as _h
+
+                losses, vecs = ys
+                return out + (jnp.mean(losses), tau, _h.combine(vecs))
+            return out + (jnp.mean(ys), tau)
 
         def step(params, state, opt, residual, batch, itc, ep, base_key,
                  tau, cvec):
@@ -499,16 +530,21 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             ctot = jnp.maximum(jax.lax.psum(c, DATA), 1.0)
             res = _tree_map(lambda r: r[0], residual)
             (new_params, new_state, new_opt, new_res, loss,
-             sparsity) = exchange(params, opt, res, grads, loss,
-                                  new_state, c, ctot, n, it, ep, tau)
-            return (new_params, new_state, new_opt,
-                    _tree_map(lambda r: r[None], new_res), loss, sparsity)
+             sparsity, vec) = exchange(params, opt, res, grads, loss,
+                                       new_state, state, c, ctot, n, it,
+                                       ep, tau)
+            out = (new_params, new_state, new_opt,
+                   _tree_map(lambda r: r[None], new_res), loss, sparsity)
+            return out + (vec,) if mode else out
 
+        out_specs = (P(), P(), P(), P(DATA), P(), P())
+        if mode:
+            out_specs = out_specs + (P(),)
         sharded = shard_map(
             step, self.mesh,
             in_specs=(P(), P(), P(), P(DATA), P(DATA), P(), P(), P(), P(),
                       P(DATA)),
-            out_specs=(P(), P(), P(), P(DATA), P(), P()))
+            out_specs=out_specs)
         return jax.jit(sharded, donate_argnums=(0, 1, 2, 3))
 
     def _build_bucketed_exact_step(self):
@@ -520,9 +556,12 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         to the default SPMD path (which lets XLA insert one fused
         all-reduce), with the collective schedule under our control so
         communication overlaps the remaining backprop."""
+        from deeplearning4j_tpu.telemetry import health
+
         gfn = self.model.grad_fn()
         afn = self.model.apply_updates_fn()
         bucket = self.gradient_bucket_bytes
+        mode = health.graph_mode()
 
         def step(params, state, opt, batch, itc, ep, base_key, cvec):
             it, rng = nn_io.step_scalars(itc, base_key)
@@ -542,37 +581,58 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                 lambda s: (jax.lax.psum(s * w, DATA)
                            if jnp.issubdtype(s.dtype, jnp.floating) else s),
                 new_state)
+            if mode:
+                # guard on the SHARED (post-psum) gradients — exactly what
+                # the updater consumed, so a non-finite accumulation on
+                # any replica is caught on every replica
+                vec = health.guard_vector(loss, shared, params=params,
+                                          new_params=new_params)
+                if mode == "skip":
+                    new_params, new_state, new_opt = health.apply_skip(
+                        vec, (new_params, new_state, new_opt),
+                        (params, state, opt))
+                return new_params, new_state, new_opt, loss, vec
             return new_params, new_state, new_opt, loss
 
+        out_specs = ((P(), P(), P(), P(), P()) if mode
+                     else (P(), P(), P(), P()))
         sharded = shard_map(
             step, self.mesh,
             in_specs=(P(), P(), P(), P(DATA), P(), P(), P(), P(DATA)),
-            out_specs=(P(), P(), P(), P()))
+            out_specs=out_specs)
         return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
     def _build_averaging_step(self):
+        from deeplearning4j_tpu.telemetry import health
+
+        mode = health.graph_mode()
         if self._tbptt:
             run = self.model.tbptt_scan_fn(self._tbptt_seg,
-                                           self._tbptt_back)
+                                           self._tbptt_back, guards=mode)
         else:
-            raw = self.model.train_step_fn()
+            raw = self.model.train_step_fn(guards=mode)
 
         def step(params, state, opt, batch, itc, ep, base_key, cvec):
             idx = jax.lax.axis_index(DATA)
             p = _tree_map(lambda x: x[0], params)
             s = _tree_map(lambda x: x[0], state)
             o = _tree_map(lambda x: x[0], opt)
+            vec = None
             if self._tbptt:
                 # per-replica rng stream via the folded base key; the
                 # runner derives per-segment scalars itself
                 key = jax.random.fold_in(base_key, idx)
-                new_p, new_s, new_o, _, loss = run(p, s, o, *batch, itc,
-                                                   ep, key)
+                out = run(p, s, o, *batch, itc, ep, key)
+                new_p, new_s, new_o, _, loss = out[:5]
+                if mode:
+                    vec = out[5]
             else:
                 it, rng = nn_io.step_scalars(itc, base_key)
                 rng = jax.random.fold_in(rng, idx)
-                new_p, new_s, new_o, loss = raw(p, s, o, *batch, it, ep,
-                                                rng)
+                out = raw(p, s, o, *batch, it, ep, rng)
+                new_p, new_s, new_o, loss = out[:4]
+                if mode:
+                    vec = out[4]
             # an all-padding replica (final ragged batch smaller than the
             # worker count) must not move: regularization/momentum would
             # otherwise update it and later be averaged into real replicas
@@ -583,14 +643,24 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             c = cvec[0]
             loss = (jax.lax.psum(loss * c, DATA)
                     / jnp.maximum(jax.lax.psum(c, DATA), 1.0))
-            return (_tree_map(lambda x: x[None], (new_p, new_s, new_o))
-                    + (loss,))
+            out = (_tree_map(lambda x: x[None], (new_p, new_s, new_o))
+                   + (loss,))
+            if mode:
+                # per-replica guards (the raw step already applied its
+                # in-graph SKIP per replica); any replica's anomaly is
+                # the step's anomaly — padding replicas report 0
+                vec = jnp.where(ok, vec, jnp.zeros_like(vec))
+                out = out + (health.combine_across(vec, DATA),)
+            return out
 
+        out_specs = (P(DATA), P(DATA), P(DATA), P())
+        if mode:
+            out_specs = out_specs + (P(),)
         sharded = shard_map(
             step, self.mesh,
             in_specs=(P(DATA), P(DATA), P(DATA), P(DATA), P(), P(), P(),
                       P(DATA)),
-            out_specs=(P(DATA), P(DATA), P(DATA), P()))
+            out_specs=out_specs)
         return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
     def _build_average_fn(self):
@@ -676,23 +746,60 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                     iterator, AsyncDataSetIterator):
                 iterator = AsyncDataSetIterator(
                     iterator, queue_size=self.prefetch_buffer)
+        from deeplearning4j_tpu.telemetry import flightrec
+
         self._setup()
         # each fit() may use a different batch size; the multi-host shape
         # lock applies within one fit only
         self._mp_target = None
         try:
-            for _ in range(epochs):
-                for lst in m.listeners:
-                    lst.on_epoch_start(m, m.epoch)
-                for ds in iterator:
-                    self._fit_batch(ds)
-                iterator.reset()
-                for lst in m.listeners:
-                    lst.on_epoch_end(m, m.epoch)
-                m.epoch += 1
+            with flightrec.flight_recorder(model=m):
+                for _ in range(epochs):
+                    for lst in m.listeners:
+                        lst.on_epoch_start(m, m.epoch)
+                    for ds in iterator:
+                        self._fit_batch(ds)
+                    iterator.reset()
+                    for lst in m.listeners:
+                        lst.on_epoch_end(m, m.epoch)
+                    m.epoch += 1
         finally:
             self._write_back()
         return m
+
+    # --- health-layer rollback hooks ---------------------------------------
+    def _health_snapshot(self):
+        """Device copies of the wrapper's live training trees (the
+        donated step buffers can never invalidate them) + the model
+        counters — what ROLLBACK restores mid-fit."""
+        copy = lambda t: _tree_map(jnp.copy, t)  # noqa: E731
+        snap = {"params": copy(self._params), "state": copy(self._state),
+                "opt": copy(self._opt),
+                "iteration": int(self.model.iteration),
+                "epoch": int(self.model.epoch)}
+        if self._residual is not None:
+            snap["residual"] = copy(self._residual)
+            snap["tau"] = self._tau
+        return snap
+
+    def _health_restore(self, snap):
+        copy = lambda t: _tree_map(jnp.copy, t)  # noqa: E731
+        # fresh copies: the snapshot must survive repeated rollbacks
+        # (the next step donates whatever trees it is handed)
+        self._params = copy(snap["params"])
+        self._state = copy(snap["state"])
+        self._opt = copy(snap["opt"])
+        if "residual" in snap:
+            self._residual = copy(snap["residual"])
+            self._tau = snap["tau"]
+        self.model.iteration = snap["iteration"]
+        self.model.epoch = snap["epoch"]
+        # both score mirrors point at the rolled-back step's loss — drop
+        # them (matches checkpoint.restore_training_state for networks)
+        self._score_dev = None
+        self._score_cache = None
+        self.model._score_dev = None
+        self.model._score_cache = None
 
     def _record_exchange(self, did_average: bool = False):
         """Telemetry: count this step's cross-replica payload (the
@@ -760,12 +867,19 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         inc = (-(-int(jax.tree_util.tree_leaves(batch)[0].shape[1])
                  // self._tbptt_seg) if self._tbptt else 1)
 
+        from deeplearning4j_tpu.telemetry import health
+
+        mode = getattr(self, "_health_mode", "")
+        gvec = None
         did_avg = False
         with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
             if self.training_mode is TrainingMode.AVERAGING:
-                (self._params, self._state, self._opt, loss) = self._step(
+                out = self._step(
                     self._params, self._state, self._opt, batch, itc, ep,
                     m._base_key, cvec)
+                (self._params, self._state, self._opt, loss) = out[:4]
+                if mode:
+                    gvec = out[4]
                 did_avg = ((m.iteration + inc) // self.averaging_frequency
                            > m.iteration // self.averaging_frequency)
                 if did_avg:
@@ -773,10 +887,13 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                         self._params, self._state, self._opt)
             elif self.threshold_algorithm is not None:
                 tau = np.float32(self._tau)
-                (self._params, self._state, self._opt, self._residual, loss,
-                 feedback) = self._step(self._params, self._state,
-                                        self._opt, self._residual, batch,
-                                        itc, ep, m._base_key, tau, cvec)
+                out = self._step(self._params, self._state,
+                                 self._opt, self._residual, batch,
+                                 itc, ep, m._base_key, tau, cvec)
+                (self._params, self._state, self._opt, self._residual,
+                 loss, feedback) = out[:6]
+                if mode:
+                    gvec = out[6]
                 # the adaptive threshold needs feedback on host — this mode
                 # inherently syncs per step (as the reference's
                 # EncodingHandler feedback loop does). tBPTT steps retune
@@ -788,18 +905,33 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                     self._tau = float(self.threshold_algorithm.update(
                         self._tau, float(feedback)))
             elif self._explicit_exchange:
-                (self._params, self._state, self._opt, loss) = self._step(
+                out = self._step(
                     self._params, self._state, self._opt, batch, itc, ep,
                     m._base_key, cvec)
+                (self._params, self._state, self._opt, loss) = out[:4]
+                if mode:
+                    gvec = out[4]
             else:
                 if self.expert_parallel and self._step is None:
                     self._step = self._build_expert_step(len(batch))
                 out = self._step(self._params, self._state, self._opt,
                                  *batch, itc, ep, m._base_key)
-                if self._tbptt:
-                    self._params, self._state, self._opt, _, loss = out
+                if self.expert_parallel:
+                    # expert-sharded grads stay local to their shard; the
+                    # guard here covers the loss (a NaN gradient reaches
+                    # the loss within one step through the shared layers)
+                    self._params, self._state, self._opt, loss = out[:4]
+                    if mode:
+                        gvec = health.loss_guard(loss)
+                elif self._tbptt:
+                    (self._params, self._state, self._opt, _,
+                     loss) = out[:5]
+                    if mode:
+                        gvec = out[5]
                 else:
                     self._params, self._state, self._opt, loss = out[:4]
+                    if mode:
+                        gvec = out[4]
             _sp.set_result(loss)
         with telemetry.span(telemetry.PHASE_GRAD_SYNC) as _sp:
             # the gradient all-reduce runs INSIDE the compiled step and the
@@ -816,6 +948,16 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         m._score_dev = loss
         m._score_cache = None
         m.iteration += inc  # listeners see iteration == next-to-run
+        if mode:
+            keys = (health.bucket_keys(m.params)
+                    if not self.expert_parallel else ("all",))
+            # expert-parallel applies no in-graph skip (loss-only guard):
+            # never report its anomalous updates as discarded
+            health.observe_step(
+                self, "parallel", m.iteration - 1, m.epoch, loss, gvec,
+                keys, batch=batch,
+                rng_seed=int(getattr(m.conf, "seed", 0) or 0),
+                skipped=False if self.expert_parallel else None)
         for lst in m.listeners:
             lst.iteration_done(m, m.iteration - 1, m.epoch, loss)
 

@@ -130,6 +130,10 @@ def make_train_step(sd, cfg: TrainingConfig):
         outs = fn(merged, placeholders)
         return sign * sum(jnp.sum(v) for v in outs.values())
 
+    from deeplearning4j_tpu.telemetry import health
+
+    mode = health.graph_mode()
+
     def train_step(trainables, frozen, opt_state, t, placeholders):
         loss, grads = jax.value_and_grad(loss_fn)(trainables, frozen,
                                                   placeholders)
@@ -144,6 +148,13 @@ def make_train_step(sd, cfg: TrainingConfig):
             for r in regs:
                 upd = r.apply_after_updater(upd, p, lr)
             new_params[n] = p - upd
+        if mode:
+            vec = health.guard_vector(loss, grads, params=trainables,
+                                      new_params=new_params)
+            if mode == "skip":
+                new_params, new_state = health.apply_skip(
+                    vec, (new_params, new_state), (trainables, opt_state))
+            return new_params, new_state, loss, vec
         return new_params, new_state, loss
 
     from deeplearning4j_tpu.optimize import aot_cache
@@ -151,13 +162,14 @@ def make_train_step(sd, cfg: TrainingConfig):
     # the executable bakes in the updater, regularization, minimize sign
     # and the loss-variable subset — they MUST be part of the key, or two
     # TrainingConfigs over the same graph would share one compiled step
-    # with the first config's lr/sign/loss frozen in
+    # with the first config's lr/sign/loss frozen in (the health guard
+    # mode joins the key the same way via cache_tag)
     cfg_key = aot_cache.graph_signature(
         (repr(updater), tuple(map(repr, regs)), sign, loss_names),
         fallback=cfg)
     step = aot_cache.wrap(jax.jit(train_step),
                           "sd:" + sd.graph_signature(),
-                          f"train_step:{cfg_key}")
+                          f"train_step:{cfg_key}{health.cache_tag()}")
     return step, trainable_names, loss_names
 
 
@@ -173,11 +185,14 @@ def fit(sd, iterator=None, epochs: int = 1, features=None, labels=None):
     # recycled); set_training_config() with a new cfg misses naturally.
     # Mutating a TrainingConfig in place between fits is not supported —
     # call set_training_config with a fresh config.
+    from deeplearning4j_tpu.telemetry import flightrec, health
+
+    mode = health.graph_mode()
     cached = sd._fn_cache.get("__train_step__")
-    if cached is None or cached[0] is not cfg:
-        cached = (cfg, make_train_step(sd, cfg))
+    if cached is None or cached[0] is not cfg or cached[1] != mode:
+        cached = (cfg, mode, make_train_step(sd, cfg))
         sd._fn_cache["__train_step__"] = cached
-    step, trainable_names, _ = cached[1]
+    step, trainable_names, _ = cached[2]
 
     trainables = {n: sd.arrays[n] for n in trainable_names}
     frozen = {k: v for k, v in sd.arrays.items()
@@ -208,64 +223,97 @@ def fit(sd, iterator=None, epochs: int = 1, features=None, labels=None):
     pending = sd.__dict__.setdefault("_dispatch_pending", [])
     from deeplearning4j_tpu import telemetry
 
-    for _ in range(epochs):
-        for ds in batches():
-            with telemetry.span(telemetry.PHASE_INGEST):
-                ph = {}
-                feats = (ds.features
-                         if isinstance(ds.features, (list, tuple))
-                         else [ds.features])
-                labs = ds.labels if isinstance(ds.labels, (list, tuple)) \
-                    else [ds.labels]
-                for name, arr in zip(cfg.data_set_feature_mapping, feats):
-                    ph[name] = jnp.asarray(arr)
-                for name, arr in zip(cfg.data_set_label_mapping, labs):
-                    ph[name] = jnp.asarray(arr)
-                if cfg.data_set_feature_mask_mapping and \
-                        getattr(ds, "features_mask", None) is not None:
-                    ph[cfg.data_set_feature_mask_mapping[0]] = jnp.asarray(
-                        ds.features_mask)
-                if cfg.data_set_label_mask_mapping and \
-                        getattr(ds, "labels_mask", None) is not None:
-                    ph[cfg.data_set_label_mask_mapping[0]] = jnp.asarray(
-                        ds.labels_mask)
-                # write staged arrays back so a reused DataSet transfers
-                # once (reference DataSet#migrate semantics, matching the
-                # networks)
-                if isinstance(ds, DataSet):
-                    fmap = list(cfg.data_set_feature_mapping
-                                or [])[:len(feats)]
-                    lmap = list(cfg.data_set_label_mapping or [])[:len(labs)]
-                    if len(fmap) == len(feats):
-                        staged = [ph[n] for n in fmap]
-                        ds.features = (staged if isinstance(
-                            ds.features, (list, tuple)) else staged[0])
-                    if len(lmap) == len(labs):
-                        staged = [ph[n] for n in lmap]
-                        ds.labels = (staged if isinstance(
-                            ds.labels, (list, tuple)) else staged[0])
-            # np scalar stages with the call; a bare python int would take
-            # the slow weak-type conversion path (~20ms on the tunnel)
-            with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
-                trainables, opt_state, loss = step(
-                    trainables, frozen, opt_state,
-                    np.float32(sd._iteration_count), ph)
-                _sp.set_result(loss)
-            with telemetry.span(telemetry.PHASE_GRAD_SYNC) as _sp:
-                _sp.set_result(trainables)  # single device: ~0
-            if telemetry.enabled():
-                rows = getattr(ph.get(next(iter(ph), None), None),
-                               "shape", (0,))
-                telemetry.record_step("samediff",
-                                      int(rows[0]) if rows else 0)
-            sd._iteration_count += 1
-            history.append(loss)
-            pending.append(loss)
-            nn_io.drain(pending)  # bounded async dispatch, no per-step sync
-            for lst in sd._listeners:
-                if hasattr(lst, "iteration_done"):
-                    lst.iteration_done(sd, sd._iteration_count, float(loss))
-        sd._epoch_count += 1
+    # health-layer rollback hooks over the loop-local training trees
+    # (the functional update below rebinds them, so the restore closure
+    # writes back through nonlocal)
+    def _snapshot():
+        host = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda x: np.asarray(x), t)
+        return (host(trainables), host(opt_state), sd._iteration_count)
+
+    def _restore(snap):
+        nonlocal trainables, opt_state
+        dev = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda x: jnp.asarray(x), t)
+        trainables = dev(snap[0])
+        opt_state = dev(snap[1])
+        sd._iteration_count = snap[2]
+
+    guard_keys = health.bucket_keys(trainables) if mode else ()
+
+    with flightrec.flight_recorder():
+        for _ in range(epochs):
+            for ds in batches():
+                with telemetry.span(telemetry.PHASE_INGEST):
+                    ph = {}
+                    feats = (ds.features
+                             if isinstance(ds.features, (list, tuple))
+                             else [ds.features])
+                    labs = (ds.labels
+                            if isinstance(ds.labels, (list, tuple))
+                            else [ds.labels])
+                    for name, arr in zip(cfg.data_set_feature_mapping,
+                                         feats):
+                        ph[name] = jnp.asarray(arr)
+                    for name, arr in zip(cfg.data_set_label_mapping, labs):
+                        ph[name] = jnp.asarray(arr)
+                    if cfg.data_set_feature_mask_mapping and \
+                            getattr(ds, "features_mask", None) is not None:
+                        ph[cfg.data_set_feature_mask_mapping[0]] = \
+                            jnp.asarray(ds.features_mask)
+                    if cfg.data_set_label_mask_mapping and \
+                            getattr(ds, "labels_mask", None) is not None:
+                        ph[cfg.data_set_label_mask_mapping[0]] = \
+                            jnp.asarray(ds.labels_mask)
+                    # write staged arrays back so a reused DataSet
+                    # transfers once (reference DataSet#migrate semantics,
+                    # matching the networks)
+                    if isinstance(ds, DataSet):
+                        fmap = list(cfg.data_set_feature_mapping
+                                    or [])[:len(feats)]
+                        lmap = list(cfg.data_set_label_mapping
+                                    or [])[:len(labs)]
+                        if len(fmap) == len(feats):
+                            staged = [ph[n] for n in fmap]
+                            ds.features = (staged if isinstance(
+                                ds.features, (list, tuple)) else staged[0])
+                        if len(lmap) == len(labs):
+                            staged = [ph[n] for n in lmap]
+                            ds.labels = (staged if isinstance(
+                                ds.labels, (list, tuple)) else staged[0])
+                # np scalar stages with the call; a bare python int would
+                # take the slow weak-type conversion path (~20ms on the
+                # tunnel)
+                gvec = None
+                with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
+                    out = step(trainables, frozen, opt_state,
+                               np.float32(sd._iteration_count), ph)
+                    trainables, opt_state, loss = out[:3]
+                    if mode:
+                        gvec = out[3]
+                    _sp.set_result(loss)
+                with telemetry.span(telemetry.PHASE_GRAD_SYNC) as _sp:
+                    _sp.set_result(trainables)  # single device: ~0
+                if telemetry.enabled():
+                    rows = getattr(ph.get(next(iter(ph), None), None),
+                                   "shape", (0,))
+                    telemetry.record_step("samediff",
+                                          int(rows[0]) if rows else 0)
+                sd._iteration_count += 1
+                if mode:
+                    health.observe_step(
+                        sd, "samediff", sd._iteration_count - 1,
+                        sd._epoch_count, loss, gvec, guard_keys,
+                        batch=tuple(ph.values()),
+                        snapshot=_snapshot, restore=_restore)
+                history.append(loss)
+                pending.append(loss)
+                nn_io.drain(pending)  # bounded async dispatch, no sync
+                for lst in sd._listeners:
+                    if hasattr(lst, "iteration_done"):
+                        lst.iteration_done(sd, sd._iteration_count,
+                                           float(loss))
+            sd._epoch_count += 1
 
     sd.arrays.update(trainables)
     sd._updater_state = opt_state

@@ -24,8 +24,19 @@ allocation, no lock, no host sync. Scrape surfaces (collectors,
 
 from __future__ import annotations
 
+from deeplearning4j_tpu.telemetry import flightrec as flightrec  # noqa: F401
+from deeplearning4j_tpu.telemetry import health as health  # noqa: F401
 from deeplearning4j_tpu.telemetry import registry as registry  # noqa: F401
 from deeplearning4j_tpu.telemetry import spans as spans  # noqa: F401
+from deeplearning4j_tpu.telemetry.flightrec import (  # noqa: F401
+    FlightRecorder,
+    flight_recorder,
+)
+from deeplearning4j_tpu.telemetry.health import (  # noqa: F401
+    AnomalyPolicy,
+    DivergenceError,
+    HealthMonitor,
+)
 from deeplearning4j_tpu.telemetry.export import (  # noqa: F401
     TelemetryListener,
     dump_jsonl,

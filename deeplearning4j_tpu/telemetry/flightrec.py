@@ -1,0 +1,273 @@
+"""Black-box flight recorder: last-N step records + crash bundles.
+
+A crashed or halted run should leave a self-contained diagnostic
+artifact the way an aircraft leaves a flight recorder: what the last
+steps looked like (score, gradient norms, LR, RNG lineage, batch
+shapes), what the telemetry counters said, and where the time went.
+
+- :class:`FlightRecorder` keeps a bounded ring of step records. Scores
+  and guard vectors are stored as **device scalars** and only
+  materialize at dump time, so recording costs a dict append per step —
+  no host sync (same contract as the lazy score).
+- :meth:`FlightRecorder.dump_bundle` writes a crash bundle::
+
+      <dir>/
+        manifest.json   # reason, policy, health report, env/config digest
+        records.jsonl   # one step record per line, oldest first
+        trace.json      # Chrome trace of the span ring (may be empty)
+        metrics.json    # registry snapshot + phase histograms
+
+- :func:`flight_recorder` is the context manager every ``fit`` wraps:
+  on an uncaught exception (including :class:`health.DivergenceError`)
+  it dumps the bundle and re-raises. Disabled (the default) it is a
+  bare ``yield`` — one flag check.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Optional, Sequence
+
+from deeplearning4j_tpu.telemetry import health as _health
+
+
+def batch_fingerprint(*arrays) -> list:
+    """Cheap, sync-free identity of a staged batch: shape + dtype per
+    array (``None`` entries pass through). Enough to answer "which batch
+    shape/dtype was in flight when it died" without hashing device
+    memory."""
+    out = []
+    for a in arrays:
+        if a is None:
+            out.append(None)
+        elif isinstance(a, (tuple, list)):
+            out.append(batch_fingerprint(*a))
+        else:
+            out.append([list(getattr(a, "shape", ())),
+                        str(getattr(a, "dtype", "?"))])
+    return out
+
+
+def sanitize_json(obj):
+    """Replace non-finite floats with the strings ``"NaN"`` /
+    ``"Infinity"`` / ``"-Infinity"`` so every emitted artifact is
+    spec-valid JSON — strict parsers (jq, JSON.parse, scrape agents)
+    reject bare NaN literals, and non-finite values are exactly what a
+    crash bundle exists to carry."""
+    if isinstance(obj, float):
+        if obj != obj:
+            return "NaN"
+        if obj == float("inf"):
+            return "Infinity"
+        if obj == float("-inf"):
+            return "-Infinity"
+        return obj
+    if isinstance(obj, dict):
+        return {k: sanitize_json(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [sanitize_json(v) for v in obj]
+    return obj
+
+
+def _materialize(x):
+    """Device scalar/vector -> plain JSON value at dump time. A buffer
+    that was donated/deleted since recording reports as unavailable
+    instead of failing the dump."""
+    import numpy as np
+
+    if x is None:
+        return None
+    try:
+        arr = np.asarray(x, np.float64)
+    except Exception:
+        return "unavailable"
+    if arr.ndim == 0:
+        return float(arr)
+    return [float(v) for v in arr.ravel()]
+
+
+class FlightRecorder:
+    """Ring buffer of step records + bundle writer."""
+
+    def __init__(self, capacity: int = 256):
+        import collections
+
+        self._ring = collections.deque(maxlen=int(capacity))
+        self._enabled = False
+        self.last_bundle: Optional[str] = None
+        self._conf_digest: Optional[str] = None
+
+    # --- switches -----------------------------------------------------------
+    def enable(self, capacity: Optional[int] = None) -> "FlightRecorder":
+        import collections
+
+        if capacity is not None and capacity != self._ring.maxlen:
+            self._ring = collections.deque(self._ring,
+                                           maxlen=int(capacity))
+        self._enabled = True
+        return self
+
+    def disable(self) -> "FlightRecorder":
+        self._enabled = False
+        return self
+
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def reset(self) -> "FlightRecorder":
+        self._ring.clear()
+        self.last_bundle = None
+        return self
+
+    # --- recording (hot path: one flag check when disabled) -----------------
+    def record_step(self, path: str, step: int, epoch: int, score=None,
+                    guard=None, guard_keys: Sequence[str] = (),
+                    lr=None, rng_seed=None, batch_fp=None) -> None:
+        if not self._enabled:
+            return
+        self._ring.append({
+            "path": path,
+            "step": int(step),
+            "epoch": int(epoch),
+            "score": score,            # device scalar, materialized on dump
+            "guard": guard,            # device guard vector (or None)
+            "guard_keys": list(guard_keys),
+            "lr": lr,
+            "rng_seed": rng_seed,
+            "batch": batch_fp,
+            "wall_time": time.time(),
+        })
+
+    def set_config_digest(self, conf_json: str) -> None:
+        """Register the model configuration (hashed into the manifest so
+        a bundle self-identifies which network produced it)."""
+        import hashlib
+
+        self._conf_digest = hashlib.sha256(
+            conf_json.encode("utf-8", "replace")).hexdigest()
+
+    def records(self) -> list:
+        """Materialized copies of the ring (oldest first)."""
+        return [self._materialize_record(r) for r in list(self._ring)]
+
+    def _materialize_record(self, r: dict) -> dict:
+        out = dict(r)
+        out["score"] = _materialize(r["score"])
+        out["guard"] = _materialize(r["guard"])
+        out["lr"] = _materialize(r["lr"])
+        return out
+
+    # --- bundles ------------------------------------------------------------
+    def dump_bundle(self, directory: Optional[str] = None,
+                    reason: str = "manual") -> str:
+        """Write the crash bundle; returns its directory. Always succeeds
+        in writing whatever it can — a flight recorder that throws during
+        a crash is worse than none."""
+        from deeplearning4j_tpu import telemetry
+        from deeplearning4j_tpu.telemetry import spans
+
+        if directory is None:
+            root = os.environ.get("DL4J_FLIGHTREC_DIR", "flightrec")
+            directory = os.path.join(
+                root, f"bundle_{int(time.time())}_{os.getpid()}")
+        os.makedirs(directory, exist_ok=True)
+
+        records = self.records()
+        with open(os.path.join(directory, "records.jsonl"), "w") as f:
+            for r in records:
+                f.write(json.dumps(sanitize_json(r)) + "\n")
+
+        try:
+            health_report = _health.report()
+        except Exception:
+            health_report = None
+        env = {k: v for k, v in os.environ.items()
+               if k.startswith(("JAX_", "XLA_", "DL4J_", "TPU_"))}
+        versions = {}
+        try:
+            import jax
+
+            versions["jax"] = jax.__version__
+            versions["backend"] = jax.default_backend()
+            versions["devices"] = [str(d) for d in jax.local_devices()]
+        except Exception:
+            pass
+        manifest = {
+            "format_version": 1,
+            "created_at": time.time(),
+            "reason": reason,
+            "n_records": len(records),
+            "health": health_report,
+            "config_digest": self._conf_digest,
+            "env": env,
+            "versions": versions,
+            "files": ["manifest.json", "records.jsonl", "trace.json",
+                      "metrics.json"],
+        }
+        with open(os.path.join(directory, "manifest.json"), "w") as f:
+            json.dump(sanitize_json(manifest), f, indent=2)
+
+        try:
+            spans.export_chrome_trace(os.path.join(directory, "trace.json"))
+        except Exception:
+            pass
+        try:
+            with open(os.path.join(directory, "metrics.json"), "w") as f:
+                json.dump(sanitize_json(telemetry.telemetry_record()), f)
+        except Exception:
+            pass
+
+        self.last_bundle = directory
+        return directory
+
+
+RECORDER = FlightRecorder()
+
+
+def record_step(*args, **kw) -> None:
+    """Module-level hot-path shim (one attribute + flag check when the
+    recorder is disabled)."""
+    rec = RECORDER
+    if rec._enabled:
+        rec.record_step(*args, **kw)
+
+
+def enabled() -> bool:
+    return RECORDER._enabled
+
+
+@contextlib.contextmanager
+def flight_recorder(directory: Optional[str] = None, model=None):
+    """Wraps a ``fit``: any exception escaping the block dumps a crash
+    bundle (once — nested fits mark the exception so outer wrappers
+    don't re-dump) and re-raises. A no-op ``yield`` when the recorder is
+    disabled."""
+    rec = RECORDER
+    if not rec._enabled:
+        yield rec
+        return
+    if model is not None:
+        # refresh per fit: the digest must identify THIS run's network,
+        # not whichever model happened to train first in the process
+        try:
+            rec.set_config_digest(model.conf.to_json())
+        except Exception:
+            pass
+    try:
+        yield rec
+    except BaseException as e:
+        # BaseException: a Ctrl-C on a diverging run is the most common
+        # way a bad run dies — it must still leave a bundle behind
+        if not getattr(e, "_dl4j_flightrec_dumped", False):
+            try:
+                reason = (f"DivergenceError: {e}"
+                          if isinstance(e, _health.DivergenceError)
+                          else f"{type(e).__name__}: {e}")
+                rec.dump_bundle(directory, reason=reason)
+                e._dl4j_flightrec_dumped = True
+            except Exception:
+                pass  # never mask the original failure
+        raise

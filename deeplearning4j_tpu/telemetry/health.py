@@ -1,0 +1,496 @@
+"""Training health: in-graph non-finite guards + host-side anomaly policy.
+
+PR 3 gave the framework eyes for *time*; this module gives it eyes for
+*numerical health*. Two halves:
+
+1. **In-graph guards** (:func:`guard_vector`, :func:`apply_skip`): pure
+   jnp reductions folded INTO the jitted train step — isfinite checks of
+   the loss, a non-finite gradient element count, the global gradient
+   norm, per-bucket (top-level-key) gradient norms, and the
+   update:param norm ratio — packed into ONE small f32 vector returned
+   alongside the loss. The vector rides the step's output, so reading it
+   costs no extra device→host sync beyond the score fetch the training
+   loop already performs. ``SKIP_STEP`` is applied in-graph too
+   (``jnp.where(ok, new, old)`` over the params/state/opt trees), so a
+   poisoned update never reaches the parameters even in fully-async
+   training.
+2. **Host-side policy** (:class:`HealthMonitor`): consumes guard vectors
+   and applies the configured :class:`AnomalyPolicy` — ``WARN`` (count +
+   registry metrics, lazily batched so nothing syncs per step),
+   ``SKIP_STEP`` (the in-graph skip plus lazy counting), ``ROLLBACK``
+   (restore the last-good snapshot via ``optimize.checkpoint``'s
+   snapshot helpers) and ``HALT`` (raise :class:`DivergenceError`).
+   ROLLBACK/HALT inherently check per step and therefore sync per step;
+   WARN/SKIP_STEP never do.
+
+The module-level mode is the build-time contract: step builders read
+:func:`graph_mode` when compiling (and fold :func:`cache_tag` into their
+AOT-cache step-kind key, so guarded and unguarded executables never
+collide), and the fit loops rebuild their cached step when the mode
+changes. Disabled (the default), every instrumented site costs one flag
+check — the same contract as the span layer.
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+import threading
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.telemetry.registry import REGISTRY
+
+# guard-vector layout: fixed head, then one global-norm entry per bucket
+# (top-level gradient key). Aggregations across segments/replicas take
+# the elementwise MAX, so every entry is oriented as "bigger = worse".
+GUARD_LOSS = 0            # loss value (max across aggregated steps)
+GUARD_LOSS_NONFINITE = 1  # 1.0 when the loss is NaN/Inf
+GUARD_GRAD_NONFINITE = 2  # 1.0 when any gradient element is NaN/Inf
+GUARD_GRAD_NORM = 3       # global L2 gradient norm
+GUARD_UPDATE_NORM = 4     # L2 norm of (new_params - params)
+GUARD_PARAM_NORM = 5      # L2 norm of params
+GUARD_RATIO = 6           # update_norm / (param_norm + 1e-12)
+GUARD_HEAD = 7
+
+
+class AnomalyPolicy(enum.Enum):
+    """What the monitor does on a non-finite loss/gradient step
+    (reference has nothing comparable — a NaN silently reaches the score
+    printout; here detection happens on the step it occurs)."""
+
+    WARN = "warn"              # count + log, training continues
+    SKIP_STEP = "skip_step"    # in-graph: discard the update, keep params
+    ROLLBACK = "rollback"      # restore the last-good snapshot
+    HALT = "halt"              # raise DivergenceError
+
+
+class DivergenceError(RuntimeError):
+    """Raised by the HALT policy (and by ROLLBACK with no snapshot to
+    restore). Carries the host guard vector for post-mortem."""
+
+    def __init__(self, msg: str, vec=None, step: Optional[int] = None,
+                 path: str = ""):
+        super().__init__(msg)
+        self.vec = None if vec is None else list(np.asarray(vec, float))
+        self.step = step
+        self.path = path
+
+
+# ---------------------------------------------------------------------------
+# in-graph guard math (pure jnp — call INSIDE the jitted step)
+# ---------------------------------------------------------------------------
+
+def bucket_keys(grads) -> Tuple[str, ...]:
+    """Static per-bucket key order for :func:`guard_vector`'s tail — the
+    sorted top-level keys of a dict gradient tree, or a single synthetic
+    bucket for anything else (flat vectors, lists)."""
+    if isinstance(grads, dict) and grads:
+        return tuple(sorted(grads))
+    return ("all",)
+
+
+def _float_leaves(tree):
+    import jax
+    import jax.numpy as jnp
+
+    return [l for l in jax.tree_util.tree_leaves(tree)
+            if jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact)]
+
+
+def guard_vector(loss, grads, params=None, new_params=None):
+    """The packed health vector (f32, ``GUARD_HEAD + n_buckets`` wide).
+
+    Hot-path cost: ONE squared-sum reduction per gradient leaf (plus
+    one diff-reduce and one sum-reduce per param leaf when the
+    update/param norms are requested) — the non-finite flag derives
+    from the reductions themselves (NaN/Inf propagate through a sum),
+    so there is no separate ``isfinite`` pass over the tensors. All
+    reductions are in f32 regardless of the compute dtype, and the
+    vector is just one more (tiny) step output — no host sync.
+    ``params``/``new_params`` enable the update/param-norm entries;
+    omitted they stay 0."""
+    import jax.numpy as jnp
+
+    f32 = jnp.float32
+    keys = bucket_keys(grads)
+    bucket_sq = []
+    for k in keys:
+        sub = grads[k] if (isinstance(grads, dict) and k in grads) else grads
+        sq = f32(0.0)
+        for l in _float_leaves(sub):
+            l32 = l.astype(f32)
+            sq = sq + jnp.sum(l32 * l32)
+        bucket_sq.append(sq)
+    total_sq = sum(bucket_sq)
+    # any NaN/Inf gradient element poisons its squared sum (an f32
+    # OVERFLOW of the sum also trips this — a gradient with norm > ~2e19
+    # is an anomaly by any definition)
+    grad_nf = (~jnp.isfinite(total_sq)).astype(f32)
+    loss32 = jnp.asarray(loss).astype(f32)
+    loss_nf = (~jnp.isfinite(loss32)).astype(f32)
+    if params is not None and new_params is not None:
+        upd_sq = sum(jnp.sum((n.astype(f32) - o.astype(f32)) ** 2)
+                     for n, o in zip(_float_leaves(new_params),
+                                     _float_leaves(params)))
+        par_sq = sum(jnp.sum(l.astype(f32) ** 2)
+                     for l in _float_leaves(params))
+        unorm = jnp.sqrt(upd_sq)
+        pnorm = jnp.sqrt(par_sq)
+    else:
+        unorm = pnorm = f32(0.0)
+    ratio = unorm / (pnorm + 1e-12)
+    return jnp.stack([loss32, loss_nf, grad_nf, jnp.sqrt(total_sq),
+                      unorm, pnorm, ratio]
+                     + [jnp.sqrt(sq) for sq in bucket_sq])
+
+
+def loss_guard_vector(loss):
+    """Loss-only guard (no gradient access) for paths whose compiled step
+    cannot cheaply expose gradients (pipeline stages, expert-parallel):
+    same layout, gradient entries 0."""
+    import jax.numpy as jnp
+
+    f32 = jnp.float32
+    loss32 = jnp.asarray(loss).astype(f32)
+    z = jnp.zeros((), f32)
+    return jnp.stack([loss32, (~jnp.isfinite(loss32)).astype(f32),
+                      z, z, z, z, z, z])
+
+
+_loss_guard_jit = None
+
+
+def loss_guard(loss):
+    """Host-callable loss-only guard: one tiny jitted isfinite reduction
+    dispatched on the (already queued) device loss — detection on the
+    step it occurs with no extra sync (the monitor decides when to
+    materialize)."""
+    global _loss_guard_jit
+    if _loss_guard_jit is None:
+        import jax
+
+        _loss_guard_jit = jax.jit(loss_guard_vector)
+    return _loss_guard_jit(loss)
+
+
+def vec_ok(vec):
+    """In-graph: True scalar when the step is numerically healthy."""
+    return (vec[GUARD_LOSS_NONFINITE] + vec[GUARD_GRAD_NONFINITE]) == 0
+
+
+def apply_skip(vec, new_trees, old_trees):
+    """In-graph SKIP_STEP: select ``new`` leaves on a healthy step, keep
+    ``old`` on an anomalous one (elementwise where — composes with
+    donation and sharding). ``*_trees`` are matching tuples of pytrees
+    (params, state, opt, ...)."""
+    import jax
+    import jax.numpy as jnp
+
+    ok = vec_ok(vec)
+    return tuple(
+        jax.tree_util.tree_map(lambda n, o: jnp.where(ok, n, o), nt, ot)
+        for nt, ot in zip(new_trees, old_trees))
+
+
+def combine(stacked_vecs):
+    """Aggregate stacked guard vectors ([n, G], e.g. one per tBPTT
+    segment) into one: elementwise max (every entry is
+    bigger-is-worse)."""
+    import jax.numpy as jnp
+
+    return jnp.max(stacked_vecs, axis=0)
+
+
+def combine_across(vec, axis_name):
+    """Aggregate one guard vector across a shard_map/pmap axis (pmax —
+    any replica's anomaly is the step's anomaly)."""
+    import jax
+
+    return jax.lax.pmax(vec, axis_name)
+
+
+# ---------------------------------------------------------------------------
+# module mode (build-time contract for the step builders)
+# ---------------------------------------------------------------------------
+
+_MODE = ""  # "" disabled | "observe" | "skip"
+
+
+def graph_mode() -> str:
+    """What the compiled step must contain: ``""`` (no guards),
+    ``"observe"`` (guard vector returned), ``"skip"`` (guard vector +
+    in-graph SKIP_STEP select). Step builders capture this at build time;
+    fit loops rebuild when it changes."""
+    return _MODE
+
+
+def cache_tag() -> str:
+    """AOT-cache step-kind suffix — guarded and unguarded executables
+    must never share a cache entry."""
+    return f"+h{_MODE}" if _MODE else ""
+
+
+def enabled() -> bool:
+    return bool(_MODE)
+
+
+# ---------------------------------------------------------------------------
+# host-side monitor
+# ---------------------------------------------------------------------------
+
+class HealthMonitor:
+    """Applies the anomaly policy to guard vectors.
+
+    WARN / SKIP_STEP are **lazy**: vectors queue as device scalars and
+    materialize in one stacked transfer every ``flush_every`` steps (or
+    on ``report()``/``flush()``), so the async fit pipeline never gains
+    a per-step sync. ROLLBACK / HALT materialize per step — remediation
+    cannot be deferred.
+
+    Snapshots for ROLLBACK are taken every ``snapshot_every`` healthy
+    steps through the owner's ``_health_snapshot``/``_health_restore``
+    hooks (networks delegate to ``optimize.checkpoint``'s
+    ``snapshot_training_state``/``restore_training_state``)."""
+
+    def __init__(self, policy: AnomalyPolicy = AnomalyPolicy.WARN,
+                 flush_every: int = 64, snapshot_every: int = 10):
+        self.policy = policy
+        self.flush_every = max(1, int(flush_every))
+        self.snapshot_every = max(1, int(snapshot_every))
+        self._lock = threading.RLock()
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            self.steps = 0
+            self.nonfinite_steps = 0
+            self.skipped_steps = 0
+            self.rollbacks = 0
+            self.halted = False
+            self.last_vec: Optional[List[float]] = None
+            self.last_keys: Tuple[str, ...] = ()
+            self.last_anomaly_step: Optional[int] = None
+            self._pending: List[tuple] = []
+
+    # --- recording ----------------------------------------------------------
+    def on_step(self, vec, keys: Sequence[str] = (), path: str = "",
+                owner=None,
+                snapshot: Optional[Callable[[], object]] = None,
+                restore: Optional[Callable[[object], None]] = None,
+                skipped: Optional[bool] = None) -> str:
+        """Feed one step's guard vector (a device array). Returns the
+        action taken: ``"none"``, ``"skip"``, ``"rollback"``; HALT
+        raises. ``owner`` hosts the rollback snapshot (stored on the
+        object itself, so monitor state never pins a dead model).
+        ``skipped``: whether an anomalous update was actually discarded
+        in-graph — paths without the in-graph select (pipeline,
+        expert-parallel) pass False so ``skipped_steps`` never claims a
+        discard that didn't happen; None = derived from the policy."""
+        if skipped is None:
+            skipped = self.policy is AnomalyPolicy.SKIP_STEP
+        self.steps += 1
+        lazy = self.policy in (AnomalyPolicy.WARN, AnomalyPolicy.SKIP_STEP)
+        if lazy:
+            self._pending.append((vec, tuple(keys), path, self.steps,
+                                  skipped))
+            if len(self._pending) >= self.flush_every:
+                self.flush()
+            return "none"
+        # ROLLBACK / HALT: the decision must happen on the step it occurs
+        v = np.asarray(vec, np.float64)
+        anomalous = (v[GUARD_LOSS_NONFINITE] + v[GUARD_GRAD_NONFINITE]) > 0
+        self._observe_host([(v, tuple(keys), path, self.steps, skipped)])
+        if not anomalous:
+            if self.policy is AnomalyPolicy.ROLLBACK and owner is not None \
+                    and snapshot is not None:
+                tag = getattr(owner, "_health_last_good", None)
+                # tag[1] > steps = a leftover from before a monitor
+                # reset — refresh rather than trust an ancient snapshot
+                if tag is None or tag[1] > self.steps \
+                        or self.steps - tag[1] >= self.snapshot_every:
+                    owner._health_last_good = (snapshot(), self.steps)
+            return "none"
+        if self.policy is AnomalyPolicy.ROLLBACK:
+            tag = getattr(owner, "_health_last_good", None) \
+                if owner is not None else None
+            if tag is None or restore is None:
+                self.halted = True
+                raise DivergenceError(
+                    f"non-finite step on path {path!r} with ROLLBACK "
+                    "policy but no last-good snapshot to restore "
+                    f"(guard={self._describe(v, keys)})",
+                    vec=v, step=self.steps, path=path)
+            restore(tag[0])
+            self.rollbacks += 1
+            REGISTRY.counter("dl4j_rollbacks_total",
+                             help="health-policy snapshot restores",
+                             path=path).inc()
+            return "rollback"
+        self.halted = True
+        REGISTRY.counter("dl4j_halts_total",
+                         help="DivergenceError raises", path=path).inc()
+        raise DivergenceError(
+            f"non-finite training step on path {path!r} "
+            f"(guard={self._describe(v, keys)})",
+            vec=v, step=self.steps, path=path)
+
+    def _describe(self, v, keys) -> str:
+        parts = [f"loss={v[GUARD_LOSS]:.4g}",
+                 f"loss_nonfinite={int(v[GUARD_LOSS_NONFINITE])}",
+                 f"grad_nonfinite={int(v[GUARD_GRAD_NONFINITE])}",
+                 f"grad_norm={v[GUARD_GRAD_NORM]:.4g}"]
+        bad = [k for k, n in zip(keys, v[GUARD_HEAD:])
+               if not math.isfinite(float(n))]
+        if bad:
+            parts.append(f"nonfinite_buckets={bad}")
+        return ", ".join(parts)
+
+    # --- lazy accounting ----------------------------------------------------
+    def flush(self) -> int:
+        """Materialize queued vectors (one stacked host transfer) and
+        fold them into counts + registry metrics. Returns the number of
+        anomalies seen in this batch."""
+        with self._lock:
+            pending, self._pending = self._pending, []
+        if not pending:
+            return 0
+        host = [(np.asarray(vec, np.float64), keys, path, step, skipped)
+                for vec, keys, path, step, skipped in pending]
+        return self._observe_host(host)
+
+    def _observe_host(self, entries) -> int:
+        anomalies = 0
+        with self._lock:
+            for v, keys, path, step, skipped in entries:
+                self.last_vec = [float(x) for x in v]
+                self.last_keys = keys
+                bad = (v[GUARD_LOSS_NONFINITE]
+                       + v[GUARD_GRAD_NONFINITE]) > 0
+                if bad:
+                    anomalies += 1
+                    self.nonfinite_steps += 1
+                    self.last_anomaly_step = step
+                    REGISTRY.counter(
+                        "dl4j_nonfinite_steps_total",
+                        help="steps with non-finite loss/gradients",
+                        path=path).inc()
+                    if skipped \
+                            and self.policy is AnomalyPolicy.SKIP_STEP:
+                        self.skipped_steps += 1
+                        REGISTRY.counter(
+                            "dl4j_skipped_steps_total",
+                            help="updates discarded by SKIP_STEP",
+                            path=path).inc()
+            if self.last_vec is not None:
+                REGISTRY.gauge("dl4j_grad_global_norm",
+                               help="last observed global gradient "
+                                    "norm").set(
+                    self.last_vec[GUARD_GRAD_NORM])
+                REGISTRY.gauge("dl4j_update_param_ratio",
+                               help="last update:param norm ratio").set(
+                    self.last_vec[GUARD_RATIO])
+        return anomalies
+
+    # --- reporting ----------------------------------------------------------
+    def report(self) -> dict:
+        """Flush + summarize (the ``/health`` endpoint payload)."""
+        self.flush()
+        with self._lock:
+            if self.halted:
+                status = "halted"
+            elif self.nonfinite_steps:
+                status = "anomalous"
+            else:
+                status = "ok"
+            last = None
+            if self.last_vec is not None:
+                last = {
+                    "loss": self.last_vec[GUARD_LOSS],
+                    "grad_norm": self.last_vec[GUARD_GRAD_NORM],
+                    "update_norm": self.last_vec[GUARD_UPDATE_NORM],
+                    "param_norm": self.last_vec[GUARD_PARAM_NORM],
+                    "update_param_ratio": self.last_vec[GUARD_RATIO],
+                    "bucket_norms": dict(zip(
+                        self.last_keys,
+                        self.last_vec[GUARD_HEAD:])),
+                }
+            return {
+                "enabled": enabled(),
+                "policy": self.policy.value,
+                "status": status,
+                "steps": self.steps,
+                "nonfinite_steps": self.nonfinite_steps,
+                "skipped_steps": self.skipped_steps,
+                "rollbacks": self.rollbacks,
+                "last_anomaly_step": self.last_anomaly_step,
+                "last": last,
+            }
+
+
+MONITOR = HealthMonitor()
+
+
+def monitor() -> HealthMonitor:
+    return MONITOR
+
+
+def observe_step(owner, path: str, step: int, epoch: int, loss, vec,
+                 keys: Sequence[str], batch=None,
+                 rng_seed: Optional[int] = None,
+                 snapshot: Optional[Callable[[], object]] = None,
+                 restore: Optional[Callable[[object], None]] = None,
+                 skipped: Optional[bool] = None) -> str:
+    """The ONE per-step health epilogue every training path calls when a
+    mode is active: flight-record the step (fingerprinting the batch
+    only if the recorder is on), then apply the policy. ``snapshot``/
+    ``restore`` default to the owner's ``_health_snapshot``/
+    ``_health_restore`` hooks. Returns the monitor's action."""
+    from deeplearning4j_tpu.telemetry import flightrec
+
+    if flightrec.RECORDER._enabled:
+        flightrec.RECORDER.record_step(
+            path, step, epoch, score=loss, guard=vec, guard_keys=keys,
+            rng_seed=rng_seed,
+            batch_fp=(flightrec.batch_fingerprint(*batch)
+                      if batch is not None else None))
+    if snapshot is None and owner is not None:
+        snapshot = getattr(owner, "_health_snapshot", None)
+    if restore is None and owner is not None:
+        restore = getattr(owner, "_health_restore", None)
+    return MONITOR.on_step(vec, keys=keys, path=path, owner=owner,
+                           snapshot=snapshot, restore=restore,
+                           skipped=skipped)
+
+
+def configure(policy: AnomalyPolicy = AnomalyPolicy.WARN,
+              flush_every: int = 64, snapshot_every: int = 10,
+              record_flights: bool = True) -> HealthMonitor:
+    """Turn the health layer on: sets the in-graph mode (step builders
+    pick it up on their next build), resets and reconfigures the global
+    monitor, and (by default) enables the flight recorder so a HALT or
+    crash leaves a bundle behind."""
+    global _MODE
+    if isinstance(policy, str):
+        policy = AnomalyPolicy(policy)
+    MONITOR.policy = policy
+    MONITOR.flush_every = max(1, int(flush_every))
+    MONITOR.snapshot_every = max(1, int(snapshot_every))
+    MONITOR.reset()
+    _MODE = "skip" if policy is AnomalyPolicy.SKIP_STEP else "observe"
+    if record_flights:
+        from deeplearning4j_tpu.telemetry import flightrec
+
+        flightrec.RECORDER.enable()
+    return MONITOR
+
+
+def disable() -> None:
+    """Back to the zero-cost fast path (recorded counts retained)."""
+    global _MODE
+    _MODE = ""
+
+
+def report() -> dict:
+    return MONITOR.report()

@@ -193,6 +193,21 @@ class UIServer:
                     payload = _json.dumps(
                         telemetry.telemetry_record()).encode()
                     ctype = "application/json"
+                elif self.path == "/health":
+                    # training-health probe (telemetry.health): policy,
+                    # anomaly counts, last guard readings — the liveness/
+                    # readiness surface a production trainer is scraped
+                    # on. Sanitized: the report carries non-finite floats
+                    # exactly when it matters, and a bare NaN literal is
+                    # invalid JSON to strict scrape agents.
+                    from deeplearning4j_tpu.telemetry import (
+                        flightrec,
+                        health,
+                    )
+
+                    payload = _json.dumps(
+                        flightrec.sanitize_json(health.report())).encode()
+                    ctype = "application/json"
                 else:
                     self.send_response(404)
                     self.end_headers()

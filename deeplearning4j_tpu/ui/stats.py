@@ -136,8 +136,11 @@ class RemoteUIStatsStorageRouter(StatsStorage):
 
     def flush(self, timeout: float = 30.0) -> bool:
         """Wait until queued records are delivered (or dropped)."""
-        deadline = time.time() + timeout
-        while self._q.unfinished_tasks and time.time() < deadline:
+        # monotonic: a wall-clock adjustment mid-flush must not extend
+        # or truncate the wait (same contract as the earlystopping and
+        # checkpoint timers)
+        deadline = time.monotonic() + timeout
+        while self._q.unfinished_tasks and time.monotonic() < deadline:
             time.sleep(0.02)
         return self._q.unfinished_tasks == 0
 

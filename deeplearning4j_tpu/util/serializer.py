@@ -31,27 +31,43 @@ FORMAT_VERSION = 1
 
 
 def write_model(net, path, save_updater: bool = True) -> None:
-    """Reference ``ModelSerializer#writeModel(net, file, saveUpdater)``."""
+    """Reference ``ModelSerializer#writeModel(net, file, saveUpdater)``.
+
+    The write is ATOMIC: the zip is assembled in a same-directory temp
+    file and published with ``os.replace``, so a crash mid-save can never
+    leave a truncated archive where the last-good checkpoint used to be
+    (the health layer's ROLLBACK policy depends on that file being
+    loadable)."""
+    import os
+
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
-        z.writestr("configuration.json", net.conf.to_json())
-        z.writestr("coefficients.npy", _npy_bytes(net.params_flat()))
-        if save_updater and net.opt_state:
-            z.writestr("updaterState.npy",
-                       _npy_bytes(params_util.flatten_state_like(net.opt_state)))
-        if net.state:
-            buf = io.BytesIO()
-            flat = {f"{k}/{name}": np.asarray(v)
-                    for k, d in net.state.items() for name, v in d.items()}
-            np.savez(buf, **flat)
-            z.writestr("state.npz", buf.getvalue())
-        z.writestr("metadata.json", json.dumps({
-            "format_version": FORMAT_VERSION,
-            "iteration": net.iteration,
-            "epoch": net.epoch,
-            "model_class": type(net).__name__,
-        }))
+    tmp = path.with_name(f"{path.name}.tmp.{os.getpid()}")
+    try:
+        with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr("configuration.json", net.conf.to_json())
+            z.writestr("coefficients.npy", _npy_bytes(net.params_flat()))
+            if save_updater and net.opt_state:
+                z.writestr(
+                    "updaterState.npy",
+                    _npy_bytes(params_util.flatten_state_like(net.opt_state)))
+            if net.state:
+                buf = io.BytesIO()
+                flat = {f"{k}/{name}": np.asarray(v)
+                        for k, d in net.state.items()
+                        for name, v in d.items()}
+                np.savez(buf, **flat)
+                z.writestr("state.npz", buf.getvalue())
+            z.writestr("metadata.json", json.dumps({
+                "format_version": FORMAT_VERSION,
+                "iteration": net.iteration,
+                "epoch": net.epoch,
+                "model_class": type(net).__name__,
+            }))
+        os.replace(tmp, path)
+    finally:
+        if tmp.exists():
+            tmp.unlink()
 
 
 def restore_multi_layer_network(path, load_updater: bool = True):
