@@ -18,6 +18,21 @@ health-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests -q -m health \
 		-p no:cacheprovider
 
+.PHONY: serve-smoke
+# Serving smoke: the dynamic-batcher test subset, then a live HTTP
+# round-trip (start InferenceServer -> concurrent ragged /predict ->
+# scrape /metrics -> clean stop, asserting zero recompiles after warmup).
+serve-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests -q -m serving \
+		-p no:cacheprovider
+	$(PY) bench_serving.py --smoke
+
+.PHONY: bench-serving
+# Closed-loop 8-client serving benchmark: locked single-request baseline
+# vs the dynamic micro-batching engine (acceptance bar: >= 4x).
+bench-serving:
+	$(PY) bench_serving.py --assert-speedup 4
+
 .PHONY: tier1
 tier1:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \

@@ -1,0 +1,303 @@
+"""Closed-loop multi-client serving benchmark (ISSUE 5 acceptance): N
+concurrent clients, each looping submit -> wait -> submit against
+
+  locked   — the pre-round-9 baseline: one global lock, one exact-shape
+             forward per request (``InferenceServer`` with
+             ``batching=None``), and
+  batched  — the dynamic micro-batching engine: shared padded launches,
+             power-of-two buckets, zero recompiles after ``warmup()``
+             (``parallel.batcher.InferenceEngine``).
+
+Reports req/s, rows/s, latency p50/p95/p99, engine fill ratio, and the
+speedup; writes ``bench_serving.json``. The acceptance bar is >= 4x
+throughput at 8 clients on the CPU proxy.
+
+Runs on CPU by default (``--tpu`` opts into the real chip): a serving
+bench must not contend with the box's single axon TPU tunnel.
+
+``--smoke`` is the ``make serve-smoke`` path: start a real HTTP
+``InferenceServer``, fire concurrent ``/predict`` clients, scrape
+``/metrics``, stop cleanly, assert the engine never recompiled.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+
+def _pin_cpu():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        # 8 virtual devices: the ParallelInference-backed deployment (the
+        # default --backend) shards launches the way a TPU pod slice does
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+
+
+def _build_net(n_in, hidden, n_out, seed=0):
+    import numpy as np
+
+    from deeplearning4j_tpu.conf import Activation, InputType, WeightInit
+    from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.conf.losses import LossMCXENT
+    from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+    from deeplearning4j_tpu.conf.updaters import Sgd
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Sgd(0.1)).weight_init(WeightInit.XAVIER).list()
+            .layer(DenseLayer(n_out=hidden, activation=Activation.RELU))
+            .layer(DenseLayer(n_out=hidden, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=n_out, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    net = MultiLayerNetwork(conf).init()
+    # one throwaway fit step so serving hits a realistic trained model
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(32, n_in)).astype(np.float32)
+    y = np.eye(n_out, dtype=np.float32)[rng.integers(0, n_out, 32)]
+    net.fit(x, y)
+    return net
+
+
+def _quantiles(sorted_ms):
+    def q(p):
+        if not sorted_ms:
+            return 0.0
+        i = min(int(p * len(sorted_ms)), len(sorted_ms) - 1)
+        return sorted_ms[i]
+
+    return {"p50_ms": round(q(0.50), 3), "p95_ms": round(q(0.95), 3),
+            "p99_ms": round(q(0.99), 3)}
+
+
+def _closed_loop(predict, clients, seconds, sizes, n_in):
+    """``clients`` threads loop predict(x) for ``seconds``; returns
+    (requests, rows, sorted per-request latencies ms)."""
+    import numpy as np
+
+    stop = threading.Event()
+    lat = [[] for _ in range(clients)]
+    rows = [0] * clients
+
+    def run(ci):
+        rng = np.random.default_rng(ci)
+        payloads = [rng.normal(size=(s, n_in)).astype(np.float32)
+                    for s in sizes]
+        i = 0
+        while not stop.is_set():
+            x = payloads[i % len(payloads)]
+            t0 = time.perf_counter()
+            predict(x)
+            lat[ci].append((time.perf_counter() - t0) * 1000.0)
+            rows[ci] += x.shape[0]
+            i += 1
+
+    threads = [threading.Thread(target=run, args=(ci,))
+               for ci in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    flat = sorted(ms for per in lat for ms in per)
+    return len(flat), sum(rows), flat, wall
+
+
+def bench(args):
+    import numpy as np
+
+    from deeplearning4j_tpu import telemetry
+    from deeplearning4j_tpu.optimize import aot_cache
+    from deeplearning4j_tpu.parallel.batcher import (
+        BatchingConfig,
+        InferenceEngine,
+    )
+
+    net = _build_net(args.n_in, args.hidden, args.n_out)
+    sizes = tuple(int(s) for s in args.sizes.split(","))
+    results = {"clients": args.clients, "seconds": args.seconds,
+               "sizes": list(sizes), "backend": args.backend,
+               "model": f"mlp {args.n_in}-{args.hidden}x2-{args.n_out}"}
+
+    if args.backend == "pi":
+        # the deployment the ISSUE targets: serving behind a sharded
+        # ParallelInference, where EVERY launch pays multi-device dispatch
+        # — the cost dynamic batching exists to amortize (on this CPU
+        # proxy a 1-row sharded launch costs the same ~2 ms as a 32-row
+        # one; a TPU pod slice behaves the same way)
+        from deeplearning4j_tpu.parallel import ParallelInference
+
+        baseline_model = ParallelInference(net, bucketize=False)  # old pad
+        engine_model = ParallelInference(net)
+    else:
+        baseline_model = engine_model = net
+
+    # --- locked baseline: global lock, one request per launch -------------
+    lock = threading.Lock()
+
+    def locked_predict(x):
+        # host materialization included — the engine demux pays it too
+        with lock:
+            return np.asarray(baseline_model.output(x))
+
+    def measure(predict):
+        """Best round by req/s: the box is shared, a slow round means
+        background contention, not a slower serving path."""
+        best = None
+        for _ in range(max(args.rounds, 1)):
+            n_req, n_rows, lat, wall = _closed_loop(
+                predict, args.clients, args.seconds, sizes, args.n_in)
+            cur = {"req_per_s": round(n_req / wall, 1),
+                   "rows_per_s": round(n_rows / wall, 1),
+                   **_quantiles(lat)}
+            if best is None or cur["req_per_s"] > best["req_per_s"]:
+                best = cur
+        return best
+
+    for s in sizes:  # prime every request shape out of the measurement
+        locked_predict(np.zeros((s, args.n_in), np.float32))
+    results["locked"] = measure(locked_predict)
+
+    # --- batched engine ---------------------------------------------------
+    eng = InferenceEngine(engine_model, BatchingConfig(
+        max_batch=args.max_batch, max_delay_ms=args.max_delay_ms,
+        settle_ms=args.settle_ms),
+        graph_opt=not args.no_graph_opt and args.backend != "pi")
+    warm = eng.warmup()
+    miss0 = aot_cache.stats()["misses"]
+    results["batched"] = measure(eng.predict)
+    recompiles = aot_cache.stats()["misses"] - miss0
+    snap = telemetry.REGISTRY.snapshot(run_collectors=False)
+    fill = snap.get("dl4j_serving_batch_fill_ratio", {})
+    per_batch = snap.get("dl4j_serving_batch_requests", {})
+    results["batched"].update({
+        "warmup": warm,
+        "recompiles_after_warmup": recompiles,
+        "mean_fill_ratio": round(fill.get("mean", 0.0), 3),
+        "mean_requests_per_launch": round(per_batch.get("mean", 0.0), 2),
+    })
+    eng.close()
+
+    results["speedup"] = round(
+        results["batched"]["req_per_s"]
+        / max(results["locked"]["req_per_s"], 1e-9), 2)
+
+    print(json.dumps(results, indent=2))
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=2)
+    print(f"\nlocked  : {results['locked']['req_per_s']:>9} req/s   "
+          f"p95 {results['locked']['p95_ms']} ms")
+    print(f"batched : {results['batched']['req_per_s']:>9} req/s   "
+          f"p95 {results['batched']['p95_ms']} ms")
+    print(f"speedup : {results['speedup']}x   "
+          f"(recompiles after warmup: {recompiles})")
+    if args.assert_speedup and results["speedup"] < args.assert_speedup:
+        print(f"FAIL: speedup {results['speedup']} < {args.assert_speedup}")
+        return 1
+    return 0
+
+
+def smoke(args):
+    """make serve-smoke: HTTP server up -> concurrent predicts ->
+    /metrics scrape -> clean stop."""
+    import urllib.request
+
+    import numpy as np
+
+    from deeplearning4j_tpu.optimize import aot_cache
+    from deeplearning4j_tpu.parallel.batcher import BatchingConfig
+    from deeplearning4j_tpu.parallel.serving import InferenceServer
+
+    net = _build_net(args.n_in, args.hidden, args.n_out)
+    server = InferenceServer(net, batching=BatchingConfig(
+        max_batch=args.max_batch, max_delay_ms=args.max_delay_ms)
+    ).start(port=0, warmup=True)
+    base = f"http://127.0.0.1:{server.port}"
+    miss0 = aot_cache.stats()["misses"]
+    errors = []
+
+    def client(ci):
+        rng = np.random.default_rng(ci)
+        for i in range(8):
+            n = 1 + (ci + i) % 5
+            x = rng.normal(size=(n, args.n_in)).astype(np.float32)
+            req = urllib.request.Request(
+                base + "/predict",
+                json.dumps({"inputs": [x.tolist()]}).encode(),
+                {"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                body = json.loads(r.read())
+            if len(body["outputs"][0]) != n:
+                errors.append(f"client {ci}: demux row count mismatch")
+
+    threads = [threading.Thread(target=client, args=(ci,))
+               for ci in range(args.clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    text = urllib.request.urlopen(base + "/metrics",
+                                  timeout=10).read().decode()
+    server.stop()
+    recompiles = aot_cache.stats()["misses"] - miss0
+    ok = (not errors and recompiles == 0
+          and "dl4j_serving_requests_total" in text
+          and "dl4j_serving_batches_total" in text)
+    print(f"serve-smoke: {args.clients} clients x 8 ragged predicts, "
+          f"recompiles={recompiles}, errors={errors or 'none'}, "
+          f"metrics={'ok' if 'dl4j_serving' in text else 'MISSING'}")
+    print("OK" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="measurement rounds per mode; best req/s wins")
+    ap.add_argument("--sizes", default="1,2,3,4",
+                    help="comma list of request row counts cycled per client")
+    ap.add_argument("--max-batch", type=int, default=32)
+    ap.add_argument("--max-delay-ms", type=float, default=2.0)
+    ap.add_argument("--settle-ms", type=float, default=0.2)
+    ap.add_argument("--backend", choices=("pi", "single"), default="pi",
+                    help="pi = sharded ParallelInference deployment "
+                         "(default), single = bare network")
+    ap.add_argument("--n-in", type=int, default=64)
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--n-out", type=int, default=10)
+    ap.add_argument("--no-graph-opt", action="store_true")
+    ap.add_argument("--out", default="bench_serving.json")
+    ap.add_argument("--assert-speedup", type=float, default=0.0,
+                    help="exit 1 if batched/locked speedup is below this")
+    ap.add_argument("--smoke", action="store_true",
+                    help="HTTP round-trip smoke instead of the benchmark")
+    ap.add_argument("--tpu", action="store_true",
+                    help="run on the real accelerator (default: CPU pin)")
+    args = ap.parse_args()
+    if not args.tpu:
+        _pin_cpu()
+    return smoke(args) if args.smoke else bench(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

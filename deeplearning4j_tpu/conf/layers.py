@@ -161,6 +161,20 @@ class DenseLayer(BaseLayer):
             y = y + params["b"]
         return y
 
+    def fold_scale_shift(self, params, scale, shift):
+        """Inference fold hook (``nn.inference_opt``): absorb a following
+        per-output-channel affine ``y*scale + shift`` (an eval-mode batch
+        norm) into W/b. Valid only when this layer's activation is
+        IDENTITY — the caller checks. Returns ``(new_layer, new_params)``;
+        a bias appears if the layer had none."""
+        dt = params["W"].dtype
+        scale = jnp.asarray(scale, jnp.float32)
+        shift = jnp.asarray(shift, jnp.float32)
+        w = (params["W"].astype(jnp.float32) * scale).astype(dt)
+        b = params["b"].astype(jnp.float32) if self.has_bias else 0.0
+        b = (b * scale + shift).astype(dt)
+        return dataclasses.replace(self, has_bias=True), {"W": w, "b": b}
+
 
 @serde.register
 @dataclasses.dataclass

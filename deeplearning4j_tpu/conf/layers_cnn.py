@@ -130,6 +130,21 @@ class ConvolutionLayer(BaseLayer):
             y = y + params["b"]
         return self.activation.apply(y), state
 
+    def fold_scale_shift(self, params, scale, shift):
+        """Inference fold hook (``nn.inference_opt``): absorb a following
+        per-output-channel affine (eval-mode BN) into W/b. HWIO weights
+        put the output channel last, so the fold is the same last-axis
+        broadcast as DenseLayer's (and stays valid for the 1D and
+        transposed subclasses, whose W layouts also end in out-channels).
+        Caller guarantees activation is IDENTITY."""
+        dt = params["W"].dtype
+        scale = jnp.asarray(scale, jnp.float32)
+        shift = jnp.asarray(shift, jnp.float32)
+        w = (params["W"].astype(jnp.float32) * scale).astype(dt)
+        b = params["b"].astype(jnp.float32) if self.has_bias else 0.0
+        b = (b * scale + shift).astype(dt)
+        return dataclasses.replace(self, has_bias=True), {"W": w, "b": b}
+
 
 @serde.register
 @dataclasses.dataclass
@@ -243,6 +258,18 @@ class SeparableConvolution2D(ConvolutionLayer):
         if self.has_bias:
             y = y + params["b"]
         return self.activation.apply(y), state
+
+    def fold_scale_shift(self, params, scale, shift):
+        """Separable conv folds the affine into the POINTWISE kernel
+        (last op before the bias), leaving the depthwise stage alone."""
+        dt = params["pW"].dtype
+        scale = jnp.asarray(scale, jnp.float32)
+        shift = jnp.asarray(shift, jnp.float32)
+        pw = (params["pW"].astype(jnp.float32) * scale).astype(dt)
+        b = params["b"].astype(jnp.float32) if self.has_bias else 0.0
+        b = (b * scale + shift).astype(dt)
+        out = dict(params, pW=pw, b=b)
+        return dataclasses.replace(self, has_bias=True), out
 
 
 @serde.register

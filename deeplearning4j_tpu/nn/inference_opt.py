@@ -1,0 +1,168 @@
+"""Inference-graph optimization pass (serving-time, applied ONCE).
+
+Reference: libnd4j's cuDNN platform helpers fuse conv+BN+activation at
+execution time per op pair (SURVEY.md §2.1); TensorRT-style deployments
+do it statically. Here the fold is static and happens at engine
+construction (``parallel.batcher.InferenceEngine``): eval-mode batch
+norm is just a per-channel affine of its input, so it collapses into the
+preceding linear layer's weights — one conv/matmul replaces
+conv+normalize, and XLA compiles a strictly smaller program for every
+serving bucket.
+
+Transforms (MultiLayerNetwork):
+
+- **BN fold**: ``BatchNormalization`` following a layer exposing
+  ``fold_scale_shift`` (Dense / Conv2D / Conv1D / Deconv / Separable)
+  with IDENTITY activation is folded into that layer's W/b
+  (``ops.conv_fused.bn_fold_scale_shift`` math); the host layer takes
+  the BN's activation. ``use_batch_mean_in_eval`` BNs are left alone
+  (they genuinely need batch statistics at inference).
+- **FusedConvBN1x1 unfuse**: the train-fused layer becomes a plain 1x1
+  ``ConvolutionLayer`` with folded weights — its Pallas statistics pass
+  has no inference role.
+- **Prune**: ``DropoutLayer`` and IDENTITY ``ActivationLayer`` nodes
+  vanish; per-layer input ``dropout`` fields are zeroed (eval no-ops,
+  but dropping them keeps the serving graph signature minimal).
+- **bf16 policy** (``bf16=True``): the clone serves its forward in
+  bfloat16 compute with f32 outputs (the existing mixed-precision
+  machinery; outputs are cast back to the storage dtype).
+
+The returned network is a NEW instance with **copied** parameters —
+donation-safe: the original can keep training (its train step donates
+its param buffers) without ever invalidating the serving copy. Models
+other than MultiLayerNetwork pass through structurally untouched (a
+ComputationGraph still gets the donation-safe clone + optional bf16).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.conf.activations import Activation
+from deeplearning4j_tpu.conf.layers import ActivationLayer, DropoutLayer
+from deeplearning4j_tpu.conf.layers_cnn import (
+    BatchNormalization,
+    ConvolutionLayer,
+    ConvolutionMode,
+    FusedConvBN1x1,
+)
+from deeplearning4j_tpu.ops.conv_fused import bn_fold_scale_shift
+
+
+def _copy_tree(tree):
+    return jax.tree_util.tree_map(jnp.copy, tree)
+
+
+def _zero_dropout(layer):
+    if getattr(layer, "dropout", 0.0):
+        try:
+            return dataclasses.replace(layer, dropout=0.0)
+        except TypeError:  # non-dataclass exotic layer: leave it
+            return layer
+    return layer
+
+
+def _prunable(layer) -> bool:
+    if isinstance(layer, DropoutLayer):
+        return True
+    return (isinstance(layer, ActivationLayer)
+            and layer.activation is Activation.IDENTITY)
+
+
+def _foldable_bn(layer) -> bool:
+    return (isinstance(layer, BatchNormalization)
+            and not layer.use_batch_mean_in_eval)
+
+
+def _bn_constants(layer, params, state):
+    gamma = beta = None
+    if not layer.lock_gamma_beta:
+        gamma, beta = params["gamma"], params["beta"]
+    return bn_fold_scale_shift(gamma, beta, state["mean"], state["var"],
+                               layer.eps)
+
+
+def optimize_for_inference(model, fold_bn: bool = True, prune: bool = True,
+                           bf16: bool = False):
+    """Return a serving-optimized, donation-safe copy of ``model`` (the
+    original is never mutated). See the module docstring for the pass
+    list; ``fold_bn=False`` / ``prune=False`` disable individual
+    transforms (the copy is still made)."""
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    if not isinstance(model, MultiLayerNetwork):
+        # structural pass is sequential-only; still deliver the
+        # donation-safe copy (+ bf16 policy) where the model supports it
+        clone = getattr(model, "clone", None)
+        if clone is None:
+            return model
+        out = clone()
+        if bf16 and hasattr(out, "conf") and hasattr(out, "_cdtype"):
+            out.conf = dataclasses.replace(out.conf,
+                                           compute_dtype="bfloat16")
+            out._cdtype = jnp.dtype("bfloat16")
+        return out
+
+    if model.params is None:
+        model.init()
+    src_layers = list(model.conf.layers)
+    new_layers, new_params, new_state = [], {}, {}
+
+    def append(layer, params=None, state=None):
+        idx = str(len(new_layers))
+        new_layers.append(layer)
+        if params:
+            new_params[idx] = params
+        if state:
+            new_state[idx] = state
+
+    def last_kept():
+        return new_layers[-1] if new_layers else None
+
+    for i, layer in enumerate(src_layers):
+        p = _copy_tree(model.params.get(str(i), {}))
+        s = _copy_tree(model.state.get(str(i), {}))
+        if prune and _prunable(layer):
+            continue
+        if prune:
+            layer = _zero_dropout(layer)
+        if fold_bn and isinstance(layer, FusedConvBN1x1):
+            # unfuse to a plain 1x1 conv with the BN affine baked in
+            scale, shift = bn_fold_scale_shift(
+                p["gamma"], p["beta"], s["mean"], s["var"], layer.eps)
+            conv = ConvolutionLayer(
+                name=layer.name, activation=layer.activation,
+                updater=layer.updater, n_out=layer.n_out,
+                kernel_size=(1, 1), stride=layer.stride,
+                convolution_mode=ConvolutionMode.SAME, has_bias=True)
+            dt = p["W"].dtype
+            w = (p["W"].astype(jnp.float32) * scale).astype(dt)
+            append(conv, {"W": w, "b": shift.astype(dt)})
+            continue
+        prev = last_kept()
+        if (fold_bn and _foldable_bn(layer) and prev is not None
+                and getattr(prev, "fold_scale_shift", None) is not None
+                and prev.activation is Activation.IDENTITY):
+            scale, shift = _bn_constants(layer, p, s)
+            idx = str(len(new_layers) - 1)
+            folded, fparams = prev.fold_scale_shift(new_params[idx],
+                                                    scale, shift)
+            # the host layer takes over the BN's activation
+            new_layers[-1] = dataclasses.replace(
+                folded, activation=layer.activation)
+            new_params[idx] = fparams
+            continue
+        append(layer, p, s)
+
+    conf = dataclasses.replace(
+        model.conf, layers=tuple(new_layers),
+        compute_dtype="bfloat16" if bf16 else model.conf.compute_dtype)
+    out = MultiLayerNetwork(conf)
+    out.params, out.state = new_params, new_state
+    # opt_state stays empty: the serving copy never trains; a fit() on it
+    # would re-init, which is the safe failure mode
+    out.opt_state = {}
+    return out

@@ -159,6 +159,31 @@ def _bwd(interpret, res, cts):
 matmul_with_stats.defvjp(_fwd, _bwd)
 
 
+def bn_fold_scale_shift(gamma, beta, mean, var, eps):
+    """Inference-time BN folding constants (the libnd4j cuDNN-helper
+    fusion, applied statically): eval-mode batch norm is the per-channel
+    affine ``y*scale + shift`` with
+
+        scale = gamma / sqrt(var + eps)
+        shift = beta - mean * scale
+
+    so a preceding linear op (conv/dense, identity activation) absorbs it
+    exactly: ``W' = W * scale`` (scale over the output-channel axis),
+    ``b' = b * scale + shift``. Computed in f32 regardless of the serving
+    dtype — the fold happens once at engine construction, and rsqrt in
+    bf16 would bake a permanent ~1e-2 error into the weights. ``gamma``/
+    ``beta`` None = locked gamma/beta (1/0)."""
+    var32 = jnp.asarray(var, jnp.float32)
+    mean32 = jnp.asarray(mean, jnp.float32)
+    scale = jax.lax.rsqrt(var32 + jnp.float32(eps))
+    if gamma is not None:
+        scale = scale * jnp.asarray(gamma, jnp.float32)
+    shift = -mean32 * scale
+    if beta is not None:
+        shift = shift + jnp.asarray(beta, jnp.float32)
+    return scale, shift
+
+
 def conv1x1_bn_stats(x, w, stride: Tuple[int, int] = (1, 1),
                      interpret: Optional[bool] = None):
     """1x1 convolution (NHWC, HWIO weights [1, 1, Cin, Cout]) returning

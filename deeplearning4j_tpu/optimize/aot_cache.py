@@ -181,24 +181,33 @@ class AotStep:
         self._jit = jit_fn
         self._key = (graph_key, fn_key)
 
+    def _compile_locked(self, key, args):
+        """Shared miss path (caller holds ``_LOCK``): returns
+        ``(executable_or_None, newly_compiled)``. ``None`` means the
+        cache is at ``_MAX_ENTRIES`` (a recorded overflow) — the caller
+        falls back to the plain jit, whose own trace cache amortizes the
+        signature; re-AOT-compiling per call would turn an evicted key
+        into a compile-per-step pathology."""
+        exe = _EXECUTABLES.get(key)
+        if exe is not None:
+            return exe, False
+        if len(_EXECUTABLES) >= _MAX_ENTRIES:
+            STATS.record_overflow()
+            return None, False
+        t0 = time.perf_counter()
+        exe = self._jit.lower(*args).compile()
+        STATS.record_miss(key, time.perf_counter() - t0)
+        _EXECUTABLES[key] = exe
+        return exe, True
+
     def __call__(self, *args):
         key = self._key + (signature_of(args),)
         exe = _EXECUTABLES.get(key)
         if exe is None:
             with _LOCK:
-                exe = _EXECUTABLES.get(key)
-                if exe is None:
-                    if len(_EXECUTABLES) >= _MAX_ENTRIES:
-                        # full cache: dispatch the plain jit, whose own
-                        # trace cache amortizes this signature — re-AOT-
-                        # compiling per CALL here would turn an evicted
-                        # key into a compile-per-step pathology
-                        STATS.record_overflow()
-                        return self._jit(*args)
-                    t0 = time.perf_counter()
-                    exe = self._jit.lower(*args).compile()
-                    STATS.record_miss(key, time.perf_counter() - t0)
-                    _EXECUTABLES[key] = exe
+                exe, _ = self._compile_locked(key, args)
+            if exe is None:
+                return self._jit(*args)
             return exe(*args)
         try:
             out = exe(*args)
@@ -212,6 +221,18 @@ class AotStep:
             return self._jit(*args)
         STATS.record_hit()
         return out
+
+    def warm(self, *args) -> bool:
+        """Compile-and-cache this signature WITHOUT dispatching — bucket
+        warmup for serving engines (``parallel.batcher``): pre-compiling
+        every padding bucket at server start costs compile time only, no
+        device execution. Returns True when a new executable was compiled
+        (a recorded miss), False when it was already cached (or the cache
+        is full, a recorded overflow)."""
+        key = self._key + (signature_of(args),)
+        with _LOCK:
+            _, compiled = self._compile_locked(key, args)
+        return compiled
 
     # escape hatches for probes that want the raw jit (bench scripts call
     # .lower() for memory analysis)

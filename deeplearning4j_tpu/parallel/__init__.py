@@ -17,6 +17,15 @@ from deeplearning4j_tpu.parallel.cluster import (  # noqa: F401
     TrainingMaster,
     global_batch,
 )
+from deeplearning4j_tpu.parallel.batcher import (  # noqa: F401
+    BadRequestError,
+    BatchingConfig,
+    DeadlineExpiredError,
+    InferenceEngine,
+    ServerOverloadedError,
+    bucket_ladder,
+    bucket_rows,
+)
 from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401
 from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS,

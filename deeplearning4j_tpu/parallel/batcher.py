@@ -1,0 +1,533 @@
+"""Dynamic cross-request micro-batching for serving.
+
+Reference: ``org.deeplearning4j.parallelism.inference`` — caller threads
+hand ``ParallelInference`` single observations, an ``ObservablesProvider``
+coalesces them into ``BatchedInferenceObservable``s, a worker runs one
+batched forward, and each caller's observable is signalled with its slice
+(SURVEY.md §3.6). The TPU-native version keeps that exact shape — queue,
+dispatcher, demux — but the "worker pool" is ONE compiled XLA program:
+concurrent requests share a single padded device launch, and the padding
+is quantized to power-of-two buckets so every launch shape after
+``warmup()`` is an AOT-cache hit (``optimize/aot_cache``), never a
+recompile.
+
+Three policies govern the dispatcher (the reference's ``batchLimit`` /
+queue semantics, plus production admission control):
+
+- ``max_batch``: rows per launch; the queue drains until the next request
+  would overflow it (a single larger request still launches alone).
+- ``settle_ms`` / ``max_delay_ms``: continuous batching — once the queue
+  goes one settle window without growing, every in-flight caller is
+  already waiting on us and the batch launches immediately;
+  ``max_delay_ms`` is the hard linger ceiling for the oldest request
+  under a steady trickle that never settles.
+- ``max_queue`` / per-request deadlines: a full queue rejects at submit
+  (HTTP 503 upstream) and a request whose deadline passes while queued is
+  expired without ever poisoning a shared launch.
+
+Requests are grouped by (trailing shape, dtype) signature — ragged batch
+SIZES share launches (that is the point), heterogeneous shapes/dtypes
+each get their own launch, and a malformed request fails at ``submit``
+with :class:`BadRequestError` for its sender only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.optimize import aot_cache
+
+
+class BadRequestError(ValueError):
+    """Client-side problem (shape/dtype/arity mismatch) — maps to HTTP
+    400. Raised at submit, BEFORE the request joins a shared batch."""
+
+
+class ServerOverloadedError(RuntimeError):
+    """Admission control: the pending queue is at ``max_queue`` — maps to
+    HTTP 503 (shed load instead of growing an unbounded backlog)."""
+
+
+class DeadlineExpiredError(RuntimeError):
+    """The request's deadline passed while it waited in the queue — maps
+    to HTTP 503 (the caller has already given up; don't burn a launch)."""
+
+
+@dataclasses.dataclass
+class BatchingConfig:
+    """Dispatcher policy knobs (reference ``ParallelInference.Builder``
+    ``batchLimit``/``queueLimit``, plus deadline admission control)."""
+
+    max_batch: int = 64        # rows per shared launch (bucket ceiling)
+    max_delay_ms: float = 2.0  # linger for batch fill before ragged launch
+    max_queue: int = 256       # pending requests before 503 rejection
+    timeout_ms: Optional[float] = None  # default per-request deadline
+    # continuous batching: once no new rows arrive within one settle
+    # window, nothing else is in flight — launch immediately instead of
+    # sitting out the rest of max_delay_ms (which stays the hard ceiling
+    # for a steady trickle that never settles). 0 disables early launch.
+    settle_ms: float = 0.2
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (n >= 1)."""
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def bucket_rows(n: int, align: int = 1) -> int:
+    """Padding bucket for an ``n``-row launch: the smallest
+    ``align * 2**k >= n``. ``align`` is the device-shard multiple (a
+    sharded backend needs row counts divisible by its worker count), so
+    the bucket ladder is align, 2*align, 4*align, ... — every ragged
+    request size quantizes to O(log) distinct compiled shapes."""
+    per = -(-int(n) // int(align))
+    return next_pow2(per) * int(align)
+
+
+def bucket_ladder(max_batch: int, align: int = 1) -> List[int]:
+    """Every bucket a <=``max_batch``-row request can land in (what
+    ``warmup()`` pre-compiles)."""
+    out = []
+    b = int(align)
+    while True:
+        out.append(b)
+        if b >= max_batch:
+            return out
+        b *= 2
+
+
+class _Request:
+    __slots__ = ("xs", "n", "group", "event", "result", "error", "deadline",
+                 "t0")
+
+    def __init__(self, xs, n, group, deadline, t0):
+        self.xs = xs
+        self.n = n
+        self.group = group
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.deadline = deadline
+        self.t0 = t0
+
+
+def _input_types(model):
+    """The model conf's per-input InputTypes, or None when unreadable."""
+    net = getattr(model, "model", model)  # unwrap ParallelInference
+    conf = getattr(net, "conf", None)
+    if conf is None:
+        return None
+    if hasattr(conf, "network_inputs"):
+        types = list(getattr(conf, "input_types", ()) or ())
+        if len(types) != len(conf.network_inputs):
+            return [None] * len(conf.network_inputs)
+        return types
+    if getattr(conf, "input_type", None) is not None:
+        return [conf.input_type]
+    return None
+
+
+def _input_templates(model):
+    """Per-input trailing shapes derived from the model's conf (None for
+    inputs whose shape the conf cannot pin, e.g. variable timesteps), or
+    None when the model has no readable conf at all (then the signature
+    of each request's own group is the only validation)."""
+    from deeplearning4j_tpu.conf import inputs as it
+
+    types = _input_types(model)
+    if types is None:
+        return None
+
+    def shape_of(t):
+        if isinstance(t, it.FeedForward):
+            return (t.size,)
+        if isinstance(t, it.Convolutional):
+            return (t.height, t.width, t.channels)
+        if isinstance(t, it.ConvolutionalFlat):
+            return (t.height * t.width * t.channels,)
+        if isinstance(t, it.Convolutional3D):
+            return (t.depth, t.height, t.width, t.channels)
+        if isinstance(t, it.Recurrent) and t.timesteps > 0:
+            return (t.timesteps, t.size)
+        return None
+
+    return [shape_of(t) for t in types]
+
+
+class InferenceEngine:
+    """Dynamic micro-batching front of one model's compiled forward.
+
+    Usage::
+
+        engine = InferenceEngine(net, BatchingConfig(max_batch=32))
+        engine.warmup()                      # pre-compile every bucket
+        y = engine.predict(x)                # thread-safe, shares launches
+        engine.close()
+
+    ``model`` is anything exposing ``output(*arrays)`` — a
+    MultiLayerNetwork, a ComputationGraph, or a ``ParallelInference``
+    (whose worker count becomes the bucket alignment so padded launches
+    stay shard-divisible). ``graph_opt=True`` (default) runs the
+    inference-graph optimization pass at construction
+    (``nn.inference_opt.optimize_for_inference``): BN statistics folded
+    into conv/dense weights, dropout/identity nodes pruned, params
+    copied so a concurrently-training original can donate its buffers
+    without corrupting the serving copy. ``bf16=True`` additionally
+    serves the forward in bfloat16 with f32 outputs.
+    """
+
+    def __init__(self, model, config: Optional[BatchingConfig] = None,
+                 graph_opt: bool = True, bf16: bool = False):
+        self.config = config or BatchingConfig()
+        if graph_opt:
+            from deeplearning4j_tpu.nn.inference_opt import (
+                optimize_for_inference,
+            )
+
+            model = optimize_for_inference(model, bf16=bf16)
+        self.model = model
+        # sharded backends need launch rows divisible by the shard count
+        self._align = int(getattr(model, "workers", 1) or 1)
+        self._np_dtype = np.dtype(getattr(
+            getattr(model, "model", model), "_dtype", np.float32))
+        self._templates = _input_templates(model)
+        self._queue: deque = deque()
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        telemetry.register_serving_engine(self)
+
+    # --- submit / wait ------------------------------------------------------
+    def _validate(self, inputs: Sequence) -> Tuple[tuple, int, tuple]:
+        if not inputs:
+            raise BadRequestError("at least one input array required")
+        if self._templates is not None and \
+                len(inputs) != len(self._templates):
+            raise BadRequestError(
+                f"model takes {len(self._templates)} input array(s), "
+                f"got {len(inputs)}")
+        xs = []
+        for i, a in enumerate(inputs):
+            try:
+                a = np.asarray(a)
+            except (ValueError, TypeError) as e:
+                raise BadRequestError(f"malformed input array: {e}")
+            if a.dtype == object:
+                raise BadRequestError("malformed input array: ragged")
+            # match nn_io.as_device(feature=True): uint8 rides to the
+            # device as-is (in-jit dequantization), floats/ints take the
+            # network dtype
+            if a.dtype != np.uint8 and a.dtype != self._np_dtype:
+                a = np.asarray(a, self._np_dtype)
+            if a.ndim < 1 or a.shape[0] < 1:
+                raise BadRequestError("input needs a non-empty batch dim")
+            tmpl = (self._templates[i]
+                    if self._templates is not None else None)
+            if tmpl is not None and tuple(a.shape[1:]) != tuple(tmpl):
+                raise BadRequestError(
+                    f"input {i} shape {tuple(a.shape[1:])} does not match "
+                    f"model input shape {tuple(tmpl)}")
+            xs.append(a)
+        n = xs[0].shape[0]
+        if any(a.shape[0] != n for a in xs):
+            raise BadRequestError("inputs disagree on batch size")
+        group = tuple((a.shape[1:], a.dtype.str) for a in xs)
+        return tuple(xs), n, group
+
+    def submit(self, inputs: Sequence, timeout_ms=...) -> _Request:
+        """Validate and enqueue one request; returns a handle whose
+        ``event`` fires when the result (or error) is in. Raises
+        :class:`BadRequestError` / :class:`ServerOverloadedError`
+        synchronously — a bad request never enters the shared queue."""
+        if timeout_ms is ...:
+            timeout_ms = self.config.timeout_ms
+        try:
+            xs, n, group = self._validate(inputs)
+        except BadRequestError:
+            telemetry.record_serving_request("bad_request")
+            raise
+        t0 = time.monotonic()
+        deadline = t0 + timeout_ms / 1000.0 if timeout_ms else None
+        req = _Request(xs, n, group, deadline, t0)
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("engine is closed")
+            if len(self._queue) >= self.config.max_queue:
+                telemetry.record_serving_request("rejected")
+                raise ServerOverloadedError(
+                    f"serving queue full ({self.config.max_queue} pending)")
+            self._queue.append(req)
+            self._cond.notify_all()
+        self._ensure_thread()
+        return req
+
+    def result(self, req: _Request):
+        """Block until ``req`` completes; returns the model output slice
+        for this request (same single-array/list convention as
+        ``model.output``) or raises the request's error."""
+        req.event.wait()
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def predict(self, *inputs, timeout_ms=...):
+        """Synchronous request: enqueue, share a launch, demux
+        (reference ``ParallelInference#output`` through the observable)."""
+        return self.result(self.submit(inputs, timeout_ms=timeout_ms))
+
+    # --- warmup -------------------------------------------------------------
+    def buckets(self) -> List[int]:
+        return bucket_ladder(self.config.max_batch, self._align)
+
+    def warmup(self, shapes=None, dtype=None) -> dict:
+        """Pre-compile the forward executable for EVERY padding bucket so
+        ragged traffic never recompiles (the acceptance invariant:
+        ``aot_cache.stats()['misses']`` stays flat across a request-size
+        sweep after this returns). ``shapes``: per-input trailing shapes
+        (default: derived from the model conf; required if the conf
+        cannot pin them). Returns ``{"buckets": [...], "compiled": k,
+        "compile_seconds": s}``."""
+        if shapes is None:
+            shapes = self._templates
+        if shapes is None or any(s is None for s in shapes):
+            raise ValueError(
+                "cannot derive input shapes from the model conf; pass "
+                "warmup(shapes=[(...), ...]) explicitly")
+        if dtype is not None:
+            dtype_sets = [tuple(np.dtype(dtype) for _ in shapes)]
+        else:
+            dtype_sets = self._warm_dtype_sets(len(shapes))
+        before = aot_cache.stats()
+        for b in self.buckets():
+            for dts in dtype_sets:
+                args = [np.zeros((b,) + tuple(s), dt)
+                        for s, dt in zip(shapes, dts)]
+                self._warm_one(args)
+        after = aot_cache.stats()
+        return {
+            "buckets": self.buckets(),
+            "compiled": after["misses"] - before["misses"],
+            "compile_seconds": round(
+                after["compile_seconds"] - before["compile_seconds"], 3),
+        }
+
+    def _warm_dtype_sets(self, k: int) -> List[tuple]:
+        """Input-dtype combinations warmup must cover. Image-typed inputs
+        reach the device as uint8 (the quantized feature path of
+        ``nn_io.as_device(..., feature=True)``) — a DIFFERENT aval than
+        the float path, hence a different executable — and a client may
+        legitimately send either, so both variants are pre-compiled for
+        every bucket."""
+        from deeplearning4j_tpu.nn import io as nn_io
+        import itertools
+
+        types = _input_types(self.model)
+        per_input = []
+        for i in range(k):
+            t = types[i] if types is not None and i < len(types) else None
+            if t is not None and nn_io.image_input(t):
+                per_input.append((self._np_dtype, np.dtype(np.uint8)))
+            else:
+                per_input.append((self._np_dtype,))
+        return list(itertools.product(*per_input))
+
+    def _warm_one(self, args):
+        try:
+            if self._warm_via_aot(args):
+                return
+        except Exception:
+            pass
+        # fallback: one real zeros-forward (any model with .output)
+        import jax
+
+        jax.block_until_ready(self.model.output(*args))
+
+    def _warm_via_aot(self, args) -> bool:
+        """Compile-without-dispatch through ``AotStep.warm`` when the
+        model is a MultiLayerNetwork whose output fn rides the AOT cache
+        (the common serving case) — warmup then costs compile time only,
+        no device execution."""
+        from deeplearning4j_tpu.nn import io as nn_io
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        m = self.model
+        if not isinstance(m, MultiLayerNetwork) or len(args) != 1:
+            return False
+        if m.params is None:
+            m.init()
+        if m._output_fn is None:
+            m._output_fn = m._build_output_fn()
+        if not isinstance(m._output_fn, aot_cache.AotStep):
+            return False
+        x = nn_io.as_device(args[0], m._dtype, feature=True)
+        m._output_fn.warm(m.params, m.state, x, None)
+        return True
+
+    # --- dispatcher ---------------------------------------------------------
+    def _ensure_thread(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        with self._cond:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, name="dl4j-serving-dispatch",
+                    daemon=True)
+                self._thread.start()
+
+    def _loop(self):
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            if batch:
+                self._launch(batch)
+
+    def _expire_locked(self, now: float):
+        if not self._queue:
+            return
+        live = deque()
+        for req in self._queue:
+            if req.deadline is not None and now > req.deadline:
+                req.error = DeadlineExpiredError(
+                    "request deadline expired after "
+                    f"{(now - req.t0) * 1000:.1f} ms in queue")
+                telemetry.record_serving_request("expired", now - req.t0)
+                req.event.set()
+            else:
+                live.append(req)
+        if len(live) != len(self._queue):
+            self._queue = live
+
+    def _rows_for(self, head: _Request) -> int:
+        return sum(r.n for r in self._queue if r.group == head.group)
+
+    def _take_batch(self) -> Optional[List[_Request]]:
+        cfg = self.config
+        settled = None  # (head, rows) snapshot being timed for settle
+        settle_t = 0.0  # monotonic time the snapshot was taken
+        with self._cond:
+            while True:
+                now = time.monotonic()
+                self._expire_locked(now)
+                if self._stop:
+                    return None
+                if not self._queue:
+                    settled = None
+                    self._cond.wait(0.1)
+                    continue
+                head = self._queue[0]
+                rows = self._rows_for(head)
+                limit = head.t0 + cfg.max_delay_ms / 1000.0
+                # The settle test needs BOTH an unchanged snapshot and a
+                # full elapsed window: the condvar also wakes for
+                # other-group submits, and those must not cut the head
+                # group's settle time short.
+                settle_ok = (settled == (head, rows)
+                             and now - settle_t >= cfg.settle_ms / 1000.0)
+                if rows >= cfg.max_batch or now >= limit or settle_ok:
+                    # full bucket, linger ceiling hit, or the queue went a
+                    # whole settle window without growing (every caller is
+                    # already waiting on us — continuous batching)
+                    return self._drain_locked(head)
+                if cfg.settle_ms > 0:
+                    if settled != (head, rows):
+                        settled, settle_t = (head, rows), now
+                    tick = settle_t + cfg.settle_ms / 1000.0 - now
+                else:
+                    settled = None
+                    tick = limit - now
+                self._cond.wait(min(max(tick, 5e-5), limit - now + 5e-5))
+
+    def _drain_locked(self, head: _Request) -> List[_Request]:
+        cfg = self.config
+        batch, rows, rest = [], 0, deque()
+        for req in self._queue:
+            take = (req.group == head.group and rows < cfg.max_batch
+                    and (rows + req.n <= cfg.max_batch or not batch))
+            if take:
+                batch.append(req)
+                rows += req.n
+            else:
+                rest.append(req)
+        self._queue = rest
+        return batch
+
+    def _launch(self, batch: List[_Request]):
+        t0 = time.monotonic()
+        rows = sum(r.n for r in batch)
+        k = len(batch[0].xs)
+        try:
+            cat = [np.concatenate([r.xs[i] for r in batch], axis=0)
+                   if len(batch) > 1 else batch[0].xs[i] for i in range(k)]
+            target = bucket_rows(rows, self._align)
+            if target != rows:
+                cat = [np.concatenate(
+                    [a, np.zeros((target - rows,) + a.shape[1:], a.dtype)])
+                    for a in cat]
+            out = self.model.output(*cat)
+            multi = isinstance(out, (list, tuple))
+            host = [np.asarray(o) for o in (out if multi else [out])]
+        except Exception as e:
+            now = time.monotonic()
+            for r in batch:
+                r.error = e
+                telemetry.record_serving_request("error", now - r.t0)
+                r.event.set()
+            return
+        now = time.monotonic()
+        telemetry.record_serving_batch(rows, target, len(batch), now - t0)
+        off = 0
+        for r in batch:
+            sl = [h[off:off + r.n] for h in host]
+            r.result = sl if multi else sl[0]
+            off += r.n
+            telemetry.record_serving_request("ok", now - r.t0)
+            r.event.set()
+
+    # --- stats / lifecycle --------------------------------------------------
+    def queue_depth(self) -> int:
+        """Pending-request count (lock-free read: deque length is
+        consistent under the GIL, and the value is a point-in-time gauge
+        anyway — the scrape-time collector sums this over live engines)."""
+        return len(self._queue)
+
+    def stats(self) -> dict:
+        """Queue depth + the AOT executable-cache counters (the
+        zero-recompile-after-warmup invariant is read off ``misses``)."""
+        with self._cond:
+            depth = len(self._queue)
+        return {"queue_depth": depth, "buckets": self.buckets(),
+                "aot_cache": aot_cache.stats()}
+
+    def close(self):
+        """Stop the dispatcher; pending requests fail with a shutdown
+        error. Idempotent."""
+        with self._cond:
+            self._stop = True
+            for req in self._queue:
+                req.error = RuntimeError("serving engine closed")
+                req.event.set()
+            self._queue.clear()
+            self._cond.notify_all()
+        telemetry.unregister_serving_engine(self)
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+        self._thread = None
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

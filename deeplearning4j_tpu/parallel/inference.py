@@ -6,10 +6,22 @@ them, per-device ``InferenceWorker`` replicas run batched forwards, results
 are demuxed.
 
 TPU-native inversion: there are no worker threads or queues — a request
-batch is padded to a multiple of the mesh's data axis and executed by the
-model's (already jitted) forward with inputs sharded ``P('data')``; XLA
+batch is padded to a power-of-two bucket of per-device rows and executed by
+the model's (already jitted) forward with inputs sharded ``P('data')``; XLA
 splits the batch across devices. ``INPLACE``-style replica semantics are
 inherent (params replicated, read-only).
+
+Padding policy (round 9): the exact-worker-multiple pad of earlier rounds
+compiled a fresh executable for EVERY distinct request size — ragged
+traffic turned into a compile-per-request pathology. Rows per device now
+quantize to the power-of-two bucket ladder (``parallel.batcher.
+bucket_rows`` with ``align=workers``), so a size sweep touches O(log)
+compiled shapes and ``cache_stats()`` (the ``optimize.aot_cache``
+counters) shows hits, not misses. ``bucketize=False`` restores the exact
+pad for memory-tight models. Cross-request coalescing lives one level up
+in ``parallel.batcher.InferenceEngine`` (which accepts a
+``ParallelInference`` as its backend and aligns its buckets to the worker
+count).
 """
 
 from __future__ import annotations
@@ -18,10 +30,11 @@ import math
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.optimize import aot_cache
 from deeplearning4j_tpu.parallel import mesh as mesh_mod
+from deeplearning4j_tpu.parallel.batcher import bucket_rows
 
 
 class ParallelInference:
@@ -34,7 +47,7 @@ class ParallelInference:
     """
 
     def __init__(self, model, workers: Optional[int] = None,
-                 batch_limit: int = 0, mesh=None):
+                 batch_limit: int = 0, mesh=None, bucketize: bool = True):
         if model.params is None:
             model.init()
         self.model = model
@@ -44,6 +57,9 @@ class ParallelInference:
         # max examples per device program launch (reference batchLimit);
         # 0 = whole request in one launch
         self.batch_limit = int(batch_limit)
+        # pad ragged batches to power-of-two per-worker buckets (zero
+        # recompiles across a size sweep) instead of the exact multiple
+        self.bucketize = bool(bucketize)
         # replicate params once up front (reference: replicas share params
         # via INPLACE model distribution)
         model.params = mesh_mod.replicate(self.mesh, model.params)
@@ -53,7 +69,10 @@ class ParallelInference:
     def _run(self, xs):
         """One sharded program launch over a tuple of input arrays."""
         n = xs[0].shape[0]
-        target = math.ceil(n / self.workers) * self.workers
+        if self.bucketize:
+            target = bucket_rows(n, align=self.workers)
+        else:
+            target = math.ceil(n / self.workers) * self.workers
         spec = mesh_mod.data_parallel_spec(self.mesh)
         placed = [jax.device_put(a, spec)
                   for a in mesh_mod.pad_leading(list(xs), target)]
@@ -70,6 +89,8 @@ class ParallelInference:
         if not self.batch_limit or n <= self.batch_limit:
             result = self._run(xs)
         else:
+            # tail chunks ride the same bucket ladder as full chunks, so a
+            # batch_limit that sits on a bucket boundary never adds shapes
             chunks = [self._run(tuple(a[i:i + self.batch_limit] for a in xs))
                       for i in range(0, n, self.batch_limit)]
             if isinstance(chunks[0], list):
@@ -78,3 +99,10 @@ class ParallelInference:
             else:
                 result = np.concatenate(chunks)
         return result
+
+    def cache_stats(self) -> dict:
+        """The process AOT executable-cache counters
+        (``optimize.aot_cache.stats``): after the first call per bucket,
+        ragged request sizes must register as hits — a rising miss count
+        here is the recompile pathology bucketing exists to kill."""
+        return aot_cache.stats()

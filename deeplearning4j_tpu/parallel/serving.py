@@ -2,23 +2,37 @@
 deployments and libnd4j's ``GraphServer``: a long-lived process answering
 inference requests over the network).
 
-Stdlib ``ThreadingHTTPServer``; concurrent requests ride the model's
-jitted forward (optionally through :class:`ParallelInference` for
-multi-device batch sharding). Endpoints:
+Stdlib ``ThreadingHTTPServer``; concurrent ``/predict`` callers are
+coalesced into shared device launches by a
+:class:`~deeplearning4j_tpu.parallel.batcher.InferenceEngine` (dynamic
+micro-batching + power-of-two padding buckets + the inference-graph
+optimization pass) — ``batching=None`` falls back to the serialized
+one-request-at-a-time path of earlier rounds. Endpoints:
 
 - ``POST /predict``  body ``{"inputs": [...]}`` (nested lists, one array
-  per network input) -> ``{"outputs": [...]}``
+  per network input) -> ``{"outputs": [...]}``; 400 on malformed input,
+  503 when the queue is full or the request's deadline expired
 - ``GET  /model``    model summary + input/output metadata
-- ``GET  /healthz``  liveness
+- ``GET  /healthz``  liveness (+ queue depth under batching)
+- ``GET  /metrics``  Prometheus scrape: serving counters/histograms
+  (``dl4j_serving_*``) + the whole telemetry registry
 """
 
 from __future__ import annotations
 
 import json
 import threading
-from typing import Optional
+from typing import Optional, Union
 
 import numpy as np
+
+from deeplearning4j_tpu.parallel.batcher import (
+    BadRequestError,
+    BatchingConfig,
+    DeadlineExpiredError,
+    InferenceEngine,
+    ServerOverloadedError,
+)
 
 
 class InferenceServer:
@@ -26,19 +40,37 @@ class InferenceServer:
 
     Usage::
 
-        server = InferenceServer(net).start(port=0)
+        server = InferenceServer(net).start(port=0, warmup=True)
         # POST http://127.0.0.1:{server.port}/predict {"inputs": [[...]]}
         server.stop()
+
+    ``batching``: a :class:`BatchingConfig` (or the default one) routes
+    concurrent ``/predict`` requests through the shared-launch engine;
+    ``None`` keeps the legacy global-lock serialized path.
+    ``graph_opt``/``bf16`` forward to the engine's inference-graph
+    optimization pass (ignored without batching).
     """
 
-    def __init__(self, model, dtype=np.float32):
+    def __init__(self, model, dtype=np.float32,
+                 batching: Union[BatchingConfig, None] = ...,
+                 graph_opt: bool = True, bf16: bool = False):
         self.model = model
         self.dtype = dtype
         self._httpd = None
         self._thread = None
         self.port: Optional[int] = None
-        self._lock = threading.Lock()  # one forward at a time: the jitted
-        # call itself pipelines; serializing here keeps results ordered
+        self._lock = threading.Lock()  # batching=None fallback: one
+        # forward at a time, results ordered by serialization
+        if batching is ...:
+            batching = BatchingConfig()
+        self.engine: Optional[InferenceEngine] = None
+        if batching is not None:
+            self.engine = InferenceEngine(model, batching,
+                                          graph_opt=graph_opt, bf16=bf16)
+        # uint8 eligibility per input index is static — walk the conf
+        # once here, not per request in the /predict hot path
+        self._uint8_inputs = tuple(
+            self._uint8_input(i) for i in range(self._expected_inputs()))
 
     # --- inference ----------------------------------------------------------
     def _expected_inputs(self) -> int:
@@ -48,23 +80,66 @@ class InferenceServer:
             return len(conf.network_inputs)
         return 1  # MultiLayerNetwork & co: one feature array
 
+    def _uint8_input(self, idx: int) -> bool:
+        """Whether input ``idx`` is an image-typed feature the model
+        dequantizes in-jit (``nn_io.as_device(..., feature=True)`` keeps
+        uint8 across the host->device link; the 1/255 scale happens
+        inside the compiled forward, matching training)."""
+        from deeplearning4j_tpu.nn import io as nn_io
+
+        net = getattr(self.model, "model", self.model)
+        conf = getattr(net, "conf", None)
+        if conf is None:
+            return False
+        if hasattr(conf, "network_inputs"):
+            types = list(getattr(conf, "input_types", ()) or ())
+            t = types[idx] if idx < len(types) else None
+        else:
+            t = getattr(conf, "input_type", None)
+        return t is not None and nn_io.image_input(t)
+
     def _parse_inputs(self, inputs):
         """Client-error surface: arity + array conversion problems raise
-        ValueError (mapped to 400), never reach the model as a 500."""
+        ValueError (mapped to 400), never reach the model as a 500.
+        Integer-valued image inputs ride as uint8 (the model's quantized
+        feature path: 4x less JSON->device traffic and the exact training
+        dequantization) instead of being silently up-cast to float."""
         expected = self._expected_inputs()
         if len(inputs) != expected:
             raise ValueError(
                 f"model takes {expected} input array(s), got {len(inputs)}")
-        try:
-            return [np.asarray(a, self.dtype) for a in inputs]
-        except (ValueError, TypeError) as e:
-            raise ValueError(f"malformed input array: {e}")
+        out = []
+        for i, a in enumerate(inputs):
+            try:
+                arr = np.asarray(a)
+                if arr.dtype == object:
+                    raise ValueError("ragged nested lists")
+                if (np.issubdtype(arr.dtype, np.integer)
+                        and self._uint8_inputs[i] and arr.size
+                        and 0 <= arr.min() and arr.max() <= 255):
+                    arr = arr.astype(np.uint8)
+                elif arr.dtype != np.dtype(self.dtype):
+                    arr = arr.astype(self.dtype)
+            except (ValueError, TypeError) as e:
+                raise ValueError(f"malformed input array: {e}")
+            out.append(arr)
+        return out
 
     def _predict(self, xs):
-        with self._lock:
-            out = self.model.output(*xs)
+        if self.engine is not None:
+            out = self.engine.predict(*xs)
+        else:
+            with self._lock:
+                out = self.model.output(*xs)
         outs = out if isinstance(out, list) else [out]
         return [np.asarray(o).tolist() for o in outs]
+
+    def warmup(self, **kw) -> dict:
+        """Pre-compile every padding bucket (engine ``warmup``); a no-op
+        dict under ``batching=None``."""
+        if self.engine is None:
+            return {"buckets": [], "compiled": 0}
+        return self.engine.warmup(**kw)
 
     def _model_info(self) -> dict:
         m = self.model
@@ -77,15 +152,29 @@ class InferenceServer:
                 info["outputs"] = list(conf.network_outputs)
             if hasattr(net, "num_params"):
                 info["num_params"] = int(net.num_params())
+        if self.engine is not None:
+            import dataclasses
+
+            info["batching"] = dataclasses.asdict(self.engine.config)
+            info["buckets"] = self.engine.buckets()
         return info
 
     # --- lifecycle ----------------------------------------------------------
     def start(self, port: int = 0, host: str = "127.0.0.1",
-              max_body_bytes: int = 64 * 1024 * 1024):
+              max_body_bytes: int = 64 * 1024 * 1024,
+              warmup: bool = False):
         import http.server
 
         if self._httpd is not None:
             return self
+        if self.engine is not None and self.engine._stop:
+            # restart after stop(): re-arm the dispatcher on the already-
+            # optimized serving model (no second graph_opt pass)
+            self.engine = InferenceEngine(self.engine.model,
+                                          self.engine.config,
+                                          graph_opt=False)
+        if warmup:
+            self.warmup()
         srv = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -99,9 +188,24 @@ class InferenceServer:
 
             def do_GET(self):
                 if self.path == "/healthz":
-                    self._send(200, {"status": "ok"})
+                    payload = {"status": "ok"}
+                    if srv.engine is not None:
+                        payload["queue_depth"] = srv.engine.stats()[
+                            "queue_depth"]
+                    self._send(200, payload)
                 elif self.path == "/model":
                     self._send(200, srv._model_info())
+                elif self.path == "/metrics":
+                    from deeplearning4j_tpu import telemetry
+
+                    body = telemetry.prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self._send(404, {"error": "not found"})
 
@@ -127,8 +231,16 @@ class InferenceServer:
                     return
                 try:
                     outs = srv._predict(xs)
-                except Exception as e:  # model/runtime failure -> 500 JSON,
-                    # never a dropped connection
+                except BadRequestError as e:
+                    # engine-level validation: this sender's problem only
+                    self._send(400, {"error": str(e)})
+                    return
+                except (ServerOverloadedError, DeadlineExpiredError) as e:
+                    # shed load: the client should back off and retry
+                    self._send(503, {"error": str(e)})
+                    return
+                except Exception as e:  # model/runtime failure -> 500
+                    # JSON, never a dropped connection
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
                     return
                 self._send(200, {"outputs": outs})
@@ -149,4 +261,6 @@ class InferenceServer:
             self._httpd.server_close()
             self._httpd = None
             self.port = None
+        if self.engine is not None:
+            self.engine.close()
         return self

@@ -24,6 +24,8 @@ allocation, no lock, no host sync. Scrape surfaces (collectors,
 
 from __future__ import annotations
 
+import weakref
+
 from deeplearning4j_tpu.telemetry import flightrec as flightrec  # noqa: F401
 from deeplearning4j_tpu.telemetry import health as health  # noqa: F401
 from deeplearning4j_tpu.telemetry import registry as registry  # noqa: F401
@@ -145,8 +147,74 @@ def record_step_seconds(seconds: float, path: str = "listener") -> None:
 
 
 # --------------------------------------------------------------------------
+# serving metrics (parallel.batcher / parallel.serving)
+#
+# Unlike the per-step training helpers above these record UNCONDITIONALLY:
+# a serving process wants its request/batch counters without opting into
+# span recording, and one registry update per HTTP request (~1µs) is noise
+# next to the network round-trip it measures. docs/serving.md lists the
+# series.
+# --------------------------------------------------------------------------
+
+def record_serving_request(status: str, seconds: float = None) -> None:
+    """Count one inference request terminal state: ``ok`` / ``error`` /
+    ``bad_request`` / ``rejected`` (queue full) / ``expired`` (deadline);
+    ``seconds`` = submit-to-completion latency when the request made it
+    into the queue."""
+    REGISTRY.counter("dl4j_serving_requests_total",
+                     help="inference requests by terminal status",
+                     status=status).inc()
+    if seconds is not None:
+        REGISTRY.histogram("dl4j_serving_request_seconds",
+                           help="submit-to-result request latency",
+                           ).observe(seconds)
+
+
+def record_serving_batch(rows: int, padded_rows: int, requests: int,
+                         seconds: float) -> None:
+    """Record one shared device launch: fill ratio (real rows / padded
+    bucket rows), rows and coalesced-request histograms, launch time."""
+    REGISTRY.counter("dl4j_serving_batches_total",
+                     help="shared inference launches").inc()
+    REGISTRY.histogram("dl4j_serving_batch_fill_ratio",
+                       help="real rows / padded bucket rows").observe(
+        rows / max(padded_rows, 1))
+    REGISTRY.histogram("dl4j_serving_batch_rows",
+                       help="real rows per shared launch").observe(rows)
+    REGISTRY.histogram("dl4j_serving_batch_requests",
+                       help="requests coalesced per launch").observe(
+        requests)
+    REGISTRY.histogram("dl4j_serving_batch_seconds",
+                       help="shared launch wall time").observe(seconds)
+
+
+_SERVING_ENGINES = weakref.WeakSet()
+
+
+def register_serving_engine(engine) -> None:
+    """Track a live ``InferenceEngine``; ``dl4j_serving_queue_depth`` is
+    collected at scrape time as the SUM over live engines, so several
+    engines in one process (two servers, a restart's old+new pair) are
+    additive instead of overwriting each other's gauge."""
+    _SERVING_ENGINES.add(engine)
+
+
+def unregister_serving_engine(engine) -> None:
+    _SERVING_ENGINES.discard(engine)
+
+
+# --------------------------------------------------------------------------
 # scrape-time collectors (run on snapshot/render, never per step)
 # --------------------------------------------------------------------------
+
+@REGISTRY.register_collector
+def _collect_serving_queue_depth(reg) -> None:
+    engines = list(_SERVING_ENGINES)
+    if engines:
+        reg.gauge("dl4j_serving_queue_depth",
+                  help="pending serving requests").set(
+            sum(e.queue_depth() for e in engines))
+
 
 @REGISTRY.register_collector
 def _collect_aot_cache(reg) -> None:

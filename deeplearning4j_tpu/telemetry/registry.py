@@ -8,10 +8,12 @@ surfaces (``/metrics`` on the UI server, JSONL dumps, the
 device-memory watermarks, host RSS) only when a snapshot/scrape actually
 happens, so a quiet registry costs nothing per step.
 
-Thread safety: metric creation is lock-guarded; increments touch a single
-float under the GIL (the same contract as aot_cache.AotCacheStats).
-Histograms keep a bounded window of recent observations for percentiles
-plus exact count/sum totals.
+Thread safety: metric creation is lock-guarded, and each metric guards
+its own read-modify-write updates with a per-metric lock — the serving
+path increments counters/histograms from many concurrent HTTP handler
+and dispatcher threads, so GIL-interleavable ``value += n`` is not
+enough. Histograms keep a bounded window of recent observations for
+percentiles plus exact count/sum totals.
 """
 
 from __future__ import annotations
@@ -50,9 +52,11 @@ class Counter:
         self.labels = labels
         self.help = help
         self.value = 0.0
+        self._lock = threading.Lock()
 
     def inc(self, n: float = 1.0) -> None:
-        self.value += n
+        with self._lock:
+            self.value += n
 
     def snapshot_value(self):
         return self.value
@@ -68,12 +72,14 @@ class Gauge:
         self.labels = labels
         self.help = help
         self.value = 0.0
+        self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
         self.value = float(v)
 
     def inc(self, n: float = 1.0) -> None:
-        self.value += n
+        with self._lock:
+            self.value += n
 
     def snapshot_value(self):
         return self.value
@@ -95,16 +101,18 @@ class Histogram:
         self.min = float("inf")
         self.max = float("-inf")
         self._window = collections.deque(maxlen=int(window))
+        self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
         v = float(v)
-        self.count += 1
-        self.total += v
-        if v < self.min:
-            self.min = v
-        if v > self.max:
-            self.max = v
-        self._window.append(v)
+        with self._lock:
+            self.count += 1
+            self.total += v
+            if v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+            self._window.append(v)
 
     def quantile(self, q: float) -> float:
         from deeplearning4j_tpu.telemetry.spans import nearest_rank

@@ -276,6 +276,35 @@ class UIServer:
                 self.attach(self._remote_storage)
             return self._remote_storage
 
+    def _serving_panel(self) -> str:
+        """Serving-engine metrics (parallel.batcher): a live table off the
+        process metrics registry — requests by status, shared-launch
+        counts, fill ratio and latency quantiles, queue depth. Rendered
+        only when a serving engine has actually run in this process."""
+        from deeplearning4j_tpu.telemetry import REGISTRY
+
+        snap = REGISTRY.snapshot(run_collectors=False)
+        rows = []
+        for key in sorted(snap):
+            if not key.startswith("dl4j_serving_"):
+                continue
+            v = snap[key]
+            if isinstance(v, dict):
+                if not v.get("count"):
+                    continue
+                val = (f"count {v['count']}  mean {v['mean']:.4g}  "
+                       f"p50 {v['p50']:.4g}  p95 {v['p95']:.4g}  "
+                       f"p99 {v['p99']:.4g}")
+            else:
+                val = f"{v:.6g}"
+            rows.append(f"<tr><td>{html.escape(key)}</td>"
+                        f"<td>{html.escape(val)}</td></tr>")
+        if not rows:
+            return ""
+        return ('<div class="chart"><h3>Serving (dynamic batcher)</h3>'
+                '<table style="font-size:12px;border-spacing:8px 2px">'
+                + "".join(rows) + "</table></div>")
+
     def render_html(self, refresh_seconds: int = 0) -> str:
         """The dashboard as an HTML string."""
         records = [r for st in self._storages for r in st.records()]
@@ -358,6 +387,7 @@ class UIServer:
             _hist_panel("Gradient histograms (latest)",
                         latest_hists.get("gradient_histograms", {}),
                         "#9467bd"),
+            self._serving_panel(),
         ]) or "<p>No stats collected yet.</p>"
         refresh = (f"<meta http-equiv='refresh' content='{refresh_seconds}'>"
                    if refresh_seconds else "")

@@ -1,0 +1,522 @@
+"""High-throughput serving: dynamic cross-request batcher, bucketed AOT
+warmup, inference-graph optimization (reference oracle: the
+``org.deeplearning4j.parallelism.inference`` observable tests, SURVEY.md
+§3.6 — batched observables must demux each caller's exact slice, and a
+bad observation fails alone).
+
+All engine/aot assertions read COUNTER DELTAS: the AOT executable cache
+and the telemetry registry are process-global and shared across the test
+session.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.conf import Activation, InputType
+from deeplearning4j_tpu.conf.layers import (
+    ActivationLayer,
+    DenseLayer,
+    DropoutLayer,
+    OutputLayer,
+)
+from deeplearning4j_tpu.conf.layers_cnn import (
+    BatchNormalization,
+    ConvolutionLayer,
+    FusedConvBN1x1,
+)
+from deeplearning4j_tpu.conf.losses import LossMCXENT
+from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+from deeplearning4j_tpu.conf.updaters import Sgd
+from deeplearning4j_tpu.nn.inference_opt import optimize_for_inference
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize import aot_cache
+from deeplearning4j_tpu.parallel.batcher import (
+    BadRequestError,
+    BatchingConfig,
+    DeadlineExpiredError,
+    InferenceEngine,
+    ServerOverloadedError,
+    bucket_ladder,
+    bucket_rows,
+    next_pow2,
+)
+
+pytestmark = pytest.mark.serving
+
+
+def _mlp_conf(n_in=4, n_out=3, hidden=8, seed=0):
+    return (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_out=hidden, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=n_out, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+
+
+def _mlp(seed=0, hidden=8):
+    # a distinct ``hidden`` width gives a test its own AOT graph key: the
+    # executable cache is process-global and keyed by conf-derived graph
+    # signature, so identical architectures SHARE compiled buckets across
+    # tests (by design — assertions about cold-cache compiles need an
+    # architecture no other test uses)
+    return MultiLayerNetwork(_mlp_conf(hidden=hidden, seed=seed)).init()
+
+
+def _engine(model=None, **cfg):
+    cfg.setdefault("max_batch", 8)
+    return InferenceEngine(model or _mlp(), BatchingConfig(**cfg),
+                           graph_opt=False)
+
+
+def _inert_engine(**cfg):
+    """Engine whose dispatcher never starts: requests stay queued so
+    drain/launch/expiry can be driven deterministically."""
+    eng = _engine(**cfg)
+    eng._ensure_thread = lambda: None
+    return eng
+
+
+# --- bucket math -----------------------------------------------------------
+
+def test_next_pow2():
+    assert [next_pow2(n) for n in (1, 2, 3, 4, 5, 8, 9, 64, 65)] == \
+        [1, 2, 4, 4, 8, 8, 16, 64, 128]
+
+
+def test_bucket_rows_alignment():
+    assert bucket_rows(5) == 8
+    assert bucket_rows(8) == 8
+    assert bucket_rows(9, align=8) == 16  # 2 rows/device -> 16 total
+    assert bucket_rows(17, align=8) == 32
+    assert bucket_rows(1, align=8) == 8
+
+
+def test_bucket_ladder_covers_max_batch():
+    assert bucket_ladder(8) == [1, 2, 4, 8]
+    assert bucket_ladder(6) == [1, 2, 4, 8]  # ceil to cover a 6-row batch
+    assert bucket_ladder(16, align=8) == [8, 16]
+
+
+# --- coalescing + demux (deterministic, no dispatcher thread) --------------
+
+def test_coalesced_launch_demuxes_exact_slices():
+    net = _mlp()
+    eng = InferenceEngine(net, BatchingConfig(max_batch=8, max_delay_ms=0.0),
+                          graph_opt=False)
+    eng._ensure_thread = lambda: None
+    rng = np.random.default_rng(0)
+    xs = [rng.normal(size=(n, 4)).astype(np.float32) for n in (1, 3, 2)]
+    reqs = [eng.submit((x,)) for x in xs]
+    batch = eng._take_batch()
+    assert len(batch) == 3  # one shared launch for all three callers
+    eng._launch(batch)
+    for req, x in zip(reqs, xs):
+        got = eng.result(req)
+        assert got.shape == (x.shape[0], 3)
+        # bit-identical to this caller's own unbatched forward at the
+        # same bucket (row-independent compute; padding rows sliced off)
+        np.testing.assert_array_equal(got, np.asarray(net.output(x)))
+    eng.close()
+
+
+def test_drain_respects_max_batch():
+    eng = _inert_engine(max_batch=4, max_delay_ms=0.0)
+    reqs = [eng.submit((np.zeros((2, 4), np.float32),)) for _ in range(3)]
+    batch = eng._take_batch()
+    assert [r.n for r in batch] == [2, 2]  # third would overflow max_batch
+    assert eng.stats()["queue_depth"] == 1
+    assert batch[0] is reqs[0] and batch[1] is reqs[1]
+    eng.close()
+
+
+def test_oversized_request_launches_alone():
+    eng = _inert_engine(max_batch=4, max_delay_ms=0.0)
+    big = eng.submit((np.zeros((6, 4), np.float32),))
+    batch = eng._take_batch()
+    assert batch == [big]
+    eng._launch(batch)
+    assert eng.result(big).shape == (6, 3)
+    eng.close()
+
+
+def test_heterogeneous_shapes_never_share_a_launch():
+    """A (B, 4) caller and a (B, 2, 4)-shaped caller must not be
+    concatenated; grouping is by trailing-shape signature."""
+    eng = _inert_engine(max_delay_ms=0.0)
+    eng._templates = None  # shape-agnostic backend: group sig only
+    a = eng.submit((np.zeros((2, 4), np.float32),))
+    eng.submit((np.zeros((1, 2, 4), np.float32),))
+    batch = eng._take_batch()
+    assert batch == [a]
+    assert eng.stats()["queue_depth"] == 1
+    eng.close()
+
+
+# --- concurrent clients through the real dispatcher ------------------------
+
+def test_concurrent_clients_each_get_their_own_result():
+    net = _mlp()
+    eng = InferenceEngine(net, BatchingConfig(max_batch=16, max_delay_ms=5),
+                          graph_opt=False)
+    rng = np.random.default_rng(1)
+    inputs = [rng.normal(size=(1 + i % 5, 4)).astype(np.float32)
+              for i in range(24)]
+    results = [None] * len(inputs)
+
+    def client(i):
+        results[i] = eng.predict(inputs[i])
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(inputs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i, x in enumerate(inputs):
+        np.testing.assert_array_equal(results[i], np.asarray(net.output(x)))
+    eng.close()
+
+
+def test_malformed_request_fails_sender_only():
+    net = _mlp()
+    eng = InferenceEngine(net, BatchingConfig(max_batch=8, max_delay_ms=20),
+                          graph_opt=False)
+    x = np.ones((2, 4), np.float32)
+    good = eng.submit((x,))
+    with pytest.raises(BadRequestError, match="does not match"):
+        eng.submit((np.ones((2, 5), np.float32),))  # wrong feature width
+    with pytest.raises(BadRequestError, match="malformed|ragged"):
+        eng.submit(([[1.0, 2.0], [3.0]],))
+    with pytest.raises(BadRequestError, match="takes 1 input"):
+        eng.submit((x, x))
+    # the shared batch was never poisoned: the good caller completes
+    np.testing.assert_array_equal(eng.result(good),
+                                  np.asarray(net.output(x)))
+    eng.close()
+
+
+def test_backend_failure_reaches_every_coalesced_caller():
+    class Broken:
+        def output(self, *xs):
+            raise RuntimeError("device exploded")
+
+    eng = InferenceEngine(Broken(), BatchingConfig(max_delay_ms=0.0),
+                          graph_opt=False)
+    req = eng.submit((np.ones((1, 4), np.float32),))
+    with pytest.raises(RuntimeError, match="device exploded"):
+        eng.result(req)
+    eng.close()
+
+
+# --- admission control / deadlines -----------------------------------------
+
+def test_queue_full_rejects_with_503_semantics():
+    eng = _inert_engine(max_queue=2)
+    eng.submit((np.zeros((1, 4), np.float32),))
+    eng.submit((np.zeros((1, 4), np.float32),))
+    with pytest.raises(ServerOverloadedError, match="queue full"):
+        eng.submit((np.zeros((1, 4), np.float32),))
+    eng.close()
+
+
+def test_expired_deadline_never_launches():
+    eng = _inert_engine()
+    req = eng.submit((np.zeros((1, 4), np.float32),), timeout_ms=0.01)
+    time.sleep(0.005)
+    with eng._cond:
+        eng._expire_locked(time.monotonic())
+    with pytest.raises(DeadlineExpiredError):
+        eng.result(req)
+    assert eng.stats()["queue_depth"] == 0
+    eng.close()
+
+
+def test_close_fails_pending_requests():
+    eng = _inert_engine()
+    req = eng.submit((np.zeros((1, 4), np.float32),))
+    eng.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.result(req)
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.submit((np.zeros((1, 4), np.float32),))
+
+
+# --- warmup / zero-recompile invariant -------------------------------------
+
+def test_warmup_then_ragged_sweep_zero_recompiles():
+    eng = _engine(_mlp(hidden=11), max_batch=8)  # unique arch: cold cache
+    report = eng.warmup()
+    assert report["buckets"] == [1, 2, 4, 8]
+    assert report["compiled"] >= 1  # cold cache: at least one real compile
+    miss0 = aot_cache.stats()["misses"]
+    rng = np.random.default_rng(2)
+    for n in (1, 2, 3, 4, 5, 6, 7, 8, 3, 7):  # ragged sweep
+        out = eng.predict(rng.normal(size=(n, 4)).astype(np.float32))
+        assert out.shape == (n, 3)
+    stats = aot_cache.stats()
+    assert stats["misses"] == miss0, "ragged traffic recompiled"
+    assert stats["hits"] > 0
+    eng.close()
+
+
+def test_warmup_is_idempotent():
+    eng = _engine(max_batch=4)
+    eng.warmup()
+    assert eng.warmup()["compiled"] == 0  # second pass: all cached
+    eng.close()
+
+
+def test_warmup_requires_shapes_when_conf_missing():
+    class Anon:
+        def output(self, *xs):
+            return xs[0]
+
+    eng = InferenceEngine(Anon(), BatchingConfig(max_batch=2),
+                          graph_opt=False)
+    with pytest.raises(ValueError, match="pass\\s+warmup"):
+        eng.warmup()
+    eng.close()
+
+
+# --- inference-graph optimization pass -------------------------------------
+
+def _bn_net(seed=3):
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                    activation=Activation.IDENTITY))
+            .layer(BatchNormalization())
+            .layer(ActivationLayer(activation=Activation.RELU))
+            .layer(DropoutLayer(dropout=0.5))
+            .layer(DenseLayer(n_out=8, activation=Activation.IDENTITY,
+                              has_bias=False))
+            .layer(BatchNormalization(activation=Activation.RELU))
+            .layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.convolutional(6, 6, 2)).build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(16, 6, 6, 2)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    net.fit(x, y)  # non-trivial BN running stats
+    return net, x
+
+
+def test_bn_fold_matches_unoptimized_output():
+    net, x = _bn_net()
+    opt = optimize_for_inference(net)
+    names = [type(l).__name__ for l in opt.conf.layers]
+    assert "BatchNormalization" not in names
+    assert "DropoutLayer" not in names
+    np.testing.assert_allclose(np.asarray(opt.output(x)),
+                               np.asarray(net.output(x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_optimize_never_mutates_original():
+    net, x = _bn_net(seed=4)
+    before = np.asarray(net.output(x))
+    layers_before = tuple(net.conf.layers)
+    optimize_for_inference(net)
+    assert tuple(net.conf.layers) == layers_before
+    np.testing.assert_array_equal(np.asarray(net.output(x)), before)
+
+
+def test_bf16_policy_outputs_f32():
+    net, x = _bn_net(seed=5)
+    opt = optimize_for_inference(net, bf16=True)
+    out = np.asarray(opt.output(x))
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out, np.asarray(net.output(x)), atol=0.05)
+
+
+def test_fused_conv_bn_unfuses_to_plain_conv():
+    conf = (NeuralNetConfiguration.builder().seed(6).list()
+            .layer(FusedConvBN1x1(n_out=4, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.convolutional(5, 5, 2)).build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(8, 5, 5, 2)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    net.fit(x, y)
+    opt = optimize_for_inference(net)
+    names = [type(l).__name__ for l in opt.conf.layers]
+    assert "FusedConvBN1x1" not in names
+    assert "ConvolutionLayer" in names
+    np.testing.assert_allclose(np.asarray(opt.output(x)),
+                               np.asarray(net.output(x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+# --- ParallelInference bucketing -------------------------------------------
+
+def test_parallel_inference_bucketed_sweep_single_compile():
+    from deeplearning4j_tpu.parallel import ParallelInference
+
+    net = _mlp(seed=7)
+    pi = ParallelInference(net)  # 8 virtual devices -> align=8
+    rng = np.random.default_rng(7)
+    # reference outputs computed UP FRONT: each exact-size net.output()
+    # launch has its own signature and must not be charged to the sweep
+    xs = [rng.normal(size=(n, 4)).astype(np.float32)
+          for n in (1, 2, 5, 7, 8, 4)]  # all quantize to the 8-row bucket
+    refs = [np.asarray(net.output(x)) for x in xs]
+    first = pi.output(xs[0])
+    assert first.shape == (1, 3)
+    miss0 = pi.cache_stats()["misses"]
+    for x, ref in zip(xs, refs):
+        np.testing.assert_allclose(pi.output(x), ref, atol=1e-6)
+    assert pi.cache_stats()["misses"] == miss0
+
+
+def test_parallel_inference_batch_limit_tail_rides_same_buckets():
+    from deeplearning4j_tpu.parallel import ParallelInference
+
+    net = _mlp(seed=8)
+    pi = ParallelInference(net, batch_limit=16)
+    rng = np.random.default_rng(8)
+    # 38 = 16 + 16 + 6-row tail; the tail pads to the 8-row bucket — a
+    # ladder shape, never a per-size shape
+    x = rng.normal(size=(38, 4)).astype(np.float32)
+    ref = np.asarray(net.output(x))
+    pi.output(rng.normal(size=(16, 4)).astype(np.float32))
+    miss0 = pi.cache_stats()["misses"]
+    got = pi.output(x)
+    np.testing.assert_allclose(got, ref, atol=1e-6)
+    assert pi.cache_stats()["misses"] - miss0 <= 1  # the 8-row bucket
+    miss1 = pi.cache_stats()["misses"]
+    pi.output(rng.normal(size=(38, 4)).astype(np.float32))
+    assert pi.cache_stats()["misses"] == miss1  # repeat size: all hits
+
+
+# --- InferenceServer over HTTP ---------------------------------------------
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_server_concurrent_predicts_share_engine():
+    from deeplearning4j_tpu.parallel import InferenceServer
+
+    net = _mlp(seed=9)
+    server = InferenceServer(
+        net, batching=BatchingConfig(max_batch=8, max_delay_ms=5)
+    ).start(port=0, warmup=True)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        rng = np.random.default_rng(9)
+        inputs = [rng.normal(size=(1 + i % 4, 4)).astype(np.float32)
+                  for i in range(8)]
+        results = [None] * len(inputs)
+
+        def client(i):
+            results[i] = _post(base + "/predict",
+                               {"inputs": [inputs[i].tolist()]})
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(inputs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, x in enumerate(inputs):
+            code, body = results[i]
+            assert code == 200, body
+            np.testing.assert_allclose(
+                np.asarray(body["outputs"][0], np.float32),
+                np.asarray(net.output(x)), rtol=1e-5, atol=1e-6)
+        # serving metrics are live on the server's own scrape endpoint
+        text = urllib.request.urlopen(base + "/metrics",
+                                      timeout=10).read().decode()
+        assert "dl4j_serving_requests_total" in text
+        assert "dl4j_serving_batches_total" in text
+        info = json.loads(urllib.request.urlopen(base + "/model",
+                                                 timeout=10).read())
+        assert info["batching"]["max_batch"] == 8
+        assert info["buckets"] == [1, 2, 4, 8]
+    finally:
+        server.stop()
+
+
+def test_server_uint8_image_path_matches_direct_output():
+    from deeplearning4j_tpu.parallel import InferenceServer
+
+    conf = (NeuralNetConfiguration.builder().seed(10).list()
+            .layer(ConvolutionLayer(n_out=3, kernel_size=(3, 3),
+                                    activation=Activation.RELU))
+            .layer(OutputLayer(n_out=2, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.convolutional(6, 6, 1)).build())
+    net = MultiLayerNetwork(conf).init()
+    server = InferenceServer(net, graph_opt=False).start(port=0, warmup=True)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        x = np.random.default_rng(10).integers(
+            0, 256, size=(3, 6, 6, 1)).astype(np.uint8)
+        ref = np.asarray(net.output(x))  # own exact-size launch: before
+        # the snapshot, so its compile isn't charged to the served path
+        miss0 = aot_cache.stats()["misses"]
+        code, body = _post(base + "/predict", {"inputs": [x.tolist()]})
+        assert code == 200, body
+        # integer-valued image JSON rides as uint8 (the in-jit dequant
+        # path), matching a direct uint8 output() call exactly — and the
+        # uint8 executable was part of warmup, so no compile happened
+        np.testing.assert_array_equal(
+            np.asarray(body["outputs"][0], np.float32), ref)
+        assert aot_cache.stats()["misses"] == miss0
+    finally:
+        server.stop()
+
+
+def test_server_legacy_lock_path_still_serves():
+    from deeplearning4j_tpu.parallel import InferenceServer
+
+    net = _mlp(seed=11)
+    server = InferenceServer(net, batching=None).start(port=0)
+    try:
+        assert server.engine is None
+        base = f"http://127.0.0.1:{server.port}"
+        x = np.ones((2, 4), np.float32)
+        code, body = _post(base + "/predict", {"inputs": [x.tolist()]})
+        assert code == 200
+        np.testing.assert_allclose(
+            np.asarray(body["outputs"][0], np.float32),
+            np.asarray(net.output(x)), rtol=1e-5, atol=1e-6)
+        assert server.warmup() == {"buckets": [], "compiled": 0}
+    finally:
+        server.stop()
+
+
+def test_server_503_when_engine_overloaded():
+    from deeplearning4j_tpu.parallel import InferenceServer
+
+    net = _mlp(seed=12)
+    server = InferenceServer(
+        net, batching=BatchingConfig(max_queue=1), graph_opt=False
+    ).start(port=0)
+    try:
+        # jam the dispatcher so submissions pile up against max_queue
+        server.engine._ensure_thread = lambda: None
+        server.engine.submit((np.ones((1, 4), np.float32),))
+        base = f"http://127.0.0.1:{server.port}"
+        code, body = _post(base + "/predict", {"inputs": [[[1, 2, 3, 4]]]})
+        assert code == 503
+        assert "queue full" in body["error"]
+    finally:
+        server.stop()
