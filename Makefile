@@ -27,6 +27,16 @@ serve-smoke:
 		-p no:cacheprovider
 	$(PY) bench_serving.py --smoke
 
+.PHONY: chaos-smoke
+# Chaos smoke: the deterministic fault-plan suite (seeded injections,
+# retry/backoff math, breaker trip->half-open->close, crash-mid-write
+# checkpointing, bit-identical TrainingSession resume) on CPU with the
+# same pinning as tier-1. Every fault is armed with a fixed seed, so a
+# failure here replays exactly.
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests -q -m resilience \
+		-p no:cacheprovider
+
 .PHONY: bench-serving
 # Closed-loop 8-client serving benchmark: locked single-request baseline
 # vs the dynamic micro-batching engine (acceptance bar: >= 4x).

@@ -149,11 +149,17 @@ class DeviceRingIterator(DataSetIterator):
     Non-``DataSet`` items (MultiDataSet) pass through unstaged."""
 
     def __init__(self, wrapped: DataSetIterator, depth: int = 2,
-                 donate: bool = True, device=None):
+                 donate: bool = True, device=None, retry=...):
+        from deeplearning4j_tpu.resilience import retry as _retry
+
         self.wrapped = wrapped
         self.depth = max(1, int(depth))
         self.donate = bool(donate)
         self.device = device
+        # transient device_put failures (driver hiccup, injected fault)
+        # are retried with backoff instead of killing the epoch; pass
+        # retry=None to stage without a safety net
+        self.retry = _retry.INGEST_RETRY if retry is ... else retry
         self.staged_count = 0
         self.retired_count = 0
 
@@ -176,10 +182,17 @@ class DeviceRingIterator(DataSetIterator):
         put = (lambda a: jax.device_put(a, self.device)) if self.device \
             else jax.device_put
 
+        from deeplearning4j_tpu.resilience import faults
+
+        def put_once(a):
+            faults.fault_point("ingest.device_put")
+            return put(np.asarray(a))
+
         def stage(a):
             if a is None or isinstance(a, jax.Array):
                 return a
-            d = put(np.asarray(a))
+            d = (self.retry.call(put_once, a, op="ingest.device_put")
+                 if self.retry is not None else put_once(a))
             owned.append(d)
             return d
 

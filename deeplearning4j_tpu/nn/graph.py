@@ -449,9 +449,17 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         if self.conf.backprop_type is BackpropType.TRUNCATED_BPTT:
             ndims = [np.ndim(f) for f in _as_multi(ds).features]
             if all(d == 3 for d in ndims):
+                from deeplearning4j_tpu.resilience import faults
+
                 # one normalization path shared with ParallelWrapper
                 with telemetry.span(telemetry.PHASE_INGEST):
                     args = self.tbptt_batch_arrays(ds)
+                # same once-per-optimization-step injection site as the
+                # standard branch — tBPTT steps are killable too (the
+                # corrupt action poisons the first input sequence)
+                feats = args[0]
+                args = ((faults.fault_point("train.step", feats[0]),
+                         ) + tuple(feats[1:]),) + tuple(args[1:])
                 return self._fit_tbptt(*args)
             if any(d == 3 for d in ndims):
                 # a MIXED seq/static batch must not silently train
@@ -498,6 +506,12 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         with telemetry.span(telemetry.PHASE_INGEST):
             features, labels, fmasks, lmasks = self._prep_batch(
                 ds, lazy_lmasks=True, write_back=True)
+        from deeplearning4j_tpu.resilience import faults
+
+        # injection site (raise = preemption/crash, corrupt = poisoned
+        # first input feeding the health guards); host-side, pre-jit
+        features = (faults.fault_point("train.step", features[0]),
+                    ) + tuple(features[1:])
         gvec = None
         with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
             out = self._train_step(

@@ -441,15 +441,25 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
 
         tbptt = (self.conf.backprop_type is BackpropType.TRUNCATED_BPTT
                  and np.ndim(ds.features) == 3)
+        from deeplearning4j_tpu.resilience import faults
+
         if tbptt:
             # one normalization path shared with ParallelWrapper
             with telemetry.span(telemetry.PHASE_INGEST):
                 args = self.tbptt_batch_arrays(ds)
+            # same once-per-optimization-step injection site as the
+            # standard branch below — tBPTT steps are killable too
+            args = (faults.fault_point("train.step", args[0]),
+                    ) + tuple(args[1:])
             return self._fit_tbptt(*args)
         with telemetry.span(telemetry.PHASE_INGEST):
             features, labels, fmask, lmask = self._batch_arrays(
                 ds, lazy_lmask=True, write_back=True)
         from deeplearning4j_tpu.telemetry import health
+
+        # injection site (raise = preemption/crash, corrupt = poisoned
+        # batch feeding the health guards); host-side, outside the jit
+        features = faults.fault_point("train.step", features)
 
         mode = health.graph_mode()
         if self._train_step is None \

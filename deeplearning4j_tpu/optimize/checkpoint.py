@@ -61,15 +61,19 @@ def restore_training_state(model, snap: dict) -> None:
 
 
 class Checkpoint:
-    """One row of checkpoint.csv metadata (reference ``Checkpoint``)."""
+    """One row of checkpoint.csv metadata (reference ``Checkpoint``).
+    ``digest`` (sha256 of the zip, recorded at save time) is empty for
+    rows written before the integrity column existed — those load
+    unverified, exactly as they always did."""
 
     def __init__(self, number: int, timestamp: float, iteration: int,
-                 epoch: int, filename: str):
+                 epoch: int, filename: str, digest: str = ""):
         self.number = int(number)
         self.timestamp = float(timestamp)
         self.iteration = int(iteration)
         self.epoch = int(epoch)
         self.filename = filename
+        self.digest = digest
 
 
 class CheckpointListener(TrainingListener):
@@ -128,11 +132,21 @@ class CheckpointListener(TrainingListener):
 
     # --- mechanics ----------------------------------------------------------
     def _save(self, model, iteration, epoch):
+        from deeplearning4j_tpu.resilience.retry import CHECKPOINT_RETRY
+
         num = self._count
         self._count += 1
         fname = f"checkpoint_{num}_iter_{iteration}_epoch_{epoch}.zip"
-        serializer.write_model(model, os.path.join(self.directory, fname))
-        new_row = Checkpoint(num, time.time(), iteration, epoch, fname)
+        path = os.path.join(self.directory, fname)
+        # retried: a transient ENOSPC/EINTR mid-save costs a backoff, not
+        # the checkpoint (write_model cleans its temp file per attempt)
+        CHECKPOINT_RETRY.call(serializer.write_model, model, path,
+                              op="checkpoint.write")
+        # digest recorded AFTER the atomic publish: checkpoint.csv only
+        # ever references fully-written zips, with the content hash load
+        # verifies against
+        new_row = Checkpoint(num, time.time(), iteration, epoch, fname,
+                             serializer.file_digest(path))
         rows = self._read_rows() + [new_row]
         # atomic rewrite: a crash mid-write must never truncate the
         # numbering authority (same temp+replace scheme as write_model)
@@ -142,7 +156,7 @@ class CheckpointListener(TrainingListener):
                 w = csv.writer(f)
                 for c in rows:
                     w.writerow([c.number, c.timestamp, c.iteration,
-                                c.epoch, c.filename])
+                                c.epoch, c.filename, c.digest])
             os.replace(tmp, self._csv)
         finally:
             if os.path.exists(tmp):
@@ -186,25 +200,49 @@ class CheckpointListener(TrainingListener):
         cps = self.list_checkpoints()
         return cps[-1] if cps else None
 
+    def verify(self, cp: Checkpoint) -> bool:
+        """Whether ``cp``'s zip matches the content digest recorded at
+        save time (rows from before the digest column pass unverified)."""
+        path = os.path.join(self.directory, cp.filename)
+        if not os.path.exists(path):
+            return False
+        if not cp.digest:
+            return True
+        return serializer.file_digest(path) == cp.digest
+
+    def _restore_chain(self, number, restore_fn):
+        """Digest-verified restore with last-good fallback
+        (``serializer.restore_newest_verified``). An explicit ``number``
+        disables the fallback (the caller asked for exactly that state;
+        silently handing back a different one would be wrong)."""
+        cps = self.list_checkpoints()
+        if not cps:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        if number is not None:
+            cp = next(c for c in cps if c.number == number)
+            if not self.verify(cp):
+                raise OSError(
+                    f"checkpoint {cp.filename} failed digest verification")
+            return restore_fn(os.path.join(self.directory, cp.filename))
+        restored, _, last_err = serializer.restore_newest_verified(
+            [(os.path.join(self.directory, c.filename), c.digest)
+             for c in cps], restore_fn)
+        if restored is None:
+            raise FileNotFoundError(
+                f"no loadable checkpoint in {self.directory} "
+                f"({len(cps)} present, all corrupt/truncated)") \
+                from last_err
+        return restored
+
     def load_checkpoint(self, number: Optional[int] = None):
         """Restore a MultiLayerNetwork from checkpoint ``number`` (default:
-        latest)."""
-        cps = self.list_checkpoints()
-        if not cps:
-            raise FileNotFoundError(f"no checkpoints in {self.directory}")
-        cp = cps[-1] if number is None else next(
-            c for c in cps if c.number == number)
-        return serializer.restore_multi_layer_network(
-            os.path.join(self.directory, cp.filename))
+        newest that passes digest verification and loads)."""
+        return self._restore_chain(
+            number, serializer.restore_multi_layer_network)
 
     def load_checkpoint_graph(self, number: Optional[int] = None):
-        cps = self.list_checkpoints()
-        if not cps:
-            raise FileNotFoundError(f"no checkpoints in {self.directory}")
-        cp = cps[-1] if number is None else next(
-            c for c in cps if c.number == number)
-        return serializer.restore_computation_graph(
-            os.path.join(self.directory, cp.filename))
+        return self._restore_chain(
+            number, serializer.restore_computation_graph)
 
 
 class AsyncCheckpointListener(TrainingListener):

@@ -34,6 +34,7 @@ with :class:`BadRequestError` for its sender only.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import threading
 import time
 from collections import deque
@@ -43,6 +44,12 @@ import numpy as np
 
 from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.optimize import aot_cache
+from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.resilience.breaker import (
+    CircuitBreaker,
+    CircuitOpenError,
+)
+from deeplearning4j_tpu.resilience.retry import SERVING_RETRY
 
 
 class BadRequestError(ValueError):
@@ -60,6 +67,13 @@ class DeadlineExpiredError(RuntimeError):
     to HTTP 503 (the caller has already given up; don't burn a launch)."""
 
 
+class LaunchTimeoutError(RuntimeError):
+    """The launch watchdog fired: a shared forward exceeded
+    ``launch_timeout_ms``. The stuck launch's waiters get this (HTTP 503)
+    and a replacement dispatcher keeps draining the queue — a wedged
+    device launch must not hang every later caller."""
+
+
 @dataclasses.dataclass
 class BatchingConfig:
     """Dispatcher policy knobs (reference ``ParallelInference.Builder``
@@ -74,6 +88,15 @@ class BatchingConfig:
     # sitting out the rest of max_delay_ms (which stays the hard ceiling
     # for a steady trickle that never settles). 0 disables early launch.
     settle_ms: float = 0.2
+    # launch watchdog: a shared forward running longer than this fails
+    # its waiters with LaunchTimeoutError (503) and hands the queue to a
+    # replacement dispatcher instead of hanging every later caller.
+    # None disables (a healthy compiled forward has no steady-state
+    # upper bound the engine can know; opt in per deployment).
+    launch_timeout_ms: Optional[float] = None
+
+
+_ENGINE_SEQ = itertools.count(1)  # default breaker names: serving-1, -2, ...
 
 
 def next_pow2(n: int) -> int:
@@ -187,8 +210,24 @@ class InferenceEngine:
     """
 
     def __init__(self, model, config: Optional[BatchingConfig] = None,
-                 graph_opt: bool = True, bf16: bool = False):
+                 graph_opt: bool = True, bf16: bool = False,
+                 breaker: Optional[CircuitBreaker] = ...,
+                 retry=...):
         self.config = config or BatchingConfig()
+        # circuit breaker on the launch path: consecutive failures trip
+        # it open and submits shed with CircuitOpenError (503) instead of
+        # queueing behind a dead model; half-open probes recover. Pass
+        # None to disable, or a configured CircuitBreaker to tune. The
+        # default name is unique per engine: multiple engines in one
+        # process must not collide on dl4j_circuit_state{breaker=...} or
+        # shadow each other in resilience.status() (same multi-engine
+        # failure mode as the PR 5 queue-depth gauge).
+        self._breaker = (CircuitBreaker(name=f"serving-{next(_ENGINE_SEQ)}")
+                         if breaker is ... else breaker)
+        # one transient-class retry (OSError/ConnectionError/Timeout/
+        # injected faults) before a launch failure reaches the breaker;
+        # model bugs (ValueError & co) are never retried. None disables.
+        self._retry = SERVING_RETRY if retry is ... else retry
         if graph_opt:
             from deeplearning4j_tpu.nn.inference_opt import (
                 optimize_for_inference,
@@ -266,6 +305,17 @@ class InferenceEngine:
                 telemetry.record_serving_request("rejected")
                 raise ServerOverloadedError(
                     f"serving queue full ({self.config.max_queue} pending)")
+            # breaker check LAST: a request rejected for being malformed
+            # or for overload must not consume a half-open probe ticket
+            # (a burned ticket with no outcome wedges the breaker
+            # half-open for a full recovery window)
+            if self._breaker is not None and not self._breaker.allow():
+                # fail-fast shedding while the breaker is open: don't
+                # queue behind a model currently failing every launch
+                telemetry.record_serving_request("shed")
+                raise CircuitOpenError(
+                    f"circuit breaker {self._breaker.name!r} is "
+                    f"{self._breaker.state}; request shed")
             self._queue.append(req)
             self._cond.notify_all()
         self._ensure_thread()
@@ -385,12 +435,21 @@ class InferenceEngine:
                 self._thread.start()
 
     def _loop(self):
+        me = threading.current_thread()
         while True:
             batch = self._take_batch()
             if batch is None:
                 return
             if batch:
                 self._launch(batch)
+            with self._cond:
+                if self._thread is not me:
+                    # the watchdog declared our launch stuck and started
+                    # a replacement dispatcher; it owns the queue now
+                    # (checked under the lock: the watchdog's claim +
+                    # thread swap are atomic, so we can never take a
+                    # batch the replacement is also draining)
+                    return
 
     def _expire_locked(self, now: float):
         if not self._queue:
@@ -462,10 +521,87 @@ class InferenceEngine:
         self._queue = rest
         return batch
 
+    def _finish(self, req: _Request, result=None, error=None,
+                status: str = "ok") -> bool:
+        """Race-safe request completion: exactly one of {dispatcher,
+        watchdog, close} delivers a request's outcome — whoever sets the
+        event first wins, later callers are no-ops (False)."""
+        with self._cond:
+            if req.event.is_set():
+                return False
+            req.result = result
+            req.error = error
+            req.event.set()
+        telemetry.record_serving_request(status, time.monotonic() - req.t0)
+        return True
+
+    def _claim_batch(self, claim, owner: str) -> bool:
+        """Exactly ONE of {dispatcher, watchdog} owns a launch's outcome:
+        the owner delivers every waiter's result/error and reports the
+        single breaker outcome. The loser does nothing — so one launch
+        can never split its waiters between the two or count on the
+        breaker twice (once as a timeout, again as a late success)."""
+        with self._cond:
+            if claim[0] is not None:
+                return False
+            claim[0] = owner
+            return True
+
+    def _forward(self, cat, batch: List[_Request]):
+        """The shared launch, behind the ``serving.launch`` fault site
+        and (when configured) one transient-class retry bounded by the
+        batch's tightest request deadline."""
+        def once():
+            faults.fault_point("serving.launch")
+            return self.model.output(*cat)
+
+        if self._retry is None:
+            return once()
+        deadlines = [r.deadline for r in batch if r.deadline is not None]
+        return self._retry.call(
+            once, deadline=min(deadlines) if deadlines else None,
+            op="serving.launch")
+
+    def _arm_watchdog(self, batch: List[_Request], claim):
+        tmo = self.config.launch_timeout_ms
+        if not tmo:
+            return None
+        t = threading.Timer(tmo / 1000.0, self._watchdog_fire,
+                            args=(batch, threading.current_thread(), claim))
+        t.daemon = True
+        t.start()
+        return t
+
+    def _watchdog_fire(self, batch: List[_Request], stuck_thread, claim):
+        """Launch-timeout path: claim the batch (atomically with the
+        dispatcher swap — ``Timer.cancel`` cannot stop an already-running
+        callback, so the claim is what decides the race), fail the stuck
+        launch's waiters with 503, and hand the queue to a fresh
+        dispatcher. The stuck thread exits when (if ever) its launch
+        returns — its claim fails, so its late outcome is a no-op."""
+        with self._cond:
+            if claim[0] is not None:
+                return  # lost the race: the launch completed in time
+            claim[0] = "watchdog"
+            if not self._stop and self._thread is stuck_thread:
+                self._thread = threading.Thread(
+                    target=self._loop, name="dl4j-serving-dispatch",
+                    daemon=True)
+                self._thread.start()
+        err = LaunchTimeoutError(
+            f"shared launch exceeded {self.config.launch_timeout_ms} ms; "
+            "waiters failed by watchdog")
+        for r in batch:
+            self._finish(r, error=err, status="timeout")
+        if self._breaker is not None:
+            self._breaker.on_failure()
+
     def _launch(self, batch: List[_Request]):
         t0 = time.monotonic()
         rows = sum(r.n for r in batch)
         k = len(batch[0].xs)
+        claim = [None]  # mutated under self._cond only (_claim_batch)
+        watchdog = self._arm_watchdog(batch, claim)
         try:
             cat = [np.concatenate([r.xs[i] for r in batch], axis=0)
                    if len(batch) > 1 else batch[0].xs[i] for i in range(k)]
@@ -474,25 +610,44 @@ class InferenceEngine:
                 cat = [np.concatenate(
                     [a, np.zeros((target - rows,) + a.shape[1:], a.dtype)])
                     for a in cat]
-            out = self.model.output(*cat)
+            out = self._forward(cat, batch)
             multi = isinstance(out, (list, tuple))
             host = [np.asarray(o) for o in (out if multi else [out])]
         except Exception as e:
-            now = time.monotonic()
+            if watchdog is not None:
+                watchdog.cancel()
+            # deliver only if we win the batch claim — a launch the
+            # watchdog already abandoned (waiters failed, breaker
+            # counted) must not report a second, contradictory outcome
+            if not self._claim_batch(claim, "dispatcher"):
+                return
             for r in batch:
-                r.error = e
-                telemetry.record_serving_request("error", now - r.t0)
-                r.event.set()
+                self._finish(r, error=e, status="error")
+            if self._breaker is not None:
+                self._breaker.on_failure()
             return
+        if watchdog is not None:
+            watchdog.cancel()
+        if not self._claim_batch(claim, "dispatcher"):
+            return  # watchdog fired mid-demux-window: it owns the batch
         now = time.monotonic()
-        telemetry.record_serving_batch(rows, target, len(batch), now - t0)
         off = 0
-        for r in batch:
-            sl = [h[off:off + r.n] for h in host]
-            r.result = sl if multi else sl[0]
-            off += r.n
-            telemetry.record_serving_request("ok", now - r.t0)
-            r.event.set()
+        try:
+            for r in batch:
+                sl = [h[off:off + r.n] for h in host]
+                off += r.n
+                self._finish(r, result=sl if multi else sl[0])
+        except Exception as e:
+            # demux failure (e.g. a model returning fewer rows than fed):
+            # fail the remaining waiters, dispatcher survives
+            for r in batch:
+                self._finish(r, error=e, status="error")
+            if self._breaker is not None:
+                self._breaker.on_failure()
+            return
+        telemetry.record_serving_batch(rows, target, len(batch), now - t0)
+        if self._breaker is not None:
+            self._breaker.on_success()
 
     # --- stats / lifecycle --------------------------------------------------
     def queue_depth(self) -> int:
@@ -503,11 +658,25 @@ class InferenceEngine:
 
     def stats(self) -> dict:
         """Queue depth + the AOT executable-cache counters (the
-        zero-recompile-after-warmup invariant is read off ``misses``)."""
+        zero-recompile-after-warmup invariant is read off ``misses``) +
+        the circuit breaker's state when one is attached."""
         with self._cond:
             depth = len(self._queue)
-        return {"queue_depth": depth, "buckets": self.buckets(),
-                "aot_cache": aot_cache.stats()}
+        out = {"queue_depth": depth, "buckets": self.buckets(),
+               "aot_cache": aot_cache.stats()}
+        if self._breaker is not None:
+            out["circuit_breaker"] = self._breaker.status()
+        return out
+
+    @property
+    def breaker(self) -> Optional[CircuitBreaker]:
+        return self._breaker
+
+    @property
+    def retry(self):
+        """The launch retry policy (None = disabled) — public for the
+        same rebuild handoff as :attr:`breaker`."""
+        return self._retry
 
     def close(self):
         """Stop the dispatcher; pending requests fail with a shutdown

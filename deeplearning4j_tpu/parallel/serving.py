@@ -29,8 +29,10 @@ import numpy as np
 from deeplearning4j_tpu.parallel.batcher import (
     BadRequestError,
     BatchingConfig,
+    CircuitOpenError,
     DeadlineExpiredError,
     InferenceEngine,
+    LaunchTimeoutError,
     ServerOverloadedError,
 )
 
@@ -172,7 +174,9 @@ class InferenceServer:
             # optimized serving model (no second graph_opt pass)
             self.engine = InferenceEngine(self.engine.model,
                                           self.engine.config,
-                                          graph_opt=False)
+                                          graph_opt=False,
+                                          breaker=self.engine.breaker,
+                                          retry=self.engine.retry)
         if warmup:
             self.warmup()
         srv = self
@@ -192,6 +196,13 @@ class InferenceServer:
                     if srv.engine is not None:
                         payload["queue_depth"] = srv.engine.stats()[
                             "queue_depth"]
+                        if srv.engine.breaker is not None:
+                            st = srv.engine.breaker.state
+                            payload["circuit"] = st
+                            if st == "open":
+                                # shedding on purpose: readiness probes
+                                # should route traffic elsewhere
+                                payload["status"] = "shedding"
                     self._send(200, payload)
                 elif self.path == "/model":
                     self._send(200, srv._model_info())
@@ -235,8 +246,11 @@ class InferenceServer:
                     # engine-level validation: this sender's problem only
                     self._send(400, {"error": str(e)})
                     return
-                except (ServerOverloadedError, DeadlineExpiredError) as e:
+                except (ServerOverloadedError, DeadlineExpiredError,
+                        CircuitOpenError, LaunchTimeoutError) as e:
                     # shed load: the client should back off and retry
+                    # (queue full, deadline gone, breaker open, or the
+                    # launch watchdog fired)
                     self._send(503, {"error": str(e)})
                     return
                 except Exception as e:  # model/runtime failure -> 500

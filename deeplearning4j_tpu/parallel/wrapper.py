@@ -831,6 +831,9 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             else "grad_psum", sum(layout), len(layout))
 
     def _fit_batch(self, ds):
+        from deeplearning4j_tpu.resilience import faults
+
+        faults.fault_point("train.step")  # preemption/crash injection site
         m = self.model
         with telemetry.span(telemetry.PHASE_INGEST):
             batch = self._prep(ds)

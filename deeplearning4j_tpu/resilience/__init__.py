@@ -1,0 +1,57 @@
+"""Fault-tolerant execution layer (docs/resilience.md).
+
+Three cooperating pieces:
+
+- :mod:`~deeplearning4j_tpu.resilience.faults` — deterministic,
+  seedable fault injection behind permanent one-line ``fault_point``
+  hooks in product code (checkpoint writes, device ingest, train steps,
+  serving launches, stats flushes).
+- :mod:`~deeplearning4j_tpu.resilience.retry` — the
+  retry/timeout/backoff engine applied at every transient-failure edge.
+- Recovery drivers: :class:`TrainingSession` (periodic snapshots +
+  auto-resume to bit-identical results) and :class:`CircuitBreaker`
+  (+ launch watchdog) on the serving engine.
+
+Everything is host-side control flow — nothing here enters a compiled
+step, so arming/disarming never recompiles and the disarmed overhead is
+one module-global check per hook.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.resilience import breaker as breaker  # noqa: F401
+from deeplearning4j_tpu.resilience import faults as faults  # noqa: F401
+from deeplearning4j_tpu.resilience import retry as retry  # noqa: F401
+from deeplearning4j_tpu.resilience.breaker import (  # noqa: F401
+    CircuitBreaker,
+    CircuitOpenError,
+)
+from deeplearning4j_tpu.resilience.faults import (  # noqa: F401
+    FaultPlan,
+    InjectedFault,
+    fault_point,
+)
+from deeplearning4j_tpu.resilience.retry import RetryPolicy  # noqa: F401
+from deeplearning4j_tpu.resilience.session import (  # noqa: F401
+    PreemptionError,
+    TrainingSession,
+)
+
+
+def status() -> dict:
+    """Process-wide resilience snapshot for ``/health`` and debugging:
+    every live circuit breaker's state, the retry/resume/fault counters,
+    and whether a fault plan is currently armed."""
+    from deeplearning4j_tpu.telemetry import REGISTRY
+
+    snap = REGISTRY.snapshot(run_collectors=False)
+    counters = {k: v for k, v in snap.items()
+                if k.startswith(("dl4j_retries_total",
+                                 "dl4j_resumes_total",
+                                 "dl4j_faults_injected_total"))}
+    return {
+        "circuit_breakers": {b.name: b.status()
+                             for b in breaker.live_breakers()},
+        "counters": counters,
+        "fault_plan_armed": faults.active_plan() is not None,
+    }

@@ -1,0 +1,293 @@
+"""Preemption-safe resumable training.
+
+The reference stack survives worker loss because Spark re-dispatches
+work and ``CheckpointListener``/EarlyStopping restart from disk. Here
+the whole trainer is one process, so surviving a crash or TPU
+preemption needs an explicit driver: :class:`TrainingSession` wraps the
+fit loop with periodic durable snapshots (the atomic
+``serializer.write_model`` zips, plus RNG key and iterator
+epoch/position in a ``session.json`` manifest) and auto-resumes after a
+resumable failure to **bit-identical-with-uninterrupted** results.
+
+Why bit-identical is cheap here: a training step is a pure function of
+(params, state, opt_state, batch, iteration, epoch, base RNG key) — the
+per-step RNG is ``fold_in(base_key, iteration)`` inside the compiled
+step. Snapshotting exactly those inputs and replaying the same batch
+order therefore reproduces the uninterrupted trajectory exactly; there
+is no hidden host-side RNG to drift.
+
+Resume chain (newest first): digest-verified on-disk snapshots (a
+corrupt/truncated zip falls back to the previous one — same contract as
+``CheckpointListener.load_checkpoint``), then the in-memory last-good
+snapshot (``optimize.checkpoint.snapshot_training_state``) for
+in-process restarts when the disk copies are gone.
+
+Usage::
+
+    sess = TrainingSession(net, "ckpts/run1",
+                           snapshot_every_n_iterations=50)
+    sess.fit(iterator, epochs=3)          # auto-resumes on preemption
+
+    # after a process crash: resume and FINISH the original 3-epoch
+    # budget (epochs= is relative to the resumed position, so use the
+    # absolute to_epoch= form when re-running the same script)
+    sess = TrainingSession(None, "ckpts/run1")
+    sess.resume()                          # -> restored model
+    sess.fit(iterator, to_epoch=3)         # continues where it died
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Tuple, Type
+
+from deeplearning4j_tpu.resilience.faults import InjectedFault
+from deeplearning4j_tpu.resilience.retry import CHECKPOINT_RETRY, RetryPolicy
+
+MANIFEST = "session.json"
+_MANIFEST_VERSION = 1
+
+
+class PreemptionError(RuntimeError):
+    """Raise (or map your platform's preemption signal to) this to tell
+    a :class:`TrainingSession` the interruption is resumable."""
+
+
+def _sha256(path: str) -> str:
+    from deeplearning4j_tpu.util.serializer import file_digest
+
+    return file_digest(path)
+
+
+class TrainingSession:
+    """Crash/preemption-safe ``fit`` driver for a MultiLayerNetwork or
+    ComputationGraph.
+
+    Args:
+        model: the network (``None`` to resume a dead process's session
+            purely from ``directory``).
+        directory: snapshot home (created if missing).
+        snapshot_every_n_iterations: periodic durable snapshot cadence
+            (0 disables; epoch boundaries always snapshot).
+        keep_last: on-disk snapshots retained (older ones pruned — but
+            at least two, so digest fallback always has a predecessor).
+        retry: policy for snapshot writes (default
+            :data:`~deeplearning4j_tpu.resilience.retry.CHECKPOINT_RETRY`).
+        resumable: exception classes that trigger auto-resume inside
+            :meth:`fit`; anything else propagates.
+        max_restarts: auto-resumes per :meth:`fit` call before giving up
+            (guards against a deterministic fault that re-fires every
+            replay).
+    """
+
+    def __init__(self, model, directory: str,
+                 snapshot_every_n_iterations: int = 50,
+                 keep_last: int = 2,
+                 retry: Optional[RetryPolicy] = None,
+                 resumable: Tuple[Type[BaseException], ...] =
+                 (PreemptionError, InjectedFault, OSError),
+                 max_restarts: int = 3):
+        self.model = model
+        self.directory = str(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.every_iters = int(snapshot_every_n_iterations)
+        self.keep_last = max(2, int(keep_last))
+        self.retry = retry or CHECKPOINT_RETRY
+        self.resumable = tuple(resumable)
+        self.max_restarts = int(max_restarts)
+        self.restarts = 0
+        self._batch_in_epoch = 0
+        self._mem = None        # in-memory last-good (fallback of last resort)
+        self._mem_entry = None
+        self._manifest = self._read_manifest()
+
+    # --- manifest -----------------------------------------------------------
+    def _manifest_path(self) -> str:
+        return os.path.join(self.directory, MANIFEST)
+
+    def _read_manifest(self) -> dict:
+        try:
+            with open(self._manifest_path()) as f:
+                m = json.load(f)
+            if isinstance(m, dict) and isinstance(m.get("snapshots"), list):
+                return m
+        except (OSError, ValueError):
+            pass
+        return {"format_version": _MANIFEST_VERSION, "snapshots": []}
+
+    def _write_manifest(self) -> None:
+        # same temp+replace discipline as write_model: the manifest is
+        # the resume authority and must never be half-written
+        tmp = f"{self._manifest_path()}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(self._manifest, f, indent=1)
+            os.replace(tmp, self._manifest_path())
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+
+    def snapshots(self) -> list:
+        """Manifest rows for snapshots whose zip still exists."""
+        return [s for s in self._manifest["snapshots"]
+                if os.path.exists(os.path.join(self.directory, s["file"]))]
+
+    # --- snapshot -----------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Write one durable snapshot now (atomic zip + manifest row with
+        content digest, RNG key, and iterator position). Returns the
+        manifest entry."""
+        import numpy as np
+
+        from deeplearning4j_tpu.optimize import checkpoint as ckpt
+        from deeplearning4j_tpu.util import serializer
+
+        m = self.model
+        fname = f"session_iter{int(m.iteration):08d}.zip"
+        path = os.path.join(self.directory, fname)
+        self.retry.call(serializer.write_model, m, path,
+                        op="checkpoint.write")
+        entry = {
+            "file": fname,
+            "digest": _sha256(path),
+            "iteration": int(m.iteration),
+            "epoch": int(m.epoch),
+            "batch_in_epoch": int(self._batch_in_epoch),
+        }
+        snaps = [s for s in self._manifest["snapshots"]
+                 if s["file"] != fname] + [entry]
+        self._manifest["snapshots"] = snaps[-max(self.keep_last, 2):]
+        key = getattr(m, "_base_key", None)
+        if key is not None:
+            self._manifest["rng_key"] = [
+                int(v) for v in np.asarray(key).ravel()]
+        self._write_manifest()
+        self._prune(snaps)
+        self._mem = ckpt.snapshot_training_state(m)
+        self._mem_entry = dict(entry)
+        return entry
+
+    def _prune(self, all_snaps: list) -> None:
+        keep = {s["file"] for s in self._manifest["snapshots"]}
+        for s in all_snaps:
+            if s["file"] in keep:
+                continue
+            p = os.path.join(self.directory, s["file"])
+            if os.path.exists(p):
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass  # retention is best-effort; resume only needs keep
+
+    # --- resume -------------------------------------------------------------
+    def resume(self):
+        """Restore the newest loadable snapshot (digest-verified; corrupt
+        or truncated zips fall back to the previous one, then to the
+        in-memory last-good). Counts ``dl4j_resumes_total``."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from deeplearning4j_tpu import telemetry
+        from deeplearning4j_tpu.optimize import checkpoint as ckpt
+        from deeplearning4j_tpu.util import serializer
+
+        self._manifest = self._read_manifest()
+        listeners = list(getattr(self.model, "listeners", []) or [])
+        snaps = self._manifest["snapshots"]
+        restored, idx, _ = serializer.restore_newest_verified(
+            [(os.path.join(self.directory, s["file"]),
+              s.get("digest", "")) for s in snaps],
+            serializer.restore_model)
+        entry = snaps[idx] if restored is not None else None
+        if restored is None and self._mem is not None \
+                and self.model is not None:
+            ckpt.restore_training_state(self.model, self._mem)
+            restored, entry = self.model, self._mem_entry
+        if restored is None:
+            raise FileNotFoundError(
+                f"no loadable snapshot in {self.directory}")
+        if listeners and not getattr(restored, "listeners", None):
+            restored.listeners = listeners
+        rng = self._manifest.get("rng_key")
+        if rng and hasattr(restored, "_base_key"):
+            restored._base_key = jnp.asarray(
+                np.asarray(rng, dtype=np.uint32))
+        self.model = restored
+        self._batch_in_epoch = int((entry or {}).get("batch_in_epoch", 0))
+        telemetry.record_resume()
+        return restored
+
+    # --- training -----------------------------------------------------------
+    def fit(self, data, labels=None, epochs: int = 1,
+            batch_size: Optional[int] = None,
+            to_epoch: Optional[int] = None):
+        """Train to ``model.epoch + epochs`` — i.e. ``epochs`` is
+        RELATIVE to the resumed position — snapshotting periodically and
+        auto-resuming on resumable failure. A cross-process restart that
+        must finish the ORIGINAL run's budget (not add to it) passes the
+        absolute ``to_epoch`` instead: ``fit(it, to_epoch=3)`` trains to
+        epoch 3 no matter where the snapshot left off, which is what the
+        bit-identical-with-uninterrupted guarantee needs after a crash
+        mid-run. The data order must be deterministic across replays (it
+        is, for the in-repo iterators) for that guarantee to hold."""
+        from deeplearning4j_tpu.nn.multilayer import _as_iterator
+
+        if self.model is None:
+            self.resume()
+        if self.model.params is None:
+            self.model.init()
+        if labels is None and hasattr(data, "reset") \
+                and hasattr(data, "__iter__"):
+            iterator = data
+        else:
+            iterator = _as_iterator(data, labels, batch_size)
+        target_epoch = int(to_epoch) if to_epoch is not None \
+            else int(self.model.epoch) + int(epochs)
+        restarts_this_fit = 0
+        while True:
+            try:
+                return self._run(iterator, target_epoch)
+            except self.resumable:
+                restarts_this_fit += 1
+                if restarts_this_fit > self.max_restarts:
+                    raise
+                self.restarts += 1  # counts resumes performed, not failures
+                self.resume()
+
+    def _run(self, iterator, target_epoch: int):
+        from deeplearning4j_tpu.nn import io as nn_io
+        from deeplearning4j_tpu.telemetry import flightrec
+
+        m = self.model
+        if not self.snapshots():
+            # a pre-first-step snapshot: a kill before the first periodic
+            # snapshot still resumes (from iteration 0) instead of
+            # silently training a fresh model
+            self.snapshot()
+        # same black-box contract as every other fit path: an exception
+        # escaping a run attempt dumps one crash bundle (this driver
+        # bypasses model.fit, so it carries the wrapper itself)
+        with flightrec.flight_recorder(model=m):
+            while m.epoch < target_epoch:
+                for lst in m.listeners:
+                    lst.on_epoch_start(m, m.epoch)
+                iterator.reset()
+                skip = self._batch_in_epoch
+                pending = []
+                for i, ds in enumerate(iterator):
+                    if i < skip:
+                        continue  # replay fast-forward to the crash pos
+                    pending.append(m._fit_batch_async(ds))
+                    nn_io.drain(pending)
+                    self._batch_in_epoch = i + 1
+                    if self.every_iters \
+                            and m.iteration % self.every_iters == 0:
+                        self.snapshot()
+                nn_io.drain(pending, force=True)
+                for lst in m.listeners:
+                    lst.on_epoch_end(m, m.epoch)
+                m.epoch += 1
+                self._batch_in_epoch = 0
+                self.snapshot()  # epoch boundary: position resets to 0
+        return m

@@ -188,6 +188,47 @@ def record_serving_batch(rows: int, padded_rows: int, requests: int,
                        help="shared launch wall time").observe(seconds)
 
 
+# --------------------------------------------------------------------------
+# resilience metrics (resilience/: faults, retry, breaker, session)
+#
+# Unconditional like the serving helpers: these record rare control-plane
+# events (a retry, a breaker trip, a resume, an injected fault), never
+# per-step hot-path work — an operator wants them without opting into
+# span recording. docs/resilience.md lists the series.
+# --------------------------------------------------------------------------
+
+def record_retry(op: str) -> None:
+    """Count one scheduled retry (first attempts are not retries)."""
+    REGISTRY.counter("dl4j_retries_total",
+                     help="retries scheduled by RetryPolicy", op=op).inc()
+
+
+def record_resume() -> None:
+    """Count one TrainingSession resume from a snapshot."""
+    REGISTRY.counter("dl4j_resumes_total",
+                     help="training resumes from snapshot").inc()
+
+
+def record_fault_injected(site: str, action: str) -> None:
+    """Count one fired fault-plan injection."""
+    REGISTRY.counter("dl4j_faults_injected_total",
+                     help="deterministic fault injections fired",
+                     site=site, action=action).inc()
+
+
+def record_circuit_state(name: str, state_code: int,
+                         transition: bool = True) -> None:
+    """Publish a breaker's state (0=closed, 1=half_open, 2=open); counts
+    the transition too unless this is the initial publish."""
+    REGISTRY.gauge("dl4j_circuit_state",
+                   help="0=closed 1=half_open 2=open",
+                   breaker=name).set(state_code)
+    if transition:
+        REGISTRY.counter("dl4j_circuit_transitions_total",
+                         help="breaker state transitions",
+                         breaker=name, to=str(state_code)).inc()
+
+
 _SERVING_ENGINES = weakref.WeakSet()
 
 

@@ -199,14 +199,19 @@ class UIServer:
                     # readiness surface a production trainer is scraped
                     # on. Sanitized: the report carries non-finite floats
                     # exactly when it matters, and a bare NaN literal is
-                    # invalid JSON to strict scrape agents.
+                    # invalid JSON to strict scrape agents. The resilience
+                    # block adds every live circuit breaker's state plus
+                    # the retry/resume/fault-injection counters.
+                    from deeplearning4j_tpu import resilience
                     from deeplearning4j_tpu.telemetry import (
                         flightrec,
                         health,
                     )
 
+                    report = dict(health.report())
+                    report["resilience"] = resilience.status()
                     payload = _json.dumps(
-                        flightrec.sanitize_json(health.report())).encode()
+                        flightrec.sanitize_json(report)).encode()
                     ctype = "application/json"
                 else:
                     self.send_response(404)

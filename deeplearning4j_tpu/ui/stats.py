@@ -86,6 +86,8 @@ class RemoteUIStatsStorageRouter(StatsStorage):
     def __init__(self, url: str, retries: int = 3, timeout: float = 10.0):
         import queue
 
+        from deeplearning4j_tpu.resilience.retry import RetryPolicy
+
         self.url = url.rstrip("/")
         self.retries = int(retries)
         self.timeout = float(timeout)
@@ -93,6 +95,15 @@ class RemoteUIStatsStorageRouter(StatsStorage):
         self._records: List[dict] = []
         self._q: "queue.Queue" = queue.Queue()
         self._thread = None
+        # retry EVERYTHING here (not just the transient classes): a
+        # delivery failure's only downside is a dropped dashboard record,
+        # and the historical contract was retries-then-drop for any error
+        # (retries=0 stays the historical drop-without-attempting config)
+        self._retry = RetryPolicy(max_attempts=self.retries,
+                                  base_delay_s=0.2, multiplier=1.5,
+                                  jitter=0.25, retryable=(Exception,),
+                                  name="stats.flush") \
+            if self.retries >= 1 else None
 
     def _ensure_thread(self):
         import threading
@@ -102,9 +113,20 @@ class RemoteUIStatsStorageRouter(StatsStorage):
                                             daemon=True)
             self._thread.start()
 
-    def _worker(self):
+    def _post(self, data: bytes) -> None:
+        """One delivery attempt (the ``stats.flush`` fault site — a chaos
+        plan exercises exactly the path a dashboard outage would)."""
         import urllib.request
 
+        from deeplearning4j_tpu.resilience import faults
+
+        faults.fault_point("stats.flush")
+        req = urllib.request.Request(
+            self.url + "/train/post", data=data,
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=self.timeout).read()
+
+    def _worker(self):
         while True:
             record = self._q.get()
             try:
@@ -113,20 +135,14 @@ class RemoteUIStatsStorageRouter(StatsStorage):
                 except (TypeError, ValueError):
                     self.dropped += 1  # unserializable record: drop, keep
                     continue           # the worker alive
-                for attempt in range(self.retries):
-                    try:
-                        req = urllib.request.Request(
-                            self.url + "/train/post", data=data,
-                            headers={"Content-Type": "application/json"})
-                        urllib.request.urlopen(
-                            req, timeout=self.timeout).read()
-                        break
-                    except Exception:
-                        if attempt < self.retries - 1:
-                            time.sleep(0.2 * (attempt + 1))
-                else:
-                    self.dropped += 1
-            finally:
+                if self._retry is None:
+                    self.dropped += 1  # retries=0: drop, never deliver
+                    continue
+                try:
+                    self._retry.call(self._post, data, op="stats.flush")
+                except Exception:
+                    self.dropped += 1  # retries exhausted: drop, keep
+            finally:                   # the worker alive
                 self._q.task_done()
 
     def put(self, record):

@@ -30,6 +30,47 @@ from deeplearning4j_tpu.util import params as params_util
 FORMAT_VERSION = 1
 
 
+def file_digest(path) -> str:
+    """sha256 of a file's content — the integrity check checkpoint
+    manifests (``checkpoint.csv``, ``session.json``) record at save time
+    and verify at load time, so a truncated/corrupted zip is detected
+    BEFORE a restore starts instead of failing halfway through one."""
+    import hashlib
+
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def restore_newest_verified(candidates, restore_fn):
+    """The digest-verified last-good restore walk shared by
+    ``CheckpointListener.load_checkpoint*`` and
+    ``TrainingSession.resume``: try ``candidates`` (``(path, digest)``
+    pairs, oldest-first) newest-first, skipping any whose file is
+    missing, whose content no longer matches its recorded digest
+    (truncation, bit rot — an empty digest skips verification), or that
+    ``restore_fn`` fails to open despite matching. Returns ``(restored,
+    index, last_error)`` — ``(None, -1, err)`` when nothing loads, so a
+    corrupted newest checkpoint costs one generation, never the whole
+    restore."""
+    import os
+
+    last_err = None
+    for i in range(len(candidates) - 1, -1, -1):
+        path, digest = candidates[i]
+        if not os.path.exists(path):
+            continue
+        if digest and file_digest(path) != digest:
+            continue
+        try:
+            return restore_fn(path), i, None
+        except Exception as e:  # unreadable despite matching digest
+            last_err = e
+    return None, -1, last_err
+
+
 def write_model(net, path, save_updater: bool = True) -> None:
     """Reference ``ModelSerializer#writeModel(net, file, saveUpdater)``.
 
@@ -40,6 +81,8 @@ def write_model(net, path, save_updater: bool = True) -> None:
     loadable)."""
     import os
 
+    from deeplearning4j_tpu.resilience import faults
+
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     tmp = path.with_name(f"{path.name}.tmp.{os.getpid()}")
@@ -47,6 +90,10 @@ def write_model(net, path, save_updater: bool = True) -> None:
         with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as z:
             z.writestr("configuration.json", net.conf.to_json())
             z.writestr("coefficients.npy", _npy_bytes(net.params_flat()))
+            # mid-assembly injection site: a raise here IS a partial
+            # write — some entries exist in the temp file, the publish
+            # below never happens, and the finally-cleanup must erase it
+            faults.fault_point("checkpoint.write")
             if save_updater and net.opt_state:
                 z.writestr(
                     "updaterState.npy",

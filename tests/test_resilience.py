@@ -1,0 +1,811 @@
+"""Fault-tolerant execution layer chaos suite (docs/resilience.md).
+
+Every fault here is ARMED WITH A FIXED SEED / exact invocation index, so
+a failure replays exactly (`make chaos-smoke`). The recovery invariants
+under test are the PR's acceptance bar:
+
+- kill training mid-run via an armed fault -> ``TrainingSession``
+  resumes and final params are bit-identical to an uninterrupted run;
+- injected serving-launch failures trip the circuit breaker open, then
+  recover through half-open probes with no dispatcher deadlock and all
+  pending futures resolved (no hung client);
+- crash-mid-write checkpointing never leaves a temp file behind, never
+  references a half-written zip from checkpoint.csv, and the prior
+  checkpoint stays loadable.
+
+Counter assertions read DELTAS: the telemetry registry is process-global
+(the autouse fixture resets it, but helpers registered by other modules
+may fire during a test).
+"""
+
+import errno
+import glob
+import os
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.conf import Activation, InputType
+from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.conf.losses import LossMCXENT
+from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+from deeplearning4j_tpu.conf.updaters import Adam
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.checkpoint import CheckpointListener
+from deeplearning4j_tpu.parallel.batcher import (
+    BatchingConfig,
+    InferenceEngine,
+    LaunchTimeoutError,
+)
+from deeplearning4j_tpu.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    FaultPlan,
+    InjectedFault,
+    RetryPolicy,
+    TrainingSession,
+    status,
+)
+from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.resilience.breaker import CLOSED, HALF_OPEN, OPEN
+from deeplearning4j_tpu.telemetry import REGISTRY
+from deeplearning4j_tpu.util import params as params_util
+
+pytestmark = pytest.mark.resilience
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    """No plan stays armed across tests (the arm slot is process-global)
+    and every test reads metrics from a clean registry."""
+    faults._ACTIVE = None
+    REGISTRY.reset()
+    yield
+    faults._ACTIVE = None
+    REGISTRY.reset()
+
+
+def counter_value(name, **labels):
+    return REGISTRY.counter(name, **labels).snapshot_value()
+
+
+# ---------------------------------------------------------------------------
+# fault injection (resilience/faults.py)
+# ---------------------------------------------------------------------------
+
+def test_fault_point_disarmed_is_identity():
+    a = np.ones(3, np.float32)
+    assert faults.fault_point("train.step", a) is a
+    assert faults.fault_point("nonexistent.site") is None
+
+
+def test_on_calls_fires_on_exact_invocations():
+    plan = FaultPlan(seed=7).inject("train.step", on_calls=[2, 4])
+    fired = []
+    with plan.armed():
+        for i in range(1, 6):
+            try:
+                faults.fault_point("train.step")
+            except InjectedFault as e:
+                fired.append(i)
+                assert e.site == "train.step"
+                assert e.invocation == i
+    assert fired == [2, 4]
+    assert plan.invocations("train.step") == 5
+    assert plan.fired("train.step") == 2
+
+
+def test_probability_stream_is_seed_deterministic():
+    def pattern(seed):
+        plan = FaultPlan(seed=seed).inject("train.step", probability=0.3)
+        hits = []
+        with plan.armed():
+            for i in range(60):
+                try:
+                    faults.fault_point("train.step")
+                except InjectedFault:
+                    hits.append(i)
+        return hits
+
+    a, b, c = pattern(42), pattern(42), pattern(43)
+    assert a == b          # same seed -> identical firing sequence
+    assert a != c          # different seed -> different stream
+    assert 5 < len(a) < 35  # sanity: roughly p=0.3 of 60
+
+
+def test_corrupt_action_nan_poisons_floats_only():
+    plan = FaultPlan().inject("ingest.device_put", action="corrupt")
+    f32 = np.arange(4, dtype=np.float32)
+    u8 = np.arange(4, dtype=np.uint8)
+    dev = jnp.arange(3, dtype=jnp.float32)
+    with plan.armed():
+        out = faults.fault_point("ingest.device_put", f32)
+        assert np.isnan(out[0]) and not np.isnan(out[1:]).any()
+        assert not np.isnan(f32).any()  # poisons a COPY
+        assert faults.fault_point("ingest.device_put", u8) is u8
+        dout = faults.fault_point("ingest.device_put", dev)
+        assert isinstance(dout, jnp.ndarray) and np.isnan(
+            np.asarray(dout)[0])
+
+
+def test_delay_action_sleeps_then_passes_through():
+    plan = FaultPlan().inject("serving.launch", action="delay",
+                              delay_s=0.05, max_fires=1)
+    with plan.armed():
+        t0 = time.monotonic()
+        assert faults.fault_point("serving.launch", "v") == "v"
+        assert time.monotonic() - t0 >= 0.045
+        t0 = time.monotonic()
+        faults.fault_point("serving.launch")  # max_fires exhausted
+        assert time.monotonic() - t0 < 0.04
+
+
+def test_custom_exception_factory_and_counter():
+    plan = FaultPlan().inject(
+        "checkpoint.write", on_calls=[1],
+        exc=lambda: OSError(errno.ENOSPC, "No space left on device"))
+    with plan.armed():
+        with pytest.raises(OSError) as ei:
+            faults.fault_point("checkpoint.write")
+    assert ei.value.errno == errno.ENOSPC
+    assert counter_value("dl4j_faults_injected_total",
+                         site="checkpoint.write", action="raise") == 1
+
+
+def test_only_one_plan_armed_per_process():
+    p1, p2 = FaultPlan(), FaultPlan()
+    with p1.armed():
+        with pytest.raises(RuntimeError, match="already armed"):
+            p2.arm()
+    # p1's context exit disarmed: p2 can now arm
+    with p2.armed():
+        assert faults.active_plan() is p2
+    assert faults.active_plan() is None
+
+
+# ---------------------------------------------------------------------------
+# retry engine (resilience/retry.py)
+# ---------------------------------------------------------------------------
+
+def test_backoff_is_pure_function_of_seed_name_attempt():
+    a = RetryPolicy(seed=5, name="op", base_delay_s=0.1, jitter=0.5)
+    b = RetryPolicy(seed=5, name="op", base_delay_s=0.1, jitter=0.5)
+    assert [a.backoff_s(k) for k in (1, 2, 3)] == \
+        [b.backoff_s(k) for k in (1, 2, 3)]
+    c = RetryPolicy(seed=6, name="op", base_delay_s=0.1, jitter=0.5)
+    assert a.backoff_s(1) != c.backoff_s(1)
+    # jitter=0: exact exponential with cap
+    p = RetryPolicy(base_delay_s=0.1, multiplier=2.0, max_delay_s=0.3,
+                    jitter=0.0)
+    assert [p.backoff_s(k) for k in (1, 2, 3, 4)] == \
+        pytest.approx([0.1, 0.2, 0.3, 0.3])
+
+
+def test_retry_recovers_from_transient_and_counts():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError(errno.EINTR, "interrupted")
+        return "ok"
+
+    slept = []
+    p = RetryPolicy(max_attempts=3, base_delay_s=0.01, name="t")
+    before = counter_value("dl4j_retries_total", op="t")
+    assert p.call(flaky, sleep=slept.append) == "ok"
+    assert len(calls) == 3 and len(slept) == 2
+    assert counter_value("dl4j_retries_total", op="t") - before == 2
+
+
+def test_non_retryable_propagates_immediately():
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise ValueError("model bug")
+
+    p = RetryPolicy(max_attempts=5)
+    with pytest.raises(ValueError):
+        p.call(bad, sleep=lambda s: pytest.fail("must not sleep"))
+    assert len(calls) == 1
+
+
+def test_retry_exhaustion_raises_last_error():
+    p = RetryPolicy(max_attempts=3, base_delay_s=0.0)
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise TimeoutError("down")
+
+    with pytest.raises(TimeoutError):
+        p.call(always, sleep=lambda s: None)
+    assert len(calls) == 3
+
+
+def test_deadline_outranks_retry_budget():
+    p = RetryPolicy(max_attempts=5, base_delay_s=0.5, jitter=0.0)
+
+    def always():
+        raise OSError("transient")
+
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        # next backoff (0.5s) would land past the deadline: no sleep,
+        # the error propagates at once
+        p.call(always, deadline=time.monotonic() + 0.05,
+               sleep=lambda s: pytest.fail("slept past the deadline"))
+    assert time.monotonic() - t0 < 0.2
+
+
+# ---------------------------------------------------------------------------
+# checkpoint write/load hardening
+# ---------------------------------------------------------------------------
+
+def _ckpt_net(seed=11):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(0.01))
+            .list()
+            .layer(DenseLayer(n_out=9, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batches(seed=0, n_batches=6, rows=8, n_in=4, n_out=3):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_batches):
+        x = rng.normal(size=(rows, n_in)).astype(np.float32)
+        y = np.eye(n_out, dtype=np.float32)[rng.integers(0, n_out, rows)]
+        out.append((x, y))
+    return out
+
+
+def _no_tmp_files(directory):
+    return glob.glob(os.path.join(directory, "*.tmp.*")) == []
+
+
+def test_crash_mid_write_keeps_prior_checkpoint_loadable(tmp_path):
+    net = _ckpt_net()
+    lst = CheckpointListener(str(tmp_path), save_every_n_iterations=1)
+    lst._save(net, 0, 0)
+    first = np.asarray(net.params_flat())
+
+    net.fit(*_batches(n_batches=1)[0])
+    # ENOSPC on every attempt of the second save (invocation counting is
+    # per-plan from arming: the hook fires once per write_model attempt
+    # and CHECKPOINT_RETRY makes three) -> the save fails for good,
+    # mid-zip-assembly = partial write
+    plan = FaultPlan().inject(
+        "checkpoint.write", on_calls=[1, 2, 3],
+        exc=lambda: OSError(errno.ENOSPC, "No space left on device"))
+    before = counter_value("dl4j_retries_total", op="checkpoint.write")
+    with plan.armed():
+        with pytest.raises(OSError):
+            lst._save(net, 1, 0)
+    assert plan.fired("checkpoint.write") == 3
+    # the two scheduled retries were real (and counted)
+    assert counter_value("dl4j_retries_total",
+                         op="checkpoint.write") - before == 2
+    # no half-written temp zip survives the crash
+    assert _no_tmp_files(str(tmp_path))
+    # checkpoint.csv never references the failed zip
+    cps = lst.list_checkpoints()
+    assert [c.number for c in cps] == [0]
+    assert len(glob.glob(os.path.join(str(tmp_path), "*.zip"))) == 1
+    # and the prior checkpoint still restores, digest-verified
+    restored = lst.load_checkpoint()
+    np.testing.assert_array_equal(
+        np.asarray(restored.params_flat()), first)
+
+
+def test_transient_write_fault_is_retried_to_success(tmp_path):
+    net = _ckpt_net()
+    lst = CheckpointListener(str(tmp_path), save_every_n_iterations=1)
+    plan = FaultPlan().inject("checkpoint.write", on_calls=[1])
+    with plan.armed():
+        lst._save(net, 0, 0)  # attempt 1 faults, attempt 2 lands
+    assert plan.fired("checkpoint.write") == 1
+    cps = lst.list_checkpoints()
+    assert len(cps) == 1 and cps[0].digest
+    assert lst.verify(cps[0])
+    assert _no_tmp_files(str(tmp_path))
+    lst.load_checkpoint()  # loadable, digest-verified
+
+
+def test_load_falls_back_to_last_good_on_corruption(tmp_path):
+    net = _ckpt_net()
+    lst = CheckpointListener(str(tmp_path), save_every_n_iterations=1)
+    lst._save(net, 0, 0)
+    good = np.asarray(net.params_flat())
+    net.fit(*_batches(n_batches=1)[0])
+    lst._save(net, 1, 0)
+    # truncate the NEWEST zip: digest verification must reject it and
+    # load must hand back the previous generation, not raise mid-restore
+    newest = os.path.join(str(tmp_path), lst.list_checkpoints()[-1].filename)
+    with open(newest, "r+b") as f:
+        f.truncate(os.path.getsize(newest) // 2)
+    restored = lst.load_checkpoint()
+    np.testing.assert_array_equal(np.asarray(restored.params_flat()), good)
+    # an EXPLICIT number disables the fallback: the caller asked for
+    # exactly that state, silently substituting another would be wrong
+    with pytest.raises(OSError, match="digest"):
+        lst.load_checkpoint(number=1)
+
+
+def test_pre_digest_rows_load_unverified(tmp_path):
+    # rows written before the digest column existed have digest="" and
+    # must keep loading exactly as they always did
+    net = _ckpt_net()
+    lst = CheckpointListener(str(tmp_path), save_every_n_iterations=1)
+    lst._save(net, 0, 0)
+    csv_path = os.path.join(str(tmp_path), "checkpoint.csv")
+    with open(csv_path) as f:
+        rows = [line.rsplit(",", 1)[0] for line in f.read().splitlines()]
+    with open(csv_path, "w") as f:
+        f.write("\n".join(rows) + "\n")
+    cps = lst.list_checkpoints()
+    assert cps[0].digest == ""
+    assert lst.verify(cps[0])
+    lst.load_checkpoint()
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker (resilience/breaker.py)
+# ---------------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_trips_on_consecutive_failures_and_recovers():
+    clk = _Clock()
+    b = CircuitBreaker(failure_threshold=3, recovery_timeout_s=10.0,
+                       success_threshold=2, name="t1", clock=clk)
+    assert b.state == CLOSED
+    b.on_failure(); b.on_failure()
+    assert b.state == CLOSED and b.allow()
+    b.on_failure()                      # third consecutive: trip
+    assert b.state == OPEN
+    assert not b.allow()                # fail-fast shedding
+    clk.t = 10.0                        # recovery timeout elapses
+    assert b.state == HALF_OPEN
+    assert b.allow()                    # the one probe ticket
+    assert not b.allow()                # second caller: still shed
+    b.on_success()
+    assert b.state == HALF_OPEN         # needs success_threshold=2
+    assert b.allow()                    # next probe admitted
+    b.on_success()
+    assert b.state == CLOSED and b.allow()
+    assert b.tripped_total == 1
+
+
+def test_failed_probe_reopens_and_restarts_clock():
+    clk = _Clock()
+    b = CircuitBreaker(failure_threshold=1, recovery_timeout_s=5.0,
+                       name="t2", clock=clk)
+    b.on_failure()
+    clk.t = 5.0
+    assert b.allow()                    # half-open probe
+    b.on_failure()                      # probe fails: re-open
+    assert b.state == OPEN
+    clk.t = 9.0                         # clock restarted at 5.0
+    assert not b.allow()
+    clk.t = 10.0
+    assert b.allow()
+
+
+def test_failure_rate_trip_catches_steady_trickle():
+    clk = _Clock()
+    b = CircuitBreaker(failure_threshold=100, failure_rate=0.5,
+                       window_size=10, name="t3", clock=clk)
+    # alternate success/failure: never 100 consecutive, but 50% rate
+    for _ in range(5):
+        b.on_success(); b.on_failure()
+    assert b.state == OPEN
+
+
+def test_lost_probe_ticket_is_reissued():
+    clk = _Clock()
+    b = CircuitBreaker(failure_threshold=1, recovery_timeout_s=2.0,
+                       name="t4", clock=clk)
+    b.on_failure()
+    clk.t = 2.0
+    assert b.allow()        # probe issued; its waiter then vanishes
+    assert not b.allow()
+    clk.t = 4.0             # a full recovery window with no outcome
+    assert b.allow()        # re-issued instead of wedging shut forever
+    assert b.state == HALF_OPEN
+
+
+def test_circuit_state_metric_published():
+    clk = _Clock()
+    b = CircuitBreaker(failure_threshold=1, name="t5", clock=clk)
+    b.on_failure()
+    snap = REGISTRY.snapshot(run_collectors=False)
+    assert snap['dl4j_circuit_state{breaker="t5"}'] == 2
+    assert b.status()["state"] == OPEN
+
+
+# ---------------------------------------------------------------------------
+# serving engine: failure isolation, breaker wiring, launch watchdog
+# ---------------------------------------------------------------------------
+
+class _StubModel:
+    """Host-only model: fast deterministic forwards, failure on demand."""
+
+    def __init__(self):
+        self.fail = None
+
+    def output(self, x):
+        if self.fail is not None:
+            raise self.fail
+        return np.asarray(x, np.float32) * 2.0
+
+
+def _stub_engine(breaker=None, retry=None, **cfg):
+    cfg.setdefault("max_batch", 8)
+    cfg.setdefault("settle_ms", 0.0)
+    cfg.setdefault("max_delay_ms", 2.0)
+    return InferenceEngine(_StubModel(), BatchingConfig(**cfg),
+                           graph_opt=False, breaker=breaker, retry=retry)
+
+
+def _await(req, timeout=10.0):
+    assert req.event.wait(timeout), "request hung (future never resolved)"
+    return req
+
+
+def test_model_failure_fails_batch_only_and_dispatcher_survives():
+    """Satellite regression: an exception from the model mid-batch must
+    fail ONLY that batch's futures (each waiter gets the error) and the
+    dispatcher thread must survive to serve the next group."""
+    eng = _stub_engine(settle_ms=1.0, max_delay_ms=20.0)
+    try:
+        eng.model.fail = RuntimeError("bad weights")
+        xs = [np.full((n, 4), n, np.float32) for n in (1, 2, 3)]
+        reqs = [eng.submit((x,)) for x in xs]
+        for r in reqs:
+            _await(r)
+            with pytest.raises(RuntimeError, match="bad weights"):
+                eng.result(r)
+        # the dispatcher survived: the very next group is served by the
+        # same engine without a restart
+        eng.model.fail = None
+        out = eng.predict(xs[1])
+        np.testing.assert_array_equal(out[:, :4], xs[1] * 2.0)
+        assert eng._thread is not None and eng._thread.is_alive()
+    finally:
+        eng.close()
+
+
+def test_injected_launch_failures_trip_breaker_then_half_open_recovers():
+    """Acceptance invariant: injected serving-launch failures trip the
+    breaker open (shedding, not queueing), then recover through
+    half-open probes — no dispatcher deadlock, every future resolved."""
+    br = CircuitBreaker(failure_threshold=2, recovery_timeout_s=0.25,
+                        name="chaos-serving")
+    eng = _stub_engine(breaker=br)
+    try:
+        plan = FaultPlan(seed=3).inject("serving.launch", max_fires=2)
+        with plan.armed():
+            for _ in range(2):
+                req = _await(eng.submit((np.ones((2, 4), np.float32),)))
+                with pytest.raises(InjectedFault):
+                    eng.result(req)
+        assert br.state == OPEN
+        # open = fail-fast shedding: the submit itself is rejected
+        with pytest.raises(CircuitOpenError):
+            eng.submit((np.ones((1, 4), np.float32),))
+        time.sleep(0.3)  # recovery timeout elapses -> half-open probe
+        out = eng.predict(np.ones((1, 4), np.float32))
+        np.testing.assert_array_equal(out, np.full((1, 4), 2.0))
+        assert br.state == CLOSED
+        assert eng._thread is not None and eng._thread.is_alive()
+    finally:
+        eng.close()
+
+
+def test_watchdog_fails_stuck_launch_and_replaces_dispatcher():
+    eng = _stub_engine(launch_timeout_ms=80.0)
+    try:
+        # one stuck launch: the injected delay holds the dispatcher well
+        # past launch_timeout_ms
+        plan = FaultPlan().inject("serving.launch", action="delay",
+                                  delay_s=0.5, max_fires=1)
+        with plan.armed():
+            req = eng.submit((np.ones((2, 4), np.float32),))
+            _await(req, timeout=5.0)
+            t_failed = time.monotonic()
+            with pytest.raises(LaunchTimeoutError):
+                eng.result(req)
+            # the waiter was failed by the WATCHDOG, not by the launch
+            # finally finishing (which takes 0.5s)
+            assert plan.fired("serving.launch") == 1
+            # the replacement dispatcher serves the next request even
+            # while the stuck thread is still sleeping
+            out = eng.predict(np.ones((1, 4), np.float32))
+            assert time.monotonic() - t_failed < 0.45
+            np.testing.assert_array_equal(out, np.full((1, 4), 2.0))
+    finally:
+        time.sleep(0.3)  # let the abandoned launch drain before close
+        eng.close()
+
+
+def test_overload_rejection_does_not_burn_half_open_probe():
+    """Regression: a submit rejected for overload (or any pre-queue
+    reason) must not consume a half-open probe ticket — a burned ticket
+    with no outcome would wedge the breaker half-open for a full extra
+    recovery window."""
+    from deeplearning4j_tpu.parallel.batcher import ServerOverloadedError
+
+    clk = _Clock()
+    br = CircuitBreaker(failure_threshold=1, recovery_timeout_s=5.0,
+                        name="probe-guard", clock=clk)
+    eng = _stub_engine(breaker=br, max_queue=0)  # every submit overloads
+    try:
+        br.on_failure()     # open at t=0
+        clk.t = 5.0         # recovery elapsed: next allow() half-opens
+        with pytest.raises(ServerOverloadedError):
+            eng.submit((np.ones((1, 4), np.float32),))
+        # the one probe ticket is still available: the rejection above
+        # never reached the breaker
+        assert br.allow()
+        assert not br.allow()
+    finally:
+        eng.close()
+
+
+def test_train_step_site_fires_on_tbptt_path():
+    """Regression: the `train.step` hook must fire once per optimization
+    step on the tBPTT branch too, or chaos plans against recurrent
+    models silently test nothing."""
+    from deeplearning4j_tpu.conf import WeightInit
+    from deeplearning4j_tpu.conf.layers_rnn import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.conf.multilayer import BackpropType
+
+    conf = (NeuralNetConfiguration.builder().seed(5)
+            .updater(Adam(0.01)).weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(LSTM(n_out=8))
+            .layer(RnnOutputLayer(n_out=3, activation=Activation.SOFTMAX,
+                                  loss_fn=LossMCXENT()))
+            .backprop_type(BackpropType.TRUNCATED_BPTT, fwd=5, back=5)
+            .set_input_type(InputType.recurrent(4, 10)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 10, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (4, 10))]
+    plan = FaultPlan().inject("train.step", on_calls=[2])
+    with plan.armed():
+        net.fit(x, y)                       # step 1: passes through
+        with pytest.raises(InjectedFault):
+            net.fit(x, y)                   # step 2: the armed kill
+    assert plan.invocations("train.step") == 2
+    assert plan.fired("train.step") == 1
+
+
+def test_engine_stats_and_resilience_status_surface_breaker():
+    br = CircuitBreaker(failure_threshold=1, name="surface-test")
+    eng = _stub_engine(breaker=br)
+    try:
+        br.on_failure()
+        st = eng.stats()["circuit_breaker"]
+        assert st["name"] == "surface-test" and st["state"] == OPEN
+        s = status()
+        assert s["circuit_breakers"]["surface-test"]["state"] == OPEN
+        assert s["fault_plan_armed"] is False
+        with FaultPlan().armed():
+            assert status()["fault_plan_armed"] is True
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# TrainingSession: preemption-safe, bit-identical resume
+# ---------------------------------------------------------------------------
+
+def _flat(net):
+    return np.asarray(net.params_flat())
+
+
+def _opt_flat(net):
+    return np.asarray(params_util.flatten_state_like(net.opt_state))
+
+
+def _iterator(seed=0):
+    return ListDataSetIterator(
+        [DataSet(x, y) for x, y in _batches(seed=seed)])
+
+
+def _baseline_params(epochs=2):
+    net = _ckpt_net()
+    net.fit(_iterator(), epochs=epochs)
+    return _flat(net), _opt_flat(net)
+
+
+@pytest.mark.parametrize("kill_at", [1, 5])
+def test_killed_training_resumes_bit_identical(tmp_path, kill_at):
+    """THE acceptance invariant: a fault kills training mid-run; the
+    session auto-resumes from its last snapshot and the final params
+    (and updater state) are bit-identical to an uninterrupted run.
+    ``kill_at=1`` dies before any periodic snapshot (the pre-first-step
+    snapshot carries it); ``kill_at=5`` dies between periodic snapshots
+    and replays from iteration 4."""
+    ref_params, ref_opt = _baseline_params()
+
+    sess = TrainingSession(_ckpt_net(), str(tmp_path),
+                           snapshot_every_n_iterations=2)
+    before = counter_value("dl4j_resumes_total")
+    plan = FaultPlan(seed=1).inject("train.step", on_calls=[kill_at])
+    with plan.armed():
+        sess.fit(_iterator(), epochs=2)
+    assert plan.fired("train.step") == 1    # the kill was real
+    assert counter_value("dl4j_resumes_total") - before == 1
+    assert sess.model.epoch == 2
+    np.testing.assert_array_equal(_flat(sess.model), ref_params)
+    np.testing.assert_array_equal(_opt_flat(sess.model), ref_opt)
+
+
+def test_resume_after_process_death_from_directory_alone(tmp_path):
+    """Process-crash shape: the first session dies (max_restarts=0 -> the
+    fault propagates, 'the process is gone'); a brand-new session built
+    from the directory alone resumes and finishes bit-identical."""
+    ref_params, ref_opt = _baseline_params()
+
+    sess = TrainingSession(_ckpt_net(), str(tmp_path),
+                           snapshot_every_n_iterations=2, max_restarts=0)
+    plan = FaultPlan().inject("train.step", on_calls=[3])
+    with plan.armed():
+        with pytest.raises(InjectedFault):
+            sess.fit(_iterator(), epochs=2)
+
+    revived = TrainingSession(None, str(tmp_path),
+                              snapshot_every_n_iterations=2)
+    model = revived.resume()
+    assert model.iteration == 2  # the iter-2 snapshot, not a fresh net
+    revived.fit(_iterator(), epochs=2)
+    assert revived.model.epoch == 2
+    np.testing.assert_array_equal(_flat(revived.model), ref_params)
+    np.testing.assert_array_equal(_opt_flat(revived.model), ref_opt)
+
+
+def test_snapshot_retention_keeps_last_and_digests(tmp_path):
+    sess = TrainingSession(_ckpt_net(), str(tmp_path),
+                           snapshot_every_n_iterations=1, keep_last=2)
+    sess.fit(_iterator(), epochs=1)  # 6 steps -> 6+ snapshots written
+    snaps = sess.snapshots()
+    assert len(snaps) == 2           # retention pruned the rest
+    zips = glob.glob(os.path.join(str(tmp_path), "session_iter*.zip"))
+    assert len(zips) == 2
+    for s in snaps:
+        assert s["digest"]           # every row digest-verified on resume
+    assert _no_tmp_files(str(tmp_path))
+
+
+def test_resume_skips_corrupt_newest_snapshot(tmp_path):
+    sess = TrainingSession(_ckpt_net(), str(tmp_path),
+                           snapshot_every_n_iterations=2, keep_last=3)
+    sess.fit(_iterator(), epochs=1)
+    snaps = sess.snapshots()
+    assert len(snaps) >= 2
+    newest = os.path.join(str(tmp_path), snaps[-1]["file"])
+    with open(newest, "r+b") as f:
+        f.truncate(os.path.getsize(newest) // 3)
+    revived = TrainingSession(None, str(tmp_path))
+    model = revived.resume()
+    # fell back to the previous generation, not the truncated newest
+    assert model.iteration == snaps[-2]["iteration"]
+
+
+def test_to_epoch_resumes_to_original_budget_not_past_it(tmp_path):
+    """Regression: a cross-process restart that re-runs the SAME script
+    must finish the original epoch budget, not add to it. The run dies
+    in epoch 1 of 2; `fit(epochs=2)` after resume would train to epoch 3
+    — the absolute `to_epoch=2` form lands bit-identical instead."""
+    ref_params, ref_opt = _baseline_params()
+
+    sess = TrainingSession(_ckpt_net(), str(tmp_path),
+                           snapshot_every_n_iterations=3, max_restarts=0)
+    plan = FaultPlan().inject("train.step", on_calls=[10])  # epoch 1
+    with plan.armed():
+        with pytest.raises(InjectedFault):
+            sess.fit(_iterator(), epochs=2)
+
+    revived = TrainingSession(None, str(tmp_path),
+                              snapshot_every_n_iterations=3)
+    model = revived.resume()
+    assert model.epoch == 1              # died mid second epoch
+    revived.fit(_iterator(), to_epoch=2)
+    assert revived.model.epoch == 2      # NOT 1 + 2 = 3
+    np.testing.assert_array_equal(_flat(revived.model), ref_params)
+    np.testing.assert_array_equal(_opt_flat(revived.model), ref_opt)
+
+
+def test_max_restarts_bounds_a_deterministic_fault(tmp_path):
+    # a fault that re-fires on every replay must not loop forever
+    sess = TrainingSession(_ckpt_net(), str(tmp_path),
+                           snapshot_every_n_iterations=2, max_restarts=2)
+    plan = FaultPlan().inject("train.step", probability=1.0)
+    with plan.armed():
+        with pytest.raises(InjectedFault):
+            sess.fit(_iterator(), epochs=1)
+    assert sess.restarts == 2
+
+
+# ---------------------------------------------------------------------------
+# ingest + stats-flush edges
+# ---------------------------------------------------------------------------
+
+def test_device_ring_retries_transient_device_put():
+    from deeplearning4j_tpu.datasets.prefetch import DeviceRingIterator
+
+    batches = [DataSet(x, y) for x, y in _batches(seed=9, n_batches=3)]
+    want = [np.asarray(b.features, np.float32) for b in batches]
+    ring = DeviceRingIterator(ListDataSetIterator(batches), depth=2)
+    before = counter_value("dl4j_retries_total", op="ingest.device_put")
+    plan = FaultPlan().inject("ingest.device_put", on_calls=[1])
+    with plan.armed():
+        staged = [np.asarray(ds.features) for ds in ring]
+    assert plan.fired("ingest.device_put") == 1
+    assert counter_value("dl4j_retries_total",
+                         op="ingest.device_put") - before == 1
+    assert len(staged) == 3
+    for got, exp in zip(staged, want):
+        np.testing.assert_array_equal(got, exp)
+
+
+def test_stats_flush_retries_then_drops_and_worker_survives():
+    from deeplearning4j_tpu.ui.stats import RemoteUIStatsStorageRouter
+
+    router = RemoteUIStatsStorageRouter("http://127.0.0.1:9", retries=2)
+    router._retry = RetryPolicy(max_attempts=2, base_delay_s=0.001,
+                                retryable=(Exception,), name="stats.flush")
+    plan = FaultPlan().inject("stats.flush")  # every delivery attempt
+    with plan.armed():
+        router.put({"kind": "chaos"})
+        router._q.join()
+    assert plan.fired("stats.flush") == 2     # initial try + 1 retry
+    assert router.dropped == 1
+    assert router._thread.is_alive()          # drop, not die
+
+
+def test_stats_router_retries_zero_still_constructs_and_drops():
+    # regression: retries=0 was the historical drop-without-attempting
+    # configuration and must not raise at construction
+    from deeplearning4j_tpu.ui.stats import RemoteUIStatsStorageRouter
+
+    router = RemoteUIStatsStorageRouter("http://127.0.0.1:9", retries=0)
+    plan = FaultPlan().inject("stats.flush")
+    with plan.armed():
+        router.put({"kind": "chaos"})
+        router._q.join()
+    assert plan.fired("stats.flush") == 0     # never even attempted
+    assert router.dropped == 1
+    assert router._thread.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# healthy-path invariants
+# ---------------------------------------------------------------------------
+
+def test_disarmed_sites_leave_training_untouched(tmp_path):
+    """The permanent hooks are inert when no plan is armed: training
+    through the instrumented paths injects nothing and counts nothing."""
+    net = _ckpt_net()
+    net.fit(_iterator(), epochs=1)
+    snap = REGISTRY.snapshot(run_collectors=False)
+    assert not any(k.startswith("dl4j_faults_injected_total")
+                   for k in snap)
+    assert np.isfinite(_flat(net)).all()
