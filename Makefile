@@ -37,6 +37,14 @@ chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests -q -m resilience \
 		-p no:cacheprovider
 
+.PHONY: fused-smoke
+# Fused multi-step driver smoke: K=1 vs K=4 bit-identity (params, updater
+# state, listener losses), super-step health granularity, K-keyed AOT
+# cache, kill-and-resume under fused_steps. CPU-pinned, fixed seeds.
+fused-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests -q -m fused \
+		-p no:cacheprovider
+
 .PHONY: bench-serving
 # Closed-loop 8-client serving benchmark: locked single-request baseline
 # vs the dynamic micro-batching engine (acceptance bar: >= 4x).

@@ -30,15 +30,18 @@ import numpy as np
 
 from deeplearning4j_tpu.telemetry import PHASES
 
-PHASE_INGEST, PHASE_COMPUTE, PHASE_GRAD_SYNC = PHASES
+PHASE_INGEST, PHASE_COMPUTE, PHASE_GRAD_SYNC, PHASE_HOST_GAP = PHASES
 
-# --phases output rows, keyed off the framework's canonical phase names
-# (deeplearning4j_tpu.telemetry.PHASES) so the bench breakdown and the
-# telemetry spans cannot drift apart — pinned by tests/test_telemetry.py
+# --phases / --fused-steps output rows, keyed off the framework's
+# canonical phase names (deeplearning4j_tpu.telemetry.PHASES) so the
+# bench breakdown and the telemetry spans cannot drift apart — pinned by
+# tests/test_telemetry.py
 PHASE_ROWS = {
     PHASE_INGEST: (f"{PHASE_INGEST}_h2d", f"{PHASE_INGEST}_after_overlap"),
     PHASE_COMPUTE: ("step_cached_fit", "step_streaming", "step_ring"),
     PHASE_GRAD_SYNC: (PHASE_GRAD_SYNC,),
+    PHASE_HOST_GAP: (f"{PHASE_HOST_GAP}_per_step_k1",
+                     f"{PHASE_HOST_GAP}_per_step_fused"),
 }
 
 BATCH = 256
@@ -76,6 +79,9 @@ def timed(fn, *args, n=N, reps=3):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=BATCH)
+    ap.add_argument("--img", type=int, default=IMG,
+                    help="input resolution (default 224; shrink for "
+                         "CPU-proxy runs of --fused-steps/--phases)")
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--s2d", action="store_true",
                     help="exact space-to-depth stem rewrite (MLPerf trick)")
@@ -84,6 +90,15 @@ def main():
     ap.add_argument("--phases", action="store_true",
                     help="per-phase step breakdown (ingest / compute / "
                          "sync overlap) instead of the prefix sweep")
+    ap.add_argument("--fused-steps", type=int, default=0,
+                    help="K-step fused A/B: train the same batch stream "
+                         "through the per-step path (K=1) and the fused "
+                         "lax.scan driver (fused_steps=K), reporting the "
+                         "telemetry-measured host gap per step, img/s, "
+                         "recompiles after the first super-step, and the "
+                         "K=1 vs K final-params max |delta| (0.0 = "
+                         "bit-identical; conv bodies may show ulp-level "
+                         "compilation variance — docs/observability.md)")
     ap.add_argument("--health", action="store_true",
                     help="enable the in-graph health guards (WARN policy) "
                          "so train_step / --phases rows measure the "
@@ -91,6 +106,7 @@ def main():
                          "the flag for the guard overhead (<5%% target)")
     args = ap.parse_args()
     batch = args.batch
+    img = int(args.img)
 
     import jax
     import jax.numpy as jnp
@@ -104,14 +120,14 @@ def main():
 
         health.configure(policy=health.AnomalyPolicy.WARN)
 
-    model = ResNet50(num_classes=CLASSES, height=IMG, width=IMG,
+    model = ResNet50(num_classes=CLASSES, height=img, width=img,
                      updater=Adam(learning_rate=1e-3))
     model.stem_space_to_depth = bool(args.s2d)
     cfg = dataclasses.replace(model.conf(), compute_dtype="bfloat16")
     net = ComputationGraph(cfg).init()
 
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.integers(0, 256, (batch, IMG, IMG, 3),
+    x = jnp.asarray(rng.integers(0, 256, (batch, img, img, 3),
                                  dtype=np.uint8))
     y = jnp.asarray(np.eye(CLASSES, dtype=np.float32)[
         rng.integers(0, CLASSES, batch)])
@@ -131,6 +147,77 @@ def main():
         rts.append((time.perf_counter() - t0) * 1000.0)
     _RT_MS[0] = min(rts)
     rows = {"null_roundtrip": _RT_MS[0]}
+
+    # ---- K-step fused A/B (round 11): host gap per step, before/after ----
+    if args.fused_steps:
+        from deeplearning4j_tpu import telemetry
+        from deeplearning4j_tpu.datasets.dataset import DataSet as _DS
+        from deeplearning4j_tpu.datasets.iterators import (
+            ListDataSetIterator,
+        )
+        from deeplearning4j_tpu.optimize import aot_cache
+
+        k = int(args.fused_steps)
+        n_super = 4
+        n_steps = k * n_super
+        rngf = np.random.default_rng(11)
+        base = [(rngf.integers(0, 256, (batch, img, img, 3),
+                               dtype=np.uint8),
+                 np.eye(CLASSES, dtype=np.float32)[
+                     rngf.integers(0, CLASSES, batch)])
+                for _ in range(n_steps)]
+
+        def stream():
+            # fresh numpy copies per run: write_back migrates arrays to
+            # device, and both modes must stage the same host stream
+            return ListDataSetIterator(
+                [_DS(np.array(f), np.array(l)) for f, l in base])
+
+        def run(kk, label):
+            netx = ComputationGraph(cfg).init()
+            netx.fit(stream(), epochs=1, fused_steps=kk)  # compile+settle
+            miss0 = aot_cache.stats()["misses"]
+            # throughput epoch: fully async pipeline, telemetry off
+            t0 = time.perf_counter()
+            netx.fit(stream(), epochs=1, fused_steps=kk)
+            jax.block_until_ready(netx.params)
+            wall = time.perf_counter() - t0
+            rows[f"imgs_per_sec_{label}"] = n_steps * batch / wall
+            rows[f"recompiles_after_warmup_{label}"] = (
+                aot_cache.stats()["misses"] - miss0)
+            # host-gap epoch: sync-mode spans block on each dispatch's
+            # device result, so the gap between spans is PURE host
+            # dispatch-loop work (no device overlap / thread starvation)
+            telemetry.reset()
+            telemetry.enable(sync=True)
+            netx.fit(stream(), epochs=1, fused_steps=kk)
+            jax.block_until_ready(netx.params)
+            telemetry.disable()
+            evs = [e for e in telemetry.events()
+                   if e["name"] == PHASE_HOST_GAP]
+            gap_ms = sum(e["duration_ns"] for e in evs) / 1e6
+            gsteps = sum(e.get("attrs", {}).get("steps", 1) for e in evs)
+            rows[f"{PHASE_HOST_GAP}_per_step_{label}"] = (
+                gap_ms / max(gsteps, 1))
+            return netx
+
+        net1 = run(1, "k1")
+        netk = run(k, "fused")
+        # the acceptance invariant: K=1 and K=K train IDENTICALLY on the
+        # same stream (max |param delta| 0.0 = bit-identical)
+        rows["fused_params_max_delta"] = max(
+            float(jnp.max(jnp.abs(jnp.asarray(a, jnp.float32)
+                                  - jnp.asarray(b, jnp.float32))))
+            for a, b in zip(jax.tree_util.tree_leaves(net1.params),
+                            jax.tree_util.tree_leaves(netk.params)))
+        if args.json:
+            print(json.dumps({kk: round(v, 4) for kk, v in rows.items()}))
+            return
+        print(f"\nResNet-50 batch {batch} fused-{k} A/B "
+              f"({n_steps} steps, {n_super} super-steps)\n")
+        for kk, v in rows.items():
+            print(f"{kk:>32} {v:>10.4f}")
+        return
 
     params, state = net.params, net.state
 
@@ -161,7 +248,7 @@ def main():
         rng2 = np.random.default_rng(7)
         n_stream = 6
         fresh = [
-            _DS(rng2.integers(0, 256, (batch, IMG, IMG, 3),
+            _DS(rng2.integers(0, 256, (batch, img, img, 3),
                               dtype=np.uint8),
                 np.eye(CLASSES, dtype=np.float32)[
                     rng2.integers(0, CLASSES, batch)])

@@ -29,10 +29,112 @@ from typing import Optional
 
 import numpy as np
 
-from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.datasets.iterators import DataSetIterator
 
 _SENTINEL = object()
+
+
+# --------------------------------------------------------------------------
+# K-batch stacking (round 11: the fused multi-step training driver's feed)
+# --------------------------------------------------------------------------
+
+def _uniform(arrs) -> bool:
+    """True when every column entry shares shape/dtype (or all are None)."""
+    first = arrs[0]
+    if first is None:
+        return all(a is None for a in arrs)
+    if any(a is None for a in arrs[1:]):
+        return False
+    shape = np.shape(first)
+    dtype = getattr(first, "dtype", None)
+    return all(np.shape(a) == shape and getattr(a, "dtype", None) == dtype
+               for a in arrs[1:])
+
+
+def _stack_col(arrs):
+    """Stack one column to [K, ...]: numpy batches stack on HOST (free —
+    the single fused device_put happens at staging time); already-device
+    batches stack on device (one tiny dispatch, no host round-trip)."""
+    if arrs[0] is None:
+        return None
+    import jax
+    import jax.numpy as jnp
+
+    if any(isinstance(a, jax.Array) for a in arrs):
+        return jnp.stack(arrs)
+    return np.stack([np.asarray(a) for a in arrs])
+
+
+def stack_batch_group(group, materialize: bool = True):
+    """K uniform batches -> ONE stacked container ([K, B, ...] per array,
+    tagged ``fused_stack=K`` so the fit paths route it to the K-step
+    fused scan). Returns None when the group cannot stack (mixed types,
+    ragged shapes, mismatched mask presence) — the caller then falls back
+    to plain per-step batches, so correctness never depends on uniform
+    streams.
+
+    ``materialize=False`` runs ONLY the shape/dtype uniformity decision
+    and returns a lightweight placeholder (first batch's arrays, tagged
+    ``fused_stack=K``) in place of the real stack — for a resuming
+    session's fast-forward, which needs the yield positions but discards
+    the content, so it must not pay the K-batch copies."""
+    k = len(group)
+    if k < 2:
+        return None
+    first = group[0]
+    if isinstance(first, DataSet) \
+            and all(type(g) is DataSet for g in group):
+        cols = [[g.features for g in group], [g.labels for g in group],
+                [g.features_mask for g in group],
+                [g.labels_mask for g in group]]
+        if not all(_uniform(c) for c in cols):
+            return None
+        if materialize:
+            out = DataSet(*(_stack_col(c) for c in cols))
+        else:
+            out = DataSet(first.features, first.labels,
+                          first.features_mask, first.labels_mask)
+        out.fused_stack = k
+        return out
+    if isinstance(first, MultiDataSet) \
+            and all(type(g) is MultiDataSet for g in group):
+        n_f, n_l = len(first.features), len(first.labels)
+        if any(len(g.features) != n_f or len(g.labels) != n_l
+               for g in group):
+            return None
+
+        def col(attr, i):
+            out = []
+            for g in group:
+                m = getattr(g, attr)
+                out.append(None if m is None else m[i])
+            return out
+
+        fcols = [[g.features[i] for g in group] for i in range(n_f)]
+        lcols = [[g.labels[i] for g in group] for i in range(n_l)]
+        fmcols = [col("features_masks", i) for i in range(n_f)]
+        lmcols = [col("labels_masks", i) for i in range(n_l)]
+        if not all(_uniform(c) for c in fcols + lcols + fmcols + lmcols):
+            return None
+        if materialize:
+            fms = [_stack_col(c) for c in fmcols]
+            lms = [_stack_col(c) for c in lmcols]
+            out = MultiDataSet(
+                features=[_stack_col(c) for c in fcols],
+                labels=[_stack_col(c) for c in lcols],
+                features_masks=(fms if any(m is not None for m in fms)
+                                else None),
+                labels_masks=(lms if any(m is not None for m in lms)
+                              else None))
+        else:
+            out = MultiDataSet(features=list(first.features),
+                               labels=list(first.labels),
+                               features_masks=first.features_masks,
+                               labels_masks=first.labels_masks)
+        out.fused_stack = k
+        return out
+    return None
 
 
 class AsyncDataSetIterator(DataSetIterator):
@@ -129,6 +231,56 @@ class AsyncDataSetIterator(DataSetIterator):
             pass
 
 
+class StackBatchIterator(DataSetIterator):
+    """Host-side K-batch stacking WITHOUT device staging — for consumers
+    that own their device placement (ParallelWrapper shards the stacks
+    over its mesh itself). Yields ``stack_batch_group`` super-batches;
+    ragged tails / non-uniform groups degrade to plain batches."""
+
+    def __init__(self, wrapped: DataSetIterator, stack_batches: int):
+        self.wrapped = wrapped
+        self.stack_batches = int(stack_batches)
+        self._skip_next = 0
+
+    def batch_size(self):
+        return self.wrapped.batch_size()
+
+    def total_examples(self):
+        return self.wrapped.total_examples()
+
+    def skip_stacking(self, n: int) -> None:
+        """One-shot: the next iteration's first ``n`` yields keep their
+        positions (the uniformity decision still runs) but skip the
+        K-batch copies — placeholder super-batches a fast-forwarding
+        consumer discards."""
+        self._skip_next = max(0, int(n))
+
+    def __iter__(self):
+        from deeplearning4j_tpu import telemetry
+
+        skip = self._skip_next
+        self._skip_next = 0
+        group = []
+        for ds in self.wrapped:
+            group.append(ds)
+            if len(group) < self.stack_batches:
+                continue
+            with telemetry.span(telemetry.PHASE_INGEST):
+                stacked = stack_batch_group(group, materialize=skip <= 0)
+            if stacked is not None:
+                skip -= 1
+                yield stacked
+            else:
+                for g in group:
+                    skip -= 1
+                    yield g
+            group = []
+        yield from group
+
+    def reset(self):
+        self.wrapped.reset()
+
+
 class DeviceRingIterator(DataSetIterator):
     """Double-buffered device ingest (default ``depth=2``).
 
@@ -146,16 +298,31 @@ class DeviceRingIterator(DataSetIterator):
     migrated DataSets) pass through untouched, so reuse across epochs
     stays safe.
 
-    Non-``DataSet`` items (MultiDataSet) pass through unstaged."""
+    ``stack_batches=K`` (round 11, the fused multi-step training feed):
+    pull K batches at a time from the wrapped iterator, stack them on
+    HOST into one ``[K, B, ...]`` super-batch (``stack_batch_group``,
+    tagged ``fused_stack=K``) and stage it with ONE ``device_put`` per
+    array — so a K-step fused dispatch costs one transfer, the ring
+    overlaps it under the running super-step exactly as it overlaps
+    single batches, and a consumed stack's buffers are donated back as
+    one unit. Ragged tails (fewer than K left) and non-uniform groups
+    (shape/dtype/mask-presence mismatch) fall back to plain per-step
+    batches.
+
+    ``MultiDataSet`` items are staged array-by-array the same way
+    (round 11; they previously passed through unstaged)."""
 
     def __init__(self, wrapped: DataSetIterator, depth: int = 2,
-                 donate: bool = True, device=None, retry=...):
+                 donate: bool = True, device=None, retry=...,
+                 stack_batches: int = 0):
         from deeplearning4j_tpu.resilience import retry as _retry
 
         self.wrapped = wrapped
         self.depth = max(1, int(depth))
         self.donate = bool(donate)
         self.device = device
+        self.stack_batches = int(stack_batches or 0)
+        self._skip_next = 0
         # transient device_put failures (driver hiccup, injected fault)
         # are retried with backoff instead of killing the epoch; pass
         # retry=None to stage without a safety net
@@ -170,13 +337,15 @@ class DeviceRingIterator(DataSetIterator):
         return self.wrapped.total_examples()
 
     def _stage(self, ds):
-        """-> (device DataSet, owned device arrays). Issues the async
-        transfers; owned = only the arrays staged here (donation-safe)."""
+        """-> (device DataSet/MultiDataSet, owned device arrays). Issues
+        the async transfers; owned = only the arrays staged here
+        (donation-safe). A stacked super-batch keeps its ``fused_stack``
+        tag across staging."""
         import jax
 
         from deeplearning4j_tpu import telemetry
 
-        if not isinstance(ds, DataSet):
+        if not isinstance(ds, (DataSet, MultiDataSet)):
             return ds, []
         owned = []
         put = (lambda a: jax.device_put(a, self.device)) if self.device \
@@ -197,8 +366,23 @@ class DeviceRingIterator(DataSetIterator):
             return d
 
         with telemetry.span(telemetry.PHASE_INGEST):
-            staged = DataSet(stage(ds.features), stage(ds.labels),
-                             stage(ds.features_mask), stage(ds.labels_mask))
+            if isinstance(ds, DataSet):
+                staged = DataSet(stage(ds.features), stage(ds.labels),
+                                 stage(ds.features_mask),
+                                 stage(ds.labels_mask))
+            else:
+                def stage_list(group):
+                    return (None if group is None
+                            else [stage(a) for a in group])
+
+                staged = MultiDataSet(
+                    features=stage_list(ds.features),
+                    labels=stage_list(ds.labels),
+                    features_masks=stage_list(ds.features_masks),
+                    labels_masks=stage_list(ds.labels_masks))
+        k = getattr(ds, "fused_stack", 0)
+        if k:
+            staged.fused_stack = k
         if telemetry.enabled() and owned:
             telemetry.record_ingest(sum(int(a.nbytes) for a in owned))
         self.staged_count += 1
@@ -215,10 +399,28 @@ class DeviceRingIterator(DataSetIterator):
         if owned:
             self.retired_count += 1
 
+    def skip_staging(self, n: int) -> None:
+        """The next iteration's first ``n`` items bypass device staging
+        (yielded as-is, host arrays): a resuming ``TrainingSession``
+        fast-forwards past already-trained (super-)steps and must not
+        pay their transfers — it counts and discards the SAME yielded
+        items either way, so positions stay aligned."""
+        self._skip_next = max(0, int(n))
+
     def __iter__(self):
         ring = collections.deque()
         last_owned = None
-        for ds in self.wrapped:
+        skip = self._skip_next
+        self._skip_next = 0
+        source = (StackBatchIterator(self.wrapped, self.stack_batches)
+                  if self.stack_batches > 1 else self.wrapped)
+        if skip and isinstance(source, StackBatchIterator):
+            source.skip_stacking(skip)  # skip the host copies too
+        for ds in source:
+            if skip > 0:
+                skip -= 1
+                yield ds  # fast-forward: un-staged, consumer discards
+                continue
             ring.append(self._stage(ds))
             if len(ring) < self.depth:
                 continue

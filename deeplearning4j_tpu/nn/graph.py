@@ -69,6 +69,7 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         self._score_cache: Optional[float] = float("nan")
         self._train_step = None
         self._tbptt_scan = None
+        self._fused_scan = None
         self._output_fn = None
         self._score_fn = None
         self._rnn_step_fn = None
@@ -345,10 +346,17 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         return afn
 
     # --- training ----------------------------------------------------------
-    def fit(self, data, labels=None, epochs: int = 1):
+    def fit(self, data, labels=None, epochs: int = 1,
+            fused_steps: Optional[int] = None):
         """Train (reference ``ComputationGraph#fit`` overloads:
         MultiDataSetIterator / DataSetIterator / (MultiData)Set /
-        (features, labels) arrays)."""
+        (features, labels) arrays).
+
+        ``fused_steps=K`` (round 11): K optimization steps per compiled
+        dispatch via the ``lax.scan`` fused runner, fed by a K-stacking
+        ``DeviceRingIterator`` — same contract as
+        ``MultiLayerNetwork.fit`` (bit-identical to K=1, K per-step
+        losses to listeners, STANDARD backprop only)."""
         if self.params is None:
             self.init()
         if isinstance(data, (DataSet, MultiDataSet)):
@@ -364,21 +372,39 @@ class ComputationGraph(nn_io.LazyScoreMixin):
             reset = lambda: None  # noqa: E731
         else:
             raise TypeError(f"cannot fit from {type(data)}")
+        if int(fused_steps or 0) > 1:
+            from deeplearning4j_tpu.nn.multilayer import _wrap_fused
+
+            if isinstance(batches, list):
+                # single (Multi)DataSet / array inputs go through the
+                # same wrap so the tBPTT refusal (and K semantics) match
+                # MultiLayerNetwork.fit exactly
+                from deeplearning4j_tpu.datasets.iterators import (
+                    ListDataSetIterator,
+                )
+
+                batches = ListDataSetIterator(batches)
+            batches = _wrap_fused(batches, fused_steps, self.conf)
+            reset = batches.reset
         from deeplearning4j_tpu.telemetry import flightrec
 
-        with flightrec.flight_recorder(model=self):
-            for _ in range(epochs):
-                for lst in self.listeners:
-                    lst.on_epoch_start(self, self.epoch)
-                pending = []
-                for ds in batches:
-                    pending.append(self._fit_batch_async(ds))
-                    nn_io.drain(pending)
-                nn_io.drain(pending, force=True)
-                reset()
-                for lst in self.listeners:
-                    lst.on_epoch_end(self, self.epoch)
-                self.epoch += 1
+        telemetry.host_gap_reset()
+        try:
+            with flightrec.flight_recorder(model=self):
+                for _ in range(epochs):
+                    for lst in self.listeners:
+                        lst.on_epoch_start(self, self.epoch)
+                    pending = []
+                    for ds in batches:
+                        pending.append(self._fit_batch_async(ds))
+                        nn_io.drain(pending)
+                    nn_io.drain(pending, force=True)
+                    reset()
+                    for lst in self.listeners:
+                        lst.on_epoch_end(self, self.epoch)
+                    self.epoch += 1
+        finally:
+            telemetry.host_gap_stop()
         return self
 
     def _dequant(self, x, idx: int = 0):
@@ -437,7 +463,11 @@ class ComputationGraph(nn_io.LazyScoreMixin):
 
     def fit_batch(self, ds) -> float:
         """One synced optimization step."""
-        return float(self._fit_batch_async(ds))
+        try:
+            return float(self._fit_batch_async(ds))
+        finally:
+            # standalone step: idle-until-next-call is not host gap
+            telemetry.host_gap_stop()
 
     def _fit_batch_async(self, ds):
         """One step without forcing a host sync (see
@@ -446,6 +476,9 @@ class ComputationGraph(nn_io.LazyScoreMixin):
 
         if self.params is None:
             self.init()
+        k = int(getattr(ds, "fused_stack", 0) or 0)
+        if k > 1:
+            return self._fit_fused(ds, k)
         if self.conf.backprop_type is BackpropType.TRUNCATED_BPTT:
             ndims = [np.ndim(f) for f in _as_multi(ds).features]
             if all(d == 3 for d in ndims):
@@ -514,6 +547,7 @@ class ComputationGraph(nn_io.LazyScoreMixin):
                     ) + tuple(features[1:])
         gvec = None
         with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
+            telemetry.host_gap_close()
             out = self._train_step(
                 self.params, self.state, self.opt_state, features, labels,
                 fmasks, lmasks, self.device_iteration(),
@@ -525,6 +559,8 @@ class ComputationGraph(nn_io.LazyScoreMixin):
             _sp.set_result(loss)
         with telemetry.span(telemetry.PHASE_GRAD_SYNC) as _sp:
             _sp.set_result(self.params)  # single device: ~0 (see MLN)
+        # post-span: under enable(sync=True) the gap excludes device time
+        telemetry.host_gap_open()
         telemetry.record_step("graph", int(features[0].shape[0]))
         self._score_dev = loss
         self._score_cache = None
@@ -729,6 +765,109 @@ class ComputationGraph(nn_io.LazyScoreMixin):
             return params, state, opt, itc, jnp.mean(ys)
 
         return run
+
+    def fused_scan_fn(self, k: int, guards: str = ""):
+        """The raw (unjitted) K-step fused runner for the DAG — the
+        tuple-batch generalization of
+        ``MultiLayerNetwork.fused_scan_fn`` (same contract: scan the
+        standard train step over [K, B, ...] stacks, K steps per
+        dispatch, bit-identical to K standard steps; guards ride the
+        ys as the [K, G] stack). ParallelWrapper jits it over a mesh
+        unchanged."""
+        raw = self.train_step_fn(guards=guards)
+        dtype = self._dtype
+
+        def run(params, state, opt, features, labels, fmasks, lmasks,
+                itc, ep, base_key):
+            def body(carry, xs):
+                params, state, opt, itc = carry
+                f_s, l_s, fm_s, lm_s = xs
+                # same in-jit defaults as the standard step builder
+                lm_s = tuple(
+                    jnp.ones((l.shape[0],), dtype) if m is None else m
+                    for m, l in zip(lm_s, l_s))
+                it, rng = nn_io.step_scalars(itc, base_key)
+                out = raw(params, state, opt, f_s, l_s, fm_s, lm_s, it,
+                          ep, rng)
+                if guards:
+                    params, state, opt, loss, vec = out
+                    return (params, state, opt, itc + 1), (loss, vec)
+                params, state, opt, loss = out
+                return (params, state, opt, itc + 1), loss
+
+            (params, state, opt, itc), ys = jax.lax.scan(
+                body, (params, state, opt, itc),
+                (features, labels, fmasks, lmasks))
+            if guards:
+                losses, vecs = ys
+                return params, state, opt, itc, losses, vecs
+            return params, state, opt, itc, ys
+
+        return run
+
+    def _fit_fused(self, ds, k: int):
+        """K fused optimization steps from one stacked (Multi)DataSet —
+        the DAG counterpart of ``MultiLayerNetwork._fit_fused`` (one
+        scan dispatch, donated carry, K-keyed AOT cache, K per-step
+        listener losses, super-step health granularity)."""
+        from deeplearning4j_tpu.conf.multilayer import BackpropType
+        from deeplearning4j_tpu.resilience import faults
+        from deeplearning4j_tpu.telemetry import health
+
+        if self.conf.backprop_type is BackpropType.TRUNCATED_BPTT:
+            raise ValueError(
+                "fused_steps composes with STANDARD backprop only: a "
+                "tBPTT batch already trains as one compiled segment scan")
+        with telemetry.span(telemetry.PHASE_INGEST):
+            features, labels, fmasks, lmasks = self._prep_batch(
+                ds, lazy_lmasks=True, write_back=True)
+        features = (faults.fault_point("train.step", features[0]),
+                    ) + tuple(features[1:])
+        mode = health.graph_mode()
+        if self._fused_scan is None:
+            self._fused_scan = {}
+        if (k, mode) not in self._fused_scan:
+            self._fused_scan[k, mode] = aot_cache.wrap(
+                jax.jit(self.fused_scan_fn(k, guards=mode),
+                        donate_argnums=(0, 1, 2, 7)),
+                self._graph_key(),
+                f"fused_scan:{k}:d0127{health.cache_tag()}")
+        gvecs = None
+        with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
+            telemetry.host_gap_close(k)
+            out = self._fused_scan[k, mode](
+                self.params, self.state, self.opt_state, features, labels,
+                fmasks, lmasks, self.device_iteration(),
+                self.device_epoch(), self._base_key)
+            (self.params, self.state, self.opt_state, new_itc,
+             losses) = out[:5]
+            if mode:
+                gvecs = out[5]
+            _sp.set_result(losses)
+        with telemetry.span(telemetry.PHASE_GRAD_SYNC) as _sp:
+            _sp.set_result(self.params)  # single device: ~0 (see MLN)
+        telemetry.host_gap_open()  # post-span: sync mode excludes device
+        telemetry.record_step(
+            "graph",
+            int(features[0].shape[0]) * int(features[0].shape[1]),
+            steps=k)
+        self._score_dev = losses[-1]
+        self._score_cache = None
+        cur = self.iteration
+        self.iteration += k
+        self.advance_device_iteration(new_itc)
+        if mode:
+            self._guard_keys = health.bucket_keys(self.params)
+            health.observe_fused(
+                self, "graph", cur, self.epoch, losses, gvecs,
+                self._guard_keys, k, batch=(features, labels),
+                rng_seed=int(getattr(self.conf, "seed", 0) or 0))
+        if self.listeners:
+            for j in range(k):
+                loss_j = losses[j]
+                for lst in self.listeners:
+                    lst.iteration_done(self, cur + j, self.epoch, loss_j)
+        return losses[-1]  # device scalar: the async fit pipeline queues it
 
     def tbptt_batch_arrays(self, ds):
         """Stage one tBPTT batch fully normalized for ``tbptt_scan_fn``:

@@ -90,9 +90,17 @@ def step_scalars(itc, base_key):
 
 def drain(pending, force: bool = False):
     """Block on queued step results when the pipeline is full (or at epoch
-    end with ``force``); returns the (possibly emptied) list."""
+    end with ``force``); returns the (possibly emptied) list. The block is
+    an intentional device wait, so the telemetry host-gap clock pauses
+    around it (device time must never read as host dispatch gap)."""
     if pending and (force or len(pending) >= DISPATCH_DEPTH):
-        jax.block_until_ready(pending)
+        from deeplearning4j_tpu.telemetry import spans
+
+        spans.host_gap_pause()
+        try:
+            jax.block_until_ready(pending)
+        finally:
+            spans.host_gap_resume()
         pending.clear()
     return pending
 

@@ -48,6 +48,28 @@ def _as_iterator(data, labels=None, batch_size: Optional[int] = None):
     raise TypeError(f"cannot build DataSetIterator from {type(data)}")
 
 
+def _wrap_fused(iterator, fused_steps, conf):
+    """``fit(fused_steps=K)`` plumbing shared by both model types: wrap
+    the fit iterator in a K-stacking ``DeviceRingIterator`` (no-op for
+    K<=1 or an already-K-stacking ring, so composed/pre-wrapped inputs
+    never double-stack). tBPTT configs refuse — a tBPTT batch already
+    trains as one compiled segment scan owning the time axis."""
+    k = int(fused_steps or 0)
+    if k <= 1:
+        return iterator
+    from deeplearning4j_tpu.conf.multilayer import BackpropType
+
+    if conf.backprop_type is BackpropType.TRUNCATED_BPTT:
+        raise ValueError(
+            "fused_steps composes with STANDARD backprop only: a tBPTT "
+            "batch already trains as one compiled segment scan")
+    from deeplearning4j_tpu.datasets.prefetch import DeviceRingIterator
+
+    if getattr(iterator, "stack_batches", 0) == k:
+        return iterator
+    return DeviceRingIterator(iterator, stack_batches=k)
+
+
 def _is_go_backwards_layer(layer) -> bool:
     """go_backwards layers get PER-SEGMENT RESET under tBPTT (their
     reversed scan's carry would come from the FUTURE segment) — same
@@ -72,6 +94,7 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         self._score_cache: Optional[float] = float("nan")
         self._train_step = None
         self._tbptt_scan = None
+        self._fused_scan = None
         self._output_fn = None
         self._score_fn = None
         self._rnn_step_fn = None
@@ -379,27 +402,41 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
 
     # --- training ----------------------------------------------------------
     def fit(self, data, labels=None, epochs: int = 1,
-            batch_size: Optional[int] = None):
+            batch_size: Optional[int] = None,
+            fused_steps: Optional[int] = None):
         """Train (reference ``MultiLayerNetwork#fit`` overloads: iterator,
-        DataSet, or (features, labels) arrays)."""
+        DataSet, or (features, labels) arrays).
+
+        ``fused_steps=K`` (round 11): fuse K optimization steps into ONE
+        compiled dispatch — the iterator is wrapped in a K-stacking
+        ``DeviceRingIterator`` (one ``device_put`` per super-step,
+        consumed stacks donated) and each stack trains through the
+        ``lax.scan`` fused runner. Bit-identical to K=1 on the same
+        batch stream; listeners still see K per-step losses. Composes
+        with STANDARD backprop only (tBPTT already scans segments)."""
         from deeplearning4j_tpu.telemetry import flightrec
 
         if self.params is None:
             self.init()
         iterator = _as_iterator(data, labels, batch_size)
-        with flightrec.flight_recorder(model=self):
-            for _ in range(epochs):
-                for lst in self.listeners:
-                    lst.on_epoch_start(self, self.epoch)
-                pending = []
-                for ds in iterator:
-                    pending.append(self._fit_batch_async(ds))
-                    nn_io.drain(pending)
-                nn_io.drain(pending, force=True)
-                iterator.reset()
-                for lst in self.listeners:
-                    lst.on_epoch_end(self, self.epoch)
-                self.epoch += 1
+        iterator = _wrap_fused(iterator, fused_steps, self.conf)
+        telemetry.host_gap_reset()
+        try:
+            with flightrec.flight_recorder(model=self):
+                for _ in range(epochs):
+                    for lst in self.listeners:
+                        lst.on_epoch_start(self, self.epoch)
+                    pending = []
+                    for ds in iterator:
+                        pending.append(self._fit_batch_async(ds))
+                        nn_io.drain(pending)
+                    nn_io.drain(pending, force=True)
+                    iterator.reset()
+                    for lst in self.listeners:
+                        lst.on_epoch_end(self, self.epoch)
+                    self.epoch += 1
+        finally:
+            telemetry.host_gap_stop()
         return self
 
     def _batch_arrays(self, ds: DataSet, lazy_lmask: bool = False,
@@ -437,6 +474,9 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         ScoreIterationListener every N prints)."""
         if self.params is None:
             self.init()
+        k = int(getattr(ds, "fused_stack", 0) or 0)
+        if k > 1:
+            return self._fit_fused(ds, k)
         from deeplearning4j_tpu.conf.multilayer import BackpropType
 
         tbptt = (self.conf.backprop_type is BackpropType.TRUNCATED_BPTT
@@ -467,6 +507,7 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
             self._train_step = self._build_train_step()
         gvec = None
         with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
+            telemetry.host_gap_close()
             out = self._train_step(
                 self.params, self.state, self.opt_state, features, labels,
                 fmask, lmask, self.device_iteration(), self.device_epoch(),
@@ -481,6 +522,10 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
             # ready the updated params are too, so this span records ~0
             # (the same convention bench_resnet_profile.py --phases uses)
             _sp.set_result(self.params)
+        # the host gap opens AFTER the result-bearing spans exit: under
+        # enable(sync=True) they block on the device result, so the gap
+        # measures pure host dispatch-loop work with no device overlap
+        telemetry.host_gap_open()
         telemetry.record_step("multilayer", int(features.shape[0]))
         self.last_batch_size = int(features.shape[0])
         self._score_dev = loss
@@ -504,7 +549,87 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
     def fit_batch(self, ds: DataSet) -> float:
         """One optimization step on one minibatch, synced (tBPTT: one step
         per segment, reference ``MultiLayerNetwork#doTruncatedBPTT``)."""
-        return float(self._fit_batch_async(ds))
+        try:
+            return float(self._fit_batch_async(ds))
+        finally:
+            # a standalone step is not a dispatch loop: idle time until
+            # the caller's next step must not record as host gap
+            telemetry.host_gap_stop()
+
+    def _fit_fused(self, ds: DataSet, k: int):
+        """K fused optimization steps from one [K, B, ...] stacked batch
+        (``DeviceRingIterator(stack_batches=K)`` built it): one compiled
+        ``lax.scan`` dispatch, params/state/opt/iteration donated across
+        the K-step boundary, K keyed into the AOT cache so K=1 and K=4
+        executables never collide. Listeners fire K times with the
+        scan's per-step losses; health guards ride the scan with
+        WARN/SKIP staying sync-free and ROLLBACK/HALT resolving at
+        super-step granularity."""
+        from deeplearning4j_tpu.conf.multilayer import BackpropType
+        from deeplearning4j_tpu.resilience import faults
+        from deeplearning4j_tpu.telemetry import health
+
+        if self.conf.backprop_type is BackpropType.TRUNCATED_BPTT:
+            raise ValueError(
+                "fused_steps composes with STANDARD backprop only: a "
+                "tBPTT batch already trains as one compiled segment scan")
+        with telemetry.span(telemetry.PHASE_INGEST):
+            features, labels, fmask, lmask = self._batch_arrays(
+                ds, lazy_lmask=True, write_back=True)
+        # same once-per-dispatch injection site as the standard branch
+        # (raise = preemption mid-super-step; corrupt poisons the stack)
+        features = faults.fault_point("train.step", features)
+        mode = health.graph_mode()
+        if self._fused_scan is None:
+            self._fused_scan = {}
+        if (k, mode) not in self._fused_scan:
+            # K joins the cache key: a K=1 and a K=4 executable must
+            # never collide even though their graph keys match
+            self._fused_scan[k, mode] = aot_cache.wrap(
+                jax.jit(self.fused_scan_fn(k, guards=mode),
+                        donate_argnums=(0, 1, 2, 7)),
+                self._graph_key(),
+                f"fused_scan:{k}:d0127{health.cache_tag()}")
+        gvecs = None
+        with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
+            telemetry.host_gap_close(k)
+            out = self._fused_scan[k, mode](
+                self.params, self.state, self.opt_state, features, labels,
+                fmask, lmask, self.device_iteration(), self.device_epoch(),
+                self._base_key)
+            (self.params, self.state, self.opt_state, new_itc,
+             losses) = out[:5]
+            if mode:
+                gvecs = out[5]
+            _sp.set_result(losses)
+        with telemetry.span(telemetry.PHASE_GRAD_SYNC) as _sp:
+            _sp.set_result(self.params)  # single device: ~0 (see above)
+        telemetry.host_gap_open()  # post-span: sync mode excludes device
+        telemetry.record_step(
+            "multilayer", int(features.shape[0]) * int(features.shape[1]),
+            steps=k)
+        # per-STEP batch size: examples/sec listeners multiply by the
+        # per-iteration rate, which counts K iterations per dispatch
+        self.last_batch_size = int(features.shape[1])
+        self._score_dev = losses[-1]
+        self._score_cache = None
+        cur = self.iteration
+        self.iteration += k
+        self.advance_device_iteration(new_itc)
+        if mode:
+            self._guard_keys = health.bucket_keys(self.params)
+            health.observe_fused(
+                self, "multilayer", cur, self.epoch, losses, gvecs,
+                self._guard_keys, k, batch=(features, labels),
+                rng_seed=int(getattr(self.conf, "seed", 0) or 0))
+        if self.listeners:
+            # K per-step losses from the scan's ys — each a lazy device
+            # slice, so listeners that never read a score never sync
+            for j in range(k):
+                loss_j = losses[j]
+                for lst in self.listeners:
+                    lst.iteration_done(self, cur + j, self.epoch, loss_j)
+        return losses[-1]  # device scalar: the async fit pipeline queues it
 
     def _tbptt_prepad(self, ds: DataSet) -> DataSet:
         """Variable-length host batches (fresh numpy per batch, NLP
@@ -692,6 +817,57 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
             return f_s, l_s, fm_s, lm_s, carries
 
         return segments, zero_carries, advance, cut
+
+    def fused_scan_fn(self, k: int, guards: str = ""):
+        """The raw (unjitted) K-step fused runner (round 11, ROADMAP open
+        item 5): ``lax.scan`` the standard train step over a
+        device-resident stack of K batches — ``(params, state, opt,
+        features[K,B,...], labels[K,...], fmask[K,...]|None,
+        lmask[K,...]|None, itc, ep, base_key) -> (params, state, opt,
+        new_itc, losses[K][, vecs[K,G]])`` — so K optimization steps cost
+        ONE host dispatch. The scan body is exactly the single-step
+        ``train_step_fn`` fed the same in-jit per-step scalars
+        (``nn_io.step_scalars`` on the carried iteration counter), so a
+        K-step fused run is bit-identical to K standard steps on the
+        same batch stream; the tBPTT segment scan is the template
+        (``tbptt_scan_fn``), with batches instead of segments as the
+        scanned axis and no carries.
+
+        ``guards``: with a health mode the per-step guard vectors ride
+        the scan's ys and the run returns the [K, G] STACK (not the max)
+        so the host can surface the offending step index; ``"skip"``
+        reverts each anomalous step's update inside the scan body.
+        Exposed (like ``tbptt_scan_fn``) so ParallelWrapper can jit it
+        over a mesh with the per-step batch axis sharded."""
+        raw = self.train_step_fn(guards=guards)
+        dtype = self._dtype
+
+        def run(params, state, opt, features, labels, fmask, lmask,
+                itc, ep, base_key):
+            def body(carry, xs):
+                params, state, opt, itc = carry
+                f_s, l_s, fm_s, lm_s = xs
+                if lm_s is None:
+                    # same in-jit default as the standard step builder
+                    lm_s = jnp.ones((f_s.shape[0],), dtype)
+                it, rng = nn_io.step_scalars(itc, base_key)
+                out = raw(params, state, opt, f_s, l_s, fm_s, lm_s, it,
+                          ep, rng)
+                if guards:
+                    params, state, opt, loss, vec = out
+                    return (params, state, opt, itc + 1), (loss, vec)
+                params, state, opt, loss = out
+                return (params, state, opt, itc + 1), loss
+
+            (params, state, opt, itc), ys = jax.lax.scan(
+                body, (params, state, opt, itc),
+                (features, labels, fmask, lmask))
+            if guards:
+                losses, vecs = ys
+                return params, state, opt, itc, losses, vecs
+            return params, state, opt, itc, ys
+
+        return run
 
     def tbptt_batch_arrays(self, ds: DataSet):
         """Stage one tBPTT batch fully normalized for ``tbptt_scan_fn``:

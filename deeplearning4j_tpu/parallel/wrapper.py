@@ -80,6 +80,22 @@ def _stack(tree, n: int):
         lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), tree)
 
 
+def _pad_axis1(tree, target: int):
+    """Zero-pad every leaf's axis 1 — the per-step batch rows of a
+    [K, B, ...] fused stack — to ``target`` rows (the stacked counterpart
+    of ``mesh.pad_leading``; the materialized labels masks zero out the
+    padded rows' loss contribution)."""
+    def pad(x):
+        x = jnp.asarray(x)
+        if x.shape[1] == target:
+            return x
+        z = jnp.zeros(x.shape[:1] + (target - x.shape[1],) + x.shape[2:],
+                      x.dtype)
+        return jnp.concatenate([x, z], axis=1)
+
+    return _tree_map(pad, tree)
+
+
 def _mean_leading(tree):
     return _tree_map(lambda x: x.mean(axis=0), tree)
 
@@ -104,7 +120,8 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                  threshold_algorithm: Optional[ThresholdAlgorithm] = None,
                  prefetch_buffer: int = 2,
                  mesh=None, expert_parallel: bool = False,
-                 gradient_bucket_mb: Optional[float] = None):
+                 gradient_bucket_mb: Optional[float] = None,
+                 fused_steps: Optional[int] = None):
         from deeplearning4j_tpu.nn.graph import ComputationGraph
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
@@ -185,6 +202,26 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                 "gradient_bucket_mb composes with the standard "
                 "SHARED_GRADIENTS / AVERAGING steps only (no "
                 "expert_parallel, no tBPTT yet)")
+        # K-step fused dispatch (round 11): the model's fused_scan_fn
+        # jitted over the mesh with the per-step batch axis sharded —
+        # exact SPMD mode only (the other modes' per-step host feedback
+        # loops — adaptive tau, averaging cadence — defeat fusion)
+        self.fused_steps = int(fused_steps or 0)
+        if self.fused_steps > 1:
+            if (training_mode is not TrainingMode.SHARED_GRADIENTS
+                    or threshold_algorithm is not None
+                    or self.expert_parallel or self._explicit_exchange
+                    or self._tbptt):
+                raise ValueError(
+                    "fused_steps composes with the exact SHARED_GRADIENTS "
+                    "SPMD path only (no threshold compression, no "
+                    "gradient_bucket_mb, no expert_parallel, no tBPTT, "
+                    "no AVERAGING)")
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "fused_steps is single-process for now (the "
+                    "multi-host per-batch shape lock does not cover "
+                    "stacked super-batches)")
         self.score_value = float("nan")
         # device-resident training trees (replicated or replica-stacked)
         self._params = self._state = self._opt = None
@@ -194,6 +231,8 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         self._avg = None
         self._collect = None
         self._mp_target = None
+        self._fused_step = None
+        self._fused_step_k = None
 
     # --- model-type adapters -----------------------------------------------
     def _prep(self, ds):
@@ -225,6 +264,7 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         mode = health.graph_mode()
         if getattr(self, "_health_mode", None) != mode:
             self._step = None
+            self._fused_step = None
             self._health_mode = mode
         if self.training_mode is TrainingMode.AVERAGING:
             # multi-process: each process contributes its LOCAL replicas;
@@ -736,22 +776,34 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                     ListDataSetIterator,
                 )
                 iterator = ListDataSetIterator([data])
-            if self.prefetch_buffer > 0 and not isinstance(
-                    iterator, AsyncDataSetIterator):
-                iterator = AsyncDataSetIterator(
-                    iterator, queue_size=self.prefetch_buffer)
         else:
             iterator = _as_iterator(data, labels)
-            if self.prefetch_buffer > 0 and not isinstance(
-                    iterator, AsyncDataSetIterator):
-                iterator = AsyncDataSetIterator(
-                    iterator, queue_size=self.prefetch_buffer)
+        already_async = isinstance(iterator, AsyncDataSetIterator)
+        if self.fused_steps > 1 and getattr(
+                iterator, "stack_batches", 0) != self.fused_steps:
+            from deeplearning4j_tpu.datasets.prefetch import (
+                StackBatchIterator,
+            )
+
+            # host-side stacking only: the wrapper owns device placement
+            # (the stack is sharded over the mesh, not default-device-
+            # put). Wrapped INSIDE the async prefetcher below so the
+            # K-batch np.stack runs on the prefetch thread, not in the
+            # dispatch loop's host gap (a user-provided async iterator
+            # keeps its single prefetch thread; the stack then runs
+            # consumer-side rather than double-wrapping).
+            iterator = StackBatchIterator(iterator, self.fused_steps)
+        if self.prefetch_buffer > 0 and not already_async \
+                and not isinstance(iterator, AsyncDataSetIterator):
+            iterator = AsyncDataSetIterator(
+                iterator, queue_size=self.prefetch_buffer)
         from deeplearning4j_tpu.telemetry import flightrec
 
         self._setup()
         # each fit() may use a different batch size; the multi-host shape
         # lock applies within one fit only
         self._mp_target = None
+        telemetry.host_gap_reset()
         try:
             with flightrec.flight_recorder(model=m):
                 for _ in range(epochs):
@@ -764,6 +816,7 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                         lst.on_epoch_end(m, m.epoch)
                     m.epoch += 1
         finally:
+            telemetry.host_gap_stop()
             self._write_back()
         return m
 
@@ -801,7 +854,7 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         self.model._score_dev = None
         self.model._score_cache = None
 
-    def _record_exchange(self, did_average: bool = False):
+    def _record_exchange(self, did_average: bool = False, steps: int = 1):
         """Telemetry: count this step's cross-replica payload (the
         per-shard gradient tree — what one fused all-reduce or the bucket
         chain moves; an upper bound under expert_parallel, whose sharded
@@ -828,12 +881,15 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             telemetry.record_bucket_layout(op, layout)
         telemetry.record_collective(
             "threshold_psum" if self.threshold_algorithm is not None
-            else "grad_psum", sum(layout), len(layout))
+            else "grad_psum", sum(layout) * steps, len(layout) * steps)
 
     def _fit_batch(self, ds):
         from deeplearning4j_tpu.resilience import faults
 
         faults.fault_point("train.step")  # preemption/crash injection site
+        k = int(getattr(ds, "fused_stack", 0) or 0)
+        if k > 1:
+            return self._fit_batch_fused(ds, k)
         m = self.model
         with telemetry.span(telemetry.PHASE_INGEST):
             batch = self._prep(ds)
@@ -876,6 +932,7 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         gvec = None
         did_avg = False
         with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
+            telemetry.host_gap_close()
             if self.training_mode is TrainingMode.AVERAGING:
                 out = self._step(
                     self._params, self._state, self._opt, batch, itc, ep,
@@ -942,6 +999,8 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             # residue here is the wait for the updated params tree (~0;
             # use XProf for the kernel-level collective/compute split)
             _sp.set_result(self._params)
+        # post-span: under enable(sync=True) the gap excludes device time
+        telemetry.host_gap_open()
         if telemetry.enabled():
             telemetry.record_step("parallel", rows)
             self._record_exchange(did_avg)
@@ -963,6 +1022,91 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                 skipped=False if self.expert_parallel else None)
         for lst in m.listeners:
             lst.iteration_done(m, m.iteration - 1, m.epoch, loss)
+
+    def _prep_fused(self, ds):
+        """Stacked [K, B, ...] batch arrays for the fused SPMD step, with
+        labels masks MATERIALIZED (ones [K, B]) — axis-1 padding must
+        zero them so padded rows contribute nothing, same contract as
+        ``pad_leading`` on the single-step path."""
+        m = self.model
+        if self._is_graph:
+            f, l, fm, lm = m._prep_batch(ds, lazy_lmasks=True)
+            lm = tuple(jnp.ones(lab.shape[:2], m._dtype) if mm is None
+                       else mm for mm, lab in zip(lm, l))
+            return f, l, fm, lm
+        f, l, fm, lm = m._batch_arrays(ds, lazy_lmask=True)
+        if lm is None:
+            lm = jnp.ones(f.shape[:2], m._dtype)
+        return f, l, fm, lm
+
+    def _fit_batch_fused(self, ds, k: int):
+        """K fused optimization steps per dispatch over the mesh: the
+        model's ``fused_scan_fn`` jitted with the stack's PER-STEP batch
+        axis (axis 1) sharded ``P(None, 'data')`` and params replicated —
+        each scan step is the same SPMD-partitioned step as the K=1 exact
+        path (XLA inserts the per-step gradient all-reduce), so K=1 and
+        K=K train bit-identically while the host pays one dispatch per K
+        steps."""
+        from deeplearning4j_tpu.telemetry import health
+
+        if (self.training_mode is not TrainingMode.SHARED_GRADIENTS
+                or self.threshold_algorithm is not None
+                or self.expert_parallel or self._explicit_exchange
+                or self._tbptt):
+            # a hand-fed stacked batch must not silently train the exact
+            # SPMD math under a different configured mode
+            raise ValueError(
+                "fused [K, B, ...] batches require the exact "
+                "SHARED_GRADIENTS SPMD path (see fused_steps)")
+        m = self.model
+        mode = getattr(self, "_health_mode", "")
+        with telemetry.span(telemetry.PHASE_INGEST):
+            batch = self._prep_fused(ds)
+            rows = jax.tree_util.tree_leaves(batch)[0].shape[1]
+            target = (math.ceil(rows / self.local_workers)
+                      * self.local_workers)
+            batch = _pad_axis1(batch, target)
+            sh = NamedSharding(self.mesh, P(None, DATA))
+            batch = _tree_map(lambda x: jax.device_put(x, sh), batch)
+        if self._fused_step is None or self._fused_step_k != k:
+            self._fused_step = jax.jit(
+                m.fused_scan_fn(k, guards=mode), donate_argnums=(0, 1, 2))
+            self._fused_step_k = k
+        itc = np.int32(m.iteration)
+        ep = np.float32(m.epoch)
+        gvecs = None
+        with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
+            telemetry.host_gap_close(k)
+            out = self._fused_step(self._params, self._state, self._opt,
+                                   *batch, itc, ep, m._base_key)
+            (self._params, self._state, self._opt, _, losses) = out[:5]
+            if mode:
+                gvecs = out[5]
+            _sp.set_result(losses)
+        with telemetry.span(telemetry.PHASE_GRAD_SYNC) as _sp:
+            _sp.set_result(self._params)  # in-graph collective (see above)
+        telemetry.host_gap_open()  # post-span: sync mode excludes device
+        if telemetry.enabled():
+            telemetry.record_step("parallel", int(rows) * k, steps=k)
+            self._record_exchange(steps=k)  # K in-scan all-reduces
+        loss = losses[-1]
+        self._score_dev = loss
+        self._score_cache = None
+        m._score_dev = loss
+        m._score_cache = None
+        cur = m.iteration
+        m.iteration += k
+        if mode:
+            health.observe_fused(
+                self, "parallel", cur, m.epoch, losses, gvecs,
+                health.bucket_keys(m.params), k, batch=batch,
+                rng_seed=int(getattr(m.conf, "seed", 0) or 0))
+        if m.listeners:
+            for j in range(k):
+                loss_j = losses[j]
+                for lst in m.listeners:
+                    lst.iteration_done(m, cur + j, m.epoch, loss_j)
+        return loss
 
     def _write_back(self):
         """Publish trained params back onto the wrapped model (reference:

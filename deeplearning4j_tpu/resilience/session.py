@@ -221,7 +221,8 @@ class TrainingSession:
     # --- training -----------------------------------------------------------
     def fit(self, data, labels=None, epochs: int = 1,
             batch_size: Optional[int] = None,
-            to_epoch: Optional[int] = None):
+            to_epoch: Optional[int] = None,
+            fused_steps: Optional[int] = None):
         """Train to ``model.epoch + epochs`` — i.e. ``epochs`` is
         RELATIVE to the resumed position — snapshotting periodically and
         auto-resuming on resumable failure. A cross-process restart that
@@ -230,8 +231,19 @@ class TrainingSession:
         epoch 3 no matter where the snapshot left off, which is what the
         bit-identical-with-uninterrupted guarantee needs after a crash
         mid-run. The data order must be deterministic across replays (it
-        is, for the in-repo iterators) for that guarantee to hold."""
-        from deeplearning4j_tpu.nn.multilayer import _as_iterator
+        is, for the in-repo iterators) for that guarantee to hold.
+
+        ``fused_steps=K``: train through the K-step fused scan (see
+        ``MultiLayerNetwork.fit``). Snapshot/resume boundaries align to
+        K automatically — one iterator item is one super-step, so
+        ``batch_in_epoch`` counts super-steps and the periodic snapshot
+        fires whenever the iteration counter CROSSES a cadence multiple
+        (at a K-aligned boundary; exact-hit semantics for K=1 are
+        unchanged) — and kill-and-resume stays bit-identical because
+        the stacking is deterministic and a super-step is atomic: a
+        kill mid-super-step replays it whole."""
+        from deeplearning4j_tpu.nn.multilayer import _as_iterator, \
+            _wrap_fused
 
         if self.model is None:
             self.resume()
@@ -242,6 +254,7 @@ class TrainingSession:
             iterator = data
         else:
             iterator = _as_iterator(data, labels, batch_size)
+        iterator = _wrap_fused(iterator, fused_steps, self.model.conf)
         target_epoch = int(to_epoch) if to_epoch is not None \
             else int(self.model.epoch) + int(epochs)
         restarts_this_fit = 0
@@ -256,10 +269,13 @@ class TrainingSession:
                 self.resume()
 
     def _run(self, iterator, target_epoch: int):
-        from deeplearning4j_tpu.nn import io as nn_io
-        from deeplearning4j_tpu.telemetry import flightrec
+        from deeplearning4j_tpu import telemetry
 
         m = self.model
+        # this driver bypasses model.fit, so it re-arms the host-gap
+        # clock itself (idle time since a previous fit must not record
+        # as a dispatch gap)
+        telemetry.host_gap_reset()
         if not self.snapshots():
             # a pre-first-step snapshot: a kill before the first periodic
             # snapshot still resumes (from iteration 0) instead of
@@ -268,21 +284,42 @@ class TrainingSession:
         # same black-box contract as every other fit path: an exception
         # escaping a run attempt dumps one crash bundle (this driver
         # bypasses model.fit, so it carries the wrapper itself)
+        try:
+            self._run_epochs(iterator, target_epoch)
+        finally:
+            telemetry.host_gap_stop()
+        return m
+
+    def _run_epochs(self, iterator, target_epoch: int):
+        from deeplearning4j_tpu.nn import io as nn_io
+        from deeplearning4j_tpu.telemetry import flightrec
+
+        m = self.model
         with flightrec.flight_recorder(model=m):
             while m.epoch < target_epoch:
                 for lst in m.listeners:
                     lst.on_epoch_start(m, m.epoch)
                 iterator.reset()
                 skip = self._batch_in_epoch
+                if skip and hasattr(iterator, "skip_staging"):
+                    # replay fast-forward must not pay device transfers
+                    # for super-steps it immediately discards
+                    iterator.skip_staging(skip)
                 pending = []
                 for i, ds in enumerate(iterator):
                     if i < skip:
                         continue  # replay fast-forward to the crash pos
+                    it_before = m.iteration
                     pending.append(m._fit_batch_async(ds))
                     nn_io.drain(pending)
                     self._batch_in_epoch = i + 1
+                    # crossing (not exact-hit) check: a fused super-step
+                    # advances the counter by K per item, so the cadence
+                    # fires at the first K-aligned boundary past each
+                    # multiple; identical to the old % check for K=1
                     if self.every_iters \
-                            and m.iteration % self.every_iters == 0:
+                            and (m.iteration // self.every_iters
+                                 > it_before // self.every_iters):
                         self.snapshot()
                 nn_io.drain(pending, force=True)
                 for lst in m.listeners:

@@ -48,6 +48,7 @@ from deeplearning4j_tpu.telemetry.registry import REGISTRY  # noqa: F401
 from deeplearning4j_tpu.telemetry.spans import (  # noqa: F401
     PHASE_COMPUTE,
     PHASE_GRAD_SYNC,
+    PHASE_HOST_GAP,
     PHASE_INGEST,
     PHASES,
     enable,
@@ -55,6 +56,12 @@ from deeplearning4j_tpu.telemetry.spans import (  # noqa: F401
     disable,
     events,
     export_chrome_trace,
+    host_gap_close,
+    host_gap_open,
+    host_gap_pause,
+    host_gap_reset,
+    host_gap_resume,
+    host_gap_stop,
     phase_stats,
     span,
     sync_mode,
@@ -72,14 +79,16 @@ def reset() -> None:
 # hot-path recording helpers (each is one flag check when disabled)
 # --------------------------------------------------------------------------
 
-def record_step(path: str, examples: int = 0) -> None:
-    """Count one optimization step (and its examples) for a training
-    path: ``multilayer`` / ``graph`` / ``samediff`` / ``parallel`` /
-    ``pipeline``."""
+def record_step(path: str, examples: int = 0, steps: int = 1) -> None:
+    """Count one host dispatch's optimization steps (and examples) for a
+    training path: ``multilayer`` / ``graph`` / ``samediff`` /
+    ``parallel`` / ``pipeline``. A fused K-step super-step passes
+    ``steps=K`` so the counters keep K=1 semantics (K steps, K*B
+    examples per dispatch)."""
     if not spans._enabled:
         return
     REGISTRY.counter("dl4j_training_steps_total",
-                     help="optimization steps", path=path).inc()
+                     help="optimization steps", path=path).inc(steps)
     if examples:
         REGISTRY.counter("dl4j_training_examples_total",
                          help="examples consumed", path=path).inc(examples)

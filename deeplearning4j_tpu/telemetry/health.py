@@ -273,6 +273,7 @@ class HealthMonitor:
             self.last_keys: Tuple[str, ...] = ()
             self.last_anomaly_step: Optional[int] = None
             self._pending: List[tuple] = []
+            self._pending_steps = 0  # entries weighted by their K
 
     # --- recording ----------------------------------------------------------
     def on_step(self, vec, keys: Sequence[str] = (), path: str = "",
@@ -295,7 +296,8 @@ class HealthMonitor:
         if lazy:
             self._pending.append((vec, tuple(keys), path, self.steps,
                                   skipped))
-            if len(self._pending) >= self.flush_every:
+            self._pending_steps += 1
+            if self._pending_steps >= self.flush_every:
                 self.flush()
             return "none"
         # ROLLBACK / HALT: the decision must happen on the step it occurs
@@ -303,25 +305,27 @@ class HealthMonitor:
         anomalous = (v[GUARD_LOSS_NONFINITE] + v[GUARD_GRAD_NONFINITE]) > 0
         self._observe_host([(v, tuple(keys), path, self.steps, skipped)])
         if not anomalous:
-            if self.policy is AnomalyPolicy.ROLLBACK and owner is not None \
-                    and snapshot is not None:
-                tag = getattr(owner, "_health_last_good", None)
-                # tag[1] > steps = a leftover from before a monitor
-                # reset — refresh rather than trust an ancient snapshot
-                if tag is None or tag[1] > self.steps \
-                        or self.steps - tag[1] >= self.snapshot_every:
-                    owner._health_last_good = (snapshot(), self.steps)
+            self._maybe_snapshot(owner, snapshot)
             return "none"
+        return self._remediate(v, keys, path, self.steps, "step", owner,
+                               restore)
+
+    def _remediate(self, v, keys, path, step: int, frag: str, owner,
+                   restore) -> str:
+        """The shared ROLLBACK/HALT tail for the single- and fused-step
+        paths: restore-or-raise with ``frag`` naming the offending step
+        ("step" for K=1; "step N (step j/K of the fused super-step)"
+        for a fused dispatch)."""
         if self.policy is AnomalyPolicy.ROLLBACK:
             tag = getattr(owner, "_health_last_good", None) \
                 if owner is not None else None
             if tag is None or restore is None:
                 self.halted = True
                 raise DivergenceError(
-                    f"non-finite step on path {path!r} with ROLLBACK "
+                    f"non-finite {frag} on path {path!r} with ROLLBACK "
                     "policy but no last-good snapshot to restore "
                     f"(guard={self._describe(v, keys)})",
-                    vec=v, step=self.steps, path=path)
+                    vec=v, step=step, path=path)
             restore(tag[0])
             self.rollbacks += 1
             REGISTRY.counter("dl4j_rollbacks_total",
@@ -332,9 +336,66 @@ class HealthMonitor:
         REGISTRY.counter("dl4j_halts_total",
                          help="DivergenceError raises", path=path).inc()
         raise DivergenceError(
-            f"non-finite training step on path {path!r} "
+            f"non-finite training {frag} on path {path!r} "
             f"(guard={self._describe(v, keys)})",
-            vec=v, step=self.steps, path=path)
+            vec=v, step=step, path=path)
+
+    def _maybe_snapshot(self, owner, snapshot):
+        """Healthy-step ROLLBACK snapshot cadence (shared by the single-
+        and fused-step paths)."""
+        if self.policy is AnomalyPolicy.ROLLBACK and owner is not None \
+                and snapshot is not None:
+            tag = getattr(owner, "_health_last_good", None)
+            # tag[1] > steps = a leftover from before a monitor
+            # reset — refresh rather than trust an ancient snapshot
+            if tag is None or tag[1] > self.steps \
+                    or self.steps - tag[1] >= self.snapshot_every:
+                owner._health_last_good = (snapshot(), self.steps)
+
+    def on_steps(self, vecs, k: int, keys: Sequence[str] = (),
+                 path: str = "", owner=None,
+                 snapshot: Optional[Callable[[], object]] = None,
+                 restore: Optional[Callable[[object], None]] = None,
+                 skipped: Optional[bool] = None) -> str:
+        """Feed one fused super-step's stacked guard vectors (a [K, G]
+        device array; row j = step j of the scan's ys). Counting
+        semantics match K :meth:`on_step` calls — WARN/SKIP queue the
+        stack as ONE pending entry (no extra host sync; the K rows are
+        unpacked at flush time). ROLLBACK/HALT resolve at SUPER-STEP
+        granularity: the compiled scan has already run all K steps when
+        the vector surfaces, so remediation restores/raises for the
+        whole super-step, with the first offending step's global index
+        surfaced in the error/report."""
+        k = int(k)
+        if skipped is None:
+            skipped = self.policy is AnomalyPolicy.SKIP_STEP
+        first = self.steps + 1
+        self.steps += k
+        lazy = self.policy in (AnomalyPolicy.WARN, AnomalyPolicy.SKIP_STEP)
+        if lazy:
+            self._pending.append((vecs, tuple(keys), path, self.steps,
+                                  skipped))
+            # the cadence counts STEPS, not queue entries: a K-step
+            # stack weighs K, so detection latency matches K=1
+            self._pending_steps += k
+            if self._pending_steps >= self.flush_every:
+                self.flush()
+            return "none"
+        # ROLLBACK / HALT: the decision happens on the super-step it
+        # occurs (one stacked transfer)
+        v = np.asarray(vecs, np.float64).reshape(k, -1)
+        self._observe_host([(v, tuple(keys), path, self.steps, skipped)])
+        bad = np.flatnonzero((v[:, GUARD_LOSS_NONFINITE]
+                              + v[:, GUARD_GRAD_NONFINITE]) > 0)
+        if bad.size == 0:
+            self._maybe_snapshot(owner, snapshot)
+            return "none"
+        j = int(bad[0])
+        offending = first + j
+        return self._remediate(
+            v[j], tuple(keys), path, offending,
+            f"step {offending} (step {j + 1}/{k} of the fused "
+            "super-step)", owner, restore)
 
     def _describe(self, v, keys) -> str:
         parts = [f"loss={v[GUARD_LOSS]:.4g}",
@@ -354,6 +415,7 @@ class HealthMonitor:
         anomalies seen in this batch."""
         with self._lock:
             pending, self._pending = self._pending, []
+            self._pending_steps = 0
         if not pending:
             return 0
         host = [(np.asarray(vec, np.float64), keys, path, step, skipped)
@@ -364,14 +426,20 @@ class HealthMonitor:
         anomalies = 0
         with self._lock:
             for v, keys, path, step, skipped in entries:
-                self.last_vec = [float(x) for x in v]
-                self.last_keys = keys
-                bad = (v[GUARD_LOSS_NONFINITE]
-                       + v[GUARD_GRAD_NONFINITE]) > 0
-                if bad:
+                # a fused super-step queues its K per-step vectors as one
+                # [K, G] stack; ``step`` records the LAST step's index
+                rows = v if v.ndim == 2 else v.reshape(1, -1)
+                base = step - len(rows) + 1
+                for i, r in enumerate(rows):
+                    self.last_vec = [float(x) for x in r]
+                    self.last_keys = keys
+                    bad = (r[GUARD_LOSS_NONFINITE]
+                           + r[GUARD_GRAD_NONFINITE]) > 0
+                    if not bad:
+                        continue
                     anomalies += 1
                     self.nonfinite_steps += 1
-                    self.last_anomaly_step = step
+                    self.last_anomaly_step = base + i
                     REGISTRY.counter(
                         "dl4j_nonfinite_steps_total",
                         help="steps with non-finite loss/gradients",
@@ -462,6 +530,38 @@ def observe_step(owner, path: str, step: int, epoch: int, loss, vec,
     return MONITOR.on_step(vec, keys=keys, path=path, owner=owner,
                            snapshot=snapshot, restore=restore,
                            skipped=skipped)
+
+
+def observe_fused(owner, path: str, first_step: int, epoch: int, losses,
+                  vecs, keys: Sequence[str], k: int, batch=None,
+                  rng_seed: Optional[int] = None,
+                  snapshot: Optional[Callable[[], object]] = None,
+                  restore: Optional[Callable[[object], None]] = None,
+                  skipped: Optional[bool] = None) -> str:
+    """The fused K-step health epilogue (the super-step counterpart of
+    :func:`observe_step`): flight-record ONE entry for the super-step
+    (max-combined guard, last step's loss) and feed the [K, G] stacked
+    guard vectors to the monitor. WARN/SKIP stay lazy — the stack queues
+    as one device array, no extra host sync per super-step;
+    ROLLBACK/HALT materialize it and resolve at super-step granularity
+    with the offending step's index in the report. ``first_step`` = the
+    global index of the scan's first step; losses is the scan's [K] ys
+    (only its last entry is touched, lazily)."""
+    from deeplearning4j_tpu.telemetry import flightrec
+
+    if flightrec.RECORDER._enabled:
+        flightrec.RECORDER.record_step(
+            path, first_step + k - 1, epoch, score=losses[-1],
+            guard=combine(vecs), guard_keys=keys, rng_seed=rng_seed,
+            batch_fp=(flightrec.batch_fingerprint(*batch)
+                      if batch is not None else None))
+    if snapshot is None and owner is not None:
+        snapshot = getattr(owner, "_health_snapshot", None)
+    if restore is None and owner is not None:
+        restore = getattr(owner, "_health_restore", None)
+    return MONITOR.on_steps(vecs, k, keys=keys, path=path, owner=owner,
+                            snapshot=snapshot, restore=restore,
+                            skipped=skipped)
 
 
 def configure(policy: AnomalyPolicy = AnomalyPolicy.WARN,

@@ -40,10 +40,14 @@ from typing import Dict, List, Optional
 # PipelineParallelWrapper) reports this same breakdown, and
 # bench_resnet_profile.py --phases derives its row keys from these so the
 # bench and the framework cannot drift (tests/test_telemetry.py).
+# ``host_gap`` (round 11) is the time the host spends BETWEEN step
+# dispatches — the launch-latency budget the fused multi-step driver
+# amortizes over K steps; see host_gap_open/close below.
 PHASE_INGEST = "ingest"
 PHASE_COMPUTE = "compute"
 PHASE_GRAD_SYNC = "grad_sync"
-PHASES = (PHASE_INGEST, PHASE_COMPUTE, PHASE_GRAD_SYNC)
+PHASE_HOST_GAP = "host_gap"
+PHASES = (PHASE_INGEST, PHASE_COMPUTE, PHASE_GRAD_SYNC, PHASE_HOST_GAP)
 
 _enabled = False
 _sync = False
@@ -130,6 +134,73 @@ def span(name: str):
     if not _enabled:
         return NULL_SPAN
     return Span(name)
+
+
+# --------------------------------------------------------------------------
+# host-gap tracking (PHASE_HOST_GAP)
+#
+# jax dispatch is asynchronous, so a ``compute`` span measures only the
+# enqueue — the cost the device actually SEES from the host is the gap
+# between one dispatch returning and the next being issued (listener
+# epilogues, health accounting, iterator work, batch staging). The fit
+# loops bracket their dispatches with these helpers: ``host_gap_close(k)``
+# right before a dispatch records the gap since the previous dispatch
+# returned (annotated with the ``steps`` the upcoming dispatch fuses, so a
+# K-step super-step's gap amortizes over K when aggregating per step) and
+# ``host_gap_open()`` right after it re-arms the clock. State is
+# thread-local; ``host_gap_reset()`` at fit entry re-arms from "now" so
+# idle time between fits never records as a gap.
+# --------------------------------------------------------------------------
+
+def host_gap_reset() -> None:
+    """Arm the gap clock at fit entry (records nothing)."""
+    _tls.gap_open_ns = time.perf_counter_ns() if _enabled else None
+
+
+def host_gap_open() -> None:
+    """Mark a step dispatch as returned: the host gap starts now."""
+    if _enabled:
+        _tls.gap_open_ns = time.perf_counter_ns()
+
+
+def host_gap_close(steps: int = 1) -> None:
+    """About to dispatch the next step: record the elapsed host gap.
+    ``steps`` = train steps the upcoming dispatch covers (K for a fused
+    super-step) — consumers divide the gap by it for per-step cost."""
+    if not _enabled:
+        return
+    t0 = getattr(_tls, "gap_open_ns", None)
+    if t0 is None:
+        return
+    _tls.gap_open_ns = None
+    t1 = time.perf_counter_ns()
+    _ring.append((PHASE_HOST_GAP, t0, t1 - t0, 0, None,
+                  threading.get_ident(), {"steps": int(steps)}))
+
+
+def host_gap_stop() -> None:
+    """Disarm the gap clock (fit exit): idle time after a fit's last
+    dispatch must never surface as a gap when some later call — a
+    standalone ``fit_batch``, the next fit — closes the clock."""
+    _tls.gap_open_ns = None
+
+
+def host_gap_pause() -> None:
+    """An INTENTIONAL host block is starting (the fit pipeline's
+    ``drain`` parking on queued device results): stop the gap clock so
+    device-wait time is never billed as host dispatch gap."""
+    if _enabled and getattr(_tls, "gap_open_ns", None) is not None:
+        _tls.gap_pause_ns = time.perf_counter_ns()
+
+
+def host_gap_resume() -> None:
+    """The intentional block ended: shift the gap origin forward by the
+    blocked interval."""
+    t0 = getattr(_tls, "gap_pause_ns", None)
+    if t0 is not None:
+        _tls.gap_pause_ns = None
+        if _enabled and getattr(_tls, "gap_open_ns", None) is not None:
+            _tls.gap_open_ns += time.perf_counter_ns() - t0
 
 
 def enable(sync: bool = False, ring_size: int = 4096) -> None:

@@ -1,0 +1,369 @@
+"""K-step fused training driver (round 11): the lax.scan multi-step
+dispatch must be invisible to everything but the host-dispatch bill —
+K=1 and K=4 train bit-identically on the same batch stream, listeners
+and counters keep K=1 semantics, health guards keep their no-extra-sync
+property with super-step remediation granularity, the AOT cache keys K,
+and TrainingSession kill-and-resume stays bit-identical under
+``fused_steps``."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.conf import Activation, InputType
+from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.conf.losses import LossMCXENT
+from deeplearning4j_tpu.conf.multilayer import (
+    BackpropType,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.conf.updaters import Adam
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.datasets.prefetch import (
+    DeviceRingIterator,
+    StackBatchIterator,
+    stack_batch_group,
+)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize import aot_cache
+from deeplearning4j_tpu.optimize.listeners import (
+    CollectScoresListener,
+    PerformanceListener,
+)
+from deeplearning4j_tpu.telemetry import REGISTRY, flightrec, health
+
+pytestmark = pytest.mark.fused
+
+N_IN, N_OUT = 5, 3
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    """Telemetry spans / health mode / recorder are process-global."""
+    telemetry.spans.disable()
+    telemetry.reset()
+    health.disable()
+    health.MONITOR.reset()
+    flightrec.RECORDER.disable().reset()
+    REGISTRY.reset()
+    yield
+    telemetry.spans.disable()
+    telemetry.reset()
+    health.disable()
+    health.MONITOR.reset()
+    flightrec.RECORDER.disable().reset()
+    REGISTRY.reset()
+
+
+def _conf(width=16, seed=42):
+    return (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(0.01))
+            .list()
+            .layer(DenseLayer(n_out=width, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=N_OUT, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(N_IN))
+            .build())
+
+
+def _graph_conf(width=16, seed=42):
+    return (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(0.01))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("h", DenseLayer(n_out=width,
+                                       activation=Activation.TANH), "in")
+            .add_layer("out", OutputLayer(n_out=N_OUT,
+                                          activation=Activation.SOFTMAX,
+                                          loss_fn=LossMCXENT()), "h")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(N_IN))
+            .build())
+
+
+def _batches(n=8, batch=8, seed=0, poison=None):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        x = rng.normal(size=(batch, N_IN)).astype(np.float32)
+        if poison is not None and i == poison:
+            x[0, 0] = np.nan
+        y = np.eye(N_OUT, dtype=np.float32)[rng.integers(0, N_OUT, batch)]
+        out.append(DataSet(x, y))
+    return out
+
+
+def _iterator(**kw):
+    return ListDataSetIterator(_batches(**kw))
+
+
+def _leaves(net):
+    return [np.asarray(l) for l in jax.tree_util.tree_leaves(
+        (net.params, net.state, net.opt_state))]
+
+
+def _assert_bit_identical(a, b):
+    la, lb = _leaves(a), _leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(x, y)
+
+
+# ---------------------------------------------------------------------------
+# stacking iterator mechanics
+# ---------------------------------------------------------------------------
+
+def test_stack_group_uniform_ragged_and_tail():
+    it = DeviceRingIterator(_iterator(n=7), stack_batches=3)
+    items = list(it)
+    ks = [int(getattr(d, "fused_stack", 0)) for d in items]
+    assert ks == [3, 3, 0]                      # 2 stacks + ragged tail
+    assert np.shape(items[0].features) == (3, 8, N_IN)
+    assert np.shape(items[2].features) == (8, N_IN)
+
+
+def test_stack_group_nonuniform_falls_back():
+    dss = _batches(n=2) + [DataSet(np.ones((4, N_IN), np.float32),
+                                   np.ones((4, N_OUT), np.float32))]
+    assert stack_batch_group(dss) is None       # ragged batch dims
+    items = list(StackBatchIterator(ListDataSetIterator(dss), 3))
+    assert [int(getattr(d, "fused_stack", 0)) for d in items] == [0, 0, 0]
+
+
+def test_skip_staging_fast_forward_pays_no_transfers():
+    """A resuming session's replay fast-forward discards items — the
+    ring must not device-stage them (same yield positions either way)."""
+    dss = _batches(n=8)
+    it = DeviceRingIterator(ListDataSetIterator(dss), stack_batches=2)
+    it.skip_staging(2)
+    items = list(it)
+    assert len(items) == 4
+    assert it.staged_count == 2                 # only the live stacks
+    # the skipped yields are un-staged AND un-stacked placeholders
+    # (first batch's arrays by identity — no K-batch host copies)
+    assert items[0].features is dss[0].features
+    assert getattr(items[0], "fused_stack", 0) == 2
+    # the hint is one-shot: a fresh epoch stages everything again
+    it.reset()
+    assert it.staged_count == 2 and list(it) and it.staged_count == 6
+
+
+def test_stack_group_multidataset():
+    mds = [MultiDataSet(features=[d.features], labels=[d.labels])
+           for d in _batches(n=2)]
+    stacked = stack_batch_group(mds)
+    assert stacked.fused_stack == 2
+    assert np.shape(stacked.features[0]) == (2, 8, N_IN)
+
+
+# ---------------------------------------------------------------------------
+# numerics: K=1 vs K=4 bit-identical
+# ---------------------------------------------------------------------------
+
+def test_fused_k4_bit_identical_multilayer():
+    n1 = MultiLayerNetwork(_conf()).init()
+    n1.fit(_iterator(), epochs=2)
+    n4 = MultiLayerNetwork(_conf()).init()
+    n4.fit(_iterator(), epochs=2, fused_steps=4)
+    _assert_bit_identical(n1, n4)
+    assert n1.iteration == n4.iteration == 16
+    assert n1.score_value == n4.score_value
+
+
+def test_fused_k4_bit_identical_graph():
+    n1 = ComputationGraph(_graph_conf()).init()
+    n1.fit(_iterator(), epochs=2)
+    n4 = ComputationGraph(_graph_conf()).init()
+    n4.fit(_iterator(), epochs=2, fused_steps=4)
+    _assert_bit_identical(n1, n4)
+    assert n1.iteration == n4.iteration == 16
+
+
+def test_fused_wrapper_exact_spmd_bit_identical():
+    from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+    n1 = MultiLayerNetwork(_conf()).init()
+    ParallelWrapper(n1, workers=2, prefetch_buffer=0).fit(
+        _iterator(), epochs=2)
+    n4 = MultiLayerNetwork(_conf()).init()
+    ParallelWrapper(n4, workers=2, prefetch_buffer=0, fused_steps=4).fit(
+        _iterator(), epochs=2)
+    for x, y in zip(_leaves(n1), _leaves(n4)):
+        np.testing.assert_array_equal(x, y)
+    assert n1.iteration == n4.iteration == 16
+
+
+def test_fused_wrapper_mode_validation():
+    from deeplearning4j_tpu.parallel.wrapper import (
+        ParallelWrapper,
+        TrainingMode,
+    )
+
+    net = MultiLayerNetwork(_conf()).init()
+    with pytest.raises(ValueError, match="exact SHARED_GRADIENTS"):
+        ParallelWrapper(net, workers=2, fused_steps=4,
+                        training_mode=TrainingMode.AVERAGING)
+    with pytest.raises(ValueError, match="exact SHARED_GRADIENTS"):
+        ParallelWrapper(net, workers=2, fused_steps=4,
+                        gradient_bucket_mb=1.0)
+
+
+def test_fused_tbptt_refuses():
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01))
+            .list()
+            .layer(DenseLayer(n_out=4, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=N_OUT, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(N_IN))
+            .backprop_type(BackpropType.TRUNCATED_BPTT, 4, 4)
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    with pytest.raises(ValueError, match="STANDARD backprop only"):
+        net.fit(_iterator(), fused_steps=4)
+
+
+# ---------------------------------------------------------------------------
+# listeners / counters keep K=1 semantics
+# ---------------------------------------------------------------------------
+
+def test_listeners_receive_k_per_step_losses():
+    c1, c4 = CollectScoresListener(), CollectScoresListener()
+    n1 = MultiLayerNetwork(_conf()).init()
+    n1.set_listeners(c1)
+    n1.fit(_iterator(), epochs=1)
+    n4 = MultiLayerNetwork(_conf()).init()
+    n4.set_listeners(c4)
+    n4.fit(_iterator(), epochs=1, fused_steps=4)
+    assert c4.iterations == c1.iterations == list(range(8))
+    np.testing.assert_array_equal(c4.scores, c1.scores)
+
+
+def test_performance_listener_counts_match_k1(capsys):
+    """K steps arrive per host callback: iteration counts and the
+    examples/sec basis (per-STEP batch size, not K*B) must match K=1."""
+    perf = PerformanceListener(frequency=4)
+    net = MultiLayerNetwork(_conf()).init()
+    net.set_listeners(perf)
+    telemetry.enable()
+    net.fit(_iterator(n=8, batch=8), epochs=1, fused_steps=4)
+    telemetry.disable()
+    assert net.last_batch_size == 8             # per-step rows, not K*B
+    assert net.iteration == 8
+    assert perf.last_batches_per_sec is not None
+    assert perf.last_examples_per_sec == pytest.approx(
+        perf.last_batches_per_sec * 8)
+    snap = REGISTRY.snapshot(run_collectors=False)
+    assert snap['dl4j_training_steps_total{path="multilayer"}'] == 8.0
+    assert snap['dl4j_training_examples_total{path="multilayer"}'] == 64.0
+
+
+def test_host_gap_spans_recorded_with_step_weights():
+    telemetry.enable()
+    net = MultiLayerNetwork(_conf()).init()
+    net.fit(_iterator(), epochs=1, fused_steps=4)
+    telemetry.disable()
+    gaps = [e for e in telemetry.events()
+            if e["name"] == telemetry.PHASE_HOST_GAP]
+    assert len(gaps) == 2                       # one per super-step
+    assert all(e["attrs"]["steps"] == 4 for e in gaps)
+    assert telemetry.PHASE_HOST_GAP in telemetry.PHASES
+
+
+# ---------------------------------------------------------------------------
+# AOT cache: K joins the key, refits never recompile
+# ---------------------------------------------------------------------------
+
+def test_fused_zero_recompiles_across_refits():
+    # unique width: the AOT cache is process-global and conf-keyed
+    net = MultiLayerNetwork(_conf(width=23)).init()
+    net.fit(_iterator(), epochs=1, fused_steps=4)
+    st0 = aot_cache.stats()
+    net.fit(_iterator(), epochs=1, fused_steps=4)
+    st1 = aot_cache.stats()
+    assert st1["misses"] == st0["misses"]       # zero recompiles on refit
+    assert st1["hits"] > st0["hits"]
+
+
+def test_fused_k_joins_cache_key():
+    net = MultiLayerNetwork(_conf(width=29)).init()
+    net.fit(_iterator(), epochs=1, fused_steps=4)
+    e0 = aot_cache.stats()["entries"]
+    net2 = MultiLayerNetwork(_conf(width=29)).init()
+    net2.fit(_iterator(), epochs=1, fused_steps=2)
+    # a different K compiles its own executable even though the graph
+    # key (same conf) and the per-step math are identical
+    assert aot_cache.stats()["entries"] > e0
+
+
+# ---------------------------------------------------------------------------
+# health guards: in-scan, super-step granularity
+# ---------------------------------------------------------------------------
+
+def test_fused_skip_step_bit_identical_to_k1_and_counts():
+    health.configure(policy=health.AnomalyPolicy.SKIP_STEP,
+                     record_flights=False)
+    n1 = MultiLayerNetwork(_conf()).init()
+    n1.fit(ListDataSetIterator(_batches(poison=5)), epochs=1)
+    r1 = health.report()
+    health.configure(policy=health.AnomalyPolicy.SKIP_STEP,
+                     record_flights=False)
+    n4 = MultiLayerNetwork(_conf()).init()
+    n4.fit(ListDataSetIterator(_batches(poison=5)), epochs=1,
+           fused_steps=4)
+    r4 = health.report()
+    _assert_bit_identical(n1, n4)               # in-graph skip per step
+    assert r1["nonfinite_steps"] == r4["nonfinite_steps"] == 1
+    assert r1["skipped_steps"] == r4["skipped_steps"] == 1
+    assert r4["last_anomaly_step"] == r1["last_anomaly_step"] == 6
+
+
+def test_fused_halt_surfaces_offending_step_index():
+    health.configure(policy=health.AnomalyPolicy.HALT,
+                     record_flights=False)
+    net = MultiLayerNetwork(_conf()).init()
+    with pytest.raises(health.DivergenceError) as exc:
+        net.fit(ListDataSetIterator(_batches(poison=5)), epochs=1,
+                fused_steps=4)
+    # batch 5 (0-based) = monitor step 6 = row 2/4 of super-step 2
+    assert exc.value.step == 6
+    assert "2/4 of the fused super-step" in str(exc.value)
+
+
+def test_fused_rollback_restores_at_superstep_granularity():
+    health.configure(policy=health.AnomalyPolicy.ROLLBACK,
+                     snapshot_every=1, record_flights=False)
+    net = MultiLayerNetwork(_conf()).init()
+    net.fit(ListDataSetIterator(_batches(poison=5)), epochs=1,
+            fused_steps=4)
+    rep = health.report()
+    assert rep["rollbacks"] == 1
+    # the restore rolled the whole poisoned super-step back to the
+    # last-good boundary; training continued and params are finite
+    assert all(np.isfinite(l).all() for l in _leaves(net))
+
+
+# ---------------------------------------------------------------------------
+# resilience: kill-and-resume bit-identical under fused_steps
+# ---------------------------------------------------------------------------
+
+def test_session_kill_mid_run_resumes_bit_identical(tmp_path):
+    from deeplearning4j_tpu.resilience import TrainingSession
+    from deeplearning4j_tpu.resilience.faults import FaultPlan
+
+    ref = MultiLayerNetwork(_conf()).init()
+    ref.fit(_iterator(), epochs=2, fused_steps=4)
+
+    sess = TrainingSession(MultiLayerNetwork(_conf()).init(),
+                           str(tmp_path), snapshot_every_n_iterations=4)
+    plan = FaultPlan(seed=1).inject("train.step", on_calls=[3])
+    with plan.armed():
+        sess.fit(_iterator(), epochs=2, fused_steps=4)
+    assert plan.fired("train.step") == 1
+    assert sess.model.epoch == 2
+    _assert_bit_identical(ref, sess.model)
+    # snapshots land on K-aligned boundaries only
+    assert all(s["iteration"] % 4 == 0 for s in sess.snapshots())

@@ -353,8 +353,8 @@ def test_bench_phase_keys_match_telemetry_phases():
     # the bench imports telemetry.PHASES and derives its --phases row
     # keys from them, so both sides report the same phase vocabulary
     assert set(brp.PHASE_ROWS) == set(tel.PHASES)
-    assert (brp.PHASE_INGEST, brp.PHASE_COMPUTE,
-            brp.PHASE_GRAD_SYNC) == tel.PHASES
+    assert (brp.PHASE_INGEST, brp.PHASE_COMPUTE, brp.PHASE_GRAD_SYNC,
+            brp.PHASE_HOST_GAP) == tel.PHASES
     for phase, keys in brp.PHASE_ROWS.items():
         assert keys, f"phase {phase} has no bench rows"
         if phase != tel.PHASE_COMPUTE:  # compute rows are the step probes
