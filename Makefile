@@ -45,6 +45,16 @@ fused-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests -q -m fused \
 		-p no:cacheprovider
 
+.PHONY: shard-smoke
+# Sharding smoke: rule-table resolution, ZeRO-vs-all-reduce bit
+# identity on the simulated 8-device mesh, save-on-mesh-A /
+# restore-on-mesh-B, collective-counter parity. CPU-pinned with the
+# same virtual-device flag as tier-1.
+shard-smoke:
+	JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	$(PY) -m pytest tests -q -m sharding -p no:cacheprovider
+
 .PHONY: bench-serving
 # Closed-loop 8-client serving benchmark: locked single-request baseline
 # vs the dynamic micro-batching engine (acceptance bar: >= 4x).

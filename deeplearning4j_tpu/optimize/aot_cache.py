@@ -108,11 +108,31 @@ def clear():
     STATS.reset()
 
 
+_NAMED_SHARDING = None  # lazy: keep this module importable without jax
+
+
 def _leaf_sig(x):
     # jax Arrays cache their aval — ~0.1us vs ~6us for .shape/.dtype
     # property chains; this function runs per leaf per step
     a = getattr(x, "aval", None)
     if a is not None:
+        global _NAMED_SHARDING
+        if _NAMED_SHARDING is None:
+            from jax.sharding import NamedSharding
+
+            _NAMED_SHARDING = NamedSharding
+        # sharding-aware signature (sharding subsystem): a leaf committed
+        # to a mesh with a NON-TRIVIAL PartitionSpec keys its spec, so a
+        # ZeRO-scattered opt tree, a TP-split param and their replicated
+        # twins can never alias one executable (identical avals,
+        # different layouts). Replicated/single-device leaves — the
+        # single-model hot path — stay (shape, dtype) at one isinstance
+        # check of extra cost.
+        sh = getattr(x, "sharding", None)
+        if type(sh) is _NAMED_SHARDING:
+            spec = sh.spec
+            if any(e is not None for e in spec):
+                return (a.shape, a.dtype, str(spec))
         return (a.shape, a.dtype)
     if isinstance(x, np.ndarray) or hasattr(x, "dtype"):
         return (np.shape(x), np.asarray(x).dtype if not hasattr(x, "dtype")
@@ -126,9 +146,11 @@ def signature_of(args):
     (shape, dtype) + the argument treedef (which encodes structure,
     including None-vs-array optional args). Built from cached avals —
     this runs on the per-step dispatch path, so it must stay ~0.1us per
-    leaf. Shardings are NOT keyed: the wrapped entry points are the
-    single-device model steps (mesh-parallel wrappers keep their own
-    jits), and a sharding/layout mismatch at call time falls back to the
+    leaf. Mesh-committed leaves with a non-trivial ``PartitionSpec``
+    additionally key the spec (see ``_leaf_sig``) — sharded wrapper
+    steps (ZeRO, partition-rule plans) cache through here, and two
+    placements of the same avals must compile separately. Exotic
+    layout mismatches outside the signature still fall back to the
     plain jit (see AotStep.__call__)."""
     import jax
 

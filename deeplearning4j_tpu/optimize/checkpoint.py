@@ -25,8 +25,20 @@ def snapshot_training_state(model) -> dict:
     params + layer state + updater state + counters. The copies are
     numpy (``np.asarray`` syncs on the device values), so a later donated
     step can never invalidate the snapshot — this is what the health
-    layer's ROLLBACK policy restores from."""
+    layer's ROLLBACK policy restores from.
+
+    Sharding-aware: while a parallel wrapper owns the live training
+    trees (ZeRO-scattered opt state, TP-sharded params), they are
+    gathered back onto the model first through the ``_live_trainer``
+    hook — the snapshot is always full host arrays, restorable onto any
+    mesh (wrapper-level rollback uses the wrapper's own device-copy
+    hooks instead; this path serves model-level callers)."""
     import jax
+
+    live = getattr(model, "_live_trainer", None)
+    trainer = live() if live is not None else None
+    if trainer is not None:
+        trainer.sync_model()
 
     host = lambda t: jax.tree_util.tree_map(  # noqa: E731
         lambda x: np.asarray(x), t)

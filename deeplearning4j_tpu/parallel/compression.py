@@ -160,6 +160,82 @@ def bucket_layout(tree, bucket_bytes=None):
             for bucket in bucket_partition(sizes, int(bucket_bytes))]
 
 
+def bucketed_psum_scatter(tree, axis_name, bucket_bytes=None):
+    """Reduce-scatter a pytree of FLAT, shard-count-padded vectors over
+    ``axis_name`` in the SAME size-targeted reverse-topological buckets
+    as :func:`bucketed_psum` (the ZeRO exchange's first half: every
+    shard receives only its 1/n slice of each leaf's cross-shard sum).
+
+    Leaves must be 1-D with length divisible by the axis size (the
+    ``sharding.zero.ZeroSpec`` flatten/pad contract). Bit-compatible
+    with ``psum`` + slice: XLA's reduce-scatter performs the identical
+    per-element reduction, it just leaves each element on one shard —
+    pinned by test_sharding's bit-identity suite."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return tree
+
+    def scatter(vals):
+        return jax.lax.psum_scatter(vals, axis_name, scatter_dimension=0,
+                                    tiled=True)
+
+    if bucket_bytes is None or len(leaves) <= 1:
+        return jax.tree_util.tree_unflatten(treedef,
+                                            list(scatter(tuple(leaves))))
+    sizes = [l.size * l.dtype.itemsize for l in leaves]
+    out = [None] * len(leaves)
+    pin = None
+    for bucket in bucket_partition(sizes, int(bucket_bytes)):
+        vals = tuple(leaves[i] for i in bucket)
+        if pin is not None:
+            pinned = jax.lax.optimization_barrier(vals + (pin,))
+            vals = tuple(pinned[:-1])
+        red = scatter(vals)
+        pin = red[0]
+        for i, r in zip(bucket, red):
+            out[i] = r
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def bucketed_all_gather(tree, axis_name, index, full_sizes,
+                        bucket_bytes=None):
+    """All-gather a pytree of per-shard 1-D slices back into full flat
+    vectors (the ZeRO exchange's second half), bucketed on the SAME
+    layout as :func:`bucketed_psum`.
+
+    Implemented as a psum of position-masked contributions — each shard
+    deposits its slice at ``[index*m, (index+1)*m)`` of a zeros vector
+    and the cross-shard sum reassembles the full array. Adding zeros is
+    exact in floating point, so the result is bitwise the concatenation
+    of the shards' slices, and (unlike raw ``lax.all_gather``) the
+    replication of the output is statically known to pre-vma jax's
+    shard_map checker.
+
+    COST CAVEAT: a masked psum moves all-reduce bandwidth (~2x a native
+    ring all-gather's (n-1)/n payload) — the deliberate price of an
+    implementation that is bitwise-exact AND expressible on this
+    container's check_rep jax. Swapping in ``lax.all_gather`` where the
+    vma type system can express the output's replication belongs to the
+    collective scheduler (ROADMAP item 3); the telemetry counters record
+    the LOGICAL gathered payload either way. ``full_sizes``: per-leaf
+    gathered lengths (``n_shards * slice_len``), in tree-leaf order."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return tree
+    contribs = []
+    for sl, full in zip(leaves, full_sizes):
+        m = sl.shape[0]
+        contribs.append(jax.lax.dynamic_update_slice(
+            jnp.zeros((int(full),), sl.dtype), sl, (index * m,)))
+    return bucketed_psum(jax.tree_util.tree_unflatten(treedef, contribs),
+                         axis_name, bucket_bytes)
+
+
 def bucketed_psum(tree, axis_name, bucket_bytes=None):
     """``lax.psum`` a pytree over ``axis_name`` in size-targeted buckets.
 

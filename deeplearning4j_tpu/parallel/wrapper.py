@@ -51,6 +51,7 @@ from deeplearning4j_tpu.parallel.compression import (
     ThresholdAlgorithm,
     bucket_layout,
     bucketed_psum,
+    bucketed_psum_scatter,
     encode_tree,
 )
 
@@ -121,7 +122,9 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                  prefetch_buffer: int = 2,
                  mesh=None, expert_parallel: bool = False,
                  gradient_bucket_mb: Optional[float] = None,
-                 fused_steps: Optional[int] = None):
+                 fused_steps: Optional[int] = None,
+                 zero_optimizer: bool = False,
+                 partition_rules=None):
         from deeplearning4j_tpu.nn.graph import ComputationGraph
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
@@ -202,6 +205,64 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                 "gradient_bucket_mb composes with the standard "
                 "SHARED_GRADIENTS / AVERAGING steps only (no "
                 "expert_parallel, no tBPTT yet)")
+        # ZeRO-style optimizer-state sharding (sharding/zero.py): the
+        # SHARED_GRADIENTS exchange becomes reduce-scatter(grads) ->
+        # local 1/n optimizer update -> all-gather(params), so each
+        # device holds 1/workers of every moment buffer. Numerically
+        # identical to the all-reduce path (elementwise updaters on a
+        # flat partition; XLA's reduce-scatter performs the same
+        # per-element reduction as its all-reduce — pinned by tests).
+        # gradient_bucket_mb composes: it sets the reduce-scatter /
+        # all-gather bucket layout exactly as it does for bucketed_psum.
+        self._zero = bool(zero_optimizer)
+        if self._zero and (training_mode is not TrainingMode.SHARED_GRADIENTS
+                           or threshold_algorithm is not None
+                           or self.expert_parallel or self._tbptt):
+            raise ValueError(
+                "zero_optimizer composes with the exact SHARED_GRADIENTS "
+                "path only (no threshold compression, no expert_parallel, "
+                "no tBPTT, no AVERAGING)")
+        if self._zero and jax.process_count() > 1:
+            raise ValueError(
+                "zero_optimizer is single-process for now (the host-side "
+                "scatter/gather of optimizer shards cannot address other "
+                "hosts' slices)")
+        # declarative DP x TP placement (sharding/plan.py): a regex rule
+        # table (or prebuilt ShardingPlan) places params/opt-state over
+        # the mesh's data x model axes; the exact SPMD step runs under
+        # those shardings (XLA partitions the matmuls and inserts the
+        # collectives) and its executable is AOT-cached under the plan's
+        # sharding tag.
+        if partition_rules is None:
+            self._plan = None
+        else:
+            from deeplearning4j_tpu.sharding import ShardingPlan
+
+            self._plan = (partition_rules
+                          if isinstance(partition_rules, ShardingPlan)
+                          else ShardingPlan(partition_rules,
+                                            mesh=self.mesh))
+            if self._plan.mesh is not self.mesh:
+                raise ValueError(
+                    "partition_rules plan must be built on the wrapper's "
+                    "mesh (pass mesh=plan.mesh or let the wrapper build "
+                    "the plan from a rule table)")
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "partition_rules is single-process for now (the "
+                    "write-back gather of TP-sharded params cannot "
+                    "address other hosts' shards)")
+            if (training_mode is not TrainingMode.SHARED_GRADIENTS
+                    or threshold_algorithm is not None
+                    or self.expert_parallel or self._tbptt or self._zero
+                    or self._explicit_exchange):
+                raise ValueError(
+                    "partition_rules composes with the exact "
+                    "SHARED_GRADIENTS SPMD path only (no threshold "
+                    "compression, no expert_parallel, no tBPTT, no "
+                    "AVERAGING, no gradient_bucket_mb — XLA owns the "
+                    "collective schedule under GSPMD — and no "
+                    "zero_optimizer yet)")
         # K-step fused dispatch (round 11): the model's fused_scan_fn
         # jitted over the mesh with the per-step batch axis sharded —
         # exact SPMD mode only (the other modes' per-step host feedback
@@ -211,12 +272,12 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             if (training_mode is not TrainingMode.SHARED_GRADIENTS
                     or threshold_algorithm is not None
                     or self.expert_parallel or self._explicit_exchange
-                    or self._tbptt):
+                    or self._tbptt or self._zero or self._plan is not None):
                 raise ValueError(
                     "fused_steps composes with the exact SHARED_GRADIENTS "
                     "SPMD path only (no threshold compression, no "
                     "gradient_bucket_mb, no expert_parallel, no tBPTT, "
-                    "no AVERAGING)")
+                    "no AVERAGING, no zero_optimizer/partition_rules)")
             if jax.process_count() > 1:
                 raise ValueError(
                     "fused_steps is single-process for now (the "
@@ -233,6 +294,11 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         self._mp_target = None
         self._fused_step = None
         self._fused_step_k = None
+        # True while the staged device trees and the model's host arrays
+        # agree — _write_back (the gather) is skipped when clean, so the
+        # stacked gather-on-save hooks (session snapshot -> write_model
+        # -> snapshot_training_state) cost ONE device_get, not three
+        self._synced = False
 
     # --- model-type adapters -----------------------------------------------
     def _prep(self, ds):
@@ -306,6 +372,56 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             self._state = self._replicated(m.state)
             # the step is built on first batch (its arity depends on the
             # model type's batch tuple)
+        elif self._zero:
+            from deeplearning4j_tpu.sharding.zero import ZeroSpec
+
+            self._params = self._replicated(m.params)
+            self._state = self._replicated(m.state)
+            # optimizer state lives SCATTERED: flat 1/workers slices,
+            # each shard's slice resident on its devices only — the
+            # ZeRO memory footprint
+            self._zero_pspec = ZeroSpec(m.params, self.workers)
+            self._zero_ospec = ZeroSpec(m.opt_state, self.workers)
+            self._opt = self._zero_ospec.scatter_host(m.opt_state,
+                                                      self.mesh, DATA)
+            if self._step is None:
+                self._step = self._build_zero_step()
+            telemetry.record_shard_bytes(
+                self._zero_pspec.total_bytes(),
+                self._zero_ospec.bytes_per_device(), self.mesh)
+        elif self._plan is not None:
+            from deeplearning4j_tpu.optimize import aot_cache
+
+            plan = self._plan
+            pspecs = plan.param_specs(m.params)
+            ospecs = plan.opt_specs(m.params, m.opt_state)
+            self._params = plan.place(m.params, pspecs)
+            self._state = self._replicated(m.state)
+            self._opt = plan.place(m.opt_state, ospecs)
+            if self._step is None:
+                raw = m.train_step_fn(guards=mode)
+
+                def plan_step(params, state, opt, *rest):
+                    *batch, itc, ep, base_key = rest
+                    it, rng = nn_io.step_scalars(itc, base_key)
+                    return raw(params, state, opt, *batch, it, ep, rng)
+
+                rep = mesh_mod.replicated_spec(self.mesh)
+                out_sh = (plan.shardings(pspecs),
+                          _tree_map(lambda _: rep, m.state),
+                          plan.shardings(ospecs), rep)
+                if mode:
+                    out_sh = out_sh + (rep,)
+                jit_fn = jax.jit(plan_step, donate_argnums=(0, 1, 2),
+                                 out_shardings=out_sh)
+                # the plan's sharding tag keys the executable: two plans
+                # over the same graph never share a compiled program,
+                # and a re-instantiated wrapper on the same plan hits
+                self._step = aot_cache.wrap(
+                    jit_fn, m._graph_key(),
+                    f"pw_rules:{plan.cache_tag()}"
+                    f"{health.cache_tag()}")
+            plan.publish_metrics(m.params, m.opt_state)
         else:
             self._params = self._replicated(m.params)
             self._state = self._replicated(m.state)
@@ -337,6 +453,8 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
 
                     self._step = jax.jit(exact_step,
                                          donate_argnums=(0, 1, 2))
+        # freshly staged from the model: trees and host arrays agree
+        self._synced = True
 
     # --- expert-parallel (GShard: experts ride the data axis) --------------
     def _layer_confs(self):
@@ -642,6 +760,162 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             out_specs=out_specs)
         return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
+    def _build_zero_step(self):
+        """ZeRO-1 data parallelism as an explicit shard_map exchange:
+        the per-shard backward runs locally, gradients REDUCE-SCATTER so
+        each shard receives only its 1/n flat slice of the cross-shard
+        sum (``compression.bucketed_psum_scatter``, same reverse-
+        topological bucket layout as ``bucketed_psum``), the updater +
+        regularization run on the local slice of params/moments (they
+        are elementwise, so the slice update equals the all-reduce
+        path's update bitwise), and the new params ALL-GATHER back to
+        replicated (``bucketed_all_gather``). Only the optimizer state
+        stays scattered — the 1/n-per-device memory footprint that lets
+        a model train when moments for the whole net don't fit one chip.
+
+        Norm-based GradientNormalization needs full-tensor norms; those
+        come from one extra psum of per-leaf squared sums (exact math,
+        but the reduction ORDER differs from the dense path, so bit-
+        identity holds for elementwise/no normalization — the default —
+        and allclose otherwise)."""
+        from deeplearning4j_tpu.conf.layers import GradientNormalization
+        from deeplearning4j_tpu.optimize import aot_cache, solver
+        from deeplearning4j_tpu.telemetry import health
+
+        m = self.model
+        gfn = m.grad_fn()
+        bucket = self.gradient_bucket_bytes
+        mode = health.graph_mode()
+        pz = self._zero_pspec
+        confs = dict(self._layer_confs())
+        layer_keys = sorted(m.params)          # jax dict-flatten order
+        gn_layers = {
+            k for k in layer_keys
+            if getattr(confs.get(k), "gradient_normalization", None)
+            not in (None, GradientNormalization.NONE)}
+
+        def norm_slices(k, gdict, sq):
+            """solver.normalize_layer_gradients on flat slices, per-
+            tensor/per-layer norms supplied from the psum'd squared
+            sums ``sq`` ({param_key: full-tensor sq sum})."""
+            conf = confs[k]
+            gn = conf.gradient_normalization
+            thr = getattr(conf, "gradient_normalization_threshold", 1.0)
+            if gn is GradientNormalization.CLIP_ELEMENTWISE_ABSOLUTE_VALUE:
+                return {pk: jnp.clip(g, -thr, thr)
+                        for pk, g in gdict.items()}
+            if gn is GradientNormalization.RENORMALIZE_L2_PER_PARAM_TYPE:
+                return {pk: g / (jnp.sqrt(sq[pk]) + 1e-12)
+                        for pk, g in gdict.items()}
+            lnorm = jnp.sqrt(sum(sq.values()) + 1e-24)
+            if gn is GradientNormalization.RENORMALIZE_L2_PER_LAYER:
+                return {pk: g / lnorm for pk, g in gdict.items()}
+            if gn is GradientNormalization.CLIP_L2_PER_LAYER:
+                scale = jnp.minimum(1.0, thr / lnorm)
+                return {pk: g * scale for pk, g in gdict.items()}
+            if gn is GradientNormalization.CLIP_L2_PER_PARAM_TYPE:
+                return {pk: g * jnp.minimum(
+                    1.0, thr / (jnp.sqrt(sq[pk]) + 1e-12))
+                    for pk, g in gdict.items()}
+            raise ValueError(f"unhandled GradientNormalization {gn}")
+
+        def sq_sums(tree_slices, keys):
+            """psum'd full-tensor squared sums of the scattered shared
+            gradient, one scalar per (layer, param) pair in ``keys`` —
+            slices partition the tensor, so the cross-shard sum of
+            slice squares IS the full tensor's squared sum."""
+            f32 = jnp.float32
+            loc = jnp.stack([
+                jnp.sum(tree_slices[k][pk].astype(f32) ** 2)
+                for k, pk in keys]) if keys else jnp.zeros((0,), f32)
+            return jax.lax.psum(loc, DATA)
+
+        def step(params, state, opt_slices, batch, itc, ep, base_key,
+                 cvec):
+            it, rng = nn_io.step_scalars(itc, base_key)
+            idx = jax.lax.axis_index(DATA)
+            rng = jax.random.fold_in(rng, idx)
+            loss, new_state, grads = gfn(params, state, *batch, rng)
+            # ragged-batch reweight: identical to the bucketed exact step
+            c = cvec[0]
+            ctot = jnp.maximum(jax.lax.psum(c, DATA), 1.0)
+            w = c / ctot
+            grads = _tree_map(lambda g: g * w, grads)
+            # the ZeRO first half: every shard receives its slice of the
+            # summed gradient — 1/n of the all-reduce payload
+            gslices = bucketed_psum_scatter(pz.flat_padded(grads), DATA,
+                                            bucket)
+            pslices = pz.local_slices(params, idx)
+            gn_keys = [(k, pk) for k in layer_keys if k in gn_layers
+                       for pk in sorted(m.params[k])]
+            gn_map = {}
+            if gn_keys:
+                gn_sq = sq_sums(gslices, gn_keys)
+                gn_map = {kp: gn_sq[i] for i, kp in enumerate(gn_keys)}
+            new_p_slices, new_o_slices = {}, {}
+            for k in layer_keys:
+                layer = confs[k]
+                upd = m._updater_for(k if self._is_graph else int(k))
+                lr = upd.current_lr(it, ep)
+                g_k = gslices[k]
+                if k in gn_layers:
+                    g_k = norm_slices(
+                        k, g_k, {pk: gn_map[(k, pk)] for pk in g_k})
+                # regularization + updater are elementwise: the slice
+                # update equals the corresponding elements of the dense
+                # path's update exactly
+                new_p_slices[k], new_o_slices[k] = \
+                    solver.apply_updater_to_layer(
+                        layer, upd, pslices[k], g_k, opt_slices[k], lr,
+                        it, ep)
+            # the ZeRO second half: updated param slices all-gather back
+            # to the replicated tree the next forward consumes
+            new_params = pz.assemble(new_p_slices, idx, DATA, bucket)
+            loss = jax.lax.psum(loss * c, DATA) / ctot
+            new_state = _tree_map(
+                lambda s: (jax.lax.psum(s * w, DATA)
+                           if jnp.issubdtype(s.dtype, jnp.floating) else s),
+                new_state)
+            if mode:
+                # guard on the SHARED gradient, reconstructed from the
+                # scattered slices' psum'd squared sums — same vector
+                # layout/semantics as the dense paths
+                keys = health.bucket_keys(m.params)
+                bsq = sq_sums(gslices,
+                              [(k, pk) for k in keys
+                               for pk in sorted(m.params.get(k, {}))])
+                off, bucket_sq = 0, []
+                for k in keys:
+                    n_k = len(m.params.get(k, {}))
+                    bucket_sq.append(jnp.sum(bsq[off:off + n_k]))
+                    off += n_k
+                vec = health.guard_vector_from_sq(
+                    loss, bucket_sq, params=params, new_params=new_params)
+                if mode == "skip":
+                    (new_params, new_state,
+                     new_o_slices) = health.apply_skip(
+                        vec, (new_params, new_state, new_o_slices),
+                        (params, state, opt_slices))
+                return new_params, new_state, new_o_slices, loss, vec
+            return new_params, new_state, new_o_slices, loss
+
+        opt_spec = _tree_map(lambda _: P(DATA), self._opt)
+        out_specs = ((P(), P(), opt_spec, P(), P()) if mode
+                     else (P(), P(), opt_spec, P()))
+        sharded = shard_map(
+            step, self.mesh,
+            in_specs=(P(), P(), opt_spec, P(DATA), P(), P(), P(),
+                      P(DATA)),
+            out_specs=out_specs)
+        jit_fn = jax.jit(sharded, donate_argnums=(0, 1, 2))
+        # sharding-keyed AOT entry: the scattered layout (worker count +
+        # bucket layout) is part of the key, so ZeRO and all-reduce
+        # executables for the same graph never collide and a fresh
+        # wrapper on the same mesh reuses the compiled program
+        return aot_cache.wrap(
+            jit_fn, m._graph_key(),
+            f"pw_zero:n{self.workers}:b{bucket or 0}{health.cache_tag()}")
+
     def _build_averaging_step(self):
         from deeplearning4j_tpu.telemetry import health
 
@@ -800,6 +1074,13 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         from deeplearning4j_tpu.telemetry import flightrec
 
         self._setup()
+        # gather-on-save hook: while this wrapper owns the live training
+        # trees, any write_model on the wrapped model (CheckpointListener,
+        # TrainingSession snapshots) first gathers them back — a
+        # checkpoint is never a stale or shard-local view
+        import weakref
+
+        m._live_trainer = weakref.ref(self)
         # each fit() may use a different batch size; the multi-host shape
         # lock applies within one fit only
         self._mp_target = None
@@ -818,6 +1099,11 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         finally:
             telemetry.host_gap_stop()
             self._write_back()
+            # disarm the gather-on-save hook: outside fit the model's
+            # host arrays are authoritative again (a later solo
+            # model.fit() must not be clobbered by these device trees
+            # at the next write_model)
+            m._live_trainer = None
         return m
 
     # --- health-layer rollback hooks ---------------------------------------
@@ -847,6 +1133,7 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             self._tau = snap["tau"]
         self.model.iteration = snap["iteration"]
         self.model.epoch = snap["epoch"]
+        self._synced = False  # rolled-back trees differ from host arrays
         # both score mirrors point at the rolled-back step's loss — drop
         # them (matches checkpoint.restore_training_state for networks)
         self._score_dev = None
@@ -872,10 +1159,43 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                 telemetry.record_collective("average", sum(layout),
                                             len(layout))
             return
+        if self._zero:
+            # ZeRO's two collectives per step — gradient reduce-scatter
+            # and param all-gather — on bucketed_psum's bucket layout
+            # over the flat-padded tree. Counters record the LOGICAL
+            # per-shard payload of each (the gather is currently a
+            # masked psum costing all-reduce bandwidth on the wire —
+            # see compression.bucketed_all_gather's cost caveat). Same
+            # counter series as every other exchange (dl4j_collective_
+            # bytes/ops + the bucket-layout histogram), new op labels —
+            # pinned by test_sharding.
+            layout = getattr(self, "_zero_layout", None)
+            if layout is None:
+                layout = self._zero_layout = self._zero_pspec.layout_bytes(
+                    self.gradient_bucket_bytes)
+                telemetry.record_bucket_layout("grad_reduce_scatter",
+                                               layout)
+                telemetry.record_bucket_layout("param_all_gather", layout)
+            for op in ("grad_reduce_scatter", "param_all_gather"):
+                telemetry.record_collective(op, sum(layout) * steps,
+                                            len(layout) * steps)
+            return
         layout = getattr(self, "_grad_layout", None)
         if layout is None:
-            layout = self._grad_layout = bucket_layout(
-                m.params, self.gradient_bucket_bytes)
+            if self._plan is not None:
+                # DP x TP: gradients of model-sharded leaves cross the
+                # data axis as 1/t shards — count the PER-SHARD payload
+                # the all-reduce actually moves, not the dense tree
+                # (XLA-inserted activation collectives are not counted)
+                from deeplearning4j_tpu.sharding import rules as _rules
+
+                layout = [_rules.bytes_per_device(
+                    m.params, self._plan.param_specs(m.params),
+                    self.mesh)]
+            else:
+                layout = bucket_layout(m.params,
+                                       self.gradient_bucket_bytes)
+            self._grad_layout = layout
             op = ("threshold_psum" if self.threshold_algorithm is not None
                   else "grad_psum")
             telemetry.record_bucket_layout(op, layout)
@@ -964,7 +1284,7 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                 else:
                     self._tau = float(self.threshold_algorithm.update(
                         self._tau, float(feedback)))
-            elif self._explicit_exchange:
+            elif self._explicit_exchange or self._zero:
                 out = self._step(
                     self._params, self._state, self._opt, batch, itc, ep,
                     m._base_key, cvec)
@@ -1009,6 +1329,7 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         self._score_cache = None
         m._score_dev = loss
         m._score_cache = None
+        self._synced = False  # device trees moved past the host arrays
         m.iteration += inc  # listeners see iteration == next-to-run
         if mode:
             keys = (health.bucket_keys(m.params)
@@ -1094,6 +1415,7 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         self._score_cache = None
         m._score_dev = loss
         m._score_cache = None
+        self._synced = False  # device trees moved past the host arrays
         cur = m.iteration
         m.iteration += k
         if mode:
@@ -1108,11 +1430,26 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                     lst.iteration_done(m, cur + j, m.epoch, loss_j)
         return loss
 
+    def sync_model(self):
+        """Gather the live device training trees back onto the wrapped
+        model WITHOUT ending training — the gather-on-save hook
+        ``serializer.write_model`` calls through ``model._live_trainer``
+        so a checkpoint taken mid-``fit`` serializes the CURRENT
+        (possibly ZeRO-scattered or TP-sharded) state as plain full host
+        arrays, restorable onto any mesh. No-op before the first
+        ``fit`` stages anything."""
+        self._write_back()
+        return self.model
+
     def _write_back(self):
         """Publish trained params back onto the wrapped model (reference:
-        fit() ends with params <- averaged replicas / shared replica 0)."""
-        if self._params is None:
+        fit() ends with params <- averaged replicas / shared replica 0).
+        Sharded trees (ZeRO opt slices, partition-rule placements)
+        gather to full host arrays here — checkpoints are always
+        mesh-shape-agnostic."""
+        if self._params is None or self._synced:
             return
+        self._synced = True
         m = self.model
         if self.training_mode is TrainingMode.AVERAGING:
             m.params = jax.device_get(self._collect(self._params))
@@ -1121,7 +1458,12 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         else:
             m.params = jax.device_get(self._params)
             m.state = jax.device_get(self._state)
-            m.opt_state = jax.device_get(self._opt)
+            if self._zero:
+                # scattered flat slices -> original shapes (np.asarray
+                # inside gather_host pulls every shard's slice)
+                m.opt_state = self._zero_ospec.gather_host(self._opt)
+            else:
+                m.opt_state = jax.device_get(self._opt)
         m.params = _tree_map(jnp.asarray, m.params)
         m.state = _tree_map(jnp.asarray, m.state)
         m.opt_state = _tree_map(jnp.asarray, m.opt_state)

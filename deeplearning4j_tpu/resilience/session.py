@@ -91,6 +91,21 @@ class TrainingSession:
         self.model = model
         self.directory = str(directory)
         os.makedirs(self.directory, exist_ok=True)
+        t = self._trainer()
+        if t is not None:
+            from deeplearning4j_tpu.parallel.wrapper import TrainingMode
+
+            if (t.training_mode is not TrainingMode.SHARED_GRADIENTS
+                    or t.threshold_algorithm is not None):
+                # model-level snapshots capture params/state/opt only:
+                # AVERAGING's per-replica divergence and the threshold
+                # accumulator's residual/tau would silently reset on
+                # resume, breaking the bit-identical guarantee
+                raise ValueError(
+                    "TrainingSession drives exact SHARED_GRADIENTS "
+                    "wrappers only (AVERAGING replica state and "
+                    "threshold-compression residuals are not captured "
+                    "by model-level snapshots)")
         self.every_iters = int(snapshot_every_n_iterations)
         self.keep_last = max(2, int(keep_last))
         self.retry = retry or CHECKPOINT_RETRY
@@ -101,6 +116,28 @@ class TrainingSession:
         self._mem = None        # in-memory last-good (fallback of last resort)
         self._mem_entry = None
         self._manifest = self._read_manifest()
+
+    # --- sharded-trainer adapter -------------------------------------------
+    def _trainer(self):
+        """The live ``ParallelWrapper`` when this session drives one
+        (``TrainingSession(wrapper, dir)``), else None. A wrapper
+        session snapshots the WRAPPED model (full host arrays, gathered
+        through the ``_live_trainer`` hook — ZeRO opt shards and
+        TP-sharded params serialize mesh-agnostically) and resume
+        re-shards onto the wrapper's CURRENT mesh, which may be a
+        different shape than the one that saved (docs/sharding.md,
+        "Resharding restore")."""
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+        return self.model if isinstance(self.model, ParallelWrapper) \
+            else None
+
+    @property
+    def _net(self):
+        """The underlying network (counters/serialization authority) —
+        the model itself, or a driven wrapper's wrapped model."""
+        t = self._trainer()
+        return t.model if t is not None else self.model
 
     # --- manifest -----------------------------------------------------------
     def _manifest_path(self) -> str:
@@ -143,7 +180,13 @@ class TrainingSession:
         from deeplearning4j_tpu.optimize import checkpoint as ckpt
         from deeplearning4j_tpu.util import serializer
 
-        m = self.model
+        t = self._trainer()
+        if t is not None:
+            # gather-on-save: ZeRO opt shards / TP-sharded params pull
+            # back to full host arrays before the atomic zip (no-op
+            # before the wrapper stages anything)
+            t.sync_model()
+        m = self._net
         fname = f"session_iter{int(m.iteration):08d}.zip"
         path = os.path.join(self.directory, fname)
         self.retry.call(serializer.write_model, m, path,
@@ -193,7 +236,7 @@ class TrainingSession:
         from deeplearning4j_tpu.util import serializer
 
         self._manifest = self._read_manifest()
-        listeners = list(getattr(self.model, "listeners", []) or [])
+        listeners = list(getattr(self._net, "listeners", []) or [])
         snaps = self._manifest["snapshots"]
         restored, idx, _ = serializer.restore_newest_verified(
             [(os.path.join(self.directory, s["file"]),
@@ -201,9 +244,9 @@ class TrainingSession:
             serializer.restore_model)
         entry = snaps[idx] if restored is not None else None
         if restored is None and self._mem is not None \
-                and self.model is not None:
-            ckpt.restore_training_state(self.model, self._mem)
-            restored, entry = self.model, self._mem_entry
+                and self._net is not None:
+            ckpt.restore_training_state(self._net, self._mem)
+            restored, entry = self._net, self._mem_entry
         if restored is None:
             raise FileNotFoundError(
                 f"no loadable snapshot in {self.directory}")
@@ -213,7 +256,21 @@ class TrainingSession:
         if rng and hasattr(restored, "_base_key"):
             restored._base_key = jnp.asarray(
                 np.asarray(rng, dtype=np.uint32))
-        self.model = restored
+        trainer = self._trainer()
+        if trainer is not None:
+            # restore-and-reshard: the snapshot is full host arrays; the
+            # wrapper re-stages (re-scatters ZeRO slices, re-places
+            # TP shards) onto its CURRENT mesh on the next run — which
+            # may be a different shape than the mesh that saved. Step
+            # closures are dropped (the AOT cache makes the rebuild a
+            # compile-cache hit on an unchanged mesh).
+            trainer.model = restored
+            trainer._params = trainer._state = trainer._opt = None
+            trainer._residual = None
+            trainer._step = None
+            trainer._fused_step = None
+        else:
+            self.model = restored
         self._batch_in_epoch = int((entry or {}).get("batch_in_epoch", 0))
         telemetry.record_resume()
         return restored
@@ -247,16 +304,35 @@ class TrainingSession:
 
         if self.model is None:
             self.resume()
-        if self.model.params is None:
-            self.model.init()
+        if self._trainer() is not None and fused_steps:
+            raise ValueError(
+                "configure fused_steps on the ParallelWrapper, not the "
+                "session, when driving a wrapper")
+        net = self._net
+        if net.params is None:
+            net.init()
         if labels is None and hasattr(data, "reset") \
                 and hasattr(data, "__iter__"):
             iterator = data
         else:
             iterator = _as_iterator(data, labels, batch_size)
-        iterator = _wrap_fused(iterator, fused_steps, self.model.conf)
+        iterator = _wrap_fused(iterator, fused_steps, net.conf)
+        trainer = self._trainer()
+        if trainer is not None and getattr(trainer, "fused_steps", 0) > 1 \
+                and getattr(iterator, "stack_batches", 0) \
+                != trainer.fused_steps:
+            # the wrapper's K-step fused dispatch needs [K, B, ...]
+            # super-batches; stack host-side exactly as wrapper.fit does
+            # (the wrapper owns device placement). One stacked item is
+            # one atomic super-step, so the K-aligned snapshot/replay
+            # accounting below holds unchanged.
+            from deeplearning4j_tpu.datasets.prefetch import (
+                StackBatchIterator,
+            )
+
+            iterator = StackBatchIterator(iterator, trainer.fused_steps)
         target_epoch = int(to_epoch) if to_epoch is not None \
-            else int(self.model.epoch) + int(epochs)
+            else int(net.epoch) + int(epochs)
         restarts_this_fit = 0
         while True:
             try:
@@ -276,6 +352,16 @@ class TrainingSession:
         # clock itself (idle time since a previous fit must not record
         # as a dispatch gap)
         telemetry.host_gap_reset()
+        trainer = self._trainer()
+        if trainer is not None:
+            # stage (or RE-stage after resume — possibly onto a
+            # different mesh shape) and arm the gather-on-save hook
+            # before the pre-first-step snapshot below
+            import weakref
+
+            trainer._setup()
+            trainer._mp_target = None
+            self._net._live_trainer = weakref.ref(trainer)
         if not self.snapshots():
             # a pre-first-step snapshot: a kill before the first periodic
             # snapshot still resumes (from iteration 0) instead of
@@ -288,13 +374,19 @@ class TrainingSession:
             self._run_epochs(iterator, target_epoch)
         finally:
             telemetry.host_gap_stop()
+            if trainer is not None:
+                # disarm the gather-on-save hook between runs (resume
+                # re-arms); outside a run the model's host arrays are
+                # authoritative
+                self._net._live_trainer = None
         return m
 
     def _run_epochs(self, iterator, target_epoch: int):
         from deeplearning4j_tpu.nn import io as nn_io
         from deeplearning4j_tpu.telemetry import flightrec
 
-        m = self.model
+        trainer = self._trainer()
+        m = self._net
         with flightrec.flight_recorder(model=m):
             while m.epoch < target_epoch:
                 for lst in m.listeners:
@@ -305,13 +397,23 @@ class TrainingSession:
                     # replay fast-forward must not pay device transfers
                     # for super-steps it immediately discards
                     iterator.skip_staging(skip)
+                elif skip and hasattr(iterator, "skip_stacking"):
+                    # host-only stacking iterators (wrapper fused mode):
+                    # skip the K-batch copies the same way
+                    iterator.skip_stacking(skip)
                 pending = []
                 for i, ds in enumerate(iterator):
                     if i < skip:
                         continue  # replay fast-forward to the crash pos
                     it_before = m.iteration
-                    pending.append(m._fit_batch_async(ds))
-                    nn_io.drain(pending)
+                    if trainer is not None:
+                        # wrapper steps dispatch synchronously (the
+                        # collective exchange is inside the compiled
+                        # step; there is no async queue to drain)
+                        trainer._fit_batch(ds)
+                    else:
+                        pending.append(m._fit_batch_async(ds))
+                        nn_io.drain(pending)
                     self._batch_in_epoch = i + 1
                     # crossing (not exact-hit) check: a fused super-step
                     # advances the counter by K per item, so the cadence
@@ -327,4 +429,6 @@ class TrainingSession:
                 m.epoch += 1
                 self._batch_in_epoch = 0
                 self.snapshot()  # epoch boundary: position resets to 0
+        if trainer is not None:
+            trainer._write_back()
         return m

@@ -1,0 +1,29 @@
+"""Declarative sharding subsystem (ROADMAP open item 1).
+
+- :mod:`~deeplearning4j_tpu.sharding.rules` — regex-over-param-path ->
+  ``PartitionSpec`` rule tables (``match_partition_rules``) and
+  optimizer-state spec cloning (``create_opt_spec``);
+- :mod:`~deeplearning4j_tpu.sharding.plan` — :class:`ShardingPlan`:
+  a rule table bound to a composed DP×TP mesh, with ``NamedSharding``
+  placement, AOT-cache sharding keys, ``explain()`` debugging and
+  per-device byte accounting;
+- :mod:`~deeplearning4j_tpu.sharding.zero` — the flatten/pad/scatter
+  layout behind ``ParallelWrapper(zero_optimizer=True)``'s ZeRO-style
+  optimizer-state sharding.
+
+docs/sharding.md has the guided tour; ``deeplearning4j_tpu.zoo.rules``
+ships rule tables for the built-in nets.
+"""
+
+from deeplearning4j_tpu.sharding.plan import (  # noqa: F401
+    ShardingPlan,
+    active_plans,
+    plans_summary,
+)
+from deeplearning4j_tpu.sharding.rules import (  # noqa: F401
+    bytes_per_device,
+    create_opt_spec,
+    match_partition_rules,
+    named_paths,
+)
+from deeplearning4j_tpu.sharding.zero import ZeroSpec  # noqa: F401

@@ -1,0 +1,289 @@
+"""``ShardingPlan`` — a resolved placement for one model on one mesh.
+
+Composes a DP×TP mesh (``data`` × ``model`` axes; unused axes size 1)
+with a regex rule table (:mod:`deeplearning4j_tpu.sharding.rules`) into
+everything a training path needs:
+
+- ``param_specs`` / ``opt_specs``: resolved ``PartitionSpec`` pytrees
+  (moment buffers cloned from their parameter's spec);
+- ``shardings(specs)``: the matching ``NamedSharding`` pytree, and
+  ``place(...)`` to commit host trees onto the mesh;
+- ``cache_tag()``: a content digest of (mesh shape, resolved spec
+  table) joined into the AOT step-executable cache key
+  (``optimize/aot_cache``) so differently-sharded executables for the
+  same graph NEVER collide — and identically-sharded re-instantiations
+  always hit;
+- ``explain()``: the param-path → spec table (and opt-state specs) as
+  text or JSON — surfaced on the UI System tab beside the AOT-cache
+  stats, because "which tensor lives where" must be inspectable, not
+  inferred from OOMs;
+- per-device byte accounting (``param_bytes_per_device`` /
+  ``opt_bytes_per_device``) published as the ``dl4j_shard_param_bytes``
+  / ``dl4j_shard_opt_bytes`` gauges.
+
+Plans register themselves in a process-wide weak set on resolve;
+``active_plans()`` / ``plans_summary()`` feed the UI server's
+``/sharding`` endpoint and the System tab.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import weakref
+from typing import Optional
+
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel import mesh as mesh_mod
+from deeplearning4j_tpu.sharding import rules as rules_mod
+
+DATA = mesh_mod.DATA_AXIS
+MODEL = mesh_mod.MODEL_AXIS
+
+_ACTIVE: "weakref.WeakSet" = weakref.WeakSet()
+_ACTIVE_LOCK = threading.Lock()
+
+
+def active_plans():
+    """Live (resolved) plans, oldest-registered first."""
+    with _ACTIVE_LOCK:
+        return sorted(_ACTIVE, key=lambda p: p._seq)
+
+
+def plans_summary() -> list:
+    """JSON-ready summaries of every live resolved plan (the UI System
+    tab / ``/sharding`` payload)."""
+    return [p.explain(fmt="json") for p in active_plans()]
+
+
+_SEQ = [0]
+
+
+class ShardingPlan:
+    """A rule table bound to a DP×TP mesh.
+
+    Usage::
+
+        plan = ShardingPlan(rules=[(r"W$", P(None, "model")),
+                                   (r".*", P())],
+                            data=4, model=2)
+        specs = plan.param_specs(net.params)
+        opt_specs = plan.opt_specs(net.params, net.opt_state)
+        params = plan.place(net.params, specs)
+
+    ``mesh=`` overrides the composed mesh (any mesh with ``data`` /
+    ``model`` axes works — the rule specs name mesh axes directly).
+    """
+
+    def __init__(self, rules, mesh=None, data: int = -1, model: int = 1,
+                 sep: str = "/", demote_indivisible: bool = False):
+        self.rules = rules_mod.normalize_rules(rules)
+        self.mesh = mesh if mesh is not None else mesh_mod.single_host_mesh(
+            data=data, model=model)
+        self.sep = sep
+        # a matched dim whose size a mesh axis does not divide: strict
+        # plans raise (the author asked for a placement that cannot be
+        # applied); demoting plans replicate THAT DIM and record the
+        # demotion in explain() — what generic zoo rule tables need,
+        # where e.g. a classifier head's width follows num_classes
+        self.demote_indivisible = bool(demote_indivisible)
+        self._resolved = None       # (param_specs, opt_specs or None)
+        self._tables = None         # explain() rows
+        self._params_key = None     # resolution-cache keys
+        self._opt_key = None
+        with _ACTIVE_LOCK:
+            _SEQ[0] += 1
+            self._seq = _SEQ[0]
+
+    # --- resolution ---------------------------------------------------------
+    def _check_divisible(self, params, specs):
+        """Every sharded dim must be divisible by its mesh axes' product;
+        raise (strict) or demote the offending dim to replicated."""
+        import jax
+
+        demoted = []
+
+        def fix(path_leaf, spec_pair):
+            (path, leaf), (_, spec) = path_leaf, spec_pair
+            shape = getattr(leaf, "shape", ())
+            out = []
+            changed = False
+            for d, entry in enumerate(spec):
+                if entry is None:
+                    out.append(None)
+                    continue
+                factor = rules_mod.shard_factor(P(entry), self.mesh) \
+                    if not isinstance(entry, (tuple, list)) \
+                    else rules_mod.shard_factor(P(tuple(entry)), self.mesh)
+                if shape[d] % factor:
+                    if not self.demote_indivisible:
+                        raise ValueError(
+                            f"param '{path}' dim {d} (size {shape[d]}) "
+                            f"is not divisible by mesh axis "
+                            f"{entry!r} (size {factor}); fix the rule "
+                            f"or build the plan with "
+                            f"demote_indivisible=True")
+                    demoted.append(path)
+                    out.append(None)
+                    changed = True
+                else:
+                    out.append(entry)
+            return P(*out) if changed else spec
+
+        paths = rules_mod.named_paths(params, self.sep)
+        spec_paths = rules_mod.named_paths_specs(specs, self.sep)
+        fixed = [fix(pl, sp) for pl, sp in zip(paths, spec_paths)]
+        treedef = jax.tree_util.tree_structure(
+            specs, is_leaf=lambda x: isinstance(x, P))
+        return jax.tree_util.tree_unflatten(treedef, fixed), demoted
+
+    def _tree_key(self, tree):
+        """Cheap resolution-cache key: per-leaf (path, shape, dtype) —
+        no regex work, just a flatten."""
+        return tuple(
+            (p, tuple(getattr(l, "shape", ())),
+             str(getattr(l, "dtype", "?")))
+            for p, l in rules_mod.named_paths(tree, self.sep))
+
+    def param_specs(self, params):
+        """Rule table -> ``PartitionSpec`` pytree. Cached per plan: a
+        plan is bound to one parameter structure, so repeated fits
+        re-use the resolved table (keyed on the leaves' path/shape/
+        dtype signature); a different structure re-resolves."""
+        key = self._tree_key(params)
+        if self._resolved is not None and self._resolved[0] is not None \
+                and self._params_key == key:
+            return self._resolved[0]
+        specs = rules_mod.match_partition_rules(self.rules, params,
+                                                sep=self.sep)
+        specs, demoted = self._check_divisible(params, specs)
+        table = rules_mod.spec_table(params, specs, sep=self.sep)
+        for row in table:
+            if row["path"] in demoted:
+                row["demoted"] = True
+        self._tables = {"params": table, "opt": []}
+        self._resolved = (specs, None)
+        self._params_key = key
+        self._opt_key = None
+        with _ACTIVE_LOCK:
+            _ACTIVE.add(self)
+        return specs
+
+    def opt_specs(self, params, opt_state):
+        """Parameter specs cloned onto updater state (scalar state
+        replicated) — ``rules.create_opt_spec``; cached like
+        ``param_specs``."""
+        pspecs = self.param_specs(params)
+        key = self._tree_key(opt_state)
+        if self._resolved[1] is not None and self._opt_key == key:
+            return self._resolved[1]
+        ospecs = rules_mod.create_opt_spec(pspecs, opt_state)
+        self._tables["opt"] = rules_mod.spec_table(
+            opt_state, ospecs, sep=self.sep)
+        self._resolved = (pspecs, ospecs)
+        self._opt_key = key
+        return ospecs
+
+    # --- placement ----------------------------------------------------------
+    def sharding(self, spec: P) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+    def shardings(self, specs):
+        """Spec pytree -> matching ``NamedSharding`` pytree."""
+        import jax
+
+        return jax.tree_util.tree_map(
+            self.sharding, specs, is_leaf=lambda x: isinstance(x, P))
+
+    def place(self, tree, specs):
+        """Commit a host tree onto the mesh under ``specs``."""
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda spec, x: jax.device_put(x, self.sharding(spec)),
+            specs, tree, is_leaf=lambda x: isinstance(x, P))
+
+    def batch_spec(self) -> P:
+        """Batches shard their leading axis over ``data`` and replicate
+        over ``model`` — standard DP×TP input placement."""
+        return P(DATA)
+
+    # --- cache keys ---------------------------------------------------------
+    def cache_tag(self) -> str:
+        """Digest of (mesh axis sizes, resolved spec table) — the AOT
+        cache's sharding key component. Requires a prior
+        ``param_specs`` resolve (a plan that never resolved has nothing
+        to key)."""
+        if self._tables is None:
+            raise ValueError("cache_tag() before param_specs() — the "
+                             "tag keys the RESOLVED table")
+        mesh_sig = tuple(
+            (a, int(self.mesh.shape[a])) for a in self.mesh.axis_names)
+        payload = json.dumps([mesh_sig, self._tables["params"],
+                              self._tables["opt"]], sort_keys=True)
+        return hashlib.sha1(payload.encode()).hexdigest()[:16]
+
+    # --- accounting ---------------------------------------------------------
+    def param_bytes_per_device(self, params) -> int:
+        return rules_mod.bytes_per_device(
+            params, self.param_specs(params), self.mesh)
+
+    def opt_bytes_per_device(self, params, opt_state) -> int:
+        return rules_mod.bytes_per_device(
+            opt_state, self.opt_specs(params, opt_state), self.mesh)
+
+    def publish_metrics(self, params, opt_state=None) -> dict:
+        """Set the per-device shard-byte gauges from this plan's
+        resolved placement; returns ``{param_bytes, opt_bytes}``."""
+        from deeplearning4j_tpu import telemetry
+
+        pb = self.param_bytes_per_device(params)
+        ob = (self.opt_bytes_per_device(params, opt_state)
+              if opt_state is not None else 0)
+        telemetry.record_shard_bytes(pb, ob, self.mesh)
+        return {"param_bytes": pb, "opt_bytes": ob}
+
+    # --- debugging surface --------------------------------------------------
+    def explain(self, fmt: str = "text"):
+        """The resolved param-path → PartitionSpec table (+ opt-state
+        spec rows) as ``"text"`` or ``"json"``. Resolve first
+        (``param_specs`` / ``opt_specs``); an unresolved plan explains
+        its rule table only."""
+        mesh_shape = {a: int(self.mesh.shape[a])
+                      for a in self.mesh.axis_names
+                      if int(self.mesh.shape[a]) > 1}
+        data = {
+            "mesh": mesh_shape,
+            "devices": int(np.prod([int(self.mesh.shape[a])
+                                    for a in self.mesh.axis_names])),
+            "rules": [[pat, str(spec)] for pat, spec in self.rules],
+            "params": (self._tables or {}).get("params", []),
+            "opt_state": (self._tables or {}).get("opt", []),
+        }
+        if fmt == "json":
+            return data
+        lines = [f"ShardingPlan mesh={mesh_shape or '{1 device}'} "
+                 f"rules={len(self.rules)}"]
+        if data["params"]:
+            w = max(5, max(len(r["path"]) for r in data["params"]))
+            lines.append(f"  {'param'.ljust(w)}  shape           spec")
+            for r in data["params"]:
+                shp = "x".join(map(str, r["shape"])) or "scalar"
+                lines.append(
+                    f"  {r['path'].ljust(w)}  {shp.ljust(14)}  {r['spec']}")
+        else:
+            for pat, spec in self.rules:
+                lines.append(f"  rule {pat!r} -> {spec}")
+        if data["opt_state"]:
+            lines.append(f"  opt-state: {len(data['opt_state'])} buffers "
+                         f"(specs cloned from params; scalars replicated)")
+        return "\n".join(lines)
+
+    def __repr__(self):
+        shape = {a: int(self.mesh.shape[a]) for a in self.mesh.axis_names
+                 if int(self.mesh.shape[a]) > 1}
+        return (f"ShardingPlan(rules={len(self.rules)}, mesh={shape}, "
+                f"resolved={self._tables is not None})")

@@ -1,0 +1,213 @@
+"""Regex partition rules: param-path -> ``PartitionSpec``.
+
+The declarative layer every parallel wrapper previously hand-rolled
+(ROADMAP open item 1): a rule table is an ordered list of
+``(regex, PartitionSpec)`` pairs matched against each parameter's
+``"layer/param"`` path (``"0/W"``, ``"res2a_branch2a/W"``, …). First
+match wins; scalars are never partitioned; a parameter no rule covers
+raises with the nearest rule as a suggestion — silent replication of a
+tensor the author meant to shard is exactly the bug this layer exists
+to remove (the fmengine ``match_partition_rules`` shape, SNIPPETS.md
+[1]/[2]).
+
+``create_opt_spec`` clones each parameter's spec onto its updater moment
+buffers (Adam m/v, Nesterovs momentum, …) while replicating scalar
+state, so optimizer state always shards exactly like the parameters it
+tracks.
+"""
+
+from __future__ import annotations
+
+import difflib
+import re
+from typing import List, Sequence, Tuple
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+def _leaf_key(entry) -> str:
+    """One key-path entry -> path segment (dict key / index / attr)."""
+    for attr in ("key", "idx", "name"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
+def named_paths(tree, sep: str = "/") -> List[Tuple[str, object]]:
+    """Flatten ``tree`` to ``[(path, leaf), ...]`` with ``sep``-joined
+    key paths — the string the rule regexes are matched against."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(sep.join(_leaf_key(k) for k in path), leaf)
+            for path, leaf in flat]
+
+
+def normalize_rules(rules) -> List[Tuple[str, P]]:
+    """Accept ``[(regex, spec), ...]`` with specs given as
+    ``PartitionSpec`` or plain tuples/strings/None; returns the
+    canonical ``(str, PartitionSpec)`` list."""
+    out = []
+    for rule, spec in rules:
+        if not isinstance(spec, P):
+            if spec is None:
+                spec = P()
+            elif isinstance(spec, str):
+                spec = P(spec)
+            else:
+                spec = P(*spec)
+        out.append((str(rule), spec))
+    return out
+
+
+def is_scalar(leaf) -> bool:
+    """Scalars (and 1-element tensors) are never partitioned."""
+    shape = getattr(leaf, "shape", None)
+    if shape is None:
+        return True
+    return len(shape) == 0 or int(np.prod(shape)) == 1
+
+
+def _nearest_rule(path: str, rules) -> str:
+    """The rule pattern most similar to ``path`` — the error-message
+    suggestion when nothing matched (a typo'd rule is the common case)."""
+    if not rules:
+        return ""
+    scored = [(difflib.SequenceMatcher(None, path, pat).ratio(), pat)
+              for pat, _ in rules]
+    return max(scored)[1]
+
+
+def match_partition_rules(rules, params, sep: str = "/"):
+    """Resolve a rule table over a parameter pytree.
+
+    Returns a pytree of ``PartitionSpec`` matching ``params``'
+    structure. Scalar leaves get ``P()`` without consulting the table;
+    every other leaf takes the FIRST rule whose regex ``re.search``-es
+    its path. An unmatched parameter raises ``ValueError`` naming the
+    path and the nearest rule (add a trailing ``(".*", P())`` catch-all
+    for replicate-by-default behavior). A matched spec wider than the
+    leaf's rank also raises — that placement could never be applied.
+    """
+    import jax
+
+    rules = normalize_rules(rules)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    specs = []
+    for path, leaf in flat:
+        name = sep.join(_leaf_key(k) for k in path)
+        if is_scalar(leaf):
+            specs.append(P())
+            continue
+        for pat, spec in rules:
+            if re.search(pat, name) is not None:
+                ndim = len(getattr(leaf, "shape", ()))
+                if len(spec) > ndim:
+                    raise ValueError(
+                        f"partition rule {pat!r} -> {spec} has "
+                        f"{len(spec)} axes but param '{name}' has rank "
+                        f"{ndim}")
+                specs.append(spec)
+                break
+        else:
+            near = _nearest_rule(name, rules)
+            hint = f"; nearest rule: {near!r}" if near else ""
+            raise ValueError(
+                f"no partition rule matches param '{name}'{hint} — add "
+                f"a rule for it or a ('.*', PartitionSpec()) catch-all")
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def create_opt_spec(param_specs, opt_state):
+    """Clone parameter specs onto updater state.
+
+    ``param_specs``: the pytree :func:`match_partition_rules` returned
+    (leaves are ``PartitionSpec``, one per parameter). ``opt_state``:
+    the updater-state tree, which nests one level DEEPER than params
+    (each param maps to a dict of moment buffers — or ``{}`` for
+    stateless updaters like SGD). Moment buffers (non-scalar leaves)
+    inherit their parameter's spec; scalar state (step counters,
+    accumulators) replicates — the snippet-[2] contract.
+    """
+    import jax
+
+    def clone(spec, state_sub):
+        return jax.tree_util.tree_map(
+            lambda leaf: P() if is_scalar(leaf) else spec, state_sub)
+
+    def rec(spec, st):
+        if isinstance(spec, P):
+            return clone(spec, st)
+        if isinstance(st, dict):
+            return {k: rec(spec[k], v) for k, v in st.items()}
+        return jax.tree_util.tree_map(
+            lambda s, t: rec(s, t), spec, st,
+            is_leaf=lambda x: isinstance(x, P))
+
+    return rec(param_specs, opt_state)
+
+
+def spec_table(params, specs, sep: str = "/") -> List[dict]:
+    """Side-by-side ``[(path, shape, dtype, spec), ...]`` rows — the
+    ``ShardingPlan.explain()`` payload."""
+    rows = []
+    for (path, leaf), (_, spec) in zip(named_paths(params, sep),
+                                       named_paths_specs(specs, sep)):
+        rows.append({
+            "path": path,
+            "shape": list(getattr(leaf, "shape", ())),
+            "dtype": str(getattr(leaf, "dtype", "?")),
+            "spec": str(spec),
+        })
+    return rows
+
+
+def named_paths_specs(specs, sep: str = "/"):
+    """``named_paths`` over a spec tree (PartitionSpec leaves are
+    themselves tuples, so flattening must treat them atomically)."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        specs, is_leaf=lambda x: isinstance(x, P))
+    return [(sep.join(_leaf_key(k) for k in path), leaf)
+            for path, leaf in flat]
+
+
+def shard_factor(spec: P, mesh) -> int:
+    """How many ways ``spec`` divides a tensor on ``mesh`` (product of
+    the named axes' sizes) — the per-device byte divisor."""
+    n = 1
+    for entry in spec:
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        for ax in axes:
+            n *= int(mesh.shape[ax])
+    return n
+
+
+def bytes_per_device(tree, specs, mesh) -> int:
+    """Per-device bytes of ``tree`` placed under ``specs`` (replicated
+    leaves count full size on every device; sharded leaves divide by
+    the spec's shard factor, padding to the ceiling)."""
+    total = 0
+    for (_, leaf), (_, spec) in zip(named_paths(tree),
+                                    named_paths_specs(specs)):
+        shape = getattr(leaf, "shape", ())
+        size = int(np.prod(shape)) if shape else 1
+        item = np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+        total += -(-size // shard_factor(spec, mesh)) * item
+    return total
+
+
+__all__ = [
+    "match_partition_rules",
+    "create_opt_spec",
+    "named_paths",
+    "normalize_rules",
+    "is_scalar",
+    "spec_table",
+    "shard_factor",
+    "bytes_per_device",
+]

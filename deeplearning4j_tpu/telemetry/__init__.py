@@ -146,6 +146,28 @@ def record_pipeline_schedule(n_stages: int, n_micro: int,
                    schedule=schedule).set(n_micro)
 
 
+def record_shard_bytes(param_bytes: float, opt_bytes: float,
+                       mesh=None) -> None:
+    """Publish the per-device parameter / optimizer-state footprint of
+    the active placement (``dl4j_shard_param_bytes`` /
+    ``dl4j_shard_opt_bytes``, one series per mesh device) — the gauge
+    pair that makes ZeRO's per-chip memory saving MEASURABLE instead of
+    asserted. Recorded unconditionally (placement happens once per
+    ``fit``/plan resolve, never per step); with ``mesh=None`` a single
+    unlabeled series is set."""
+    devices = (list(mesh.devices.flat) if mesh is not None else [None])
+    for d in devices:
+        labels = {"device": str(d)} if d is not None else {}
+        REGISTRY.gauge("dl4j_shard_param_bytes",
+                       help="per-device parameter bytes under the "
+                            "active sharding plan", **labels).set(
+            param_bytes)
+        REGISTRY.gauge("dl4j_shard_opt_bytes",
+                       help="per-device optimizer-state bytes under "
+                            "the active sharding plan", **labels).set(
+            opt_bytes)
+
+
 def record_step_seconds(seconds: float, path: str = "listener") -> None:
     """Observe one step duration into the registry histogram (the
     ProfilerListener / OpProfiler routing)."""

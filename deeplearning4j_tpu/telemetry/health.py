@@ -123,10 +123,26 @@ def guard_vector(loss, grads, params=None, new_params=None):
             l32 = l.astype(f32)
             sq = sq + jnp.sum(l32 * l32)
         bucket_sq.append(sq)
-    total_sq = sum(bucket_sq)
     # any NaN/Inf gradient element poisons its squared sum (an f32
     # OVERFLOW of the sum also trips this — a gradient with norm > ~2e19
-    # is an anomaly by any definition)
+    # is an anomaly by any definition); the tail assembly is shared with
+    # the pre-reduced path so the two can never desynchronize
+    return guard_vector_from_sq(loss, bucket_sq, params=params,
+                                new_params=new_params)
+
+
+def guard_vector_from_sq(loss, bucket_sq, params=None, new_params=None):
+    """:func:`guard_vector` built from PRE-REDUCED per-bucket squared
+    sums (an ordered list matching :func:`bucket_keys`). The ZeRO
+    wrapper computes squared sums on its reduce-scattered gradient
+    slices and psums them — this finishes the vector with the exact
+    same layout/semantics as the dense-gradient path, so the monitor
+    never needs to know which exchange produced the numbers."""
+    import jax.numpy as jnp
+
+    f32 = jnp.float32
+    bucket_sq = [jnp.asarray(b, f32) for b in bucket_sq]
+    total_sq = sum(bucket_sq) if bucket_sq else f32(0.0)
     grad_nf = (~jnp.isfinite(total_sq)).astype(f32)
     loss32 = jnp.asarray(loss).astype(f32)
     loss_nf = (~jnp.isfinite(loss32)).astype(f32)

@@ -10,6 +10,7 @@ docs/observability.md) and auto-refresh, no JS dependencies."""
 from __future__ import annotations
 
 import html
+import json
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from deeplearning4j_tpu.ui.stats import StatsStorage
@@ -193,6 +194,14 @@ class UIServer:
                     payload = _json.dumps(
                         telemetry.telemetry_record()).encode()
                     ctype = "application/json"
+                elif self.path == "/sharding":
+                    # live sharding plans (sharding.plan registry): the
+                    # resolved param-path -> PartitionSpec tables as
+                    # JSON — the scriptable twin of the System-tab panel
+                    from deeplearning4j_tpu.sharding import plans_summary
+
+                    payload = _json.dumps(plans_summary()).encode()
+                    ctype = "application/json"
                 elif self.path == "/health":
                     # training-health probe (telemetry.health): policy,
                     # anomaly counts, last guard readings — the liveness/
@@ -310,6 +319,36 @@ class UIServer:
                 '<table style="font-size:12px;border-spacing:8px 2px">'
                 + "".join(rows) + "</table></div>")
 
+    def _sharding_panel(self) -> str:
+        """Live sharding plans (sharding.plan registry): the resolved
+        param-path -> PartitionSpec table (opt-state specs summarized) +
+        the per-device shard-byte gauges — the System-tab view of "which
+        tensor lives where", beside the AOT-cache stats whose keys the
+        plans feed. Rendered only when a plan has resolved in this
+        process."""
+        from deeplearning4j_tpu.sharding import plans_summary
+
+        summaries = plans_summary()
+        if not summaries:
+            return ""
+        blocks = []
+        for s in summaries:
+            rows = "".join(
+                f"<tr><td>{html.escape(r['path'])}</td>"
+                f"<td>{html.escape('x'.join(map(str, r['shape'])) or 'scalar')}"
+                f"</td><td>{html.escape(r['spec'])}"
+                f"{' (demoted)' if r.get('demoted') else ''}</td></tr>"
+                for r in s["params"])
+            blocks.append(
+                f"<h4>mesh {html.escape(json.dumps(s['mesh']))} · "
+                f"{len(s['params'])} params · "
+                f"{len(s['opt_state'])} opt buffers</h4>"
+                '<table style="font-size:12px;border-spacing:8px 2px">'
+                "<tr><th>param</th><th>shape</th><th>spec</th></tr>"
+                + rows + "</table>")
+        return ('<div class="chart"><h3>Sharding plans</h3>'
+                + "".join(blocks) + "</div>")
+
     def render_html(self, refresh_seconds: int = 0) -> str:
         """The dashboard as an HTML string."""
         records = [r for st in self._storages for r in st.records()]
@@ -393,6 +432,7 @@ class UIServer:
                         latest_hists.get("gradient_histograms", {}),
                         "#9467bd"),
             self._serving_panel(),
+            self._sharding_panel(),
         ]) or "<p>No stats collected yet.</p>"
         refresh = (f"<meta http-equiv='refresh' content='{refresh_seconds}'>"
                    if refresh_seconds else "")

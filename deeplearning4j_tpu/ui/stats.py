@@ -351,6 +351,23 @@ def collect_system_metrics() -> dict:
     except Exception:
         pass
     try:
+        # active sharding plans (sharding.plan registry): compact rows —
+        # the full param-path -> spec tables live on /sharding and the
+        # System-tab panel
+        from deeplearning4j_tpu.sharding import active_plans
+
+        plans = []
+        for p in active_plans():
+            s = p.explain(fmt="json")
+            plans.append({"mesh": s["mesh"], "params": len(s["params"]),
+                          "opt_buffers": len(s["opt_state"]),
+                          "demoted": sum(1 for r in s["params"]
+                                         if r.get("demoted"))})
+        if plans:
+            out["sharding_plans"] = plans
+    except Exception:
+        pass
+    try:
         import jax
 
         devices = {}

@@ -83,6 +83,17 @@ def write_model(net, path, save_updater: bool = True) -> None:
 
     from deeplearning4j_tpu.resilience import faults
 
+    # sharding-aware gather-on-save: while a parallel wrapper owns the
+    # live (possibly ZeRO-scattered / TP-sharded) training trees, pull
+    # them back onto the model first — the zip below is always full host
+    # arrays, restorable onto ANY mesh shape (the atomic temp+replace
+    # publish is unchanged; the gather happens before the temp file
+    # opens, so a crash mid-gather leaves nothing behind)
+    live = getattr(net, "_live_trainer", None)
+    trainer = live() if live is not None else None
+    if trainer is not None:
+        trainer.sync_model()
+
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     tmp = path.with_name(f"{path.name}.tmp.{os.getpid()}")

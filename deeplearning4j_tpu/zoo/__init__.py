@@ -7,6 +7,7 @@ from deeplearning4j_tpu.zoo.graphs import (
     UNet,
 )
 from deeplearning4j_tpu.zoo.models import LeNet, SimpleCNN, ZooModel
+from deeplearning4j_tpu.zoo import rules as rules  # noqa: F401  (partition-rule tables)
 from deeplearning4j_tpu.zoo.pretrained import (
     PretrainedType,
     load_pretrained,

@@ -1,0 +1,93 @@
+"""Partition-rule tables for the built-in zoo nets.
+
+One table per architecture family, written against the zoo configs'
+actual vertex/param paths (``"res2a_branch2a/W"``, ``"b0_attn/Wq"``,
+``"4/W"`` for sequential nets): dense/conv KERNELS are tensor-parallel
+split over the ``model`` axis, biases and normalization parameters
+replicate (their payload is negligible and replicating them keeps the
+activation layouts simple). Every table ends with a replicate-by-default
+catch-all, and :func:`plan_for` builds the table into a
+``ShardingPlan`` with ``demote_indivisible=True`` — classifier heads
+follow ``num_classes``, which a generic table cannot promise divides
+the ``model`` axis.
+
+Usage::
+
+    from deeplearning4j_tpu.zoo import rules as zoo_rules
+
+    net = ResNet50(num_classes=1000).init()
+    plan = zoo_rules.plan_for(zoo_rules.resnet_rules(), data=4, model=2)
+    pw = ParallelWrapper(net, workers=4, mesh=plan.mesh,
+                         partition_rules=plan)
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS
+from deeplearning4j_tpu.sharding import ShardingPlan
+
+# split a rank-2 kernel's OUTPUT features / a rank-4 conv kernel's
+# OUTPUT channels over the tensor-parallel axis
+DENSE_OUT = P(None, MODEL_AXIS)
+DENSE_IN = P(MODEL_AXIS, None)
+CONV_OUT = P(None, None, None, MODEL_AXIS)
+REPLICATED = P()
+
+
+def resnet_rules():
+    """ResNet/VGG-family ComputationGraphs (ResNet50, VGG16/19,
+    SqueezeNet, Darknet19, …): conv kernels split on output channels,
+    the dense head(s) on output features; biases / BN (gamma, beta,
+    mean, var) replicated via the catch-all."""
+    return [
+        (r"(output|fc\d*)/W$", DENSE_OUT),
+        (r"/W$", CONV_OUT),          # every remaining kernel is a conv
+        (r".*", REPLICATED),
+    ]
+
+
+def transformer_rules():
+    """``zoo.graphs.TransformerEncoder``: Megatron-style block split —
+    QKV projections column-parallel, the attention output projection
+    and second FFN matmul row-parallel (their input dim carries the
+    split head/hidden features), embedding and classifier head
+    column-parallel; LayerNorm and biases replicated."""
+    return [
+        (r"_attn/W[qkv]$", DENSE_OUT),
+        (r"_attn/Wo$", DENSE_IN),
+        (r"_ff1/W$", DENSE_OUT),
+        (r"_ff2/W$", DENSE_IN),
+        (r"(embed|output)/W$", DENSE_OUT),
+        (r".*", REPLICATED),
+    ]
+
+
+def lenet_rules():
+    """``zoo.models.LeNet`` (sequential — param paths are layer
+    indices): conv kernels (layers 0/2) on output channels, dense +
+    softmax head (layers 5/6) on output features."""
+    return [
+        (r"^[02]/W$", CONV_OUT),
+        (r"^[56]/W$", DENSE_OUT),
+        (r".*", REPLICATED),
+    ]
+
+
+def mlp_rules():
+    """Any all-dense sequential net: every kernel column-parallel."""
+    return [
+        (r"/W$", DENSE_OUT),
+        (r".*", REPLICATED),
+    ]
+
+
+def plan_for(rules, mesh=None, data: int = -1, model: int = 1
+             ) -> ShardingPlan:
+    """Rule table -> ``ShardingPlan`` on a DP×TP mesh, demoting
+    indivisible dims (generic tables meet nets whose widths the model
+    axis does not divide — a demoted tensor replicates and shows up
+    flagged in ``plan.explain()``)."""
+    return ShardingPlan(rules, mesh=mesh, data=data, model=model,
+                        demote_indivisible=True)
