@@ -55,6 +55,28 @@ shard-smoke:
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	$(PY) -m pytest tests -q -m sharding -p no:cacheprovider
 
+.PHONY: lint
+# Repo-discipline source lint (analysis/source.py AST rules): host syncs
+# in compiled functions, lock discipline on shared registries, wall-clock/
+# RNG in traced code, fit-loop bracketing, unused imports. Exits nonzero
+# on any unwaived finding >= WARN; waive inline with
+# "# dl4j: waive SRC1xx — reason" (docs/analysis.md has the catalog).
+lint:
+	JAX_PLATFORMS=cpu $(PY) -m deeplearning4j_tpu.analysis source
+
+.PHONY: analysis-smoke
+# Program-lint smoke: the per-rule seeded-defect fixtures, then the
+# compile-time pass for real — one MLN / graph / ZeRO-wrapper step each
+# through the AOT cache with the lint hook armed (donation audit included).
+# CPU-pinned, 2 virtual devices, fixed seeds.
+analysis-smoke:
+	JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+	$(PY) -m pytest tests -q -m analysis -p no:cacheprovider
+	JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+	$(PY) -m deeplearning4j_tpu.analysis program
+
 .PHONY: bench-serving
 # Closed-loop 8-client serving benchmark: locked single-request baseline
 # vs the dynamic micro-batching engine (acceptance bar: >= 4x).

@@ -1,0 +1,40 @@
+"""Static analysis: program (jaxpr/HLO) + source (AST) linters.
+
+- ``analysis.program`` — hooked into ``optimize.aot_cache``'s
+  lower/compile miss path: every executable the process caches is
+  checked for donation aliasing, baked-in constants, dtype-promotion
+  leaks, host callbacks, collective misuse, and near-miss recompile
+  churn. ``DL4J_TPU_PROGRAM_LINT=0`` disables, ``=strict`` raises.
+- ``analysis.source`` — AST checks over the repo: host syncs in
+  compiled functions, lock discipline on shared registries, wall-clock/
+  RNG in traced code, fit-loop fault/host-gap bracketing, unused
+  imports.
+- ``analysis.findings`` — the shared findings model (rule ids,
+  severities, inline ``# dl4j: waive RULE — reason`` waivers) and the
+  process-global ``LOG`` feeding
+  ``dl4j_analysis_findings_total{rule,severity}``.
+
+CLI: ``python -m deeplearning4j_tpu.analysis [source|program|all]``
+(``make lint`` / ``make analysis-smoke``). docs/analysis.md has the
+rule catalog.
+"""
+
+from deeplearning4j_tpu.analysis.findings import (  # noqa: F401
+    ERROR,
+    INFO,
+    WARN,
+    Finding,
+    LOG,
+    summarize,
+)
+from deeplearning4j_tpu.analysis.program import (  # noqa: F401
+    ProgramLintError,
+    donation_audit,
+    lint_program,
+    trace_artifact,
+    waive_program,
+)
+from deeplearning4j_tpu.analysis.source import (  # noqa: F401
+    lint_paths,
+    lint_source,
+)

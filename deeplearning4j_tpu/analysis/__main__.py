@@ -1,0 +1,166 @@
+"""CLI: ``python -m deeplearning4j_tpu.analysis [source|program|all]``.
+
+``source`` (the ``make lint`` pass) lints the package tree with the AST
+rules and exits 1 when any unwaived finding at or above ``--fail-on``
+remains. ``program`` (the ``make analysis-smoke`` pass) builds one
+small MultiLayerNetwork, ComputationGraph, and ParallelWrapper step
+each, runs them through the AOT cache with the compile-time linter
+armed, and reports what the program rules saw — the repo's own steps
+must come out clean. ``--json`` emits machine-readable findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _print(findings, as_json: bool, source: str) -> None:
+    from deeplearning4j_tpu.analysis import findings as fmod
+
+    if as_json:
+        print(json.dumps({
+            "pass": source,
+            "findings": [f.as_dict() for f in findings],
+            "summary": fmod.summarize(findings),
+        }, indent=2))
+        return
+    for f in sorted(findings, key=lambda f: (f.location, f.rule)):
+        print(f.render())
+    s = fmod.summarize(findings)
+    print(f"[{source}] {s['total']} finding(s), {s['waived']} waived, "
+          f"{s['actionable']} actionable")
+
+
+def run_source(root: str, as_json: bool, fail_on: str) -> int:
+    from deeplearning4j_tpu.analysis import findings as fmod
+    from deeplearning4j_tpu.analysis.source import lint_paths
+
+    findings = lint_paths(root)
+    _print(findings, as_json, "source")
+    if fail_on == "never":
+        return 0
+    bad = [f for f in findings if not f.waived
+           and fmod.severity_at_least(f.severity, fail_on.upper())]
+    return 1 if bad else 0
+
+
+def run_program(as_json: bool, fail_on: str) -> int:
+    """Drive one step of each training path through the AOT cache with
+    the program linter armed, then report the accumulated LOG."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from deeplearning4j_tpu.analysis import findings as fmod
+    from deeplearning4j_tpu.analysis.findings import LOG
+
+    rng = np.random.RandomState(7)
+    x = rng.randn(8, 6).astype("float32")
+    y = np.eye(4, dtype="float32")[rng.randint(0, 4, 8)]
+
+    from deeplearning4j_tpu.conf import Activation, InputType
+    from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.conf.losses import LossMCXENT
+    from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+
+    def _out_layer():
+        return OutputLayer(n_out=4, activation=Activation.SOFTMAX,
+                           loss_fn=LossMCXENT())
+
+    def mln():
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = (NeuralNetConfiguration.builder().seed(7).list()
+                .layer(DenseLayer(n_out=11, activation=Activation.TANH))
+                .layer(_out_layer())
+                .set_input_type(InputType.feed_forward(6)).build())
+        MultiLayerNetwork(conf).init().fit(x, y, epochs=1)
+
+    def graph():
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        conf = (NeuralNetConfiguration.builder().seed(7).graph_builder()
+                .add_inputs("in")
+                .set_input_types(InputType.feed_forward(6))
+                .add_layer("d", DenseLayer(n_out=11,
+                                           activation=Activation.TANH),
+                           "in")
+                .add_layer("out", _out_layer(), "d")
+                .set_outputs("out").build())
+        ComputationGraph(conf).init().fit(x, y, epochs=1)
+
+    def wrapper():
+        from deeplearning4j_tpu.datasets.iterators import (
+            ArrayDataSetIterator,
+        )
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+        conf = (NeuralNetConfiguration.builder().seed(7).list()
+                .layer(DenseLayer(n_out=13, activation=Activation.TANH))
+                .layer(_out_layer())
+                .set_input_type(InputType.feed_forward(6)).build())
+        net = MultiLayerNetwork(conf).init()
+        # ZeRO mode: the one wrapper step kind that is BOTH aot_cache-
+        # keyed (pw_zero → donation audit) and collective-bearing
+        # (reduce-scatter/all-gather → the PRG205 audit runs for real)
+        pw = ParallelWrapper(net, zero_optimizer=True)
+        pw.fit(ArrayDataSetIterator(x, y, batch=8), epochs=1)
+
+    failures = []
+    for name, fn in (("multilayer", mln), ("graph", graph),
+                     ("wrapper", wrapper)):
+        try:
+            fn()
+        except Exception as e:  # a path that cannot run still reports
+            failures.append(f"{name}: {type(e).__name__}: {e}")
+
+    findings = LOG.items()
+    _print(findings, as_json, "program")
+    from deeplearning4j_tpu.analysis.program import donation_audit
+
+    audit = donation_audit()
+    undonated = {k: v for k, v in audit.items() if v["aliases"] == 0}
+    if not as_json:
+        print(f"[program] donation audit: {len(audit)} train-step "
+              f"executable(s), {len(undonated)} without aliasing")
+    for msg in failures:
+        print(f"[program] PATH FAILED {msg}", file=sys.stderr)
+    if fail_on == "never":
+        return 0
+    bad = [f for f in findings if not f.waived
+           and fmod.severity_at_least(f.severity, fail_on.upper())]
+    return 1 if bad or undonated or failures else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.analysis",
+        description="jaxpr/HLO program lint + repo-discipline AST lint")
+    ap.add_argument("which", nargs="?", default="all",
+                    choices=("source", "program", "all"))
+    ap.add_argument("--root", default=None,
+                    help="package root for the source pass (default: the "
+                         "installed deeplearning4j_tpu tree)")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--fail-on", default="warn",
+                    choices=("info", "warn", "error", "never"),
+                    help="exit 1 on unwaived findings at/above this "
+                         "severity (default: warn)")
+    args = ap.parse_args(argv)
+
+    root = args.root
+    if root is None:
+        root = os.path.dirname(os.path.abspath(__file__ + "/.."))
+    rc = 0
+    if args.which in ("source", "all"):
+        rc |= run_source(root, args.json, args.fail_on)
+    if args.which in ("program", "all"):
+        rc |= run_program(args.json, args.fail_on)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

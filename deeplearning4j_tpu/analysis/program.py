@@ -1,0 +1,458 @@
+"""Program linter: jaxpr + compiled-HLO checks on every AOT-cache miss.
+
+The framework's memory and dispatch story rests on properties of the
+COMPILED step executables that nothing used to verify: train steps must
+donate their params/opt buffers (the fused/ZeRO memory claims are void
+without input→output aliasing), no host callback may hide inside a step,
+ZeRO steps must reduce-scatter rather than all-reduce, bucketed
+collective chains must keep their ``optimization_barrier`` issue-order
+pins, and closure-captured arrays must not get baked into executables as
+constants (silent memory bloat + a recompile per captured object).
+PyGraph (arXiv:2503.19779) makes the same argument for CUDA-graph
+capture: whole-program dispatch is only safe when a compiler-side check
+enforces the capture rules; arXiv:2112.01075 shows collective placement
+is auditable from the lowered program alone.
+
+``optimize.aot_cache`` calls :func:`on_compile` from its lower/compile
+miss path (every executable the process ever caches passes through
+here). Findings land in ``analysis.findings.LOG`` and the
+``dl4j_analysis_findings_total`` metric; ``DL4J_TPU_PROGRAM_LINT=0``
+disables the hook, ``=strict`` additionally raises on unwaived ERROR
+findings (CI fixtures). The pass never retraces: the cache's miss path
+already produces the Traced (jaxpr) and Compiled (HLO) artifacts, and
+linting reads those.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.analysis.findings import (
+    ERROR,
+    WARN,
+    Finding,
+    LOG,
+)
+
+# step kinds whose executables MUST donate (alias) their params/opt
+# buffers: the model train steps, the fused/tbptt scans, and every
+# ParallelWrapper SPMD step kind ("pw_*")
+TRAIN_KIND_PREFIXES = ("train_step", "fused_scan", "tbptt_scan", "pw_")
+
+ALL_REDUCE_PRIMS = frozenset({"psum", "psum2", "all_reduce"})
+REDUCE_SCATTER_PRIMS = frozenset({"psum_scatter", "reduce_scatter"})
+CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "outside_call", "infeed", "outfeed",
+})
+
+# closure-captured consts above WARN_BYTES are reported; above
+# ERROR_BYTES they are treated as baked-in weights (the classic
+# "jitted over self.params instead of passing them" bug)
+CONST_WARN_BYTES = 1 << 20   # 1 MiB
+CONST_ERROR_BYTES = 16 << 20  # 16 MiB
+
+
+class ProgramLintError(RuntimeError):
+    """Raised in strict mode when a compile produces an unwaived ERROR."""
+
+    def __init__(self, findings: List[Finding]):
+        super().__init__("; ".join(f.render() for f in findings))
+        self.findings = findings
+
+
+@dataclasses.dataclass
+class ProgramArtifact:
+    """Everything one compile exposes to the rules. ``jaxpr`` may be
+    None (a jax without ``jit.trace``); jaxpr-based rules then skip.
+    ``sibling_sigs``: signatures already cached under the same
+    (graph_key, fn_key) — the recompile-hazard diff input."""
+
+    graph_key: str
+    fn_key: str
+    jaxpr: object = None                  # ClosedJaxpr
+    executable: object = None             # jax Compiled
+    signature: object = None              # aot_cache.signature_of(args)
+    sibling_sigs: Tuple = ()
+    _aliases: object = dataclasses.field(default=False, repr=False)
+
+    @property
+    def location(self) -> str:
+        return f"graph={str(self.graph_key)[:12]} kind={self.fn_key}"
+
+    def is_train_kind(self) -> bool:
+        return self.fn_key.startswith(TRAIN_KIND_PREFIXES)
+
+    def alias_count(self):
+        """Cached: ``executable.as_text()`` renders the whole optimized
+        HLO module, so the donation rule and the audit must share one
+        pass over it."""
+        if self._aliases is False:
+            self._aliases = (_alias_count(self.executable)
+                             if self.executable is not None else None)
+        return self._aliases
+
+
+# --------------------------------------------------------------------------
+# waivers (no source line to annotate: program waivers match on the
+# cache key instead, registered next to the wrap() callsite)
+# --------------------------------------------------------------------------
+
+_WAIVERS: List[Tuple[str, str, str]] = []
+
+
+def waive_program(rule: str, key_substring: str, reason: str) -> None:
+    """Accept ``rule`` findings for executables whose
+    ``graph_key + fn_key`` contains ``key_substring``. Register next to
+    the ``aot_cache.wrap`` callsite the waiver justifies."""
+    _WAIVERS.append((rule, key_substring, reason))
+
+
+def _apply_waivers(art: ProgramArtifact,
+                   findings: List[Finding]) -> List[Finding]:
+    hay = f"{art.graph_key}{art.fn_key}"
+    for f in findings:
+        for rule, sub, reason in _WAIVERS:
+            if f.rule == rule and sub in hay:
+                f.waived = True
+                f.waiver_reason = reason
+                break
+    return findings
+
+
+# --------------------------------------------------------------------------
+# jaxpr walking
+# --------------------------------------------------------------------------
+
+def iter_eqns(closed_jaxpr):
+    """Yield every eqn in a ClosedJaxpr, recursing into sub-jaxprs
+    (scan/while/cond bodies, pjit/shard_map call_jaxprs, custom-vjp
+    branches) wherever they appear in eqn params."""
+    seen = set()
+
+    def walk(jaxpr):
+        if id(jaxpr) in seen:
+            return
+        seen.add(id(jaxpr))
+        for eqn in jaxpr.eqns:
+            yield eqn
+            for v in eqn.params.values():
+                vals = v if isinstance(v, (list, tuple)) else (v,)
+                for sub in vals:
+                    inner = getattr(sub, "jaxpr", None)
+                    if inner is not None and hasattr(inner, "eqns"):
+                        yield from walk(inner)      # ClosedJaxpr
+                    elif hasattr(sub, "eqns"):
+                        yield from walk(sub)        # raw Jaxpr
+
+    yield from walk(closed_jaxpr.jaxpr)
+
+
+def _prim_counts(closed_jaxpr) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for eqn in iter_eqns(closed_jaxpr):
+        n = eqn.primitive.name
+        counts[n] = counts.get(n, 0) + 1
+    return counts
+
+
+def _alias_count(executable) -> Optional[int]:
+    """Input→output alias entries in the compiled module (the HLO-level
+    truth of donation: jit-side donate_argnums that XLA could not honor
+    — dtype mismatch, non-donatable layout — silently drop the alias,
+    which is exactly what this rule exists to surface). None = the
+    backend exposed no HLO text (rule skips, intent check takes over)."""
+    try:
+        text = executable.as_text()
+    except Exception:
+        return None
+    header = text.split("\n", 1)[0]
+    i = header.find("input_output_alias={")
+    if i < 0:
+        return 0
+    # balanced-brace scan: alias entries themselves contain "{}", so a
+    # substring search for the closing brace picks the wrong one
+    depth, start = 0, header.index("{", i)
+    end = len(header)
+    for j in range(start, len(header)):
+        if header[j] == "{":
+            depth += 1
+        elif header[j] == "}":
+            depth -= 1
+            if depth == 0:
+                end = j
+                break
+    seg = header[start:end + 1]
+    return seg.count("may-alias") + seg.count("must-alias")
+
+
+# --------------------------------------------------------------------------
+# rules
+# --------------------------------------------------------------------------
+
+def _rule_donation(art: ProgramArtifact, out: List[Finding]) -> None:
+    """PRG201: a train-step executable with zero input→output aliases
+    keeps TWO copies of params/opt state live across every step —
+    defeats the fused-scan and ZeRO memory story and doubles peak HBM."""
+    if not art.is_train_kind() or art.executable is None:
+        return
+    n = art.alias_count()
+    if n == 0:
+        out.append(Finding(
+            rule="PRG201", severity=ERROR, location=art.location,
+            message="train-step executable has no input/output donation "
+                    "aliasing — params/opt buffers are copied, not "
+                    "reused (add donate_argnums to the jit)"))
+
+
+def _rule_baked_constants(art: ProgramArtifact, out: List[Finding]) -> None:
+    """PRG202: large arrays captured as jaxpr consts are baked into the
+    executable — silent device-memory bloat, and a fresh capture (a
+    rebuilt closure) recompiles the whole program."""
+    if art.jaxpr is None:
+        return
+    for c in getattr(art.jaxpr, "consts", ()):
+        nbytes = getattr(c, "nbytes", 0) or 0
+        if nbytes >= CONST_WARN_BYTES:
+            sev = ERROR if nbytes >= CONST_ERROR_BYTES else WARN
+            shape = getattr(c, "shape", ())
+            dtype = getattr(c, "dtype", "?")
+            out.append(Finding(
+                rule="PRG202", severity=sev, location=art.location,
+                message=f"closure-captured constant {shape} {dtype} "
+                        f"({nbytes / (1 << 20):.1f} MiB) baked into the "
+                        f"executable — pass it as an argument"))
+
+
+def _rule_dtype_promotion(art: ProgramArtifact, out: List[Finding]) -> None:
+    """PRG203: f64 values inside a graph with no f64 inputs (a python
+    float / enable_x64 promotion leak — doubles the op's cost on TPU,
+    where f64 emulation is catastrophic). bf16→f32 promotions are NOT
+    flagged: mixed-precision steps keep f32 masters/losses by design."""
+    if art.jaxpr is None:
+        return
+    in_dtypes = {str(getattr(a, "dtype", "")) for a in art.jaxpr.in_avals}
+    if "float64" in in_dtypes:
+        return  # caller asked for f64 (x64 gradcheck); nothing leaked
+    f64_prims = set()
+    for eqn in iter_eqns(art.jaxpr):
+        for v in eqn.outvars:
+            if str(getattr(v.aval, "dtype", "")) == "float64":
+                f64_prims.add(eqn.primitive.name)
+    if f64_prims:
+        out.append(Finding(
+            rule="PRG203", severity=WARN, location=art.location,
+            message=f"f64 values inside a graph with no f64 inputs "
+                    f"(promotion leak in: "
+                    f"{', '.join(sorted(f64_prims)[:6])})"))
+
+
+def _rule_host_callback(art: ProgramArtifact, out: List[Finding]) -> None:
+    """PRG204: a host callback inside a compiled step serializes the
+    device on the host every dispatch — the exact sync the AOT cache
+    exists to eliminate."""
+    if art.jaxpr is None:
+        return
+    hits = sorted(set(_prim_counts(art.jaxpr)) & CALLBACK_PRIMS)
+    if hits:
+        out.append(Finding(
+            rule="PRG204", severity=ERROR, location=art.location,
+            message=f"host callback/transfer inside the compiled step: "
+                    f"{', '.join(hits)}"))
+
+
+def _rule_collectives(art: ProgramArtifact, out: List[Finding]) -> None:
+    """PRG205: collective audit. (a) a ZeRO step whose gradient exchange
+    all-reduces instead of reduce-scattering moves n× the bytes and
+    replicates what sharding was meant to split; (b) a bucketed schedule
+    with multiple scatter collectives but no ``optimization_barrier``
+    lets XLA merge/reorder the buckets — the overlap schedule silently
+    degrades to one fused exchange."""
+    if art.jaxpr is None:
+        return
+    counts = _prim_counts(art.jaxpr)
+    n_allreduce = sum(counts.get(p, 0) for p in ALL_REDUCE_PRIMS)
+    n_scatter = sum(counts.get(p, 0) for p in REDUCE_SCATTER_PRIMS)
+    n_barrier = counts.get("optimization_barrier", 0)
+    if art.fn_key.startswith("pw_zero"):
+        if n_allreduce and not n_scatter:
+            out.append(Finding(
+                rule="PRG205", severity=ERROR, location=art.location,
+                message="ZeRO-mode step contains all-reduce collectives "
+                        "but no reduce-scatter — the gradient exchange "
+                        "is not sharded"))
+        # barrier audit only when the key declares a bucketed schedule
+        # (":b<nonzero>"): the fused b0 exchange has one variadic
+        # collective (per-leaf eqns) and legitimately no ordering chain.
+        # Caveat: a bucket size that swallows the whole tree also yields
+        # one bucket — that WARN means "your bucket config is inert",
+        # which is worth hearing too.
+        m = re.search(r":b(\d+)", art.fn_key)
+        if (m and int(m.group(1)) > 0 and n_scatter >= 2
+                and n_barrier == 0):
+            out.append(Finding(
+                rule="PRG205", severity=WARN, location=art.location,
+                message=f"{n_scatter} scatter collectives with no "
+                        f"optimization_barrier issue-order chain — "
+                        f"buckets can merge/reorder"))
+
+
+def _near_miss(sig_a, sig_b) -> Optional[str]:
+    """Classify two cache signatures as a near-miss recompile hazard.
+    Returns a human reason, or None when the recompile was legitimate
+    (shape change, different arity/structure)."""
+    try:
+        leaves_a, tree_a = sig_a
+        leaves_b, tree_b = sig_b
+    except (TypeError, ValueError):
+        return None
+    if tree_a != tree_b or len(leaves_a) != len(leaves_b):
+        return None
+    reasons = []
+    for i, (a, b) in enumerate(zip(leaves_a, leaves_b)):
+        if a == b:
+            continue
+        if isinstance(a, str) or isinstance(b, str):
+            # one side traced as a weak-typed python scalar
+            reasons.append(f"leaf {i}: python scalar vs array "
+                           f"({a!r} vs {b!r})")
+        elif (isinstance(a, tuple) and isinstance(b, tuple)
+                and len(a) >= 2 and len(b) >= 2 and a[0] == b[0]):
+            reasons.append(f"leaf {i}: same shape {a[0]}, dtype "
+                           f"{a[1]} vs {b[1]} (weak-type churn?)")
+        else:
+            return None  # a real shape/layout change: legitimate miss
+    return "; ".join(reasons) if reasons else None
+
+
+def _rule_recompile_hazard(art: ProgramArtifact,
+                           out: List[Finding]) -> None:
+    """PRG206: this miss differs from an already-cached signature only
+    in python-scalar/dtype leaves — the classic silent-recompile churn
+    (an int passed one call, np.int32 the next). One finding per
+    compile, naming the first near-miss sibling."""
+    if art.signature is None:
+        return
+    for sib in art.sibling_sigs:
+        reason = _near_miss(art.signature, sib)
+        if reason:
+            out.append(Finding(
+                rule="PRG206", severity=WARN, location=art.location,
+                message=f"near-miss recompile — signature churn, not a "
+                        f"shape change: {reason}. Pin the argument's "
+                        f"dtype (np.int32/np.float32) at the callsite"))
+            return
+
+
+_RULES = (
+    _rule_donation,
+    _rule_baked_constants,
+    _rule_dtype_promotion,
+    _rule_host_callback,
+    _rule_collectives,
+    _rule_recompile_hazard,
+)
+
+
+def lint_program(art: ProgramArtifact) -> List[Finding]:
+    """Run every program rule over one compile's artifacts."""
+    out: List[Finding] = []
+    for rule in _RULES:
+        rule(art, out)
+    return _apply_waivers(art, out)
+
+
+# --------------------------------------------------------------------------
+# the AOT-cache hook
+# --------------------------------------------------------------------------
+
+# (graph_key, fn_key) -> {"aliases": int|None, "findings": int} for every
+# train-kind compile this process performed — the donation-audit record
+_AUDIT: Dict[Tuple[str, str], dict] = {}
+_AUDIT_LOCK = threading.Lock()
+# dedup: a (rule, graph, kind) triple is reported once per process, so a
+# fallback-retracing loop cannot spam the log
+_REPORTED: set = set()
+
+
+def on_compile(key, traced, executable, sibling_keys=()) -> None:
+    """Called by ``optimize.aot_cache`` after each lower/compile miss
+    (under the cache lock — everything here is host-side and fast).
+    ``key`` = (graph_key, fn_key, signature); ``traced`` = the jax
+    Traced (or None); ``sibling_keys`` = cached keys sharing the
+    (graph_key, fn_key) prefix."""
+    graph_key, fn_key, signature = key[0], key[1], key[2]
+    art = ProgramArtifact(
+        graph_key=graph_key, fn_key=fn_key,
+        jaxpr=getattr(traced, "jaxpr", None),
+        executable=executable, signature=signature,
+        sibling_sigs=tuple(k[2] for k in sibling_keys))
+    findings = lint_program(art)
+    if art.is_train_kind():
+        with _AUDIT_LOCK:
+            _AUDIT[(graph_key, fn_key)] = {
+                "aliases": art.alias_count(),
+                "findings": len([f for f in findings if not f.waived]),
+            }
+    fresh = []
+    for f in findings:
+        k = (f.rule, graph_key, fn_key)
+        if k in _REPORTED:
+            continue
+        _REPORTED.add(k)
+        LOG.record(f)
+        fresh.append(f)
+    strict = [f for f in fresh if not f.waived and f.severity == ERROR]
+    if strict and _strict_mode():
+        raise ProgramLintError(strict)
+
+
+def _strict_mode() -> bool:
+    import os
+
+    return os.environ.get("DL4J_TPU_PROGRAM_LINT", "1") == "strict"
+
+
+def donation_audit() -> Dict[Tuple[str, str], dict]:
+    """Per-(graph_key, fn_key) donation record for every train-kind
+    executable compiled this process. An entry with ``aliases == 0``
+    is a step paying double params memory — the repo-clean test asserts
+    there are none."""
+    with _AUDIT_LOCK:
+        return dict(_AUDIT)
+
+
+def reset() -> None:
+    """Test hook: forget audit + dedup state (the findings LOG is owned
+    by the caller; clear it separately)."""
+    with _AUDIT_LOCK:
+        _AUDIT.clear()
+    _REPORTED.clear()
+
+
+# --------------------------------------------------------------------------
+# standalone entry (tests / `python -m deeplearning4j_tpu.analysis program`)
+# --------------------------------------------------------------------------
+
+def trace_artifact(jit_fn, args, graph_key: str = "adhoc",
+                   fn_key: str = "adhoc", compile: bool = True,
+                   sibling_sigs: Tuple = ()) -> ProgramArtifact:
+    """Build a ProgramArtifact from a jitted fn outside the cache —
+    fixture tests and the CLI drive rules through this without touching
+    process-global cache state."""
+    from deeplearning4j_tpu.optimize.aot_cache import signature_of
+
+    traced = jit_fn.trace(*args) if hasattr(jit_fn, "trace") else None
+    executable = None
+    if compile:
+        lowered = (traced.lower() if traced is not None
+                   else jit_fn.lower(*args))
+        executable = lowered.compile()
+    return ProgramArtifact(
+        graph_key=graph_key, fn_key=fn_key,
+        jaxpr=getattr(traced, "jaxpr", None),
+        executable=executable, signature=signature_of(args),
+        sibling_sigs=tuple(sibling_sigs))

@@ -1,0 +1,847 @@
+"""Source linter: AST checks for the repo's hand-enforced disciplines.
+
+Five invariants this codebase previously kept by review alone:
+
+- **SRC101 host-sync-in-compiled-fn** — no ``.item()`` /
+  ``block_until_ready`` / ``np.asarray`` / ``float()`` on traced values
+  inside functions that reach ``aot_cache`` (functions traced into a
+  compiled step). Most such calls explode only when their branch is
+  traced — a guard-mode branch no test covers ships the bug; the AST
+  check catches it on every branch.
+- **SRC102 unlocked-shared-mutation** — a shared registry that is
+  lock-guarded *somewhere* must be lock-guarded *everywhere* (the
+  batcher/registry thread rules PRs 5/6 hardened by hand). Functions
+  named ``*_locked`` are exempt by convention (their caller holds the
+  lock), as are ``__init__``/module-level construction.
+- **SRC103 wallclock-rng-in-compiled-fn** — ``time.time()`` or
+  unseeded RNG inside a compiled function executes ONCE at trace time
+  and bakes its value into the executable: a silent constant that is
+  also nondeterministic across processes.
+- **SRC105 dispatch-bracketing** — every fit dispatch loop keeps the
+  ``host_gap_close``/``host_gap_open`` pair, the
+  ``host_gap_reset``/``host_gap_stop`` fit bracket, and a reachable
+  ``fault_point`` kill site (the telemetry/resilience contracts from
+  PRs 6/7).
+- **SRC106 unused-import** — dead imports (re-exports via
+  ``import x as x``, ``__all__``, ``# noqa`` and availability probes in
+  ``try/except ImportError`` are exempt).
+
+Reachability ("reaches aot_cache") is a package-wide fixpoint: roots
+are functions passed to ``jax.jit`` / ``shard_map`` / ``lax.scan`` -
+family transforms (or returned by a builder whose result is), closure
+over nested defs, same-class ``self.x()`` calls, same-module calls, and
+imported-name calls across modules. Waive with
+``# dl4j: waive SRC1xx — reason`` on the flagged line (see
+``analysis.findings``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from deeplearning4j_tpu.analysis.findings import (
+    ERROR,
+    WARN,
+    Finding,
+    apply_waivers,
+    parse_waivers,
+)
+
+# jax transform entry points whose function-valued arguments are traced
+# (builtin-shadowing names like `map` are deliberately absent: `map(f,
+# xs)` is almost never `lax.map` and one false root taints everything f
+# transitively calls)
+JIT_LIKE = {
+    "jit", "shard_map", "scan", "while_loop", "fori_loop", "cond",
+    "switch", "vmap", "pmap", "grad", "value_and_grad", "checkpoint",
+    "remat", "custom_vjp", "custom_jvp", "associative_scan",
+}
+# receiver methods that force a host sync on a device value
+SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+# converter calls that force concretization when fed a traced value.
+# int() is deliberately absent: `int(key)` / `int(np.prod(shape))` on
+# static config params is pervasive trace-time idiom, and a traced-int
+# sync nearly always spells itself float()/.item() first.
+SYNC_CONVERTERS = {"float", "bool"}
+NP_SYNC_FUNCS = {"asarray", "array", "ascontiguousarray", "copyto", "save"}
+# container-mutating method names (SRC102)
+MUTATORS = {"append", "extend", "insert", "add", "discard", "remove",
+            "pop", "popitem", "popleft", "appendleft", "clear", "update",
+            "setdefault"}
+RNG_DRAW_FUNCS = {"random", "rand", "randn", "randint", "uniform",
+                  "normal", "choice", "shuffle", "permutation", "sample",
+                  "randrange", "getrandbits"}
+
+
+def _tail(node: ast.expr) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _base_name(node: ast.expr) -> str:
+    """Leading name of an attribute chain: ``np.random.rand`` -> 'np'."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else ""
+
+
+def _dotted(node: ast.expr) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_self_attr(node: ast.expr) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class FuncInfo:
+    """One function/method: identity, params, call edges, lexical
+    context — the unit the reachability fixpoint runs over."""
+
+    __slots__ = ("node", "module", "cls", "name", "params", "calls",
+                 "self_calls", "imported_calls", "jit_builder_calls",
+                 "returned_names", "nested", "compiled", "parent",
+                 "factory_vars")
+
+    def __init__(self, node, module: str, cls: Optional[str],
+                 parent: Optional["FuncInfo"]):
+        self.node = node
+        self.module = module
+        self.cls = cls
+        self.name = node.name
+        self.parent = parent
+        a = node.args
+        self.params = {p.arg for p in
+                       a.posonlyargs + a.args + a.kwonlyargs}
+        if a.vararg:
+            self.params.add(a.vararg.arg)
+        if a.kwarg:
+            self.params.add(a.kwarg.arg)
+        self.params.discard("self")
+        self.calls: Set[str] = set()            # bare-name calls
+        self.self_calls: Set[str] = set()       # self.X(...) calls
+        self.imported_calls: Set[Tuple[str, str]] = set()  # (alias, attr)
+        # factories whose RESULT went straight into a jit-like call:
+        # `jax.jit(self.fused_scan_fn(k))` — their returned fns are roots
+        self.jit_builder_calls: Set[str] = set()
+        self.returned_names: Set[str] = set()   # names this fn returns
+        self.nested: List["FuncInfo"] = []
+        self.compiled = False
+        # local name -> factory callee: `raw = self.train_step_fn(...)`.
+        # Nested compiled fns calling `raw(...)` resolve through this
+        # (the dominant builder idiom in nn/multilayer & friends).
+        self.factory_vars: Dict[str, str] = {}
+
+
+class ModuleAnalysis:
+    """Parse + index one module; rule application happens after the
+    package-wide compiled-function fixpoint."""
+
+    def __init__(self, path: str, text: str, relpath: str):
+        self.path = path
+        self.relpath = relpath
+        self.text = text
+        self.tree = ast.parse(text, filename=path)
+        self.is_init = os.path.basename(path) == "__init__.py"
+        self.funcs: List[FuncInfo] = []
+        # name -> module dotted path, for `import x.y as z` / `from p
+        # import mod` bindings used in cross-module call edges
+        self.module_aliases: Dict[str, str] = {}
+        # name -> (module dotted path, original name) for `from m import f`
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        # (enclosing FuncInfo or None for module level, root fn name)
+        self.jit_name_roots: List[Tuple[Optional[FuncInfo], str]] = []
+        self._index()
+
+    # -- indexing ------------------------------------------------------------
+    def _index(self) -> None:
+        self._collect_imports()
+        for node in self.tree.body:
+            self._walk_scope(node, cls=None, parent=None)
+        self._scan_module_level()
+
+    def _scan_module_level(self) -> None:
+        """jit-like calls outside any function (module/class level):
+        their Name args are roots resolved at module scope."""
+
+        def walk(node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return
+            if isinstance(node, ast.Call) and _tail(node.func) in JIT_LIKE:
+                for arg in self._fn_args(node):
+                    if isinstance(arg, ast.Name):
+                        self.jit_name_roots.append((None, arg.id))
+            for child in ast.iter_child_nodes(node):
+                walk(child)
+
+        for stmt in self.tree.body:
+            walk(stmt)
+
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for al in node.names:
+                    self.module_aliases[al.asname or
+                                        al.name.split(".")[0]] = al.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for al in node.names:
+                    if al.name == "*":
+                        continue
+                    self.from_imports[al.asname or al.name] = (
+                        node.module, al.name)
+
+    def _walk_scope(self, node, cls, parent) -> None:
+        if isinstance(node, ast.ClassDef):
+            for child in node.body:
+                self._walk_scope(child, cls=node.name, parent=parent)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fi = FuncInfo(node, self.relpath, cls, parent)
+            self.funcs.append(fi)
+            if parent is not None:
+                parent.nested.append(fi)
+            self._scan_body(fi, cls)
+            for deco in node.decorator_list:
+                d = deco.func if isinstance(deco, ast.Call) else deco
+                if _tail(d) in JIT_LIKE:
+                    fi.compiled = True
+        else:
+            for child in ast.iter_child_nodes(node):
+                self._walk_scope(child, cls=cls, parent=parent)
+
+    def _scan_body(self, fi: FuncInfo, cls) -> None:
+        """Record fi's call edges + jit roots; recurse into nested defs
+        as their own FuncInfo (their statements are NOT fi's)."""
+        factory_vars = fi.factory_vars  # local name -> factory callee
+
+        def visit(node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_scope(node, cls=cls, parent=fi)
+                return
+            if isinstance(node, ast.Lambda):
+                return  # lambdas: no statements to lint
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                callee = node.value.func
+                cname = (_is_self_attr(callee) or
+                         (callee.id if isinstance(callee, ast.Name)
+                          else ""))
+                if cname:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            factory_vars[tgt.id] = cname
+            if isinstance(node, ast.Return) and node.value is not None:
+                vals = (node.value.elts
+                        if isinstance(node.value, ast.Tuple)
+                        else [node.value])
+                for v in vals:
+                    if isinstance(v, ast.Name):
+                        fi.returned_names.add(v.id)
+            if isinstance(node, ast.Call):
+                f = node.func
+                sname = _is_self_attr(f)
+                if sname:
+                    fi.self_calls.add(sname)
+                elif isinstance(f, ast.Name):
+                    fi.calls.add(f.id)
+                elif isinstance(f, ast.Attribute):
+                    base = _base_name(f)
+                    if base and base != "self":
+                        fi.imported_calls.add((base, f.attr))
+                if _tail(f) in JIT_LIKE:
+                    for arg in self._fn_args(node):
+                        if isinstance(arg, ast.Name):
+                            # resolve later, in the scope that issued it
+                            self.jit_name_roots.append((fi, arg.id))
+                        elif isinstance(arg, ast.Call):
+                            # jit(self.fused_scan_fn(k)): the builder's
+                            # returned functions are the traced roots
+                            cal = arg.func
+                            cn = (_is_self_attr(cal) or
+                                  (cal.id if isinstance(cal, ast.Name)
+                                   else ""))
+                            if cn:
+                                fi.jit_builder_calls.add(cn)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for stmt in fi.node.body:
+            visit(stmt)
+
+    @staticmethod
+    def _fn_args(call: ast.Call) -> List[ast.expr]:
+        """Positional args of a jit-like call that can carry a function
+        (Name / Lambda / builder Call)."""
+        return [a for a in call.args
+                if isinstance(a, (ast.Name, ast.Lambda, ast.Call))]
+
+
+class SourceLinter:
+    """Package-wide pass: parse all modules, run the compiled-function
+    fixpoint across module boundaries, then apply rules per module."""
+
+    def __init__(self):
+        self.modules: Dict[str, ModuleAnalysis] = {}  # dotted -> analysis
+
+    # -- loading -------------------------------------------------------------
+    def add_file(self, path: str, root: str) -> None:
+        rel = os.path.relpath(path, root)
+        dotted = rel[:-3].replace(os.sep, ".")
+        if dotted.endswith(".__init__"):
+            dotted = dotted[: -len(".__init__")]
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        self.modules[dotted] = ModuleAnalysis(path, text, rel)
+
+    def add_source(self, text: str, name: str = "<fixture>") -> None:
+        self.modules[name] = ModuleAnalysis(name, text, name)
+
+    # -- reachability fixpoint ----------------------------------------------
+    def _func_index(self):
+        by_module: Dict[str, Dict[str, FuncInfo]] = {}
+        by_class: Dict[Tuple[str, str, str], FuncInfo] = {}
+        for dotted, mod in self.modules.items():
+            mfuncs = by_module.setdefault(dotted, {})
+            for fi in mod.funcs:
+                if fi.cls is None and fi.parent is None:
+                    mfuncs[fi.name] = fi
+                if fi.cls is not None:
+                    by_class[(dotted, fi.cls, fi.name)] = fi
+        return by_module, by_class
+
+    def mark_compiled(self) -> None:
+        by_module, by_class = self._func_index()
+
+        def resolve(dotted: str, mod: ModuleAnalysis, fi: FuncInfo,
+                    name: str) -> Optional[FuncInfo]:
+            # local defs shadow module scope
+            p = fi
+            while p is not None:
+                for n in p.nested:
+                    if n.name == name:
+                        return n
+                p = p.parent
+            if fi.cls is not None and (dotted, fi.cls, name) in by_class:
+                return by_class[(dotted, fi.cls, name)]
+            if name in by_module.get(dotted, {}):
+                return by_module[dotted][name]
+            if name in mod.from_imports:
+                src_mod, orig = mod.from_imports[name]
+                return by_module.get(src_mod, {}).get(orig)
+            return None
+
+        owner = {id(fi): (dotted, mod)
+                 for dotted, mod in self.modules.items()
+                 for fi in mod.funcs}
+
+        # seed: jit-root expressions (resolved in their issuing scope)
+        work: List[FuncInfo] = []
+
+        def seed(fi: Optional[FuncInfo]) -> None:
+            if fi is not None and not fi.compiled:
+                fi.compiled = True
+                work.append(fi)
+
+        def seed_factory_returns(dotted, mod, scope, factory) -> None:
+            """A factory whose result is traced (passed to jit, or
+            called from compiled code): its returned local defs are
+            compiled roots."""
+            bf = resolve(dotted, mod, scope, factory)
+            if bf is None:
+                return
+            for rname in bf.returned_names:
+                seed(resolve(dotted, mod, bf, rname))
+
+        for dotted, mod in self.modules.items():
+            for fi in mod.funcs:
+                if fi.compiled:
+                    work.append(fi)
+                # jit(self.builder(...)) seeds regardless of whether the
+                # CALLER is compiled — fit loops are host code
+                for factory in fi.jit_builder_calls:
+                    seed_factory_returns(dotted, mod, fi, factory)
+            for scope, name in mod.jit_name_roots:
+                if scope is not None:
+                    seed(resolve(dotted, mod, scope, name))
+                else:
+                    t = (by_module.get(dotted, {}).get(name)
+                         or self._from_import_func(mod, name, by_module))
+                    seed(t)
+
+        # propagate: nested defs, same-class/self calls, bare-name and
+        # cross-module calls, builder returns
+        while work:
+            fi = work.pop()
+            dotted, mod = owner[id(fi)]
+            for n in fi.nested:
+                if not n.compiled:
+                    n.compiled = True
+                    work.append(n)
+            for name in list(fi.calls) + list(fi.self_calls):
+                t = resolve(dotted, mod, fi, name)
+                if t is not None:
+                    seed(t)
+                    continue
+                # unresolved bare call from compiled code: maybe a
+                # factory-result variable bound here or in an enclosing
+                # builder scope (`raw = self.train_step_fn(); raw(x)`)
+                p = fi
+                while p is not None:
+                    if name in p.factory_vars:
+                        seed_factory_returns(dotted, mod, p,
+                                             p.factory_vars[name])
+                        break
+                    p = p.parent
+            for base, attr in fi.imported_calls:
+                target_mod = mod.module_aliases.get(base)
+                if target_mod is None and base in mod.from_imports:
+                    target_mod = ".".join(mod.from_imports[base])
+                t = self._module_func(target_mod, attr)
+                if t is not None:
+                    seed(t)
+
+    @staticmethod
+    def _from_import_func(mod: ModuleAnalysis, name: str,
+                          by_module) -> Optional[FuncInfo]:
+        if name in mod.from_imports:
+            src_mod, orig = mod.from_imports[name]
+            return by_module.get(src_mod, {}).get(orig)
+        return None
+
+    def _module_func(self, dotted: Optional[str],
+                     name: str) -> Optional[FuncInfo]:
+        if dotted is None:
+            return None
+        mod = self.modules.get(dotted)
+        if mod is None:
+            return None
+        for fi in mod.funcs:
+            if fi.cls is None and fi.parent is None and fi.name == name:
+                return fi
+        return None
+
+    # -- run -----------------------------------------------------------------
+    def run(self, today: Optional[str] = None) -> List[Finding]:
+        self.mark_compiled()
+        out: List[Finding] = []
+        for mod in self.modules.values():
+            findings = []
+            for fi in mod.funcs:
+                if fi.compiled:
+                    _rule_host_sync(mod, fi, findings)
+                    _rule_wallclock_rng(mod, fi, findings)
+            _rule_lock_discipline(mod, findings)
+            _rule_dispatch_bracketing(mod, findings)
+            _rule_unused_imports(mod, findings)
+            apply_waivers(findings, parse_waivers(mod.text), mod.relpath,
+                          today=today)
+            out.extend(findings)
+        return out
+
+
+# --------------------------------------------------------------------------
+# per-function rules (compiled functions only)
+# --------------------------------------------------------------------------
+
+def _own_statements(fi: FuncInfo):
+    """Walk fi's body, NOT descending into nested function defs (each
+    nested def is linted as its own FuncInfo)."""
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            yield child
+            yield from walk(child)
+
+    for stmt in fi.node.body:
+        yield stmt
+        yield from walk(stmt)
+
+
+def _refs_param(fi: FuncInfo, node: ast.AST) -> bool:
+    return bool(_names_in(node) & fi.params)
+
+
+def _rule_host_sync(mod: ModuleAnalysis, fi: FuncInfo,
+                    out: List[Finding]) -> None:
+    loc = lambda n: f"{mod.relpath}:{n.lineno}"  # noqa: E731
+    for node in _own_statements(fi):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        # value.item() / value.block_until_ready() on a traced param
+        if (isinstance(f, ast.Attribute) and f.attr in SYNC_METHODS
+                and _refs_param(fi, f.value)):
+            out.append(Finding(
+                rule="SRC101", severity=ERROR, location=loc(node),
+                message=f".{f.attr}() on a traced value inside compiled "
+                        f"function {fi.name!r} forces a host sync"))
+        # jax.device_get(anything) inside a compiled fn
+        elif isinstance(f, ast.Attribute) and f.attr == "device_get":
+            out.append(Finding(
+                rule="SRC101", severity=ERROR, location=loc(node),
+                message=f"jax.device_get inside compiled function "
+                        f"{fi.name!r}"))
+        # np.asarray(param-derived) and friends
+        elif (isinstance(f, ast.Attribute) and f.attr in NP_SYNC_FUNCS
+                and _base_name(f) in ("np", "numpy", "onp")
+                and node.args and _refs_param(fi, node.args[0])):
+            out.append(Finding(
+                rule="SRC101", severity=ERROR, location=loc(node),
+                message=f"numpy.{f.attr} on a traced value inside "
+                        f"compiled function {fi.name!r} — use jnp, or "
+                        f"hoist to the host side"))
+        # float(x)/int(x)/bool(x) on a param-derived expression
+        elif (isinstance(f, ast.Name) and f.id in SYNC_CONVERTERS
+                and node.args and _refs_param(fi, node.args[0])):
+            out.append(Finding(
+                rule="SRC101", severity=ERROR, location=loc(node),
+                message=f"{f.id}() on a traced value inside compiled "
+                        f"function {fi.name!r} forces concretization"))
+
+
+def _rule_wallclock_rng(mod: ModuleAnalysis, fi: FuncInfo,
+                        out: List[Finding]) -> None:
+    for node in _own_statements(fi):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        if dotted in ("time.time", "time.perf_counter",
+                      "time.monotonic", "time.perf_counter_ns",
+                      "datetime.datetime.now", "datetime.datetime.utcnow"):
+            out.append(Finding(
+                rule="SRC103", severity=ERROR,
+                location=f"{mod.relpath}:{node.lineno}",
+                message=f"{dotted}() inside compiled function "
+                        f"{fi.name!r}: runs once at trace time and "
+                        f"bakes a stale wall-clock constant into the "
+                        f"executable"))
+        elif (isinstance(node.func, ast.Attribute)
+                and node.func.attr in RNG_DRAW_FUNCS
+                and _dotted(node.func).split(".")[0] in
+                ("np", "numpy", "random")
+                and ".random" in "." + _dotted(node.func)):
+            out.append(Finding(
+                rule="SRC103", severity=ERROR,
+                location=f"{mod.relpath}:{node.lineno}",
+                message=f"unseeded host RNG ({_dotted(node.func)}) "
+                        f"inside compiled function {fi.name!r}: traced "
+                        f"once, baked in, nondeterministic across "
+                        f"processes — use jax.random with a threaded "
+                        f"key"))
+
+
+# --------------------------------------------------------------------------
+# module-wide rules
+# --------------------------------------------------------------------------
+
+def _lockish(expr: ast.expr) -> bool:
+    name = _tail(expr).lower()
+    return "lock" in name or "cond" in name or "mutex" in name
+
+
+def _rule_lock_discipline(mod: ModuleAnalysis,
+                          out: List[Finding]) -> None:
+    """SRC102: collect every mutation of module-global containers and
+    ``self.X`` targets, note which targets are EVER mutated under a
+    lock-ish ``with``, then flag the unlocked mutations of those same
+    targets."""
+    # mutation = (target_key, lineno, locked, func_name, at_module_level)
+    mutations: List[Tuple[Tuple, int, bool, Optional[str], bool]] = []
+
+    module_globals: Set[str] = set()
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    module_globals.add(tgt.id)
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.with_locks = 0
+            self.func_stack: List[Tuple[Optional[str], str]] = []
+            self.cls: Optional[str] = None
+
+        # -- context tracking
+        def visit_ClassDef(self, node):
+            prev, self.cls = self.cls, node.name
+            self.generic_visit(node)
+            self.cls = prev
+
+        def _visit_func(self, node):
+            self.func_stack.append((self.cls, node.name))
+            saved, self.with_locks = self.with_locks, 0
+            self.generic_visit(node)
+            self.with_locks = saved
+            self.func_stack.pop()
+
+        visit_FunctionDef = _visit_func
+        visit_AsyncFunctionDef = _visit_func
+
+        def visit_With(self, node):
+            locked = any(_lockish(item.context_expr)
+                         for item in node.items)
+            if locked:
+                self.with_locks += 1
+            self.generic_visit(node)
+            if locked:
+                self.with_locks -= 1
+
+        # -- mutation collection
+        def _target_key(self, expr) -> Optional[Tuple]:
+            attr = _is_self_attr(expr)
+            if attr is not None:
+                return ("self", self.cls, attr)
+            if isinstance(expr, ast.Name) and expr.id in module_globals:
+                return ("global", expr.id)
+            return None
+
+        def _record(self, key, lineno):
+            if key is None:
+                return
+            fname = self.func_stack[-1][1] if self.func_stack else None
+            mutations.append((key, lineno, self.with_locks > 0, fname,
+                              not self.func_stack))
+
+        def visit_Assign(self, node):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    self._record(self._target_key(tgt.value), node.lineno)
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            tgt = node.target
+            if isinstance(tgt, ast.Subscript):
+                self._record(self._target_key(tgt.value), node.lineno)
+            else:
+                self._record(self._target_key(tgt), node.lineno)
+            self.generic_visit(node)
+
+        def visit_Delete(self, node):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    self._record(self._target_key(tgt.value), node.lineno)
+            self.generic_visit(node)
+
+        def visit_Call(self, node):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in MUTATORS:
+                self._record(self._target_key(f.value), node.lineno)
+            self.generic_visit(node)
+
+    V().visit(mod.tree)
+
+    locked_targets = {m[0] for m in mutations if m[2]}
+    for key, lineno, locked, fname, at_module in mutations:
+        if key not in locked_targets or locked or at_module:
+            continue
+        if fname in ("__init__", "__new__", "__del__", "__post_init__"):
+            continue  # construction: not shared yet / teardown
+        if fname and fname.endswith("_locked"):
+            continue  # convention: caller holds the lock
+        target = (f"self.{key[2]}" if key[0] == "self" else key[1])
+        out.append(Finding(
+            rule="SRC102", severity=WARN,
+            location=f"{mod.relpath}:{lineno}",
+            message=f"{target} is lock-guarded elsewhere but mutated "
+                    f"here without the lock (in {fname!r}) — take the "
+                    f"lock, or rename the function *_locked if the "
+                    f"caller holds it"))
+
+
+def _rule_dispatch_bracketing(mod: ModuleAnalysis,
+                              out: List[Finding]) -> None:
+    """SRC105: (a) ``host_gap_close`` without ``host_gap_open`` in the
+    same function; (b) ``host_gap_reset`` and ``host_gap_stop`` must
+    travel together; (c) a dispatching function (calls host_gap_close)
+    with no ``fault_point`` in itself or any same-module caller is a
+    step the chaos layer cannot kill."""
+    calls_by_func: Dict[int, Set[str]] = {}
+    for fi in mod.funcs:
+        names = set()
+        for node in _own_statements(fi):
+            if isinstance(node, ast.Call):
+                t = _tail(node.func)
+                if t:
+                    names.add(t)
+        calls_by_func[id(fi)] = names
+
+    # same-module reverse call graph (bare + self + module-attr calls all
+    # reduce to trailing-name matching here: good enough for "is there a
+    # kill site above this dispatch loop")
+    callers: Dict[str, Set[int]] = {}
+    for fi in mod.funcs:
+        for name in (fi.calls | fi.self_calls |
+                     {a for _, a in fi.imported_calls}):
+            callers.setdefault(name, set()).add(id(fi))
+    by_id = {id(fi): fi for fi in mod.funcs}
+
+    def reachable_upward(fi: FuncInfo, needle: str,
+                         depth: int = 3) -> bool:
+        seen, frontier = {id(fi)}, [id(fi)]
+        for _ in range(depth):
+            nxt = []
+            for fid in frontier:
+                if needle in calls_by_func.get(fid, ()):
+                    return True
+                for up in callers.get(by_id[fid].name, ()):
+                    if up not in seen:
+                        seen.add(up)
+                        nxt.append(up)
+            frontier = nxt
+        return any(needle in calls_by_func.get(fid, ()) for fid in seen)
+
+    for fi in mod.funcs:
+        names = calls_by_func[id(fi)]
+        line = fi.node.lineno
+        loc = f"{mod.relpath}:{line}"
+        if "host_gap_close" in names and "host_gap_open" not in names:
+            out.append(Finding(
+                rule="SRC105", severity=WARN, location=loc,
+                message=f"{fi.name!r} calls host_gap_close but never "
+                        f"host_gap_open — the gap clock stays disarmed "
+                        f"and every later step's gap is lost"))
+        if "host_gap_reset" in names and "host_gap_stop" not in names:
+            # the reverse (stop without reset) is a legitimate disarm —
+            # fit_batch-style single steps stop a clock someone else arms
+            out.append(Finding(
+                rule="SRC105", severity=WARN, location=loc,
+                message=f"{fi.name!r} arms the gap clock "
+                        f"(host_gap_reset) but never disarms it "
+                        f"(host_gap_stop in a finally) — idle time "
+                        f"after the last dispatch records as host gap"))
+        if ("host_gap_close" in names
+                and not reachable_upward(fi, "fault_point")):
+            out.append(Finding(
+                rule="SRC105", severity=WARN, location=loc,
+                message=f"dispatch loop {fi.name!r} has no fault_point "
+                        f"kill site in itself or its callers — "
+                        f"resilience chaos plans cannot preempt it"))
+
+
+def _rule_unused_imports(mod: ModuleAnalysis,
+                         out: List[Finding]) -> None:
+    """SRC106: imported names never referenced. Exemptions: explicit
+    re-exports (``import x as x`` / ``__all__``), ``__future__``,
+    TYPE_CHECKING blocks, availability probes (``try: import m`` with an
+    ImportError handler), ``# noqa`` lines, and ``__init__.py`` files
+    (a package __init__'s imports ARE its public API)."""
+    if mod.is_init:
+        return
+    lines = mod.text.splitlines()
+    dunder_all: Set[str] = set()
+    for node in mod.tree.body:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "__all__"
+                        for t in node.targets)
+                and isinstance(node.value, (ast.List, ast.Tuple))):
+            dunder_all = {e.value for e in node.value.elts
+                          if isinstance(e, ast.Constant)}
+
+    probe_lines: Set[int] = set()
+    type_check_lines: Set[int] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Try):
+            if any(_handles_import_error(h) for h in node.handlers):
+                for sub in ast.walk(node):
+                    if isinstance(sub, (ast.Import, ast.ImportFrom)):
+                        probe_lines.add(sub.lineno)
+        if (isinstance(node, ast.If)
+                and "TYPE_CHECKING" in _names_in(node.test)):
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Import, ast.ImportFrom)):
+                    type_check_lines.add(sub.lineno)
+
+    used: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            used.add(_base_name(node))
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue
+        if isinstance(node, ast.ImportFrom) and \
+                node.module == "__future__":
+            continue
+        if node.lineno in probe_lines or node.lineno in type_check_lines:
+            continue
+        for al in node.names:
+            if al.name == "*":
+                continue
+            bound = al.asname or al.name.split(".")[0]
+            if isinstance(node, ast.ImportFrom):
+                bound = al.asname or al.name
+                if al.asname == al.name:
+                    continue  # PEP 484 explicit re-export
+            if bound in used or bound in dunder_all:
+                continue
+            # multi-line froms: the name may sit lines below node.lineno
+            for ln in range(node.lineno,
+                            getattr(node, "end_lineno", node.lineno) + 1):
+                if ln - 1 < len(lines) and "noqa" in lines[ln - 1] \
+                        and (bound in lines[ln - 1]
+                             or node.lineno == ln):
+                    break
+            else:
+                out.append(Finding(
+                    rule="SRC106", severity=WARN,
+                    location=f"{mod.relpath}:{node.lineno}",
+                    message=f"unused import {bound!r}"))
+
+
+def _handles_import_error(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = {_tail(e) for e in
+             (t.elts if isinstance(t, ast.Tuple) else [t])}
+    return bool(names & {"ImportError", "ModuleNotFoundError",
+                         "Exception"})
+
+
+# --------------------------------------------------------------------------
+# entry points
+# --------------------------------------------------------------------------
+
+def lint_paths(root: str, today: Optional[str] = None) -> List[Finding]:
+    """Lint every .py file under ``root`` as one package (cross-module
+    reachability enabled)."""
+    linter = SourceLinter()
+    pkg_root = os.path.dirname(os.path.abspath(root).rstrip(os.sep))
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in sorted(dirnames)
+                       if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                linter.add_file(os.path.join(dirpath, fn), pkg_root)
+    return linter.run(today=today)
+
+
+def lint_source(text: str, name: str = "<fixture>",
+                today: Optional[str] = None) -> List[Finding]:
+    """Lint one module from a string (fixture tests)."""
+    linter = SourceLinter()
+    linter.add_source(text, name)
+    return linter.run(today=today)

@@ -34,7 +34,6 @@ import jax.numpy as jnp
 from deeplearning4j_tpu import serde
 from deeplearning4j_tpu.conf import inputs as it
 from deeplearning4j_tpu.conf.layers import (
-    BaseLayer,
     CnnToFeedForwardPreProcessor,
     DenseLayer,
     Layer,

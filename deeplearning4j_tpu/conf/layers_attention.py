@@ -24,7 +24,6 @@ Sequence data layout is ``[batch, time, features]`` (see layers_rnn.py);
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional
 
 import jax
 import jax.numpy as jnp

@@ -20,7 +20,6 @@ from jax import lax
 
 from deeplearning4j_tpu import serde
 from deeplearning4j_tpu.conf import inputs as it
-from deeplearning4j_tpu.conf.activations import Activation
 from deeplearning4j_tpu.conf.layers import BaseLayer, Layer
 from deeplearning4j_tpu.conf.layers_cnn import ConvolutionMode, PoolingType
 from deeplearning4j_tpu.conf.layers_rnn import (

@@ -25,14 +25,13 @@ shape-IOU against the truth box, as in the reference.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu import serde
-from deeplearning4j_tpu.conf import inputs as it
 from deeplearning4j_tpu.conf.layers import Layer
 
 

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import List, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -11,7 +11,6 @@ deterministic learnable synthetic set.
 from __future__ import annotations
 
 import os
-import string
 from pathlib import Path
 from typing import Optional, Tuple
 

@@ -23,7 +23,6 @@ from pathlib import Path
 
 import numpy as np
 
-from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
 
 _CACHE = Path(os.path.expanduser("~/.deeplearning4j_tpu/mnist"))

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 from deeplearning4j_tpu import serde
 from deeplearning4j_tpu.datavec.schema import Schema

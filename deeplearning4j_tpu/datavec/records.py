@@ -16,7 +16,7 @@ import re
 from pathlib import Path
 from typing import Iterator, List, Optional, Sequence
 
-from deeplearning4j_tpu.datavec.split import InputSplit, StringSplit
+from deeplearning4j_tpu.datavec.split import InputSplit
 
 
 class RecordReader:

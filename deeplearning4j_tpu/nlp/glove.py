@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import functools
 from collections import defaultdict
-from typing import Iterable, List, Optional
+from typing import Iterable, Optional
 
 import jax
 import jax.numpy as jnp

@@ -7,9 +7,7 @@ the classic text format (one ``word v1 v2 ...`` line per word, first line
 
 from __future__ import annotations
 
-import io
 import zipfile
-from typing import Optional
 
 import numpy as np
 

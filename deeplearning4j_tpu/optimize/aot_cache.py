@@ -166,7 +166,8 @@ _ID_PINNED: list = []
 
 def pin_id(obj) -> int:
     """-> id(obj), with obj kept alive for the life of the cache."""
-    _ID_PINNED.append(obj)
+    with _LOCK:  # clear() mutates the pin list under the same lock
+        _ID_PINNED.append(obj)
     return id(obj)
 
 
@@ -186,6 +187,41 @@ def graph_signature(obj, fallback=None) -> str:
     if r and "..." not in r:
         return hashlib.sha1(r.encode()).hexdigest()
     return f"id:{pin_id(obj if fallback is None else fallback)}"
+
+
+# the compile-time program linter (analysis.program.on_compile), bound
+# lazily on the first miss so importing this module never imports the
+# analysis package; DL4J_TPU_PROGRAM_LINT=0 leaves it unbound
+_LINT_HOOK = None
+_LINT_INIT = False
+
+
+def _program_lint(key, traced, exe) -> None:
+    """Run the program linter over one fresh compile (caller holds
+    ``_LOCK``). Lint failures never break a compile — except in strict
+    mode, where ProgramLintError is the point."""
+    global _LINT_HOOK, _LINT_INIT
+    if not _LINT_INIT:
+        _LINT_INIT = True
+        import os
+
+        if os.environ.get("DL4J_TPU_PROGRAM_LINT", "1") != "0":
+            try:
+                from deeplearning4j_tpu.analysis import program
+
+                _LINT_HOOK = program.on_compile
+            except Exception:
+                _LINT_HOOK = None
+    if _LINT_HOOK is None:
+        return
+    try:
+        siblings = [k for k in _EXECUTABLES
+                    if k[:2] == key[:2] and k != key]
+        _LINT_HOOK(key, traced, exe, siblings)
+    except Exception as e:
+        if type(e).__name__ == "ProgramLintError":
+            raise  # strict mode: surface the findings to the caller
+        # any other lint crash must never take down a working compile
 
 
 class AotStep:
@@ -217,9 +253,22 @@ class AotStep:
             STATS.record_overflow()
             return None, False
         t0 = time.perf_counter()
-        exe = self._jit.lower(*args).compile()
+        # trace and lower as separate stages when this jax supports it:
+        # .lower() runs the same trace internally, but splitting keeps
+        # the jaxpr available for the program linter at zero extra cost
+        traced = None
+        trace = getattr(self._jit, "trace", None)
+        if trace is not None:
+            try:
+                traced = trace(*args)
+            except Exception:
+                traced = None
+        lowered = (traced.lower() if traced is not None
+                   else self._jit.lower(*args))
+        exe = lowered.compile()
         STATS.record_miss(key, time.perf_counter() - t0)
         _EXECUTABLES[key] = exe
+        _program_lint(key, traced, exe)
         return exe, True
 
     def __call__(self, *args):

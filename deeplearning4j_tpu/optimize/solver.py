@@ -12,7 +12,6 @@ this entire pipeline is one fused XLA program.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.conf.layers import GradientNormalization

@@ -26,7 +26,6 @@ last checkpoint (``CheckpointListener`` / ``ModelSerializer``).
 
 from __future__ import annotations
 
-import math
 from typing import Optional
 
 import jax

@@ -904,8 +904,6 @@ class PipelineParallelWrapper:
         return total
 
     def _build(self, feats):
-        import jax.tree_util as jtu
-
         model = self.model
         S = self.n_stages
         self._infer_shapes(feats)

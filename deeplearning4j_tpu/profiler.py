@@ -23,7 +23,7 @@ import contextlib
 import dataclasses
 import os
 import time
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 import jax
 

@@ -101,7 +101,7 @@ class CircuitBreaker:
             if self._state == OPEN:
                 if self._clock() - self._opened_at \
                         >= self.recovery_timeout_s:
-                    self._to_half_open()
+                    self._to_half_open_locked()
                 else:
                     return False
             # HALF_OPEN (possibly just entered)
@@ -127,7 +127,7 @@ class CircuitBreaker:
             if self._state == HALF_OPEN:
                 self._probe_successes += 1
                 if self._probe_successes >= self.success_threshold:
-                    self._to_closed()
+                    self._to_closed_locked()
                 else:
                     self._probe_tickets += 1  # next probe may proceed
 
@@ -136,34 +136,34 @@ class CircuitBreaker:
             self._window.append(False)
             self._consecutive_failures += 1
             if self._state == HALF_OPEN:
-                self._to_open()  # a failed probe re-opens immediately
+                self._to_open_locked()  # a failed probe re-opens immediately
                 return
             if self._state != CLOSED:
                 return
             if self._consecutive_failures >= self.failure_threshold:
-                self._to_open()
+                self._to_open_locked()
                 return
             if (self.failure_rate is not None
                     and len(self._window) >= self.window_size
                     and (self._window.count(False) / len(self._window)
                          >= self.failure_rate)):
-                self._to_open()
+                self._to_open_locked()
 
     # --- state (locked callers only) ---------------------------------------
-    def _to_open(self):
+    def _to_open_locked(self):
         self._state = OPEN
         self._opened_at = self._clock()
         self.tripped_total += 1
         self._publish(OPEN)
 
-    def _to_half_open(self):
+    def _to_half_open_locked(self):
         self._state = HALF_OPEN
         self._probe_tickets = self.half_open_probes
         self._probe_successes = 0
         self._probe_issued_at = self._clock()
         self._publish(HALF_OPEN)
 
-    def _to_closed(self):
+    def _to_closed_locked(self):
         self._state = CLOSED
         self._consecutive_failures = 0
         self._window.clear()
@@ -183,7 +183,7 @@ class CircuitBreaker:
             # a probe submit first (scrapes read the truth)
             if self._state == OPEN and (self._clock() - self._opened_at
                                         >= self.recovery_timeout_s):
-                self._to_half_open()
+                self._to_half_open_locked()
             return self._state
 
     def status(self) -> dict:

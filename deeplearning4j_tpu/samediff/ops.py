@@ -15,8 +15,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.samediff.core import (OP_REGISTRY, SDVariable,
-                                              register_op)
+from deeplearning4j_tpu.samediff.core import register_op
 
 
 class _Namespace:

@@ -167,9 +167,14 @@ def make_train_step(sd, cfg: TrainingConfig):
     cfg_key = aot_cache.graph_signature(
         (repr(updater), tuple(map(repr, regs)), sign, loss_names),
         fallback=cfg)
-    step = aot_cache.wrap(jax.jit(train_step),
+    # donate trainables + opt state (argnums 0, 2): every step's outputs
+    # reuse the previous step's buffers instead of allocating a second
+    # copy of the model — the same aliasing contract the network train
+    # steps carry (PRG201). fit() stages per-fit copies so ``sd.arrays``
+    # never aliases a donated buffer.
+    step = aot_cache.wrap(jax.jit(train_step, donate_argnums=(0, 2)),
                           "sd:" + sd.graph_signature(),
-                          f"train_step:{cfg_key}{health.cache_tag()}")
+                          f"train_step:d02:{cfg_key}{health.cache_tag()}")
     return step, trainable_names, loss_names
 
 
@@ -194,13 +199,18 @@ def fit(sd, iterator=None, epochs: int = 1, features=None, labels=None):
         sd._fn_cache["__train_step__"] = cached
     step, trainable_names, _ = cached[2]
 
-    trainables = {n: sd.arrays[n] for n in trainable_names}
+    # the step DONATES trainables + opt state, so the loop must own its
+    # buffers: stage device COPIES at fit entry (one copy per fit, not
+    # per step) — ``sd.arrays`` / ``sd._updater_state`` keep their own
+    # live arrays until the final write-back below, and a fit that dies
+    # mid-run never leaves the graph pointing at deleted donated buffers
+    trainables = {n: jnp.array(sd.arrays[n]) for n in trainable_names}
     frozen = {k: v for k, v in sd.arrays.items()
               if k not in set(trainable_names)}
     if sd._updater_state is None:
         sd._updater_state = {n: cfg.updater.init_state(trainables[n])
                              for n in trainable_names}
-    opt_state = sd._updater_state
+    opt_state = jax.tree_util.tree_map(jnp.array, sd._updater_state)
     history = History()
 
     def batches():

@@ -18,7 +18,7 @@ import numpy as np
 from deeplearning4j_tpu.samediff import ops as _ops  # noqa: F401  — importing
 # populates OP_REGISTRY (namespaces are otherwise lazy; a validate() call
 # before any namespace use must still see the full registry)
-from deeplearning4j_tpu.samediff.core import OP_REGISTRY, SameDiff, SDVariable
+from deeplearning4j_tpu.samediff.core import OP_REGISTRY, SameDiff
 
 _VALIDATED: set[str] = set()
 

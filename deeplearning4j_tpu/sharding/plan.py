@@ -32,7 +32,6 @@ import hashlib
 import json
 import threading
 import weakref
-from typing import Optional
 
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import difflib
 import re
-from typing import List, Sequence, Tuple
+from typing import List, Tuple
 
 import numpy as np
 from jax.sharding import PartitionSpec as P

@@ -247,6 +247,16 @@ def record_fault_injected(site: str, action: str) -> None:
                      site=site, action=action).inc()
 
 
+def record_analysis_finding(rule: str, severity: str) -> None:
+    """Count one unwaived static-analysis finding (the program linter
+    records at compile time, so a live process's ``/metrics`` shows what
+    lint saw without re-running the CLI). Unconditional like the other
+    control-plane events: findings are per-compile, never per-step."""
+    REGISTRY.counter("dl4j_analysis_findings_total",
+                     help="static-analysis findings (analysis/ linters)",
+                     rule=rule, severity=severity).inc()
+
+
 def record_circuit_state(name: str, state_code: int,
                          transition: bool = True) -> None:
     """Publish a breaker's state (0=closed, 1=half_open, 2=open); counts

@@ -202,6 +202,16 @@ class UIServer:
 
                     payload = _json.dumps(plans_summary()).encode()
                     ctype = "application/json"
+                elif self.path == "/analysis":
+                    # compile-time program-lint findings accumulated by
+                    # this process (analysis.findings.LOG): what the
+                    # jaxpr/HLO rules flagged on every AOT-cache miss,
+                    # plus per-(rule, severity) totals — the scriptable
+                    # twin of dl4j_analysis_findings_total
+                    from deeplearning4j_tpu.analysis.findings import LOG
+
+                    payload = _json.dumps(LOG.snapshot()).encode()
+                    ctype = "application/json"
                 elif self.path == "/health":
                     # training-health probe (telemetry.health): policy,
                     # anomaly counts, last guard readings — the liveness/
