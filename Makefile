@@ -55,6 +55,17 @@ shard-smoke:
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	$(PY) -m pytest tests -q -m sharding -p no:cacheprovider
 
+.PHONY: decode-smoke
+# Continuous-batching generation smoke: KV-cache math vs the no-cache
+# oracle, continuous-vs-sequential token identity, late-join/EOS-retire
+# scheduling, breaker/deadline admission, zero recompiles after warmup —
+# then the closed-loop token-throughput bench in smoke mode (continuous
+# must beat sequential on aggregate tokens/s).
+decode-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests -q -m decode \
+		-p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) bench_decode.py --smoke
+
 .PHONY: lint
 # Repo-discipline source lint (analysis/source.py AST rules): host syncs
 # in compiled functions, lock discipline on shared registries, wall-clock/

@@ -1,0 +1,202 @@
+"""Closed-loop token-throughput benchmark for continuous-batching decode
+(ISSUE 11 acceptance): a mixed prompt/output-length workload runs twice
+through the SAME compiled KV-cache executables,
+
+  sequential — one request at a time to completion (occupancy 1: the
+               per-request generation loop every pre-continuous server
+               runs, ``TransformerDecoder.generate``), and
+  continuous — the iteration-level scheduler
+               (``parallel.generation.GenerationEngine``): sequences
+               join and retire the running batch every K-token window,
+               so freed KV rows never sit idle.
+
+Reports aggregate tokens/s for both modes, the speedup, the prefill vs
+decode wall-time split, p50/p95 per-token latency and time-to-first-
+token, recompiles after warmup (must be 0), and a greedy token-identity
+check (continuous output must equal sequential bit-for-bit). Writes
+``bench_decode.json``; ``BENCH_decode_r01.json`` is the committed
+round-1 baseline.
+
+Methodology + honest caveats (docs/serving.md has the full discussion):
+- CPU proxy by default — absolute tokens/s is meaningless off-chip; the
+  CONTRAST is the result. Both modes share every executable, so the
+  speedup isolates scheduling, not kernels.
+- The sequential baseline still pads its single row to the same
+  ``max_batch``-wide decode executable: per-step device cost is roughly
+  equal across modes on the CPU proxy, and the continuous win is pure
+  occupancy (more sequences advanced per identically-priced window).
+  On a real chip a batch-1 decode executable would be cheaper per step,
+  but it would also recompile per occupancy level — exactly the
+  request-granularity pathology this subsystem removes.
+- ``--smoke`` (the ``make decode-smoke`` leg) runs a small workload and
+  asserts speedup > 1, token identity, and zero recompiles.
+"""
+
+import argparse
+import json
+import os
+import random
+import time
+
+
+def _pin_cpu():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+
+    try:
+        jax.config.update("jax_platforms",
+                          os.environ.get("JAX_PLATFORMS", "cpu"))
+    except Exception:
+        pass
+
+
+def _workload(n, vocab, max_len, seed):
+    """Mixed closed-loop workload: prompts 2..max_len//3 tokens, outputs
+    3..max_len//2 tokens, lengths drawn from a seeded stream so the two
+    modes (and two rounds) see identical traffic."""
+    rng = random.Random(seed)
+    reqs = []
+    for _ in range(n):
+        plen = rng.randint(2, max_len // 3)
+        mnew = rng.randint(3, min(max_len // 2, max_len - plen))
+        prompt = [rng.randrange(vocab) for _ in range(plen)]
+        reqs.append((prompt, mnew))
+    return reqs
+
+
+def _quantiles(snap, name):
+    h = snap.get(name)
+    if not isinstance(h, dict) or not h.get("count"):
+        return None
+    return {"p50": h["p50"], "p95": h["p95"], "count": h["count"]}
+
+
+def bench(args):
+    if not args.tpu:
+        _pin_cpu()
+    from deeplearning4j_tpu.optimize import aot_cache
+    from deeplearning4j_tpu.parallel.generation import (
+        GenerationConfig,
+        GenerationEngine,
+    )
+    from deeplearning4j_tpu.telemetry import REGISTRY
+    from deeplearning4j_tpu.zoo.graphs import TransformerEncoder
+
+    model = TransformerEncoder(
+        vocab_size=args.vocab, embed_dim=args.embed, n_heads=args.heads,
+        n_layers=args.layers, max_len=args.max_len, causal=True,
+        lm_head=True, seed=123)
+    dec = model.decoder(max_batch=args.max_batch,
+                        kv_bucket_min=args.max_len // 4,
+                        prompt_bucket_min=8)
+    eng = GenerationEngine(dec, GenerationConfig(
+        max_batch=args.max_batch, fused_steps=args.fused_steps,
+        kv_bucket_min=args.max_len // 4, prompt_bucket_min=8))
+    warm = eng.warmup()
+    print(f"warmup: {warm['compiled']} executables in "
+          f"{warm['compile_seconds']}s "
+          f"(kv {warm['kv_buckets']}, prompt {warm['prompt_buckets']}, "
+          f"join {warm['join_buckets']}, K {warm['fused_steps']})")
+    reqs = _workload(args.requests, args.vocab, args.max_len, args.seed)
+    miss0 = aot_cache.stats()["misses"]
+
+    # sequential per-request generation (the baseline being replaced)
+    t0 = time.monotonic()
+    seq_out = [dec.generate(p, mn, fused_steps=args.fused_steps)
+               for p, mn in reqs]
+    seq_s = time.monotonic() - t0
+    seq_tokens = sum(len(o) for o in seq_out)
+
+    # continuous: submit everything, the engine streams requests through
+    # max_batch rows at token granularity (the per-token / TTFT
+    # histograms below are engine-only series, so they describe this
+    # mode alone)
+    st0 = eng.stats()
+    t0 = time.monotonic()
+    handles = [eng.submit(p, max_new_tokens=mn) for p, mn in reqs]
+    cont_out = [eng.result(h) for h in handles]
+    cont_s = time.monotonic() - t0
+    cont_tokens = sum(len(o) for o in cont_out)
+    st1 = eng.stats()
+    snap1 = REGISTRY.snapshot(run_collectors=False)
+
+    identical = cont_out == seq_out
+    recompiles = aot_cache.stats()["misses"] - miss0
+    prefill_s = st1["prefill_seconds"] - st0["prefill_seconds"]
+    decode_s = st1["decode_seconds"] - st0["decode_seconds"]
+    results = {
+        "bench": "decode_continuous_batching",
+        "mode": "cpu-proxy" if not args.tpu else "tpu",
+        "model": {"vocab": args.vocab, "embed": args.embed,
+                  "heads": args.heads, "layers": args.layers,
+                  "max_len": args.max_len},
+        "engine": {"max_batch": args.max_batch,
+                   "fused_steps": args.fused_steps,
+                   "kv_buckets": warm["kv_buckets"],
+                   "warmup_executables": warm["compiled"],
+                   "warmup_compile_seconds": warm["compile_seconds"]},
+        "workload": {"requests": args.requests, "seed": args.seed,
+                     "total_tokens": cont_tokens},
+        "sequential": {"tokens_per_sec": round(seq_tokens / seq_s, 1),
+                       "wall_seconds": round(seq_s, 3),
+                       "tokens": seq_tokens},
+        "continuous": {"tokens_per_sec": round(cont_tokens / cont_s, 1),
+                       "wall_seconds": round(cont_s, 3),
+                       "tokens": cont_tokens,
+                       "prefill_seconds": round(prefill_s, 3),
+                       "decode_seconds": round(decode_s, 3),
+                       "prefill_fraction": round(
+                           prefill_s / max(prefill_s + decode_s, 1e-9), 3)},
+        "speedup": round((cont_tokens / cont_s) / (seq_tokens / seq_s), 2),
+        "per_token_latency_s": _quantiles(snap1,
+                                          "dl4j_decode_token_seconds"),
+        "time_to_first_token_s": _quantiles(
+            snap1, "dl4j_decode_first_token_seconds"),
+        "greedy_identical_to_sequential": identical,
+        "recompiles_after_warmup": recompiles,
+    }
+    eng.close()
+    print(json.dumps(results, indent=2))
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=2)
+    print(f"wrote {args.out}")
+    if args.smoke:
+        assert identical, "continuous greedy output != sequential reference"
+        assert recompiles == 0, f"{recompiles} recompiles after warmup"
+        assert results["speedup"] > 1.0, \
+            f"continuous batching slower than sequential " \
+            f"(speedup {results['speedup']})"
+        print(f"decode-smoke OK: speedup {results['speedup']}x, "
+              f"0 recompiles, token-identical")
+    return 0
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--fused-steps", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=128)
+    ap.add_argument("--embed", type=int, default=64)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--max-len", type=int, default=128)
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--out", default="bench_decode.json")
+    ap.add_argument("--tpu", action="store_true",
+                    help="run on the real chip instead of the CPU proxy")
+    ap.add_argument("--smoke", action="store_true",
+                    help="small workload + assertions (make decode-smoke)")
+    args = ap.parse_args()
+    if args.smoke:
+        args.requests = min(args.requests, 12)
+        args.vocab, args.embed, args.max_len = 32, 16, 48
+        args.max_batch = min(args.max_batch, 4)
+    if not args.tpu:
+        _pin_cpu()
+    return bench(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -37,10 +37,15 @@ from deeplearning4j_tpu.analysis.findings import (
     LOG,
 )
 
-# step kinds whose executables MUST donate (alias) their params/opt
-# buffers: the model train steps, the fused/tbptt scans, and every
-# ParallelWrapper SPMD step kind ("pw_*")
-TRAIN_KIND_PREFIXES = ("train_step", "fused_scan", "tbptt_scan", "pw_")
+# step kinds whose executables MUST donate (alias) their buffers: the
+# model train steps, the fused/tbptt scans, every ParallelWrapper SPMD
+# step kind ("pw_*"), and the KV-cached generation path — "decode_step*"
+# consumes the whole decode state (the KV caches dominate it) every
+# fused window, "prefill*" (prefill_join) scatters prompt KV into it,
+# and "gen_release*" passes it through with rows masked; a non-donated
+# decode-state executable silently doubles KV memory every token.
+TRAIN_KIND_PREFIXES = ("train_step", "fused_scan", "tbptt_scan", "pw_",
+                       "decode_step", "prefill", "gen_release")
 
 ALL_REDUCE_PRIMS = frozenset({"psum", "psum2", "all_reduce"})
 REDUCE_SCATTER_PRIMS = frozenset({"psum_scatter", "reduce_scatter"})

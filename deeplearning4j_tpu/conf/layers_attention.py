@@ -32,7 +32,11 @@ from deeplearning4j_tpu import serde
 from deeplearning4j_tpu.conf import inputs as it
 from deeplearning4j_tpu.conf.activations import Activation
 from deeplearning4j_tpu.conf.layers import BaseLayer
-from deeplearning4j_tpu.ops import dot_product_attention
+from deeplearning4j_tpu.ops import (
+    cache_update,
+    decode_attention,
+    dot_product_attention,
+)
 
 
 def _split_heads(x, nheads):
@@ -137,6 +141,77 @@ class SelfAttentionLayer(BaseLayer):
         if mask is not None:  # masked-out steps emit zeros, as the reference
             y = y * jnp.asarray(mask, y.dtype)[:, :, None]
         return y, state
+
+    # --- KV-cached autoregressive decode (nn.decoding / generation) -------
+    #
+    # The serving decode path splits the forward into two phases sharing
+    # one cache layout — ``k/v: [max_batch, max_len, n_heads, head_size]``
+    # plus a per-sequence slot count — so a sequence's keys/values are
+    # projected exactly once and every later token attends them from the
+    # cache instead of re-running the whole-prompt projection.
+
+    def _decode_check(self):
+        if not self.project_input:
+            raise ValueError("KV-cached decode requires project_input=True")
+        if not self.causal:
+            raise ValueError("KV-cached decode requires causal=True "
+                             "(bidirectional attention cannot stream)")
+
+    def init_kv_cache(self, max_batch, max_len, n_in, dtype=jnp.float32):
+        """Preallocated per-sequence KV buffers for this layer:
+        ``{"k","v"}: [max_batch, max_len, n_heads, head_size]`` zeros."""
+        self._decode_check()
+        hs = self._head_size(n_in)
+        shape = (max_batch, max_len, self.n_heads, hs)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    def prefill(self, params, x, key_mask=None):
+        """Whole-prompt forward that ALSO returns the projected keys and
+        values so the caller can seed a KV cache in one launch.
+        ``x: [batch, time, features]``; returns ``(y, k, v)`` with
+        ``k/v: [batch, time, n_heads, head_size]`` (cache layout) and
+        ``y`` identical to :meth:`forward` in eval mode (activation and
+        mask-zeroing applied)."""
+        self._decode_check()
+        b, t, _ = x.shape
+        hs = params["Wk"].shape[1] // self.n_heads
+        q = x @ params["Wq"] + params["bq"]
+        k = x @ params["Wk"] + params["bk"]
+        v = x @ params["Wv"] + params["bv"]
+        o = dot_product_attention(
+            _split_heads(q, self.n_heads), _split_heads(k, self.n_heads),
+            _split_heads(v, self.n_heads), key_mask=key_mask, causal=True,
+            impl=self.attention_impl, train=False)
+        y = self.activation.apply(_merge_heads(o) @ params["Wo"]
+                                  + params["bo"])
+        if key_mask is not None:
+            y = y * jnp.asarray(key_mask, y.dtype)[:, :, None]
+        return (y, k.reshape(b, t, self.n_heads, hs),
+                v.reshape(b, t, self.n_heads, hs))
+
+    def decode_step(self, params, x, cache, positions):
+        """One token of causal attention against the KV cache.
+        ``x: [batch, features]`` is the new token's representation,
+        ``positions: [batch]`` the cache slot it occupies (== number of
+        tokens already cached for that row). Projects q/k/v for the
+        token, writes k/v into the cache at ``positions`` via
+        ``dynamic_update_slice``, attends slots ``0..positions``
+        inclusive, and returns ``(y [batch, features_out], new_cache)``.
+        The caller donates the cache buffers into the compiled step so
+        the write is in-place (PRG201 audits this)."""
+        self._decode_check()
+        b = x.shape[0]
+        nh = self.n_heads
+        hs = params["Wk"].shape[1] // nh
+        q = (x @ params["Wq"] + params["bq"]).reshape(b, nh, hs)
+        k_new = (x @ params["Wk"] + params["bk"]).reshape(b, 1, nh, hs)
+        v_new = (x @ params["Wv"] + params["bv"]).reshape(b, 1, nh, hs)
+        k_cache = cache_update(cache["k"], k_new, positions)
+        v_cache = cache_update(cache["v"], v_new, positions)
+        o = decode_attention(q, k_cache, v_cache, positions)
+        y = o.reshape(b, nh * hs) @ params["Wo"] + params["bo"]
+        return (self.activation.apply(y),
+                {"k": k_cache, "v": v_cache})
 
 
 def _rnn_size_static(input_type):

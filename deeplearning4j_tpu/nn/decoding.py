@@ -1,0 +1,565 @@
+"""KV-cached autoregressive decode for causal Transformer graphs.
+
+The serving engine (PR 5) batches at *request* granularity — fine for
+one-shot classification, useless for autoregressive generation where a
+request is a whole token-by-token loop. This module gives a causal
+``zoo.TransformerEncoder(lm_head=True)`` graph (or any graph of the same
+shape: embedding → position embedding → pre-LN causal-attention blocks →
+LN → time-distributed output head) a decode path split into the two
+phases every production LLM server uses:
+
+- ``prefill``: the whole prompt in ONE launch — full causal attention,
+  the projected keys/values of every layer captured in cache layout and
+  scattered into the preallocated per-sequence KV buffers
+  (``[max_batch, kv_bucket, heads, head_dim]`` + a per-sequence slot
+  count), the first output token sampled from the last valid position.
+- ``decode_step``: one token per sequence per step against the cache —
+  each step projects q/k/v for the new token only, writes k/v at the
+  sequence's slot via ``dynamic_update_slice``, and attends the cached
+  prefix. ``fused_steps=K`` of these are ``lax.scan``-ned into one host
+  dispatch (PR 7's scan-per-dispatch shape) with in-graph EOS masking so
+  sequences that finish inside the window become no-ops instead of
+  forcing a dispatch boundary.
+
+Every executable rides ``optimize/aot_cache`` with its bucket geometry in
+the step-kind key — ``decode_step:s{kv_bucket}:k{K}``,
+``prefill_join:s{S}:t{prompt_bucket}:b{join_bucket}``,
+``gen_prompt:t{T}:b{B}`` — exactly like serving's power-of-two row
+buckets, so after ``warmup()`` mixed-length traffic never recompiles.
+The decode and join executables DONATE the state pytree (the KV buffers
+dominate it); the PRG201 donation audit covers the ``decode_step*`` /
+``prefill*`` kinds, so a regression that silently copies the cache every
+token is a lint ERROR, not a memory mystery.
+
+Scheduling on top of this lives in ``parallel.generation`` — this module
+is the pure model path plus :meth:`TransformerDecoder.generate`, the
+sequential one-request-at-a-time reference the continuous-batching
+engine is pinned bit-identical against (greedy token ids).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.conf.layers import (
+    EmbeddingSequenceLayer,
+    OutputLayer,
+)
+from deeplearning4j_tpu.conf.layers_cnn import GlobalPoolingLayer
+from deeplearning4j_tpu.conf.layers_attention import (
+    LearnedSelfAttentionLayer,
+    RecurrentAttentionLayer,
+    SelfAttentionLayer,
+)
+from deeplearning4j_tpu.conf.layers_extra import PositionEmbeddingLayer
+from deeplearning4j_tpu.optimize import aot_cache
+
+
+def pow2_ladder(lo: int, hi: int) -> List[int]:
+    """Power-of-two bucket ladder from ``lo`` up, capped at (and always
+    including) ``hi`` — the KV-length / prompt-length twin of serving's
+    ``bucket_ladder`` row buckets."""
+    lo, hi = int(lo), int(hi)
+    if lo >= hi:
+        return [hi]
+    out = []
+    b = lo
+    while b < hi:
+        out.append(b)
+        b *= 2
+    out.append(hi)
+    return out
+
+
+def bucket_for(n: int, ladder: List[int]) -> int:
+    """Smallest ladder entry >= n (raises when n exceeds the ladder)."""
+    for b in ladder:
+        if b >= n:
+            return b
+    raise ValueError(f"{n} exceeds the largest bucket {ladder[-1]}")
+
+
+def _advance_rng(rng):
+    """Split every per-sequence PRNG key: ``rng [B, 2] uint32`` →
+    (step keys, carried keys). Per-sequence streams keep sampling
+    deterministic per request no matter which co-tenants share the
+    running batch — the continuous-vs-sequential bit-identity hinges on
+    this."""
+    ks = jax.vmap(jax.random.split)(rng.astype(jnp.uint32))
+    return ks[:, 0], ks[:, 1]
+
+
+def _sample_tokens(logits, step_keys, temps):
+    """Greedy (temp == 0) or temperature sampling per row. The argmax
+    and the categorical draw are both computed and selected with
+    ``where`` so one executable serves mixed greedy/sampled batches."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.vmap(jax.random.categorical)(
+        step_keys, scaled).astype(jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy)
+
+
+def _reject_types():
+    # MoE routing is cross-row (capacity is shared over the whole
+    # batch), which breaks both decode-shape assumptions and the
+    # row-independence the continuous-vs-sequential bit-identity pin
+    # rests on — refuse rather than silently mis-route
+    from deeplearning4j_tpu.conf.layers_moe import MoELayer
+
+    return (GlobalPoolingLayer, LearnedSelfAttentionLayer,
+            RecurrentAttentionLayer, MoELayer)
+
+
+class TransformerDecoder:
+    """KV-cached generation path over an initialized causal-LM
+    ``ComputationGraph``.
+
+    ``max_batch`` rows of KV cache are preallocated; the cache LENGTH is
+    bucketed (``kv_bucket_min``, doubling to ``max_len``) and grows with
+    the longest live sequence — each bucket is its own compiled
+    executable, pre-built by ``warm_all``/engine ``warmup()``. State is
+    one device-resident pytree (caches + per-row token/position/active/
+    rng/temperature arrays) that every decode/join executable consumes
+    donated and returns updated — the host never copies it.
+    """
+
+    def __init__(self, net, max_batch: int = 8, max_len: Optional[int] = None,
+                 kv_bucket_min: int = 32, prompt_bucket_min: int = 8,
+                 pad_id: int = 0):
+        self._net = net
+        if net.params is None:
+            net.init()
+        self.max_batch = int(max_batch)
+        self.pad_id = int(pad_id)
+        self._dtype = net._dtype
+        self._fns: Dict[tuple, object] = {}
+        conf = net.conf
+        if len(conf.network_inputs) != 1 or len(conf.network_outputs) != 1:
+            raise ValueError("KV-cached decode requires exactly one input "
+                             "and one output vertex")
+        self._input = conf.network_inputs[0]
+        types = conf.vertex_output_types()
+        self._plan = []
+        self._attn: Dict[str, int] = {}  # name -> n_in (cache head dims)
+        derived_max = None
+        reject = _reject_types()
+        for name in net._topo:
+            spec = net._vmap[name]
+            layer = getattr(spec.vertex, "layer", None)
+            if isinstance(layer, reject) or getattr(
+                    spec.vertex, "has_carry", False):
+                raise ValueError(
+                    f"vertex {name!r} ({type(layer or spec.vertex).__name__})"
+                    " is not supported in the KV-cached decode path")
+            if isinstance(layer, SelfAttentionLayer):
+                layer._decode_check()  # causal + projected, or raise
+                src_t = types[spec.inputs[0]] if spec.inputs[0] in types \
+                    else conf.input_types[0]
+                self._attn[name] = src_t.size
+                kind = "attn"
+            elif isinstance(layer, PositionEmbeddingLayer):
+                derived_max = layer.max_len if derived_max is None \
+                    else min(derived_max, layer.max_len)
+                kind = "pos"
+            elif name in conf.network_outputs:
+                if not isinstance(layer, OutputLayer):
+                    raise ValueError("the output vertex must be an "
+                                     "OutputLayer emitting vocab logits")
+                kind = "head"
+            else:
+                kind = "gen"
+            self._plan.append((kind, name, spec))
+        if not self._attn:
+            raise ValueError("graph has no causal SelfAttentionLayer — "
+                             "nothing to KV-cache")
+        first = self._plan[0]
+        if not (first[2].inputs == [self._input] or
+                tuple(first[2].inputs) == (self._input,)) or \
+                not isinstance(getattr(first[2].vertex, "layer", None),
+                               EmbeddingSequenceLayer):
+            raise ValueError("generation needs token-id inputs: the vertex "
+                             "consuming the network input must be an "
+                             "EmbeddingSequenceLayer (vocab_size > 0)")
+        self.vocab_size = first[2].vertex.layer.n_in
+        if max_len is None:
+            max_len = derived_max
+        if not max_len:
+            raise ValueError("pass max_len= (no PositionEmbeddingLayer to "
+                             "derive it from)")
+        self.max_len = int(max_len if derived_max is None
+                           else min(max_len, derived_max))
+        self.kv_ladder = pow2_ladder(min(kv_bucket_min, self.max_len),
+                                     self.max_len)
+        self.prompt_ladder = pow2_ladder(min(prompt_bucket_min, self.max_len),
+                                         self.max_len)
+        self.join_ladder = pow2_ladder(1, self.max_batch)
+        # any decode-state entry for a planned vertex would be silently
+        # frozen at its init value — refuse rather than mis-serve
+        stateful = [n for _, n, _ in self._plan if net.state.get(n)]
+        if stateful:
+            raise ValueError(f"stateful layers unsupported in decode: "
+                             f"{stateful}")
+
+    # --- state --------------------------------------------------------------
+    def new_state(self, s: int) -> dict:
+        """Fresh device-resident decode state at KV bucket ``s``: zeroed
+        caches + per-row scheduler arrays (all rows inactive)."""
+        b = self.max_batch
+        caches = {}
+        for name, n_in in self._attn.items():
+            layer = self._layer(name)
+            caches[name] = layer.init_kv_cache(b, s, n_in, self._dtype)
+        return {
+            "caches": caches,
+            "tokens": jnp.zeros((b,), jnp.int32),
+            "positions": jnp.zeros((b,), jnp.int32),
+            "prompt_lens": jnp.ones((b,), jnp.int32),
+            "max_new": jnp.ones((b,), jnp.int32),
+            "eos": jnp.full((b,), -1, jnp.int32),
+            "active": jnp.zeros((b,), bool),
+            "rng": jnp.zeros((b, 2), jnp.uint32),
+            "temps": jnp.zeros((b,), jnp.float32),
+        }
+
+    def _struct_of(self, s: int) -> dict:
+        """ShapeDtypeStruct twin of :meth:`new_state` — lets ``warmup``
+        compile every bucket without allocating a single cache buffer
+        (``AotStep.warm`` only needs avals)."""
+        b = self.max_batch
+        sds = jax.ShapeDtypeStruct
+        caches = {}
+        for name, n_in in self._attn.items():
+            layer = self._layer(name)
+            hs = layer._head_size(n_in)
+            shape = (b, s, layer.n_heads, hs)
+            caches[name] = {"k": sds(shape, self._dtype),
+                            "v": sds(shape, self._dtype)}
+        return {
+            "caches": caches,
+            "tokens": sds((b,), jnp.int32),
+            "positions": sds((b,), jnp.int32),
+            "prompt_lens": sds((b,), jnp.int32),
+            "max_new": sds((b,), jnp.int32),
+            "eos": sds((b,), jnp.int32),
+            "active": sds((b,), jnp.bool_),
+            "rng": sds((b, 2), jnp.uint32),
+            "temps": sds((b,), jnp.float32),
+        }
+
+    def _layer(self, name):
+        return self._net._vmap[name].vertex.layer
+
+    def _graph_key(self):
+        return self._net._graph_key()
+
+    @property
+    def net(self):
+        """The wrapped ComputationGraph (shares live params — training
+        the net between generations is visible immediately)."""
+        return self._net
+
+    @property
+    def params(self):
+        return self._net.params
+
+    # --- pure model walks ---------------------------------------------------
+    def _run_token(self, params, tokens, positions, caches):
+        """One token through the graph against the caches:
+        ``tokens [B] int32`` → (vocab logits ``[B, V]``, new caches)."""
+        acts = {self._input: tokens}
+        caches = dict(caches)
+        logits = None
+        for kind, name, spec in self._plan:
+            xs = [acts[src] for src in spec.inputs]
+            if kind == "attn":
+                y, caches[name] = self._layer(name).decode_step(
+                    params[name], xs[0], caches[name], positions)
+            elif kind == "pos":
+                y = xs[0] + params[name]["P"][positions]
+            elif kind == "head":
+                logits = self._layer(name).pre_output(params[name], xs[0])
+                continue
+            else:
+                y, _ = spec.vertex.forward(params.get(name, {}), {}, xs,
+                                           train=False, rng=None)
+            acts[name] = y
+        return logits, caches
+
+    def _run_prompt(self, params, prompts, lengths):
+        """Whole-prompt prefill walk: ``prompts [Bp, Tp] int32`` →
+        (last-valid-position logits ``[Bp, V]``, per-layer kv blocks in
+        cache layout)."""
+        tp = prompts.shape[1]
+        key_mask = (jnp.arange(tp)[None, :]
+                    < lengths[:, None]).astype(self._dtype)
+        acts = {self._input: prompts}
+        kv = {}
+        logits = None
+        for kind, name, spec in self._plan:
+            xs = [acts[src] for src in spec.inputs]
+            if kind == "attn":
+                y, k, v = self._layer(name).prefill(
+                    params[name], xs[0], key_mask)
+                kv[name] = {"k": k, "v": v}
+            elif kind == "head":
+                full = self._layer(name).pre_output(params[name], xs[0])
+                idx = jnp.maximum(lengths - 1, 0)[:, None, None]
+                logits = jnp.take_along_axis(full, idx, axis=1)[:, 0]
+                continue
+            else:  # pos + generic both run the ordinary layer forward
+                y, _ = spec.vertex.forward(params.get(name, {}), {}, xs,
+                                           train=False, rng=None)
+            acts[name] = y
+        return logits, kv
+
+    # --- compiled executables (all through optimize/aot_cache) -------------
+    def decode_fn(self, s: int, k: int):
+        """K fused decode steps at KV bucket ``s``: ``lax.scan`` of the
+        single-token walk, in-graph EOS/max-tokens masking (finished
+        rows stop advancing, their rng/token/position freeze), state
+        DONATED. Returns ``(state', tokens [K, B], emitted [K, B])`` —
+        ``emitted[i, b]`` is True where row b was live going into step i
+        (the host appends exactly those tokens)."""
+        key = ("decode", s, k)
+        if key not in self._fns:
+            def fn(params, state):
+                def body(st, _):
+                    active = st["active"]
+                    logits, caches = self._run_token(
+                        params, st["tokens"], st["positions"], st["caches"])
+                    step_keys, rng_next = _advance_rng(st["rng"])
+                    tok = _sample_tokens(logits, step_keys, st["temps"])
+                    tok = jnp.where(active, tok, st["tokens"])
+                    new_pos = st["positions"] + active.astype(jnp.int32)
+                    gen = new_pos - st["prompt_lens"] + 1
+                    nxt = active & (tok != st["eos"]) & (gen < st["max_new"])
+                    st = dict(st, caches=caches, tokens=tok,
+                              positions=new_pos, active=nxt,
+                              rng=jnp.where(active[:, None], rng_next,
+                                            st["rng"]))
+                    return st, (tok, active)
+
+                st, (toks, emitted) = jax.lax.scan(
+                    body, state, None, length=k)
+                return st, toks, emitted
+
+            self._fns[key] = aot_cache.wrap(
+                jax.jit(fn, donate_argnums=(1,)), self._graph_key(),
+                f"decode_step:s{s}:k{k}")
+        return self._fns[key]
+
+    def prompt_fn(self, tp: int, bp: int):
+        """Prefill forward for a compact ``[bp, tp]`` group of joining
+        prompts: kv blocks + sampled first token + in-graph liveness
+        (EOS-on-first-token / max_new == 1 rows are born retired)."""
+        key = ("prompt", tp, bp)
+        if key not in self._fns:
+            def fn(params, prompts, lengths, max_new, eos, temps, rng):
+                logits, kv = self._run_prompt(params, prompts, lengths)
+                step_keys, rng_next = _advance_rng(rng)
+                tok = _sample_tokens(logits, step_keys, temps)
+                active = (tok != eos) & (max_new > 1)
+                return kv, tok, active, rng_next
+
+            self._fns[key] = aot_cache.wrap(
+                jax.jit(fn), self._graph_key(), f"gen_prompt:t{tp}:b{bp}")
+        return self._fns[key]
+
+    def join_fn(self, s: int, tp: int, bp: int):
+        """Scatter a prefilled group into the running state at given row
+        indices (length-``bp``; slots >= ``max_batch`` are padding and
+        dropped by the scatter). State DONATED — this is the ``prefill*``
+        kind the PRG201 donation audit proves writes the KV cache in
+        place."""
+        key = ("join", s, tp, bp)
+        if key not in self._fns:
+            def fn(state, kv, rows, tok, lengths, max_new, eos, temps,
+                   rng, active):
+                pad = ((0, 0), (0, s - tp), (0, 0), (0, 0))
+                caches = {}
+                for name, c in state["caches"].items():
+                    caches[name] = {
+                        "k": c["k"].at[rows].set(
+                            jnp.pad(kv[name]["k"], pad), mode="drop"),
+                        "v": c["v"].at[rows].set(
+                            jnp.pad(kv[name]["v"], pad), mode="drop"),
+                    }
+                at = lambda a, v: a.at[rows].set(v, mode="drop")  # noqa: E731
+                return dict(
+                    state, caches=caches,
+                    tokens=at(state["tokens"], tok),
+                    positions=at(state["positions"], lengths),
+                    prompt_lens=at(state["prompt_lens"],
+                                   jnp.maximum(lengths, 1)),
+                    max_new=at(state["max_new"], max_new),
+                    eos=at(state["eos"], eos),
+                    temps=at(state["temps"], temps),
+                    rng=at(state["rng"], rng),
+                    active=at(state["active"], active))
+
+            self._fns[key] = aot_cache.wrap(
+                jax.jit(fn, donate_argnums=(0,)), self._graph_key(),
+                f"prefill_join:s{s}:t{tp}:b{bp}")
+        return self._fns[key]
+
+    def grow_fn(self, s: int, s2: int):
+        """Pad every cache from KV bucket ``s`` to ``s2`` (the bucket
+        hop when the longest live sequence outgrows the current cache).
+        Not donated: the cache shapes differ, so XLA could not alias
+        them anyway — the old buffers free by refcount when the engine
+        swaps states."""
+        key = ("grow", s, s2)
+        if key not in self._fns:
+            def fn(state):
+                pad = ((0, 0), (0, s2 - s), (0, 0), (0, 0))
+                caches = {name: {"k": jnp.pad(c["k"], pad),
+                                 "v": jnp.pad(c["v"], pad)}
+                          for name, c in state["caches"].items()}
+                return dict(state, caches=caches)
+
+            self._fns[key] = aot_cache.wrap(
+                jax.jit(fn), self._graph_key(), f"kv_grow:s{s}:{s2}")
+        return self._fns[key]
+
+    def release_fn(self, s: int):
+        """Deactivate rows in-graph (deadline aborts, breaker resets):
+        ``active &= keep``. State donated; everything else passes
+        through aliased."""
+        key = ("release", s)
+        if key not in self._fns:
+            def fn(state, keep):
+                return dict(state, active=state["active"] & keep)
+
+            self._fns[key] = aot_cache.wrap(
+                jax.jit(fn, donate_argnums=(0,)), self._graph_key(),
+                f"gen_release:s{s}")
+        return self._fns[key]
+
+    # --- warmup -------------------------------------------------------------
+    def warm_all(self, fused_steps=(1,)) -> dict:
+        """Compile every (bucket, K) combination WITHOUT dispatching
+        (``AotStep.warm`` on ShapeDtypeStructs): all KV buckets × K for
+        decode, prompt × join buckets for prefill, every (S, T<=S, B)
+        join, every upward grow hop, the release fn. After this, mixed
+        prompt/output-length traffic is zero-recompile by construction
+        (pinned in tests and reported by ``bench_decode.py``)."""
+        sds = jax.ShapeDtypeStruct
+        params = jax.tree_util.tree_map(
+            lambda x: sds(jnp.shape(x), x.dtype), self._net.params)
+
+        def row(shape, dt):
+            return sds(shape, dt)
+
+        before = aot_cache.stats()
+        for s in self.kv_ladder:
+            st = self._struct_of(s)
+            for k in fused_steps:
+                self.decode_fn(s, int(k)).warm(params, st)
+            self.release_fn(s).warm(st, row((self.max_batch,), jnp.bool_))
+            for s2 in self.kv_ladder:
+                if s2 > s:
+                    self.grow_fn(s, s2).warm(st)
+        for tp in self.prompt_ladder:
+            for bp in self.join_ladder:
+                args = (params, row((bp, tp), jnp.int32),
+                        row((bp,), jnp.int32), row((bp,), jnp.int32),
+                        row((bp,), jnp.int32), row((bp,), jnp.float32),
+                        row((bp, 2), jnp.uint32))
+                self.prompt_fn(tp, bp).warm(*args)
+                for s in self.kv_ladder:
+                    if tp > s:
+                        continue
+                    kv = {}
+                    for name, n_in in self._attn.items():
+                        layer = self._layer(name)
+                        shape = (bp, tp, layer.n_heads,
+                                 layer._head_size(n_in))
+                        kv[name] = {"k": row(shape, self._dtype),
+                                    "v": row(shape, self._dtype)}
+                    self.join_fn(s, tp, bp).warm(
+                        self._struct_of(s), kv, row((bp,), jnp.int32),
+                        row((bp,), jnp.int32), row((bp,), jnp.int32),
+                        row((bp,), jnp.int32), row((bp,), jnp.int32),
+                        row((bp,), jnp.float32), row((bp, 2), jnp.uint32),
+                        row((bp,), jnp.bool_))
+        after = aot_cache.stats()
+        return {
+            "kv_buckets": list(self.kv_ladder),
+            "prompt_buckets": list(self.prompt_ladder),
+            "join_buckets": list(self.join_ladder),
+            "fused_steps": [int(k) for k in fused_steps],
+            "compiled": after["misses"] - before["misses"],
+            "compile_seconds": round(
+                after["compile_seconds"] - before["compile_seconds"], 3),
+        }
+
+    # --- sequential reference ----------------------------------------------
+    def validate_request(self, tokens, max_new: int):
+        toks = [int(t) for t in np.asarray(tokens).reshape(-1)]
+        if not toks:
+            raise ValueError("prompt must contain at least one token")
+        if any(t < 0 or t >= self.vocab_size for t in toks):
+            raise ValueError(f"token ids must be in [0, {self.vocab_size})")
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(toks) + max_new > self.max_len:
+            raise ValueError(
+                f"prompt ({len(toks)}) + max_new_tokens ({max_new}) "
+                f"exceeds max_len={self.max_len}")
+        return toks
+
+    def generate(self, tokens, max_new: int, eos_id: Optional[int] = None,
+                 temperature: float = 0.0, seed: int = 0,
+                 fused_steps: int = 1) -> List[int]:
+        """Sequential single-request generation through the SAME compiled
+        executables the continuous engine uses (one live row, the other
+        ``max_batch - 1`` rows inactive). This is the unbatched
+        reference: the engine's continuous schedule is pinned to produce
+        token-identical greedy output, and ``bench_decode.py``'s
+        sequential baseline is this loop."""
+        toks = self.validate_request(tokens, max_new)
+        ln = len(toks)
+        tp = bucket_for(ln, self.prompt_ladder)
+        # the KV bucket must cover the prompt bucket too: the join
+        # scatter pads the [tp]-long prompt KV out to [s], and the
+        # ladders need not be aligned (kv_bucket_min can sit below a
+        # prompt bucket)
+        s = bucket_for(max(min(ln + max_new, self.max_len), tp),
+                       self.kv_ladder)
+        state = self.new_state(s)
+        prompts = np.full((1, tp), self.pad_id, np.int32)
+        prompts[0, :ln] = toks
+        rng = np.asarray(jax.random.PRNGKey(int(seed)),
+                         np.uint32).reshape(1, 2)
+        eos = np.asarray([-1 if eos_id is None else int(eos_id)], np.int32)
+        lengths = np.asarray([ln], np.int32)
+        mn = np.asarray([int(max_new)], np.int32)
+        temps = np.asarray([float(temperature)], np.float32)
+        kv, tok, active, rng2 = self.prompt_fn(tp, 1)(
+            self._net.params, prompts, lengths, mn, eos, temps, rng)
+        rows = np.asarray([0], np.int32)
+        state = self.join_fn(s, tp, 1)(
+            state, kv, rows, tok, lengths, mn, eos, temps, rng2, active)
+        out = [int(np.asarray(tok)[0])]
+        alive = bool(np.asarray(active)[0])
+        step = self.decode_fn(s, int(fused_steps))
+        while alive:
+            state, toks_w, emitted = step(self._net.params, state)
+            toks_w = np.asarray(toks_w)
+            emitted = np.asarray(emitted)
+            for i in range(toks_w.shape[0]):
+                if not emitted[i, 0]:
+                    alive = False
+                    break
+                t = int(toks_w[i, 0])
+                out.append(t)
+                if (eos_id is not None and t == eos_id) \
+                        or len(out) >= max_new:
+                    alive = False
+                    break
+        return out

@@ -8,6 +8,8 @@ plan).
 """
 
 from deeplearning4j_tpu.ops.attention import (  # noqa: F401
+    cache_update,
+    decode_attention,
     dot_product_attention,
     flash_attention,
     blockwise_attention,

@@ -67,6 +67,49 @@ def reference_attention(q, k, v, key_mask=None, causal=False, scale=None):
 
 
 # ---------------------------------------------------------------------------
+# KV-cached single-token decode (autoregressive serving)
+# ---------------------------------------------------------------------------
+
+def decode_attention(q, k_cache, v_cache, positions, scale=None):
+    """One decode step of causal attention against a preallocated KV
+    cache. ``q: [batch, heads, head_dim]`` is the new token's query,
+    ``k_cache/v_cache: [batch, max_len, heads, head_dim]`` hold every
+    previously-written key/value (including the new token's own, written
+    by the caller via ``dynamic_update_slice`` before this call), and
+    ``positions: [batch]`` is the cache slot the new token occupies —
+    slots ``0..positions[b]`` inclusive are attended, everything beyond
+    is masked to ``NEG_INF`` exactly like the padding mask in
+    :func:`reference_attention` (exp underflows to 0.0, so garbage in
+    unwritten slots can never leak into the output as long as it is
+    finite — zeros or stale keys from a retired sequence both qualify).
+
+    This is ``reference_attention`` math at ``Tq=1`` — the full [S]
+    score row per head, no online softmax — because a decode step's
+    score row is tiny and one fused softmax is the fastest shape for it.
+    """
+    sm = _scale(q, scale)
+    s = jnp.einsum("bhd,bshd->bhs", q, k_cache) * sm
+    live = jnp.arange(k_cache.shape[1])[None, :] <= positions[:, None]
+    s = jnp.where(live[:, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhs,bshd->bhd", p, v_cache)
+
+
+def cache_update(cache, new, positions):
+    """Write one token's ``new: [batch, 1, heads, head_dim]`` into
+    ``cache: [batch, max_len, heads, head_dim]`` at per-sequence slot
+    ``positions: [batch]`` via a vmapped ``dynamic_update_slice`` (the
+    slot index is traced, so one executable serves every position).
+    Out-of-range positions clamp to the last slot (``dynamic_update_slice``
+    semantics) — harmless by construction: only retired rows ever sit at
+    a position that high, and their slots are never attended."""
+    def write(c, n, p):
+        return jax.lax.dynamic_update_slice(c, n, (p, 0, 0))
+
+    return jax.vmap(write)(cache, new, positions)
+
+
+# ---------------------------------------------------------------------------
 # Tier 1: blockwise online-softmax (pure XLA, any backend)
 # ---------------------------------------------------------------------------
 

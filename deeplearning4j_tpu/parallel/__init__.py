@@ -26,6 +26,10 @@ from deeplearning4j_tpu.parallel.batcher import (  # noqa: F401
     bucket_ladder,
     bucket_rows,
 )
+from deeplearning4j_tpu.parallel.generation import (  # noqa: F401
+    GenerationConfig,
+    GenerationEngine,
+)
 from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401
 from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS,

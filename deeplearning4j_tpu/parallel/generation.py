@@ -1,0 +1,523 @@
+"""Iteration-level continuous batching for autoregressive generation.
+
+``parallel.batcher.InferenceEngine`` coalesces requests into shared
+launches at REQUEST granularity — right for one-shot inference, wrong
+for generation, where a request is a token loop of unpredictable length:
+batching whole loops means every sequence in a batch waits for the
+longest one, and freed slots stay empty until the batch drains. This
+engine schedules at TOKEN granularity (the vLLM iteration-level shape)
+on top of ``nn.decoding.TransformerDecoder``:
+
+- one persistent decode loop owns a device-resident state of
+  ``max_batch`` KV-cache rows;
+- every iteration dispatches ONE fused window of ``fused_steps=K``
+  decode steps for the whole running batch (PR 7's scan-per-dispatch:
+  K tokens per sequence per host dispatch, finished rows masked to
+  no-ops in-graph);
+- between windows, finished sequences (EOS / max-tokens / expired
+  deadline) retire and free their rows, and waiting prompts prefill
+  into the freed rows in one launch — no sequence ever waits for the
+  batch to drain.
+
+The admission-control surface is the batcher's, reused wholesale: the
+same queue semantics, ``max_queue`` → :class:`ServerOverloadedError`
+(503), per-request deadlines → :class:`DeadlineExpiredError`, malformed
+prompts → :class:`BadRequestError` at submit, and a
+:class:`~deeplearning4j_tpu.resilience.breaker.CircuitBreaker` shedding
+at submit while the decode path is failing. Every executable (prefill,
+join, decode, grow) is AOT-cached with its bucket geometry in the key;
+``warmup()`` pre-compiles all of them, so steady-state traffic of any
+prompt/output-length mix runs zero-recompile (``stats()`` exposes the
+invariant).
+
+Greedy decode through this engine is pinned token-identical to
+``TransformerDecoder.generate`` (the sequential reference): the decode
+arithmetic is row-independent and every row runs the same compiled
+executables, so continuous scheduling changes WHEN a sequence's tokens
+are computed, never WHAT they are.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.nn.decoding import TransformerDecoder, bucket_for
+from deeplearning4j_tpu.optimize import aot_cache
+from deeplearning4j_tpu.parallel.batcher import (
+    BadRequestError,
+    DeadlineExpiredError,
+    ServerOverloadedError,
+)
+from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.resilience.breaker import (
+    CircuitBreaker,
+    CircuitOpenError,
+)
+from deeplearning4j_tpu.resilience.retry import SERVING_RETRY
+
+_ENGINE_SEQ = itertools.count(1)
+
+
+@dataclasses.dataclass
+class GenerationConfig:
+    """Scheduler policy knobs (the generation twin of
+    ``BatchingConfig``)."""
+
+    max_batch: int = 8          # KV-cache rows (running-batch capacity)
+    fused_steps: int = 4        # K decode steps per host dispatch
+    max_queue: int = 256        # waiting requests before 503 rejection
+    timeout_ms: Optional[float] = None  # default per-request deadline
+    kv_bucket_min: int = 32     # smallest KV length bucket
+    prompt_bucket_min: int = 8  # smallest prompt padding bucket
+    max_new_default: int = 64   # max_new_tokens when the caller omits it
+
+
+class _GenRequest:
+    __slots__ = ("tokens", "n", "max_new", "eos", "temp", "rng", "deadline",
+                 "event", "out", "error", "t0", "row")
+
+    def __init__(self, tokens, max_new, eos, temp, rng, deadline, t0):
+        self.tokens = tokens
+        self.n = len(tokens)
+        self.max_new = max_new
+        self.eos = eos
+        self.temp = temp
+        self.rng = rng              # [2] uint32 per-request PRNG key
+        self.deadline = deadline
+        self.event = threading.Event()
+        self.out: List[int] = []
+        self.error: Optional[BaseException] = None
+        self.t0 = t0
+        self.row: Optional[int] = None
+
+
+class GenerationEngine:
+    """Continuous-batching generation front of one causal LM.
+
+    Usage::
+
+        engine = GenerationEngine(net, GenerationConfig(max_batch=8))
+        engine.warmup()                    # pre-compile every bucket/K
+        toks = engine.generate([1, 2, 3], max_new_tokens=32)
+        engine.close()
+
+    ``model`` is a ``TransformerDecoder``, an initialized causal-LM
+    ``ComputationGraph``, or a ``zoo.TransformerEncoder(lm_head=True)``
+    config (initialized fresh). All scheduling state (row ownership,
+    queue, output accumulation) lives behind one condition variable, the
+    same discipline as the batcher; device state is touched only by the
+    single decode-loop thread.
+    """
+
+    def __init__(self, model, config: Optional[GenerationConfig] = None,
+                 breaker: Optional[CircuitBreaker] = ...,
+                 retry=...):
+        self.config = config or GenerationConfig()
+        cfg = self.config
+        if isinstance(model, TransformerDecoder):
+            self._dec = model
+        elif hasattr(model, "params"):  # an initialized ComputationGraph
+            self._dec = TransformerDecoder(
+                model, max_batch=cfg.max_batch,
+                kv_bucket_min=cfg.kv_bucket_min,
+                prompt_bucket_min=cfg.prompt_bucket_min)
+        elif hasattr(model, "decoder"):  # a zoo TransformerEncoder config
+            self._dec = model.decoder(
+                max_batch=cfg.max_batch,
+                kv_bucket_min=cfg.kv_bucket_min,
+                prompt_bucket_min=cfg.prompt_bucket_min)
+        else:
+            raise TypeError(
+                "model must be a TransformerDecoder, a causal-LM "
+                "ComputationGraph, or a zoo config with .decoder()")
+        if self._dec.max_batch != cfg.max_batch:
+            cfg.max_batch = self._dec.max_batch
+        self._breaker = (CircuitBreaker(name=f"decode-{next(_ENGINE_SEQ)}")
+                         if breaker is ... else breaker)
+        self._retry = SERVING_RETRY if retry is ... else retry
+        self._queue: deque = deque()
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        # device decode state + host mirrors (rows/positions), owned by
+        # the decode loop; _rows/_n_active are read under _cond by
+        # submit/stats
+        self._state = None
+        self._S = self._dec.kv_ladder[0]
+        self._rows: List[Optional[_GenRequest]] = [None] * cfg.max_batch
+        self._positions = [0] * cfg.max_batch  # host mirror of slot counts
+        self._n_active = 0
+        self._joined_total = 0
+        self._retired_total = 0
+        self._tokens_total = 0
+        self._prefill_seconds = 0.0
+        self._decode_seconds = 0.0
+        telemetry.register_generation_engine(self)
+
+    # --- submit / wait ------------------------------------------------------
+    def submit(self, tokens: Sequence[int], max_new_tokens: int = None,
+               eos_id: Optional[int] = None, temperature: float = 0.0,
+               seed: int = 0, timeout_ms=...) -> _GenRequest:
+        """Validate and enqueue one generation request; returns a handle
+        whose ``event`` fires when the token list (or error) is in.
+        Admission order matches the batcher: malformed → 400, queue full
+        → 503, breaker open → shed (503) — breaker LAST so a rejected
+        request never burns a half-open probe ticket."""
+        if max_new_tokens is None:
+            max_new_tokens = self.config.max_new_default
+        try:
+            toks = self._dec.validate_request(tokens, int(max_new_tokens))
+            if temperature < 0:
+                raise ValueError("temperature must be >= 0")
+            if eos_id is not None and not (
+                    0 <= int(eos_id) < self._dec.vocab_size):
+                raise ValueError("eos_id outside the vocabulary")
+        except ValueError as e:
+            telemetry.record_decode_request("bad_request")
+            raise BadRequestError(str(e)) from None
+        if timeout_ms is ...:
+            timeout_ms = self.config.timeout_ms
+        t0 = time.monotonic()
+        deadline = t0 + timeout_ms / 1000.0 if timeout_ms else None
+        rng = np.asarray(jax.random.PRNGKey(int(seed)), np.uint32)
+        req = _GenRequest(toks, int(max_new_tokens),
+                          -1 if eos_id is None else int(eos_id),
+                          float(temperature), rng, deadline, t0)
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("generation engine is closed")
+            if len(self._queue) >= self.config.max_queue:
+                telemetry.record_decode_request("rejected")
+                raise ServerOverloadedError(
+                    f"generation queue full "
+                    f"({self.config.max_queue} waiting)")
+            if self._breaker is not None and not self._breaker.allow():
+                telemetry.record_decode_request("shed")
+                raise CircuitOpenError(
+                    f"circuit breaker {self._breaker.name!r} is "
+                    f"{self._breaker.state}; request shed")
+            self._queue.append(req)
+            self._cond.notify_all()
+        self._ensure_thread()
+        return req
+
+    def result(self, req: _GenRequest) -> List[int]:
+        """Block until ``req`` completes; returns its generated token
+        ids (EOS included when hit) or raises its error."""
+        req.event.wait()
+        if req.error is not None:
+            raise req.error
+        return req.out
+
+    def generate(self, tokens, **kw) -> List[int]:
+        """Synchronous request: enqueue, join the running batch at the
+        next iteration, collect tokens until EOS/max-tokens."""
+        return self.result(self.submit(tokens, **kw))
+
+    # --- warmup / stats -----------------------------------------------------
+    def warmup(self) -> dict:
+        """Pre-compile every (KV bucket × K) decode window, every
+        (prompt bucket × join bucket) prefill, every join/grow hop —
+        compile-only, no dispatch. After this the zero-recompile
+        invariant holds for ANY mix of prompt/output lengths up to
+        ``max_len`` (pinned by test and reported by bench_decode.py)."""
+        return self._dec.warm_all(fused_steps=(1, self.config.fused_steps))
+
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def stats(self) -> dict:
+        """Scheduler + cache counters: running-batch occupancy, rows in
+        use, retire/join/token totals, current KV bucket, the AOT cache
+        (zero-recompile invariant reads off ``misses``), breaker state."""
+        with self._cond:
+            out = {
+                "rows": self.config.max_batch,
+                "rows_in_use": sum(r is not None for r in self._rows),
+                "occupancy": (sum(r is not None for r in self._rows)
+                              / max(self.config.max_batch, 1)),
+                "queued": len(self._queue),
+                "kv_bucket": self._S,
+                "fused_steps": self.config.fused_steps,
+                "joined_total": self._joined_total,
+                "retired_total": self._retired_total,
+                "tokens_total": self._tokens_total,
+                "prefill_seconds": round(self._prefill_seconds, 4),
+                "decode_seconds": round(self._decode_seconds, 4),
+            }
+        out["buckets"] = {"kv": list(self._dec.kv_ladder),
+                          "prompt": list(self._dec.prompt_ladder),
+                          "join": list(self._dec.join_ladder)}
+        out["aot_cache"] = aot_cache.stats()
+        if self._breaker is not None:
+            out["circuit_breaker"] = self._breaker.status()
+        return out
+
+    @property
+    def breaker(self) -> Optional[CircuitBreaker]:
+        return self._breaker
+
+    @property
+    def decoder(self) -> TransformerDecoder:
+        return self._dec
+
+    # --- decode loop --------------------------------------------------------
+    def _ensure_thread(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        with self._cond:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, name="dl4j-decode-loop", daemon=True)
+                self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while (not self._stop and not self._queue
+                       and self._n_active == 0):
+                    self._cond.wait(0.1)
+                if self._stop:
+                    return
+                self._expire_queued_locked(time.monotonic())
+                joins = self._pick_joins_locked()
+            try:
+                if joins:
+                    self._do_prefill(joins)
+                if self._n_active:
+                    self._do_decode()
+            except Exception as e:  # noqa: BLE001 — loop must survive
+                self._on_dispatch_failure(e)
+
+    def _expire_queued_locked(self, now: float):
+        if not self._queue:
+            return
+        live = deque()
+        for req in self._queue:
+            if req.deadline is not None and now > req.deadline:
+                req.error = DeadlineExpiredError(
+                    "request deadline expired after "
+                    f"{(now - req.t0) * 1000:.1f} ms in queue")
+                telemetry.record_decode_request("expired", now - req.t0)
+                req.event.set()
+            else:
+                live.append(req)
+        if len(live) != len(self._queue):
+            self._queue = live
+
+    def _pick_joins_locked(self) -> List[_GenRequest]:
+        """Token-granularity admission: every iteration, as many waiting
+        prompts as there are free cache rows join the running batch —
+        FIFO, no waiting for a drain."""
+        free = [i for i, r in enumerate(self._rows) if r is None]
+        n = min(len(free), len(self._queue))
+        joins = []
+        for _ in range(n):
+            req = self._queue.popleft()
+            req.row = free[len(joins)]
+            self._rows[req.row] = req
+            joins.append(req)
+        return joins
+
+    def _grow_to(self, target: int):
+        s2 = bucket_for(target, self._dec.kv_ladder)
+        if self._state is None:
+            self._S = max(self._S, s2)
+            self._state = self._dec.new_state(self._S)
+            return
+        if s2 > self._S:
+            self._state = self._dec.grow_fn(self._S, s2)(self._state)
+            self._S = s2
+
+    def _do_prefill(self, joins: List[_GenRequest]):
+        cfg = self.config
+        t0 = time.monotonic()
+        tp = bucket_for(max(r.n for r in joins), self._dec.prompt_ladder)
+        bp = bucket_for(len(joins), self._dec.join_ladder)
+        self._grow_to(max(tp, self._S))
+        prompts = np.full((bp, tp), self._dec.pad_id, np.int32)
+        lengths = np.zeros((bp,), np.int32)
+        rows = np.full((bp,), cfg.max_batch, np.int32)  # OOB = dropped
+        max_new = np.ones((bp,), np.int32)
+        eos = np.full((bp,), -1, np.int32)
+        temps = np.zeros((bp,), np.float32)
+        rng = np.zeros((bp, 2), np.uint32)
+        for i, r in enumerate(joins):
+            prompts[i, :r.n] = r.tokens
+            lengths[i] = r.n
+            rows[i] = r.row
+            max_new[i] = r.max_new
+            eos[i] = r.eos
+            temps[i] = r.temp
+            rng[i] = r.rng
+
+        def once():
+            faults.fault_point("decode.launch")
+            return self._dec.prompt_fn(tp, bp)(
+                self._net_params(), prompts, lengths, max_new, eos, temps,
+                rng)
+
+        if self._retry is None:
+            kv, tok, active, rng2 = once()
+        else:
+            deadlines = [r.deadline for r in joins if r.deadline is not None]
+            kv, tok, active, rng2 = self._retry.call(
+                once, deadline=min(deadlines) if deadlines else None,
+                op="decode.launch")
+        self._state = self._dec.join_fn(self._S, tp, bp)(
+            self._state, kv, rows, tok, lengths, max_new, eos, temps,
+            rng2, active)
+        tok = np.asarray(tok)
+        active = np.asarray(active)
+        now = time.monotonic()
+        n_live = 0
+        with self._cond:
+            for i, r in enumerate(joins):
+                r.out.append(int(tok[i]))
+                self._positions[r.row] = r.n
+                telemetry.record_decode_first_token(now - r.t0)
+                if active[i]:
+                    n_live += 1
+                else:
+                    self._finish_locked(r, now)
+            self._n_active += n_live
+            self._joined_total += len(joins)
+            self._tokens_total += len(joins)
+            self._prefill_seconds += now - t0
+        telemetry.record_decode_prefill(len(joins), bp, now - t0)
+        if self._breaker is not None:
+            self._breaker.on_success()
+
+    def _do_decode(self):
+        cfg = self.config
+        k = cfg.fused_steps
+        t0 = time.monotonic()
+        with self._cond:
+            active_rows = [r for r in self._rows if r is not None]
+            need = max((self._positions[r.row] for r in active_rows
+                        if r is not None), default=0) + k
+        self._grow_to(min(need, self._dec.max_len))
+
+        def once():
+            faults.fault_point("decode.launch")
+            return self._dec.decode_fn(self._S, k)(
+                self._net_params(), self._state)
+
+        # NO retry on the decode window: the state pytree is donated
+        # into the executable, so a mid-flight failure may have consumed
+        # it — _on_dispatch_failure resets instead
+        self._state, toks, emitted = once()
+        toks = np.asarray(toks)
+        emitted = np.asarray(emitted)
+        now = time.monotonic()
+        n_emitted = int(emitted.sum())
+        occupancy = 0
+        released = []
+        with self._cond:
+            occupancy = sum(r is not None for r in self._rows)
+            for b, req in enumerate(self._rows):
+                if req is None:
+                    continue
+                done = False
+                for i in range(k):
+                    if not emitted[i, b]:
+                        break
+                    t = int(toks[i, b])
+                    req.out.append(t)
+                    self._positions[b] += 1
+                    if t == req.eos or len(req.out) >= req.max_new:
+                        done = True
+                        break
+                if done:
+                    self._finish_locked(req, now)
+                    self._n_active -= 1
+                elif req.deadline is not None and now > req.deadline:
+                    req.error = DeadlineExpiredError(
+                        "deadline expired mid-generation after "
+                        f"{len(req.out)} tokens")
+                    telemetry.record_decode_request("expired", now - req.t0)
+                    req.event.set()
+                    self._rows[b] = None
+                    self._n_active -= 1
+                    released.append(b)
+            self._tokens_total += n_emitted
+            self._decode_seconds += now - t0
+            rows_in_use = sum(r is not None for r in self._rows)
+        if released:
+            keep = np.ones((cfg.max_batch,), bool)
+            keep[released] = False
+            self._state = self._dec.release_fn(self._S)(self._state, keep)
+        telemetry.record_decode_iteration(
+            n_emitted, occupancy, cfg.max_batch, rows_in_use, k, now - t0)
+        if self._breaker is not None:
+            self._breaker.on_success()
+
+    def _net_params(self):
+        return self._dec.params
+
+    def _finish_locked(self, req: _GenRequest, now: float):
+        self._rows[req.row] = None
+        self._retired_total += 1
+        telemetry.record_decode_request("ok", now - req.t0)
+        req.event.set()
+
+    def _on_dispatch_failure(self, e: BaseException):
+        """A prefill/decode dispatch raised. The decode state may have
+        been donated into the failed executable, so it cannot be trusted:
+        fail every in-flight request (the batcher fails its batch the
+        same way), reset to a fresh zeroed state, and count the breaker
+        failure — persistent failure trips it open and submits shed."""
+        with self._cond:
+            for b, req in enumerate(self._rows):
+                if req is None:
+                    continue
+                req.error = e if req.error is None else req.error
+                telemetry.record_decode_request("error")
+                req.event.set()
+                self._rows[b] = None
+            self._n_active = 0
+            self._positions = [0] * self.config.max_batch
+        self._state = self._dec.new_state(self._S)
+        if self._breaker is not None:
+            self._breaker.on_failure()
+
+    # --- lifecycle ----------------------------------------------------------
+    def close(self):
+        """Stop the decode loop; queued and in-flight requests fail with
+        a shutdown error. Idempotent."""
+        with self._cond:
+            self._stop = True
+            err = RuntimeError("generation engine closed")
+            for req in self._queue:
+                req.error = err
+                req.event.set()
+            self._queue.clear()
+            for b, req in enumerate(self._rows):
+                if req is not None:
+                    req.error = err
+                    req.event.set()
+                    self._rows[b] = None
+            self._n_active = 0
+            self._cond.notify_all()
+        telemetry.unregister_generation_engine(self)
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+        self._thread = None
+        self._state = None
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

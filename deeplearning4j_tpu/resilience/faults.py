@@ -21,6 +21,9 @@ Named sites (the permanent hooks in product code)::
     serving.launch       parallel.batcher dispatcher, before the shared
                          forward (delay mode simulates a stuck launch —
                          the watchdog's test vector)
+    decode.launch        parallel.generation decode loop, before each
+                         prefill/decode dispatch (the generation
+                         breaker's test vector)
     stats.flush          ui.stats remote-router delivery attempt
 
 Usage::
@@ -56,6 +59,7 @@ SITES = (
     "ingest.device_put",
     "train.step",
     "serving.launch",
+    "decode.launch",
     "stats.flush",
 )
 

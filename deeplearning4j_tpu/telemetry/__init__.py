@@ -270,6 +270,71 @@ def record_circuit_state(name: str, state_code: int,
                          breaker=name, to=str(state_code)).inc()
 
 
+# --------------------------------------------------------------------------
+# generation metrics (parallel.generation — iteration-level continuous
+# batching for autoregressive decode). Unconditional like the serving
+# helpers: one registry update per decode ITERATION (not per token),
+# noise next to a device dispatch. docs/serving.md lists the series.
+# --------------------------------------------------------------------------
+
+def record_decode_request(status: str, seconds: float = None) -> None:
+    """Count one generation-request terminal state (``ok`` / ``error`` /
+    ``bad_request`` / ``rejected`` / ``expired`` / ``shed``);
+    ``seconds`` = submit-to-last-token latency when it ran."""
+    REGISTRY.counter("dl4j_decode_requests_total",
+                     help="generation requests by terminal status",
+                     status=status).inc()
+    if seconds is not None:
+        REGISTRY.histogram("dl4j_decode_request_seconds",
+                           help="submit-to-completion generation latency",
+                           ).observe(seconds)
+
+
+def record_decode_iteration(tokens: int, active_rows: int, capacity: int,
+                            rows_in_use: int, k: int,
+                            seconds: float) -> None:
+    """One decode window: tokens actually emitted, running-batch
+    occupancy, KV-cache rows in use, per-token latency (window wall
+    time / K — the iteration-granularity inter-token latency)."""
+    REGISTRY.counter("dl4j_decode_tokens_total",
+                     help="tokens generated (all sequences)").inc(tokens)
+    REGISTRY.gauge("dl4j_decode_batch_occupancy",
+                   help="active rows / max_batch in the running "
+                        "decode batch").set(
+        active_rows / max(capacity, 1))
+    REGISTRY.gauge("dl4j_decode_kv_rows_in_use",
+                   help="KV-cache rows currently owned by sequences").set(
+        rows_in_use)
+    if k > 0:
+        REGISTRY.histogram("dl4j_decode_token_seconds",
+                           help="per-token decode latency "
+                                "(window time / K)").observe(seconds / k)
+
+
+def record_decode_prefill(rows: int, bucket_rows: int,
+                          seconds: float) -> None:
+    """One prefill launch: joining sequences, padded join-bucket fill,
+    prompt-ingestion wall time (the prefill side of the prefill/decode
+    split bench_decode.py reports). Each joining row samples its first
+    token in the prefill launch, so those count as generated tokens."""
+    REGISTRY.counter("dl4j_decode_prefills_total",
+                     help="prompt prefill launches").inc()
+    REGISTRY.counter("dl4j_decode_tokens_total",
+                     help="tokens generated (all sequences)").inc(rows)
+    REGISTRY.histogram("dl4j_decode_prefill_fill_ratio",
+                       help="joining rows / padded join bucket").observe(
+        rows / max(bucket_rows, 1))
+    REGISTRY.histogram("dl4j_decode_prefill_seconds",
+                       help="prefill launch wall time").observe(seconds)
+
+
+def record_decode_first_token(seconds: float) -> None:
+    """Time-to-first-token for one request (submit → prefill sample)."""
+    REGISTRY.histogram("dl4j_decode_first_token_seconds",
+                       help="submit-to-first-token latency").observe(
+        seconds)
+
+
 _SERVING_ENGINES = weakref.WeakSet()
 
 
@@ -285,6 +350,19 @@ def unregister_serving_engine(engine) -> None:
     _SERVING_ENGINES.discard(engine)
 
 
+_GENERATION_ENGINES = weakref.WeakSet()
+
+
+def register_generation_engine(engine) -> None:
+    """Track a live ``GenerationEngine`` for the scrape-time queue-depth
+    collector (same additive multi-engine semantics as serving)."""
+    _GENERATION_ENGINES.add(engine)
+
+
+def unregister_generation_engine(engine) -> None:
+    _GENERATION_ENGINES.discard(engine)
+
+
 # --------------------------------------------------------------------------
 # scrape-time collectors (run on snapshot/render, never per step)
 # --------------------------------------------------------------------------
@@ -295,6 +373,15 @@ def _collect_serving_queue_depth(reg) -> None:
     if engines:
         reg.gauge("dl4j_serving_queue_depth",
                   help="pending serving requests").set(
+            sum(e.queue_depth() for e in engines))
+
+
+@REGISTRY.register_collector
+def _collect_decode_queue_depth(reg) -> None:
+    engines = list(_GENERATION_ENGINES)
+    if engines:
+        reg.gauge("dl4j_decode_queue_depth",
+                  help="generation requests waiting for a cache row").set(
             sum(e.queue_depth() for e in engines))
 
 

@@ -300,17 +300,17 @@ class UIServer:
                 self.attach(self._remote_storage)
             return self._remote_storage
 
-    def _serving_panel(self) -> str:
-        """Serving-engine metrics (parallel.batcher): a live table off the
-        process metrics registry — requests by status, shared-launch
-        counts, fill ratio and latency quantiles, queue depth. Rendered
-        only when a serving engine has actually run in this process."""
+    def _metric_table_panel(self, title: str, prefix: str) -> str:
+        """One System-tab table of every registry series under
+        ``prefix`` (scalars verbatim, histograms as count/mean/quantile
+        summaries). Rendered only when the subsystem has actually
+        produced a series in this process."""
         from deeplearning4j_tpu.telemetry import REGISTRY
 
         snap = REGISTRY.snapshot(run_collectors=False)
         rows = []
         for key in sorted(snap):
-            if not key.startswith("dl4j_serving_"):
+            if not key.startswith(prefix):
                 continue
             v = snap[key]
             if isinstance(v, dict):
@@ -325,9 +325,24 @@ class UIServer:
                         f"<td>{html.escape(val)}</td></tr>")
         if not rows:
             return ""
-        return ('<div class="chart"><h3>Serving (dynamic batcher)</h3>'
+        return (f'<div class="chart"><h3>{html.escape(title)}</h3>'
                 '<table style="font-size:12px;border-spacing:8px 2px">'
                 + "".join(rows) + "</table></div>")
+
+    def _serving_panel(self) -> str:
+        """Serving-engine metrics (parallel.batcher): requests by
+        status, shared-launch counts, fill ratio and latency quantiles,
+        queue depth."""
+        return self._metric_table_panel("Serving (dynamic batcher)",
+                                        "dl4j_serving_")
+
+    def _generation_panel(self) -> str:
+        """Continuous-batching generation metrics (parallel.generation):
+        token counters, running-batch occupancy, KV-cache rows in use,
+        per-token and time-to-first-token latency quantiles — next to
+        the serving panel."""
+        return self._metric_table_panel("Generation (continuous batching)",
+                                        "dl4j_decode_")
 
     def _sharding_panel(self) -> str:
         """Live sharding plans (sharding.plan registry): the resolved
@@ -442,6 +457,7 @@ class UIServer:
                         latest_hists.get("gradient_histograms", {}),
                         "#9467bd"),
             self._serving_panel(),
+            self._generation_panel(),
             self._sharding_panel(),
         ]) or "<p>No stats collected yet.</p>"
         refresh = (f"<meta http-equiv='refresh' content='{refresh_seconds}'>"

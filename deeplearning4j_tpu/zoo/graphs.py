@@ -955,7 +955,8 @@ class TransformerEncoder(GraphZooModel):
                  updater: IUpdater | None = None,
                  attention_impl: str = "auto", causal: bool = False,
                  moe_experts: int = 0, moe_top_k: int = 2,
-                 moe_capacity_factor: float = 1.25):
+                 moe_capacity_factor: float = 1.25,
+                 lm_head: bool = False):
         """``vocab_size``>0: token-id inputs through an embedding;
         0: continuous ``[batch, time, embed_dim]`` inputs.
 
@@ -963,7 +964,15 @@ class TransformerEncoder(GraphZooModel):
         GShard-style ``MoELayer`` (round-4 productization): the same
         config then trains data+expert-parallel under
         ``ParallelWrapper(expert_parallel=True)`` with no hand-written
-        shard_map."""
+        shard_map.
+
+        ``lm_head=True`` makes this a causal language model instead of a
+        classifier: the pooling layer is dropped and the output head is a
+        time-distributed ``[batch, time, vocab_size]`` softmax over the
+        vocabulary (requires ``vocab_size > 0`` and ``causal=True``).
+        This is the configuration :meth:`decoder` serves with a KV cache
+        (``nn.decoding.TransformerDecoder`` /
+        ``parallel.generation.GenerationEngine``)."""
         self.num_classes = num_classes
         self.vocab_size = vocab_size
         self.embed_dim = embed_dim
@@ -978,6 +987,11 @@ class TransformerEncoder(GraphZooModel):
         self.moe_experts = moe_experts
         self.moe_top_k = moe_top_k
         self.moe_capacity_factor = moe_capacity_factor
+        self.lm_head = lm_head
+        if lm_head and not (vocab_size and causal):
+            raise ValueError("lm_head=True requires vocab_size > 0 and "
+                             "causal=True (a language model decodes token "
+                             "ids left to right)")
 
     def conf(self) -> ComputationGraphConfiguration:
         from deeplearning4j_tpu.conf.layers import EmbeddingSequenceLayer
@@ -1035,10 +1049,35 @@ class TransformerEncoder(GraphZooModel):
                          f"b{i}_res1", ff_out)
             prev = f"b{i}_res2"
         g.add_layer("final_ln", LayerNormalization(), prev)
-        g.add_layer("pool", GlobalPoolingLayer(
-            pooling_type=PoolingType.AVG), "final_ln")
-        g.add_layer("output", OutputLayer(
-            n_out=self.num_classes, activation=Activation.SOFTMAX,
-            loss_fn=LossMCXENT()), "pool")
+        if self.lm_head:
+            # language-model head: time-distributed vocab logits — no
+            # pooling, every position predicts its next token
+            g.add_layer("output", OutputLayer(
+                n_out=self.vocab_size, activation=Activation.SOFTMAX,
+                loss_fn=LossMCXENT()), "final_ln")
+        else:
+            g.add_layer("pool", GlobalPoolingLayer(
+                pooling_type=PoolingType.AVG), "final_ln")
+            g.add_layer("output", OutputLayer(
+                n_out=self.num_classes, activation=Activation.SOFTMAX,
+                loss_fn=LossMCXENT()), "pool")
         g.set_outputs("output")
         return g.build()
+
+    def decoder(self, net=None, **kw):
+        """KV-cached generation front for this configuration: a
+        ``nn.decoding.TransformerDecoder`` with ``prefill`` (one-launch
+        prompt ingestion) and ``decode_step`` (fused multi-token
+        autoregressive decode) executables, AOT-cached per KV
+        length-bucket. ``net``: an already-initialized/trained
+        ComputationGraph of this conf (default: a fresh ``init()``).
+        Remaining kwargs go to ``TransformerDecoder`` (``max_batch``,
+        ``fused_steps``, bucket knobs)."""
+        if not self.lm_head:
+            raise ValueError(
+                "decoder() requires lm_head=True (the classifier head "
+                "pools over time and cannot emit next-token logits)")
+        from deeplearning4j_tpu.nn.decoding import TransformerDecoder
+
+        return TransformerDecoder(net if net is not None else self.init(),
+                                  max_len=self.max_len, **kw)

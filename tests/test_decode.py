@@ -1,0 +1,426 @@
+"""Iteration-level continuous batching for autoregressive decode
+(``nn.decoding`` + ``parallel.generation``).
+
+The invariants pinned here are the acceptance criteria of the decode
+subsystem: greedy generation through the KV cache matches the full
+no-cache forward exactly; continuous scheduling (token-granularity
+join/leave, fused-K windows, bucket growth) NEVER changes any
+sequence's tokens relative to the sequential one-request-at-a-time
+reference; warmup makes mixed-length traffic zero-recompile; finished
+sequences free their rows immediately; admission control (400/503/
+deadline/breaker-shed) matches the serving batcher's semantics; and the
+program linter's donation audit proves every decode/prefill executable
+writes the KV cache in place.
+
+All cache assertions read COUNTER DELTAS — the AOT executable cache and
+the telemetry registry are process-global and shared across the session.
+"""
+
+import functools
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.decoding import (
+    TransformerDecoder,
+    bucket_for,
+    pow2_ladder,
+)
+from deeplearning4j_tpu.optimize import aot_cache
+from deeplearning4j_tpu.parallel.batcher import (
+    BadRequestError,
+    DeadlineExpiredError,
+    ServerOverloadedError,
+)
+from deeplearning4j_tpu.parallel.generation import (
+    GenerationConfig,
+    GenerationEngine,
+)
+from deeplearning4j_tpu.resilience.breaker import (
+    CircuitBreaker,
+    CircuitOpenError,
+)
+from deeplearning4j_tpu.resilience.faults import FaultPlan
+from deeplearning4j_tpu.telemetry import REGISTRY
+from deeplearning4j_tpu.zoo.graphs import TransformerEncoder
+
+pytestmark = pytest.mark.decode
+
+VOCAB = 32
+MAX_LEN = 32
+MAX_BATCH = 4
+K = 2
+
+
+@functools.lru_cache(maxsize=None)
+def _decoder() -> TransformerDecoder:
+    """One warmed decoder for the whole module (executables are shared
+    through the process-global AOT cache anyway; warming once keeps the
+    suite fast)."""
+    m = TransformerEncoder(vocab_size=VOCAB, embed_dim=16, n_heads=2,
+                           n_layers=2, max_len=MAX_LEN, causal=True,
+                           lm_head=True, seed=7)
+    dec = m.decoder(max_batch=MAX_BATCH, kv_bucket_min=16,
+                    prompt_bucket_min=4)
+    dec.warm_all(fused_steps=(1, K))
+    return dec
+
+
+def _engine(**over):
+    cfg = dict(max_batch=MAX_BATCH, fused_steps=K, kv_bucket_min=16,
+               prompt_bucket_min=4)
+    cfg.update(over)
+    return GenerationEngine(_decoder(), GenerationConfig(**cfg))
+
+
+# --- bucket math -----------------------------------------------------------
+
+def test_pow2_ladder_and_bucket_for():
+    assert pow2_ladder(8, 64) == [8, 16, 32, 64]
+    assert pow2_ladder(32, 48) == [32, 48]  # capped at (and including) hi
+    assert pow2_ladder(64, 32) == [32]
+    assert bucket_for(9, [8, 16, 32]) == 16
+    assert bucket_for(16, [8, 16, 32]) == 16
+    with pytest.raises(ValueError):
+        bucket_for(33, [8, 16, 32])
+
+
+# --- KV-cache math against the no-cache oracle -----------------------------
+
+def test_greedy_generate_matches_full_forward_oracle():
+    """The KV-cached prefill+decode path must produce exactly the tokens
+    the full no-cache forward picks: grow the sequence one token at a
+    time through ``net.output`` and argmax the last position."""
+    dec = _decoder()
+    prompt = [3, 9, 1, 14, 2]
+    out = dec.generate(prompt, max_new=6)
+    seq = list(prompt)
+    ref = []
+    for _ in range(6):
+        y = np.asarray(dec.net.output(np.asarray([seq], np.int32)))
+        ref.append(int(np.argmax(y[0, len(seq) - 1])))
+        seq.append(ref[-1])
+    assert out == ref
+
+
+def test_fused_k1_vs_k4_token_identical():
+    dec = _decoder()
+    prompt = [5, 6, 7, 8, 2, 11]
+    a = dec.generate(prompt, max_new=9, fused_steps=1)
+    b = dec.generate(prompt, max_new=9, fused_steps=K)
+    assert a == b
+
+
+def test_generate_stops_at_eos():
+    dec = _decoder()
+    ref = dec.generate([4, 8, 15], max_new=8)
+    eos = ref[2]
+    out = dec.generate([4, 8, 15], max_new=8, eos_id=eos)
+    assert out == ref[:ref.index(eos) + 1]
+    assert out[-1] == eos
+
+
+def test_temperature_sampling_deterministic_per_seed():
+    dec = _decoder()
+    a = dec.generate([1, 2, 3], max_new=8, temperature=0.9, seed=123)
+    b = dec.generate([1, 2, 3], max_new=8, temperature=0.9, seed=123)
+    assert a == b  # same seed replays the same per-request stream
+    assert all(0 <= t < VOCAB for t in a)
+    greedy = dec.generate([1, 2, 3], max_new=8)
+    assert len(a) == len(greedy) == 8
+
+
+def test_unsupported_graphs_rejected():
+    """Graphs the decode path cannot serve faithfully refuse at
+    construction: classifier heads (pooling), MoE FFNs (cross-row
+    routing breaks the row-independence the bit-identity pin rests on),
+    and non-causal attention."""
+    with pytest.raises(ValueError, match="lm_head"):
+        TransformerEncoder(vocab_size=16, causal=True).decoder()
+    moe = TransformerEncoder(vocab_size=16, embed_dim=8, n_heads=2,
+                             n_layers=1, max_len=16, causal=True,
+                             lm_head=True, moe_experts=2)
+    with pytest.raises(ValueError, match="MoELayer"):
+        moe.decoder(max_batch=2)
+    with pytest.raises(ValueError, match="causal"):
+        TransformerEncoder(vocab_size=16, lm_head=True)
+
+
+def test_request_validation():
+    dec = _decoder()
+    with pytest.raises(ValueError, match="at least one token"):
+        dec.validate_request([], 4)
+    with pytest.raises(ValueError, match="token ids"):
+        dec.validate_request([VOCAB], 4)
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        dec.validate_request([1] * 30, 8)
+
+
+# --- continuous scheduling == sequential reference -------------------------
+
+def test_continuous_engine_token_identical_to_sequential():
+    """Five requests churn through four cache rows (join/leave mid-
+    flight, mixed prompt/output lengths) and every sequence's greedy
+    tokens equal the sequential one-at-a-time reference exactly."""
+    dec = _decoder()
+    prompts = [[3, 9, 1], [5, 6, 7, 8, 2, 11], [1], [14, 13, 12, 2],
+               [9, 9, 2, 3, 4, 5, 6, 1]]
+    mns = [6, 9, 4, 12, 5]
+    refs = [dec.generate(p, mn) for p, mn in zip(prompts, mns)]
+    with _engine() as eng:
+        eng.warmup()
+        reqs = [eng.submit(p, max_new_tokens=mn)
+                for p, mn in zip(prompts, mns)]
+        outs = [eng.result(r) for r in reqs]
+    assert outs == refs
+
+
+def test_sampled_engine_matches_sequential_reference():
+    """Per-sequence PRNG keys make even temperature sampling immune to
+    co-tenant churn: engine output equals the sequential reference for
+    the same (seed, temperature)."""
+    dec = _decoder()
+    ref = dec.generate([2, 4, 6], max_new=7, temperature=0.8, seed=42)
+    with _engine() as eng:
+        out = eng.generate([2, 4, 6], max_new_tokens=7, temperature=0.8,
+                           seed=42)
+    assert out == ref
+
+
+def test_late_join_completes_before_earlier_longer_sequence():
+    """Token-granularity admission: a short request submitted AFTER a
+    long one is already decoding joins the running batch at the next
+    iteration and finishes first — no request-granularity drain wait."""
+    dec = _decoder()
+    long_ref = dec.generate([7, 3], max_new=24)
+    short_ref = dec.generate([9, 9, 2], max_new=3)
+    order = []
+    with _engine() as eng:
+        long_req = eng.submit([7, 3], max_new_tokens=24)
+        # wait until the long request is genuinely mid-generation
+        deadline = time.monotonic() + 5
+        while len(long_req.out) < 4:
+            assert time.monotonic() < deadline, "long request never started"
+            time.sleep(0.002)
+        short_req = eng.submit([9, 9, 2], max_new_tokens=3)
+
+        def wait(tag, req):
+            eng.result(req)
+            order.append(tag)
+
+        ts = [threading.Thread(target=wait, args=("long", long_req)),
+              threading.Thread(target=wait, args=("short", short_req))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert eng.result(short_req) == short_ref
+        assert eng.result(long_req) == long_ref
+    assert order[0] == "short", "late-joining short request should retire first"
+
+
+def test_eos_retirement_frees_rows():
+    dec = _decoder()
+    ref = dec.generate([4, 8, 15], max_new=8)
+    eos = ref[2]
+    with _engine() as eng:
+        before = eng.stats()
+        out = eng.generate([4, 8, 15], max_new_tokens=8, eos_id=eos)
+        after = eng.stats()
+    assert out == ref[:ref.index(eos) + 1]
+    assert after["rows_in_use"] == 0
+    assert after["retired_total"] == before["retired_total"] + 1
+
+
+# --- zero-recompile invariant ----------------------------------------------
+
+def test_warmup_then_mixed_traffic_zero_recompiles():
+    """After ``warmup()`` a mixed sweep — short and long prompts, short
+    and long outputs, KV bucket growth 16→32, join groups of 1..4 —
+    never misses the AOT cache."""
+    with _engine() as eng:
+        eng.warmup()
+        miss0 = aot_cache.stats()["misses"]
+        reqs = [eng.submit([1 + i % 7] * (1 + 3 * i), max_new_tokens=3 + i)
+                for i in range(4)]
+        for r in reqs:
+            eng.result(r)
+        # long prompt: prompt bucket 32 forces a KV grow hop mid-service
+        eng.generate([2] * 20, max_new_tokens=8)
+        assert eng.stats()["kv_bucket"] == 32
+        assert aot_cache.stats()["misses"] == miss0, \
+            "mixed-length traffic recompiled after warmup"
+
+
+def test_warmup_is_idempotent():
+    eng = _engine()
+    try:
+        assert eng.warmup()["compiled"] == 0  # module decoder pre-warmed
+    finally:
+        eng.close()
+
+
+# --- admission control / resilience ----------------------------------------
+
+def test_bad_request_rejected_at_submit():
+    with _engine() as eng:
+        with pytest.raises(BadRequestError):
+            eng.submit([], max_new_tokens=4)
+        with pytest.raises(BadRequestError):
+            eng.submit([VOCAB + 1], max_new_tokens=4)
+        with pytest.raises(BadRequestError):
+            eng.submit([1] * 31, max_new_tokens=8)
+        with pytest.raises(BadRequestError):
+            eng.submit([1], max_new_tokens=4, temperature=-1.0)
+        with pytest.raises(BadRequestError):
+            eng.submit([1], max_new_tokens=4, eos_id=VOCAB + 5)
+
+
+def test_queue_full_rejects_with_503_semantics():
+    eng = _engine(max_queue=2)
+    eng._ensure_thread = lambda: None  # keep requests queued
+    try:
+        eng.submit([1], max_new_tokens=2)
+        eng.submit([2], max_new_tokens=2)
+        with pytest.raises(ServerOverloadedError):
+            eng.submit([3], max_new_tokens=2)
+    finally:
+        eng.close()
+
+
+def test_expired_deadline_fails_queued_request():
+    eng = _engine()
+    eng._ensure_thread = lambda: None
+    try:
+        req = eng.submit([1, 2], max_new_tokens=4, timeout_ms=5)
+        time.sleep(0.02)
+        eng._expire_queued_locked(time.monotonic())
+        with pytest.raises(DeadlineExpiredError):
+            eng.result(req)
+    finally:
+        eng.close()
+
+
+def test_deadline_mid_generation_frees_row():
+    """A deadline that expires while the sequence is decoding fails the
+    request at the next retire check and releases its cache row (the
+    in-graph ``gen_release`` mask keeps the dead row a no-op)."""
+    plan = FaultPlan(seed=3)
+    plan.inject("decode.launch", probability=1.0, action="delay",
+                delay_s=0.02)
+    with _engine() as eng:
+        with plan.armed():
+            req = eng.submit([1, 2, 3], max_new_tokens=28, timeout_ms=60)
+            with pytest.raises(DeadlineExpiredError):
+                eng.result(req)
+        deadline = time.monotonic() + 5
+        while eng.stats()["rows_in_use"] and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert eng.stats()["rows_in_use"] == 0
+
+
+def test_breaker_trips_open_and_sheds_then_recovers():
+    """Persistent decode-path failure trips the circuit open (every
+    in-flight request fails, like the batcher failing its batch), open
+    sheds at submit with 503 semantics, and a half-open probe closes it
+    once the fault clears."""
+    breaker = CircuitBreaker(name="decode-test", failure_threshold=2,
+                             recovery_timeout_s=0.15, success_threshold=1)
+    eng = GenerationEngine(
+        _decoder(), GenerationConfig(max_batch=MAX_BATCH, fused_steps=K,
+                                     kv_bucket_min=16, prompt_bucket_min=4),
+        breaker=breaker, retry=None)
+    plan = FaultPlan(seed=11)
+    plan.inject("decode.launch", probability=1.0, action="raise")
+    try:
+        with plan.armed():
+            for _ in range(2):
+                req = eng.submit([1, 2], max_new_tokens=4)
+                with pytest.raises(Exception):
+                    eng.result(req)
+            deadline = time.monotonic() + 5
+            while breaker.state != "open" and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert breaker.state == "open"
+            with pytest.raises(CircuitOpenError):
+                eng.submit([1, 2], max_new_tokens=4)
+            rec = REGISTRY.snapshot(run_collectors=False)
+        time.sleep(0.2)  # recovery window, fault now disarmed
+        out = eng.generate([1, 2], max_new_tokens=4)  # half-open probe
+        assert len(out) == 4
+        assert breaker.state == "closed"
+        assert rec.get('dl4j_decode_requests_total{status="shed"}', 0) >= 1
+    finally:
+        eng.close()
+
+
+def test_close_fails_pending_requests():
+    eng = _engine()
+    eng._ensure_thread = lambda: None
+    req = eng.submit([1], max_new_tokens=2)
+    eng.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.result(req)
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.submit([1], max_new_tokens=2)
+
+
+# --- telemetry / stats / lint ----------------------------------------------
+
+def test_decode_telemetry_series():
+    snap0 = REGISTRY.snapshot(run_collectors=False)
+    with _engine() as eng:
+        eng.generate([1, 2, 3, 4], max_new_tokens=6)
+        snap1 = REGISTRY.snapshot(run_collectors=True)
+    d_tokens = (snap1["dl4j_decode_tokens_total"]
+                - snap0.get("dl4j_decode_tokens_total", 0))
+    assert d_tokens >= 6
+    assert "dl4j_decode_batch_occupancy" in snap1
+    assert "dl4j_decode_kv_rows_in_use" in snap1
+    assert snap1["dl4j_decode_token_seconds"]["count"] > 0
+    assert snap1["dl4j_decode_first_token_seconds"]["count"] > 0
+    ok_key = 'dl4j_decode_requests_total{status="ok"}'
+    assert snap1.get(ok_key, 0) >= snap0.get(ok_key, 0) + 1
+
+
+def test_generation_panel_renders():
+    from deeplearning4j_tpu.ui.server import UIServer
+
+    with _engine() as eng:
+        eng.generate([5, 5], max_new_tokens=3)
+    panel = UIServer.get_instance()._generation_panel()
+    assert "Generation (continuous batching)" in panel
+    assert "dl4j_decode_tokens_total" in panel
+
+
+def test_stats_shape():
+    with _engine() as eng:
+        eng.generate([1, 2], max_new_tokens=3)
+        st = eng.stats()
+    assert st["rows"] == MAX_BATCH
+    assert st["joined_total"] >= 1 and st["retired_total"] >= 1
+    assert st["tokens_total"] >= 3
+    assert st["prefill_seconds"] > 0 and st["decode_seconds"] > 0
+    assert st["buckets"]["kv"] == [16, 32]
+    assert "misses" in st["aot_cache"]
+
+
+def test_donation_audit_covers_decode_kinds():
+    """PRG201: the program linter's train-kind set includes
+    ``decode_step*``/``prefill*`` and every compiled decode/join
+    executable aliases its state buffers (the KV cache is donated, not
+    copied)."""
+    from deeplearning4j_tpu.analysis import program
+
+    assert "decode_step" in program.TRAIN_KIND_PREFIXES
+    assert "prefill" in program.TRAIN_KIND_PREFIXES
+    _decoder()  # ensure the executables exist in this process
+    audit = program.donation_audit()
+    kinds = {k: v for k, v in audit.items()
+             if k[1].startswith(("decode_step", "prefill"))}
+    assert kinds, "no decode executables were audited"
+    for key, rep in kinds.items():
+        assert rep["aliases"] > 0, f"{key[1]} does not donate its KV state"
+        assert rep["findings"] == 0
