@@ -66,6 +66,18 @@ decode-smoke:
 		-p no:cacheprovider
 	JAX_PLATFORMS=cpu $(PY) bench_decode.py --smoke
 
+.PHONY: comms-smoke
+# Collective-scheduler smoke: plan determinism/digests, scheduler-vs-
+# legacy bit-identity for every wrapper exchange mode, PRG205 plan
+# audit, cross-mesh reshard + publish_to_engine — then the legacy-vs-
+# scheduler A/B bench asserting no regression in collective launches or
+# bytes. CPU-pinned, 8 virtual devices, fixed seeds.
+comms-smoke:
+	JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	$(PY) -m pytest tests -q -m comms -p no:cacheprovider
+	$(PY) bench_collectives.py --smoke
+
 .PHONY: lint
 # Repo-discipline source lint (analysis/source.py AST rules): host syncs
 # in compiled functions, lock discipline on shared registries, wall-clock/

@@ -275,13 +275,21 @@ def _rule_collectives(art: ProgramArtifact, out: List[Finding]) -> None:
     replicates what sharding was meant to split; (b) a bucketed schedule
     with multiple scatter collectives but no ``optimization_barrier``
     lets XLA merge/reorder the buckets — the overlap schedule silently
-    degrades to one fused exchange."""
+    degrades to one fused exchange; (c) scheduler-emitted plans: a step
+    key carrying ``plan:<digest>`` tokens promised a specific collective
+    sequence — op kinds, bucket count, barrier chain — and the compiled
+    module must deliver it (``comms.scheduler.lookup_plan`` resolves the
+    digests). Single-bucket plans and the legacy ``:b0`` fused exchange
+    are variadic single collectives with legitimately no ordering chain
+    — exempt."""
     if art.jaxpr is None:
         return
     counts = _prim_counts(art.jaxpr)
     n_allreduce = sum(counts.get(p, 0) for p in ALL_REDUCE_PRIMS)
     n_scatter = sum(counts.get(p, 0) for p in REDUCE_SCATTER_PRIMS)
     n_barrier = counts.get("optimization_barrier", 0)
+    _audit_scheduler_plans(art, counts, n_allreduce, n_scatter,
+                           n_barrier, out)
     if art.fn_key.startswith("pw_zero"):
         if n_allreduce and not n_scatter:
             out.append(Finding(
@@ -303,6 +311,58 @@ def _rule_collectives(art: ProgramArtifact, out: List[Finding]) -> None:
                 message=f"{n_scatter} scatter collectives with no "
                         f"optimization_barrier issue-order chain — "
                         f"buckets can merge/reorder"))
+
+
+def _audit_scheduler_plans(art: ProgramArtifact, counts, n_allreduce,
+                           n_scatter, n_barrier,
+                           out: List[Finding]) -> None:
+    """PRG205(c): verify the compiled collective sequence against every
+    scheduler plan whose digest the step key carries."""
+    digests = re.findall(r"plan:([0-9a-f]{8,40})", art.fn_key)
+    if not digests:
+        return
+    try:
+        from deeplearning4j_tpu.comms import scheduler as comms_sched
+
+        plans = [p for d in digests
+                 if (p := comms_sched.lookup_plan(d)) is not None]
+    except Exception:
+        return  # keys minted elsewhere / comms unavailable: nothing to say
+    if not plans:
+        return
+    n_gather = counts.get("all_gather", 0)
+    exp_barriers = sum(max(0, p.launches() - 1) for p in plans)
+    for p in plans:
+        if (p.intent == "reduce_scatter" and n_scatter == 0
+                and n_allreduce):
+            out.append(Finding(
+                rule="PRG205", severity=ERROR, location=art.location,
+                message=f"plan {p.digest} promised reduce-scatter but "
+                        f"the module compiled all-reduce collectives "
+                        f"only — the gradient exchange is not sharded"))
+        if (p.intent == "all_gather" and "all_gather" in p.choices
+                and n_gather == 0 and n_allreduce == 0):
+            out.append(Finding(
+                rule="PRG205", severity=WARN, location=art.location,
+                message=f"plan {p.digest} promised a native all-gather "
+                        f"but the module contains no gather (or masked-"
+                        f"psum) collective"))
+    # expected scatter launches: >= one psum_scatter eqn per leaf, so at
+    # least one per bucket — fewer means buckets merged despite the pins
+    exp_scatter = sum(p.launches() for p in plans
+                      if p.intent == "reduce_scatter")
+    if exp_scatter and 0 < n_scatter < exp_scatter:
+        out.append(Finding(
+            rule="PRG205", severity=WARN, location=art.location,
+            message=f"scheduler plans promised >= {exp_scatter} "
+                    f"reduce-scatter launches; module has {n_scatter} — "
+                    f"buckets merged"))
+    if exp_barriers and n_barrier < exp_barriers:
+        out.append(Finding(
+            rule="PRG205", severity=WARN, location=art.location,
+            message=f"scheduler plans promised {exp_barriers} "
+                    f"optimization_barrier issue-order pins; module has "
+                    f"{n_barrier} — buckets can merge/reorder"))
 
 
 def _near_miss(sig_a, sig_b) -> Optional[str]:

@@ -1,0 +1,36 @@
+"""Unified collective communication layer (ROADMAP item 4).
+
+Two pillars:
+
+- :mod:`deeplearning4j_tpu.comms.scheduler` — the
+  :class:`CollectiveScheduler`: ONE planner owning bucket layout, issue
+  order, and per-bucket collective choice for every explicit exchange in
+  the tree (``parallel.compression``'s ``bucketed_psum`` /
+  ``bucketed_psum_scatter`` / ``bucketed_all_gather`` are thin wrappers
+  over scheduler plans). Every plan carries a content digest that joins
+  the AOT step-executable cache key, so a changed layout can never
+  silently reuse a stale executable.
+- :mod:`deeplearning4j_tpu.comms.reshard` — portable cross-mesh
+  resharding (arXiv:2112.01075 shape: per-device slice intersection →
+  minimal exchange → reassemble) for live-state hand-offs: restore
+  across mesh shapes without the host gather/scatter round-trip, and
+  ``publish_to_engine`` for zero-copy train→serve publishing.
+
+docs/collectives.md has the guided tour.
+"""
+
+from deeplearning4j_tpu.comms.scheduler import (  # noqa: F401
+    CollectivePlan,
+    CollectiveScheduler,
+    bucket_layout,
+    bucket_partition,
+    exchange,
+    lookup_plan,
+    plan_for,
+    stats,
+)
+from deeplearning4j_tpu.comms.reshard import (  # noqa: F401
+    publish_to_engine,
+    reshard,
+    reshard_training_state,
+)

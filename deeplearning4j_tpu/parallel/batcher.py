@@ -228,18 +228,9 @@ class InferenceEngine:
         # injected faults) before a launch failure reaches the breaker;
         # model bugs (ValueError & co) are never retried. None disables.
         self._retry = SERVING_RETRY if retry is ... else retry
-        if graph_opt:
-            from deeplearning4j_tpu.nn.inference_opt import (
-                optimize_for_inference,
-            )
-
-            model = optimize_for_inference(model, bf16=bf16)
-        self.model = model
-        # sharded backends need launch rows divisible by the shard count
-        self._align = int(getattr(model, "workers", 1) or 1)
-        self._np_dtype = np.dtype(getattr(
-            getattr(model, "model", model), "_dtype", np.float32))
-        self._templates = _input_templates(model)
+        self._graph_opt = bool(graph_opt)
+        self._bf16 = bool(bf16)
+        self._adopt_model(model)
         self._queue: deque = deque()
         self._cond = threading.Condition()
         self._stop = False
@@ -334,6 +325,61 @@ class InferenceEngine:
         """Synchronous request: enqueue, share a launch, demux
         (reference ``ParallelInference#output`` through the observable)."""
         return self.result(self.submit(inputs, timeout_ms=timeout_ms))
+
+    # --- model adoption / hot publish ---------------------------------------
+    def _adopt_model(self, model, run_graph_opt: bool = True):
+        """Derive the engine's serving surface from ``model`` — the ONE
+        place the inference-graph pass, bucket alignment, numpy dtype
+        and input templates are computed (construction and ``publish``
+        share it, so the derivations can never drift)."""
+        if self._graph_opt and run_graph_opt:
+            from deeplearning4j_tpu.nn.inference_opt import (
+                optimize_for_inference,
+            )
+
+            model = optimize_for_inference(model, bf16=self._bf16)
+        self.model = model
+        # sharded backends need launch rows divisible by the shard count
+        self._align = int(getattr(model, "workers", 1) or 1)
+        self._np_dtype = np.dtype(getattr(
+            getattr(model, "model", model), "_dtype", np.float32))
+        self._templates = _input_templates(model)
+        return model
+
+    def publish(self, model, params=None, state=None):
+        """Swap the serving weights WITHOUT restarting the engine — the
+        ``comms.reshard.publish_to_engine`` zero-copy train→serve
+        hand-off. ``model`` is the source network (configuration
+        authority); ``params``/``state`` override its trees with
+        device-resident ones (a live wrapper's resharded state — nothing
+        crosses the host). The construction-time inference-graph pass
+        re-runs with the same ``graph_opt``/``bf16`` flags, so a
+        BN-folding engine keeps folding. The swap is atomic per batch:
+        requests drained after it take the new weights; a batch the
+        dispatcher already claimed at swap time may run on either
+        version (the engine never splits one batch across versions).
+        The published model shares the source configuration, so every
+        warmed bucket executable stays valid (conf-derived AOT graph
+        key + unchanged avals: zero recompiles, pinned by test_comms).
+        Returns the model now serving."""
+        import copy
+
+        src = model
+        if params is not None or state is not None:
+            src = copy.copy(model)
+            if params is not None:
+                src.params = params
+            if state is not None:
+                src.state = state
+        if self._graph_opt:
+            from deeplearning4j_tpu.nn.inference_opt import (
+                optimize_for_inference,
+            )
+
+            src = optimize_for_inference(src, bf16=self._bf16)
+        with self._cond:
+            self._adopt_model(src, run_graph_opt=False)
+        return src
 
     # --- warmup -------------------------------------------------------------
     def buckets(self) -> List[int]:

@@ -27,7 +27,6 @@ from __future__ import annotations
 import dataclasses
 
 import jax.numpy as jnp
-import numpy as np
 
 
 def threshold_encode(g, tau):
@@ -105,7 +104,8 @@ def encode_tree(grads, residuals, tau):
 
 
 # ---------------------------------------------------------------------------
-# Bucketed, overlap-scheduled all-reduce
+# Bucketed, overlap-scheduled collectives — thin wrappers over the unified
+# collective scheduler (comms/scheduler.py)
 # ---------------------------------------------------------------------------
 #
 # The reference's EncodedGradientsAccumulator streams per-parameter update
@@ -114,161 +114,82 @@ def encode_tree(grads, residuals, tau):
 # recovers the overlap on TPU: the gradient pytree is partitioned into
 # size-targeted buckets in REVERSE-topological order (the last layers'
 # grads — the first ones backprop produces — land in bucket 0), and each
-# bucket is reduced by its own collective. An ``optimization_barrier`` chain
-# pins the issue ORDER of the collectives (bucket 0 first) without adding
-# data dependencies on later gradients, so XLA's latency-hiding scheduler
-# can run bucket k's all-reduce while the backward pass is still producing
-# bucket k+1's gradients. Cite: arXiv:1905.04035 (collective performance
-# during gradient accumulation dominates DP scaling) and arXiv:2112.01075
-# (decomposing one big transfer into scheduled collective chunks).
+# bucket is reduced by its own collective under an ``optimization_barrier``
+# issue chain. Since the comms round these three primitives no longer own
+# that machinery: ``comms.scheduler`` plans layout, order, AND the per-
+# bucket collective choice (variadic / densified / native-vs-masked
+# gather), and each function here is one ``scheduler.exchange`` call.
+# ``bucket_partition`` / ``bucket_layout`` are re-exported from the
+# scheduler (the single shared implementation).
 
-
-def bucket_partition(sizes, bucket_bytes: int):
-    """Partition leaf indices into size-targeted buckets, walking the
-    leaves in REVERSE order (reverse-topological: backprop computes the
-    deepest layers' grads first). Returns a list of index lists; every
-    index appears exactly once. A leaf larger than ``bucket_bytes`` gets
-    its own bucket."""
-    buckets, cur, acc = [], [], 0
-    for i in reversed(range(len(sizes))):
-        if cur and acc + sizes[i] > bucket_bytes:
-            buckets.append(cur)
-            cur, acc = [], 0
-        cur.append(i)
-        acc += sizes[i]
-    if cur:
-        buckets.append(cur)
-    return buckets
-
-
-def bucket_layout(tree, bucket_bytes=None):
-    """Host-side preview of :func:`bucketed_psum`'s schedule for a pytree
-    of (possibly abstract) arrays: the list of per-bucket payload sizes in
-    bytes, in issue order. ``bucket_bytes=None`` (the single fused
-    collective) returns one bucket holding the whole tree. Used by the
-    telemetry layer to record per-bucket collective bytes without running
-    the compiled exchange."""
-    import jax
-
-    leaves = jax.tree_util.tree_leaves(tree)
-    if not leaves:
-        return []
-    sizes = [l.size * np.dtype(l.dtype).itemsize for l in leaves]
-    if bucket_bytes is None or len(leaves) <= 1:
-        return [sum(sizes)]
-    return [sum(sizes[i] for i in bucket)
-            for bucket in bucket_partition(sizes, int(bucket_bytes))]
+from deeplearning4j_tpu.comms.scheduler import (  # noqa: F401,E402
+    bucket_layout,
+    bucket_partition,
+)
 
 
 def bucketed_psum_scatter(tree, axis_name, bucket_bytes=None):
     """Reduce-scatter a pytree of FLAT, shard-count-padded vectors over
-    ``axis_name`` in the SAME size-targeted reverse-topological buckets
-    as :func:`bucketed_psum` (the ZeRO exchange's first half: every
-    shard receives only its 1/n slice of each leaf's cross-shard sum).
+    ``axis_name`` on the scheduler's ``reduce_scatter`` plan — same
+    size-targeted reverse-topological buckets as :func:`bucketed_psum`
+    (the ZeRO exchange's first half: every shard receives only its 1/n
+    slice of each leaf's cross-shard sum).
 
     Leaves must be 1-D with length divisible by the axis size (the
     ``sharding.zero.ZeroSpec`` flatten/pad contract). Bit-compatible
     with ``psum`` + slice: XLA's reduce-scatter performs the identical
     per-element reduction, it just leaves each element on one shard —
     pinned by test_sharding's bit-identity suite."""
-    import jax
+    from deeplearning4j_tpu.comms import scheduler
 
-    leaves, treedef = jax.tree_util.tree_flatten(tree)
-    if not leaves:
-        return tree
-
-    def scatter(vals):
-        return jax.lax.psum_scatter(vals, axis_name, scatter_dimension=0,
-                                    tiled=True)
-
-    if bucket_bytes is None or len(leaves) <= 1:
-        return jax.tree_util.tree_unflatten(treedef,
-                                            list(scatter(tuple(leaves))))
-    sizes = [l.size * l.dtype.itemsize for l in leaves]
-    out = [None] * len(leaves)
-    pin = None
-    for bucket in bucket_partition(sizes, int(bucket_bytes)):
-        vals = tuple(leaves[i] for i in bucket)
-        if pin is not None:
-            pinned = jax.lax.optimization_barrier(vals + (pin,))
-            vals = tuple(pinned[:-1])
-        red = scatter(vals)
-        pin = red[0]
-        for i, r in zip(bucket, red):
-            out[i] = r
-    return jax.tree_util.tree_unflatten(treedef, out)
+    return scheduler.exchange(tree, "reduce_scatter", axis_name,
+                              bucket_bytes)
 
 
 def bucketed_all_gather(tree, axis_name, index, full_sizes,
                         bucket_bytes=None):
     """All-gather a pytree of per-shard 1-D slices back into full flat
-    vectors (the ZeRO exchange's second half), bucketed on the SAME
-    layout as :func:`bucketed_psum`.
+    vectors (the ZeRO exchange's second half) on the scheduler's
+    ``all_gather`` plan — bucketed on the SAME layout as
+    :func:`bucketed_psum`, with the collective CHOICE probe-gated:
 
-    Implemented as a psum of position-masked contributions — each shard
-    deposits its slice at ``[index*m, (index+1)*m)`` of a zeros vector
-    and the cross-shard sum reassembles the full array. Adding zeros is
-    exact in floating point, so the result is bitwise the concatenation
-    of the shards' slices, and (unlike raw ``lax.all_gather``) the
-    replication of the output is statically known to pre-vma jax's
-    shard_map checker.
+    - **vma-capable jax** (``comms.scheduler.NATIVE_ALL_GATHER``): a
+      native ``lax.all_gather`` per leaf — the ring all-gather's
+      (n-1)/n payload, with the output's replication expressed by the
+      vma type system;
+    - **this container's 0.4.37 (check_rep)**: the masked-psum fallback
+      — each shard deposits its slice at ``[index*m, (index+1)*m)`` of
+      a zeros vector and the cross-shard sum reassembles the full
+      array. Adding zeros is exact in floating point, so the result is
+      bitwise the concatenation of the shards' slices, and the psum
+      output's replication is statically known to the pre-vma shard_map
+      checker — at the cost of all-reduce bandwidth on the wire (~2x
+      the native path's payload; the telemetry counters record the
+      LOGICAL gathered payload under either choice).
 
-    COST CAVEAT: a masked psum moves all-reduce bandwidth (~2x a native
-    ring all-gather's (n-1)/n payload) — the deliberate price of an
-    implementation that is bitwise-exact AND expressible on this
-    container's check_rep jax. Swapping in ``lax.all_gather`` where the
-    vma type system can express the output's replication belongs to the
-    collective scheduler (ROADMAP item 3); the telemetry counters record
-    the LOGICAL gathered payload either way. ``full_sizes``: per-leaf
-    gathered lengths (``n_shards * slice_len``), in tree-leaf order."""
-    import jax
-    import jax.numpy as jnp
+    docs/collectives.md has the full choice/probe table.
+    ``full_sizes``: per-leaf gathered lengths (``n_shards *
+    slice_len``), in tree-leaf order."""
+    from deeplearning4j_tpu.comms import scheduler
 
-    leaves, treedef = jax.tree_util.tree_flatten(tree)
-    if not leaves:
-        return tree
-    contribs = []
-    for sl, full in zip(leaves, full_sizes):
-        m = sl.shape[0]
-        contribs.append(jax.lax.dynamic_update_slice(
-            jnp.zeros((int(full),), sl.dtype), sl, (index * m,)))
-    return bucketed_psum(jax.tree_util.tree_unflatten(treedef, contribs),
-                         axis_name, bucket_bytes)
+    return scheduler.exchange(tree, "all_gather", axis_name, bucket_bytes,
+                              index=index, full_sizes=full_sizes)
 
 
 def bucketed_psum(tree, axis_name, bucket_bytes=None):
-    """``lax.psum`` a pytree over ``axis_name`` in size-targeted buckets.
+    """``lax.psum`` a pytree over ``axis_name`` on the scheduler's
+    ``all_reduce`` plan.
 
-    ``bucket_bytes=None`` (or a tree of <= 1 leaf) falls back to ONE fused
-    variadic psum — the single-collective baseline. Otherwise each bucket
-    becomes one variadic psum, issued in reverse-topological order with an
-    ``optimization_barrier`` chain tying bucket k+1's operands to bucket
-    k's result so the collectives cannot be merged or reordered — the
-    overlap schedule described above. The reduction itself is unchanged
-    (same per-leaf cross-shard sum), so bucketed and fused results are
-    numerically identical."""
-    import jax
+    ``bucket_bytes=None`` (or a tree of <= 1 leaf) is ONE fused variadic
+    psum — the single-collective baseline. Otherwise each bucket issues
+    in reverse-topological order under the ``optimization_barrier``
+    chain so the collectives cannot merge or reorder — the overlap
+    schedule described above — and a bucket of many tiny same-dtype
+    leaves exchanges as one densified buffer (``densify`` choice). The
+    per-element reduction is unchanged in every case, so scheduled and
+    fused results are bitwise identical."""
+    from deeplearning4j_tpu.comms import scheduler
 
-    leaves, treedef = jax.tree_util.tree_flatten(tree)
-    if not leaves:
-        return tree
-    if bucket_bytes is None or len(leaves) <= 1:
-        return jax.tree_util.tree_unflatten(
-            treedef, list(jax.lax.psum(tuple(leaves), axis_name)))
-    sizes = [l.size * l.dtype.itemsize for l in leaves]
-    out = [None] * len(leaves)
-    pin = None
-    for bucket in bucket_partition(sizes, int(bucket_bytes)):
-        vals = tuple(leaves[i] for i in bucket)
-        if pin is not None:
-            # order pin: this bucket's reduce is scheduled after the
-            # previous bucket's — a pure scheduling edge, no math
-            pinned = jax.lax.optimization_barrier(vals + (pin,))
-            vals = tuple(pinned[:-1])
-        red = jax.lax.psum(vals, axis_name)
-        pin = red[0]
-        for i, r in zip(bucket, red):
-            out[i] = r
-    return jax.tree_util.tree_unflatten(treedef, out)
+    return scheduler.exchange(tree, "all_reduce", axis_name, bucket_bytes)
 
 

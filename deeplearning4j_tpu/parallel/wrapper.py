@@ -331,6 +331,12 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             self._step = None
             self._fused_step = None
             self._health_mode = mode
+        # one-shot prestaged trees from comms.reshard_training_state: a
+        # cross-mesh hand-off already recommitted params/state/opt onto
+        # THIS mesh device-to-device — adopt them instead of re-staging
+        # from the model's host arrays (exact/ZeRO/plan modes only; the
+        # hand-off refuses the others)
+        pre = self.__dict__.pop("_prestaged", None)
         if self.training_mode is TrainingMode.AVERAGING:
             # multi-process: each process contributes its LOCAL replicas;
             # shard_batch assembles the [workers]-leading global tree
@@ -374,15 +380,21 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         elif self._zero:
             from deeplearning4j_tpu.sharding.zero import ZeroSpec
 
-            self._params = self._replicated(m.params)
-            self._state = self._replicated(m.state)
-            # optimizer state lives SCATTERED: flat 1/workers slices,
-            # each shard's slice resident on its devices only — the
-            # ZeRO memory footprint
-            self._zero_pspec = ZeroSpec(m.params, self.workers)
-            self._zero_ospec = ZeroSpec(m.opt_state, self.workers)
-            self._opt = self._zero_ospec.scatter_host(m.opt_state,
-                                                      self.mesh, DATA)
+            if pre is not None:
+                self._params, self._state, self._opt = pre
+            else:
+                self._params = self._replicated(m.params)
+                self._state = self._replicated(m.state)
+                # optimizer state lives SCATTERED: flat 1/workers
+                # slices, each shard's slice resident on its devices
+                # only — the ZeRO memory footprint. Device-resident
+                # trees (a restored checkpoint, a rolled-back state)
+                # re-scatter through comms.reshard's slice-intersection
+                # path instead of the numpy round-trip.
+                self._zero_pspec = ZeroSpec(m.params, self.workers)
+                self._zero_ospec = ZeroSpec(m.opt_state, self.workers)
+                self._opt = self._zero_ospec.scatter(m.opt_state,
+                                                     self.mesh, DATA)
             if self._step is None:
                 self._step = self._build_zero_step()
             telemetry.record_shard_bytes(
@@ -394,9 +406,12 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             plan = self._plan
             pspecs = plan.param_specs(m.params)
             ospecs = plan.opt_specs(m.params, m.opt_state)
-            self._params = plan.place(m.params, pspecs)
-            self._state = self._replicated(m.state)
-            self._opt = plan.place(m.opt_state, ospecs)
+            if pre is not None:
+                self._params, self._state, self._opt = pre
+            else:
+                self._params = plan.place(m.params, pspecs)
+                self._state = self._replicated(m.state)
+                self._opt = plan.place(m.opt_state, ospecs)
             if self._step is None:
                 raw = m.train_step_fn(guards=mode)
 
@@ -422,9 +437,12 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                     f"{health.cache_tag()}")
             plan.publish_metrics(m.params, m.opt_state)
         else:
-            self._params = self._replicated(m.params)
-            self._state = self._replicated(m.state)
-            self._opt = self._replicated(m.opt_state)
+            if pre is not None:
+                self._params, self._state, self._opt = pre
+            else:
+                self._params = self._replicated(m.params)
+                self._state = self._replicated(m.state)
+                self._opt = self._replicated(m.opt_state)
             # exact mode: the model's own fused step, jitted over the mesh —
             # batch shardings drive SPMD partitioning, XLA inserts the
             # all-reduce. With gradient_bucket_mb set, the explicit
@@ -452,8 +470,10 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
 
                     self._step = jax.jit(exact_step,
                                          donate_argnums=(0, 1, 2))
-        # freshly staged from the model: trees and host arrays agree
-        self._synced = True
+        # freshly staged from the model: trees and host arrays agree —
+        # except after a prestaged cross-mesh hand-off, whose device
+        # trees are AHEAD of the model's host arrays until a gather
+        self._synced = pre is None
 
     # --- expert-parallel (GShard: experts ride the data axis) --------------
     def _layer_confs(self):
@@ -702,7 +722,23 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             in_specs=(P(), P(), P(), P(DATA), P(DATA), P(), P(), P(), P(),
                       P(DATA)),
             out_specs=out_specs)
-        return jax.jit(sharded, donate_argnums=(0, 1, 2, 3))
+        jit_fn = jax.jit(sharded, donate_argnums=(0, 1, 2, 3))
+        # scheduler-keyed AOT entry: the message exchange's collective
+        # plan (layout + choices) and the threshold algorithm's constants
+        # key the executable, so a changed bucket config or retuned
+        # algorithm can never silently reuse a stale program — and a
+        # fresh wrapper on the same config recompiles nothing
+        from deeplearning4j_tpu.comms import scheduler as comms_sched
+        from deeplearning4j_tpu.optimize import aot_cache
+
+        plan = comms_sched.plan_for(self.model.params, "all_reduce", DATA,
+                                    self.gradient_bucket_bytes)
+        alg = aot_cache.graph_signature(self.threshold_algorithm)[:12]
+        return aot_cache.wrap(
+            jit_fn, self.model._graph_key(),
+            f"pw_thresh:n{self.workers}"
+            f":b{self.gradient_bucket_bytes or 0}:{plan.key_token()}"
+            f":alg{alg}{health.cache_tag()}")
 
     def _build_bucketed_exact_step(self):
         """Exact SHARED_GRADIENTS as an EXPLICIT shard_map exchange: the
@@ -757,7 +793,20 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             step, self.mesh,
             in_specs=(P(), P(), P(), P(DATA), P(), P(), P(), P(DATA)),
             out_specs=out_specs)
-        return jax.jit(sharded, donate_argnums=(0, 1, 2))
+        jit_fn = jax.jit(sharded, donate_argnums=(0, 1, 2))
+        # plan-keyed AOT entry: the gradient exchange's CollectivePlan
+        # digest joins the step key, so a changed bucket layout or
+        # collective choice recompiles instead of silently reusing the
+        # old schedule's executable (and identical re-instantiations hit)
+        from deeplearning4j_tpu.comms import scheduler as comms_sched
+        from deeplearning4j_tpu.optimize import aot_cache
+
+        plan = comms_sched.plan_for(self.model.params, "all_reduce", DATA,
+                                    bucket)
+        return aot_cache.wrap(
+            jit_fn, self.model._graph_key(),
+            f"pw_bucketed:n{self.workers}:b{bucket or 0}"
+            f":{plan.key_token()}{health.cache_tag()}")
 
     def _build_zero_step(self):
         """ZeRO-1 data parallelism as an explicit shard_map exchange:
@@ -907,13 +956,20 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                       P(DATA)),
             out_specs=out_specs)
         jit_fn = jax.jit(sharded, donate_argnums=(0, 1, 2))
-        # sharding-keyed AOT entry: the scattered layout (worker count +
-        # bucket layout) is part of the key, so ZeRO and all-reduce
-        # executables for the same graph never collide and a fresh
-        # wrapper on the same mesh reuses the compiled program
+        # sharding- AND plan-keyed AOT entry: the scattered layout
+        # (worker count) plus both exchange plans — the gradient
+        # reduce-scatter and the param all-gather, each carrying bucket
+        # layout + collective choice in its digest — key the executable,
+        # so ZeRO and all-reduce programs for the same graph never
+        # collide, a changed schedule never reuses a stale executable,
+        # and a fresh wrapper on the same mesh recompiles nothing. The
+        # PRG205 audit resolves the digests back to the plans to verify
+        # the compiled collective sequence.
+        rs_plan, ag_plan = pz.exchange_plans(DATA, bucket)
         return aot_cache.wrap(
             jit_fn, m._graph_key(),
-            f"pw_zero:n{self.workers}:b{bucket or 0}{health.cache_tag()}")
+            f"pw_zero:n{self.workers}:b{bucket or 0}:{rs_plan.key_token()}"
+            f":{ag_plan.key_token()}{health.cache_tag()}")
 
     def _build_averaging_step(self):
         from deeplearning4j_tpu.telemetry import health
@@ -1029,7 +1085,20 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             average, self.mesh,
             in_specs=(P(DATA), P(DATA), P(DATA)),
             out_specs=(P(DATA), P(DATA), P(DATA)))
-        return jax.jit(sharded, donate_argnums=(0, 1, 2))
+        jit_fn = jax.jit(sharded, donate_argnums=(0, 1, 2))
+        # plan-keyed like the gradient exchanges: the AVERAGING barrier-
+        # average rides the same scheduler, and its plan digest keys the
+        # executable
+        from deeplearning4j_tpu.comms import scheduler as comms_sched
+        from deeplearning4j_tpu.optimize import aot_cache
+
+        m = self.model
+        group = (m.params, m.state) + ((m.opt_state,) if avg_upd else ())
+        plan = comms_sched.plan_for(group, "all_reduce", DATA, bucket)
+        return aot_cache.wrap(
+            jit_fn, m._graph_key(),
+            f"pw_avg:n{self.workers}:b{bucket or 0}:u{int(avg_upd)}"
+            f":{plan.key_token()}")
 
     # --- training loop ------------------------------------------------------
     def fit(self, data, labels=None, epochs: int = 1):
@@ -1160,14 +1229,16 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             return
         if self._zero:
             # ZeRO's two collectives per step — gradient reduce-scatter
-            # and param all-gather — on bucketed_psum's bucket layout
+            # and param all-gather — on the scheduler's bucket layout
             # over the flat-padded tree. Counters record the LOGICAL
-            # per-shard payload of each (the gather is currently a
-            # masked psum costing all-reduce bandwidth on the wire —
-            # see compression.bucketed_all_gather's cost caveat). Same
-            # counter series as every other exchange (dl4j_collective_
-            # bytes/ops + the bucket-layout histogram), new op labels —
-            # pinned by test_sharding.
+            # per-shard payload of each; the gather's WIRE cost depends
+            # on the scheduler's probe-gated choice (native lax.
+            # all_gather at (n-1)/n payload on vma-capable jax, the
+            # masked-psum fallback at ~2x that on this container's
+            # check_rep 0.4.37 — see compression.bucketed_all_gather /
+            # docs/collectives.md). Same counter series as every other
+            # exchange (dl4j_collective_bytes/ops + the bucket-layout
+            # histogram), new op labels — pinned by test_sharding.
             layout = getattr(self, "_zero_layout", None)
             if layout is None:
                 layout = self._zero_layout = self._zero_pspec.layout_bytes(

@@ -258,12 +258,16 @@ class TrainingSession:
                 np.asarray(rng, dtype=np.uint32))
         trainer = self._trainer()
         if trainer is not None:
-            # restore-and-reshard: the snapshot is full host arrays; the
-            # wrapper re-stages (re-scatters ZeRO slices, re-places
+            # restore-and-reshard: the snapshot restores to full arrays;
+            # the wrapper re-stages (re-scatters ZeRO slices, re-places
             # TP shards) onto its CURRENT mesh on the next run — which
-            # may be a different shape than the mesh that saved. Step
-            # closures are dropped (the AOT cache makes the rebuild a
-            # compile-cache hit on an unchanged mesh).
+            # may be a different shape than the mesh that saved. The
+            # restage routes device-resident trees through
+            # comms.reshard's slice-intersection exchange (ZeroSpec.
+            # scatter / ShardingPlan.place), so the restore-across-mesh
+            # path no longer pays a numpy gather/scatter round-trip.
+            # Step closures are dropped (the AOT cache makes the rebuild
+            # a compile-cache hit on an unchanged mesh).
             trainer.model = restored
             trainer._params = trainer._state = trainer._opt = None
             trainer._residual = None

@@ -41,6 +41,66 @@ class ZeroSpec:
         self.slice_sizes = [-(-s // self.n) for s in self.sizes]   # m_i
         self.padded_sizes = [m * self.n for m in self.slice_sizes]
 
+    # --- staging ------------------------------------------------------------
+    def scatter(self, tree, mesh, axis: str):
+        """Stage ``tree`` into the scattered flat layout, choosing the
+        data path by residency: device-resident trees (a restored
+        checkpoint's arrays, a live training state) re-cut through
+        ``comms.reshard``'s slice-intersection exchange — no host
+        round-trip — while host/numpy trees take :meth:`scatter_host`.
+        Identical values either way (the restore-across-mesh-shapes
+        bit-identity is pinned by test_comms)."""
+        import jax
+
+        leaves = jax.tree_util.tree_flatten(tree)[0]
+        if jax.process_count() > 1 or not all(
+                isinstance(l, jax.Array) for l in leaves):
+            return self.scatter_host(tree, mesh, axis)
+        try:
+            return self.scatter_device(tree, mesh, axis)
+        except Exception:
+            # residency probe passed but the exchange could not decompose
+            # the layout — the host path is always correct
+            return self.scatter_host(tree, mesh, axis)
+
+    def scatter_device(self, tree, mesh, axis: str):
+        """Device tree -> scattered flat layout via
+        ``comms.reshard.reshard_flat`` (flatten/pad stays in jax;
+        shard k's slice lands on shard k's devices by slice
+        intersection, not via a numpy mirror)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from deeplearning4j_tpu.comms.reshard import reshard_flat
+
+        sh = NamedSharding(mesh, P(axis))
+        leaves = jax.tree_util.tree_flatten(tree)[0]
+        out = []
+        for leaf, size, padded in zip(leaves, self.sizes,
+                                      self.padded_sizes):
+            flat = jnp.reshape(leaf, (-1,))
+            out.append(reshard_flat(flat, size, padded, sh))
+        return jax.tree_util.tree_unflatten(self.treedef, out)
+
+    def exchange_plans(self, axis: str, bucket_bytes=None):
+        """The (reduce_scatter, all_gather) CollectivePlans of one ZeRO
+        step over this layout — digest source for the AOT step key, and
+        exactly the plans the compiled exchange resolves at trace time
+        (same leaf sizes/dtypes → same plan cache entry)."""
+        import jax
+
+        from deeplearning4j_tpu.comms import scheduler
+
+        flat = [jax.ShapeDtypeStruct((p,), dt)
+                for p, dt in zip(self.padded_sizes, self.dtypes)]
+        rs = scheduler.plan_for(flat, "reduce_scatter", axis, bucket_bytes)
+        slices = [jax.ShapeDtypeStruct((m,), dt)
+                  for m, dt in zip(self.slice_sizes, self.dtypes)]
+        ag = scheduler.plan_for(slices, "all_gather", axis, bucket_bytes,
+                                full_sizes=self.padded_sizes)
+        return rs, ag
+
     # --- host side ----------------------------------------------------------
     def scatter_host(self, tree, mesh, axis: str):
         """Host tree -> tree of flat ``[n*m_i]`` arrays committed with
@@ -130,8 +190,8 @@ class ZeroSpec:
     def layout_bytes(self, bucket_bytes=None) -> List[int]:
         """Per-bucket payload bytes of one scatter/gather schedule over
         this layout (telemetry's bucket-layout histogram — same
-        ``bucket_partition`` the compiled exchange uses)."""
-        from deeplearning4j_tpu.parallel.compression import bucket_partition
+        ``bucket_partition`` the scheduler's compiled exchange uses)."""
+        from deeplearning4j_tpu.comms.scheduler import bucket_partition
 
         sizes = [p * dt.itemsize
                  for p, dt in zip(self.padded_sizes, self.dtypes)]

@@ -120,6 +120,26 @@ def record_bucket_layout(op: str, bucket_bytes_list) -> None:
         h.observe(b)
 
 
+def record_collective_plan(intent: str, choice: str, nbytes: float,
+                           launches: int) -> None:
+    """Record one freshly planned collective schedule
+    (``comms.scheduler``): the ``dl4j_collective_plan_total{intent,
+    choice}`` counter plus per-plan bytes/launches gauges feeding the UI
+    System tab collective panel — the scheduler's CHOICES (variadic /
+    densify / native all-gather vs masked psum) made observable per fit.
+    Unconditional like the control-plane events below: plans resolve at
+    trace time (once per unique layout per process), never per step."""
+    REGISTRY.counter("dl4j_collective_plan_total",
+                     help="collective plans built by the scheduler",
+                     intent=intent, choice=choice).inc()
+    REGISTRY.gauge("dl4j_collective_plan_bytes",
+                   help="logical per-shard payload of the newest plan",
+                   intent=intent).set(nbytes)
+    REGISTRY.gauge("dl4j_collective_plan_launches",
+                   help="collectives issued per exchange by the newest "
+                        "plan", intent=intent).set(launches)
+
+
 def record_ingest(nbytes: float, batches: int = 1) -> None:
     """Count host->device batch staging (DeviceRingIterator and friends)."""
     if not spans._enabled:

@@ -344,6 +344,16 @@ class UIServer:
         return self._metric_table_panel("Generation (continuous batching)",
                                         "dl4j_decode_")
 
+    def _collectives_panel(self) -> str:
+        """Collective-exchange metrics (comms.scheduler +
+        parallel.compression): per-op bytes/launch counters, bucket
+        layouts, and the scheduler's per-plan choice counter
+        (``dl4j_collective_plan_total{intent,choice}``) with the newest
+        plan's bytes/launches gauges — which collective the scheduler
+        picked, observable per fit."""
+        return self._metric_table_panel("Collectives (scheduler)",
+                                        "dl4j_collective_")
+
     def _sharding_panel(self) -> str:
         """Live sharding plans (sharding.plan registry): the resolved
         param-path -> PartitionSpec table (opt-state specs summarized) +
@@ -458,6 +468,7 @@ class UIServer:
                         "#9467bd"),
             self._serving_panel(),
             self._generation_panel(),
+            self._collectives_panel(),
             self._sharding_panel(),
         ]) or "<p>No stats collected yet.</p>"
         refresh = (f"<meta http-equiv='refresh' content='{refresh_seconds}'>"

@@ -368,6 +368,17 @@ def collect_system_metrics() -> dict:
     except Exception:
         pass
     try:
+        # collective-scheduler counters (comms.scheduler): plans built /
+        # plan-cache hits — the System-tab companion to the per-plan
+        # choice metrics on /metrics
+        from deeplearning4j_tpu.comms import scheduler as _comms_sched
+
+        st = _comms_sched.stats()
+        if st["plans_built"]:
+            out["collective_plans"] = st
+    except Exception:
+        pass
+    try:
         import jax
 
         devices = {}
