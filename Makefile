@@ -78,6 +78,18 @@ comms-smoke:
 	$(PY) -m pytest tests -q -m comms -p no:cacheprovider
 	$(PY) bench_collectives.py --smoke
 
+.PHONY: platform-smoke
+# Multi-tenant platform smoke: the registry/hot-swap/canary/quota test
+# subset (seeded chaos, deterministic rollback), then the two-tenant
+# faulted-canary bench in assert mode — the healthy tenant's responses
+# must stay byte-identical with zero recompiles while the canary trips,
+# sheds, and rolls back.
+platform-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests -q -m platform \
+		-p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) bench_serving.py --multi-model --seconds 1.5 \
+		--assert-isolation --out /tmp/bench_serving_mt_smoke.json
+
 .PHONY: lint
 # Repo-discipline source lint (analysis/source.py AST rules): host syncs
 # in compiled functions, lock discipline on shared registries, wall-clock/

@@ -215,6 +215,159 @@ def bench(args):
     return 0
 
 
+def bench_multi_model(args):
+    """``--multi-model``: the two-tenant isolation A/B (ISSUE 13). One
+    healthy tenant and one tenant whose CANARY version is degraded by a
+    seeded fault plan serve concurrent closed-loop traffic on one
+    platform host. Reports per-tenant req/s, latency quantiles, shed
+    counts, the automatic-rollback record, and the two isolation
+    invariants: the healthy tenant's responses stay byte-identical and
+    the host performs ZERO recompiles after warmup while the canary
+    trips, sheds, and rolls back. ``--assert-isolation`` exits 1 if
+    either invariant breaks or the gate never trips."""
+    import tempfile
+
+    import numpy as np
+
+    from deeplearning4j_tpu.optimize import aot_cache
+    from deeplearning4j_tpu.parallel.batcher import BatchingConfig
+    from deeplearning4j_tpu.parallel.platform import (
+        CanaryGate,
+        ModelPlatform,
+        ModelRegistry,
+        TenantConfig,
+    )
+    from deeplearning4j_tpu.resilience import FaultPlan
+    from deeplearning4j_tpu.telemetry import REGISTRY
+
+    net_a = _build_net(args.n_in, args.hidden, args.n_out, seed=1)
+    net_b = _build_net(args.n_in, args.hidden + 32, args.n_out, seed=2)
+    # v2 = same conf, "newly trained" weights (the real rollout shape:
+    # same conf-derived AOT graph key, so the canary warms for free)
+    net_b2 = type(net_b)(net_b.conf).init()
+    net_b2.set_params_flat(np.asarray(net_b.params_flat()) + 0.05)
+
+    reg = ModelRegistry(tempfile.mkdtemp(prefix="dl4j_mt_bench_"))
+    reg.publish("tenant_a", net_a)
+    reg.publish("tenant_b", net_b)
+    reg.publish("tenant_b", net_b2)
+    plat = ModelPlatform(reg, seed=7)
+    cfg = TenantConfig(batching=BatchingConfig(
+        max_batch=args.max_batch, max_delay_ms=args.max_delay_ms,
+        settle_ms=args.settle_ms))
+    plat.deploy("tenant_a", config=cfg)
+    plat.deploy("tenant_b", version=1, config=cfg)
+
+    probe = np.zeros((2, args.n_in), np.float32)
+    y_a0 = np.asarray(plat.predict("tenant_a", probe)).tobytes()
+    plat.deploy_canary("tenant_b", 2, fraction=0.5,
+                       gate=CanaryGate(max_consecutive_failures=5))
+    miss0 = aot_cache.stats()["misses"]
+    req0 = {
+        k: v for k, v in REGISTRY.snapshot(run_collectors=False).items()
+        if k.startswith("dl4j_serving_requests_total")}
+
+    stop = threading.Event()
+    per_tenant = {"tenant_a": {"lat": [], "ok": 0, "failed": 0},
+                  "tenant_b": {"lat": [], "ok": 0, "failed": 0}}
+    healthy_identical = [True]
+
+    def client(tenant, ci):
+        import numpy as _np
+
+        rng = _np.random.default_rng(ci)
+        rec = per_tenant[tenant]
+        payloads = [rng.normal(size=(s, args.n_in)).astype(_np.float32)
+                    for s in (1, 2, 3, 4)]
+        i = 0
+        while not stop.is_set():
+            x = payloads[i % 4]
+            t0 = time.perf_counter()
+            try:
+                plat.predict(tenant, x)
+                rec["lat"].append((time.perf_counter() - t0) * 1000.0)
+                rec["ok"] += 1
+            except Exception:
+                rec["failed"] += 1
+            i += 1
+
+    def probe_healthy():
+        # the byte-identity monitor rides WITH the chaos, not after it
+        while not stop.is_set():
+            y = np.asarray(plat.predict("tenant_a", probe)).tobytes()
+            if y != y_a0:
+                healthy_identical[0] = False
+            time.sleep(0.01)
+
+    plan = FaultPlan(seed=11).inject("serving.launch:tenant_b#canary")
+    half = max(args.clients // 2, 1)
+    threads = ([threading.Thread(target=client, args=("tenant_a", ci))
+                for ci in range(half)]
+               + [threading.Thread(target=client, args=("tenant_b", ci))
+                  for ci in range(half)]
+               + [threading.Thread(target=probe_healthy)])
+    with plan.armed():
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(args.seconds)
+        stop.set()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+
+    recompiles = aot_cache.stats()["misses"] - miss0
+    post = np.asarray(plat.predict("tenant_b", probe)).tobytes()
+    y_b_v1 = np.asarray(net_b.output(probe)).tobytes()
+    st_b = plat.stats()["tenant_b"]
+    rollback = st_b.get("last_rollback")
+    req1 = {
+        k: v for k, v in REGISTRY.snapshot(run_collectors=False).items()
+        if k.startswith("dl4j_serving_requests_total")}
+    sheds = {k: req1[k] - req0.get(k, 0) for k in req1
+             if '"shed"' in k or '"error"' in k or '"rejected"' in k}
+    plat.close()
+
+    results = {"mode": "multi-model", "clients": args.clients,
+               "seconds": args.seconds, "wall": round(wall, 2),
+               "fault_plan": "seed=11 serving.launch:tenant_b#canary",
+               "platform_seed": 7}
+    for name, rec in per_tenant.items():
+        lat = sorted(rec["lat"])
+        results[name] = {
+            "req_per_s": round(len(lat) / wall, 1),
+            "ok": rec["ok"], "failed": rec["failed"],
+            **_quantiles(lat)}
+    results["tenant_b"]["rollback"] = rollback
+    results["shed_error_counts"] = {
+        k.split("{", 1)[1].rstrip("}"): v for k, v in sorted(sheds.items())
+        if v}
+    results["recompiles_after_warmup"] = recompiles
+    results["healthy_tenant_bytes_identical"] = healthy_identical[0]
+    results["incumbent_restored_after_rollback"] = post == y_b_v1
+
+    print(json.dumps(results, indent=2))
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=2)
+    ra, rb = results["tenant_a"], results["tenant_b"]
+    print(f"\ntenant_a (healthy): {ra['req_per_s']:>8} req/s  "
+          f"p95 {ra['p95_ms']} ms  failed {ra['failed']}")
+    print(f"tenant_b (canary) : {rb['req_per_s']:>8} req/s  "
+          f"p95 {rb['p95_ms']} ms  failed {rb['failed']}")
+    print(f"rollback: {rollback and rollback['reason']!r} "
+          f"@ request {rollback and rollback['at_request']}   "
+          f"recompiles {recompiles}   "
+          f"healthy identical {healthy_identical[0]}")
+    if args.assert_isolation:
+        ok = (recompiles == 0 and healthy_identical[0]
+              and rollback is not None
+              and results["incumbent_restored_after_rollback"]
+              and ra["failed"] == 0)
+        print("OK" if ok else "FAIL: isolation invariant broken")
+        return 0 if ok else 1
+    return 0
+
+
 def smoke(args):
     """make serve-smoke: HTTP server up -> concurrent predicts ->
     /metrics scrape -> clean stop."""
@@ -291,11 +444,23 @@ def main():
                     help="exit 1 if batched/locked speedup is below this")
     ap.add_argument("--smoke", action="store_true",
                     help="HTTP round-trip smoke instead of the benchmark")
+    ap.add_argument("--multi-model", action="store_true",
+                    help="two-tenant platform isolation A/B: healthy "
+                         "tenant + fault-injected canary, per-tenant "
+                         "req/s / p95 / sheds / rollback / recompiles")
+    ap.add_argument("--assert-isolation", action="store_true",
+                    help="with --multi-model: exit 1 unless the healthy "
+                         "tenant stayed byte-identical with zero "
+                         "recompiles and the canary rolled back")
     ap.add_argument("--tpu", action="store_true",
                     help="run on the real accelerator (default: CPU pin)")
     args = ap.parse_args()
     if not args.tpu:
         _pin_cpu()
+    if args.multi_model:
+        if args.out == "bench_serving.json":
+            args.out = "bench_serving_mt.json"
+        return bench_multi_model(args)
     return smoke(args) if args.smoke else bench(args)
 
 

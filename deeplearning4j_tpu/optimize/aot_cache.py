@@ -25,6 +25,7 @@ per-step host work is a cache lookup + one dispatch.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import threading
 import time
@@ -189,6 +190,84 @@ def graph_signature(obj, fallback=None) -> str:
     return f"id:{pin_id(obj if fallback is None else fallback)}"
 
 
+class WarmupBudgetExceeded(RuntimeError):
+    """A compile requested under an exhausted :class:`WarmupBudget`
+    scope was refused. Raised BEFORE the compile starts, so the budget
+    bounds work, not just accounting."""
+
+
+class WarmupBudget:
+    """Per-tenant cap on warmup compilation (multi-tenant serving: one
+    model's warmup storm — a huge bucket ladder, a conf churning graph
+    keys — must not monopolize the host's compile bandwidth while its
+    co-tenants wait to come up).
+
+    Activate with :func:`warmup_budget`; while the scope is active on
+    the current thread, every FRESH compile through the cache (warm()
+    or a dispatch miss) is charged to the budget, and a compile that
+    would start with the budget exhausted raises
+    :class:`WarmupBudgetExceeded` instead. Cache hits are free — a
+    tenant whose buckets are already compiled (same conf as a live
+    version) warms at zero cost. Thread-local: live traffic on other
+    threads never sees another tenant's budget.
+    """
+
+    def __init__(self, name: str, max_compiles: Optional[int] = None,
+                 max_compile_seconds: Optional[float] = None):
+        self.name = name
+        self.max_compiles = max_compiles
+        self.max_compile_seconds = max_compile_seconds
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        self._lock = threading.Lock()
+
+    def allow(self) -> bool:
+        """Whether another compile may start under this budget."""
+        with self._lock:
+            if self.max_compiles is not None \
+                    and self.compiles >= self.max_compiles:
+                return False
+            if self.max_compile_seconds is not None \
+                    and self.compile_seconds >= self.max_compile_seconds:
+                return False
+            return True
+
+    def charge(self, seconds: float) -> None:
+        with self._lock:
+            self.compiles += 1
+            self.compile_seconds += float(seconds)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "name": self.name,
+                "compiles": self.compiles,
+                "compile_seconds": round(self.compile_seconds, 3),
+                "max_compiles": self.max_compiles,
+                "max_compile_seconds": self.max_compile_seconds,
+            }
+
+
+_BUDGET_SCOPE = threading.local()
+
+
+def active_budget() -> Optional[WarmupBudget]:
+    """The :class:`WarmupBudget` active on this thread (or None)."""
+    return getattr(_BUDGET_SCOPE, "active", None)
+
+
+@contextlib.contextmanager
+def warmup_budget(budget: WarmupBudget):
+    """Scope ``budget`` over this thread's compiles (nesting restores
+    the outer scope on exit)."""
+    prev = active_budget()
+    _BUDGET_SCOPE.active = budget
+    try:
+        yield budget
+    finally:
+        _BUDGET_SCOPE.active = prev
+
+
 # the compile-time program linter (analysis.program.on_compile), bound
 # lazily on the first miss so importing this module never imports the
 # analysis package; DL4J_TPU_PROGRAM_LINT=0 leaves it unbound
@@ -252,6 +331,17 @@ class AotStep:
         if len(_EXECUTABLES) >= _MAX_ENTRIES:
             STATS.record_overflow()
             return None, False
+        budget = active_budget()
+        if budget is not None and not budget.allow():
+            # refused BEFORE compiling: the budget bounds the work. Only
+            # the budget-holder's own thread (a tenant warming up under
+            # warmup_budget()) can land here — live traffic on other
+            # threads compiles unbudgeted as always.
+            raise WarmupBudgetExceeded(
+                f"warmup budget {budget.name!r} exhausted "
+                f"({budget.compiles} compiles, "
+                f"{budget.compile_seconds:.2f}s) — refusing to compile "
+                f"{key[1]}")
         t0 = time.perf_counter()
         # trace and lower as separate stages when this jax supports it:
         # .lower() runs the same trace internally, but splitting keeps
@@ -266,7 +356,10 @@ class AotStep:
         lowered = (traced.lower() if traced is not None
                    else self._jit.lower(*args))
         exe = lowered.compile()
-        STATS.record_miss(key, time.perf_counter() - t0)
+        seconds = time.perf_counter() - t0
+        STATS.record_miss(key, seconds)
+        if budget is not None:
+            budget.charge(seconds)
         _EXECUTABLES[key] = exe
         _program_lint(key, traced, exe)
         return exe, True

@@ -31,6 +31,15 @@ from deeplearning4j_tpu.parallel.generation import (  # noqa: F401
     GenerationEngine,
 )
 from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401
+from deeplearning4j_tpu.parallel.platform import (  # noqa: F401
+    CanaryGate,
+    HostOverloadedError,
+    ModelIntegrityError,
+    ModelPlatform,
+    ModelRegistry,
+    TenantConfig,
+    UnknownModelError,
+)
 from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS,
     EXPERT_AXIS,

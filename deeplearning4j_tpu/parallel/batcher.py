@@ -38,7 +38,7 @@ import itertools
 import threading
 import time
 from collections import deque
-from typing import List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -212,8 +212,24 @@ class InferenceEngine:
     def __init__(self, model, config: Optional[BatchingConfig] = None,
                  graph_opt: bool = True, bf16: bool = False,
                  breaker: Optional[CircuitBreaker] = ...,
-                 retry=...):
+                 retry=..., name: Optional[str] = None,
+                 admission: Optional[Callable] = None):
         self.config = config or BatchingConfig()
+        # multi-tenant identity (parallel.platform): a NAMED engine
+        # labels its dl4j_serving_* series with model=<name>, defaults
+        # its breaker to "serving:<name>" (so /health aggregates every
+        # breaker of one model under one key), and fires the scoped
+        # fault site "serving.launch:<name>" so a chaos plan can degrade
+        # exactly this tenant. Unnamed engines keep every prior surface.
+        self.name = name
+        self._fault_site = (f"serving.launch:{name}" if name
+                            else "serving.launch")
+        # host-level admission hook (platform quota): called at submit
+        # with (engine, rows) AFTER this engine's own queue-full check
+        # and BEFORE the breaker; it may raise ServerOverloadedError to
+        # shed for a reason bigger than this tenant's queue (e.g. total
+        # pending across all co-tenants) — counted as "rejected".
+        self._admission = admission
         # circuit breaker on the launch path: consecutive failures trip
         # it open and submits shed with CircuitOpenError (503) instead of
         # queueing behind a dead model; half-open probes recover. Pass
@@ -222,8 +238,10 @@ class InferenceEngine:
         # process must not collide on dl4j_circuit_state{breaker=...} or
         # shadow each other in resilience.status() (same multi-engine
         # failure mode as the PR 5 queue-depth gauge).
-        self._breaker = (CircuitBreaker(name=f"serving-{next(_ENGINE_SEQ)}")
-                         if breaker is ... else breaker)
+        self._breaker = (CircuitBreaker(
+            name=(f"serving:{name}" if name
+                  else f"serving-{next(_ENGINE_SEQ)}"))
+            if breaker is ... else breaker)
         # one transient-class retry (OSError/ConnectionError/Timeout/
         # injected faults) before a launch failure reaches the breaker;
         # model bugs (ValueError & co) are never retried. None disables.
@@ -284,7 +302,7 @@ class InferenceEngine:
         try:
             xs, n, group = self._validate(inputs)
         except BadRequestError:
-            telemetry.record_serving_request("bad_request")
+            telemetry.record_serving_request("bad_request", model=self.name)
             raise
         t0 = time.monotonic()
         deadline = t0 + timeout_ms / 1000.0 if timeout_ms else None
@@ -293,9 +311,21 @@ class InferenceEngine:
             if self._stop:
                 raise RuntimeError("engine is closed")
             if len(self._queue) >= self.config.max_queue:
-                telemetry.record_serving_request("rejected")
+                telemetry.record_serving_request("rejected", model=self.name)
                 raise ServerOverloadedError(
+                    f"model {self.name!r} serving queue full "
+                    f"({self.config.max_queue} pending)" if self.name else
                     f"serving queue full ({self.config.max_queue} pending)")
+            if self._admission is not None:
+                # platform-level quota (e.g. total pending across all
+                # co-tenants); still before the breaker so a host-level
+                # rejection never burns a half-open probe ticket
+                try:
+                    self._admission(self, n)
+                except ServerOverloadedError:
+                    telemetry.record_serving_request("rejected",
+                                                     model=self.name)
+                    raise
             # breaker check LAST: a request rejected for being malformed
             # or for overload must not consume a half-open probe ticket
             # (a burned ticket with no outcome wedges the breaker
@@ -303,9 +333,10 @@ class InferenceEngine:
             if self._breaker is not None and not self._breaker.allow():
                 # fail-fast shedding while the breaker is open: don't
                 # queue behind a model currently failing every launch
-                telemetry.record_serving_request("shed")
+                telemetry.record_serving_request("shed", model=self.name)
                 raise CircuitOpenError(
-                    f"circuit breaker {self._breaker.name!r} is "
+                    (f"model {self.name!r}: " if self.name else "")
+                    + f"circuit breaker {self._breaker.name!r} is "
                     f"{self._breaker.state}; request shed")
             self._queue.append(req)
             self._cond.notify_all()
@@ -441,9 +472,17 @@ class InferenceEngine:
         try:
             if self._warm_via_aot(args):
                 return
+        except aot_cache.WarmupBudgetExceeded:
+            # an exhausted per-tenant warmup budget is the CALLER's
+            # signal (the platform truncates this tenant's warmup), not
+            # a reason to fall back to a real forward — which would
+            # compile the very executable the budget just refused
+            raise
         except Exception:
             pass
-        # fallback: one real zeros-forward (any model with .output)
+        # fallback: one real zeros-forward (any model with .output); an
+        # AOT-cached output fn still charges/honors any active warmup
+        # budget inside its own miss path
         import jax
 
         jax.block_until_ready(self.model.output(*args))
@@ -506,7 +545,8 @@ class InferenceEngine:
                 req.error = DeadlineExpiredError(
                     "request deadline expired after "
                     f"{(now - req.t0) * 1000:.1f} ms in queue")
-                telemetry.record_serving_request("expired", now - req.t0)
+                telemetry.record_serving_request("expired", now - req.t0,
+                                                 model=self.name)
                 req.event.set()
             else:
                 live.append(req)
@@ -578,7 +618,8 @@ class InferenceEngine:
             req.result = result
             req.error = error
             req.event.set()
-        telemetry.record_serving_request(status, time.monotonic() - req.t0)
+        telemetry.record_serving_request(status, time.monotonic() - req.t0,
+                                         model=self.name)
         return True
 
     def _claim_batch(self, claim, owner: str) -> bool:
@@ -598,7 +639,7 @@ class InferenceEngine:
         and (when configured) one transient-class retry bounded by the
         batch's tightest request deadline."""
         def once():
-            faults.fault_point("serving.launch")
+            faults.fault_point(self._fault_site)
             return self.model.output(*cat)
 
         if self._retry is None:
@@ -606,7 +647,7 @@ class InferenceEngine:
         deadlines = [r.deadline for r in batch if r.deadline is not None]
         return self._retry.call(
             once, deadline=min(deadlines) if deadlines else None,
-            op="serving.launch")
+            op=self._fault_site)
 
     def _arm_watchdog(self, batch: List[_Request], claim):
         tmo = self.config.launch_timeout_ms
@@ -691,7 +732,8 @@ class InferenceEngine:
             if self._breaker is not None:
                 self._breaker.on_failure()
             return
-        telemetry.record_serving_batch(rows, target, len(batch), now - t0)
+        telemetry.record_serving_batch(rows, target, len(batch), now - t0,
+                                       model=self.name)
         if self._breaker is not None:
             self._breaker.on_success()
 

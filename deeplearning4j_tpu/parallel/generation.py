@@ -120,8 +120,16 @@ class GenerationEngine:
 
     def __init__(self, model, config: Optional[GenerationConfig] = None,
                  breaker: Optional[CircuitBreaker] = ...,
-                 retry=...):
+                 retry=..., name: Optional[str] = None):
         self.config = config or GenerationConfig()
+        # multi-tenant identity (parallel.platform): same semantics as
+        # the batcher — named engines label dl4j_decode_* series with
+        # model=<name>, default their breaker to "serving:<name>" (one
+        # /health key per model) and fire "decode.launch:<name>" so a
+        # chaos plan can target exactly this tenant.
+        self.name = name
+        self._fault_site = (f"decode.launch:{name}" if name
+                            else "decode.launch")
         cfg = self.config
         if isinstance(model, TransformerDecoder):
             self._dec = model
@@ -141,8 +149,10 @@ class GenerationEngine:
                 "ComputationGraph, or a zoo config with .decoder()")
         if self._dec.max_batch != cfg.max_batch:
             cfg.max_batch = self._dec.max_batch
-        self._breaker = (CircuitBreaker(name=f"decode-{next(_ENGINE_SEQ)}")
-                         if breaker is ... else breaker)
+        self._breaker = (CircuitBreaker(
+            name=(f"serving:{name}" if name
+                  else f"decode-{next(_ENGINE_SEQ)}"))
+            if breaker is ... else breaker)
         self._retry = SERVING_RETRY if retry is ... else retry
         self._queue: deque = deque()
         self._cond = threading.Condition()
@@ -182,7 +192,7 @@ class GenerationEngine:
                     0 <= int(eos_id) < self._dec.vocab_size):
                 raise ValueError("eos_id outside the vocabulary")
         except ValueError as e:
-            telemetry.record_decode_request("bad_request")
+            telemetry.record_decode_request("bad_request", model=self.name)
             raise BadRequestError(str(e)) from None
         if timeout_ms is ...:
             timeout_ms = self.config.timeout_ms
@@ -196,12 +206,12 @@ class GenerationEngine:
             if self._stop:
                 raise RuntimeError("generation engine is closed")
             if len(self._queue) >= self.config.max_queue:
-                telemetry.record_decode_request("rejected")
+                telemetry.record_decode_request("rejected", model=self.name)
                 raise ServerOverloadedError(
                     f"generation queue full "
                     f"({self.config.max_queue} waiting)")
             if self._breaker is not None and not self._breaker.allow():
-                telemetry.record_decode_request("shed")
+                telemetry.record_decode_request("shed", model=self.name)
                 raise CircuitOpenError(
                     f"circuit breaker {self._breaker.name!r} is "
                     f"{self._breaker.state}; request shed")
@@ -307,7 +317,7 @@ class GenerationEngine:
                 req.error = DeadlineExpiredError(
                     "request deadline expired after "
                     f"{(now - req.t0) * 1000:.1f} ms in queue")
-                telemetry.record_decode_request("expired", now - req.t0)
+                telemetry.record_decode_request("expired", now - req.t0, model=self.name)
                 req.event.set()
             else:
                 live.append(req)
@@ -361,7 +371,7 @@ class GenerationEngine:
             rng[i] = r.rng
 
         def once():
-            faults.fault_point("decode.launch")
+            faults.fault_point(self._fault_site)
             return self._dec.prompt_fn(tp, bp)(
                 self._net_params(), prompts, lengths, max_new, eos, temps,
                 rng)
@@ -372,7 +382,7 @@ class GenerationEngine:
             deadlines = [r.deadline for r in joins if r.deadline is not None]
             kv, tok, active, rng2 = self._retry.call(
                 once, deadline=min(deadlines) if deadlines else None,
-                op="decode.launch")
+                op=self._fault_site)
         self._state = self._dec.join_fn(self._S, tp, bp)(
             self._state, kv, rows, tok, lengths, max_new, eos, temps,
             rng2, active)
@@ -408,7 +418,7 @@ class GenerationEngine:
         self._grow_to(min(need, self._dec.max_len))
 
         def once():
-            faults.fault_point("decode.launch")
+            faults.fault_point(self._fault_site)
             return self._dec.decode_fn(self._S, k)(
                 self._net_params(), self._state)
 
@@ -444,7 +454,7 @@ class GenerationEngine:
                     req.error = DeadlineExpiredError(
                         "deadline expired mid-generation after "
                         f"{len(req.out)} tokens")
-                    telemetry.record_decode_request("expired", now - req.t0)
+                    telemetry.record_decode_request("expired", now - req.t0, model=self.name)
                     req.event.set()
                     self._rows[b] = None
                     self._n_active -= 1
@@ -467,7 +477,7 @@ class GenerationEngine:
     def _finish_locked(self, req: _GenRequest, now: float):
         self._rows[req.row] = None
         self._retired_total += 1
-        telemetry.record_decode_request("ok", now - req.t0)
+        telemetry.record_decode_request("ok", now - req.t0, model=self.name)
         req.event.set()
 
     def _on_dispatch_failure(self, e: BaseException):
@@ -481,7 +491,7 @@ class GenerationEngine:
                 if req is None:
                     continue
                 req.error = e if req.error is None else req.error
-                telemetry.record_decode_request("error")
+                telemetry.record_decode_request("error", model=self.name)
                 req.event.set()
                 self._rows[b] = None
             self._n_active = 0

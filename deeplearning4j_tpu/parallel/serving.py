@@ -16,6 +16,21 @@ one-request-at-a-time path of earlier rounds. Endpoints:
 - ``GET  /healthz``  liveness (+ queue depth under batching)
 - ``GET  /metrics``  Prometheus scrape: serving counters/histograms
   (``dl4j_serving_*``) + the whole telemetry registry
+
+Multi-tenant mode: construct with a
+:class:`~deeplearning4j_tpu.parallel.platform.ModelPlatform` instead of
+a model and the server grows per-model routes —
+
+- ``POST /predict/<model>`` (alias ``/models/<model>/predict``) routes
+  through the platform's canary-aware router. An unknown model (or a
+  bare ``/predict``) is a NAMED 404 listing the deployed models — never
+  a ``KeyError`` 500. Every 503 body carries ``model`` / ``scope`` /
+  ``breaker`` fields so a client can tell "this model is shedding"
+  (``scope="model"``) from "host overloaded" (``scope="host"``).
+- ``GET /models``     per-tenant platform stats (versions, canary,
+  breakers, warmup budgets)
+- ``GET /healthz``    per-model breaker/queue block; ``status`` becomes
+  ``"shedding"`` when ANY tenant's breaker is open
 """
 
 from __future__ import annotations
@@ -34,6 +49,11 @@ from deeplearning4j_tpu.parallel.batcher import (
     InferenceEngine,
     LaunchTimeoutError,
     ServerOverloadedError,
+)
+from deeplearning4j_tpu.parallel.platform import (
+    HostOverloadedError,
+    ModelPlatform,
+    UnknownModelError,
 )
 
 
@@ -56,7 +76,9 @@ class InferenceServer:
     def __init__(self, model, dtype=np.float32,
                  batching: Union[BatchingConfig, None] = ...,
                  graph_opt: bool = True, bf16: bool = False):
-        self.model = model
+        self.platform: Optional[ModelPlatform] = (
+            model if isinstance(model, ModelPlatform) else None)
+        self.model = None if self.platform is not None else model
         self.dtype = dtype
         self._httpd = None
         self._thread = None
@@ -66,6 +88,12 @@ class InferenceServer:
         if batching is ...:
             batching = BatchingConfig()
         self.engine: Optional[InferenceEngine] = None
+        self._uint8_cache: dict = {}  # platform mode: per-tenant flags
+        if self.platform is not None:
+            # platform mode: each tenant brings its own engine/quotas;
+            # the server is pure routing + error surfaces
+            self._uint8_inputs = ()
+            return
         if batching is not None:
             self.engine = InferenceEngine(model, batching,
                                           graph_opt=graph_opt, bf16=bf16)
@@ -75,21 +103,23 @@ class InferenceServer:
             self._uint8_input(i) for i in range(self._expected_inputs()))
 
     # --- inference ----------------------------------------------------------
-    def _expected_inputs(self) -> int:
-        net = getattr(self.model, "model", self.model)
+    def _expected_inputs(self, model=None) -> int:
+        net = self.model if model is None else model
+        net = getattr(net, "model", net)  # unwrap ParallelInference
         conf = getattr(net, "conf", None)
         if conf is not None and hasattr(conf, "network_inputs"):
             return len(conf.network_inputs)
         return 1  # MultiLayerNetwork & co: one feature array
 
-    def _uint8_input(self, idx: int) -> bool:
+    def _uint8_input(self, idx: int, model=None) -> bool:
         """Whether input ``idx`` is an image-typed feature the model
         dequantizes in-jit (``nn_io.as_device(..., feature=True)`` keeps
         uint8 across the host->device link; the 1/255 scale happens
         inside the compiled forward, matching training)."""
         from deeplearning4j_tpu.nn import io as nn_io
 
-        net = getattr(self.model, "model", self.model)
+        net = self.model if model is None else model
+        net = getattr(net, "model", net)
         conf = getattr(net, "conf", None)
         if conf is None:
             return False
@@ -135,6 +165,129 @@ class InferenceServer:
                 out = self.model.output(*xs)
         outs = out if isinstance(out, list) else [out]
         return [np.asarray(o).tolist() for o in outs]
+
+    # --- platform (multi-tenant) routing ------------------------------------
+    def _resolve_predict_path(self, path: str):
+        """-> (model_name_or_None, error_payload_or_None). Single-model
+        mode accepts exactly ``/predict``; platform mode requires a
+        model segment and 404s BY NAME (listing the deployed models)
+        instead of letting a missing tenant surface as a 500."""
+        if self.platform is None:
+            if path == "/predict":
+                return None, None
+            return None, {"error": "not found"}
+        name = None
+        if path.startswith("/predict/"):
+            name = path[len("/predict/"):]
+        elif path.startswith("/models/") and path.endswith("/predict"):
+            name = path[len("/models/"):-len("/predict")]
+        if not name:
+            return None, {
+                "error": "no model in path; POST /predict/<model>",
+                "models": self.platform.models()}
+        if "/" in name:
+            return None, {"error": "not found"}
+        return name, None
+
+    def _platform_uint8_flags(self, engine) -> tuple:
+        """Per-tenant uint8 eligibility, cached per tenant and
+        validated against the LIVE model by identity (a weakref, not a
+        bare ``id()`` — after a hot swap frees the old model, CPython
+        may reuse its address for the new one, and stale flags would
+        silently route a non-image input down the uint8 dequantize
+        path)."""
+        import weakref
+
+        model = engine.model
+        with self._lock:
+            entry = self._uint8_cache.get(engine.name)
+            if entry is not None and entry[0]() is model:
+                return entry[1]
+            flags = tuple(
+                self._uint8_input(i, model)
+                for i in range(self._expected_inputs(model)))
+            try:
+                ref = weakref.ref(model)
+            except TypeError:  # unweakrefable model type: never cache
+                return flags
+            self._uint8_cache[engine.name] = (ref, flags)
+        return flags
+
+    def _predict_platform(self, name: str, inputs):
+        """Parse + route one multi-tenant request: generic JSON→array
+        conversion (arity/shape/dtype validation lives in the tenant's
+        engine, mapped to 400), integer image payloads ride as uint8
+        exactly like the single-model path."""
+        xs = []
+        flags = None
+        for i, a in enumerate(inputs):
+            try:
+                arr = np.asarray(a)
+                if arr.dtype == object:
+                    raise ValueError("ragged nested lists")
+            except (ValueError, TypeError) as e:
+                # numpy raises on inhomogeneous nesting (or yields an
+                # object array) — either way it's the sender's 400, not
+                # a host 500
+                raise BadRequestError(f"malformed input array: {e}")
+            if np.issubdtype(arr.dtype, np.integer) and arr.size \
+                    and 0 <= arr.min() and arr.max() <= 255:
+                if flags is None:
+                    flags = self._platform_uint8_flags(
+                        self.platform.engine(name))
+                if i < len(flags) and flags[i]:
+                    arr = arr.astype(np.uint8)
+            xs.append(arr)
+        out = self.platform.predict(name, *xs)
+        outs = out if isinstance(out, list) else [out]
+        return [np.asarray(o).tolist() for o in outs]
+
+    def _shed_payload(self, e: Exception, name: Optional[str]) -> dict:
+        """The 503 body: which scope is shedding (this model vs the
+        whole host) and the model's breaker state, so a client can back
+        off per-model instead of abandoning the host."""
+        payload = {"error": str(e)}
+        if isinstance(e, HostOverloadedError):
+            payload["scope"] = "host"
+            return payload
+        payload["scope"] = "model"
+        if name is not None:
+            payload["model"] = name
+        breaker = None
+        if self.platform is not None and name is not None:
+            try:
+                breaker = self.platform.engine(name).breaker
+            except UnknownModelError:
+                breaker = None
+        elif self.engine is not None:
+            breaker = self.engine.breaker
+        if breaker is not None:
+            payload["breaker"] = breaker.state
+        return payload
+
+    def _platform_health(self) -> dict:
+        """Per-model readiness: any open breaker flips the host status
+        to "shedding" and names the models doing it."""
+        stats = self.platform.stats()
+        payload = {"status": "ok", "models": {}}
+        shedding = []
+        for name, row in stats.items():
+            entry = {k: row[k] for k in ("version", "queue_depth",
+                                         "breaker") if k in row}
+            if "canary" in row:
+                entry["canary"] = {
+                    k: row["canary"][k]
+                    for k in ("version", "fraction", "breaker")}
+            states = [entry.get("breaker"),
+                      entry.get("canary", {}).get("breaker"),
+                      row.get("generation", {}).get("breaker")]
+            if "open" in states:
+                shedding.append(name)
+            payload["models"][name] = entry
+        if shedding:
+            payload["status"] = "shedding"
+            payload["shedding_models"] = shedding
+        return payload
 
     def warmup(self, **kw) -> dict:
         """Pre-compile every padding bucket (engine ``warmup``); a no-op
@@ -192,6 +345,9 @@ class InferenceServer:
 
             def do_GET(self):
                 if self.path == "/healthz":
+                    if srv.platform is not None:
+                        self._send(200, srv._platform_health())
+                        return
                     payload = {"status": "ok"}
                     if srv.engine is not None:
                         payload["queue_depth"] = srv.engine.stats()[
@@ -204,7 +360,14 @@ class InferenceServer:
                                 # should route traffic elsewhere
                                 payload["status"] = "shedding"
                     self._send(200, payload)
+                elif self.path == "/models" and srv.platform is not None:
+                    self._send(200, {"models": srv.platform.stats()})
                 elif self.path == "/model":
+                    if srv.platform is not None:
+                        self._send(404, {
+                            "error": "multi-model host; GET /models",
+                            "models": srv.platform.models()})
+                        return
                     self._send(200, srv._model_info())
                 elif self.path == "/metrics":
                     from deeplearning4j_tpu import telemetry
@@ -221,8 +384,9 @@ class InferenceServer:
                     self._send(404, {"error": "not found"})
 
             def do_POST(self):
-                if self.path != "/predict":
-                    self._send(404, {"error": "not found"})
+                name, notfound = srv._resolve_predict_path(self.path)
+                if notfound is not None:
+                    self._send(404, notfound)
                     return
                 length = int(self.headers.get("Content-Length", 0))
                 if length < 0 or length > max_body_bytes:
@@ -236,12 +400,21 @@ class InferenceServer:
                     inputs = req["inputs"]
                     if not isinstance(inputs, list) or not inputs:
                         raise ValueError("inputs must be a non-empty list")
-                    xs = srv._parse_inputs(inputs)
+                    if name is None:
+                        xs = srv._parse_inputs(inputs)
                 except (ValueError, KeyError, TypeError) as e:
                     self._send(400, {"error": str(e)})
                     return
                 try:
-                    outs = srv._predict(xs)
+                    outs = (srv._predict(xs) if name is None
+                            else srv._predict_platform(name, inputs))
+                except UnknownModelError as e:
+                    # a missing tenant is the CLIENT's addressing error:
+                    # a named 404 listing what IS deployed, never a
+                    # KeyError-shaped 500
+                    self._send(404, {"error": str(e),
+                                     "models": srv.platform.models()})
+                    return
                 except BadRequestError as e:
                     # engine-level validation: this sender's problem only
                     self._send(400, {"error": str(e)})
@@ -250,8 +423,9 @@ class InferenceServer:
                         CircuitOpenError, LaunchTimeoutError) as e:
                     # shed load: the client should back off and retry
                     # (queue full, deadline gone, breaker open, or the
-                    # launch watchdog fired)
-                    self._send(503, {"error": str(e)})
+                    # launch watchdog fired); the body names the model
+                    # and breaker state vs a host-wide overload
+                    self._send(503, srv._shed_payload(e, name))
                     return
                 except Exception as e:  # model/runtime failure -> 500
                     # JSON, never a dropped connection

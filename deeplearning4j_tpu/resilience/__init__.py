@@ -38,9 +38,41 @@ from deeplearning4j_tpu.resilience.session import (  # noqa: F401
 )
 
 
+_STATE_RANK = {"closed": 0, "half_open": 1, "open": 2}
+
+
+def _aggregate_breakers() -> dict:
+    """Every live breaker's status, grouped per MODEL: a multi-tenant
+    serving host names a model's breakers ``serving:<model>`` (primary)
+    and ``serving:<model>#canary`` — distinct metric series, but ONE
+    ``/health`` entry per model, keyed by the pre-``#`` prefix. The
+    entry aggregates all of a model's live breakers — worst state wins
+    (open > half_open > closed), counters sum — instead of the
+    last-registered breaker silently shadowing the rest."""
+    groups: dict = {}
+    for b in breaker.live_breakers():
+        groups.setdefault(b.name.split("#", 1)[0], []).append(b.status())
+    out = {}
+    for name, sts in sorted(groups.items()):
+        agg = dict(max(sts, key=lambda s: _STATE_RANK.get(s["state"], 0)))
+        agg["breakers"] = len(sts)
+        if len(sts) > 1:
+            agg["states"] = sorted(s["state"] for s in sts)
+            agg["tripped_total"] = sum(s["tripped_total"] for s in sts)
+            agg["consecutive_failures"] = max(
+                s["consecutive_failures"] for s in sts)
+            agg["window"] = {
+                "size": sum(s["window"]["size"] for s in sts),
+                "failures": sum(s["window"]["failures"] for s in sts),
+            }
+        out[name] = agg
+    return out
+
+
 def status() -> dict:
     """Process-wide resilience snapshot for ``/health`` and debugging:
-    every live circuit breaker's state, the retry/resume/fault counters,
+    every live circuit breaker's state (aggregated per breaker name —
+    see :func:`_aggregate_breakers`), the retry/resume/fault counters,
     and whether a fault plan is currently armed."""
     from deeplearning4j_tpu.telemetry import REGISTRY
 
@@ -50,8 +82,7 @@ def status() -> dict:
                                  "dl4j_resumes_total",
                                  "dl4j_faults_injected_total"))}
     return {
-        "circuit_breakers": {b.name: b.status()
-                             for b in breaker.live_breakers()},
+        "circuit_breakers": _aggregate_breakers(),
         "counters": counters,
         "fault_plan_armed": faults.active_plan() is not None,
     }

@@ -25,6 +25,21 @@ Named sites (the permanent hooks in product code)::
                          prefill/decode dispatch (the generation
                          breaker's test vector)
     stats.flush          ui.stats remote-router delivery attempt
+    model.load           parallel.platform.ModelRegistry.load, after
+                         the version resolves and before the zip is
+                         digest-verified + restored (raise = a failed
+                         load that must leave the incumbent serving;
+                         retried by MODEL_LOAD_RETRY)
+    model.swap           parallel.platform.ModelPlatform.swap, after
+                         the new version loaded and before it is
+                         published into the serving engine (raise =
+                         partial swap, incumbent keeps serving; delay =
+                         wedged swap, traffic must flow throughout)
+
+Per-model scoping: an engine constructed with ``name=`` fires
+``serving.launch:<name>`` / ``decode.launch:<name>`` instead of the
+bare site, so a chaos plan can degrade exactly one tenant of a
+multi-model host (``ModelPlatform``) while its co-tenants stay clean.
 
 Usage::
 
@@ -61,6 +76,8 @@ SITES = (
     "serving.launch",
     "decode.launch",
     "stats.flush",
+    "model.load",
+    "model.swap",
 )
 
 

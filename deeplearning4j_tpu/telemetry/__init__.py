@@ -207,36 +207,54 @@ def record_step_seconds(seconds: float, path: str = "listener") -> None:
 # series.
 # --------------------------------------------------------------------------
 
-def record_serving_request(status: str, seconds: float = None) -> None:
+def record_serving_request(status: str, seconds: float = None,
+                           model: str = None) -> None:
     """Count one inference request terminal state: ``ok`` / ``error`` /
     ``bad_request`` / ``rejected`` (queue full) / ``expired`` (deadline);
     ``seconds`` = submit-to-completion latency when the request made it
-    into the queue."""
+    into the queue. ``model`` labels the series for named (multi-tenant
+    platform) engines; unnamed engines keep the unlabeled series."""
+    labels = {"model": model} if model else {}
     REGISTRY.counter("dl4j_serving_requests_total",
                      help="inference requests by terminal status",
-                     status=status).inc()
+                     status=status, **labels).inc()
     if seconds is not None:
         REGISTRY.histogram("dl4j_serving_request_seconds",
                            help="submit-to-result request latency",
-                           ).observe(seconds)
+                           **labels).observe(seconds)
 
 
 def record_serving_batch(rows: int, padded_rows: int, requests: int,
-                         seconds: float) -> None:
+                         seconds: float, model: str = None) -> None:
     """Record one shared device launch: fill ratio (real rows / padded
-    bucket rows), rows and coalesced-request histograms, launch time."""
+    bucket rows), rows and coalesced-request histograms, launch time.
+    ``model`` labels the series for named engines (per-tenant views)."""
+    labels = {"model": model} if model else {}
     REGISTRY.counter("dl4j_serving_batches_total",
-                     help="shared inference launches").inc()
+                     help="shared inference launches", **labels).inc()
     REGISTRY.histogram("dl4j_serving_batch_fill_ratio",
-                       help="real rows / padded bucket rows").observe(
-        rows / max(padded_rows, 1))
+                       help="real rows / padded bucket rows",
+                       **labels).observe(rows / max(padded_rows, 1))
     REGISTRY.histogram("dl4j_serving_batch_rows",
-                       help="real rows per shared launch").observe(rows)
+                       help="real rows per shared launch",
+                       **labels).observe(rows)
     REGISTRY.histogram("dl4j_serving_batch_requests",
-                       help="requests coalesced per launch").observe(
-        requests)
+                       help="requests coalesced per launch",
+                       **labels).observe(requests)
     REGISTRY.histogram("dl4j_serving_batch_seconds",
-                       help="shared launch wall time").observe(seconds)
+                       help="shared launch wall time",
+                       **labels).observe(seconds)
+
+
+def record_platform_event(event: str, model: str = None) -> None:
+    """Count one platform control-plane event (``parallel.platform``):
+    ``swap`` / ``canary_deploy`` / ``canary_rollback`` / ``promote`` /
+    ``host_rejected`` — unconditional, these are rare lifecycle events,
+    never per-request hot-path work. docs/serving.md lists the series."""
+    labels = {"model": model} if model else {}
+    REGISTRY.counter(f"dl4j_platform_{event}_total",
+                     help="multi-tenant platform lifecycle events",
+                     **labels).inc()
 
 
 # --------------------------------------------------------------------------
@@ -297,17 +315,20 @@ def record_circuit_state(name: str, state_code: int,
 # noise next to a device dispatch. docs/serving.md lists the series.
 # --------------------------------------------------------------------------
 
-def record_decode_request(status: str, seconds: float = None) -> None:
+def record_decode_request(status: str, seconds: float = None,
+                          model: str = None) -> None:
     """Count one generation-request terminal state (``ok`` / ``error`` /
     ``bad_request`` / ``rejected`` / ``expired`` / ``shed``);
-    ``seconds`` = submit-to-last-token latency when it ran."""
+    ``seconds`` = submit-to-last-token latency when it ran. ``model``
+    labels the series for named (multi-tenant platform) engines."""
+    labels = {"model": model} if model else {}
     REGISTRY.counter("dl4j_decode_requests_total",
                      help="generation requests by terminal status",
-                     status=status).inc()
+                     status=status, **labels).inc()
     if seconds is not None:
         REGISTRY.histogram("dl4j_decode_request_seconds",
                            help="submit-to-completion generation latency",
-                           ).observe(seconds)
+                           **labels).observe(seconds)
 
 
 def record_decode_iteration(tokens: int, active_rows: int, capacity: int,

@@ -202,6 +202,18 @@ class UIServer:
 
                     payload = _json.dumps(plans_summary()).encode()
                     ctype = "application/json"
+                elif self.path == "/platform":
+                    # live multi-tenant serving platforms
+                    # (parallel.platform registry): per-model version,
+                    # queue, breaker, canary + last-rollback records,
+                    # warmup-budget spend — the scriptable twin of the
+                    # "Serving platform" panel
+                    from deeplearning4j_tpu.parallel.platform import (
+                        platforms_summary,
+                    )
+
+                    payload = _json.dumps(platforms_summary()).encode()
+                    ctype = "application/json"
                 elif self.path == "/analysis":
                     # compile-time program-lint findings accumulated by
                     # this process (analysis.findings.LOG): what the
@@ -344,6 +356,47 @@ class UIServer:
         return self._metric_table_panel("Generation (continuous batching)",
                                         "dl4j_decode_")
 
+    def _platform_panel(self) -> str:
+        """Multi-tenant serving platform (parallel.platform): one row
+        per tenant — version, queue depth, breaker state, canary arm +
+        gate records, warmup-budget spend — plus the ``dl4j_platform_*``
+        lifecycle counters. Rendered only while a platform is live (or
+        its counters have recorded)."""
+        try:
+            from deeplearning4j_tpu.parallel.platform import (
+                platforms_summary,
+            )
+
+            summaries = platforms_summary()
+        except Exception:
+            summaries = []
+        rows = []
+        for stats in summaries:
+            for name, row in sorted(stats.items()):
+                canary = row.get("canary")
+                cell = (f"v{canary['version']} @ {canary['fraction']:.0%} "
+                        f"({canary['breaker']})" if canary else "—")
+                last = row.get("last_rollback")
+                rows.append(
+                    f"<tr><td>{html.escape(name)}</td>"
+                    f"<td>v{row.get('version', '?')}</td>"
+                    f"<td>{row.get('queue_depth', 0)}</td>"
+                    f"<td>{html.escape(str(row.get('breaker')))}</td>"
+                    f"<td>{html.escape(cell)}</td>"
+                    f"<td>{html.escape(last['reason']) if last else '—'}"
+                    f"</td></tr>")
+        table = ""
+        if rows:
+            table = ('<table style="font-size:12px;border-spacing:8px 2px">'
+                     "<tr><th>model</th><th>version</th><th>queue</th>"
+                     "<th>breaker</th><th>canary</th><th>last rollback</th>"
+                     "</tr>" + "".join(rows) + "</table>")
+        counters = self._metric_table_panel("", "dl4j_platform_")
+        if not table and not counters:
+            return ""
+        return ('<div class="chart"><h3>Serving platform '
+                f'(multi-tenant)</h3>{table}{counters}</div>')
+
     def _collectives_panel(self) -> str:
         """Collective-exchange metrics (comms.scheduler +
         parallel.compression): per-op bytes/launch counters, bucket
@@ -468,6 +521,7 @@ class UIServer:
                         "#9467bd"),
             self._serving_panel(),
             self._generation_panel(),
+            self._platform_panel(),
             self._collectives_panel(),
             self._sharding_panel(),
         ]) or "<p>No stats collected yet.</p>"

@@ -1,0 +1,614 @@
+"""Multi-tenant serving platform (parallel.platform): versioned
+registry with digest-refused corruption, atomic hot-swap behind the
+``model.swap`` fault site, seeded canary routing with deterministic
+automatic rollback, per-tenant fault isolation (quotas, warmup budgets,
+breakers), and the named HTTP 404/503 surfaces.
+
+Chaos invariants pinned here (ISSUE 13 acceptance):
+- same seed + same fault plan → same rollback request index;
+- the healthy co-tenant's responses stay BYTE-identical with zero
+  recompiles while the faulted tenant trips, sheds, and rolls back;
+- a kill/fault mid-swap or mid-publish leaves the registry
+  digest-verified on the prior version.
+
+All AOT assertions read counter DELTAS (the cache is process-global);
+nets that must compile cold use hidden widths no other test uses.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.conf import Activation, InputType, WeightInit
+from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.conf.losses import LossMCXENT
+from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+from deeplearning4j_tpu.conf.updaters import Sgd
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize import aot_cache
+from deeplearning4j_tpu.parallel.batcher import (
+    BatchingConfig,
+    ServerOverloadedError,
+)
+from deeplearning4j_tpu.parallel.platform import (
+    CanaryGate,
+    HostOverloadedError,
+    ModelIntegrityError,
+    ModelPlatform,
+    ModelRegistry,
+    TenantConfig,
+    UnknownModelError,
+)
+from deeplearning4j_tpu.parallel.serving import InferenceServer
+from deeplearning4j_tpu import resilience
+from deeplearning4j_tpu.resilience import FaultPlan
+from deeplearning4j_tpu.resilience.faults import InjectedFault
+from deeplearning4j_tpu.telemetry import REGISTRY
+
+pytestmark = pytest.mark.platform
+
+
+def _mlp(seed=0, hidden=8, n_in=4, n_out=3):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+            .weight_init(WeightInit.XAVIER).list()
+            .layer(DenseLayer(n_out=hidden, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=n_out, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _x(rows=2, n_in=4, seed=0):
+    return np.random.default_rng(seed).normal(
+        size=(rows, n_in)).astype(np.float32)
+
+
+def _bump(net, delta=0.1):
+    """A "newly trained" version of ``net``: SAME configuration (same
+    conf-derived AOT graph key — the real version-rollout shape, where
+    weights changed but the architecture didn't), different weights."""
+    net2 = MultiLayerNetwork(net.conf).init()
+    net2.set_params_flat(np.asarray(net.params_flat()) + delta)
+    return net2
+
+
+def _cfg(**over):
+    over.setdefault("max_batch", 4)
+    return TenantConfig(batching=BatchingConfig(**over))
+
+
+# --- registry ---------------------------------------------------------------
+
+def test_registry_publish_load_roundtrip(tmp_path):
+    reg = ModelRegistry(tmp_path)
+    v1 = reg.publish("m", _mlp(seed=1))
+    v2 = reg.publish("m", _mlp(seed=2))
+    assert (v1, v2) == (1, 2)
+    assert reg.models() == ["m"]
+    assert reg.versions("m") == [1, 2]
+    assert reg.latest_version("m") == 2
+    assert reg.verify("m", 1) and reg.verify("m", 2)
+    x = _x()
+    net1, ver1 = reg.load("m", 1)
+    latest, ver = reg.load("m")
+    assert (ver1, ver) == (1, 2)
+    # distinct seeds -> distinct weights -> distinct outputs
+    assert not np.array_equal(np.asarray(net1.output(x)),
+                              np.asarray(latest.output(x)))
+    # round-trip exactness: the restored latest matches the source
+    assert np.array_equal(np.asarray(latest.output(x)),
+                          np.asarray(reg.load("m", 2)[0].output(x)))
+
+
+def test_registry_unknown_model_and_version(tmp_path):
+    reg = ModelRegistry(tmp_path)
+    with pytest.raises(UnknownModelError, match="unknown model 'ghost'"):
+        reg.load("ghost")
+    reg.publish("m", _mlp())
+    with pytest.raises(UnknownModelError, match="no version 9"):
+        reg.load("m", 9)
+    with pytest.raises(ValueError, match="invalid model name"):
+        reg.publish("../escape", _mlp())
+
+
+def test_registry_digest_mismatch_refused(tmp_path):
+    reg = ModelRegistry(tmp_path)
+    reg.publish("m", _mlp(seed=1))
+    reg.publish("m", _mlp(seed=2))
+    with open(tmp_path / "m" / "v0002.zip", "ab") as f:
+        f.write(b"bitrot")
+    assert reg.verify("m", 1) and not reg.verify("m", 2)
+    with pytest.raises(ModelIntegrityError, match="sha256 mismatch"):
+        reg.load("m", 2)
+    # the prior version is untouched and loads digest-verified
+    assert reg.load("m", 1)[1] == 1
+
+
+def test_registry_load_fault_retried(tmp_path):
+    reg = ModelRegistry(tmp_path)
+    reg.publish("m", _mlp())
+    snap = REGISTRY.snapshot(run_collectors=False)
+    r0 = snap.get('dl4j_retries_total{op="model.load"}', 0)
+    # one transient failure: MODEL_LOAD_RETRY's second attempt lands
+    with FaultPlan(seed=1).inject("model.load", on_calls=[1]).armed():
+        net, ver = reg.load("m")
+    assert ver == 1 and net is not None
+    snap = REGISTRY.snapshot(run_collectors=False)
+    assert snap.get('dl4j_retries_total{op="model.load"}', 0) == r0 + 1
+    # persistent failure exhausts the 2-attempt budget and surfaces
+    with FaultPlan(seed=1).inject("model.load", on_calls=[1, 2]).armed():
+        with pytest.raises(InjectedFault):
+            reg.load("m")
+
+
+def test_kill_mid_publish_leaves_prior_verified(tmp_path):
+    reg = ModelRegistry(tmp_path)
+    reg.publish("m", _mlp(seed=1))
+    # the zip assembly dies mid-write (write_model's permanent
+    # checkpoint.write site): no v2 zip is published, the manifest never
+    # learns of v2, and v1 stays digest-verified
+    with FaultPlan(seed=2).inject("checkpoint.write", on_calls=[1]).armed():
+        with pytest.raises(InjectedFault):
+            reg.publish("m", _mlp(seed=2))
+    assert reg.versions("m") == [1]
+    assert reg.verify("m")
+    assert not list((tmp_path / "m").glob("*.tmp.*"))
+    assert reg.load("m")[1] == 1
+
+
+# --- deploy / swap ----------------------------------------------------------
+
+def test_deploy_predict_and_stats(tmp_path):
+    reg = ModelRegistry(tmp_path)
+    reg.publish("m", _mlp(seed=1))
+    with ModelPlatform(reg) as plat:
+        out = plat.deploy("m", config=_cfg())
+        assert out["version"] == 1 and not out["warmup_truncated"]
+        x = _x()
+        y = np.asarray(plat.predict("m", x))
+        assert y.shape == (2, 3)
+        st = plat.stats()["m"]
+        assert st["version"] == 1
+        assert st["breaker"] == "closed"
+        assert st["warmup_budget"]["compiles"] >= 0
+        with pytest.raises(UnknownModelError, match="unknown model"):
+            plat.predict("ghost", x)
+
+
+def test_swap_atomic_and_fault_mid_swap(tmp_path):
+    reg = ModelRegistry(tmp_path)
+    v1 = _mlp(seed=1, hidden=27)
+    reg.publish("m", v1)
+    reg.publish("m", _bump(v1))
+    x = _x()
+    with ModelPlatform(reg) as plat:
+        plat.deploy("m", version=1, config=_cfg())
+        y1 = np.asarray(plat.predict("m", x)).tobytes()
+        # a fault between load and publish = partial swap: the incumbent
+        # keeps serving, the tenant record never moves
+        with FaultPlan(seed=3).inject("model.swap", on_calls=[1]).armed():
+            with pytest.raises(InjectedFault):
+                plat.swap("m", 2)
+        assert plat.stats()["m"]["version"] == 1
+        assert np.asarray(plat.predict("m", x)).tobytes() == y1
+        # clean swap: same conf -> warmed buckets stay valid, zero
+        # recompiles; outputs flip to v2's weights
+        miss0 = aot_cache.stats()["misses"]
+        assert plat.swap("m", 2)["version"] == 2
+        y2 = np.asarray(plat.predict("m", x)).tobytes()
+        assert y2 != y1
+        assert aot_cache.stats()["misses"] == miss0
+        assert plat.stats()["m"]["version"] == 2
+
+
+def test_swap_to_corrupt_version_refused(tmp_path):
+    reg = ModelRegistry(tmp_path)
+    reg.publish("m", _mlp(seed=1))
+    reg.publish("m", _mlp(seed=2))
+    with open(tmp_path / "m" / "v0002.zip", "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff\xff\xff")
+    x = _x()
+    with ModelPlatform(reg) as plat:
+        plat.deploy("m", version=1, config=_cfg())
+        y1 = np.asarray(plat.predict("m", x)).tobytes()
+        with pytest.raises(ModelIntegrityError):
+            plat.swap("m", 2)
+        assert plat.stats()["m"]["version"] == 1
+        assert np.asarray(plat.predict("m", x)).tobytes() == y1
+
+
+def test_wedged_swap_keeps_incumbent_serving(tmp_path):
+    reg = ModelRegistry(tmp_path)
+    reg.publish("m", _mlp(seed=1))
+    reg.publish("m", _mlp(seed=2))
+    x = _x()
+    with ModelPlatform(reg) as plat:
+        plat.deploy("m", version=1, config=_cfg())
+        y1 = np.asarray(plat.predict("m", x)).tobytes()
+        done = threading.Event()
+
+        def slow_swap():
+            # delay at the model.swap site = a wedged swap in flight
+            with FaultPlan(seed=4).inject(
+                    "model.swap", action="delay", delay_s=0.4).armed():
+                plat.swap("m", 2)
+            done.set()
+
+        t = threading.Thread(target=slow_swap, daemon=True)
+        t.start()
+        served = 0
+        while not done.is_set() and served < 50:
+            # traffic flows on the incumbent for the whole wedge window
+            assert np.asarray(plat.predict("m", x)).tobytes() == y1
+            served += 1
+        t.join(timeout=5)
+        assert done.is_set() and served > 0
+        assert plat.stats()["m"]["version"] == 2
+
+
+# --- isolation: quotas, host cap, warmup budgets ----------------------------
+
+def test_quota_flood_isolated_to_one_tenant(tmp_path):
+    reg = ModelRegistry(tmp_path)
+    reg.publish("a", _mlp(seed=1, hidden=8))
+    reg.publish("b", _mlp(seed=2, hidden=10))
+    x = _x()
+    with ModelPlatform(reg, seed=5) as plat:
+        plat.deploy("a", config=_cfg())
+        plat.deploy("b", config=_cfg(max_queue=2))
+        ya = np.asarray(plat.predict("a", x)).tobytes()
+        miss0 = aot_cache.stats()["misses"]
+        # park b's dispatcher (the serving-suite inert idiom) and flood
+        # past its private queue quota — deterministic, no timing races
+        eng_b = plat.engine("b")
+        eng_b._ensure_thread = lambda: None
+        held = [eng_b.submit([x]) for _ in range(2)]
+        with pytest.raises(ServerOverloadedError, match="model 'b'"):
+            eng_b.submit([x])
+        # the flood degrades ONLY b: a serves promptly, bytes pinned
+        for _ in range(3):
+            assert np.asarray(plat.predict("a", x)).tobytes() == ya
+        del eng_b.__dict__["_ensure_thread"]  # un-park the dispatcher
+        eng_b._ensure_thread()
+        for h in held:
+            eng_b.result(h)
+        assert aot_cache.stats()["misses"] == miss0
+
+
+def test_host_overload_distinct_from_model_shed(tmp_path):
+    reg = ModelRegistry(tmp_path)
+    reg.publish("a", _mlp(seed=1, hidden=8))
+    reg.publish("b", _mlp(seed=2, hidden=10))
+    x = _x()
+    with ModelPlatform(reg, host_max_pending=2) as plat:
+        plat.deploy("a", config=_cfg())
+        plat.deploy("b", config=_cfg(max_queue=64))
+        # park b's dispatcher (the serving-suite inert idiom) so its
+        # flood stays GENUINELY queued — deterministic, no timing
+        eng_b = plat.engine("b")
+        eng_b._ensure_thread = lambda: None
+        held = [eng_b.submit([x]) for _ in range(2)]
+        # the host-wide cap is now exhausted by b alone: even the
+        # HEALTHY tenant sheds, and the error names the HOST — a client
+        # can tell this apart from "model 'b' serving queue full"
+        with pytest.raises(HostOverloadedError, match="host overloaded"):
+            plat.predict("a", x)
+        # host overload is a ServerOverloadedError (HTTP 503) subclass,
+        # distinguishable by class and message from a model's own shed
+        assert issubclass(HostOverloadedError, ServerOverloadedError)
+        del eng_b.__dict__["_ensure_thread"]  # un-park the dispatcher
+        eng_b._ensure_thread()
+        for h in held:
+            eng_b.result(h)
+        assert np.asarray(plat.predict("a", x)).shape == (2, 3)
+
+
+def test_warmup_budget_truncates_only_that_tenant(tmp_path):
+    from deeplearning4j_tpu.analysis.findings import LOG
+
+    reg = ModelRegistry(tmp_path)
+    # unique widths: these tenants must compile cold for the budget to
+    # have anything to refuse
+    reg.publish("cheap", _mlp(seed=1, hidden=29))
+    reg.publish("storm", _mlp(seed=2, hidden=31))
+    with ModelPlatform(reg) as plat:
+        out = plat.deploy("cheap", config=_cfg(max_batch=4))
+        assert not out["warmup_truncated"]
+        cfg = _cfg(max_batch=8)
+        cfg.warmup_max_compiles = 2
+        storm = plat.deploy("storm", config=cfg)
+        assert storm["warmup_truncated"]
+        assert storm["warmup"]["compiles"] == 2  # charged, then refused
+        # the truncation is on /analysis as a PLT301 finding
+        assert any(f.rule == "PLT301" and "storm" in f.location
+                   for f in LOG.items())
+        # and the tenant still SERVES (uncompiled buckets just compile
+        # lazily on first traffic — degraded warmup, not a dead tenant)
+        assert np.asarray(plat.predict("storm", _x())).shape == (2, 3)
+        # the co-tenant's warmup was complete and its traffic compiles
+        # nothing new
+        miss0 = aot_cache.stats()["misses"]
+        plat.predict("cheap", _x())
+        assert aot_cache.stats()["misses"] == miss0
+
+
+# --- canary -----------------------------------------------------------------
+
+def _canary_chaos_run(reg, x, seed):
+    """One full canary-chaos pass; returns (rollback record, healthy
+    tenant bytes pinned, recompiles, shed count, tripped)."""
+    plat = ModelPlatform(reg, seed=seed)
+    plat.deploy("good", version=1, config=_cfg())
+    plat.deploy("bad", version=1, config=_cfg())
+    y_good = np.asarray(plat.predict("good", x)).tobytes()
+    y_bad_v1 = np.asarray(plat.predict("bad", x)).tobytes()
+    plat.deploy_canary("bad", 2, fraction=0.5,
+                       gate=CanaryGate(max_consecutive_failures=3))
+    miss0 = aot_cache.stats()["misses"]
+    plan = FaultPlan(seed=11).inject("serving.launch:bad#canary")
+    pinned, sheds, tripped = True, 0, False
+    with plan.armed():
+        for _ in range(30):
+            try:
+                plat.predict("bad", x)
+            except Exception:
+                sheds += 1
+            st = plat.stats()["bad"]
+            tripped = tripped or st.get("canary", {}).get(
+                "breaker") == "open"
+            pinned = pinned and (np.asarray(
+                plat.predict("good", x)).tobytes() == y_good)
+    st = plat.stats()["bad"]
+    rollback = st.get("last_rollback")
+    # rollback restored the incumbent: v1 serves again, bit-identical
+    post = np.asarray(plat.predict("bad", x)).tobytes()
+    recompiles = aot_cache.stats()["misses"] - miss0
+    plat.close()
+    return rollback, pinned and post == y_bad_v1, recompiles, sheds
+
+
+def test_canary_rollback_chaos_deterministic(tmp_path):
+    """ISSUE 13 acceptance: a seeded fault plan degrades the canary
+    mid-traffic, the gate trips, rollback restores the incumbent — and
+    the whole run replays bit-identically: same seed → same rollback
+    request index, healthy co-tenant byte-identical with ZERO recompiles
+    throughout."""
+    reg = ModelRegistry(tmp_path)
+    reg.publish("good", _mlp(seed=1, hidden=8))
+    bad_v1 = _mlp(seed=2, hidden=12)
+    reg.publish("bad", bad_v1)
+    reg.publish("bad", _bump(bad_v1))
+    x = _x()
+    r1 = _canary_chaos_run(reg, x, seed=9)
+    r2 = _canary_chaos_run(reg, x, seed=9)
+    for rollback, restored, recompiles, sheds in (r1, r2):
+        assert rollback is not None, "gate never tripped"
+        assert rollback["version"] == 2
+        assert "consecutive canary failures" in rollback["reason"]
+        assert restored, "co-tenant or post-rollback bytes diverged"
+        assert recompiles == 0
+        assert sheds >= 3  # the canary's failures surfaced to callers
+    # the deterministic heart: both runs rolled back at the SAME request
+    assert r1[0]["at_request"] == r2[0]["at_request"]
+    assert r1[0]["canary"]["requests"] == r2[0]["canary"]["requests"]
+    # the retired canary's state gauge was zeroed at rollback — the
+    # model must not keep reporting "open" after it stopped shedding
+    snap = REGISTRY.snapshot(run_collectors=False)
+    assert snap['dl4j_circuit_state{breaker="serving:bad#canary"}'] == 0
+
+
+def test_canary_promote_zero_recompiles(tmp_path):
+    reg = ModelRegistry(tmp_path)
+    v1 = _mlp(seed=1, hidden=14)
+    reg.publish("m", v1)
+    reg.publish("m", _bump(v1))
+    x = _x()
+    with ModelPlatform(reg, seed=2) as plat:
+        plat.deploy("m", version=1, config=_cfg())
+        y1 = np.asarray(plat.predict("m", x)).tobytes()
+        plat.deploy_canary("m", 2, fraction=0.5)
+        miss0 = aot_cache.stats()["misses"]
+        for _ in range(10):
+            plat.predict("m", x)
+        st = plat.stats()["m"]["canary"]
+        assert st["requests"] > 0 and st["failures"] == 0
+        out = plat.promote("m")
+        assert out["version"] == 2
+        y2 = np.asarray(plat.predict("m", x)).tobytes()
+        assert y2 != y1  # v2's weights serve now
+        assert "canary" not in plat.stats()["m"]
+        assert aot_cache.stats()["misses"] == miss0
+        with pytest.raises(RuntimeError, match="no canary"):
+            plat.promote("m")
+
+
+def test_canary_fraction_routing_is_seeded(tmp_path):
+    reg = ModelRegistry(tmp_path)
+    v1 = _mlp(seed=1, hidden=16)
+    reg.publish("m", v1)
+    reg.publish("m", _bump(v1))
+    x = _x()
+
+    def arm_counts(seed):
+        plat = ModelPlatform(reg, seed=seed)
+        plat.deploy("m", version=1, config=_cfg())
+        plat.deploy_canary("m", 2, fraction=0.3,
+                           gate=CanaryGate(min_requests=10 ** 6))
+        for _ in range(40):
+            plat.predict("m", x)
+        st = plat.stats()["m"]
+        counts = (st["canary"]["requests"], st["requests"])
+        plat.close()
+        return counts
+
+    a, b, c = arm_counts(1), arm_counts(1), arm_counts(2)
+    assert a == b  # same seed: identical request routing
+    assert a[0] > 0 and a[1] > 0  # both arms actually took traffic
+    assert a != c  # a different platform seed routes differently
+
+
+# --- breaker aggregation (/health) ------------------------------------------
+
+def test_health_aggregates_breakers_per_model_name():
+    from deeplearning4j_tpu.resilience.breaker import CircuitBreaker
+
+    primary = CircuitBreaker(name="serving:agg-test",
+                             failure_threshold=1)
+    canary = CircuitBreaker(name="serving:agg-test#canary",
+                            failure_threshold=1)
+    primary.on_success()
+    canary.on_failure()  # trips open
+    # the arms keep distinct metric series, but /health groups them by
+    # the pre-# prefix: ONE entry per model, reporting the WORST of its
+    # live breakers plus how many it aggregated — one shedding arm is
+    # visible even while the other is healthy
+    st = resilience.status()["circuit_breakers"]["serving:agg-test"]
+    assert st["state"] == "open"
+    assert st["breakers"] == 2
+    assert sorted(st["states"]) == ["closed", "open"]
+    assert st["tripped_total"] == 1
+
+
+# --- HTTP surfaces ----------------------------------------------------------
+
+def _post(base, path, payload):
+    req = urllib.request.Request(base + path, json.dumps(payload).encode(),
+                                 {"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_http_named_404_and_503(tmp_path):
+    reg = ModelRegistry(tmp_path)
+    reg.publish("alpha", _mlp(seed=1, hidden=8))
+    reg.publish("beta", _mlp(seed=2, hidden=10))
+    x = [[0.1, 0.2, 0.3, 0.4]]
+    with ModelPlatform(reg, seed=1) as plat:
+        plat.deploy("alpha", config=_cfg())
+        plat.deploy("beta", config=_cfg())
+        srv = InferenceServer(plat).start(port=0)
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            code, body = _post(base, "/predict/alpha", {"inputs": [x]})
+            assert code == 200 and len(body["outputs"][0]) == 1
+            code, body = _post(base, "/models/beta/predict",
+                               {"inputs": [x]})
+            assert code == 200
+            # unknown model: NAMED 404 (not a KeyError 500), and it
+            # tells the client what IS deployed
+            code, body = _post(base, "/predict/ghost", {"inputs": [x]})
+            assert code == 404
+            assert "ghost" in body["error"]
+            assert body["models"] == ["alpha", "beta"]
+            # bare /predict on a multi-model host: same named surface
+            code, body = _post(base, "/predict", {"inputs": [x]})
+            assert code == 404 and body["models"] == ["alpha", "beta"]
+            # malformed input is a 400 for the sender only
+            code, body = _post(base, "/predict/alpha",
+                               {"inputs": [[[0.1, 0.2]]]})
+            assert code == 400
+            # ragged nesting too (numpy RAISES on inhomogeneous lists —
+            # must surface as the sender's 400, never a host 500)
+            code, body = _post(base, "/predict/alpha",
+                               {"inputs": [[[0.1, 0.2], [0.3]]]})
+            assert code == 400 and "malformed" in body["error"]
+            # trip beta's breaker: the 503 names the model, its scope,
+            # and the breaker state — distinguishable from host overload
+            with FaultPlan(seed=8).inject("serving.launch:beta").armed():
+                for _ in range(6):
+                    _post(base, "/predict/beta", {"inputs": [x]})
+                code, body = _post(base, "/predict/beta", {"inputs": [x]})
+            assert code == 503
+            assert body["model"] == "beta"
+            assert body["scope"] == "model"
+            assert body["breaker"] == "open"
+            # /healthz flips to shedding and names the shedding model
+            health = json.loads(urllib.request.urlopen(
+                base + "/healthz", timeout=10).read())
+            assert health["status"] == "shedding"
+            assert health["shedding_models"] == ["beta"]
+            assert health["models"]["alpha"]["breaker"] == "closed"
+            # /models carries the per-tenant platform stats
+            models = json.loads(urllib.request.urlopen(
+                base + "/models", timeout=10).read())["models"]
+            assert models["beta"]["breaker"] == "open"
+            # alpha kept serving through beta's whole episode
+            code, _ = _post(base, "/predict/alpha", {"inputs": [x]})
+            assert code == 200
+        finally:
+            srv.stop()
+
+
+# --- metrics / UI -----------------------------------------------------------
+
+def test_platform_metrics_and_ui_surfaces(tmp_path):
+    reg = ModelRegistry(tmp_path)
+    v1 = _mlp(seed=1, hidden=18)
+    reg.publish("mtr", v1)
+    reg.publish("mtr", _bump(v1))
+    x = _x()
+    plat = ModelPlatform(reg, seed=1)
+    try:
+        plat.deploy("mtr", version=1, config=_cfg())
+        plat.predict("mtr", x)
+        snap = REGISTRY.snapshot()
+        # per-tenant serving series (model label) + platform gauges
+        assert snap[
+            'dl4j_serving_requests_total{model="mtr",status="ok"}'] >= 1
+        assert 'dl4j_platform_queue_depth{model="mtr"}' in snap
+        assert 'dl4j_platform_warmup_compiles{model="mtr"}' in snap
+        plat.swap("mtr", 2)
+        snap = REGISTRY.snapshot(run_collectors=False)
+        assert snap['dl4j_platform_swap_total{model="mtr"}'] >= 1
+        # UI panel + /platform endpoint
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        ui = UIServer()
+        html = ui.render_html()
+        assert "Serving platform" in html and "mtr" in html
+        port = ui.start(port=0)
+        try:
+            rows = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/platform", timeout=10).read())
+            assert any("mtr" in p for p in rows)
+        finally:
+            ui.stop()
+    finally:
+        plat.close()
+
+
+# --- generation tenants -----------------------------------------------------
+
+def test_generation_tenant_deploy_and_generate():
+    from deeplearning4j_tpu.parallel.generation import GenerationConfig
+    from deeplearning4j_tpu.zoo.graphs import TransformerEncoder
+
+    lm = TransformerEncoder(vocab_size=16, embed_dim=8, n_heads=2,
+                            n_layers=1, max_len=16, causal=True,
+                            lm_head=True, seed=5)
+    with ModelPlatform(seed=1) as plat:
+        out = plat.deploy_generation(
+            "lm", model=lm,
+            config=GenerationConfig(max_batch=2, fused_steps=2,
+                                    kv_bucket_min=8, prompt_bucket_min=4))
+        assert out["model"] == "lm"
+        toks = plat.generate("lm", [1, 2, 3], max_new_tokens=4)
+        assert len(toks) >= 1
+        # named tenant: model-labeled decode series + serving:<name>
+        # breaker visible in the aggregated /health view
+        snap = REGISTRY.snapshot(run_collectors=False)
+        assert snap[
+            'dl4j_decode_requests_total{model="lm",status="ok"}'] >= 1
+        assert "serving:lm" in resilience.status()["circuit_breakers"]
+        assert plat.stats()["lm"]["generation"]["queue_depth"] == 0
+        with pytest.raises(UnknownModelError, match="generation model"):
+            plat.generate("nope", [1, 2])
