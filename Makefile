@@ -90,6 +90,18 @@ platform-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --multi-model --seconds 1.5 \
 		--assert-isolation --out /tmp/bench_serving_mt_smoke.json
 
+.PHONY: pod-smoke
+# Pod scale-out smoke: the distributed-snapshot / pod-preemption test
+# subset — seeded host-death chaos with bit-identical resume, the
+# mid-shard-write commit-protocol pins, cross-pod-shape restore through
+# comms.reshard, and the make_array scatter/gather parity pins. The
+# real 2-process leg probes the jaxlib for CPU multi-process
+# collectives and skips cleanly where they are absent.
+pod-smoke:
+	JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	$(PY) -m pytest tests -q -m pod -p no:cacheprovider
+
 .PHONY: lint
 # Repo-discipline source lint (analysis/source.py AST rules): host syncs
 # in compiled functions, lock discipline on shared registries, wall-clock/

@@ -39,13 +39,28 @@ from deeplearning4j_tpu.analysis.findings import (
 
 # step kinds whose executables MUST donate (alias) their buffers: the
 # model train steps, the fused/tbptt scans, every ParallelWrapper SPMD
-# step kind ("pw_*"), and the KV-cached generation path — "decode_step*"
+# step kind ("pw_*" — including the pod-path multi-process keys, which
+# carry a ":p<N>" process-topology token so a pod executable never
+# collides with a single-host one; donation + collective audit apply to
+# them unchanged), and the KV-cached generation path — "decode_step*"
 # consumes the whole decode state (the KV caches dominate it) every
 # fused window, "prefill*" (prefill_join) scatters prompt KV into it,
 # and "gen_release*" passes it through with rows masked; a non-donated
 # decode-state executable silently doubles KV memory every token.
 TRAIN_KIND_PREFIXES = ("train_step", "fused_scan", "tbptt_scan", "pw_",
                        "decode_step", "prefill", "gen_release")
+
+# pod/reshard data-plane kinds (comms.reshard commit_compiled /
+# recut_flat — the pod checkpoint restore-across-pod-shapes route):
+# every OTHER program rule applies to them (baked consts, f64 leaks,
+# callbacks, collective audit), but they are deliberately NOT in
+# TRAIN_KIND_PREFIXES — exempting them from the PRG201 donation
+# expectation BY CONSTRUCTION: a cross-placement recommit's source and
+# target layouts have different per-device buffer sizes, which XLA
+# cannot alias — demanding donation there would force a waiver on
+# every pod restore (test_pod pins that they never enter the donation
+# audit and compile finding-free).
+RESHARD_KIND_PREFIXES = ("pod_recut", "reshard_commit")
 
 ALL_REDUCE_PRIMS = frozenset({"psum", "psum2", "all_reduce"})
 REDUCE_SCATTER_PRIMS = frozenset({"psum_scatter", "reduce_scatter"})

@@ -30,7 +30,10 @@ from deeplearning4j_tpu.comms.scheduler import (  # noqa: F401
     stats,
 )
 from deeplearning4j_tpu.comms.reshard import (  # noqa: F401
+    commit_compiled,
     publish_to_engine,
+    recut_flat,
     reshard,
+    reshard_flat,
     reshard_training_state,
 )

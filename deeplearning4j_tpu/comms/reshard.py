@@ -24,10 +24,17 @@ Consumers:
   between launches, so a training loop publishes fresh weights without
   gathering to host.
 
-Single-process scope: intersection works over *addressable* shards, so
-multi-process arrays fall back to ``jax.device_put`` (which jax routes
-correctly, just without the minimal-exchange guarantee). That matches
-the wrapper's existing ``process_count == 1`` contract for ZeRO/plans.
+Multi-process route: the eager slice intersection works over
+*addressable* shards only, so process-SPANNING arrays route through a
+COMPILED identity with the target sharding pinned
+(:func:`commit_compiled`) — XLA emits the minimal cross-host exchange
+over ICI/DCN, which is exactly the portable-collective discipline of
+the paper applied by the compiler instead of by hand. Host values stage
+through ``jax.make_array_from_callback`` (each process materializes
+only its own addressable index boxes). ``jax.device_put`` remains the
+final portability valve. Flat ZeRO layouts whose padded lengths differ
+re-cut through :func:`recut_flat` (the pod checkpoint
+restore-across-pod-shapes path — ``resilience.pod``).
 """
 
 from __future__ import annotations
@@ -62,13 +69,75 @@ def _assemble(pieces, d, ndim):
     return jnp.concatenate(runs, axis=d)
 
 
+def _sharding_token(sharding) -> str:
+    """Short digest of a target placement for compiled-route cache keys:
+    the ORDERED device identities + spec (+ mesh axis sizes when
+    named). Two targets that differ in any of those — including the
+    same axis sizes over a different or permuted device set — must
+    never share an executable, or the cached program would commit the
+    result under the wrong placement."""
+    import hashlib
+
+    mesh = getattr(sharding, "mesh", None)
+    spec = getattr(sharding, "spec", None)
+    if mesh is not None:
+        axes = tuple((a, int(mesh.shape[a])) for a in mesh.axis_names)
+        dev_ids = tuple(int(d.id) for d in mesh.devices.flat)
+    else:
+        axes = ()
+        # device_set is unordered; sorting still distinguishes SETS
+        # (the exotic-sharding valve — NamedSharding covers every
+        # in-repo caller with the ordered mesh above)
+        dev_ids = tuple(sorted(
+            int(getattr(d, "id", -1))
+            for d in getattr(sharding, "device_set", ()) or ()))
+    payload = repr((dev_ids, str(spec), axes))
+    return hashlib.sha1(payload.encode()).hexdigest()[:12]
+
+
+def commit_compiled(x, target):
+    """The REAL multi-process route: recommit a committed global array
+    under ``target`` through a compiled identity with ``out_shardings``
+    pinned — XLA plans the cross-host exchange (collective-permute /
+    all-gather as needed), each process executing only its addressable
+    part. AOT-cached under the ``reshard_commit`` kind so repeated
+    restores / train→serve hand-offs on a pod never re-lower.
+    Non-donating: callers (``publish_to_engine``, restore paths) keep
+    the source alive — and cross-placement per-device buffers could not
+    alias anyway."""
+    import jax
+
+    from deeplearning4j_tpu.optimize import aot_cache
+
+    step = aot_cache.wrap(
+        jax.jit(lambda a: a, out_shardings=target),
+        "reshard", f"reshard_commit:{_sharding_token(target)}")
+    return step(x)
+
+
 def _reshard_leaf(x, target):
     import jax
 
-    if not isinstance(x, jax.Array) or jax.process_count() > 1:
+    if not isinstance(x, jax.Array):
+        # host value: every process stages ONLY its own addressable
+        # index boxes (device_put of a full host array is fine single-
+        # process and wrong on a pod, where remote shards are not ours
+        # to place)
+        if jax.process_count() > 1:
+            arr = np.asarray(x)
+            return jax.make_array_from_callback(
+                arr.shape, target, lambda idx: arr[idx])
         return jax.device_put(x, target)
     if x.sharding == target:
         return x
+    if jax.process_count() > 1 \
+            or not getattr(x, "is_fully_addressable", True):
+        # process-spanning arrays: the eager intersection below can only
+        # see addressable shards — route through the compiled exchange
+        try:
+            return commit_compiled(x, target)
+        except Exception:
+            return jax.device_put(x, target)  # portability valve
     try:
         return _intersect_exchange(x, target)
     except Exception:
@@ -151,14 +220,25 @@ def reshard_flat(x, logical_size, target_padded, target_sharding):
     import jax
     import jax.numpy as jnp
 
-    if not isinstance(x, jax.Array) or jax.process_count() > 1:
+    if not isinstance(x, jax.Array):
         flat = np.zeros((int(target_padded),),
                         np.asarray(x).dtype if not hasattr(x, "dtype")
                         else np.dtype(x.dtype))
         src = np.asarray(x).reshape(-1)
         n = min(src.size, int(logical_size))
         flat[:n] = src[:n]
+        if jax.process_count() > 1:
+            # each pod host stages only its addressable slices
+            return jax.make_array_from_callback(
+                flat.shape, target_sharding, lambda idx: flat[idx])
         return jax.device_put(flat, target_sharding)
+    if jax.process_count() > 1 \
+            or not getattr(x, "is_fully_addressable", True):
+        # process-spanning flat vector: compiled re-cut (XLA owns the
+        # cross-host exchange) — same route the pod checkpoint restore
+        # takes between pod shapes
+        return recut_flat(x, logical_size, target_padded,
+                          target_sharding)
     src_len = x.shape[0]
     if src_len == int(target_padded):
         return _reshard_leaf(x, target_sharding)
@@ -187,6 +267,45 @@ def reshard_flat(x, logical_size, target_padded, target_sharding):
                       else jax.numpy.concatenate(pieces))
     return jax.make_array_from_single_device_arrays(
         (int(target_padded),), target_sharding, arrays)
+
+
+def recut_flat(x, logical_size, target_padded, target_sharding):
+    """COMPILED re-cut of one flat vector between ZeRO/pod layouts whose
+    padded lengths differ: keep ``[0, logical_size)``, zero-fill the
+    target pad tail, and commit under ``target_sharding`` — XLA plans
+    the exchange, so the route works across processes (each host
+    executes its addressable part) exactly like :func:`commit_compiled`.
+    This is the restore-across-pod-shapes path of the pod checkpoint
+    layer (``resilience.pod``): shards saved by an n-host pod restore
+    onto an m-host pod through this executable, bitwise the snapshot
+    (pinned by test_pod). AOT-cached under the ``pod_recut`` kind.
+    Non-donating by necessity: source and target layouts have
+    different per-device buffer sizes, which XLA cannot alias — the
+    one reshard family exempt from the PRG201 donation expectation
+    (see analysis/program.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.optimize import aot_cache
+
+    logical = int(logical_size)
+    target_padded = int(target_padded)
+    src_len = int(x.shape[0])
+    keep = min(logical, src_len)
+
+    def recut(a):
+        a = a[:keep]
+        if target_padded > keep:
+            a = jnp.concatenate(
+                [a, jnp.zeros((target_padded - keep,), a.dtype)])
+        return a
+
+    step = aot_cache.wrap(
+        jax.jit(recut, out_shardings=target_sharding),
+        "reshard",
+        f"pod_recut:s{src_len}:l{logical}:t{target_padded}"
+        f":{_sharding_token(target_sharding)}")
+    return step(x)
 
 
 # --------------------------------------------------------------------------

@@ -14,8 +14,6 @@ import os
 import time
 from typing import List, Optional
 
-import numpy as np
-
 from deeplearning4j_tpu.optimize.listeners import TrainingListener
 from deeplearning4j_tpu.util import serializer
 
@@ -33,15 +31,17 @@ def snapshot_training_state(model) -> dict:
     hook — the snapshot is always full host arrays, restorable onto any
     mesh (wrapper-level rollback uses the wrapper's own device-copy
     hooks instead; this path serves model-level callers)."""
-    import jax
-
     live = getattr(model, "_live_trainer", None)
     trainer = live() if live is not None else None
     if trainer is not None:
         trainer.sync_model()
 
-    host = lambda t: jax.tree_util.tree_map(  # noqa: E731
-        lambda x: np.asarray(x), t)
+    # host_gather: bitwise np.asarray for fully-addressable leaves, and
+    # the compiled cross-host replicate for pod-spanning trees — the
+    # snapshot is full host arrays at any process count
+    from deeplearning4j_tpu.parallel import mesh as mesh_mod
+
+    host = mesh_mod.host_gather
     return {
         "params": host(model.params),
         "state": host(model.state),

@@ -70,6 +70,16 @@ def _tree_map(f, *trees):
     return jax.tree_util.tree_map(f, *trees)
 
 
+def _proc_token() -> str:
+    """Multi-process step-key component: the same mesh axis sizes over a
+    different process topology compile different SPMD programs (per-host
+    shard ownership differs), so pod executables must never collide with
+    single-host ones in the AOT cache. Empty at ``process_count == 1``
+    — every pre-pod cache key is unchanged."""
+    procs = jax.process_count()
+    return f":p{procs}" if procs > 1 else ""
+
+
 # shared version-adaptive vma helpers (see parallel/mesh.py)
 _EFFICIENT_PSUM_TRANSPOSE = mesh_mod.EFFICIENT_PSUM_TRANSPOSE
 _vary_on = mesh_mod.ensure_varying
@@ -221,11 +231,12 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                 "zero_optimizer composes with the exact SHARED_GRADIENTS "
                 "path only (no threshold compression, no expert_parallel, "
                 "no tBPTT, no AVERAGING)")
-        if self._zero and jax.process_count() > 1:
-            raise ValueError(
-                "zero_optimizer is single-process for now (the host-side "
-                "scatter/gather of optimizer shards cannot address other "
-                "hosts' slices)")
+        # multi-host ZeRO (pod scale-out): the host-side scatter stages
+        # through make_array_from_callback (each process commits only
+        # its addressable slices) and the gather replicates process-
+        # spanning slices through a compiled identity — see
+        # sharding/zero.py + parallel/mesh.py. No process-count refusal:
+        # the same wrapper spans hosts when jax.distributed is up.
         # declarative DP x TP placement (sharding/plan.py): a regex rule
         # table (or prebuilt ShardingPlan) places params/opt-state over
         # the mesh's data x model axes; the exact SPMD step runs under
@@ -246,11 +257,12 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                     "partition_rules plan must be built on the wrapper's "
                     "mesh (pass mesh=plan.mesh or let the wrapper build "
                     "the plan from a rule table)")
-            if jax.process_count() > 1:
-                raise ValueError(
-                    "partition_rules is single-process for now (the "
-                    "write-back gather of TP-sharded params cannot "
-                    "address other hosts' shards)")
+            # multi-host plans work: placement host arrays stage via
+            # make_array_from_callback (comms.reshard), the write-back
+            # gather replicates TP-sharded leaves through a compiled
+            # identity (mesh_mod.host_gather), and the plan's cache_tag
+            # keys the process topology so pod executables never
+            # collide with single-host ones.
             if (training_mode is not TrainingMode.SHARED_GRADIENTS
                     or threshold_algorithm is not None
                     or self.expert_parallel or self._tbptt or self._zero
@@ -277,11 +289,11 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                     "SPMD path only (no threshold compression, no "
                     "gradient_bucket_mb, no expert_parallel, no tBPTT, "
                     "no AVERAGING, no zero_optimizer/partition_rules)")
-            if jax.process_count() > 1:
-                raise ValueError(
-                    "fused_steps is single-process for now (the "
-                    "multi-host per-batch shape lock does not cover "
-                    "stacked super-batches)")
+            # multi-host fused dispatch works: stacked super-batches
+            # stage via make_array_from_process_local_data (each host
+            # contributes its local [K, B_local, ...] partition) and
+            # the per-fit shape lock covers the stacked per-step rows
+            # exactly as it covers single-step batches (_fit_batch_fused)
         self.score_value = float("nan")
         # device-resident training trees (replicated or replica-stacked)
         self._params = self._state = self._opt = None
@@ -736,7 +748,7 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         alg = aot_cache.graph_signature(self.threshold_algorithm)[:12]
         return aot_cache.wrap(
             jit_fn, self.model._graph_key(),
-            f"pw_thresh:n{self.workers}"
+            f"pw_thresh:n{self.workers}{_proc_token()}"
             f":b{self.gradient_bucket_bytes or 0}:{plan.key_token()}"
             f":alg{alg}{health.cache_tag()}")
 
@@ -805,7 +817,7 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
                                     bucket)
         return aot_cache.wrap(
             jit_fn, self.model._graph_key(),
-            f"pw_bucketed:n{self.workers}:b{bucket or 0}"
+            f"pw_bucketed:n{self.workers}{_proc_token()}:b{bucket or 0}"
             f":{plan.key_token()}{health.cache_tag()}")
 
     def _build_zero_step(self):
@@ -968,7 +980,8 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         rs_plan, ag_plan = pz.exchange_plans(DATA, bucket)
         return aot_cache.wrap(
             jit_fn, m._graph_key(),
-            f"pw_zero:n{self.workers}:b{bucket or 0}:{rs_plan.key_token()}"
+            f"pw_zero:n{self.workers}{_proc_token()}:b{bucket or 0}"
+            f":{rs_plan.key_token()}"
             f":{ag_plan.key_token()}{health.cache_tag()}")
 
     def _build_averaging_step(self):
@@ -1097,7 +1110,7 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
         plan = comms_sched.plan_for(group, "all_reduce", DATA, bucket)
         return aot_cache.wrap(
             jit_fn, m._graph_key(),
-            f"pw_avg:n{self.workers}:b{bucket or 0}:u{int(avg_upd)}"
+            f"pw_avg:n{self.workers}{_proc_token()}:b{bucket or 0}:u{int(avg_upd)}"
             f":{plan.key_token()}")
 
     # --- training loop ------------------------------------------------------
@@ -1456,9 +1469,29 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             rows = jax.tree_util.tree_leaves(batch)[0].shape[1]
             target = (math.ceil(rows / self.local_workers)
                       * self.local_workers)
+            if jax.process_count() > 1:
+                # same per-fit shape lock as the single-step path: every
+                # host must present identically-shaped [K, B, ...] local
+                # stacks (SPMD), tails padding up to the locked size
+                if self._mp_target is None:
+                    self._mp_target = target
+                if target > self._mp_target:
+                    raise ValueError(
+                        f"multi-host fused stack of {rows} per-step rows "
+                        f"exceeds the established per-host batch of "
+                        f"{self._mp_target}; all hosts must feed "
+                        f"equal-size super-batches")
+                target = self._mp_target
             batch = _pad_axis1(batch, target)
             sh = NamedSharding(self.mesh, P(None, DATA))
-            batch = _tree_map(lambda x: jax.device_put(x, sh), batch)
+            if jax.process_count() > 1:
+                # each host contributes its LOCAL [K, B_local, ...]
+                # partition of the global stacked super-batch
+                batch = _tree_map(
+                    lambda x: jax.make_array_from_process_local_data(
+                        sh, np.asarray(x)), batch)
+            else:
+                batch = _tree_map(lambda x: jax.device_put(x, sh), batch)
         if self._fused_step is None or self._fused_step_k != k:
             self._fused_step = jax.jit(
                 m.fused_scan_fn(k, guards=mode), donate_argnums=(0, 1, 2))
@@ -1521,19 +1554,24 @@ class ParallelWrapper(nn_io.LazyScoreMixin):
             return
         self._synced = True
         m = self.model
+        # host_gather handles pod-spanning trees: a leaf whose shards
+        # live on remote hosts (ZeRO opt slices, TP-sharded params)
+        # replicates through one compiled identity before the read;
+        # fully-addressable leaves keep the direct device_get bitwise
+        host = mesh_mod.host_gather
         if self.training_mode is TrainingMode.AVERAGING:
-            m.params = jax.device_get(self._collect(self._params))
-            m.state = jax.device_get(self._collect(self._state))
-            m.opt_state = jax.device_get(self._collect(self._opt))
+            m.params = host(self._collect(self._params))
+            m.state = host(self._collect(self._state))
+            m.opt_state = host(self._collect(self._opt))
         else:
-            m.params = jax.device_get(self._params)
-            m.state = jax.device_get(self._state)
+            m.params = host(self._params)
+            m.state = host(self._state)
             if self._zero:
-                # scattered flat slices -> original shapes (np.asarray
-                # inside gather_host pulls every shard's slice)
+                # scattered flat slices -> original shapes (gather_host
+                # pulls every shard's slice, cross-host when needed)
                 m.opt_state = self._zero_ospec.gather_host(self._opt)
             else:
-                m.opt_state = jax.device_get(self._opt)
+                m.opt_state = host(self._opt)
         m.params = _tree_map(jnp.asarray, m.params)
         m.state = _tree_map(jnp.asarray, m.state)
         m.opt_state = _tree_map(jnp.asarray, m.opt_state)

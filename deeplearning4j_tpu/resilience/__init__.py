@@ -31,6 +31,12 @@ from deeplearning4j_tpu.resilience.faults import (  # noqa: F401
     InjectedFault,
     fault_point,
 )
+from deeplearning4j_tpu.resilience import pod as pod  # noqa: F401
+from deeplearning4j_tpu.resilience.pod import (  # noqa: F401
+    HostDeathError,
+    PodConfig,
+    PodSnapshotIncompleteError,
+)
 from deeplearning4j_tpu.resilience.retry import RetryPolicy  # noqa: F401
 from deeplearning4j_tpu.resilience.session import (  # noqa: F401
     PreemptionError,
@@ -73,7 +79,8 @@ def status() -> dict:
     """Process-wide resilience snapshot for ``/health`` and debugging:
     every live circuit breaker's state (aggregated per breaker name —
     see :func:`_aggregate_breakers`), the retry/resume/fault counters,
-    and whether a fault plan is currently armed."""
+    the pod topology + snapshot/restore series (when a pod session has
+    recorded any), and whether a fault plan is currently armed."""
     from deeplearning4j_tpu.telemetry import REGISTRY
 
     snap = REGISTRY.snapshot(run_collectors=False)
@@ -81,8 +88,19 @@ def status() -> dict:
                 if k.startswith(("dl4j_retries_total",
                                  "dl4j_resumes_total",
                                  "dl4j_faults_injected_total"))}
-    return {
+    out = {
         "circuit_breakers": _aggregate_breakers(),
         "counters": counters,
         "fault_plan_armed": faults.active_plan() is not None,
     }
+    pod_series = {k: v for k, v in snap.items()
+                  if k.startswith("dl4j_pod_")}
+    if pod_series:
+        out["pod"] = {
+            "hosts": int(snap.get("dl4j_pod_hosts", 0)),
+            "series": {
+                k: (v if not isinstance(v, dict)
+                    else {kk: v[kk] for kk in ("count", "mean", "p95")})
+                for k, v in pod_series.items()},
+        }
+    return out

@@ -35,6 +35,18 @@ Named sites (the permanent hooks in product code)::
                          published into the serving engine (raise =
                          partial swap, incumbent keeps serving; delay =
                          wedged swap, traffic must flow throughout)
+    snapshot.shard_write resilience.pod.write_pod_snapshot, inside one
+                         host's shard file assembly, before the atomic
+                         publish (a raise IS a partial shard on that
+                         host: temp bytes exist, no host manifest
+                         references them, the coordinator manifest is
+                         never committed — the prior complete snapshot
+                         stays authoritative)
+    pod.heartbeat        resilience.session pod-mode fit loop, once per
+                         batch (raise HostDeathError(host=k) here = a
+                         FaultPlan-seeded host death; the session
+                         treats it as resumable and the whole job
+                         resumes from the last distributed snapshot)
 
 Per-model scoping: an engine constructed with ``name=`` fires
 ``serving.launch:<name>`` / ``decode.launch:<name>`` instead of the
@@ -78,6 +90,8 @@ SITES = (
     "stats.flush",
     "model.load",
     "model.swap",
+    "snapshot.shard_write",
+    "pod.heartbeat",
 )
 
 

@@ -39,6 +39,7 @@ Usage::
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Optional, Tuple, Type
 
@@ -47,6 +48,8 @@ from deeplearning4j_tpu.resilience.retry import CHECKPOINT_RETRY, RetryPolicy
 
 MANIFEST = "session.json"
 _MANIFEST_VERSION = 1
+
+logger = logging.getLogger(__name__)
 
 
 class PreemptionError(RuntimeError):
@@ -79,15 +82,37 @@ class TrainingSession:
         max_restarts: auto-resumes per :meth:`fit` call before giving up
             (guards against a deterministic fault that re-fires every
             replay).
+        pod: pod-grade distributed snapshots
+            (:mod:`~deeplearning4j_tpu.resilience.pod`): an int ``N``
+            (→ ``PodConfig(n_hosts=N)``) or a prebuilt
+            :class:`~deeplearning4j_tpu.resilience.pod.PodConfig`.
+            Snapshots become per-host shard directories (each host
+            writes its slice of params/updater state under the ZeroSpec
+            flat layout, coordinator manifest committed last), resume
+            digest-verifies every shard and falls back newest-first
+            past partial snapshots with a logged
+            ``PodSnapshotIncompleteError`` reason, the fit loop carries
+            the ``pod.heartbeat`` fault site (a seeded
+            ``HostDeathError`` there = chaos host death, resumed at
+            host scope), and restore re-cuts through ``comms.reshard``
+            when the restoring pod shape differs from the saving one.
     """
 
     def __init__(self, model, directory: str,
                  snapshot_every_n_iterations: int = 50,
                  keep_last: int = 2,
                  retry: Optional[RetryPolicy] = None,
-                 resumable: Tuple[Type[BaseException], ...] =
-                 (PreemptionError, InjectedFault, OSError),
-                 max_restarts: int = 3):
+                 resumable: Optional[
+                     Tuple[Type[BaseException], ...]] = None,
+                 max_restarts: int = 3,
+                 pod=None):
+        from deeplearning4j_tpu.resilience import pod as pod_mod
+
+        if resumable is None:
+            resumable = (PreemptionError, InjectedFault, OSError,
+                         pod_mod.HostDeathError)
+        self.pod = (pod_mod.PodConfig(n_hosts=pod)
+                    if isinstance(pod, int) else pod)
         self.model = model
         self.directory = str(directory)
         os.makedirs(self.directory, exist_ok=True)
@@ -187,19 +212,50 @@ class TrainingSession:
             # before the wrapper stages anything)
             t.sync_model()
         m = self._net
-        fname = f"session_iter{int(m.iteration):08d}.zip"
-        path = os.path.join(self.directory, fname)
-        self.retry.call(serializer.write_model, m, path,
-                        op="checkpoint.write")
-        entry = {
-            "file": fname,
-            "digest": _sha256(path),
-            "iteration": int(m.iteration),
-            "epoch": int(m.epoch),
-            "batch_in_epoch": int(self._batch_in_epoch),
-        }
+        if self.pod is not None:
+            # distributed snapshot: one directory, per-host shard files
+            # + host manifests, coordinator manifest committed last
+            # (resilience/pod.py has the protocol)
+            import jax
+
+            from deeplearning4j_tpu.resilience import pod as pod_mod
+
+            dname = f"pod_iter{int(m.iteration):08d}"
+            args = (pod_mod.write_pod_snapshot, m,
+                    os.path.join(self.directory, dname), self.pod)
+            kw = dict(batch_in_epoch=int(self._batch_in_epoch),
+                      rng_key=getattr(m, "_base_key", None))
+            if self.pod.emulated or jax.process_count() == 1:
+                self.retry.call(*args, op="checkpoint.write", **kw)
+            else:
+                # REAL pod: the write contains global barriers, and a
+                # PER-PROCESS retry would re-enter them on one host
+                # while the others wait at the next tag — desyncing the
+                # whole pod. A failed collective snapshot propagates
+                # (job-scope resumable) instead of retrying locally.
+                args[0](*args[1:], **kw)
+            entry = {
+                "file": dname,
+                "pod": True,
+                "n_hosts": self.pod.n_hosts,
+                "iteration": int(m.iteration),
+                "epoch": int(m.epoch),
+                "batch_in_epoch": int(self._batch_in_epoch),
+            }
+        else:
+            fname = f"session_iter{int(m.iteration):08d}.zip"
+            path = os.path.join(self.directory, fname)
+            self.retry.call(serializer.write_model, m, path,
+                            op="checkpoint.write")
+            entry = {
+                "file": fname,
+                "digest": _sha256(path),
+                "iteration": int(m.iteration),
+                "epoch": int(m.epoch),
+                "batch_in_epoch": int(self._batch_in_epoch),
+            }
         snaps = [s for s in self._manifest["snapshots"]
-                 if s["file"] != fname] + [entry]
+                 if s["file"] != entry["file"]] + [entry]
         self._manifest["snapshots"] = snaps[-max(self.keep_last, 2):]
         key = getattr(m, "_base_key", None)
         if key is not None:
@@ -212,6 +268,8 @@ class TrainingSession:
         return entry
 
     def _prune(self, all_snaps: list) -> None:
+        import shutil
+
         keep = {s["file"] for s in self._manifest["snapshots"]}
         for s in all_snaps:
             if s["file"] in keep:
@@ -219,15 +277,22 @@ class TrainingSession:
             p = os.path.join(self.directory, s["file"])
             if os.path.exists(p):
                 try:
-                    os.remove(p)
+                    # pod snapshots are directories of shard files
+                    shutil.rmtree(p) if os.path.isdir(p) else os.remove(p)
                 except OSError:
                     pass  # retention is best-effort; resume only needs keep
 
     # --- resume -------------------------------------------------------------
-    def resume(self):
+    def resume(self, scope: str = "job"):
         """Restore the newest loadable snapshot (digest-verified; corrupt
         or truncated zips fall back to the previous one, then to the
-        in-memory last-good). Counts ``dl4j_resumes_total``."""
+        in-memory last-good). Pod snapshots (``pod=``) verify every
+        host shard; a partial one — missing shard, digest mismatch,
+        uncommitted/stale coordinator manifest — is skipped with its
+        :class:`~deeplearning4j_tpu.resilience.pod.
+        PodSnapshotIncompleteError` reason logged, falling back
+        newest-first. Counts ``dl4j_resumes_total{scope=...}``
+        (``scope="host"`` when a pod host died, ``"job"`` otherwise)."""
         import jax.numpy as jnp
         import numpy as np
 
@@ -238,11 +303,15 @@ class TrainingSession:
         self._manifest = self._read_manifest()
         listeners = list(getattr(self._net, "listeners", []) or [])
         snaps = self._manifest["snapshots"]
-        restored, idx, _ = serializer.restore_newest_verified(
-            [(os.path.join(self.directory, s["file"]),
-              s.get("digest", "")) for s in snaps],
-            serializer.restore_model)
-        entry = snaps[idx] if restored is not None else None
+        restored, entry = None, None
+        if any(s.get("pod") for s in snaps):
+            restored, entry = self._resume_pod_walk(snaps)
+        else:
+            restored, idx, _ = serializer.restore_newest_verified(
+                [(os.path.join(self.directory, s["file"]),
+                  s.get("digest", "")) for s in snaps],
+                serializer.restore_model)
+            entry = snaps[idx] if restored is not None else None
         if restored is None and self._mem is not None \
                 and self._net is not None:
             ckpt.restore_training_state(self._net, self._mem)
@@ -276,8 +345,35 @@ class TrainingSession:
         else:
             self.model = restored
         self._batch_in_epoch = int((entry or {}).get("batch_in_epoch", 0))
-        telemetry.record_resume()
+        telemetry.record_resume(scope=scope)
         return restored
+
+    def _resume_pod_walk(self, snaps):
+        """Newest-first walk over pod snapshot rows: a partial snapshot
+        is SKIPPED with its specific reason in the log (never a bare
+        ``KeyError``/``FileNotFoundError``) and the walk falls back to
+        the previous generation. Zip rows interleave transparently (a
+        session switched to pod mode mid-history keeps its old
+        snapshots restorable)."""
+        from deeplearning4j_tpu.resilience import pod as pod_mod
+        from deeplearning4j_tpu.util import serializer
+
+        for s in reversed(snaps):
+            path = os.path.join(self.directory, s["file"])
+            if s.get("pod"):
+                try:
+                    net, _ = pod_mod.restore_pod_snapshot(path, self.pod)
+                    return net, s
+                except pod_mod.PodSnapshotIncompleteError as e:
+                    logger.warning(
+                        "skipping pod snapshot %s: %s", s["file"],
+                        e.reason)
+                    continue
+            restored, _, _ = serializer.restore_newest_verified(
+                [(path, s.get("digest", ""))], serializer.restore_model)
+            if restored is not None:
+                return restored, s
+        return None, None
 
     # --- training -----------------------------------------------------------
     def fit(self, data, labels=None, epochs: int = 1,
@@ -337,16 +433,23 @@ class TrainingSession:
             iterator = StackBatchIterator(iterator, trainer.fused_steps)
         target_epoch = int(to_epoch) if to_epoch is not None \
             else int(net.epoch) + int(epochs)
+        from deeplearning4j_tpu.resilience import pod as pod_mod
+
         restarts_this_fit = 0
         while True:
             try:
                 return self._run(iterator, target_epoch)
-            except self.resumable:
+            except self.resumable as e:
                 restarts_this_fit += 1
                 if restarts_this_fit > self.max_restarts:
                     raise
                 self.restarts += 1  # counts resumes performed, not failures
-                self.resume()
+                # host scope: one pod host died and the whole job is
+                # resuming from the last distributed snapshot; job
+                # scope: whole-process preemption/fault
+                self.resume(scope="host"
+                            if isinstance(e, pod_mod.HostDeathError)
+                            else "job")
 
     def _run(self, iterator, target_epoch: int):
         from deeplearning4j_tpu import telemetry
@@ -356,6 +459,8 @@ class TrainingSession:
         # clock itself (idle time since a previous fit must not record
         # as a dispatch gap)
         telemetry.host_gap_reset()
+        if self.pod is not None:
+            telemetry.record_pod_hosts(self.pod.n_hosts)
         trainer = self._trainer()
         if trainer is not None:
             # stage (or RE-stage after resume — possibly onto a
@@ -409,6 +514,14 @@ class TrainingSession:
                 for i, ds in enumerate(iterator):
                     if i < skip:
                         continue  # replay fast-forward to the crash pos
+                    if self.pod is not None:
+                        # the pod liveness edge, once per batch: a
+                        # seeded HostDeathError here is the chaos
+                        # host-death vector (deterministic kill step —
+                        # same seed, same step, every replay)
+                        from deeplearning4j_tpu.resilience import faults
+
+                        faults.fault_point("pod.heartbeat")
                     it_before = m.iteration
                     if trainer is not None:
                         # wrapper steps dispatch synchronously (the

@@ -221,10 +221,21 @@ class ShardingPlan:
         if self._tables is None:
             raise ValueError("cache_tag() before param_specs() — the "
                              "tag keys the RESOLVED table")
+        import jax
+
         mesh_sig = tuple(
             (a, int(self.mesh.shape[a])) for a in self.mesh.axis_names)
-        payload = json.dumps([mesh_sig, self._tables["params"],
-                              self._tables["opt"]], sort_keys=True)
+        # pod scope: the same axis sizes over a different process
+        # topology compile different SPMD programs (per-host shard
+        # ownership differs) — the process count keys the tag so a
+        # multi-host plan never reuses a single-host executable.
+        # Single-process tags are unchanged (every pre-pod cache key
+        # stays valid).
+        procs = jax.process_count()
+        sig = [mesh_sig, self._tables["params"], self._tables["opt"]]
+        if procs > 1:
+            sig.append(["processes", procs])
+        payload = json.dumps(sig, sort_keys=True)
         return hashlib.sha1(payload.encode()).hexdigest()[:16]
 
     # --- accounting ---------------------------------------------------------

@@ -105,9 +105,17 @@ class ZeroSpec:
     def scatter_host(self, tree, mesh, axis: str):
         """Host tree -> tree of flat ``[n*m_i]`` arrays committed with
         their leading axis sharded over ``axis`` (shard k's slice lives
-        on shard k's devices — the 1/n-per-device memory footprint)."""
+        on shard k's devices — the 1/n-per-device memory footprint).
+        Multi-process-safe: ``mesh_mod.stage_host`` routes through
+        ``jax.make_array_from_callback``, so each pod host stages only
+        its OWN addressable slices of every flat vector — no process
+        ever materializes or addresses a remote host's shard (bitwise
+        the old ``device_put`` path at ``process_count == 1``, pinned
+        by test_sharding's parity suite)."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from deeplearning4j_tpu.parallel import mesh as mesh_mod
 
         leaves = jax.tree_util.tree_flatten(tree)[0]
         sh = NamedSharding(mesh, P(axis))
@@ -115,18 +123,24 @@ class ZeroSpec:
         for leaf, padded, dt in zip(leaves, self.padded_sizes, self.dtypes):
             flat = np.zeros((padded,), dt)
             flat[:leaf.size] = np.asarray(leaf).reshape(-1)
-            out.append(jax.device_put(flat, sh))
+            out.append(mesh_mod.stage_host(flat, sh))
         return jax.tree_util.tree_unflatten(self.treedef, out)
 
     def gather_host(self, scattered):
         """Inverse of :meth:`scatter_host`: device tree of flat padded
-        arrays -> host numpy tree with the original shapes."""
+        arrays -> host numpy tree with the original shapes.
+        Multi-process-safe: ``mesh_mod.host_gather`` replicates
+        process-spanning slices through a compiled identity (the
+        cross-host all-gather) before reading; single-process arrays
+        keep the direct ``np.asarray`` route bitwise."""
         import jax
+
+        from deeplearning4j_tpu.parallel import mesh as mesh_mod
 
         leaves = jax.tree_util.tree_flatten(scattered)[0]
         out = []
         for leaf, shape, size in zip(leaves, self.shapes, self.sizes):
-            flat = np.asarray(leaf)           # gathers across shards
+            flat = mesh_mod.host_gather(leaf)
             out.append(flat[:size].reshape(shape))
         return jax.tree_util.tree_unflatten(self.treedef, out)
 

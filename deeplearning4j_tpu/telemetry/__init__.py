@@ -272,10 +272,49 @@ def record_retry(op: str) -> None:
                      help="retries scheduled by RetryPolicy", op=op).inc()
 
 
-def record_resume() -> None:
-    """Count one TrainingSession resume from a snapshot."""
+def record_resume(scope: str = "job") -> None:
+    """Count one TrainingSession resume from a snapshot.
+    ``scope="job"`` = whole-process failure (preemption, injected step
+    fault, crash restart); ``scope="host"`` = one pod host died
+    (``HostDeathError`` at the ``pod.heartbeat`` site) and the whole
+    job resumed from the last distributed snapshot."""
     REGISTRY.counter("dl4j_resumes_total",
-                     help="training resumes from snapshot").inc()
+                     help="training resumes from snapshot",
+                     scope=scope).inc()
+
+
+def record_pod_hosts(n_hosts: int) -> None:
+    """Publish the pod shape (``dl4j_pod_hosts``) — how many hosts the
+    active snapshot/training topology spans (1 = single-host; an
+    emulated pod reports its emulated width)."""
+    REGISTRY.gauge("dl4j_pod_hosts",
+                   help="hosts in the active pod topology").set(n_hosts)
+
+
+def record_pod_shard(host: int, nbytes: int, seconds: float) -> None:
+    """One host's pod-snapshot shard written: per-host shard bytes
+    gauge + shard write-time histogram."""
+    REGISTRY.gauge("dl4j_pod_snapshot_shard_bytes",
+                   help="bytes in this host's newest snapshot shard",
+                   host=str(host)).set(nbytes)
+    REGISTRY.histogram("dl4j_pod_shard_write_seconds",
+                       help="per-host shard write time").observe(seconds)
+
+
+def record_pod_snapshot_seconds(seconds: float) -> None:
+    """One full distributed snapshot (all shards + manifests + the
+    coordinator commit) observed into ``dl4j_pod_snapshot_seconds``."""
+    REGISTRY.histogram("dl4j_pod_snapshot_seconds",
+                       help="distributed snapshot wall time").observe(
+        seconds)
+
+
+def record_pod_restore_seconds(seconds: float) -> None:
+    """One pod-snapshot restore (verify + aggregate + rebuild) observed
+    into ``dl4j_pod_restore_seconds``."""
+    REGISTRY.histogram("dl4j_pod_restore_seconds",
+                       help="distributed restore wall time").observe(
+        seconds)
 
 
 def record_fault_injected(site: str, action: str) -> None:

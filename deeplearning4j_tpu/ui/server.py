@@ -397,6 +397,14 @@ class UIServer:
         return ('<div class="chart"><h3>Serving platform '
                 f'(multi-tenant)</h3>{table}{counters}</div>')
 
+    def _pod_panel(self) -> str:
+        """Pod topology + distributed-snapshot metrics
+        (resilience.pod): host count, per-host shard bytes, snapshot /
+        restore duration quantiles, and the scoped resume counters —
+        rendered only once a pod session has recorded a series."""
+        return self._metric_table_panel("Pod (distributed snapshots)",
+                                        "dl4j_pod_")
+
     def _collectives_panel(self) -> str:
         """Collective-exchange metrics (comms.scheduler +
         parallel.compression): per-op bytes/launch counters, bucket
@@ -524,6 +532,7 @@ class UIServer:
             self._platform_panel(),
             self._collectives_panel(),
             self._sharding_panel(),
+            self._pod_panel(),
         ]) or "<p>No stats collected yet.</p>"
         refresh = (f"<meta http-equiv='refresh' content='{refresh_seconds}'>"
                    if refresh_seconds else "")

@@ -3,7 +3,6 @@ the 8-device CPU mesh, plus a REAL 2-process jax.distributed run over
 loopback (reference test strategy §4: PS/Spark tests run in-process over
 loopback Aeron / local[*] SparkContext)."""
 
-import functools
 import os
 import subprocess
 import sys
@@ -120,65 +119,14 @@ _WORKER = textwrap.dedent("""
 """)
 
 
-_PROBE = textwrap.dedent("""
-    import os, sys
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-    try:
-        from jax._src import xla_bridge as _xb
-        _xb._backend_factories.pop("axon", None)
-    except Exception:
-        pass
-    jax.distributed.initialize(coordinator_address="127.0.0.1:" + sys.argv[2],
-                               num_processes=2, process_id=int(sys.argv[1]))
-    import numpy as np
-    from jax.experimental import multihost_utils
-    multihost_utils.broadcast_one_to_all(np.ones(1, np.float32))
-    print("PROBE_OK")
-""")
-
-
-@functools.lru_cache(maxsize=None)
-def _cpu_multiprocess_supported() -> bool:
-    """Capability probe: can THIS jax/jaxlib run multi-process
-    computations on the CPU backend? Feature-probed with two real
-    loopback processes running the same ``broadcast_one_to_all`` the
-    distributed fit path needs — jaxlibs without cross-process CPU
-    collectives fail it with "Multiprocess computations aren't
-    implemented on the CPU backend"."""
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("PALLAS_AXON_POOL_IPS",)}
-    env["JAX_PLATFORMS"] = "cpu"
-    # ephemeral coordinator port: a collision would read as "unsupported"
-    import socket
-
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = str(s.getsockname()[1])
-    procs = []
-    try:
-        for i in range(2):
-            procs.append(subprocess.Popen(
-                [sys.executable, "-c", _PROBE, str(i), port],
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env))
-        outs = [p.communicate(timeout=120)[0].decode() for p in procs]
-    except Exception:
-        for p in procs:
-            p.kill()
-        return False
-    return all(p.returncode == 0 and "PROBE_OK" in o
-               for p, o in zip(procs, outs))
+# the N-process loopback probe + spawn machinery now lives in
+# tests/pod_harness.py (shared with the pod-scale-out suite)
+from tests import pod_harness
 
 
 def test_two_process_distributed_matches_single(tmp_path):
     """2 hosts x 4 devices == 1 host x 8 devices == the same math."""
-    if not _cpu_multiprocess_supported():
-        pytest.skip("this jax/jaxlib cannot run multi-process "
-                    "computations on the CPU backend (loopback "
-                    "collective probe failed)")
+    pod_harness.require_multiprocess(2)
     script = tmp_path / "worker.py"
     script.write_text(_WORKER.format(
         repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))

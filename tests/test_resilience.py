@@ -647,12 +647,12 @@ def test_killed_training_resumes_bit_identical(tmp_path, kill_at):
 
     sess = TrainingSession(_ckpt_net(), str(tmp_path),
                            snapshot_every_n_iterations=2)
-    before = counter_value("dl4j_resumes_total")
+    before = counter_value("dl4j_resumes_total", scope="job")
     plan = FaultPlan(seed=1).inject("train.step", on_calls=[kill_at])
     with plan.armed():
         sess.fit(_iterator(), epochs=2)
     assert plan.fired("train.step") == 1    # the kill was real
-    assert counter_value("dl4j_resumes_total") - before == 1
+    assert counter_value("dl4j_resumes_total", scope="job") - before == 1
     assert sess.model.epoch == 2
     np.testing.assert_array_equal(_flat(sess.model), ref_params)
     np.testing.assert_array_equal(_opt_flat(sess.model), ref_opt)

@@ -601,3 +601,87 @@ def test_zero_spec_roundtrip():
     for k in tree:
         np.testing.assert_array_equal(back[k], tree[k])
     assert z.bytes_per_device() == (2 + 1) * 4     # ceil(13/8)+ceil(6/8)
+
+
+# ---------------------------------------------------------------------------
+# pod-refactor parity: the make_array-based scatter/gather pinned BITWISE
+# against the legacy numpy round-trip at process_count == 1 (the pod
+# scale-out rebuilt these paths on jax.make_array_from_callback /
+# host_gather; every existing green path must be unperturbed)
+# ---------------------------------------------------------------------------
+
+def test_make_array_scatter_matches_legacy_device_put_bitwise():
+    """ZeroSpec.scatter_host now stages through mesh.stage_host
+    (make_array_from_callback on pods); at process_count == 1 the
+    arrays must be BITWISE the legacy jax.device_put staging, and
+    gather_host must be bitwise np.asarray."""
+    from jax.sharding import NamedSharding
+
+    tree = {"a": np.arange(13, dtype=np.float32),
+            "b": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    z = ZeroSpec(tree, 8)
+    mesh = mesh_mod.single_host_mesh()
+    new = z.scatter_host(tree, mesh, "data")
+    # the legacy route, re-implemented inline
+    sh = NamedSharding(mesh, P("data"))
+    leaves = jax.tree_util.tree_leaves(tree)
+    legacy = []
+    for leaf, padded, dt in zip(leaves, z.padded_sizes, z.dtypes):
+        flat = np.zeros((padded,), dt)
+        flat[:leaf.size] = np.asarray(leaf).reshape(-1)
+        legacy.append(jax.device_put(flat, sh))
+    for n, l in zip(jax.tree_util.tree_leaves(new), legacy):
+        assert n.sharding == l.sharding
+        np.testing.assert_array_equal(np.asarray(n), np.asarray(l))
+    # and the explicit make_array_from_callback staging agrees too
+    cb = jax.make_array_from_callback(
+        legacy[0].shape, sh,
+        lambda idx: np.asarray(legacy[0])[idx])
+    np.testing.assert_array_equal(np.asarray(cb), np.asarray(legacy[0]))
+    back = z.gather_host(new)
+    for k in tree:
+        np.testing.assert_array_equal(back[k], tree[k])
+
+
+def test_plan_place_parity_with_device_put():
+    """ShardingPlan.place (the comms.reshard host route) pinned bitwise
+    against direct device_put placement under the same shardings —
+    plan placement is one of the paths the pod refactor re-staged."""
+    mesh = mesh_mod.single_host_mesh(data=4, model=2)
+    plan = ShardingPlan([(r"W$", P(None, "model")), (r".*", P())],
+                        mesh=mesh, demote_indivisible=True)
+    params = _toy_params()
+    specs = plan.param_specs(params)
+    placed = plan.place(params, specs)
+    shardings = plan.shardings(specs)
+    legacy = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(np.asarray(x), s), params, shardings)
+    for a, b in zip(jax.tree_util.tree_leaves(placed),
+                    jax.tree_util.tree_leaves(legacy)):
+        assert a.sharding == b.sharding
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_zero_fit_checkpoint_roundtrip_parity(tmp_path):
+    """ZeRO fit -> mid-training write_model (gather-on-save through the
+    new host_gather) -> restore: bitwise the wrapper's live state. Pins
+    that the pod refactor's gather cannot silently perturb the
+    checkpoint path at process_count == 1."""
+    from deeplearning4j_tpu.util import params as params_util
+    from deeplearning4j_tpu.util.serializer import (
+        restore_multi_layer_network,
+        write_model,
+    )
+
+    net = MultiLayerNetwork(_conf()).init()
+    pw = ParallelWrapper(net, workers=8, zero_optimizer=True)
+    x, y = _data(32)
+    pw.fit(ArrayDataSetIterator(x, y, batch=16), epochs=1)
+    path = os.path.join(str(tmp_path), "zero.zip")
+    write_model(net, path)
+    restored = restore_multi_layer_network(path)
+    np.testing.assert_array_equal(np.asarray(restored.params_flat()),
+                                  np.asarray(net.params_flat()))
+    np.testing.assert_array_equal(
+        np.asarray(params_util.flatten_state_like(restored.opt_state)),
+        np.asarray(params_util.flatten_state_like(net.opt_state)))
