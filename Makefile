@@ -102,6 +102,18 @@ pod-smoke:
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	$(PY) -m pytest tests -q -m pod -p no:cacheprovider
 
+.PHONY: kernels-smoke
+# Pallas kernel-subsystem smoke: registry parity against the XLA
+# references (interpret mode), autotuner + digest-verified tuning
+# cache (corruption refusal, cross-process persistence), off-by-default
+# bitwise pin, fallback zero-recompile churn, PRG207 + donation audit
+# on kernel-bearing steps — then the in-process A/B bench asserting
+# parity and zero recompiles after warmup for both modes.
+kernels-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests -q -m kernels \
+		-p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) bench_conv_matrix.py --kernels --smoke
+
 .PHONY: lint
 # Repo-discipline source lint (analysis/source.py AST rules): host syncs
 # in compiled functions, lock discipline on shared registries, wall-clock/

@@ -242,6 +242,102 @@ def child_layout(batch=256, chain=24):
     print(json.dumps(results))
 
 
+def child_kernels(batch=8, img=8, steps=8, out_path=None, smoke=False):
+    """Pallas kernel-registry A/B on a small fused-conv net (ROADMAP
+    item 5): autotune every routable envelope at this batch, then train
+    FRESH nets per mode — stock XLA vs ``use_kernels`` — on the same
+    stream, reporting img/s per mode, recompiles-after-warmup (asserted
+    0 for both), and the final-params max |delta| (the parity record).
+
+    Sized for the CPU proxy: off-TPU the kernels execute through the
+    Pallas INTERPRETER, so the kernels-mode img/s measures the
+    interpreter, not the MXU — the committed JSON records parity, the
+    zero-recompile contract, and the autotuner machinery; speed claims
+    need the TPU backend (docs/kernels.md states the caveat)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import kernels as kern
+    from deeplearning4j_tpu.conf import inputs as it
+    from deeplearning4j_tpu.conf.activations import Activation
+    from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.conf.layers_cnn import FusedConvBN1x1
+    from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+    from deeplearning4j_tpu.conf.updaters import Adam
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize import aot_cache
+
+    def conf(use_k):
+        b = NeuralNetConfiguration.builder().seed(42).updater(
+            Adam(learning_rate=1e-3))
+        if use_k:
+            b = b.use_kernels()
+        return (b.list()
+                .layer(FusedConvBN1x1(n_out=16,
+                                      activation=Activation.RELU))
+                .layer(FusedConvBN1x1(n_out=16,
+                                      activation=Activation.RELU))
+                .layer(DenseLayer(n_out=32, activation=Activation.RELU))
+                .layer(OutputLayer(n_out=10))
+                .set_input_type(it.Convolutional(img, img, 8))
+                .build())
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(batch, img, img, 8)).astype(np.float32)
+    Y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
+
+    results = {"backend": kern.capability(), "batch": batch, "img": img,
+               "steps": steps}
+    t0 = time.perf_counter()
+    tuned = kern.autotune_model(conf(True), batch, max_candidates=8)
+    results["autotune_s"] = round(time.perf_counter() - t0, 2)
+    results["tuned_envelopes"] = len(tuned)
+    results["winners"] = {r.env_key: list(r.tiling) for r in tuned}
+
+    def run(use_k, label):
+        net = MultiLayerNetwork(conf(use_k)).init()
+        ds = DataSet(X.copy(), Y.copy())
+        net.fit_batch(ds)  # compile + settle
+        net.fit_batch(ds)
+        miss0 = aot_cache.stats()["misses"]
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            net._fit_batch_async(ds)
+        _ = float(net.score_value)
+        wall = time.perf_counter() - t0
+        results[f"img_per_sec_{label}"] = round(steps * batch / wall, 1)
+        results[f"recompiles_after_warmup_{label}"] = (
+            aot_cache.stats()["misses"] - miss0)
+        return net
+
+    net_a = run(False, "xla")
+    net_b = run(True, "kernels")
+    results["params_max_delta"] = max(
+        float(jnp.max(jnp.abs(jnp.asarray(a, jnp.float32)
+                              - jnp.asarray(b, jnp.float32))))
+        for a, b in zip(jax.tree_util.tree_leaves(net_a.params),
+                        jax.tree_util.tree_leaves(net_b.params)))
+    results["note"] = (
+        "CPU proxy: kernels ran through the Pallas interpreter — "
+        "img_per_sec_kernels measures the interpreter, not the MXU; "
+        "the record here is parity + zero recompiles + the tuned "
+        "winner set. Re-run on a TPU backend for speed."
+        if results["backend"] != "tpu" else
+        "TPU backend: real Mosaic lowering.")
+    assert results["recompiles_after_warmup_xla"] == 0, results
+    assert results["recompiles_after_warmup_kernels"] == 0, results
+    if smoke:
+        assert results["tuned_envelopes"] >= 2, results
+        assert results["params_max_delta"] < 1e-3, results
+    blob = json.dumps(results, indent=1)
+    print(blob)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(blob + "\n")
+        print(f"# wrote {out_path}", file=sys.stderr)
+
+
 CELLS = [
     # (cell name, kind, batch, extra XLA flags)
     ("b128", "train", 128, ""),
@@ -269,7 +365,24 @@ def main():
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--cells", default="",
                     help="comma-separated subset of cell names")
+    ap.add_argument("--kernels", action="store_true",
+                    help="in-process Pallas kernel-registry A/B "
+                         "(stock XLA vs use_kernels, fresh nets per "
+                         "mode; CPU-proxy sized — see child_kernels)")
+    ap.add_argument("--kernels-batch", type=int, default=8)
+    ap.add_argument("--kernels-img", type=int, default=8)
+    ap.add_argument("--kernels-steps", type=int, default=8)
+    ap.add_argument("--smoke", action="store_true",
+                    help="with --kernels: assert parity + tuned "
+                         "envelopes (make kernels-smoke)")
+    ap.add_argument("--out", default="",
+                    help="with --kernels: also write the JSON here")
     args = ap.parse_args()
+    if args.kernels:
+        child_kernels(args.kernels_batch, args.kernels_img,
+                      args.kernels_steps, out_path=args.out or None,
+                      smoke=args.smoke)
+        return
     if args.child == "train":
         child_train(args.batch)
         return

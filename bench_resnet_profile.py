@@ -104,6 +104,16 @@ def main():
                          "so train_step / --phases rows measure the "
                          "guarded step — compare against a run without "
                          "the flag for the guard overhead (<5%% target)")
+    ap.add_argument("--kernels", action="store_true",
+                    help="Pallas kernel-registry A/B: autotune every "
+                         "routable envelope at this batch, then train "
+                         "fresh nets through stock XLA and the "
+                         "use_kernels path, reporting img/s per mode, "
+                         "recompiles after warmup (must be 0), and the "
+                         "final-params max |delta|. Off-TPU the kernels "
+                         "run via the Pallas interpreter — correctness "
+                         "proxy only, not a speed measurement "
+                         "(docs/kernels.md)")
     args = ap.parse_args()
     batch = args.batch
     img = int(args.img)
@@ -147,6 +157,53 @@ def main():
         rts.append((time.perf_counter() - t0) * 1000.0)
     _RT_MS[0] = min(rts)
     rows = {"null_roundtrip": _RT_MS[0]}
+
+    # ---- Pallas kernel-registry A/B (ROADMAP item 5) ---------------------
+    if args.kernels:
+        from deeplearning4j_tpu import kernels as kern
+        from deeplearning4j_tpu.datasets.dataset import DataSet as _DS
+        from deeplearning4j_tpu.optimize import aot_cache
+
+        n_steps = 6
+        cfg_on = dataclasses.replace(cfg, use_kernels=True)
+        tuned = kern.autotune_model(cfg_on, batch, max_candidates=8)
+        rows["kernels_tuned_envelopes"] = len(tuned)
+        print(f"# kernels backend={kern.capability()} "
+              f"tuned={len(tuned)} envelopes")
+
+        def run(cfgx, label):
+            netx = ComputationGraph(cfgx).init()  # fresh net per mode
+            ds = _DS(np.asarray(x), np.asarray(y))
+            netx.fit_batch(ds)  # compile + settle
+            netx.fit_batch(ds)
+            miss0 = aot_cache.stats()["misses"]
+            t0 = time.perf_counter()
+            for _ in range(n_steps):
+                netx._fit_batch_async(ds)
+            _ = float(netx.score_value)
+            wall = time.perf_counter() - t0
+            rows[f"imgs_per_sec_{label}"] = n_steps * batch / wall
+            rows[f"recompiles_after_warmup_{label}"] = (
+                aot_cache.stats()["misses"] - miss0)
+            return netx
+
+        net_a = run(cfg, "xla")
+        net_b = run(cfg_on, "kernels")
+        rows["kernels_params_max_delta"] = max(
+            float(jnp.max(jnp.abs(jnp.asarray(a, jnp.float32)
+                                  - jnp.asarray(b, jnp.float32))))
+            for a, b in zip(jax.tree_util.tree_leaves(net_a.params),
+                            jax.tree_util.tree_leaves(net_b.params)))
+        assert rows["recompiles_after_warmup_xla"] == 0
+        assert rows["recompiles_after_warmup_kernels"] == 0
+        if args.json:
+            print(json.dumps({kk: round(v, 4) for kk, v in rows.items()}))
+            return
+        print(f"\nResNet-50 batch {batch} kernel-registry A/B "
+              f"({n_steps} steps/mode)\n")
+        for kk, v in rows.items():
+            print(f"{kk:>32} {v:>10.4f}")
+        return
 
     # ---- K-step fused A/B (round 11): host gap per step, before/after ----
     if args.fused_steps:

@@ -408,6 +408,54 @@ def _near_miss(sig_a, sig_b) -> Optional[str]:
     return "; ".join(reasons) if reasons else None
 
 
+# kern:<id>:<digest> tokens minted by kernels.cache_tag() into
+# use_kernels step keys — the kernel-registry audit's input
+_KERNEL_TOKEN_RE = re.compile(r"kern:([A-Za-z0-9_]+):([0-9a-f]{8})")
+
+
+def _rule_kernel_registry(art: ProgramArtifact,
+                          out: List[Finding]) -> None:
+    """PRG207: executables whose key carries ``kern:<id>:<digest>``
+    tokens promised to route through the Pallas kernel registry —
+    (a) an id that does not resolve in the registry means the
+    executable was keyed against kernels this process cannot audit
+    (ERROR); (b) a key-time tuning digest that mismatches the
+    registry's CURRENT winner table means the executable bakes a
+    stale/unknown tuned layout — a retune is supposed to mint a NEW
+    key, so a mismatch is a dispatch of an unverified kernel (ERROR).
+    PRG201 applies unchanged to kernel-bearing train kinds (the token
+    is a suffix; the kind prefix still classifies)."""
+    tokens = _KERNEL_TOKEN_RE.findall(art.fn_key)
+    if not tokens:
+        return
+    try:
+        from deeplearning4j_tpu import kernels as kmod
+    except Exception:
+        out.append(Finding(
+            rule="PRG207", severity=ERROR, location=art.location,
+            message="step key carries kern:<id>:<digest> tokens but the "
+                    "kernel registry is unavailable — the executable "
+                    "cannot be audited"))
+        return
+    for kid, digest in tokens:
+        if kmod.REGISTRY.get(kid) is None:
+            out.append(Finding(
+                rule="PRG207", severity=ERROR, location=art.location,
+                message=f"key token kern:{kid}:{digest} does not resolve "
+                        f"through the kernel registry (known kernels: "
+                        f"{', '.join(kmod.REGISTRY.ids()) or 'none'})"))
+            continue
+        current = kmod.tuning_digest(kid)
+        if digest != current:
+            out.append(Finding(
+                rule="PRG207", severity=ERROR, location=art.location,
+                message=f"key-time tuning digest {digest} for kernel "
+                        f"{kid!r} mismatches the registry's current "
+                        f"winner table ({current}) — stale executable "
+                        f"vs a retune; rebuild the step so the key "
+                        f"re-mints"))
+
+
 def _rule_recompile_hazard(art: ProgramArtifact,
                            out: List[Finding]) -> None:
     """PRG206: this miss differs from an already-cached signature only
@@ -433,6 +481,7 @@ _RULES = (
     _rule_dtype_promotion,
     _rule_host_callback,
     _rule_collectives,
+    _rule_kernel_registry,
     _rule_recompile_hazard,
 )
 

@@ -520,6 +520,9 @@ class ComputationGraphConfiguration:
     dtype: str = "float32"
     # mixed-precision compute dtype (see MultiLayerConfiguration.compute_dtype)
     compute_dtype: Optional[str] = None
+    # Pallas kernel-registry routing (see
+    # MultiLayerConfiguration.use_kernels; default OFF = unchanged)
+    use_kernels: bool = False
 
     def to_json(self) -> str:
         return serde.to_json(self)
@@ -661,6 +664,7 @@ class GraphBuilder:
             tbptt_back_length=self._tbptt_back,
             dtype=self._base._dtype,
             compute_dtype=self._base._compute_dtype,
+            use_kernels=self._base._use_kernels,
         )
         if self._input_types:
             _insert_graph_preprocessors(conf)

@@ -66,6 +66,13 @@ class MultiLayerConfiguration:
     # (jax.checkpoint) — trades FLOPs for HBM, no reference analog (the
     # reference's workspaces manage allocator churn, not liveness)
     gradient_checkpointing: bool = False
+    # route conv/dense forwards through the Pallas kernel registry
+    # (deeplearning4j_tpu/kernels/) when a TUNED kernel covers the
+    # concrete shape; untuned/unsupported shapes run stock XLA
+    # unchanged. Default OFF = bit-identical to no subsystem at all
+    # (the step cache keys only gain kern:<id>:<digest> tokens when
+    # this is on). See docs/kernels.md.
+    use_kernels: bool = False
 
     def to_json(self) -> str:
         return serde.to_json(self)
@@ -118,6 +125,7 @@ class Builder:
         self._dropout: Optional[float] = None
         self._dtype = "float32"
         self._compute_dtype: Optional[str] = None
+        self._use_kernels = False
 
     def seed(self, s: int) -> "Builder":
         self._seed = int(s)
@@ -155,6 +163,13 @@ class Builder:
         """Mixed-precision compute dtype (usually "bfloat16"); params and
         optimizer state stay in ``dtype``. See MultiLayerConfiguration."""
         self._compute_dtype = dt
+        return self
+
+    def use_kernels(self, enabled: bool = True) -> "Builder":
+        """Route conv/dense forwards through the Pallas kernel registry
+        (``deeplearning4j_tpu.kernels``) where a tuned kernel covers the
+        shape. See MultiLayerConfiguration.use_kernels."""
+        self._use_kernels = bool(enabled)
         return self
 
     def list(self) -> "ListBuilder":
@@ -221,6 +236,7 @@ class ListBuilder:
             tbptt_back_length=self._tbptt_back,
             dtype=self._base._dtype,
             compute_dtype=self._base._compute_dtype,
+            use_kernels=self._base._use_kernels,
         )
 
     def _apply_defaults(self, layer: Layer) -> Layer:

@@ -1,0 +1,302 @@
+"""Kernel registry: named Pallas kernels, envelopes, tuned selection.
+
+Each registered :class:`Kernel` declares
+
+- a **shape/dtype envelope** (``supports(env)``) — the exact set of
+  concrete problems its grid can cover; anything outside routes to
+  stock XLA with zero behavior change;
+- a **tiling/grid parameter space** (``candidates(env)``) the autotuner
+  (``kernels.tuner``) sweeps per concrete ``(shape, dtype, backend)``;
+- a **reference implementation** (``reference(env)``) — the ``jax.lax``
+  path it must match numerically (the parity tests pin every kernel
+  against it in interpret mode);
+- the **builder** (``build(env, tiling)``) producing the Pallas
+  callable for one tuned layout.
+
+Selection (:meth:`KernelRegistry.select`) is a pure tuning-cache
+lookup: only a TUNED envelope gets a kernel — an untuned shape is a
+recorded fallback, never a guess. The per-kernel **tuning digest**
+(8-hex over the winner table + kernel version, epoch-memoized) is what
+the model step keys fold in as ``kern:<id>:<digest>`` tokens, so a
+retune re-keys every kernel-bearing executable (PRG207 audits the
+tokens against this registry).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.kernels import impls, tuner
+
+# candidate block sweeps (clamped per-problem, deduped by effective
+# tiling): sublane-multiples for rows, lane-width favorites for
+# columns/contraction — the guide's (8/16, 128) tile floors
+_BM_SWEEP = (512, 256, 128, 64, 32, 16, 8)
+_BN_SWEEP = (256, 128, 64, 32, 16, 8)
+_BK_SWEEP = (512, 256, 128, 64, 32, 16, 8)
+
+_SUPPORTED_DTYPES = ("float32", "bfloat16")
+
+
+@dataclasses.dataclass(frozen=True)
+class MatmulEnvelope:
+    """One concrete matmul-class problem: [M, K] @ [K, N] in ``dtype``
+    on ``backend`` ("tpu" = real Mosaic lowering, "interpret" = the
+    Pallas interpreter — this container's mode), with an optional
+    elementwise activation baked in the epilogue."""
+
+    m: int
+    k: int
+    n: int
+    dtype: str
+    backend: str
+    act: str = "identity"
+
+    @property
+    def key(self) -> str:
+        return (f"{self.backend}:m{self.m}:k{self.k}:n{self.n}"
+                f":{self.dtype}:{self.act}")
+
+    @property
+    def shape_bucket(self) -> str:
+        """The telemetry label: shape class without backend/act noise."""
+        return f"m{self.m}_k{self.k}_n{self.n}"
+
+
+def _sweep_candidates(env: MatmulEnvelope,
+                      limit: Optional[int]) -> List[Tuple[int, int, int]]:
+    seen, out = set(), []
+    for bm in _BM_SWEEP:
+        for bn in _BN_SWEEP:
+            for bk in _BK_SWEEP:
+                t = (bm, bn, bk)
+                eff = impls.effective_tiling(env.m, env.k, env.n, t)
+                if eff in seen or not impls.tiling_valid(
+                        env.m, env.k, env.n, t):
+                    continue
+                seen.add(eff)
+                out.append(eff)
+    # prefer big MXU-shaped tiles first so a capped sweep still sees
+    # the plausible winners
+    out.sort(key=lambda t: (-(t[0] * t[1]), -t[2]))
+    return out[:limit] if limit else out
+
+
+def _matmul_supports(env) -> bool:
+    return (impls.has_pallas()
+            and env.dtype in _SUPPORTED_DTYPES
+            and env.m > 0 and env.k > 0 and env.n > 0
+            and bool(_sweep_candidates(env, limit=1)))
+
+
+def _activation(name: str):
+    from deeplearning4j_tpu.conf.activations import Activation
+
+    return Activation(name)
+
+
+def _rand_inputs(env: MatmulEnvelope, seed: int, with_bias: bool):
+    import jax
+    import jax.numpy as jnp
+
+    kx, kw, kb = jax.random.split(jax.random.PRNGKey(seed), 3)
+    dt = jnp.dtype(env.dtype)
+    x = jax.random.normal(kx, (env.m, env.k), jnp.float32).astype(dt)
+    w = jax.random.normal(kw, (env.k, env.n), jnp.float32).astype(dt)
+    if not with_bias:
+        return x, w
+    b = jax.random.normal(kb, (env.n,), jnp.float32).astype(dt)
+    return x, w, b
+
+
+class Kernel:
+    """Base registry entry. ``version`` participates in the tuning
+    digest, so a kernel-body change invalidates every cached executable
+    keyed on the old behavior."""
+
+    kernel_id: str = ""
+    version: int = 1
+
+    def supports(self, env) -> bool:
+        raise NotImplementedError
+
+    def candidates(self, env, limit: Optional[int] = None):
+        raise NotImplementedError
+
+    def build(self, env, tiling):
+        """-> callable over :meth:`make_inputs`-shaped args running the
+        Pallas path with ``tiling``."""
+        raise NotImplementedError
+
+    def reference(self, env):
+        """-> callable over the same args running the stock ``jax.lax``
+        path this kernel must match."""
+        raise NotImplementedError
+
+    def make_inputs(self, env, seed: int = 0):
+        raise NotImplementedError
+
+
+class MatmulBiasActKernel(Kernel):
+    """Tiled matmul + bias + elementwise activation in one pass — the
+    dense / 1x1-conv forward class (``impls.matmul_bias_act``)."""
+
+    kernel_id = "matmul_bias_act"
+    version = 1
+
+    def supports(self, env) -> bool:
+        return _matmul_supports(env)
+
+    def candidates(self, env, limit: Optional[int] = None):
+        return _sweep_candidates(env, limit)
+
+    def build(self, env, tiling):
+        act = _activation(env.act)
+        interpret = env.backend != "tpu"
+        tiling = tuple(tiling)
+
+        def fn(x, w, b):
+            return impls.matmul_bias_act(x, w, b, act, tiling, interpret)
+
+        return fn
+
+    def reference(self, env):
+        act = _activation(env.act)
+
+        def ref(x, w, b):
+            return act.apply(x @ w + b)
+
+        return ref
+
+    def make_inputs(self, env, seed: int = 0):
+        return _rand_inputs(env, seed, with_bias=True)
+
+
+class ConvBnActKernel(Kernel):
+    """Fused 1x1-conv + batch-norm statistics — the dominant trace
+    fusion class (round-2 ``ops/conv_fused`` experiment): the matmul
+    emits y AND the per-channel sum / sum-of-squares in one output
+    pass, so the train-mode BN statistics re-read of the activation
+    disappears (normalize + activation stay in XLA where they fuse
+    with whatever follows)."""
+
+    kernel_id = "conv_bn_act"
+    version = 1
+
+    def supports(self, env) -> bool:
+        return _matmul_supports(env)
+
+    def candidates(self, env, limit: Optional[int] = None):
+        return _sweep_candidates(env, limit)
+
+    def build(self, env, tiling):
+        interpret = env.backend != "tpu"
+        tiling = tuple(tiling)
+
+        def fn(x, w):
+            return impls.matmul_stats(x, w, tiling, interpret)
+
+        return fn
+
+    def reference(self, env):
+        import jax.numpy as jnp
+
+        def ref(x, w):
+            y = x @ w
+            y32 = y.astype(jnp.float32)
+            return y, jnp.sum(y32, axis=0), jnp.sum(y32 * y32, axis=0)
+
+        return ref
+
+    def make_inputs(self, env, seed: int = 0):
+        return _rand_inputs(env, seed, with_bias=False)
+
+
+@dataclasses.dataclass(frozen=True)
+class Selection:
+    """One resolved routing decision."""
+
+    kernel: Kernel
+    env: object
+    tiling: Tuple[int, int, int]
+
+
+class KernelRegistry:
+    """Process-global name -> :class:`Kernel` table + tuned selection
+    + epoch-memoized tuning digests."""
+
+    def __init__(self, cache: Optional[tuner.TuningCache] = None):
+        self._kernels: Dict[str, Kernel] = {}
+        self._cache = cache if cache is not None else tuner.TUNING
+        self._digests: Dict[str, Tuple[int, str]] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def tuning(self) -> tuner.TuningCache:
+        return self._cache
+
+    def register(self, kernel: Kernel) -> Kernel:
+        with self._lock:
+            self._kernels[kernel.kernel_id] = kernel
+            self._digests.pop(kernel.kernel_id, None)
+        return kernel
+
+    def get(self, kernel_id: str) -> Optional[Kernel]:
+        return self._kernels.get(kernel_id)
+
+    def ids(self) -> List[str]:
+        return sorted(self._kernels)
+
+    def select(self, kernel_id: str, env) -> Optional[Selection]:
+        """The tuned kernel for one envelope, or None (untuned /
+        unsupported / winner no longer legal) — None means stock XLA."""
+        kernel = self._kernels.get(kernel_id)
+        if kernel is None or not kernel.supports(env):
+            return None
+        win = self._cache.winner(kernel_id, env.key)
+        if win is None:
+            return None
+        tiling = tuple(int(t) for t in win.get("tiling", ()))
+        if len(tiling) != 3 or not impls.tiling_valid(
+                env.m, env.k, env.n, tiling):
+            # a hand-edited / cross-version winner that no longer covers
+            # the problem: refuse it, fall back to stock XLA
+            return None
+        return Selection(kernel=kernel, env=env, tiling=tiling)
+
+    def tuning_digest(self, kernel_id: str) -> str:
+        """8-hex digest over the kernel's current winner table (+ its
+        version); memoized against the tuning-cache epoch so the
+        per-step re-key check stays two dict lookups."""
+        epoch = self._cache.epoch
+        with self._lock:
+            memo = self._digests.get(kernel_id)
+            if memo is not None and memo[0] == epoch:
+                return memo[1]
+        kernel = self._kernels.get(kernel_id)
+        payload = {
+            "version": getattr(kernel, "version", 0),
+            "winners": self._cache.winners(kernel_id),
+        }
+        d = hashlib.sha256(
+            json.dumps(payload, sort_keys=True,
+                       separators=(",", ":")).encode()).hexdigest()[:8]
+        with self._lock:
+            self._digests[kernel_id] = (epoch, d)
+        return d
+
+    def cache_tag(self) -> str:
+        """The ``:kern:<id>:<digest>`` token string step keys fold in —
+        one token per registered kernel, so retuning ANY kernel mints
+        new executables for every kernel-enabled step."""
+        return "".join(f":kern:{kid}:{self.tuning_digest(kid)}"
+                       for kid in self.ids())
+
+
+REGISTRY = KernelRegistry()
+REGISTRY.register(MatmulBiasActKernel())
+REGISTRY.register(ConvBnActKernel())

@@ -129,6 +129,16 @@ class ComputationGraph(nn_io.LazyScoreMixin):
                 self.conf, fallback=self)
         return self._graph_key_cache
 
+    def _ktag(self) -> str:
+        """Kernel-registry step-key tokens (``kernels.cache_tag``;
+        empty unless ``conf.use_kernels`` — see MultiLayerNetwork._ktag
+        for the re-key contract)."""
+        if not getattr(self.conf, "use_kernels", False):
+            return ""
+        from deeplearning4j_tpu import kernels
+
+        return kernels.cache_tag(self.conf)
+
     # --- functional core ---------------------------------------------------
     def _forward(self, params, state, inputs: Sequence, train: bool, rng,
                  skip=frozenset(), fmasks=None, carries=None):
@@ -165,7 +175,20 @@ class ComputationGraph(nn_io.LazyScoreMixin):
             vrng = jax.random.fold_in(rng, i) if rng is not None else None
             kw = ({"mask": mask} if mask is not None
                   and isinstance(spec.vertex, LayerVertex) else {})
-            if carries is not None \
+            routed = None
+            if getattr(self.conf, "use_kernels", False) \
+                    and (carries is None
+                         or not getattr(spec.vertex, "has_carry", False)):
+                # kernel-registry routing (conf.use_kernels): a TUNED
+                # Pallas kernel covering the wrapped layer's concrete
+                # shapes replaces the vertex forward; None = stock XLA
+                from deeplearning4j_tpu import kernels as _kernels
+
+                routed = _kernels.maybe_vertex_forward(
+                    spec.vertex, p, s, xs, train=train, rng=vrng, **kw)
+            if routed is not None:
+                y, s2 = routed
+            elif carries is not None \
                     and getattr(spec.vertex, "has_carry", False) \
                     and not _is_go_backwards(spec.vertex):
                 c = carries.get(name)
@@ -510,7 +533,8 @@ class ComputationGraph(nn_io.LazyScoreMixin):
 
         mode = health.graph_mode()
         if self._train_step is None \
-                or getattr(self, "_train_step_mode", "") != mode:
+                or getattr(self, "_train_step_mode", "") != mode \
+                or getattr(self, "_train_step_ktag", "") != self._ktag():
             raw = self.train_step_fn(guards=mode)
             dtype = self._dtype
 
@@ -530,10 +554,12 @@ class ComputationGraph(nn_io.LazyScoreMixin):
                     return new_p, new_s, new_o, loss, itc + 1, out[4]
                 return new_p, new_s, new_o, loss, itc + 1
 
+            self._train_step_ktag = self._ktag()
             self._train_step = aot_cache.wrap(
                 jax.jit(step, donate_argnums=(0, 1, 2, 7)),
                 self._graph_key(),
-                f"train_step:d012+itc{health.cache_tag()}")
+                f"train_step:d012+itc{health.cache_tag()}"
+                f"{self._train_step_ktag}")
             self._train_step_mode = mode
             self._guard_keys = health.bucket_keys(self.params or {})
         with telemetry.span(telemetry.PHASE_INGEST):
@@ -824,18 +850,19 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         features = (faults.fault_point("train.step", features[0]),
                     ) + tuple(features[1:])
         mode = health.graph_mode()
+        ktag = self._ktag()
         if self._fused_scan is None:
             self._fused_scan = {}
-        if (k, mode) not in self._fused_scan:
-            self._fused_scan[k, mode] = aot_cache.wrap(
+        if (k, mode, ktag) not in self._fused_scan:
+            self._fused_scan[k, mode, ktag] = aot_cache.wrap(
                 jax.jit(self.fused_scan_fn(k, guards=mode),
                         donate_argnums=(0, 1, 2, 7)),
                 self._graph_key(),
-                f"fused_scan:{k}:d0127{health.cache_tag()}")
+                f"fused_scan:{k}:d0127{health.cache_tag()}{ktag}")
         gvecs = None
         with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
             telemetry.host_gap_close(k)
-            out = self._fused_scan[k, mode](
+            out = self._fused_scan[k, mode, ktag](
                 self.params, self.state, self.opt_state, features, labels,
                 fmasks, lmasks, self.device_iteration(),
                 self.device_epoch(), self._base_key)
@@ -937,17 +964,18 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         # cache keyed by (seg, back, health mode): a conf length (or
         # guard-mode) change between fits must not silently reuse a
         # closure compiled for the old configuration
+        ktag = self._ktag()
         if self._tbptt_scan is None:
             self._tbptt_scan = {}
-        if (seg, back, mode) not in self._tbptt_scan:
-            self._tbptt_scan[seg, back, mode] = aot_cache.wrap(
+        if (seg, back, mode, ktag) not in self._tbptt_scan:
+            self._tbptt_scan[seg, back, mode, ktag] = aot_cache.wrap(
                 jax.jit(self.tbptt_scan_fn(seg, back, guards=mode),
                         donate_argnums=(0, 1, 2)),
                 self._graph_key(),
-                f"tbptt_scan:{seg}:{back}:d012{health.cache_tag()}")
+                f"tbptt_scan:{seg}:{back}:d012{health.cache_tag()}{ktag}")
         gvec = None
         with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
-            out = self._tbptt_scan[seg, back, mode](
+            out = self._tbptt_scan[seg, back, mode, ktag](
                 self.params, self.state, self.opt_state, features, labels,
                 fmasks, lmasks, self.device_iteration(),
                 self.device_epoch(), self._base_key)
@@ -1069,7 +1097,8 @@ class ComputationGraph(nn_io.LazyScoreMixin):
         ``#output(INDArray[], INDArray[] featureMasks, ...)``)."""
         if self.params is None:
             self.init()
-        if self._output_fn is None:
+        if self._output_fn is None \
+                or getattr(self, "_output_ktag", "") != self._ktag():
             def out(params, state, xs, fmasks):
                 xs = tuple(self._dequant(x, i) for i, x in enumerate(xs))
                 params, xs = self._fwd_cast(params, xs, full=True)
@@ -1078,8 +1107,10 @@ class ComputationGraph(nn_io.LazyScoreMixin):
                 return tuple(acts[n].astype(self._dtype)
                              for n in self.conf.network_outputs)
 
-            self._output_fn = aot_cache.wrap(jax.jit(out),
-                                             self._graph_key(), "output")
+            self._output_ktag = self._ktag()
+            self._output_fn = aot_cache.wrap(
+                jax.jit(out), self._graph_key(),
+                f"output{self._output_ktag}")
         # jax.Arrays pass through (keeps committed shardings); uint8
         # features dequantize inside the jit, matching training
         xs = tuple(nn_io.as_device(x, self._dtype, feature=True)
@@ -1095,14 +1126,17 @@ class ComputationGraph(nn_io.LazyScoreMixin):
             return self.score_value
         if self.params is None:
             self.init()
-        if self._score_fn is None:
+        if self._score_fn is None \
+                or getattr(self, "_score_ktag", "") != self._ktag():
             def score(params, state, features, labels, fmasks, lmasks):
                 loss, _ = self._loss(params, state, features, labels,
                                      fmasks, lmasks, rng=None, train=False)
                 return loss
 
-            self._score_fn = aot_cache.wrap(jax.jit(score),
-                                            self._graph_key(), "score")
+            self._score_ktag = self._ktag()
+            self._score_fn = aot_cache.wrap(
+                jax.jit(score), self._graph_key(),
+                f"score{self._score_ktag}")
         features, labels, fmasks, lmasks = self._prep_batch(ds)
         return float(self._score_fn(self.params, self.state, features,
                                     labels, fmasks, lmasks))

@@ -141,6 +141,18 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
                 self.conf, fallback=self)
         return self._graph_key_cache
 
+    def _ktag(self) -> str:
+        """Kernel-registry step-key tokens (``kernels.cache_tag``):
+        empty unless ``conf.use_kernels`` — every pre-subsystem key is
+        unchanged — else ``:kern:<id>:<digest>`` per kernel, so a
+        RETUNED kernel re-keys (and re-traces) the step instead of
+        silently dispatching the stale layout."""
+        if not getattr(self.conf, "use_kernels", False):
+            return ""
+        from deeplearning4j_tpu import kernels
+
+        return kernels.cache_tag(self.conf)
+
     # --- functional core ---------------------------------------------------
     def _forward(self, params, state, x, train: bool, rng, fmask=None,
                  upto: int = None, carries=None):
@@ -159,6 +171,9 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         n = len(self.conf.layers) if upto is None else upto
         new_state, new_carries = {}, {}
         remat = bool(getattr(self.conf, "gradient_checkpointing", False))
+        use_k = bool(getattr(self.conf, "use_kernels", False))
+        if use_k:
+            from deeplearning4j_tpu import kernels as _kernels
         for i in range(n):
             layer = self.conf.layers[i]
             p = params.get(str(i), {})
@@ -176,7 +191,15 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
                 if str(i) in state:
                     new_state[str(i)] = s
             else:
-                if remat and layer.has_params():
+                # kernel-registry routing (conf.use_kernels): a TUNED
+                # Pallas kernel covering this layer's concrete shapes
+                # replaces the stock forward; None = stock XLA unchanged
+                routed = (_kernels.maybe_forward(
+                    layer, p, s, x, train=train, rng=lrng, **kw)
+                    if use_k else None)
+                if routed is not None:
+                    x, s2 = routed
+                elif remat and layer.has_params():
                     def fwd(p, s, x, _layer=layer, _rng=lrng, _kw=kw):
                         return _layer.forward(p, s, x, train=train,
                                               rng=_rng, **_kw)
@@ -362,10 +385,13 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
             return new_p, new_s, new_o, loss, itc + 1
 
         self._train_step_mode = mode
+        self._train_step_ktag = self._ktag()
         self._guard_keys = health.bucket_keys(self.params or {})
         return aot_cache.wrap(
             jax.jit(step, donate_argnums=(0, 1, 2, 7)),
-            self._graph_key(), f"train_step:d012+itc{health.cache_tag()}")
+            self._graph_key(),
+            f"train_step:d012+itc{health.cache_tag()}"
+            f"{self._train_step_ktag}")
 
     def _build_output_fn(self):
         def out(params, state, x, fmask):
@@ -375,7 +401,9 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
                                     train=False, rng=None, fmask=fmask)
             return y.astype(self._dtype)
 
-        return aot_cache.wrap(jax.jit(out), self._graph_key(), "output")
+        self._output_ktag = self._ktag()
+        return aot_cache.wrap(jax.jit(out), self._graph_key(),
+                              f"output{self._output_ktag}")
 
     def _build_rnn_step_fn(self):
         def out(params, state, carries, x, fmask):
@@ -398,7 +426,9 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
                                  lmask, rng=None, train=False)
             return loss
 
-        return aot_cache.wrap(jax.jit(score), self._graph_key(), "score")
+        self._score_ktag = self._ktag()
+        return aot_cache.wrap(jax.jit(score), self._graph_key(),
+                              f"score{self._score_ktag}")
 
     # --- training ----------------------------------------------------------
     def fit(self, data, labels=None, epochs: int = 1,
@@ -503,7 +533,8 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
 
         mode = health.graph_mode()
         if self._train_step is None \
-                or getattr(self, "_train_step_mode", "") != mode:
+                or getattr(self, "_train_step_mode", "") != mode \
+                or getattr(self, "_train_step_ktag", "") != self._ktag():
             self._train_step = self._build_train_step()
         gvec = None
         with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
@@ -580,20 +611,21 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         # (raise = preemption mid-super-step; corrupt poisons the stack)
         features = faults.fault_point("train.step", features)
         mode = health.graph_mode()
+        ktag = self._ktag()
         if self._fused_scan is None:
             self._fused_scan = {}
-        if (k, mode) not in self._fused_scan:
+        if (k, mode, ktag) not in self._fused_scan:
             # K joins the cache key: a K=1 and a K=4 executable must
             # never collide even though their graph keys match
-            self._fused_scan[k, mode] = aot_cache.wrap(
+            self._fused_scan[k, mode, ktag] = aot_cache.wrap(
                 jax.jit(self.fused_scan_fn(k, guards=mode),
                         donate_argnums=(0, 1, 2, 7)),
                 self._graph_key(),
-                f"fused_scan:{k}:d0127{health.cache_tag()}")
+                f"fused_scan:{k}:d0127{health.cache_tag()}{ktag}")
         gvecs = None
         with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
             telemetry.host_gap_close(k)
-            out = self._fused_scan[k, mode](
+            out = self._fused_scan[k, mode, ktag](
                 self.params, self.state, self.opt_state, features, labels,
                 fmask, lmask, self.device_iteration(), self.device_epoch(),
                 self._base_key)
@@ -906,17 +938,18 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         # cache keyed by (seg, back, health mode): a conf.tbptt_*_length
         # (or guard-mode) change between fits must not silently reuse a
         # closure compiled for the old configuration
+        ktag = self._ktag()
         if self._tbptt_scan is None:
             self._tbptt_scan = {}
-        if (seg, back, mode) not in self._tbptt_scan:
-            self._tbptt_scan[seg, back, mode] = aot_cache.wrap(
+        if (seg, back, mode, ktag) not in self._tbptt_scan:
+            self._tbptt_scan[seg, back, mode, ktag] = aot_cache.wrap(
                 jax.jit(self.tbptt_scan_fn(seg, back, guards=mode),
                         donate_argnums=(0, 1, 2)),
                 self._graph_key(),
-                f"tbptt_scan:{seg}:{back}:d012{health.cache_tag()}")
+                f"tbptt_scan:{seg}:{back}:d012{health.cache_tag()}{ktag}")
         gvec = None
         with telemetry.span(telemetry.PHASE_COMPUTE) as _sp:
-            out = self._tbptt_scan[seg, back, mode](
+            out = self._tbptt_scan[seg, back, mode, ktag](
                 self.params, self.state, self.opt_state, features, labels,
                 fmask, lmask, self.device_iteration(), self.device_epoch(),
                 self._base_key)
@@ -1046,7 +1079,8 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
         """Forward pass, eval mode (reference ``#output``)."""
         if self.params is None:
             self.init()
-        if self._output_fn is None:
+        if self._output_fn is None \
+                or getattr(self, "_output_ktag", "") != self._ktag():
             self._output_fn = self._build_output_fn()
         # jax.Arrays pass through (keeps committed shardings); uint8
         # features stay uint8 and dequantize inside the jit, matching
@@ -1063,7 +1097,8 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
             return self.score_value
         if self.params is None:
             self.init()
-        if self._score_fn is None:
+        if self._score_fn is None \
+                or getattr(self, "_score_ktag", "") != self._ktag():
             self._score_fn = self._build_score_fn()
         features, labels, fmask, lmask = self._batch_arrays(ds)
         return float(self._score_fn(self.params, self.state, features, labels,

@@ -334,6 +334,39 @@ def record_analysis_finding(rule: str, severity: str) -> None:
                      rule=rule, severity=severity).inc()
 
 
+def record_kernel_selected(kernel: str, shape_bucket: str) -> None:
+    """Count one kernel-registry routing decision (``kernels.routing``):
+    a tuned Pallas kernel was selected for a concrete shape class
+    inside a fresh trace. Unconditional like the other control-plane
+    events: selection happens at trace time (once per executable),
+    never per step."""
+    REGISTRY.counter("dl4j_kernel_selected_total",
+                     help="tuned kernel selections at trace time",
+                     kernel=kernel, shape_bucket=shape_bucket).inc()
+
+
+def record_autotune_trial(kernel: str) -> None:
+    """Count one autotuner candidate benchmark (``kernels.tuner``)."""
+    REGISTRY.counter("dl4j_kernel_autotune_trials_total",
+                     help="autotune candidate tilings benchmarked",
+                     kernel=kernel).inc()
+
+
+def record_autotune_winner(kernel: str) -> None:
+    """Count one autotuner winner recorded into the tuning cache."""
+    REGISTRY.counter("dl4j_kernel_autotune_winners_total",
+                     help="autotune winners recorded", kernel=kernel).inc()
+
+
+def record_tuning_cache(hits: int, entries: int) -> None:
+    """Publish the kernel tuning cache's cumulative hit count and entry
+    count (control-plane cadence: selection and autotune events)."""
+    REGISTRY.gauge("dl4j_kernel_tuning_cache_hits",
+                   help="tuning-cache winner lookups that hit").set(hits)
+    REGISTRY.gauge("dl4j_kernel_tuning_cache_entries",
+                   help="tuned envelopes in the cache").set(entries)
+
+
 def record_circuit_state(name: str, state_code: int,
                          transition: bool = True) -> None:
     """Publish a breaker's state (0=closed, 1=half_open, 2=open); counts

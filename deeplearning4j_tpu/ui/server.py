@@ -405,6 +405,14 @@ class UIServer:
         return self._metric_table_panel("Pod (distributed snapshots)",
                                         "dl4j_pod_")
 
+    def _kernels_panel(self) -> str:
+        """Pallas kernel subsystem (kernels/): tuned-selection counts by
+        kernel and shape bucket, autotune trial/winner counters, tuning
+        cache hit/entry gauges — rendered only once the registry has
+        routed or tuned something in this process."""
+        return self._metric_table_panel("Kernels (autotuner)",
+                                        "dl4j_kernel_")
+
     def _collectives_panel(self) -> str:
         """Collective-exchange metrics (comms.scheduler +
         parallel.compression): per-op bytes/launch counters, bucket
@@ -531,6 +539,7 @@ class UIServer:
             self._generation_panel(),
             self._platform_panel(),
             self._collectives_panel(),
+            self._kernels_panel(),
             self._sharding_panel(),
             self._pod_panel(),
         ]) or "<p>No stats collected yet.</p>"
