@@ -60,11 +60,14 @@ shard-smoke:
 # oracle, continuous-vs-sequential token identity, late-join/EOS-retire
 # scheduling, breaker/deadline admission, zero recompiles after warmup —
 # then the closed-loop token-throughput bench in smoke mode (continuous
-# must beat sequential on aggregate tokens/s).
+# must beat sequential on aggregate tokens/s; prefix-cache leg must hit
+# the trie, speculative leg uses an oracle draft so acceptance and
+# identity assert without a training run).
 decode-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests -q -m decode \
 		-p no:cacheprovider
-	JAX_PLATFORMS=cpu $(PY) bench_decode.py --smoke
+	JAX_PLATFORMS=cpu $(PY) bench_decode.py --smoke \
+		--prefix-cache --speculative
 
 .PHONY: comms-smoke
 # Collective-scheduler smoke: plan determinism/digests, scheduler-vs-

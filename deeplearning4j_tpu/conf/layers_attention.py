@@ -34,6 +34,7 @@ from deeplearning4j_tpu.conf.activations import Activation
 from deeplearning4j_tpu.conf.layers import BaseLayer
 from deeplearning4j_tpu.ops import (
     cache_update,
+    chunk_decode_attention,
     decode_attention,
     dot_product_attention,
 )
@@ -212,6 +213,69 @@ class SelfAttentionLayer(BaseLayer):
         y = o.reshape(b, nh * hs) @ params["Wo"] + params["bo"]
         return (self.activation.apply(y),
                 {"k": k_cache, "v": v_cache})
+
+    def decode_chunk(self, params, x, cache, positions):
+        """A ``t``-token window of causal attention against the KV cache
+        — the multi-token twin of :meth:`decode_step` used by the
+        speculative ``spec_verify`` launch. ``x: [batch, t, features]``
+        are the window's representations; token ``i`` of row ``b``
+        occupies cache slot ``positions[b] + i``. Projects q/k/v for the
+        whole window, writes the k/v block at ``positions`` in one
+        ``dynamic_update_slice``, attends each token causally through
+        :func:`chunk_decode_attention`, and returns
+        ``(y [batch, t, features_out], new_cache)``."""
+        self._decode_check()
+        b, t, _ = x.shape
+        nh = self.n_heads
+        hs = params["Wk"].shape[1] // nh
+        q = (x @ params["Wq"] + params["bq"]).reshape(b, t, nh, hs)
+        k_new = (x @ params["Wk"] + params["bk"]).reshape(b, t, nh, hs)
+        v_new = (x @ params["Wv"] + params["bv"]).reshape(b, t, nh, hs)
+        k_cache = cache_update(cache["k"], k_new, positions)
+        v_cache = cache_update(cache["v"], v_new, positions)
+        o = chunk_decode_attention(q, k_cache, v_cache, positions)
+        y = o.reshape(b, t, nh * hs) @ params["Wo"] + params["bo"]
+        return (self.activation.apply(y),
+                {"k": k_cache, "v": v_cache})
+
+    def prefill_suffix(self, params, x, prefix_k, prefix_v, prefix_mask,
+                       key_mask=None):
+        """Prompt-suffix prefill against an already-projected prefix —
+        the prefix-cache-hit twin of :meth:`prefill`. ``x: [batch,
+        t_suffix, features]`` holds the suffix tokens' representations;
+        ``prefix_k/prefix_v: [batch, t_prefix, n_heads, head_size]`` are
+        the shared prefix pages in cache layout (padding masked by
+        ``prefix_mask: [batch, t_prefix]``). The suffix queries attend
+        the concatenation ``[prefix ; suffix]``: with ``Tk = t_prefix +
+        t_suffix`` and ``Tq = t_suffix``, the reference causal rule
+        ``j <= i + (Tk - Tq)`` makes the whole prefix visible to every
+        suffix query while the suffix stays causal within itself —
+        exactly the cold-prefill semantics, minus re-projecting the
+        prefix. Returns ``(y, k, v)`` with ``k/v`` the SUFFIX blocks only
+        (cache layout), ready for the dynamic-offset join scatter."""
+        self._decode_check()
+        b, t, _ = x.shape
+        nh = self.n_heads
+        hs = params["Wk"].shape[1] // nh
+        q = x @ params["Wq"] + params["bq"]
+        k = (x @ params["Wk"] + params["bk"]).reshape(b, t, nh, hs)
+        v = (x @ params["Wv"] + params["bv"]).reshape(b, t, nh, hs)
+        k_full = jnp.concatenate([prefix_k, k], axis=1)
+        v_full = jnp.concatenate([prefix_v, v], axis=1)
+        if key_mask is None:
+            key_mask = jnp.ones((b, t), x.dtype)
+        mask = jnp.concatenate(
+            [jnp.asarray(prefix_mask, x.dtype),
+             jnp.asarray(key_mask, x.dtype)], axis=1)
+        kh = jnp.transpose(k_full, (0, 2, 1, 3))
+        vh = jnp.transpose(v_full, (0, 2, 1, 3))
+        o = dot_product_attention(
+            _split_heads(q, nh), kh, vh, key_mask=mask, causal=True,
+            impl=self.attention_impl, train=False)
+        y = self.activation.apply(_merge_heads(o) @ params["Wo"]
+                                  + params["bo"])
+        y = y * jnp.asarray(key_mask, y.dtype)[:, :, None]
+        return y, k, v
 
 
 def _rnn_size_static(input_type):

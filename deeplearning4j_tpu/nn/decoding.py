@@ -317,6 +317,75 @@ class TransformerDecoder:
             acts[name] = y
         return logits, kv
 
+    def _run_chunk(self, params, tokens, positions, caches):
+        """A ``[B, T]`` window of tokens through the graph against the
+        caches in ONE wide step (no scan): token ``i`` of row ``b`` sits
+        at cache slot ``positions[b] + i``. Returns (full per-position
+        logits ``[B, T, V]``, new caches) — the speculative verifier
+        scores every drafted position from one launch of this walk."""
+        t = tokens.shape[1]
+        acts = {self._input: tokens}
+        caches = dict(caches)
+        logits = None
+        for kind, name, spec in self._plan:
+            xs = [acts[src] for src in spec.inputs]
+            if kind == "attn":
+                y, caches[name] = self._layer(name).decode_chunk(
+                    params[name], xs[0], caches[name], positions)
+            elif kind == "pos":
+                idx = jnp.clip(positions[:, None] + jnp.arange(t),
+                               0, self.max_len - 1)
+                y = xs[0] + params[name]["P"][idx]
+            elif kind == "head":
+                logits = self._layer(name).pre_output(params[name], xs[0])
+                continue
+            else:
+                y, _ = spec.vertex.forward(params.get(name, {}), {}, xs,
+                                           train=False, rng=None)
+            acts[name] = y
+        return logits, caches
+
+    def _run_suffix(self, params, suffix, suf_lens, prefix_kv, prefix_lens):
+        """Prompt-SUFFIX prefill walk against already-projected prefix
+        KV pages: ``suffix [Bp, Ts] int32`` holds only the uncached tail
+        of each prompt, ``prefix_kv[name]{k,v} [Bp, Tpre, heads, hd]``
+        the shared pages (valid up to ``prefix_lens[b]``). Position
+        embeddings are gathered at the suffix tokens' TRUE positions
+        (``prefix_lens + i``), and each attention layer attends the
+        ``[prefix ; suffix]`` concatenation — cold-prefill semantics
+        minus re-projecting the prefix. Returns (last-valid-position
+        logits ``[Bp, V]``, suffix-only kv blocks)."""
+        ts = suffix.shape[1]
+        tpre = next(iter(prefix_kv.values()))["k"].shape[1]
+        key_mask = (jnp.arange(ts)[None, :]
+                    < suf_lens[:, None]).astype(self._dtype)
+        prefix_mask = (jnp.arange(tpre)[None, :]
+                       < prefix_lens[:, None]).astype(self._dtype)
+        acts = {self._input: suffix}
+        kv = {}
+        logits = None
+        for kind, name, spec in self._plan:
+            xs = [acts[src] for src in spec.inputs]
+            if kind == "attn":
+                y, k, v = self._layer(name).prefill_suffix(
+                    params[name], xs[0], prefix_kv[name]["k"],
+                    prefix_kv[name]["v"], prefix_mask, key_mask)
+                kv[name] = {"k": k, "v": v}
+            elif kind == "pos":
+                idx = jnp.clip(prefix_lens[:, None] + jnp.arange(ts),
+                               0, self.max_len - 1)
+                y = xs[0] + params[name]["P"][idx]
+            elif kind == "head":
+                full = self._layer(name).pre_output(params[name], xs[0])
+                idx = jnp.maximum(suf_lens - 1, 0)[:, None, None]
+                logits = jnp.take_along_axis(full, idx, axis=1)[:, 0]
+                continue
+            else:
+                y, _ = spec.vertex.forward(params.get(name, {}), {}, xs,
+                                           train=False, rng=None)
+            acts[name] = y
+        return logits, kv
+
     # --- compiled executables (all through optimize/aot_cache) -------------
     def decode_fn(self, s: int, k: int):
         """K fused decode steps at KV bucket ``s``: ``lax.scan`` of the
@@ -328,29 +397,55 @@ class TransformerDecoder:
         key = ("decode", s, k)
         if key not in self._fns:
             def fn(params, state):
-                def body(st, _):
-                    active = st["active"]
-                    logits, caches = self._run_token(
-                        params, st["tokens"], st["positions"], st["caches"])
-                    step_keys, rng_next = _advance_rng(st["rng"])
-                    tok = _sample_tokens(logits, step_keys, st["temps"])
-                    tok = jnp.where(active, tok, st["tokens"])
-                    new_pos = st["positions"] + active.astype(jnp.int32)
-                    gen = new_pos - st["prompt_lens"] + 1
-                    nxt = active & (tok != st["eos"]) & (gen < st["max_new"])
-                    st = dict(st, caches=caches, tokens=tok,
-                              positions=new_pos, active=nxt,
-                              rng=jnp.where(active[:, None], rng_next,
-                                            st["rng"]))
-                    return st, (tok, active)
-
-                st, (toks, emitted) = jax.lax.scan(
-                    body, state, None, length=k)
-                return st, toks, emitted
+                return self._decode_window(params, state, k)
 
             self._fns[key] = aot_cache.wrap(
                 jax.jit(fn, donate_argnums=(1,)), self._graph_key(),
                 f"decode_step:s{s}:k{k}")
+        return self._fns[key]
+
+    def _decode_window(self, params, state, k):
+        """The fused K-step window body shared by :meth:`decode_fn` and
+        :meth:`spec_draft_fn`: ``lax.scan`` of the single-token walk
+        with in-graph EOS/max-tokens masking."""
+        def body(st, _):
+            active = st["active"]
+            logits, caches = self._run_token(
+                params, st["tokens"], st["positions"], st["caches"])
+            step_keys, rng_next = _advance_rng(st["rng"])
+            tok = _sample_tokens(logits, step_keys, st["temps"])
+            tok = jnp.where(active, tok, st["tokens"])
+            new_pos = st["positions"] + active.astype(jnp.int32)
+            gen = new_pos - st["prompt_lens"] + 1
+            nxt = active & (tok != st["eos"]) & (gen < st["max_new"])
+            st = dict(st, caches=caches, tokens=tok,
+                      positions=new_pos, active=nxt,
+                      rng=jnp.where(active[:, None], rng_next,
+                                    st["rng"]))
+            return st, (tok, active)
+
+        st, (toks, emitted) = jax.lax.scan(body, state, None, length=k)
+        return st, toks, emitted
+
+    def spec_draft_fn(self, s: int, k: int):
+        """The DRAFT side of a speculative iteration in ONE launch:
+        overwrite the draft's cursor with the target's (the spec_sync
+        reconciliation — accepted slots already hold the right k/v, so
+        it is pure bookkeeping) and run the fused K-step window from
+        there. Folding the sync into the window halves the draft-side
+        dispatches per iteration, which is most of speculation's cost
+        on a dispatch-bound host. State DONATED; the cursor arrays come
+        from the TARGET's state and are not."""
+        key = ("spec_draft", s, k)
+        if key not in self._fns:
+            def fn(params, state, tokens, positions, active):
+                st = dict(state, tokens=tokens, positions=positions,
+                          active=active)
+                return self._decode_window(params, st, k)
+
+            self._fns[key] = aot_cache.wrap(
+                jax.jit(fn, donate_argnums=(1,)), self._graph_key(),
+                f"spec_draft:s{s}:k{k}")
         return self._fns[key]
 
     def prompt_fn(self, tp: int, bp: int):
@@ -440,14 +535,252 @@ class TransformerDecoder:
                 f"gen_release:s{s}")
         return self._fns[key]
 
+    # --- speculative decoding (draft K, verify K+1 in one launch) ----------
+    def spec_verify_fn(self, s: int, k: int):
+        """Score a K-token drafted window in ONE wide launch — the
+        speculative-decoding verifier. Input ``drafts [K, B]`` holds the
+        draft model's proposals; the window fed through the graph is
+        ``[current token ; drafts]`` (K+1 positions), scored by
+        :meth:`_run_chunk` without a scan. Acceptance is resolved
+        in-graph: position ``i`` emits the token the TARGET samples
+        there (greedy argmax, or a categorical draw from the row's
+        frozen PRNG stream — the SAME rule sequential decode applies),
+        and emission continues only while the draft agreed at every
+        earlier position, so the emitted stream is token-identical to
+        non-speculative decode at ANY acceptance rate; drafts merely
+        decide how many positions one launch may emit. Per-row rollback
+        is the KV write cursor: all K+1 k/v blocks are written, but
+        ``positions`` advances only by the emitted count and the row's
+        PRNG stream consumes exactly that many draws — slots beyond the
+        cursor are dead weight the attention mask never reads, and the
+        next window overwrites them. State DONATED. Returns
+        ``(state', tokens [K+1, B], emitted [K+1, B],
+        accepted [B])`` — ``accepted`` counts the drafted tokens that
+        survived (emitted minus the always-emitted first position)."""
+        key = ("spec_verify", s, k)
+        if key not in self._fns:
+            w = k + 1
+
+            def fn(params, state, drafts):
+                active = state["active"]
+                p0 = state["positions"]
+                window = jnp.concatenate(
+                    [state["tokens"][:, None],
+                     jnp.transpose(drafts)], axis=1)  # [B, K+1]
+                logits, caches = self._run_chunk(
+                    params, window, p0, state["caches"])
+
+                def split(carry, _):
+                    ks = jax.vmap(jax.random.split)(carry)
+                    return ks[:, 1], (ks[:, 0], ks[:, 1])
+
+                rng0 = state["rng"].astype(jnp.uint32)
+                _, (step_keys, chain) = jax.lax.scan(
+                    split, rng0, None, length=w)
+                tstar = jnp.stack([
+                    _sample_tokens(logits[:, i], step_keys[i],
+                                   state["temps"])
+                    for i in range(w)])  # [K+1, B]
+                match = jnp.cumprod(
+                    (drafts == tstar[:k]).astype(jnp.int32), axis=0)
+                a = match.sum(axis=0)  # accepted drafted prefix [B]
+                emits = []
+                emit = active
+                for i in range(w):
+                    if i > 0:
+                        gen_prev = p0 + i + 1 - state["prompt_lens"]
+                        emit = emit & (a >= i) \
+                            & (tstar[i - 1] != state["eos"]) \
+                            & (gen_prev < state["max_new"])
+                    emits.append(emit)
+                emitted = jnp.stack(emits)  # [K+1, B] bool
+                e = emitted.astype(jnp.int32).sum(axis=0)
+                positions_new = p0 + e
+                last_i = jnp.maximum(e - 1, 0)
+                last = jnp.take_along_axis(
+                    tstar, last_i[None, :], axis=0)[0]
+                tokens_new = jnp.where(e > 0, last, state["tokens"])
+                rng_sel = jnp.take_along_axis(
+                    chain, jnp.broadcast_to(
+                        last_i[None, :, None], (1,) + chain.shape[1:]),
+                    axis=0)[0]
+                rng_new = jnp.where((e > 0)[:, None], rng_sel,
+                                    state["rng"])
+                gen_now = positions_new - state["prompt_lens"] + 1
+                active_new = (e > 0) & (tokens_new != state["eos"]) \
+                    & (gen_now < state["max_new"])
+                accepted = jnp.maximum(e - 1, 0)
+                st = dict(state, caches=caches, tokens=tokens_new,
+                          positions=positions_new, active=active_new,
+                          rng=rng_new)
+                return st, tstar, emitted, accepted
+
+            self._fns[key] = aot_cache.wrap(
+                jax.jit(fn, donate_argnums=(1,)), self._graph_key(),
+                f"spec_verify:s{s}:k{k}")
+        return self._fns[key]
+
+    def spec_sync_fn(self, s: int):
+        """Roll the DRAFT state's cursor back onto the target's after a
+        verify window: the draft speculated K steps ahead on its own
+        chain, but its k/v for the accepted slots are already correct
+        (accepted means the drafted token WAS the emitted token), so
+        reconciliation is pure bookkeeping — set tokens/positions/active
+        to the target's and let the mask strand the rejected tail. State
+        DONATED; caches pass through aliased."""
+        key = ("spec_sync", s)
+        if key not in self._fns:
+            def fn(state, tokens, positions, active):
+                return dict(state, tokens=tokens, positions=positions,
+                            active=active)
+
+            self._fns[key] = aot_cache.wrap(
+                jax.jit(fn, donate_argnums=(0,)), self._graph_key(),
+                f"spec_sync:s{s}")
+        return self._fns[key]
+
+    # --- prefix-cache executables ------------------------------------------
+    def prefix_attach_fn(self, s: int, tpre: int, bp: int):
+        """Scatter shared prefix KV pages into joining rows' caches —
+        the ``prefill_join`` shape applied to cached pages instead of a
+        fresh prefill: ``prefix_kv[name]{k,v} [bp, tpre, heads, hd]``
+        lands at slots ``[0, tpre)`` of each row in ``rows`` (OOB slots
+        are padding, dropped), ``positions`` is set to the per-row valid
+        prefix length. State DONATED — the audit-visible in-place cache
+        write that makes a hit O(pages copied), not O(prefix
+        re-projected)."""
+        key = ("prefix_attach", s, tpre, bp)
+        if key not in self._fns:
+            def fn(state, prefix_kv, rows, prefix_lens):
+                caches = {}
+                for name, c in state["caches"].items():
+                    caches[name] = {
+                        "k": c["k"].at[rows, :tpre].set(
+                            prefix_kv[name]["k"], mode="drop"),
+                        "v": c["v"].at[rows, :tpre].set(
+                            prefix_kv[name]["v"], mode="drop"),
+                    }
+                return dict(
+                    state, caches=caches,
+                    positions=state["positions"].at[rows].set(
+                        prefix_lens, mode="drop"))
+
+            self._fns[key] = aot_cache.wrap(
+                jax.jit(fn, donate_argnums=(0,)), self._graph_key(),
+                f"prefix_attach:s{s}:t{tpre}:b{bp}")
+        return self._fns[key]
+
+    def suffix_prompt_fn(self, ts: int, tpre: int, bp: int):
+        """Suffix-only prefill for a prefix-cache-hit join group: like
+        :meth:`prompt_fn` but over ``[bp, ts]`` suffix tokens attending
+        the shared prefix pages (see :meth:`_run_suffix`). NOT donated —
+        the prefix pages are shared, refcounted buffers that other
+        requests may attach concurrently."""
+        key = ("suffix_prompt", ts, tpre, bp)
+        if key not in self._fns:
+            def fn(params, suffix, suf_lens, prefix_kv, prefix_lens,
+                   max_new, eos, temps, rng):
+                logits, kv = self._run_suffix(
+                    params, suffix, suf_lens, prefix_kv, prefix_lens)
+                step_keys, rng_next = _advance_rng(rng)
+                tok = _sample_tokens(logits, step_keys, temps)
+                active = (tok != eos) & (max_new > 1)
+                return kv, tok, active, rng_next
+
+            self._fns[key] = aot_cache.wrap(
+                jax.jit(fn), self._graph_key(),
+                f"gen_prompt_sfx:t{ts}:p{tpre}:b{bp}")
+        return self._fns[key]
+
+    def suffix_join_fn(self, s: int, ts: int, bp: int):
+        """Join a suffix-prefilled group behind its attached prefix: the
+        suffix kv block lands at each row's PER-ROW offset
+        (``prefix_lens[i]``, a traced ``dynamic_update_slice`` — the
+        static join scatter cannot express a per-row start), and the row
+        arrays are seeded exactly like :meth:`join_fn` with
+        ``positions = prefix + suffix = full prompt length``. Padding
+        group slots write back what the target row already holds (a
+        gather/select no-op) because ``dynamic_update_slice`` clamps
+        instead of dropping. State DONATED."""
+        key = ("suffix_join", s, ts, bp)
+        if key not in self._fns:
+            def fn(state, kv, rows, tok, prefix_lens, lengths, max_new,
+                   eos, temps, rng, active):
+                b = self.max_batch
+                valid = rows < b
+                rc = jnp.minimum(rows, b - 1)
+                off = jnp.clip(prefix_lens, 0, s - ts)
+                caches = {}
+                for name, c in state["caches"].items():
+                    ck, cv = c["k"], c["v"]
+                    for i in range(bp):
+                        cur_k = jax.lax.dynamic_slice(
+                            ck, (rc[i], off[i], 0, 0),
+                            (1,) + kv[name]["k"].shape[1:])
+                        cur_v = jax.lax.dynamic_slice(
+                            cv, (rc[i], off[i], 0, 0),
+                            (1,) + kv[name]["v"].shape[1:])
+                        new_k = jnp.where(valid[i], kv[name]["k"][i][None],
+                                          cur_k)
+                        new_v = jnp.where(valid[i], kv[name]["v"][i][None],
+                                          cur_v)
+                        ck = jax.lax.dynamic_update_slice(
+                            ck, new_k, (rc[i], off[i], 0, 0))
+                        cv = jax.lax.dynamic_update_slice(
+                            cv, new_v, (rc[i], off[i], 0, 0))
+                    caches[name] = {"k": ck, "v": cv}
+                at = lambda a, v: a.at[rows].set(v, mode="drop")  # noqa: E731
+                return dict(
+                    state, caches=caches,
+                    tokens=at(state["tokens"], tok),
+                    positions=at(state["positions"], lengths),
+                    prompt_lens=at(state["prompt_lens"],
+                                   jnp.maximum(lengths, 1)),
+                    max_new=at(state["max_new"], max_new),
+                    eos=at(state["eos"], eos),
+                    temps=at(state["temps"], temps),
+                    rng=at(state["rng"], rng),
+                    active=at(state["active"], active))
+
+            self._fns[key] = aot_cache.wrap(
+                jax.jit(fn, donate_argnums=(0,)), self._graph_key(),
+                f"prefix_join:s{s}:t{ts}:b{bp}")
+        return self._fns[key]
+
     # --- warmup -------------------------------------------------------------
-    def warm_all(self, fused_steps=(1,)) -> dict:
+    def _kv_struct(self, bp: int, tp: int):
+        """ShapeDtypeStruct pytree of a ``[bp, tp]`` per-layer kv block
+        (prefill output / prefix-page layout)."""
+        sds = jax.ShapeDtypeStruct
+        kv = {}
+        for name, n_in in self._attn.items():
+            layer = self._layer(name)
+            shape = (bp, tp, layer.n_heads, layer._head_size(n_in))
+            kv[name] = {"k": sds(shape, self._dtype),
+                        "v": sds(shape, self._dtype)}
+        return kv
+
+    def _ladder_floor(self, ladder: List[int], b: int) -> int:
+        """Smallest real length that maps to bucket ``b`` (one past the
+        previous ladder entry; 1 for the first)."""
+        i = ladder.index(b)
+        return 1 if i == 0 else ladder[i - 1] + 1
+
+    def warm_all(self, fused_steps=(1,), spec_steps=(), spec_sync=False,
+                 spec_draft=(), prefix=False) -> dict:
         """Compile every (bucket, K) combination WITHOUT dispatching
         (``AotStep.warm`` on ShapeDtypeStructs): all KV buckets × K for
         decode, prompt × join buckets for prefill, every (S, T<=S, B)
-        join, every upward grow hop, the release fn. After this, mixed
-        prompt/output-length traffic is zero-recompile by construction
-        (pinned in tests and reported by ``bench_decode.py``)."""
+        join, every upward grow hop, the release fn. ``spec_steps``
+        additionally warms the ``spec_verify:s:k`` verifier (+ the sync
+        op) per KV bucket; ``spec_sync`` warms just the draft-side sync;
+        ``prefix`` warms every feasible prefix-attach / suffix-prefill /
+        suffix-join bucket combination (feasible = some real prefix and
+        suffix lengths map to the pair without exceeding ``max_len``).
+        After this, mixed prompt/output-length traffic — including mixed
+        prefix hit/miss and speculative accept/reject — is
+        zero-recompile by construction (pinned in tests and reported by
+        ``bench_decode.py``)."""
         sds = jax.ShapeDtypeStruct
         params = jax.tree_util.tree_map(
             lambda x: sds(jnp.shape(x), x.dtype), self._net.params)
@@ -455,15 +788,70 @@ class TransformerDecoder:
         def row(shape, dt):
             return sds(shape, dt)
 
+        nb = self.max_batch
         before = aot_cache.stats()
         for s in self.kv_ladder:
             st = self._struct_of(s)
             for k in fused_steps:
                 self.decode_fn(s, int(k)).warm(params, st)
+            for k in spec_steps:
+                # the K+1-wide verify window cannot fit a bucket
+                # shorter than it; the engine grows the bucket past
+                # max_pos + K + 1 before ever dispatching a spec
+                # window, so the small-bucket shapes are unreachable
+                if s < int(k) + 1:
+                    continue
+                self.spec_verify_fn(s, int(k)).warm(
+                    params, st, row((int(k), nb), jnp.int32))
+            for k in spec_draft:
+                self.spec_draft_fn(s, int(k)).warm(
+                    params, st, row((nb,), jnp.int32),
+                    row((nb,), jnp.int32), row((nb,), jnp.bool_))
+            if spec_sync:
+                self.spec_sync_fn(s).warm(
+                    st, row((nb,), jnp.int32), row((nb,), jnp.int32),
+                    row((nb,), jnp.bool_))
             self.release_fn(s).warm(st, row((self.max_batch,), jnp.bool_))
             for s2 in self.kv_ladder:
                 if s2 > s:
                     self.grow_fn(s, s2).warm(st)
+        if prefix:
+            # the suffix path always pads its join group to max_batch
+            # (padding rows scatter out of bounds and drop) so the
+            # prefix machinery compiles ONE join-width per shape — the
+            # full join ladder here would multiply the warm set ~4x
+            # for no measurable prefill win at these sizes
+            bp = nb
+            for tpre in self.prompt_ladder:
+                m_min = self._ladder_floor(self.prompt_ladder, tpre)
+                for s in self.kv_ladder:
+                    if tpre <= s:
+                        self.prefix_attach_fn(s, tpre, bp).warm(
+                            self._struct_of(s),
+                            self._kv_struct(bp, tpre),
+                            row((bp,), jnp.int32), row((bp,), jnp.int32))
+                for ts in self.prompt_ladder:
+                    if m_min + self._ladder_floor(
+                            self.prompt_ladder, ts) > self.max_len:
+                        continue
+                    self.suffix_prompt_fn(ts, tpre, bp).warm(
+                        params, row((bp, ts), jnp.int32),
+                        row((bp,), jnp.int32),
+                        self._kv_struct(bp, tpre),
+                        row((bp,), jnp.int32), row((bp,), jnp.int32),
+                        row((bp,), jnp.int32), row((bp,), jnp.float32),
+                        row((bp, 2), jnp.uint32))
+            for s in self.kv_ladder:
+                for ts in self.prompt_ladder:
+                    if ts > s:
+                        continue
+                    self.suffix_join_fn(s, ts, bp).warm(
+                        self._struct_of(s), self._kv_struct(bp, ts),
+                        row((bp,), jnp.int32), row((bp,), jnp.int32),
+                        row((bp,), jnp.int32), row((bp,), jnp.int32),
+                        row((bp,), jnp.int32), row((bp,), jnp.int32),
+                        row((bp,), jnp.float32),
+                        row((bp, 2), jnp.uint32), row((bp,), jnp.bool_))
         for tp in self.prompt_ladder:
             for bp in self.join_ladder:
                 args = (params, row((bp, tp), jnp.int32),
@@ -493,6 +881,9 @@ class TransformerDecoder:
             "prompt_buckets": list(self.prompt_ladder),
             "join_buckets": list(self.join_ladder),
             "fused_steps": [int(k) for k in fused_steps],
+            "spec_steps": [int(k) for k in spec_steps],
+            "spec_draft": [int(k) for k in spec_draft],
+            "prefix": bool(prefix),
             "compiled": after["misses"] - before["misses"],
             "compile_seconds": round(
                 after["compile_seconds"] - before["compile_seconds"], 3),

@@ -9,6 +9,7 @@ plan).
 
 from deeplearning4j_tpu.ops.attention import (  # noqa: F401
     cache_update,
+    chunk_decode_attention,
     decode_attention,
     dot_product_attention,
     flash_attention,

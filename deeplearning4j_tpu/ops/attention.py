@@ -95,8 +95,30 @@ def decode_attention(q, k_cache, v_cache, positions, scale=None):
     return jnp.einsum("bhs,bshd->bhd", p, v_cache)
 
 
+def chunk_decode_attention(q, k_cache, v_cache, positions, scale=None):
+    """A ``Tq``-token window of causal attention against a preallocated
+    KV cache — the speculative-verification generalization of
+    :func:`decode_attention`. ``q: [batch, time, heads, head_dim]`` holds
+    the window's queries; query ``i`` of row ``b`` sits at cache slot
+    ``positions[b] + i`` (its own k/v already written by the caller via
+    :func:`cache_update`), so it may attend slots
+    ``0 .. positions[b] + i`` inclusive and everything beyond is masked
+    to ``NEG_INF`` exactly like the single-token step. One wide launch
+    scores the whole drafted window — ``lax.scan``-free, which is the
+    entire point of ``spec_verify:s:k``: K+1 target positions for one
+    dispatch instead of K+1 sequential steps."""
+    sm = _scale(q, scale)
+    s = jnp.einsum("bthd,bshd->bhts", q, k_cache) * sm
+    slot = jnp.arange(k_cache.shape[1])[None, None, :]
+    qpos = positions[:, None, None] + jnp.arange(q.shape[1])[None, :, None]
+    s = jnp.where((slot <= qpos)[:, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", p, v_cache)
+
+
 def cache_update(cache, new, positions):
-    """Write one token's ``new: [batch, 1, heads, head_dim]`` into
+    """Write a token block ``new: [batch, t, heads, head_dim]`` (t = 1
+    for ordinary decode, t = K+1 for a speculative verify window) into
     ``cache: [batch, max_len, heads, head_dim]`` at per-sequence slot
     ``positions: [batch]`` via a vmapped ``dynamic_update_slice`` (the
     slot index is traced, so one executable serves every position).

@@ -30,6 +30,7 @@ from deeplearning4j_tpu.parallel.generation import (  # noqa: F401
     GenerationConfig,
     GenerationEngine,
 )
+from deeplearning4j_tpu.parallel.prefix_cache import PrefixCache  # noqa: F401
 from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401
 from deeplearning4j_tpu.parallel.platform import (  # noqa: F401
     CanaryGate,

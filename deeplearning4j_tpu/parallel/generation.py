@@ -35,6 +35,39 @@ Greedy decode through this engine is pinned token-identical to
 arithmetic is row-independent and every row runs the same compiled
 executables, so continuous scheduling changes WHEN a sequence's tokens
 are computed, never WHAT they are.
+
+Two multiplicative throughput features ride on top, both OFF by
+default and composable with each other and with continuous batching:
+
+- **Radix-tree prefix caching** (``prefix_cache=True``): finished
+  prefills donate page-aligned KV blocks to a refcounted
+  :class:`~deeplearning4j_tpu.parallel.prefix_cache.PrefixCache`;
+  a new request pins the longest cached prefix at submit, the engine
+  scatters the pinned pages into the joining row with the
+  ``prefix_attach`` executable and prefills ONLY the suffix
+  (``gen_prompt_sfx`` + ``prefix_join``) — TTFT drops by the share of
+  the prompt served from cache. Pinned pages are decref'd on every
+  terminal edge (finish, queue expiry, mid-generation deadline,
+  dispatch failure, close), so the tree always returns to its
+  steady-state page count.
+- **Draft-model speculative decoding** (``draft_conf=...``): a small
+  same-vocabulary draft decoder speculates ``fused_steps`` tokens per
+  iteration with its own fused window; the target scores all K+1
+  positions in ONE wide ``spec_verify`` launch and emits the accepted
+  prefix plus one bonus token. Emission replays the target's own
+  sampling rule position by position, so output is token-identical to
+  non-speculative decode at ANY acceptance rate (greedy and seeded
+  sampling both) — the draft only decides how many tokens each launch
+  may emit. Near the context limit (``pos + K + 1 > max_len``) the
+  iteration falls back to the plain fused window, which can leave the
+  draft's KV with unwritten slots: that degrades draft agreement,
+  never output correctness.
+
+Both features key their executables into the AOT cache
+(``prefix_attach:s:t:b``, ``gen_prompt_sfx:t:p:b``,
+``prefix_join:s:t:b``, ``spec_verify:s:k``, ``spec_sync:s``) and
+``warmup()`` pre-compiles every feasible geometry, so mixed hit/miss
+and accept/reject traffic stays zero-recompile.
 """
 
 from __future__ import annotations
@@ -57,6 +90,7 @@ from deeplearning4j_tpu.parallel.batcher import (
     DeadlineExpiredError,
     ServerOverloadedError,
 )
+from deeplearning4j_tpu.parallel.prefix_cache import PrefixCache
 from deeplearning4j_tpu.resilience import faults
 from deeplearning4j_tpu.resilience.breaker import (
     CircuitBreaker,
@@ -79,11 +113,27 @@ class GenerationConfig:
     kv_bucket_min: int = 32     # smallest KV length bucket
     prompt_bucket_min: int = 8  # smallest prompt padding bucket
     max_new_default: int = 64   # max_new_tokens when the caller omits it
+    # speculative decoding: a small same-vocabulary causal LM (decoder /
+    # initialized graph / zoo config) that drafts spec_tokens tokens per
+    # iteration for the target to verify in one launch. None = off.
+    draft_conf: object = None
+    # draft window length K (default fused_steps). Unlike the plain
+    # fused window, a spec window costs ~one draft launch + one wide
+    # verify regardless of K, so K can run well past fused_steps — the
+    # verifier truncates emission wherever the draft diverges, so a
+    # long window never over-emits, it just caps the per-launch win.
+    spec_tokens: Optional[int] = None
+    # radix-tree prompt-prefix KV cache. Off by default; page size is
+    # the trie granularity in tokens, pages the LRU eviction budget.
+    prefix_cache: bool = False
+    prefix_page: int = 16
+    prefix_cache_pages: int = 256
 
 
 class _GenRequest:
     __slots__ = ("tokens", "n", "max_new", "eos", "temp", "rng", "deadline",
-                 "event", "out", "error", "t0", "row")
+                 "event", "out", "error", "t0", "t_first", "row",
+                 "prefix_len", "prefix_nodes")
 
     def __init__(self, tokens, max_new, eos, temp, rng, deadline, t0):
         self.tokens = tokens
@@ -97,7 +147,10 @@ class _GenRequest:
         self.out: List[int] = []
         self.error: Optional[BaseException] = None
         self.t0 = t0
+        self.t_first: Optional[float] = None  # first-token wall clock
         self.row: Optional[int] = None
+        self.prefix_len = 0          # tokens served from the prefix cache
+        self.prefix_nodes: list = []  # pinned trie nodes (one pin each)
 
 
 class GenerationEngine:
@@ -149,6 +202,16 @@ class GenerationEngine:
                 "ComputationGraph, or a zoo config with .decoder()")
         if self._dec.max_batch != cfg.max_batch:
             cfg.max_batch = self._dec.max_batch
+        self._draft_dec: Optional[TransformerDecoder] = None
+        self._draft_state = None
+        self._spec_k = int(cfg.spec_tokens or cfg.fused_steps)
+        if cfg.draft_conf is not None:
+            self._draft_dec = self._coerce_draft(cfg.draft_conf)
+        self._prefix = (PrefixCache(cfg.prefix_page, cfg.prefix_cache_pages)
+                        if cfg.prefix_cache else None)
+        self._spec_windows = 0
+        self._spec_drafted = 0
+        self._spec_accepted = 0
         self._breaker = (CircuitBreaker(
             name=(f"serving:{name}" if name
                   else f"decode-{next(_ENGINE_SEQ)}"))
@@ -172,6 +235,46 @@ class GenerationEngine:
         self._prefill_seconds = 0.0
         self._decode_seconds = 0.0
         telemetry.register_generation_engine(self)
+
+    def _coerce_draft(self, model) -> TransformerDecoder:
+        """Build the draft decoder with the TARGET's bucket geometry and
+        reject mismatches up front: the verifier streams the draft's
+        proposals straight into target executables, so the two must
+        agree on vocabulary, row count and every ladder (otherwise
+        spec windows would silently recompile per geometry)."""
+        cfg = self.config
+        if isinstance(model, TransformerDecoder):
+            draft = model
+        elif hasattr(model, "params"):
+            draft = TransformerDecoder(
+                model, max_batch=cfg.max_batch,
+                max_len=self._dec.max_len,
+                kv_bucket_min=cfg.kv_bucket_min,
+                prompt_bucket_min=cfg.prompt_bucket_min)
+        elif hasattr(model, "decoder"):
+            draft = model.decoder(
+                max_batch=cfg.max_batch,
+                kv_bucket_min=cfg.kv_bucket_min,
+                prompt_bucket_min=cfg.prompt_bucket_min)
+        else:
+            raise TypeError(
+                "draft_conf must be a TransformerDecoder, a causal-LM "
+                "ComputationGraph, or a zoo config with .decoder()")
+        if draft.vocab_size != self._dec.vocab_size:
+            raise ValueError(
+                f"draft vocab {draft.vocab_size} != target "
+                f"{self._dec.vocab_size}: speculative tokens would be "
+                "meaningless to the verifier")
+        if (draft.max_batch != self._dec.max_batch
+                or draft.max_len != self._dec.max_len
+                or list(draft.kv_ladder) != list(self._dec.kv_ladder)
+                or list(draft.prompt_ladder) != list(
+                    self._dec.prompt_ladder)):
+            raise ValueError(
+                "draft/target bucket geometry must match (max_batch, "
+                "max_len, kv and prompt ladders) so draft windows ride "
+                "the same AOT keys as target windows")
+        return draft
 
     # --- submit / wait ------------------------------------------------------
     def submit(self, tokens: Sequence[int], max_new_tokens: int = None,
@@ -202,23 +305,49 @@ class GenerationEngine:
         req = _GenRequest(toks, int(max_new_tokens),
                           -1 if eos_id is None else int(eos_id),
                           float(temperature), rng, deadline, t0)
-        with self._cond:
-            if self._stop:
-                raise RuntimeError("generation engine is closed")
-            if len(self._queue) >= self.config.max_queue:
-                telemetry.record_decode_request("rejected", model=self.name)
-                raise ServerOverloadedError(
-                    f"generation queue full "
-                    f"({self.config.max_queue} waiting)")
-            if self._breaker is not None and not self._breaker.allow():
-                telemetry.record_decode_request("shed", model=self.name)
-                raise CircuitOpenError(
-                    f"circuit breaker {self._breaker.name!r} is "
-                    f"{self._breaker.state}; request shed")
-            self._queue.append(req)
-            self._cond.notify_all()
+        if self._prefix is not None:
+            # pin the longest cached prefix NOW (refcounts on the whole
+            # path) so eviction can't free the pages before the join;
+            # fits() rejects matches whose padded suffix bucket would
+            # push the row past max_len (the suffix join writes a
+            # ts-wide block at offset m, so m + bucket(n - m) must fit).
+            ladder = self._dec.prompt_ladder
+            m, nodes = self._prefix.match(
+                req.tokens, limit=req.n - 1,
+                fits=lambda mm: mm + bucket_for(
+                    req.n - mm, ladder) <= self._dec.max_len)
+            req.prefix_len = m
+            req.prefix_nodes = list(nodes)
+        try:
+            with self._cond:
+                if self._stop:
+                    raise RuntimeError("generation engine is closed")
+                if len(self._queue) >= self.config.max_queue:
+                    telemetry.record_decode_request("rejected",
+                                                    model=self.name)
+                    raise ServerOverloadedError(
+                        f"generation queue full "
+                        f"({self.config.max_queue} waiting)")
+                if self._breaker is not None and not self._breaker.allow():
+                    telemetry.record_decode_request("shed", model=self.name)
+                    raise CircuitOpenError(
+                        f"circuit breaker {self._breaker.name!r} is "
+                        f"{self._breaker.state}; request shed")
+                self._queue.append(req)
+                self._cond.notify_all()
+        except BaseException:
+            self._release_prefix(req)
+            raise
         self._ensure_thread()
         return req
+
+    def _release_prefix(self, req: _GenRequest):
+        """Drop the request's pins on its prefix-cache path. Called on
+        EVERY terminal edge exactly once (the list is cleared), so the
+        tree's refcounts always return to steady state."""
+        nodes, req.prefix_nodes = req.prefix_nodes, []
+        if nodes and self._prefix is not None:
+            self._prefix.release(nodes)
 
     def result(self, req: _GenRequest) -> List[int]:
         """Block until ``req`` completes; returns its generated token
@@ -239,8 +368,21 @@ class GenerationEngine:
         (prompt bucket × join bucket) prefill, every join/grow hop —
         compile-only, no dispatch. After this the zero-recompile
         invariant holds for ANY mix of prompt/output lengths up to
-        ``max_len`` (pinned by test and reported by bench_decode.py)."""
-        return self._dec.warm_all(fused_steps=(1, self.config.fused_steps))
+        ``max_len`` (pinned by test and reported by bench_decode.py).
+        With a draft model the verifier (``spec_verify``) and both sync
+        ops are warmed too; with the prefix cache every feasible
+        attach/suffix-prefill/suffix-join geometry is — so mixed
+        hit/miss and accept/reject traffic stays zero-recompile."""
+        k = self.config.fused_steps
+        out = self._dec.warm_all(
+            fused_steps=(1, k),
+            spec_steps=(self._spec_k,) if self._draft_dec is not None
+            else (),
+            prefix=self._prefix is not None)
+        if self._draft_dec is not None:
+            out["draft"] = self._draft_dec.warm_all(
+                fused_steps=(1, k), spec_draft=(self._spec_k,))
+        return out
 
     def queue_depth(self) -> int:
         return len(self._queue)
@@ -268,6 +410,17 @@ class GenerationEngine:
                           "prompt": list(self._dec.prompt_ladder),
                           "join": list(self._dec.join_ladder)}
         out["aot_cache"] = aot_cache.stats()
+        if self._prefix is not None:
+            out["prefix_cache"] = self._prefix.stats()
+        if self._draft_dec is not None:
+            drafted = self._spec_drafted
+            out["speculative"] = {
+                "windows": self._spec_windows,
+                "drafted": drafted,
+                "accepted": self._spec_accepted,
+                "acceptance": (self._spec_accepted / drafted
+                               if drafted else 0.0),
+            }
         if self._breaker is not None:
             out["circuit_breaker"] = self._breaker.status()
         return out
@@ -318,6 +471,7 @@ class GenerationEngine:
                     "request deadline expired after "
                     f"{(now - req.t0) * 1000:.1f} ms in queue")
                 telemetry.record_decode_request("expired", now - req.t0, model=self.name)
+                self._release_prefix(req)
                 req.event.set()
             else:
                 live.append(req)
@@ -343,12 +497,40 @@ class GenerationEngine:
         if self._state is None:
             self._S = max(self._S, s2)
             self._state = self._dec.new_state(self._S)
+            if self._draft_dec is not None:
+                self._draft_state = self._draft_dec.new_state(self._S)
             return
         if s2 > self._S:
             self._state = self._dec.grow_fn(self._S, s2)(self._state)
+            if self._draft_dec is not None:
+                self._draft_state = self._draft_dec.grow_fn(
+                    self._S, s2)(self._draft_state)
             self._S = s2
 
     def _do_prefill(self, joins: List[_GenRequest]):
+        """Prompt ingestion for this iteration's joins: cold prompts
+        prefill in one full launch (and donate their KV pages to the
+        prefix cache); prefix-cache hits prefill only their suffix,
+        grouped by suffix bucket so each group's geometry is a warmed
+        AOT key; with a draft model every join also prefills the
+        draft's cache (full prompt — the draft does not ride the
+        prefix cache) so speculation starts on the next window."""
+        cold = [r for r in joins if not r.prefix_len]
+        hits = [r for r in joins if r.prefix_len]
+        if cold:
+            self._prefill_cold(cold)
+        if hits:
+            groups = {}
+            for r in hits:
+                ts = bucket_for(r.n - r.prefix_len,
+                                self._dec.prompt_ladder)
+                groups.setdefault(ts, []).append(r)
+            for ts in sorted(groups):
+                self._prefill_suffix_group(groups[ts], ts)
+        if self._draft_dec is not None:
+            self._draft_prefill(joins)
+
+    def _prefill_cold(self, joins: List[_GenRequest]):
         cfg = self.config
         t0 = time.monotonic()
         tp = bucket_for(max(r.n for r in joins), self._dec.prompt_ladder)
@@ -386,6 +568,159 @@ class GenerationEngine:
         self._state = self._dec.join_fn(self._S, tp, bp)(
             self._state, kv, rows, tok, lengths, max_new, eos, temps,
             rng2, active)
+        if self._prefix is not None:
+            self._insert_pages(joins, kv, offset=0)
+        self._account_prefill(joins, tok, active, bp, t0)
+
+    def _prefill_suffix_group(self, joins: List[_GenRequest], ts: int):
+        """One prefix-HIT join group (shared suffix bucket ``ts``): the
+        pinned pages are host-assembled into a padded ``[bp, tpre]``
+        block, the suffix prefills against them in one launch, then the
+        pages scatter into the rows (``prefix_attach``) and the suffix
+        KV lands at each row's per-row offset (``prefix_join``). Every
+        member passed the submit-time ``fits`` check for THIS ts, so
+        ``prefix_len + ts <= max_len`` holds row-wise and the grown
+        bucket covers the widest row."""
+        cfg = self.config
+        t0 = time.monotonic()
+        max_m = max(r.prefix_len for r in joins)
+        tpre = bucket_for(max_m, self._dec.prompt_ladder)
+        # suffix joins always pad to the full join width: one compiled
+        # width per (ts, tpre, s) keeps the prefix warm set small, and
+        # padding rows scatter out of bounds (dropped)
+        bp = cfg.max_batch
+        self._grow_to(max(max_m + ts, self._S))
+        suffix = np.full((bp, ts), self._dec.pad_id, np.int32)
+        suf_lens = np.zeros((bp,), np.int32)
+        plens = np.zeros((bp,), np.int32)
+        lengths = np.zeros((bp,), np.int32)
+        rows = np.full((bp,), cfg.max_batch, np.int32)  # OOB = dropped
+        max_new = np.ones((bp,), np.int32)
+        eos = np.full((bp,), -1, np.int32)
+        temps = np.zeros((bp,), np.float32)
+        rng = np.zeros((bp, 2), np.uint32)
+        pkv = None
+        for i, r in enumerate(joins):
+            blk = self._prefix.assemble(r.prefix_nodes, tpre)
+            if pkv is None:
+                pkv = {name: {
+                    "k": np.zeros((bp,) + b["k"].shape, b["k"].dtype),
+                    "v": np.zeros((bp,) + b["v"].shape, b["v"].dtype)}
+                    for name, b in blk.items()}
+            for name, b in blk.items():
+                pkv[name]["k"][i] = b["k"]
+                pkv[name]["v"][i] = b["v"]
+            suffix[i, :r.n - r.prefix_len] = r.tokens[r.prefix_len:]
+            suf_lens[i] = r.n - r.prefix_len
+            plens[i] = r.prefix_len
+            lengths[i] = r.n
+            rows[i] = r.row
+            max_new[i] = r.max_new
+            eos[i] = r.eos
+            temps[i] = r.temp
+            rng[i] = r.rng
+
+        def once():
+            faults.fault_point(self._fault_site)
+            return self._dec.suffix_prompt_fn(ts, tpre, bp)(
+                self._net_params(), suffix, suf_lens, pkv, plens,
+                max_new, eos, temps, rng)
+
+        if self._retry is None:
+            kv, tok, active, rng2 = once()
+        else:
+            deadlines = [r.deadline for r in joins if r.deadline is not None]
+            kv, tok, active, rng2 = self._retry.call(
+                once, deadline=min(deadlines) if deadlines else None,
+                op=self._fault_site)
+        self._state = self._dec.prefix_attach_fn(self._S, tpre, bp)(
+            self._state, pkv, rows, plens)
+        self._state = self._dec.suffix_join_fn(self._S, ts, bp)(
+            self._state, kv, rows, tok, plens, lengths, max_new, eos,
+            temps, rng2, active)
+        # extend the trie with the hit requests' own suffix pages (page
+        # extension: next time a LONGER shared prefix hits)
+        self._insert_pages(joins, kv, offset="prefix")
+        self._account_prefill(joins, tok, active, bp, t0)
+
+    def _insert_pages(self, joins, kv, offset):
+        """Donate a prefill launch's KV to the prefix cache: full pages
+        of each request's prompt that the trie lacks. ``kv`` is the
+        device block ``[bp, t, heads, hd]`` per layer; ``offset`` is 0
+        for a cold prefill or ``"prefix"`` when ``kv`` holds only the
+        suffix (page starts shift down by the row's prefix length — the
+        prefix portion is already in the tree and pinned, so the slicer
+        is never asked for it). Device→host transfer happens at most
+        once per launch, and only when a new page is actually created.
+        The inserted path's pins are appended to the request's node
+        list, so its own pages cannot be evicted before it retires and
+        every pin still releases on the usual terminal edges."""
+        host = {}
+
+        def make_slicer(i, off):
+            def slicer(start, stop):
+                blk = {}
+                for name in kv:
+                    if name not in host:
+                        host[name] = {"k": np.asarray(kv[name]["k"]),
+                                      "v": np.asarray(kv[name]["v"])}
+                    h = host[name]
+                    blk[name] = {
+                        "k": h["k"][i, start - off:stop - off].copy(),
+                        "v": h["v"][i, start - off:stop - off].copy()}
+                return blk
+            return slicer
+
+        for i, r in enumerate(joins):
+            off = r.prefix_len if offset == "prefix" else 0
+            nodes = self._prefix.insert(r.tokens, r.n, make_slicer(i, off))
+            r.prefix_nodes = list(r.prefix_nodes) + list(nodes)
+
+    def _draft_prefill(self, joins: List[_GenRequest]):
+        """Prefill the DRAFT's cache for every join (full prompt, one
+        launch) and seed its rows from the TARGET's first sampled token:
+        the draft row greedily extends the target's stream, never its
+        own (eos=-1 / max_new=max_len / temp=0 — the draft must never
+        self-terminate; the verifier decides all emission)."""
+        d = self._draft_dec
+        cfg = self.config
+        tp = bucket_for(max(r.n for r in joins), d.prompt_ladder)
+        bp = bucket_for(len(joins), d.join_ladder)
+        prompts = np.full((bp, tp), d.pad_id, np.int32)
+        lengths = np.zeros((bp,), np.int32)
+        rows = np.full((bp,), cfg.max_batch, np.int32)
+        max_new = np.full((bp,), d.max_len, np.int32)
+        eos = np.full((bp,), -1, np.int32)
+        temps = np.zeros((bp,), np.float32)
+        rng = np.zeros((bp, 2), np.uint32)
+        tok = np.zeros((bp,), np.int32)
+        active = np.zeros((bp,), bool)
+        with self._cond:
+            for i, r in enumerate(joins):
+                prompts[i, :r.n] = r.tokens
+                lengths[i] = r.n
+                rows[i] = r.row
+                rng[i] = r.rng
+                tok[i] = r.out[0]
+                active[i] = self._rows[r.row] is r
+
+        def once():
+            faults.fault_point(self._fault_site)
+            return d.prompt_fn(tp, bp)(
+                d.params, prompts, lengths, max_new, eos, temps, rng)
+
+        if self._retry is None:
+            kv, _tok, _act, rng2 = once()
+        else:
+            deadlines = [r.deadline for r in joins if r.deadline is not None]
+            kv, _tok, _act, rng2 = self._retry.call(
+                once, deadline=min(deadlines) if deadlines else None,
+                op=self._fault_site)
+        self._draft_state = d.join_fn(self._S, tp, bp)(
+            self._draft_state, kv, rows, tok, lengths, max_new, eos,
+            temps, rng2, active)
+
+    def _account_prefill(self, joins, tok, active, bp, t0):
         tok = np.asarray(tok)
         active = np.asarray(active)
         now = time.monotonic()
@@ -394,6 +729,7 @@ class GenerationEngine:
             for i, r in enumerate(joins):
                 r.out.append(int(tok[i]))
                 self._positions[r.row] = r.n
+                r.t_first = now
                 telemetry.record_decode_first_token(now - r.t0)
                 if active[i]:
                     n_live += 1
@@ -413,19 +749,49 @@ class GenerationEngine:
         t0 = time.monotonic()
         with self._cond:
             active_rows = [r for r in self._rows if r is not None]
-            need = max((self._positions[r.row] for r in active_rows
-                        if r is not None), default=0) + k
+            max_pos = max((self._positions[r.row] for r in active_rows
+                           if r is not None), default=0)
+        # speculative window needs K+1 cache slots past the deepest row
+        # (K drafts + the bonus position); past that the iteration falls
+        # back to the plain fused window — the dynamic_update_slice
+        # clamp would otherwise corrupt valid slots. The fallback can
+        # leave the draft cache with unwritten slots, which degrades
+        # draft agreement but never output correctness (the verifier
+        # replays the target's own sampling rule regardless).
+        ks = self._spec_k
+        spec = (self._draft_dec is not None
+                and max_pos + ks + 1 <= self._dec.max_len)
+        need = max_pos + (ks + 1 if spec else k)
         self._grow_to(min(need, self._dec.max_len))
+        accepted = None
 
-        def once():
-            faults.fault_point(self._fault_site)
-            return self._dec.decode_fn(self._S, k)(
-                self._net_params(), self._state)
+        # NO retry on decode windows: the state pytrees are donated
+        # into the executables, so a mid-flight failure may have
+        # consumed them — _on_dispatch_failure resets instead
+        if spec:
+            k = ks
 
-        # NO retry on the decode window: the state pytree is donated
-        # into the executable, so a mid-flight failure may have consumed
-        # it — _on_dispatch_failure resets instead
-        self._state, toks, emitted = once()
+            def once():
+                faults.fault_point(self._fault_site)
+                # ONE launch syncs the draft's cursor onto the target's
+                # (reconciling the previous window's rollback) and runs
+                # its fused K-step draft window
+                return self._draft_dec.spec_draft_fn(self._S, k)(
+                    self._draft_dec.params, self._draft_state,
+                    self._state["tokens"], self._state["positions"],
+                    self._state["active"])
+
+            self._draft_state, drafts, _ = once()
+            self._state, toks, emitted, accepted = self._dec.spec_verify_fn(
+                self._S, k)(self._net_params(), self._state, drafts)
+            accepted = np.asarray(accepted)
+        else:
+            def once():
+                faults.fault_point(self._fault_site)
+                return self._dec.decode_fn(self._S, k)(
+                    self._net_params(), self._state)
+
+            self._state, toks, emitted = once()
         toks = np.asarray(toks)
         emitted = np.asarray(emitted)
         now = time.monotonic()
@@ -437,8 +803,15 @@ class GenerationEngine:
             for b, req in enumerate(self._rows):
                 if req is None:
                     continue
+                if accepted is not None and emitted[0, b]:
+                    e_b = int(emitted[:, b].sum())
+                    telemetry.record_spec_window(
+                        int(accepted[b]), k, e_b)
+                    self._spec_windows += 1
+                    self._spec_drafted += k
+                    self._spec_accepted += int(accepted[b])
                 done = False
-                for i in range(k):
+                for i in range(toks.shape[0]):
                     if not emitted[i, b]:
                         break
                     t = int(toks[i, b])
@@ -455,6 +828,7 @@ class GenerationEngine:
                         "deadline expired mid-generation after "
                         f"{len(req.out)} tokens")
                     telemetry.record_decode_request("expired", now - req.t0, model=self.name)
+                    self._release_prefix(req)
                     req.event.set()
                     self._rows[b] = None
                     self._n_active -= 1
@@ -478,6 +852,7 @@ class GenerationEngine:
         self._rows[req.row] = None
         self._retired_total += 1
         telemetry.record_decode_request("ok", now - req.t0, model=self.name)
+        self._release_prefix(req)
         req.event.set()
 
     def _on_dispatch_failure(self, e: BaseException):
@@ -492,11 +867,14 @@ class GenerationEngine:
                     continue
                 req.error = e if req.error is None else req.error
                 telemetry.record_decode_request("error", model=self.name)
+                self._release_prefix(req)
                 req.event.set()
                 self._rows[b] = None
             self._n_active = 0
             self._positions = [0] * self.config.max_batch
         self._state = self._dec.new_state(self._S)
+        if self._draft_dec is not None:
+            self._draft_state = self._draft_dec.new_state(self._S)
         if self._breaker is not None:
             self._breaker.on_failure()
 
@@ -509,11 +887,13 @@ class GenerationEngine:
             err = RuntimeError("generation engine closed")
             for req in self._queue:
                 req.error = err
+                self._release_prefix(req)
                 req.event.set()
             self._queue.clear()
             for b, req in enumerate(self._rows):
                 if req is not None:
                     req.error = err
+                    self._release_prefix(req)
                     req.event.set()
                     self._rows[b] = None
             self._n_active = 0
@@ -524,6 +904,7 @@ class GenerationEngine:
             t.join(timeout=5)
         self._thread = None
         self._state = None
+        self._draft_state = None
         return self
 
     def __enter__(self):

@@ -424,3 +424,268 @@ def test_donation_audit_covers_decode_kinds():
     for key, rep in kinds.items():
         assert rep["aliases"] > 0, f"{key[1]} does not donate its KV state"
         assert rep["findings"] == 0
+
+
+# --- prefix caching + speculative decoding ---------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _draft_decoder(seed=99) -> TransformerDecoder:
+    """A 1-layer draft with the TARGET's bucket geometry. Seed 99 gives
+    an untrained, disagreeing draft (the ~0%-acceptance leg); seed 7
+    with the target's architecture gives an oracle draft."""
+    m = TransformerEncoder(vocab_size=VOCAB, embed_dim=16, n_heads=2,
+                           n_layers=1, max_len=MAX_LEN, causal=True,
+                           lm_head=True, seed=seed)
+    return m.decoder(max_batch=MAX_BATCH, kv_bucket_min=16,
+                     prompt_bucket_min=4)
+
+
+@functools.lru_cache(maxsize=None)
+def _oracle_draft() -> TransformerDecoder:
+    """Same architecture AND seed as the target: greedy-agrees at every
+    position, so acceptance is 100% and windows emit K+1 tokens."""
+    m = TransformerEncoder(vocab_size=VOCAB, embed_dim=16, n_heads=2,
+                           n_layers=2, max_len=MAX_LEN, causal=True,
+                           lm_head=True, seed=7)
+    return m.decoder(max_batch=MAX_BATCH, kv_bucket_min=16,
+                     prompt_bucket_min=4)
+
+
+def test_prefix_cache_radix_unit():
+    """Trie mechanics in isolation: page-aligned match with pins,
+    limit/fits backoff, insert-once, LRU eviction of refcount-0 leaves
+    only."""
+    from deeplearning4j_tpu.parallel.prefix_cache import PrefixCache
+
+    made = []
+
+    def slicer(start, stop):
+        made.append((start, stop))
+        return {"l": {"k": np.full((stop - start, 2, 4), float(start)),
+                      "v": np.full((stop - start, 2, 4), float(start))}}
+
+    pc = PrefixCache(page_tokens=4, max_pages=2)
+    toks = list(range(12))
+    path = pc.insert(toks, 12, slicer)          # 3 pages, over budget
+    assert made == [(0, 4), (4, 8), (8, 12)]
+    assert pc.stats()["pages"] == 3              # all pinned: no eviction
+    pc.release(path)
+    m, nodes = pc.match(toks, limit=11)          # page-aligned, <= limit
+    assert m == 8 and len(nodes) == 2
+    assert nodes[0].kv["l"]["k"][0, 0, 0] == 0.0
+    m2, nodes2 = pc.match(toks, limit=11, fits=lambda mm: mm <= 4)
+    assert m2 == 4 and len(nodes2) == 1          # fits() backs off a page
+    pc.release(nodes + nodes2)
+    pc.insert(toks, 12, slicer)                  # re-pin forces eviction
+    assert pc.stats()["pages"] <= 3
+    assert made == [(0, 4), (4, 8), (8, 12)]     # nothing re-sliced
+
+
+def test_prefix_hit_token_identical_to_cold_miss():
+    """The tentpole determinism contract: requests sharing a cached
+    prefix produce EXACTLY the tokens of a cold-cache run and of the
+    sequential reference — the cached pages are bit-identical to the
+    prefill they came from."""
+    dec = _decoder()
+    shared = [3, 1, 4, 1, 5, 9, 2, 6]
+    prompts = [shared + [i + 1, i + 2] for i in range(5)]
+    refs = [dec.generate(p, 6) for p in prompts]
+    with _engine(prefix_cache=True, prefix_page=4) as eng:
+        cold = [eng.generate(p, max_new_tokens=6) for p in prompts]
+        hot = [eng.generate(p, max_new_tokens=6) for p in prompts]
+        st = eng.stats()
+    assert cold == refs and hot == refs
+    assert st["prefix_cache"]["hits"] >= 5      # the whole second sweep
+    assert st["prefix_cache"]["pages"] >= 2
+
+
+def test_prefix_pages_released_on_all_edges():
+    """Leak contract: after finish, queued-deadline expiry, dispatch
+    failure and close, every pin is returned — the tree's pages are all
+    refcount-0 (evictable) again."""
+    def pinned(pc):
+        with pc._lock:
+            total, stack = 0, [pc._root]
+            while stack:
+                nd = stack.pop()
+                for ch in nd.children.values():
+                    stack.append(ch)
+                    total += ch.refs
+            return total
+
+    prompt = [5, 4, 3, 2, 1, 6, 7, 8, 2, 2]
+    eng = GenerationEngine(
+        _decoder(),
+        GenerationConfig(max_batch=MAX_BATCH, fused_steps=K,
+                         kv_bucket_min=16, prompt_bucket_min=4,
+                         prefix_cache=True, prefix_page=4),
+        retry=None)
+    try:
+        pc = eng._prefix
+        eng.generate(prompt, max_new_tokens=4)          # normal finish
+        eng.generate(prompt, max_new_tokens=4)          # a hit finishes too
+        assert pinned(pc) == 0
+        # queued-deadline expiry: loop suppressed so the request expires
+        # in the queue holding its pins
+        eng._ensure_thread = lambda: None
+        req = eng.submit(prompt, max_new_tokens=4, timeout_ms=1)
+        assert pinned(pc) > 0
+        time.sleep(0.01)
+        eng._ensure_thread = type(eng)._ensure_thread.__get__(eng)
+        with eng._cond:
+            eng._expire_queued_locked(time.monotonic())
+        with pytest.raises(DeadlineExpiredError):
+            eng.result(req)
+        assert pinned(pc) == 0
+        # dispatch failure: breaker path fails the in-flight row
+        plan = FaultPlan(seed=5)
+        plan.inject("decode.launch", probability=1.0, action="raise")
+        with plan.armed():
+            req = eng.submit(prompt, max_new_tokens=4)
+            with pytest.raises(Exception):
+                eng.result(req)
+        assert pinned(pc) == 0
+        # close with a pinned request still queued
+        eng._ensure_thread = lambda: None
+        req = eng.submit(prompt, max_new_tokens=4)
+        assert pinned(pc) > 0
+    finally:
+        eng.close()
+    assert pinned(pc) == 0
+
+
+def test_speculative_greedy_token_identical():
+    """Speculation NEVER changes tokens: with an oracle draft (100%
+    acceptance) and with a disagreeing draft (~0% acceptance — the
+    degraded path emits exactly the non-speculative stream), engine
+    output equals the sequential reference."""
+    dec = _decoder()
+    prompts = [[3, 9, 1], [5, 6, 7, 8, 2, 11], [1], [14, 13, 12, 2]]
+    mns = [6, 9, 4, 12]
+    refs = [dec.generate(p, mn) for p, mn in zip(prompts, mns)]
+    with _engine(draft_conf=_oracle_draft()) as eng:
+        outs = [eng.generate(p, max_new_tokens=mn)
+                for p, mn in zip(prompts, mns)]
+        st = eng.stats()
+    assert outs == refs
+    assert st["speculative"]["accepted"] > 0     # oracle draft agrees
+    with _engine(draft_conf=_draft_decoder()) as eng:
+        outs2 = [eng.generate(p, max_new_tokens=mn)
+                 for p, mn in zip(prompts, mns)]
+        st2 = eng.stats()
+    assert outs2 == refs                         # 0%-acceptance degrades
+    assert st2["speculative"]["windows"] > 0     # ...but still speculated
+
+
+def test_speculative_sampled_matches_reference():
+    """Seeded sampling through the verifier consumes the row's PRNG
+    chain exactly as sequential decode does: same (seed, temperature)
+    → same tokens, at any acceptance rate."""
+    dec = _decoder()
+    ref = dec.generate([2, 4, 6], max_new=7, temperature=0.8, seed=42)
+    with _engine(draft_conf=_draft_decoder()) as eng:
+        out = eng.generate([2, 4, 6], max_new_tokens=7, temperature=0.8,
+                           seed=42)
+    assert out == ref
+
+
+def test_spec_prefix_compose_zero_recompiles():
+    """Both features together under mixed traffic (hit + miss joins,
+    accept + reject windows, bucket growth) never miss the AOT cache
+    after warmup, and still match the sequential reference."""
+    dec = _decoder()
+    shared = [7, 3, 7, 3, 7, 3, 7, 3]
+    prompts = [shared + [i + 1] for i in range(3)] + [[9, 9, 2]]
+    refs = [dec.generate(p, 5) for p in prompts]
+    with _engine(draft_conf=_oracle_draft(), prefix_cache=True,
+                 prefix_page=4) as eng:
+        eng.warmup()
+        miss0 = aot_cache.stats()["misses"]
+        outs = [eng.generate(p, max_new_tokens=5) for p in prompts]
+        outs += [eng.generate(p, max_new_tokens=5) for p in prompts]
+        eng.generate([2] * 20, max_new_tokens=8)   # KV grow hop
+        st = eng.stats()
+    assert outs == refs + refs
+    assert st["prefix_cache"]["hits"] >= 1
+    assert aot_cache.stats()["misses"] == miss0, \
+        "prefix/spec traffic recompiled after warmup"
+
+
+def test_spec_fallback_near_context_limit():
+    """When a row is within K+1 slots of max_len the iteration falls
+    back to the plain fused window — output still matches the
+    sequential reference all the way to the context edge."""
+    dec = _decoder()
+    prompt = [1, 2, 3, 4]
+    mn = MAX_LEN - len(prompt)                    # decode to the edge
+    ref = dec.generate(prompt, mn)
+    with _engine(draft_conf=_oracle_draft()) as eng:
+        out = eng.generate(prompt, max_new_tokens=mn)
+    assert out == ref
+
+
+def test_draft_geometry_mismatch_rejected():
+    m = TransformerEncoder(vocab_size=VOCAB, embed_dim=16, n_heads=2,
+                           n_layers=1, max_len=16, causal=True,
+                           lm_head=True, seed=1)
+    bad = m.decoder(max_batch=MAX_BATCH, kv_bucket_min=16,
+                    prompt_bucket_min=4)          # max_len 16 != 32
+    with pytest.raises(ValueError, match="geometry"):
+        GenerationEngine(
+            _decoder(),
+            GenerationConfig(max_batch=MAX_BATCH, fused_steps=K,
+                             kv_bucket_min=16, prompt_bucket_min=4,
+                             draft_conf=bad))
+
+
+def test_prefix_and_spec_telemetry_series():
+    snap0 = REGISTRY.snapshot(run_collectors=False)
+    shared = [4, 4, 4, 4, 8, 8, 8, 8]
+    with _engine(draft_conf=_oracle_draft(), prefix_cache=True,
+                 prefix_page=4) as eng:
+        eng.generate(shared + [1], max_new_tokens=5)
+        eng.generate(shared + [2], max_new_tokens=5)
+        snap1 = REGISTRY.snapshot(run_collectors=False)
+    for name in ("dl4j_prefix_cache_hits_total",
+                 "dl4j_prefix_cache_misses_total",
+                 "dl4j_prefix_cache_hit_tokens_total",
+                 "dl4j_spec_draft_tokens_total",
+                 "dl4j_spec_accepted_tokens_total"):
+        assert snap1.get(name, 0) > snap0.get(name, 0), name
+    assert "dl4j_prefix_cache_pages" in snap1
+    assert snap1["dl4j_spec_accepted_tokens"]["count"] > 0
+
+
+def test_generation_panel_includes_prefix_and_spec():
+    from deeplearning4j_tpu.ui.server import UIServer
+
+    with _engine(draft_conf=_oracle_draft(), prefix_cache=True,
+                 prefix_page=4) as eng:
+        eng.generate([6, 6, 6, 6, 2], max_new_tokens=4)
+    panel = UIServer.get_instance()._generation_panel()
+    assert "Generation — prefix cache" in panel
+    assert "Generation — speculative decode" in panel
+    assert "dl4j_spec_accepted_tokens" in panel
+
+
+def test_donation_audit_covers_spec_and_prefix_kinds():
+    """PRG201 satellite: the new decode-state consumers are in the
+    audit's train-kind set, every compiled one donates, and the suffix
+    prefill (shared refcounted pages) is deliberately exempt."""
+    from deeplearning4j_tpu.analysis import program
+
+    for kind in ("spec_verify", "spec_sync", "prefix_attach",
+                 "prefix_join"):
+        assert kind in program.TRAIN_KIND_PREFIXES
+    with _engine(draft_conf=_oracle_draft(), prefix_cache=True,
+                 prefix_page=4) as eng:
+        eng.generate([1, 2, 3, 4, 5], max_new_tokens=4)
+        eng.generate([1, 2, 3, 4, 6], max_new_tokens=4)
+    audit = program.donation_audit()
+    kinds = {k: v for k, v in audit.items()
+             if k[1].startswith(("spec_verify", "spec_sync",
+                                 "prefix_attach", "prefix_join"))}
+    assert kinds, "no spec/prefix executables were audited"
+    for key, rep in kinds.items():
+        assert rep["aliases"] > 0, f"{key[1]} does not donate its state"
+        assert rep["findings"] == 0
