@@ -117,6 +117,21 @@ kernels-smoke:
 		-p no:cacheprovider
 	JAX_PLATFORMS=cpu $(PY) bench_conv_matrix.py --kernels --smoke
 
+.PHONY: attention-smoke
+# Attention-kernel smoke: flash/paged parity (per candidate, f32+bf16),
+# flash gradient parity, routing/fallback/retune pins, the kernel-routed
+# decode subset (continuous-vs-sequential token identity, prefix-attached
+# pages, donation audit) — then the kernel-registry A/B bench in smoke
+# mode (flash-vs-stock prefill, paged-vs-masked decode across
+# occupancies, zero recompiles after warmup asserted).
+attention-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_kernels.py -q \
+		-k "flash or paged or attention or attn or cache_tag" \
+		-p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_decode.py -q -k kern \
+		-p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) bench_attention.py --smoke
+
 .PHONY: lint
 # Repo-discipline source lint (analysis/source.py AST rules): host syncs
 # in compiled functions, lock discipline on shared registries, wall-clock/

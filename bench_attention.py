@@ -1,28 +1,44 @@
-"""Reproducible attention-path benchmark (the source of BASELINE.md's
-attention table and of ``dot_product_attention``'s dispatch thresholds).
+"""Reproducible attention-path benchmark, two harnesses in one file:
 
-Protocol (see BASELINE.md measurement notes — ``block_until_ready`` on the
-axon tunnel returns at dispatch, so syncs must force a VALUE):
+1. **Impl sweep** (default; the source of BASELINE.md's attention table
+   and of ``dot_product_attention``'s dispatch thresholds): jitted
+   reference / blockwise / flash closures, B4/H8/D64 bf16 causal, T
+   swept. Protocol per BASELINE.md measurement notes —
+   ``block_until_ready`` on the axon tunnel returns at dispatch, so
+   syncs must force a VALUE: 2 warmup calls, time N enqueued calls
+   (default 20), force one scalar from the LAST output, report per-call
+   ms. OOM / compile failures are recorded, not fatal. The dispatcher
+   rule derived from this sweep lives in
+   ``deeplearning4j_tpu/ops/attention.py::dot_product_attention`` — if
+   the two ever disagree on-chip, re-run this script and fix the
+   dispatcher, not the table.
 
-- shapes: B4 / H8 / D64, bf16, causal self-attention, T swept;
-- jitted closure per (impl, mode); 2 warmup calls (compile + settle);
-- time N enqueued calls (default 20 — the tunnel's fixed ~20ms
-  enqueue+sync round-trip must amortize below the per-call compute, or
-  sub-30ms configs all measure the same), then force one scalar from the
-  LAST output; report per-call ms. OOM / compile failures are recorded,
-  not fatal.
+2. **Kernel-registry A/B** (``--kernels``; the ISSUE-17 acceptance
+   harness, committed as ``BENCH_attention_r01.json``): the tuned
+   ``flash_attention`` registry kernel vs the stock XLA reference
+   across sequence lengths (fwd and fwd+bwd — the custom-VJP backward
+   is part of the contract), the ``paged_decode_attention`` gather vs
+   the masked full-cache ``decode_attention`` read across cache
+   OCCUPANCIES (the paged kernel's cost is O(used pages); the masked
+   read always pays the full bucket), and an end-to-end decoder leg:
+   stock vs ``use_kernels=True`` ``TransformerDecoder`` generation,
+   asserting greedy token identity and ZERO recompiles after warmup
+   with ``kern:`` tokens in every step key. ``--smoke`` shrinks every
+   axis and turns the assertions on (``make attention-smoke``).
+
+Honest CPU-proxy caveat (same as docs/kernels.md): off-TPU every
+kernel body runs through the Pallas INTERPRETER, so kernel-leg
+timings rank the interpreter, not the MXU — the committed record is
+parity + token identity + zero recompiles + the tuned winner set, not
+speed. The A/B speed claim requires ``--tpu`` on a real chip.
 
 Run on the real chip (no env overrides needed):  python bench_attention.py
 Optional: ``--json`` emits one JSON line per measurement for tooling.
-
-The dispatcher rule derived from this script's output is encoded in
-``deeplearning4j_tpu/ops/attention.py::dot_product_attention`` — if the two
-ever disagree on-chip, re-run this script and fix the dispatcher, not the
-table.
 """
 
 import argparse
 import json
+import os
 import time
 
 import jax
@@ -82,17 +98,7 @@ def measure(impl: str, mode: str, t: int):
         return f"{type(e).__name__}"
 
 
-def main():
-    global N_CALLS
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--json", action="store_true")
-    ap.add_argument("--n", type=int, default=N_CALLS,
-                    help="queued calls per measurement")
-    ap.add_argument("--ts", type=int, nargs="*",
-                    default=[1024, 2048, 4096, 8192, 16384])
-    args = ap.parse_args()
-    N_CALLS = args.n
-
+def impl_sweep(args):
     backend = jax.default_backend()
     rows = []
     for t in args.ts:
@@ -129,5 +135,241 @@ def main():
             print(f"{t:>6} {mode:>8} | " + " ".join(cells))
 
 
+# --------------------------------------------------------------------------
+# kernel-registry A/B (--kernels)
+# --------------------------------------------------------------------------
+
+def _time_step(step, inputs, n):
+    out = None
+    for _ in range(WARMUP):
+        out = step(*inputs)
+    _force(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = step(*inputs)
+    _force(out)
+    return (time.perf_counter() - t0) / n * 1000.0
+
+
+def _max_abs(a, b):
+    return float(np.max(np.abs(np.asarray(a, np.float32)
+                               - np.asarray(b, np.float32))))
+
+
+def kernel_prefill_ab(args):
+    """Tuned flash (registry build) vs the stock XLA reference, fwd and
+    fwd+bwd, per sequence length."""
+    from deeplearning4j_tpu import kernels
+    from deeplearning4j_tpu.kernels.registry import AttentionEnvelope
+
+    k = kernels.REGISTRY.get("flash_attention")
+    rows = []
+    for t in args.kts:
+        env = AttentionEnvelope(b=args.kb, h=args.kh, tq=t, tk=t,
+                                d=args.kd, dtype="float32",
+                                backend=kernels.backend(), causal=True,
+                                masked=False)
+        if not k.supports(env):
+            continue
+        res = kernels.autotune(k, env, max_candidates=args.candidates,
+                               trials=1)
+        inputs = k.make_inputs(env, seed=0)
+        flash_fn = jax.jit(k.build(env, res.tiling))
+        stock_fn = jax.jit(k.reference(env))
+        parity = _max_abs(flash_fn(*inputs), stock_fn(*inputs))
+
+        def loss(fn):
+            return jax.jit(jax.grad(
+                lambda q, kk, v: jnp.sum(fn(q, kk, v) ** 2),
+                argnums=(0, 1, 2)))
+
+        g_par = max(_max_abs(a, b) for a, b in
+                    zip(loss(k.build(env, res.tiling))(*inputs),
+                        loss(k.reference(env))(*inputs)))
+        row = {
+            "t": t, "tiling": list(res.tiling),
+            "flash_ms": round(_time_step(flash_fn, inputs, args.kn), 3),
+            "stock_ms": round(_time_step(stock_fn, inputs, args.kn), 3),
+            "flash_bwd_ms": round(_time_step(
+                loss(k.build(env, res.tiling)), inputs, args.kn), 3),
+            "stock_bwd_ms": round(_time_step(
+                loss(k.reference(env)), inputs, args.kn), 3),
+            "fwd_max_abs_err": parity,
+            "bwd_max_abs_err": g_par,
+        }
+        rows.append(row)
+        print(f"prefill t={t}: flash {row['flash_ms']}ms vs stock "
+              f"{row['stock_ms']}ms (bwd {row['flash_bwd_ms']} vs "
+              f"{row['stock_bwd_ms']}), |err| fwd {parity:.2e} "
+              f"bwd {g_par:.2e}, tiling {res.tiling}")
+    return rows
+
+
+def kernel_paged_ab(args):
+    """Paged gather vs the masked full-cache read, per occupancy: every
+    row's positions sit at the given fraction of the cache bucket, so
+    the paged kernel touches ceil(occ * tk / page) pages while the
+    masked read always streams the whole bucket."""
+    from deeplearning4j_tpu import kernels
+    from deeplearning4j_tpu.kernels.registry import AttentionEnvelope
+
+    k = kernels.REGISTRY.get("paged_decode_attention")
+    tk = args.ktk
+    env = AttentionEnvelope(b=args.kb, h=args.kh, tq=1, tk=tk, d=args.kd,
+                            dtype="float32", backend=kernels.backend(),
+                            causal=True, masked=False)
+    if not k.supports(env):
+        return []
+    res = kernels.autotune(k, env, max_candidates=args.candidates,
+                           trials=1)
+    q, kc, vc, _ = k.make_inputs(env, seed=0)
+    paged_fn = jax.jit(k.build(env, res.tiling))
+    stock_fn = jax.jit(k.reference(env))
+    rows = []
+    for occ in args.occupancies:
+        pos = jnp.full((args.kb,), max(0, int(occ * tk) - 1), jnp.int32)
+        parity = _max_abs(paged_fn(q, kc, vc, pos),
+                          stock_fn(q, kc, vc, pos))
+        row = {
+            "tk": tk, "occupancy": occ, "page": int(res.tiling[0]),
+            "paged_ms": round(_time_step(
+                paged_fn, (q, kc, vc, pos), args.kn), 3),
+            "masked_ms": round(_time_step(
+                stock_fn, (q, kc, vc, pos), args.kn), 3),
+            "max_abs_err": parity,
+        }
+        rows.append(row)
+        print(f"decode tk={tk} occ={occ}: paged {row['paged_ms']}ms "
+              f"(page {row['page']}) vs masked {row['masked_ms']}ms, "
+              f"|err| {parity:.2e}")
+    return rows
+
+
+def kernel_engine_leg(args):
+    """End-to-end: stock vs use_kernels decoder, greedy token identity
+    + zero recompiles after warmup + kern: tokens in the step keys."""
+    from deeplearning4j_tpu import kernels
+    from deeplearning4j_tpu.optimize import aot_cache
+    from deeplearning4j_tpu.zoo.graphs import TransformerEncoder
+
+    margs = dict(vocab_size=32, embed_dim=16, n_heads=2, n_layers=2,
+                 max_len=32, causal=True, lm_head=True, seed=7)
+    dargs = dict(max_batch=2, kv_bucket_min=16, prompt_bucket_min=8)
+    stock = TransformerEncoder(**margs).decoder(**dargs)
+    kern = TransformerEncoder(use_kernels=True, **margs).decoder(**dargs)
+    t0 = time.monotonic()
+    tuned = kernels.autotune_decoder(kern, max_candidates=args.candidates,
+                                     trials=1)
+    tune_s = time.monotonic() - t0
+    tag = kern._ktag()
+    stock.warm_all(fused_steps=(1, 2))
+    kern.warm_all(fused_steps=(1, 2))
+    prompts = [[3, 1, 4, 1, 5], [2, 7, 1], [9] * 12]
+    m0 = aot_cache.stats()["misses"]
+    identical = True
+    for p in prompts:
+        identical = identical and (stock.generate(p, 10)
+                                   == kern.generate(p, 10))
+    leg = {
+        "greedy_identical_to_stock": identical,
+        "recompiles_after_warmup": aot_cache.stats()["misses"] - m0,
+        "tuned_envelopes": len(tuned),
+        "autotune_seconds": round(tune_s, 2),
+        "flash_token_in_keys": "kern:flash_attention:" in tag,
+        "paged_token_in_keys": "kern:paged_decode_attention:" in tag,
+    }
+    print(f"engine: identical={identical}, "
+          f"recompiles={leg['recompiles_after_warmup']}, "
+          f"{leg['tuned_envelopes']} envelopes tuned in {tune_s:.1f}s")
+    return leg
+
+
+def kernel_ab(args):
+    from deeplearning4j_tpu import kernels
+
+    backend = jax.default_backend()
+    results = {
+        "bench": "attention_kernels_r01",
+        "mode": "cpu-interpret" if kernels.backend() != "tpu" else "tpu",
+        "caveat": ("CPU proxy: kernel bodies run through the Pallas "
+                   "interpreter, so ms columns rank the interpreter, "
+                   "not the MXU. The committed record is parity + "
+                   "token identity + zero recompiles + the winner "
+                   "set; the speed claim needs --tpu on a real chip."),
+        "backend": backend,
+        "shape": {"b": args.kb, "h": args.kh, "d": args.kd},
+        "prefill_flash_vs_stock": kernel_prefill_ab(args),
+        "decode_paged_vs_masked": kernel_paged_ab(args),
+        "engine": kernel_engine_leg(args),
+    }
+    print(json.dumps(results, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=2)
+        print(f"wrote {args.out}")
+    if args.smoke:
+        eng = results["engine"]
+        assert eng["greedy_identical_to_stock"], \
+            "use_kernels greedy output != stock decoder"
+        assert eng["recompiles_after_warmup"] == 0, \
+            f"{eng['recompiles_after_warmup']} recompiles after warmup"
+        assert eng["flash_token_in_keys"] and eng["paged_token_in_keys"]
+        for row in results["prefill_flash_vs_stock"]:
+            assert row["fwd_max_abs_err"] < 1e-4, row
+            assert row["bwd_max_abs_err"] < 1e-3, row
+        for row in results["decode_paged_vs_masked"]:
+            assert row["max_abs_err"] < 1e-4, row
+        print("attention-smoke OK: parity pinned, token-identical, "
+              "0 recompiles")
+    return 0
+
+
+def main():
+    global N_CALLS
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--n", type=int, default=N_CALLS,
+                    help="queued calls per impl-sweep measurement")
+    ap.add_argument("--ts", type=int, nargs="*",
+                    default=[1024, 2048, 4096, 8192, 16384])
+    ap.add_argument("--kernels", action="store_true",
+                    help="run the kernel-registry A/B harness instead "
+                         "of the impl sweep")
+    ap.add_argument("--kts", type=int, nargs="*", default=[64, 128, 256],
+                    help="sequence lengths for the flash A/B leg")
+    ap.add_argument("--ktk", type=int, default=256,
+                    help="cache bucket for the paged A/B leg")
+    ap.add_argument("--occupancies", type=float, nargs="*",
+                    default=[0.25, 0.5, 1.0])
+    ap.add_argument("--kb", type=int, default=2)
+    ap.add_argument("--kh", type=int, default=4)
+    ap.add_argument("--kd", type=int, default=16)
+    ap.add_argument("--kn", type=int, default=3,
+                    help="timed calls per kernel-leg measurement")
+    ap.add_argument("--candidates", type=int, default=4,
+                    help="autotune candidates per envelope")
+    ap.add_argument("--out", default=None,
+                    help="write the kernel A/B JSON blob here")
+    ap.add_argument("--tpu", action="store_true",
+                    help="run on the real chip instead of the CPU proxy")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny axes + assertions (make attention-smoke)")
+    args = ap.parse_args()
+    N_CALLS = args.n
+    if not args.tpu:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.smoke:
+        args.kernels = True
+        args.kts = [16, 32]
+        args.ktk = 32
+        args.occupancies = [0.5, 1.0]
+        args.kn = 2
+        args.candidates = 2
+    if args.kernels:
+        return kernel_ab(args)
+    impl_sweep(args)
+    return 0
+
+
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

@@ -15,7 +15,13 @@ executables in up to five legs,
                 (``--speculative``): a distilled 1-layer draft proposes
                 ``--spec-tokens`` tokens per iteration, the target
                 scores all K+1 positions in one ``spec_verify`` launch,
-  combined    — prefix cache + speculation together (both flags).
+  combined    — prefix cache + speculation together (both flags),
+  paged       — continuous over a ``use_kernels=True`` model
+                (``--paged``): flash prefill + paged decode attention
+                through the Pallas kernel registry, tuned before
+                warmup; on the CPU proxy the kernel bodies run the
+                Pallas interpreter, so this leg pins token identity +
+                zero recompiles + the tuned winner set, not speed.
 
 Every engine leg runs the workload twice: an UNTIMED settle pass that
 pays each executable's one-time first-dispatch cost (and, in prefix
@@ -163,7 +169,7 @@ def _oracle_draft(model_args):
 
 
 def _run_engine_leg(name, model, args, reqs, seq_out, draft=None,
-                    prefix=False):
+                    prefix=False, tune_kernels=False):
     from deeplearning4j_tpu.optimize import aot_cache
     from deeplearning4j_tpu.parallel.generation import (
         GenerationConfig,
@@ -175,10 +181,20 @@ def _run_engine_leg(name, model, args, reqs, seq_out, draft=None,
         kv_bucket_min=args.max_len // 4, prompt_bucket_min=8,
         draft_conf=draft, spec_tokens=args.spec_tokens if draft else None,
         prefix_cache=prefix, prefix_page=args.prefix_page)
-    eng = GenerationEngine(
-        model.decoder(max_batch=args.max_batch,
-                      kv_bucket_min=args.max_len // 4,
-                      prompt_bucket_min=8), cfg)
+    dec = model.decoder(max_batch=args.max_batch,
+                        kv_bucket_min=args.max_len // 4,
+                        prompt_bucket_min=8)
+    tune_info = None
+    if tune_kernels:
+        # tune BEFORE warmup: a later tune would bump the digest and
+        # re-mint every kern:-keyed executable the warmup just built
+        from deeplearning4j_tpu import kernels
+
+        t0 = time.monotonic()
+        tuned = kernels.autotune_decoder(dec, max_candidates=2, trials=1)
+        tune_info = {"tuned_envelopes": len(tuned),
+                     "autotune_seconds": round(time.monotonic() - t0, 2)}
+    eng = GenerationEngine(dec, cfg)
     warm = eng.warmup()
     miss0 = aot_cache.stats()["misses"]
 
@@ -238,6 +254,9 @@ def _run_engine_leg(name, model, args, reqs, seq_out, draft=None,
     if prefix:
         pc = dict(st1["prefix_cache"])
         leg["prefix_cache"] = pc
+    if tune_kernels:
+        leg["kernels"] = dict(st1["kernels"])
+        leg["kernels"].update(tune_info)
     eng.close()
     print(f"{name}: {leg['tokens_per_sec']} tok/s, identical={identical}, "
           f"recompiles={recompiles}"
@@ -279,6 +298,19 @@ def bench(args):
     legs = {}
     legs["continuous"] = _run_engine_leg(
         "continuous", model, args, reqs, seq_out)
+    if args.paged:
+        # same weights (same seed) with use_kernels=True: flash prefill
+        # + paged decode attention through the kernel registry, tuned
+        # before warmup so the timed passes run the kern:-keyed
+        # executables; token identity vs the STOCK sequential reference
+        # is part of the leg
+        model_k = TransformerEncoder(
+            vocab_size=args.vocab, embed_dim=args.embed,
+            n_heads=args.heads, n_layers=args.layers,
+            max_len=args.max_len, causal=True, lm_head=True, seed=123,
+            use_kernels=True)
+        legs["paged"] = _run_engine_leg(
+            "paged", model_k, args, reqs, seq_out, tune_kernels=True)
     draft = info = None
     if args.speculative:
         if args.smoke:
@@ -367,6 +399,11 @@ def bench(args):
             acc = legs["speculative"]["speculative"]["acceptance"]
             assert 0.0 < acc <= 1.0, \
                 f"speculative leg acceptance not recorded ({acc})"
+        if "paged" in legs:
+            kinfo = legs["paged"]["kernels"]
+            assert kinfo["enabled"] and kinfo["tuned_envelopes"] > 0
+            assert "kern:flash_attention:" in kinfo["tag"]
+            assert "kern:paged_decode_attention:" in kinfo["tag"]
         print(f"decode-smoke OK: speedup {results['speedup']}x, "
               f"0 recompiles, token-identical"
               + (", prefix hits "
@@ -393,6 +430,11 @@ def main():
     ap.add_argument("--prefix-cache", action="store_true",
                     help="add the radix prefix-cache leg (+ combined leg "
                          "when --speculative is also set)")
+    ap.add_argument("--paged", action="store_true",
+                    help="add the use_kernels leg: flash prefill + paged "
+                         "decode attention through the kernel registry "
+                         "(CPU proxy runs the Pallas interpreter — the "
+                         "leg pins identity + zero recompiles, not speed)")
     ap.add_argument("--speculative", action="store_true",
                     help="add the draft-model speculative leg; the draft "
                          "is distilled on the sequential leg's outputs")

@@ -50,15 +50,32 @@ def _merge_heads(x):
     return jnp.transpose(x, (0, 2, 1, 3)).reshape(b, t, h * d)
 
 
+def _attn_core(q, k, v, key_mask, causal, impl, train, use_kernels):
+    """The softmax(QK^T)V core over head-split ``[B, H, T, D]`` inputs:
+    the tuned Pallas flash kernel when ``use_kernels`` finds a registry
+    winner for this envelope, else the stock
+    :func:`dot_product_attention` tier — an untuned or unsupported
+    shape is bit-identical to ``use_kernels=False``."""
+    if use_kernels and impl in ("auto", "flash"):
+        from deeplearning4j_tpu.kernels import routing as _routing
+
+        o = _routing.maybe_flash_attention(q, k, v, key_mask=key_mask,
+                                           causal=causal)
+        if o is not None:
+            return o
+    return dot_product_attention(q, k, v, key_mask=key_mask, causal=causal,
+                                 impl=impl, train=train)
+
+
 def _mha(params, q_in, kv_in, nheads, key_mask, causal=False, impl="auto",
-         train=True):
+         train=True, use_kernels=False):
     """Projected multi-head attention over [B, T, E] inputs."""
     q = q_in @ params["Wq"] + params["bq"]
     k = kv_in @ params["Wk"] + params["bk"]
     v = kv_in @ params["Wv"] + params["bv"]
-    o = dot_product_attention(_split_heads(q, nheads), _split_heads(k, nheads),
-                              _split_heads(v, nheads), key_mask=key_mask,
-                              causal=causal, impl=impl, train=train)
+    o = _attn_core(_split_heads(q, nheads), _split_heads(k, nheads),
+                   _split_heads(v, nheads), key_mask, causal, impl, train,
+                   use_kernels)
     return _merge_heads(o) @ params["Wo"] + params["bo"]
 
 
@@ -127,17 +144,18 @@ class SelfAttentionLayer(BaseLayer):
     def regularized_param_keys(self):
         return ["Wq", "Wk", "Wv", "Wo"]
 
-    def forward(self, params, state, x, train=False, rng=None, mask=None):
+    def forward(self, params, state, x, train=False, rng=None, mask=None,
+                use_kernels=False):
         x = self._dropout_input(x, train, rng)
         if not self.project_input:
             q = _split_heads(x, 1)
-            o = dot_product_attention(q, q, q, key_mask=mask,
-                                      causal=self.causal,
-                                      impl=self.attention_impl, train=train)
+            o = _attn_core(q, q, q, mask, self.causal, self.attention_impl,
+                           train, use_kernels)
             y = _merge_heads(o)
         else:
             y = _mha(params, x, x, self.n_heads, mask, self.causal,
-                     self.attention_impl, train=train)
+                     self.attention_impl, train=train,
+                     use_kernels=use_kernels)
         y = self.activation.apply(y)
         if mask is not None:  # masked-out steps emit zeros, as the reference
             y = y * jnp.asarray(mask, y.dtype)[:, :, None]
@@ -166,23 +184,24 @@ class SelfAttentionLayer(BaseLayer):
         shape = (max_batch, max_len, self.n_heads, hs)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
-    def prefill(self, params, x, key_mask=None):
+    def prefill(self, params, x, key_mask=None, use_kernels=False):
         """Whole-prompt forward that ALSO returns the projected keys and
         values so the caller can seed a KV cache in one launch.
         ``x: [batch, time, features]``; returns ``(y, k, v)`` with
         ``k/v: [batch, time, n_heads, head_size]`` (cache layout) and
         ``y`` identical to :meth:`forward` in eval mode (activation and
-        mask-zeroing applied)."""
+        mask-zeroing applied). ``use_kernels`` swaps the attention core
+        for the tuned flash kernel when this envelope has a winner."""
         self._decode_check()
         b, t, _ = x.shape
         hs = params["Wk"].shape[1] // self.n_heads
         q = x @ params["Wq"] + params["bq"]
         k = x @ params["Wk"] + params["bk"]
         v = x @ params["Wv"] + params["bv"]
-        o = dot_product_attention(
+        o = _attn_core(
             _split_heads(q, self.n_heads), _split_heads(k, self.n_heads),
-            _split_heads(v, self.n_heads), key_mask=key_mask, causal=True,
-            impl=self.attention_impl, train=False)
+            _split_heads(v, self.n_heads), key_mask, True,
+            self.attention_impl, False, use_kernels)
         y = self.activation.apply(_merge_heads(o) @ params["Wo"]
                                   + params["bo"])
         if key_mask is not None:
@@ -190,7 +209,7 @@ class SelfAttentionLayer(BaseLayer):
         return (y, k.reshape(b, t, self.n_heads, hs),
                 v.reshape(b, t, self.n_heads, hs))
 
-    def decode_step(self, params, x, cache, positions):
+    def decode_step(self, params, x, cache, positions, use_kernels=False):
         """One token of causal attention against the KV cache.
         ``x: [batch, features]`` is the new token's representation,
         ``positions: [batch]`` the cache slot it occupies (== number of
@@ -199,7 +218,9 @@ class SelfAttentionLayer(BaseLayer):
         ``dynamic_update_slice``, attends slots ``0..positions``
         inclusive, and returns ``(y [batch, features_out], new_cache)``.
         The caller donates the cache buffers into the compiled step so
-        the write is in-place (PRG201 audits this)."""
+        the write is in-place (PRG201 audits this). ``use_kernels``
+        swaps the masked full-cache read for the tuned paged-gather
+        kernel when this cache bucket has a winner."""
         self._decode_check()
         b = x.shape[0]
         nh = self.n_heads
@@ -209,7 +230,14 @@ class SelfAttentionLayer(BaseLayer):
         v_new = (x @ params["Wv"] + params["bv"]).reshape(b, 1, nh, hs)
         k_cache = cache_update(cache["k"], k_new, positions)
         v_cache = cache_update(cache["v"], v_new, positions)
-        o = decode_attention(q, k_cache, v_cache, positions)
+        o = None
+        if use_kernels:
+            from deeplearning4j_tpu.kernels import routing as _routing
+
+            o = _routing.maybe_decode_attention(q, k_cache, v_cache,
+                                                positions)
+        if o is None:
+            o = decode_attention(q, k_cache, v_cache, positions)
         y = o.reshape(b, nh * hs) @ params["Wo"] + params["bo"]
         return (self.activation.apply(y),
                 {"k": k_cache, "v": v_cache})
@@ -223,7 +251,10 @@ class SelfAttentionLayer(BaseLayer):
         whole window, writes the k/v block at ``positions`` in one
         ``dynamic_update_slice``, attends each token causally through
         :func:`chunk_decode_attention`, and returns
-        ``(y [batch, t, features_out], new_cache)``."""
+        ``(y [batch, t, features_out], new_cache)``. Stays on the stock
+        core even under ``use_kernels``: the window's PER-ROW cache
+        offsets (``positions[b] + i``) don't fit the flash kernel's
+        single global ``Tk - Tq`` causal rule."""
         self._decode_check()
         b, t, _ = x.shape
         nh = self.n_heads
@@ -239,7 +270,7 @@ class SelfAttentionLayer(BaseLayer):
                 {"k": k_cache, "v": v_cache})
 
     def prefill_suffix(self, params, x, prefix_k, prefix_v, prefix_mask,
-                       key_mask=None):
+                       key_mask=None, use_kernels=False):
         """Prompt-suffix prefill against an already-projected prefix —
         the prefix-cache-hit twin of :meth:`prefill`. ``x: [batch,
         t_suffix, features]`` holds the suffix tokens' representations;
@@ -269,9 +300,9 @@ class SelfAttentionLayer(BaseLayer):
              jnp.asarray(key_mask, x.dtype)], axis=1)
         kh = jnp.transpose(k_full, (0, 2, 1, 3))
         vh = jnp.transpose(v_full, (0, 2, 1, 3))
-        o = dot_product_attention(
-            _split_heads(q, nh), kh, vh, key_mask=mask, causal=True,
-            impl=self.attention_impl, train=False)
+        # flash handles Tq != Tk via the same off = Tk - Tq causal rule
+        o = _attn_core(_split_heads(q, nh), kh, vh, mask, True,
+                       self.attention_impl, False, use_kernels)
         y = self.activation.apply(_merge_heads(o) @ params["Wo"]
                                   + params["bo"])
         y = y * jnp.asarray(key_mask, y.dtype)[:, :, None]

@@ -37,6 +37,7 @@ from deeplearning4j_tpu.kernels import registry as registry  # noqa: F401
 from deeplearning4j_tpu.kernels import routing as routing  # noqa: F401
 from deeplearning4j_tpu.kernels import tuner as tuner  # noqa: F401
 from deeplearning4j_tpu.kernels.registry import (  # noqa: F401
+    AttentionEnvelope,
     Kernel,
     KernelRegistry,
     MatmulEnvelope,
@@ -44,9 +45,13 @@ from deeplearning4j_tpu.kernels.registry import (  # noqa: F401
     Selection,
 )
 from deeplearning4j_tpu.kernels.routing import (  # noqa: F401
+    autotune_decoder,
     autotune_model,
     backend,
     capability,
+    decoder_envelopes,
+    maybe_decode_attention,
+    maybe_flash_attention,
     maybe_forward,
     maybe_vertex_forward,
     plan_envelopes,

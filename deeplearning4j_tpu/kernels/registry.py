@@ -41,6 +41,15 @@ _BK_SWEEP = (512, 256, 128, 64, 32, 16, 8)
 
 _SUPPORTED_DTYPES = ("float32", "bfloat16")
 
+# attention sweeps: flash (block_q, block_k) favors the MXU-shaped big
+# blocks first (ops.attention._blk clamps per-problem, so the candidate
+# space is the EFFECTIVE block set — small-T problems collapse to one
+# candidate); paged decode sweeps the page (KV slots per DMA) down the
+# pow2 ladder the cache buckets come from
+_ATTN_BQ_SWEEP = (512, 256, 128)
+_ATTN_BK_SWEEP = (512, 256, 128)
+_PAGE_SWEEP = (128, 64, 32, 16, 8)
+
 
 @dataclasses.dataclass(frozen=True)
 class MatmulEnvelope:
@@ -65,6 +74,36 @@ class MatmulEnvelope:
     def shape_bucket(self) -> str:
         """The telemetry label: shape class without backend/act noise."""
         return f"m{self.m}_k{self.k}_n{self.n}"
+
+
+@dataclasses.dataclass(frozen=True)
+class AttentionEnvelope:
+    """One concrete attention problem. ``tq`` is the query length (1 for
+    single-token decode), ``tk`` the key length — for the paged decode
+    kernel that is the KV cache bucket, so every hop up the pow2 ladder
+    is its own tuned envelope. ``masked`` marks a key-padding mask
+    operand (train prefill over ragged batches); decode masking rides
+    ``positions`` and is always on."""
+
+    b: int
+    h: int
+    tq: int
+    tk: int
+    d: int
+    dtype: str
+    backend: str
+    causal: bool = True
+    masked: bool = False
+
+    @property
+    def key(self) -> str:
+        return (f"{self.backend}:b{self.b}:h{self.h}:tq{self.tq}"
+                f":tk{self.tk}:d{self.d}:{self.dtype}"
+                f":c{int(self.causal)}:m{int(self.masked)}")
+
+    @property
+    def shape_bucket(self) -> str:
+        return f"b{self.b}_h{self.h}_tq{self.tq}_tk{self.tk}_d{self.d}"
 
 
 def _sweep_candidates(env: MatmulEnvelope,
@@ -140,8 +179,24 @@ class Kernel:
     def make_inputs(self, env, seed: int = 0):
         raise NotImplementedError
 
+    def tiling_ok(self, env, tiling) -> bool:
+        """Whether a cached winner still legally covers ``env`` — the
+        guard :meth:`KernelRegistry.select` runs before trusting a
+        hand-edited / cross-version tuning-cache entry. Default: the
+        winner must be one of this kernel's own candidates."""
+        return tuple(tiling) in {tuple(t) for t in self.candidates(env)}
 
-class MatmulBiasActKernel(Kernel):
+
+class _MatmulKernel(Kernel):
+    """Shared matmul-class winner validation: a 3-tuple whose clamped
+    blocks divide the problem exactly (``impls.tiling_valid``)."""
+
+    def tiling_ok(self, env, tiling) -> bool:
+        return len(tiling) == 3 and impls.tiling_valid(
+            env.m, env.k, env.n, tiling)
+
+
+class MatmulBiasActKernel(_MatmulKernel):
     """Tiled matmul + bias + elementwise activation in one pass — the
     dense / 1x1-conv forward class (``impls.matmul_bias_act``)."""
 
@@ -176,7 +231,7 @@ class MatmulBiasActKernel(Kernel):
         return _rand_inputs(env, seed, with_bias=True)
 
 
-class ConvBnActKernel(Kernel):
+class ConvBnActKernel(_MatmulKernel):
     """Fused 1x1-conv + batch-norm statistics — the dominant trace
     fusion class (round-2 ``ops/conv_fused`` experiment): the matmul
     emits y AND the per-channel sum / sum-of-squares in one output
@@ -216,13 +271,160 @@ class ConvBnActKernel(Kernel):
         return _rand_inputs(env, seed, with_bias=False)
 
 
+def _attention_supports(env) -> bool:
+    return (impls.has_pallas()
+            and isinstance(env, AttentionEnvelope)
+            and env.dtype in _SUPPORTED_DTYPES
+            and env.b > 0 and env.h > 0 and env.d > 0
+            and env.tq > 0 and env.tk > 0)
+
+
+def _rand_attn(env, seed: int, shapes):
+    import jax
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(env.dtype)
+    keys = jax.random.split(jax.random.PRNGKey(seed), len(shapes))
+    return tuple(
+        jax.random.normal(k, s, jnp.float32).astype(dt)
+        for k, s in zip(keys, shapes))
+
+
+class FlashAttentionKernel(Kernel):
+    """Tiled online-softmax attention (``ops.attention.flash_attention``):
+    (Bq, Bk)-blocked forward that never materializes the [Tq, Tk] score
+    matrix, custom-VJP backward recomputing each probability tile from
+    the saved row-max/row-sum stats. The tuned tiling is the
+    ``(block_q, block_k)`` pair; ``ops.attention._blk`` clamps each to
+    the effective legal block for the problem, so every candidate here
+    IS its own effective tiling."""
+
+    kernel_id = "flash_attention"
+    version = 1
+
+    def supports(self, env) -> bool:
+        if not _attention_supports(env):
+            return False
+        # the kernel's lane-replication math needs d <= 128 or 128 | d
+        return env.d <= 128 or env.d % 128 == 0
+
+    def candidates(self, env, limit: Optional[int] = None):
+        from deeplearning4j_tpu.ops import attention as A
+
+        seen, out = set(), []
+        for bq in _ATTN_BQ_SWEEP:
+            for bk in _ATTN_BK_SWEEP:
+                eff = (A._blk(bq, env.tq), A._blk(bk, env.tk))
+                if eff in seen:
+                    continue
+                seen.add(eff)
+                out.append(eff)
+        out.sort(key=lambda t: (-(t[0] * t[1]), -t[0]))
+        return out[:limit] if limit else out
+
+    def build(self, env, tiling):
+        from deeplearning4j_tpu.ops import attention as A
+
+        bq, bk = (int(t) for t in tiling)
+        causal = env.causal
+        interpret = env.backend != "tpu"
+
+        def fn(q, k, v, key_mask=None):
+            return A.flash_attention(q, k, v, key_mask, causal=causal,
+                                     block_q=bq, block_k=bk,
+                                     interpret=interpret)
+
+        return fn
+
+    def reference(self, env):
+        from deeplearning4j_tpu.ops import attention as A
+
+        causal = env.causal
+
+        def ref(q, k, v, key_mask=None):
+            return A.reference_attention(q, k, v, key_mask, causal=causal)
+
+        return ref
+
+    def make_inputs(self, env, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        q, k, v = _rand_attn(env, seed, [(env.b, env.h, env.tq, env.d),
+                                         (env.b, env.h, env.tk, env.d),
+                                         (env.b, env.h, env.tk, env.d)])
+        if not env.masked:
+            return q, k, v
+        # ragged key-padding mask: every row keeps at least one key
+        lens = jax.random.randint(jax.random.PRNGKey(seed + 1),
+                                  (env.b,), 1, env.tk + 1)
+        km = (jnp.arange(env.tk)[None, :]
+              < lens[:, None]).astype(jnp.float32)
+        return q, k, v, km
+
+
+class PagedDecodeAttentionKernel(Kernel):
+    """Single-token decode against the KV cache as an in-kernel page
+    gather (``ops.attention.paged_decode_attention``): the cache streams
+    page-by-page, pages wholly past ``positions[b]`` skip their DMA via
+    the scalar-prefetched index map, so a row's decode step costs
+    O(used pages) instead of the masked full-cache read. The tuned
+    tiling is the 1-tuple ``(page,)``; only divisors of the cache bucket
+    are legal."""
+
+    kernel_id = "paged_decode_attention"
+    version = 1
+
+    def supports(self, env) -> bool:
+        return (_attention_supports(env) and env.tq == 1
+                and bool(self.candidates(env, limit=1)))
+
+    def candidates(self, env, limit: Optional[int] = None):
+        out = [(p,) for p in _PAGE_SWEEP
+               if p <= env.tk and env.tk % p == 0]
+        if not out and env.tk <= max(_PAGE_SWEEP):
+            out = [(env.tk,)]  # tiny caches: one page covers the bucket
+        return out[:limit] if limit else out
+
+    def build(self, env, tiling):
+        from deeplearning4j_tpu.ops import attention as A
+
+        page = int(tiling[0])
+        interpret = env.backend != "tpu"
+
+        def fn(q, k_cache, v_cache, positions):
+            return A.paged_decode_attention(q, k_cache, v_cache, positions,
+                                            page=page, interpret=interpret)
+
+        return fn
+
+    def reference(self, env):
+        from deeplearning4j_tpu.ops import attention as A
+
+        def ref(q, k_cache, v_cache, positions):
+            return A.decode_attention(q, k_cache, v_cache, positions)
+
+        return ref
+
+    def make_inputs(self, env, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        q, kc, vc = _rand_attn(env, seed, [(env.b, env.h, env.d),
+                                           (env.b, env.tk, env.h, env.d),
+                                           (env.b, env.tk, env.h, env.d)])
+        pos = jax.random.randint(jax.random.PRNGKey(seed + 1),
+                                 (env.b,), 0, env.tk, jnp.int32)
+        return q, kc, vc, pos
+
+
 @dataclasses.dataclass(frozen=True)
 class Selection:
     """One resolved routing decision."""
 
     kernel: Kernel
     env: object
-    tiling: Tuple[int, int, int]
+    tiling: Tuple[int, ...]
 
 
 class KernelRegistry:
@@ -233,6 +435,7 @@ class KernelRegistry:
         self._kernels: Dict[str, Kernel] = {}
         self._cache = cache if cache is not None else tuner.TUNING
         self._digests: Dict[str, Tuple[int, str]] = {}
+        self._tag_memo: Optional[Tuple[int, Tuple[str, ...], str]] = None
         self._lock = threading.Lock()
 
     @property
@@ -243,6 +446,7 @@ class KernelRegistry:
         with self._lock:
             self._kernels[kernel.kernel_id] = kernel
             self._digests.pop(kernel.kernel_id, None)
+            self._tag_memo = None
         return kernel
 
     def get(self, kernel_id: str) -> Optional[Kernel]:
@@ -261,8 +465,7 @@ class KernelRegistry:
         if win is None:
             return None
         tiling = tuple(int(t) for t in win.get("tiling", ()))
-        if len(tiling) != 3 or not impls.tiling_valid(
-                env.m, env.k, env.n, tiling):
+        if not kernel.tiling_ok(env, tiling):
             # a hand-edited / cross-version winner that no longer covers
             # the problem: refuse it, fall back to stock XLA
             return None
@@ -292,11 +495,25 @@ class KernelRegistry:
     def cache_tag(self) -> str:
         """The ``:kern:<id>:<digest>`` token string step keys fold in —
         one token per registered kernel, so retuning ANY kernel mints
-        new executables for every kernel-enabled step."""
-        return "".join(f":kern:{kid}:{self.tuning_digest(kid)}"
-                       for kid in self.ids())
+        new executables for every kernel-enabled step. Memoized against
+        the tuning-cache epoch (like the per-kernel digests), so the hot
+        decode loop's per-dispatch re-key check is one tuple compare
+        instead of a join over every registered kernel."""
+        epoch = self._cache.epoch
+        ids = tuple(self.ids())
+        with self._lock:
+            memo = self._tag_memo
+            if memo is not None and memo[0] == epoch and memo[1] == ids:
+                return memo[2]
+        tag = "".join(f":kern:{kid}:{self.tuning_digest(kid)}"
+                      for kid in ids)
+        with self._lock:
+            self._tag_memo = (epoch, ids, tag)
+        return tag
 
 
 REGISTRY = KernelRegistry()
 REGISTRY.register(MatmulBiasActKernel())
 REGISTRY.register(ConvBnActKernel())
+REGISTRY.register(FlashAttentionKernel())
+REGISTRY.register(PagedDecodeAttentionKernel())

@@ -16,7 +16,19 @@ Routed classes:
 - ``FusedConvBN1x1`` in train mode → ``conv_bn_act`` (matmul + fused
   per-channel statistics; normalize/activation stay in XLA), sharing
   ``_bn_running_update`` / ``_bn_normalize`` with the layer so the
-  semantics cannot diverge.
+  semantics cannot diverge;
+- ``SelfAttentionLayer`` → ``flash_attention``: the route re-enters the
+  layer's OWN forward with ``use_kernels=True`` so only the
+  softmax(QK^T)V core is swapped (dropout / projections / activation /
+  mask-zeroing stay single-sourced in the layer).
+
+The serving decode path routes through the functional twins
+:func:`maybe_flash_attention` (prefill) and
+:func:`maybe_decode_attention` (the paged single-token kernel), called
+from inside ``SelfAttentionLayer.prefill`` / ``decode_step`` when the
+decoder passes ``use_kernels=True``; :func:`decoder_envelopes` /
+:func:`autotune_decoder` plan and tune the bucket-ladder envelopes
+those steps bake.
 
 Selection happens at TRACE time (shapes are static under jit), so a
 routed executable bakes exactly one tuned layout — which is why the
@@ -31,6 +43,7 @@ from typing import List, Optional, Tuple
 from deeplearning4j_tpu.kernels import impls
 from deeplearning4j_tpu.kernels.registry import (
     REGISTRY,
+    AttentionEnvelope,
     MatmulEnvelope,
 )
 
@@ -101,6 +114,15 @@ def _env(m: int, k: int, n: int, dtype, act: str = "identity",
     # failing every routed trace at compile time
     return MatmulEnvelope(m=int(m), k=int(k), n=int(n), dtype=str(dtype),
                           backend=mode or capability(), act=act)
+
+
+def _attn_env(b: int, h: int, tq: int, tk: int, d: int, dtype,
+              causal: bool, masked: bool,
+              mode: Optional[str] = None) -> AttentionEnvelope:
+    return AttentionEnvelope(b=int(b), h=int(h), tq=int(tq), tk=int(tk),
+                             d=int(d), dtype=str(dtype),
+                             backend=mode or capability(),
+                             causal=bool(causal), masked=bool(masked))
 
 
 # --------------------------------------------------------------------------
@@ -220,18 +242,85 @@ def _route_fused_conv_bn(layer, params, state, x, train, rng):
     return layer.activation.apply(xhat).astype(x.dtype), new_state
 
 
+def maybe_flash_attention(q, k, v, key_mask=None, causal=False):
+    """Route head-split ``[B, H, T, D]`` attention through the tuned
+    flash kernel, or return ``None`` for the stock tier (untuned
+    envelope, unsupported shape, pallas unavailable). Selection happens
+    at trace time, so the caller's executable bakes one tuned
+    ``(block_q, block_k)`` layout."""
+    if capability() == "none":
+        return None
+    b, h, tq, d = q.shape
+    env = _attn_env(b, h, tq, k.shape[2], d, q.dtype, causal=causal,
+                    masked=key_mask is not None)
+    sel = REGISTRY.select("flash_attention", env)
+    if sel is None:
+        return None
+    out = sel.kernel.build(sel.env, sel.tiling)(q, k, v, key_mask)
+    _record_selected("flash_attention", sel.env)
+    return out
+
+
+def maybe_decode_attention(q, k_cache, v_cache, positions):
+    """Route single-token decode attention (``q [B, H, D]`` against
+    ``[B, S, H, D]`` caches valid through ``positions``) through the
+    tuned paged-gather kernel, or return ``None`` for the stock masked
+    full-cache read."""
+    if capability() == "none":
+        return None
+    b, h, d = q.shape
+    env = _attn_env(b, h, 1, k_cache.shape[1], d, q.dtype, causal=True,
+                    masked=False)
+    sel = REGISTRY.select("paged_decode_attention", env)
+    if sel is None:
+        return None
+    out = sel.kernel.build(sel.env, sel.tiling)(q, k_cache, v_cache,
+                                                positions)
+    _record_selected("paged_decode_attention", sel.env)
+    return out
+
+
+def _route_self_attention(layer, params, state, x, train, rng, mask):
+    from deeplearning4j_tpu.conf.layers_attention import SelfAttentionLayer
+
+    if type(layer).forward is not SelfAttentionLayer.forward:
+        return None
+    if x.ndim != 3 or layer.attention_impl not in ("auto", "flash"):
+        return None
+    b, t, e = x.shape
+    h = layer.n_heads if layer.project_input else 1
+    env = _attn_env(b, h, t, t, layer._head_size(e), x.dtype,
+                    causal=layer.causal, masked=mask is not None)
+    if REGISTRY.select("flash_attention", env) is None:
+        return None
+    # the layer's OWN forward with the kernel core swapped in — the
+    # dropout / projection / activation / mask-zeroing semantics stay
+    # single-sourced (the inner route re-derives this same envelope)
+    return layer.forward(params, state, x, train=train, rng=rng,
+                         mask=mask, use_kernels=True)
+
+
 def maybe_forward(layer, params, state, x, train=False, rng=None, **kw):
     """Run ``layer`` through a tuned registry kernel, or return ``None``
-    for the stock path. ``kw`` non-empty (mask-consuming layers) never
-    routes."""
-    if kw or capability() == "none":
+    for the stock path. ``kw`` beyond SelfAttentionLayer's ``mask``
+    never routes."""
+    if capability() == "none":
         return None
     from deeplearning4j_tpu.conf.layers import DenseLayer
+    from deeplearning4j_tpu.conf.layers_attention import SelfAttentionLayer
     from deeplearning4j_tpu.conf.layers_cnn import (
         ConvolutionLayer,
         FusedConvBN1x1,
     )
 
+    if isinstance(layer, SelfAttentionLayer):
+        mask = kw.pop("mask", None)
+        if kw:
+            return None
+        return _route_self_attention(layer, params, state, x, train, rng,
+                                     mask)
+    if kw:
+        return None
     if isinstance(layer, FusedConvBN1x1):
         return _route_fused_conv_bn(layer, params, state, x, train, rng)
     if isinstance(layer, ConvolutionLayer):
@@ -247,17 +336,28 @@ def maybe_vertex_forward(vertex, params, state, xs, train=False, rng=None,
     wrapped layer (applying its preprocessor first, exactly as
     ``LayerVertex.forward`` does). None = run the stock vertex forward
     (an unrouted preprocessor application here is dead code XLA
-    eliminates)."""
+    eliminates). A feature ``mask`` rides through only for
+    SelfAttentionLayer (the one routed class that consumes it)."""
+    mask = kw.pop("mask", None)
     if kw:
         return None
     layer = getattr(vertex, "layer", None)
     if layer is None or len(xs) != 1:
         return None
+    if mask is not None:
+        from deeplearning4j_tpu.conf.layers_attention import (
+            SelfAttentionLayer,
+        )
+
+        if not isinstance(layer, SelfAttentionLayer):
+            return None
     x = xs[0]
     pre = getattr(vertex, "preprocessor", None)
     if pre is not None:
         x, _ = pre.forward({}, {}, x, train=train, rng=None)
-    return maybe_forward(layer, params, state, x, train=train, rng=rng)
+    mkw = {"mask": mask} if mask is not None else {}
+    return maybe_forward(layer, params, state, x, train=train, rng=rng,
+                         **mkw)
 
 
 # --------------------------------------------------------------------------
@@ -271,12 +371,26 @@ def _layer_envelope(layer, itype, batch: int, dtype,
     the ``_route_*`` checks (same qualifiers, conf-derived geometry)."""
     from deeplearning4j_tpu.conf import inputs as it
     from deeplearning4j_tpu.conf.layers import DenseLayer
+    from deeplearning4j_tpu.conf.layers_attention import SelfAttentionLayer
     from deeplearning4j_tpu.conf.layers_cnn import (
         ConvolutionLayer,
         ConvolutionMode,
         FusedConvBN1x1,
     )
 
+    if isinstance(layer, SelfAttentionLayer) \
+            and type(layer).forward is SelfAttentionLayer.forward \
+            and isinstance(itype, it.Recurrent) \
+            and itype.timesteps and itype.timesteps > 0 \
+            and layer.attention_impl in ("auto", "flash"):
+        h = layer.n_heads if layer.project_input else 1
+        t = itype.timesteps
+        # masked=False: the planned fit envelope is the no-feature-mask
+        # path; a masked fit derives its own envelope at trace time
+        return ("flash_attention",
+                _attn_env(batch, h, t, t, layer._head_size(itype.size),
+                          dtype, causal=layer.causal, masked=False,
+                          mode=mode))
     if isinstance(layer, FusedConvBN1x1) \
             and type(layer).forward is FusedConvBN1x1.forward \
             and isinstance(itype, it.Convolutional):
@@ -366,6 +480,64 @@ def autotune_model(conf, batch: int, retune: bool = False,
 
     results = []
     for kid, env in plan_envelopes(conf, batch):
+        kernel = REGISTRY.get(kid)
+        if kernel is None or not kernel.supports(env):
+            continue
+        if not retune \
+                and REGISTRY.tuning.winner(kid, env.key) is not None:
+            continue
+        results.append(tuner_mod.autotune(kernel, env, **autotune_kw))
+    return results
+
+
+def decoder_envelopes(decoder,
+                      mode: Optional[str] = None
+                      ) -> List[Tuple[str, object]]:
+    """The attention ``(kernel_id, envelope)`` list a ``use_kernels``
+    :class:`nn.decoding.TransformerDecoder` routes: one paged-decode
+    envelope per KV bucket (the fused decode window runs at full
+    ``max_batch``) and one flash envelope per (prompt bucket, join
+    width) — cold prefill always attends under the prompt-length key
+    mask, so those envelopes are ``masked=True``. Derived from the
+    decoder's ladders and attention geometry; needs no params or
+    traffic."""
+    out: List[Tuple[str, object]] = []
+    seen = set()
+
+    def add(kid, env):
+        if (kid, env.key) not in seen:
+            seen.add((kid, env.key))
+            out.append((kid, env))
+
+    dtype = decoder._dtype
+    geoms = set()
+    for name, n_in in decoder._attn.items():
+        layer = decoder._layer(name)
+        geoms.add((layer.n_heads, layer._head_size(n_in)))
+    for h, d in sorted(geoms):
+        for s in decoder.kv_ladder:
+            add("paged_decode_attention",
+                _attn_env(decoder.max_batch, h, 1, s, d, dtype,
+                          causal=True, masked=False, mode=mode))
+        for tp in decoder.prompt_ladder:
+            for bp in decoder.join_ladder:
+                add("flash_attention",
+                    _attn_env(bp, h, tp, tp, d, dtype, causal=True,
+                              masked=True, mode=mode))
+    return out
+
+
+def autotune_decoder(decoder, retune: bool = False,
+                     **autotune_kw) -> List[object]:
+    """Autotune every attention envelope a ``use_kernels`` decoder would
+    route (paged decode per KV bucket, flash prefill per prompt/join
+    bucket pair). Run BEFORE ``warm_all``: selection is baked at trace
+    time, so executables compiled before tuning keep the stock core
+    until their key's digest token changes."""
+    from deeplearning4j_tpu.kernels import tuner as tuner_mod
+
+    results = []
+    for kid, env in decoder_envelopes(decoder):
         kernel = REGISTRY.get(kid)
         if kernel is None or not kernel.supports(env):
             continue

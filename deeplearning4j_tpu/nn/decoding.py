@@ -138,6 +138,7 @@ class TransformerDecoder:
         self.pad_id = int(pad_id)
         self._dtype = net._dtype
         self._fns: Dict[tuple, object] = {}
+        self.use_kernels = bool(getattr(net.conf, "use_kernels", False))
         conf = net.conf
         if len(conf.network_inputs) != 1 or len(conf.network_outputs) != 1:
             raise ValueError("KV-cached decode requires exactly one input "
@@ -257,6 +258,17 @@ class TransformerDecoder:
     def _graph_key(self):
         return self._net._graph_key()
 
+    def _ktag(self) -> str:
+        """The ``:kern:<id>:<digest>`` token string folded into every
+        step key (and ``_fns`` memo key): empty unless
+        ``conf.use_kernels``, so pre-subsystem keys are untouched. Keyed
+        off the tuning-cache epoch — a retune changes the digest, the
+        next getter call misses the memo, and the re-trace bakes the new
+        winner (a NEW executable, never a silently stale kernel)."""
+        from deeplearning4j_tpu import kernels
+
+        return kernels.cache_tag(self._net.conf)
+
     @property
     def net(self):
         """The wrapped ComputationGraph (shares live params — training
@@ -278,7 +290,8 @@ class TransformerDecoder:
             xs = [acts[src] for src in spec.inputs]
             if kind == "attn":
                 y, caches[name] = self._layer(name).decode_step(
-                    params[name], xs[0], caches[name], positions)
+                    params[name], xs[0], caches[name], positions,
+                    use_kernels=self.use_kernels)
             elif kind == "pos":
                 y = xs[0] + params[name]["P"][positions]
             elif kind == "head":
@@ -304,7 +317,8 @@ class TransformerDecoder:
             xs = [acts[src] for src in spec.inputs]
             if kind == "attn":
                 y, k, v = self._layer(name).prefill(
-                    params[name], xs[0], key_mask)
+                    params[name], xs[0], key_mask,
+                    use_kernels=self.use_kernels)
                 kv[name] = {"k": k, "v": v}
             elif kind == "head":
                 full = self._layer(name).pre_output(params[name], xs[0])
@@ -369,7 +383,8 @@ class TransformerDecoder:
             if kind == "attn":
                 y, k, v = self._layer(name).prefill_suffix(
                     params[name], xs[0], prefix_kv[name]["k"],
-                    prefix_kv[name]["v"], prefix_mask, key_mask)
+                    prefix_kv[name]["v"], prefix_mask, key_mask,
+                    use_kernels=self.use_kernels)
                 kv[name] = {"k": k, "v": v}
             elif kind == "pos":
                 idx = jnp.clip(prefix_lens[:, None] + jnp.arange(ts),
@@ -394,14 +409,15 @@ class TransformerDecoder:
         DONATED. Returns ``(state', tokens [K, B], emitted [K, B])`` —
         ``emitted[i, b]`` is True where row b was live going into step i
         (the host appends exactly those tokens)."""
-        key = ("decode", s, k)
+        tag = self._ktag()
+        key = ("decode", s, k, tag)
         if key not in self._fns:
             def fn(params, state):
                 return self._decode_window(params, state, k)
 
             self._fns[key] = aot_cache.wrap(
                 jax.jit(fn, donate_argnums=(1,)), self._graph_key(),
-                f"decode_step:s{s}:k{k}")
+                f"decode_step:s{s}:k{k}{tag}")
         return self._fns[key]
 
     def _decode_window(self, params, state, k):
@@ -436,7 +452,8 @@ class TransformerDecoder:
         dispatches per iteration, which is most of speculation's cost
         on a dispatch-bound host. State DONATED; the cursor arrays come
         from the TARGET's state and are not."""
-        key = ("spec_draft", s, k)
+        tag = self._ktag()
+        key = ("spec_draft", s, k, tag)
         if key not in self._fns:
             def fn(params, state, tokens, positions, active):
                 st = dict(state, tokens=tokens, positions=positions,
@@ -445,14 +462,15 @@ class TransformerDecoder:
 
             self._fns[key] = aot_cache.wrap(
                 jax.jit(fn, donate_argnums=(1,)), self._graph_key(),
-                f"spec_draft:s{s}:k{k}")
+                f"spec_draft:s{s}:k{k}{tag}")
         return self._fns[key]
 
     def prompt_fn(self, tp: int, bp: int):
         """Prefill forward for a compact ``[bp, tp]`` group of joining
         prompts: kv blocks + sampled first token + in-graph liveness
         (EOS-on-first-token / max_new == 1 rows are born retired)."""
-        key = ("prompt", tp, bp)
+        tag = self._ktag()
+        key = ("prompt", tp, bp, tag)
         if key not in self._fns:
             def fn(params, prompts, lengths, max_new, eos, temps, rng):
                 logits, kv = self._run_prompt(params, prompts, lengths)
@@ -462,7 +480,8 @@ class TransformerDecoder:
                 return kv, tok, active, rng_next
 
             self._fns[key] = aot_cache.wrap(
-                jax.jit(fn), self._graph_key(), f"gen_prompt:t{tp}:b{bp}")
+                jax.jit(fn), self._graph_key(),
+                f"gen_prompt:t{tp}:b{bp}{tag}")
         return self._fns[key]
 
     def join_fn(self, s: int, tp: int, bp: int):
@@ -471,7 +490,8 @@ class TransformerDecoder:
         dropped by the scatter). State DONATED — this is the ``prefill*``
         kind the PRG201 donation audit proves writes the KV cache in
         place."""
-        key = ("join", s, tp, bp)
+        tag = self._ktag()
+        key = ("join", s, tp, bp, tag)
         if key not in self._fns:
             def fn(state, kv, rows, tok, lengths, max_new, eos, temps,
                    rng, active):
@@ -499,7 +519,7 @@ class TransformerDecoder:
 
             self._fns[key] = aot_cache.wrap(
                 jax.jit(fn, donate_argnums=(0,)), self._graph_key(),
-                f"prefill_join:s{s}:t{tp}:b{bp}")
+                f"prefill_join:s{s}:t{tp}:b{bp}{tag}")
         return self._fns[key]
 
     def grow_fn(self, s: int, s2: int):
@@ -508,7 +528,8 @@ class TransformerDecoder:
         Not donated: the cache shapes differ, so XLA could not alias
         them anyway — the old buffers free by refcount when the engine
         swaps states."""
-        key = ("grow", s, s2)
+        tag = self._ktag()
+        key = ("grow", s, s2, tag)
         if key not in self._fns:
             def fn(state):
                 pad = ((0, 0), (0, s2 - s), (0, 0), (0, 0))
@@ -518,21 +539,22 @@ class TransformerDecoder:
                 return dict(state, caches=caches)
 
             self._fns[key] = aot_cache.wrap(
-                jax.jit(fn), self._graph_key(), f"kv_grow:s{s}:{s2}")
+                jax.jit(fn), self._graph_key(), f"kv_grow:s{s}:{s2}{tag}")
         return self._fns[key]
 
     def release_fn(self, s: int):
         """Deactivate rows in-graph (deadline aborts, breaker resets):
         ``active &= keep``. State donated; everything else passes
         through aliased."""
-        key = ("release", s)
+        tag = self._ktag()
+        key = ("release", s, tag)
         if key not in self._fns:
             def fn(state, keep):
                 return dict(state, active=state["active"] & keep)
 
             self._fns[key] = aot_cache.wrap(
                 jax.jit(fn, donate_argnums=(0,)), self._graph_key(),
-                f"gen_release:s{s}")
+                f"gen_release:s{s}{tag}")
         return self._fns[key]
 
     # --- speculative decoding (draft K, verify K+1 in one launch) ----------
@@ -557,7 +579,8 @@ class TransformerDecoder:
         ``(state', tokens [K+1, B], emitted [K+1, B],
         accepted [B])`` — ``accepted`` counts the drafted tokens that
         survived (emitted minus the always-emitted first position)."""
-        key = ("spec_verify", s, k)
+        tag = self._ktag()
+        key = ("spec_verify", s, k, tag)
         if key not in self._fns:
             w = k + 1
 
@@ -617,7 +640,7 @@ class TransformerDecoder:
 
             self._fns[key] = aot_cache.wrap(
                 jax.jit(fn, donate_argnums=(1,)), self._graph_key(),
-                f"spec_verify:s{s}:k{k}")
+                f"spec_verify:s{s}:k{k}{tag}")
         return self._fns[key]
 
     def spec_sync_fn(self, s: int):
@@ -628,7 +651,8 @@ class TransformerDecoder:
         reconciliation is pure bookkeeping — set tokens/positions/active
         to the target's and let the mask strand the rejected tail. State
         DONATED; caches pass through aliased."""
-        key = ("spec_sync", s)
+        tag = self._ktag()
+        key = ("spec_sync", s, tag)
         if key not in self._fns:
             def fn(state, tokens, positions, active):
                 return dict(state, tokens=tokens, positions=positions,
@@ -636,7 +660,7 @@ class TransformerDecoder:
 
             self._fns[key] = aot_cache.wrap(
                 jax.jit(fn, donate_argnums=(0,)), self._graph_key(),
-                f"spec_sync:s{s}")
+                f"spec_sync:s{s}{tag}")
         return self._fns[key]
 
     # --- prefix-cache executables ------------------------------------------
@@ -649,7 +673,8 @@ class TransformerDecoder:
         prefix length. State DONATED — the audit-visible in-place cache
         write that makes a hit O(pages copied), not O(prefix
         re-projected)."""
-        key = ("prefix_attach", s, tpre, bp)
+        tag = self._ktag()
+        key = ("prefix_attach", s, tpre, bp, tag)
         if key not in self._fns:
             def fn(state, prefix_kv, rows, prefix_lens):
                 caches = {}
@@ -667,7 +692,7 @@ class TransformerDecoder:
 
             self._fns[key] = aot_cache.wrap(
                 jax.jit(fn, donate_argnums=(0,)), self._graph_key(),
-                f"prefix_attach:s{s}:t{tpre}:b{bp}")
+                f"prefix_attach:s{s}:t{tpre}:b{bp}{tag}")
         return self._fns[key]
 
     def suffix_prompt_fn(self, ts: int, tpre: int, bp: int):
@@ -676,7 +701,8 @@ class TransformerDecoder:
         the shared prefix pages (see :meth:`_run_suffix`). NOT donated —
         the prefix pages are shared, refcounted buffers that other
         requests may attach concurrently."""
-        key = ("suffix_prompt", ts, tpre, bp)
+        tag = self._ktag()
+        key = ("suffix_prompt", ts, tpre, bp, tag)
         if key not in self._fns:
             def fn(params, suffix, suf_lens, prefix_kv, prefix_lens,
                    max_new, eos, temps, rng):
@@ -689,7 +715,7 @@ class TransformerDecoder:
 
             self._fns[key] = aot_cache.wrap(
                 jax.jit(fn), self._graph_key(),
-                f"gen_prompt_sfx:t{ts}:p{tpre}:b{bp}")
+                f"gen_prompt_sfx:t{ts}:p{tpre}:b{bp}{tag}")
         return self._fns[key]
 
     def suffix_join_fn(self, s: int, ts: int, bp: int):
@@ -702,7 +728,8 @@ class TransformerDecoder:
         group slots write back what the target row already holds (a
         gather/select no-op) because ``dynamic_update_slice`` clamps
         instead of dropping. State DONATED."""
-        key = ("suffix_join", s, ts, bp)
+        tag = self._ktag()
+        key = ("suffix_join", s, ts, bp, tag)
         if key not in self._fns:
             def fn(state, kv, rows, tok, prefix_lens, lengths, max_new,
                    eos, temps, rng, active):
@@ -744,7 +771,7 @@ class TransformerDecoder:
 
             self._fns[key] = aot_cache.wrap(
                 jax.jit(fn, donate_argnums=(0,)), self._graph_key(),
-                f"prefix_join:s{s}:t{ts}:b{bp}")
+                f"prefix_join:s{s}:t{ts}:b{bp}{tag}")
         return self._fns[key]
 
     # --- warmup -------------------------------------------------------------

@@ -116,6 +116,117 @@ def chunk_decode_attention(q, k_cache, v_cache, positions, scale=None):
     return jnp.einsum("bhts,bshd->bthd", p, v_cache)
 
 
+def _paged_decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_sc, l_sc, acc_sc, *, sm, page, npages):
+    """Online-softmax decode over KV pages. Grid (batch, page); the page
+    dim is innermost/sequential so the [h, ·] scratch accumulates across
+    pages. ``pos_ref`` is scalar-prefetched: the kernel AND the index
+    maps read it before the body runs, so dead pages (wholly past
+    ``positions[b]``) skip both their DMA (index-map redirect to page 0,
+    same trick as the flash causal skip) and their compute
+    (``pl.when``) — O(used pages) work per row, not O(max_len)."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    pos = pos_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    @pl.when(j * page <= pos)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)           # [h, d]
+        k = k_ref[0].astype(jnp.float32)           # [page, h, d]
+        v = v_ref[0].astype(jnp.float32)           # [page, h, d]
+        s = jnp.sum(q[None] * k, axis=2).T * sm    # [h, page]
+        # boundary page: slots past positions[b] masked exactly like the
+        # masked full-cache read (exp underflows to 0.0 — garbage in
+        # unwritten slots can never leak)
+        slot = j * page + jax.lax.broadcasted_iota(jnp.int32, (1, page), 1)
+        s = jnp.where(slot <= pos, s, NEG_INF)
+        m_prev, l_prev = m_sc[...], l_sc[...]
+        m_next = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
+        p = jnp.exp(s - _rep(m_next, page))
+        alpha = jnp.exp(m_prev - m_next)
+        l_corr = alpha * l_prev
+        l_next = jnp.sum(p, axis=1)[:, None] + l_corr
+        m_sc[...] = m_next
+        l_sc[...] = l_next
+        # pre-normalized accumulator (flash-kernel convention): rescale
+        # by 1/l every step so the final store is a cast
+        l_inv = jnp.where(l_next == 0.0, 1.0, 1.0 / l_next)
+        d = acc_sc.shape[1]
+        acc_sc[...] *= _rep(l_corr * l_inv, d)
+        pv = jnp.sum(p.T[:, :, None] * v, axis=0)  # [h, d]
+        acc_sc[...] += pv * _rep(l_inv, d)
+
+    @pl.when(j == npages - 1)
+    def _store():
+        o_ref[0] = acc_sc[...].astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_cache, v_cache, positions, scale=None,
+                           page: int = 64,
+                           interpret: Optional[bool] = None):
+    """:func:`decode_attention` as a Pallas kernel gathering KV **pages**
+    in-kernel: ``page``-slot blocks of the cache stream HBM→VMEM one DMA
+    per page, pages wholly past ``positions[b]`` are skipped at the DMA
+    level (scalar-prefetched positions drive the index map), and the
+    boundary page masks per-slot. Same signature and semantics as the
+    masked full-cache read — ``q: [batch, heads, head_dim]``,
+    ``k_cache/v_cache: [batch, max_len, heads, head_dim]``,
+    ``positions: [batch]`` — and bitwise the same masking rule, so the
+    parity tests pin it directly against :func:`decode_attention`.
+
+    ``page`` must divide ``max_len`` (the pow2 bucket ladder guarantees
+    a divisor exists; the autotuner only proposes legal pages).
+    ``interpret=None`` auto-enables the Pallas interpreter off-TPU."""
+    if not _HAS_PLTPU:
+        raise NotImplementedError("pallas tpu dialect unavailable")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, s, h, d = k_cache.shape
+    page = min(int(page), s)
+    if s % page:
+        raise ValueError(f"page {page} must divide cache length {s}")
+    npages = s // page
+    sm = _scale(q, scale)
+    pos = positions.astype(jnp.int32)
+
+    def q_map(b_, j, p):
+        return (b_, 0, 0)
+
+    def kv_map(b_, j, p):
+        live = j * page <= p[b_]
+        return (b_, jax.lax.select(live, j, 0), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, npages),
+        in_specs=[pl.BlockSpec((1, h, d), q_map),
+                  pl.BlockSpec((1, page, h, d), kv_map),
+                  pl.BlockSpec((1, page, h, d), kv_map)],
+        out_specs=pl.BlockSpec((1, h, d), q_map),
+        scratch_shapes=[pltpu.VMEM((h, _LANES), jnp.float32),
+                        pltpu.VMEM((h, _LANES), jnp.float32),
+                        pltpu.VMEM((h, d), jnp.float32)],
+    )
+    params = None
+    if not interpret and _HAS_PLTPU:
+        params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"))
+    return pl.pallas_call(
+        functools.partial(_paged_decode_kernel, sm=sm, page=page,
+                          npages=npages),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        compiler_params=params,
+        interpret=interpret,
+    )(pos, q, k_cache, v_cache)
+
+
 def cache_update(cache, new, positions):
     """Write a token block ``new: [batch, t, heads, head_dim]`` (t = 1
     for ordinary decode, t = K+1 for a speculative verify window) into

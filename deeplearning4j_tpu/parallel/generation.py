@@ -363,7 +363,7 @@ class GenerationEngine:
         return self.result(self.submit(tokens, **kw))
 
     # --- warmup / stats -----------------------------------------------------
-    def warmup(self) -> dict:
+    def warmup(self, autotune_kernels: bool = False, **autotune_kw) -> dict:
         """Pre-compile every (KV bucket × K) decode window, every
         (prompt bucket × join bucket) prefill, every join/grow hop —
         compile-only, no dispatch. After this the zero-recompile
@@ -372,7 +372,19 @@ class GenerationEngine:
         With a draft model the verifier (``spec_verify``) and both sync
         ops are warmed too; with the prefix cache every feasible
         attach/suffix-prefill/suffix-join geometry is — so mixed
-        hit/miss and accept/reject traffic stays zero-recompile."""
+        hit/miss and accept/reject traffic stays zero-recompile.
+
+        ``autotune_kernels`` (with ``conf.use_kernels``) tunes every
+        bucket-ladder attention envelope FIRST, so the warmed
+        executables bake the paged-decode / flash-prefill winners —
+        tuning after warmup would mint new ``kern:`` keys and re-warm
+        from scratch."""
+        if autotune_kernels and self._dec.use_kernels:
+            from deeplearning4j_tpu import kernels
+
+            kernels.autotune_decoder(self._dec, **autotune_kw)
+            if self._draft_dec is not None:
+                kernels.autotune_decoder(self._draft_dec, **autotune_kw)
         k = self.config.fused_steps
         out = self._dec.warm_all(
             fused_steps=(1, k),
@@ -382,6 +394,8 @@ class GenerationEngine:
         if self._draft_dec is not None:
             out["draft"] = self._draft_dec.warm_all(
                 fused_steps=(1, k), spec_draft=(self._spec_k,))
+        out["kernels"] = {"enabled": self._dec.use_kernels,
+                          "tag": self._dec._ktag()}
         return out
 
     def queue_depth(self) -> int:
@@ -409,6 +423,8 @@ class GenerationEngine:
         out["buckets"] = {"kv": list(self._dec.kv_ladder),
                           "prompt": list(self._dec.prompt_ladder),
                           "join": list(self._dec.join_ladder)}
+        out["kernels"] = {"enabled": self._dec.use_kernels,
+                          "tag": self._dec._ktag()}
         out["aot_cache"] = aot_cache.stats()
         if self._prefix is not None:
             out["prefix_cache"] = self._prefix.stats()

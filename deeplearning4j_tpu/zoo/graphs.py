@@ -956,7 +956,7 @@ class TransformerEncoder(GraphZooModel):
                  attention_impl: str = "auto", causal: bool = False,
                  moe_experts: int = 0, moe_top_k: int = 2,
                  moe_capacity_factor: float = 1.25,
-                 lm_head: bool = False):
+                 lm_head: bool = False, use_kernels: bool = False):
         """``vocab_size``>0: token-id inputs through an embedding;
         0: continuous ``[batch, time, embed_dim]`` inputs.
 
@@ -972,7 +972,11 @@ class TransformerEncoder(GraphZooModel):
         vocabulary (requires ``vocab_size > 0`` and ``causal=True``).
         This is the configuration :meth:`decoder` serves with a KV cache
         (``nn.decoding.TransformerDecoder`` /
-        ``parallel.generation.GenerationEngine``)."""
+        ``parallel.generation.GenerationEngine``).
+
+        ``use_kernels=True`` opts the conf into registry kernel routing
+        (tuned flash-attention prefill / paged decode attention plus the
+        matmul-class fusions); untuned envelopes stay stock XLA."""
         self.num_classes = num_classes
         self.vocab_size = vocab_size
         self.embed_dim = embed_dim
@@ -988,6 +992,7 @@ class TransformerEncoder(GraphZooModel):
         self.moe_top_k = moe_top_k
         self.moe_capacity_factor = moe_capacity_factor
         self.lm_head = lm_head
+        self.use_kernels = use_kernels
         if lm_head and not (vocab_size and causal):
             raise ValueError("lm_head=True requires vocab_size > 0 and "
                              "causal=True (a language model decodes token "
@@ -1007,6 +1012,7 @@ class TransformerEncoder(GraphZooModel):
         g = (NeuralNetConfiguration.builder()
              .seed(self.seed).updater(self.updater)
              .weight_init(WeightInit.XAVIER)
+             .use_kernels(self.use_kernels)
              .graph_builder()
              .add_inputs("input")
              .set_input_types(InputType.recurrent(

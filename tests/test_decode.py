@@ -668,6 +668,139 @@ def test_generation_panel_includes_prefix_and_spec():
     assert "dl4j_spec_accepted_tokens" in panel
 
 
+# --- Pallas attention kernels through the decode path -----------------------
+
+@functools.lru_cache(maxsize=None)
+def _kern_decoder() -> TransformerDecoder:
+    """The target model with ``use_kernels=True``: same weights (seed 7)
+    as ``_decoder()``, attention envelopes tuned BEFORE warm_all so the
+    warmed executables bake the winners and carry the ``kern:`` tokens."""
+    from deeplearning4j_tpu import kernels
+
+    m = TransformerEncoder(vocab_size=VOCAB, embed_dim=16, n_heads=2,
+                           n_layers=2, max_len=MAX_LEN, causal=True,
+                           lm_head=True, seed=7, use_kernels=True)
+    dec = m.decoder(max_batch=MAX_BATCH, kv_bucket_min=16,
+                    prompt_bucket_min=4)
+    kernels.autotune_decoder(dec, max_candidates=1, trials=1)
+    dec.warm_all(fused_steps=(1, K))
+    return dec
+
+
+def _kern_engine(**over):
+    cfg = dict(max_batch=MAX_BATCH, fused_steps=K, kv_bucket_min=16,
+               prompt_bucket_min=4)
+    cfg.update(over)
+    return GenerationEngine(_kern_decoder(), GenerationConfig(**cfg))
+
+
+def test_kernels_decoder_token_identical_and_zero_recompile():
+    """use_kernels greedy decode (flash prefill + paged decode steps)
+    is token-identical to the stock decoder for every prompt, at K=1
+    and fused K, with ZERO recompiles after warmup — and every step key
+    carries both attention kernel tokens."""
+    dec = _decoder()
+    kdec = _kern_decoder()
+    tag = kdec._ktag()
+    assert "kern:flash_attention:" in tag
+    assert "kern:paged_decode_attention:" in tag
+    prompts = [[3, 9, 1], [5, 6, 7, 8, 2, 11], [1], [9] * 12]
+    mns = [6, 9, 4, 8]
+    m0 = aot_cache.stats()["misses"]
+    for p, mn in zip(prompts, mns):
+        ref = dec.generate(p, mn)
+        assert kdec.generate(p, mn) == ref
+        assert kdec.generate(p, mn, fused_steps=K) == ref
+    assert aot_cache.stats()["misses"] == m0, \
+        "kernel-routed decode recompiled after warmup"
+
+
+def test_kernels_engine_continuous_matches_sequential():
+    """Continuous batching over the kernel-routed decoder: mixed
+    prompt/output lengths churn rows at ragged per-row cache occupancy
+    (the paged gather's DMA-skip sees every row at a different page
+    count) and each sequence equals the STOCK sequential reference."""
+    dec = _decoder()
+    prompts = [[3, 9, 1], [5, 6, 7, 8, 2, 11], [1], [14, 13, 12, 2],
+               [9, 9, 2, 3, 4, 5, 6, 1]]
+    mns = [6, 9, 4, 12, 5]
+    refs = [dec.generate(p, mn) for p, mn in zip(prompts, mns)]
+    with _kern_engine() as eng:
+        warm = eng.warmup()
+        assert warm["kernels"]["enabled"]
+        assert "kern:flash_attention:" in warm["kernels"]["tag"]
+        m0 = aot_cache.stats()["misses"]
+        reqs = [eng.submit(p, max_new_tokens=mn)
+                for p, mn in zip(prompts, mns)]
+        outs = [eng.result(r) for r in reqs]
+        st = eng.stats()
+    assert outs == refs
+    assert aot_cache.stats()["misses"] == m0
+    assert st["kernels"]["enabled"] and "kern:" in st["kernels"]["tag"]
+
+
+def test_kernels_prefix_attached_pages_token_identical():
+    """Prefix-cache hits attach cached KV pages and decode continues at
+    an offset position — the paged kernel's gather must read attached
+    pages exactly like prefilled ones (cold run, hot run, and the stock
+    sequential reference all agree)."""
+    dec = _decoder()
+    shared = [3, 1, 4, 1, 5, 9, 2, 6]
+    prompts = [shared + [i + 1, i + 2] for i in range(4)]
+    refs = [dec.generate(p, 6) for p in prompts]
+    with _kern_engine(prefix_cache=True, prefix_page=4) as eng:
+        cold = [eng.generate(p, max_new_tokens=6) for p in prompts]
+        hot = [eng.generate(p, max_new_tokens=6) for p in prompts]
+        st = eng.stats()
+    assert cold == refs and hot == refs
+    assert st["prefix_cache"]["hits"] >= 4
+
+
+def test_kernel_bearing_decode_kinds_donate_and_audit_clean():
+    """PRG201/PRG207 satellite: every kernel-bearing decode/prefill
+    executable compiled this process donates its KV state and carries
+    zero lint findings (PRG207 verified the tokens at compile time)."""
+    from deeplearning4j_tpu.analysis import program
+
+    _kern_decoder()  # ensure the executables exist in this process
+    audit = program.donation_audit()
+    kinds = {k: v for k, v in audit.items()
+             if "kern:" in k[1]
+             and k[1].startswith(("decode_step", "prefill"))}
+    assert kinds, "no kernel-bearing decode executable was audited"
+    for key, rep in kinds.items():
+        assert rep["aliases"] > 0, f"{key[1]} does not donate its KV state"
+        assert rep["findings"] == 0, f"{key[1]} has lint findings"
+
+
+def test_kernels_retune_mints_new_decoder_executable():
+    """A retune bumps the tuning digest, every ``kern:``-keyed step
+    re-mints (AOT misses), and the retuned paged kernel is still
+    token-identical. Runs LAST of the kernel-decode tests: it leaves
+    the tuning table mutated."""
+    from deeplearning4j_tpu import kernels
+
+    dec = _decoder()
+    kdec = _kern_decoder()
+    prompt = [2, 4, 6]
+    ref = dec.generate(prompt, 5)
+    assert kdec.generate(prompt, 5) == ref
+    tag0 = kdec._ktag()
+    kid = "paged_decode_attention"
+    env = next(e for k_, e in kernels.decoder_envelopes(kdec)
+               if k_ == kid and e.tk == 16)
+    cur = tuple(kernels.TUNING.winner(kid, env.key)["tiling"])
+    alt = next(tuple(t) for t in
+               kernels.REGISTRY.get(kid).candidates(env)
+               if tuple(t) != cur)
+    m0 = aot_cache.stats()["misses"]
+    kernels.TUNING.record(kid, env.key, alt, 0.0)
+    assert kdec._ktag() != tag0
+    assert kdec.generate(prompt, 5) == ref
+    assert aot_cache.stats()["misses"] > m0, \
+        "a retuned kernel must be a NEW executable"
+
+
 def test_donation_audit_covers_spec_and_prefix_kinds():
     """PRG201 satellite: the new decode-state consumers are in the
     audit's train-kind set, every compiled one donates, and the suffix

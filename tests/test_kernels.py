@@ -30,7 +30,10 @@ from deeplearning4j_tpu.conf.layers_cnn import (
 from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
 from deeplearning4j_tpu.conf.updaters import Adam
 from deeplearning4j_tpu.datasets.dataset import DataSet
-from deeplearning4j_tpu.kernels.registry import MatmulEnvelope
+from deeplearning4j_tpu.kernels.registry import (
+    AttentionEnvelope,
+    MatmulEnvelope,
+)
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.optimize import aot_cache
 
@@ -503,6 +506,220 @@ def test_graph_vertex_routes_and_parity():
 
 
 # --------------------------------------------------------------------------
+# attention kernels: flash prefill + paged decode
+# --------------------------------------------------------------------------
+
+def _attn_env(b=2, h=2, tq=16, tk=16, d=8, dtype="float32", causal=True,
+              masked=False):
+    return AttentionEnvelope(b=b, h=h, tq=tq, tk=tk, d=d, dtype=dtype,
+                             backend="interpret", causal=causal,
+                             masked=masked)
+
+
+@pytest.mark.parametrize("causal,masked", [(True, False), (False, False),
+                                           (True, True)])
+def test_flash_attention_parity_f32(causal, masked):
+    env = _attn_env(causal=causal, masked=masked)
+    k = kernels.REGISTRY.get("flash_attention")
+    assert k.supports(env)
+    args = k.make_inputs(env, seed=3)
+    ref = np.asarray(k.reference(env)(*args))
+    for tiling in k.candidates(env, limit=4):
+        got = np.asarray(k.build(env, tiling)(*args))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_flash_attention_parity_bf16():
+    env = _attn_env(dtype="bfloat16")
+    k = kernels.REGISTRY.get("flash_attention")
+    args = k.make_inputs(env, seed=4)
+    ref = np.asarray(k.reference(env)(*args), np.float32)
+    got = np.asarray(k.build(env, k.candidates(env, limit=1)[0])(*args),
+                     np.float32)
+    np.testing.assert_allclose(got, ref, rtol=0.05, atol=0.1)
+
+
+def test_flash_attention_tall_query_parity():
+    """Tq != Tk (the prefill_suffix join shape): the kernel's single
+    off = Tk - Tq causal rule must match the reference exactly."""
+    env = _attn_env(tq=8, tk=24, masked=True)
+    k = kernels.REGISTRY.get("flash_attention")
+    args = k.make_inputs(env, seed=5)
+    ref = np.asarray(k.reference(env)(*args))
+    got = np.asarray(k.build(env, k.candidates(env, limit=1)[0])(*args))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_flash_gradient_parity():
+    """The custom-VJP backward (blockwise recompute from the saved
+    row-max/row-sum stats) tracks the reference gradients — the pin the
+    train-path routing rests on."""
+    env = _attn_env(tq=16, tk=16)
+    k = kernels.REGISTRY.get("flash_attention")
+    tiling = k.candidates(env, limit=1)[0]
+    q, kk, v = k.make_inputs(env, seed=6)
+
+    def loss(fn):
+        return lambda q, kk, v: jnp.sum(fn(q, kk, v) ** 2)
+
+    gk = jax.grad(loss(k.build(env, tiling)), argnums=(0, 1, 2))(q, kk, v)
+    gr = jax.grad(loss(k.reference(env)), argnums=(0, 1, 2))(q, kk, v)
+    for a, b in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_paged_decode_parity_f32_per_candidate():
+    env = _attn_env(b=4, tq=1, tk=32)
+    k = kernels.REGISTRY.get("paged_decode_attention")
+    assert k.supports(env)
+    args = k.make_inputs(env, seed=7)
+    ref = np.asarray(k.reference(env)(*args))
+    cands = [tuple(t) for t in k.candidates(env)]
+    assert len(cands) >= 2  # 32 admits at least pages 32, 16, 8
+    for tiling in cands:
+        got = np.asarray(k.build(env, tiling)(*args))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_paged_decode_ragged_occupancy_parity():
+    """Per-row positions at every occupancy extreme — empty-but-one,
+    page-boundary, mid-page, full cache — match the masked full-cache
+    read for every legal page size."""
+    env = _attn_env(b=5, tq=1, tk=32)
+    k = kernels.REGISTRY.get("paged_decode_attention")
+    q, kc, vc, _ = k.make_inputs(env, seed=8)
+    pos = jnp.asarray([0, 7, 8, 21, 31], jnp.int32)
+    ref = np.asarray(k.reference(env)(q, kc, vc, pos))
+    for tiling in k.candidates(env):
+        got = np.asarray(k.build(env, tiling)(q, kc, vc, pos))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_paged_decode_parity_bf16():
+    env = _attn_env(b=2, tq=1, tk=16, dtype="bfloat16")
+    k = kernels.REGISTRY.get("paged_decode_attention")
+    args = k.make_inputs(env, seed=9)
+    ref = np.asarray(k.reference(env)(*args), np.float32)
+    got = np.asarray(k.build(env, k.candidates(env, limit=1)[0])(*args),
+                     np.float32)
+    np.testing.assert_allclose(got, ref, rtol=0.05, atol=0.1)
+
+
+def test_attention_routing_untuned_is_stock():
+    """Empty tuning cache: both attention entry points decline (None)
+    — the caller runs stock XLA, zero behavior change."""
+    env = _attn_env()
+    k = kernels.REGISTRY.get("flash_attention")
+    q, kk, v = k.make_inputs(env, seed=10)
+    assert kernels.maybe_flash_attention(q, kk, v, causal=True) is None
+    penv = _attn_env(b=2, tq=1, tk=16)
+    pk = kernels.REGISTRY.get("paged_decode_attention")
+    q1, kc, vc, pos = pk.make_inputs(penv, seed=10)
+    assert kernels.maybe_decode_attention(q1, kc, vc, pos) is None
+
+
+def test_attention_routing_tuned_selects_and_records():
+    from deeplearning4j_tpu import telemetry
+
+    telemetry.reset()
+    env = _attn_env()
+    k = kernels.REGISTRY.get("flash_attention")
+    kernels.autotune(k, env, max_candidates=2, trials=1)
+    q, kk, v = k.make_inputs(env, seed=11)
+    out = kernels.maybe_flash_attention(q, kk, v, causal=True)
+    assert out is not None
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(k.reference(env)(q, kk, v)),
+                               rtol=1e-5, atol=1e-5)
+    penv = _attn_env(b=2, tq=1, tk=16)
+    pk = kernels.REGISTRY.get("paged_decode_attention")
+    kernels.autotune(pk, penv, max_candidates=2, trials=1)
+    q1, kc, vc, pos = pk.make_inputs(penv, seed=11)
+    pout = kernels.maybe_decode_attention(q1, kc, vc, pos)
+    assert pout is not None
+    np.testing.assert_allclose(
+        np.asarray(pout), np.asarray(pk.reference(penv)(q1, kc, vc, pos)),
+        rtol=1e-5, atol=1e-5)
+    snap = telemetry.REGISTRY.snapshot(run_collectors=False)
+    assert any(k_.startswith('dl4j_kernel_selected_total{'
+                             'kernel="flash_attention"') for k_ in snap)
+    assert any(k_.startswith('dl4j_kernel_selected_total{'
+                             'kernel="paged_decode_attention"')
+               for k_ in snap)
+
+
+def _attn_net(use_k, seed=11):
+    from deeplearning4j_tpu.zoo.graphs import TransformerEncoder
+
+    return TransformerEncoder(num_classes=3, embed_dim=16, n_heads=2,
+                              n_layers=1, max_len=8, seed=seed,
+                              use_kernels=use_k).init()
+
+
+def test_self_attention_layer_train_parity():
+    """The train-fit acceptance pin: a transformer classifier with the
+    routed flash kernel tracks the stock path through eval AND through
+    optimizer steps (forward + custom-VJP backward in the real loss)."""
+    stock = _attn_net(False)
+    kern = _attn_net(True)
+    for kid, env in kernels.plan_envelopes(kern.conf, 4):
+        k = kernels.REGISTRY.get(kid)
+        if k and k.supports(env):
+            kernels.autotune(k, env, max_candidates=1, trials=1)
+    assert "kern:flash_attention:" in kern._ktag()
+    rng = np.random.default_rng(0)
+    x = np.asarray(rng.normal(size=(4, 8, 16)), np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4)]
+    np.testing.assert_allclose(np.asarray(kern.output(x)),
+                               np.asarray(stock.output(x)),
+                               rtol=1e-5, atol=1e-5)
+    stock.fit(x, y, epochs=3)
+    kern.fit(x, y, epochs=3)
+    assert _max_delta(stock.params, kern.params) < 1e-3
+
+
+def test_self_attention_untuned_is_bitwise_stock():
+    stock = _attn_net(False, seed=12)
+    kern = _attn_net(True, seed=12)
+    rng = np.random.default_rng(1)
+    x = np.asarray(rng.normal(size=(4, 8, 16)), np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4)]
+    stock.fit(x, y, epochs=2)
+    kern.fit(x, y, epochs=2)
+    for a, b in zip(_leaves(stock.params), _leaves(kern.params)):
+        assert np.array_equal(a, b)
+
+
+@pytest.mark.slow
+def test_flash_autotune_full_sweep():
+    """The heaviest tuning leg: the full (block_q, block_k) candidate
+    space at a shape big enough to split blocks, through the interpreter.
+    Slow-marked; tier-1 covers the limited sweeps above."""
+    env = _attn_env(b=1, h=1, tq=256, tk=256, d=8)
+    k = kernels.REGISTRY.get("flash_attention")
+    cands = [tuple(t) for t in k.candidates(env)]
+    assert len(cands) >= 2
+    res = kernels.autotune(k, env, trials=1)
+    assert tuple(res.tiling) in cands
+    sel = kernels.REGISTRY.select("flash_attention", env)
+    assert sel is not None and tuple(sel.tiling) == tuple(res.tiling)
+
+
+def test_cache_tag_memoized_against_epoch():
+    """cache_tag() is a per-dispatch hot path: repeated calls must hit
+    the (epoch, ids) memo — same object, no re-digest — and a tuning
+    mutation must bump the epoch and re-mint."""
+    t0 = kernels.REGISTRY.cache_tag()
+    assert kernels.REGISTRY.cache_tag() is t0
+    env = _attn_env()
+    kernels.TUNING.record("flash_attention", env.key, (128, 128), 1.0)
+    t1 = kernels.REGISTRY.cache_tag()
+    assert t1 != t0
+    assert kernels.REGISTRY.cache_tag() is t1
+
+
+# --------------------------------------------------------------------------
 # program-linter integration: PRG207 + the donation audit
 # --------------------------------------------------------------------------
 
@@ -532,6 +749,38 @@ def test_prg207_seeded_defects_and_negative_control():
     art = program.trace_artifact(fn, (x,), fn_key="output")
     assert not [f for f in program.lint_program(art)
                 if f.rule == "PRG207"]
+
+
+def test_prg207_attention_step_kinds_seeded_and_clean():
+    """PRG207 over the serving step kinds the attention kernels key:
+    a decode_step key with a stale flash digest is an ERROR, an unknown
+    paged id is an ERROR, and keys carrying the CURRENT digests audit
+    clean. PRG201 classification: every kernel-bearing decode/prefill
+    kind stays a train kind (the token is a suffix)."""
+    from deeplearning4j_tpu.analysis import program
+
+    fn = jax.jit(lambda x: x * 2.0)
+    x = jnp.ones((4,))
+    art = program.trace_artifact(
+        fn, (x,), fn_key="decode_step:s16:k1:kern:flash_attention:00000000")
+    finds = [f for f in program.lint_program(art) if f.rule == "PRG207"]
+    assert finds and finds[0].severity == "ERROR"
+    assert "mismatches" in finds[0].message
+    art = program.trace_artifact(
+        fn, (x,), fn_key="prefill_join:s16:t8:b2:kern:paged_decode:bad00bad")
+    rules = [(f.rule, f.severity) for f in program.lint_program(art)]
+    assert ("PRG207", "ERROR") in rules
+    # negative control: current digests on an attention-bearing key
+    df = kernels.tuning_digest("flash_attention")
+    dp = kernels.tuning_digest("paged_decode_attention")
+    key = (f"decode_step:s16:k2:kern:flash_attention:{df}"
+           f":kern:paged_decode_attention:{dp}")
+    art = program.trace_artifact(fn, (x,), fn_key=key)
+    assert not [f for f in program.lint_program(art)
+                if f.rule == "PRG207"]
+    for kind in ("decode_step", "prefill", "spec_verify", "prefix_join"):
+        assert (f"{kind}:s16:kern:flash_attention:{df}").startswith(
+            program.TRAIN_KIND_PREFIXES)
 
 
 def test_kernel_bearing_step_donates_and_audits_clean():
