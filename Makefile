@@ -132,6 +132,21 @@ attention-smoke:
 		-p no:cacheprovider
 	JAX_PLATFORMS=cpu $(PY) bench_attention.py --smoke
 
+.PHONY: obs-smoke
+# Observability smoke: the request-tracing / SLO burn-rate test subset
+# (traceparent round-trip over live HTTP, one trace across prefix-attach
+# → join → decode windows → retire, replay-deterministic tail sampling
+# and SLO transitions, flight-recorder trace capture + keep-last-N,
+# /traces + /slo endpoints, SRC107 fixtures), then the tracing-overhead
+# A/B bench in both serving and decode shapes — tracing-on must hold
+# the pinned throughput budget with zero recompiles in BOTH modes.
+obs-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests -q -m obs -p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) bench_serving.py --traces --seconds 1.5 \
+		--rounds 2 --out /tmp/bench_serving_traces_smoke.json
+	JAX_PLATFORMS=cpu $(PY) bench_decode.py --traces --smoke \
+		--out /tmp/bench_decode_traces_smoke.json
+
 .PHONY: lint
 # Repo-discipline source lint (analysis/source.py AST rules): host syncs
 # in compiled functions, lock discipline on shared registries, wall-clock/

@@ -266,6 +266,71 @@ def _run_engine_leg(name, model, args, reqs, seq_out, draft=None,
     return leg
 
 
+def bench_traces(args):
+    """``--traces``: request-tracing overhead A/B on the continuous leg.
+    The identical shared-prefix workload runs through two engines —
+    tracing OFF (one boolean check per ``start_trace``) then ON with
+    ``sample_every=1`` (every request carries its span through queued →
+    join → prefill/prefix_attach → first_token → decode_window* →
+    done) — and the JSON carries both tokens/s, the overhead fraction
+    against ``--trace-overhead-budget``, the trace-derived queue-wait /
+    decode-window breakdown, and the zero-recompile check for BOTH
+    modes: tracing is host-side monotonic_ns + list appends and must
+    never mint an AOT key. Token identity vs the sequential reference
+    is asserted in both modes too — tracing must not perturb
+    scheduling-order-sensitive outputs."""
+    if not args.tpu:
+        _pin_cpu()
+    from deeplearning4j_tpu.telemetry import tracing
+    from deeplearning4j_tpu.zoo.graphs import TransformerEncoder
+
+    model = TransformerEncoder(
+        vocab_size=args.vocab, embed_dim=args.embed, n_heads=args.heads,
+        n_layers=args.layers, max_len=args.max_len, causal=True,
+        lm_head=True, seed=123)
+    dec = model.decoder(max_batch=args.max_batch,
+                        kv_bucket_min=args.max_len // 4,
+                        prompt_bucket_min=8)
+    reqs = _workload(args.requests, args.vocab, args.max_len, args.seed)
+    seq_out = [dec.generate(p, mn, fused_steps=args.fused_steps)
+               for p, mn in reqs]
+
+    tracing.disable()
+    off = _run_engine_leg("traces-off", model, args, reqs, seq_out)
+    tracing.enable(seed=7, sample_every=1)
+    on = _run_engine_leg("traces-on", model, args, reqs, seq_out)
+    on["sampler"] = tracing.stats()
+    on["stage_breakdown"] = {
+        k: v for k, v in tracing.stage_breakdown().items() if v is not None}
+    tracing.disable()
+
+    overhead = round(
+        1.0 - on["tokens_per_sec"] / max(off["tokens_per_sec"], 1e-9), 4)
+    results = {
+        "bench": "decode_tracing_overhead",
+        "mode": "cpu-proxy" if not args.tpu else "tpu",
+        "workload": {"requests": args.requests, "seed": args.seed},
+        "tracing_off": off,
+        "tracing_on": on,
+        "overhead_fraction": overhead,
+        "overhead_budget": args.trace_overhead_budget,
+    }
+    print(json.dumps(results, indent=2))
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=2)
+    print(f"wrote {args.out}")
+    print(f"tracing off: {off['tokens_per_sec']} tok/s   "
+          f"on: {on['tokens_per_sec']} tok/s   overhead {overhead:+.1%} "
+          f"(budget {args.trace_overhead_budget:.0%})")
+    ok = (overhead <= args.trace_overhead_budget
+          and off["recompiles_after_warmup"] == 0
+          and on["recompiles_after_warmup"] == 0
+          and off["greedy_identical_to_sequential"]
+          and on["greedy_identical_to_sequential"])
+    print("OK" if ok else "FAIL: tracing overhead/recompile/identity broken")
+    return 0 if ok else 1
+
+
 def bench(args):
     if not args.tpu:
         _pin_cpu()
@@ -448,6 +513,13 @@ def main():
     ap.add_argument("--passes", type=int, default=3,
                     help="timed passes per leg; best is reported and "
                          "every pass recorded")
+    ap.add_argument("--traces", action="store_true",
+                    help="request-tracing overhead A/B: the continuous "
+                         "leg with tracing off then on (sample_every=1), "
+                         "plus the trace-derived stage breakdown")
+    ap.add_argument("--trace-overhead-budget", type=float, default=0.25,
+                    help="with --traces: exit 1 if tracing-on loses more "
+                         "than this fraction of tracing-off tokens/s")
     ap.add_argument("--tpu", action="store_true",
                     help="run on the real chip instead of the CPU proxy")
     ap.add_argument("--smoke", action="store_true",
@@ -463,6 +535,10 @@ def main():
         args.passes = 1
     if not args.tpu:
         _pin_cpu()
+    if args.traces:
+        if args.out == "bench_decode.json":
+            args.out = "bench_decode_traces.json"
+        return bench_traces(args)
     return bench(args)
 
 

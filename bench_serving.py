@@ -368,6 +368,78 @@ def bench_multi_model(args):
     return 0
 
 
+def bench_traces(args):
+    """``--traces``: request-tracing overhead A/B on the batched engine.
+    The same closed-loop traffic runs twice — tracing OFF (the module
+    flag short-circuits ``start_trace`` to one boolean check) then ON
+    (every request carries a span through queued → admitted → grouped →
+    launched → demuxed) — and the JSON carries both throughputs, the
+    overhead fraction against ``--trace-overhead-budget``, the
+    trace-derived queue-wait / batch-wait / launch breakdown, and the
+    zero-recompile check for BOTH modes (tracing is host-side
+    monotonic_ns + list appends; it must never mint an AOT key)."""
+    from deeplearning4j_tpu.optimize import aot_cache
+    from deeplearning4j_tpu.parallel.batcher import (
+        BatchingConfig,
+        InferenceEngine,
+    )
+    from deeplearning4j_tpu.telemetry import tracing
+
+    net = _build_net(args.n_in, args.hidden, args.n_out)
+    sizes = tuple(int(s) for s in args.sizes.split(","))
+    eng = InferenceEngine(net, BatchingConfig(
+        max_batch=args.max_batch, max_delay_ms=args.max_delay_ms,
+        settle_ms=args.settle_ms), graph_opt=not args.no_graph_opt)
+    eng.warmup()
+
+    def measure():
+        best = None
+        for _ in range(max(args.rounds, 1)):
+            n_req, _rows, lat, wall = _closed_loop(
+                eng.predict, args.clients, args.seconds, sizes, args.n_in)
+            cur = {"req_per_s": round(n_req / wall, 1), **_quantiles(lat)}
+            if best is None or cur["req_per_s"] > best["req_per_s"]:
+                best = cur
+        return best
+
+    results = {"mode": "traces", "clients": args.clients,
+               "seconds": args.seconds, "rounds": args.rounds,
+               "sizes": list(sizes)}
+    tracing.disable()
+    miss0 = aot_cache.stats()["misses"]
+    results["tracing_off"] = measure()
+    results["tracing_off"]["recompiles_after_warmup"] = (
+        aot_cache.stats()["misses"] - miss0)
+    tracing.enable(seed=7, sample_every=64)
+    miss1 = aot_cache.stats()["misses"]
+    results["tracing_on"] = measure()
+    results["tracing_on"]["recompiles_after_warmup"] = (
+        aot_cache.stats()["misses"] - miss1)
+    results["tracing_on"]["sampler"] = tracing.stats()
+    bd = tracing.stage_breakdown()
+    results["tracing_on"]["stage_breakdown"] = {
+        k: v for k, v in bd.items() if v is not None}
+    tracing.disable()
+    eng.close()
+
+    off = results["tracing_off"]["req_per_s"]
+    on = results["tracing_on"]["req_per_s"]
+    overhead = round(1.0 - on / max(off, 1e-9), 4)
+    results["overhead_fraction"] = overhead
+    results["overhead_budget"] = args.trace_overhead_budget
+
+    print(json.dumps(results, indent=2))
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=2)
+    print(f"\ntracing off: {off:>9} req/s   on: {on:>9} req/s   "
+          f"overhead {overhead:+.1%} (budget {args.trace_overhead_budget:.0%})")
+    ok = (overhead <= args.trace_overhead_budget
+          and results["tracing_off"]["recompiles_after_warmup"] == 0
+          and results["tracing_on"]["recompiles_after_warmup"] == 0)
+    print("OK" if ok else "FAIL: tracing overhead/recompile budget broken")
+    return 0 if ok else 1
+
+
 def smoke(args):
     """make serve-smoke: HTTP server up -> concurrent predicts ->
     /metrics scrape -> clean stop."""
@@ -452,6 +524,13 @@ def main():
                     help="with --multi-model: exit 1 unless the healthy "
                          "tenant stayed byte-identical with zero "
                          "recompiles and the canary rolled back")
+    ap.add_argument("--traces", action="store_true",
+                    help="request-tracing overhead A/B: the same "
+                         "closed-loop traffic with tracing off then on, "
+                         "plus the trace-derived stage breakdown")
+    ap.add_argument("--trace-overhead-budget", type=float, default=0.25,
+                    help="with --traces: exit 1 if tracing-on loses more "
+                         "than this fraction of tracing-off req/s")
     ap.add_argument("--tpu", action="store_true",
                     help="run on the real accelerator (default: CPU pin)")
     args = ap.parse_args()
@@ -461,6 +540,10 @@ def main():
         if args.out == "bench_serving.json":
             args.out = "bench_serving_mt.json"
         return bench_multi_model(args)
+    if args.traces:
+        if args.out == "bench_serving.json":
+            args.out = "bench_serving_traces.json"
+        return bench_traces(args)
     return smoke(args) if args.smoke else bench(args)
 
 

@@ -25,6 +25,15 @@ Five invariants this codebase previously kept by review alone:
 - **SRC106 unused-import** — dead imports (re-exports via
   ``import x as x``, ``__all__``, ``# noqa`` and availability probes in
   ``try/except ImportError`` are exempt).
+- **SRC107 request-span-finish** — a function that opens a request
+  trace (``start_trace``) must live in a module that closes traces
+  (``finish_trace``) at all (ERROR: the span can never finish), and a
+  function that both opens a span and ``raise``s must finish the span
+  on the reject edge itself (WARN: the raise leaks an open span, which
+  the tail sampler then never sees — exactly the abnormal trace it
+  exists to keep). Only ``tracing.``-qualified calls (or names imported
+  from a ``tracing`` module) count — the XProf
+  ``jax.profiler.start_trace`` pair is a different protocol.
 
 Reachability ("reaches aot_cache") is a package-wide fixpoint: roots
 are functions passed to ``jax.jit`` / ``shard_map`` / ``lax.scan`` -
@@ -450,6 +459,7 @@ class SourceLinter:
                     _rule_wallclock_rng(mod, fi, findings)
             _rule_lock_discipline(mod, findings)
             _rule_dispatch_bracketing(mod, findings)
+            _rule_request_span_finish(mod, findings)
             _rule_unused_imports(mod, findings)
             apply_waivers(findings, parse_waivers(mod.text), mod.relpath,
                           today=today)
@@ -737,6 +747,71 @@ def _rule_dispatch_bracketing(mod: ModuleAnalysis,
                 message=f"dispatch loop {fi.name!r} has no fault_point "
                         f"kill site in itself or its callers — "
                         f"resilience chaos plans cannot preempt it"))
+
+
+def _rule_request_span_finish(mod: ModuleAnalysis,
+                              out: List[Finding]) -> None:
+    """SRC107: every opened request span must reach a terminal edge.
+    (a) a function calls ``start_trace`` but NOTHING in its module ever
+    calls ``finish_trace`` — the span cannot finish on any path (ERROR);
+    (b) a function both opens a span and ``raise``s without calling
+    ``finish_trace`` in its own body — the reject edge leaks the open
+    span (WARN). Finishing is usually delegated across functions
+    (submit opens, the dispatcher finishes), so (b) only fires on the
+    function that raises PAST its own open span; ``tracing.py`` itself
+    (the module that defines the helpers) is exempt. Only request-trace
+    calls count: ``tracing.start_trace(...)`` or a bare name imported
+    from a ``tracing`` module — the XProf profiler's
+    ``jax.profiler.start_trace``/``stop_trace`` pair is a different
+    protocol and must not trip this rule."""
+    if mod.relpath.endswith("telemetry/tracing.py"):
+        return
+
+    def span_call(node: ast.Call, name: str) -> bool:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            return f.attr == name and _base_name(f) == "tracing"
+        if isinstance(f, ast.Name) and f.id == name:
+            src = mod.from_imports.get(name)
+            return src is not None and "tracing" in str(src)
+        return False
+
+    calls_by_func: Dict[int, Set[str]] = {}
+    raises_by_func: Dict[int, bool] = {}
+    module_finishes = False
+    for fi in mod.funcs:
+        names = set()
+        has_raise = False
+        for node in _own_statements(fi):
+            if isinstance(node, ast.Call):
+                for t in ("start_trace", "finish_trace"):
+                    if span_call(node, t):
+                        names.add(t)
+            elif isinstance(node, ast.Raise):
+                has_raise = True
+        calls_by_func[id(fi)] = names
+        raises_by_func[id(fi)] = has_raise
+        if "finish_trace" in names:
+            module_finishes = True
+
+    for fi in mod.funcs:
+        names = calls_by_func[id(fi)]
+        if "start_trace" not in names:
+            continue
+        loc = f"{mod.relpath}:{fi.node.lineno}"
+        if not module_finishes:
+            out.append(Finding(
+                rule="SRC107", severity=ERROR, location=loc,
+                message=f"{fi.name!r} opens a request span "
+                        f"(start_trace) but nothing in this module "
+                        f"ever calls finish_trace — the span cannot "
+                        f"reach a terminal edge on any path"))
+        elif raises_by_func[id(fi)] and "finish_trace" not in names:
+            out.append(Finding(
+                rule="SRC107", severity=WARN, location=loc,
+                message=f"{fi.name!r} opens a request span and raises "
+                        f"without finishing it — the reject edge leaks "
+                        f"an open span the tail sampler never sees"))
 
 
 def _rule_unused_imports(mod: ModuleAnalysis,

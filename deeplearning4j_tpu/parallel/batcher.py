@@ -44,6 +44,7 @@ import numpy as np
 
 from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.optimize import aot_cache
+from deeplearning4j_tpu.telemetry import tracing
 from deeplearning4j_tpu.resilience import faults
 from deeplearning4j_tpu.resilience.breaker import (
     CircuitBreaker,
@@ -131,9 +132,9 @@ def bucket_ladder(max_batch: int, align: int = 1) -> List[int]:
 
 class _Request:
     __slots__ = ("xs", "n", "group", "event", "result", "error", "deadline",
-                 "t0")
+                 "t0", "trace")
 
-    def __init__(self, xs, n, group, deadline, t0):
+    def __init__(self, xs, n, group, deadline, t0, trace=None):
         self.xs = xs
         self.n = n
         self.group = group
@@ -142,6 +143,10 @@ class _Request:
         self.error: Optional[BaseException] = None
         self.deadline = deadline
         self.t0 = t0
+        # request trace (telemetry.tracing) or None when tracing is
+        # disabled; rides the request across submit/dispatcher/watchdog
+        # threads, finished exactly once on the first terminal edge
+        self.trace = trace
 
 
 def _input_types(model):
@@ -253,6 +258,7 @@ class InferenceEngine:
         self._cond = threading.Condition()
         self._stop = False
         self._thread: Optional[threading.Thread] = None
+        self._batch_seq = itertools.count(1)  # trace launch ids
         telemetry.register_serving_engine(self)
 
     # --- submit / wait ------------------------------------------------------
@@ -292,26 +298,37 @@ class InferenceEngine:
         group = tuple((a.shape[1:], a.dtype.str) for a in xs)
         return tuple(xs), n, group
 
-    def submit(self, inputs: Sequence, timeout_ms=...) -> _Request:
+    def submit(self, inputs: Sequence, timeout_ms=...,
+               traceparent: Optional[str] = None) -> _Request:
         """Validate and enqueue one request; returns a handle whose
         ``event`` fires when the result (or error) is in. Raises
         :class:`BadRequestError` / :class:`ServerOverloadedError`
-        synchronously — a bad request never enters the shared queue."""
+        synchronously — a bad request never enters the shared queue.
+        ``traceparent`` (W3C header) is adopted into the request trace
+        when tracing is armed; every reject edge below finishes the
+        trace before raising."""
         if timeout_ms is ...:
             timeout_ms = self.config.timeout_ms
+        trace = tracing.start_trace(
+            "predict", traceparent=traceparent,
+            attrs={"model": self.name} if self.name else None)
         try:
             xs, n, group = self._validate(inputs)
         except BadRequestError:
             telemetry.record_serving_request("bad_request", model=self.name)
+            tracing.finish_trace(trace, "bad_request")
             raise
         t0 = time.monotonic()
         deadline = t0 + timeout_ms / 1000.0 if timeout_ms else None
-        req = _Request(xs, n, group, deadline, t0)
+        req = _Request(xs, n, group, deadline, t0, trace)
+        tracing.trace_event(trace, "queued", {"rows": n} if trace else None)
         with self._cond:
             if self._stop:
+                tracing.finish_trace(trace, "shutdown")
                 raise RuntimeError("engine is closed")
             if len(self._queue) >= self.config.max_queue:
                 telemetry.record_serving_request("rejected", model=self.name)
+                tracing.finish_trace(trace, "rejected")
                 raise ServerOverloadedError(
                     f"model {self.name!r} serving queue full "
                     f"({self.config.max_queue} pending)" if self.name else
@@ -325,6 +342,7 @@ class InferenceEngine:
                 except ServerOverloadedError:
                     telemetry.record_serving_request("rejected",
                                                      model=self.name)
+                    tracing.finish_trace(trace, "rejected")
                     raise
             # breaker check LAST: a request rejected for being malformed
             # or for overload must not consume a half-open probe ticket
@@ -334,11 +352,13 @@ class InferenceEngine:
                 # fail-fast shedding while the breaker is open: don't
                 # queue behind a model currently failing every launch
                 telemetry.record_serving_request("shed", model=self.name)
+                tracing.finish_trace(trace, "shed")
                 raise CircuitOpenError(
                     (f"model {self.name!r}: " if self.name else "")
                     + f"circuit breaker {self._breaker.name!r} is "
                     f"{self._breaker.state}; request shed")
             self._queue.append(req)
+            tracing.trace_event(trace, "admitted")
             self._cond.notify_all()
         self._ensure_thread()
         return req
@@ -352,10 +372,19 @@ class InferenceEngine:
             raise req.error
         return req.result
 
-    def predict(self, *inputs, timeout_ms=...):
+    def predict(self, *inputs, timeout_ms=..., traceparent=None):
         """Synchronous request: enqueue, share a launch, demux
         (reference ``ParallelInference#output`` through the observable)."""
-        return self.result(self.submit(inputs, timeout_ms=timeout_ms))
+        return self.result(self.submit(inputs, timeout_ms=timeout_ms,
+                                       traceparent=traceparent))
+
+    def predict_traced(self, *inputs, timeout_ms=..., traceparent=None):
+        """``predict`` that also returns the request's trace (or None
+        when tracing is disabled) — the HTTP server uses it to echo the
+        ``traceparent`` response header."""
+        req = self.submit(inputs, timeout_ms=timeout_ms,
+                          traceparent=traceparent)
+        return self.result(req), req.trace
 
     # --- model adoption / hot publish ---------------------------------------
     def _adopt_model(self, model, run_graph_opt: bool = True):
@@ -547,6 +576,7 @@ class InferenceEngine:
                     f"{(now - req.t0) * 1000:.1f} ms in queue")
                 telemetry.record_serving_request("expired", now - req.t0,
                                                  model=self.name)
+                tracing.finish_trace(req.trace, "expired")
                 req.event.set()
             else:
                 live.append(req)
@@ -602,6 +632,8 @@ class InferenceEngine:
             if take:
                 batch.append(req)
                 rows += req.n
+                if req.trace is not None:
+                    req.trace.event("grouped", {"batch_rows": rows})
             else:
                 rest.append(req)
         self._queue = rest
@@ -620,6 +652,7 @@ class InferenceEngine:
             req.event.set()
         telemetry.record_serving_request(status, time.monotonic() - req.t0,
                                          model=self.name)
+        tracing.finish_trace(req.trace, status)
         return True
 
     def _claim_batch(self, claim, owner: str) -> bool:
@@ -689,10 +722,17 @@ class InferenceEngine:
         k = len(batch[0].xs)
         claim = [None]  # mutated under self._cond only (_claim_batch)
         watchdog = self._arm_watchdog(batch, claim)
+        traced = [r for r in batch if r.trace is not None]
         try:
             cat = [np.concatenate([r.xs[i] for r in batch], axis=0)
                    if len(batch) > 1 else batch[0].xs[i] for i in range(k)]
             target = bucket_rows(rows, self._align)
+            if traced:
+                attrs = {"batch": next(self._batch_seq), "bucket": target,
+                         "rows": rows, "requests": len(batch),
+                         "occupancy": round(rows / max(target, 1), 3)}
+                for r in traced:
+                    r.trace.event("launched", attrs)
             if target != rows:
                 cat = [np.concatenate(
                     [a, np.zeros((target - rows,) + a.shape[1:], a.dtype)])
@@ -718,6 +758,8 @@ class InferenceEngine:
         if not self._claim_batch(claim, "dispatcher"):
             return  # watchdog fired mid-demux-window: it owns the batch
         now = time.monotonic()
+        for r in traced:
+            r.trace.event("demuxed")
         off = 0
         try:
             for r in batch:
@@ -773,6 +815,7 @@ class InferenceEngine:
             self._stop = True
             for req in self._queue:
                 req.error = RuntimeError("serving engine closed")
+                tracing.finish_trace(req.trace, "shutdown")
                 req.event.set()
             self._queue.clear()
             self._cond.notify_all()

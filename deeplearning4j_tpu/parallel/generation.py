@@ -84,6 +84,7 @@ import numpy as np
 
 from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.nn.decoding import TransformerDecoder, bucket_for
+from deeplearning4j_tpu.telemetry import tracing
 from deeplearning4j_tpu.optimize import aot_cache
 from deeplearning4j_tpu.parallel.batcher import (
     BadRequestError,
@@ -133,9 +134,10 @@ class GenerationConfig:
 class _GenRequest:
     __slots__ = ("tokens", "n", "max_new", "eos", "temp", "rng", "deadline",
                  "event", "out", "error", "t0", "t_first", "row",
-                 "prefix_len", "prefix_nodes")
+                 "prefix_len", "prefix_nodes", "trace")
 
-    def __init__(self, tokens, max_new, eos, temp, rng, deadline, t0):
+    def __init__(self, tokens, max_new, eos, temp, rng, deadline, t0,
+                 trace=None):
         self.tokens = tokens
         self.n = len(tokens)
         self.max_new = max_new
@@ -151,6 +153,7 @@ class _GenRequest:
         self.row: Optional[int] = None
         self.prefix_len = 0          # tokens served from the prefix cache
         self.prefix_nodes: list = []  # pinned trie nodes (one pin each)
+        self.trace = trace           # request trace (None when disabled)
 
 
 class GenerationEngine:
@@ -234,6 +237,10 @@ class GenerationEngine:
         self._tokens_total = 0
         self._prefill_seconds = 0.0
         self._decode_seconds = 0.0
+        # optional SLOMonitor (parallel.platform wires it): TTFT + error
+        # outcomes observed synchronously at the same points telemetry
+        # records them
+        self._slo = None
         telemetry.register_generation_engine(self)
 
     def _coerce_draft(self, model) -> TransformerDecoder:
@@ -279,12 +286,16 @@ class GenerationEngine:
     # --- submit / wait ------------------------------------------------------
     def submit(self, tokens: Sequence[int], max_new_tokens: int = None,
                eos_id: Optional[int] = None, temperature: float = 0.0,
-               seed: int = 0, timeout_ms=...) -> _GenRequest:
+               seed: int = 0, timeout_ms=..., traceparent=None
+               ) -> _GenRequest:
         """Validate and enqueue one generation request; returns a handle
         whose ``event`` fires when the token list (or error) is in.
         Admission order matches the batcher: malformed → 400, queue full
         → 503, breaker open → shed (503) — breaker LAST so a rejected
         request never burns a half-open probe ticket."""
+        trace = tracing.start_trace(
+            "generate", traceparent=traceparent,
+            attrs={"model": self.name} if self.name else None)
         if max_new_tokens is None:
             max_new_tokens = self.config.max_new_default
         try:
@@ -296,6 +307,7 @@ class GenerationEngine:
                 raise ValueError("eos_id outside the vocabulary")
         except ValueError as e:
             telemetry.record_decode_request("bad_request", model=self.name)
+            tracing.finish_trace(trace, "bad_request")
             raise BadRequestError(str(e)) from None
         if timeout_ms is ...:
             timeout_ms = self.config.timeout_ms
@@ -304,7 +316,8 @@ class GenerationEngine:
         rng = np.asarray(jax.random.PRNGKey(int(seed)), np.uint32)
         req = _GenRequest(toks, int(max_new_tokens),
                           -1 if eos_id is None else int(eos_id),
-                          float(temperature), rng, deadline, t0)
+                          float(temperature), rng, deadline, t0,
+                          trace=trace)
         if self._prefix is not None:
             # pin the longest cached prefix NOW (refcounts on the whole
             # path) so eviction can't free the pages before the join;
@@ -321,19 +334,26 @@ class GenerationEngine:
         try:
             with self._cond:
                 if self._stop:
+                    tracing.finish_trace(trace, "shutdown")
                     raise RuntimeError("generation engine is closed")
                 if len(self._queue) >= self.config.max_queue:
                     telemetry.record_decode_request("rejected",
                                                     model=self.name)
+                    tracing.finish_trace(trace, "rejected")
                     raise ServerOverloadedError(
                         f"generation queue full "
                         f"({self.config.max_queue} waiting)")
                 if self._breaker is not None and not self._breaker.allow():
                     telemetry.record_decode_request("shed", model=self.name)
+                    tracing.finish_trace(trace, "shed")
                     raise CircuitOpenError(
                         f"circuit breaker {self._breaker.name!r} is "
                         f"{self._breaker.state}; request shed")
                 self._queue.append(req)
+                tracing.trace_event(
+                    trace, "queued",
+                    {"prefix_len": req.prefix_len} if req.prefix_len
+                    else None)
                 self._cond.notify_all()
         except BaseException:
             self._release_prefix(req)
@@ -487,6 +507,7 @@ class GenerationEngine:
                     "request deadline expired after "
                     f"{(now - req.t0) * 1000:.1f} ms in queue")
                 telemetry.record_decode_request("expired", now - req.t0, model=self.name)
+                tracing.finish_trace(req.trace, "expired")
                 self._release_prefix(req)
                 req.event.set()
             else:
@@ -505,6 +526,8 @@ class GenerationEngine:
             req = self._queue.popleft()
             req.row = free[len(joins)]
             self._rows[req.row] = req
+            if req.trace is not None:
+                req.trace.event("join", {"row": req.row})
             joins.append(req)
         return joins
 
@@ -586,6 +609,9 @@ class GenerationEngine:
             rng2, active)
         if self._prefix is not None:
             self._insert_pages(joins, kv, offset=0)
+        for r in joins:
+            if r.trace is not None:
+                r.trace.event("prefill", {"prompt_bucket": tp, "rows": bp})
         self._account_prefill(joins, tok, active, bp, t0)
 
     def _prefill_suffix_group(self, joins: List[_GenRequest], ts: int):
@@ -657,6 +683,11 @@ class GenerationEngine:
         # extend the trie with the hit requests' own suffix pages (page
         # extension: next time a LONGER shared prefix hits)
         self._insert_pages(joins, kv, offset="prefix")
+        for r in joins:
+            if r.trace is not None:
+                r.trace.event("prefix_attach",
+                              {"prefix_len": r.prefix_len,
+                               "suffix_bucket": ts})
         self._account_prefill(joins, tok, active, bp, t0)
 
     def _insert_pages(self, joins, kv, offset):
@@ -747,6 +778,11 @@ class GenerationEngine:
                 self._positions[r.row] = r.n
                 r.t_first = now
                 telemetry.record_decode_first_token(now - r.t0)
+                if r.trace is not None:
+                    r.trace.event("first_token")
+                if self._slo is not None:
+                    self._slo.observe(self.name or "default",
+                                      ttft=now - r.t0)
                 if active[i]:
                     n_live += 1
                 else:
@@ -826,6 +862,11 @@ class GenerationEngine:
                     self._spec_windows += 1
                     self._spec_drafted += k
                     self._spec_accepted += int(accepted[b])
+                if req.trace is not None:
+                    req.trace.event("decode_window", {
+                        "k": k, "kv_bucket": self._S,
+                        "tokens": int(emitted[:, b].sum()),
+                        "ms": round((now - t0) * 1000.0, 3)})
                 done = False
                 for i in range(toks.shape[0]):
                     if not emitted[i, b]:
@@ -844,6 +885,8 @@ class GenerationEngine:
                         "deadline expired mid-generation after "
                         f"{len(req.out)} tokens")
                     telemetry.record_decode_request("expired", now - req.t0, model=self.name)
+                    tracing.finish_trace(req.trace, "expired",
+                                         {"tokens": len(req.out)})
                     self._release_prefix(req)
                     req.event.set()
                     self._rows[b] = None
@@ -868,6 +911,11 @@ class GenerationEngine:
         self._rows[req.row] = None
         self._retired_total += 1
         telemetry.record_decode_request("ok", now - req.t0, model=self.name)
+        tracing.finish_trace(req.trace, "done",
+                             {"tokens": len(req.out)})
+        if self._slo is not None:
+            self._slo.observe(self.name or "default", ok=True,
+                              seconds=now - req.t0)
         self._release_prefix(req)
         req.event.set()
 
@@ -883,6 +931,10 @@ class GenerationEngine:
                     continue
                 req.error = e if req.error is None else req.error
                 telemetry.record_decode_request("error", model=self.name)
+                tracing.finish_trace(req.trace, "rollback",
+                                     {"error": type(e).__name__})
+                if self._slo is not None:
+                    self._slo.observe(self.name or "default", ok=False)
                 self._release_prefix(req)
                 req.event.set()
                 self._rows[b] = None
@@ -903,12 +955,14 @@ class GenerationEngine:
             err = RuntimeError("generation engine closed")
             for req in self._queue:
                 req.error = err
+                tracing.finish_trace(req.trace, "shutdown")
                 self._release_prefix(req)
                 req.event.set()
             self._queue.clear()
             for b, req in enumerate(self._rows):
                 if req is not None:
                     req.error = err
+                    tracing.finish_trace(req.trace, "shutdown")
                     self._release_prefix(req)
                     req.event.set()
                     self._rows[b] = None

@@ -77,6 +77,7 @@ from deeplearning4j_tpu.parallel.batcher import (
 )
 from deeplearning4j_tpu.resilience import faults
 from deeplearning4j_tpu.resilience.retry import MODEL_LOAD_RETRY
+from deeplearning4j_tpu.telemetry import slo as slo_mod
 from deeplearning4j_tpu.util import serializer
 
 _NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.-]{0,63}$")
@@ -415,7 +416,8 @@ class ModelPlatform:
     """
 
     def __init__(self, registry: Optional[ModelRegistry] = None,
-                 seed: int = 0, host_max_pending: Optional[int] = None):
+                 seed: int = 0, host_max_pending: Optional[int] = None,
+                 slo=None):
         self.registry = registry
         self.seed = int(seed)
         self.host_max_pending = host_max_pending
@@ -423,7 +425,17 @@ class ModelPlatform:
         self._gen_tenants: Dict[str, tuple] = {}  # name -> (engine, ver)
         self._lock = threading.RLock()
         self._closed = False
+        # declarative SLOs: an slo.SLO applied to every tenant or a
+        # {tenant: SLO} dict. Outcomes are observed synchronously at the
+        # same points the canary gate records them, so a seeded replay
+        # fires every burn-rate transition at the same request index.
+        self._slo = (slo_mod.SLOMonitor(slo, seed=self.seed)
+                     if slo is not None else None)
         _PLATFORMS.add(self)
+
+    @property
+    def slo(self) -> Optional[slo_mod.SLOMonitor]:
+        return self._slo
 
     # --- deploy -------------------------------------------------------------
     def _load(self, name, version, model):
@@ -654,12 +666,22 @@ class ModelPlatform:
         """The tenant's PRIMARY engine (tests, direct wiring)."""
         return self._tenant(name).engine
 
-    def predict(self, name: str, *inputs, timeout_ms=...):
+    def predict(self, name: str, *inputs, timeout_ms=...,
+                traceparent=None):
+        out, _ = self.predict_traced(name, *inputs, timeout_ms=timeout_ms,
+                                     traceparent=traceparent)
+        return out
+
+    def predict_traced(self, name: str, *inputs, timeout_ms=...,
+                       traceparent=None):
         """Route one request: pick the arm (seeded canary draw), run it
-        through that arm's engine, record the outcome for the gate, and
-        evaluate the gate. Raises exactly what the engine raises — the
-        HTTP layer maps the classes; a canary failure still propagates
-        to ITS caller (that request was the canary's to lose)."""
+        through that arm's engine, record the outcome for the gate AND
+        the tenant's SLO monitor, and evaluate the gate. Returns
+        ``(outputs, trace-or-None)`` so the HTTP layer can echo the
+        server-side traceparent. Raises exactly what the engine raises —
+        the HTTP layer maps the classes; a canary failure still
+        propagates to ITS caller (that request was the canary's to
+        lose)."""
         tenant = self._tenant(name)
         with self._lock:
             tenant.request_seq += 1
@@ -670,23 +692,32 @@ class ModelPlatform:
         engine = canary.engine if use_canary else tenant.engine
         t0 = time.monotonic()
         try:
-            out = engine.predict(*inputs, timeout_ms=timeout_ms)
+            out, trace = engine.predict_traced(
+                *inputs, timeout_ms=timeout_ms, traceparent=traceparent)
         except Exception as e:
+            # client errors (BadRequest & co) are the sender's
+            # fault, and queue/host overload is LOAD, not model
+            # badness — neither judges an arm (a traffic burst must
+            # not roll back a healthy canary or mask a bad one by
+            # inflating the incumbent's error rate). Launch errors,
+            # timeouts, and the arm's own breaker shedding do count.
+            # The SLO monitor applies the same exclusions: its error
+            # objective judges the MODEL, not the sender or the load.
+            judged = not isinstance(e, (ServerOverloadedError, ValueError))
             with self._lock:
-                # client errors (BadRequest & co) are the sender's
-                # fault, and queue/host overload is LOAD, not model
-                # badness — neither judges an arm (a traffic burst must
-                # not roll back a healthy canary or mask a bad one by
-                # inflating the incumbent's error rate). Launch errors,
-                # timeouts, and the arm's own breaker shedding do count.
-                if not isinstance(e, (ServerOverloadedError, ValueError)):
+                if judged:
                     arm.stats.record_locked(False, 0.0)
+            if judged and self._slo is not None:
+                self._slo.observe(name, ok=False)
             self._check_gate(tenant)
             raise
+        dt = time.monotonic() - t0
         with self._lock:
-            arm.stats.record_locked(True, time.monotonic() - t0)
+            arm.stats.record_locked(True, dt)
+        if self._slo is not None:
+            self._slo.observe(name, ok=True, seconds=dt)
         self._check_gate(tenant)
-        return out
+        return out, trace
 
     def _check_gate(self, tenant: _Tenant) -> None:
         with self._lock:
@@ -744,6 +775,9 @@ class ModelPlatform:
         _check_name(name)
         src, ver = self._load(name, version, model)
         engine = GenerationEngine(src, config, name=name)
+        # generation tenants report TTFT + completion outcomes into the
+        # platform's SLO monitor (the ttft_ms objective's only source)
+        engine._slo = self._slo
         warm = engine.warmup()
         with self._lock:
             if self._closed:
@@ -830,6 +864,14 @@ class ModelPlatform:
                 "queue_depth": engine.queue_depth(),
                 "breaker": breaker.state if breaker is not None else None,
             }
+        if self._slo is not None:
+            snap = self._slo.snapshot()
+            for name, s in snap.items():
+                out.setdefault(name, {})["slo"] = {
+                    "state": s["state"],
+                    "burn_rates": s["burn_rates"],
+                    "since_index": s["since_index"],
+                }
         return out
 
     def close(self) -> None:

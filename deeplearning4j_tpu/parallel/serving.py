@@ -55,6 +55,7 @@ from deeplearning4j_tpu.parallel.platform import (
     ModelPlatform,
     UnknownModelError,
 )
+from deeplearning4j_tpu.telemetry import tracing
 
 
 class InferenceServer:
@@ -157,14 +158,26 @@ class InferenceServer:
             out.append(arr)
         return out
 
-    def _predict(self, xs):
+    def _predict(self, xs, traceparent=None):
+        """-> (outputs, trace-or-None). The trace rides back so the
+        handler can echo its ``traceparent`` on the response — the W3C
+        propagation contract: a client that sent a trace context gets
+        the server-side span of the SAME trace back."""
         if self.engine is not None:
-            out = self.engine.predict(*xs)
+            out, trace = self.engine.predict_traced(
+                *xs, traceparent=traceparent)
         else:
-            with self._lock:
-                out = self.model.output(*xs)
+            trace = tracing.start_trace("predict",
+                                        traceparent=traceparent)
+            try:
+                with self._lock:
+                    out = self.model.output(*xs)
+            except BaseException:
+                tracing.finish_trace(trace, "error")
+                raise
+            tracing.finish_trace(trace, "ok")
         outs = out if isinstance(out, list) else [out]
-        return [np.asarray(o).tolist() for o in outs]
+        return [np.asarray(o).tolist() for o in outs], trace
 
     # --- platform (multi-tenant) routing ------------------------------------
     def _resolve_predict_path(self, path: str):
@@ -213,7 +226,7 @@ class InferenceServer:
             self._uint8_cache[engine.name] = (ref, flags)
         return flags
 
-    def _predict_platform(self, name: str, inputs):
+    def _predict_platform(self, name: str, inputs, traceparent=None):
         """Parse + route one multi-tenant request: generic JSON→array
         conversion (arity/shape/dtype validation lives in the tenant's
         engine, mapped to 400), integer image payloads ride as uint8
@@ -238,9 +251,10 @@ class InferenceServer:
                 if i < len(flags) and flags[i]:
                     arr = arr.astype(np.uint8)
             xs.append(arr)
-        out = self.platform.predict(name, *xs)
+        out, trace = self.platform.predict_traced(
+            name, *xs, traceparent=traceparent)
         outs = out if isinstance(out, list) else [out]
-        return [np.asarray(o).tolist() for o in outs]
+        return [np.asarray(o).tolist() for o in outs], trace
 
     def _shed_payload(self, e: Exception, name: Optional[str]) -> dict:
         """The 503 body: which scope is shedding (this model vs the
@@ -335,11 +349,14 @@ class InferenceServer:
         srv = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
-            def _send(self, code: int, payload: dict):
+            def _send(self, code: int, payload: dict,
+                      traceparent: Optional[str] = None):
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                if traceparent:
+                    self.send_header("traceparent", traceparent)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -384,16 +401,22 @@ class InferenceServer:
                     self._send(404, {"error": "not found"})
 
             def do_POST(self):
+                # W3C trace-context propagation: an incoming traceparent
+                # joins the client's trace (the engine's span keeps the
+                # caller's trace id); error responses echo the CALLER's
+                # header so failed requests still correlate
+                tp_in = self.headers.get("traceparent")
                 name, notfound = srv._resolve_predict_path(self.path)
                 if notfound is not None:
-                    self._send(404, notfound)
+                    self._send(404, notfound, traceparent=tp_in)
                     return
                 length = int(self.headers.get("Content-Length", 0))
                 if length < 0 or length > max_body_bytes:
                     # reject before reading: one oversized request (or a
                     # negative length turning read() unbounded) must not
                     # exhaust the serving process's memory
-                    self._send(413, {"error": "request body too large"})
+                    self._send(413, {"error": "request body too large"},
+                               traceparent=tp_in)
                     return
                 try:
                     req = json.loads(self.rfile.read(length))
@@ -403,21 +426,25 @@ class InferenceServer:
                     if name is None:
                         xs = srv._parse_inputs(inputs)
                 except (ValueError, KeyError, TypeError) as e:
-                    self._send(400, {"error": str(e)})
+                    self._send(400, {"error": str(e)}, traceparent=tp_in)
                     return
                 try:
-                    outs = (srv._predict(xs) if name is None
-                            else srv._predict_platform(name, inputs))
+                    outs, trace = (
+                        srv._predict(xs, traceparent=tp_in)
+                        if name is None
+                        else srv._predict_platform(name, inputs,
+                                                   traceparent=tp_in))
                 except UnknownModelError as e:
                     # a missing tenant is the CLIENT's addressing error:
                     # a named 404 listing what IS deployed, never a
                     # KeyError-shaped 500
                     self._send(404, {"error": str(e),
-                                     "models": srv.platform.models()})
+                                     "models": srv.platform.models()},
+                               traceparent=tp_in)
                     return
                 except BadRequestError as e:
                     # engine-level validation: this sender's problem only
-                    self._send(400, {"error": str(e)})
+                    self._send(400, {"error": str(e)}, traceparent=tp_in)
                     return
                 except (ServerOverloadedError, DeadlineExpiredError,
                         CircuitOpenError, LaunchTimeoutError) as e:
@@ -425,13 +452,17 @@ class InferenceServer:
                     # (queue full, deadline gone, breaker open, or the
                     # launch watchdog fired); the body names the model
                     # and breaker state vs a host-wide overload
-                    self._send(503, srv._shed_payload(e, name))
+                    self._send(503, srv._shed_payload(e, name),
+                               traceparent=tp_in)
                     return
                 except Exception as e:  # model/runtime failure -> 500
                     # JSON, never a dropped connection
-                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"},
+                               traceparent=tp_in)
                     return
-                self._send(200, {"outputs": outs})
+                self._send(200, {"outputs": outs},
+                           traceparent=(trace.traceparent()
+                                        if trace is not None else tp_in))
 
             def log_message(self, *args):
                 pass

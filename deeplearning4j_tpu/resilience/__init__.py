@@ -93,6 +93,11 @@ def status() -> dict:
         "counters": counters,
         "fault_plan_armed": faults.active_plan() is not None,
     }
+    from deeplearning4j_tpu.telemetry import slo
+
+    slo_status = slo.status()
+    if slo_status["tenants"]:
+        out["slo"] = slo_status
     pod_series = {k: v for k, v in snap.items()
                   if k.startswith("dl4j_pod_")}
     if pod_series:

@@ -29,7 +29,9 @@ import weakref
 from deeplearning4j_tpu.telemetry import flightrec as flightrec  # noqa: F401
 from deeplearning4j_tpu.telemetry import health as health  # noqa: F401
 from deeplearning4j_tpu.telemetry import registry as registry  # noqa: F401
+from deeplearning4j_tpu.telemetry import slo as slo  # noqa: F401
 from deeplearning4j_tpu.telemetry import spans as spans  # noqa: F401
+from deeplearning4j_tpu.telemetry import tracing as tracing  # noqa: F401
 from deeplearning4j_tpu.telemetry.flightrec import (  # noqa: F401
     FlightRecorder,
     flight_recorder,
@@ -69,9 +71,11 @@ from deeplearning4j_tpu.telemetry.spans import (  # noqa: F401
 
 
 def reset() -> None:
-    """Clear recorded spans AND metrics (flags/collectors untouched) —
-    the per-test / per-bench-round zero point."""
+    """Clear recorded spans, request traces AND metrics
+    (flags/collectors untouched) — the per-test / per-bench-round zero
+    point."""
     spans.reset()
+    tracing.reset()
     REGISTRY.reset()
 
 
@@ -367,6 +371,16 @@ def record_tuning_cache(hits: int, entries: int) -> None:
                    help="tuned envelopes in the cache").set(entries)
 
 
+def record_slo_transition(tenant: str, to_state: str) -> None:
+    """Count one SLO alert-state transition (``telemetry.slo``):
+    unconditional like the other control-plane events — transitions are
+    rare by construction (hysteresis), never per-request work. The
+    current state/burn gauges are scrape-time collectors."""
+    REGISTRY.counter("dl4j_slo_transitions_total",
+                     help="SLO alert-state transitions",
+                     tenant=tenant, to=to_state).inc()
+
+
 def record_circuit_state(name: str, state_code: int,
                          transition: bool = True) -> None:
     """Publish a breaker's state (0=closed, 1=half_open, 2=open); counts
@@ -540,6 +554,22 @@ def _collect_decode_queue_depth(reg) -> None:
         reg.gauge("dl4j_decode_queue_depth",
                   help="generation requests waiting for a cache row").set(
             sum(e.queue_depth() for e in engines))
+
+
+@REGISTRY.register_collector
+def _collect_slo_metrics(reg) -> None:
+    for mon in slo.monitors():
+        for tenant, snap in mon.snapshot().items():
+            reg.gauge("dl4j_slo_state",
+                      help="0=ok 1=warn 2=page",
+                      tenant=tenant).set(slo.STATE_CODE[snap["state"]])
+            for objective, b in snap["burn_rates"].items():
+                for window in ("short", "long"):
+                    reg.gauge("dl4j_slo_burn_rate",
+                              help="violation fraction / objective "
+                                   "budget per rolling window",
+                              tenant=tenant, objective=objective,
+                              window=window).set(b[window])
 
 
 @REGISTRY.register_collector

@@ -15,7 +15,15 @@ shapes), what the telemetry counters said, and where the time went.
         manifest.json   # reason, policy, health report, env/config digest
         records.jsonl   # one step record per line, oldest first
         trace.json      # Chrome trace of the span ring (may be empty)
+        traces.json     # retained request traces (telemetry.tracing)
         metrics.json    # registry snapshot + phase histograms
+
+  The manifest carries the retained request-trace ids
+  (``request_trace_ids``), so a failed request's end-to-end timeline
+  survives post-mortem alongside the step records. Bundles are pruned
+  keep-last-N on publish (``DL4J_FLIGHTREC_KEEP``, default 16): chaos
+  sessions dump a bundle per induced crash, and without retention a
+  long soak fills the disk with them.
 
 - :func:`flight_recorder` is the context manager every ``fit`` wraps:
   on an uncaught exception (including :class:`health.DivergenceError`)
@@ -167,13 +175,18 @@ class FlightRecorder:
         in writing whatever it can — a flight recorder that throws during
         a crash is worse than none."""
         from deeplearning4j_tpu import telemetry
-        from deeplearning4j_tpu.telemetry import spans
+        from deeplearning4j_tpu.telemetry import spans, tracing
 
         if directory is None:
             root = os.environ.get("DL4J_FLIGHTREC_DIR", "flightrec")
             directory = os.path.join(
                 root, f"bundle_{int(time.time())}_{os.getpid()}")
         os.makedirs(directory, exist_ok=True)
+
+        try:
+            trace_snap = tracing.snapshot()
+        except Exception:
+            trace_snap = None
 
         records = self.records()
         with open(os.path.join(directory, "records.jsonl"), "w") as f:
@@ -204,8 +217,11 @@ class FlightRecorder:
             "config_digest": self._conf_digest,
             "env": env,
             "versions": versions,
+            "request_trace_ids": (
+                [t["trace_id"] for t in trace_snap["traces"]]
+                if trace_snap else []),
             "files": ["manifest.json", "records.jsonl", "trace.json",
-                      "metrics.json"],
+                      "traces.json", "metrics.json"],
         }
         with open(os.path.join(directory, "manifest.json"), "w") as f:
             json.dump(sanitize_json(manifest), f, indent=2)
@@ -215,13 +231,45 @@ class FlightRecorder:
         except Exception:
             pass
         try:
+            if trace_snap is not None:
+                with open(os.path.join(directory, "traces.json"),
+                          "w") as f:
+                    json.dump(sanitize_json(trace_snap), f)
+        except Exception:
+            pass
+        try:
             with open(os.path.join(directory, "metrics.json"), "w") as f:
                 json.dump(sanitize_json(telemetry.telemetry_record()), f)
         except Exception:
             pass
 
         self.last_bundle = directory
+        try:
+            self._prune_siblings(directory)
+        except Exception:
+            pass  # retention must never fail the dump
         return directory
+
+    @staticmethod
+    def _prune_siblings(directory: str) -> None:
+        """Keep-last-N retention over sibling ``bundle_*`` directories
+        (N from ``DL4J_FLIGHTREC_KEEP``, default 16; <= 0 disables).
+        Runs AFTER the new bundle is fully published, newest-first by
+        mtime so the bundle just written always survives."""
+        keep = int(os.environ.get("DL4J_FLIGHTREC_KEEP", "16"))
+        if keep <= 0:
+            return
+        root = os.path.dirname(os.path.abspath(directory)) or "."
+        bundles = []
+        for name in os.listdir(root):
+            p = os.path.join(root, name)
+            if name.startswith("bundle_") and os.path.isdir(p):
+                bundles.append((os.path.getmtime(p), p))
+        bundles.sort(reverse=True)
+        import shutil
+
+        for _, p in bundles[keep:]:
+            shutil.rmtree(p, ignore_errors=True)
 
 
 RECORDER = FlightRecorder()

@@ -224,6 +224,27 @@ class UIServer:
 
                     payload = _json.dumps(LOG.snapshot()).encode()
                     ctype = "application/json"
+                elif self.path == "/traces":
+                    # retained request traces (telemetry.tracing): the
+                    # tail-sampled ring + sampler counters — the
+                    # scriptable twin of the flight-recorder bundle's
+                    # traces.json
+                    from deeplearning4j_tpu.telemetry import (
+                        flightrec,
+                        tracing,
+                    )
+
+                    payload = _json.dumps(flightrec.sanitize_json(
+                        tracing.snapshot())).encode()
+                    ctype = "application/json"
+                elif self.path == "/slo":
+                    # burn-rate alert states over every live SLO monitor
+                    # (telemetry.slo): per-tenant state, burn rates and
+                    # the full transition history with request indices
+                    from deeplearning4j_tpu.telemetry import slo
+
+                    payload = _json.dumps(slo.status()).encode()
+                    ctype = "application/json"
                 elif self.path == "/health":
                     # training-health probe (telemetry.health): policy,
                     # anomaly counts, last guard readings — the liveness/
@@ -405,6 +426,13 @@ class UIServer:
         return ('<div class="chart"><h3>Serving platform '
                 f'(multi-tenant)</h3>{table}{counters}</div>')
 
+    def _slo_panel(self) -> str:
+        """SLO burn-rate alerting (telemetry.slo): per-tenant alert
+        state and short/long-window burn rates (``dl4j_slo_*``) plus the
+        transition counter — rendered only once a monitor has recorded
+        a transition or the collector has published a gauge."""
+        return self._metric_table_panel("SLOs (burn rates)", "dl4j_slo_")
+
     def _pod_panel(self) -> str:
         """Pod topology + distributed-snapshot metrics
         (resilience.pod): host count, per-host shard bytes, snapshot /
@@ -546,6 +574,7 @@ class UIServer:
             self._serving_panel(),
             self._generation_panel(),
             self._platform_panel(),
+            self._slo_panel(),
             self._collectives_panel(),
             self._kernels_panel(),
             self._sharding_panel(),

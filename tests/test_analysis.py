@@ -420,6 +420,70 @@ def test_src106_unused_import_fixture():
     assert "'List'" in hits[0] and "'j'" in hits[1]
 
 
+@pytest.mark.obs
+def test_src107_unfinished_request_span_fixture():
+    """Seeded defect: a request span opened and never finished anywhere
+    in the module — the trace leaks and the tail sampler never sees it."""
+    fs = lint('''
+        from deeplearning4j_tpu.telemetry import tracing
+
+        def submit(x):
+            t = tracing.start_trace("predict")
+            return x, t
+    ''')
+    hits = [f for f in fs if f.rule == "SRC107"]
+    assert hits and hits[0].severity == fmod.ERROR
+
+
+@pytest.mark.obs
+def test_src107_leaky_raise_warns():
+    """The module does finish traces, but a function that both opens a
+    span and raises without a finish on its own error edges leaks the
+    span on exactly the abnormal path the sampler always keeps."""
+    fs = lint('''
+        from deeplearning4j_tpu.telemetry import tracing
+
+        def submit(x):
+            t = tracing.start_trace("predict")
+            if x is None:
+                raise ValueError("x required")
+            return t
+
+        def retire(t):
+            tracing.finish_trace(t, "done")
+    ''')
+    hits = [f for f in fs if f.rule == "SRC107"]
+    assert hits and hits[0].severity == fmod.WARN
+
+
+@pytest.mark.obs
+def test_src107_negative_control_and_xprof_exempt():
+    # finish on every edge (the batcher/generation idiom): clean
+    fs = lint('''
+        from deeplearning4j_tpu.telemetry import tracing
+
+        def submit(x):
+            t = tracing.start_trace("predict")
+            if x is None:
+                tracing.finish_trace(t, "bad_request")
+                raise ValueError("x required")
+            return t
+
+        def retire(t):
+            tracing.finish_trace(t, "done")
+    ''')
+    assert "SRC107" not in rules_of(fs)
+    # jax.profiler.start_trace is the XProf capture API, a different
+    # protocol (stop_trace), not a request span: exempt
+    fs = lint('''
+        import jax
+
+        def capture(path):
+            jax.profiler.start_trace(path)
+    ''')
+    assert "SRC107" not in rules_of(fs)
+
+
 def test_src106_exemptions():
     fs = lint('''
         from deeplearning4j_tpu.analysis import findings as findings  # re-export

@@ -293,7 +293,7 @@ def test_bundle_schema_roundtrip(tmp_path):
     out = rec.dump_bundle(str(tmp_path / "bundle"), reason="test")
     names = sorted(os.listdir(out))
     assert names == ["manifest.json", "metrics.json", "records.jsonl",
-                     "trace.json"]
+                     "trace.json", "traces.json"]
     manifest = json.loads((tmp_path / "bundle" / "manifest.json")
                           .read_text())
     assert manifest["reason"] == "test"

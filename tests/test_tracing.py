@@ -1,0 +1,399 @@
+"""End-to-end request tracing + SLO burn-rate engine (telemetry.tracing,
+telemetry.slo) threaded through the serving stack.
+
+The invariants pinned here are the observability subsystem's acceptance
+criteria: W3C ``traceparent`` round-trips over live HTTP (inbound trace
+ids adopted, fresh span id minted, error paths echo the caller's header
+verbatim); one trace follows a generation request across prefix-attach,
+join, every fused decode window, and retirement; tracing DISABLED is
+inert (``start_trace`` returns ``None``, nothing is recorded, the
+request path is unchanged); tail sampling and SLO alert transitions are
+replay-deterministic (same seed + same traffic → same retained trace
+ids, same transition indices); flight-recorder bundles carry the
+retained request traces and are pruned keep-last-N on publish; and the
+``/traces`` + ``/slo`` UI endpoints serve the live snapshots.
+"""
+
+import functools
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.conf import Activation, InputType
+from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.conf.losses import LossMCXENT
+from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+from deeplearning4j_tpu.conf.updaters import Sgd
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.batcher import (
+    BatchingConfig,
+    InferenceEngine,
+)
+from deeplearning4j_tpu.parallel.generation import (
+    GenerationConfig,
+    GenerationEngine,
+)
+from deeplearning4j_tpu.parallel.platform import (
+    ModelPlatform,
+    ModelRegistry,
+    TenantConfig,
+)
+from deeplearning4j_tpu.parallel.serving import InferenceServer
+from deeplearning4j_tpu import resilience
+from deeplearning4j_tpu.telemetry import REGISTRY, flightrec, tracing
+from deeplearning4j_tpu.telemetry.slo import SLO, SLOMonitor
+from deeplearning4j_tpu.zoo.graphs import TransformerEncoder
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    yield
+    tracing.disable()
+    tracing.reset()
+
+
+def _mlp(seed=0):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_out=8, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _x(rows=2):
+    return np.random.default_rng(0).normal(size=(rows, 4)).astype(np.float32)
+
+
+# same shape as tests/test_decode.py so the AOT cache shares every
+# executable across the suite (the cache is process-global)
+VOCAB = 32
+MAX_LEN = 32
+MAX_BATCH = 4
+K = 2
+
+
+@functools.lru_cache(maxsize=None)
+def _decoder():
+    m = TransformerEncoder(vocab_size=VOCAB, embed_dim=16, n_heads=2,
+                           n_layers=2, max_len=MAX_LEN, causal=True,
+                           lm_head=True, seed=7)
+    return m.decoder(max_batch=MAX_BATCH, kv_bucket_min=16,
+                     prompt_bucket_min=4)
+
+
+def _names(trace):
+    return [name for name, _, _ in trace.events]
+
+
+# --- traceparent ------------------------------------------------------------
+
+def test_traceparent_parse():
+    tid, sid = "ab" * 16, "cd" * 8
+    assert tracing.parse_traceparent(f"00-{tid}-{sid}-01") == (tid, sid)
+    assert tracing.parse_traceparent(None) is None
+    assert tracing.parse_traceparent("garbage") is None
+    assert tracing.parse_traceparent(f"00-{tid[:-2]}-{sid}-01") is None
+    assert tracing.parse_traceparent(f"ff-{tid}-{sid}-01") is None
+    assert tracing.parse_traceparent(f"00-{'0' * 32}-{sid}-01") is None
+
+
+def test_disabled_tracing_is_inert():
+    tracing.disable()
+    tracing.reset()
+    assert tracing.start_trace("predict") is None
+    tracing.trace_event(None, "queued")   # all helpers no-op on None
+    tracing.finish_trace(None, "ok")
+    eng = InferenceEngine(_mlp(), BatchingConfig(max_batch=2))
+    try:
+        out, trace = eng.predict_traced(_x())
+        assert trace is None
+        assert np.asarray(out).shape == (2, 3)
+    finally:
+        eng.close()
+    assert tracing.stats()["started"] == 0
+    assert tracing.traces() == []
+
+
+# --- batcher lifecycle ------------------------------------------------------
+
+def test_batcher_trace_chain():
+    tracing.enable(seed=1, sample_every=1)
+    eng = InferenceEngine(_mlp(), BatchingConfig(max_batch=2))
+    try:
+        out, trace = eng.predict_traced(_x())
+    finally:
+        eng.close()
+    assert np.asarray(out).shape == (2, 3)
+    assert trace.status == "ok"
+    names = _names(trace)
+    assert [n for n in names if n in ("queued", "admitted", "grouped",
+                                      "launched", "demuxed")] == \
+        ["queued", "admitted", "grouped", "launched", "demuxed"]
+    # the retained trace is the same record the caller saw
+    assert trace.trace_id in [t.trace_id for t in tracing.traces()]
+
+
+# --- HTTP round-trip --------------------------------------------------------
+
+def test_http_traceparent_round_trip():
+    tracing.enable(seed=2, sample_every=1)
+    server = InferenceServer(_mlp()).start(port=0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        hdr = f"00-{'ab' * 16}-{'cd' * 8}-01"
+        req = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps({"inputs": [_x().tolist()]}).encode(),
+            headers={"Content-Type": "application/json",
+                     "traceparent": hdr})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            echoed = r.headers["traceparent"]
+            json.loads(r.read())
+        # inbound trace id adopted, NEW span id minted for this hop
+        parsed = tracing.parse_traceparent(echoed)
+        assert parsed is not None and parsed[0] == "ab" * 16
+        assert parsed[1] != "cd" * 8
+
+        # no inbound header: a fresh, well-formed root trace
+        req = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps({"inputs": [_x().tolist()]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            fresh = tracing.parse_traceparent(r.headers["traceparent"])
+            json.loads(r.read())
+        assert fresh is not None and fresh[0] != "ab" * 16
+
+        # error responses echo the CALLER's header verbatim so the
+        # client can still correlate the failure
+        bad = urllib.request.Request(
+            base + "/predict", data=b'{"nope": 1}',
+            headers={"Content-Type": "application/json",
+                     "traceparent": hdr})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad, timeout=10)
+        assert ei.value.code == 400
+        assert ei.value.headers["traceparent"] == hdr
+    finally:
+        server.stop()
+
+
+# --- generation lifecycle ---------------------------------------------------
+
+def test_generation_trace_across_prefix_join_windows_retire():
+    """One trace follows a generation request end to end: queued →
+    (prefix_attach | prefill) → join → every fused decode window →
+    retirement, with the inbound traceparent's trace id adopted."""
+    tracing.enable(seed=3, sample_every=1)
+    cfg = GenerationConfig(max_batch=MAX_BATCH, fused_steps=K,
+                           kv_bucket_min=16, prompt_bucket_min=4,
+                           prefix_cache=True, prefix_page=4)
+    eng = GenerationEngine(_decoder(), cfg)
+    try:
+        shared = [5, 9, 2, 7, 1, 4, 8, 3]
+        h1 = eng.submit(shared + [6], max_new_tokens=6)
+        out1 = eng.result(h1)
+        hdr = f"00-{'ef' * 16}-{'12' * 8}-01"
+        h2 = eng.submit(shared + [11], max_new_tokens=6, traceparent=hdr)
+        out2 = eng.result(h2)
+    finally:
+        eng.close()
+    assert len(out1) == 6 and len(out2) == 6
+
+    cold, warm = h1.trace, h2.trace
+    assert cold.status == "done" and warm.status == "done"
+    assert cold.attrs["tokens"] == 6
+
+    n1 = _names(cold)
+    assert n1[0] == "queued" and "join" in n1 and "prefill" in n1
+    assert "first_token" in n1
+    assert n1.count("decode_window") >= 2  # 6 tokens / K=2 → 3 windows
+
+    # the second request attaches cached prefix pages instead of a
+    # cold prefill, under the SAME (adopted) trace
+    n2 = _names(warm)
+    assert "prefix_attach" in n2 and "prefill" not in n2
+    assert n2.count("decode_window") >= 2
+    assert warm.trace_id == "ef" * 16
+    assert warm.parent_id == "12" * 8
+
+    # per-window attrs feed the bench's stage breakdown
+    windows = [a for name, _, a in warm.events if name == "decode_window"]
+    assert all(w["k"] == K and "ms" in w and "kv_bucket" in w
+               for w in windows)
+    bd = tracing.stage_breakdown()
+    assert bd["decode_window"]["count"] >= 4
+    assert bd["queue_wait"]["count"] >= 2
+
+
+# --- deterministic tail sampling --------------------------------------------
+
+def test_tail_sampling_replay_deterministic():
+    """Same seed + same traffic → the SAME retained trace ids: ids are a
+    pure function of (seed, submit counter) and the sampling decision a
+    pure function of the id + status."""
+    def replay(seed):
+        tracing.enable(seed=seed, sample_every=4,
+                       min_slow_samples=10_000)  # isolate the hash rule
+        for i in range(40):
+            t = tracing.start_trace("req")
+            tracing.finish_trace(t, "error" if i % 7 == 3 else "ok")
+        kept = [(t.trace_id, t.status) for t in tracing.traces()]
+        st = tracing.stats()
+        tracing.disable()
+        return kept, st
+
+    kept_a, stats_a = replay(5)
+    kept_b, stats_b = replay(5)
+    assert kept_a == kept_b
+    assert stats_a["started"] == stats_b["started"] == 40
+    assert stats_a["dropped"] == stats_b["dropped"] > 0
+    # abnormal terminals are NEVER sampled away
+    assert sum(1 for _, s in kept_a if s == "error") == 6
+    # a different seed mints different ids
+    kept_c, _ = replay(6)
+    assert [i for i, _ in kept_c] != [i for i, _ in kept_a]
+
+
+# --- SLO burn rates ---------------------------------------------------------
+
+def test_slo_burn_rate_transitions_replay_deterministic():
+    """Alert state is a pure function of the observation stream: two
+    seeded replays of the same traffic fire warn → page → recovery at
+    identical observation indices, and hysteresis clears the alert only
+    after ``clear_after`` consecutive clean evaluations."""
+    cfg = SLO(error_rate=0.1, short_window=8, long_window=16,
+              min_samples=8, warn_burn=1.0, page_burn=4.0, clear_after=4)
+
+    def drive(mon):
+        states = []
+        for i in range(40):
+            states.append(mon.observe("t", ok=not (8 <= i < 20)))
+        for _ in range(40):
+            states.append(mon.observe("t", ok=True))
+        return states
+
+    m1, m2 = SLOMonitor(cfg, seed=3), SLOMonitor(cfg, seed=3)
+    s1, s2 = drive(m1), drive(m2)
+    assert s1 == s2
+    assert "page" in s1
+    t1, t2 = m1.transitions("t"), m2.transitions("t")
+    assert t1 == t2  # same transition indices, same burn snapshots
+    assert [t["to"] for t in t1][:2] == ["warn", "page"]
+    assert all(t["index"] == u["index"] for t, u in zip(t1, t2))
+    # recovered: the error burst aged out of both windows and the
+    # clear_after streak elapsed
+    assert m1.state("t") == "ok"
+    snap = m1.snapshot()["t"]
+    assert snap["observations"] == 80
+    assert snap["burn_rates"]["error_rate"]["short"] == 0.0
+
+
+def test_platform_slo_surface(tmp_path):
+    """The serving platform observes every judged outcome into its own
+    monitor and surfaces it through stats(), resilience.status(), and
+    the dl4j_slo_* gauges."""
+    reg = ModelRegistry(tmp_path)
+    reg.publish("m", _mlp(seed=1))
+    cfg = SLO(error_rate=0.5, latency_p95_ms=60_000.0,
+              short_window=4, long_window=8, min_samples=4)
+    with ModelPlatform(reg, slo=cfg) as plat:
+        plat.deploy("m", config=TenantConfig(
+            batching=BatchingConfig(max_batch=4)))
+        for _ in range(6):
+            plat.predict("m", _x())
+        st = plat.stats()["m"]["slo"]
+        assert st["state"] == "ok"
+        assert "latency_p95" in st["burn_rates"]
+        res = resilience.status()
+        assert res["slo"]["tenants"]["m"]["state"] == "ok"
+        snap = REGISTRY.snapshot()
+        assert snap.get('dl4j_slo_state{tenant="m"}') == 0
+        key = ('dl4j_slo_burn_rate{objective="latency_p95",'
+               'tenant="m",window="short"}')
+        assert key in snap or 'objective="latency_p95"' in str(snap)
+
+
+# --- flight recorder --------------------------------------------------------
+
+def test_flightrec_bundle_traces_and_keep_last_n(tmp_path, monkeypatch):
+    tracing.enable(seed=2, sample_every=1)
+    t = tracing.start_trace("req")
+    tracing.trace_event(t, "queued")
+    tracing.finish_trace(t, "error")
+
+    rec = flightrec.FlightRecorder(capacity=4)
+    out = rec.dump_bundle(str(tmp_path / "bundle_a"), reason="test")
+    assert out == str(tmp_path / "bundle_a")
+    traces_doc = json.loads((tmp_path / "bundle_a" / "traces.json")
+                            .read_text())
+    assert [tr["trace_id"] for tr in traces_doc["traces"]] == [t.trace_id]
+    manifest = json.loads((tmp_path / "bundle_a" / "manifest.json")
+                          .read_text())
+    assert manifest["request_trace_ids"] == [t.trace_id]
+    assert "traces.json" in manifest["files"]
+
+    # keep-last-N retention on publish: a chaos soak dumping a bundle
+    # per crash must not fill the disk
+    monkeypatch.setenv("DL4J_FLIGHTREC_KEEP", "3")
+    os.utime(tmp_path / "bundle_a", (999, 999))
+    for i in range(6):
+        d = str(tmp_path / f"bundle_{i:02d}")
+        rec.dump_bundle(d, reason="soak")
+        os.utime(d, (1000 + i, 1000 + i))
+    survivors = sorted(p.name for p in tmp_path.iterdir())
+    assert survivors == ["bundle_03", "bundle_04", "bundle_05"]
+
+
+# --- UI endpoints -----------------------------------------------------------
+
+def test_traces_and_slo_ui_endpoints():
+    from deeplearning4j_tpu.ui.server import UIServer
+
+    tracing.enable(seed=4, sample_every=1)
+    t = tracing.start_trace("demo")
+    tracing.trace_event(t, "queued")
+    tracing.finish_trace(t, "error")
+    mon = SLOMonitor(SLO(error_rate=0.1, short_window=4, long_window=8,
+                         min_samples=2), seed=0)
+    for _ in range(4):
+        mon.observe("ui-tenant", ok=True)
+
+    ui = UIServer()
+    port = ui.start(port=0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/traces", timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["stats"]["started"] >= 1
+        ours = [tr for tr in doc["traces"]
+                if tr["trace_id"] == t.trace_id]
+        assert ours and ours[0]["status"] == "error"
+        assert ours[0]["events"][0]["name"] == "queued"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/slo", timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["tenants"]["ui-tenant"]["state"] == "ok"
+    finally:
+        ui.stop()
+
+
+def test_chrome_trace_export_shape(tmp_path):
+    tracing.enable(seed=6, sample_every=1)
+    t = tracing.start_trace("req")
+    tracing.trace_event(t, "queued")
+    tracing.finish_trace(t, "ok")
+    doc = tracing.export_chrome_trace(str(tmp_path / "trace.json"))
+    slices = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    instants = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert slices and slices[0]["args"]["trace_id"] == t.trace_id
+    assert instants and instants[0]["name"] == "queued"
+    json.loads((tmp_path / "trace.json").read_text())
