@@ -175,6 +175,18 @@ analysis-smoke:
 bench-serving:
 	$(PY) bench_serving.py --assert-speedup 4
 
+.PHONY: quant-smoke
+# Quantized-serving smoke: the int8 calibration / kernel-parity /
+# registry / accuracy-gate test subset, then the f32-vs-int8 platform
+# A/B (calibrate -> quantize -> canary behind the accuracy arm ->
+# promote), asserting zero recompiles after warmup in BOTH modes and a
+# bounded accuracy_max_delta.
+quant-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests -q -m quant \
+		-p no:cacheprovider
+	$(PY) bench_serving.py --quant --seconds 1.5 --rounds 1 \
+		--hidden 96 --out /tmp/bench_serving_quant_smoke.json
+
 .PHONY: tier1
 tier1:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \

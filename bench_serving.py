@@ -440,6 +440,134 @@ def bench_traces(args):
     return 0 if ok else 1
 
 
+def bench_quant(args):
+    """``--quant``: the f32-vs-int8 quantized-serving A/B (ISSUE 20).
+    Two fresh platforms serve the same closed-loop traffic:
+
+      f32   — publish v1, deploy, measure.
+      int8  — publish v1, calibrate + quantize -> publish v2, deploy v1,
+              ``deploy_canary`` v2 behind an accuracy-armed gate, drive
+              canary traffic, ``promote`` (which pre-warms the quantized
+              executables), then measure the promoted quantized serving.
+
+    Reports per-mode req/s, latency quantiles and
+    recompiles-after-warmup (asserted ZERO for both — the quantized
+    version must be fully warmed at promote time, not on first
+    traffic), plus the canary's observed ``accuracy_max_delta``.
+
+    Honest caveat baked into the JSON: on the CPU proxy XLA often runs
+    int8 dot products SLOWER than f32 (no VNNI path through this
+    emitter), so the ratio here validates the plumbing + accuracy, not
+    the TPU speedup — that A/B is one ``--tpu`` run away.
+    """
+    import tempfile
+
+    import numpy as np
+
+    from deeplearning4j_tpu.nn import inference_opt as iopt
+    from deeplearning4j_tpu.optimize import aot_cache
+    from deeplearning4j_tpu.parallel.batcher import BatchingConfig
+    from deeplearning4j_tpu.parallel.platform import (
+        CanaryGate,
+        ModelPlatform,
+        ModelRegistry,
+        TenantConfig,
+    )
+
+    sizes = tuple(int(s) for s in args.sizes.split(","))
+    cfg = TenantConfig(batching=BatchingConfig(
+        max_batch=args.max_batch, max_delay_ms=args.max_delay_ms,
+        settle_ms=args.settle_ms))
+    results = {"mode": "quant", "clients": args.clients,
+               "seconds": args.seconds, "sizes": list(sizes),
+               "n_in": args.n_in, "hidden": args.hidden,
+               "platform_seed": 7,
+               "cpu_proxy_note": (
+                   "CPU XLA int8 dot is often slower than f32 (no VNNI "
+                   "path); this leg validates plumbing + accuracy, the "
+                   "TPU speed A/B is one --tpu run away")}
+
+    def measure(predict):
+        best = None
+        for _ in range(max(args.rounds, 1)):
+            n_req, _rows, lat, wall = _closed_loop(
+                predict, args.clients, args.seconds, sizes, args.n_in)
+            cur = {"req_per_s": round(n_req / wall, 1), **_quantiles(lat)}
+            if best is None or cur["req_per_s"] > best["req_per_s"]:
+                best = cur
+        return best
+
+    # ---- mode 1: f32 incumbent on a fresh platform -----------------------
+    net = _build_net(args.n_in, args.hidden, args.n_out, seed=1)
+    reg = ModelRegistry(tempfile.mkdtemp(prefix="dl4j_quant_bench_"))
+    reg.publish("m", net)
+    plat = ModelPlatform(reg, seed=7)
+    plat.deploy("m", version=1, config=cfg)
+    miss0 = aot_cache.stats()["misses"]
+    results["f32"] = measure(lambda x: plat.predict("m", x))
+    results["f32"]["recompiles_after_warmup"] = (
+        aot_cache.stats()["misses"] - miss0)
+    plat.close()
+
+    # ---- mode 2: int8 canary -> promote on a fresh platform --------------
+    rng = np.random.default_rng(0)
+    cal_batches = [rng.normal(size=(32, args.n_in)).astype(np.float32)
+                   for _ in range(4)]
+    rec = iopt.calibrate(net, cal_batches)
+    qnet = iopt.quantize_for_inference(net, rec)
+    plat2 = ModelPlatform(reg, seed=7)
+    plat2.deploy("m", version=1, config=cfg)
+    reg.publish("m", qnet)
+    plat2.deploy_canary("m", version=2, fraction=0.5,
+                        gate=CanaryGate(min_requests=8,
+                                        max_accuracy_delta=0.25,
+                                        accuracy_sample=1.0))
+    miss_canary = aot_cache.stats()["misses"]
+    for i in range(24):
+        x = np.random.default_rng(100 + i).normal(
+            size=(sizes[i % len(sizes)], args.n_in)).astype(np.float32)
+        plat2.predict("m", x)
+    canary_recompiles = aot_cache.stats()["misses"] - miss_canary
+    canary = plat2.stats()["m"].get("canary") or {}
+    promoted = plat2.promote("m")
+    miss1 = aot_cache.stats()["misses"]
+    results["int8"] = measure(lambda x: plat2.predict("m", x))
+    results["int8"]["recompiles_after_warmup"] = (
+        aot_cache.stats()["misses"] - miss1)
+    results["int8"]["canary_recompiles"] = canary_recompiles
+    results["int8"]["promoted_version"] = promoted["version"]
+    results["accuracy_max_delta"] = canary.get("accuracy_max_delta")
+    results["accuracy_samples"] = canary.get("accuracy_samples")
+    results["quantization"] = {"scheme": rec.scheme,
+                               "calibration_digest": rec.digest[:8]}
+    plat2.close()
+
+    speed = round(results["int8"]["req_per_s"]
+                  / max(results["f32"]["req_per_s"], 1e-9), 3)
+    results["int8_over_f32"] = speed
+
+    print(json.dumps(results, indent=2))
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=2)
+    rf, rq = results["f32"], results["int8"]
+    print(f"\nf32 : {rf['req_per_s']:>8} req/s  p95 {rf['p95_ms']} ms  "
+          f"recompiles {rf['recompiles_after_warmup']}")
+    print(f"int8: {rq['req_per_s']:>8} req/s  p95 {rq['p95_ms']} ms  "
+          f"recompiles {rq['recompiles_after_warmup']}  "
+          f"(canary {canary_recompiles})")
+    print(f"accuracy_max_delta {results['accuracy_max_delta']} over "
+          f"{results['accuracy_samples']} samples   "
+          f"int8/f32 {speed}x (CPU proxy)")
+    ok = (rf["recompiles_after_warmup"] == 0
+          and rq["recompiles_after_warmup"] == 0
+          and canary_recompiles == 0
+          and promoted["version"] == 2
+          and results["accuracy_max_delta"] is not None
+          and results["accuracy_max_delta"] <= 0.25)
+    print("OK" if ok else "FAIL: quantized-serving invariant broken")
+    return 0 if ok else 1
+
+
 def smoke(args):
     """make serve-smoke: HTTP server up -> concurrent predicts ->
     /metrics scrape -> clean stop."""
@@ -531,6 +659,10 @@ def main():
     ap.add_argument("--trace-overhead-budget", type=float, default=0.25,
                     help="with --traces: exit 1 if tracing-on loses more "
                          "than this fraction of tracing-off req/s")
+    ap.add_argument("--quant", action="store_true",
+                    help="f32-vs-int8 quantized serving A/B: calibrate, "
+                         "quantize, canary with the accuracy gate, "
+                         "promote, measure — zero recompiles both modes")
     ap.add_argument("--tpu", action="store_true",
                     help="run on the real accelerator (default: CPU pin)")
     args = ap.parse_args()
@@ -544,6 +676,10 @@ def main():
         if args.out == "bench_serving.json":
             args.out = "bench_serving_traces.json"
         return bench_traces(args)
+    if args.quant:
+        if args.out == "bench_serving.json":
+            args.out = "bench_serving_quant.json"
+        return bench_quant(args)
     return smoke(args) if args.smoke else bench(args)
 
 

@@ -466,6 +466,61 @@ def _rule_kernel_registry(art: ProgramArtifact,
                         f"re-mints"))
 
 
+# q:<scheme>:<digest8> tokens minted by MultiLayerNetwork._qtag() into
+# quantized-artifact step keys — the calibration-liveness audit's input.
+# The leading (^|:) anchor keeps ids like "seq:..." from aliasing.
+_QUANT_TOKEN_RE = re.compile(r"(?:^|:)q:([A-Za-z0-9_]+):([0-9a-f]{8})")
+
+
+def _rule_quant_calibration(art: ProgramArtifact,
+                            out: List[Finding]) -> None:
+    """PRG208: executables whose key carries ``q:<scheme>:<digest8>``
+    tokens were traced from a quantized artifact — (a) a scheme this
+    build does not implement means the executable's math cannot be
+    audited (ERROR); (b) a digest with no live calibration record means
+    the executable outlived a recalibration or a registry restore never
+    happened — it bakes scales no record vouches for (ERROR). A
+    recalibration mints a new digest and therefore a new key; the stale
+    executable surviving under the old token is exactly what this rule
+    catches. PRG201 applies unchanged to quantized train kinds."""
+    tokens = _QUANT_TOKEN_RE.findall(art.fn_key)
+    if not tokens:
+        return
+    try:
+        from deeplearning4j_tpu.nn import inference_opt as iopt
+    except Exception:
+        out.append(Finding(
+            rule="PRG208", severity=ERROR, location=art.location,
+            message="step key carries q:<scheme>:<digest> tokens but the "
+                    "quantization pass is unavailable — the executable "
+                    "cannot be audited"))
+        return
+    for scheme, digest in tokens:
+        if scheme not in iopt.QUANT_SCHEMES:
+            out.append(Finding(
+                rule="PRG208", severity=ERROR, location=art.location,
+                message=f"key token q:{scheme}:{digest} names a "
+                        f"quantization scheme this build does not "
+                        f"implement (supported: "
+                        f"{', '.join(iopt.QUANT_SCHEMES)})"))
+            continue
+        rec = iopt.lookup_calibration(digest)
+        if rec is None:
+            out.append(Finding(
+                rule="PRG208", severity=ERROR, location=art.location,
+                message=f"key token q:{scheme}:{digest} does not resolve "
+                        f"to a live calibration record — stale executable "
+                        f"vs a recalibration (or a quantized restore that "
+                        f"skipped ModelRegistry.load); rebuild the step "
+                        f"so the key re-mints"))
+        elif rec.scheme != scheme:
+            out.append(Finding(
+                rule="PRG208", severity=ERROR, location=art.location,
+                message=f"key token q:{scheme}:{digest} resolves to a "
+                        f"calibration record of scheme {rec.scheme!r} — "
+                        f"token/record drift"))
+
+
 def _rule_recompile_hazard(art: ProgramArtifact,
                            out: List[Finding]) -> None:
     """PRG206: this miss differs from an already-cached signature only
@@ -492,6 +547,7 @@ _RULES = (
     _rule_host_callback,
     _rule_collectives,
     _rule_kernel_registry,
+    _rule_quant_calibration,
     _rule_recompile_hazard,
 )
 

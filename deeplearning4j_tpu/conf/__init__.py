@@ -14,5 +14,5 @@ from deeplearning4j_tpu.conf.weights import WeightInit
 # from_json works regardless of which entry point the user imported first
 from deeplearning4j_tpu.conf import (  # noqa: E402,F401
     layers, layers_attention, layers_cnn, layers_extra, layers_objdetect,
-    layers_rnn, losses, regularization, schedules, updaters,
+    layers_quant, layers_rnn, losses, regularization, schedules, updaters,
 )

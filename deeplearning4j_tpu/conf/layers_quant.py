@@ -1,0 +1,154 @@
+"""Post-training int8 quantized inference layers.
+
+Produced by :func:`deeplearning4j_tpu.nn.inference_opt.quantize_for_inference`
+— never built by hand and never trained. The scheme is the classic
+dequant-free affine fold (reference: TFLite / ``org.nd4j`` int8 inference
+paths; PAPERS.md 1905.04035 for the bytes-moved argument):
+
+- activations: per-input-channel asymmetric int8,
+  ``xq = clip(round(x / xs + xz), -128, 127)`` with ``xs``/``xz`` calibrated
+  from observed ranges (running min/max + percentile clip);
+- weights: the per-channel activation scale is folded *into* the weight
+  before quantizing (``W2 = diag(xs) @ W``), then per-output-channel
+  symmetric int8 (``scale[n] = max|W2[:, n]| / 127``);
+- the zero-point correction ``scale[n] * sum_k(xz_k * Wq[k, n])`` is folded
+  into an effective bias at quantize time.
+
+The hot path is therefore ``act(int32_acc(xq, Wq) * scale + b)`` — one int8
+matmul with an f32 epilogue, no dequant pass over the activations. The same
+math is the ``jax.lax`` reference for the Pallas kernel
+(``matmul_bias_act_int8``), so stock-XLA fallback and kernel path agree.
+
+Params (all layers): ``Wq`` int8 ``[K, N]``, ``scale`` f32 ``[N]``,
+``b`` f32 ``[N]`` (effective bias), ``xs`` f32 ``[K]``, ``xz`` f32 ``[K]``.
+int8 survives the flat-coefficients round trip: values in [-128, 127] are
+exact in the f32 flat vector and ``unflatten_params`` casts back per-ref.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import serde
+from deeplearning4j_tpu.conf import inputs as it
+from deeplearning4j_tpu.conf.layers import BaseLayer, _as_ff_size
+
+
+@serde.register
+@dataclasses.dataclass
+class QuantizationSpec:
+    """Stamp on ``MultiLayerConfiguration.quantization`` identifying the
+    calibration that produced a quantized artifact. ``digest`` is the full
+    sha256 of the calibration record; step keys carry ``q:<scheme>:<digest8>``
+    so a recalibration mints new executables (PRG208 checks liveness)."""
+
+    scheme: str = "int8"
+    digest: str = ""
+    seed: int = 0
+    clip_percentile: float = 99.9
+
+
+def quantize_input(x, xs, xz):
+    """f32 activations -> int8 per-channel affine. Stays in XLA (fuses into
+    the surrounding program); the kernel receives the already-int8 tensor."""
+    q = jnp.round(x.astype(jnp.float32) / xs + xz)
+    return jnp.clip(q, -128.0, 127.0).astype(jnp.int8)
+
+
+def quant_pre_output(params, x):
+    """Reference int8 forward: int8xint8->int32 dot, f32 scale/bias epilogue.
+
+    This exact expression is both the stock-XLA serving path and the parity
+    reference for the ``matmul_bias_act_int8`` Pallas kernel.
+    """
+    xq = quantize_input(x, params["xs"], params["xz"])
+    acc = jax.lax.dot_general(
+        xq, params["Wq"],
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return acc.astype(jnp.float32) * params["scale"] + params["b"]
+
+
+def _placeholder_params(n_in: int, n_out: int) -> dict:
+    # Shapes/dtypes only — real values come from quantize_for_inference or
+    # the serializer restore path (MultiLayerNetwork(conf).init() then
+    # set_params_flat), which needs correctly-typed references to cast into.
+    return {
+        "Wq": jnp.zeros((n_in, n_out), jnp.int8),
+        "scale": jnp.ones((n_out,), jnp.float32),
+        "b": jnp.zeros((n_out,), jnp.float32),
+        "xs": jnp.ones((n_in,), jnp.float32),
+        "xz": jnp.zeros((n_in,), jnp.float32),
+    }
+
+
+@serde.register
+@dataclasses.dataclass
+class QuantizedDenseLayer(BaseLayer):
+    """int8 replacement for an eligible ``DenseLayer`` (post BN-fold)."""
+
+    n_out: int = 0
+
+    def output_type(self, input_type):
+        return it.FeedForward(size=self.n_out)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        return _placeholder_params(_as_ff_size(input_type), self.n_out)
+
+    def param_order(self):
+        return ["Wq", "scale", "b", "xs", "xz"]
+
+    def regularized_param_keys(self):
+        return []  # inference-only: never trained, never regularized
+
+    def forward(self, params, state, x, train=False, rng=None):
+        y = quant_pre_output(params, x)
+        return self.activation.apply(y).astype(x.dtype), state
+
+
+@serde.register
+@dataclasses.dataclass
+class QuantizedConv1x1Layer(BaseLayer):
+    """int8 replacement for an eligible 1x1 convolution (post BN-fold).
+
+    A 1x1 conv is a matmul over ``[B*H*W, Cin]``; the epilogue variant of
+    the int8 kernel serves it through the same ``matmul_bias_act_int8``
+    envelope after the reshape (mirrors ``kernels.routing._route_conv1x1``).
+    """
+
+    n_out: int = 0
+    stride: Tuple[int, int] = (1, 1)
+
+    def output_type(self, input_type):
+        assert isinstance(input_type, it.Convolutional), (
+            f"{type(self).__name__} needs CNN input, got {input_type}"
+        )
+        sh, sw = self.stride
+        return it.Convolutional(
+            height=-(-input_type.height // sh),
+            width=-(-input_type.width // sw),
+            channels=self.n_out,
+        )
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        return _placeholder_params(input_type.channels, self.n_out)
+
+    def param_order(self):
+        return ["Wq", "scale", "b", "xs", "xz"]
+
+    def regularized_param_keys(self):
+        return []
+
+    def forward(self, params, state, x, train=False, rng=None):
+        sh, sw = self.stride
+        if (sh, sw) != (1, 1):
+            x = x[:, ::sh, ::sw, :]
+        b, h, w, cin = x.shape
+        y = quant_pre_output(params, x.reshape(b * h * w, cin))
+        y = y.reshape(b, h, w, self.n_out)
+        return self.activation.apply(y).astype(x.dtype), state

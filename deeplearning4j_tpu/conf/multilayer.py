@@ -73,6 +73,12 @@ class MultiLayerConfiguration:
     # (the step cache keys only gain kern:<id>:<digest> tokens when
     # this is on). See docs/kernels.md.
     use_kernels: bool = False
+    # Stamp set by nn.inference_opt.quantize_for_inference on the quantized
+    # artifact it emits (a conf.layers_quant.QuantizationSpec: scheme +
+    # calibration digest). Never set by builders. Default None = quantization
+    # is bitwise inert: no ``q:`` token in any step key, zero new compiles,
+    # byte-identical serving. See docs/quantization.md.
+    quantization: Optional[object] = None
 
     def to_json(self) -> str:
         return serde.to_json(self)

@@ -239,3 +239,62 @@ def _mm_stats_bwd(tiling, interpret, res, cts):
 
 
 matmul_stats.defvjp(_mm_stats_fwd, _mm_stats_bwd)
+
+
+# --------------------------------------------------------------------------
+# int8 matmul + f32 scale/bias + activation (quantized dense / 1x1-conv)
+# --------------------------------------------------------------------------
+
+def _mm_bias_act_q8_kernel(x_ref, w_ref, s_ref, b_ref, y_ref, acc, *, nk,
+                           act_fn):
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+
+    # int8 x int8 -> int32: the MXU's native int8 path (the interpreter
+    # runs the same accumulate in int32 on CPU)
+    acc[...] += jax.lax.dot(x_ref[...], w_ref[...],
+                            preferred_element_type=jnp.int32)
+
+    @pl.when(kk == nk - 1)
+    def _():
+        # dequant-free epilogue: the per-output-channel scale already
+        # carries the folded activation scales, the effective bias carries
+        # the zero-point correction (see conf.layers_quant)
+        z = (acc[...].astype(jnp.float32) * s_ref[...].astype(jnp.float32)
+             + b_ref[...].astype(jnp.float32))
+        y_ref[...] = act_fn(z).astype(y_ref.dtype)
+
+
+def matmul_bias_act_int8(xq, wq, scale, b, act, tiling, interpret):
+    """``act(int32_dot(xq, wq) * scale + b)`` as ONE tiled Pallas pass —
+    the quantized-serving variant of :func:`matmul_bias_act`.
+
+    xq: [M, K] int8 (already quantized in-graph); wq: [K, N] int8;
+    scale/b: [N] f32 (effective scale/bias from
+    ``nn.inference_opt.quantize_for_inference``). Forward-only: quantized
+    layers never train, so there is no custom VJP — differentiating
+    through this is a programming error and fails loudly in JAX.
+    """
+    m, k = xq.shape
+    n = wq.shape[-1]
+    ebm, ebn, ebk = effective_tiling(m, k, n, tiling)
+    assert tiling_valid(m, k, n, tiling), (m, k, n, tiling)
+    if not _HAS_PLTPU:  # pragma: no cover - interpret-only environments
+        raise NotImplementedError("pallas tpu dialect unavailable")
+    nbm, nbn, nbk = m // ebm, n // ebn, k // ebk
+    return pl.pallas_call(
+        functools.partial(_mm_bias_act_q8_kernel, nk=nbk, act_fn=act.apply),
+        grid=(nbm, nbn, nbk),
+        in_specs=[pl.BlockSpec((ebm, ebk), lambda i, j, kk: (i, kk)),
+                  pl.BlockSpec((ebk, ebn), lambda i, j, kk: (kk, j)),
+                  pl.BlockSpec((1, ebn), lambda i, j, kk: (0, j)),
+                  pl.BlockSpec((1, ebn), lambda i, j, kk: (0, j))],
+        out_specs=pl.BlockSpec((ebm, ebn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((ebm, ebn), jnp.int32)],
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(xq, wq, scale.reshape(1, n), b.reshape(1, n))

@@ -231,6 +231,60 @@ class MatmulBiasActKernel(_MatmulKernel):
         return _rand_inputs(env, seed, with_bias=True)
 
 
+class Int8MatmulBiasActKernel(_MatmulKernel):
+    """Quantized-serving matmul: int8 x int8 -> int32 accumulate with the
+    f32 scale/bias/activation epilogue fused in the same pass
+    (``impls.matmul_bias_act_int8``). Serves ``QuantizedDenseLayer`` and —
+    after the routing reshape — ``QuantizedConv1x1Layer``. The envelope
+    machinery (candidates/tuner/on-disk cache/stock fallback/PRG207) is
+    untouched: this is just a new dtype reaching the same sweeps."""
+
+    kernel_id = "matmul_bias_act_int8"
+    version = 1
+
+    def supports(self, env) -> bool:
+        return (impls.has_pallas() and env.dtype == "int8"
+                and env.m > 0 and env.k > 0 and env.n > 0
+                and bool(_sweep_candidates(env, limit=1)))
+
+    def candidates(self, env, limit: Optional[int] = None):
+        return _sweep_candidates(env, limit)
+
+    def build(self, env, tiling):
+        act = _activation(env.act)
+        interpret = env.backend != "tpu"
+        tiling = tuple(tiling)
+
+        def fn(xq, wq, scale, b):
+            return impls.matmul_bias_act_int8(xq, wq, scale, b, act,
+                                              tiling, interpret)
+
+        return fn
+
+    def reference(self, env):
+        import jax
+        import jax.numpy as jnp
+
+        act = _activation(env.act)
+
+        def ref(xq, wq, scale, b):
+            acc = jax.lax.dot(xq, wq, preferred_element_type=jnp.int32)
+            return act.apply(acc.astype(jnp.float32) * scale + b)
+
+        return ref
+
+    def make_inputs(self, env, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        kx, kw, ks, kb = jax.random.split(jax.random.PRNGKey(seed), 4)
+        xq = jax.random.randint(kx, (env.m, env.k), -127, 128, jnp.int8)
+        wq = jax.random.randint(kw, (env.k, env.n), -127, 128, jnp.int8)
+        scale = jax.random.uniform(ks, (env.n,), jnp.float32, 0.5, 2.0) / 127
+        b = jax.random.normal(kb, (env.n,), jnp.float32)
+        return xq, wq, scale, b
+
+
 class ConvBnActKernel(_MatmulKernel):
     """Fused 1x1-conv + batch-norm statistics — the dominant trace
     fusion class (round-2 ``ops/conv_fused`` experiment): the matmul
@@ -514,6 +568,7 @@ class KernelRegistry:
 
 REGISTRY = KernelRegistry()
 REGISTRY.register(MatmulBiasActKernel())
+REGISTRY.register(Int8MatmulBiasActKernel())
 REGISTRY.register(ConvBnActKernel())
 REGISTRY.register(FlashAttentionKernel())
 REGISTRY.register(PagedDecodeAttentionKernel())

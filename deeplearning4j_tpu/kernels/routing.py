@@ -203,6 +203,58 @@ def _route_conv1x1(layer, params, state, x, train, rng):
     return y2.reshape(b_, h_o, w_o, layer.n_out), state
 
 
+def _route_quant_dense(layer, params, state, x, train, rng):
+    from deeplearning4j_tpu.conf.layers_quant import (
+        QuantizedDenseLayer,
+        quantize_input,
+    )
+
+    if type(layer).forward is not QuantizedDenseLayer.forward:
+        return None
+    if x.ndim != 2 or not _elementwise(layer.activation):
+        return None
+    m, k = x.shape
+    sel = REGISTRY.select("matmul_bias_act_int8",
+                          _env(m, k, layer.n_out, "int8",
+                               act=layer.activation.value))
+    if sel is None:
+        return None
+    # the round/clip/cast stays in XLA (it fuses into the surrounding
+    # program); the kernel receives the already-int8 activations
+    xq = quantize_input(x, params["xs"], params["xz"])
+    y = sel.kernel.build(sel.env, sel.tiling)(xq, params["Wq"],
+                                              params["scale"], params["b"])
+    _record_selected("matmul_bias_act_int8", sel.env)
+    return y.astype(x.dtype), state
+
+
+def _route_quant_conv1x1(layer, params, state, x, train, rng):
+    from deeplearning4j_tpu.conf.layers_quant import (
+        QuantizedConv1x1Layer,
+        quantize_input,
+    )
+
+    if type(layer).forward is not QuantizedConv1x1Layer.forward:
+        return None
+    if x.ndim != 4 or not _elementwise(layer.activation):
+        return None
+    sh, sw = _pair(layer.stride)
+    b_, h, wd, cin = x.shape
+    h_o, w_o = -(-h // sh), -(-wd // sw)
+    m = b_ * h_o * w_o
+    sel = REGISTRY.select("matmul_bias_act_int8",
+                          _env(m, cin, layer.n_out, "int8",
+                               act=layer.activation.value))
+    if sel is None:
+        return None
+    xs = x[:, ::sh, ::sw, :] if (sh, sw) != (1, 1) else x
+    xq = quantize_input(xs.reshape(m, cin), params["xs"], params["xz"])
+    y2 = sel.kernel.build(sel.env, sel.tiling)(xq, params["Wq"],
+                                               params["scale"], params["b"])
+    _record_selected("matmul_bias_act_int8", sel.env)
+    return y2.reshape(b_, h_o, w_o, layer.n_out).astype(x.dtype), state
+
+
 def _route_fused_conv_bn(layer, params, state, x, train, rng):
     from deeplearning4j_tpu.conf.layers_cnn import (
         FusedConvBN1x1,
@@ -312,6 +364,10 @@ def maybe_forward(layer, params, state, x, train=False, rng=None, **kw):
         ConvolutionLayer,
         FusedConvBN1x1,
     )
+    from deeplearning4j_tpu.conf.layers_quant import (
+        QuantizedConv1x1Layer,
+        QuantizedDenseLayer,
+    )
 
     if isinstance(layer, SelfAttentionLayer):
         mask = kw.pop("mask", None)
@@ -321,6 +377,10 @@ def maybe_forward(layer, params, state, x, train=False, rng=None, **kw):
                                      mask)
     if kw:
         return None
+    if isinstance(layer, QuantizedDenseLayer):
+        return _route_quant_dense(layer, params, state, x, train, rng)
+    if isinstance(layer, QuantizedConv1x1Layer):
+        return _route_quant_conv1x1(layer, params, state, x, train, rng)
     if isinstance(layer, FusedConvBN1x1):
         return _route_fused_conv_bn(layer, params, state, x, train, rng)
     if isinstance(layer, ConvolutionLayer):
@@ -377,7 +437,32 @@ def _layer_envelope(layer, itype, batch: int, dtype,
         ConvolutionMode,
         FusedConvBN1x1,
     )
+    from deeplearning4j_tpu.conf.layers_quant import (
+        QuantizedConv1x1Layer,
+        QuantizedDenseLayer,
+    )
 
+    if isinstance(layer, QuantizedDenseLayer) \
+            and type(layer).forward is QuantizedDenseLayer.forward \
+            and _elementwise(layer.activation):
+        try:
+            from deeplearning4j_tpu.conf.layers import _as_ff_size
+
+            k = _as_ff_size(itype)
+        except ValueError:
+            return None
+        return ("matmul_bias_act_int8",
+                _env(batch, k, layer.n_out, "int8",
+                     act=layer.activation.value, mode=mode))
+    if isinstance(layer, QuantizedConv1x1Layer) \
+            and type(layer).forward is QuantizedConv1x1Layer.forward \
+            and isinstance(itype, it.Convolutional) \
+            and _elementwise(layer.activation):
+        sh, sw = _pair(layer.stride)
+        m = batch * (-(-itype.height // sh)) * (-(-itype.width // sw))
+        return ("matmul_bias_act_int8",
+                _env(m, itype.channels, layer.n_out, "int8",
+                     act=layer.activation.value, mode=mode))
     if isinstance(layer, SelfAttentionLayer) \
             and type(layer).forward is SelfAttentionLayer.forward \
             and isinstance(itype, it.Recurrent) \

@@ -37,19 +37,38 @@ ComputationGraph still gets the donation-safe clone + optional bf16).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
+import threading
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deeplearning4j_tpu.conf.activations import Activation
-from deeplearning4j_tpu.conf.layers import ActivationLayer, DropoutLayer
+from deeplearning4j_tpu.conf.inputs import FeedForward as _FFType
+from deeplearning4j_tpu.conf.inputs import Convolutional as _ConvType
+from deeplearning4j_tpu.conf.layers import (
+    ActivationLayer,
+    DenseLayer,
+    DropoutLayer,
+    OutputLayer,
+)
 from deeplearning4j_tpu.conf.layers_cnn import (
     BatchNormalization,
     ConvolutionLayer,
     ConvolutionMode,
     FusedConvBN1x1,
 )
+from deeplearning4j_tpu.conf.layers_quant import (
+    QuantizationSpec,
+    QuantizedConv1x1Layer,
+    QuantizedDenseLayer,
+)
+from deeplearning4j_tpu.nn import io as nn_io
 from deeplearning4j_tpu.ops.conv_fused import bn_fold_scale_shift
+from deeplearning4j_tpu.telemetry import spans
 
 
 def _copy_tree(tree):
@@ -92,6 +111,18 @@ def optimize_for_inference(model, fold_bn: bool = True, prune: bool = True,
     list; ``fold_bn=False`` / ``prune=False`` disable individual
     transforms (the copy is still made)."""
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    if (isinstance(model, MultiLayerNetwork)
+            and getattr(model.conf, "quantization", None) is not None):
+        # already a quantized artifact: the structural transforms ran before
+        # quantization and a re-pass (e.g. the engine's adopt-time bf16
+        # policy) would cast the f32 scales/zero-points and corrupt the
+        # calibrated math — deliver the donation-safe copy untouched
+        out = MultiLayerNetwork(model.conf)
+        out.params = _copy_tree(model.params)
+        out.state = _copy_tree(model.state)
+        out.opt_state = {}
+        return out
 
     if not isinstance(model, MultiLayerNetwork):
         # structural pass is sequential-only; still deliver the
@@ -165,4 +196,267 @@ def optimize_for_inference(model, fold_bn: bool = True, prune: bool = True,
     # opt_state stays empty: the serving copy never trains; a fit() on it
     # would re-init, which is the safe failure mode
     out.opt_state = {}
+    return out
+
+
+# --------------------------------------------------------------------------
+# post-training int8 quantization (calibrate -> quantize_for_inference)
+#
+# Scheme/math live in conf.layers_quant; this module owns the host-side
+# pipeline: observe per-channel activation ranges over a calibration set,
+# digest them deterministically, and emit the quantized artifact as a pure
+# function of (f32 model, calibration record). The process-global record
+# registry backs PRG208: a ``q:<scheme>:<digest8>`` token in a step key must
+# resolve to a live record here, so a stale executable surviving past a
+# recalibration is an analysis ERROR, not a silent accuracy drift.
+# --------------------------------------------------------------------------
+
+QUANT_SCHEMES = ("int8",)
+
+
+@dataclasses.dataclass
+class CalibrationRecord:
+    """Per-channel activation ranges for every quantizable layer of the
+    BN-folded serving graph, plus the digest that stamps the artifact."""
+
+    scheme: str
+    seed: int
+    clip_percentile: float
+    graph: str                # graph_signature of the folded f32 conf
+    batches: int
+    ranges: Dict[str, Dict[str, List[float]]]  # layer idx -> {lo, hi}
+    digest: str = ""
+    restored: bool = False    # re-registered from a restored artifact's spec
+
+
+_CAL_LOCK = threading.Lock()
+_CALIBRATIONS: Dict[str, CalibrationRecord] = {}  # keyed by digest[:8]
+
+
+def register_calibration(record: CalibrationRecord) -> None:
+    with _CAL_LOCK:
+        _CALIBRATIONS[record.digest[:8]] = record
+
+
+def register_restored(spec) -> None:
+    """Re-register a calibration from a restored artifact's conf spec
+    (``ModelRegistry.load``): ranges are gone but scheme+digest liveness is
+    what PRG208 audits — a restore makes its executables legitimate."""
+    with _CAL_LOCK:
+        if spec.digest[:8] not in _CALIBRATIONS:
+            _CALIBRATIONS[spec.digest[:8]] = CalibrationRecord(
+                scheme=spec.scheme, seed=spec.seed,
+                clip_percentile=spec.clip_percentile, graph="", batches=0,
+                ranges={}, digest=spec.digest, restored=True)
+
+
+def lookup_calibration(digest: str) -> Optional[CalibrationRecord]:
+    """Record for a full digest or its 8-hex step-key prefix, else None."""
+    with _CAL_LOCK:
+        rec = _CALIBRATIONS.get(digest[:8])
+    if rec is not None and len(digest) > 8 and not digest.startswith(
+            rec.digest[:len(digest)]):
+        return None
+    return rec
+
+
+def clear_calibrations() -> None:
+    """Test hook: forget every live record (simulates a recalibrated or
+    restarted process for the PRG208 staleness fixtures)."""
+    with _CAL_LOCK:
+        _CALIBRATIONS.clear()
+
+
+def _quantizable(layer, input_type) -> bool:
+    """Eligible for int8 replacement on the BN-folded graph: plain Dense
+    (not the loss head — score()/loss math stays f32-exact) with
+    feed-forward input, or a plain 1x1 conv (dilation 1, SAME/0-pad)."""
+    if isinstance(layer, OutputLayer):
+        return False
+    if isinstance(layer, DenseLayer):
+        return (type(layer).forward is DenseLayer.forward
+                and isinstance(input_type, _FFType))
+    if type(layer) is ConvolutionLayer:
+        kh, kw = layer.kernel_size if isinstance(layer.kernel_size, tuple) \
+            else (layer.kernel_size, layer.kernel_size)
+        dh, dw = layer.dilation if isinstance(layer.dilation, tuple) \
+            else (layer.dilation, layer.dilation)
+        return ((kh, kw) == (1, 1) and (dh, dw) == (1, 1)
+                and isinstance(input_type, _ConvType)
+                and (layer.convolution_mode is ConvolutionMode.SAME
+                     or tuple(layer.padding) == (0, 0)))
+    return False
+
+
+def _range_digest(scheme, seed, clip_percentile, graph, ranges) -> str:
+    payload = json.dumps(
+        {"scheme": scheme, "seed": seed, "clip_percentile": clip_percentile,
+         "graph": graph, "ranges": ranges},
+        sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def calibrate(model, batches, clip_percentile: float = 99.9,
+              scheme: str = "int8", seed: Optional[int] = None
+              ) -> CalibrationRecord:
+    """Observe per-channel activation ranges for every quantizable layer.
+
+    Runs the standard inference fold first (BN fold + prune) so ranges are
+    recorded against the exact graph :func:`quantize_for_inference` will
+    transform, then feeds each calibration batch forward and keeps a
+    running min/max of the per-batch ``clip_percentile`` bounds per input
+    channel. Everything after the forward pass is host-side numpy under a
+    ``quant_calibrate`` span; the result digest is a deterministic function
+    of (ranges, graph, knobs) — same calibration set + seed => same digest.
+
+    ``batches``: iterable of feature arrays (or ``(features, labels)``
+    tuples / DataSet-likes, in which case the features are taken).
+    """
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize import aot_cache
+
+    if not isinstance(model, MultiLayerNetwork):
+        raise TypeError("calibrate() needs a MultiLayerNetwork")
+    if getattr(model.conf, "quantization", None) is not None:
+        raise ValueError("model is already quantized")
+    if scheme not in QUANT_SCHEMES:
+        raise ValueError(f"unknown quantization scheme {scheme!r} "
+                         f"(supported: {QUANT_SCHEMES})")
+
+    opt = optimize_for_inference(model)
+    itypes = opt.conf.input_types()
+    eligible = [i for i, lyr in enumerate(opt.conf.layers)
+                if _quantizable(lyr, itypes[i])]
+    if not eligible:
+        raise ValueError("no quantizable layers (plain Dense / 1x1 conv) "
+                         "in the folded serving graph")
+
+    lo_hi: Dict[int, list] = {}
+    n_batches = 0
+    p_lo, p_hi = 100.0 - clip_percentile, clip_percentile
+    for batch in batches:
+        feats = batch[0] if isinstance(batch, (tuple, list)) else \
+            getattr(batch, "features", batch)
+        acts = opt.feed_forward(feats)
+        with spans.span("quant_calibrate"):
+            x0 = np.asarray(nn_io.dequant(
+                nn_io.as_device(feats, opt._dtype, feature=True),
+                opt._dtype))
+            n_batches += 1
+            for i in eligible:
+                x = x0 if i == 0 else np.asarray(acts[i - 1])
+                v = x.reshape(-1, x.shape[-1]).astype(np.float64)
+                blo = np.percentile(v, p_lo, axis=0)
+                bhi = np.percentile(v, p_hi, axis=0)
+                if i not in lo_hi:
+                    lo_hi[i] = [blo, bhi]
+                else:
+                    lo_hi[i][0] = np.minimum(lo_hi[i][0], blo)
+                    lo_hi[i][1] = np.maximum(lo_hi[i][1], bhi)
+    if not n_batches:
+        raise ValueError("empty calibration set")
+
+    graph = aot_cache.graph_signature(opt.conf)
+    ranges = {
+        str(i): {"lo": [float(np.float32(v)) for v in lo],
+                 "hi": [float(np.float32(v)) for v in hi]}
+        for i, (lo, hi) in sorted(lo_hi.items())
+    }
+    seed = int(model.conf.seed if seed is None else seed)
+    rec = CalibrationRecord(
+        scheme=scheme, seed=seed, clip_percentile=float(clip_percentile),
+        graph=graph, batches=n_batches, ranges=ranges,
+        digest=_range_digest(scheme, seed, float(clip_percentile), graph,
+                             ranges))
+    register_calibration(rec)
+    return rec
+
+
+def _quantize_linear(W, b, lo, hi):
+    """The core affine fold (see conf.layers_quant docstring): returns
+    ``(Wq int8 [K,N], scale f32 [N], b_eff f32 [N], xs f32 [K], xz f32 [K])``
+    as a deterministic numpy function of the f32 weights + ranges."""
+    W = np.asarray(W, np.float64)
+    lo = np.asarray(lo, np.float64)
+    hi = np.asarray(hi, np.float64)
+    xs = np.maximum((hi - lo) / 255.0, 1e-8)
+    xz = -128.0 - lo / xs
+    W2 = W * xs[:, None]
+    ws = np.maximum(np.abs(W2).max(axis=0) / 127.0, 1e-12)
+    Wq = np.clip(np.rint(W2 / ws), -127, 127).astype(np.int8)
+    corr = ws * (xz @ Wq.astype(np.float64))
+    b_eff = np.asarray(b, np.float64) - corr
+    return (Wq, ws.astype(np.float32), b_eff.astype(np.float32),
+            xs.astype(np.float32), xz.astype(np.float32))
+
+
+def quantize_for_inference(model, calibration: CalibrationRecord):
+    """Emit the int8 serving artifact: BN-fold/prune exactly as
+    :func:`optimize_for_inference`, then replace every calibrated layer
+    with its ``conf.layers_quant`` twin and stamp the conf with a
+    :class:`QuantizationSpec` carrying the calibration digest.
+
+    Deterministic: the artifact is a pure function of the f32 model and the
+    calibration record — same calibration set + seed => bit-identical
+    quantized params and the same ``q:<scheme>:<digest8>`` step-key token.
+    The mixed-precision compute policy is dropped (epilogues are f32; the
+    hot matmuls are int8 already).
+    """
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize import aot_cache
+
+    if not isinstance(model, MultiLayerNetwork):
+        raise TypeError("quantize_for_inference() needs a MultiLayerNetwork")
+    if getattr(model.conf, "quantization", None) is not None:
+        raise ValueError("model is already quantized")
+    if calibration.scheme not in QUANT_SCHEMES:
+        raise ValueError(f"unknown scheme {calibration.scheme!r}")
+
+    opt = optimize_for_inference(model)
+    graph = aot_cache.graph_signature(opt.conf)
+    if calibration.graph != graph:
+        raise ValueError(
+            "calibration record was built for a different graph "
+            f"({calibration.graph[:12]}… != {graph[:12]}…); recalibrate "
+            "against this model")
+
+    itypes = opt.conf.input_types()
+    new_layers = list(opt.conf.layers)
+    for key, rng in calibration.ranges.items():
+        i = int(key)
+        layer = new_layers[i]
+        if not _quantizable(layer, itypes[i]):
+            raise ValueError(f"calibrated layer {i} is not quantizable in "
+                             "this graph (topology drift?)")
+        p = opt.params[str(i)]
+        if isinstance(layer, DenseLayer):
+            W = np.asarray(p["W"], np.float32)
+            qlayer = QuantizedDenseLayer(
+                name=layer.name, activation=layer.activation,
+                n_out=layer.n_out)
+        else:  # plain 1x1 conv, W is [1, 1, Cin, Cout]
+            W = np.asarray(p["W"], np.float32).reshape(
+                p["W"].shape[2], p["W"].shape[3])
+            qlayer = QuantizedConv1x1Layer(
+                name=layer.name, activation=layer.activation,
+                n_out=layer.n_out, stride=tuple(layer.stride))
+        b = np.asarray(p["b"], np.float32) if "b" in p else \
+            np.zeros((W.shape[1],), np.float32)
+        Wq, ws, b_eff, xs, xz = _quantize_linear(W, b, rng["lo"], rng["hi"])
+        new_layers[i] = qlayer
+        opt.params[str(i)] = {
+            "Wq": jnp.asarray(Wq), "scale": jnp.asarray(ws),
+            "b": jnp.asarray(b_eff), "xs": jnp.asarray(xs),
+            "xz": jnp.asarray(xz)}
+
+    spec = QuantizationSpec(
+        scheme=calibration.scheme, digest=calibration.digest,
+        seed=calibration.seed, clip_percentile=calibration.clip_percentile)
+    conf = dataclasses.replace(
+        opt.conf, layers=tuple(new_layers), compute_dtype=None,
+        quantization=spec)
+    out = MultiLayerNetwork(conf)
+    out.params, out.state = opt.params, opt.state
+    out.opt_state = {}
+    register_calibration(calibration)
     return out

@@ -70,6 +70,37 @@ def image_input(input_type) -> bool:
     return isinstance(input_type, (it.Convolutional, it.ConvolutionalFlat))
 
 
+def warm_dtype_variants(input_types, base_dtype, quantization=None):
+    """THE source of truth for the client-visible input-dtype variant sets
+    a serving engine must pre-compile per padding bucket
+    (``InferenceEngine.warmup`` delegates here; keep any new variant in
+    this one derivation).
+
+    Per input: image-typed inputs reach the device as either the float
+    base dtype or raw uint8 (the quantized-feature path of
+    :func:`as_device` — a DIFFERENT aval, hence a different executable),
+    so both are covered; everything else serves the base dtype only.
+    ``quantization`` (the conf's ``QuantizationSpec``) adds no variant:
+    int8 quantization happens in-graph behind the same f32/uint8 client
+    avals, keyed by the artifact's ``q:<scheme>:<digest8>`` token — the
+    quantized executables are warmed through this same product, just
+    under their own keys. Returns the cross-product list of per-input
+    dtype tuples.
+    """
+    import itertools
+
+    import numpy as np
+
+    base = np.dtype(base_dtype)
+    per_input = []
+    for t in input_types:
+        if t is not None and image_input(t):
+            per_input.append((base, np.dtype(np.uint8)))
+        else:
+            per_input.append((base,))
+    return list(itertools.product(*per_input))
+
+
 # bounded dispatch depth for async fit loops: each host sync costs a
 # ~100ms tunnel round-trip, so the pipeline should be deep enough to queue
 # a whole small epoch (device-resident data: 12 deep measured 984 img/s vs

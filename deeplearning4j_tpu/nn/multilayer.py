@@ -153,6 +153,18 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
 
         return kernels.cache_tag(self.conf)
 
+    def _qtag(self) -> str:
+        """Quantization step-key token: empty unless the conf carries a
+        ``QuantizationSpec`` (default-off is bitwise inert — every
+        pre-quantization key is unchanged), else ``:q:<scheme>:<digest8>``
+        so a RECALIBRATION mints a new executable instead of silently
+        serving stale scales, and PRG208 can audit every quantized
+        executable against the live calibration records."""
+        q = getattr(self.conf, "quantization", None)
+        if q is None:
+            return ""
+        return f":q:{q.scheme}:{q.digest[:8]}"
+
     # --- functional core ---------------------------------------------------
     def _forward(self, params, state, x, train: bool, rng, fmask=None,
                  upto: int = None, carries=None):
@@ -391,7 +403,7 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
             jax.jit(step, donate_argnums=(0, 1, 2, 7)),
             self._graph_key(),
             f"train_step:d012+itc{health.cache_tag()}"
-            f"{self._train_step_ktag}")
+            f"{self._train_step_ktag}{self._qtag()}")
 
     def _build_output_fn(self):
         def out(params, state, x, fmask):
@@ -403,7 +415,7 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
 
         self._output_ktag = self._ktag()
         return aot_cache.wrap(jax.jit(out), self._graph_key(),
-                              f"output{self._output_ktag}")
+                              f"output{self._output_ktag}{self._qtag()}")
 
     def _build_rnn_step_fn(self):
         def out(params, state, carries, x, fmask):
@@ -428,7 +440,7 @@ class MultiLayerNetwork(nn_io.LazyScoreMixin):
 
         self._score_ktag = self._ktag()
         return aot_cache.wrap(jax.jit(score), self._graph_key(),
-                              f"score{self._score_ktag}")
+                              f"score{self._score_ktag}{self._qtag()}")
 
     # --- training ----------------------------------------------------------
     def fit(self, data, labels=None, epochs: int = 1,

@@ -478,24 +478,21 @@ class InferenceEngine:
         }
 
     def _warm_dtype_sets(self, k: int) -> List[tuple]:
-        """Input-dtype combinations warmup must cover. Image-typed inputs
-        reach the device as uint8 (the quantized feature path of
-        ``nn_io.as_device(..., feature=True)``) — a DIFFERENT aval than
-        the float path, hence a different executable — and a client may
-        legitimately send either, so both variants are pre-compiled for
-        every bucket."""
+        """Input-dtype combinations warmup must cover — delegated to
+        ``nn_io.warm_dtype_variants``, the ONE derivation of the variant
+        set (f32/uint8-image/int8-quantized semantics documented there),
+        so engine warmup, the platform's deploy/promote warms, and any
+        future caller can never drift apart."""
         from deeplearning4j_tpu.nn import io as nn_io
-        import itertools
 
         types = _input_types(self.model)
-        per_input = []
-        for i in range(k):
-            t = types[i] if types is not None and i < len(types) else None
-            if t is not None and nn_io.image_input(t):
-                per_input.append((self._np_dtype, np.dtype(np.uint8)))
-            else:
-                per_input.append((self._np_dtype,))
-        return list(itertools.product(*per_input))
+        conf = getattr(getattr(self.model, "model", self.model), "conf",
+                       None)
+        padded = [types[i] if types is not None and i < len(types) else None
+                  for i in range(k)]
+        return nn_io.warm_dtype_variants(
+            padded, self._np_dtype,
+            quantization=getattr(conf, "quantization", None))
 
     def _warm_one(self, args):
         try:

@@ -184,12 +184,22 @@ class ModelRegistry:
                               default=0)
             path = d / f"v{version:04d}.zip"
             serializer.write_model(net, path, save_updater=save_updater)
-            manifest["versions"].append({
+            entry = {
                 "version": version,
                 "file": path.name,
                 "sha256": serializer.file_digest(path),
                 "model_class": type(net).__name__,
-            })
+            }
+            spec = getattr(getattr(net, "conf", None), "quantization", None)
+            if spec is not None:
+                # quantized artifacts are ordinary VERSIONS: the manifest
+                # records scheme + calibration digest so load() can
+                # re-verify the restored conf against what was published
+                entry["quantization"] = {
+                    "scheme": spec.scheme,
+                    "calibration_digest": spec.digest,
+                }
+            manifest["versions"].append(entry)
             self._write_manifest_locked(name, manifest)
         return version
 
@@ -228,11 +238,36 @@ class ModelRegistry:
                 raise ModelIntegrityError(
                     f"model {name!r} v{ent['version']}: sha256 mismatch "
                     f"({ent['file']} corrupted or tampered) — load refused")
-            return serializer.restore_model(path)
+            net = serializer.restore_model(path)
+            self._verify_quantization(name, ent, net)
+            return net
 
         net = retry.call(once, op="model.load") if retry is not None \
             else once()
         return net, ent["version"]
+
+    @staticmethod
+    def _verify_quantization(name: str, ent: dict, net) -> None:
+        """Cross-check the restored conf's QuantizationSpec against the
+        manifest entry (both directions — a quantized zip under an
+        unquantized manifest row is as wrong as the reverse), then
+        re-register the calibration digest as live so PRG208 accepts the
+        executables this restore is about to mint."""
+        qent = ent.get("quantization")
+        spec = getattr(getattr(net, "conf", None), "quantization", None)
+        if qent is None and spec is None:
+            return
+        if (qent is None or spec is None
+                or spec.scheme != qent.get("scheme")
+                or spec.digest != qent.get("calibration_digest")):
+            raise ModelIntegrityError(
+                f"model {name!r} v{ent['version']}: quantization metadata "
+                f"mismatch between manifest ({qent}) and restored artifact "
+                f"({spec and (spec.scheme, spec.digest[:12] + '…')}) — "
+                f"load refused")
+        from deeplearning4j_tpu.nn import inference_opt as _iopt
+
+        _iopt.register_restored(spec)
 
     # --- introspection ------------------------------------------------------
     def models(self) -> List[str]:
@@ -257,6 +292,29 @@ class ModelRegistry:
         path = self._dir(name) / ent["file"]
         return path.exists() \
             and serializer.file_digest(path) == ent["sha256"]
+
+
+def _output_delta(a, b) -> float:
+    """Max-abs elementwise delta between two prediction outputs (arrays or
+    lists of arrays) — the accuracy arm's scalar. Shape/arity drift is
+    ``inf``: structurally different outputs are maximally regressed."""
+    import numpy as np
+
+    la = list(a) if isinstance(a, (list, tuple)) else [a]
+    lb = list(b) if isinstance(b, (list, tuple)) else [b]
+    if len(la) != len(lb):
+        return float("inf")
+    worst = 0.0
+    for x, y in zip(la, lb):
+        x = np.asarray(x)
+        y = np.asarray(y)
+        if x.shape != y.shape:
+            return float("inf")
+        if x.size:
+            d = float(np.max(np.abs(x.astype(np.float64)
+                                    - y.astype(np.float64))))
+            worst = max(worst, d)
+    return worst
 
 
 # --------------------------------------------------------------------------
@@ -302,6 +360,17 @@ class CanaryGate:
     max_p95_ratio: Optional[float] = None   # canary p95 / incumbent p95
     trip_on_breaker_open: bool = True
     window: int = 50                  # per-arm outcome window size
+    # accuracy arm (quantized rollouts): per-request max-abs output delta
+    # vs the f32 incumbent, measured by replaying a deterministically
+    # sampled subset of successful canary requests through the incumbent
+    # ENGINE (off the routing stats, so the incumbent's gate arm is not
+    # polluted). The sample draw comes from its own (seed, model) stream —
+    # the routing stream is untouched, so enabling the arm never changes
+    # which requests the canary serves. Trips IMMEDIATELY (no min_requests
+    # wait): an accuracy regression is deterministic model badness, and
+    # the synchronous compare makes the rollback request index replayable.
+    max_accuracy_delta: Optional[float] = None
+    accuracy_sample: float = 1.0      # fraction of canary hits compared
 
 
 class _ArmStats:
@@ -348,10 +417,12 @@ class _ArmStats:
 
 class _Canary:
     __slots__ = ("version", "engine", "src_model", "fraction", "gate",
-                 "rng", "stats", "rolled_back_at", "rollback_reason")
+                 "rng", "stats", "rolled_back_at", "rollback_reason",
+                 "acc_rng", "accuracy_samples", "accuracy_max_delta",
+                 "accuracy_last_delta")
 
     def __init__(self, version, engine, src_model, fraction, gate, rng,
-                 window):
+                 window, acc_rng=None):
         self.version = version
         self.engine = engine
         self.src_model = src_model
@@ -361,6 +432,20 @@ class _Canary:
         self.stats = _ArmStats(window)
         self.rolled_back_at: Optional[int] = None
         self.rollback_reason: Optional[str] = None
+        # accuracy arm state (gate.max_accuracy_delta), all under the
+        # platform lock; acc_rng is a SEPARATE seeded stream from the
+        # routing rng so sampling never perturbs arm selection
+        self.acc_rng = acc_rng
+        self.accuracy_samples = 0
+        self.accuracy_max_delta = 0.0
+        self.accuracy_last_delta: Optional[float] = None
+
+    def accuracy_snapshot(self) -> dict:
+        return {
+            "accuracy_samples": self.accuracy_samples,
+            "accuracy_max_delta": self.accuracy_max_delta,
+            "accuracy_last_delta": self.accuracy_last_delta,
+        }
 
 
 class _Tenant:
@@ -585,9 +670,12 @@ class ModelPlatform:
         # the FaultPlan seeding discipline: the k-th draw is a pure
         # function of (seed, model) — replays route identically
         rng = random.Random(f"{self.seed}:{name}:canary")
+        # the accuracy arm samples from its OWN pure (seed, model) stream:
+        # enabling/disabling it leaves the routing draws byte-identical
+        acc_rng = random.Random(f"{self.seed}:{name}:accuracy")
         with self._lock:
             tenant.canary = _Canary(ver, engine, src, fraction, gate, rng,
-                                    gate.window)
+                                    gate.window, acc_rng=acc_rng)
             # fresh comparison windows for both arms: the gate judges
             # the canary against the incumbent's CONCURRENT behavior,
             # not against stale pre-canary history
@@ -599,8 +687,12 @@ class ModelPlatform:
     def promote(self, name: str) -> dict:
         """Make the canary the primary: its weights publish into the
         (warmed) primary engine, the canary engine closes, the tenant
-        records the new version. Zero recompiles for a same-conf
-        version — the same invariant as :meth:`swap`."""
+        records the new version. The primary engine is then re-warmed
+        under the tenant's budget: for a same-conf version every walk is
+        a cache hit (zero compiles — the same invariant as :meth:`swap`),
+        while a DIFFERENT-conf version (a quantized artifact promoted
+        over its f32 incumbent) pre-compiles its own-keyed executables
+        here instead of on first post-promote traffic."""
         tenant = self._tenant(name)
         with self._lock:
             canary = tenant.canary
@@ -608,12 +700,16 @@ class ModelPlatform:
                 raise RuntimeError(f"model {name!r} has no canary")
             tenant.canary = None
         tenant.engine.publish(canary.src_model)
+        warm, truncated = self._warm_engine(name, tenant.engine,
+                                            tenant.config, tenant.budget)
         with self._lock:
             tenant.src_model = canary.src_model
             tenant.version = canary.version
+            if truncated:
+                tenant.warmup_truncated = True
         self._retire_canary_engine(canary)
         telemetry.record_platform_event("promote", name)
-        return {"model": name, "version": canary.version}
+        return {"model": name, "version": canary.version, "warmup": warm}
 
     @staticmethod
     def _retire_canary_engine(canary: "_Canary") -> None:
@@ -645,7 +741,8 @@ class ModelPlatform:
                 "version": canary.version,
                 "at_request": canary.rolled_back_at,
                 "reason": reason,
-                "canary": canary.stats.snapshot(),
+                "canary": {**canary.stats.snapshot(),
+                           **canary.accuracy_snapshot()},
                 "incumbent": tenant.stats.snapshot(),
             }
         self._retire_canary_engine(canary)
@@ -714,10 +811,37 @@ class ModelPlatform:
         dt = time.monotonic() - t0
         with self._lock:
             arm.stats.record_locked(True, dt)
+        if use_canary and canary.gate.max_accuracy_delta is not None:
+            # synchronous on the caller's thread: the gate sees the delta
+            # BEFORE this request returns, so a regression rolls back at
+            # the same request index across seeded replays
+            self._shadow_accuracy(tenant, canary, inputs, out)
         if self._slo is not None:
             self._slo.observe(name, ok=True, seconds=dt)
         self._check_gate(tenant)
         return out, trace
+
+    def _shadow_accuracy(self, tenant: _Tenant, canary: "_Canary",
+                         inputs, out) -> None:
+        """Accuracy arm: replay a sampled canary request through the
+        incumbent ENGINE (not the platform router — the incumbent's gate
+        arm must not see synthetic traffic) and fold the max-abs output
+        delta into the canary record."""
+        with self._lock:
+            if canary.acc_rng is not None \
+                    and canary.acc_rng.random() >= canary.gate.accuracy_sample:
+                return
+        try:
+            ref = tenant.engine.predict(*inputs)
+        except Exception:
+            return  # incumbent hiccup: no accuracy verdict this request
+        delta = _output_delta(out, ref)
+        with self._lock:
+            canary.accuracy_samples += 1
+            canary.accuracy_last_delta = delta
+            if delta > canary.accuracy_max_delta:
+                canary.accuracy_max_delta = delta
+        telemetry.record_canary_accuracy(tenant.name, delta)
 
     def _check_gate(self, tenant: _Tenant) -> None:
         with self._lock:
@@ -742,6 +866,13 @@ class ModelPlatform:
         if gate.trip_on_breaker_open and canary.engine.breaker is not None \
                 and canary.engine.breaker.state == "open":
             return "canary circuit breaker open"
+        if gate.max_accuracy_delta is not None \
+                and canary.accuracy_max_delta > gate.max_accuracy_delta:
+            # no min_requests wait: output divergence is deterministic
+            # model badness, one confirmed sample is enough
+            return (f"canary output delta {canary.accuracy_max_delta:.6g} "
+                    f"> {gate.max_accuracy_delta:g} vs incumbent "
+                    f"(accuracy arm, {canary.accuracy_samples} samples)")
         if st.requests < gate.min_requests:
             return None
         if gate.max_error_rate_delta is not None:
@@ -851,6 +982,8 @@ class ModelPlatform:
                     "queue_depth": c.engine.queue_depth(),
                     "breaker": cb.state if cb is not None else None,
                     **c.stats.snapshot(),
+                    **(c.accuracy_snapshot()
+                       if c.gate.max_accuracy_delta is not None else {}),
                 }
             if t.last_rollback is not None:
                 row["last_rollback"] = t.last_rollback

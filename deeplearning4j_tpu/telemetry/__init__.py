@@ -338,6 +338,22 @@ def record_analysis_finding(rule: str, severity: str) -> None:
                      rule=rule, severity=severity).inc()
 
 
+def record_canary_accuracy(model: str, delta: float) -> None:
+    """Record one canary accuracy-arm shadow compare
+    (``parallel.platform``): the max-abs output delta between the canary
+    (e.g. an int8 quantized version) and its f32 incumbent on one sampled
+    request. Gauge = last observed delta; the counter tracks sample
+    volume. Rate-bounded by ``CanaryGate.accuracy_sample``, and only
+    active while a gated canary is live — not steady-state hot-path
+    work."""
+    REGISTRY.gauge("dl4j_canary_accuracy_delta",
+                   help="last canary-vs-incumbent output delta",
+                   model=model).set(float(delta))
+    REGISTRY.counter("dl4j_canary_accuracy_samples_total",
+                     help="canary accuracy-arm shadow compares",
+                     model=model).inc()
+
+
 def record_kernel_selected(kernel: str, shape_bucket: str) -> None:
     """Count one kernel-registry routing decision (``kernels.routing``):
     a tuned Pallas kernel was selected for a concrete shape class

@@ -405,6 +405,11 @@ class UIServer:
                 canary = row.get("canary")
                 cell = (f"v{canary['version']} @ {canary['fraction']:.0%} "
                         f"({canary['breaker']})" if canary else "—")
+                if canary and canary.get("accuracy_samples") is not None:
+                    # accuracy arm live (quantized rollout): show the
+                    # worst observed output delta vs the incumbent
+                    cell += (f" Δmax {canary['accuracy_max_delta']:.2g}/"
+                             f"{canary['accuracy_samples']}")
                 last = row.get("last_rollback")
                 rows.append(
                     f"<tr><td>{html.escape(name)}</td>"
@@ -420,7 +425,8 @@ class UIServer:
                      "<tr><th>model</th><th>version</th><th>queue</th>"
                      "<th>breaker</th><th>canary</th><th>last rollback</th>"
                      "</tr>" + "".join(rows) + "</table>")
-        counters = self._metric_table_panel("", "dl4j_platform_")
+        counters = (self._metric_table_panel("", "dl4j_platform_")
+                    + self._metric_table_panel("", "dl4j_canary_"))
         if not table and not counters:
             return ""
         return ('<div class="chart"><h3>Serving platform '

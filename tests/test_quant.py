@@ -1,0 +1,465 @@
+"""Quantized serving end to end (ISSUE 20): int8 post-training
+calibration, the dequant-free kernel path, quantized versions in the
+registry, the accuracy-armed canary gate, and the PRG208 lint rule.
+
+Determinism invariants pinned here:
+- same calibration set + seed -> same digest -> same ``q:`` AOT key
+  token (recalibration mints a NEW executable, never a silent reuse);
+- the quantized artifact is bit-identical across repeated
+  ``quantize_for_inference`` calls and across a registry round-trip;
+- a seeded accuracy regression rolls the canary back at the SAME
+  request index across two fresh replays, with the f32 co-tenant
+  byte-identical throughout;
+- default-off is bitwise inert: no quant config means no ``:q:`` key
+  token, byte-identical serving, zero new compiles.
+
+All AOT assertions read counter DELTAS (the cache is process-global);
+nets that must compile cold use hidden widths no other test uses.
+"""
+
+import dataclasses
+import tempfile
+import zipfile
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import kernels
+from deeplearning4j_tpu.analysis import program as prog
+from deeplearning4j_tpu.conf import Activation, InputType, WeightInit
+from deeplearning4j_tpu.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.conf.layers_cnn import ConvolutionLayer
+from deeplearning4j_tpu.conf.layers_quant import (
+    QuantizationSpec,
+    QuantizedDenseLayer,
+)
+from deeplearning4j_tpu.conf.losses import LossMCXENT
+from deeplearning4j_tpu.conf.multilayer import NeuralNetConfiguration
+from deeplearning4j_tpu.conf.updaters import Sgd
+from deeplearning4j_tpu.kernels.registry import REGISTRY, MatmulEnvelope
+from deeplearning4j_tpu.nn import inference_opt as iopt
+from deeplearning4j_tpu.nn import io as nn_io
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize import aot_cache
+from deeplearning4j_tpu.parallel.batcher import BatchingConfig
+from deeplearning4j_tpu.parallel.platform import (
+    CanaryGate,
+    ModelIntegrityError,
+    ModelPlatform,
+    ModelRegistry,
+    TenantConfig,
+)
+
+pytestmark = pytest.mark.quant
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tuning():
+    kernels.TUNING.clear()
+    yield
+    kernels.TUNING.clear()
+
+
+def _mlp(seed=3, n_in=9, hidden=27, n_out=4, act=Activation.RELU):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+            .weight_init(WeightInit.XAVIER).list()
+            .layer(DenseLayer(n_out=hidden, activation=act))
+            .layer(OutputLayer(n_out=n_out, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _conv_mlp(seed=5, h=4, w=4, c=3, width=11, n_out=3):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+            .weight_init(WeightInit.XAVIER).list()
+            .layer(ConvolutionLayer(n_out=width, kernel_size=(1, 1),
+                                    activation=Activation.RELU))
+            .layer(OutputLayer(n_out=n_out, activation=Activation.SOFTMAX,
+                               loss_fn=LossMCXENT()))
+            .set_input_type(InputType.convolutional(h, w, c)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batches(n_in, n=3, rows=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.normal(size=(rows, n_in)).astype(np.float32)
+            for _ in range(n)]
+
+
+def _leaves(tree):
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
+
+
+def _tree_equal(a, b):
+    la, lb = _leaves(a), _leaves(b)
+    return (len(la) == len(lb)
+            and all(x.dtype == y.dtype and np.array_equal(x, y)
+                    for x, y in zip(la, lb)))
+
+
+def _quantize(net, batches, **kw):
+    rec = iopt.calibrate(net, batches, **kw)
+    return iopt.quantize_for_inference(net, rec), rec
+
+
+# --------------------------------------------------------------------------
+# calibration determinism + key discipline
+# --------------------------------------------------------------------------
+
+def test_calibration_deterministic_same_digest_same_key():
+    """Same calibration set + seed -> same digest -> same AOT key
+    token; a different calibration set mints a NEW digest (and with it
+    a new executable key)."""
+    net = _mlp(hidden=27)
+    batches = _batches(9)
+    q1, r1 = _quantize(net, batches)
+    q2, r2 = _quantize(net, batches)
+    assert r1.digest == r2.digest
+    assert _tree_equal(q1.params, q2.params)
+    assert q1._qtag() == q2._qtag() == f":q:int8:{r1.digest[:8]}"
+    # recalibration against different data = different digest/key
+    _, r3 = _quantize(net, _batches(9, seed=99))
+    assert r3.digest != r1.digest
+
+
+def test_quantized_output_key_carries_qtag():
+    net = _mlp(hidden=29)
+    qnet, rec = _quantize(net, _batches(9))
+    qnet.output(_batches(9, n=1, rows=4)[0])
+    tok = f":q:int8:{rec.digest[:8]}"
+    keys = [k[1] for k in aot_cache._EXECUTABLES]
+    assert any(k.startswith("output") and tok in k for k in keys)
+
+
+def test_default_off_bitwise_inert():
+    """No quant config: no ``:q:`` token, no manifest quantization
+    entry, byte-identical outputs, zero extra compiles on re-serve."""
+    net = _mlp(hidden=31)
+    assert net.conf.quantization is None
+    assert net._qtag() == ""
+    x = _batches(9, n=1, rows=4)[0]
+    before = set(aot_cache._EXECUTABLES)
+    y0 = np.asarray(net.output(x)).tobytes()
+    minted = set(aot_cache._EXECUTABLES) - before
+    assert minted and all(":q:" not in k[1] for k in minted)
+    miss0 = aot_cache.stats()["misses"]
+    assert np.asarray(net.output(x)).tobytes() == y0
+    assert aot_cache.stats()["misses"] == miss0
+    reg = ModelRegistry(tempfile.mkdtemp(prefix="dl4j_q_"))
+    reg.publish("plain", net)
+    assert "quantization" not in reg._read_manifest("plain")["versions"][0]
+
+
+def test_quantize_rejects_mismatched_model():
+    """The calibration record is pinned to the folded graph: quantizing
+    a DIFFERENT architecture with it must refuse, not mis-scale."""
+    net = _mlp(hidden=27)
+    rec = iopt.calibrate(net, _batches(9))
+    other = _mlp(hidden=33)
+    with pytest.raises(ValueError, match="recalibrate"):
+        iopt.quantize_for_inference(other, rec)
+
+
+# --------------------------------------------------------------------------
+# numerics: stock path + kernel parity
+# --------------------------------------------------------------------------
+
+def test_quantized_output_close_to_f32():
+    net = _mlp(hidden=27)
+    qnet, _ = _quantize(net, _batches(9))
+    x = _batches(9, n=1, rows=8)[0]
+    yf = np.asarray(net.output(x))
+    yq = np.asarray(qnet.output(x))
+    assert yq.dtype == yf.dtype
+    np.testing.assert_allclose(yq, yf, atol=0.05)
+
+
+def test_conv1x1_quantizes_and_tracks_f32():
+    net = _conv_mlp(width=11)
+    rng = np.random.default_rng(1)
+    batches = [rng.normal(size=(8, 4, 4, 3)).astype(np.float32)
+               for _ in range(3)]
+    qnet, rec = _quantize(net, batches)
+    names = [type(l).__name__ for l in qnet.conf.layers]
+    assert names[0] == "QuantizedConv1x1Layer"
+    assert names[-1] == "OutputLayer"      # output layer never quantized
+    x = batches[0][:4]
+    np.testing.assert_allclose(np.asarray(qnet.output(x)),
+                               np.asarray(net.output(x)), atol=0.08)
+
+
+def test_int8_kernel_parity_vs_lax_reference():
+    """The Pallas int8 matmul+epilogue (interpret mode on CPU) against
+    the ``jax.lax`` int8->int32 reference, across activations."""
+    kern = REGISTRY.get("matmul_bias_act_int8")
+    for act in ("identity", "relu"):
+        env = MatmulEnvelope(m=16, k=24, n=16, dtype="int8",
+                             backend="interpret", act=act)
+        cands = kern.candidates(env)
+        assert cands
+        fn = jax.jit(kern.build(env, cands[0]))
+        ref = jax.jit(kern.reference(env))
+        args = kern.make_inputs(env, seed=3)
+        np.testing.assert_allclose(np.asarray(fn(*args)),
+                                   np.asarray(ref(*args)),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_routed_quantized_model_matches_stock():
+    """use_kernels + tuned int8 envelopes: the routed quantized forward
+    must match the stock-XLA quantized forward (same int8 math, fused
+    epilogue vs unfused — tight tolerance)."""
+    net = _mlp(hidden=32, n_in=8)
+    batches = _batches(8)
+    qnet, _ = _quantize(net, batches)
+    y_stock = np.asarray(qnet.output(batches[0][:8]))
+
+    conf_on = dataclasses.replace(qnet.conf, use_kernels=True)
+    planned = kernels.plan_envelopes(conf_on, 8)
+    assert any(name == "matmul_bias_act_int8" for name, _ in planned)
+    tuned = kernels.autotune_model(conf_on, 8, max_candidates=4)
+    assert len(tuned) >= 1
+    routed = MultiLayerNetwork(conf_on)
+    routed.params, routed.state = qnet.params, qnet.state
+    y_routed = np.asarray(routed.output(batches[0][:8]))
+    np.testing.assert_allclose(y_routed, y_stock, rtol=1e-4, atol=1e-4)
+
+
+# --------------------------------------------------------------------------
+# registry round-trip + tamper refusal
+# --------------------------------------------------------------------------
+
+def test_registry_roundtrip_reverifies_digest():
+    net = _mlp(hidden=34)
+    qnet, rec = _quantize(net, _batches(9))
+    reg = ModelRegistry(tempfile.mkdtemp(prefix="dl4j_q_"))
+    reg.publish("m", net)
+    v = reg.publish("m", qnet)
+    ent = reg._read_manifest("m")["versions"][-1]
+    assert ent["quantization"] == {"scheme": "int8",
+                                   "calibration_digest": rec.digest}
+    # restore after a simulated process restart (calibration registry
+    # empty): load() re-registers the digest as live for PRG208
+    iopt.clear_calibrations()
+    restored, got_v = reg.load("m", v)
+    assert got_v == v
+    assert _tree_equal(restored.params, qnet.params)
+    spec = restored.conf.quantization
+    assert isinstance(spec, QuantizationSpec) and spec.digest == rec.digest
+    live = iopt.lookup_calibration(rec.digest)
+    assert live is not None and live.restored
+    x = _batches(9, n=1, rows=4)[0]
+    np.testing.assert_array_equal(np.asarray(restored.output(x)),
+                                  np.asarray(qnet.output(x)))
+
+
+def test_registry_tamper_refused():
+    net = _mlp(hidden=35)
+    qnet, rec = _quantize(net, _batches(9))
+    reg = ModelRegistry(tempfile.mkdtemp(prefix="dl4j_q_"))
+    v = reg.publish("m", qnet)
+    # 1) flip a byte in the zip: sha256 refusal
+    path = Path(reg._dir("m")) / f"v{v:04d}.zip"
+    blob = bytearray(path.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    path.write_bytes(bytes(blob))
+    with pytest.raises(ModelIntegrityError, match="sha256"):
+        reg.load("m", v, retry=None)
+    # 2) manifest quantization drift (digest swapped for another run's):
+    #    zip is intact but metadata lies -> quantization mismatch refusal
+    reg2 = ModelRegistry(tempfile.mkdtemp(prefix="dl4j_q_"))
+    v2 = reg2.publish("m", qnet)
+    man = reg2._read_manifest("m")
+    man["versions"][-1]["quantization"]["calibration_digest"] = "0" * 64
+    path2 = Path(reg2._dir("m")) / f"v{v2:04d}.zip"
+    man["versions"][-1]["sha256"] = reg2.digest("m", v2)
+    with reg2._model_lock("m"):
+        reg2._write_manifest_locked("m", man)
+    with pytest.raises(ModelIntegrityError, match="quantization metadata"):
+        reg2.load("m", v2, retry=None)
+
+
+# --------------------------------------------------------------------------
+# warmup unification
+# --------------------------------------------------------------------------
+
+def test_warm_dtype_variants_single_source_of_truth():
+    """nn.io.warm_dtype_variants IS the derivation: image inputs get
+    (f32, uint8), flat inputs f32 only, and a QuantizationSpec adds NO
+    client-visible variant (int8 is in-graph, keyed by the q: token)."""
+    img = InputType.convolutional(4, 4, 3)
+    ff = InputType.feed_forward(9)
+    base = np.dtype(np.float32)
+    u8 = np.dtype(np.uint8)
+    assert nn_io.warm_dtype_variants([ff], base) == [(base,)]
+    assert nn_io.warm_dtype_variants([img], base) == [(base,), (u8,)]
+    spec = QuantizationSpec(scheme="int8", digest="ab" * 32, seed=0)
+    assert (nn_io.warm_dtype_variants([img], base, quantization=spec)
+            == [(base,), (u8,)])
+    assert (nn_io.warm_dtype_variants([img, ff], base)
+            == [(base, base), (u8, base)])
+
+
+def test_engine_warm_sets_delegate_to_io():
+    """The batcher derives its per-bucket warmup variants from the one
+    nn.io source of truth — no parallel derivation to drift."""
+    from deeplearning4j_tpu.parallel.batcher import InferenceEngine
+
+    net = _mlp(hidden=36)
+    eng = InferenceEngine(net, BatchingConfig(max_batch=4), graph_opt=False)
+    try:
+        expected = nn_io.warm_dtype_variants([None], eng._np_dtype,
+                                             quantization=None)
+        assert eng._warm_dtype_sets(1) == expected
+    finally:
+        eng.close()
+
+
+def test_quantized_deploy_warm_zero_recompiles_first_traffic():
+    """A deployed quantized version serves its FIRST request with zero
+    compiles: deploy_canary warms the quantized executables up front."""
+    net = _mlp(hidden=37)
+    qnet, _ = _quantize(net, _batches(9))
+    reg = ModelRegistry(tempfile.mkdtemp(prefix="dl4j_q_"))
+    reg.publish("m", net)
+    reg.publish("m", qnet)
+    plat = ModelPlatform(reg, seed=11)
+    cfg = TenantConfig(batching=BatchingConfig(max_batch=8))
+    try:
+        plat.deploy("m", version=1, config=cfg)
+        plat.deploy_canary("m", version=2, fraction=1.0,
+                           gate=CanaryGate(min_requests=4,
+                                           max_accuracy_delta=0.5))
+        miss0 = aot_cache.stats()["misses"]
+        for i in range(12):
+            plat.predict("m", _batches(9, n=1, rows=2, seed=50 + i)[0])
+        assert aot_cache.stats()["misses"] == miss0
+        st = plat.stats()["m"]["canary"]
+        assert st["accuracy_samples"] > 0
+        assert st["accuracy_max_delta"] < 0.5
+        r = plat.promote("m")
+        assert r["version"] == 2
+        miss1 = aot_cache.stats()["misses"]
+        for i in range(6):
+            plat.predict("m", _batches(9, n=1, rows=2, seed=80 + i)[0])
+        assert aot_cache.stats()["misses"] == miss1
+    finally:
+        plat.close()
+
+
+# --------------------------------------------------------------------------
+# accuracy-armed canary: deterministic regression rollback
+# --------------------------------------------------------------------------
+
+def _corrupted_copy(qnet, factor=10.0):
+    bad = MultiLayerNetwork(qnet.conf)
+    bad.params = {k: dict(v) for k, v in qnet.params.items()}
+    bad.state, bad.opt_state = qnet.state, {}
+    bad.params["0"]["scale"] = qnet.params["0"]["scale"] * factor
+    return bad
+
+
+def test_accuracy_regression_rolls_back_deterministically():
+    """A mis-scaled quantized canary trips the accuracy arm at the SAME
+    request index across two fresh replays (same platform seed, same
+    traffic), while the f32 co-tenant stays byte-identical with zero
+    recompiles after its warmup."""
+    net = _mlp(hidden=38)
+    co = _mlp(seed=9, hidden=39)
+    qnet, _ = _quantize(net, _batches(9))
+    bad = _corrupted_copy(qnet)
+    reg = ModelRegistry(tempfile.mkdtemp(prefix="dl4j_q_"))
+    reg.publish("m", net)
+    reg.publish("co", co)
+    cfg = TenantConfig(batching=BatchingConfig(max_batch=8))
+    probe = _batches(9, n=1, rows=2, seed=7)[0]
+    xs = [_batches(9, n=1, rows=2, seed=200 + i)[0] for i in range(40)]
+
+    replays = []
+    for _trial in range(2):
+        plat = ModelPlatform(reg, seed=42)
+        try:
+            plat.deploy("m", version=1, config=cfg)
+            plat.deploy("co", version=1, config=cfg)
+            co_bytes = np.asarray(plat.predict("co", probe)).tobytes()
+            plat.deploy_canary(
+                "m", version=99, model=bad, fraction=0.5,
+                gate=CanaryGate(min_requests=5, max_accuracy_delta=0.05))
+            miss0 = aot_cache.stats()["misses"]
+            rollback = None
+            for i, x in enumerate(xs):
+                plat.predict("m", x)
+                assert (np.asarray(plat.predict("co", probe)).tobytes()
+                        == co_bytes)
+                lr = plat.stats()["m"].get("last_rollback")
+                if lr:
+                    rollback = lr
+                    break
+            assert rollback is not None, "accuracy arm never tripped"
+            assert "accuracy arm" in rollback["reason"]
+            assert aot_cache.stats()["misses"] == miss0
+            replays.append(rollback["at_request"])
+        finally:
+            plat.close()
+    assert replays[0] == replays[1]
+
+
+# --------------------------------------------------------------------------
+# PRG208 + PRG201 on quantized executables
+# --------------------------------------------------------------------------
+
+def _quant_artifact(qnet, rec, x):
+    def out(params, state, xx, fmask):
+        y, _, _ = qnet._forward(params, state, xx, train=False,
+                                rng=None, fmask=fmask)
+        return y
+
+    return prog.trace_artifact(
+        jax.jit(out), (qnet.params, qnet.state, x, None),
+        graph_key="quant_fixture",
+        fn_key=f"output:q:{rec.scheme}:{rec.digest[:8]}")
+
+
+def test_prg208_negative_control_and_prg201_clean():
+    """A live calibration record: the quantized serving executable
+    lints clean — PRG208 resolves the token and the PRG201 donation
+    audit has nothing to say."""
+    net = _mlp(hidden=41)
+    qnet, rec = _quantize(net, _batches(9))
+    art = _quant_artifact(qnet, rec, _batches(9, n=1, rows=4)[0])
+    findings = prog.lint_program(art)
+    assert not [f for f in findings
+                if f.rule in ("PRG208", "PRG201") and f.severity == "ERROR"]
+
+
+def test_prg208_stale_digest_is_error():
+    """Seeded defect: the calibration registry was cleared (a restart /
+    recalibration) but an executable still carries the old token —
+    stale artifact, ERROR."""
+    net = _mlp(hidden=42)
+    qnet, rec = _quantize(net, _batches(9))
+    art = _quant_artifact(qnet, rec, _batches(9, n=1, rows=4)[0])
+    iopt.clear_calibrations()
+    try:
+        found = [f for f in prog.lint_program(art) if f.rule == "PRG208"]
+        assert any(f.severity == "ERROR"
+                   and "does not resolve" in f.message for f in found)
+    finally:
+        iopt.register_calibration(rec)
+    # re-registered: clean again
+    assert not [f for f in prog.lint_program(art)
+                if f.rule == "PRG208" and f.severity == "ERROR"]
+
+
+def test_prg208_unknown_scheme_is_error():
+    net = _mlp(hidden=43)
+    qnet, rec = _quantize(net, _batches(9))
+    art = _quant_artifact(qnet, rec, _batches(9, n=1, rows=4)[0])
+    bad = dataclasses.replace(
+        art, fn_key=f"output:q:int3:{rec.digest[:8]}")
+    found = [f for f in prog.lint_program(bad) if f.rule == "PRG208"]
+    assert any(f.severity == "ERROR" and "scheme" in f.message
+               for f in found)
